@@ -1,0 +1,41 @@
+"""dgc_tpu — TPU-native distributed graph coloring framework.
+
+A brand-new JAX/XLA/pjit/Pallas framework with the capabilities of the PySpark
+reference ``danitdrvc/Distributed-Graph-Coloring-with-PySpark``: minimal vertex
+coloring of undirected graphs via a bulk-synchronous greedy engine, wrapped in a
+driver-side minimal-k loop, with the reference's JSON graph/coloring schemas.
+
+Instead of RDDs of mutable node objects, driver broadcasts, and shuffle-based
+conflict resolution (reference ``coloring.py:73-132``), the graph lives as
+padded-ELL / CSR device arrays, one coloring superstep is one iteration of a
+``lax.while_loop`` inside a single ``jax.jit`` (neighbor-color gather, bitmask
+first-fit, data-parallel priority conflict resolution), and multi-chip scale
+comes from ``shard_map`` over a vertex-sharded ``jax.sharding.Mesh`` with
+all-gather / ``psum`` collectives over ICI.
+
+Layer map (mirrors SURVEY.md §1 of the reference):
+  L5 CLI/driver      dgc_tpu.cli
+  L4 minimal-k loop  dgc_tpu.engine.minimal_k
+  L3 engines         dgc_tpu.engine.{superstep,dense_engine,sharded,oracle,reference_sim}
+  L2 data model      dgc_tpu.models.{node,graph,arrays,generators}
+  L1 runtime         JAX/XLA (+ dgc_tpu.parallel mesh/collectives, dgc_tpu.native)
+"""
+
+from dgc_tpu.version import __version__
+
+from dgc_tpu.models.node import Node
+from dgc_tpu.models.graph import Graph
+from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.engine.minimal_k import find_minimal_coloring, MinimalColoringResult
+from dgc_tpu.ops.validate import validate_coloring, ValidationResult
+
+__all__ = [
+    "__version__",
+    "Node",
+    "Graph",
+    "GraphArrays",
+    "find_minimal_coloring",
+    "MinimalColoringResult",
+    "validate_coloring",
+    "ValidationResult",
+]

@@ -1,0 +1,23 @@
+"""Coloring engines.
+
+- ``oracle``: sequential NumPy greedy — the parity/validity oracle
+  (SURVEY.md §7.2 step 3).
+- ``reference_sim``: pure-Python BSP replica of the reference's *optimized*
+  engine semantics (``coloring_optimized.py:70-146``) — the behavioral
+  contract the TPU engines are tested against.
+- ``superstep``: single-device jit'd ELL engine (``lax.while_loop``).
+- ``dense_engine``: dense-adjacency MXU engine for small V.
+- ``sharded``: ``shard_map`` multi-device engine.
+- ``minimal_k``: the driver-side outer loop shared by all engines
+  (reference ``coloring.py:215-235``).
+"""
+
+from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.engine.minimal_k import find_minimal_coloring, MinimalColoringResult
+
+__all__ = [
+    "AttemptResult",
+    "AttemptStatus",
+    "find_minimal_coloring",
+    "MinimalColoringResult",
+]

@@ -1,0 +1,62 @@
+"""Engine protocol and result types shared by all coloring engines.
+
+An *engine* answers one question (the reference's ``graph_coloring``
+contract, ``/root/reference/coloring.py:73``): can this graph be colored
+with ``k`` colors — and if so, with what color vector? One call = one
+k-attempt; the minimal-k outer loop drives it.
+"""
+
+from __future__ import annotations
+
+import enum
+from dataclasses import dataclass, field
+from typing import Callable, Protocol
+
+import numpy as np
+
+
+class AttemptStatus(enum.IntEnum):
+    """Superstep-loop exit status (carried inside the jit'd while_loop)."""
+
+    RUNNING = 0
+    SUCCESS = 1      # every vertex colored (reference: uncolored count == 0)
+    FAILURE = 2      # some vertex's forbidden set filled all k colors
+                     # (reference sentinel −3, coloring.py:53,104-108)
+    STALLED = 3      # safety bound hit — must not happen (the priority rule
+                     # guarantees ≥1 vertex colored per superstep; the
+                     # reference's stall guard coloring.py:93-95 exists only
+                     # because its baseline semantics can deadlock, §2.4.1)
+
+
+@dataclass
+class AttemptResult:
+    status: AttemptStatus
+    colors: np.ndarray       # int32[V]; valid coloring iff status == SUCCESS
+    supersteps: int          # BSP rounds executed
+    k: int                   # the color budget attempted
+
+    @property
+    def success(self) -> bool:
+        return self.status == AttemptStatus.SUCCESS
+
+    @property
+    def colors_used(self) -> int:
+        colored = self.colors[self.colors >= 0]
+        return int(colored.max()) + 1 if len(colored) else 0
+
+
+class ColoringEngine(Protocol):
+    """One k-attempt. Implementations: oracle, reference_sim, ell, dense, sharded."""
+
+    def attempt(self, k: int) -> AttemptResult: ...
+
+
+@dataclass
+class SuperstepTrace:
+    """Per-superstep metrics (the reference prints uncolored counts per
+    superstep, ``coloring.py:89`` — tracing subsystem analog, SURVEY.md §5)."""
+
+    uncolored: list[int] = field(default_factory=list)
+
+    def record(self, uncolored: int) -> None:
+        self.uncolored.append(uncolored)

@@ -1,0 +1,118 @@
+"""Dense-adjacency coloring engine — the MXU path for small graphs.
+
+For V up to a few thousand (BASELINE config "dense adjacency"), the whole
+superstep maps onto matrix units instead of gathers:
+
+- **Forbidden sets** are one matmul: ``counts = A @ onehot(colors)`` with
+  ``A`` bf16 [V, V] and the one-hot color matrix bf16 [V, K]; accumulation
+  in f32 keeps counts exact. ``counts[u, c] > 0`` ⇔ some neighbor of u has
+  color c — the reference's per-vertex used-color set
+  (``coloring.py:46-47``) for all vertices at once, on the MXU.
+- **First-fit** picks the lowest free column below the dynamic budget k
+  (optimized-engine semantics: no colored neighbor → candidate 0).
+- **Conflict resolution** is the same (degree desc, id asc) priority rule as
+  the ELL engine, evaluated as a [V, V] elementwise mask against the
+  precomputed beats matrix — fine at dense-engine scale.
+
+K (the one-hot width) is static: Δ+1 rounded up to a lane multiple of 128
+so the matmul tiles cleanly; the dynamic k only masks columns.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.models.arrays import GraphArrays
+
+_RUNNING = AttemptStatus.RUNNING
+_SUCCESS = AttemptStatus.SUCCESS
+_FAILURE = AttemptStatus.FAILURE
+_STALLED = AttemptStatus.STALLED
+
+
+@partial(jax.jit, static_argnames=("kmax", "max_steps"))
+def _attempt_kernel_dense(adj, degrees, k, kmax: int, max_steps: int):
+    """adj: bf16[V, V] symmetric 0/1; k dynamic int32; kmax static."""
+    v = adj.shape[0]
+    ids = jnp.arange(v, dtype=jnp.int32)
+    k = jnp.asarray(k, jnp.int32)
+    col_ids = jnp.arange(kmax, dtype=jnp.int32)
+
+    colors0 = jnp.where(degrees == 0, 0, -1).astype(jnp.int32)
+
+    # loop-invariant priority: does v beat u? (degree desc, id asc —
+    # optimized reference's order, coloring_optimized.py:170-172)
+    beats = (degrees[None, :] > degrees[:, None]) | (
+        (degrees[None, :] == degrees[:, None]) & (ids[None, :] < ids[:, None])
+    )
+    adj_bool = adj > 0
+
+    def cond(carry):
+        _, _, status = carry
+        return status == _RUNNING
+
+    def body(carry):
+        colors, step, status = carry
+        uncol = colors < 0
+        onehot = (colors[:, None] == col_ids[None, :]).astype(jnp.bfloat16)
+        counts = jax.lax.dot(adj, onehot, preferred_element_type=jnp.float32)
+        forbidden = (counts > 0.5) | (col_ids[None, :] >= k)
+        free = ~forbidden
+        cand = jnp.argmax(free, axis=1).astype(jnp.int32)  # first free column
+        fail_v = ~jnp.any(free, axis=1)
+        any_fail = jnp.any(uncol & fail_v)
+
+        same_cand = cand[None, :] == cand[:, None]
+        beaten = adj_bool & uncol[None, :] & same_cand & beats
+        keep = ~jnp.any(beaten, axis=1)
+
+        new_colors = jnp.where(uncol & keep & ~fail_v, cand, colors)
+        uncol_after = jnp.sum(new_colors < 0)
+        status = jnp.where(
+            any_fail,
+            _FAILURE,
+            jnp.where(
+                uncol_after == 0,
+                _SUCCESS,
+                jnp.where(step + 1 >= max_steps, _STALLED, _RUNNING),
+            ),
+        ).astype(jnp.int32)
+        new_colors = jnp.where(any_fail, colors, new_colors)
+        return (new_colors, step + 1, status)
+
+    colors, steps, status = jax.lax.while_loop(
+        cond, body, (colors0, jnp.int32(0), jnp.int32(_RUNNING))
+    )
+    return status, colors, steps
+
+
+class DenseEngine:
+    """Dense-adjacency MXU engine. Memory is O(V²); intended for V ≲ 8192."""
+
+    def __init__(self, arrays: GraphArrays, max_steps: int | None = None):
+        v = arrays.num_vertices
+        if v > 16384:
+            raise ValueError(
+                f"DenseEngine is O(V^2) memory; V={v} is too large — use the ELL or sharded engine"
+            )
+        self.arrays = arrays
+        self.adj = jnp.asarray(arrays.to_dense(), dtype=jnp.bfloat16)
+        self.degrees = jnp.asarray(arrays.degrees)
+        # one-hot width: Δ+1 padded to an MXU-friendly lane multiple
+        self.kmax = max(128, -(-(arrays.max_degree + 1) // 128) * 128)
+        self.max_steps = max_steps if max_steps is not None else v + 2
+
+    def attempt(self, k: int) -> AttemptResult:
+        if k > self.kmax:
+            raise ValueError(f"k={k} exceeds one-hot capacity {self.kmax}")
+        status, colors, steps = _attempt_kernel_dense(
+            self.adj, self.degrees, k, kmax=self.kmax, max_steps=self.max_steps
+        )
+        return AttemptResult(
+            AttemptStatus(int(status)), np.asarray(colors), int(steps), int(k)
+        )

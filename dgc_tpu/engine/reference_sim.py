@@ -1,0 +1,112 @@
+"""Pure-Python BSP replica of the reference engines' semantics.
+
+This is the behavioral contract the TPU engines are tested against — a
+faithful, Spark-free reimplementation of one k-attempt
+(``graph_coloring``) in both reference variants:
+
+- ``variant='optimized'`` (``/root/reference/coloring_optimized.py:70-146``,
+  the semantics the TPU engines adopt):
+  superstep = snapshot colors → per-uncolored-vertex first-fit candidate
+  (*no colored neighbor → candidate 0*, ``coloring_optimized.py:159-160``) →
+  group by candidate color → greedy independent set per color class in
+  **degree-descending** order (``coloring_optimized.py:170-172,190``) →
+  apply kept.
+- ``variant='baseline'`` (``coloring.py:73-132``): candidates *defer*
+  (sentinel −2) when no neighbor is colored (``coloring.py:48-49``), and the
+  per-class greedy IS keeps **degree-ascending** (``coloring.py:64``). The
+  baseline deadlocks on graphs with a component not containing the seed
+  (SURVEY.md §2.4.1); here the unbounded stall becomes ``STALLED`` after the
+  stall guard fires with no possible progress.
+
+Both variants keep the reference's reset pass (isolated vertices → color 0,
+rest → −1, ``coloring.py:12-17``), max-degree seeding (``coloring.py:19-35``;
+ties broken by lowest id — Spark's reduce order is nondeterministic), and the
+failure sentinel (no free color within k → attempt fails,
+``coloring.py:53,104-108``). Greedy-IS insertion order ties (equal degree) are
+broken by ascending id, matching a single-partition Spark run's id order.
+"""
+
+from __future__ import annotations
+
+import numpy as np
+
+from dgc_tpu.engine.base import AttemptResult, AttemptStatus, SuperstepTrace
+from dgc_tpu.models.arrays import GraphArrays
+
+
+class ReferenceSimEngine:
+    def __init__(self, arrays: GraphArrays, variant: str = "optimized", max_supersteps: int | None = None):
+        if variant not in ("optimized", "baseline"):
+            raise ValueError(f"unknown variant: {variant!r}")
+        self.arrays = arrays
+        self.variant = variant
+        self.max_supersteps = max_supersteps
+        self.trace = SuperstepTrace()
+
+    def attempt(self, k: int) -> AttemptResult:
+        arrays = self.arrays
+        v = arrays.num_vertices
+        indptr, indices = arrays.indptr, arrays.indices
+        degrees = arrays.degrees
+        nbrs = [indices[indptr[u]: indptr[u + 1]] for u in range(v)]
+
+        # reset pass: isolated → 0, rest → −1 (coloring.py:12-17)
+        colors = np.where(degrees == 0, 0, -1).astype(np.int32)
+
+        # seed: max-degree uncolored vertex → color 0 (coloring.py:19-35,76)
+        uncolored_ids = np.where(colors < 0)[0]
+        if len(uncolored_ids):
+            seed = uncolored_ids[np.argmax(degrees[uncolored_ids])]
+            colors[seed] = 0
+
+        max_steps = self.max_supersteps if self.max_supersteps is not None else 2 * v + 10
+        prev_uncolored = -1
+        stalled_once = False
+        steps = 0
+        while True:
+            steps += 1
+            if steps > max_steps:
+                return AttemptResult(AttemptStatus.STALLED, colors, steps - 1, k)
+            snapshot = colors.copy()  # broadcast_colors analog (coloring.py:135-137)
+            uncolored = np.where(snapshot < 0)[0]
+            self.trace.record(len(uncolored))
+            if len(uncolored) == 0:
+                return AttemptResult(AttemptStatus.SUCCESS, colors, steps, k)
+            # stall guard (coloring.py:93-95): re-propagate + continue. For
+            # the baseline variant a second consecutive stall with deferral
+            # semantics means no progress is possible → STALLED.
+            if len(uncolored) == prev_uncolored:
+                if self.variant == "baseline" and stalled_once:
+                    return AttemptResult(AttemptStatus.STALLED, colors, steps, k)
+                stalled_once = True
+                prev_uncolored = len(uncolored)
+                continue
+            prev_uncolored = len(uncolored)
+
+            # candidate assignment (determine_color_key / assign_color)
+            candidates: dict[int, list[int]] = {}
+            failed = False
+            for u in uncolored:
+                used = {int(c) for c in snapshot[nbrs[u]] if c >= 0}
+                if not used:
+                    if self.variant == "baseline":
+                        continue  # defer (sentinel −2, coloring.py:48-49)
+                    cand = 0  # eager (coloring_optimized.py:159-160)
+                else:
+                    cand = next((c for c in range(k) if c not in used), None)
+                    if cand is None:
+                        failed = True  # sentinel −3 (coloring.py:53)
+                        break
+                candidates.setdefault(cand, []).append(int(u))
+            if failed:
+                return AttemptResult(AttemptStatus.FAILURE, colors, steps, k)
+
+            # conflict resolution: greedy IS per candidate-color class
+            descending = self.variant == "optimized"
+            for cand, members in candidates.items():
+                members.sort(key=lambda u: (-degrees[u], u) if descending else (degrees[u], u))
+                kept: set[int] = set()
+                for u in members:
+                    if not any(int(w) in kept for w in nbrs[u]):
+                        kept.add(u)
+                        colors[u] = cand

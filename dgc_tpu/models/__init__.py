@@ -1,0 +1,16 @@
+"""Graph data model: Node/Graph records, array forms (CSR/ELL), generators."""
+
+from dgc_tpu.models.node import Node
+from dgc_tpu.models.graph import Graph
+from dgc_tpu.models.arrays import GraphArrays, csr_to_ell, ell_to_csr
+from dgc_tpu.models.generators import generate_random_graph, generate_rmat_graph
+
+__all__ = [
+    "Node",
+    "Graph",
+    "GraphArrays",
+    "csr_to_ell",
+    "ell_to_csr",
+    "generate_random_graph",
+    "generate_rmat_graph",
+]

@@ -1,0 +1,5 @@
+"""JIT kernels and array ops: bitmask first-fit, ELL/dense supersteps, validation."""
+
+from dgc_tpu.ops.validate import validate_coloring, ValidationResult
+
+__all__ = ["validate_coloring", "ValidationResult"]

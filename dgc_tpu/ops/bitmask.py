@@ -1,0 +1,74 @@
+"""Forbidden-set bitmask planes and first-fit candidate selection.
+
+The reference computes each vertex's forbidden set as a Python set of
+neighbor colors and first-fit as a linear scan over ``range(k)``
+(``/root/reference/coloring.py:44-54``). Here the forbidden set is a packed
+bitmask: ``P = ceil(k_max/32)`` uint32 planes per vertex, built from the
+gathered neighbor colors with an OR-reduction, and first-fit is
+"lowest clear bit" computed with two's-complement isolate + popcount —
+all rank-2 elementwise/reduce ops that XLA vectorizes on the VPU.
+
+``k`` (the color budget) is a *dynamic* scalar: plane validity masks are
+computed from it at trace time so the whole minimal-k sweep reuses one
+compiled executable. Only the plane count ``P`` is static (sized for
+``k0 = max_degree + 1``).
+"""
+
+from __future__ import annotations
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def num_planes_for(k_max: int) -> int:
+    return max(1, -(-int(k_max) // 32))
+
+
+def plane_masks(k, num_planes: int) -> jnp.ndarray:
+    """uint32[P]: bit b of plane p is set iff color 32p+b < k."""
+    p = jnp.arange(num_planes, dtype=jnp.int32)
+    nbits = jnp.clip(k - 32 * p, 0, 32)
+    shift = jnp.minimum(nbits, 31).astype(jnp.uint32)
+    partial = (jnp.uint32(1) << shift) - jnp.uint32(1)
+    return jnp.where(nbits >= 32, jnp.uint32(0xFFFFFFFF), jnp.where(nbits <= 0, jnp.uint32(0), partial))
+
+
+def forbidden_planes(neighbor_colors: jnp.ndarray, num_planes: int) -> jnp.ndarray:
+    """Build forbidden bitmask planes from gathered neighbor colors.
+
+    ``neighbor_colors``: int32[V, W]; negative entries (uncolored neighbors /
+    ELL padding) contribute nothing. Returns uint32[V, P].
+    """
+    nc = neighbor_colors
+    valid = nc >= 0
+    word = jnp.where(valid, nc >> 5, -1)
+    bit = (nc & 31).astype(jnp.uint32)
+    contrib = jnp.uint32(1) << bit
+    planes = []
+    for p in range(num_planes):
+        lane = jnp.where(valid & (word == p), contrib, jnp.uint32(0))
+        planes.append(
+            jax.lax.reduce(lane, np.uint32(0), jax.lax.bitwise_or, (1,))
+        )
+    return jnp.stack(planes, axis=-1)  # [V, P]
+
+
+def first_fit(forbidden: jnp.ndarray, k) -> tuple[jnp.ndarray, jnp.ndarray]:
+    """Lowest color in [0, k) not present in the forbidden planes.
+
+    Returns ``(candidate int32[V], fail bool[V])``; where ``fail`` is True the
+    forbidden set covers all of [0, k) — the reference's sentinel −3
+    (``coloring.py:53``) — and ``candidate`` is clamped to ``k``.
+    """
+    num_planes = forbidden.shape[-1]
+    free = jnp.bitwise_not(forbidden) & plane_masks(k, num_planes)[None, :]
+    has_free = free != 0  # [V, P]
+    first_plane = jnp.argmax(has_free, axis=-1).astype(jnp.int32)  # first True
+    freew = jnp.take_along_axis(free, first_plane[:, None].astype(jnp.int32), axis=-1)[:, 0]
+    lsb = freew & (jnp.bitwise_not(freew) + jnp.uint32(1))  # isolate lowest set bit
+    bit_idx = jax.lax.population_count(lsb - jnp.uint32(1)).astype(jnp.int32)
+    candidate = first_plane * 32 + bit_idx
+    fail = ~jnp.any(has_free, axis=-1)
+    candidate = jnp.where(fail, k, candidate).astype(jnp.int32)
+    return candidate, fail

@@ -1,0 +1,12 @@
+"""Device-mesh + sharding utilities (the TPU-native L1 runtime layer).
+
+Replaces the reference's Spark cluster config and shuffle fabric
+(``coloring.py:190-199``, SURVEY.md §2.5): mesh shape comes from
+``jax.devices()``, the vertex axis is hash-partitioned by contiguous block
+(mirroring ``id % N`` at ``coloring.py:206`` in spirit), and all exchange is
+XLA collectives over ICI.
+"""
+
+from dgc_tpu.parallel.mesh import make_mesh, pad_to_multiple, shard_rows
+
+__all__ = ["make_mesh", "pad_to_multiple", "shard_rows"]

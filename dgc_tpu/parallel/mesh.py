@@ -1,0 +1,38 @@
+"""Mesh construction and vertex-axis sharding helpers."""
+
+from __future__ import annotations
+
+import numpy as np
+
+import jax
+from jax.sharding import Mesh, NamedSharding, PartitionSpec as P
+
+VERTEX_AXIS = "v"
+
+
+def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
+    """1-D mesh over the vertex axis. ``num_devices=None`` uses all local
+    devices (the reference hardcodes ``local[*]``, ``coloring.py:192``; here
+    the mesh is discovered)."""
+    if devices is None:
+        devices = jax.devices()
+    if num_devices is not None:
+        if num_devices > len(devices):
+            raise ValueError(f"requested {num_devices} devices, have {len(devices)}")
+        devices = devices[:num_devices]
+    return Mesh(np.array(devices), (VERTEX_AXIS,))
+
+
+def pad_to_multiple(n: int, m: int) -> int:
+    return -(-n // m) * m
+
+
+def shard_rows(mesh: Mesh, *arrays):
+    """Place each array with its leading (vertex) axis sharded over the mesh."""
+    sharding = NamedSharding(mesh, P(VERTEX_AXIS))
+    return tuple(jax.device_put(a, sharding) for a in arrays)
+
+
+def replicated(mesh: Mesh, *arrays):
+    sharding = NamedSharding(mesh, P())
+    return tuple(jax.device_put(a, sharding) for a in arrays)

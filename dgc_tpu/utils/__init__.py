@@ -1,0 +1,7 @@
+"""Auxiliary subsystems: logging/metrics, tracing, checkpoint/resume, config.
+
+The reference's observability is bare ``print()`` calls (uncolored counts,
+timings, validation booleans — ``coloring.py:89,107,153,160,222-224,233-235``)
+and it has no checkpointing at all (SURVEY.md §5). These modules provide the
+structured equivalents the build plan calls for (§7.2 step 7).
+"""

@@ -1,0 +1,94 @@
+"""Checkpoint/resume of the minimal-k sweep.
+
+The reference has no checkpointing (SURVEY.md §5); a crashed sweep restarts
+from k0. Here the sweep state — next k to try, best valid coloring so far,
+whether the sweep already hit its terminating failure — is persisted after
+every attempt as an ``.npz`` + JSON manifest pair, so a resumed run continues
+exactly where it stopped. State is tiny (one int32[V] vector), so plain
+atomic-rename files beat pulling in a full Orbax dependency here.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+from pathlib import Path
+
+import numpy as np
+
+from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+
+_MANIFEST = "sweep_state.json"
+_COLORS = "best_colors.npy"
+
+
+class CheckpointManager:
+    def __init__(self, directory: str | os.PathLike, fingerprint: str | None = None):
+        """``fingerprint`` identifies the (graph, engine) pair; a stored
+        checkpoint with a different fingerprint is ignored on restore, so a
+        stale directory can never hand a previous graph's coloring to a new
+        run. Use :func:`graph_fingerprint` to derive one."""
+        self.dir = Path(directory)
+        self.dir.mkdir(parents=True, exist_ok=True)
+        self.fingerprint = fingerprint
+
+    def save(self, k: int, best: AttemptResult | None, failed: bool) -> None:
+        state = {
+            "fingerprint": self.fingerprint,
+            "next_k": int(k),
+            "done": bool(failed),
+            "best": None
+            if best is None
+            else {
+                "k": int(best.k),
+                "status": int(best.status),
+                "supersteps": int(best.supersteps),
+            },
+        }
+        if best is not None:
+            tmp = self.dir / ("tmp_" + _COLORS)  # np.save appends .npy to bare names
+            np.save(tmp, best.colors)
+            os.replace(tmp, self.dir / _COLORS)
+        tmp = self.dir / (_MANIFEST + ".tmp")
+        tmp.write_text(json.dumps(state))
+        os.replace(tmp, self.dir / _MANIFEST)
+
+    def restore(self) -> tuple[int, AttemptResult | None, bool] | None:
+        """Returns (next_k, best_attempt, done) or None if no checkpoint."""
+        manifest = self.dir / _MANIFEST
+        if not manifest.exists():
+            return None
+        state = json.loads(manifest.read_text())
+        if state.get("fingerprint") != self.fingerprint:
+            return None  # checkpoint belongs to a different graph/engine
+        best = None
+        if state["best"] is not None:
+            colors = np.load(self.dir / _COLORS)
+            b = state["best"]
+            best = AttemptResult(
+                status=AttemptStatus(b["status"]),
+                colors=colors,
+                supersteps=b["supersteps"],
+                k=b["k"],
+            )
+        return int(state["next_k"]), best, bool(state["done"])
+
+    def clear(self) -> None:
+        for name in (_MANIFEST, _COLORS):
+            p = self.dir / name
+            if p.exists():
+                p.unlink()
+
+
+def graph_fingerprint(arrays, backend: str, strict_decrement: bool) -> str:
+    """Cheap structural fingerprint of (graph, engine config) for checkpoint
+    safety: vertex/edge counts plus a hash of the CSR arrays."""
+    import hashlib
+
+    h = hashlib.sha256()
+    h.update(np.ascontiguousarray(arrays.indptr).tobytes())
+    h.update(np.ascontiguousarray(arrays.indices).tobytes())
+    return (
+        f"v{arrays.num_vertices}-e{arrays.num_directed_edges}-{backend}"
+        f"-{'strict' if strict_decrement else 'jump'}-{h.hexdigest()[:16]}"
+    )

@@ -1,0 +1,65 @@
+"""Test env: an 8-device virtual CPU platform for multi-device tests.
+
+Multi-device behavior (shard_map engines, collectives) is exercised on a
+virtual 8-device CPU mesh per the build plan (SURVEY.md §7.2 step 5) — no
+TPU pod needed in CI.
+
+This image's sitecustomize (PYTHONPATH=/root/.axon_site) pre-imports JAX and
+pins the axon TPU backend before conftest runs, so env tweaks here would be
+too late. If JAX arrives pre-imported, re-exec pytest once with a clean
+PYTHONPATH and JAX_PLATFORMS=cpu; the re-exec'd process then configures 8
+virtual CPU devices before any backend initializes.
+"""
+
+import os
+import sys
+
+if (
+    "jax" in sys.modules
+    and os.environ.get("DGC_TPU_TEST_REEXEC") != "1"
+    and os.environ.get("DGC_TPU_TEST_ON_TPU") != "1"  # escape hatch: run on real chip
+):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = ""
+    env["JAX_PLATFORMS"] = "cpu"
+    env["DGC_TPU_TEST_REEXEC"] = "1"
+    os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+import jax
+
+try:
+    # must run before backend init; conftest import is early enough in the
+    # re-exec'd interpreter. On the real TPU (escape hatch) this raises and
+    # is ignored.
+    jax.config.update("jax_num_cpu_devices", 8)
+except Exception:
+    pass
+
+import numpy as np
+import pytest
+
+from dgc_tpu.models.generators import generate_random_graph
+from dgc_tpu.models.graph import Graph
+
+
+@pytest.fixture(scope="session")
+def small_graphs():
+    """Ensemble of small reference-semantics random graphs (varied seeds)."""
+    return [generate_random_graph(60, 6, seed=s) for s in range(6)]
+
+
+@pytest.fixture(scope="session")
+def medium_graph():
+    return generate_random_graph(400, 10, seed=7)
+
+
+@pytest.fixture()
+def tiny_graph_json(tmp_path):
+    """A 10-vertex graph file in the reference's JSON schema (analog of the
+    bundled ``graph.json``, reference §2.7 — regenerated, not copied)."""
+    g = Graph.generate(10, 5, seed=3)
+    path = tmp_path / "graph.json"
+    g.serialize(path)
+    return path

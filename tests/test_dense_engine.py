@@ -1,0 +1,34 @@
+"""Dense MXU engine tests: validity + agreement with the ELL engine."""
+
+import numpy as np
+import pytest
+
+from dgc_tpu.engine.base import AttemptStatus
+from dgc_tpu.engine.dense_engine import DenseEngine
+from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
+from dgc_tpu.engine.superstep import ELLEngine
+from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.ops.validate import validate_coloring
+
+
+def test_dense_valid_and_matches_ell(small_graphs):
+    for g in small_graphs:
+        k0 = g.max_degree + 1
+        d = find_minimal_coloring(DenseEngine(g), k0, validate=make_validator(g))
+        e = find_minimal_coloring(ELLEngine(g), k0)
+        assert d.minimal_colors is not None
+        assert validate_coloring(g.indptr, g.indices, d.colors).valid
+        # same priority rule ⇒ identical colorings, not just counts
+        assert np.array_equal(d.colors, e.colors)
+
+
+def test_dense_failure_below_minimal(small_graphs):
+    g = small_graphs[0]
+    res = find_minimal_coloring(DenseEngine(g), g.max_degree + 1)
+    assert DenseEngine(g).attempt(res.minimal_colors - 1).status == AttemptStatus.FAILURE
+
+
+def test_dense_rejects_huge_graph():
+    big = GraphArrays(indptr=np.zeros(20001, dtype=np.int32), indices=np.zeros(0, dtype=np.int32))
+    with pytest.raises(ValueError):
+        DenseEngine(big)

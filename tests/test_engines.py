@@ -1,0 +1,171 @@
+"""Engine correctness: validity, parity, failure semantics, deadlock-freedom."""
+
+import numpy as np
+import pytest
+
+from dgc_tpu.engine.base import AttemptStatus
+from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
+from dgc_tpu.engine.oracle import OracleEngine, greedy_color
+from dgc_tpu.engine.reference_sim import ReferenceSimEngine
+from dgc_tpu.engine.superstep import ELLEngine
+from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.models.generators import generate_random_graph
+from dgc_tpu.ops.validate import validate_coloring
+
+
+def _minimal(engine, arrays, **kw):
+    return find_minimal_coloring(
+        engine, initial_k=arrays.max_degree + 1, validate=make_validator(arrays), **kw
+    )
+
+
+# ---------------- oracle ----------------
+
+
+def test_oracle_valid_and_bounded(small_graphs):
+    for g in small_graphs:
+        colors = greedy_color(g)
+        assert validate_coloring(g.indptr, g.indices, colors).valid
+        assert colors.max() + 1 <= g.max_degree + 1
+
+
+# ---------------- reference-sim ----------------
+
+
+def test_reference_sim_optimized_valid(small_graphs):
+    for g in small_graphs:
+        res = _minimal(ReferenceSimEngine(g), g)
+        assert res.minimal_colors is not None
+        assert validate_coloring(g.indptr, g.indices, res.colors).valid
+
+
+def test_reference_sim_progress_on_disconnected():
+    # two disjoint triangles — the exact shape that deadlocks the baseline
+    # reference engine (SURVEY §2.4.1); optimized semantics must finish
+    g = GraphArrays.from_edge_list(
+        6, np.array([[0, 1], [1, 2], [0, 2], [3, 4], [4, 5], [3, 5]])
+    )
+    res = ReferenceSimEngine(g, variant="optimized").attempt(3)
+    assert res.status == AttemptStatus.SUCCESS
+
+
+def test_reference_sim_baseline_stalls_on_disconnected():
+    # the baseline variant defers vertices with no colored neighbor
+    # (coloring.py:48-49); a component without the max-degree seed never
+    # progresses — our sim surfaces that as STALLED instead of hanging
+    g = GraphArrays.from_edge_list(
+        7, np.array([[0, 1], [1, 2], [0, 2], [0, 3], [4, 5], [5, 6], [4, 6]])
+    )
+    res = ReferenceSimEngine(g, variant="baseline").attempt(4)
+    assert res.status == AttemptStatus.STALLED
+
+
+def test_reference_sim_baseline_succeeds_on_connected():
+    g = GraphArrays.from_edge_list(
+        5, np.array([[0, 1], [1, 2], [2, 3], [3, 4], [0, 4], [1, 3]])
+    )
+    res = ReferenceSimEngine(g, variant="baseline").attempt(4)
+    assert res.status == AttemptStatus.SUCCESS
+    assert validate_coloring(g.indptr, g.indices, res.colors).valid
+
+
+# ---------------- ELL engine ----------------
+
+
+def test_ell_valid_across_seeds(small_graphs):
+    for g in small_graphs:
+        res = _minimal(ELLEngine(g), g)
+        assert res.minimal_colors is not None
+        assert validate_coloring(g.indptr, g.indices, res.colors).valid
+
+
+def test_ell_parity_with_reference_sim(small_graphs):
+    # color-count parity ±1 against the reference's optimized semantics
+    # (the contract from BASELINE.json; per-vertex equality is not expected,
+    # SURVEY §7.3)
+    for g in small_graphs:
+        a = _minimal(ELLEngine(g), g).minimal_colors
+        b = _minimal(ReferenceSimEngine(g), g).minimal_colors
+        assert abs(a - b) <= 1, (a, b)
+
+
+def test_ell_failure_below_minimal(small_graphs):
+    g = small_graphs[0]
+    res = _minimal(ELLEngine(g), g)
+    below = ELLEngine(g).attempt(res.minimal_colors - 1)
+    assert below.status == AttemptStatus.FAILURE
+
+
+def test_ell_disconnected_progress():
+    g = GraphArrays.from_edge_list(
+        6, np.array([[0, 1], [1, 2], [0, 2], [3, 4], [4, 5], [3, 5]])
+    )
+    res = ELLEngine(g).attempt(3)
+    assert res.status == AttemptStatus.SUCCESS
+    assert validate_coloring(g.indptr, g.indices, res.colors).valid
+
+
+def test_ell_isolated_vertices_get_color_zero():
+    # reference reset pass: degree-0 vertices → color 0 (coloring.py:12-17)
+    g = GraphArrays.from_neighbor_lists([[], [2], [1], []])
+    res = ELLEngine(g).attempt(2)
+    assert res.status == AttemptStatus.SUCCESS
+    assert res.colors[0] == 0 and res.colors[3] == 0
+
+
+def test_ell_deterministic(small_graphs):
+    g = small_graphs[1]
+    r1 = ELLEngine(g).attempt(g.max_degree + 1)
+    r2 = ELLEngine(g).attempt(g.max_degree + 1)
+    assert np.array_equal(r1.colors, r2.colors)
+
+
+def test_ell_k_is_dynamic_no_recompile(small_graphs):
+    # one compiled executable serves all k in the sweep
+    import jax
+
+    g = small_graphs[2]
+    eng = ELLEngine(g)
+    eng.attempt(g.max_degree + 1)
+    from dgc_tpu.engine.superstep import _attempt_kernel
+
+    sizes_before = _attempt_kernel._cache_size()
+    eng.attempt(g.max_degree)
+    eng.attempt(max(1, g.max_degree - 1))
+    assert _attempt_kernel._cache_size() == sizes_before
+
+
+def test_ell_large_k_many_planes():
+    # k > 32 exercises multi-word bitmask planes (SURVEY §7.3)
+    g = generate_random_graph(300, 40, seed=11)
+    assert g.max_degree > 32
+    res = _minimal(ELLEngine(g), g)
+    assert res.minimal_colors is not None
+    assert validate_coloring(g.indptr, g.indices, res.colors).valid
+
+
+def test_single_vertex_and_empty_edge_graphs():
+    g = GraphArrays.from_neighbor_lists([[]])
+    res = ELLEngine(g).attempt(1)
+    assert res.status == AttemptStatus.SUCCESS and res.colors[0] == 0
+    g2 = GraphArrays.from_neighbor_lists([[], [], []])
+    res2 = ELLEngine(g2).attempt(1)
+    assert res2.status == AttemptStatus.SUCCESS and (res2.colors == 0).all()
+
+
+def test_complete_graph_needs_v_colors():
+    v = 9
+    edges = np.array([[i, j] for i in range(v) for j in range(i + 1, v)])
+    g = GraphArrays.from_edge_list(v, edges)
+    res = _minimal(ELLEngine(g), g)
+    assert res.minimal_colors == v
+    assert ELLEngine(g).attempt(v - 1).status == AttemptStatus.FAILURE
+
+
+def test_bipartite_two_colors():
+    # even cycle: chromatic number 2; greedy first-fit finds it
+    v = 12
+    edges = np.array([[i, (i + 1) % v] for i in range(v)])
+    g = GraphArrays.from_edge_list(v, edges)
+    res = _minimal(ELLEngine(g), g)
+    assert res.minimal_colors == 2

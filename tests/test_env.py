@@ -1,0 +1,15 @@
+"""Guard: the test environment must provide the 8-device virtual CPU mesh
+(SURVEY.md §7.2 step 5). If this fails, multi-device tests are vacuous."""
+
+import os
+
+import jax
+
+
+def test_eight_cpu_devices():
+    if os.environ.get("DGC_TPU_TEST_ON_TPU") == "1":
+        import pytest
+
+        pytest.skip("running on real TPU hardware by request")
+    assert jax.devices()[0].platform == "cpu"
+    assert jax.local_device_count() == 8

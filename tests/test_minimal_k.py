@@ -1,0 +1,78 @@
+"""Outer-loop tests: jump vs strict schedules, checkpoint/resume, quirk fix."""
+
+import numpy as np
+
+from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
+from dgc_tpu.engine.superstep import ELLEngine
+from dgc_tpu.models.generators import generate_random_graph
+from dgc_tpu.ops.validate import validate_coloring
+from dgc_tpu.utils.checkpoint import CheckpointManager
+
+
+def test_jump_and_strict_agree(small_graphs):
+    for g in small_graphs:
+        k0 = g.max_degree + 1
+        jump = find_minimal_coloring(ELLEngine(g), k0)
+        strict = find_minimal_coloring(ELLEngine(g), k0, strict_decrement=True)
+        assert jump.minimal_colors == strict.minimal_colors
+        # jump mode: exactly 2 attempts (find u, confirm u−1 fails), unless u == k0
+        assert len(jump.attempts) <= 3
+        # strict mode mirrors the reference's one-by-one schedule
+        # (coloring.py:226-231): k0 − u + 2 attempts (final one fails)
+        assert len(strict.attempts) == k0 - strict.minimal_colors + 2 - (
+            1 if strict.minimal_colors == 1 else 0
+        )
+
+
+def test_last_valid_coloring_kept(small_graphs):
+    # the reference saves the failed attempt's partial coloring
+    # (SURVEY §3.1); we must return the last *valid* one
+    g = small_graphs[0]
+    res = find_minimal_coloring(ELLEngine(g), g.max_degree + 1)
+    assert (res.colors >= 0).all()
+    assert validate_coloring(g.indptr, g.indices, res.colors).valid
+    assert not res.attempts[-1].success  # sweep ends on the failing attempt
+    assert res.minimal_colors == res.attempts[-2].colors_used
+
+
+def test_checkpoint_resume(tmp_path):
+    g = generate_random_graph(120, 8, seed=5)
+    k0 = g.max_degree + 1
+    full = find_minimal_coloring(ELLEngine(g), k0, strict_decrement=True)
+
+    # run once with checkpointing, interrupting after the second attempt
+    class Interrupt(Exception):
+        pass
+
+    ckpt = CheckpointManager(tmp_path / "ck")
+    count = 0
+
+    def boom(res, val):
+        nonlocal count
+        count += 1
+        if count == 2:
+            raise Interrupt
+
+    try:
+        find_minimal_coloring(
+            ELLEngine(g), k0, strict_decrement=True, on_attempt=boom, checkpoint=ckpt
+        )
+    except Interrupt:
+        pass
+
+    resumed = find_minimal_coloring(
+        ELLEngine(g), k0, strict_decrement=True, checkpoint=ckpt
+    )
+    assert resumed.minimal_colors == full.minimal_colors
+    assert validate_coloring(g.indptr, g.indices, resumed.colors).valid
+    # resumed run skips the attempts done before the interrupt
+    assert len(resumed.attempts) < len(full.attempts) + 1
+
+
+def test_checkpoint_resume_after_done(tmp_path):
+    g = generate_random_graph(50, 5, seed=9)
+    ckpt = CheckpointManager(tmp_path / "ck2")
+    first = find_minimal_coloring(ELLEngine(g), g.max_degree + 1, checkpoint=ckpt)
+    again = find_minimal_coloring(ELLEngine(g), g.max_degree + 1, checkpoint=ckpt)
+    assert again.minimal_colors == first.minimal_colors
+    assert len(again.attempts) == 1  # only the restored best; no re-execution

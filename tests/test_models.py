@@ -1,0 +1,114 @@
+"""Data-model tests: JSON schema parity, array conversions, generators."""
+
+import json
+
+import numpy as np
+import pytest
+
+from dgc_tpu.models.arrays import GraphArrays, csr_to_ell, ell_to_csr
+from dgc_tpu.models.generators import (
+    generate_random_graph,
+    generate_random_graph_fast,
+    generate_rmat_graph,
+)
+from dgc_tpu.models.graph import Graph
+from dgc_tpu.models.node import Node
+
+
+def test_node_dict_roundtrip():
+    n = Node(3, [1, 2, 5], 4)
+    d = n.to_dict()
+    assert d == {"id": 3, "neighbors": [1, 2, 5], "color": 4}
+    n2 = Node.from_dict(d)
+    assert n2 == n  # from_dict keeps neighbors (reference's was dead/lossy, node.py:16-18)
+
+
+def test_graph_json_roundtrip(tmp_path):
+    g = Graph.generate(25, 5, seed=1)
+    p = tmp_path / "g.json"
+    g.serialize(p)
+    data = json.loads(p.read_text())
+    # reference schema: list of {"id","neighbors","color"} (graph.py:10-12)
+    assert isinstance(data, list) and len(data) == 25
+    assert set(data[0].keys()) == {"id", "neighbors", "color"}
+    assert all(d["color"] == -1 for d in data)
+    g2 = Graph.deserialize(p)
+    assert np.array_equal(g2.arrays.indptr, g.arrays.indptr)
+    assert np.array_equal(g2.arrays.indices, g.arrays.indices)
+
+
+def test_coloring_json_schema(tmp_path):
+    g = Graph.generate(8, 3, seed=2)
+    colors = np.arange(8, dtype=np.int32)
+    p = tmp_path / "colors.json"
+    g.save_coloring(p, colors)
+    data = json.loads(p.read_text())
+    # reference schema: list of {"id","color"} (coloring.py:239-241)
+    assert data == [{"id": i, "color": i} for i in range(8)]
+    assert np.array_equal(Graph.load_coloring(p), colors)
+
+
+@pytest.mark.parametrize("seed", range(4))
+def test_generator_invariants(seed):
+    max_degree = 7
+    arrays = generate_random_graph(150, max_degree, seed=seed)
+    lists = arrays.to_neighbor_lists()
+    for v, ns in enumerate(lists):
+        assert v not in ns, "no self loops (graph.py:36)"
+        assert len(ns) == len(set(ns)), "no duplicate edges (graph.py:37)"
+        assert len(ns) <= max_degree, "degree cap (graph.py:38)"
+        for u in ns:
+            assert v in lists[u], "symmetric edges (graph.py:39-41)"
+
+
+def test_generator_terminates_on_saturated_pool():
+    # The reference's unbounded rejection loop can spin forever (SURVEY §2.1
+    # hazard a); ours must return. Tiny pool, big degree demand.
+    arrays = generate_random_graph(3, 10, seed=0)
+    assert arrays.num_vertices == 3
+
+
+def test_fast_generator_invariants():
+    arrays = generate_random_graph_fast(5000, avg_degree=8, seed=1, max_degree=16)
+    assert arrays.num_vertices == 5000
+    assert arrays.max_degree <= 16
+    deg = arrays.degrees
+    assert 4 <= deg.mean() <= 12
+    # symmetry via sorted edge multiset
+    g = arrays
+    rows = np.repeat(np.arange(5000), g.degrees)
+    fwd = set(zip(rows.tolist(), g.indices.tolist()))
+    assert all((b, a) in fwd for a, b in fwd)
+
+
+def test_rmat_generator_heavy_tail():
+    arrays = generate_rmat_graph(4096, avg_degree=8, seed=0)
+    assert arrays.num_vertices == 4096
+    deg = arrays.degrees
+    assert deg.max() > 4 * max(deg.mean(), 1)  # skewed
+
+
+def test_csr_ell_roundtrip(medium_graph):
+    nbrs, degrees = medium_graph.to_ell(pad_to=8)
+    v = medium_graph.num_vertices
+    assert nbrs.shape[1] % 8 == 0
+    assert (nbrs[np.arange(nbrs.shape[1])[None, :] >= degrees[:, None]] == v).all()
+    back = ell_to_csr(nbrs, degrees)
+    assert np.array_equal(back.indptr, medium_graph.indptr)
+    assert np.array_equal(back.indices, medium_graph.indices)
+
+
+def test_dense_adjacency(small_graphs):
+    g = small_graphs[0]
+    a = g.to_dense()
+    assert a.shape == (g.num_vertices, g.num_vertices)
+    assert (a == a.T).all()
+    assert not a.diagonal().any()
+    assert a.sum() == g.num_directed_edges
+
+
+def test_from_nodes_nonzero_based_ids():
+    nodes = [Node(10, [12], -1), Node(12, [10, 14], -1), Node(14, [12], -1)]
+    g = Graph.from_nodes(nodes)
+    assert g.num_vertices == 3
+    assert g.arrays.to_neighbor_lists() == [[1], [0, 2], [1]]

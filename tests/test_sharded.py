@@ -1,0 +1,57 @@
+"""Sharded engine tests on the 8-device virtual CPU mesh (SURVEY §7.2 step 5)."""
+
+import jax
+import numpy as np
+import pytest
+
+from dgc_tpu.engine.base import AttemptStatus
+from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
+from dgc_tpu.engine.sharded import ShardedELLEngine
+from dgc_tpu.engine.superstep import ELLEngine
+from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.models.generators import generate_random_graph
+from dgc_tpu.ops.validate import validate_coloring
+
+
+def test_sharded_matches_single_device(medium_graph):
+    g = medium_graph
+    k0 = g.max_degree + 1
+    s = find_minimal_coloring(ShardedELLEngine(g, num_shards=8), k0, validate=make_validator(g))
+    e = find_minimal_coloring(ELLEngine(g), k0)
+    assert s.minimal_colors == e.minimal_colors
+    # deterministic priority rule ⇒ bit-identical colorings across meshes
+    assert np.array_equal(s.colors, e.colors)
+
+
+@pytest.mark.parametrize("num_shards", [1, 2, 8])
+def test_sharded_mesh_sizes_agree(num_shards):
+    g = generate_random_graph(123, 7, seed=13)  # V not divisible by mesh → padding path
+    k0 = g.max_degree + 1
+    res = find_minimal_coloring(
+        ShardedELLEngine(g, num_shards=num_shards), k0, validate=make_validator(g)
+    )
+    ref = find_minimal_coloring(ELLEngine(g), k0)
+    assert res.minimal_colors == ref.minimal_colors
+    assert np.array_equal(res.colors, ref.colors)
+
+
+def test_sharded_failure_semantics():
+    g = generate_random_graph(64, 6, seed=3)
+    res = find_minimal_coloring(ShardedELLEngine(g, num_shards=8), g.max_degree + 1)
+    below = ShardedELLEngine(g, num_shards=8).attempt(res.minimal_colors - 1)
+    assert below.status == AttemptStatus.FAILURE
+
+
+def test_sharded_disconnected_progress():
+    g = GraphArrays.from_edge_list(
+        6, np.array([[0, 1], [1, 2], [0, 2], [3, 4], [4, 5], [3, 5]])
+    )
+    res = ShardedELLEngine(g, num_shards=2).attempt(3)
+    assert res.status == AttemptStatus.SUCCESS
+    assert validate_coloring(g.indptr, g.indices, res.colors).valid
+
+
+def test_sharded_uses_requested_mesh():
+    assert jax.local_device_count() >= 8
+    eng = ShardedELLEngine(generate_random_graph(40, 4, seed=0), num_shards=4)
+    assert eng.mesh.shape["v"] == 4

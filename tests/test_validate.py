@@ -1,0 +1,47 @@
+"""Ground-truth validation tests."""
+
+import numpy as np
+
+from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.ops.validate import num_colors_used, validate_coloring
+
+
+def _triangle():
+    return GraphArrays.from_edge_list(3, np.array([[0, 1], [1, 2], [0, 2]]))
+
+
+def test_valid_coloring():
+    g = _triangle()
+    v = validate_coloring(g.indptr, g.indices, np.array([0, 1, 2]))
+    assert v.valid and v.uncolored == 0 and v.conflicts == 0
+
+
+def test_conflict_counted_doubled():
+    # the reference counts each conflicting edge twice — both directions
+    # (coloring.py:157-160); our directed count matches that contract
+    g = _triangle()
+    v = validate_coloring(g.indptr, g.indices, np.array([0, 0, 1]))
+    assert not v.valid
+    assert v.conflicts == 2 and v.conflict_edges == 1
+
+
+def test_uncolored_detected():
+    g = _triangle()
+    v = validate_coloring(g.indptr, g.indices, np.array([0, -1, 1]))
+    assert not v.valid and v.uncolored == 1
+    # −1 endpoints never count as conflicts
+    assert v.conflicts == 0
+
+
+def test_stale_copy_vacuity_cannot_happen():
+    # The optimized reference validates via cached neighbor copies that are
+    # stale at validation time, so conflicts pass vacuously (SURVEY §2.4.3).
+    # Our validation reads the actual color vector: plant a conflict, it must
+    # be seen regardless of any cached state.
+    g = _triangle()
+    assert validate_coloring(g.indptr, g.indices, np.array([1, 1, 0])).conflicts > 0
+
+
+def test_num_colors_used():
+    assert num_colors_used(np.array([0, 2, 1])) == 3
+    assert num_colors_used(np.array([-1, -1])) == 0
