@@ -28,7 +28,7 @@ def main() -> int:
     p.add_argument("--nodes", type=int, default=1_000_000)
     p.add_argument("--avg-degree", type=float, default=16.0)
     p.add_argument("--max-degree", type=int, default=None)
-    p.add_argument("--backend", choices=["ell", "sharded"], default="ell")
+    p.add_argument("--backend", choices=["ell", "ell-bucketed", "sharded"], default="ell-bucketed")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--include-compile", action="store_true")
     args = p.parse_args()
@@ -57,6 +57,10 @@ def main() -> int:
             from dgc_tpu.engine.sharded import ShardedELLEngine
 
             return ShardedELLEngine(arrays)
+        if args.backend == "ell-bucketed":
+            from dgc_tpu.engine.bucketed import BucketedELLEngine
+
+            return BucketedELLEngine(arrays)
         from dgc_tpu.engine.superstep import ELLEngine
 
         return ELLEngine(arrays)

@@ -38,7 +38,7 @@ def build_parser() -> argparse.ArgumentParser:
     # new flags
     p.add_argument(
         "--backend",
-        choices=["ell", "dense", "sharded", "reference-sim", "oracle", "spark"],
+        choices=["ell", "ell-bucketed", "dense", "sharded", "reference-sim", "oracle", "spark"],
         default="ell",
         help="coloring engine (default: ell — single-device jit'd ELL kernel)",
     )
@@ -72,6 +72,9 @@ def make_engine(args, graph: Graph):
     if args.backend == "ell":
         from dgc_tpu.engine.superstep import ELLEngine
         return ELLEngine(arrays)
+    if args.backend == "ell-bucketed":
+        from dgc_tpu.engine.bucketed import BucketedELLEngine
+        return BucketedELLEngine(arrays)
     if args.backend == "dense":
         from dgc_tpu.engine.dense_engine import DenseEngine
         return DenseEngine(arrays)

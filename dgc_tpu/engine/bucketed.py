@@ -1,0 +1,216 @@
+"""Degree-bucketed ELL engine — gather-volume-optimized single-device path.
+
+The plain ELL table pads every row to the max degree, so on an avg-degree-16
+/ max-degree-32 graph half the gather slots are sentinel padding — and the
+neighbor-state gather is the dominant superstep cost on TPU (XLA element
+gathers, ~100M lookups/s). This engine sorts vertices by degree (a static
+relabeling), splits them into power-of-two width buckets (8, 16, 32, ...),
+and runs the same speculative superstep as ``engine.superstep`` with one
+gather per bucket. Gather volume drops from V·Δ to ~Σ deg rounded up per
+bucket (~1.6-2x on Poisson-degree graphs; more on power-law/RMAT graphs,
+SURVEY.md §7.3 load-balancing hard part).
+
+Relabeling changes the id tie-break in the (degree desc, id asc) priority,
+so colorings differ per-vertex from the unbucketed engine — color-count
+parity stays within the ±1 contract (BASELINE.md). Results are mapped back
+to original ids on the host.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.models.arrays import GraphArrays, csr_to_ell
+from dgc_tpu.ops.bitmask import num_planes_for
+from dgc_tpu.ops.speculative import speculative_update
+
+_RUNNING = AttemptStatus.RUNNING
+_SUCCESS = AttemptStatus.SUCCESS
+_FAILURE = AttemptStatus.FAILURE
+_STALLED = AttemptStatus.STALLED
+
+
+def _bucket_widths(max_degree: int, min_width: int = 8) -> list[int]:
+    widths = []
+    w = min_width
+    while w < max_degree:
+        widths.append(w)
+        w *= 2
+    widths.append(max(w, 1))
+    return widths
+
+
+@partial(jax.jit, static_argnames=("num_planes", "max_steps", "stall_window"))
+def _attempt_kernel_bucketed(nbrs_buckets, degrees, carry_in, k,
+                             num_planes: int, max_steps: int,
+                             stall_window: int = 64):
+    """Run up to ``max_steps`` supersteps from ``carry_in`` and return the
+    carry — the host chains calls until the status leaves RUNNING, keeping
+    any single device call bounded (a 4M-vertex power-law attempt can need
+    hundreds of supersteps; one unbounded while_loop call trips runtime
+    watchdogs). ``carry_in`` is (packed, step, status, prev_active,
+    stall_rounds); pass ``initial_carry_bucketed`` to start.
+
+    nbrs_buckets: tuple of int32[Vb, Wb] (relabeled ids, sentinel = V),
+    concatenated along the vertex axis in relabeled order.
+
+    The plane budget may be smaller than k (power-law graphs where
+    k0 = Δ+1 is huge, SURVEY.md §7.3): candidates are then restricted to
+    [0, 32·num_planes) and a vertex whose in-cap colors are all taken simply
+    defers. Failure is only assertable when k fits the cap (a full in-cap
+    forbidden set doesn't prove k colors are exhausted otherwise). A run
+    that makes no progress for ``stall_window`` consecutive supersteps exits
+    STALLED so the caller can retry with a bigger plane budget."""
+    v = degrees.shape[0]
+    k = jnp.asarray(k, jnp.int32)
+    fail_assertable = k <= 32 * num_planes
+    chunk_end = carry_in[1] + max_steps
+
+    deg_pad = jnp.concatenate([degrees, jnp.array([-1], jnp.int32)])
+    # per-bucket loop-invariant priority masks
+    pre_beats = []
+    row0 = 0
+    for nb in nbrs_buckets:
+        vb = nb.shape[0]
+        my_deg = jax.lax.dynamic_slice_in_dim(degrees, row0, vb)[:, None]
+        my_ids = (row0 + jnp.arange(vb, dtype=jnp.int32))[:, None]
+        n_deg = deg_pad[nb]
+        pre_beats.append((n_deg > my_deg) | ((n_deg == my_deg) & (nb < my_ids)))
+        row0 += vb
+
+    def cond(carry):
+        _, step, status, _, _ = carry
+        return (status == _RUNNING) & (step < chunk_end)
+
+    def body(carry):
+        packed, step, status, prev_active, stall_rounds = carry
+        packed_pad = jnp.concatenate([packed, jnp.array([-1], jnp.int32)])
+
+        new_parts, fail_parts, active_parts = [], [], []
+        row0 = 0
+        for nb, beats in zip(nbrs_buckets, pre_beats):
+            vb = nb.shape[0]
+            packed_b = jax.lax.dynamic_slice_in_dim(packed, row0, vb)
+            np_ = packed_pad[nb]                      # the bucket's gather
+            new_b, fail_mask, active_mask = speculative_update(
+                packed_b, np_, beats, k, num_planes
+            )
+            new_parts.append(new_b)
+            fail_parts.append(jnp.sum(fail_mask.astype(jnp.int32)))
+            active_parts.append(jnp.sum(active_mask.astype(jnp.int32)))
+            row0 += vb
+
+        new_packed = jnp.concatenate(new_parts)
+        any_fail = (sum(fail_parts) > 0) & fail_assertable
+        active = sum(active_parts)
+        stall_rounds = jnp.where(active < prev_active, 0, stall_rounds + 1)
+        status = jnp.where(
+            any_fail,
+            _FAILURE,
+            jnp.where(
+                active == 0,
+                _SUCCESS,
+                jnp.where(stall_rounds >= stall_window, _STALLED, _RUNNING),
+            ),
+        ).astype(jnp.int32)
+        new_packed = jnp.where(any_fail, packed, new_packed)
+        return (new_packed, step + 1, status, active, stall_rounds)
+
+    return jax.lax.while_loop(cond, body, carry_in)
+
+
+def initial_carry_bucketed(degrees):
+    v = degrees.shape[0]
+    packed0 = jnp.where(degrees == 0, 0, -1).astype(jnp.int32)
+    return (packed0, jnp.int32(0), jnp.int32(_RUNNING), jnp.int32(v + 1), jnp.int32(0))
+
+
+class BucketedELLEngine:
+    """Degree-sorted, width-bucketed speculative engine (single device).
+
+    ``max_colors_hint`` caps the bitmask plane budget (the reference's
+    k0 = Δ+1 start is absurd on power-law graphs where Δ is tens of
+    thousands; actual color counts track the core number). If an attempt
+    exits STALLED because the cap starved some vertex of candidates, the
+    plane budget is doubled and the attempt retried transparently.
+    """
+
+    def __init__(self, arrays: GraphArrays, max_steps: int | None = None,
+                 min_width: int = 8, max_colors_hint: int = 256,
+                 chunk_steps: int = 64):
+        self.arrays = arrays
+        v = arrays.num_vertices
+        degrees_old = arrays.degrees
+        widths = _bucket_widths(arrays.max_degree, min_width=min_width)
+        # stable degree-descending order → big-width buckets first
+        self.perm = np.lexsort((np.arange(v), -degrees_old)).astype(np.int64)
+        inv = np.empty(v, dtype=np.int32)
+        inv[self.perm] = np.arange(v, dtype=np.int32)
+
+        # relabeled CSR, fully vectorized: entries keyed by (new_row, new_col)
+        rows_old = np.repeat(np.arange(v, dtype=np.int64), degrees_old)
+        new_row = inv[rows_old].astype(np.int64)
+        new_col = inv[arrays.indices].astype(np.int64)
+        order = np.argsort(new_row * v + new_col, kind="stable")
+        new_indices = new_col[order].astype(np.int32)
+        deg_new = degrees_old[self.perm].astype(np.int32)
+        new_indptr = np.zeros(v + 1, dtype=np.int64)
+        np.cumsum(deg_new, out=new_indptr[1:])
+
+        # split rows into buckets by width (descending degrees → contiguous)
+        widths_desc = sorted(widths, reverse=True)
+        buckets = []
+        row = 0
+        for wi, width in enumerate(widths_desc):
+            lo = 0 if wi + 1 >= len(widths_desc) else widths_desc[wi + 1]
+            # deg_new is non-increasing: rows with degree > lo come first
+            end = int(np.searchsorted(-deg_new, -lo, side="left"))
+            if wi + 1 >= len(widths_desc):
+                end = v  # last bucket takes the rest (incl. isolated)
+            if end > row:
+                sub_indptr = new_indptr[row: end + 1] - new_indptr[row]
+                sub_indices = new_indices[new_indptr[row]: new_indptr[end]]
+                nb, _ = csr_to_ell(sub_indptr, sub_indices, width=width, sentinel=v)
+                buckets.append(jnp.asarray(nb))
+            row = end
+        assert row == v, (row, v)
+
+        self.nbrs_buckets = tuple(buckets)
+        self.degrees = jnp.asarray(deg_new)
+        self.k_full = arrays.max_degree + 1
+        self.num_planes = num_planes_for(min(self.k_full, max_colors_hint))
+        self.max_steps = max_steps if max_steps is not None else 2 * v + 4
+        self.chunk_steps = chunk_steps
+
+    def attempt(self, k: int) -> AttemptResult:
+        while True:  # plane-budget retry loop
+            carry = initial_carry_bucketed(self.degrees)
+            while True:  # chunked superstep loop (bounded device calls)
+                carry = _attempt_kernel_bucketed(
+                    self.nbrs_buckets, self.degrees, carry, k,
+                    num_planes=self.num_planes, max_steps=self.chunk_steps,
+                )
+                status = AttemptStatus(int(carry[2]))
+                steps = int(carry[1])
+                if status != AttemptStatus.RUNNING or steps >= self.max_steps:
+                    if status == AttemptStatus.RUNNING:
+                        status = AttemptStatus.STALLED
+                    break
+            if status == AttemptStatus.STALLED and 32 * self.num_planes < k:
+                # the plane cap starved candidates — double it and retry
+                self.num_planes = min(
+                    2 * self.num_planes, num_planes_for(self.k_full)
+                )
+                continue
+            break
+        colors_new = np.asarray(
+            jnp.where(carry[0] >= 0, carry[0] >> 1, -1).astype(jnp.int32)
+        )
+        colors = np.empty_like(colors_new)
+        colors[self.perm] = colors_new  # back to original ids
+        return AttemptResult(status, colors, steps, int(k))

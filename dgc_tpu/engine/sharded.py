@@ -10,16 +10,16 @@ The distribution design the reference implements with Spark machinery
 - **Per-superstep color exchange** (reference: ``collectAsMap`` to the
   driver + ``sc.broadcast`` of the full id→color dict — O(V) through the
   driver every superstep, ``coloring.py:135-137``) → one
-  ``lax.all_gather`` of the sharded int32 color vector over ICI
-  (4 MB @ 1M vertices), plus one more for the candidate vector; no host
-  involvement.
+  ``lax.all_gather`` of the sharded packed (color, fresh) int32 vector over
+  ICI (4 MB @ 1M vertices) per superstep; no host involvement.
 - **All-to-one reductions** (reference: ``reduce``/``count`` driver
   round-trips per superstep, ``coloring.py:88,104``) → ``lax.psum`` inside
   the jit'd ``while_loop``; the host reads back one scalar per k-attempt.
 - **Shuffle conflict resolution** (reference: ``groupByKey`` /
   ``aggregateByKey``, ``coloring_optimized.py:120-126``) → not needed: the
-  same data-parallel priority rule as the single-device engines, evaluated
-  on each shard against the gathered candidate vector.
+  same speculative assign-then-demote priority rule as the single-device
+  ELL engine (see ``engine.superstep``), evaluated on each shard against
+  the gathered packed state — bit-identical results across mesh sizes.
 
 The whole k-attempt (while_loop over supersteps) runs inside one
 ``jit(shard_map(...))`` call. Padding vertices (to make V divisible by the
@@ -38,8 +38,23 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
 from dgc_tpu.models.arrays import GraphArrays
-from dgc_tpu.ops.bitmask import first_fit, forbidden_planes, num_planes_for
+from dgc_tpu.ops.bitmask import num_planes_for
+from dgc_tpu.ops.speculative import speculative_update
 from dgc_tpu.parallel.mesh import VERTEX_AXIS, make_mesh, pad_to_multiple
+
+
+def _shard_superstep(packed_l, nbrs_l, pre_beats, k, num_planes: int):
+    """One speculative superstep on a shard: all_gather the packed state,
+    apply the shared core, psum the fail/active masks."""
+    packed_g = jax.lax.all_gather(packed_l, VERTEX_AXIS, tiled=True)
+    packed_pad = jnp.concatenate([packed_g, jnp.array([-1], jnp.int32)])
+    np_ = packed_pad[nbrs_l]
+    new_packed_l, fail_mask, active_mask = speculative_update(
+        packed_l, np_, pre_beats, k, num_planes
+    )
+    any_fail = jax.lax.psum(jnp.sum(fail_mask.astype(jnp.int32)), VERTEX_AXIS) > 0
+    active = jax.lax.psum(jnp.sum(active_mask.astype(jnp.int32)), VERTEX_AXIS)
+    return new_packed_l, any_fail, active
 
 _RUNNING = AttemptStatus.RUNNING
 _SUCCESS = AttemptStatus.SUCCESS
@@ -51,12 +66,11 @@ def _shard_body(nbrs_l, deg_l, deg_g, k, num_planes: int, max_steps: int):
     """Per-shard body under shard_map. nbrs_l: int32[Vl, W] with *global*
     neighbor ids (sentinel = V_padded); deg_l: int32[Vl]; deg_g: int32[V]."""
     vl, w = nbrs_l.shape
-    vg = deg_g.shape[0]
     shard = jax.lax.axis_index(VERTEX_AXIS)
     my_ids = (shard * vl + jnp.arange(vl, dtype=jnp.int32)).astype(jnp.int32)
     k = jnp.asarray(k, jnp.int32)
 
-    colors0_l = jnp.where(deg_l == 0, 0, -1).astype(jnp.int32)
+    packed0_l = jnp.where(deg_l == 0, 0, -1).astype(jnp.int32)
 
     # loop-invariant neighbor priority (degree desc, id asc)
     deg_g_pad = jnp.concatenate([deg_g, jnp.array([-1], jnp.int32)])
@@ -69,39 +83,26 @@ def _shard_body(nbrs_l, deg_l, deg_g, k, num_planes: int, max_steps: int):
         return status == _RUNNING
 
     def body(carry):
-        colors_l, step, status = carry
-        colors_g = jax.lax.all_gather(colors_l, VERTEX_AXIS, tiled=True)   # [V] int32
-        colors_pad = jnp.concatenate([colors_g, jnp.array([-1], jnp.int32)])
-        nc = colors_pad[nbrs_l]                                            # [Vl, W]
-        forb = forbidden_planes(nc, num_planes)
-        cand_l, fail_l = first_fit(forb, k)
-        uncol_l = colors_l < 0
-        any_fail = jax.lax.psum(jnp.sum((uncol_l & fail_l).astype(jnp.int32)), VERTEX_AXIS) > 0
-
-        code_l = jnp.where(uncol_l, cand_l, -1).astype(jnp.int32)
-        code_g = jax.lax.all_gather(code_l, VERTEX_AXIS, tiled=True)       # [V] int32
-        code_pad = jnp.concatenate([code_g, jnp.array([-1], jnp.int32)])
-        n_code = code_pad[nbrs_l]
-        beaten = (n_code == cand_l[:, None]) & pre_beats
-        keep = ~jnp.any(beaten, axis=1)
-
-        new_colors_l = jnp.where(uncol_l & keep & ~fail_l, cand_l, colors_l)
-        uncol_after = jax.lax.psum(jnp.sum((new_colors_l < 0).astype(jnp.int32)), VERTEX_AXIS)
+        packed_l, step, status = carry
+        new_packed_l, any_fail, active = _shard_superstep(
+            packed_l, nbrs_l, pre_beats, k, num_planes
+        )
         status = jnp.where(
             any_fail,
             _FAILURE,
             jnp.where(
-                uncol_after == 0,
+                active == 0,
                 _SUCCESS,
                 jnp.where(step + 1 >= max_steps, _STALLED, _RUNNING),
             ),
         ).astype(jnp.int32)
-        new_colors_l = jnp.where(any_fail, colors_l, new_colors_l)
-        return (new_colors_l, step + 1, status)
+        new_packed_l = jnp.where(any_fail, packed_l, new_packed_l)
+        return (new_packed_l, step + 1, status)
 
-    colors_l, steps, status = jax.lax.while_loop(
-        cond, body, (colors0_l, jnp.int32(0), jnp.int32(_RUNNING))
+    packed_l, steps, status = jax.lax.while_loop(
+        cond, body, (packed0_l, jnp.int32(0), jnp.int32(_RUNNING))
     )
+    colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
     return colors_l, steps, status
 
 
@@ -131,7 +132,7 @@ class ShardedELLEngine:
         deg_p[:v] = degrees
 
         self.num_planes = num_planes_for(arrays.max_degree + 1)
-        self.max_steps = max_steps if max_steps is not None else v_pad + 2
+        self.max_steps = max_steps if max_steps is not None else 2 * v_pad + 4
 
         shard_rows = NamedSharding(self.mesh, P(VERTEX_AXIS))
         replicated = NamedSharding(self.mesh, P())

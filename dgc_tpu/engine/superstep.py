@@ -3,29 +3,37 @@
 One k-attempt runs entirely on device as a ``lax.while_loop`` whose body is
 one BSP superstep — the TPU-native replacement for the reference's
 per-superstep driver round-trips (2-3 RDD actions + an O(V) color collect +
-3 shuffles each, SURVEY.md §3.2):
+3 shuffles each, SURVEY.md §3.2).
 
-1. **Gather** neighbor colors through the padded ELL table (the reference's
-   broadcast + neighbor-copy rewrite, ``coloring.py:82-83``).
-2. **First-fit** candidate via bitmask planes (``ops.bitmask``) — the
-   reference's ``assign_color``/``determine_color_key`` with the optimized
-   engine's eager semantics: a vertex with no colored neighbor becomes a
-   candidate for color 0 (``coloring_optimized.py:159-160``), which is what
-   makes every component progress (deadlock-freedom, SURVEY.md §2.4.1).
-3. **Conflict resolution** as a data-parallel priority rule (Jones–Plotkin
-   style): a vertex keeps its candidate iff no *uncolored* neighbor shares
-   the candidate with higher (degree desc, id asc) priority — the optimized
-   engine's high-degree-wins order (``coloring_optimized.py:170-172``) with
-   zero shuffles. The globally highest-priority uncolored vertex always
-   keeps, so every superstep colors ≥ 1 vertex: termination in ≤ V steps.
-4. **Failure** when any uncolored vertex's forbidden set covers [0, k)
-   (reference sentinel −3 → immediate ``(False, rdd)``,
-   ``coloring.py:104-108``).
+The superstep is a *speculative* variant of Jones–Plotkin symmetry breaking,
+chosen because the neighbor-state gather is the dominant cost on TPU (XLA
+element gathers run at ~100M lookups/s), so the kernel does exactly **one
+[V, W] gather per superstep** of a packed (color, fresh) word instead of two
+(colors, then candidates):
 
-The loop-invariant parts of the conflict test (neighbor degree/id priority
-comparisons) are precomputed outside the while_loop, leaving two [V, W]
-int32 gathers per superstep. ``k`` is dynamic — one compile serves the whole
-minimal-k sweep.
+1. **Gather** packed neighbor state through the padded ELL table.
+2. **Demote**: a vertex assigned last round ("fresh") gives its color back
+   iff a fresh neighbor with the same color has higher (degree desc, id asc)
+   priority — the optimized reference's high-degree-wins conflict order
+   (``coloring_optimized.py:170-172``). Confirmed ("old") colors are
+   conflict-free by induction, so only fresh-fresh conflicts exist.
+3. **First-fit** candidates for uncolored/demoted vertices via bitmask
+   planes over *all* colored neighbors (optimized-engine eager semantics:
+   no colored neighbor → candidate 0, ``coloring_optimized.py:159-160``) —
+   assignments are speculative and get conflict-checked next round.
+4. **Failure** exactly when an uncolored vertex's *confirmed*-neighbor
+   forbidden set covers [0, k) — the reference's sentinel −3
+   (``coloring.py:53,104-108``); speculative colors never trigger failure.
+
+Per round, the highest-priority fresh vertex of every contested color class
+confirms, so every superstep makes progress (termination ≤ ~2·V steps;
+O(log V / log log V) expected on bounded-degree random graphs).
+
+State packing: ``packed = color·2 + fresh`` for colored vertices, −1 for
+uncolored; the ELL pad sentinel row also holds −1. The loop-invariant
+priority comparison is precomputed outside the while_loop, leaving one
+[V, W] int32 gather + elementwise work per superstep. ``k`` is dynamic —
+one compile serves the whole minimal-k sweep.
 """
 
 from __future__ import annotations
@@ -38,12 +46,24 @@ import numpy as np
 
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
 from dgc_tpu.models.arrays import GraphArrays
-from dgc_tpu.ops.bitmask import first_fit, forbidden_planes, num_planes_for
+from dgc_tpu.ops.bitmask import num_planes_for
+from dgc_tpu.ops.speculative import speculative_update
 
 _RUNNING = AttemptStatus.RUNNING
 _SUCCESS = AttemptStatus.SUCCESS
 _FAILURE = AttemptStatus.FAILURE
 _STALLED = AttemptStatus.STALLED
+
+
+def superstep(packed, nbrs, pre_beats, k, num_planes: int):
+    """One speculative BSP superstep on packed state. Returns
+    (new_packed, any_fail, active_count)."""
+    packed_pad = jnp.concatenate([packed, jnp.array([-1], jnp.int32)])
+    np_ = packed_pad[nbrs]                       # the single [V, W] gather
+    new_packed, fail_mask, active_mask = speculative_update(
+        packed, np_, pre_beats, k, num_planes
+    )
+    return new_packed, jnp.any(fail_mask), jnp.sum(active_mask.astype(jnp.int32))
 
 
 @partial(jax.jit, static_argnames=("num_planes", "max_steps"))
@@ -53,15 +73,15 @@ def _attempt_kernel(nbrs, degrees, k, num_planes: int, max_steps: int):
     ids = jnp.arange(v, dtype=jnp.int32)
     k = jnp.asarray(k, jnp.int32)
 
-    # Reset pass: isolated vertices → color 0 immediately, rest → −1
-    # (reference changeColorFirstIteration, coloring.py:12-17). The max-degree
-    # seed (coloring.py:19-35) is subsumed by the priority rule: the highest-
-    # priority vertex unconditionally wins its candidate in superstep 1.
-    colors0 = jnp.where(degrees == 0, 0, -1).astype(jnp.int32)
+    # Reset pass: isolated vertices → color 0 (confirmed) immediately, rest
+    # uncolored (reference changeColorFirstIteration, coloring.py:12-17).
+    # The max-degree seed (coloring.py:19-35) is subsumed by the priority
+    # rule: the highest-priority vertex confirms its color in round 2.
+    packed0 = jnp.where(degrees == 0, 0, -1).astype(jnp.int32)
 
-    # Loop-invariant neighbor priority: does neighbor slot j beat vertex i?
+    # loop-invariant neighbor priority: does neighbor slot j beat vertex i?
     deg_pad = jnp.concatenate([degrees, jnp.array([-1], jnp.int32)])
-    n_deg = deg_pad[nbrs]                       # sentinel → −1, never beats
+    n_deg = deg_pad[nbrs]                         # sentinel → −1, never beats
     my_deg = degrees[:, None]
     pre_beats = (n_deg > my_deg) | ((n_deg == my_deg) & (nbrs < ids[:, None]))
 
@@ -70,42 +90,26 @@ def _attempt_kernel(nbrs, degrees, k, num_planes: int, max_steps: int):
         return status == _RUNNING
 
     def body(carry):
-        colors, step, status = carry
-        colors_pad = jnp.concatenate([colors, jnp.array([-1], jnp.int32)])
-        nc = colors_pad[nbrs]                                   # gather #1
-        forb = forbidden_planes(nc, num_planes)
-        cand, fail_v = first_fit(forb, k)
-        uncol = colors < 0
-        any_fail = jnp.any(uncol & fail_v)
-
-        # candidate code: cand for uncolored vertices, −1 otherwise; the
-        # sentinel pad slot is −1 so padding never contests a candidate.
-        code = jnp.where(uncol, cand, -1).astype(jnp.int32)
-        code_pad = jnp.concatenate([code, jnp.array([-1], jnp.int32)])
-        n_code = code_pad[nbrs]                                 # gather #2
-        beaten = (n_code == cand[:, None]) & pre_beats
-        keep = ~jnp.any(beaten, axis=1)
-
-        new_colors = jnp.where(uncol & keep & ~fail_v, cand, colors)
-        uncol_after = jnp.sum(new_colors < 0)
+        packed, step, status = carry
+        new_packed, any_fail, active = superstep(packed, nbrs, pre_beats, k, num_planes)
         status = jnp.where(
             any_fail,
             _FAILURE,
             jnp.where(
-                uncol_after == 0,
+                active == 0,
                 _SUCCESS,
                 jnp.where(step + 1 >= max_steps, _STALLED, _RUNNING),
             ),
         ).astype(jnp.int32)
-        # On failure the attempt's colors are discarded by the outer loop;
-        # keep the pre-step colors (reference returns without applying,
-        # coloring.py:104-108).
-        new_colors = jnp.where(any_fail, colors, new_colors)
-        return (new_colors, step + 1, status)
+        # on failure the attempt is discarded; keep pre-step state
+        # (reference returns without applying, coloring.py:104-108)
+        new_packed = jnp.where(any_fail, packed, new_packed)
+        return (new_packed, step + 1, status)
 
-    colors, steps, status = jax.lax.while_loop(
-        cond, body, (colors0, jnp.int32(0), jnp.int32(_RUNNING))
+    packed, steps, status = jax.lax.while_loop(
+        cond, body, (packed0, jnp.int32(0), jnp.int32(_RUNNING))
     )
+    colors = jnp.where(packed >= 0, packed >> 1, -1).astype(jnp.int32)
     return status, colors, steps
 
 
@@ -119,7 +123,7 @@ class ELLEngine:
         self.degrees = jnp.asarray(degrees)
         self.num_planes = num_planes_for(arrays.max_degree + 1)
         v = arrays.num_vertices
-        self.max_steps = max_steps if max_steps is not None else v + 2
+        self.max_steps = max_steps if max_steps is not None else 2 * v + 4
 
     def attempt(self, k: int) -> AttemptResult:
         if k > 32 * self.num_planes:

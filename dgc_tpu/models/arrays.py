@@ -109,13 +109,14 @@ class GraphArrays:
 
 
 def csr_to_ell(
-    indptr: np.ndarray, indices: np.ndarray, width: int | None = None, pad_to: int = 1
+    indptr: np.ndarray, indices: np.ndarray, width: int | None = None,
+    pad_to: int = 1, sentinel: int | None = None,
 ) -> tuple[np.ndarray, np.ndarray]:
     """Convert CSR to sentinel-padded ELL.
 
     Returns ``(nbrs int32[V, W], degrees int32[V])`` with pad slots set to
-    ``V`` (the sentinel vertex). ``W = max(width or max_degree, 1)`` rounded
-    up to a multiple of ``pad_to``.
+    ``sentinel`` (default: ``V``, the one-past-the-end vertex). ``W =
+    max(width or max_degree, 1)`` rounded up to a multiple of ``pad_to``.
     """
     indptr = np.asarray(indptr)
     indices = np.asarray(indices)
@@ -126,7 +127,7 @@ def csr_to_ell(
     if w < maxd:
         raise ValueError(f"ELL width {w} < max degree {maxd}")
     w = -(-w // pad_to) * pad_to
-    nbrs = np.full((v, w), v, dtype=np.int32)
+    nbrs = np.full((v, w), v if sentinel is None else sentinel, dtype=np.int32)
     # vectorized fill: position of each index within its row
     if len(indices):
         rows = np.repeat(np.arange(v, dtype=np.int64), degrees)

@@ -23,13 +23,40 @@ import numpy as np
 from dgc_tpu.models.arrays import GraphArrays
 
 
+def _native():
+    """The C++ generator module, or None (import deferred to avoid cycles)."""
+    try:
+        from dgc_tpu.native import bindings
+
+        return bindings if bindings.native_available() else None
+    except Exception:
+        return None
+
+
 def generate_random_graph(
     node_count: int,
     max_degree: int,
     seed: int | None = None,
     max_retries_per_vertex: int | None = None,
+    native: bool | None = None,
 ) -> GraphArrays:
-    """Reference-semantics generator (bounded). Suitable for V up to ~100k."""
+    """Reference-semantics generator (bounded retries).
+
+    ``native=None`` auto-selects the C++ implementation for large V (same
+    semantics, different RNG stream); ``native=False`` forces the Python
+    path (deterministic under ``random.Random(seed)``).
+    """
+    if native is None:
+        native = node_count >= 50_000
+    if native:
+        nb = _native()
+        if nb is not None:
+            out = nb.generate_reference_native(
+                node_count, max_degree, seed=seed,
+                max_retries_per_vertex=max_retries_per_vertex,
+            )
+            if out is not None:
+                return out
     rng = random.Random(seed)
     neighbors: list[set[int]] = [set() for _ in range(node_count)]
     if max_retries_per_vertex is None:
@@ -53,13 +80,25 @@ def generate_random_graph_fast(
     avg_degree: float,
     seed: int | None = None,
     max_degree: int | None = None,
+    native: bool | None = None,
 ) -> GraphArrays:
     """Vectorized uniform edge sampling for large graphs.
 
     Draws ``node_count * avg_degree / 2`` candidate edges uniformly, removes
     self loops and duplicates, and (optionally) drops edges at vertices that
     exceed ``max_degree`` (processed in sampled order, like the reference cap).
+    ``native=None`` auto-selects the C++ implementation for large V.
     """
+    if native is None:
+        native = node_count >= 50_000
+    if native:
+        nb = _native()
+        if nb is not None:
+            out = nb.generate_fast_native(
+                node_count, avg_degree, seed=seed, max_degree=max_degree
+            )
+            if out is not None:
+                return out
     rng = np.random.default_rng(seed)
     m = int(node_count * avg_degree / 2)
     src = rng.integers(0, node_count, size=m, dtype=np.int64)
@@ -85,12 +124,25 @@ def generate_rmat_graph(
     b: float = 0.19,
     c: float = 0.19,
     max_degree: int | None = None,
+    native: bool | None = None,
 ) -> GraphArrays:
     """R-MAT power-law generator (Chakrabarti et al.): recursive quadrant
     sampling, vectorized over all edges at once. ``node_count`` is rounded up
     to a power of two internally; vertices beyond ``node_count`` are remapped
     by modulo so the returned graph has exactly ``node_count`` vertices.
+    ``native=None`` auto-selects the C++ implementation for large V.
     """
+    if native is None:
+        native = node_count >= 50_000
+    if native:
+        nb = _native()
+        if nb is not None:
+            out = nb.generate_rmat_native(
+                node_count, avg_degree, seed=seed, a=a, b=b, c=c,
+                max_degree=max_degree,
+            )
+            if out is not None:
+                return out
     rng = np.random.default_rng(seed)
     scale = max(1, int(np.ceil(np.log2(max(node_count, 2)))))
     m = int(node_count * avg_degree / 2)

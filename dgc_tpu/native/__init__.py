@@ -1,0 +1,23 @@
+"""Native (C++) runtime components, bound via ctypes.
+
+The reference has no native code (SURVEY.md §2.6); here the host-side
+runtime around the TPU compute path is native where it matters: graph
+generation and CSR construction, which otherwise bottleneck the pipeline at
+million-vertex scale (CPython rejection sampling vs the device coloring the
+graph in seconds). Pure-Python fallbacks in ``dgc_tpu.models.generators``
+keep everything working when the shared library isn't built.
+"""
+
+from dgc_tpu.native.bindings import (
+    native_available,
+    generate_fast_native,
+    generate_reference_native,
+    generate_rmat_native,
+)
+
+__all__ = [
+    "native_available",
+    "generate_fast_native",
+    "generate_reference_native",
+    "generate_rmat_native",
+]

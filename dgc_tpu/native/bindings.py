@@ -1,0 +1,143 @@
+"""ctypes bindings for the native graph generator.
+
+No pybind11 in this image; the C ABI in ``graphgen.cpp`` is loaded with
+ctypes. The shared library is built on demand (one ``g++ -O3 -shared``
+invocation, cached next to the source) the first time a native generator is
+requested; failures degrade silently to the Python implementations.
+"""
+
+from __future__ import annotations
+
+import ctypes
+import os
+import subprocess
+import threading
+from pathlib import Path
+
+import numpy as np
+
+_HERE = Path(__file__).resolve().parent
+_SRC = _HERE / "graphgen.cpp"
+_LIB = _HERE / "libdgcgraph.so"
+
+_lock = threading.Lock()
+_lib = None
+_load_failed = False
+
+
+def _build() -> bool:
+    # -O3 without -march=native: the .so is machine-local (gitignored), but a
+    # copied tree must never SIGILL on an older CPU — portable codegen only.
+    tmp = str(_LIB) + ".tmp"
+    cmd = ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", tmp]
+    try:
+        subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        os.replace(tmp, _LIB)
+        return True
+    except Exception:
+        return False
+
+
+def _load():
+    global _lib, _load_failed
+    with _lock:
+        if _lib is not None or _load_failed:
+            return _lib
+        # <= so equal mtimes (fresh checkout / copied tree) trigger a rebuild
+        if not _LIB.exists() or _LIB.stat().st_mtime <= _SRC.stat().st_mtime:
+            if not _build():
+                _load_failed = True
+                return None
+        try:
+            lib = ctypes.CDLL(str(_LIB))
+        except OSError:
+            _load_failed = True
+            return None
+        lib.dgc_generate_fast.restype = ctypes.c_void_p
+        lib.dgc_generate_fast.argtypes = [
+            ctypes.c_int64, ctypes.c_double, ctypes.c_uint64, ctypes.c_int32,
+        ]
+        lib.dgc_generate_reference.restype = ctypes.c_void_p
+        lib.dgc_generate_reference.argtypes = [
+            ctypes.c_int64, ctypes.c_int32, ctypes.c_uint64, ctypes.c_int64,
+        ]
+        lib.dgc_generate_rmat.restype = ctypes.c_void_p
+        lib.dgc_generate_rmat.argtypes = [
+            ctypes.c_int64, ctypes.c_double, ctypes.c_uint64,
+            ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_int32,
+        ]
+        lib.dgc_num_vertices.restype = ctypes.c_int64
+        lib.dgc_num_vertices.argtypes = [ctypes.c_void_p]
+        lib.dgc_num_directed_edges.restype = ctypes.c_int64
+        lib.dgc_num_directed_edges.argtypes = [ctypes.c_void_p]
+        lib.dgc_copy_csr.restype = None
+        lib.dgc_copy_csr.argtypes = [
+            ctypes.c_void_p,
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+        ]
+        lib.dgc_free.restype = None
+        lib.dgc_free.argtypes = [ctypes.c_void_p]
+        _lib = lib
+        return _lib
+
+
+def native_available() -> bool:
+    return _load() is not None
+
+
+def _resolve_seed(seed: int | None) -> int:
+    """None → fresh OS entropy (matching random.Random(None) semantics);
+    the C ABI needs a concrete uint64."""
+    if seed is None:
+        return int.from_bytes(os.urandom(8), "little")
+    return int(seed) & 0xFFFFFFFFFFFFFFFF
+
+
+def _extract(lib, handle):
+    from dgc_tpu.models.arrays import GraphArrays
+
+    if not handle:  # NULL: native generator failed (e.g. allocation) — fall back
+        return None
+    try:
+        v = lib.dgc_num_vertices(handle)
+        e = lib.dgc_num_directed_edges(handle)
+        indptr = np.empty(v + 1, dtype=np.int32)
+        indices = np.empty(e, dtype=np.int32)
+        lib.dgc_copy_csr(handle, indptr, indices)
+    finally:
+        lib.dgc_free(handle)
+    return GraphArrays(indptr=indptr, indices=indices)
+
+
+def generate_fast_native(node_count: int, avg_degree: float, seed: int | None = None,
+                         max_degree: int | None = None):
+    lib = _load()
+    if lib is None:
+        return None
+    h = lib.dgc_generate_fast(node_count, avg_degree, _resolve_seed(seed),
+                              -1 if max_degree is None else max_degree)
+    return _extract(lib, h)
+
+
+def generate_reference_native(node_count: int, max_degree: int, seed: int | None = None,
+                              max_retries_per_vertex: int | None = None):
+    lib = _load()
+    if lib is None:
+        return None
+    h = lib.dgc_generate_reference(
+        node_count, max_degree, _resolve_seed(seed),
+        -1 if max_retries_per_vertex is None else max_retries_per_vertex,
+    )
+    return _extract(lib, h)
+
+
+def generate_rmat_native(node_count: int, avg_degree: float, seed: int | None = None,
+                         a: float = 0.57, b: float = 0.19, c: float = 0.19,
+                         max_degree: int | None = None):
+    lib = _load()
+    if lib is None:
+        return None
+    h = lib.dgc_generate_rmat(node_count, avg_degree, _resolve_seed(seed), a, b, c,
+                              -1 if max_degree is None else max_degree)
+    return _extract(lib, h)

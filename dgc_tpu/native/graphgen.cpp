@@ -1,0 +1,217 @@
+// Native graph generation + CSR construction for dgc_tpu.
+//
+// The reference repo is pure Python (SURVEY.md §2.6 — no native components);
+// its generator (graph.py:30-43) is a host-side rejection sampler that becomes
+// the pipeline bottleneck at TPU scale (the device colors 1M vertices faster
+// than CPython can build them). This library provides the three generators
+// with the same semantics as dgc_tpu.models.generators, at C++ speed:
+//
+//  - reference: visit vertices in id order, target degree ~ U{0..max_degree},
+//    rejection-sample partners (no self loop / duplicate / partner at cap),
+//    symmetric insert, bounded retries.
+//  - fast: uniform edge sampling with dedup and an *exact sequential greedy*
+//    degree cap (the Python fallback uses a stricter one-pass rank cap).
+//  - rmat: recursive quadrant sampling (R-MAT), optional greedy cap.
+//
+// Exposed as a C ABI for ctypes (no pybind11 in this image). Graphs are
+// returned as an opaque handle; callers read CSR sizes, copy out, and free.
+
+#include <algorithm>
+#include <cstdint>
+#include <cstring>
+#include <random>
+#include <unordered_set>
+#include <vector>
+
+namespace {
+
+struct DgcGraph {
+  int64_t num_vertices = 0;
+  std::vector<int32_t> indptr;   // [V+1]
+  std::vector<int32_t> indices;  // [E2]
+};
+
+// Build symmetric CSR from an undirected (deduped) edge list.
+DgcGraph build_csr(int64_t v, const std::vector<std::pair<int32_t, int32_t>>& edges) {
+  DgcGraph g;
+  g.num_vertices = v;
+  std::vector<int32_t> deg(v, 0);
+  for (auto& e : edges) {
+    deg[e.first]++;
+    deg[e.second]++;
+  }
+  g.indptr.resize(v + 1);
+  g.indptr[0] = 0;
+  for (int64_t i = 0; i < v; ++i) g.indptr[i + 1] = g.indptr[i] + deg[i];
+  g.indices.resize(g.indptr[v]);
+  std::vector<int32_t> cursor(g.indptr.begin(), g.indptr.end() - 1);
+  for (auto& e : edges) {
+    g.indices[cursor[e.first]++] = e.second;
+    g.indices[cursor[e.second]++] = e.first;
+  }
+  // sort each neighbor list for deterministic output (matches the Python path)
+  for (int64_t i = 0; i < v; ++i)
+    std::sort(g.indices.begin() + g.indptr[i], g.indices.begin() + g.indptr[i + 1]);
+  return g;
+}
+
+// Dedup undirected edges (and drop self loops), preserving first-seen order.
+// Sort-based: at 10^8 sampled edges an unordered_set spends most of the
+// generator's wall-clock on hashing/chasing; sort+mark is ~10x faster.
+void dedup_edges(int64_t v, std::vector<std::pair<int32_t, int32_t>>& edges) {
+  const size_t n = edges.size();
+  std::vector<std::pair<uint64_t, uint32_t>> keyed;  // (canonical key, position)
+  keyed.reserve(n);
+  for (size_t i = 0; i < n; ++i) {
+    auto& e = edges[i];
+    if (e.first == e.second) continue;
+    uint64_t lo = std::min(e.first, e.second), hi = std::max(e.first, e.second);
+    keyed.emplace_back(lo * (uint64_t)v + hi, (uint32_t)i);
+  }
+  std::sort(keyed.begin(), keyed.end());
+  std::vector<uint32_t> keep_pos;
+  keep_pos.reserve(keyed.size());
+  for (size_t i = 0; i < keyed.size(); ++i) {
+    if (i == 0 || keyed[i].first != keyed[i - 1].first)
+      keep_pos.push_back(keyed[i].second);
+    else
+      // duplicates keep the earliest occurrence (first-seen order)
+      keep_pos.back() = std::min(keep_pos.back(), keyed[i].second);
+  }
+  std::sort(keep_pos.begin(), keep_pos.end());
+  std::vector<std::pair<int32_t, int32_t>> out;
+  out.reserve(keep_pos.size());
+  for (uint32_t p : keep_pos) out.push_back(edges[p]);
+  edges.swap(out);
+}
+
+// Exact sequential greedy degree cap (keeps an edge iff both endpoints are
+// under max_degree at its position — the reference partner-cap semantics,
+// graph.py:38, applied in sampled order).
+void greedy_cap(int64_t v, std::vector<std::pair<int32_t, int32_t>>& edges,
+                int32_t max_degree) {
+  std::vector<int32_t> deg(v, 0);
+  size_t out = 0;
+  for (auto& e : edges) {
+    if (deg[e.first] < max_degree && deg[e.second] < max_degree) {
+      deg[e.first]++;
+      deg[e.second]++;
+      edges[out++] = e;
+    }
+  }
+  edges.resize(out);
+}
+
+}  // namespace
+
+extern "C" {
+
+// Exceptions (std::bad_alloc at multi-GB scale) must not cross the C ABI —
+// they would std::terminate() the host Python process instead of letting the
+// bindings fall back to the Python generators. NULL signals failure.
+#define DGC_GUARD_BEGIN try {
+#define DGC_GUARD_END \
+  }                   \
+  catch (...) { return nullptr; }
+
+void* dgc_generate_fast(int64_t node_count, double avg_degree, uint64_t seed,
+                        int32_t max_degree) {
+  DGC_GUARD_BEGIN
+  std::mt19937_64 rng(seed);
+  std::uniform_int_distribution<int64_t> pick(0, node_count - 1);
+  int64_t m = (int64_t)(node_count * avg_degree / 2.0);
+  std::vector<std::pair<int32_t, int32_t>> edges;
+  edges.reserve(m);
+  for (int64_t i = 0; i < m; ++i)
+    edges.emplace_back((int32_t)pick(rng), (int32_t)pick(rng));
+  dedup_edges(node_count, edges);
+  if (max_degree >= 0) greedy_cap(node_count, edges, max_degree);
+  return new DgcGraph(build_csr(node_count, edges));
+  DGC_GUARD_END
+}
+
+void* dgc_generate_reference(int64_t node_count, int32_t max_degree, uint64_t seed,
+                             int64_t max_retries_per_vertex) {
+  DGC_GUARD_BEGIN
+  std::mt19937_64 rng(seed);
+  if (max_retries_per_vertex < 0) max_retries_per_vertex = 50L * std::max(max_degree, 1);
+  std::vector<std::vector<int32_t>> nbrs(node_count);
+  std::vector<std::unordered_set<int32_t>> sets(node_count);
+  std::uniform_int_distribution<int64_t> pick(0, node_count - 1);
+  for (int64_t vtx = 0; vtx < node_count; ++vtx) {
+    std::uniform_int_distribution<int32_t> degd(0, max_degree);
+    int32_t target = degd(rng);
+    int64_t tries = 0;
+    while ((int32_t)nbrs[vtx].size() < target && tries < max_retries_per_vertex) {
+      ++tries;
+      int64_t u = pick(rng);
+      if (u == vtx || sets[vtx].count((int32_t)u) ||
+          (int32_t)nbrs[u].size() >= max_degree)
+        continue;
+      nbrs[vtx].push_back((int32_t)u);
+      sets[vtx].insert((int32_t)u);
+      nbrs[u].push_back((int32_t)vtx);
+      sets[u].insert((int32_t)vtx);
+    }
+  }
+  auto* g = new DgcGraph();
+  g->num_vertices = node_count;
+  g->indptr.resize(node_count + 1);
+  g->indptr[0] = 0;
+  for (int64_t i = 0; i < node_count; ++i)
+    g->indptr[i + 1] = g->indptr[i] + (int32_t)nbrs[i].size();
+  g->indices.resize(g->indptr[node_count]);
+  for (int64_t i = 0; i < node_count; ++i) {
+    std::sort(nbrs[i].begin(), nbrs[i].end());
+    std::copy(nbrs[i].begin(), nbrs[i].end(), g->indices.begin() + g->indptr[i]);
+  }
+  return g;
+  DGC_GUARD_END
+}
+
+void* dgc_generate_rmat(int64_t node_count, double avg_degree, uint64_t seed,
+                        double a, double b, double c, int32_t max_degree) {
+  DGC_GUARD_BEGIN
+  std::mt19937_64 rng(seed);
+  std::uniform_real_distribution<double> unif(0.0, 1.0);
+  int scale = 1;
+  while ((1L << scale) < node_count) ++scale;
+  int64_t m = (int64_t)(node_count * avg_degree / 2.0);
+  double ab = a + b;
+  double abc = a + b + c;
+  double right_top = b / ab;
+  double right_bot = (1.0 - ab) > 0 ? (1.0 - abc) / (1.0 - ab) : 0.5;
+  std::vector<std::pair<int32_t, int32_t>> edges;
+  edges.reserve(m);
+  for (int64_t i = 0; i < m; ++i) {
+    int64_t src = 0, dst = 0;
+    for (int s = 0; s < scale; ++s) {
+      double r = unif(rng);
+      bool bottom = r >= ab;
+      src = src * 2 + (bottom ? 1 : 0);
+      double pr = bottom ? right_bot : right_top;
+      dst = dst * 2 + (unif(rng) < pr ? 1 : 0);
+    }
+    edges.emplace_back((int32_t)(src % node_count), (int32_t)(dst % node_count));
+  }
+  dedup_edges(node_count, edges);
+  if (max_degree >= 0) greedy_cap(node_count, edges, max_degree);
+  return new DgcGraph(build_csr(node_count, edges));
+  DGC_GUARD_END
+}
+
+int64_t dgc_num_vertices(void* h) { return static_cast<DgcGraph*>(h)->num_vertices; }
+
+int64_t dgc_num_directed_edges(void* h) {
+  return (int64_t) static_cast<DgcGraph*>(h)->indices.size();
+}
+
+void dgc_copy_csr(void* h, int32_t* indptr_out, int32_t* indices_out) {
+  auto* g = static_cast<DgcGraph*>(h);
+  std::memcpy(indptr_out, g->indptr.data(), g->indptr.size() * sizeof(int32_t));
+  std::memcpy(indices_out, g->indices.data(), g->indices.size() * sizeof(int32_t));
+}
+
+void dgc_free(void* h) { delete static_cast<DgcGraph*>(h); }
+
+}  // extern "C"

@@ -1,0 +1,69 @@
+"""The speculative superstep core, shared by every array engine.
+
+One function owns the conflict-rule semantics (demote → first-fit →
+assign/confirm, reference citations in ``engine.superstep``); the engines
+differ only in how they gather neighbor state (plain ELL gather, per-bucket
+gathers, all-gather + gather on a shard) and how they reduce the returned
+masks (``jnp.sum``/``any`` vs ``lax.psum``). Keeping the core in one place
+is what makes the "same rule, bit-identical results" contract between the
+ELL and sharded engines a fact rather than a hope.
+"""
+
+from __future__ import annotations
+
+import jax.numpy as jnp
+
+from dgc_tpu.ops.bitmask import first_fit, forbidden_planes
+
+
+def speculative_update(packed_local, gathered, pre_beats, k, num_planes: int):
+    """One superstep's elementwise core.
+
+    Args:
+      packed_local: int32[Vl] — this block's packed state
+        (``color·2 + fresh``; −1 = uncolored).
+      gathered: int32[Vl, W] — neighbor packed state (−1 for uncolored
+        neighbors and ELL padding).
+      pre_beats: bool[Vl, W] — loop-invariant (degree desc, id asc) priority:
+        does neighbor slot j beat vertex i?
+      k: dynamic int32 color budget.
+      num_planes: static bitmask plane count.
+
+    Returns ``(new_packed int32[Vl], fail_mask bool[Vl], active_mask
+    bool[Vl])`` — the caller reduces fail/active however its topology needs.
+    """
+    nvalid = gathered >= 0
+    ncol = jnp.where(nvalid, gathered >> 1, -1)
+    nfresh = nvalid & ((gathered & 1) == 1)
+
+    mycol = packed_local >> 1  # arithmetic shift: −1 stays −1
+    myfresh = (packed_local >= 0) & ((packed_local & 1) == 1)
+    uncol = packed_local < 0
+
+    # fresh-fresh conflict demotion (confirmed colors are conflict-free by
+    # induction, so only fresh-fresh conflicts exist)
+    clash = nfresh & (ncol == mycol[:, None]) & pre_beats
+    demote = myfresh & jnp.any(clash, axis=1)
+
+    # forbidden sets: all colored neighbors (for candidates) and confirmed
+    # ones only (for exact reference failure semantics)
+    forb_all = forbidden_planes(ncol, num_planes)
+    forb_old = forbidden_planes(jnp.where(nfresh, -1, ncol), num_planes)
+    cand, nofree_all = first_fit(forb_all, k)
+    _, fail_old = first_fit(forb_old, k)
+
+    needs_color = uncol | demote
+    assign = needs_color & ~nofree_all
+
+    new_packed = jnp.where(
+        assign,
+        cand * 2 + 1,                                    # speculative (fresh)
+        jnp.where(
+            demote,
+            -1,                                          # couldn't re-pick this round
+            jnp.where(myfresh, mycol * 2, packed_local)  # confirm fresh → old
+        ),
+    ).astype(jnp.int32)
+    fail_mask = needs_color & fail_old
+    active_mask = (new_packed < 0) | ((new_packed & 1) == 1)
+    return new_packed, fail_mask, active_mask

@@ -1,0 +1,92 @@
+"""Tracing/profiling subsystem.
+
+The reference's tracing is wall-clock prints around each k-iteration and
+per-superstep uncolored counts (``coloring.py:89,214-223``, SURVEY.md §5).
+Equivalents here:
+
+- ``Timer``: accumulating scoped timer for host-side phases.
+- ``trace_attempt``: run one k-attempt superstep-at-a-time (host-stepped
+  loop over the jitted superstep instead of the fused ``lax.while_loop``),
+  recording per-superstep active counts and wall times. Slower than the
+  fused kernel (one dispatch per superstep) — an observability mode, not
+  the production path.
+- ``profile``: context manager around ``jax.profiler.trace`` for XLA-level
+  traces when a trace dir is given.
+"""
+
+from __future__ import annotations
+
+import contextlib
+import time
+from dataclasses import dataclass, field
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+@dataclass
+class Timer:
+    totals: dict = field(default_factory=dict)
+
+    @contextlib.contextmanager
+    def section(self, name: str):
+        t0 = time.perf_counter()
+        try:
+            yield
+        finally:
+            self.totals[name] = self.totals.get(name, 0.0) + time.perf_counter() - t0
+
+
+@dataclass
+class AttemptTrace:
+    k: int
+    active_per_step: list[int] = field(default_factory=list)
+    step_seconds: list[float] = field(default_factory=list)
+    status: int | None = None
+
+
+def trace_attempt(engine, k: int, max_steps: int | None = None) -> AttemptTrace:
+    """Host-stepped attempt on an ELLEngine, recording per-superstep metrics
+    (the reference's uncolored-count prints, ``coloring.py:89``)."""
+    from functools import partial
+
+    from dgc_tpu.engine.base import AttemptStatus
+    from dgc_tpu.engine.superstep import superstep
+
+    nbrs = engine.nbrs
+    degrees = engine.degrees
+    v = nbrs.shape[0]
+    ids = jnp.arange(v, dtype=jnp.int32)
+    deg_pad = jnp.concatenate([degrees, jnp.array([-1], jnp.int32)])
+    n_deg = deg_pad[nbrs]
+    pre_beats = (n_deg > degrees[:, None]) | ((n_deg == degrees[:, None]) & (nbrs < ids[:, None]))
+
+    step_fn = jax.jit(partial(superstep, num_planes=engine.num_planes))
+    packed = jnp.where(degrees == 0, 0, -1).astype(jnp.int32)
+    trace = AttemptTrace(k=k)
+    limit = max_steps if max_steps is not None else engine.max_steps
+    for _ in range(limit):
+        t0 = time.perf_counter()
+        packed, any_fail, active = step_fn(packed, nbrs, pre_beats, jnp.int32(k))
+        active = int(active)
+        trace.step_seconds.append(time.perf_counter() - t0)
+        trace.active_per_step.append(active)
+        if bool(any_fail):
+            trace.status = int(AttemptStatus.FAILURE)
+            return trace
+        if active == 0:
+            trace.status = int(AttemptStatus.SUCCESS)
+            return trace
+    trace.status = int(AttemptStatus.STALLED)
+    return trace
+
+
+@contextlib.contextmanager
+def profile(trace_dir: str | None):
+    """XLA profiler scope; no-op when trace_dir is falsy."""
+    if not trace_dir:
+        yield
+        return
+    with jax.profiler.trace(trace_dir):
+        yield

@@ -1,0 +1,76 @@
+"""Degree-bucketed engine tests."""
+
+import numpy as np
+import pytest
+
+from dgc_tpu.engine.base import AttemptStatus
+from dgc_tpu.engine.bucketed import BucketedELLEngine, _bucket_widths
+from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
+from dgc_tpu.engine.superstep import ELLEngine
+from dgc_tpu.models.generators import generate_random_graph, generate_rmat_graph
+from dgc_tpu.ops.validate import validate_coloring
+
+
+def test_bucket_widths():
+    assert _bucket_widths(32) == [8, 16, 32]
+    assert _bucket_widths(33) == [8, 16, 32, 64]
+    assert _bucket_widths(5) == [8]
+    assert _bucket_widths(8) == [8]
+
+
+def test_bucketed_valid_and_parity(small_graphs):
+    for g in small_graphs:
+        k0 = g.max_degree + 1
+        b = find_minimal_coloring(BucketedELLEngine(g), k0, validate=make_validator(g))
+        e = find_minimal_coloring(ELLEngine(g), k0)
+        assert b.minimal_colors is not None
+        assert validate_coloring(g.indptr, g.indices, b.colors).valid
+        assert abs(b.minimal_colors - e.minimal_colors) <= 1
+
+
+def test_bucketed_failure_below_minimal(medium_graph):
+    g = medium_graph
+    res = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1)
+    assert BucketedELLEngine(g).attempt(res.minimal_colors - 1).status == AttemptStatus.FAILURE
+
+
+def test_bucketed_deterministic(medium_graph):
+    g = medium_graph
+    r1 = BucketedELLEngine(g).attempt(g.max_degree + 1)
+    r2 = BucketedELLEngine(g).attempt(g.max_degree + 1)
+    assert np.array_equal(r1.colors, r2.colors)
+
+
+def test_bucketed_heavy_tail():
+    # power-law degrees: the case bucketing exists for (SURVEY §7.3)
+    g = generate_rmat_graph(2048, avg_degree=8, seed=1, native=False)
+    res = find_minimal_coloring(
+        BucketedELLEngine(g), g.max_degree + 1, validate=make_validator(g)
+    )
+    assert res.minimal_colors is not None
+    assert validate_coloring(g.indptr, g.indices, res.colors).valid
+
+
+def test_bucketed_adaptive_plane_cap():
+    # complete graph K40 needs 40 colors; a 32-color plane cap must
+    # transparently double instead of stalling or failing
+    v = 40
+    edges = np.array([[i, j] for i in range(v) for j in range(i + 1, v)])
+    from dgc_tpu.models.arrays import GraphArrays
+
+    g = GraphArrays.from_edge_list(v, edges)
+    eng = BucketedELLEngine(g, max_colors_hint=32)
+    assert eng.num_planes == 1
+    res = eng.attempt(g.max_degree + 1)
+    assert res.status == AttemptStatus.SUCCESS
+    assert res.colors_used == 40
+    assert eng.num_planes == 2  # doubled during the retry
+
+
+def test_bucketed_isolated_vertices():
+    from dgc_tpu.models.arrays import GraphArrays
+
+    g = GraphArrays.from_neighbor_lists([[], [2], [1], []])
+    res = BucketedELLEngine(g).attempt(2)
+    assert res.status == AttemptStatus.SUCCESS
+    assert res.colors[0] == 0 and res.colors[3] == 0

@@ -1,0 +1,37 @@
+"""Tracing subsystem tests."""
+
+from dgc_tpu.engine.base import AttemptStatus
+from dgc_tpu.engine.superstep import ELLEngine
+from dgc_tpu.engine.minimal_k import find_minimal_coloring
+from dgc_tpu.utils.tracing import Timer, trace_attempt
+
+
+def test_trace_attempt_matches_fused_kernel(medium_graph):
+    g = medium_graph
+    k0 = g.max_degree + 1
+    eng = ELLEngine(g)
+    trace = trace_attempt(eng, k0)
+    fused = eng.attempt(k0)
+    assert trace.status == AttemptStatus.SUCCESS == fused.status
+    # host-stepped and fused loops run the identical superstep function
+    assert len(trace.active_per_step) == fused.supersteps
+    # active counts are monotone non-increasing after the first round
+    a = trace.active_per_step
+    assert all(x >= y for x, y in zip(a[1:], a[2:]))
+    assert a[-1] == 0
+
+
+def test_trace_attempt_failure(medium_graph):
+    g = medium_graph
+    res = find_minimal_coloring(ELLEngine(g), g.max_degree + 1)
+    trace = trace_attempt(ELLEngine(g), res.minimal_colors - 1)
+    assert trace.status == AttemptStatus.FAILURE
+
+
+def test_timer_sections():
+    t = Timer()
+    with t.section("a"):
+        pass
+    with t.section("a"):
+        pass
+    assert "a" in t.totals and t.totals["a"] >= 0
