@@ -15,10 +15,15 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 
 import numpy as np
+
+# persistent XLA compilation cache: repeat bench runs skip the recompile
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+    os.path.dirname(os.path.abspath(__file__)), ".jax_cache"))
 
 TARGET_SECONDS = 5.0  # BASELINE.json: "<5 s for 1M vertices, avg-degree 16"
 
@@ -28,7 +33,10 @@ def main() -> int:
     p.add_argument("--nodes", type=int, default=1_000_000)
     p.add_argument("--avg-degree", type=float, default=16.0)
     p.add_argument("--max-degree", type=int, default=None)
-    p.add_argument("--backend", choices=["ell", "ell-bucketed", "sharded"], default="ell-bucketed")
+    p.add_argument("--backend", choices=["ell", "ell-bucketed", "ell-compact", "sharded"],
+                   default="ell-compact")
+    p.add_argument("--gen", choices=["fast", "rmat"], default="fast",
+                   help="graph family: uniform random or power-law RMAT")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--include-compile", action="store_true")
     args = p.parse_args()
@@ -36,7 +44,7 @@ def main() -> int:
     import jax
 
     from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
-    from dgc_tpu.models.generators import generate_random_graph_fast
+    from dgc_tpu.models.generators import generate_random_graph_fast, generate_rmat_graph
     from dgc_tpu.ops.validate import validate_coloring
 
     dev = jax.devices()[0]
@@ -44,10 +52,16 @@ def main() -> int:
           file=sys.stderr)
 
     t0 = time.perf_counter()
-    arrays = generate_random_graph_fast(
-        args.nodes, avg_degree=args.avg_degree, seed=args.seed,
-        max_degree=args.max_degree,
-    )
+    if args.gen == "rmat":
+        arrays = generate_rmat_graph(
+            args.nodes, avg_degree=args.avg_degree, seed=args.seed,
+            max_degree=args.max_degree,
+        )
+    else:
+        arrays = generate_random_graph_fast(
+            args.nodes, avg_degree=args.avg_degree, seed=args.seed,
+            max_degree=args.max_degree,
+        )
     t_gen = time.perf_counter() - t0
     print(f"# graph: V={arrays.num_vertices} E2={arrays.num_directed_edges} "
           f"maxdeg={arrays.max_degree} gen={t_gen:.2f}s", file=sys.stderr)
@@ -61,6 +75,10 @@ def main() -> int:
             from dgc_tpu.engine.bucketed import BucketedELLEngine
 
             return BucketedELLEngine(arrays)
+        if args.backend == "ell-compact":
+            from dgc_tpu.engine.compact import CompactFrontierEngine
+
+            return CompactFrontierEngine(arrays)
         from dgc_tpu.engine.superstep import ELLEngine
 
         return ELLEngine(arrays)
@@ -84,7 +102,8 @@ def main() -> int:
           f"({arrays.num_vertices / elapsed:,.0f} vertices/s)", file=sys.stderr)
 
     print(json.dumps({
-        "metric": f"wall_clock_minimal_k_sweep_{args.nodes}v_avgdeg{args.avg_degree:g}_{args.backend}",
+        "metric": f"wall_clock_minimal_k_sweep_{args.nodes}v_avgdeg{args.avg_degree:g}"
+                  f"{'_rmat' if args.gen == 'rmat' else ''}_{args.backend}",
         "value": round(elapsed, 4),
         "unit": "s",
         "vs_baseline": round(TARGET_SECONDS / elapsed, 2),

@@ -38,7 +38,7 @@ def build_parser() -> argparse.ArgumentParser:
     # new flags
     p.add_argument(
         "--backend",
-        choices=["ell", "ell-bucketed", "dense", "sharded", "reference-sim", "oracle", "spark"],
+        choices=["ell", "ell-bucketed", "ell-compact", "dense", "sharded", "reference-sim", "oracle", "spark"],
         default="ell",
         help="coloring engine (default: ell — single-device jit'd ELL kernel)",
     )
@@ -75,6 +75,9 @@ def make_engine(args, graph: Graph):
     if args.backend == "ell-bucketed":
         from dgc_tpu.engine.bucketed import BucketedELLEngine
         return BucketedELLEngine(arrays)
+    if args.backend == "ell-compact":
+        from dgc_tpu.engine.compact import CompactFrontierEngine
+        return CompactFrontierEngine(arrays)
     if args.backend == "dense":
         from dgc_tpu.engine.dense_engine import DenseEngine
         return DenseEngine(arrays)

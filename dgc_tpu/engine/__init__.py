@@ -7,6 +7,8 @@
   contract the TPU engines are tested against.
 - ``superstep``: single-device jit'd ELL engine (``lax.while_loop``).
 - ``dense_engine``: dense-adjacency MXU engine for small V.
+- ``bucketed``: degree-bucketed gather-volume-optimized engine.
+- ``compact``: bucketed dense phase + frontier-compacted tail (flagship).
 - ``sharded``: ``shard_map`` multi-device engine.
 - ``minimal_k``: the driver-side outer loop shared by all engines
   (reference ``coloring.py:215-235``).

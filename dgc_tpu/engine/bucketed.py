@@ -14,10 +14,25 @@ Relabeling changes the id tie-break in the (degree desc, id asc) priority,
 so colorings differ per-vertex from the unbucketed engine — color-count
 parity stays within the ±1 contract (BASELINE.md). Results are mapped back
 to original ids on the host.
+
+Two TPU-informed layout/schedule choices (measured in PERF.md):
+
+- **Combined tables**: the loop-invariant priority bit ("does neighbor slot
+  j beat vertex i?") is packed into bit 30 of the neighbor-id table —
+  ``entry = nbr | beats << 30`` — so engines that row-gather frontier rows
+  (``engine.compact``) move one table, not two; TPU row gathers are
+  row-rate-limited (~6M rows/s), so halving the row count halves the cost.
+- **Round-1 specialization**: in the first superstep every vertex's
+  forbidden set is empty, so its outcome is known without any gather —
+  isolated vertices confirm color 0 (reference ``changeColorFirstIteration``,
+  ``coloring.py:12-17``) and everything else speculatively takes color 0
+  (optimized-engine eager semantics, ``coloring_optimized.py:159-160``).
+  The initial state *is* that outcome; the loop starts at superstep 2.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from functools import partial
 
 import jax
@@ -27,12 +42,15 @@ import numpy as np
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
 from dgc_tpu.models.arrays import GraphArrays, csr_to_ell
 from dgc_tpu.ops.bitmask import num_planes_for
-from dgc_tpu.ops.speculative import speculative_update
+from dgc_tpu.ops.speculative import beats_rule, speculative_update
 
 _RUNNING = AttemptStatus.RUNNING
 _SUCCESS = AttemptStatus.SUCCESS
 _FAILURE = AttemptStatus.FAILURE
 _STALLED = AttemptStatus.STALLED
+
+BEATS_BIT = 30
+_NBR_MASK = (1 << BEATS_BIT) - 1
 
 
 def _bucket_widths(max_degree: int, min_width: int = 8) -> list[int]:
@@ -45,19 +63,118 @@ def _bucket_widths(max_degree: int, min_width: int = 8) -> list[int]:
     return widths
 
 
-@partial(jax.jit, static_argnames=("num_planes", "max_steps", "stall_window"))
-def _attempt_kernel_bucketed(nbrs_buckets, degrees, carry_in, k,
-                             num_planes: int, max_steps: int,
-                             stall_window: int = 64):
-    """Run up to ``max_steps`` supersteps from ``carry_in`` and return the
-    carry — the host chains calls until the status leaves RUNNING, keeping
-    any single device call bounded (a 4M-vertex power-law attempt can need
-    hundreds of supersteps; one unbounded while_loop call trips runtime
-    watchdogs). ``carry_in`` is (packed, step, status, prev_active,
-    stall_rounds); pass ``initial_carry_bucketed`` to start.
+def decode_combined(combined):
+    """Split a combined table entry into (neighbor id, beats flag)."""
+    return combined & _NBR_MASK, (combined >> BEATS_BIT) == 1
 
-    nbrs_buckets: tuple of int32[Vb, Wb] (relabeled ids, sentinel = V),
-    concatenated along the vertex axis in relabeled order.
+
+def encode_combined(nbrs: np.ndarray, beats: np.ndarray) -> np.ndarray:
+    """Pack neighbor ids and beats flags into one int32 table (host-side)."""
+    return nbrs | (beats.astype(np.int32) << BEATS_BIT)
+
+
+@dataclass
+class DegreeBuckets:
+    """Degree-descending relabeled graph split into width buckets.
+
+    ``perm[new_id] = old_id``; bucket b owns relabeled rows
+    ``[row0[b], row0[b] + combined[b].shape[0])``. ``combined[b]`` packs the
+    global (relabeled) neighbor id (sentinel = V) with the precomputed
+    (degree desc, id asc) priority bit at ``BEATS_BIT``.
+    """
+
+    perm: np.ndarray                 # int64[V]: new → old
+    degrees: np.ndarray              # int32[V] (relabeled, non-increasing)
+    indptr: np.ndarray               # int64[V+1] relabeled CSR
+    indices: np.ndarray              # int32[E2] relabeled CSR
+    row0: list[int]                  # bucket start rows
+    combined: list[np.ndarray]       # int32[Vb, Wb]
+
+
+def build_degree_buckets(arrays: GraphArrays, min_width: int = 8) -> DegreeBuckets:
+    v = arrays.num_vertices
+    if v >= 1 << BEATS_BIT:
+        raise ValueError(f"V={v} exceeds combined-table id capacity 2^{BEATS_BIT}")
+    degrees_old = arrays.degrees
+    widths = _bucket_widths(arrays.max_degree, min_width=min_width)
+    # stable degree-descending order → big-width buckets first
+    perm = np.lexsort((np.arange(v), -degrees_old)).astype(np.int64)
+    inv = np.empty(v, dtype=np.int32)
+    inv[perm] = np.arange(v, dtype=np.int32)
+
+    # relabeled CSR, fully vectorized: entries keyed by (new_row, new_col)
+    rows_old = np.repeat(np.arange(v, dtype=np.int64), degrees_old)
+    new_row = inv[rows_old].astype(np.int64)
+    new_col = inv[arrays.indices].astype(np.int64)
+    order = np.argsort(new_row * v + new_col, kind="stable")
+    new_indices = new_col[order].astype(np.int32)
+    deg_new = degrees_old[perm].astype(np.int32)
+    new_indptr = np.zeros(v + 1, dtype=np.int64)
+    np.cumsum(deg_new, out=new_indptr[1:])
+
+    deg_pad = np.concatenate([deg_new, np.array([-1], np.int32)])
+
+    # split rows into buckets by width (descending degrees → contiguous)
+    widths_desc = sorted(widths, reverse=True)
+    row0s, combined_list = [], []
+    row = 0
+    for wi, width in enumerate(widths_desc):
+        lo = 0 if wi + 1 >= len(widths_desc) else widths_desc[wi + 1]
+        # deg_new is non-increasing: rows with degree > lo come first
+        end = int(np.searchsorted(-deg_new, -lo, side="left"))
+        if wi + 1 >= len(widths_desc):
+            end = v  # last bucket takes the rest (incl. isolated)
+        if end > row:
+            sub_indptr = new_indptr[row: end + 1] - new_indptr[row]
+            sub_indices = new_indices[new_indptr[row]: new_indptr[end]]
+            nb, _ = csr_to_ell(sub_indptr, sub_indices, width=width, sentinel=v)
+            n_deg = deg_pad[nb]
+            my_deg = deg_new[row:end, None]
+            my_ids = np.arange(row, end, dtype=np.int32)[:, None]
+            beats = beats_rule(n_deg, nb, my_deg, my_ids)
+            row0s.append(row)
+            combined_list.append(encode_combined(nb, beats))
+        row = end
+    assert row == v, (row, v)
+    return DegreeBuckets(
+        perm=perm, degrees=deg_new, indptr=new_indptr, indices=new_indices,
+        row0=row0s, combined=combined_list,
+    )
+
+
+def initial_packed(degrees):
+    """Post-round-1 state: isolated → confirmed 0, else speculative 0."""
+    return jnp.where(degrees == 0, 0, 1).astype(jnp.int32)
+
+
+def bucketed_superstep(packed, combined_buckets, k, num_planes: int):
+    """One full-table superstep over all buckets. Returns
+    (new_packed, fail_count, active_count)."""
+    packed_pad = jnp.concatenate([packed, jnp.array([-1], jnp.int32)])
+    new_parts, fail_parts, active_parts = [], [], []
+    row0 = 0
+    for cb in combined_buckets:
+        vb = cb.shape[0]
+        nb, beats = decode_combined(cb)
+        packed_b = jax.lax.dynamic_slice_in_dim(packed, row0, vb)
+        np_ = packed_pad[nb]                      # the bucket's gather
+        new_b, fail_mask, active_mask = speculative_update(
+            packed_b, np_, beats, k, num_planes
+        )
+        new_parts.append(new_b)
+        fail_parts.append(jnp.sum(fail_mask.astype(jnp.int32)))
+        active_parts.append(jnp.sum(active_mask.astype(jnp.int32)))
+        row0 += vb
+    return jnp.concatenate(new_parts), sum(fail_parts), sum(active_parts)
+
+
+@partial(jax.jit, static_argnames=("num_planes", "stall_window"))
+def _attempt_kernel_bucketed(combined_buckets, degrees, carry_in, k,
+                             nsteps, num_planes: int, stall_window: int = 64):
+    """Run up to ``nsteps`` (dynamic) supersteps from ``carry_in`` and return
+    the carry — the host chains calls until the status leaves RUNNING, keeping
+    any single device call bounded. ``carry_in`` is (packed, step, status,
+    prev_active, stall_rounds); pass ``initial_carry_bucketed`` to start.
 
     The plane budget may be smaller than k (power-law graphs where
     k0 = Δ+1 is huge, SURVEY.md §7.3): candidates are then restricted to
@@ -66,22 +183,9 @@ def _attempt_kernel_bucketed(nbrs_buckets, degrees, carry_in, k,
     forbidden set doesn't prove k colors are exhausted otherwise). A run
     that makes no progress for ``stall_window`` consecutive supersteps exits
     STALLED so the caller can retry with a bigger plane budget."""
-    v = degrees.shape[0]
     k = jnp.asarray(k, jnp.int32)
     fail_assertable = k <= 32 * num_planes
-    chunk_end = carry_in[1] + max_steps
-
-    deg_pad = jnp.concatenate([degrees, jnp.array([-1], jnp.int32)])
-    # per-bucket loop-invariant priority masks
-    pre_beats = []
-    row0 = 0
-    for nb in nbrs_buckets:
-        vb = nb.shape[0]
-        my_deg = jax.lax.dynamic_slice_in_dim(degrees, row0, vb)[:, None]
-        my_ids = (row0 + jnp.arange(vb, dtype=jnp.int32))[:, None]
-        n_deg = deg_pad[nb]
-        pre_beats.append((n_deg > my_deg) | ((n_deg == my_deg) & (nb < my_ids)))
-        row0 += vb
+    chunk_end = carry_in[1] + jnp.asarray(nsteps, jnp.int32)
 
     def cond(carry):
         _, step, status, _, _ = carry
@@ -89,25 +193,10 @@ def _attempt_kernel_bucketed(nbrs_buckets, degrees, carry_in, k,
 
     def body(carry):
         packed, step, status, prev_active, stall_rounds = carry
-        packed_pad = jnp.concatenate([packed, jnp.array([-1], jnp.int32)])
-
-        new_parts, fail_parts, active_parts = [], [], []
-        row0 = 0
-        for nb, beats in zip(nbrs_buckets, pre_beats):
-            vb = nb.shape[0]
-            packed_b = jax.lax.dynamic_slice_in_dim(packed, row0, vb)
-            np_ = packed_pad[nb]                      # the bucket's gather
-            new_b, fail_mask, active_mask = speculative_update(
-                packed_b, np_, beats, k, num_planes
-            )
-            new_parts.append(new_b)
-            fail_parts.append(jnp.sum(fail_mask.astype(jnp.int32)))
-            active_parts.append(jnp.sum(active_mask.astype(jnp.int32)))
-            row0 += vb
-
-        new_packed = jnp.concatenate(new_parts)
-        any_fail = (sum(fail_parts) > 0) & fail_assertable
-        active = sum(active_parts)
+        new_packed, fail_count, active = bucketed_superstep(
+            packed, combined_buckets, k, num_planes
+        )
+        any_fail = (fail_count > 0) & fail_assertable
         stall_rounds = jnp.where(active < prev_active, 0, stall_rounds + 1)
         status = jnp.where(
             any_fail,
@@ -126,8 +215,9 @@ def _attempt_kernel_bucketed(nbrs_buckets, degrees, carry_in, k,
 
 def initial_carry_bucketed(degrees):
     v = degrees.shape[0]
-    packed0 = jnp.where(degrees == 0, 0, -1).astype(jnp.int32)
-    return (packed0, jnp.int32(0), jnp.int32(_RUNNING), jnp.int32(v + 1), jnp.int32(0))
+    # round-1 specialization: start from the known post-round-1 state
+    return (initial_packed(degrees), jnp.int32(1), jnp.int32(_RUNNING),
+            jnp.int32(v + 1), jnp.int32(0))
 
 
 class BucketedELLEngine:
@@ -145,55 +235,36 @@ class BucketedELLEngine:
                  chunk_steps: int = 64):
         self.arrays = arrays
         v = arrays.num_vertices
-        degrees_old = arrays.degrees
-        widths = _bucket_widths(arrays.max_degree, min_width=min_width)
-        # stable degree-descending order → big-width buckets first
-        self.perm = np.lexsort((np.arange(v), -degrees_old)).astype(np.int64)
-        inv = np.empty(v, dtype=np.int32)
-        inv[self.perm] = np.arange(v, dtype=np.int32)
-
-        # relabeled CSR, fully vectorized: entries keyed by (new_row, new_col)
-        rows_old = np.repeat(np.arange(v, dtype=np.int64), degrees_old)
-        new_row = inv[rows_old].astype(np.int64)
-        new_col = inv[arrays.indices].astype(np.int64)
-        order = np.argsort(new_row * v + new_col, kind="stable")
-        new_indices = new_col[order].astype(np.int32)
-        deg_new = degrees_old[self.perm].astype(np.int32)
-        new_indptr = np.zeros(v + 1, dtype=np.int64)
-        np.cumsum(deg_new, out=new_indptr[1:])
-
-        # split rows into buckets by width (descending degrees → contiguous)
-        widths_desc = sorted(widths, reverse=True)
-        buckets = []
-        row = 0
-        for wi, width in enumerate(widths_desc):
-            lo = 0 if wi + 1 >= len(widths_desc) else widths_desc[wi + 1]
-            # deg_new is non-increasing: rows with degree > lo come first
-            end = int(np.searchsorted(-deg_new, -lo, side="left"))
-            if wi + 1 >= len(widths_desc):
-                end = v  # last bucket takes the rest (incl. isolated)
-            if end > row:
-                sub_indptr = new_indptr[row: end + 1] - new_indptr[row]
-                sub_indices = new_indices[new_indptr[row]: new_indptr[end]]
-                nb, _ = csr_to_ell(sub_indptr, sub_indices, width=width, sentinel=v)
-                buckets.append(jnp.asarray(nb))
-            row = end
-        assert row == v, (row, v)
-
-        self.nbrs_buckets = tuple(buckets)
-        self.degrees = jnp.asarray(deg_new)
+        b = build_degree_buckets(arrays, min_width=min_width)
+        self.perm = b.perm
+        self.rel_indptr = b.indptr    # relabeled CSR kept host-side for
+        self.rel_indices = b.indices  # subclasses (compacted-phase tables)
+        self.combined_buckets = tuple(jnp.asarray(cb) for cb in b.combined)
+        self.degrees = jnp.asarray(b.degrees)
         self.k_full = arrays.max_degree + 1
         self.num_planes = num_planes_for(min(self.k_full, max_colors_hint))
         self.max_steps = max_steps if max_steps is not None else 2 * v + 4
         self.chunk_steps = chunk_steps
 
+    def _finish(self, packed: np.ndarray, status, steps: int, k: int) -> AttemptResult:
+        colors_new = np.where(packed >= 0, packed >> 1, -1).astype(np.int32)
+        colors = np.empty_like(colors_new)
+        colors[self.perm] = colors_new  # back to original ids
+        return AttemptResult(status, colors, steps, int(k))
+
     def attempt(self, k: int) -> AttemptResult:
+        if k < 1:
+            # round-1 specialization presumes color 0 is in budget; an empty
+            # budget fails outright (reference sentinel −3 on every vertex)
+            return self._finish(
+                np.full(self.arrays.num_vertices, -1, np.int32),
+                AttemptStatus.FAILURE, 0, k)
         while True:  # plane-budget retry loop
             carry = initial_carry_bucketed(self.degrees)
             while True:  # chunked superstep loop (bounded device calls)
                 carry = _attempt_kernel_bucketed(
-                    self.nbrs_buckets, self.degrees, carry, k,
-                    num_planes=self.num_planes, max_steps=self.chunk_steps,
+                    self.combined_buckets, self.degrees,
+                    carry, k, self.chunk_steps, num_planes=self.num_planes,
                 )
                 status = AttemptStatus(int(carry[2]))
                 steps = int(carry[1])
@@ -208,9 +279,4 @@ class BucketedELLEngine:
                 )
                 continue
             break
-        colors_new = np.asarray(
-            jnp.where(carry[0] >= 0, carry[0] >> 1, -1).astype(jnp.int32)
-        )
-        colors = np.empty_like(colors_new)
-        colors[self.perm] = colors_new  # back to original ids
-        return AttemptResult(status, colors, steps, int(k))
+        return self._finish(np.asarray(carry[0]), status, steps, int(k))

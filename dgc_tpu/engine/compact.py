@@ -1,0 +1,248 @@
+"""Frontier-compacted engine — the flagship single-device path.
+
+The speculative superstep converges geometrically, but the bucketed kernel
+still gathers every row's neighbor state each superstep even when most
+vertices are inert (confirmed with confirmed neighborhoods). Since the
+superstep is gather-bound, the target invariant is: **per-superstep gather
+volume ∝ frontier size**, not V.
+
+Measured TPU rates (PERF.md) shape the design:
+
+- element gather ~100-140M lookups/s — the superstep cost;
+- row gather ~6M *rows*/s — compaction cost; hence the combined nbr+beats
+  table (one row move, ``engine.bucketed.BEATS_BIT``);
+- 1-D scatter ≥100M/s — writing compacted results back is cheap;
+- **dispatch ~65 ms per device call** — so the whole k-attempt runs as ONE
+  jit call: a full-table phase followed by static compaction stages, with
+  no host round-trips in between.
+
+The attempt kernel executes, inside one ``jax.jit``:
+
+1. **Full-table phase** — degree-bucketed supersteps (shared
+   ``bucketed_superstep``) while the frontier (uncolored ∪ fresh) exceeds
+   ``V/4``. Round 1 never runs at all: its outcome is known statically
+   (``engine.bucketed.initial_packed``).
+2. **Compaction stages** at static thresholds (V/4, V/64): the frontier is
+   compacted on-device into a padded index list (pad = threshold rounded to
+   a power of two — static shapes, one compile ever), its rows of the flat
+   combined table are row-gathered once, and supersteps gather only
+   ``A_pad × W`` neighbor states, scattering results back into the full
+   state vector.
+
+Compaction is *exact*: a confirmed vertex can never become active again
+(demotion only applies to fresh vertices, and confirm/demote both read the
+same per-superstep snapshot), so the frontier is monotone non-increasing
+and every vertex that could change state is in the compacted set. Colors
+are bit-identical to ``BucketedELLEngine`` — stages change the schedule of
+*computation*, not the update rule (``ops.speculative``) or its inputs.
+
+State layout: ``packed_ext = int32[V+2]`` where slot ``V`` is the ELL
+neighbor-pad sentinel (always −1 = "no neighbor", so padding never forbids
+a color — invariant: never written) and slot ``V+1`` is the dummy-row
+target for unused compaction slots (confirmed color 0, degree 0 — a no-op
+row that absorbs duplicate scatter writes).
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.engine.bucketed import (
+    BucketedELLEngine,
+    bucketed_superstep,
+    decode_combined,
+    encode_combined,
+    initial_packed,
+)
+from dgc_tpu.models.arrays import GraphArrays, csr_to_ell
+from dgc_tpu.ops.bitmask import num_planes_for
+from dgc_tpu.ops.speculative import beats_rule, speculative_update
+
+_RUNNING = AttemptStatus.RUNNING
+_SUCCESS = AttemptStatus.SUCCESS
+_FAILURE = AttemptStatus.FAILURE
+_STALLED = AttemptStatus.STALLED
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+def default_stages(v: int) -> tuple:
+    """((a_pad, run_down_to_threshold), ...); a_pad None = full-table phase."""
+    if v <= 1 << 14:
+        return ((None, 0),)
+    return (
+        (None, v // 4),
+        (_pow2_ceil(v // 4), v // 64),
+        (_pow2_ceil(v // 64), 0),
+    )
+
+
+def _status_step(any_fail, active, stall_rounds, stall_window):
+    return jnp.where(
+        any_fail,
+        _FAILURE,
+        jnp.where(
+            active == 0,
+            _SUCCESS,
+            jnp.where(stall_rounds >= stall_window, _STALLED, _RUNNING),
+        ),
+    ).astype(jnp.int32)
+
+
+@partial(jax.jit, static_argnames=("num_planes", "stages", "max_steps", "stall_window"))
+def _attempt_kernel_staged(combined_buckets, combined_flat_ext, degrees, k,
+                           num_planes: int, stages: tuple, max_steps: int,
+                           stall_window: int = 64):
+    """One whole k-attempt in a single device call: full-table phase +
+    static compaction stages. Returns (packed_ext, steps, status).
+
+    combined_flat_ext: int32[V+1, W] flat relabeled combined table with a
+    trailing dummy row (all sentinel). ``stages``/``max_steps`` static.
+    """
+    v = degrees.shape[0]
+    k = jnp.asarray(k, jnp.int32)
+    fail_assertable = k <= 32 * num_planes
+
+    packed_ext = jnp.concatenate(
+        [initial_packed(degrees), jnp.array([-1, 0], jnp.int32)]
+    )
+    carry = (packed_ext, jnp.int32(1), jnp.int32(_RUNNING),
+             jnp.int32(v + 1), jnp.int32(0))
+
+    for a_pad, thresh in stages:
+        if a_pad is None:
+            # --- full-table phase (degree-bucketed supersteps) ---
+            def cond(c, thresh=thresh):
+                _, step, status, active, _ = c
+                return (status == _RUNNING) & (active > thresh) & (step < max_steps)
+
+            def body(c):
+                pe, step, status, prev_active, stall = c
+                new_p, fail_count, active = bucketed_superstep(
+                    pe[:v], combined_buckets, k, num_planes
+                )
+                any_fail = (fail_count > 0) & fail_assertable
+                stall = jnp.where(active < prev_active, 0, stall + 1)
+                status = _status_step(any_fail, active, stall, stall_window)
+                new_pe = jnp.concatenate([new_p, jnp.array([-1, 0], jnp.int32)])
+                new_pe = jnp.where(any_fail, pe, new_pe)
+                return (new_pe, step + 1, status, active, stall)
+
+            carry = jax.lax.while_loop(cond, body, carry)
+            continue
+
+        # --- compaction stage: frontier ≤ previous threshold ≤ a_pad ---
+        def run_stage(c, a_pad=a_pad, thresh=thresh):
+            pe0, step0, status0, active0, stall0 = c
+            pk = pe0[:v]
+            act = (pk < 0) | ((pk & 1) == 1)
+            pos = jnp.cumsum(act.astype(jnp.int32)) - 1
+            idx = jnp.full((a_pad,), v, jnp.int32)       # unused slots → dummy row
+            scatter_pos = jnp.where(act & (pos < a_pad), pos, a_pad)
+            idx = idx.at[scatter_pos].set(jnp.arange(v, dtype=jnp.int32), mode="drop")
+            gidx = jnp.where(idx == v, v + 1, idx)       # dummy slots → state slot V+1
+            comb_a = jnp.take(combined_flat_ext, idx, axis=0)  # ONE row gather
+            nbrs_a, beats_a = decode_combined(comb_a)
+
+            def cond(c2):
+                _, step, status, active, _ = c2
+                return (status == _RUNNING) & (active > thresh) & (step < max_steps)
+
+            def body(c2):
+                pe, step, status, prev_active, stall = c2
+                pk_a = pe[gidx]
+                np_ = pe[nbrs_a]                         # element gather [A, W]
+                new_a, fail_mask, active_mask = speculative_update(
+                    pk_a, np_, beats_a, k, num_planes
+                )
+                new_pe = pe.at[gidx].set(new_a)          # dup writes only at V+1, same value
+                any_fail = (jnp.sum(fail_mask.astype(jnp.int32)) > 0) & fail_assertable
+                active = jnp.sum(active_mask.astype(jnp.int32))
+                stall = jnp.where(active < prev_active, 0, stall + 1)
+                status = _status_step(any_fail, active, stall, stall_window)
+                new_pe = jnp.where(any_fail, pe, new_pe)
+                return (new_pe, step + 1, status, active, stall)
+
+            return jax.lax.while_loop(cond, body, c)
+
+        carry = jax.lax.cond(carry[2] == _RUNNING, run_stage, lambda c: c, carry)
+
+    pe, steps, status, active, _ = carry
+    # fixups: nothing-to-do graphs (status never set) and step-budget exhaustion
+    status = jnp.where(
+        (status == _RUNNING) & (active == 0), _SUCCESS,
+        jnp.where(status == _RUNNING, _STALLED, status),
+    ).astype(jnp.int32)
+    return pe, steps, status
+
+
+class CompactFrontierEngine(BucketedELLEngine):
+    """Single-call staged frontier-compacted engine (single device).
+
+    Inherits the bucketed relabeling/structures and plane-budget logic.
+    Colors are bit-identical to ``BucketedELLEngine``.
+    """
+
+    # heavy-tailed guard: the flat compacted-phase table is [V+1, Δ]; past
+    # this width the O(V·Δ) blowup bucketing exists to avoid comes back
+    # (power-law/RMAT graphs), so fall back to the pure bucketed schedule
+    FLAT_WIDTH_CAP = 256
+
+    def __init__(self, arrays: GraphArrays, max_steps: int | None = None,
+                 min_width: int = 8, max_colors_hint: int = 256,
+                 stages: tuple | None = None):
+        super().__init__(arrays, max_steps=max_steps, min_width=min_width,
+                         max_colors_hint=max_colors_hint)
+        v = arrays.num_vertices
+        w = max(arrays.max_degree, 1)
+        if stages is None:
+            stages = default_stages(v) if w <= self.FLAT_WIDTH_CAP else ((None, 0),)
+        # a compaction stage must be able to hold the whole frontier at entry
+        # (bounded by the previous stage's exit threshold, or V at the start) —
+        # a smaller pad would silently drop active vertices
+        bound = v
+        for a_pad, thresh in stages:
+            if a_pad is not None and a_pad < min(bound, v):
+                raise ValueError(
+                    f"stage pad {a_pad} < possible frontier {min(bound, v)}; "
+                    f"stages={stages}")
+            bound = thresh
+        self.stages = stages
+        if all(a_pad is None for a_pad, _ in self.stages):
+            self.combined_flat_ext = None  # no compaction stage needs it
+            return
+        nbrs, _ = csr_to_ell(self.rel_indptr, self.rel_indices, width=w, sentinel=v)
+        deg_new = np.asarray(self.degrees)
+        deg_pad = np.concatenate([deg_new, np.array([-1], np.int32)])
+        n_deg = deg_pad[nbrs]
+        beats = beats_rule(n_deg, nbrs, deg_new[:, None],
+                           np.arange(v, dtype=np.int32)[:, None])
+        combined = encode_combined(nbrs, beats)
+        # trailing dummy row: all sentinel, never beats
+        self.combined_flat_ext = jnp.asarray(
+            np.concatenate([combined, np.full((1, w), v, np.int32)])
+        )
+
+    def attempt(self, k: int) -> AttemptResult:
+        v = self.arrays.num_vertices
+        if k < 1:
+            return self._finish(np.full(v, -1, np.int32), AttemptStatus.FAILURE, 0, k)
+        while True:  # plane-budget retry loop
+            pe, steps, status = _attempt_kernel_staged(
+                self.combined_buckets, self.combined_flat_ext, self.degrees, k,
+                num_planes=self.num_planes, stages=self.stages,
+                max_steps=self.max_steps,
+            )
+            status = AttemptStatus(int(status))
+            if status == AttemptStatus.STALLED and 32 * self.num_planes < k:
+                self.num_planes = min(2 * self.num_planes, num_planes_for(self.k_full))
+                continue
+            break
+        return self._finish(np.asarray(pe)[:v], status, int(steps), int(k))

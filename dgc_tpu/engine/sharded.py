@@ -39,7 +39,7 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import num_planes_for
-from dgc_tpu.ops.speculative import speculative_update
+from dgc_tpu.ops.speculative import beats_rule, speculative_update
 from dgc_tpu.parallel.mesh import VERTEX_AXIS, make_mesh, pad_to_multiple
 
 
@@ -76,7 +76,7 @@ def _shard_body(nbrs_l, deg_l, deg_g, k, num_planes: int, max_steps: int):
     deg_g_pad = jnp.concatenate([deg_g, jnp.array([-1], jnp.int32)])
     n_deg = deg_g_pad[nbrs_l]
     my_deg = deg_l[:, None]
-    pre_beats = (n_deg > my_deg) | ((n_deg == my_deg) & (nbrs_l < my_ids[:, None]))
+    pre_beats = beats_rule(n_deg, nbrs_l, my_deg, my_ids[:, None])
 
     def cond(carry):
         _, _, status = carry

@@ -47,7 +47,7 @@ import numpy as np
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import num_planes_for
-from dgc_tpu.ops.speculative import speculative_update
+from dgc_tpu.ops.speculative import beats_rule, speculative_update
 
 _RUNNING = AttemptStatus.RUNNING
 _SUCCESS = AttemptStatus.SUCCESS
@@ -83,7 +83,7 @@ def _attempt_kernel(nbrs, degrees, k, num_planes: int, max_steps: int):
     deg_pad = jnp.concatenate([degrees, jnp.array([-1], jnp.int32)])
     n_deg = deg_pad[nbrs]                         # sentinel → −1, never beats
     my_deg = degrees[:, None]
-    pre_beats = (n_deg > my_deg) | ((n_deg == my_deg) & (nbrs < ids[:, None]))
+    pre_beats = beats_rule(n_deg, nbrs, my_deg, ids[:, None])
 
     def cond(carry):
         _, _, status = carry

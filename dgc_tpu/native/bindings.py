@@ -28,13 +28,19 @@ _load_failed = False
 def _build() -> bool:
     # -O3 without -march=native: the .so is machine-local (gitignored), but a
     # copied tree must never SIGILL on an older CPU — portable codegen only.
-    tmp = str(_LIB) + ".tmp"
+    # pid-unique tmp: concurrent processes may build simultaneously; each
+    # os.replace then installs a complete library, never a half-written one
+    tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
         os.replace(tmp, _LIB)
         return True
     except Exception:
+        try:
+            os.unlink(tmp)
+        except OSError:
+            pass
         return False
 
 

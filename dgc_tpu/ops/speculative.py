@@ -3,10 +3,19 @@
 One function owns the conflict-rule semantics (demote → first-fit →
 assign/confirm, reference citations in ``engine.superstep``); the engines
 differ only in how they gather neighbor state (plain ELL gather, per-bucket
-gathers, all-gather + gather on a shard) and how they reduce the returned
-masks (``jnp.sum``/``any`` vs ``lax.psum``). Keeping the core in one place
-is what makes the "same rule, bit-identical results" contract between the
-ELL and sharded engines a fact rather than a hope.
+gathers, all-gather + gather on a shard, ring-halo rotations) and how they
+reduce the returned masks (``jnp.sum``/``any`` vs ``lax.psum``). Keeping the
+core in one place is what makes the "same rule, bit-identical results"
+contract between the ELL and sharded engines a fact rather than a hope.
+
+The core is split in two so ring-halo engines can stream neighbor state:
+
+- ``neighbor_stats``: per-gather reduction to (forbidden planes, confirmed
+  forbidden planes, clash mask). Associative across gathers — a ring engine
+  OR-combines the stats from each rotation's partial gather.
+- ``apply_update``: the state transition from the combined stats.
+
+``speculative_update`` composes them for single-gather engines.
 """
 
 from __future__ import annotations
@@ -16,39 +25,56 @@ import jax.numpy as jnp
 from dgc_tpu.ops.bitmask import first_fit, forbidden_planes
 
 
-def speculative_update(packed_local, gathered, pre_beats, k, num_planes: int):
-    """One superstep's elementwise core.
+def beats_rule(n_deg, n_id, my_deg, my_id):
+    """The (degree desc, id asc) priority: does the neighbor beat me?
+
+    Works elementwise on any broadcastable shapes (ELL tables, edge lists) in
+    both NumPy and JAX — every engine must derive its precomputed ``beats``
+    masks through this one function so the tie-break stays a single fact.
+    Replaces the reference's conflict orderings (``coloring_optimized.py:
+    170-172`` high-degree-wins; id tie-break makes it a total order).
+    """
+    return (n_deg > my_deg) | ((n_deg == my_deg) & (n_id < my_id))
+
+
+def neighbor_stats(gathered, pre_beats, mycol, num_planes: int):
+    """Reduce one gathered neighbor block to combinable per-vertex stats.
 
     Args:
-      packed_local: int32[Vl] — this block's packed state
-        (``color·2 + fresh``; −1 = uncolored).
-      gathered: int32[Vl, W] — neighbor packed state (−1 for uncolored
-        neighbors and ELL padding).
+      gathered: int32[Vl, W] — neighbor packed state (``color·2 + fresh``;
+        −1 for uncolored neighbors and ELL padding).
       pre_beats: bool[Vl, W] — loop-invariant (degree desc, id asc) priority:
         does neighbor slot j beat vertex i?
-      k: dynamic int32 color budget.
-      num_planes: static bitmask plane count.
+      mycol: int32[Vl] — this block's current colors (−1 = uncolored).
 
-    Returns ``(new_packed int32[Vl], fail_mask bool[Vl], active_mask
-    bool[Vl])`` — the caller reduces fail/active however its topology needs.
+    Returns ``(forb_all uint32[Vl, P], forb_old uint32[Vl, P], clash
+    bool[Vl])``; combine across gathers with elementwise OR.
     """
     nvalid = gathered >= 0
     ncol = jnp.where(nvalid, gathered >> 1, -1)
     nfresh = nvalid & ((gathered & 1) == 1)
 
-    mycol = packed_local >> 1  # arithmetic shift: −1 stays −1
-    myfresh = (packed_local >= 0) & ((packed_local & 1) == 1)
-    uncol = packed_local < 0
-
-    # fresh-fresh conflict demotion (confirmed colors are conflict-free by
-    # induction, so only fresh-fresh conflicts exist)
-    clash = nfresh & (ncol == mycol[:, None]) & pre_beats
-    demote = myfresh & jnp.any(clash, axis=1)
+    # fresh-fresh conflict (confirmed colors are conflict-free by induction)
+    clash = jnp.any(nfresh & (ncol == mycol[:, None]) & pre_beats, axis=1)
 
     # forbidden sets: all colored neighbors (for candidates) and confirmed
     # ones only (for exact reference failure semantics)
     forb_all = forbidden_planes(ncol, num_planes)
     forb_old = forbidden_planes(jnp.where(nfresh, -1, ncol), num_planes)
+    return forb_all, forb_old, clash
+
+
+def apply_update(packed_local, forb_all, forb_old, clash, k):
+    """State transition from combined neighbor stats.
+
+    Returns ``(new_packed int32[Vl], fail_mask bool[Vl], active_mask
+    bool[Vl])`` — the caller reduces fail/active however its topology needs.
+    """
+    mycol = packed_local >> 1  # arithmetic shift: −1 stays −1
+    myfresh = (packed_local >= 0) & ((packed_local & 1) == 1)
+    uncol = packed_local < 0
+
+    demote = myfresh & clash
     cand, nofree_all = first_fit(forb_all, k)
     _, fail_old = first_fit(forb_old, k)
 
@@ -67,3 +93,22 @@ def speculative_update(packed_local, gathered, pre_beats, k, num_planes: int):
     fail_mask = needs_color & fail_old
     active_mask = (new_packed < 0) | ((new_packed & 1) == 1)
     return new_packed, fail_mask, active_mask
+
+
+def speculative_update(packed_local, gathered, pre_beats, k, num_planes: int):
+    """One superstep's elementwise core (single-gather form).
+
+    Args:
+      packed_local: int32[Vl] — this block's packed state
+        (``color·2 + fresh``; −1 = uncolored).
+      gathered: int32[Vl, W] — neighbor packed state (−1 for uncolored
+        neighbors and ELL padding).
+      pre_beats: bool[Vl, W] — loop-invariant priority mask.
+      k: dynamic int32 color budget.
+      num_planes: static bitmask plane count.
+
+    Returns ``(new_packed, fail_mask, active_mask)``.
+    """
+    mycol = packed_local >> 1
+    forb_all, forb_old, clash = neighbor_stats(gathered, pre_beats, mycol, num_planes)
+    return apply_update(packed_local, forb_all, forb_old, clash, k)

@@ -24,6 +24,8 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
+from dgc_tpu.ops.speculative import beats_rule
+
 
 @dataclass
 class Timer:
@@ -60,7 +62,7 @@ def trace_attempt(engine, k: int, max_steps: int | None = None) -> AttemptTrace:
     ids = jnp.arange(v, dtype=jnp.int32)
     deg_pad = jnp.concatenate([degrees, jnp.array([-1], jnp.int32)])
     n_deg = deg_pad[nbrs]
-    pre_beats = (n_deg > degrees[:, None]) | ((n_deg == degrees[:, None]) & (nbrs < ids[:, None]))
+    pre_beats = beats_rule(n_deg, nbrs, degrees[:, None], ids[:, None])
 
     step_fn = jax.jit(partial(superstep, num_planes=engine.num_planes))
     packed = jnp.where(degrees == 0, 0, -1).astype(jnp.int32)

@@ -1,0 +1,141 @@
+"""Frontier-compacted engine tests.
+
+The compaction contract is exactness: the compacted stages must produce
+bit-identical colors to the bucketed engine (same update rule, same
+relabeling, different computation schedule). Passing a custom ``stages``
+tuple with small thresholds forces both compaction stages even on
+test-size graphs (the default schedule only compacts above 2^14 vertices).
+"""
+
+import numpy as np
+
+from dgc_tpu.engine.base import AttemptStatus
+from dgc_tpu.engine.bucketed import BucketedELLEngine
+from dgc_tpu.engine.compact import CompactFrontierEngine, _pow2_ceil
+from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
+from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.models.generators import generate_random_graph, generate_rmat_graph
+from dgc_tpu.ops.validate import validate_coloring
+
+
+def _forced_compact(g, **kw):
+    # small thresholds force both compaction stages even on test-size graphs
+    v = g.num_vertices
+    t0, t1 = max(v // 2, 1), max(v // 8, 1)
+    stages = ((None, t0), (_pow2_ceil(t0), t1), (_pow2_ceil(t1), 0))
+    return CompactFrontierEngine(g, stages=stages, **kw)
+
+
+def test_pow2_ceil():
+    assert [_pow2_ceil(n) for n in (1, 2, 3, 4, 5, 1000, 1024, 1025)] == \
+        [1, 2, 4, 4, 8, 1024, 1024, 2048]
+
+
+def test_compact_bit_identical_to_bucketed(small_graphs):
+    for g in small_graphs:
+        k0 = g.max_degree + 1
+        rb = BucketedELLEngine(g).attempt(k0)
+        rc = _forced_compact(g).attempt(k0)
+        assert rc.status == rb.status
+        assert np.array_equal(rc.colors, rb.colors)
+
+
+def test_compact_bit_identical_medium(medium_graph):
+    g = medium_graph
+    for k in (g.max_degree + 1, 6):
+        rb = BucketedELLEngine(g).attempt(k)
+        rc = _forced_compact(g).attempt(k)
+        assert rc.status == rb.status
+        if rb.status == AttemptStatus.SUCCESS:
+            assert np.array_equal(rc.colors, rb.colors)
+
+
+def test_compact_minimal_sweep(medium_graph):
+    g = medium_graph
+    res = find_minimal_coloring(
+        _forced_compact(g), g.max_degree + 1, validate=make_validator(g)
+    )
+    ref = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1)
+    assert res.minimal_colors == ref.minimal_colors
+    assert validate_coloring(g.indptr, g.indices, res.colors).valid
+
+
+def test_compact_failure_below_minimal(medium_graph):
+    g = medium_graph
+    res = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1)
+    r = _forced_compact(g).attempt(res.minimal_colors - 1)
+    assert r.status == AttemptStatus.FAILURE
+
+
+def test_compact_heavy_tail():
+    g = generate_rmat_graph(2048, avg_degree=8, seed=1, native=False)
+    res = find_minimal_coloring(
+        _forced_compact(g), g.max_degree + 1, validate=make_validator(g)
+    )
+    assert res.minimal_colors is not None
+    assert validate_coloring(g.indptr, g.indices, res.colors).valid
+
+
+def test_compact_heavy_tail_falls_back_to_bucketed_schedule():
+    # max_degree above FLAT_WIDTH_CAP must not allocate the [V+1, Δ] flat
+    # table (O(V·Δ) blowup on power-law graphs) — pure bucketed schedule
+    g = generate_rmat_graph(1 << 15, avg_degree=4, seed=5, native=False)
+    if g.max_degree <= CompactFrontierEngine.FLAT_WIDTH_CAP:
+        import pytest
+
+        pytest.skip("RMAT draw not heavy-tailed enough to trip the cap")
+    eng = CompactFrontierEngine(g)
+    assert eng.stages == ((None, 0),)
+    assert eng.combined_flat_ext is None
+    res = eng.attempt(min(g.max_degree + 1, 32 * eng.num_planes))
+    assert res.status == AttemptStatus.SUCCESS
+
+
+def test_compact_adaptive_plane_cap():
+    # K40 with a 32-color cap: the retry loop must also work in the
+    # compacted phase (the stall is detected there)
+    v = 40
+    edges = np.array([[i, j] for i in range(v) for j in range(i + 1, v)])
+    g = GraphArrays.from_edge_list(v, edges)
+    eng = _forced_compact(g, max_colors_hint=32)
+    assert eng.num_planes == 1
+    res = eng.attempt(g.max_degree + 1)
+    assert res.status == AttemptStatus.SUCCESS
+    assert res.colors_used == 40
+    assert eng.num_planes == 2
+
+
+def test_compact_disconnected_components():
+    # the exact case that deadlocks the reference baseline (SURVEY §2.4.1)
+    lists = [[1], [0], [3], [2], [], [6, 7], [5, 7], [5, 6]]
+    g = GraphArrays.from_neighbor_lists(lists)
+    res = _forced_compact(g).attempt(3)
+    assert res.status == AttemptStatus.SUCCESS
+    assert validate_coloring(g.indptr, g.indices, res.colors).valid
+
+
+def test_compact_default_params():
+    # default stages: single full-table stage below 2^14 vertices
+    g = generate_random_graph(600, 8, seed=11)
+    eng = CompactFrontierEngine(g)
+    assert eng.stages == ((None, 0),)
+    res = find_minimal_coloring(eng, g.max_degree + 1, validate=make_validator(g))
+    ref = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1)
+    assert res.minimal_colors == ref.minimal_colors
+
+
+def test_default_stages_large():
+    from dgc_tpu.engine.compact import default_stages
+
+    st = default_stages(1_000_000)
+    assert st[0] == (None, 250_000)
+    assert st[1] == (262_144, 15_625)
+    assert st[2] == (16_384, 0)
+
+
+def test_compact_rejects_underspecified_stage_pad():
+    import pytest
+
+    g = generate_random_graph(100, 6, seed=0)
+    with pytest.raises(ValueError, match="stage pad"):
+        CompactFrontierEngine(g, stages=((None, 50), (16, 0)))
