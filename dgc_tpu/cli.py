@@ -38,7 +38,8 @@ def build_parser() -> argparse.ArgumentParser:
     # new flags
     p.add_argument(
         "--backend",
-        choices=["ell", "ell-bucketed", "ell-compact", "dense", "sharded", "reference-sim", "oracle", "spark"],
+        choices=["ell", "ell-bucketed", "ell-compact", "dense", "sharded",
+                 "sharded-ring", "reference-sim", "oracle", "spark"],
         default="ell",
         help="coloring engine (default: ell — single-device jit'd ELL kernel)",
     )
@@ -84,6 +85,9 @@ def make_engine(args, graph: Graph):
     if args.backend == "sharded":
         from dgc_tpu.engine.sharded import ShardedELLEngine
         return ShardedELLEngine(arrays, num_shards=args.shards)
+    if args.backend == "sharded-ring":
+        from dgc_tpu.engine.ring import RingHaloEngine
+        return RingHaloEngine(arrays, num_shards=args.shards)
     if args.backend == "reference-sim":
         from dgc_tpu.engine.reference_sim import ReferenceSimEngine
         return ReferenceSimEngine(arrays, variant=args.sim_variant)

@@ -147,6 +147,21 @@ def initial_packed(degrees):
     return jnp.where(degrees == 0, 0, 1).astype(jnp.int32)
 
 
+def status_step(any_fail, active, stall_rounds, stall_window):
+    """The shared per-superstep status transition (FAILURE > SUCCESS >
+    STALLED > RUNNING) — one definition so engines whose contract is
+    bit-identical behavior cannot diverge."""
+    return jnp.where(
+        any_fail,
+        _FAILURE,
+        jnp.where(
+            active == 0,
+            _SUCCESS,
+            jnp.where(stall_rounds >= stall_window, _STALLED, _RUNNING),
+        ),
+    ).astype(jnp.int32)
+
+
 def bucketed_superstep(packed, combined_buckets, k, num_planes: int):
     """One full-table superstep over all buckets. Returns
     (new_packed, fail_count, active_count)."""
@@ -198,15 +213,7 @@ def _attempt_kernel_bucketed(combined_buckets, degrees, carry_in, k,
         )
         any_fail = (fail_count > 0) & fail_assertable
         stall_rounds = jnp.where(active < prev_active, 0, stall_rounds + 1)
-        status = jnp.where(
-            any_fail,
-            _FAILURE,
-            jnp.where(
-                active == 0,
-                _SUCCESS,
-                jnp.where(stall_rounds >= stall_window, _STALLED, _RUNNING),
-            ),
-        ).astype(jnp.int32)
+        status = status_step(any_fail, active, stall_rounds, stall_window)
         new_packed = jnp.where(any_fail, packed, new_packed)
         return (new_packed, step + 1, status, active, stall_rounds)
 

@@ -58,6 +58,7 @@ from dgc_tpu.engine.bucketed import (
     decode_combined,
     encode_combined,
     initial_packed,
+    status_step,
 )
 from dgc_tpu.models.arrays import GraphArrays, csr_to_ell
 from dgc_tpu.ops.bitmask import num_planes_for
@@ -82,18 +83,6 @@ def default_stages(v: int) -> tuple:
         (_pow2_ceil(v // 4), v // 64),
         (_pow2_ceil(v // 64), 0),
     )
-
-
-def _status_step(any_fail, active, stall_rounds, stall_window):
-    return jnp.where(
-        any_fail,
-        _FAILURE,
-        jnp.where(
-            active == 0,
-            _SUCCESS,
-            jnp.where(stall_rounds >= stall_window, _STALLED, _RUNNING),
-        ),
-    ).astype(jnp.int32)
 
 
 @partial(jax.jit, static_argnames=("num_planes", "stages", "max_steps", "stall_window"))
@@ -130,7 +119,7 @@ def _attempt_kernel_staged(combined_buckets, combined_flat_ext, degrees, k,
                 )
                 any_fail = (fail_count > 0) & fail_assertable
                 stall = jnp.where(active < prev_active, 0, stall + 1)
-                status = _status_step(any_fail, active, stall, stall_window)
+                status = status_step(any_fail, active, stall, stall_window)
                 new_pe = jnp.concatenate([new_p, jnp.array([-1, 0], jnp.int32)])
                 new_pe = jnp.where(any_fail, pe, new_pe)
                 return (new_pe, step + 1, status, active, stall)
@@ -166,7 +155,7 @@ def _attempt_kernel_staged(combined_buckets, combined_flat_ext, degrees, k,
                 any_fail = (jnp.sum(fail_mask.astype(jnp.int32)) > 0) & fail_assertable
                 active = jnp.sum(active_mask.astype(jnp.int32))
                 stall = jnp.where(active < prev_active, 0, stall + 1)
-                status = _status_step(any_fail, active, stall, stall_window)
+                status = status_step(any_fail, active, stall, stall_window)
                 new_pe = jnp.where(any_fail, pe, new_pe)
                 return (new_pe, step + 1, status, active, stall)
 

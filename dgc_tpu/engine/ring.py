@@ -1,0 +1,206 @@
+"""Ring-halo sharded engine — ``lax.ppermute`` color exchange.
+
+The all-gather engine (``engine.sharded``) replicates the packed state on
+every shard each superstep — O(V) memory per chip. This variant keeps the
+exchange *streaming*: the packed state rotates around the ICI ring one
+block at a time (``lax.ppermute``), and each shard consumes the block it
+currently holds by gathering through a per-rotation neighbor table. Peak
+per-chip memory is O(V/n + tables); the bytes moved per superstep equal the
+all-gather (which XLA also implements as a ring), but no shard ever
+materializes the full vector — the design SURVEY.md §2.5/§7.1 calls for
+when V outgrows per-chip replication (the 4M power-law config).
+
+Neighbor tables are grouped by *relative owner offset*: table r holds, for
+each local vertex, the block-local ids of its neighbors owned by the shard
+``(me − r) mod n`` — exactly the block held after r ring rotations. The
+gather→reduce per rotation uses ``ops.speculative.neighbor_stats``, whose
+outputs OR-combine across rotations; the final transition is the shared
+``apply_update``, so results are bit-identical to the all-gather and
+single-device engines on the same graph.
+
+Reference mapping: replaces ``collectAsMap`` + ``sc.broadcast`` of the full
+id→color dict per superstep (``coloring.py:135-137``) with n−1 ppermutes of
+a V/n block; reductions (``coloring.py:88,104``) are ``lax.psum``.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.ops.bitmask import num_planes_for
+from dgc_tpu.ops.speculative import apply_update, beats_rule, neighbor_stats
+from dgc_tpu.parallel.mesh import VERTEX_AXIS, make_mesh, pad_to_multiple
+
+_RUNNING = AttemptStatus.RUNNING
+_SUCCESS = AttemptStatus.SUCCESS
+_FAILURE = AttemptStatus.FAILURE
+_STALLED = AttemptStatus.STALLED
+
+
+def build_rotation_tables(arrays: GraphArrays, n: int):
+    """Group each vertex's neighbors by relative owner offset.
+
+    Returns ``(v_pad, vl, tables, beats)`` where ``tables[r]`` is
+    int32[v_pad, W_r] of *block-local* neighbor ids owned by shard
+    ``(owner(i) − r) mod n`` (sentinel = vl), and ``beats[r]`` the matching
+    precomputed (degree desc, id asc) priority masks.
+    """
+    v = arrays.num_vertices
+    v_pad = pad_to_multiple(max(v, n), n)
+    vl = v_pad // n
+    degrees = np.zeros(v_pad, dtype=np.int32)
+    degrees[:v] = arrays.degrees
+
+    src = np.repeat(np.arange(v, dtype=np.int64), arrays.degrees)
+    dst = arrays.indices.astype(np.int64)
+    rel = ((src // vl) - (dst // vl)) % n
+    gloc = (dst % vl).astype(np.int32)
+
+    # rank of each entry within its (vertex, rel) group, preserving CSR order
+    key = src * n + rel
+    order = np.argsort(key, kind="stable")
+    sk = key[order]
+    group_start = np.concatenate([[0], np.flatnonzero(np.diff(sk)) + 1]) \
+        if len(sk) else np.zeros(0, np.int64)
+    gs = np.zeros(len(sk), dtype=np.int64)
+    gs[group_start] = group_start
+    np.maximum.accumulate(gs, out=gs)
+    rank_sorted = np.arange(len(sk), dtype=np.int64) - gs
+    rank = np.empty_like(rank_sorted)
+    rank[order] = rank_sorted
+
+    n_beats = beats_rule(degrees[dst], dst, degrees[src], src)
+
+    tables, beats = [], []
+    for r in range(n):
+        sel = rel == r
+        w_r = int(rank[sel].max()) + 1 if sel.any() else 1
+        t = np.full((v_pad, w_r), vl, dtype=np.int32)
+        b = np.zeros((v_pad, w_r), dtype=bool)
+        t[src[sel], rank[sel]] = gloc[sel]
+        b[src[sel], rank[sel]] = n_beats[sel]
+        tables.append(t)
+        beats.append(b)
+    return v_pad, vl, tables, beats
+
+
+def _ring_body(deg_l, tables_l, beats_l, k,
+               num_planes: int, max_steps: int, n: int):
+    """Per-shard body under shard_map. tables_l[r]: int32[vl, W_r] block-local
+    neighbor ids for rotation r (sentinel = vl); deg_l: int32[vl]."""
+    vl = deg_l.shape[0]
+    k = jnp.asarray(k, jnp.int32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+
+    packed0_l = jnp.where(deg_l == 0, 0, -1).astype(jnp.int32)
+    pshape = (vl, num_planes)
+
+    def superstep(packed_l):
+        mycol = packed_l >> 1
+        forb_all = jnp.zeros(pshape, jnp.uint32)
+        forb_old = jnp.zeros(pshape, jnp.uint32)
+        clash = jnp.zeros((vl,), bool)
+        block = packed_l
+        for r in range(n):
+            block_pad = jnp.concatenate([block, jnp.array([-1], jnp.int32)])
+            g = block_pad[tables_l[r]]
+            fa, fo, cl = neighbor_stats(g, beats_l[r], mycol, num_planes)
+            forb_all |= fa
+            forb_old |= fo
+            clash |= cl
+            if r + 1 < n:
+                block = jax.lax.ppermute(block, VERTEX_AXIS, perm)
+        new_packed_l, fail_mask, active_mask = apply_update(
+            packed_l, forb_all, forb_old, clash, k
+        )
+        any_fail = jax.lax.psum(jnp.sum(fail_mask.astype(jnp.int32)), VERTEX_AXIS) > 0
+        active = jax.lax.psum(jnp.sum(active_mask.astype(jnp.int32)), VERTEX_AXIS)
+        return new_packed_l, any_fail, active
+
+    def cond(carry):
+        _, _, status = carry
+        return status == _RUNNING
+
+    def body(carry):
+        packed_l, step, status = carry
+        new_packed_l, any_fail, active = superstep(packed_l)
+        status = jnp.where(
+            any_fail,
+            _FAILURE,
+            jnp.where(
+                active == 0,
+                _SUCCESS,
+                jnp.where(step + 1 >= max_steps, _STALLED, _RUNNING),
+            ),
+        ).astype(jnp.int32)
+        new_packed_l = jnp.where(any_fail, packed_l, new_packed_l)
+        return (new_packed_l, step + 1, status)
+
+    packed_l, steps, status = jax.lax.while_loop(
+        cond, body, (packed0_l, jnp.int32(0), jnp.int32(_RUNNING))
+    )
+    colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
+    return colors_l, steps, status
+
+
+class RingHaloEngine:
+    """Vertex-sharded engine with ppermute ring-halo color exchange."""
+
+    def __init__(
+        self,
+        arrays: GraphArrays,
+        num_shards: int | None = None,
+        max_steps: int | None = None,
+        mesh=None,
+    ):
+        self.arrays = arrays
+        self.mesh = mesh if mesh is not None else make_mesh(num_shards)
+        n = self.mesh.shape[VERTEX_AXIS]
+        v = arrays.num_vertices
+        self.v_true = v
+        v_pad, vl, tables, beats = build_rotation_tables(arrays, n)
+
+        deg_p = np.zeros(v_pad, dtype=np.int32)
+        deg_p[:v] = arrays.degrees
+
+        self.num_planes = num_planes_for(arrays.max_degree + 1)
+        self.max_steps = max_steps if max_steps is not None else 2 * v_pad + 4
+
+        rows = NamedSharding(self.mesh, P(VERTEX_AXIS))
+        rows2d = NamedSharding(self.mesh, P(VERTEX_AXIS, None))
+        self.deg_l = jax.device_put(deg_p, rows)
+        self.tables = tuple(jax.device_put(t, rows2d) for t in tables)
+        self.beats = tuple(jax.device_put(b, rows2d) for b in beats)
+
+        body = partial(
+            _ring_body, num_planes=self.num_planes, max_steps=self.max_steps, n=n
+        )
+        sm = jax.shard_map(
+            body,
+            mesh=self.mesh,
+            in_specs=(P(VERTEX_AXIS),
+                      tuple(P(VERTEX_AXIS, None) for _ in self.tables),
+                      tuple(P(VERTEX_AXIS, None) for _ in self.beats),
+                      P()),
+            out_specs=(P(VERTEX_AXIS), P(), P()),
+            check_vma=False,
+        )
+        self._kernel = jax.jit(sm)
+
+    def attempt(self, k: int) -> AttemptResult:
+        if k > 32 * self.num_planes:
+            raise ValueError(f"k={k} exceeds plane capacity {32 * self.num_planes}")
+        colors, steps, status = self._kernel(self.deg_l, self.tables, self.beats, k)
+        return AttemptResult(
+            AttemptStatus(int(status)),
+            np.asarray(colors)[: self.v_true],
+            int(steps),
+            int(k),
+        )

@@ -1,0 +1,98 @@
+"""Ring-halo sharded engine tests (8-device virtual CPU mesh, conftest).
+
+The ring engine's contract: identical update rule to the all-gather sharded
+engine, different exchange topology — so colors must be bit-identical to
+``ShardedELLEngine`` (and therefore to the single-device ``ELLEngine``)
+at every mesh size.
+"""
+
+import numpy as np
+import pytest
+
+import jax
+
+from dgc_tpu.engine.base import AttemptStatus
+from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
+from dgc_tpu.engine.ring import RingHaloEngine, build_rotation_tables
+from dgc_tpu.engine.sharded import ShardedELLEngine
+from dgc_tpu.engine.superstep import ELLEngine
+from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.models.generators import generate_random_graph, generate_rmat_graph
+from dgc_tpu.ops.validate import validate_coloring
+
+needs8 = pytest.mark.skipif(
+    jax.device_count() < 8, reason="needs 8 (virtual) devices"
+)
+
+
+def test_rotation_tables_reconstruct_adjacency():
+    g = generate_random_graph(37, 6, seed=2)
+    n = 4
+    v_pad, vl, tables, beats = build_rotation_tables(g, n)
+    assert v_pad % n == 0 and vl == v_pad // n
+    rebuilt = [set() for _ in range(v_pad)]
+    for r, (t, b) in enumerate(zip(tables, beats)):
+        for i in range(v_pad):
+            owner = ((i // vl) - r) % n
+            for j, loc in enumerate(t[i]):
+                if loc == vl:
+                    assert not b[i, j]
+                    continue
+                rebuilt[i].add(owner * vl + int(loc))
+    expected = [set(ns) for ns in g.to_neighbor_lists()]
+    expected += [set()] * (v_pad - g.num_vertices)
+    assert rebuilt == expected
+
+
+@needs8
+@pytest.mark.parametrize("shards", [1, 2, 8])
+def test_ring_bit_identical_to_sharded_and_ell(small_graphs, shards):
+    for g in small_graphs:
+        k0 = g.max_degree + 1
+        rr = RingHaloEngine(g, num_shards=shards).attempt(k0)
+        rs = ShardedELLEngine(g, num_shards=shards).attempt(k0)
+        re = ELLEngine(g).attempt(k0)
+        assert rr.status == rs.status == re.status
+        assert np.array_equal(rr.colors, rs.colors)
+        assert np.array_equal(rr.colors, re.colors)
+
+
+@needs8
+def test_ring_minimal_sweep(medium_graph):
+    g = medium_graph
+    res = find_minimal_coloring(
+        RingHaloEngine(g, num_shards=8), g.max_degree + 1,
+        validate=make_validator(g),
+    )
+    ref = find_minimal_coloring(ELLEngine(g), g.max_degree + 1)
+    assert res.minimal_colors == ref.minimal_colors
+    assert validate_coloring(g.indptr, g.indices, res.colors).valid
+
+
+@needs8
+def test_ring_failure_below_minimal(medium_graph):
+    g = medium_graph
+    ref = find_minimal_coloring(ELLEngine(g), g.max_degree + 1)
+    r = RingHaloEngine(g, num_shards=4).attempt(ref.minimal_colors - 1)
+    assert r.status == AttemptStatus.FAILURE
+
+
+@needs8
+def test_ring_uneven_padding_and_isolated():
+    # V not divisible by the mesh + isolated vertices exercise the pad path
+    g = GraphArrays.from_neighbor_lists(
+        [[1], [0], [3], [2], [], [6, 7], [5, 7], [5, 6], [], [10], [9]]
+    )
+    res = RingHaloEngine(g, num_shards=8).attempt(3)
+    assert res.status == AttemptStatus.SUCCESS
+    assert len(res.colors) == g.num_vertices
+    assert validate_coloring(g.indptr, g.indices, res.colors).valid
+
+
+@needs8
+def test_ring_heavy_tail():
+    g = generate_rmat_graph(1024, avg_degree=6, seed=3, native=False)
+    rr = RingHaloEngine(g, num_shards=8).attempt(g.max_degree + 1)
+    rs = ShardedELLEngine(g, num_shards=8).attempt(g.max_degree + 1)
+    assert rr.status == AttemptStatus.SUCCESS
+    assert np.array_equal(rr.colors, rs.colors)
