@@ -68,8 +68,15 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
-def make_engine(args, graph: Graph):
+def make_engine(args, graph: Graph, logger=None):
     arrays = graph.arrays
+    if args.backend in ("sharded", "sharded-ring"):
+        # multi-host: no-op single-process; spans the pod when configured
+        from dgc_tpu.parallel.multihost import initialize_multihost, process_info
+
+        multi = initialize_multihost()
+        if logger is not None:
+            logger.event("distributed", multi_process=multi, **process_info())
     if args.backend == "ell":
         from dgc_tpu.engine.superstep import ELLEngine
         return ELLEngine(arrays)
@@ -138,7 +145,7 @@ def _run(args, logger: RunLogger) -> int:
             graph.serialize(args.output_graph)
             logger.event("graph_saved", path=args.output_graph)
 
-    engine = make_engine(args, graph)
+    engine = make_engine(args, graph, logger=logger)
     checkpoint = None
     if args.checkpoint_dir:
         from dgc_tpu.utils.checkpoint import CheckpointManager, graph_fingerprint

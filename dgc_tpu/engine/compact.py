@@ -85,11 +85,10 @@ def default_stages(v: int) -> tuple:
     )
 
 
-@partial(jax.jit, static_argnames=("num_planes", "stages", "max_steps", "stall_window"))
-def _attempt_kernel_staged(combined_buckets, combined_flat_ext, degrees, k,
-                           num_planes: int, stages: tuple, max_steps: int,
-                           stall_window: int = 64):
-    """One whole k-attempt in a single device call: full-table phase +
+def _staged_pipeline(combined_buckets, combined_flat_ext, degrees, k,
+                     num_planes: int, stages: tuple, max_steps: int,
+                     stall_window: int = 64):
+    """One whole k-attempt as a traceable pipeline: full-table phase +
     static compaction stages. Returns (packed_ext, steps, status).
 
     combined_flat_ext: int32[V+1, W] flat relabeled combined table with a
@@ -172,6 +171,43 @@ def _attempt_kernel_staged(combined_buckets, combined_flat_ext, degrees, k,
     return pe, steps, status
 
 
+_attempt_kernel_staged = partial(jax.jit, static_argnames=(
+    "num_planes", "stages", "max_steps", "stall_window"))(_staged_pipeline)
+
+
+@partial(jax.jit, static_argnames=("num_planes", "stages", "max_steps", "stall_window"))
+def _sweep_kernel_staged(combined_buckets, combined_flat_ext, degrees, k0,
+                         num_planes: int, stages: tuple, max_steps: int,
+                         stall_window: int = 64):
+    """Fused minimal-k sweep: attempt(k0), then — still on device — the
+    jump-mode confirm attempt at (colors_used − 1). One dispatch for what
+    jump mode otherwise does in two (PERF.md lever: ~65 ms dispatch each).
+
+    Returns (pe1, steps1, status1, used, pe2, steps2, status2); the second
+    triple is the first repeated when the confirm attempt was skipped
+    (attempt 1 not successful, or used − 1 < 1 — the host fabricates the
+    trivial k=0 FAILURE in that case, matching ``attempt(0)``).
+    """
+    v = degrees.shape[0]
+    args = (combined_buckets, combined_flat_ext, degrees)
+    kw = dict(num_planes=num_planes, stages=stages, max_steps=max_steps,
+              stall_window=stall_window)
+    pe1, steps1, status1 = _staged_pipeline(*args, k0, **kw)
+    colors1 = jnp.where(pe1[:v] >= 0, pe1[:v] >> 1, -1)
+    used = jnp.max(colors1, initial=-1) + 1
+    k2 = used - 1
+
+    def second(_):
+        return _staged_pipeline(*args, k2, **kw)
+
+    def skip(_):
+        return pe1, jnp.int32(0), jnp.int32(_FAILURE)
+
+    run2 = (status1 == _SUCCESS) & (k2 >= 1)
+    pe2, steps2, status2 = jax.lax.cond(run2, second, skip, 0)
+    return pe1, steps1, status1, used, pe2, steps2, status2
+
+
 class CompactFrontierEngine(BucketedELLEngine):
     """Single-call staged frontier-compacted engine (single device).
 
@@ -235,3 +271,35 @@ class CompactFrontierEngine(BucketedELLEngine):
                 continue
             break
         return self._finish(np.asarray(pe)[:v], status, int(steps), int(k))
+
+    def sweep(self, k0: int) -> tuple[AttemptResult, AttemptResult | None]:
+        """Fused jump-mode pair: attempt(k0) and the confirm attempt at
+        (colors_used − 1), both inside one device call. Returns
+        ``(first, second)``; ``second`` is None when attempt 1 did not
+        succeed. Bit-identical to calling ``attempt`` twice."""
+        v = self.arrays.num_vertices
+        if k0 < 1:
+            return self.attempt(k0), None
+        while True:  # plane-budget retry loop
+            pe1, steps1, status1, used, pe2, steps2, status2 = _sweep_kernel_staged(
+                self.combined_buckets, self.combined_flat_ext, self.degrees, k0,
+                num_planes=self.num_planes, stages=self.stages,
+                max_steps=self.max_steps,
+            )
+            status1 = AttemptStatus(int(status1))
+            if status1 == AttemptStatus.STALLED and 32 * self.num_planes < k0:
+                self.num_planes = min(2 * self.num_planes, num_planes_for(self.k_full))
+                continue
+            break
+        first = self._finish(np.asarray(pe1)[:v], status1, int(steps1), int(k0))
+        if status1 != AttemptStatus.SUCCESS:
+            return first, None
+        k2 = int(used) - 1
+        if k2 < 1:
+            # matches attempt(0): trivial FAILURE, nothing colored
+            second = self._finish(np.full(v, -1, np.int32),
+                                  AttemptStatus.FAILURE, 0, k2)
+        else:
+            second = self._finish(np.asarray(pe2)[:v],
+                                  AttemptStatus(int(status2)), int(steps2), k2)
+        return first, second

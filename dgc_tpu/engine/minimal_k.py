@@ -73,28 +73,41 @@ def find_minimal_coloring(
             if best is not None:
                 result.attempts.append(best)
 
+    # fused path: engines exposing sweep() run the jump-mode pair (find u,
+    # confirm u−1 fails) in one device call; results are bit-identical to
+    # two attempt() calls. Strict mode, checkpointing, and a raised k_min
+    # floor (the fused confirm attempt can't honor a floor below u−1) use
+    # the per-attempt loop instead.
+    fused = (not strict_decrement and checkpoint is None and k_min <= 1
+             and hasattr(engine, "sweep"))
+
     while not done and k >= k_min:
-        res = engine.attempt(k)
-        result.attempts.append(res)
-        val = None
-        if res.success:
-            if validate is not None:
-                val = validate(res.colors)
-                if not val.valid:
-                    raise AssertionError(
-                        f"engine produced invalid coloring at k={k}: {val}"
-                    )
-            best = res
-            next_k = (res.colors_used - 1) if not strict_decrement else (k - 1)
-        else:
-            next_k = None
-        if on_attempt is not None:
-            on_attempt(res, val)
-        if checkpoint is not None:
-            checkpoint.save(k=(next_k if next_k is not None else k), best=best, failed=not res.success)
-        if not res.success:
-            break
-        k = next_k
+        pair = engine.sweep(k) if fused else (engine.attempt(k),)
+        for res in pair:
+            if res is None:
+                continue
+            result.attempts.append(res)
+            val = None
+            if res.success:
+                if validate is not None:
+                    val = validate(res.colors)
+                    if not val.valid:
+                        raise AssertionError(
+                            f"engine produced invalid coloring at k={res.k}: {val}"
+                        )
+                best = res
+                next_k = (res.colors_used - 1) if not strict_decrement else (res.k - 1)
+            else:
+                next_k = None
+            if on_attempt is not None:
+                on_attempt(res, val)
+            if checkpoint is not None:
+                checkpoint.save(k=(next_k if next_k is not None else k),
+                                best=best, failed=not res.success)
+            if not res.success:
+                done = True
+                break
+            k = next_k
 
     if best is not None and best.success:
         result.minimal_colors = best.colors_used

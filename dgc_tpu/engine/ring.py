@@ -33,6 +33,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.engine.bucketed import status_step
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import num_planes_for
 from dgc_tpu.ops.speculative import apply_update, beats_rule, neighbor_stats
@@ -131,15 +132,8 @@ def _ring_body(deg_l, tables_l, beats_l, k,
     def body(carry):
         packed_l, step, status = carry
         new_packed_l, any_fail, active = superstep(packed_l)
-        status = jnp.where(
-            any_fail,
-            _FAILURE,
-            jnp.where(
-                active == 0,
-                _SUCCESS,
-                jnp.where(step + 1 >= max_steps, _STALLED, _RUNNING),
-            ),
-        ).astype(jnp.int32)
+        # shared transition; step budget plays the stall role here
+        status = status_step(any_fail, active, step + 1, max_steps)
         new_packed_l = jnp.where(any_fail, packed_l, new_packed_l)
         return (new_packed_l, step + 1, status)
 

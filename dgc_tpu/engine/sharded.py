@@ -37,6 +37,7 @@ import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.engine.bucketed import status_step
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import num_planes_for
 from dgc_tpu.ops.speculative import beats_rule, speculative_update
@@ -87,15 +88,8 @@ def _shard_body(nbrs_l, deg_l, deg_g, k, num_planes: int, max_steps: int):
         new_packed_l, any_fail, active = _shard_superstep(
             packed_l, nbrs_l, pre_beats, k, num_planes
         )
-        status = jnp.where(
-            any_fail,
-            _FAILURE,
-            jnp.where(
-                active == 0,
-                _SUCCESS,
-                jnp.where(step + 1 >= max_steps, _STALLED, _RUNNING),
-            ),
-        ).astype(jnp.int32)
+        # shared transition; step budget plays the stall role here
+        status = status_step(any_fail, active, step + 1, max_steps)
         new_packed_l = jnp.where(any_fail, packed_l, new_packed_l)
         return (new_packed_l, step + 1, status)
 

@@ -1,0 +1,84 @@
+"""Multi-host (DCN) initialization for the sharded engines.
+
+The reference's multi-node story is a Spark cluster URL swapped into the
+hardcoded ``local[*]`` session (``/root/reference/coloring.py:190-198``;
+README merely notes a cluster is optional). Here multi-host runs on JAX's
+single-controller-per-process model: every host process calls
+``initialize_multihost`` once, after which ``jax.devices()`` spans the
+whole slice/pod — collectives ride ICI within a slice and DCN across
+slices, with no engine-code changes (the 1-D vertex mesh from
+``parallel.mesh.make_mesh`` simply covers all global devices).
+
+Engine-side requirements for multi-host are already met by construction:
+
+- every process executes the same jit'd program (SPMD);
+- graph tables are built identically on every host from the same seed or
+  input file (deterministic NumPy/C++ builders), then device_put against
+  the global mesh places only each host's shards locally;
+- the only host-side decisions (minimal-k schedule, plane-budget retry)
+  depend on scalars that are identical on all processes (psum'd counts),
+  so control flow cannot diverge.
+"""
+
+from __future__ import annotations
+
+import os
+
+import jax
+
+
+def initialize_multihost(
+    coordinator_address: str | None = None,
+    num_processes: int | None = None,
+    process_id: int | None = None,
+) -> bool:
+    """Initialize JAX's distributed runtime if a multi-process setup is
+    configured; returns True iff running multi-process.
+
+    With no arguments, the environment decides: the standard
+    ``JAX_COORDINATOR_ADDRESS`` / ``JAX_NUM_PROCESSES`` / ``JAX_PROCESS_ID``
+    variables are honored, and on Cloud TPU pod workers (detected via a
+    multi-entry ``TPU_WORKER_HOSTNAMES`` list or a ``MEGASCALE_*``
+    coordinator — single-host TPU VMs set the worker variables too, so a
+    lone hostname does not count) ``jax.distributed.initialize()`` is
+    called with no arguments so it can discover the topology itself. Plain
+    single-process setups (neither signal present) are a no-op, so the CLI
+    can call this unconditionally. Must run before any JAX backend
+    initialization.
+    """
+    if coordinator_address is None:
+        coordinator_address = os.environ.get("JAX_COORDINATOR_ADDRESS")
+    if num_processes is None:
+        np_ = os.environ.get("JAX_NUM_PROCESSES")
+        num_processes = int(np_) if np_ else None
+    if process_id is None:
+        pid = os.environ.get("JAX_PROCESS_ID")
+        process_id = int(pid) if pid else None
+
+    if coordinator_address is None and num_processes is None:
+        # single-host TPU VMs also set TPU_WORKER_ID/HOSTNAMES; only a
+        # multi-entry worker list (or a megascale coordinator) means pod
+        hostnames = os.environ.get("TPU_WORKER_HOSTNAMES", "")
+        pod_worker = ("," in hostnames) or bool(
+            os.environ.get("MEGASCALE_COORDINATOR_ADDRESS"))
+        if not pod_worker:
+            return False  # plain single-process run
+        jax.distributed.initialize()  # pod runtime discovers the topology
+        return jax.process_count() > 1
+
+    jax.distributed.initialize(
+        coordinator_address=coordinator_address,
+        num_processes=num_processes,
+        process_id=process_id,
+    )
+    return jax.process_count() > 1
+
+
+def process_info() -> dict:
+    """Topology summary for logs (reference prints none; SURVEY §5)."""
+    return {
+        "process_index": jax.process_index(),
+        "process_count": jax.process_count(),
+        "local_devices": jax.local_device_count(),
+        "global_devices": jax.device_count(),
+    }

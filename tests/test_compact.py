@@ -139,3 +139,65 @@ def test_compact_rejects_underspecified_stage_pad():
     g = generate_random_graph(100, 6, seed=0)
     with pytest.raises(ValueError, match="stage pad"):
         CompactFrontierEngine(g, stages=((None, 50), (16, 0)))
+
+
+def test_sweep_pair_matches_two_attempts(medium_graph):
+    g = medium_graph
+    eng = _forced_compact(g)
+    first, second = eng.sweep(g.max_degree + 1)
+    ref = _forced_compact(g)
+    r1 = ref.attempt(g.max_degree + 1)
+    assert first.status == r1.status and np.array_equal(first.colors, r1.colors)
+    r2 = ref.attempt(r1.colors_used - 1)
+    assert second.k == r1.colors_used - 1
+    assert second.status == r2.status
+    assert np.array_equal(second.colors, r2.colors)
+
+
+def test_minimal_k_uses_fused_sweep(medium_graph, monkeypatch):
+    g = medium_graph
+    eng = _forced_compact(g)
+    calls = {"sweep": 0, "attempt": 0}
+    orig_sweep, orig_attempt = eng.sweep, eng.attempt
+    monkeypatch.setattr(eng, "sweep",
+                        lambda k: calls.__setitem__("sweep", calls["sweep"] + 1) or orig_sweep(k))
+    monkeypatch.setattr(eng, "attempt",
+                        lambda k: calls.__setitem__("attempt", calls["attempt"] + 1) or orig_attempt(k))
+    res = find_minimal_coloring(eng, g.max_degree + 1, validate=make_validator(g))
+    ref = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1)
+    assert res.minimal_colors == ref.minimal_colors
+    assert calls["sweep"] >= 1 and calls["attempt"] == 0
+    assert len(res.attempts) == 2  # find u + confirm u-1 fails
+
+
+def test_sweep_single_color_graph():
+    # edgeless graph colors with u=1; confirm attempt at k=0 is the trivial
+    # FAILURE (matching attempt(0)) and minimal_k must report 1
+    g = GraphArrays.from_neighbor_lists([[], [], []])
+    eng = _forced_compact(g)
+    first, second = eng.sweep(1)
+    assert first.status == AttemptStatus.SUCCESS and first.colors_used == 1
+    assert second.status == AttemptStatus.FAILURE and second.k == 0
+    res = find_minimal_coloring(eng, 1)
+    assert res.minimal_colors == 1
+
+
+def test_sweep_plane_cap_retry():
+    v = 40
+    edges = np.array([[i, j] for i in range(v) for j in range(i + 1, v)])
+    g = GraphArrays.from_edge_list(v, edges)
+    eng = _forced_compact(g, max_colors_hint=32)
+    first, second = eng.sweep(g.max_degree + 1)
+    assert first.status == AttemptStatus.SUCCESS and first.colors_used == 40
+    assert second.status == AttemptStatus.FAILURE
+    assert eng.num_planes == 2
+
+
+def test_fused_sweep_respects_k_min(medium_graph):
+    # raised k_min floor must fall back to the per-attempt loop: no attempt
+    # below the floor may be recorded (review regression)
+    g = medium_graph
+    res = find_minimal_coloring(_forced_compact(g), g.max_degree + 1, k_min=3)
+    assert all(a.k >= 3 for a in res.attempts)
+    ref = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1, k_min=3)
+    assert [a.k for a in res.attempts] == [a.k for a in ref.attempts]

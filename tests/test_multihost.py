@@ -1,0 +1,29 @@
+"""Multi-host init helper tests (single-process semantics)."""
+
+import jax
+
+from dgc_tpu.parallel.multihost import initialize_multihost, process_info
+
+
+def test_single_process_noop(monkeypatch):
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+                "TPU_WORKER_HOSTNAMES", "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    assert initialize_multihost() is False  # no coordinator -> no-op
+
+
+def test_single_host_tpu_vm_is_not_a_pod(monkeypatch):
+    # single-host TPU VMs set TPU_WORKER_HOSTNAMES with ONE entry; that must
+    # not trigger jax.distributed.initialize()
+    for var in ("JAX_COORDINATOR_ADDRESS", "JAX_NUM_PROCESSES", "JAX_PROCESS_ID",
+                "MEGASCALE_COORDINATOR_ADDRESS"):
+        monkeypatch.delenv(var, raising=False)
+    monkeypatch.setenv("TPU_WORKER_HOSTNAMES", "localhost")
+    assert initialize_multihost() is False
+
+
+def test_process_info_shape():
+    info = process_info()
+    assert info["process_count"] >= 1
+    assert info["global_devices"] == jax.device_count()
+    assert set(info) == {"process_index", "process_count", "local_devices", "global_devices"}

@@ -1,0 +1,148 @@
+"""Unit tests for the shared speculative-superstep core (``ops.speculative``)
+and the combined-table packing (``engine.bucketed``).
+
+These pin the semantics every engine inherits: the (degree desc, id asc)
+priority total order, the OR-combinability of ``neighbor_stats`` that the
+ring engine's rotation streaming relies on, and the demote/confirm/fail
+transitions against the reference's sentinel contract (−2 defer / −3 fail,
+``/root/reference/coloring.py:44-54`` — here: defer = stay uncolored,
+fail = fail_mask).
+"""
+
+import numpy as np
+import pytest
+
+import jax.numpy as jnp
+
+from dgc_tpu.engine.bucketed import BEATS_BIT, decode_combined, encode_combined
+from dgc_tpu.ops.speculative import (
+    apply_update,
+    beats_rule,
+    neighbor_stats,
+    speculative_update,
+)
+
+
+def test_beats_rule_total_order():
+    # degree descending wins; id ascending breaks ties; irreflexive/antisymmetric
+    assert beats_rule(5, 9, 3, 0)          # higher degree beats
+    assert not beats_rule(3, 0, 5, 9)
+    assert beats_rule(4, 1, 4, 2)          # tie → lower id beats
+    assert not beats_rule(4, 2, 4, 1)
+    assert not beats_rule(4, 7, 4, 7)      # never beats itself
+    # numpy broadcast form
+    n_deg = np.array([[3, 5, 4]])
+    n_id = np.array([[9, 9, 1]])
+    out = beats_rule(n_deg, n_id, np.array([[4]]), np.array([[2]]))
+    assert out.tolist() == [[False, True, True]]
+
+
+def test_beats_rule_sentinel_never_beats():
+    # ELL padding carries degree −1 (deg_pad sentinel) — loses to everyone
+    assert not beats_rule(-1, 999, 0, 0)
+
+
+def test_encode_decode_combined_roundtrip():
+    nbrs = np.array([[0, 5, 1 << (BEATS_BIT - 1)], [7, 7, 7]], np.int32)
+    beats = np.array([[True, False, True], [False, True, False]])
+    nb, bt = decode_combined(jnp.asarray(encode_combined(nbrs, beats)))
+    assert np.array_equal(np.asarray(nb), nbrs)
+    assert np.array_equal(np.asarray(bt), beats)
+
+
+def _pack(color, fresh):
+    return color * 2 + (1 if fresh else 0)
+
+
+def test_neighbor_stats_or_combinable():
+    # streaming the neighbor axis in two chunks and OR-ing the stats must
+    # equal one combined call — the ring engine's correctness precondition
+    rng = np.random.default_rng(0)
+    vl, w, planes = 17, 8, 2
+    gathered = rng.integers(-1, 12, (vl, w)).astype(np.int32)
+    beats = rng.random((vl, w)) < 0.5
+    mycol = rng.integers(-1, 6, (vl,)).astype(np.int32)
+
+    fa, fo, cl = neighbor_stats(jnp.asarray(gathered), jnp.asarray(beats),
+                                jnp.asarray(mycol), planes)
+    fa1, fo1, cl1 = neighbor_stats(jnp.asarray(gathered[:, :3]),
+                                   jnp.asarray(beats[:, :3]),
+                                   jnp.asarray(mycol), planes)
+    fa2, fo2, cl2 = neighbor_stats(jnp.asarray(gathered[:, 3:]),
+                                   jnp.asarray(beats[:, 3:]),
+                                   jnp.asarray(mycol), planes)
+    assert np.array_equal(np.asarray(fa), np.asarray(fa1 | fa2))
+    assert np.array_equal(np.asarray(fo), np.asarray(fo1 | fo2))
+    assert np.array_equal(np.asarray(cl), np.asarray(cl1 | cl2))
+
+
+def test_update_uncolored_first_fit_skips_forbidden():
+    # uncolored vertex with neighbors at colors 0 (confirmed) and 1 (fresh)
+    # must speculate color 2 (forb_all covers both)
+    packed = jnp.asarray([_pack(-1, False) - 1 + 0], jnp.int32)  # -1 uncolored
+    packed = jnp.asarray([-1], jnp.int32)
+    gathered = jnp.asarray([[_pack(0, False), _pack(1, True), -1]], jnp.int32)
+    beats = jnp.zeros((1, 3), bool)
+    new, fail, active = speculative_update(packed, gathered, beats, 8, 1)
+    assert int(new[0]) == _pack(2, True)
+    assert not bool(fail[0]) and bool(active[0])
+
+
+def test_update_fresh_confirms_without_clash():
+    packed = jnp.asarray([_pack(3, True)], jnp.int32)
+    gathered = jnp.asarray([[_pack(3, True)]], jnp.int32)
+    beats = jnp.asarray([[False]])  # neighbor does NOT beat me → I confirm
+    new, fail, active = speculative_update(packed, gathered, beats, 8, 1)
+    assert int(new[0]) == _pack(3, False)
+    assert not bool(active[0])
+
+
+def test_update_fresh_demotes_and_repicks_on_clash():
+    # higher-priority fresh neighbor at my color → demote; first-fit repick
+    # avoids that fresh color (forb_all includes fresh)
+    packed = jnp.asarray([_pack(0, True)], jnp.int32)
+    gathered = jnp.asarray([[_pack(0, True)]], jnp.int32)
+    beats = jnp.asarray([[True]])
+    new, fail, active = speculative_update(packed, gathered, beats, 8, 1)
+    assert int(new[0]) == _pack(1, True)
+    assert bool(active[0]) and not bool(fail[0])
+
+
+def test_update_demoted_with_full_budget_defers_not_fails():
+    # clash demotion + all of [0,k) taken by FRESH neighbors: no free color,
+    # but failure must NOT assert (fresh colors are speculative — reference
+    # only fails on confirmed exhaustion, sentinel −3 semantics)
+    packed = jnp.asarray([_pack(0, True)], jnp.int32)
+    gathered = jnp.asarray([[_pack(0, True), _pack(1, True)]], jnp.int32)
+    beats = jnp.asarray([[True, True]])
+    new, fail, active = speculative_update(packed, gathered, beats, 2, 1)
+    assert int(new[0]) == -1          # deferred (uncolored), retry next round
+    assert not bool(fail[0])
+    assert bool(active[0])
+
+
+def test_update_fails_on_confirmed_exhaustion():
+    packed = jnp.asarray([-1], jnp.int32)
+    gathered = jnp.asarray([[_pack(0, False), _pack(1, False)]], jnp.int32)
+    beats = jnp.zeros((1, 2), bool)
+    new, fail, active = speculative_update(packed, gathered, beats, 2, 1)
+    assert bool(fail[0])
+
+
+def test_update_confirmed_vertex_is_inert():
+    packed = jnp.asarray([_pack(4, False)], jnp.int32)
+    gathered = jnp.asarray([[_pack(4, True), _pack(4, False), -1]], jnp.int32)
+    beats = jnp.asarray([[True, True, True]])
+    new, fail, active = speculative_update(packed, gathered, beats, 8, 1)
+    assert int(new[0]) == _pack(4, False)   # unchanged
+    assert not bool(active[0]) and not bool(fail[0])
+
+
+def test_update_multi_plane_first_fit():
+    # forbidden colors 0..39 confirmed → candidate 40 lands in plane 2
+    packed = jnp.asarray([-1], jnp.int32)
+    gathered = jnp.asarray([[_pack(c, False) for c in range(40)]], jnp.int32)
+    beats = jnp.zeros((1, 40), bool)
+    new, fail, active = speculative_update(packed, gathered, beats, 64, 2)
+    assert int(new[0]) == _pack(40, True)
+    assert not bool(fail[0])
