@@ -92,7 +92,12 @@ def main() -> int:
 
     if not args.include_compile:
         t0 = time.perf_counter()
-        engine.attempt(k0)  # warm-up: compile + first run
+        # warm-up must compile the same kernels the measured sweep uses
+        # (engines with a fused sweep() take that path in find_minimal_coloring)
+        if hasattr(engine, "sweep"):
+            engine.sweep(k0)
+        else:
+            engine.attempt(k0)
         print(f"# warmup(compile+run)={time.perf_counter() - t0:.2f}s", file=sys.stderr)
 
     t0 = time.perf_counter()

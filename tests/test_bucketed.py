@@ -12,10 +12,13 @@ from dgc_tpu.ops.validate import validate_coloring
 
 
 def test_bucket_widths():
-    assert _bucket_widths(32) == [8, 16, 32]
-    assert _bucket_widths(33) == [8, 16, 32, 64]
-    assert _bucket_widths(5) == [8]
-    assert _bucket_widths(8) == [8]
+    # linear min_width steps below linear_until, then doubling
+    assert _bucket_widths(16) == [4, 8, 12, 16]
+    assert _bucket_widths(17) == [4, 8, 12, 16, 20]
+    assert _bucket_widths(3) == [4]
+    assert _bucket_widths(300) == [4, 8, 12, 16, 20, 24, 28, 32, 36, 40,
+                                   44, 48, 52, 56, 60, 64, 128, 256, 512]
+    assert _bucket_widths(64, min_width=8) == [8, 16, 24, 32, 40, 48, 56, 64]
 
 
 def test_bucketed_valid_and_parity(small_graphs):
@@ -51,20 +54,19 @@ def test_bucketed_heavy_tail():
     assert validate_coloring(g.indptr, g.indices, res.colors).valid
 
 
-def test_bucketed_adaptive_plane_cap():
-    # complete graph K40 needs 40 colors; a 32-color plane cap must
-    # transparently double instead of stalling or failing
+def test_bucketed_color_windows():
+    # complete graph K40 needs 40 colors; the per-bucket color window
+    # (width+1 budget, pigeonhole-exact) must cover it with no retry
     v = 40
     edges = np.array([[i, j] for i in range(v) for j in range(i + 1, v)])
     from dgc_tpu.models.arrays import GraphArrays
 
     g = GraphArrays.from_edge_list(v, edges)
-    eng = BucketedELLEngine(g, max_colors_hint=32)
-    assert eng.num_planes == 1
+    eng = BucketedELLEngine(g)
     res = eng.attempt(g.max_degree + 1)
     assert res.status == AttemptStatus.SUCCESS
     assert res.colors_used == 40
-    assert eng.num_planes == 2  # doubled during the retry
+    assert res.colors.min() == 0 and res.colors.max() == 39
 
 
 def test_bucketed_isolated_vertices():
@@ -74,3 +76,20 @@ def test_bucketed_isolated_vertices():
     res = BucketedELLEngine(g).attempt(2)
     assert res.status == AttemptStatus.SUCCESS
     assert res.colors[0] == 0 and res.colors[3] == 0
+
+
+def test_window_cap_retry_widens_on_stall():
+    # a 1-plane cap (32 colors) on K40 saturates every window -> STALL ->
+    # the retry must widen the windows and succeed (review regression)
+    v = 40
+    edges = np.array([[i, j] for i in range(v) for j in range(i + 1, v)])
+    from dgc_tpu.models.arrays import GraphArrays
+
+    g = GraphArrays.from_edge_list(v, edges)
+    eng = BucketedELLEngine(g, max_window_planes=1)
+    assert any(32 * p < cb.shape[1] + 1
+               for cb, p in zip(eng.combined_buckets, eng.planes))
+    res = eng.attempt(g.max_degree + 1)
+    assert res.status == AttemptStatus.SUCCESS
+    assert res.colors_used == 40
+    assert eng._window_cap > 1  # widened during the retry

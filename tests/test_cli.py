@@ -92,3 +92,19 @@ def test_cli_compat_failed_output(tiny_graph_json, tmp_path):
     # pre-failure state of that attempt (may contain −1 / be partial)
     colors = Graph.load_coloring(out)
     assert len(colors) == 10
+
+
+def test_bundled_examples_are_valid():
+    # the repo's example artifacts (examples/) must stay loadable and the
+    # coloring valid — unlike the reference's bundled colors.json, which is
+    # an invalid partial (SURVEY §2.7)
+    from pathlib import Path
+
+    from dgc_tpu.models.graph import Graph
+    from dgc_tpu.ops.validate import validate_coloring
+
+    root = Path(__file__).resolve().parent.parent / "examples"
+    g = Graph.deserialize(root / "graph.json")
+    c = Graph.load_coloring(root / "colors.json")
+    val = validate_coloring(g.arrays.indptr, g.arrays.indices, c)
+    assert val.valid

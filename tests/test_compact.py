@@ -78,7 +78,8 @@ def test_compact_heavy_tail():
 
 def test_compact_heavy_tail_falls_back_to_bucketed_schedule():
     # max_degree above FLAT_WIDTH_CAP must not allocate the [V+1, Δ] flat
-    # table (O(V·Δ) blowup on power-law graphs) — pure bucketed schedule
+    # table (O(V·Δ) blowup on power-law graphs) — pure bucketed schedule;
+    # the per-bucket windows make the full k0 = Δ+1 budget workable directly
     g = generate_rmat_graph(1 << 15, avg_degree=4, seed=5, native=False)
     if g.max_degree <= CompactFrontierEngine.FLAT_WIDTH_CAP:
         import pytest
@@ -87,22 +88,20 @@ def test_compact_heavy_tail_falls_back_to_bucketed_schedule():
     eng = CompactFrontierEngine(g)
     assert eng.stages == ((None, 0),)
     assert eng.combined_flat_ext is None
-    res = eng.attempt(min(g.max_degree + 1, 32 * eng.num_planes))
+    res = eng.attempt(g.max_degree + 1)
     assert res.status == AttemptStatus.SUCCESS
+    assert validate_coloring(g.indptr, g.indices, res.colors).valid
 
 
-def test_compact_adaptive_plane_cap():
-    # K40 with a 32-color cap: the retry loop must also work in the
-    # compacted phase (the stall is detected there)
+def test_compact_color_windows_complete_graph():
+    # K40 needs 40 colors; compacted stages must honor the color windows
     v = 40
     edges = np.array([[i, j] for i in range(v) for j in range(i + 1, v)])
     g = GraphArrays.from_edge_list(v, edges)
-    eng = _forced_compact(g, max_colors_hint=32)
-    assert eng.num_planes == 1
+    eng = _forced_compact(g)
     res = eng.attempt(g.max_degree + 1)
     assert res.status == AttemptStatus.SUCCESS
     assert res.colors_used == 40
-    assert eng.num_planes == 2
 
 
 def test_compact_disconnected_components():
@@ -182,15 +181,14 @@ def test_sweep_single_color_graph():
     assert res.minimal_colors == 1
 
 
-def test_sweep_plane_cap_retry():
+def test_sweep_complete_graph():
     v = 40
     edges = np.array([[i, j] for i in range(v) for j in range(i + 1, v)])
     g = GraphArrays.from_edge_list(v, edges)
-    eng = _forced_compact(g, max_colors_hint=32)
+    eng = _forced_compact(g)
     first, second = eng.sweep(g.max_degree + 1)
     assert first.status == AttemptStatus.SUCCESS and first.colors_used == 40
-    assert second.status == AttemptStatus.FAILURE
-    assert eng.num_planes == 2
+    assert second.status == AttemptStatus.FAILURE and second.k == 39
 
 
 def test_fused_sweep_respects_k_min(medium_graph):
@@ -201,3 +199,29 @@ def test_fused_sweep_respects_k_min(medium_graph):
     assert all(a.k >= 3 for a in res.attempts)
     ref = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1, k_min=3)
     assert [a.k for a in res.attempts] == [a.k for a in ref.attempts]
+
+
+def test_compact_flat_stage_covers_capped_windows():
+    # with capped bucket windows, the flat compaction stage (planes sized to
+    # Δ+1, not capped) still finishes K40 without any widening retry
+    v = 40
+    edges = np.array([[i, j] for i in range(v) for j in range(i + 1, v)])
+    g = GraphArrays.from_edge_list(v, edges)
+    eng = _forced_compact(g, max_window_planes=1)
+    first, second = eng.sweep(g.max_degree + 1)
+    assert first.status == AttemptStatus.SUCCESS and first.colors_used == 40
+    assert second.status == AttemptStatus.FAILURE
+    assert eng._window_cap == 1  # flat stage finished the job; no retry
+
+
+def test_compact_window_cap_retry_bucketed_schedule():
+    # heavy-tail fallback schedule (no flat stage): capped windows must
+    # widen on STALL, like the bucketed engine (review regression)
+    v = 40
+    edges = np.array([[i, j] for i in range(v) for j in range(i + 1, v)])
+    g = GraphArrays.from_edge_list(v, edges)
+    eng = CompactFrontierEngine(g, stages=((None, 0),), max_window_planes=1)
+    first, second = eng.sweep(g.max_degree + 1)
+    assert first.status == AttemptStatus.SUCCESS and first.colors_used == 40
+    assert second.status == AttemptStatus.FAILURE
+    assert eng._window_cap > 1

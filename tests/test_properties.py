@@ -1,0 +1,120 @@
+"""Property-based tests (Hypothesis) — the invariants every engine must hold
+on arbitrary graphs, not just the fixture ensemble (SURVEY.md §4/§7.2: the
+reference has no tests; these pin the behavioral contract instead).
+
+Invariants:
+
+1. **Validity**: any SUCCESS attempt yields a proper coloring (no −1, no
+   equal-colored edge) using ≤ k colors.
+2. **Monotone k**: if k succeeds, every k' > k succeeds; if k fails, every
+   k' < k fails (first-fit candidates don't depend on the budget except
+   through failure).
+3. **Determinism**: same graph → same coloring, across engine instances.
+4. **Engine agreement**: bucketed and compact are bit-identical; ELL/dense
+   agree with each other; all stay within the ±1 color-count contract.
+5. **Progress**: attempts terminate with a decisive status on every input,
+   including disconnected graphs — the case that deadlocks the reference
+   baseline engine (SURVEY §2.4.1).
+"""
+
+import numpy as np
+from hypothesis import given, settings, strategies as st
+
+from dgc_tpu.engine.base import AttemptStatus
+from dgc_tpu.engine.bucketed import BucketedELLEngine
+from dgc_tpu.engine.compact import CompactFrontierEngine, _pow2_ceil
+from dgc_tpu.engine.minimal_k import find_minimal_coloring
+from dgc_tpu.engine.oracle import OracleEngine
+from dgc_tpu.engine.superstep import ELLEngine
+from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.ops.validate import validate_coloring
+
+# keep graphs small: every example builds jit caches only for shapes already
+# compiled (V padded via ELL) — runtime stays seconds, not minutes
+MAX_V = 24
+
+
+@st.composite
+def graphs(draw):
+    v = draw(st.integers(min_value=1, max_value=MAX_V))
+    if v == 1:
+        return GraphArrays.from_neighbor_lists([[]])
+    density = draw(st.floats(min_value=0.0, max_value=0.6))
+    bits = draw(st.lists(
+        st.floats(min_value=0.0, max_value=1.0),
+        min_size=v * (v - 1) // 2, max_size=v * (v - 1) // 2))
+    edges = []
+    t = 0
+    for i in range(v):
+        for j in range(i + 1, v):
+            if bits[t] < density:
+                edges.append((i, j))
+            t += 1
+    if not edges:
+        return GraphArrays.from_neighbor_lists([[] for _ in range(v)])
+    return GraphArrays.from_edge_list(v, np.array(edges))
+
+
+def _compact(g):
+    v = g.num_vertices
+    t0, t1 = max(v // 2, 1), max(v // 8, 1)
+    return CompactFrontierEngine(
+        g, stages=((None, t0), (_pow2_ceil(t0), t1), (_pow2_ceil(t1), 0)))
+
+
+@settings(max_examples=40, deadline=None)
+@given(graphs())
+def test_success_is_valid_and_within_budget(g):
+    k0 = g.max_degree + 1
+    res = BucketedELLEngine(g).attempt(k0)
+    assert res.status == AttemptStatus.SUCCESS  # Δ+1 always colorable (greedy)
+    val = validate_coloring(g.indptr, g.indices, res.colors)
+    assert val.valid
+    assert res.colors_used <= k0
+
+
+@settings(max_examples=25, deadline=None)
+@given(graphs(), st.integers(min_value=1, max_value=6))
+def test_k_monotonicity(g, k):
+    eng = BucketedELLEngine(g)
+    res = eng.attempt(k)
+    if res.status == AttemptStatus.SUCCESS:
+        up = eng.attempt(min(k + 2, g.max_degree + 1) if g.max_degree + 1 > k else k)
+        assert up.status == AttemptStatus.SUCCESS
+    else:
+        down = eng.attempt(max(k - 1, 1))
+        if k > 1:
+            assert down.status == AttemptStatus.FAILURE
+
+
+@settings(max_examples=25, deadline=None)
+@given(graphs())
+def test_determinism_and_engine_agreement(g):
+    k0 = g.max_degree + 1
+    a = BucketedELLEngine(g).attempt(k0)
+    b = BucketedELLEngine(g).attempt(k0)
+    assert np.array_equal(a.colors, b.colors)
+    c = _compact(g).attempt(k0)
+    assert np.array_equal(a.colors, c.colors)  # bit-identical contract
+    e = ELLEngine(g).attempt(k0)
+    val = validate_coloring(g.indptr, g.indices, e.colors)
+    assert val.valid
+    # ±1 color-count contract across relabeled vs original priority order
+    assert abs(e.colors_used - a.colors_used) <= 1
+
+
+@settings(max_examples=15, deadline=None)
+@given(graphs())
+def test_minimal_sweep_bracket(g):
+    # minimal count from the sweep must be a valid coloring AND k-1 must fail
+    k0 = g.max_degree + 1
+    eng = BucketedELLEngine(g)
+    res = find_minimal_coloring(eng, k0)
+    assert res.minimal_colors is not None
+    assert validate_coloring(g.indptr, g.indices, res.colors).valid
+    # oracle (sequential greedy) never does better than chromatic number;
+    # engines must be within +1 of the oracle's greedy count
+    o = find_minimal_coloring(OracleEngine(g), k0)
+    assert abs(res.minimal_colors - o.minimal_colors) <= 1
+    if res.minimal_colors > 1:
+        assert eng.attempt(res.minimal_colors - 1).status == AttemptStatus.FAILURE
