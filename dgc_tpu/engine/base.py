@@ -51,6 +51,28 @@ class ColoringEngine(Protocol):
     def attempt(self, k: int) -> AttemptResult: ...
 
 
+def clamp_budget(k: int, capacity: int) -> int:
+    """Clamp an oversized color budget to the engine's static capacity.
+
+    Exactness argument (shared by every fixed-capacity engine): capacity is
+    sized ≥ Δ+1, first-fit candidates don't depend on k, and by pigeonhole a
+    vertex with ≤ Δ forbidden colors can never fail once k > Δ — so any
+    k ≥ capacity behaves identically to k = capacity.
+    """
+    return min(int(k), capacity)
+
+
+def empty_budget_failure(num_vertices: int, k: int) -> AttemptResult:
+    """The k < 1 attempt: nothing can be colored — immediate FAILURE with an
+    all-uncolored vector (reference sentinel −3 on every vertex). Engines
+    whose reset pass pre-confirms isolated vertices to color 0 must take
+    this path instead of running the kernel, or an all-isolated graph would
+    claim SUCCESS against an empty budget."""
+    return AttemptResult(
+        AttemptStatus.FAILURE, np.full(num_vertices, -1, np.int32), 0, int(k)
+    )
+
+
 @dataclass
 class SuperstepTrace:
     """Per-superstep metrics (the reference prints uncolored counts per

@@ -224,7 +224,9 @@ def bucketed_superstep(packed, combined_buckets, k, planes: tuple):
     return jnp.concatenate(new_parts), sum(fail_parts), sum(active_parts)
 
 
-@partial(jax.jit, static_argnames=("planes", "stall_window"))
+@partial(jax.jit, static_argnames=("planes", "stall_window"),
+         donate_argnums=(2,))  # carry_in is consumed: chain in-place, no
+                               # double-buffered [V] state across chunks
 def _attempt_kernel_bucketed(combined_buckets, degrees, carry_in, k,
                              nsteps, planes: tuple, stall_window: int = 64):
     """Run up to ``nsteps`` (dynamic) supersteps from ``carry_in`` and return

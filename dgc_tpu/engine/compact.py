@@ -298,6 +298,11 @@ class CompactFrontierEngine(BucketedELLEngine):
             # matches attempt(0): trivial FAILURE, nothing colored
             second = self._finish(np.full(v, -1, np.int32),
                                   AttemptStatus.FAILURE, 0, k2)
+        elif AttemptStatus(int(status2)) == AttemptStatus.STALLED:
+            # a capped hub-bucket window can starve the confirm attempt;
+            # attempt() owns the widen-and-retry loop, so falling back to it
+            # preserves the bit-identical-to-two-attempt-calls contract
+            second = self.attempt(k2)
         else:
             second = self._finish(np.asarray(pe2)[:v],
                                   AttemptStatus(int(status2)), int(steps2), k2)

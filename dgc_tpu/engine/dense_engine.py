@@ -26,7 +26,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.engine.base import (
+    AttemptResult,
+    AttemptStatus,
+    clamp_budget,
+    empty_budget_failure,
+)
 from dgc_tpu.models.arrays import GraphArrays
 
 _RUNNING = AttemptStatus.RUNNING
@@ -108,10 +113,11 @@ class DenseEngine:
         self.max_steps = max_steps if max_steps is not None else v + 2
 
     def attempt(self, k: int) -> AttemptResult:
-        if k > self.kmax:
-            raise ValueError(f"k={k} exceeds one-hot capacity {self.kmax}")
+        if k < 1:
+            return empty_budget_failure(self.arrays.num_vertices, k)
+        k_eff = clamp_budget(k, self.kmax)
         status, colors, steps = _attempt_kernel_dense(
-            self.adj, self.degrees, k, kmax=self.kmax, max_steps=self.max_steps
+            self.adj, self.degrees, k_eff, kmax=self.kmax, max_steps=self.max_steps
         )
         return AttemptResult(
             AttemptStatus(int(status)), np.asarray(colors), int(steps), int(k)

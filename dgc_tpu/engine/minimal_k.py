@@ -86,6 +86,12 @@ def find_minimal_coloring(
         for res in pair:
             if res is None:
                 continue
+            if fused and res.k < k_min:
+                # sweep() fabricates the confirm attempt even below the floor
+                # (e.g. k=0 after a 1-color success); the per-attempt loop
+                # never makes that attempt, so drop it for identical
+                # attempt/callback sequences in both modes
+                continue
             result.attempts.append(res)
             val = None
             if res.success:

@@ -32,7 +32,12 @@ import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
-from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.engine.base import (
+    AttemptResult,
+    AttemptStatus,
+    clamp_budget,
+    empty_budget_failure,
+)
 from dgc_tpu.engine.bucketed import status_step
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import num_planes_for
@@ -189,9 +194,10 @@ class RingHaloEngine:
         self._kernel = jax.jit(sm)
 
     def attempt(self, k: int) -> AttemptResult:
-        if k > 32 * self.num_planes:
-            raise ValueError(f"k={k} exceeds plane capacity {32 * self.num_planes}")
-        colors, steps, status = self._kernel(self.deg_l, self.tables, self.beats, k)
+        if k < 1:
+            return empty_budget_failure(self.v_true, k)
+        k_eff = clamp_budget(k, 32 * self.num_planes)
+        colors, steps, status = self._kernel(self.deg_l, self.tables, self.beats, k_eff)
         return AttemptResult(
             AttemptStatus(int(status)),
             np.asarray(colors)[: self.v_true],

@@ -44,7 +44,12 @@ import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.engine.base import (
+    AttemptResult,
+    AttemptStatus,
+    clamp_budget,
+    empty_budget_failure,
+)
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import num_planes_for
 from dgc_tpu.ops.speculative import beats_rule, speculative_update
@@ -126,12 +131,11 @@ class ELLEngine:
         self.max_steps = max_steps if max_steps is not None else 2 * v + 4
 
     def attempt(self, k: int) -> AttemptResult:
-        if k > 32 * self.num_planes:
-            # plane budget is sized for k0 = Δ+1; larger k trivially succeeds
-            # with the same coloring as k0, but keep the contract strict.
-            raise ValueError(f"k={k} exceeds plane capacity {32 * self.num_planes}")
+        if k < 1:
+            return empty_budget_failure(self.arrays.num_vertices, k)
+        k_eff = clamp_budget(k, 32 * self.num_planes)
         status, colors, steps = _attempt_kernel(
-            self.nbrs, self.degrees, k, num_planes=self.num_planes, max_steps=self.max_steps
+            self.nbrs, self.degrees, k_eff, num_planes=self.num_planes, max_steps=self.max_steps
         )
         return AttemptResult(
             AttemptStatus(int(status)), np.asarray(colors), int(steps), int(k)

@@ -48,6 +48,7 @@ struct SplitMix64 {
   int64_t below(int64_t n) {
     return (int64_t)(((__uint128_t)next() * (uint64_t)n) >> 64);
   }
+  double uniform() { return (double)(next() >> 11) * 0x1.0p-53; }
 };
 
 // LSB-radix sort of (u64 key, u32 payload) pairs, 4 x 16-bit passes —
@@ -111,7 +112,9 @@ void dedup_edges(int64_t v, std::vector<std::pair<int32_t, int32_t>>& edges) {
     uint64_t lo = std::min(e.first, e.second), hi = std::max(e.first, e.second);
     keyed.emplace_back(lo * (uint64_t)v + hi, (uint32_t)i);
   }
-  std::sort(keyed.begin(), keyed.end());
+  // radix is stable, so equal keys stay in position order — same result as
+  // std::sort on (key, pos) pairs, ~4x faster at 10^8 edges
+  radix_sort_keyed(keyed);
   std::vector<uint32_t> keep_pos;
   keep_pos.reserve(keyed.size());
   for (size_t i = 0; i < keyed.size(); ++i) {
@@ -160,13 +163,13 @@ extern "C" {
 void* dgc_generate_fast(int64_t node_count, double avg_degree, uint64_t seed,
                         int32_t max_degree) {
   DGC_GUARD_BEGIN
-  std::mt19937_64 rng(seed);
-  std::uniform_int_distribution<int64_t> pick(0, node_count - 1);
+  SplitMix64 rng(seed);
   int64_t m = (int64_t)(node_count * avg_degree / 2.0);
   std::vector<std::pair<int32_t, int32_t>> edges;
   edges.reserve(m);
   for (int64_t i = 0; i < m; ++i)
-    edges.emplace_back((int32_t)pick(rng), (int32_t)pick(rng));
+    edges.emplace_back((int32_t)rng.below(node_count),
+                       (int32_t)rng.below(node_count));
   dedup_edges(node_count, edges);
   if (max_degree >= 0) greedy_cap(node_count, edges, max_degree);
   return new DgcGraph(build_csr(node_count, edges));
@@ -215,8 +218,7 @@ void* dgc_generate_reference(int64_t node_count, int32_t max_degree, uint64_t se
 void* dgc_generate_rmat(int64_t node_count, double avg_degree, uint64_t seed,
                         double a, double b, double c, int32_t max_degree) {
   DGC_GUARD_BEGIN
-  std::mt19937_64 rng(seed);
-  std::uniform_real_distribution<double> unif(0.0, 1.0);
+  SplitMix64 rng(seed);
   int scale = 1;
   while ((1L << scale) < node_count) ++scale;
   int64_t m = (int64_t)(node_count * avg_degree / 2.0);
@@ -229,11 +231,11 @@ void* dgc_generate_rmat(int64_t node_count, double avg_degree, uint64_t seed,
   for (int64_t i = 0; i < m; ++i) {
     int64_t src = 0, dst = 0;
     for (int s = 0; s < scale; ++s) {
-      double r = unif(rng);
+      double r = rng.uniform();
       bool bottom = r >= ab;
       src = src * 2 + (bottom ? 1 : 0);
       double pr = bottom ? right_bot : right_top;
-      dst = dst * 2 + (unif(rng) < pr ? 1 : 0);
+      dst = dst * 2 + (rng.uniform() < pr ? 1 : 0);
     }
     edges.emplace_back((int32_t)(src % node_count), (int32_t)(dst % node_count));
   }

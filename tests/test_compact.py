@@ -214,6 +214,34 @@ def test_compact_flat_stage_covers_capped_windows():
     assert eng._window_cap == 1  # flat stage finished the job; no retry
 
 
+def test_sweep_confirm_stall_falls_back_to_attempt(medium_graph, monkeypatch):
+    # if the fused confirm attempt exits STALLED (a capped hub-bucket window
+    # can starve it), sweep() must fall back to attempt(k2) — which owns the
+    # widen-retry loop — instead of returning STALLED as-is (advisor
+    # regression: find_minimal_coloring would report used1 as minimal
+    # without proof that used1-1 fails)
+    import dgc_tpu.engine.compact as compact_mod
+
+    g = medium_graph
+    eng = _forced_compact(g)
+    orig = compact_mod._sweep_kernel_staged
+
+    def stalled_confirm(*args, **kw):
+        pe1, steps1, status1, used, pe2, steps2, _ = orig(*args, **kw)
+        return (pe1, steps1, status1, used, pe2, steps2,
+                np.int32(AttemptStatus.STALLED))
+
+    monkeypatch.setattr(compact_mod, "_sweep_kernel_staged", stalled_confirm)
+    first, second = eng.sweep(g.max_degree + 1)
+    ref = _forced_compact(g)
+    r1 = ref.attempt(g.max_degree + 1)
+    r2 = ref.attempt(r1.colors_used - 1)
+    assert first.status == r1.status
+    assert second.k == r1.colors_used - 1
+    assert second.status == r2.status
+    assert np.array_equal(second.colors, r2.colors)
+
+
 def test_compact_window_cap_retry_bucketed_schedule():
     # heavy-tail fallback schedule (no flat stage): capped windows must
     # widen on STALL, like the bucketed engine (review regression)

@@ -104,6 +104,36 @@ def test_determinism_and_engine_agreement(g):
 
 
 @settings(max_examples=15, deadline=None)
+@given(graphs(), st.integers(min_value=0, max_value=500))
+def test_arbitrary_k_is_graceful(g, k):
+    # any user-supplied budget must produce a decisive status on every
+    # engine — including k far beyond the plane/one-hot capacity, which is
+    # clamped exactly (past Δ failure is impossible and first-fit candidates
+    # don't depend on k, so an oversized budget must reproduce the k0 = Δ+1
+    # coloring bit-for-bit; this was a ValueError before) — and k_min floors
+    # above capacity in the outer loop
+    from dgc_tpu.engine.dense_engine import DenseEngine
+
+    k0 = g.max_degree + 1
+    for eng in (BucketedELLEngine(g), ELLEngine(g), DenseEngine(g), _compact(g)):
+        res = eng.attempt(k)
+        assert res.status in (AttemptStatus.SUCCESS, AttemptStatus.FAILURE)
+        assert res.k == k
+        if k < 1:  # empty budget: FAILURE on every engine, even all-isolated
+            assert res.status == AttemptStatus.FAILURE
+            assert (res.colors == -1).all()
+        if res.status == AttemptStatus.SUCCESS:
+            assert validate_coloring(g.indptr, g.indices, res.colors).valid
+            assert res.colors_used <= min(k, k0)
+        if k > k0:  # oversized budget ≡ the k0 attempt, exactly
+            assert res.status == AttemptStatus.SUCCESS
+            assert np.array_equal(res.colors, eng.attempt(k0).colors)
+    res = find_minimal_coloring(ELLEngine(g), initial_k=k,
+                                k_min=max(1, k - 2), strict_decrement=True)
+    assert all(a.k >= max(1, k - 2) for a in res.attempts)
+
+
+@settings(max_examples=15, deadline=None)
 @given(graphs())
 def test_minimal_sweep_bracket(g):
     # minimal count from the sweep must be a valid coloring AND k-1 must fail

@@ -51,6 +51,22 @@ def test_sharded_disconnected_progress():
     assert validate_coloring(g.indptr, g.indices, res.colors).valid
 
 
+def test_sharded_oversized_k_is_graceful():
+    # k beyond the plane capacity (32·planes ≥ Δ+1) must not raise: a budget
+    # past Δ can't fail and doesn't change first-fit candidates, so the
+    # engines clamp it exactly (review regression: this was a ValueError)
+    from dgc_tpu.engine.ring import RingHaloEngine
+
+    g = generate_random_graph(64, 6, seed=3)
+    big_k = 32 * ShardedELLEngine(g, num_shards=4).num_planes + 77
+    ref = ELLEngine(g).attempt(g.max_degree + 1)
+    for eng in (ShardedELLEngine(g, num_shards=4), RingHaloEngine(g, num_shards=4)):
+        res = eng.attempt(big_k)
+        assert res.status == AttemptStatus.SUCCESS
+        assert res.k == big_k  # reports the requested budget
+        assert np.array_equal(res.colors, ref.colors)
+
+
 def test_sharded_uses_requested_mesh():
     assert jax.local_device_count() >= 8
     eng = ShardedELLEngine(generate_random_graph(40, 4, seed=0), num_shards=4)
