@@ -33,7 +33,8 @@ def main() -> int:
     p.add_argument("--nodes", type=int, default=1_000_000)
     p.add_argument("--avg-degree", type=float, default=16.0)
     p.add_argument("--max-degree", type=int, default=None)
-    p.add_argument("--backend", choices=["ell", "ell-bucketed", "ell-compact", "sharded", "sharded-ring"],
+    p.add_argument("--backend", choices=["ell", "ell-bucketed", "ell-compact", "sharded",
+                                         "sharded-bucketed", "sharded-ring"],
                    default="ell-compact")
     p.add_argument("--gen", choices=["fast", "rmat"], default="fast",
                    help="graph family: uniform random or power-law RMAT")
@@ -71,6 +72,10 @@ def main() -> int:
             from dgc_tpu.engine.sharded import ShardedELLEngine
 
             return ShardedELLEngine(arrays)
+        if args.backend == "sharded-bucketed":
+            from dgc_tpu.engine.sharded_bucketed import ShardedBucketedEngine
+
+            return ShardedBucketedEngine(arrays)
         if args.backend == "sharded-ring":
             from dgc_tpu.engine.ring import RingHaloEngine
 

@@ -39,7 +39,8 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--backend",
         choices=["ell", "ell-bucketed", "ell-compact", "dense", "sharded",
-                 "sharded-ring", "reference-sim", "oracle", "spark"],
+                 "sharded-bucketed", "sharded-ring", "reference-sim", "oracle",
+                 "spark"],
         default="ell",
         help="coloring engine (default: ell — single-device jit'd ELL kernel)",
     )
@@ -70,7 +71,7 @@ def build_parser() -> argparse.ArgumentParser:
 
 def make_engine(args, graph: Graph, logger=None):
     arrays = graph.arrays
-    if args.backend in ("sharded", "sharded-ring"):
+    if args.backend in ("sharded", "sharded-bucketed", "sharded-ring"):
         # multi-host: no-op single-process; spans the pod when configured
         from dgc_tpu.parallel.multihost import initialize_multihost, process_info
 
@@ -92,6 +93,9 @@ def make_engine(args, graph: Graph, logger=None):
     if args.backend == "sharded":
         from dgc_tpu.engine.sharded import ShardedELLEngine
         return ShardedELLEngine(arrays, num_shards=args.shards)
+    if args.backend == "sharded-bucketed":
+        from dgc_tpu.engine.sharded_bucketed import ShardedBucketedEngine
+        return ShardedBucketedEngine(arrays, num_shards=args.shards)
     if args.backend == "sharded-ring":
         from dgc_tpu.engine.ring import RingHaloEngine
         return RingHaloEngine(arrays, num_shards=args.shards)

@@ -9,7 +9,10 @@
 - ``dense_engine``: dense-adjacency MXU engine for small V.
 - ``bucketed``: degree-bucketed gather-volume-optimized engine.
 - ``compact``: bucketed dense phase + frontier-compacted tail (flagship).
-- ``sharded``: ``shard_map`` multi-device engine.
+- ``sharded``: ``shard_map`` multi-device engine (flat ELL).
+- ``sharded_bucketed``: degree-bucketed, color-windowed multi-device engine
+  (the power-law/RMAT-capable sharded path).
+- ``ring``: ``ppermute`` ring-halo multi-device engine (O(V/n) state/chip).
 - ``minimal_k``: the driver-side outer loop shared by all engines
   (reference ``coloring.py:215-235``).
 """

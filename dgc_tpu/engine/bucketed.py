@@ -198,10 +198,19 @@ def bucket_planes(combined_buckets, max_planes: int = MAX_WINDOW_PLANES) -> tupl
                  for cb in combined_buckets)
 
 
-def bucketed_superstep(packed, combined_buckets, k, planes: tuple):
-    """One full-table superstep over all buckets (per-bucket plane windows).
-    Returns (new_packed, fail_count, active_count)."""
-    packed_pad = jnp.concatenate([packed, jnp.array([-1], jnp.int32)])
+def bucketed_superstep(packed, combined_buckets, k, planes: tuple,
+                       packed_src=None):
+    """One superstep over all buckets (per-bucket plane windows). Returns
+    (new_packed, fail_count, active_count); fail/active counts are sums over
+    the rows of ``combined_buckets`` only.
+
+    ``packed_src`` is the state vector the neighbor-id tables index into —
+    defaults to ``packed`` (single-device: tables hold local ids). Sharded
+    engines pass the all-gathered global state while ``packed`` stays the
+    shard's local block whose rows align with the (local) table rows.
+    """
+    src = packed if packed_src is None else packed_src
+    packed_pad = jnp.concatenate([src, jnp.array([-1], jnp.int32)])
     new_parts, fail_parts, active_parts = [], [], []
     row0 = 0
     for cb, p_b in zip(combined_buckets, planes):

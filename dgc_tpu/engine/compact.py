@@ -52,6 +52,7 @@ import jax.numpy as jnp
 import numpy as np
 
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.engine.fused import finish_sweep_pair
 from dgc_tpu.engine.bucketed import (
     BucketedELLEngine,
     bucket_planes,
@@ -291,19 +292,9 @@ class CompactFrontierEngine(BucketedELLEngine):
                 continue
             break
         first = self._finish(np.asarray(pe1)[:v], status1, int(steps1), int(k0))
-        if status1 != AttemptStatus.SUCCESS:
-            return first, None
-        k2 = int(used) - 1
-        if k2 < 1:
-            # matches attempt(0): trivial FAILURE, nothing colored
-            second = self._finish(np.full(v, -1, np.int32),
-                                  AttemptStatus.FAILURE, 0, k2)
-        elif AttemptStatus(int(status2)) == AttemptStatus.STALLED:
-            # a capped hub-bucket window can starve the confirm attempt;
-            # attempt() owns the widen-and-retry loop, so falling back to it
-            # preserves the bit-identical-to-two-attempt-calls contract
-            second = self.attempt(k2)
-        else:
-            second = self._finish(np.asarray(pe2)[:v],
-                                  AttemptStatus(int(status2)), int(steps2), k2)
-        return first, second
+        return finish_sweep_pair(
+            first, used, status2,
+            lambda k2: self._finish(np.asarray(pe2)[:v],
+                                    AttemptStatus(int(status2)), int(steps2), k2),
+            v, self.attempt,
+        )

@@ -1,0 +1,73 @@
+"""Shared fused jump-mode sweep machinery for the sharded engines.
+
+Jump mode needs two k-attempts per minimal-k sweep: find ``u`` at k0, then
+confirm ``u − 1`` fails (``engine.minimal_k``). Fusing the pair into one
+device call saves a dispatch round-trip (~65 ms on TPU, PERF.md). The
+device-side pair and the host-side epilogue live here once so the
+"bit-identical to two ``attempt`` calls" contract is single-sourced across
+``sharded``/``ring``/``sharded_bucketed`` (``compact`` keeps its own
+single-device variant — no collective ``used`` reduction there).
+"""
+
+from __future__ import annotations
+
+from typing import Callable
+
+import jax
+import jax.numpy as jnp
+
+from dgc_tpu.engine.base import AttemptResult, AttemptStatus, empty_budget_failure
+
+_SUCCESS = AttemptStatus.SUCCESS
+_FAILURE = AttemptStatus.FAILURE
+
+
+def device_sweep_pair(attempt_fn: Callable, k0, axis: str):
+    """Trace the fused pair inside a shard_map body.
+
+    ``attempt_fn(k) -> (colors_l, steps, status)`` is the engine's per-shard
+    k-attempt. Returns ``(colors1_l, steps1, status1, used, colors2_l,
+    steps2, status2)``; ``used`` is shard-invariant (``pmax`` over ``axis``),
+    so the ``cond`` control flow cannot diverge across shards. The second
+    triple echoes a skipped confirm as (colors1, 0, FAILURE) — the host
+    epilogue replaces it.
+    """
+    colors1_l, steps1, status1 = attempt_fn(k0)
+    used = jax.lax.pmax(jnp.max(colors1_l, initial=-1), axis) + 1
+    k2 = used - 1
+
+    def second(_):
+        return attempt_fn(k2)
+
+    def skip(_):
+        return colors1_l, jnp.int32(0), jnp.int32(_FAILURE)
+
+    run2 = (status1 == _SUCCESS) & (k2 >= 1)
+    colors2_l, steps2, status2 = jax.lax.cond(run2, second, skip, 0)
+    return colors1_l, steps1, status1, used, colors2_l, steps2, status2
+
+
+def finish_sweep_pair(
+    first: AttemptResult,
+    used,
+    status2,
+    finish_second: Callable[[int], AttemptResult],
+    num_vertices: int,
+    attempt: Callable[[int], AttemptResult],
+) -> tuple[AttemptResult, AttemptResult | None]:
+    """Host epilogue shared by every fused ``sweep()``.
+
+    Keeps the two-attempt contract exact: no confirm after a non-success
+    first attempt; ``k2 < 1`` is the trivial empty-budget FAILURE; a STALLED
+    confirm (a capped window can starve it) falls back to ``attempt(k2)``,
+    which owns the widen-and-retry loop; otherwise ``finish_second(k2)``
+    materializes the fused confirm result.
+    """
+    if first.status != AttemptStatus.SUCCESS:
+        return first, None
+    k2 = int(used) - 1
+    if k2 < 1:
+        return first, empty_budget_failure(num_vertices, k2)
+    if AttemptStatus(int(status2)) == AttemptStatus.STALLED:
+        return first, attempt(k2)
+    return first, finish_second(k2)

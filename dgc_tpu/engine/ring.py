@@ -38,6 +38,7 @@ from dgc_tpu.engine.base import (
     clamp_budget,
     empty_budget_failure,
 )
+from dgc_tpu.engine.fused import device_sweep_pair, finish_sweep_pair
 from dgc_tpu.engine.bucketed import status_step
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import num_planes_for
@@ -45,8 +46,6 @@ from dgc_tpu.ops.speculative import apply_update, beats_rule, neighbor_stats
 from dgc_tpu.parallel.mesh import VERTEX_AXIS, make_mesh, pad_to_multiple
 
 _RUNNING = AttemptStatus.RUNNING
-_SUCCESS = AttemptStatus.SUCCESS
-_FAILURE = AttemptStatus.FAILURE
 _STALLED = AttemptStatus.STALLED
 
 
@@ -97,16 +96,26 @@ def build_rotation_tables(arrays: GraphArrays, n: int):
     return v_pad, vl, tables, beats
 
 
-def _ring_body(deg_l, tables_l, beats_l, k,
-               num_planes: int, max_steps: int, n: int):
-    """Per-shard body under shard_map. tables_l[r]: int32[vl, W_r] block-local
-    neighbor ids for rotation r (sentinel = vl); deg_l: int32[vl]."""
+def _ring_attempt(deg_l, tables_l, beats_l, k, num_planes: int,
+                  max_degree: int, max_steps: int, n: int,
+                  stall_window: int = 64):
+    """One k-attempt on a shard. tables_l[r]: int32[vl, W_r] block-local
+    neighbor ids for rotation r (sentinel = vl); deg_l: int32[vl].
+
+    ``num_planes`` may be a *capped* color window (< Δ+1 colors) on
+    heavy-tailed graphs: neighbor colors beyond the window drop out of the
+    mask (they can never block the lowest free bit), and the failure flag is
+    suppressed unless k fits the window, so a capped window can never assert
+    a wrong FAILURE — a starved attempt exits STALLED and the engine widens
+    the window and retries (the ``bucketed`` contract)."""
     vl = deg_l.shape[0]
     k = jnp.asarray(k, jnp.int32)
     perm = [(i, (i + 1) % n) for i in range(n)]
 
     packed0_l = jnp.where(deg_l == 0, 0, -1).astype(jnp.int32)
     pshape = (vl, num_planes)
+    fail_exact = 32 * num_planes >= max_degree + 1
+    fail_valid = fail_exact | (k <= 32 * num_planes)
 
     def superstep(packed_l):
         mycol = packed_l >> 1
@@ -126,31 +135,65 @@ def _ring_body(deg_l, tables_l, beats_l, k,
         new_packed_l, fail_mask, active_mask = apply_update(
             packed_l, forb_all, forb_old, clash, k
         )
-        any_fail = jax.lax.psum(jnp.sum(fail_mask.astype(jnp.int32)), VERTEX_AXIS) > 0
+        fail_count = jax.lax.psum(jnp.sum(fail_mask.astype(jnp.int32)), VERTEX_AXIS)
+        any_fail = (fail_count > 0) & fail_valid
         active = jax.lax.psum(jnp.sum(active_mask.astype(jnp.int32)), VERTEX_AXIS)
         return new_packed_l, any_fail, active
 
     def cond(carry):
-        _, _, status = carry
+        _, _, status, _, _ = carry
         return status == _RUNNING
 
     def body(carry):
-        packed_l, step, status = carry
+        packed_l, step, status, prev_active, stall = carry
         new_packed_l, any_fail, active = superstep(packed_l)
-        # shared transition; step budget plays the stall role here
-        status = status_step(any_fail, active, step + 1, max_steps)
+        stall = jnp.where(active < prev_active, 0, stall + 1)
+        status = status_step(any_fail, active, stall, stall_window)
+        status = jnp.where(
+            (status == _RUNNING) & (step + 1 >= max_steps), _STALLED, status
+        ).astype(jnp.int32)
         new_packed_l = jnp.where(any_fail, packed_l, new_packed_l)
-        return (new_packed_l, step + 1, status)
+        return (new_packed_l, step + 1, status, active, stall)
 
-    packed_l, steps, status = jax.lax.while_loop(
-        cond, body, (packed0_l, jnp.int32(0), jnp.int32(_RUNNING))
+    packed_l, steps, status, _, _ = jax.lax.while_loop(
+        cond, body,
+        (packed0_l, jnp.int32(0), jnp.int32(_RUNNING),
+         jnp.int32(n * vl + 1), jnp.int32(0)),
     )
     colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
     return colors_l, steps, status
 
 
+def _ring_attempt_body(deg_l, tables_l, beats_l, k, *, num_planes: int,
+                       max_degree: int, max_steps: int, n: int):
+    return _ring_attempt(deg_l, tables_l, beats_l, k, num_planes,
+                         max_degree, max_steps, n)
+
+
+def _ring_sweep_body(deg_l, tables_l, beats_l, k0, *, num_planes: int,
+                     max_degree: int, max_steps: int, n: int):
+    """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call."""
+    return device_sweep_pair(
+        lambda k: _ring_attempt(deg_l, tables_l, beats_l, k, num_planes,
+                                max_degree, max_steps, n),
+        k0, VERTEX_AXIS,
+    )
+
+
 class RingHaloEngine:
-    """Vertex-sharded engine with ppermute ring-halo color exchange."""
+    """Vertex-sharded engine with ppermute ring-halo color exchange.
+
+    The bitmask planes are a *capped color window* (``max_window_planes``,
+    default 32 planes = 1024 colors): memory and plane-unroll stay bounded
+    even when Δ+1 is five digits, and a genuinely starved attempt exits
+    STALLED and widens the window (``bucketed`` contract) instead of
+    asserting a wrong answer. Note the per-rotation neighbor *tables* are
+    still flat-width (Σ_r W_r ≈ Δ per vertex): for heavy-tailed/RMAT graphs
+    where that O(V·Δ) table is the bottleneck, use
+    ``engine.sharded_bucketed.ShardedBucketedEngine`` — this engine's niche
+    is bounded-degree graphs whose packed state outgrows per-chip
+    replication (O(V/n) state per chip vs the all-gather engines' O(V)).
+    """
 
     def __init__(
         self,
@@ -158,18 +201,20 @@ class RingHaloEngine:
         num_shards: int | None = None,
         max_steps: int | None = None,
         mesh=None,
+        max_window_planes: int = 32,
     ):
         self.arrays = arrays
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
-        n = self.mesh.shape[VERTEX_AXIS]
+        self._n = self.mesh.shape[VERTEX_AXIS]
         v = arrays.num_vertices
         self.v_true = v
-        v_pad, vl, tables, beats = build_rotation_tables(arrays, n)
+        v_pad, vl, tables, beats = build_rotation_tables(arrays, self._n)
 
         deg_p = np.zeros(v_pad, dtype=np.int32)
         deg_p[:v] = arrays.degrees
 
-        self.num_planes = num_planes_for(arrays.max_degree + 1)
+        self.num_planes = min(num_planes_for(arrays.max_degree + 1),
+                              max_window_planes)
         self.max_steps = max_steps if max_steps is not None else 2 * v_pad + 4
 
         rows = NamedSharding(self.mesh, P(VERTEX_AXIS))
@@ -177,30 +222,75 @@ class RingHaloEngine:
         self.deg_l = jax.device_put(deg_p, rows)
         self.tables = tuple(jax.device_put(t, rows2d) for t in tables)
         self.beats = tuple(jax.device_put(b, rows2d) for b in beats)
+        self._kernels = {}
 
-        body = partial(
-            _ring_body, num_planes=self.num_planes, max_steps=self.max_steps, n=n
-        )
-        sm = jax.shard_map(
-            body,
-            mesh=self.mesh,
-            in_specs=(P(VERTEX_AXIS),
-                      tuple(P(VERTEX_AXIS, None) for _ in self.tables),
-                      tuple(P(VERTEX_AXIS, None) for _ in self.beats),
-                      P()),
-            out_specs=(P(VERTEX_AXIS), P(), P()),
-            check_vma=False,
-        )
-        self._kernel = jax.jit(sm)
+    def _maybe_widen_window(self) -> bool:
+        """After STALLED: double the color window if it is capped below
+        Δ+1; returns True iff the caller should retry."""
+        full = num_planes_for(self.arrays.max_degree + 1)
+        if self.num_planes >= full:
+            return False
+        self.num_planes = min(2 * self.num_planes, full)
+        return True
+
+    def _kernel(self, body, name: str):
+        key = (name, self.num_planes)
+        if key not in self._kernels:
+            fn = partial(body, num_planes=self.num_planes,
+                         max_degree=self.arrays.max_degree,
+                         max_steps=self.max_steps, n=self._n)
+            out_one = (P(VERTEX_AXIS), P(), P())
+            sm = jax.shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=(P(VERTEX_AXIS),
+                          tuple(P(VERTEX_AXIS, None) for _ in self.tables),
+                          tuple(P(VERTEX_AXIS, None) for _ in self.beats),
+                          P()),
+                out_specs=out_one if name == "attempt"
+                else out_one + (P(),) + out_one,
+                check_vma=False,
+            )
+            self._kernels[key] = jax.jit(sm)
+        return self._kernels[key]
 
     def attempt(self, k: int) -> AttemptResult:
         if k < 1:
             return empty_budget_failure(self.v_true, k)
-        k_eff = clamp_budget(k, 32 * self.num_planes)
-        colors, steps, status = self._kernel(self.deg_l, self.tables, self.beats, k_eff)
+        while True:  # window-cap retry loop (STALLED + capped window)
+            k_eff = clamp_budget(k, 32 * num_planes_for(self.arrays.max_degree + 1))
+            kern = self._kernel(_ring_attempt_body, "attempt")
+            colors, steps, status = kern(self.deg_l, self.tables, self.beats, k_eff)
+            status = AttemptStatus(int(status))
+            if status == AttemptStatus.STALLED and self._maybe_widen_window():
+                continue
+            break
         return AttemptResult(
-            AttemptStatus(int(status)),
-            np.asarray(colors)[: self.v_true],
-            int(steps),
-            int(k),
+            status, np.asarray(colors)[: self.v_true], int(steps), int(k)
+        )
+
+    def sweep(self, k0: int) -> tuple[AttemptResult, AttemptResult | None]:
+        """Fused jump-mode pair in one device call (contract of
+        ``CompactFrontierEngine.sweep``: bit-identical to two ``attempt``
+        calls; STALLED confirm falls back to ``attempt``)."""
+        if k0 < 1:
+            return self.attempt(k0), None
+        while True:
+            k_eff = clamp_budget(k0, 32 * num_planes_for(self.arrays.max_degree + 1))
+            kern = self._kernel(_ring_sweep_body, "sweep")
+            c1, steps1, status1, used, c2, steps2, status2 = kern(
+                self.deg_l, self.tables, self.beats, k_eff
+            )
+            status1 = AttemptStatus(int(status1))
+            if status1 == AttemptStatus.STALLED and self._maybe_widen_window():
+                continue
+            break
+        first = AttemptResult(status1, np.asarray(c1)[: self.v_true],
+                              int(steps1), int(k0))
+        return finish_sweep_pair(
+            first, used, status2,
+            lambda k2: AttemptResult(AttemptStatus(int(status2)),
+                                     np.asarray(c2)[: self.v_true],
+                                     int(steps2), k2),
+            self.v_true, self.attempt,
         )

@@ -42,6 +42,7 @@ from dgc_tpu.engine.base import (
     clamp_budget,
     empty_budget_failure,
 )
+from dgc_tpu.engine.fused import device_sweep_pair, finish_sweep_pair
 from dgc_tpu.engine.bucketed import status_step
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import num_planes_for
@@ -63,13 +64,11 @@ def _shard_superstep(packed_l, nbrs_l, pre_beats, k, num_planes: int):
     return new_packed_l, any_fail, active
 
 _RUNNING = AttemptStatus.RUNNING
-_SUCCESS = AttemptStatus.SUCCESS
-_FAILURE = AttemptStatus.FAILURE
 _STALLED = AttemptStatus.STALLED
 
 
-def _shard_body(nbrs_l, deg_l, deg_g, k, num_planes: int, max_steps: int):
-    """Per-shard body under shard_map. nbrs_l: int32[Vl, W] with *global*
+def _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes: int, max_steps: int):
+    """One k-attempt on a shard. nbrs_l: int32[Vl, W] with *global*
     neighbor ids (sentinel = V_padded); deg_l: int32[Vl]; deg_g: int32[V]."""
     vl, w = nbrs_l.shape
     shard = jax.lax.axis_index(VERTEX_AXIS)
@@ -103,6 +102,20 @@ def _shard_body(nbrs_l, deg_l, deg_g, k, num_planes: int, max_steps: int):
     )
     colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
     return colors_l, steps, status
+
+
+def _flat_attempt_body(nbrs_l, deg_l, deg_g, k, *, num_planes: int,
+                       max_steps: int):
+    return _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes, max_steps)
+
+
+def _flat_sweep_body(nbrs_l, deg_l, deg_g, k0, *, num_planes: int,
+                     max_steps: int):
+    """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call."""
+    return device_sweep_pair(
+        lambda k: _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes, max_steps),
+        k0, VERTEX_AXIS,
+    )
 
 
 class ShardedELLEngine:
@@ -139,17 +152,18 @@ class ShardedELLEngine:
         self.deg_l = jax.device_put(deg_p, shard_rows)
         self.deg_g = jax.device_put(deg_p, replicated)
 
-        body = partial(
-            _shard_body, num_planes=self.num_planes, max_steps=self.max_steps
-        )
-        sm = jax.shard_map(
-            body,
-            mesh=self.mesh,
-            in_specs=(P(VERTEX_AXIS, None), P(VERTEX_AXIS), P(), P()),
-            out_specs=(P(VERTEX_AXIS), P(), P()),
-            check_vma=False,
-        )
-        self._kernel = jax.jit(sm)
+        out_one = (P(VERTEX_AXIS), P(), P())
+        in_specs = (P(VERTEX_AXIS, None), P(VERTEX_AXIS), P(), P())
+
+        def _build(body, out_specs):
+            fn = partial(body, num_planes=self.num_planes, max_steps=self.max_steps)
+            return jax.jit(jax.shard_map(
+                fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
+                check_vma=False,
+            ))
+
+        self._kernel = _build(_flat_attempt_body, out_one)
+        self._sweep_kernel = _build(_flat_sweep_body, out_one + (P(),) + out_one)
 
     def attempt(self, k: int) -> AttemptResult:
         if k < 1:
@@ -161,4 +175,24 @@ class ShardedELLEngine:
             np.asarray(colors)[: self.v_true],
             int(steps),
             int(k),
+        )
+
+    def sweep(self, k0: int) -> tuple[AttemptResult, AttemptResult | None]:
+        """Fused jump-mode pair in one device call (contract of
+        ``CompactFrontierEngine.sweep``: bit-identical to two ``attempt``
+        calls)."""
+        if k0 < 1:
+            return self.attempt(k0), None
+        k_eff = clamp_budget(k0, 32 * self.num_planes)
+        c1, steps1, status1, used, c2, steps2, status2 = self._sweep_kernel(
+            self.nbrs, self.deg_l, self.deg_g, k_eff
+        )
+        first = AttemptResult(AttemptStatus(int(status1)),
+                              np.asarray(c1)[: self.v_true], int(steps1), int(k0))
+        return finish_sweep_pair(
+            first, used, status2,
+            lambda k2: AttemptResult(AttemptStatus(int(status2)),
+                                     np.asarray(c2)[: self.v_true],
+                                     int(steps2), k2),
+            self.v_true, self.attempt,
         )

@@ -1,0 +1,282 @@
+"""Degree-bucketed sharded engine — power-law graphs on a device mesh.
+
+``ShardedELLEngine`` represents the graph as one flat ELL table of width Δ
+with a global plane budget sized to Δ+1 — untenable on power-law/RMAT
+graphs where Δ is five digits (O(V·Δ) memory, thousands of bitmask planes;
+SURVEY.md §7.3 load-balancing hard part). This engine brings the
+single-device bucketing design (``engine.bucketed``) to the ``shard_map``
+path:
+
+- **Global degree-descending relabeling** (``build_degree_buckets``) splits
+  vertices into width buckets with per-bucket combined (neighbor id +
+  priority bit) tables and per-bucket color windows (``bucket_planes``), so
+  memory is ∝ ELL entries (~Σ deg) and plane unrolls stay bounded even when
+  Δ+1 is five digits.
+- **Per-shard bucket slices**: each bucket's rows are dealt round-robin in
+  contiguous blocks across the mesh (bucket b's slice s goes to shard s),
+  so every shard owns an equal cut of *every* width class — the hub bucket
+  is spread over all chips instead of landing on shard 0, which is what
+  block-sharding the degree-sorted order would do. A second (static)
+  relabeling makes each shard's rows contiguous in the state vector, so
+  ``lax.all_gather(..., tiled=True)`` reassembles the global packed state
+  in table-id order with no permutation traffic.
+- **Exchange/reductions**: one all-gather of the packed (color, fresh)
+  int32 vector per superstep over ICI (the reference ships the full
+  id→color dict through the driver each superstep, ``coloring.py:135-137``),
+  ``lax.psum`` for the fail/active counts (reference: per-superstep
+  ``count`` actions, ``coloring.py:88,104``).
+- **Update rule**: the shared ``bucketed_superstep`` core — colors are
+  bit-identical to ``BucketedELLEngine`` at every mesh size because the
+  rule, the relabeled priority bits, and the per-superstep snapshot
+  semantics are identical; only the computation layout changes.
+
+Capped hub-bucket windows follow the bucketed engine's contract: a capped
+window can never assert a wrong FAILURE (failure flags are suppressed
+unless k fits the window), and a genuinely starved attempt exits STALLED,
+after which ``attempt``/``sweep`` widen the cap and retry
+(``BucketedELLEngine._maybe_widen_windows``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+from jax.sharding import NamedSharding, PartitionSpec as P
+
+from dgc_tpu.engine.base import AttemptResult, AttemptStatus, empty_budget_failure
+from dgc_tpu.engine.fused import device_sweep_pair, finish_sweep_pair
+from dgc_tpu.engine.bucketed import (
+    MAX_WINDOW_PLANES,
+    build_degree_buckets,
+    bucket_planes,
+    bucketed_superstep,
+    decode_combined,
+    encode_combined,
+    initial_packed,
+    status_step,
+)
+from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.parallel.mesh import VERTEX_AXIS, make_mesh, pad_to_multiple
+
+_RUNNING = AttemptStatus.RUNNING
+_STALLED = AttemptStatus.STALLED
+
+
+@dataclass
+class ShardedBucketLayout:
+    """Bucketed graph in shard-major final-id space.
+
+    ``orig_of_final[f]`` is the original vertex id of final row f (−1 for
+    bucket-padding rows); ``deg_final`` its degree (pads: 0). ``tables[b]``
+    is the bucket's combined (neighbor id | beats bit) table with neighbor
+    ids in final space (sentinel = ``v_final``), row-padded so every shard
+    owns ``slice_sizes[b]`` rows of it.
+    """
+
+    orig_of_final: np.ndarray
+    deg_final: np.ndarray
+    tables: list[np.ndarray]
+    slice_sizes: list[int]
+    v_final: int
+
+
+def build_sharded_buckets(arrays: GraphArrays, n: int,
+                          min_width: int = 4) -> ShardedBucketLayout:
+    """Deal each degree bucket's rows across ``n`` shards in contiguous
+    slices and relabel so shard s's rows (its slice of every bucket,
+    buckets in order) are the contiguous final-id range [s·V/n, (s+1)·V/n)."""
+    b = build_degree_buckets(arrays, min_width=min_width)
+    v = arrays.num_vertices
+    vb = [cb.shape[0] for cb in b.combined]
+    vb_pad = [pad_to_multiple(x, n) for x in vb]
+    slices = [x // n for x in vb_pad]
+    v_final = sum(vb_pad)
+    vl = v_final // n
+    # within-shard start offset of each bucket's slice
+    lb0 = np.concatenate([[0], np.cumsum(slices[:-1])]).astype(np.int64)
+
+    final_of_rel = np.empty(v, np.int64)
+    for bi in range(len(vb)):
+        r = np.arange(vb[bi], dtype=np.int64)
+        shard = r // slices[bi]
+        final_of_rel[b.row0[bi] + r] = shard * vl + lb0[bi] + r % slices[bi]
+
+    deg_final = np.zeros(v_final, np.int32)
+    orig_of_final = np.full(v_final, -1, np.int64)
+    deg_final[final_of_rel] = b.degrees
+    orig_of_final[final_of_rel] = b.perm
+
+    # remap neighbor ids (relabeled space, sentinel v) into final space
+    fmap = np.concatenate([final_of_rel, [v_final]]).astype(np.int32)
+    tables = []
+    for bi, cb in enumerate(b.combined):
+        nbr, beats = decode_combined(cb)
+        t = encode_combined(fmap[nbr], beats)
+        pad_rows = vb_pad[bi] - vb[bi]
+        if pad_rows:  # all-sentinel rows: degree 0, nobody references them
+            t = np.concatenate(
+                [t, np.full((pad_rows, cb.shape[1]), v_final, np.int32)]
+            )
+        # deal slices shard-major so NamedSharding(P(VERTEX_AXIS)) hands
+        # shard s exactly bucket rows [s·slice, (s+1)·slice) — already true
+        # for a contiguous row split, so no data movement needed here
+        tables.append(t)
+    return ShardedBucketLayout(
+        orig_of_final=orig_of_final, deg_final=deg_final, tables=tables,
+        slice_sizes=slices, v_final=v_final,
+    )
+
+
+def _shard_attempt(tables_l, deg_l, k, planes: tuple, max_steps: int,
+                   v_final: int, stall_window: int = 64):
+    """One k-attempt on a shard: while_loop of all-gather + shared bucketed
+    superstep + psum reductions. Returns (colors_l, steps, status)."""
+    k = jnp.asarray(k, jnp.int32)
+    carry = (initial_packed(deg_l), jnp.int32(1), jnp.int32(_RUNNING),
+             jnp.int32(v_final + 1), jnp.int32(0))
+
+    def cond(c):
+        _, _, status, _, _ = c
+        return status == _RUNNING
+
+    def body(c):
+        packed_l, step, status, prev_active, stall = c
+        packed_g = jax.lax.all_gather(packed_l, VERTEX_AXIS, tiled=True)
+        new_packed_l, fail_l, active_l = bucketed_superstep(
+            packed_l, tables_l, k, planes, packed_src=packed_g
+        )
+        fail_count = jax.lax.psum(fail_l, VERTEX_AXIS)
+        active = jax.lax.psum(active_l, VERTEX_AXIS)
+        any_fail = fail_count > 0
+        stall = jnp.where(active < prev_active, 0, stall + 1)
+        status = status_step(any_fail, active, stall, stall_window)
+        status = jnp.where(
+            (status == _RUNNING) & (step + 1 >= max_steps), _STALLED, status
+        ).astype(jnp.int32)
+        new_packed_l = jnp.where(any_fail, packed_l, new_packed_l)
+        return (new_packed_l, step + 1, status, active, stall)
+
+    packed_l, steps, status, _, _ = jax.lax.while_loop(cond, body, carry)
+    colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
+    return colors_l, steps, status
+
+
+def _shard_attempt_body(tables_l, deg_l, k, *, planes: tuple, max_steps: int,
+                        v_final: int):
+    return _shard_attempt(tables_l, deg_l, k, planes, max_steps, v_final)
+
+
+def _shard_sweep_body(tables_l, deg_l, k0, *, planes: tuple, max_steps: int,
+                      v_final: int):
+    """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call."""
+    return device_sweep_pair(
+        lambda k: _shard_attempt(tables_l, deg_l, k, planes, max_steps, v_final),
+        k0, VERTEX_AXIS,
+    )
+
+
+class ShardedBucketedEngine:
+    """Degree-bucketed, color-windowed engine over an n-device vertex mesh.
+
+    The multi-chip engine for power-law graphs: per-bucket tables keep
+    memory ∝ ELL entries and per-bucket color windows keep bitmask planes
+    bounded at any Δ (SURVEY §7.3), while colors stay bit-identical to
+    ``BucketedELLEngine`` at every mesh size.
+    """
+
+    def __init__(self, arrays: GraphArrays, num_shards: int | None = None,
+                 mesh=None, max_steps: int | None = None, min_width: int = 4,
+                 max_window_planes: int = MAX_WINDOW_PLANES):
+        self.arrays = arrays
+        self.mesh = mesh if mesh is not None else make_mesh(num_shards)
+        n = self.mesh.shape[VERTEX_AXIS]
+        v = arrays.num_vertices
+        lay = build_sharded_buckets(arrays, n, min_width=min_width)
+        self.layout = lay
+        self._window_cap = max_window_planes
+        self.planes = bucket_planes(lay.tables, max_planes=max_window_planes)
+        self.max_steps = max_steps if max_steps is not None else 2 * v + 4
+
+        rows2d = NamedSharding(self.mesh, P(VERTEX_AXIS, None))
+        self.tables = tuple(jax.device_put(t, rows2d) for t in lay.tables)
+        self.deg_l = jax.device_put(
+            lay.deg_final, NamedSharding(self.mesh, P(VERTEX_AXIS))
+        )
+        self._kernels = {}
+
+    def _maybe_widen_windows(self) -> bool:
+        """Same contract as ``BucketedELLEngine._maybe_widen_windows``:
+        after STALLED, double the hub-window cap if any bucket is capped
+        below its width; returns True iff the caller should retry."""
+        capped = any(32 * p < t.shape[1] + 1
+                     for t, p in zip(self.tables, self.planes))
+        if not capped:
+            return False
+        self._window_cap *= 2
+        self.planes = bucket_planes(self.tables, max_planes=self._window_cap)
+        return True
+
+    def _kernel(self, body, name: str):
+        key = (name, self.planes)
+        if key not in self._kernels:
+            fn = partial(body, planes=self.planes, max_steps=self.max_steps,
+                         v_final=self.layout.v_final)
+            nt = len(self.tables)
+            out_one = (P(VERTEX_AXIS), P(), P())
+            sm = jax.shard_map(
+                fn,
+                mesh=self.mesh,
+                in_specs=(tuple(P(VERTEX_AXIS, None) for _ in range(nt)),
+                          P(VERTEX_AXIS), P()),
+                out_specs=out_one if name == "attempt"
+                else out_one + (P(),) + out_one,
+                check_vma=False,
+            )
+            self._kernels[key] = jax.jit(sm)
+        return self._kernels[key]
+
+    def _finish(self, colors_final: np.ndarray, status, steps: int,
+                k: int) -> AttemptResult:
+        real = self.layout.orig_of_final >= 0
+        colors = np.empty(self.arrays.num_vertices, np.int32)
+        colors[self.layout.orig_of_final[real]] = colors_final[real]
+        return AttemptResult(status, colors, int(steps), int(k))
+
+    def attempt(self, k: int) -> AttemptResult:
+        if k < 1:
+            return empty_budget_failure(self.arrays.num_vertices, k)
+        while True:  # window-cap retry loop (STALLED + capped hub buckets)
+            kern = self._kernel(_shard_attempt_body, "attempt")
+            colors_f, steps, status = kern(self.tables, self.deg_l, k)
+            status = AttemptStatus(int(status))
+            if status == AttemptStatus.STALLED and self._maybe_widen_windows():
+                continue
+            break
+        return self._finish(np.asarray(colors_f), status, int(steps), k)
+
+    def sweep(self, k0: int) -> tuple[AttemptResult, AttemptResult | None]:
+        """Fused jump-mode pair in one device call (see
+        ``CompactFrontierEngine.sweep`` for the contract: bit-identical to
+        two ``attempt`` calls, STALLED confirm falls back to ``attempt``)."""
+        if k0 < 1:
+            return self.attempt(k0), None
+        while True:
+            kern = self._kernel(_shard_sweep_body, "sweep")
+            c1, steps1, status1, used, c2, steps2, status2 = kern(
+                self.tables, self.deg_l, k0
+            )
+            status1 = AttemptStatus(int(status1))
+            if status1 == AttemptStatus.STALLED and self._maybe_widen_windows():
+                continue
+            break
+        first = self._finish(np.asarray(c1), status1, int(steps1), k0)
+        return finish_sweep_pair(
+            first, used, status2,
+            lambda k2: self._finish(np.asarray(c2),
+                                    AttemptStatus(int(status2)), int(steps2), k2),
+            self.arrays.num_vertices, self.attempt,
+        )

@@ -96,3 +96,34 @@ def test_ring_heavy_tail():
     rs = ShardedELLEngine(g, num_shards=8).attempt(g.max_degree + 1)
     assert rr.status == AttemptStatus.SUCCESS
     assert np.array_equal(rr.colors, rs.colors)
+
+
+@needs8
+def test_ring_sweep_pair_matches_two_attempts(medium_graph):
+    g = medium_graph
+    first, second = RingHaloEngine(g, num_shards=8).sweep(g.max_degree + 1)
+    ref = RingHaloEngine(g, num_shards=8)
+    r1 = ref.attempt(g.max_degree + 1)
+    r2 = ref.attempt(r1.colors_used - 1)
+    assert first.status == r1.status and np.array_equal(first.colors, r1.colors)
+    assert second.k == r1.colors_used - 1
+    assert second.status == r2.status
+    assert np.array_equal(second.colors, r2.colors)
+
+
+@needs8
+def test_ring_capped_window_widens_on_clique():
+    # K40 with a 1-plane (32-color) window: the capped window must defer —
+    # never assert a wrong FAILURE — then STALL, widen, and finish with 40
+    # colors (advisor regression: the old global Δ+1 plane budget is what
+    # made the ring engine untenable on heavy-tailed graphs)
+    v = 40
+    edges = np.array([[i, j] for i in range(v) for j in range(i + 1, v)])
+    g = GraphArrays.from_edge_list(v, edges)
+    eng = RingHaloEngine(g, num_shards=8, max_window_planes=1)
+    res = eng.attempt(g.max_degree + 1)
+    assert res.status == AttemptStatus.SUCCESS
+    assert res.colors_used == 40
+    assert eng.num_planes > 1  # widened
+    below = eng.attempt(39)
+    assert below.status == AttemptStatus.FAILURE

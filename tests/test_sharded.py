@@ -51,6 +51,33 @@ def test_sharded_disconnected_progress():
     assert validate_coloring(g.indptr, g.indices, res.colors).valid
 
 
+def test_sharded_sweep_pair_matches_two_attempts(medium_graph):
+    g = medium_graph
+    first, second = ShardedELLEngine(g, num_shards=8).sweep(g.max_degree + 1)
+    ref = ShardedELLEngine(g, num_shards=8)
+    r1 = ref.attempt(g.max_degree + 1)
+    r2 = ref.attempt(r1.colors_used - 1)
+    assert first.status == r1.status and np.array_equal(first.colors, r1.colors)
+    assert second.k == r1.colors_used - 1
+    assert second.status == r2.status
+    assert np.array_equal(second.colors, r2.colors)
+
+
+def test_sharded_minimal_k_takes_fused_sweep(medium_graph, monkeypatch):
+    g = medium_graph
+    eng = ShardedELLEngine(g, num_shards=8)
+    calls = {"sweep": 0, "attempt": 0}
+    orig_sweep, orig_attempt = eng.sweep, eng.attempt
+    monkeypatch.setattr(eng, "sweep",
+                        lambda k: calls.__setitem__("sweep", calls["sweep"] + 1) or orig_sweep(k))
+    monkeypatch.setattr(eng, "attempt",
+                        lambda k: calls.__setitem__("attempt", calls["attempt"] + 1) or orig_attempt(k))
+    res = find_minimal_coloring(eng, g.max_degree + 1, validate=make_validator(g))
+    ref = find_minimal_coloring(ELLEngine(g), g.max_degree + 1)
+    assert res.minimal_colors == ref.minimal_colors
+    assert calls["sweep"] >= 1 and calls["attempt"] == 0
+
+
 def test_sharded_oversized_k_is_graceful():
     # k beyond the plane capacity (32·planes ≥ Δ+1) must not raise: a budget
     # past Δ can't fail and doesn't change first-fit candidates, so the
