@@ -16,25 +16,37 @@ Measured TPU rates (PERF.md) shape the design:
   jit call: a full-table phase followed by static compaction stages, with
   no host round-trips in between.
 
-The attempt kernel executes, inside one ``jax.jit``:
+Vertices are split (along the degree-descending bucket order) into a **hub
+region** — buckets whose width exceeds ``flat_cap`` or whose flat rows
+would blow the table budget — and a **flat region** (everything else; on
+bounded-degree graphs like the 1M benchmark the hub region is empty). The
+attempt kernel executes, inside one ``jax.jit``:
 
 1. **Full-table phase** — degree-bucketed supersteps (shared
-   ``bucketed_superstep``) while the frontier (uncolored ∪ fresh) exceeds
-   ``V/4``. Round 1 never runs at all: its outcome is known statically
-   (``engine.bucketed.initial_packed``).
-2. **Compaction stages** at static thresholds (V/4, V/64): the frontier is
-   compacted on-device into a padded index list (pad = threshold rounded to
-   a power of two — static shapes, one compile ever), its rows of the flat
-   combined table are row-gathered once, and supersteps gather only
-   ``A_pad × W`` neighbor states, scattering results back into the full
-   state vector.
+   ``speculative_update`` core) while the frontier (uncolored ∪ fresh)
+   exceeds the first threshold. Every bucket is wrapped in a ``lax.cond``
+   on its own live active count: an inert bucket costs *nothing*. On
+   power-law graphs the hub buckets (few rows × huge width) have the
+   highest priority, confirm in the first rounds, and drop out — which is
+   what makes heavy-tailed graphs tractable with no width cap on the
+   representation.
+2. **Compaction stages** at static thresholds: the flat region's active
+   rows are compacted on-device into one padded index list (pad =
+   pow2(stage scale) — safe: flat active ≤ global active ≤ scale), their
+   rows of the flat ``[V_flat+1, W_flat]`` combined table are row-gathered
+   once, and supersteps gather only ``A_pad × W_flat`` flat neighbor
+   states; hub buckets keep running their (cond-skipped) full-bucket
+   updates in the same superstep, so the stage is exact at any Δ — the
+   old all-or-nothing Δ > 256 fallback to the pure bucketed schedule is
+   gone.
 
-Compaction is *exact*: a confirmed vertex can never become active again
-(demotion only applies to fresh vertices, and confirm/demote both read the
-same per-superstep snapshot), so the frontier is monotone non-increasing
-and every vertex that could change state is in the compacted set. Colors
-are bit-identical to ``BucketedELLEngine`` — stages change the schedule of
-*computation*, not the update rule (``ops.speculative``) or its inputs.
+Compaction and skipping are *exact*: a confirmed vertex can never become
+active again (demotion only applies to fresh vertices, and confirm/demote
+both read the same per-superstep snapshot), so the frontier is monotone
+non-increasing per bucket and every vertex that could change state is in
+the compacted set or a live bucket. Colors are bit-identical to
+``BucketedELLEngine`` — stages change the schedule of *computation*, not
+the update rule (``ops.speculative``) or its inputs.
 
 State layout: ``packed_ext = int32[V+2]`` where slot ``V`` is the ELL
 neighbor-pad sentinel (always −1 = "no neighbor", so padding never forbids
@@ -55,8 +67,6 @@ from dgc_tpu.engine.base import AttemptResult, AttemptStatus
 from dgc_tpu.engine.fused import finish_sweep_pair
 from dgc_tpu.engine.bucketed import (
     BucketedELLEngine,
-    bucket_planes,
-    bucketed_superstep,
     decode_combined,
     encode_combined,
     initial_packed,
@@ -77,93 +87,199 @@ def _pow2_ceil(n: int) -> int:
 
 
 def default_stages(v: int) -> tuple:
-    """((a_pad, run_down_to_threshold), ...); a_pad None = full-table phase."""
+    """((scale, run_down_to_threshold), ...); scale None = full-table phase.
+    A compaction stage's flat pad is ``pow2(scale)`` rows."""
     if v <= 1 << 14:
         return ((None, 0),)
     return (
         (None, v // 4),
-        (_pow2_ceil(v // 4), v // 64),
-        (_pow2_ceil(v // 64), 0),
+        (v // 4, v // 64),
+        (v // 64, 0),
     )
 
 
-def _staged_pipeline(combined_buckets, combined_flat_ext, degrees, k,
-                     planes: tuple, flat_planes: int, stages: tuple,
-                     max_steps: int, stall_window: int = 64):
-    """One whole k-attempt as a traceable pipeline: full-table phase +
-    static compaction stages. Returns (packed_ext, steps, status).
+def _bucket_fail_valid(width: int, planes: int, k):
+    """A window covering the bucket's degrees asserts failure exactly; a
+    capped hub window must not unless k fits inside it (shared contract
+    with ``bucketed_superstep``)."""
+    fail_exact = 32 * planes >= width + 1
+    return fail_exact | (k <= 32 * planes)
 
-    combined_flat_ext: int32[V+1, W] flat relabeled combined table with a
-    trailing dummy row (all sentinel). ``stages``/``max_steps`` static.
+
+def _bucket_update(pe, pk_b, cb, p_b, k, v: int):
+    """One bucket's superstep against the ``pe`` snapshot. Returns
+    (new_pk_b, valid_fail_count, active_count)."""
+    w = cb.shape[1]
+    nb, beats = decode_combined(cb)
+    np_ = pe[: v + 1][nb]
+    new_b, fail_mask, act_mask = speculative_update(pk_b, np_, beats, k, p_b)
+    fv = _bucket_fail_valid(w, p_b, k)
+    return (new_b,
+            jnp.sum(fail_mask.astype(jnp.int32)) * fv.astype(jnp.int32),
+            jnp.sum(act_mask.astype(jnp.int32)))
+
+
+def _skipping_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int):
+    """One full-table superstep, per bucket, each wrapped in a ``lax.cond``
+    on the bucket's live active count ``ba`` (int32[B], from the previous
+    superstep — exact by frontier monotonicity). Returns
+    (new_pe, fail_count, active_count, bucket_active int32[B])."""
+    new_parts, parts_fail, parts_active = [], [], []
+    pk = pe[:v]
+
+    for bi, (cb, p_b, row0) in enumerate(zip(buckets, planes, row0s)):
+        vb = cb.shape[0]
+        pk_b = jax.lax.dynamic_slice_in_dim(pk, row0, vb)
+
+        def do(pk_b, cb=cb, p_b=p_b):
+            return _bucket_update(pe, pk_b, cb, p_b, k, v)
+
+        def skip(pk_b):
+            return pk_b, jnp.int32(0), jnp.int32(0)
+
+        new_b, f_b, a_b = jax.lax.cond(ba[bi] > 0, do, skip, pk_b)
+        new_parts.append(new_b)
+        parts_fail.append(f_b)
+        parts_active.append(a_b)
+    new_pk = jnp.concatenate(new_parts)
+    new_pe = jnp.concatenate([new_pk, jnp.array([-1, 0], jnp.int32)])
+    return (new_pe, sum(parts_fail), sum(parts_active),
+            jnp.stack(parts_active))
+
+
+def _staged_pipeline(buckets, flat_ext, degrees, k, planes: tuple,
+                     row0s: tuple, hub_buckets: int, flat_row0: int,
+                     flat_planes: int, stages: tuple, max_steps: int,
+                     init_bucket_active: tuple, stall_window: int = 64):
+    """One whole k-attempt as a traceable pipeline: cond-skipped full-table
+    phase + hybrid (flat-compacted + live-hub) compaction stages. Returns
+    (packed_ext, steps, status).
+
+    ``buckets[b]``: int32[V_b, W_b] combined bucket table. ``flat_ext``:
+    int32[V_flat+1, W_flat]
+    flat combined table over the flat region (relabeled rows ≥ flat_row0;
+    trailing dummy row), or None when there are no compaction stages. The
+    first ``hub_buckets`` buckets are the hub region. Everything except
+    ``k`` is static.
     """
     v = degrees.shape[0]
     k = jnp.asarray(k, jnp.int32)
+    nb_hub = hub_buckets
 
     packed_ext = jnp.concatenate(
         [initial_packed(degrees), jnp.array([-1, 0], jnp.int32)]
     )
     carry = (packed_ext, jnp.int32(1), jnp.int32(_RUNNING),
-             jnp.int32(v + 1), jnp.int32(0))
+             jnp.int32(v + 1), jnp.int32(0),
+             jnp.asarray(init_bucket_active, jnp.int32))
 
-    for a_pad, thresh in stages:
-        if a_pad is None:
-            # --- full-table phase (degree-bucketed supersteps) ---
+    for scale, thresh in stages:
+        if scale is None:
+            # --- full-table phase (cond-skipped bucketed supersteps) ---
             def cond(c, thresh=thresh):
-                _, step, status, active, _ = c
+                _, step, status, active, _, _ = c
                 return (status == _RUNNING) & (active > thresh) & (step < max_steps)
 
             def body(c):
-                pe, step, status, prev_active, stall = c
-                new_p, fail_count, active = bucketed_superstep(
-                    pe[:v], combined_buckets, k, planes
+                pe, step, status, prev_active, stall, ba = c
+                new_pe, fail_count, active, ba_new = _skipping_superstep(
+                    pe, ba, buckets, row0s, k, planes, v
                 )
                 any_fail = fail_count > 0
                 stall = jnp.where(active < prev_active, 0, stall + 1)
                 status = status_step(any_fail, active, stall, stall_window)
-                new_pe = jnp.concatenate([new_p, jnp.array([-1, 0], jnp.int32)])
                 new_pe = jnp.where(any_fail, pe, new_pe)
-                return (new_pe, step + 1, status, active, stall)
+                ba_new = jnp.where(any_fail, ba, ba_new)
+                return (new_pe, step + 1, status, active, stall, ba_new)
 
             carry = jax.lax.while_loop(cond, body, carry)
             continue
 
-        # --- compaction stage: frontier ≤ previous threshold ≤ a_pad ---
-        def run_stage(c, a_pad=a_pad, thresh=thresh):
-            pe0, step0, status0, active0, stall0 = c
+        # --- hybrid compaction stage: frontier ≤ scale at entry ---
+        a_pad = _pow2_ceil(scale)
+        v_flat = flat_ext.shape[0] - 1
+
+        def run_stage(c, a_pad=a_pad, thresh=thresh, v_flat=v_flat):
+            pe0, step0, status0, active0, stall0, ba0 = c
             pk = pe0[:v]
             act = (pk < 0) | ((pk & 1) == 1)
-            pos = jnp.cumsum(act.astype(jnp.int32)) - 1
-            idx = jnp.full((a_pad,), v, jnp.int32)       # unused slots → dummy row
-            scatter_pos = jnp.where(act & (pos < a_pad), pos, a_pad)
-            idx = idx.at[scatter_pos].set(jnp.arange(v, dtype=jnp.int32), mode="drop")
-            gidx = jnp.where(idx == v, v + 1, idx)       # dummy slots → state slot V+1
-            comb_a = jnp.take(combined_flat_ext, idx, axis=0)  # ONE row gather
-            nbrs_a, beats_a = decode_combined(comb_a)
 
-            def cond(c2):
-                _, step, status, active, _ = c2
+            # compact the flat region's active rows (safe: ≤ scale ≤ a_pad)
+            act_f = jax.lax.slice(act, (flat_row0,), (v,))
+            pos = jnp.cumsum(act_f.astype(jnp.int32)) - 1
+            idx_f = jnp.full((a_pad,), v_flat, jnp.int32)     # dummy row
+            scatter_pos = jnp.where(act_f & (pos < a_pad), pos, a_pad)
+            idx_f = idx_f.at[scatter_pos].set(
+                jnp.arange(v_flat, dtype=jnp.int32), mode="drop")
+            comb_a = jnp.take(flat_ext, idx_f, axis=0)        # ONE row gather
+            nbrs_a, beats_a = decode_combined(comb_a)
+            gidx = jnp.where(idx_f == v_flat, v + 1, idx_f + flat_row0)
+
+            def cond2(c2):
+                _, step, status, active, _, _ = c2
                 return (status == _RUNNING) & (active > thresh) & (step < max_steps)
 
-            def body(c2):
-                pe, step, status, prev_active, stall = c2
-                pk_a = pe[gidx]
-                np_ = pe[nbrs_a]                         # element gather [A, W]
-                new_a, fail_mask, active_mask = speculative_update(
-                    pk_a, np_, beats_a, k, flat_planes
-                )
-                new_pe = pe.at[gidx].set(new_a)          # dup writes only at V+1, same value
-                any_fail = jnp.sum(fail_mask.astype(jnp.int32)) > 0
-                active = jnp.sum(active_mask.astype(jnp.int32))
+            def body2(c2):
+                pe, step, status, prev_active, stall, ba = c2
+                # BSP snapshot semantics: all reads from ``pe``; writes
+                # accumulate in ``new_pe`` over disjoint row sets
+                flat_live = sum(ba[bi] for bi in range(nb_hub, ba.shape[0])) \
+                    if nb_hub < ba.shape[0] else jnp.int32(0)
+
+                def do_flat(acc):
+                    pk_a = pe[gidx]
+                    np_ = pe[nbrs_a]                 # gather [A_pad, W_flat]
+                    new_a, fail_mask, act_mask = speculative_update(
+                        pk_a, np_, beats_a, k, flat_planes
+                    )
+                    return (acc.at[gidx].set(new_a),  # dups only at V+1, same value
+                            jnp.sum(fail_mask.astype(jnp.int32)),
+                            jnp.sum(act_mask.astype(jnp.int32)))
+
+                def skip_any(acc):
+                    return acc, jnp.int32(0), jnp.int32(0)
+
+                new_pe, fail_f, act_fl = jax.lax.cond(
+                    flat_live > 0, do_flat, skip_any, pe)
+
+                fails, actives = [fail_f], [act_fl]
+                ba_parts = []
+                for bi in range(nb_hub):
+                    cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
+                    vb = cb.shape[0]
+
+                    def do_hub(acc, cb=cb, p_b=p_b, row0=row0, vb=vb):
+                        pk_b = jax.lax.dynamic_slice_in_dim(pe[:v], row0, vb)
+                        new_b, f_b, a_b = _bucket_update(
+                            pe, pk_b, cb, p_b, k, v)
+                        return (jax.lax.dynamic_update_slice_in_dim(
+                            acc, new_b, row0, axis=0), f_b, a_b)
+
+                    new_pe, f_b, a_b = jax.lax.cond(
+                        ba[bi] > 0, do_hub, skip_any, new_pe)
+                    fails.append(f_b)
+                    actives.append(a_b)
+                    ba_parts.append(a_b)
+                # flat per-bucket granularity is collapsed inside stages:
+                # park the flat total in the first flat slot (sum preserved)
+                for bi in range(nb_hub, ba.shape[0]):
+                    ba_parts.append(act_fl if bi == nb_hub else jnp.int32(0))
+                ba_new = jnp.stack(ba_parts) if ba_parts else ba
+
+                fail_count = sum(fails)
+                active = sum(actives)
+                any_fail = fail_count > 0
                 stall = jnp.where(active < prev_active, 0, stall + 1)
                 status = status_step(any_fail, active, stall, stall_window)
                 new_pe = jnp.where(any_fail, pe, new_pe)
-                return (new_pe, step + 1, status, active, stall)
+                ba_new = jnp.where(any_fail, ba, ba_new)
+                return (new_pe, step + 1, status, active, stall, ba_new)
 
-            return jax.lax.while_loop(cond, body, c)
+            return jax.lax.while_loop(cond2, body2, c)
 
         carry = jax.lax.cond(carry[2] == _RUNNING, run_stage, lambda c: c, carry)
 
-    pe, steps, status, active, _ = carry
+    pe, steps, status, active, _, _ = carry
     # fixups: nothing-to-do graphs (status never set) and step-budget exhaustion
     status = jnp.where(
         (status == _RUNNING) & (active == 0), _SUCCESS,
@@ -172,14 +288,18 @@ def _staged_pipeline(combined_buckets, combined_flat_ext, degrees, k,
     return pe, steps, status
 
 
-_attempt_kernel_staged = partial(jax.jit, static_argnames=(
-    "planes", "flat_planes", "stages", "max_steps", "stall_window"))(_staged_pipeline)
+_STATIC_NAMES = ("planes", "row0s", "hub_buckets", "flat_row0", "flat_planes",
+                 "stages", "max_steps", "init_bucket_active", "stall_window")
+
+_attempt_kernel_staged = partial(jax.jit, static_argnames=_STATIC_NAMES)(
+    _staged_pipeline)
 
 
-@partial(jax.jit, static_argnames=("planes", "flat_planes", "stages", "max_steps", "stall_window"))
-def _sweep_kernel_staged(combined_buckets, combined_flat_ext, degrees, k0,
-                         planes: tuple, flat_planes: int, stages: tuple,
-                         max_steps: int, stall_window: int = 64):
+@partial(jax.jit, static_argnames=_STATIC_NAMES)
+def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
+                         row0s: tuple, hub_buckets: int, flat_row0: int,
+                         flat_planes: int, stages: tuple, max_steps: int,
+                         init_bucket_active: tuple, stall_window: int = 64):
     """Fused minimal-k sweep: attempt(k0), then — still on device — the
     jump-mode confirm attempt at (colors_used − 1). One dispatch for what
     jump mode otherwise does in two (PERF.md lever: ~65 ms dispatch each).
@@ -190,9 +310,11 @@ def _sweep_kernel_staged(combined_buckets, combined_flat_ext, degrees, k0,
     trivial k=0 FAILURE in that case, matching ``attempt(0)``).
     """
     v = degrees.shape[0]
-    args = (combined_buckets, combined_flat_ext, degrees)
-    kw = dict(planes=planes, flat_planes=flat_planes, stages=stages,
-              max_steps=max_steps, stall_window=stall_window)
+    args = (buckets, flat_ext, degrees)
+    kw = dict(planes=planes, row0s=row0s, hub_buckets=hub_buckets,
+              flat_row0=flat_row0, flat_planes=flat_planes, stages=stages,
+              max_steps=max_steps, init_bucket_active=init_bucket_active,
+              stall_window=stall_window)
     pe1, steps1, status1 = _staged_pipeline(*args, k0, **kw)
     colors1 = jnp.where(pe1[:v] >= 0, pe1[:v] >> 1, -1)
     used = jnp.max(colors1, initial=-1) + 1
@@ -212,50 +334,91 @@ def _sweep_kernel_staged(combined_buckets, combined_flat_ext, degrees, k0,
 class CompactFrontierEngine(BucketedELLEngine):
     """Single-call staged frontier-compacted engine (single device).
 
-    Inherits the bucketed relabeling/structures and color windows.
-    Colors are bit-identical to ``BucketedELLEngine``.
+    Inherits the bucketed relabeling/structures and per-bucket color
+    windows. Any Δ — including power-law/RMAT graphs — takes the staged
+    path: flat-region rows (bucket width ≤ ``flat_cap`` and within the
+    table budget) compact into the flat table; wider hub buckets run
+    cond-skipped full-bucket updates and vanish once inert. Colors are
+    bit-identical to ``BucketedELLEngine``.
     """
 
-    # heavy-tailed guard: the flat compacted-phase table is [V+1, Δ]; past
-    # this width the O(V·Δ) blowup bucketing exists to avoid comes back
-    # (power-law/RMAT graphs), so fall back to the pure bucketed schedule
-    FLAT_WIDTH_CAP = 256
+    # hub/flat split: a bucket joins the flat region only if its width is
+    # ≤ FLAT_CAP *and* the flat table (rows × widest flat width) stays
+    # under FLAT_BUDGET entries — the O(V·Δ) blowup guard, now per-region
+    # instead of an engine-wide fallback
+    FLAT_CAP = 256
+    FLAT_BUDGET = 1 << 28  # table entries (×4 B = 1 GiB)
 
     def __init__(self, arrays: GraphArrays, max_steps: int | None = None,
                  min_width: int = 4, stages: tuple | None = None,
-                 max_window_planes: int | None = None):
+                 max_window_planes: int | None = None,
+                 flat_cap: int | None = None):
         kw = {} if max_window_planes is None else {"max_window_planes": max_window_planes}
         super().__init__(arrays, max_steps=max_steps, min_width=min_width, **kw)
         v = arrays.num_vertices
-        w = max(arrays.max_degree, 1)
-        self.flat_planes = num_planes_for(w + 1)  # window for any degree ≤ Δ
         if stages is None:
-            stages = default_stages(v) if w <= self.FLAT_WIDTH_CAP else ((None, 0),)
-        # a compaction stage must be able to hold the whole frontier at entry
-        # (bounded by the previous stage's exit threshold, or V at the start) —
-        # a smaller pad would silently drop active vertices
+            stages = default_stages(v)
+        # a compaction stage's scale must bound the frontier at entry
+        # (the previous stage's exit threshold, or V at the start) — a
+        # smaller scale would silently drop active vertices
         bound = v
-        for a_pad, thresh in stages:
-            if a_pad is not None and a_pad < min(bound, v):
+        for scale, thresh in stages:
+            if scale is not None and scale < min(bound, v):
                 raise ValueError(
-                    f"stage pad {a_pad} < possible frontier {min(bound, v)}; "
+                    f"stage scale {scale} < possible frontier {min(bound, v)}; "
                     f"stages={stages}")
             bound = thresh
         self.stages = stages
-        if all(a_pad is None for a_pad, _ in self.stages):
-            self.combined_flat_ext = None  # no compaction stage needs it
-            return
-        nbrs, _ = csr_to_ell(self.rel_indptr, self.rel_indices, width=w, sentinel=v)
-        deg_new = np.asarray(self.degrees)
-        deg_pad = np.concatenate([deg_new, np.array([-1], np.int32)])
-        n_deg = deg_pad[nbrs]
-        beats = beats_rule(n_deg, nbrs, deg_new[:, None],
-                           np.arange(v, dtype=np.int32)[:, None])
-        combined = encode_combined(nbrs, beats)
-        # trailing dummy row: all sentinel, never beats
-        self.combined_flat_ext = jnp.asarray(
-            np.concatenate([combined, np.full((1, w), v, np.int32)])
+
+        sizes = [cb.shape[0] for cb in self.combined_buckets]
+        widths = [cb.shape[1] for cb in self.combined_buckets]
+        self.row0s = tuple(int(x) for x in
+                           np.concatenate([[0], np.cumsum(sizes[:-1])]))
+        deg_rel = np.asarray(self.degrees)
+        self.init_bucket_active = tuple(
+            int(np.count_nonzero(deg_rel[r0: r0 + vb] > 0))
+            for r0, vb in zip(self.row0s, sizes)
         )
+
+        # hub/flat split along the (width-descending) bucket order
+        cap = flat_cap if flat_cap is not None else self.FLAT_CAP
+        hub = 0
+        while hub < len(widths):
+            w_flat = widths[hub]
+            rows = v - self.row0s[hub]
+            if w_flat <= cap and rows * w_flat <= self.FLAT_BUDGET:
+                break
+            hub += 1
+        self.hub_buckets = hub
+        self.flat_row0 = self.row0s[hub] if hub < len(widths) else v
+
+        if all(scale is None for scale, _ in self.stages):
+            self.flat_ext = None
+            self.flat_planes = 0
+            return
+        # flat combined table over the flat region (relabeled CSR suffix)
+        w_flat = max(widths[hub:]) if hub < len(widths) else 1
+        f0 = self.flat_row0
+        sub_indptr = self.rel_indptr[f0:] - self.rel_indptr[f0]
+        sub_indices = self.rel_indices[self.rel_indptr[f0]:]
+        nbrs, _ = csr_to_ell(sub_indptr, sub_indices, width=w_flat, sentinel=v)
+        deg_pad = np.concatenate([deg_rel, np.array([-1], np.int32)])
+        n_deg = deg_pad[nbrs]
+        my_deg = deg_rel[f0:, None]
+        my_ids = np.arange(f0, v, dtype=np.int32)[:, None]
+        beats = beats_rule(n_deg, nbrs, my_deg, my_ids)
+        combined = encode_combined(nbrs, beats)
+        self.flat_ext = jnp.asarray(
+            np.concatenate([combined, np.full((1, w_flat), v, np.int32)])
+        )
+        self.flat_planes = num_planes_for(w_flat + 1)
+
+    def _kernel_kw(self):
+        return dict(planes=self.planes, row0s=self.row0s,
+                    hub_buckets=self.hub_buckets, flat_row0=self.flat_row0,
+                    flat_planes=self.flat_planes, stages=self.stages,
+                    max_steps=self.max_steps,
+                    init_bucket_active=self.init_bucket_active)
 
     def attempt(self, k: int) -> AttemptResult:
         v = self.arrays.num_vertices
@@ -263,9 +426,8 @@ class CompactFrontierEngine(BucketedELLEngine):
             return self._finish(np.full(v, -1, np.int32), AttemptStatus.FAILURE, 0, k)
         while True:  # window-cap retry loop (STALLED + capped hub buckets)
             pe, steps, status = _attempt_kernel_staged(
-                self.combined_buckets, self.combined_flat_ext, self.degrees, k,
-                planes=self.planes, flat_planes=self.flat_planes,
-                stages=self.stages, max_steps=self.max_steps,
+                self.combined_buckets, self.flat_ext, self.degrees, k,
+                **self._kernel_kw()
             )
             status = AttemptStatus(int(status))
             if status == AttemptStatus.STALLED and self._maybe_widen_windows():
@@ -283,9 +445,8 @@ class CompactFrontierEngine(BucketedELLEngine):
             return self.attempt(k0), None
         while True:  # window-cap retry loop (STALLED + capped hub buckets)
             pe1, steps1, status1, used, pe2, steps2, status2 = _sweep_kernel_staged(
-                self.combined_buckets, self.combined_flat_ext, self.degrees, k0,
-                planes=self.planes, flat_planes=self.flat_planes,
-                stages=self.stages, max_steps=self.max_steps,
+                self.combined_buckets, self.flat_ext, self.degrees, k0,
+                **self._kernel_kw()
             )
             status1 = AttemptStatus(int(status1))
             if status1 == AttemptStatus.STALLED and self._maybe_widen_windows():

@@ -76,21 +76,20 @@ def test_compact_heavy_tail():
     assert validate_coloring(g.indptr, g.indices, res.colors).valid
 
 
-def test_compact_heavy_tail_falls_back_to_bucketed_schedule():
-    # max_degree above FLAT_WIDTH_CAP must not allocate the [V+1, Δ] flat
-    # table (O(V·Δ) blowup on power-law graphs) — pure bucketed schedule;
-    # the per-bucket windows make the full k0 = Δ+1 budget workable directly
+def test_compact_heavy_tail_takes_compacted_stages():
+    # power-law graphs (Δ ≫ 256) used to fall back to the pure bucketed
+    # schedule; the per-bucket compacted stages now handle any Δ natively —
+    # default stages must be the full staged pipeline, bit-identical to the
+    # bucketed engine
     g = generate_rmat_graph(1 << 15, avg_degree=4, seed=5, native=False)
-    if g.max_degree <= CompactFrontierEngine.FLAT_WIDTH_CAP:
-        import pytest
-
-        pytest.skip("RMAT draw not heavy-tailed enough to trip the cap")
+    assert g.max_degree > 256  # heavy-tailed draw
     eng = CompactFrontierEngine(g)
-    assert eng.stages == ((None, 0),)
-    assert eng.combined_flat_ext is None
+    assert len(eng.stages) > 1  # compacted stages engaged, no fallback
     res = eng.attempt(g.max_degree + 1)
     assert res.status == AttemptStatus.SUCCESS
     assert validate_coloring(g.indptr, g.indices, res.colors).valid
+    ref = BucketedELLEngine(g).attempt(g.max_degree + 1)
+    assert np.array_equal(res.colors, ref.colors)
 
 
 def test_compact_color_windows_complete_graph():
@@ -127,16 +126,24 @@ def test_default_stages_large():
     from dgc_tpu.engine.compact import default_stages
 
     st = default_stages(1_000_000)
-    assert st[0] == (None, 250_000)
-    assert st[1] == (262_144, 15_625)
-    assert st[2] == (16_384, 0)
+    assert st == (
+        (None, 250_000),
+        (250_000, 15_625),
+        (15_625, 0),
+    )
+    # every stage's scale bounds the frontier at its entry
+    bound = 1_000_000
+    for scale, thresh in st:
+        if scale is not None:
+            assert scale >= bound
+        bound = thresh
 
 
-def test_compact_rejects_underspecified_stage_pad():
+def test_compact_rejects_underspecified_stage_scale():
     import pytest
 
     g = generate_random_graph(100, 6, seed=0)
-    with pytest.raises(ValueError, match="stage pad"):
+    with pytest.raises(ValueError, match="stage scale"):
         CompactFrontierEngine(g, stages=((None, 50), (16, 0)))
 
 
@@ -203,7 +210,9 @@ def test_fused_sweep_respects_k_min(medium_graph):
 
 def test_compact_flat_stage_covers_capped_windows():
     # with capped bucket windows, the flat compaction stage (planes sized to
-    # Δ+1, not capped) still finishes K40 without any widening retry
+    # the flat width, not capped) still finishes K40 without any widening
+    # retry: capped vertices defer through the full-table phase, drop into
+    # the compacted stage, and first-fit there sees the full budget
     v = 40
     edges = np.array([[i, j] for i in range(v) for j in range(i + 1, v)])
     g = GraphArrays.from_edge_list(v, edges)
