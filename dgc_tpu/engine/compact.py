@@ -24,12 +24,15 @@ attempt kernel executes, inside one ``jax.jit``:
 
 1. **Full-table phase** — degree-bucketed supersteps (shared
    ``speculative_update`` core) while the frontier (uncolored ∪ fresh)
-   exceeds the first threshold. Every bucket is wrapped in a ``lax.cond``
-   on its own live active count: an inert bucket costs *nothing*. On
-   power-law graphs the hub buckets (few rows × huge width) have the
-   highest priority, confirm in the first rounds, and drop out — which is
-   what makes heavy-tailed graphs tractable with no width cap on the
-   representation.
+   exceeds the first threshold. *Hub* buckets are each wrapped in a
+   ``lax.cond`` on their live active count: an inert hub bucket costs
+   *nothing*. On power-law graphs the hub buckets (few rows × huge width)
+   have the highest priority, confirm in the first rounds, and drop out —
+   which is what makes heavy-tailed graphs tractable with no width cap on
+   the representation. *Flat* buckets run fused with no conds: they stay
+   live for most of the sweep, so per-bucket cond dispatch is pure
+   overhead there (the round-2 regression: cond-wrapping every bucket cost
+   +70% per superstep on the bounded-degree 1M benchmark).
 2. **Compaction stages** at static thresholds: the flat region's active
    rows are compacted on-device into one padded index list (pad =
    pow2(stage scale) — safe: flat active ≤ global active ≤ scale), their
@@ -119,15 +122,26 @@ def _bucket_update(pe, pk_b, cb, p_b, k, v: int):
             jnp.sum(act_mask.astype(jnp.int32)))
 
 
-def _skipping_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int):
-    """One full-table superstep, per bucket, each wrapped in a ``lax.cond``
-    on the bucket's live active count ``ba`` (int32[B], from the previous
-    superstep — exact by frontier monotonicity). Returns
-    (new_pe, fail_count, active_count, bucket_active int32[B])."""
+def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
+                      hub_buckets: int):
+    """One full-table superstep. The first ``hub_buckets`` buckets (the hub
+    region: few rows, huge widths) are each wrapped in a ``lax.cond`` on
+    their live active count ``ba[bi]`` (exact by frontier monotonicity) —
+    they confirm early and then cost *nothing*. The flat region runs fused,
+    no conds: on bounded-degree graphs (hub empty) this is the round-1
+    fused schedule with zero dispatch overhead — cond-wrapping every flat
+    bucket cost 70% per superstep on the 1M benchmark (round-2 regression,
+    2.86 s → 4.88 s) because flat buckets stay live for most of the sweep.
+
+    ``ba`` is int32[hub_buckets (+1 if a flat region exists)]: per-hub-bucket
+    actives, then the flat-region total. Returns
+    (new_pe, fail_count, active_count, ba_new)."""
     new_parts, parts_fail, parts_active = [], [], []
+    ba_parts = []
     pk = pe[:v]
 
-    for bi, (cb, p_b, row0) in enumerate(zip(buckets, planes, row0s)):
+    for bi in range(hub_buckets):
+        cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
         vb = cb.shape[0]
         pk_b = jax.lax.dynamic_slice_in_dim(pk, row0, vb)
 
@@ -141,10 +155,22 @@ def _skipping_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int):
         new_parts.append(new_b)
         parts_fail.append(f_b)
         parts_active.append(a_b)
+        ba_parts.append(a_b)
+
+    for bi in range(hub_buckets, len(buckets)):
+        cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
+        pk_b = jax.lax.dynamic_slice_in_dim(pk, row0, cb.shape[0])
+        new_b, f_b, a_b = _bucket_update(pe, pk_b, cb, p_b, k, v)
+        new_parts.append(new_b)
+        parts_fail.append(f_b)
+        parts_active.append(a_b)
+    if hub_buckets < len(buckets):
+        ba_parts.append(sum(parts_active[hub_buckets:]))
+
     new_pk = jnp.concatenate(new_parts)
     new_pe = jnp.concatenate([new_pk, jnp.array([-1, 0], jnp.int32)])
     return (new_pe, sum(parts_fail), sum(parts_active),
-            jnp.stack(parts_active))
+            jnp.stack(ba_parts))
 
 
 def _staged_pipeline(buckets, flat_ext, degrees, k, planes: tuple,
@@ -159,12 +185,15 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, planes: tuple,
     int32[V_flat+1, W_flat]
     flat combined table over the flat region (relabeled rows ≥ flat_row0;
     trailing dummy row), or None when there are no compaction stages. The
-    first ``hub_buckets`` buckets are the hub region. Everything except
+    first ``hub_buckets`` buckets are the hub region.
+    ``init_bucket_active`` holds the hub buckets' initial actives followed
+    by the flat-region total (see ``_hybrid_superstep``). Everything except
     ``k`` is static.
     """
     v = degrees.shape[0]
     k = jnp.asarray(k, jnp.int32)
     nb_hub = hub_buckets
+    has_flat = nb_hub < len(buckets)
 
     packed_ext = jnp.concatenate(
         [initial_packed(degrees), jnp.array([-1, 0], jnp.int32)]
@@ -175,15 +204,15 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, planes: tuple,
 
     for scale, thresh in stages:
         if scale is None:
-            # --- full-table phase (cond-skipped bucketed supersteps) ---
+            # --- full-table phase (hub cond-skipped, flat fused) ---
             def cond(c, thresh=thresh):
                 _, step, status, active, _, _ = c
                 return (status == _RUNNING) & (active > thresh) & (step < max_steps)
 
             def body(c):
                 pe, step, status, prev_active, stall, ba = c
-                new_pe, fail_count, active, ba_new = _skipping_superstep(
-                    pe, ba, buckets, row0s, k, planes, v
+                new_pe, fail_count, active, ba_new = _hybrid_superstep(
+                    pe, ba, buckets, row0s, k, planes, v, nb_hub
                 )
                 any_fail = fail_count > 0
                 stall = jnp.where(active < prev_active, 0, stall + 1)
@@ -223,8 +252,6 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, planes: tuple,
                 pe, step, status, prev_active, stall, ba = c2
                 # BSP snapshot semantics: all reads from ``pe``; writes
                 # accumulate in ``new_pe`` over disjoint row sets
-                flat_live = sum(ba[bi] for bi in range(nb_hub, ba.shape[0])) \
-                    if nb_hub < ba.shape[0] else jnp.int32(0)
 
                 def do_flat(acc):
                     pk_a = pe[gidx]
@@ -239,8 +266,15 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, planes: tuple,
                 def skip_any(acc):
                     return acc, jnp.int32(0), jnp.int32(0)
 
-                new_pe, fail_f, act_fl = jax.lax.cond(
-                    flat_live > 0, do_flat, skip_any, pe)
+                if not has_flat:
+                    new_pe, fail_f, act_fl = pe, jnp.int32(0), jnp.int32(0)
+                elif nb_hub == 0:
+                    # no hub: while-cond (active > thresh ≥ 0) already
+                    # guarantees flat work exists — run uncond'd
+                    new_pe, fail_f, act_fl = do_flat(pe)
+                else:
+                    new_pe, fail_f, act_fl = jax.lax.cond(
+                        ba[nb_hub] > 0, do_flat, skip_any, pe)
 
                 fails, actives = [fail_f], [act_fl]
                 ba_parts = []
@@ -260,10 +294,8 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, planes: tuple,
                     fails.append(f_b)
                     actives.append(a_b)
                     ba_parts.append(a_b)
-                # flat per-bucket granularity is collapsed inside stages:
-                # park the flat total in the first flat slot (sum preserved)
-                for bi in range(nb_hub, ba.shape[0]):
-                    ba_parts.append(act_fl if bi == nb_hub else jnp.int32(0))
+                if has_flat:
+                    ba_parts.append(act_fl)
                 ba_new = jnp.stack(ba_parts) if ba_parts else ba
 
                 fail_count = sum(fails)
@@ -375,10 +407,6 @@ class CompactFrontierEngine(BucketedELLEngine):
         self.row0s = tuple(int(x) for x in
                            np.concatenate([[0], np.cumsum(sizes[:-1])]))
         deg_rel = np.asarray(self.degrees)
-        self.init_bucket_active = tuple(
-            int(np.count_nonzero(deg_rel[r0: r0 + vb] > 0))
-            for r0, vb in zip(self.row0s, sizes)
-        )
 
         # hub/flat split along the (width-descending) bucket order
         cap = flat_cap if flat_cap is not None else self.FLAT_CAP
@@ -391,6 +419,17 @@ class CompactFrontierEngine(BucketedELLEngine):
             hub += 1
         self.hub_buckets = hub
         self.flat_row0 = self.row0s[hub] if hub < len(widths) else v
+
+        # live-count layout matching _hybrid_superstep: per-hub-bucket
+        # actives, then one flat-region total
+        init_active = [
+            int(np.count_nonzero(deg_rel[r0: r0 + vb] > 0))
+            for r0, vb in zip(self.row0s[:hub], sizes[:hub])
+        ]
+        if hub < len(widths):
+            init_active.append(
+                int(np.count_nonzero(deg_rel[self.flat_row0:] > 0)))
+        self.init_bucket_active = tuple(init_active)
 
         if all(scale is None for scale, _ in self.stages):
             self.flat_ext = None
