@@ -62,9 +62,31 @@ def clamp_budget(k: int, capacity: int) -> int:
     return min(int(k), capacity)
 
 
+def maybe_widen_window(engine) -> bool:
+    """Shared STALLED-retry step for the capped-window flat engines
+    (``ShardedELLEngine``, ``RingHaloEngine``): double ``engine.num_planes``
+    toward the full Δ+1 budget and evict the kernel cache (planes only ever
+    grow, so every cached executable is superseded). Returns True iff the
+    caller should retry the attempt at the wider window.
+
+    The degree-bucketed engines keep their own per-bucket variant
+    (``_maybe_widen_windows``) — their window is a tuple, not a scalar.
+    """
+    from dgc_tpu.ops.bitmask import num_planes_for
+
+    full = num_planes_for(engine.arrays.max_degree + 1)
+    if engine.num_planes >= full:
+        return False
+    engine.num_planes = min(2 * engine.num_planes, full)
+    engine._kernels.clear()  # stale executables would pin device memory
+    return True
+
+
 def empty_budget_failure(num_vertices: int, k: int) -> AttemptResult:
     """The k < 1 attempt: nothing can be colored — immediate FAILURE with an
-    all-uncolored vector (reference sentinel −3 on every vertex). Engines
+    all-uncolored vector. (The reference marks such vertices −3,
+    ``coloring.py:53``; this repo's uncolored sentinel is −1 throughout,
+    so the arrays do not match the reference format here.) Engines
     whose reset pass pre-confirms isolated vertices to color 0 must take
     this path instead of running the kernel, or an all-isolated graph would
     claim SUCCESS against an empty budget."""

@@ -326,7 +326,8 @@ class BucketedELLEngine:
     def attempt(self, k: int) -> AttemptResult:
         if k < 1:
             # round-1 specialization presumes color 0 is in budget; an empty
-            # budget fails outright (reference sentinel −3 on every vertex)
+            # budget fails outright with all vertices uncolored (−1; the
+            # reference marks these −3, coloring.py:53)
             return self._finish(
                 np.full(self.arrays.num_vertices, -1, np.int32),
                 AttemptStatus.FAILURE, 0, k)

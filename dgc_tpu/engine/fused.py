@@ -11,15 +11,55 @@ single-device variant — no collective ``used`` reduction there).
 
 from __future__ import annotations
 
+from functools import partial
 from typing import Callable
 
 import jax
 import jax.numpy as jnp
+from jax.sharding import PartitionSpec as P
 
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus, empty_budget_failure
+from dgc_tpu.parallel.mesh import VERTEX_AXIS, fetch_global
 
 _SUCCESS = AttemptStatus.SUCCESS
 _FAILURE = AttemptStatus.FAILURE
+
+
+def cached_shard_kernel(engine, body, name: str, window_key, in_specs,
+                        static_kwargs: dict):
+    """(name, window_key)-cached ``jit(shard_map(body))`` with the shared
+    out_specs convention: an ``attempt`` kernel returns (colors, steps,
+    status); a ``sweep`` kernel returns that twice around the shard-invariant
+    ``used`` scalar (``device_sweep_pair``). One builder for every sharded
+    engine so the convention can't silently diverge per engine; the cache
+    lives on ``engine._kernels`` and is evicted by the widen step."""
+    key = (name, window_key)
+    if key not in engine._kernels:
+        out_one = (P(VERTEX_AXIS), P(), P())
+        engine._kernels[key] = jax.jit(jax.shard_map(
+            partial(body, **static_kwargs),
+            mesh=engine.mesh,
+            in_specs=in_specs,
+            out_specs=out_one if name == "attempt"
+            else out_one + (P(),) + out_one,
+            check_vma=False,
+        ))
+    return engine._kernels[key]
+
+
+def run_windowed(run: Callable, widen: Callable[[], bool], status_index=-1):
+    """Drive a capped-window kernel: run, and while it exits STALLED with a
+    widenable window, widen and re-run (``run`` must re-fetch the kernel so
+    it picks up the new window). ``status_index`` selects the status scalar
+    in the kernel's output tuple (attempt: last; fused sweep: the first
+    attempt's status, index 2). Returns ``(outs, status)`` — the shared
+    retry driver for every capped-window engine."""
+    while True:
+        outs = run()
+        status = AttemptStatus(int(fetch_global(outs[status_index])))
+        if status == AttemptStatus.STALLED and widen():
+            continue
+        return outs, status
 
 
 def device_sweep_pair(attempt_fn: Callable, k0, axis: str):
@@ -65,9 +105,9 @@ def finish_sweep_pair(
     """
     if first.status != AttemptStatus.SUCCESS:
         return first, None
-    k2 = int(used) - 1
+    k2 = int(fetch_global(used)) - 1
     if k2 < 1:
         return first, empty_budget_failure(num_vertices, k2)
-    if AttemptStatus(int(status2)) == AttemptStatus.STALLED:
+    if AttemptStatus(int(fetch_global(status2))) == AttemptStatus.STALLED:
         return first, attempt(k2)
     return first, finish_second(k2)

@@ -25,8 +25,6 @@ a V/n block; reductions (``coloring.py:88,104``) are ``lax.psum``.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -37,13 +35,24 @@ from dgc_tpu.engine.base import (
     AttemptStatus,
     clamp_budget,
     empty_budget_failure,
+    maybe_widen_window,
 )
-from dgc_tpu.engine.fused import device_sweep_pair, finish_sweep_pair
+from dgc_tpu.engine.fused import (
+    cached_shard_kernel,
+    device_sweep_pair,
+    finish_sweep_pair,
+    run_windowed,
+)
 from dgc_tpu.engine.bucketed import status_step
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import num_planes_for
 from dgc_tpu.ops.speculative import apply_update, beats_rule, neighbor_stats
-from dgc_tpu.parallel.mesh import VERTEX_AXIS, make_mesh, pad_to_multiple
+from dgc_tpu.parallel.mesh import (
+    VERTEX_AXIS,
+    fetch_global,
+    make_mesh,
+    pad_to_multiple,
+)
 
 _RUNNING = AttemptStatus.RUNNING
 _STALLED = AttemptStatus.STALLED
@@ -224,49 +233,31 @@ class RingHaloEngine:
         self.beats = tuple(jax.device_put(b, rows2d) for b in beats)
         self._kernels = {}
 
-    def _maybe_widen_window(self) -> bool:
-        """After STALLED: double the color window if it is capped below
-        Δ+1; returns True iff the caller should retry."""
-        full = num_planes_for(self.arrays.max_degree + 1)
-        if self.num_planes >= full:
-            return False
-        self.num_planes = min(2 * self.num_planes, full)
-        return True
+    _maybe_widen_window = maybe_widen_window
 
     def _kernel(self, body, name: str):
-        key = (name, self.num_planes)
-        if key not in self._kernels:
-            fn = partial(body, num_planes=self.num_planes,
-                         max_degree=self.arrays.max_degree,
-                         max_steps=self.max_steps, n=self._n)
-            out_one = (P(VERTEX_AXIS), P(), P())
-            sm = jax.shard_map(
-                fn,
-                mesh=self.mesh,
-                in_specs=(P(VERTEX_AXIS),
-                          tuple(P(VERTEX_AXIS, None) for _ in self.tables),
-                          tuple(P(VERTEX_AXIS, None) for _ in self.beats),
-                          P()),
-                out_specs=out_one if name == "attempt"
-                else out_one + (P(),) + out_one,
-                check_vma=False,
-            )
-            self._kernels[key] = jax.jit(sm)
-        return self._kernels[key]
+        return cached_shard_kernel(
+            self, body, name, self.num_planes,
+            in_specs=(P(VERTEX_AXIS),
+                      tuple(P(VERTEX_AXIS, None) for _ in self.tables),
+                      tuple(P(VERTEX_AXIS, None) for _ in self.beats),
+                      P()),
+            static_kwargs=dict(num_planes=self.num_planes,
+                               max_degree=self.arrays.max_degree,
+                               max_steps=self.max_steps, n=self._n),
+        )
 
     def attempt(self, k: int) -> AttemptResult:
         if k < 1:
             return empty_budget_failure(self.v_true, k)
-        while True:  # window-cap retry loop (STALLED + capped window)
-            k_eff = clamp_budget(k, 32 * num_planes_for(self.arrays.max_degree + 1))
-            kern = self._kernel(_ring_attempt_body, "attempt")
-            colors, steps, status = kern(self.deg_l, self.tables, self.beats, k_eff)
-            status = AttemptStatus(int(status))
-            if status == AttemptStatus.STALLED and self._maybe_widen_window():
-                continue
-            break
+        k_eff = clamp_budget(k, 32 * num_planes_for(self.arrays.max_degree + 1))
+        (colors, steps, _), status = run_windowed(
+            lambda: self._kernel(_ring_attempt_body, "attempt")(
+                self.deg_l, self.tables, self.beats, k_eff),
+            self._maybe_widen_window,
+        )
         return AttemptResult(
-            status, np.asarray(colors)[: self.v_true], int(steps), int(k)
+            status, fetch_global(colors)[: self.v_true], int(fetch_global(steps)), int(k)
         )
 
     def sweep(self, k0: int) -> tuple[AttemptResult, AttemptResult | None]:
@@ -275,22 +266,19 @@ class RingHaloEngine:
         calls; STALLED confirm falls back to ``attempt``)."""
         if k0 < 1:
             return self.attempt(k0), None
-        while True:
-            k_eff = clamp_budget(k0, 32 * num_planes_for(self.arrays.max_degree + 1))
-            kern = self._kernel(_ring_sweep_body, "sweep")
-            c1, steps1, status1, used, c2, steps2, status2 = kern(
-                self.deg_l, self.tables, self.beats, k_eff
-            )
-            status1 = AttemptStatus(int(status1))
-            if status1 == AttemptStatus.STALLED and self._maybe_widen_window():
-                continue
-            break
-        first = AttemptResult(status1, np.asarray(c1)[: self.v_true],
-                              int(steps1), int(k0))
+        k_eff = clamp_budget(k0, 32 * num_planes_for(self.arrays.max_degree + 1))
+        outs, status1 = run_windowed(
+            lambda: self._kernel(_ring_sweep_body, "sweep")(
+                self.deg_l, self.tables, self.beats, k_eff),
+            self._maybe_widen_window, status_index=2,
+        )
+        c1, steps1, _, used, c2, steps2, status2 = outs
+        first = AttemptResult(status1, fetch_global(c1)[: self.v_true],
+                              int(fetch_global(steps1)), int(k0))
         return finish_sweep_pair(
             first, used, status2,
-            lambda k2: AttemptResult(AttemptStatus(int(status2)),
-                                     np.asarray(c2)[: self.v_true],
-                                     int(steps2), k2),
+            lambda k2: AttemptResult(AttemptStatus(int(fetch_global(status2))),
+                                     fetch_global(c2)[: self.v_true],
+                                     int(fetch_global(steps2)), k2),
             self.v_true, self.attempt,
         )

@@ -29,8 +29,6 @@ never interact; results are sliced back to the true V on the host.
 
 from __future__ import annotations
 
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
@@ -41,13 +39,24 @@ from dgc_tpu.engine.base import (
     AttemptStatus,
     clamp_budget,
     empty_budget_failure,
+    maybe_widen_window,
 )
-from dgc_tpu.engine.fused import device_sweep_pair, finish_sweep_pair
+from dgc_tpu.engine.fused import (
+    cached_shard_kernel,
+    device_sweep_pair,
+    finish_sweep_pair,
+    run_windowed,
+)
 from dgc_tpu.engine.bucketed import status_step
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import num_planes_for
 from dgc_tpu.ops.speculative import beats_rule, speculative_update
-from dgc_tpu.parallel.mesh import VERTEX_AXIS, make_mesh, pad_to_multiple
+from dgc_tpu.parallel.mesh import (
+    VERTEX_AXIS,
+    fetch_global,
+    make_mesh,
+    pad_to_multiple,
+)
 
 
 def _shard_superstep(packed_l, nbrs_l, pre_beats, k, num_planes: int):
@@ -67,15 +76,24 @@ _RUNNING = AttemptStatus.RUNNING
 _STALLED = AttemptStatus.STALLED
 
 
-def _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes: int, max_steps: int):
+def _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes: int, max_degree: int,
+                  max_steps: int, stall_window: int = 64):
     """One k-attempt on a shard. nbrs_l: int32[Vl, W] with *global*
-    neighbor ids (sentinel = V_padded); deg_l: int32[Vl]; deg_g: int32[V]."""
+    neighbor ids (sentinel = V_padded); deg_l: int32[Vl]; deg_g: int32[V].
+
+    ``num_planes`` may be a *capped* color window (< Δ+1 colors): the
+    failure flag is then suppressed unless ``k`` fits the window, so a
+    capped window can never assert a wrong FAILURE — a starved attempt
+    stops making progress, trips the stall counter, and exits STALLED for
+    the engine to widen the window and retry (the ``bucketed`` contract)."""
     vl, w = nbrs_l.shape
     shard = jax.lax.axis_index(VERTEX_AXIS)
     my_ids = (shard * vl + jnp.arange(vl, dtype=jnp.int32)).astype(jnp.int32)
     k = jnp.asarray(k, jnp.int32)
 
     packed0_l = jnp.where(deg_l == 0, 0, -1).astype(jnp.int32)
+    fail_exact = 32 * num_planes >= max_degree + 1
+    fail_valid = fail_exact | (k <= 32 * num_planes)
 
     # loop-invariant neighbor priority (degree desc, id asc)
     deg_g_pad = jnp.concatenate([deg_g, jnp.array([-1], jnp.int32)])
@@ -84,42 +102,61 @@ def _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes: int, max_steps: int):
     pre_beats = beats_rule(n_deg, nbrs_l, my_deg, my_ids[:, None])
 
     def cond(carry):
-        _, _, status = carry
+        _, _, status, _, _ = carry
         return status == _RUNNING
 
     def body(carry):
-        packed_l, step, status = carry
+        packed_l, step, status, prev_active, stall = carry
         new_packed_l, any_fail, active = _shard_superstep(
             packed_l, nbrs_l, pre_beats, k, num_planes
         )
-        # shared transition; step budget plays the stall role here
-        status = status_step(any_fail, active, step + 1, max_steps)
+        any_fail = any_fail & fail_valid
+        stall = jnp.where(active < prev_active, 0, stall + 1)
+        status = status_step(any_fail, active, stall, stall_window)
+        status = jnp.where(
+            (status == _RUNNING) & (step + 1 >= max_steps), _STALLED, status
+        ).astype(jnp.int32)
         new_packed_l = jnp.where(any_fail, packed_l, new_packed_l)
-        return (new_packed_l, step + 1, status)
+        return (new_packed_l, step + 1, status, active, stall)
 
-    packed_l, steps, status = jax.lax.while_loop(
-        cond, body, (packed0_l, jnp.int32(0), jnp.int32(_RUNNING))
+    packed_l, steps, status, _, _ = jax.lax.while_loop(
+        cond, body,
+        (packed0_l, jnp.int32(0), jnp.int32(_RUNNING),
+         jnp.int32(nbrs_l.shape[0] * jax.lax.psum(1, VERTEX_AXIS) + 1),
+         jnp.int32(0)),
     )
     colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
     return colors_l, steps, status
 
 
 def _flat_attempt_body(nbrs_l, deg_l, deg_g, k, *, num_planes: int,
-                       max_steps: int):
-    return _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes, max_steps)
+                       max_degree: int, max_steps: int):
+    return _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes, max_degree,
+                         max_steps)
 
 
 def _flat_sweep_body(nbrs_l, deg_l, deg_g, k0, *, num_planes: int,
-                     max_steps: int):
+                     max_degree: int, max_steps: int):
     """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call."""
     return device_sweep_pair(
-        lambda k: _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes, max_steps),
+        lambda k: _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes,
+                                max_degree, max_steps),
         k0, VERTEX_AXIS,
     )
 
 
 class ShardedELLEngine:
-    """Vertex-sharded engine over an n-device mesh (all-gather exchange)."""
+    """Vertex-sharded engine over an n-device mesh (all-gather exchange).
+
+    A *flat* engine: one ``[V, Δ]`` ELL table, so both its memory and its
+    per-superstep gather volume scale with the max degree. Heavy-tailed
+    graphs are refused at construction (``max_ell_width``) with a pointer
+    to the degree-bucketed ``ShardedBucketedEngine``, whose tables scale
+    with Σdeg instead. The first-fit color window is capped at
+    ``max_window_planes`` (widened on STALLED, like ``RingHaloEngine``) so
+    a large Δ+1 budget never unrolls hundreds of bitmask planes into the
+    compiled kernel.
+    """
 
     def __init__(
         self,
@@ -127,6 +164,8 @@ class ShardedELLEngine:
         num_shards: int | None = None,
         max_steps: int | None = None,
         mesh=None,
+        max_window_planes: int = 32,
+        max_ell_width: int = 2048,
     ):
         self.arrays = arrays
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
@@ -134,6 +173,17 @@ class ShardedELLEngine:
         v = arrays.num_vertices
         self.v_true = v
         v_pad = pad_to_multiple(max(v, n), n)
+
+        if arrays.max_degree > max_ell_width:
+            raise ValueError(
+                f"ShardedELLEngine is a flat-ELL engine: max degree "
+                f"{arrays.max_degree} would pad every vertex row to "
+                f"{arrays.max_degree} columns (O(V*maxdeg) memory and gather "
+                f"volume). Use the degree-bucketed multi-chip backend instead "
+                f"(--backend sharded-bucketed / ShardedBucketedEngine), whose "
+                f"tables scale with the edge count; or raise max_ell_width "
+                f"explicitly if the padding cost is acceptable."
+            )
 
         nbrs, degrees = arrays.to_ell()
         w = nbrs.shape[1]
@@ -143,7 +193,8 @@ class ShardedELLEngine:
         deg_p = np.zeros(v_pad, dtype=np.int32)
         deg_p[:v] = degrees
 
-        self.num_planes = num_planes_for(arrays.max_degree + 1)
+        self.num_planes = min(num_planes_for(arrays.max_degree + 1),
+                              max_window_planes)
         self.max_steps = max_steps if max_steps is not None else 2 * v_pad + 4
 
         shard_rows = NamedSharding(self.mesh, P(VERTEX_AXIS))
@@ -151,48 +202,54 @@ class ShardedELLEngine:
         self.nbrs = jax.device_put(nbrs_p, NamedSharding(self.mesh, P(VERTEX_AXIS, None)))
         self.deg_l = jax.device_put(deg_p, shard_rows)
         self.deg_g = jax.device_put(deg_p, replicated)
+        self._kernels = {}
 
-        out_one = (P(VERTEX_AXIS), P(), P())
-        in_specs = (P(VERTEX_AXIS, None), P(VERTEX_AXIS), P(), P())
+    _maybe_widen_window = maybe_widen_window
 
-        def _build(body, out_specs):
-            fn = partial(body, num_planes=self.num_planes, max_steps=self.max_steps)
-            return jax.jit(jax.shard_map(
-                fn, mesh=self.mesh, in_specs=in_specs, out_specs=out_specs,
-                check_vma=False,
-            ))
-
-        self._kernel = _build(_flat_attempt_body, out_one)
-        self._sweep_kernel = _build(_flat_sweep_body, out_one + (P(),) + out_one)
+    def _kernel(self, body, name: str):
+        return cached_shard_kernel(
+            self, body, name, self.num_planes,
+            in_specs=(P(VERTEX_AXIS, None), P(VERTEX_AXIS), P(), P()),
+            static_kwargs=dict(num_planes=self.num_planes,
+                               max_degree=self.arrays.max_degree,
+                               max_steps=self.max_steps),
+        )
 
     def attempt(self, k: int) -> AttemptResult:
         if k < 1:
             return empty_budget_failure(self.v_true, k)
-        k_eff = clamp_budget(k, 32 * self.num_planes)
-        colors, steps, status = self._kernel(self.nbrs, self.deg_l, self.deg_g, k_eff)
+        k_eff = clamp_budget(k, 32 * num_planes_for(self.arrays.max_degree + 1))
+        (colors, steps, _), status = run_windowed(
+            lambda: self._kernel(_flat_attempt_body, "attempt")(
+                self.nbrs, self.deg_l, self.deg_g, k_eff),
+            self._maybe_widen_window,
+        )
         return AttemptResult(
-            AttemptStatus(int(status)),
-            np.asarray(colors)[: self.v_true],
-            int(steps),
+            status,
+            fetch_global(colors)[: self.v_true],
+            int(fetch_global(steps)),
             int(k),
         )
 
     def sweep(self, k0: int) -> tuple[AttemptResult, AttemptResult | None]:
         """Fused jump-mode pair in one device call (contract of
         ``CompactFrontierEngine.sweep``: bit-identical to two ``attempt``
-        calls)."""
+        calls; STALLED confirm falls back to ``attempt``)."""
         if k0 < 1:
             return self.attempt(k0), None
-        k_eff = clamp_budget(k0, 32 * self.num_planes)
-        c1, steps1, status1, used, c2, steps2, status2 = self._sweep_kernel(
-            self.nbrs, self.deg_l, self.deg_g, k_eff
+        k_eff = clamp_budget(k0, 32 * num_planes_for(self.arrays.max_degree + 1))
+        outs, status1 = run_windowed(
+            lambda: self._kernel(_flat_sweep_body, "sweep")(
+                self.nbrs, self.deg_l, self.deg_g, k_eff),
+            self._maybe_widen_window, status_index=2,
         )
-        first = AttemptResult(AttemptStatus(int(status1)),
-                              np.asarray(c1)[: self.v_true], int(steps1), int(k0))
+        c1, steps1, _, used, c2, steps2, status2 = outs
+        first = AttemptResult(status1, fetch_global(c1)[: self.v_true],
+                              int(fetch_global(steps1)), int(k0))
         return finish_sweep_pair(
             first, used, status2,
-            lambda k2: AttemptResult(AttemptStatus(int(status2)),
-                                     np.asarray(c2)[: self.v_true],
-                                     int(steps2), k2),
+            lambda k2: AttemptResult(AttemptStatus(int(fetch_global(status2))),
+                                     fetch_global(c2)[: self.v_true],
+                                     int(fetch_global(steps2)), k2),
             self.v_true, self.attempt,
         )

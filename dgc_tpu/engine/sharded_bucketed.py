@@ -40,15 +40,18 @@ after which ``attempt``/``sweep`` widen the cap and retry
 from __future__ import annotations
 
 from dataclasses import dataclass
-from functools import partial
-
 import jax
 import jax.numpy as jnp
 import numpy as np
 from jax.sharding import NamedSharding, PartitionSpec as P
 
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus, empty_budget_failure
-from dgc_tpu.engine.fused import device_sweep_pair, finish_sweep_pair
+from dgc_tpu.engine.fused import (
+    cached_shard_kernel,
+    device_sweep_pair,
+    finish_sweep_pair,
+    run_windowed,
+)
 from dgc_tpu.engine.bucketed import (
     MAX_WINDOW_PLANES,
     build_degree_buckets,
@@ -60,7 +63,12 @@ from dgc_tpu.engine.bucketed import (
     status_step,
 )
 from dgc_tpu.models.arrays import GraphArrays
-from dgc_tpu.parallel.mesh import VERTEX_AXIS, make_mesh, pad_to_multiple
+from dgc_tpu.parallel.mesh import (
+    VERTEX_AXIS,
+    fetch_global,
+    make_mesh,
+    pad_to_multiple,
+)
 
 _RUNNING = AttemptStatus.RUNNING
 _STALLED = AttemptStatus.STALLED
@@ -218,26 +226,17 @@ class ShardedBucketedEngine:
             return False
         self._window_cap *= 2
         self.planes = bucket_planes(self.tables, max_planes=self._window_cap)
+        self._kernels.clear()  # stale executables would pin device memory
         return True
 
     def _kernel(self, body, name: str):
-        key = (name, self.planes)
-        if key not in self._kernels:
-            fn = partial(body, planes=self.planes, max_steps=self.max_steps,
-                         v_final=self.layout.v_final)
-            nt = len(self.tables)
-            out_one = (P(VERTEX_AXIS), P(), P())
-            sm = jax.shard_map(
-                fn,
-                mesh=self.mesh,
-                in_specs=(tuple(P(VERTEX_AXIS, None) for _ in range(nt)),
-                          P(VERTEX_AXIS), P()),
-                out_specs=out_one if name == "attempt"
-                else out_one + (P(),) + out_one,
-                check_vma=False,
-            )
-            self._kernels[key] = jax.jit(sm)
-        return self._kernels[key]
+        return cached_shard_kernel(
+            self, body, name, self.planes,
+            in_specs=(tuple(P(VERTEX_AXIS, None) for _ in self.tables),
+                      P(VERTEX_AXIS), P()),
+            static_kwargs=dict(planes=self.planes, max_steps=self.max_steps,
+                               v_final=self.layout.v_final),
+        )
 
     def _finish(self, colors_final: np.ndarray, status, steps: int,
                 k: int) -> AttemptResult:
@@ -249,14 +248,12 @@ class ShardedBucketedEngine:
     def attempt(self, k: int) -> AttemptResult:
         if k < 1:
             return empty_budget_failure(self.arrays.num_vertices, k)
-        while True:  # window-cap retry loop (STALLED + capped hub buckets)
-            kern = self._kernel(_shard_attempt_body, "attempt")
-            colors_f, steps, status = kern(self.tables, self.deg_l, k)
-            status = AttemptStatus(int(status))
-            if status == AttemptStatus.STALLED and self._maybe_widen_windows():
-                continue
-            break
-        return self._finish(np.asarray(colors_f), status, int(steps), k)
+        (colors_f, steps, _), status = run_windowed(
+            lambda: self._kernel(_shard_attempt_body, "attempt")(
+                self.tables, self.deg_l, k),
+            self._maybe_widen_windows,
+        )
+        return self._finish(fetch_global(colors_f), status, int(fetch_global(steps)), k)
 
     def sweep(self, k0: int) -> tuple[AttemptResult, AttemptResult | None]:
         """Fused jump-mode pair in one device call (see
@@ -264,19 +261,16 @@ class ShardedBucketedEngine:
         two ``attempt`` calls, STALLED confirm falls back to ``attempt``)."""
         if k0 < 1:
             return self.attempt(k0), None
-        while True:
-            kern = self._kernel(_shard_sweep_body, "sweep")
-            c1, steps1, status1, used, c2, steps2, status2 = kern(
-                self.tables, self.deg_l, k0
-            )
-            status1 = AttemptStatus(int(status1))
-            if status1 == AttemptStatus.STALLED and self._maybe_widen_windows():
-                continue
-            break
-        first = self._finish(np.asarray(c1), status1, int(steps1), k0)
+        outs, status1 = run_windowed(
+            lambda: self._kernel(_shard_sweep_body, "sweep")(
+                self.tables, self.deg_l, k0),
+            self._maybe_widen_windows, status_index=2,
+        )
+        c1, steps1, _, used, c2, steps2, status2 = outs
+        first = self._finish(fetch_global(c1), status1, int(fetch_global(steps1)), k0)
         return finish_sweep_pair(
             first, used, status2,
-            lambda k2: self._finish(np.asarray(c2),
-                                    AttemptStatus(int(status2)), int(steps2), k2),
+            lambda k2: self._finish(fetch_global(c2),
+                                    AttemptStatus(int(fetch_global(status2))), int(fetch_global(steps2)), k2),
             self.arrays.num_vertices, self.attempt,
         )

@@ -58,9 +58,11 @@ void radix_sort_keyed(std::vector<std::pair<uint64_t, uint32_t>>& a) {
   std::vector<std::pair<uint64_t, uint32_t>> tmp(n);
   auto* src = a.data();
   auto* dst = tmp.data();
+  // heap histogram: 512 KB would be unsafe on small-stack threads
+  std::vector<size_t> count(65536);
   for (int pass = 0; pass < 4; ++pass) {
     const int shift = pass * 16;
-    size_t count[65536] = {0};
+    std::fill(count.begin(), count.end(), 0);
     for (size_t i = 0; i < n; ++i) count[(src[i].first >> shift) & 0xFFFF]++;
     size_t pos = 0;
     for (size_t b = 0; b < 65536; ++b) {

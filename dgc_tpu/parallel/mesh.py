@@ -36,3 +36,22 @@ def shard_rows(mesh: Mesh, *arrays):
 def replicated(mesh: Mesh, *arrays):
     sharding = NamedSharding(mesh, P())
     return tuple(jax.device_put(a, sharding) for a in arrays)
+
+
+def fetch_global(x) -> np.ndarray:
+    """Host copy of a device array that may span processes.
+
+    Single-process (and anything fully addressable) is a plain
+    ``np.asarray``. Multi-host, a replicated output is read from any local
+    shard, and a vertex-sharded output is gathered over DCN with
+    ``process_allgather`` — the reference's executors→driver ``collect()``
+    (``coloring.py:238``) mapped to the cross-host fabric. Engines call
+    this instead of ``np.asarray`` on kernel outputs so the same code runs
+    single-chip and on a multi-process slice."""
+    if getattr(x, "is_fully_addressable", True):
+        return np.asarray(x)
+    if x.sharding.is_fully_replicated:
+        return np.asarray(x.addressable_shards[0].data)
+    from jax.experimental import multihost_utils
+
+    return np.asarray(multihost_utils.process_allgather(x, tiled=True))

@@ -1,0 +1,50 @@
+"""Worker for the two-process ``jax.distributed`` smoke test.
+
+Launched twice by ``test_multihost.py`` (one subprocess per process id) with
+a localhost coordinator and the CPU backend. Executes the explicit-coordinator
+branch of ``initialize_multihost`` (``parallel/multihost.py``), then runs a
+tiny sharded k-attempt over the 2-process global mesh — the reference's
+cluster-config story (``/root/reference/coloring.py:190-199``) exercised for
+real rather than parsed.
+
+Usage: python tests/_multihost_worker.py PORT PROCESS_ID OUTDIR
+"""
+
+import json
+import os
+import sys
+
+os.environ.setdefault("JAX_PLATFORMS", "cpu")
+
+port, pid, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dgc_tpu.parallel.multihost import initialize_multihost, process_info  # noqa: E402
+
+is_multi = initialize_multihost(
+    coordinator_address=f"localhost:{port}", num_processes=2, process_id=pid
+)
+
+import jax  # noqa: E402  (backend init happens after distributed init)
+
+assert is_multi, "initialize_multihost returned False for a 2-process setup"
+info = process_info()
+assert info["process_count"] == 2, info
+assert info["global_devices"] == 2 * info["local_devices"], info
+
+from dgc_tpu.engine.base import AttemptStatus  # noqa: E402
+from dgc_tpu.engine.sharded import ShardedELLEngine  # noqa: E402
+from dgc_tpu.models.generators import generate_random_graph  # noqa: E402
+from dgc_tpu.parallel.mesh import make_mesh  # noqa: E402
+
+g = generate_random_graph(50, 5, seed=7)  # same seed on both processes
+mesh = make_mesh(len(jax.devices()))
+engine = ShardedELLEngine(g, mesh=mesh)
+res = engine.attempt(g.max_degree + 1)
+assert res.status == AttemptStatus.SUCCESS, res.status
+
+with open(os.path.join(outdir, f"result_{pid}.json"), "w") as f:
+    json.dump({"info": info, "colors": res.colors.tolist(),
+               "supersteps": res.supersteps}, f)
+print(f"worker {pid} OK: {info}")

@@ -98,3 +98,34 @@ def test_sharded_uses_requested_mesh():
     assert jax.local_device_count() >= 8
     eng = ShardedELLEngine(generate_random_graph(40, 4, seed=0), num_shards=4)
     assert eng.mesh.shape["v"] == 4
+
+
+def test_sharded_capped_window_widens_on_clique():
+    # K40 with a 1-plane (32-color) window: the capped window must defer —
+    # never assert a wrong FAILURE — then STALL, widen, and finish with 40
+    # colors (flat-engine analog of the ring engine's capped-window contract)
+    v = 40
+    edges = np.array([[i, j] for i in range(v) for j in range(i + 1, v)])
+    g = GraphArrays.from_edge_list(v, edges)
+    eng = ShardedELLEngine(g, num_shards=8, max_window_planes=1)
+    res = eng.attempt(g.max_degree + 1)
+    assert res.status == AttemptStatus.SUCCESS
+    assert res.colors_used == 40
+    assert eng.num_planes > 1  # widened
+    below = eng.attempt(39)
+    assert below.status == AttemptStatus.FAILURE
+
+
+def test_sharded_refuses_heavy_tail():
+    # a hub vertex past max_ell_width makes the flat [V, Δ] table a blowup:
+    # construction must fail fast and point at the bucketed backend
+    v = 600
+    edges = np.array([[0, j] for j in range(1, v)])
+    g = GraphArrays.from_edge_list(v, edges)
+    with pytest.raises(ValueError, match="sharded-bucketed"):
+        ShardedELLEngine(g, num_shards=2, max_ell_width=256)
+    # explicit opt-in still works and agrees with the single-device engine
+    eng = ShardedELLEngine(g, num_shards=2, max_ell_width=1024)
+    res = eng.attempt(g.max_degree + 1)
+    assert res.status == AttemptStatus.SUCCESS
+    assert np.array_equal(res.colors, ELLEngine(g).attempt(g.max_degree + 1).colors)
