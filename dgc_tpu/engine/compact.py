@@ -37,11 +37,22 @@ attempt kernel executes, inside one ``jax.jit``:
    rows are compacted on-device into one padded index list (pad =
    pow2(stage scale) — safe: flat active ≤ global active ≤ scale), their
    rows of the flat ``[V_flat+1, W_flat]`` combined table are row-gathered
-   once, and supersteps gather only ``A_pad × W_flat`` flat neighbor
-   states; hub buckets keep running their (cond-skipped) full-bucket
-   updates in the same superstep, so the stage is exact at any Δ — the
-   old all-or-nothing Δ > 256 fallback to the pure bucketed schedule is
-   gone.
+   once, and supersteps gather only the compacted rows' neighbor states;
+   hub buckets keep running their (cond-skipped) full-bucket updates in
+   the same superstep, so the stage is exact at any Δ — the old
+   all-or-nothing Δ > 256 fallback to the pure bucketed schedule is gone.
+
+   **Width-ranged slots**: compaction preserves the degree-descending
+   relabeled order, so slot i's row always belongs to a bucket at least as
+   narrow as the bucket whose *worst-case* cumulative row count first
+   covers i. The padded slot list is therefore split at static boundaries
+   ``q_b = min(cum flat-bucket sizes, A_pad)`` and each range is gathered
+   with its own clip width ``w_b`` (columns [0, w_b) of the same flat
+   table — ELL rows pack real neighbors leftmost, and a bucket-b row has
+   ≤ w_b of them). Stage gather volume drops from ``A_pad × W_flat`` to
+   ``Σ_b (q_b − q_{b-1}) · w_b`` (−44% on the 1M benchmark) with no new
+   tables and bit-identical results (each range's color window covers its
+   width, so first-fit and failure detection stay exact per row).
 
 Compaction and skipping are *exact*: a confirmed vertex can never become
 active again (demotion only applies to fresh vertices, and confirm/demote
@@ -77,7 +88,7 @@ from dgc_tpu.engine.bucketed import (
 )
 from dgc_tpu.models.arrays import GraphArrays, csr_to_ell
 from dgc_tpu.ops.bitmask import num_planes_for
-from dgc_tpu.ops.speculative import beats_rule, speculative_update
+from dgc_tpu.ops.speculative import beats_rule, speculative_update_mc
 
 _RUNNING = AttemptStatus.RUNNING
 _SUCCESS = AttemptStatus.SUCCESS
@@ -91,14 +102,66 @@ def _pow2_ceil(n: int) -> int:
 
 def default_stages(v: int) -> tuple:
     """((scale, run_down_to_threshold), ...); scale None = full-table phase.
-    A compaction stage's flat pad is ``pow2(scale)`` rows."""
+    A compaction stage's flat pad is ``pow2(scale)`` rows.
+
+    The ladder descends geometrically (÷4) to ~v/1024: high-color sweeps
+    (heavy-tail/RMAT graphs take ~2·C supersteps for C colors — the dense
+    core serializes one color class per round) spend most supersteps on a
+    tiny frontier, and a ladder stopping at v/64 makes every one of those
+    late rounds pay a 16k-row gather. The extra stage bodies compile once
+    per sweep kernel (the phase-carried loop shares them between the
+    attempt and its confirm)."""
     if v <= 1 << 14:
         return ((None, 0),)
-    return (
-        (None, v // 4),
-        (v // 4, v // 64),
-        (v // 64, 0),
-    )
+    stages = [(None, v // 4)]
+    scale = v // 4
+    while scale > max(1024, v // 1024):
+        nxt = scale // 4
+        stages.append((scale, nxt))
+        scale = nxt
+    stages.append((scale, 0))
+    return tuple(stages)
+
+
+def stage_slot_ranges(flat_sizes, flat_widths, a_pad: int) -> tuple:
+    """Static width ranges for a compaction stage's padded slot list.
+
+    Slots are filled in degree-descending relabeled order, so the row at
+    slot i belongs to flat bucket b or narrower once i ≥ cum sizes through
+    b−1 — and cum actives through b can never exceed min(cum sizes, A_pad)
+    by frontier monotonicity. Returns ``((start, stop, width, planes), …)``
+    covering [0, a_pad); trailing slots past the flat region can only hold
+    dummy rows and take the narrowest width."""
+    exact = []
+    q = cum = 0
+    for sz, w in zip(flat_sizes, flat_widths):
+        cum += int(sz)
+        q1 = min(cum, a_pad)
+        if q1 > q:
+            exact.append((q, q1, int(w)))
+            q = q1
+        if q == a_pad:
+            break
+    if q < a_pad:
+        w = int(flat_widths[-1]) if len(flat_widths) else 1
+        exact.append((q, a_pad, w))
+
+    # coalesce adjacent ranges (taking the wider width) while the volume
+    # overhead stays under 10% — one gather op per range, so dozens of
+    # exact ranges would trade compile time for negligible gather savings
+    exact_vol = sum((r1 - r0) * w for r0, r1, w in exact)
+    budget = exact_vol // 10
+    ranges = []
+    for r0, r1, w in exact:
+        if ranges:
+            p0, p1, pw = ranges[-1]
+            extra = (pw - w) * (r1 - r0)  # widths are non-increasing
+            if extra <= budget:
+                budget -= extra
+                ranges[-1] = (p0, r1, pw)
+                continue
+        ranges.append((r0, r1, w))
+    return tuple((r0, r1, w, num_planes_for(w + 1)) for r0, r1, w in ranges)
 
 
 def _bucket_fail_valid(width: int, planes: int, k):
@@ -111,15 +174,17 @@ def _bucket_fail_valid(width: int, planes: int, k):
 
 def _bucket_update(pe, pk_b, cb, p_b, k, v: int):
     """One bucket's superstep against the ``pe`` snapshot. Returns
-    (new_pk_b, valid_fail_count, active_count)."""
+    (new_pk_b, valid_fail_count, active_count, mc)."""
     w = cb.shape[1]
     nb, beats = decode_combined(cb)
     np_ = pe[: v + 1][nb]
-    new_b, fail_mask, act_mask = speculative_update(pk_b, np_, beats, k, p_b)
+    new_b, fail_mask, act_mask, mc = speculative_update_mc(
+        pk_b, np_, beats, k, p_b)
     fv = _bucket_fail_valid(w, p_b, k)
     return (new_b,
             jnp.sum(fail_mask.astype(jnp.int32)) * fv.astype(jnp.int32),
-            jnp.sum(act_mask.astype(jnp.int32)))
+            jnp.sum(act_mask.astype(jnp.int32)),
+            mc)
 
 
 def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
@@ -135,8 +200,8 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
 
     ``ba`` is int32[hub_buckets (+1 if a flat region exists)]: per-hub-bucket
     actives, then the flat-region total. Returns
-    (new_pe, fail_count, active_count, ba_new)."""
-    new_parts, parts_fail, parts_active = [], [], []
+    (new_pe, fail_count, active_count, ba_new, mc)."""
+    new_parts, parts_fail, parts_active, parts_mc = [], [], [], []
     ba_parts = []
     pk = pe[:v]
 
@@ -149,37 +214,66 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
             return _bucket_update(pe, pk_b, cb, p_b, k, v)
 
         def skip(pk_b):
-            return pk_b, jnp.int32(0), jnp.int32(0)
+            return pk_b, jnp.int32(0), jnp.int32(0), jnp.int32(-1)
 
-        new_b, f_b, a_b = jax.lax.cond(ba[bi] > 0, do, skip, pk_b)
+        new_b, f_b, a_b, m_b = jax.lax.cond(ba[bi] > 0, do, skip, pk_b)
         new_parts.append(new_b)
         parts_fail.append(f_b)
         parts_active.append(a_b)
+        parts_mc.append(m_b)
         ba_parts.append(a_b)
 
     for bi in range(hub_buckets, len(buckets)):
         cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
         pk_b = jax.lax.dynamic_slice_in_dim(pk, row0, cb.shape[0])
-        new_b, f_b, a_b = _bucket_update(pe, pk_b, cb, p_b, k, v)
+        new_b, f_b, a_b, m_b = _bucket_update(pe, pk_b, cb, p_b, k, v)
         new_parts.append(new_b)
         parts_fail.append(f_b)
         parts_active.append(a_b)
+        parts_mc.append(m_b)
     if hub_buckets < len(buckets):
         ba_parts.append(sum(parts_active[hub_buckets:]))
 
     new_pk = jnp.concatenate(new_parts)
     new_pe = jnp.concatenate([new_pk, jnp.array([-1, 0], jnp.int32)])
+    mc = parts_mc[0] if len(parts_mc) == 1 else jnp.max(jnp.stack(parts_mc))
     return (new_pe, sum(parts_fail), sum(parts_active),
-            jnp.stack(ba_parts))
+            jnp.stack(ba_parts), mc)
 
 
-def _staged_pipeline(buckets, flat_ext, degrees, k, planes: tuple,
+_REC_SLOTS = 4  # prefix-resume ring: pre-states of the last 4 record rounds
+
+
+def _default_init(degrees, init_bucket_active):
+    """Fresh-attempt carry head: (pe, step, active, stall, ba)."""
+    v = degrees.shape[0]
+    packed_ext = jnp.concatenate(
+        [initial_packed(degrees), jnp.array([-1, 0], jnp.int32)]
+    )
+    return (packed_ext, jnp.int32(1), jnp.int32(v + 1), jnp.int32(0),
+            jnp.asarray(init_bucket_active, jnp.int32))
+
+
+def _empty_rec(v: int, nb: int, dummy: bool = False):
+    """(ring_pe, ring_ba, ring_meta, count, best) — see ``_staged_pipeline``.
+    ``dummy=True`` gives 1-wide rings for kernels that statically never
+    record (the plain attempt), so no dead O(V) state rides the carries."""
+    w = 1 if dummy else v + 2
+    return (jnp.zeros((_REC_SLOTS, w), jnp.int32),
+            jnp.zeros((_REC_SLOTS, max(nb, 1)), jnp.int32),
+            jnp.full((_REC_SLOTS, 5), -1, jnp.int32),
+            jnp.int32(0), jnp.int32(-1))
+
+
+def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
+                     planes: tuple,
                      row0s: tuple, hub_buckets: int, flat_row0: int,
                      flat_planes: int, stages: tuple, max_steps: int,
-                     init_bucket_active: tuple, stall_window: int = 64):
+                     init_bucket_active: tuple, stage_ranges: tuple = (),
+                     stall_window: int = 64):
     """One whole k-attempt as a traceable pipeline: cond-skipped full-table
     phase + hybrid (flat-compacted + live-hub) compaction stages. Returns
-    (packed_ext, steps, status).
+    (packed_ext, steps, status, rec).
 
     ``buckets[b]``: int32[V_b, W_b] combined bucket table. ``flat_ext``:
     int32[V_flat+1, W_flat]
@@ -188,38 +282,72 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, planes: tuple,
     first ``hub_buckets`` buckets are the hub region.
     ``init_bucket_active`` holds the hub buckets' initial actives followed
     by the flat-region total (see ``_hybrid_superstep``). Everything except
-    ``k`` is static.
+    ``k``/``init``/``rec``/``record`` is static.
+
+    **Prefix-resume machinery** (the fused sweep's confirm shortcut):
+    ``init`` is the carry head ``(pe, step, active, stall, ba)`` — the
+    default from ``_default_init`` for a fresh attempt, or a recorded
+    pre-state to resume from (the stage thresholds re-route a resumed
+    carry into the right stage automatically, since every stage's while
+    cond gates on the carried ``active``). When ``record`` is set, each
+    superstep whose divergence candidate ``mc`` (``apply_update_mc``)
+    exceeds the best seen so far pushes its *pre*-state into the ``rec``
+    ring: a later run at budget k' transitions bit-identically until the
+    first round with mc ≥ k', so the ring entry whose (m_old, m_new]
+    bracket contains k' is exactly the state that run would reach on its
+    own after step_pre supersteps. Ring of ``_REC_SLOTS``; a bracket
+    evicted from the ring just means the caller falls back to a scratch
+    run — exact either way.
     """
     v = degrees.shape[0]
     k = jnp.asarray(k, jnp.int32)
     nb_hub = hub_buckets
     has_flat = nb_hub < len(buckets)
 
-    packed_ext = jnp.concatenate(
-        [initial_packed(degrees), jnp.array([-1, 0], jnp.int32)]
-    )
-    carry = (packed_ext, jnp.int32(1), jnp.int32(_RUNNING),
-             jnp.int32(v + 1), jnp.int32(0),
-             jnp.asarray(init_bucket_active, jnp.int32))
+    carry = (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3],
+             init[4]) + tuple(rec)
 
-    for scale, thresh in stages:
+    def recstep(rec5, pe, ba, step, prev_active, stall, mc, any_fail):
+        """Push this superstep's pre-state when it sets a new mc record."""
+        if record is False:  # statically off (plain attempt): no dead work
+            return rec5
+        rpe, rba, rmeta, cnt, best = rec5
+        push = record & (mc > best) & ~any_fail
+        slot = jnp.where(push, cnt % _REC_SLOTS, 0).astype(jnp.int32)
+        old_pe = jax.lax.dynamic_slice_in_dim(rpe, slot, 1, axis=0)[0]
+        old_ba = jax.lax.dynamic_slice_in_dim(rba, slot, 1, axis=0)[0]
+        old_meta = jax.lax.dynamic_slice_in_dim(rmeta, slot, 1, axis=0)[0]
+        meta = jnp.stack([step, best, mc, stall, prev_active])
+        rpe = jax.lax.dynamic_update_slice_in_dim(
+            rpe, jnp.where(push, pe, old_pe)[None], slot, axis=0)
+        rba = jax.lax.dynamic_update_slice_in_dim(
+            rba, jnp.where(push, ba, old_ba)[None], slot, axis=0)
+        rmeta = jax.lax.dynamic_update_slice_in_dim(
+            rmeta, jnp.where(push, meta, old_meta)[None], slot, axis=0)
+        return (rpe, rba, rmeta, cnt + push.astype(jnp.int32),
+                jnp.where(push, mc, best))
+
+    for si, (scale, thresh) in enumerate(stages):
         if scale is None:
             # --- full-table phase (hub cond-skipped, flat fused) ---
             def cond(c, thresh=thresh):
-                _, step, status, active, _, _ = c
+                step, status, active = c[1], c[2], c[3]
                 return (status == _RUNNING) & (active > thresh) & (step < max_steps)
 
             def body(c):
-                pe, step, status, prev_active, stall, ba = c
-                new_pe, fail_count, active, ba_new = _hybrid_superstep(
+                pe, step, status, prev_active, stall, ba = c[:6]
+                rec5 = c[6:]
+                new_pe, fail_count, active, ba_new, mc = _hybrid_superstep(
                     pe, ba, buckets, row0s, k, planes, v, nb_hub
                 )
                 any_fail = fail_count > 0
+                rec5 = recstep(rec5, pe, ba, step, prev_active, stall, mc,
+                               any_fail)
                 stall = jnp.where(active < prev_active, 0, stall + 1)
                 status = status_step(any_fail, active, stall, stall_window)
                 new_pe = jnp.where(any_fail, pe, new_pe)
                 ba_new = jnp.where(any_fail, ba, ba_new)
-                return (new_pe, step + 1, status, active, stall, ba_new)
+                return (new_pe, step + 1, status, active, stall, ba_new) + rec5
 
             carry = jax.lax.while_loop(cond, body, carry)
             continue
@@ -227,9 +355,15 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, planes: tuple,
         # --- hybrid compaction stage: frontier ≤ scale at entry ---
         a_pad = _pow2_ceil(scale)
         v_flat = flat_ext.shape[0] - 1
+        # width-ranged slots (see module docstring); fallback: one
+        # full-width range, the pre-range behavior
+        ranges = (stage_ranges[si] if si < len(stage_ranges)
+                  and stage_ranges[si] else
+                  ((0, a_pad, flat_ext.shape[1], flat_planes),))
 
-        def run_stage(c, a_pad=a_pad, thresh=thresh, v_flat=v_flat):
-            pe0, step0, status0, active0, stall0, ba0 = c
+        def run_stage(c, a_pad=a_pad, thresh=thresh, v_flat=v_flat,
+                      ranges=ranges):
+            pe0 = c[0]
             pk = pe0[:v]
             act = (pk < 0) | ((pk & 1) == 1)
 
@@ -240,43 +374,62 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, planes: tuple,
             scatter_pos = jnp.where(act_f & (pos < a_pad), pos, a_pad)
             idx_f = idx_f.at[scatter_pos].set(
                 jnp.arange(v_flat, dtype=jnp.int32), mode="drop")
-            comb_a = jnp.take(flat_ext, idx_f, axis=0)        # ONE row gather
-            nbrs_a, beats_a = decode_combined(comb_a)
+            # per-range row gathers, clipped to the range's width (ELL rows
+            # pack real neighbors leftmost; a range's rows have deg ≤ w_r)
+            range_tabs = []
+            for (r0, r1, w_r, p_r) in ranges:
+                comb_r = jnp.take(flat_ext[:, :w_r],
+                                  jax.lax.slice(idx_f, (r0,), (r1,)), axis=0)
+                nbrs_r, beats_r = decode_combined(comb_r)
+                range_tabs.append((r0, r1, w_r, p_r, nbrs_r, beats_r))
             gidx = jnp.where(idx_f == v_flat, v + 1, idx_f + flat_row0)
 
             def cond2(c2):
-                _, step, status, active, _, _ = c2
+                step, status, active = c2[1], c2[2], c2[3]
                 return (status == _RUNNING) & (active > thresh) & (step < max_steps)
 
             def body2(c2):
-                pe, step, status, prev_active, stall, ba = c2
+                pe, step, status, prev_active, stall, ba = c2[:6]
+                rec5 = c2[6:]
                 # BSP snapshot semantics: all reads from ``pe``; writes
                 # accumulate in ``new_pe`` over disjoint row sets
 
                 def do_flat(acc):
                     pk_a = pe[gidx]
-                    np_ = pe[nbrs_a]                 # gather [A_pad, W_flat]
-                    new_a, fail_mask, act_mask = speculative_update(
-                        pk_a, np_, beats_a, k, flat_planes
-                    )
+                    new_parts, fail_t, act_t = [], jnp.int32(0), jnp.int32(0)
+                    mcs = []
+                    for (r0, r1, w_r, p_r, nbrs_r, beats_r) in range_tabs:
+                        pk_r = jax.lax.slice(pk_a, (r0,), (r1,))
+                        np_r = pe[nbrs_r]            # gather [r1-r0, w_r]
+                        new_r, fail_mask, act_mask, mc_r = speculative_update_mc(
+                            pk_r, np_r, beats_r, k, p_r
+                        )
+                        # p_r covers w_r+1 colors, so failure is exact here
+                        new_parts.append(new_r)
+                        fail_t += jnp.sum(fail_mask.astype(jnp.int32))
+                        act_t += jnp.sum(act_mask.astype(jnp.int32))
+                        mcs.append(mc_r)
+                    new_a = (new_parts[0] if len(new_parts) == 1
+                             else jnp.concatenate(new_parts))
+                    mc = mcs[0] if len(mcs) == 1 else jnp.max(jnp.stack(mcs))
                     return (acc.at[gidx].set(new_a),  # dups only at V+1, same value
-                            jnp.sum(fail_mask.astype(jnp.int32)),
-                            jnp.sum(act_mask.astype(jnp.int32)))
+                            fail_t, act_t, mc)
 
                 def skip_any(acc):
-                    return acc, jnp.int32(0), jnp.int32(0)
+                    return acc, jnp.int32(0), jnp.int32(0), jnp.int32(-1)
 
                 if not has_flat:
-                    new_pe, fail_f, act_fl = pe, jnp.int32(0), jnp.int32(0)
+                    new_pe, fail_f, act_fl, mc_f = (
+                        pe, jnp.int32(0), jnp.int32(0), jnp.int32(-1))
                 elif nb_hub == 0:
                     # no hub: while-cond (active > thresh ≥ 0) already
                     # guarantees flat work exists — run uncond'd
-                    new_pe, fail_f, act_fl = do_flat(pe)
+                    new_pe, fail_f, act_fl, mc_f = do_flat(pe)
                 else:
-                    new_pe, fail_f, act_fl = jax.lax.cond(
+                    new_pe, fail_f, act_fl, mc_f = jax.lax.cond(
                         ba[nb_hub] > 0, do_flat, skip_any, pe)
 
-                fails, actives = [fail_f], [act_fl]
+                fails, actives, mcs_all = [fail_f], [act_fl], [mc_f]
                 ba_parts = []
                 for bi in range(nb_hub):
                     cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
@@ -284,15 +437,16 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, planes: tuple,
 
                     def do_hub(acc, cb=cb, p_b=p_b, row0=row0, vb=vb):
                         pk_b = jax.lax.dynamic_slice_in_dim(pe[:v], row0, vb)
-                        new_b, f_b, a_b = _bucket_update(
+                        new_b, f_b, a_b, m_b = _bucket_update(
                             pe, pk_b, cb, p_b, k, v)
                         return (jax.lax.dynamic_update_slice_in_dim(
-                            acc, new_b, row0, axis=0), f_b, a_b)
+                            acc, new_b, row0, axis=0), f_b, a_b, m_b)
 
-                    new_pe, f_b, a_b = jax.lax.cond(
+                    new_pe, f_b, a_b, m_b = jax.lax.cond(
                         ba[bi] > 0, do_hub, skip_any, new_pe)
                     fails.append(f_b)
                     actives.append(a_b)
+                    mcs_all.append(m_b)
                     ba_parts.append(a_b)
                 if has_flat:
                     ba_parts.append(act_fl)
@@ -300,41 +454,70 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, planes: tuple,
 
                 fail_count = sum(fails)
                 active = sum(actives)
+                mc = jnp.max(jnp.stack(mcs_all))
                 any_fail = fail_count > 0
+                rec5 = recstep(rec5, pe, ba, step, prev_active, stall, mc,
+                               any_fail)
                 stall = jnp.where(active < prev_active, 0, stall + 1)
                 status = status_step(any_fail, active, stall, stall_window)
                 new_pe = jnp.where(any_fail, pe, new_pe)
                 ba_new = jnp.where(any_fail, ba, ba_new)
-                return (new_pe, step + 1, status, active, stall, ba_new)
+                return (new_pe, step + 1, status, active, stall, ba_new) + rec5
 
             return jax.lax.while_loop(cond2, body2, c)
 
         carry = jax.lax.cond(carry[2] == _RUNNING, run_stage, lambda c: c, carry)
 
-    pe, steps, status, active, _, _ = carry
+    pe, steps, status, active = carry[0], carry[1], carry[2], carry[3]
     # fixups: nothing-to-do graphs (status never set) and step-budget exhaustion
     status = jnp.where(
         (status == _RUNNING) & (active == 0), _SUCCESS,
         jnp.where(status == _RUNNING, _STALLED, status),
     ).astype(jnp.int32)
-    return pe, steps, status
+    return pe, steps, status, tuple(carry[6:])
 
 
 _STATIC_NAMES = ("planes", "row0s", "hub_buckets", "flat_row0", "flat_planes",
-                 "stages", "max_steps", "init_bucket_active", "stall_window")
+                 "stages", "max_steps", "init_bucket_active", "stage_ranges",
+                 "stall_window")
 
-_attempt_kernel_staged = partial(jax.jit, static_argnames=_STATIC_NAMES)(
-    _staged_pipeline)
+
+@partial(jax.jit, static_argnames=_STATIC_NAMES)
+def _attempt_kernel_staged(buckets, flat_ext, degrees, k, **static_kw):
+    """Plain staged k-attempt (no recording): (pe, steps, status)."""
+    init = _default_init(degrees, static_kw["init_bucket_active"])
+    rec = _empty_rec(degrees.shape[0], len(static_kw["init_bucket_active"]),
+                     dummy=True)
+    pe, steps, status, _ = _staged_pipeline(
+        buckets, flat_ext, degrees, k, init, rec, False,
+        **static_kw)
+    return pe, steps, status
 
 
 @partial(jax.jit, static_argnames=_STATIC_NAMES)
 def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
                          row0s: tuple, hub_buckets: int, flat_row0: int,
                          flat_planes: int, stages: tuple, max_steps: int,
-                         init_bucket_active: tuple, stall_window: int = 64):
+                         init_bucket_active: tuple, stage_ranges: tuple = (),
+                         stall_window: int = 64):
     """Fused minimal-k sweep: attempt(k0), then — still on device — the
     jump-mode confirm attempt at (colors_used − 1). One dispatch for what
     jump mode otherwise does in two (PERF.md lever: ~65 ms dispatch each).
+
+    The two attempts run as a *phase-carried* ``while_loop`` whose body is
+    a single ``_staged_pipeline`` instance — the pipeline (the bulk of the
+    XLA program) is traced and compiled once, not twice; compile time of
+    the fused sweep ≈ the plain attempt kernel's.
+
+    **Prefix-resume**: attempt 1 records the pre-state of each new-max-
+    candidate superstep (``_staged_pipeline``'s rec ring). The confirm
+    attempt at k2 = used−1 transitions bit-identically to attempt 1 until
+    the first superstep whose divergence candidate reached k2, so phase 1
+    initializes from the ring entry whose (m_old, m_new] bracket contains
+    k2 and skips the shared prefix outright — typically most of the
+    confirm attempt (its steps counter continues from the snapshot, so
+    steps/status/colors all match a scratch run exactly). A ring miss
+    falls back to the scratch init.
 
     Returns (pe1, steps1, status1, used, pe2, steps2, status2); the second
     triple is the first repeated when the confirm attempt was skipped
@@ -346,20 +529,57 @@ def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
     kw = dict(planes=planes, row0s=row0s, hub_buckets=hub_buckets,
               flat_row0=flat_row0, flat_planes=flat_planes, stages=stages,
               max_steps=max_steps, init_bucket_active=init_bucket_active,
-              stall_window=stall_window)
-    pe1, steps1, status1 = _staged_pipeline(*args, k0, **kw)
-    colors1 = jnp.where(pe1[:v] >= 0, pe1[:v] >> 1, -1)
-    used = jnp.max(colors1, initial=-1) + 1
-    k2 = used - 1
+              stage_ranges=stage_ranges, stall_window=stall_window)
+    pe0 = jnp.zeros(v + 2, jnp.int32)
+    z = jnp.int32(0)
+    rec0 = _empty_rec(v, len(init_bucket_active))
+    init = (jnp.int32(0), jnp.asarray(k0, jnp.int32),
+            pe0, z, z,          # slot 1: pe1, steps1, status1
+            z,                  # used
+            pe0, z, jnp.int32(_FAILURE)) + rec0  # slot 2 (skip default)
 
-    def second(_):
-        return _staged_pipeline(*args, k2, **kw)
+    def cond(c):
+        return c[0] < 2
 
-    def skip(_):
-        return pe1, jnp.int32(0), jnp.int32(_FAILURE)
+    def body(c):
+        phase, k, pe1, steps1, status1, used, pe2, steps2, status2 = c[:9]
+        rec = c[9:]
+        first = phase == 0
 
-    run2 = (status1 == _SUCCESS) & (k2 >= 1)
-    pe2, steps2, status2 = jax.lax.cond(run2, second, skip, 0)
+        # init: scratch for phase 0; phase 1 resumes from the ring entry
+        # whose (m_old, m_new] bracket contains k (= k2), if still present
+        pe_i, step_i, act_i, stall_i, ba_i = _default_init(
+            degrees, init_bucket_active)
+        rpe, rba, rmeta, cnt, _ = rec
+        for j in range(_REC_SLOTS):
+            ok = (~first) & (j < cnt) & (rmeta[j, 1] < k) & (k <= rmeta[j, 2])
+            pe_i = jnp.where(ok, rpe[j], pe_i)
+            ba_i = jnp.where(ok, rba[j], ba_i)
+            step_i = jnp.where(ok, rmeta[j, 0], step_i)
+            stall_i = jnp.where(ok, rmeta[j, 3], stall_i)
+            act_i = jnp.where(ok, rmeta[j, 4], act_i)
+
+        pe, steps, status, rec = _staged_pipeline(
+            *args, k, (pe_i, step_i, act_i, stall_i, ba_i), rec, first, **kw)
+        colors = jnp.where(pe[:v] >= 0, pe[:v] >> 1, -1)
+        used_new = jnp.where(first, jnp.max(colors, initial=-1) + 1, used)
+        k2 = used_new - 1
+        run2 = first & (status == _SUCCESS) & (k2 >= 1)
+        sel = lambda a, b: jnp.where(first, a, b)
+        out = (
+            jnp.where(run2, 1, 2).astype(jnp.int32),
+            jnp.where(run2, k2, k).astype(jnp.int32),
+            sel(pe, pe1), sel(steps, steps1), sel(status, status1),
+            used_new,
+            # slot 2: phase 1 stores its result; phase 0 echoes attempt 1
+            # (the skipped-confirm contract; host fabricates k=0 FAILURE)
+            pe, jnp.where(first, z, steps),
+            jnp.where(first, jnp.int32(_FAILURE), status),
+        ) + tuple(rec)
+        return out
+
+    out = jax.lax.while_loop(cond, body, init)
+    (_, _, pe1, steps1, status1, used, pe2, steps2, status2) = out[:9]
     return pe1, steps1, status1, used, pe2, steps2, status2
 
 
@@ -434,6 +654,7 @@ class CompactFrontierEngine(BucketedELLEngine):
         if all(scale is None for scale, _ in self.stages):
             self.flat_ext = None
             self.flat_planes = 0
+            self.stage_ranges = ()
             return
         # flat combined table over the flat region (relabeled CSR suffix)
         w_flat = max(widths[hub:]) if hub < len(widths) else 1
@@ -451,13 +672,22 @@ class CompactFrontierEngine(BucketedELLEngine):
             np.concatenate([combined, np.full((1, w_flat), v, np.int32)])
         )
         self.flat_planes = num_planes_for(w_flat + 1)
+        # static width ranges per compaction stage (module docstring §2)
+        flat_sizes = sizes[hub:]
+        flat_widths = widths[hub:]
+        self.stage_ranges = tuple(
+            None if scale is None else
+            stage_slot_ranges(flat_sizes, flat_widths, _pow2_ceil(scale))
+            for scale, _ in self.stages
+        )
 
     def _kernel_kw(self):
         return dict(planes=self.planes, row0s=self.row0s,
                     hub_buckets=self.hub_buckets, flat_row0=self.flat_row0,
                     flat_planes=self.flat_planes, stages=self.stages,
                     max_steps=self.max_steps,
-                    init_bucket_active=self.init_bucket_active)
+                    init_bucket_active=self.init_bucket_active,
+                    stage_ranges=self.stage_ranges)
 
     def attempt(self, k: int) -> AttemptResult:
         v = self.arrays.num_vertices

@@ -64,11 +64,18 @@ def neighbor_stats(gathered, pre_beats, mycol, num_planes: int):
     return forb_all, forb_old, clash
 
 
-def apply_update(packed_local, forb_all, forb_old, clash, k):
-    """State transition from combined neighbor stats.
+def apply_update_mc(packed_local, forb_all, forb_old, clash, k):
+    """State transition from combined neighbor stats, plus the divergence
+    candidate.
 
     Returns ``(new_packed int32[Vl], fail_mask bool[Vl], active_mask
-    bool[Vl])`` — the caller reduces fail/active however its topology needs.
+    bool[Vl], mc int32)`` — the caller reduces fail/active however its
+    topology needs. ``mc`` is the max first-fit candidate any needy vertex
+    reached this superstep (−1 if none; ``DIVERGE_BIG`` when a needy
+    vertex's forbidden set covered the whole budget): a run of the same
+    superstep at a smaller budget k' < k transitions bit-identically as
+    long as ``mc < k'`` — the prefix-resume invariant ``engine.compact``
+    uses to fast-forward the fused sweep's confirm attempt.
     """
     mycol = packed_local >> 1  # arithmetic shift: −1 stays −1
     myfresh = (packed_local >= 0) & ((packed_local & 1) == 1)
@@ -92,7 +99,26 @@ def apply_update(packed_local, forb_all, forb_old, clash, k):
     ).astype(jnp.int32)
     fail_mask = needs_color & fail_old
     active_mask = (new_packed < 0) | ((new_packed & 1) == 1)
-    return new_packed, fail_mask, active_mask
+    mc = jnp.max(
+        jnp.where(needs_color,
+                  jnp.where(nofree_all, jnp.int32(DIVERGE_BIG), cand),
+                  -1),
+        initial=-1,
+    ).astype(jnp.int32)
+    return new_packed, fail_mask, active_mask, mc
+
+
+def apply_update(packed_local, forb_all, forb_old, clash, k):
+    """State transition from combined neighbor stats (no divergence
+    tracking — see ``apply_update_mc``).
+
+    Returns ``(new_packed int32[Vl], fail_mask bool[Vl], active_mask
+    bool[Vl])`` — the caller reduces fail/active however its topology needs.
+    """
+    return apply_update_mc(packed_local, forb_all, forb_old, clash, k)[:3]
+
+
+DIVERGE_BIG = 1 << 30  # "candidate" stand-in for a full forbidden window
 
 
 def speculative_update(packed_local, gathered, pre_beats, k, num_planes: int):
@@ -112,3 +138,11 @@ def speculative_update(packed_local, gathered, pre_beats, k, num_planes: int):
     mycol = packed_local >> 1
     forb_all, forb_old, clash = neighbor_stats(gathered, pre_beats, mycol, num_planes)
     return apply_update(packed_local, forb_all, forb_old, clash, k)
+
+
+def speculative_update_mc(packed_local, gathered, pre_beats, k, num_planes: int):
+    """``speculative_update`` + the divergence candidate (``apply_update_mc``).
+    Returns ``(new_packed, fail_mask, active_mask, mc)``."""
+    mycol = packed_local >> 1
+    forb_all, forb_old, clash = neighbor_stats(gathered, pre_beats, mycol, num_planes)
+    return apply_update_mc(packed_local, forb_all, forb_old, clash, k)

@@ -126,16 +126,17 @@ def test_default_stages_large():
     from dgc_tpu.engine.compact import default_stages
 
     st = default_stages(1_000_000)
-    assert st == (
-        (None, 250_000),
-        (250_000, 15_625),
-        (15_625, 0),
-    )
+    # geometric ÷4 ladder from v/4 down to ~v/1024 (tiny late frontiers on
+    # high-color graphs must not keep paying big pads)
+    assert st[0] == (None, 250_000)
+    assert st[-1][1] == 0
+    assert len(st) >= 4
     # every stage's scale bounds the frontier at its entry
     bound = 1_000_000
     for scale, thresh in st:
         if scale is not None:
             assert scale >= bound
+        assert thresh < bound
         bound = thresh
 
 
@@ -158,6 +159,10 @@ def test_sweep_pair_matches_two_attempts(medium_graph):
     assert second.k == r1.colors_used - 1
     assert second.status == r2.status
     assert np.array_equal(second.colors, r2.colors)
+    # prefix-resume runs THROUGH the forced compaction stages here; the
+    # continued step counter must still match the scratch confirm exactly
+    assert first.supersteps == r1.supersteps
+    assert second.supersteps == r2.supersteps
 
 
 def test_minimal_k_uses_fused_sweep(medium_graph, monkeypatch):
@@ -262,3 +267,47 @@ def test_compact_window_cap_retry_bucketed_schedule():
     assert first.status == AttemptStatus.SUCCESS and first.colors_used == 40
     assert second.status == AttemptStatus.FAILURE
     assert eng._window_cap > 1
+
+
+def test_stage_slot_ranges_cover_and_bound():
+    from dgc_tpu.engine.compact import stage_slot_ranges
+
+    sizes = [9, 132, 2104, 20193, 109454, 302203, 372747, 171048, 21717, 393]
+    widths = [40, 36, 32, 28, 24, 20, 16, 12, 8, 4]
+    for a_pad in (1 << 12, 1 << 18, 1 << 20):
+        ranges = stage_slot_ranges(sizes, widths, a_pad)
+        # contiguous cover of [0, a_pad)
+        assert ranges[0][0] == 0 and ranges[-1][1] == a_pad
+        for (r0, r1, w, p) in ranges:
+            assert r1 > r0 and 32 * p >= w + 1
+        for a, b in zip(ranges, ranges[1:]):
+            assert a[1] == b[0]
+            assert a[2] >= b[2]  # widths non-increasing
+        # range b's width covers every row that can land in its slots:
+        # slot i >= cum sizes through bucket j-1  =>  row from bucket >= j
+        cum = 0
+        bi = 0
+        for (r0, r1, w, _) in ranges:
+            # the widest row reachable at slot r0 is from the first bucket
+            # whose cumulative size exceeds r0
+            while bi < len(sizes) and cum + sizes[bi] <= r0:
+                cum += sizes[bi]
+                bi += 1
+            if bi < len(widths):
+                assert w >= widths[bi]
+
+
+def test_sweep_prefix_resume_steps_match_scratch():
+    # the fused sweep's confirm attempt resumes from a recorded prefix;
+    # its superstep count must still equal a scratch attempt's (the resume
+    # continues the step counter from the snapshot)
+    g = generate_random_graph(3000, 10, seed=11)
+    eng = _forced_compact(g)  # resume must re-route through real stages
+    first, second = eng.sweep(g.max_degree + 1)
+    scratch = _forced_compact(g)
+    r1 = scratch.attempt(g.max_degree + 1)
+    r2 = scratch.attempt(r1.colors_used - 1)
+    assert first.supersteps == r1.supersteps
+    assert second is not None and second.supersteps == r2.supersteps
+    assert second.status == r2.status
+    assert np.array_equal(second.colors, r2.colors)
