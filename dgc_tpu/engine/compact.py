@@ -104,23 +104,22 @@ def default_stages(v: int) -> tuple:
     """((scale, run_down_to_threshold), ...); scale None = full-table phase.
     A compaction stage's flat pad is ``pow2(scale)`` rows.
 
-    The ladder descends geometrically (÷4) to ~v/1024: high-color sweeps
-    (heavy-tail/RMAT graphs take ~2·C supersteps for C colors — the dense
-    core serializes one color class per round) spend most supersteps on a
-    tiny frontier, and a ladder stopping at v/64 makes every one of those
-    late rounds pay a 16k-row gather. The extra stage bodies compile once
-    per sweep kernel (the phase-carried loop shares them between the
-    attempt and its confirm)."""
+    Three rungs with widening ratios (v/4 → v/16 → v/256): high-color
+    sweeps (heavy-tail/RMAT graphs take ~2·C supersteps for C colors —
+    the dense core serializes one color class per round) spend most
+    supersteps on a tiny frontier, and a ladder stopping at v/64 made
+    every late round pay a 16k-row gather; the v/256 rung gets those
+    rounds onto ~4k pads. More rungs than this measured ≈ nothing on
+    either graph family (the flat region is inert for the heavy-tail
+    long tail) while each extra rung is another compiled stage body."""
     if v <= 1 << 14:
         return ((None, 0),)
-    stages = [(None, v // 4)]
-    scale = v // 4
-    while scale > max(1024, v // 1024):
-        nxt = scale // 4
-        stages.append((scale, nxt))
-        scale = nxt
-    stages.append((scale, 0))
-    return tuple(stages)
+    return (
+        (None, v // 4),
+        (v // 4, v // 16),
+        (v // 16, v // 256),
+        (v // 256, 0),
+    )
 
 
 def stage_slot_ranges(flat_sizes, flat_widths, a_pad: int) -> tuple:
@@ -148,7 +147,9 @@ def stage_slot_ranges(flat_sizes, flat_widths, a_pad: int) -> tuple:
 
     # coalesce adjacent ranges (taking the wider width) while the volume
     # overhead stays under 10% — one gather op per range, so dozens of
-    # exact ranges would trade compile time for negligible gather savings
+    # exact ranges would trade compile time for negligible gather savings;
+    # then force down to ``max_ranges`` (cheapest merges first) so a wide
+    # bucket ladder (RMAT W_flat=256) can't explode the stage body
     exact_vol = sum((r1 - r0) * w for r0, r1, w in exact)
     budget = exact_vol // 10
     ranges = []
@@ -161,6 +162,14 @@ def stage_slot_ranges(flat_sizes, flat_widths, a_pad: int) -> tuple:
                 ranges[-1] = (p0, r1, pw)
                 continue
         ranges.append((r0, r1, w))
+    max_ranges = 6
+    while len(ranges) > max_ranges:
+        costs = [(ranges[i][2] - ranges[i + 1][2])
+                 * (ranges[i + 1][1] - ranges[i + 1][0])
+                 for i in range(len(ranges) - 1)]
+        i = costs.index(min(costs))
+        ranges[i] = (ranges[i][0], ranges[i + 1][1], ranges[i][2])
+        del ranges[i + 1]
     return tuple((r0, r1, w, num_planes_for(w + 1)) for r0, r1, w in ranges)
 
 
@@ -597,9 +606,13 @@ class CompactFrontierEngine(BucketedELLEngine):
     # hub/flat split: a bucket joins the flat region only if its width is
     # ≤ FLAT_CAP *and* the flat table (rows × widest flat width) stays
     # under FLAT_BUDGET entries — the O(V·Δ) blowup guard, now per-region
-    # instead of an engine-wide fallback
+    # instead of an engine-wide fallback. The budget is worth spending:
+    # a mid-wide bucket (e.g. 128-wide × 500k rows on a 4M RMAT graph)
+    # that lands in the hub runs as a cond'd FULL-bucket update for as
+    # long as any of its rows is active — in the flat region its rows
+    # compact away with the frontier instead.
     FLAT_CAP = 256
-    FLAT_BUDGET = 1 << 28  # table entries (×4 B = 1 GiB)
+    FLAT_BUDGET = 1 << 29  # table entries (×4 B = 2 GiB)
 
     def __init__(self, arrays: GraphArrays, max_steps: int | None = None,
                  min_width: int = 4, stages: tuple | None = None,

@@ -126,8 +126,9 @@ def test_default_stages_large():
     from dgc_tpu.engine.compact import default_stages
 
     st = default_stages(1_000_000)
-    # geometric ÷4 ladder from v/4 down to ~v/1024 (tiny late frontiers on
-    # high-color graphs must not keep paying big pads)
+    # 3-rung ladder v/4 → v/16 → v/256 (tiny late frontiers on high-color
+    # graphs must not keep paying big pads; deeper rungs measured ≈ nothing
+    # while costing a compiled stage body each)
     assert st[0] == (None, 250_000)
     assert st[-1][1] == 0
     assert len(st) >= 4
