@@ -100,7 +100,8 @@ class DegreeBuckets:
     combined: list[np.ndarray]       # int32[Vb, Wb]
 
 
-def build_degree_buckets(arrays: GraphArrays, min_width: int = 4) -> DegreeBuckets:
+def build_degree_buckets(arrays: GraphArrays, min_width: int = 4,
+                         native: bool | None = None) -> DegreeBuckets:
     v = arrays.num_vertices
     if v >= 1 << BEATS_BIT:
         raise ValueError(f"V={v} exceeds combined-table id capacity 2^{BEATS_BIT}")
@@ -111,15 +112,30 @@ def build_degree_buckets(arrays: GraphArrays, min_width: int = 4) -> DegreeBucke
     inv = np.empty(v, dtype=np.int32)
     inv[perm] = np.arange(v, dtype=np.int32)
 
-    # relabeled CSR, fully vectorized: entries keyed by (new_row, new_col)
-    rows_old = np.repeat(np.arange(v, dtype=np.int64), degrees_old)
-    new_row = inv[rows_old].astype(np.int64)
-    new_col = inv[arrays.indices].astype(np.int64)
-    order = np.argsort(new_row * v + new_col, kind="stable")
-    new_indices = new_col[order].astype(np.int32)
+    # relabeled CSR: prefer the native per-row relabel (the 16M-entry
+    # global argsort is the host-side hot spot at 1M+, PERF.md); the
+    # NumPy path is the reference implementation and the fallback
     deg_new = degrees_old[perm].astype(np.int32)
     new_indptr = np.zeros(v + 1, dtype=np.int64)
     np.cumsum(deg_new, out=new_indptr[1:])
+    # native=None auto-selects by size (the generators' convention);
+    # native=True forces the C++ path (tests), False forces NumPy
+    if native is None:
+        native = len(arrays.indices) >= 1_000_000
+    relabeled = None
+    if native:
+        from dgc_tpu.native.bindings import relabel_csr_native
+
+        relabeled = relabel_csr_native(arrays.indptr, arrays.indices, perm)
+    if relabeled is not None:
+        new_indices = relabeled[1]
+    else:
+        # fully vectorized: entries keyed by (new_row, new_col)
+        rows_old = np.repeat(np.arange(v, dtype=np.int64), degrees_old)
+        new_row = inv[rows_old].astype(np.int64)
+        new_col = inv[arrays.indices].astype(np.int64)
+        order = np.argsort(new_row * v + new_col, kind="stable")
+        new_indices = new_col[order].astype(np.int32)
 
     deg_pad = np.concatenate([deg_new, np.array([-1], np.int32)])
 

@@ -59,6 +59,21 @@ def _load():
         except OSError:
             _load_failed = True
             return None
+        # a cached .so built from older source can pass the mtime check yet
+        # miss newer symbols (deploys that preserve source mtimes); rebuild
+        # once, and keep the silent-fallback contract if that fails too
+        if not hasattr(lib, "dgc_relabel_csr"):
+            if not _build():
+                _load_failed = True
+                return None
+            try:
+                lib = ctypes.CDLL(str(_LIB))
+            except OSError:
+                _load_failed = True
+                return None
+            if not hasattr(lib, "dgc_relabel_csr"):
+                _load_failed = True
+                return None
         lib.dgc_generate_fast.restype = ctypes.c_void_p
         lib.dgc_generate_fast.argtypes = [
             ctypes.c_int64, ctypes.c_double, ctypes.c_uint64, ctypes.c_int32,
@@ -71,6 +86,13 @@ def _load():
         lib.dgc_generate_rmat.argtypes = [
             ctypes.c_int64, ctypes.c_double, ctypes.c_uint64,
             ctypes.c_double, ctypes.c_double, ctypes.c_double, ctypes.c_int32,
+        ]
+        lib.dgc_relabel_csr.restype = ctypes.c_void_p
+        lib.dgc_relabel_csr.argtypes = [
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
         ]
         lib.dgc_num_vertices.restype = ctypes.c_int64
         lib.dgc_num_vertices.argtypes = [ctypes.c_void_p]
@@ -136,6 +158,27 @@ def generate_reference_native(node_count: int, max_degree: int, seed: int | None
         -1 if max_retries_per_vertex is None else max_retries_per_vertex,
     )
     return _extract(lib, h)
+
+
+def relabel_csr_native(indptr: np.ndarray, indices: np.ndarray,
+                       perm: np.ndarray):
+    """Degree-descending CSR relabel (row nr = old row perm[nr], neighbor
+    ids mapped through inv(perm), sorted ascending) — bit-identical to the
+    NumPy path in ``engine.bucketed.build_degree_buckets``. Returns
+    ``(new_indptr int32[V+1], new_indices int32[E])`` or None when the
+    native library is unavailable or fails."""
+    lib = _load()
+    if lib is None:
+        return None
+    v = int(indptr.shape[0]) - 1
+    h = lib.dgc_relabel_csr(
+        v,
+        np.ascontiguousarray(indptr, dtype=np.int32),
+        np.ascontiguousarray(indices, dtype=np.int32),
+        np.ascontiguousarray(perm, dtype=np.int32),
+    )
+    g = _extract(lib, h)
+    return None if g is None else (g.indptr, g.indices)
 
 
 def generate_rmat_native(node_count: int, avg_degree: float, seed: int | None = None,

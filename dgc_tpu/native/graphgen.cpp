@@ -19,6 +19,7 @@
 #include <algorithm>
 #include <cstdint>
 #include <cstring>
+#include <memory>
 #include <random>
 #include <unordered_set>
 #include <vector>
@@ -244,6 +245,39 @@ void* dgc_generate_rmat(int64_t node_count, double avg_degree, uint64_t seed,
   dedup_edges(node_count, edges);
   if (max_degree >= 0) greedy_cap(node_count, edges, max_degree);
   return new DgcGraph(build_csr(node_count, edges));
+  DGC_GUARD_END
+}
+
+// Degree-descending CSR relabel for the bucketed engines: row nr of the
+// output is old row perm[nr] with neighbor ids mapped through inv(perm)
+// and sorted ascending — the same result as the NumPy path's global
+// (new_row, new_col) argsort, but via per-row copy+sort (rows are short;
+// no 16M-entry global sort). The hot host-side step of engine build.
+void* dgc_relabel_csr(int64_t v, const int32_t* indptr, const int32_t* indices,
+                      const int32_t* perm) {
+  DGC_GUARD_BEGIN
+  std::vector<int32_t> inv(v);
+  for (int64_t nr = 0; nr < v; ++nr) inv[perm[nr]] = (int32_t)nr;
+  // unique_ptr: a bad_alloc mid-build (the multi-GB case the guard exists
+  // for) must not leak the partially built graph
+  auto g = std::make_unique<DgcGraph>();
+  g->num_vertices = v;
+  g->indptr.resize(v + 1);
+  g->indptr[0] = 0;
+  for (int64_t nr = 0; nr < v; ++nr) {
+    int32_t u = perm[nr];
+    g->indptr[nr + 1] = g->indptr[nr] + (indptr[u + 1] - indptr[u]);
+  }
+  g->indices.resize(g->indptr[v]);
+  for (int64_t nr = 0; nr < v; ++nr) {
+    int32_t u = perm[nr];
+    int32_t* out = g->indices.data() + g->indptr[nr];
+    const int32_t* in = indices + indptr[u];
+    const int32_t d = indptr[u + 1] - indptr[u];
+    for (int32_t j = 0; j < d; ++j) out[j] = inv[in[j]];
+    std::sort(out, out + d);
+  }
+  return g.release();
   DGC_GUARD_END
 }
 
