@@ -196,6 +196,77 @@ def _bucket_update(pe, pk_b, cb, p_b, k, v: int):
             mc)
 
 
+def _compact_idx(act, pad: int, n: int):
+    """Compacted index list of the ≤ ``pad`` active positions of ``act``
+    (bool[n]); unused slots hold the dummy index ``n``. The exactness-
+    critical slot-compaction idiom, shared by the flat stages and the hub
+    row compaction so the two cannot drift."""
+    pos = jnp.cumsum(act.astype(jnp.int32)) - 1
+    idx = jnp.full((pad,), n, jnp.int32)
+    scatter_pos = jnp.where(act & (pos < pad), pos, pad)
+    return idx.at[scatter_pos].set(
+        jnp.arange(act.shape[0], dtype=jnp.int32), mode="drop")
+
+
+def hub_pad_for(rows: int) -> int:
+    """Row-compaction pad for a big hub bucket (0 = never compact): big
+    buckets (> 2·pad rows) get a compacted branch used once their live
+    count fits the pad — on power-law graphs the mid-wide hub buckets
+    stay live for most of the sweep with only a sliver of rows active."""
+    pad = _pow2_ceil(max(rows // 8, 256))
+    return pad if rows > 2 * pad else 0
+
+
+def _bucket_update_compact(pe, pk_b, cb, p_b, k, v: int, pad: int):
+    """``_bucket_update`` on the bucket's ≤ ``pad`` active rows only.
+
+    Exact when the bucket's live count ≤ pad (the caller's cond gate;
+    monotone by frontier monotonicity): inactive rows transition to
+    themselves, so updating only active rows is the same superstep.
+    Dummy slots carry confirmed-0 state (inert: no fail/active/mc
+    contribution) and their writes scatter out of range (dropped)."""
+    vb = cb.shape[0]
+    act_b = (pk_b < 0) | ((pk_b & 1) == 1)
+    idx = _compact_idx(act_b, pad, vb)
+    real = idx < vb
+    idx_safe = jnp.where(real, idx, 0)
+    pk_slot = jnp.where(real, pk_b[idx_safe], 0)  # dummies: confirmed 0
+    cb_slot = jnp.take(cb, idx_safe, axis=0)      # [pad, W_b] row gather
+    nb, beats = decode_combined(cb_slot)
+    np_ = pe[: v + 1][nb]
+    new_slot, fail_mask, act_mask, mc = speculative_update_mc(
+        pk_slot, np_, beats, k, p_b)
+    fv = _bucket_fail_valid(cb.shape[1], p_b, k)
+    new_b = pk_b.at[idx].set(new_slot, mode="drop")  # dummies (= vb) drop
+    return (new_b,
+            jnp.sum(fail_mask.astype(jnp.int32)) * fv.astype(jnp.int32),
+            jnp.sum(act_mask.astype(jnp.int32)),
+            mc)
+
+
+def _hub_dispatch(pe, ba_bi, pk_b, cb, p_b, k, v: int):
+    """Cond ladder for one hub bucket: inert → skip; small live count →
+    compacted rows; else full bucket. Returns (new_pk_b, fail, act, mc)."""
+    pad = hub_pad_for(cb.shape[0])
+
+    def full(pk_b):
+        return _bucket_update(pe, pk_b, cb, p_b, k, v)
+
+    def skip(pk_b):
+        return pk_b, jnp.int32(0), jnp.int32(0), jnp.int32(-1)
+
+    if pad == 0:
+        return jax.lax.cond(ba_bi > 0, full, skip, pk_b)
+
+    def compact(pk_b):
+        return _bucket_update_compact(pe, pk_b, cb, p_b, k, v, pad)
+
+    def live(pk_b):
+        return jax.lax.cond(ba_bi <= pad, compact, full, pk_b)
+
+    return jax.lax.cond(ba_bi > 0, live, skip, pk_b)
+
+
 def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
                       hub_buckets: int):
     """One full-table superstep. The first ``hub_buckets`` buckets (the hub
@@ -218,14 +289,7 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
         cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
         vb = cb.shape[0]
         pk_b = jax.lax.dynamic_slice_in_dim(pk, row0, vb)
-
-        def do(pk_b, cb=cb, p_b=p_b):
-            return _bucket_update(pe, pk_b, cb, p_b, k, v)
-
-        def skip(pk_b):
-            return pk_b, jnp.int32(0), jnp.int32(0), jnp.int32(-1)
-
-        new_b, f_b, a_b, m_b = jax.lax.cond(ba[bi] > 0, do, skip, pk_b)
+        new_b, f_b, a_b, m_b = _hub_dispatch(pe, ba[bi], pk_b, cb, p_b, k, v)
         new_parts.append(new_b)
         parts_fail.append(f_b)
         parts_active.append(a_b)
@@ -378,11 +442,7 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
 
             # compact the flat region's active rows (safe: ≤ scale ≤ a_pad)
             act_f = jax.lax.slice(act, (flat_row0,), (v,))
-            pos = jnp.cumsum(act_f.astype(jnp.int32)) - 1
-            idx_f = jnp.full((a_pad,), v_flat, jnp.int32)     # dummy row
-            scatter_pos = jnp.where(act_f & (pos < a_pad), pos, a_pad)
-            idx_f = idx_f.at[scatter_pos].set(
-                jnp.arange(v_flat, dtype=jnp.int32), mode="drop")
+            idx_f = _compact_idx(act_f, a_pad, v_flat)
             # per-range row gathers, clipped to the range's width (ELL rows
             # pack real neighbors leftmost; a range's rows have deg ≤ w_r)
             range_tabs = []
@@ -444,10 +504,13 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                     cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
                     vb = cb.shape[0]
 
-                    def do_hub(acc, cb=cb, p_b=p_b, row0=row0, vb=vb):
+                    # slice + write-back stay inside the cond: an inert hub
+                    # bucket must cost *nothing* per superstep (module
+                    # docstring invariant), not an O(rows) copy
+                    def do_hub(acc, cb=cb, p_b=p_b, row0=row0, vb=vb, bi=bi):
                         pk_b = jax.lax.dynamic_slice_in_dim(pe[:v], row0, vb)
-                        new_b, f_b, a_b, m_b = _bucket_update(
-                            pe, pk_b, cb, p_b, k, v)
+                        new_b, f_b, a_b, m_b = _hub_dispatch(
+                            pe, ba[bi], pk_b, cb, p_b, k, v)
                         return (jax.lax.dynamic_update_slice_in_dim(
                             acc, new_b, row0, axis=0), f_b, a_b, m_b)
 

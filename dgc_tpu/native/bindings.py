@@ -25,15 +25,22 @@ _lib = None
 _load_failed = False
 
 
-def _build() -> bool:
+def _build(load_path: str | None = None) -> bool:
     # -O3 without -march=native: the .so is machine-local (gitignored), but a
     # copied tree must never SIGILL on an older CPU — portable codegen only.
     # pid-unique tmp: concurrent processes may build simultaneously; each
-    # os.replace then installs a complete library, never a half-written one
+    # os.replace then installs a complete library, never a half-written one.
+    # ``load_path``: additionally leave a copy at this DISTINCT path — dlopen
+    # of the canonical path returns the already-mapped stale object when one
+    # is loaded, so a rebuild-recovery must load from a fresh name.
     tmp = f"{_LIB}.{os.getpid()}.tmp"
     cmd = ["g++", "-O3", "-shared", "-fPIC", str(_SRC), "-o", tmp]
     try:
         subprocess.run(cmd, check=True, capture_output=True, timeout=120)
+        if load_path is not None:
+            import shutil
+
+            shutil.copy2(tmp, load_path)
         os.replace(tmp, _LIB)
         return True
     except Exception:
@@ -61,16 +68,24 @@ def _load():
             return None
         # a cached .so built from older source can pass the mtime check yet
         # miss newer symbols (deploys that preserve source mtimes); rebuild
-        # once, and keep the silent-fallback contract if that fails too
+        # once and load via a distinct pid-unique path — re-dlopening the
+        # canonical path would return the already-mapped stale object.
+        # Keep the silent-fallback contract if recovery fails too.
         if not hasattr(lib, "dgc_relabel_csr"):
-            if not _build():
+            fresh = f"{_LIB}.{os.getpid()}.reload"
+            if not _build(load_path=fresh):
                 _load_failed = True
                 return None
             try:
-                lib = ctypes.CDLL(str(_LIB))
+                lib = ctypes.CDLL(fresh)
             except OSError:
                 _load_failed = True
                 return None
+            finally:
+                try:
+                    os.unlink(fresh)  # mapping persists; dirent can go
+                except OSError:
+                    pass
             if not hasattr(lib, "dgc_relabel_csr"):
                 _load_failed = True
                 return None

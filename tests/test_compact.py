@@ -312,3 +312,23 @@ def test_sweep_prefix_resume_steps_match_scratch():
     assert second is not None and second.supersteps == r2.supersteps
     assert second.status == r2.status
     assert np.array_equal(second.colors, r2.colors)
+
+
+def test_hub_row_compaction_bit_identical():
+    # force every bucket into the hub region (flat_cap=4): mid-size hub
+    # buckets (>512 rows) get the row-compacted branch, taken once their
+    # live count fits the pad — colors must stay bit-identical to bucketed
+    from dgc_tpu.engine.compact import hub_pad_for
+
+    g = generate_random_graph(5000, 16, seed=21)
+    eng = CompactFrontierEngine(g, flat_cap=4,
+                                stages=((None, 2500), (2500, 312), (312, 0)))
+    assert eng.hub_buckets > 0
+    assert any(hub_pad_for(cb.shape[0]) > 0 for cb in eng.combined_buckets)
+    first, second = eng.sweep(g.max_degree + 1)
+    r1 = BucketedELLEngine(g).attempt(g.max_degree + 1)
+    assert np.array_equal(first.colors, r1.colors)
+    assert first.supersteps == r1.supersteps
+    r2 = BucketedELLEngine(g).attempt(r1.colors_used - 1)
+    assert second.status == r2.status
+    assert np.array_equal(second.colors, r2.colors)

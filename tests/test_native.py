@@ -71,3 +71,35 @@ def test_generators_native_roundtrip():
         src = np.repeat(np.arange(g.num_vertices), g.degrees)
         fwd = set(zip(src.tolist(), g.indices.tolist()))
         assert all((b, a) in fwd for a, b in fwd)
+
+
+def test_stale_library_recovery(tmp_path):
+    # a cached .so missing newer symbols (deploy with preserved mtimes) must
+    # recover in-process: rebuild + load via a distinct path (re-dlopening
+    # the canonical path returns the already-mapped stale object)
+    import os
+    import shutil
+    import subprocess
+
+    from dgc_tpu.native import bindings
+
+    backup = tmp_path / "libdgcgraph.so.bak"
+    shutil.copy2(bindings._LIB, backup)
+    stub = tmp_path / "stub.cpp"
+    stub.write_text('extern "C" int dgc_unrelated() { return 0; }\n')
+    try:
+        subprocess.run(["g++", "-shared", "-fPIC", str(stub), "-o",
+                        str(bindings._LIB)], check=True, capture_output=True)
+        future = bindings._SRC.stat().st_mtime + 3600
+        os.utime(bindings._LIB, (future, future))
+        with bindings._lock:
+            bindings._lib = None
+            bindings._load_failed = False
+        assert bindings.native_available()  # stale lib loaded, then recovered
+        g = bindings.generate_fast_native(500, 6.0, seed=1)
+        assert g is not None and g.num_vertices == 500
+    finally:
+        shutil.copy2(backup, bindings._LIB)
+        with bindings._lock:
+            bindings._lib = None
+            bindings._load_failed = False
