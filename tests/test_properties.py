@@ -148,3 +148,31 @@ def test_minimal_sweep_bracket(g):
     assert abs(res.minimal_colors - o.minimal_colors) <= 1
     if res.minimal_colors > 1:
         assert eng.attempt(res.minimal_colors - 1).status == AttemptStatus.FAILURE
+
+
+@settings(max_examples=25, deadline=None)
+@given(graphs())
+def test_fused_sweep_prefix_resume_exact(g):
+    # the fused sweep's confirm attempt (prefix-resume from the rec ring)
+    # must be indistinguishable from two scratch attempts on ANY graph:
+    # colors, status, and superstep counts
+    eng = _compact(g)
+    k0 = g.max_degree + 1
+    first, second = eng.sweep(k0)
+    scratch = _compact(g)
+    r1 = scratch.attempt(k0)
+    assert first.status == r1.status
+    assert np.array_equal(first.colors, r1.colors)
+    assert first.supersteps == r1.supersteps
+    if first.status != AttemptStatus.SUCCESS:
+        assert second is None
+        return
+    k2 = r1.colors_used - 1
+    if k2 < 1:
+        assert second.status == AttemptStatus.FAILURE and second.k == k2
+        return
+    r2 = scratch.attempt(k2)
+    assert second.k == k2
+    assert second.status == r2.status
+    assert np.array_equal(second.colors, r2.colors)
+    assert second.supersteps == r2.supersteps
