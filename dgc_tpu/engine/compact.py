@@ -209,12 +209,14 @@ def _compact_idx(act, pad: int, n: int):
 
 
 def hub_pad_for(rows: int) -> int:
-    """Row-compaction pad for a big hub bucket (0 = never compact): big
-    buckets (> 2·pad rows) get a compacted branch used once their live
-    count fits the pad — on power-law graphs the mid-wide hub buckets
-    stay live for most of the sweep with only a sliver of rows active."""
-    pad = _pow2_ceil(max(rows // 8, 256))
-    return pad if rows > 2 * pad else 0
+    """Row-compaction pad for a hub bucket (0 = never compact): buckets
+    with a ≥4× row-to-pad ratio get a compacted branch used once their
+    live count fits the pad — on power-law graphs hub buckets stay live
+    for most of the sweep with only a sliver of rows active, and even a
+    ~200-row × 8192-wide bucket is millions of gathered entries per
+    superstep until its last row confirms."""
+    pad = _pow2_ceil(max(rows // 8, 32))
+    return pad if rows > 4 * pad else 0
 
 
 def _bucket_update_compact(pe, pk_b, cb, p_b, k, v: int, pad: int):
