@@ -87,8 +87,13 @@ from dgc_tpu.engine.bucketed import (
     status_step,
 )
 from dgc_tpu.models.arrays import GraphArrays, csr_to_ell
-from dgc_tpu.ops.bitmask import num_planes_for
-from dgc_tpu.ops.speculative import beats_rule, speculative_update_mc
+from dgc_tpu.ops.bitmask import forbidden_planes, num_planes_for
+from dgc_tpu.ops.speculative import (
+    apply_update_mc,
+    beats_rule,
+    neighbor_stats,
+    speculative_update_mc,
+)
 
 _RUNNING = AttemptStatus.RUNNING
 _SUCCESS = AttemptStatus.SUCCESS
@@ -104,20 +109,23 @@ def default_stages(v: int) -> tuple:
     """((scale, run_down_to_threshold), ...); scale None = full-table phase.
     A compaction stage's flat pad is ``pow2(scale)`` rows.
 
-    Three rungs with widening ratios (v/4 → v/16 → v/256): high-color
-    sweeps (heavy-tail/RMAT graphs take ~2·C supersteps for C colors —
-    the dense core serializes one color class per round) spend most
-    supersteps on a tiny frontier, and a ladder stopping at v/64 made
-    every late round pay a 16k-row gather; the v/256 rung gets those
-    rounds onto ~4k pads. More rungs than this measured ≈ nothing on
-    either graph family (the flat region is inert for the heavy-tail
-    long tail) while each extra rung is another compiled stage body."""
+    Four ×4 rungs (v/4 → v/16 → v/64 → v/256): a stage's per-superstep
+    cost is bound by its *static* pad, not the live frontier, so each
+    missing rung makes every superstep in its span pay up to 4× its
+    frontier's gather volume. High-color sweeps (heavy-tail/RMAT graphs
+    take ~2·C supersteps for C colors — the dense core serializes one
+    color class per round) spend most supersteps far down the ladder; the
+    200k-RMAT trace showed the v/16→v/256 gap alone holding 19 of 68
+    supersteps at 4× weight. Rungs below v/256 measured ≈ nothing (the
+    flat region is inert for the heavy-tail long tail) while each extra
+    rung is another compiled stage body."""
     if v <= 1 << 14:
         return ((None, 0),)
     return (
         (None, v // 4),
         (v // 4, v // 16),
-        (v // 16, v // 256),
+        (v // 16, v // 64),
+        (v // 64, v // 256),
         (v // 256, 0),
     )
 
@@ -219,6 +227,129 @@ def hub_pad_for(rows: int) -> int:
     return pad if rows > 4 * pad else 0
 
 
+# below this many table entries a hub bucket runs UNCONDITIONED — no
+# cond, no capture state, no extra compiled branches: skipping a gather
+# this small cannot pay for the machinery that skips it
+HUB_UNCOND_ENTRIES = 1 << 17
+
+
+def hub_prune_cfg(rows: int, width: int, u_min: int = 128,
+                  u_div: int = 4,
+                  uncond_entries: int | None = None) -> tuple | None:
+    """Static neighbor-pruning config ``(P, U)`` for a hub bucket, or None.
+
+    Row compaction shrinks the *row* axis, but a live hub row still
+    re-gathers its full (up to Δ-wide) neighborhood every superstep even
+    when nearly all of those neighbors are long confirmed — on power-law
+    graphs the heavy-tail long tail is exactly this: a few-hundred-vertex
+    core serializing one color class per round while each round pays the
+    hub's full table. ``U`` is the pruned width: once every active row has
+    ≤ U unconfirmed neighbors (checked at rebase), supersteps gather
+    ``[P, U]`` instead of ``[P, W]`` — the tail's cost scales with the live
+    core's edges, not the hub's neighborhoods. ``P`` is the slot pad (the
+    row-compaction pad, or all rows for small buckets). Disabled when the
+    pruned table would not be ≥2× narrower than the bucket (and never for
+    buckets small enough to run unconditioned — see ``HUB_UNCOND_ENTRIES``).
+
+    Sizing is trajectory-driven (the exact-rule NumPy trajectory on
+    200k RMAT): per-bucket live counts in the high-degree core decay
+    *slowly* (the core serializes one color class per round), so slot
+    pads at rows/8 only engaged in the last quarter of the sweep; pads at
+    rows/2 engage around the sweep's first third, and the rebase branch
+    they gate is already cheaper than the full branch (it row-compacts).
+    ``U`` = W/4 (capped at 2048) for the same reason: the measured
+    max-unconfirmed-per-row crosses W/4 mid-sweep but W/16 only at the
+    very end."""
+    if rows * width <= (HUB_UNCOND_ENTRIES if uncond_entries is None
+                        else uncond_entries):
+        return None
+    u = max(u_min, min(width // u_div, 2048))
+    if 2 * u > width:
+        return None
+    return (_pow2_ceil(max(rows // 2, 32)), u)
+
+
+def _fresh_prune(buckets, hub_buckets: int, planes: tuple, hub_prune: tuple,
+                 v: int) -> tuple:
+    """Per-hub-bucket pruned-mode state ``(valid, slots, comb, conf)`` (or
+    None where disabled), initially invalid. Built fresh per attempt — and
+    per fused-sweep phase: the confirm attempt runs at a smaller k where
+    confirmed colors differ, so attempt-1 captures must never leak across
+    (the prefix-resume ring deliberately does not record pruned state)."""
+    out = []
+    for bi in range(hub_buckets):
+        cfg = hub_prune[bi] if bi < len(hub_prune) else None
+        if cfg is None:
+            out.append(None)
+            continue
+        p, u = cfg
+        vb = buckets[bi].shape[0]
+        out.append((jnp.int32(0),
+                    jnp.full((p,), vb, jnp.int32),
+                    jnp.full((p, u), v, jnp.int32),
+                    jnp.zeros((p, planes[bi]), jnp.uint32)))
+    return tuple(out)
+
+
+def _bucket_update_pruned(pe, pk_b, ps_b, p_b, k, width: int, v: int):
+    """Superstep on the rebased slots via the pruned tables: static
+    confirmed-forbidden planes OR'd with a gather of only the ≤U
+    unconfirmed-at-rebase neighbors.
+
+    Exact by monotone confirmation (module docstring): every neighbor is
+    either in the pruned list (gathered live — including ones that have
+    confirmed since rebase, whose colors the stats see exactly) or was
+    confirmed at rebase (color final, baked into ``conf``); fresh
+    neighbors are always unconfirmed, so clash detection sees all of them.
+    Slots captured at rebase are a superset of currently-active rows
+    (stale confirmed rows transition to themselves)."""
+    _, slots, comb, conf = ps_b
+    vb = pk_b.shape[0]
+    real = slots < vb
+    idx_safe = jnp.where(real, slots, 0)
+    pk_slot = jnp.where(real, pk_b[idx_safe], 0)  # dummies: confirmed 0
+    nb, beats = decode_combined(comb)
+    np_ = pe[: v + 1][nb]                         # [P, U] gather
+    forb_all, forb_old, clash = neighbor_stats(np_, beats, pk_slot >> 1, p_b)
+    new_slot, fail_mask, act_mask, mc = apply_update_mc(
+        pk_slot, forb_all | conf, forb_old | conf, clash, k)
+    fv = _bucket_fail_valid(width, p_b, k)
+    new_b = pk_b.at[slots].set(new_slot, mode="drop")
+    return (new_b,
+            jnp.sum(fail_mask.astype(jnp.int32)) * fv.astype(jnp.int32),
+            jnp.sum(act_mask.astype(jnp.int32)),
+            mc)
+
+
+def _bucket_update_rebase(pe, pk_b, cb, p_b, k, v: int, pad: int, u: int):
+    """``_bucket_update_compact`` + pruned-state capture from the same
+    full-width gather (shared ``_compact_core``): the compacted active rows
+    run their superstep, and the PRE-state snapshot yields (slots, ≤U-wide
+    unconfirmed-neighbor list, confirmed-forbidden planes). The capture is
+    valid iff every active row had ≤ ``u`` unconfirmed neighbors — until
+    then the caller keeps rebasing (at exactly the compacted branch's
+    gather cost)."""
+    new_b, fail, act, mc, (idx, real, cb_slot, np_) = _compact_core(
+        pe, pk_b, cb, p_b, k, v, pad)
+    nb, _ = decode_combined(cb_slot)
+
+    # pruned-state capture (pre-state snapshot; dummy slots contribute
+    # nothing — their unconf mask is zeroed through ``real``)
+    realn = (nb < v) & real[:, None]
+    nconf = (np_ >= 0) & ((np_ & 1) == 0)
+    unconf = realn & ~nconf
+    cnt = jnp.sum(unconf.astype(jnp.int32), axis=1)
+    ok = jnp.max(cnt, initial=0) <= u
+    pos = jnp.cumsum(unconf.astype(jnp.int32), axis=1) - 1
+    col = jnp.where(unconf & (pos < u), pos, u)
+    rows2d = jnp.broadcast_to(
+        jnp.arange(pad, dtype=jnp.int32)[:, None], col.shape)
+    comb_u = jnp.full((pad, u), v, jnp.int32).at[rows2d, col].set(
+        cb_slot, mode="drop")
+    conf = forbidden_planes(jnp.where(unconf | ~realn, -1, np_ >> 1), p_b)
+    return new_b, fail, act, mc, (ok.astype(jnp.int32), idx, comb_u, conf)
+
+
 def _bucket_update_compact(pe, pk_b, cb, p_b, k, v: int, pad: int):
     """``_bucket_update`` on the bucket's ≤ ``pad`` active rows only.
 
@@ -227,6 +358,15 @@ def _bucket_update_compact(pe, pk_b, cb, p_b, k, v: int, pad: int):
     themselves, so updating only active rows is the same superstep.
     Dummy slots carry confirmed-0 state (inert: no fail/active/mc
     contribution) and their writes scatter out of range (dropped)."""
+    new_b, fail, act, mc, _ = _compact_core(pe, pk_b, cb, p_b, k, v, pad)
+    return new_b, fail, act, mc
+
+
+def _compact_core(pe, pk_b, cb, p_b, k, v: int, pad: int):
+    """Row-compacted superstep shared by the compact and rebase branches
+    (one body so the dispatcher's interchangeable branches cannot drift).
+    Returns ``(new_b, fail, act, mc, (idx, real, cb_slot, np_))`` — the
+    intermediates are what the rebase branch's capture consumes."""
     vb = cb.shape[0]
     act_b = (pk_b < 0) | ((pk_b & 1) == 1)
     idx = _compact_idx(act_b, pad, vb)
@@ -243,34 +383,66 @@ def _bucket_update_compact(pe, pk_b, cb, p_b, k, v: int, pad: int):
     return (new_b,
             jnp.sum(fail_mask.astype(jnp.int32)) * fv.astype(jnp.int32),
             jnp.sum(act_mask.astype(jnp.int32)),
-            mc)
+            mc,
+            (idx, real, cb_slot, np_))
 
 
-def _hub_dispatch(pe, ba_bi, pk_b, cb, p_b, k, v: int):
-    """Cond ladder for one hub bucket: inert → skip; small live count →
-    compacted rows; else full bucket. Returns (new_pk_b, fail, act, mc)."""
-    pad = hub_pad_for(cb.shape[0])
+def _hub_dispatch(pe, ba_bi, pk_b, cb, p_b, k, v: int, ps_b=None,
+                  cfg: tuple | None = None, uncond: bool = False):
+    """Cond ladder for one hub bucket: inert → skip; pruned-valid → gather
+    only the captured ≤U unconfirmed neighbors; small live count →
+    compacted rows (with pruned-state capture when ``cfg`` enables it);
+    else full bucket. ``uncond`` buckets (table ≤ ``HUB_UNCOND_ENTRIES``)
+    run the full update with no control flow at all — a device-side cond
+    costs more than the gather it would skip. Returns
+    (new_pk_b, fail, act, mc, ps_b')."""
+    vb, w = cb.shape
 
-    def full(pk_b):
-        return _bucket_update(pe, pk_b, cb, p_b, k, v)
+    if uncond:
+        return _bucket_update(pe, pk_b, cb, p_b, k, v) + (ps_b,)
 
-    def skip(pk_b):
-        return pk_b, jnp.int32(0), jnp.int32(0), jnp.int32(-1)
+    def skip(op):
+        pk_b, ps = op
+        return pk_b, jnp.int32(0), jnp.int32(0), jnp.int32(-1), ps
 
-    if pad == 0:
-        return jax.lax.cond(ba_bi > 0, full, skip, pk_b)
+    def full(op):
+        pk_b, ps = op
+        return _bucket_update(pe, pk_b, cb, p_b, k, v) + (ps,)
 
-    def compact(pk_b):
-        return _bucket_update_compact(pe, pk_b, cb, p_b, k, v, pad)
+    if cfg is None:
+        pad = hub_pad_for(vb)
+        if pad == 0:
+            return jax.lax.cond(ba_bi > 0, full, skip, (pk_b, ps_b))
 
-    def live(pk_b):
-        return jax.lax.cond(ba_bi <= pad, compact, full, pk_b)
+        def compact(op):
+            pk_b, ps = op
+            return _bucket_update_compact(pe, pk_b, cb, p_b, k, v, pad) + (ps,)
 
-    return jax.lax.cond(ba_bi > 0, live, skip, pk_b)
+        def live(op):
+            return jax.lax.cond(ba_bi <= pad, compact, full, op)
+
+        return jax.lax.cond(ba_bi > 0, live, skip, (pk_b, ps_b))
+
+    pad, u = cfg
+
+    def pruned(op):
+        pk_b, ps = op
+        return _bucket_update_pruned(pe, pk_b, ps, p_b, k, w, v) + (ps,)
+
+    def rebase(op):
+        pk_b, ps = op
+        r = _bucket_update_rebase(pe, pk_b, cb, p_b, k, v, pad, u)
+        return r[:4] + (r[4],)
+
+    branch = jnp.where(
+        ba_bi == 0, 0,
+        jnp.where(ps_b[0] == 1, 1, jnp.where(ba_bi <= pad, 2, 3)))
+    return jax.lax.switch(branch, (skip, pruned, rebase, full), (pk_b, ps_b))
 
 
 def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
-                      hub_buckets: int):
+                      hub_buckets: int, prune: tuple = (),
+                      hub_prune: tuple = (), hub_uncond: tuple = ()):
     """One full-table superstep. The first ``hub_buckets`` buckets (the hub
     region: few rows, huge widths) are each wrapped in a ``lax.cond`` on
     their live active count ``ba[bi]`` (exact by frontier monotonicity) —
@@ -282,21 +454,27 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
 
     ``ba`` is int32[hub_buckets (+1 if a flat region exists)]: per-hub-bucket
     actives, then the flat-region total. Returns
-    (new_pe, fail_count, active_count, ba_new, mc)."""
+    (new_pe, fail_count, active_count, ba_new, mc, prune_new)."""
     new_parts, parts_fail, parts_active, parts_mc = [], [], [], []
     ba_parts = []
+    prune_new = []
     pk = pe[:v]
 
     for bi in range(hub_buckets):
         cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
         vb = cb.shape[0]
         pk_b = jax.lax.dynamic_slice_in_dim(pk, row0, vb)
-        new_b, f_b, a_b, m_b = _hub_dispatch(pe, ba[bi], pk_b, cb, p_b, k, v)
+        new_b, f_b, a_b, m_b, ps_b = _hub_dispatch(
+            pe, ba[bi], pk_b, cb, p_b, k, v,
+            prune[bi] if bi < len(prune) else None,
+            hub_prune[bi] if bi < len(hub_prune) else None,
+            uncond=bool(hub_uncond[bi]) if bi < len(hub_uncond) else False)
         new_parts.append(new_b)
         parts_fail.append(f_b)
         parts_active.append(a_b)
         parts_mc.append(m_b)
         ba_parts.append(a_b)
+        prune_new.append(ps_b)
 
     for bi in range(hub_buckets, len(buckets)):
         cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
@@ -313,7 +491,7 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
     new_pe = jnp.concatenate([new_pk, jnp.array([-1, 0], jnp.int32)])
     mc = parts_mc[0] if len(parts_mc) == 1 else jnp.max(jnp.stack(parts_mc))
     return (new_pe, sum(parts_fail), sum(parts_active),
-            jnp.stack(ba_parts), mc)
+            jnp.stack(ba_parts), mc, tuple(prune_new))
 
 
 _REC_SLOTS = 4  # prefix-resume ring: pre-states of the last 4 record rounds
@@ -345,6 +523,7 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                      row0s: tuple, hub_buckets: int, flat_row0: int,
                      flat_planes: int, stages: tuple, max_steps: int,
                      init_bucket_active: tuple, stage_ranges: tuple = (),
+                     hub_prune: tuple = (), hub_uncond: tuple = (),
                      stall_window: int = 64):
     """One whole k-attempt as a traceable pipeline: cond-skipped full-table
     phase + hybrid (flat-compacted + live-hub) compaction stages. Returns
@@ -379,8 +558,9 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
     nb_hub = hub_buckets
     has_flat = nb_hub < len(buckets)
 
+    prune0 = _fresh_prune(buckets, nb_hub, planes, hub_prune, v)
     carry = (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3],
-             init[4]) + tuple(rec)
+             init[4]) + tuple(rec) + (prune0,)
 
     def recstep(rec5, pe, ba, step, prev_active, stall, mc, any_fail):
         """Push this superstep's pre-state when it sets a new mc record."""
@@ -411,10 +591,10 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
 
             def body(c):
                 pe, step, status, prev_active, stall, ba = c[:6]
-                rec5 = c[6:]
-                new_pe, fail_count, active, ba_new, mc = _hybrid_superstep(
-                    pe, ba, buckets, row0s, k, planes, v, nb_hub
-                )
+                rec5, prune = c[6:11], c[11]
+                new_pe, fail_count, active, ba_new, mc, prune_new = (
+                    _hybrid_superstep(pe, ba, buckets, row0s, k, planes, v,
+                                      nb_hub, prune, hub_prune, hub_uncond))
                 any_fail = fail_count > 0
                 rec5 = recstep(rec5, pe, ba, step, prev_active, stall, mc,
                                any_fail)
@@ -422,7 +602,10 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                 status = status_step(any_fail, active, stall, stall_window)
                 new_pe = jnp.where(any_fail, pe, new_pe)
                 ba_new = jnp.where(any_fail, ba, ba_new)
-                return (new_pe, step + 1, status, active, stall, ba_new) + rec5
+                prune_new = jax.tree.map(
+                    lambda a, b: jnp.where(any_fail, a, b), prune, prune_new)
+                return ((new_pe, step + 1, status, active, stall, ba_new)
+                        + rec5 + (prune_new,))
 
             carry = jax.lax.while_loop(cond, body, carry)
             continue
@@ -461,7 +644,7 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
 
             def body2(c2):
                 pe, step, status, prev_active, stall, ba = c2[:6]
-                rec5 = c2[6:]
+                rec5, prune = c2[6:11], c2[11]
                 # BSP snapshot semantics: all reads from ``pe``; writes
                 # accumulate in ``new_pe`` over disjoint row sets
 
@@ -502,26 +685,45 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
 
                 fails, actives, mcs_all = [fail_f], [act_fl], [mc_f]
                 ba_parts = []
+                prune_new = []
                 for bi in range(nb_hub):
                     cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
                     vb = cb.shape[0]
+                    cfg = hub_prune[bi] if bi < len(hub_prune) else None
+                    uncond = (bool(hub_uncond[bi])
+                              if bi < len(hub_uncond) else False)
 
                     # slice + write-back stay inside the cond: an inert hub
                     # bucket must cost *nothing* per superstep (module
                     # docstring invariant), not an O(rows) copy
-                    def do_hub(acc, cb=cb, p_b=p_b, row0=row0, vb=vb, bi=bi):
+                    def do_hub(op, cb=cb, p_b=p_b, row0=row0, vb=vb, bi=bi,
+                               cfg=cfg, uncond=uncond):
+                        acc, ps = op
                         pk_b = jax.lax.dynamic_slice_in_dim(pe[:v], row0, vb)
-                        new_b, f_b, a_b, m_b = _hub_dispatch(
-                            pe, ba[bi], pk_b, cb, p_b, k, v)
+                        new_b, f_b, a_b, m_b, ps2 = _hub_dispatch(
+                            pe, ba[bi], pk_b, cb, p_b, k, v, ps, cfg,
+                            uncond=uncond)
                         return (jax.lax.dynamic_update_slice_in_dim(
-                            acc, new_b, row0, axis=0), f_b, a_b, m_b)
+                            acc, new_b, row0, axis=0), f_b, a_b, m_b, ps2)
 
-                    new_pe, f_b, a_b, m_b = jax.lax.cond(
-                        ba[bi] > 0, do_hub, skip_any, new_pe)
+                    def skip_hub(op):
+                        acc, ps = op
+                        return (acc, jnp.int32(0), jnp.int32(0),
+                                jnp.int32(-1), ps)
+
+                    if uncond:  # no cond: costs less than the cond would
+                        new_pe, f_b, a_b, m_b, ps2 = do_hub(
+                            (new_pe, prune[bi] if bi < len(prune) else None))
+                    else:
+                        new_pe, f_b, a_b, m_b, ps2 = jax.lax.cond(
+                            ba[bi] > 0, do_hub, skip_hub,
+                            (new_pe, prune[bi] if bi < len(prune) else None))
                     fails.append(f_b)
                     actives.append(a_b)
                     mcs_all.append(m_b)
                     ba_parts.append(a_b)
+                    prune_new.append(ps2)
+                prune_new = tuple(prune_new)
                 if has_flat:
                     ba_parts.append(act_fl)
                 ba_new = jnp.stack(ba_parts) if ba_parts else ba
@@ -536,7 +738,10 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                 status = status_step(any_fail, active, stall, stall_window)
                 new_pe = jnp.where(any_fail, pe, new_pe)
                 ba_new = jnp.where(any_fail, ba, ba_new)
-                return (new_pe, step + 1, status, active, stall, ba_new) + rec5
+                prune_new = jax.tree.map(
+                    lambda a, b: jnp.where(any_fail, a, b), prune, prune_new)
+                return ((new_pe, step + 1, status, active, stall, ba_new)
+                        + rec5 + (prune_new,))
 
             return jax.lax.while_loop(cond2, body2, c)
 
@@ -548,12 +753,12 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
         (status == _RUNNING) & (active == 0), _SUCCESS,
         jnp.where(status == _RUNNING, _STALLED, status),
     ).astype(jnp.int32)
-    return pe, steps, status, tuple(carry[6:])
+    return pe, steps, status, tuple(carry[6:11])
 
 
 _STATIC_NAMES = ("planes", "row0s", "hub_buckets", "flat_row0", "flat_planes",
                  "stages", "max_steps", "init_bucket_active", "stage_ranges",
-                 "stall_window")
+                 "hub_prune", "hub_uncond", "stall_window")
 
 
 @partial(jax.jit, static_argnames=_STATIC_NAMES)
@@ -573,6 +778,7 @@ def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
                          row0s: tuple, hub_buckets: int, flat_row0: int,
                          flat_planes: int, stages: tuple, max_steps: int,
                          init_bucket_active: tuple, stage_ranges: tuple = (),
+                         hub_prune: tuple = (), hub_uncond: tuple = (),
                          stall_window: int = 64):
     """Fused minimal-k sweep: attempt(k0), then — still on device — the
     jump-mode confirm attempt at (colors_used − 1). One dispatch for what
@@ -603,7 +809,8 @@ def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
     kw = dict(planes=planes, row0s=row0s, hub_buckets=hub_buckets,
               flat_row0=flat_row0, flat_planes=flat_planes, stages=stages,
               max_steps=max_steps, init_bucket_active=init_bucket_active,
-              stage_ranges=stage_ranges, stall_window=stall_window)
+              stage_ranges=stage_ranges, hub_prune=hub_prune,
+              hub_uncond=hub_uncond, stall_window=stall_window)
     pe0 = jnp.zeros(v + 2, jnp.int32)
     z = jnp.int32(0)
     rec0 = _empty_rec(v, len(init_bucket_active))
@@ -671,18 +878,25 @@ class CompactFrontierEngine(BucketedELLEngine):
     # hub/flat split: a bucket joins the flat region only if its width is
     # ≤ FLAT_CAP *and* the flat table (rows × widest flat width) stays
     # under FLAT_BUDGET entries — the O(V·Δ) blowup guard, now per-region
-    # instead of an engine-wide fallback. The budget is worth spending:
-    # a mid-wide bucket (e.g. 128-wide × 500k rows on a 4M RMAT graph)
-    # that lands in the hub runs as a cond'd FULL-bucket update for as
-    # long as any of its rows is active — in the flat region its rows
-    # compact away with the frontier instead.
+    # instead of an engine-wide fallback.
+    #
+    # The cap was A/B-measured on 200k RMAT: pushing the W=256/128
+    # buckets into the hub (cap 64) ran 6% *slower* — their live counts
+    # stay above any useful row-compaction pad for most of the sweep, so
+    # they just traded the stage ranges' static pricing for full-bucket
+    # gathers. The budget is worth spending: a mid-wide bucket that lands
+    # in the hub runs as a cond'd full-bucket update while its live count
+    # exceeds its pads — in the flat region its rows compact away with
+    # the frontier instead.
     FLAT_CAP = 256
     FLAT_BUDGET = 1 << 29  # table entries (×4 B = 2 GiB)
 
     def __init__(self, arrays: GraphArrays, max_steps: int | None = None,
                  min_width: int = 4, stages: tuple | None = None,
                  max_window_planes: int | None = None,
-                 flat_cap: int | None = None):
+                 flat_cap: int | None = None,
+                 prune_u_min: int = 128, prune_u_div: int = 4,
+                 hub_uncond_entries: int | None = None):
         kw = {} if max_window_planes is None else {"max_window_planes": max_window_planes}
         super().__init__(arrays, max_steps=max_steps, min_width=min_width, **kw)
         v = arrays.num_vertices
@@ -717,6 +931,23 @@ class CompactFrontierEngine(BucketedELLEngine):
             hub += 1
         self.hub_buckets = hub
         self.flat_row0 = self.row0s[hub] if hub < len(widths) else v
+        # per-hub-bucket neighbor-pruning config (the heavy-tail long-tail
+        # lever: tail supersteps gather the live core's edges, not the
+        # hub's full neighborhoods)
+        uncond_entries = (HUB_UNCOND_ENTRIES if hub_uncond_entries is None
+                          else hub_uncond_entries)
+        self.hub_prune = tuple(
+            hub_prune_cfg(sizes[bi], widths[bi],
+                          u_min=prune_u_min, u_div=prune_u_div,
+                          uncond_entries=uncond_entries)
+            for bi in range(hub)
+        )
+        # small hub buckets run with no control flow at all (a device-side
+        # cond costs ~7-30 ms/execution, more than these buckets' gathers)
+        self.hub_uncond = tuple(
+            sizes[bi] * widths[bi] <= uncond_entries
+            for bi in range(hub)
+        )
 
         # live-count layout matching _hybrid_superstep: per-hub-bucket
         # actives, then one flat-region total
@@ -765,7 +996,8 @@ class CompactFrontierEngine(BucketedELLEngine):
                     flat_planes=self.flat_planes, stages=self.stages,
                     max_steps=self.max_steps,
                     init_bucket_active=self.init_bucket_active,
-                    stage_ranges=self.stage_ranges)
+                    stage_ranges=self.stage_ranges,
+                    hub_prune=self.hub_prune, hub_uncond=self.hub_uncond)
 
     def attempt(self, k: int) -> AttemptResult:
         v = self.arrays.num_vertices

@@ -332,3 +332,135 @@ def test_hub_row_compaction_bit_identical():
     r2 = BucketedELLEngine(g).attempt(r1.colors_used - 1)
     assert second.status == r2.status
     assert np.array_equal(second.colors, r2.colors)
+
+
+# --- hub neighbor pruning (the heavy-tail long-tail lever) ---
+
+
+def _hub_fixture(n=48):
+    """K_n forced entirely into the hub: one bucket, clique semantics
+    serialize ~one confirm per superstep — the adversarial shape for the
+    pruned path (state changes every round)."""
+    import jax.numpy as jnp
+
+    edges = np.array([[i, j] for i in range(n) for j in range(i + 1, n)])
+    g = GraphArrays.from_edge_list(n, edges)
+    eng = CompactFrontierEngine(g, flat_cap=4, prune_u_min=8,
+                                hub_uncond_entries=0, stages=((None, 0),))
+    assert eng.hub_buckets == len(eng.combined_buckets)
+    cb = eng.combined_buckets[0]
+    p_b = eng.planes[0]
+    v = g.num_vertices
+    pe0 = jnp.concatenate([jnp.asarray(np.ones(v, np.int32)),
+                           jnp.array([-1, 0], np.int32)])
+    return eng, cb, p_b, v, pe0
+
+
+def test_hub_prune_rebase_then_pruned_matches_full():
+    # run the real transition a few rounds, rebase mid-way, then check the
+    # pruned branch reproduces the full-bucket branch bit-for-bit on every
+    # later state (the monotone-confirmation exactness argument, executed)
+    import jax.numpy as jnp
+
+    from dgc_tpu.engine.compact import (
+        _bucket_update, _bucket_update_pruned, _bucket_update_rebase)
+
+    eng, cb, p_b, v, pe = _hub_fixture()
+    k = np.int32(v)
+    pad, u = _pow2_ceil(v), v  # u = V: capture always valid on a clique
+    states = [pe]
+    for _ in range(6):  # advance with the full branch
+        new_b, _, _, _ = _bucket_update(pe, pe[:v], cb, p_b, k, v)
+        pe = jnp.concatenate([new_b, jnp.array([-1, 0], np.int32)])
+        states.append(pe)
+
+    r = _bucket_update_rebase(states[3], states[3][:v], cb, p_b, k, v, pad, u)
+    full_now = _bucket_update(states[3], states[3][:v], cb, p_b, k, v)
+    assert np.array_equal(r[0], full_now[0])  # rebase's own update is exact
+    assert int(r[1]) == int(full_now[1]) and int(r[2]) == int(full_now[2])
+    assert int(r[3]) == int(full_now[3])
+    ps = r[4]
+    assert int(ps[0]) == 1  # capture valid
+
+    for pe_t in states[4:]:  # pruned == full on every later state
+        got = _bucket_update_pruned(pe_t, pe_t[:v], ps, p_b, k,
+                                    cb.shape[1], v)
+        want = _bucket_update(pe_t, pe_t[:v], cb, p_b, k, v)
+        assert np.array_equal(got[0], want[0])
+        assert all(int(got[i]) == int(want[i]) for i in (1, 2, 3))
+
+
+def test_hub_prune_rebase_validity_flag():
+    # u smaller than the live unconfirmed neighborhood → capture invalid;
+    # u covering it → valid, and the captured list holds exactly the
+    # unconfirmed neighbors (everything else is the sentinel)
+    from dgc_tpu.engine.compact import _bucket_update_rebase
+    from dgc_tpu.engine.bucketed import decode_combined
+
+    eng, cb, p_b, v, pe0 = _hub_fixture()
+    k = np.int32(v)
+    pad = _pow2_ceil(v)
+    r_small = _bucket_update_rebase(pe0, pe0[:v], cb, p_b, k, v, pad, 8)
+    assert int(r_small[4][0]) == 0  # 47 unconfirmed neighbors > 8
+
+    r_big = _bucket_update_rebase(pe0, pe0[:v], cb, p_b, k, v, pad, v)
+    valid, slots, comb, conf = r_big[4]
+    assert int(valid) == 1
+    nb, _ = decode_combined(comb)
+    nb = np.asarray(nb)
+    # every vertex is unconfirmed in pe0 → each real slot lists its full
+    # neighborhood (v−1 real ids) and pads the rest with the sentinel
+    real_rows = np.asarray(slots) < v
+    assert (np.sort(nb[real_rows], axis=1)[:, : v - 1] < v).all()
+    assert (nb[~real_rows] == v).all()
+    assert not np.asarray(conf).any()  # nothing confirmed yet → empty planes
+
+
+def test_hub_dispatch_routes_to_pruned_branch():
+    # white-box routing check: hand the dispatcher a *deliberately empty*
+    # valid capture — if the pruned branch executes, the bucket sees no
+    # neighbors and every vertex confirms color 0 (≠ the full branch's
+    # result on a clique), proving the switch actually took the pruned path
+    import jax.numpy as jnp
+
+    from dgc_tpu.engine.compact import _hub_dispatch
+
+    eng, cb, p_b, v, pe0 = _hub_fixture()
+    k = np.int32(v)
+    pad, u = _pow2_ceil(v), 8
+    ps_empty = (jnp.int32(1),
+                jnp.arange(pad, dtype=jnp.int32).clip(0, v),
+                jnp.full((pad, u), v, jnp.int32),
+                jnp.zeros((pad, p_b), jnp.uint32))
+    new_b, fail, act, mc, _ = _hub_dispatch(
+        pe0, jnp.int32(v), pe0[:v], cb, p_b, k, v, ps_empty, (pad, u))
+    assert np.all(np.asarray(new_b) == 0)  # all confirmed 0: pruned ran
+    full_b, *_ = _hub_dispatch(
+        pe0, jnp.int32(v), pe0[:v], cb, p_b, k, v,
+        (jnp.int32(0),) + ps_empty[1:], (pad, u))  # invalid → rebase/full
+    assert not np.all(np.asarray(full_b) == 0)
+
+
+def test_hub_prune_end_to_end_bit_identical():
+    # clique + RMAT, pruning forced on (tiny u_min): attempts, fused sweep,
+    # and the minimal-k driver all bit-match the bucketed engine
+    n = 48
+    edges = np.array([[i, j] for i in range(n) for j in range(i + 1, n)])
+    clique = GraphArrays.from_edge_list(n, edges)
+    rmat = generate_rmat_graph(2000, avg_degree=10.0, seed=5)
+    for g in (clique, rmat):
+        eng = CompactFrontierEngine(g, flat_cap=8, prune_u_min=4,
+                                    hub_uncond_entries=0)
+        assert any(cfg is not None for cfg in eng.hub_prune)
+        ref = BucketedELLEngine(g)
+        for k in (g.max_degree + 1, max(2, g.max_degree // 2)):
+            r1, r2 = ref.attempt(k), eng.attempt(k)
+            assert r1.status == r2.status and r1.supersteps == r2.supersteps
+            assert np.array_equal(r1.colors, r2.colors)
+        first, second = eng.sweep(g.max_degree + 1)
+        a1 = ref.attempt(g.max_degree + 1)
+        assert np.array_equal(first.colors, a1.colors)
+        if second is not None and a1.colors_used > 1:
+            a2 = ref.attempt(a1.colors_used - 1)
+            assert second.status == a2.status
+            assert np.array_equal(second.colors, a2.colors)
