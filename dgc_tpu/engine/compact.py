@@ -189,6 +189,18 @@ def _bucket_fail_valid(width: int, planes: int, k):
     return fail_exact | (k <= 32 * planes)
 
 
+def _reduce_bucket_result(new_b, fail_mask, act_mask, mc, width: int,
+                          p_b: int, k):
+    """Shared epilogue of every hub-branch superstep: reduce the masks and
+    gate the fail count by the capped-window validity rule — one body so
+    the dispatcher's interchangeable branches cannot drift."""
+    fv = _bucket_fail_valid(width, p_b, k)
+    return (new_b,
+            jnp.sum(fail_mask.astype(jnp.int32)) * fv.astype(jnp.int32),
+            jnp.sum(act_mask.astype(jnp.int32)),
+            mc)
+
+
 def _bucket_update(pe, pk_b, cb, p_b, k, v: int):
     """One bucket's superstep against the ``pe`` snapshot. Returns
     (new_pk_b, valid_fail_count, active_count, mc)."""
@@ -197,11 +209,7 @@ def _bucket_update(pe, pk_b, cb, p_b, k, v: int):
     np_ = pe[: v + 1][nb]
     new_b, fail_mask, act_mask, mc = speculative_update_mc(
         pk_b, np_, beats, k, p_b)
-    fv = _bucket_fail_valid(w, p_b, k)
-    return (new_b,
-            jnp.sum(fail_mask.astype(jnp.int32)) * fv.astype(jnp.int32),
-            jnp.sum(act_mask.astype(jnp.int32)),
-            mc)
+    return _reduce_bucket_result(new_b, fail_mask, act_mask, mc, w, p_b, k)
 
 
 def _compact_idx(act, pad: int, n: int):
@@ -266,7 +274,9 @@ def hub_prune_cfg(rows: int, width: int, u_min: int = 128,
     u = max(u_min, min(width // u_div, 2048))
     if 2 * u > width:
         return None
-    return (_pow2_ceil(max(rows // 2, 32)), u)
+    # clamp to the bucket's rows: a pad above them would make rebase
+    # gather MORE than the full branch (dummy slots re-gather row 0)
+    return (min(_pow2_ceil(max(rows // 2, 32)), _pow2_ceil(rows)), u)
 
 
 def _fresh_prune(buckets, hub_buckets: int, planes: tuple, hub_prune: tuple,
@@ -313,12 +323,9 @@ def _bucket_update_pruned(pe, pk_b, ps_b, p_b, k, width: int, v: int):
     forb_all, forb_old, clash = neighbor_stats(np_, beats, pk_slot >> 1, p_b)
     new_slot, fail_mask, act_mask, mc = apply_update_mc(
         pk_slot, forb_all | conf, forb_old | conf, clash, k)
-    fv = _bucket_fail_valid(width, p_b, k)
     new_b = pk_b.at[slots].set(new_slot, mode="drop")
-    return (new_b,
-            jnp.sum(fail_mask.astype(jnp.int32)) * fv.astype(jnp.int32),
-            jnp.sum(act_mask.astype(jnp.int32)),
-            mc)
+    return _reduce_bucket_result(new_b, fail_mask, act_mask, mc, width,
+                                 p_b, k)
 
 
 def _bucket_update_rebase(pe, pk_b, cb, p_b, k, v: int, pad: int, u: int):
@@ -378,13 +385,10 @@ def _compact_core(pe, pk_b, cb, p_b, k, v: int, pad: int):
     np_ = pe[: v + 1][nb]
     new_slot, fail_mask, act_mask, mc = speculative_update_mc(
         pk_slot, np_, beats, k, p_b)
-    fv = _bucket_fail_valid(cb.shape[1], p_b, k)
     new_b = pk_b.at[idx].set(new_slot, mode="drop")  # dummies (= vb) drop
-    return (new_b,
-            jnp.sum(fail_mask.astype(jnp.int32)) * fv.astype(jnp.int32),
-            jnp.sum(act_mask.astype(jnp.int32)),
-            mc,
-            (idx, real, cb_slot, np_))
+    return _reduce_bucket_result(new_b, fail_mask, act_mask, mc,
+                                 cb.shape[1], p_b, k) + (
+        (idx, real, cb_slot, np_),)
 
 
 def _hub_dispatch(pe, ba_bi, pk_b, cb, p_b, k, v: int, ps_b=None,

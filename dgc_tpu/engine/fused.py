@@ -40,7 +40,7 @@ def cached_shard_kernel(engine, body, name: str, window_key, in_specs,
             partial(body, **static_kwargs),
             mesh=engine.mesh,
             in_specs=in_specs,
-            out_specs=out_one if name == "attempt"
+            out_specs=out_one if name.startswith("attempt")
             else out_one + (P(),) + out_one,
             check_vma=False,
         ))

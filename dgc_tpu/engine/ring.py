@@ -105,6 +105,139 @@ def build_rotation_tables(arrays: GraphArrays, n: int):
     return v_pad, vl, tables, beats
 
 
+def flat_rotation_entries(arrays: GraphArrays, n: int) -> int:
+    """Exact entry count of the FLAT rotation tables without building them:
+    ``v_pad · Σ_r max_v(rotation-degree_r(v))``. Cheap (one O(E) pass); the
+    auto-select between table layouts must use this rather than
+    ``v_pad · Δ``, which is only a lower bound — n different vertices can
+    each concentrate a near-Δ neighborhood into a distinct rotation,
+    making Σ_r W_r approach n·Δ."""
+    v = arrays.num_vertices
+    v_pad = pad_to_multiple(max(v, n), n)
+    vl = v_pad // n
+    if arrays.num_directed_edges == 0:
+        return v_pad * n
+    src = np.repeat(np.arange(v, dtype=np.int64), arrays.degrees)
+    dst = arrays.indices.astype(np.int64)
+    rel = ((src // vl) - (dst // vl)) % n
+    key, counts = np.unique(src * n + rel, return_counts=True)
+    wmax = np.ones(n, np.int64)
+    np.maximum.at(wmax, key % n, counts)
+    return int(v_pad * wmax.sum())
+
+
+def build_bucketed_rotation_tables(arrays: GraphArrays, n: int,
+                                   min_width: int = 4):
+    """Degree-bucketed rotation tables: memory ∝ Σ deg, any Δ.
+
+    The flat ``build_rotation_tables`` pads every local row to the
+    rotation's max width, so one hub vertex makes every rotation table
+    Δ/n wide — O(V·Δ) total on power-law graphs (the doc/design gap
+    VERDICT r2 flagged). Here, for each rotation r, each shard's rows
+    with ≥1 neighbor toward offset r are grouped into power-of-two-ish
+    width buckets (``engine.bucketed._bucket_widths`` ladder over the
+    *rotation* degrees); rows with none are dropped outright (most rows,
+    for most rotations, on any graph). Because a ``shard_map`` program is
+    SPMD, the bucket structure must be shape-uniform across shards: each
+    (rotation, bucket) row count is padded to the max over shards and the
+    row lists ride as *sharded operands* (int32[n·P_rb] row ids into the
+    local block, sentinel = vl) instead of static constants.
+
+    Returns ``(v_pad, vl, rot_buckets)`` with ``rot_buckets[r]`` a list of
+    ``(rows, combined)`` arrays: ``rows`` int32[n, P_rb] (shard-major),
+    ``combined`` int32[n, P_rb, W_rb] block-local neighbor ids with the
+    priority bit at ``BEATS_BIT`` (``engine.bucketed.encode_combined``;
+    block-local ids < vl < 2^30). Priorities stay in original id space —
+    colors are bit-identical to the flat ring engine by construction.
+    """
+    from dgc_tpu.engine.bucketed import _bucket_widths, encode_combined
+
+    v = arrays.num_vertices
+    v_pad = pad_to_multiple(max(v, n), n)
+    vl = v_pad // n
+    degrees = np.zeros(v_pad, dtype=np.int32)
+    degrees[:v] = arrays.degrees
+
+    src = np.repeat(np.arange(v, dtype=np.int64), arrays.degrees)
+    dst = arrays.indices.astype(np.int64)
+    rel = ((src // vl) - (dst // vl)) % n
+    gloc = (dst % vl).astype(np.int32)
+    n_beats = beats_rule(degrees[dst], dst, degrees[src], src)
+    comb_e = encode_combined(gloc, n_beats)
+
+    rot_buckets = []
+    for r in range(n):
+        sel = rel == r
+        sr, er = src[sel], comb_e[sel]
+        # rotation-degree per vertex; bucket rows by it
+        rdeg = np.bincount(sr, minlength=v_pad).astype(np.int64)
+        order = np.argsort(sr, kind="stable")
+        sr_o, er_o = sr[order], er[order]
+        starts = np.zeros(v_pad + 1, np.int64)
+        np.cumsum(rdeg, out=starts[1:])
+        max_rdeg = int(rdeg.max()) if len(sr) else 0
+        widths = _bucket_widths(max(max_rdeg, 1), min_width=min_width)
+        buckets = []
+        e_arange = np.arange(len(sr_o), dtype=np.int64)
+        e_col = e_arange - starts[sr_o]          # edge offset within its row
+        slot_of_row = np.zeros(v_pad, np.int64)  # within-shard bucket slot
+        for wi, w in enumerate(widths):
+            lo = widths[wi - 1] if wi else 0
+            in_b = (rdeg > lo) & (rdeg <= w)
+            rows_w = np.flatnonzero(in_b)
+            if len(rows_w) == 0:
+                continue
+            shard_of = rows_w // vl              # rows_w ascending → stable
+            per_shard = np.bincount(shard_of, minlength=n)
+            p_rb = int(per_shard.max())
+            first = np.zeros(n, np.int64)
+            np.cumsum(per_shard[:-1], out=first[1:])
+            rank = np.arange(len(rows_w), dtype=np.int64) - first[shard_of]
+            slot_of_row[rows_w] = rank
+            rows = np.full((n, p_rb), vl, np.int32)
+            rows[shard_of, rank] = (rows_w % vl).astype(np.int32)
+            comb = np.full((n, p_rb, w), vl, np.int32)
+            e_in = in_b[sr_o]
+            se = sr_o[e_in]
+            comb[se // vl, slot_of_row[se], e_col[e_in]] = er_o[e_in]
+            buckets.append((rows, comb))
+        rot_buckets.append(buckets)
+    return v_pad, vl, rot_buckets
+
+
+def _ring_drive(superstep, deg_l, n: int, max_steps: int,
+                stall_window: int = 64):
+    """Shared while-loop driver for both ring table layouts: carry layout,
+    stall/status transitions, max-steps STALLED clamp, and fail rollback
+    live here once so the flat and bucketed kernels cannot drift.
+    ``superstep(packed_l) -> (new_packed_l, any_fail, active)``."""
+    vl = deg_l.shape[0]
+    packed0_l = jnp.where(deg_l == 0, 0, -1).astype(jnp.int32)
+
+    def cond(carry):
+        _, _, status, _, _ = carry
+        return status == _RUNNING
+
+    def body(carry):
+        packed_l, step, status, prev_active, stall = carry
+        new_packed_l, any_fail, active = superstep(packed_l)
+        stall = jnp.where(active < prev_active, 0, stall + 1)
+        status = status_step(any_fail, active, stall, stall_window)
+        status = jnp.where(
+            (status == _RUNNING) & (step + 1 >= max_steps), _STALLED, status
+        ).astype(jnp.int32)
+        new_packed_l = jnp.where(any_fail, packed_l, new_packed_l)
+        return (new_packed_l, step + 1, status, active, stall)
+
+    packed_l, steps, status, _, _ = jax.lax.while_loop(
+        cond, body,
+        (packed0_l, jnp.int32(0), jnp.int32(_RUNNING),
+         jnp.int32(n * vl + 1), jnp.int32(0)),
+    )
+    colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
+    return colors_l, steps, status
+
+
 def _ring_attempt(deg_l, tables_l, beats_l, k, num_planes: int,
                   max_degree: int, max_steps: int, n: int,
                   stall_window: int = 64):
@@ -120,8 +253,6 @@ def _ring_attempt(deg_l, tables_l, beats_l, k, num_planes: int,
     vl = deg_l.shape[0]
     k = jnp.asarray(k, jnp.int32)
     perm = [(i, (i + 1) % n) for i in range(n)]
-
-    packed0_l = jnp.where(deg_l == 0, 0, -1).astype(jnp.int32)
     pshape = (vl, num_planes)
     fail_exact = 32 * num_planes >= max_degree + 1
     fail_valid = fail_exact | (k <= 32 * num_planes)
@@ -149,28 +280,79 @@ def _ring_attempt(deg_l, tables_l, beats_l, k, num_planes: int,
         active = jax.lax.psum(jnp.sum(active_mask.astype(jnp.int32)), VERTEX_AXIS)
         return new_packed_l, any_fail, active
 
-    def cond(carry):
-        _, _, status, _, _ = carry
-        return status == _RUNNING
+    return _ring_drive(superstep, deg_l, n, max_steps, stall_window)
 
-    def body(carry):
-        packed_l, step, status, prev_active, stall = carry
-        new_packed_l, any_fail, active = superstep(packed_l)
-        stall = jnp.where(active < prev_active, 0, stall + 1)
-        status = status_step(any_fail, active, stall, stall_window)
-        status = jnp.where(
-            (status == _RUNNING) & (step + 1 >= max_steps), _STALLED, status
-        ).astype(jnp.int32)
-        new_packed_l = jnp.where(any_fail, packed_l, new_packed_l)
-        return (new_packed_l, step + 1, status, active, stall)
 
-    packed_l, steps, status, _, _ = jax.lax.while_loop(
-        cond, body,
-        (packed0_l, jnp.int32(0), jnp.int32(_RUNNING),
-         jnp.int32(n * vl + 1), jnp.int32(0)),
+def _ring_attempt_bucketed(deg_l, rot_buckets_l, k, num_planes: int,
+                           max_degree: int, max_steps: int, n: int,
+                           stall_window: int = 64):
+    """``_ring_attempt`` over degree-bucketed rotation tables.
+
+    ``rot_buckets_l[r]`` is a tuple of ``(rows, comb)`` per-shard slices
+    (``build_bucketed_rotation_tables``): rows int32[P_rb] block-local row
+    ids (sentinel = vl), comb int32[P_rb, W_rb] combined neighbor entries.
+    Stats are computed per bucket and OR-merged into the full [vl, planes]
+    accumulators through a gather-modify-scatter on just the bucket's rows
+    (cost ∝ rows with neighbors toward the rotation, not vl). The update
+    rule, priorities, and windows are the flat ring engine's — colors are
+    bit-identical; only table memory changes (∝ Σ deg, any Δ)."""
+    from dgc_tpu.engine.bucketed import decode_combined
+
+    vl = deg_l.shape[0]
+    k = jnp.asarray(k, jnp.int32)
+    perm = [(i, (i + 1) % n) for i in range(n)]
+    pshape = (vl, num_planes)
+    fail_exact = 32 * num_planes >= max_degree + 1
+    fail_valid = fail_exact | (k <= 32 * num_planes)
+
+    def superstep(packed_l):
+        mycol = packed_l >> 1
+        forb_all = jnp.zeros(pshape, jnp.uint32)
+        forb_old = jnp.zeros(pshape, jnp.uint32)
+        clash = jnp.zeros((vl,), bool)
+        block = packed_l
+        for r in range(n):
+            block_pad = jnp.concatenate([block, jnp.array([-1], jnp.int32)])
+            for rows, comb in rot_buckets_l[r]:
+                rows = rows.reshape(-1)            # [1, P_rb] shard slice
+                comb = comb.reshape(rows.shape[0], -1)
+                real = rows < vl
+                rs = jnp.where(real, rows, 0)
+                nb, beats = decode_combined(comb)
+                g = block_pad[nb]
+                mc = jnp.where(real, mycol[rs], -1)
+                fa, fo, cl = neighbor_stats(g, beats, mc, num_planes)
+                forb_all = forb_all.at[rows].set(
+                    forb_all[rs] | fa, mode="drop")
+                forb_old = forb_old.at[rows].set(
+                    forb_old[rs] | fo, mode="drop")
+                clash = clash.at[rows].set(clash[rs] | cl, mode="drop")
+            if r + 1 < n:
+                block = jax.lax.ppermute(block, VERTEX_AXIS, perm)
+        new_packed_l, fail_mask, active_mask = apply_update(
+            packed_l, forb_all, forb_old, clash, k
+        )
+        fail_count = jax.lax.psum(jnp.sum(fail_mask.astype(jnp.int32)), VERTEX_AXIS)
+        any_fail = (fail_count > 0) & fail_valid
+        active = jax.lax.psum(jnp.sum(active_mask.astype(jnp.int32)), VERTEX_AXIS)
+        return new_packed_l, any_fail, active
+
+    return _ring_drive(superstep, deg_l, n, max_steps, stall_window)
+
+
+def _ring_attempt_bucketed_body(deg_l, rot_buckets_l, k, *, num_planes: int,
+                                max_degree: int, max_steps: int, n: int):
+    return _ring_attempt_bucketed(deg_l, rot_buckets_l, k, num_planes,
+                                  max_degree, max_steps, n)
+
+
+def _ring_sweep_bucketed_body(deg_l, rot_buckets_l, k0, *, num_planes: int,
+                              max_degree: int, max_steps: int, n: int):
+    return device_sweep_pair(
+        lambda k: _ring_attempt_bucketed(deg_l, rot_buckets_l, k, num_planes,
+                                         max_degree, max_steps, n),
+        k0, VERTEX_AXIS,
     )
-    colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
-    return colors_l, steps, status
 
 
 def _ring_attempt_body(deg_l, tables_l, beats_l, k, *, num_planes: int,
@@ -196,13 +378,21 @@ class RingHaloEngine:
     default 32 planes = 1024 colors): memory and plane-unroll stay bounded
     even when Δ+1 is five digits, and a genuinely starved attempt exits
     STALLED and widens the window (``bucketed`` contract) instead of
-    asserting a wrong answer. Note the per-rotation neighbor *tables* are
-    still flat-width (Σ_r W_r ≈ Δ per vertex): for heavy-tailed/RMAT graphs
-    where that O(V·Δ) table is the bottleneck, use
-    ``engine.sharded_bucketed.ShardedBucketedEngine`` — this engine's niche
-    is bounded-degree graphs whose packed state outgrows per-chip
-    replication (O(V/n) state per chip vs the all-gather engines' O(V)).
+    asserting a wrong answer. Per-rotation neighbor tables come in two
+    layouts: flat width (fastest on bounded-degree graphs — one gather per
+    rotation, no scatter merge) and degree-bucketed
+    (``build_bucketed_rotation_tables``, memory ∝ Σ deg at any Δ), chosen
+    automatically by the flat layout's waste ratio (``bucket_tables``
+    overrides). With the bucketed layout the O(V/n)-state story extends to
+    power-law graphs: peak per-chip memory is O(V/n + Σdeg/n) with
+    bit-identical colors either way.
     """
+
+    # flat rotation tables pad every row to the rotation's max width; on
+    # heavy tails that is O(V·Δ) — switch to the bucketed layout once the
+    # flat form would waste ≥8× the edges (the flat layout is faster per
+    # superstep on bounded-degree graphs: no scatter merge)
+    BUCKET_WASTE_RATIO = 8
 
     def __init__(
         self,
@@ -211,13 +401,36 @@ class RingHaloEngine:
         max_steps: int | None = None,
         mesh=None,
         max_window_planes: int = 32,
+        bucket_tables: bool | None = None,
     ):
         self.arrays = arrays
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
         self._n = self.mesh.shape[VERTEX_AXIS]
         v = arrays.num_vertices
         self.v_true = v
-        v_pad, vl, tables, beats = build_rotation_tables(arrays, self._n)
+
+        if bucket_tables is None:
+            bucket_tables = flat_rotation_entries(arrays, self._n) > (
+                self.BUCKET_WASTE_RATIO * max(arrays.num_directed_edges, 1))
+        self.bucket_tables = bucket_tables
+
+        if bucket_tables:
+            v_pad, vl, rot_buckets = build_bucketed_rotation_tables(
+                arrays, self._n)
+            rows2d = NamedSharding(self.mesh, P(VERTEX_AXIS, None))
+            rows3d = NamedSharding(self.mesh, P(VERTEX_AXIS, None, None))
+            self.rot_buckets = tuple(
+                tuple((jax.device_put(r, rows2d), jax.device_put(c, rows3d))
+                      for r, c in bl)
+                for bl in rot_buckets
+            )
+            self.tables = self.beats = ()
+        else:
+            v_pad, vl, tables, beats = build_rotation_tables(arrays, self._n)
+            rows2d = NamedSharding(self.mesh, P(VERTEX_AXIS, None))
+            self.tables = tuple(jax.device_put(t, rows2d) for t in tables)
+            self.beats = tuple(jax.device_put(b, rows2d) for b in beats)
+            self.rot_buckets = ()
 
         deg_p = np.zeros(v_pad, dtype=np.int32)
         deg_p[:v] = arrays.degrees
@@ -227,33 +440,54 @@ class RingHaloEngine:
         self.max_steps = max_steps if max_steps is not None else 2 * v_pad + 4
 
         rows = NamedSharding(self.mesh, P(VERTEX_AXIS))
-        rows2d = NamedSharding(self.mesh, P(VERTEX_AXIS, None))
         self.deg_l = jax.device_put(deg_p, rows)
-        self.tables = tuple(jax.device_put(t, rows2d) for t in tables)
-        self.beats = tuple(jax.device_put(b, rows2d) for b in beats)
         self._kernels = {}
 
     _maybe_widen_window = maybe_widen_window
 
     def _kernel(self, body, name: str):
+        static = dict(num_planes=self.num_planes,
+                      max_degree=self.arrays.max_degree,
+                      max_steps=self.max_steps, n=self._n)
+        if self.bucket_tables:
+            in_specs = (P(VERTEX_AXIS),
+                        tuple(tuple((P(VERTEX_AXIS, None),
+                                     P(VERTEX_AXIS, None, None))
+                                    for _ in bl)
+                              for bl in self.rot_buckets),
+                        P())
+            return cached_shard_kernel(self, body, name, self.num_planes,
+                                       in_specs=in_specs,
+                                       static_kwargs=static)
         return cached_shard_kernel(
             self, body, name, self.num_planes,
             in_specs=(P(VERTEX_AXIS),
                       tuple(P(VERTEX_AXIS, None) for _ in self.tables),
                       tuple(P(VERTEX_AXIS, None) for _ in self.beats),
                       P()),
-            static_kwargs=dict(num_planes=self.num_planes,
-                               max_degree=self.arrays.max_degree,
-                               max_steps=self.max_steps, n=self._n),
+            static_kwargs=static,
         )
+
+    def _run_attempt(self, k_eff):
+        if self.bucket_tables:
+            return self._kernel(_ring_attempt_bucketed_body, "attempt_b")(
+                self.deg_l, self.rot_buckets, k_eff)
+        return self._kernel(_ring_attempt_body, "attempt")(
+            self.deg_l, self.tables, self.beats, k_eff)
+
+    def _run_sweep(self, k_eff):
+        if self.bucket_tables:
+            return self._kernel(_ring_sweep_bucketed_body, "sweep_b")(
+                self.deg_l, self.rot_buckets, k_eff)
+        return self._kernel(_ring_sweep_body, "sweep")(
+            self.deg_l, self.tables, self.beats, k_eff)
 
     def attempt(self, k: int) -> AttemptResult:
         if k < 1:
             return empty_budget_failure(self.v_true, k)
         k_eff = clamp_budget(k, 32 * num_planes_for(self.arrays.max_degree + 1))
         (colors, steps, _), status = run_windowed(
-            lambda: self._kernel(_ring_attempt_body, "attempt")(
-                self.deg_l, self.tables, self.beats, k_eff),
+            lambda: self._run_attempt(k_eff),
             self._maybe_widen_window,
         )
         return AttemptResult(
@@ -268,8 +502,7 @@ class RingHaloEngine:
             return self.attempt(k0), None
         k_eff = clamp_budget(k0, 32 * num_planes_for(self.arrays.max_degree + 1))
         outs, status1 = run_windowed(
-            lambda: self._kernel(_ring_sweep_body, "sweep")(
-                self.deg_l, self.tables, self.beats, k_eff),
+            lambda: self._run_sweep(k_eff),
             self._maybe_widen_window, status_index=2,
         )
         c1, steps1, _, used, c2, steps2, status2 = outs

@@ -56,12 +56,13 @@ from dgc_tpu.engine.bucketed import (
     MAX_WINDOW_PLANES,
     build_degree_buckets,
     bucket_planes,
-    bucketed_superstep,
     decode_combined,
     encode_combined,
     initial_packed,
     status_step,
 )
+from dgc_tpu.engine.compact import _bucket_fail_valid, _compact_idx, _pow2_ceil
+from dgc_tpu.ops.speculative import speculative_update
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.parallel.mesh import (
     VERTEX_AXIS,
@@ -139,11 +140,86 @@ def build_sharded_buckets(arrays: GraphArrays, n: int,
     )
 
 
+def shard_pad_for(slice_rows: int, width: int,
+                  uncond_entries: int = 1 << 17) -> int:
+    """Row-compaction pad for one shard's slice of a bucket (0 = run the
+    full slice unconditioned — for small slices the cond machinery costs
+    more than the gather it can skip). Pads sit at rows/2: per-bucket live
+    counts in the high-degree core decay slowly (trajectory measurement,
+    ``utils.trajectory``), so rows/8-style pads only engage at the very
+    end of the sweep."""
+    if slice_rows * width <= uncond_entries:
+        return 0
+    pad = _pow2_ceil(max(slice_rows // 2, 32))
+    return pad if pad < slice_rows else 0
+
+
+def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
+                     pads: tuple):
+    """One superstep over the shard's bucket slices with per-bucket live
+    gating: an inert slice is skipped, a slice whose live count fits its
+    pad runs row-compacted, everything else runs the full slice — each
+    shard independently (the branches contain no collectives, so
+    shard-divergent control flow is legal under ``shard_map``). Exact by
+    the same monotone-frontier argument as ``engine.compact``: inactive
+    rows transition to themselves. Bit-identical to the ungated
+    ``bucketed_superstep`` by construction (shared ``speculative_update``
+    core, shared ``_compact_idx`` slot idiom)."""
+    packed_pad = jnp.concatenate([packed_g, jnp.array([-1], jnp.int32)])
+    new_parts, fail_parts, act_parts = [], [], []
+    row0 = 0
+    for tb, p_b, pad in zip(tables_l, planes, pads):
+        rows, w = tb.shape
+        pk_b = jax.lax.dynamic_slice_in_dim(packed_l, row0, rows)
+        fv = _bucket_fail_valid(w, p_b, k).astype(jnp.int32)
+
+        def full(pk_b, tb=tb, p_b=p_b, fv=fv):
+            nb, beats = decode_combined(tb)
+            new_b, fail_m, act_m = speculative_update(
+                pk_b, packed_pad[nb], beats, k, p_b)
+            return (new_b, jnp.sum(fail_m.astype(jnp.int32)) * fv,
+                    jnp.sum(act_m.astype(jnp.int32)))
+
+        if pad == 0:
+            r = full(pk_b)
+        else:
+            act_b = (pk_b < 0) | ((pk_b & 1) == 1)
+            na = jnp.sum(act_b.astype(jnp.int32))
+
+            def compact(pk_b, tb=tb, p_b=p_b, fv=fv, pad=pad, rows=rows,
+                        act_b=act_b):
+                idx = _compact_idx(act_b, pad, rows)
+                real = idx < rows
+                idx_safe = jnp.where(real, idx, 0)
+                pk_slot = jnp.where(real, pk_b[idx_safe], 0)  # dummies inert
+                nb, beats = decode_combined(jnp.take(tb, idx_safe, axis=0))
+                new_slot, fail_m, act_m = speculative_update(
+                    pk_slot, packed_pad[nb], beats, k, p_b)
+                return (pk_b.at[idx].set(new_slot, mode="drop"),
+                        jnp.sum(fail_m.astype(jnp.int32)) * fv,
+                        jnp.sum(act_m.astype(jnp.int32)))
+
+            def skip(pk_b):
+                return pk_b, jnp.int32(0), jnp.int32(0)
+
+            def live(pk_b, pad=pad, compact=compact, full=full, na=na):
+                return jax.lax.cond(na <= pad, compact, full, pk_b)
+
+            r = jax.lax.cond(na > 0, live, skip, pk_b)
+        new_parts.append(r[0])
+        fail_parts.append(r[1])
+        act_parts.append(r[2])
+        row0 += rows
+    return jnp.concatenate(new_parts), sum(fail_parts), sum(act_parts)
+
+
 def _shard_attempt(tables_l, deg_l, k, planes: tuple, max_steps: int,
-                   v_final: int, stall_window: int = 64):
-    """One k-attempt on a shard: while_loop of all-gather + shared bucketed
+                   v_final: int, pads: tuple = (), stall_window: int = 64):
+    """One k-attempt on a shard: while_loop of all-gather + gated bucketed
     superstep + psum reductions. Returns (colors_l, steps, status)."""
     k = jnp.asarray(k, jnp.int32)
+    if not pads:
+        pads = tuple(0 for _ in tables_l)
     carry = (initial_packed(deg_l), jnp.int32(1), jnp.int32(_RUNNING),
              jnp.int32(v_final + 1), jnp.int32(0))
 
@@ -154,8 +230,8 @@ def _shard_attempt(tables_l, deg_l, k, planes: tuple, max_steps: int,
     def body(c):
         packed_l, step, status, prev_active, stall = c
         packed_g = jax.lax.all_gather(packed_l, VERTEX_AXIS, tiled=True)
-        new_packed_l, fail_l, active_l = bucketed_superstep(
-            packed_l, tables_l, k, planes, packed_src=packed_g
+        new_packed_l, fail_l, active_l = _gated_superstep(
+            packed_l, packed_g, tables_l, k, planes, pads
         )
         fail_count = jax.lax.psum(fail_l, VERTEX_AXIS)
         active = jax.lax.psum(active_l, VERTEX_AXIS)
@@ -174,15 +250,17 @@ def _shard_attempt(tables_l, deg_l, k, planes: tuple, max_steps: int,
 
 
 def _shard_attempt_body(tables_l, deg_l, k, *, planes: tuple, max_steps: int,
-                        v_final: int):
-    return _shard_attempt(tables_l, deg_l, k, planes, max_steps, v_final)
+                        v_final: int, pads: tuple = ()):
+    return _shard_attempt(tables_l, deg_l, k, planes, max_steps, v_final,
+                          pads=pads)
 
 
 def _shard_sweep_body(tables_l, deg_l, k0, *, planes: tuple, max_steps: int,
-                      v_final: int):
+                      v_final: int, pads: tuple = ()):
     """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call."""
     return device_sweep_pair(
-        lambda k: _shard_attempt(tables_l, deg_l, k, planes, max_steps, v_final),
+        lambda k: _shard_attempt(tables_l, deg_l, k, planes, max_steps,
+                                 v_final, pads=pads),
         k0, VERTEX_AXIS,
     )
 
@@ -198,7 +276,8 @@ class ShardedBucketedEngine:
 
     def __init__(self, arrays: GraphArrays, num_shards: int | None = None,
                  mesh=None, max_steps: int | None = None, min_width: int = 4,
-                 max_window_planes: int = MAX_WINDOW_PLANES):
+                 max_window_planes: int = MAX_WINDOW_PLANES,
+                 uncond_entries: int = 1 << 17):
         self.arrays = arrays
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
         n = self.mesh.shape[VERTEX_AXIS]
@@ -209,6 +288,11 @@ class ShardedBucketedEngine:
         self.planes = bucket_planes(lay.tables, max_planes=max_window_planes)
         self.max_steps = max_steps if max_steps is not None else 2 * v + 4
 
+        # per-shard-slice frontier gating pads (0 = unconditioned slice)
+        self.pads = tuple(
+            shard_pad_for(s, t.shape[1], uncond_entries=uncond_entries)
+            for s, t in zip(lay.slice_sizes, lay.tables)
+        )
         rows2d = NamedSharding(self.mesh, P(VERTEX_AXIS, None))
         self.tables = tuple(jax.device_put(t, rows2d) for t in lay.tables)
         self.deg_l = jax.device_put(
@@ -235,7 +319,7 @@ class ShardedBucketedEngine:
             in_specs=(tuple(P(VERTEX_AXIS, None) for _ in self.tables),
                       P(VERTEX_AXIS), P()),
             static_kwargs=dict(planes=self.planes, max_steps=self.max_steps,
-                               v_final=self.layout.v_final),
+                               v_final=self.layout.v_final, pads=self.pads),
         )
 
     def _finish(self, colors_final: np.ndarray, status, steps: int,

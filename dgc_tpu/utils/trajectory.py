@@ -1,0 +1,148 @@
+"""Exact-rule frontier trajectory — the schedule-design instrument.
+
+Replays the engines' speculative update rule (``ops.speculative``: eager
+color-0 speculation, high-degree-wins demotion, first-fit re-pick —
+reference semantics ``coloring_optimized.py:150-200``) in vectorized
+NumPy over the degree-relabeled CSR, recording per superstep the
+quantities every scheduling decision in ``engine.compact`` is sized
+against:
+
+- ``active``: |uncolored ∪ fresh| — stage thresholds;
+- ``sum_deg_active``: Σ deg over active vertices — the fundamental
+  per-superstep gather floor of any exact schedule;
+- ``active_per_bucket``: live rows per width bucket — hub cond gates and
+  row-compaction pad sizing;
+- ``max_unconf_per_bucket``: max unconfirmed-neighbor count over a
+  bucket's active rows — hub neighbor-pruning width (U) sizing and the
+  rebase validity bar.
+
+This is measurement tooling, not an engine: it runs the same transition
+(colors match the bucketed engines bit-for-bit in relabeled space — see
+``tests/test_tracing.py::test_trajectory_matches_engine``) but on host,
+with no compile cost, so trajectory questions ("when do the W=1024
+bucket's live rows fit a 512 pad?") cost seconds instead of a TPU
+compile+run cycle. The 200k-RMAT findings that sized the round-3 hub
+machinery (slot pads rows/2, pruned width W/4, the v/64 ladder rung) came
+from exactly this replay.
+
+The color window is 512 (8 × 64-bit plane words) — far above any greedy
+color count this tool is pointed at; it asserts rather than truncates.
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from dgc_tpu.models.arrays import GraphArrays
+
+_WORDS = 8  # 512-color window
+
+
+@dataclass
+class TrajectoryStep:
+    """One superstep's frontier measurements."""
+
+    step: int
+    active: int
+    sum_deg_active: int
+    active_per_bucket: list[int]
+    max_unconf_per_bucket: list[int]
+
+
+@dataclass
+class Trajectory:
+    """Full-sweep record plus the bucket layout it is indexed against."""
+
+    bucket_sizes: list[int]
+    bucket_widths: list[int]
+    steps: list[TrajectoryStep] = field(default_factory=list)
+    colors: np.ndarray | None = None  # final colors, relabeled id space
+
+    @property
+    def supersteps(self) -> int:
+        return len(self.steps)
+
+    def gather_floor(self) -> int:
+        """Σ over supersteps of Σdeg(active) — the least any exact
+        superstep schedule must gather for this (graph, k) trajectory."""
+        return sum(s.sum_deg_active for s in self.steps)
+
+
+def record_trajectory(arrays: GraphArrays, k: int | None = None,
+                      max_steps: int = 100_000) -> Trajectory:
+    """Replay the exact update rule on ``arrays`` and record the frontier.
+
+    ``k`` defaults to Δ+1 (the reference's starting budget,
+    ``coloring.py:212``); the replay assumes k is never exhausted within
+    the 512-color window (greedy color counts track the core number,
+    orders of magnitude below) and asserts if that breaks.
+    """
+    from dgc_tpu.engine.bucketed import build_degree_buckets
+
+    b = build_degree_buckets(arrays)
+    v = arrays.num_vertices
+    deg = b.degrees.astype(np.int64)
+    indices = b.indices.astype(np.int64)
+    src = np.repeat(np.arange(v, dtype=np.int64), deg)
+    nd, sd = deg[indices], deg[src]
+    beats_e = (nd > sd) | ((nd == sd) & (indices < src))
+    sizes = [cb.shape[0] for cb in b.combined]
+    widths = [cb.shape[1] for cb in b.combined]
+    row0s = np.concatenate([[0], np.cumsum(sizes)]).astype(int)
+    k = int(arrays.max_degree + 1 if k is None else k)
+    assert k >= 1, "trajectory replay assumes a non-empty budget"
+
+    traj = Trajectory(bucket_sizes=sizes, bucket_widths=widths)
+    # round-1 specialization (engine.bucketed.initial_packed)
+    packed = np.where(deg == 0, 0, 1).astype(np.int64)
+    arange_v = np.arange(v)
+    for step in range(1, max_steps + 1):
+        col = np.where(packed >= 0, packed >> 1, -1)
+        fresh = (packed >= 0) & ((packed & 1) == 1)
+        uncol = packed < 0
+        act = uncol | fresh
+        if not act.any():
+            break
+
+        conf_e = ~((packed >= 0) & ((packed & 1) == 0))[indices]
+        ucnt = np.bincount(src[conf_e], minlength=v)
+        traj.steps.append(TrajectoryStep(
+            step=step,
+            active=int(act.sum()),
+            sum_deg_active=int(deg[act].sum()),
+            active_per_bucket=[
+                int(act[row0s[i]:row0s[i + 1]].sum()) for i in range(len(sizes))],
+            max_unconf_per_bucket=[
+                int(ucnt[row0s[i]:row0s[i + 1]][act[row0s[i]:row0s[i + 1]]]
+                    .max(initial=0)) for i in range(len(sizes))],
+        ))
+
+        ncol, nfresh = col[indices], fresh[indices]
+        m = nfresh & (ncol == col[src]) & beats_e
+        clash = np.bincount(src[m], minlength=v) > 0
+        nvalid = ncol >= 0
+        forb = np.zeros((v, _WORDS), np.uint64)
+        np.bitwise_or.at(
+            forb, (src[nvalid], ncol[nvalid] >> 6),
+            np.uint64(1) << (ncol[nvalid] & 63).astype(np.uint64))
+        needs = uncol | (fresh & clash)
+        free = ~forb
+        word = np.argmax(free != 0, axis=1)
+        fw = free[arange_v, word]
+        lsb = fw & (~fw + np.uint64(1))
+        bit = np.zeros(v, np.int64)
+        nz = lsb != 0
+        bit[nz] = np.log2(lsb[nz].astype(np.float64)).astype(np.int64)
+        cand = word * 64 + bit
+        assert cand[needs].max(initial=0) < 64 * _WORDS - 1 and \
+            cand[needs].max(initial=0) < k, "color window exhausted"
+        new = packed.copy()
+        confirm = fresh & ~clash
+        new[confirm] = col[confirm] * 2
+        new[needs] = cand[needs] * 2 + 1
+        packed = new
+
+    traj.colors = np.where(packed >= 0, packed >> 1, -1).astype(np.int32)
+    return traj

@@ -35,3 +35,27 @@ def test_timer_sections():
     with t.section("a"):
         pass
     assert "a" in t.totals and t.totals["a"] >= 0
+
+
+def test_trajectory_matches_engine():
+    # the NumPy trajectory replay must be the engines' exact rule: same
+    # colors (relabeled space) and the engine's superstep counter is the
+    # replay's update count + 1 (the counter starts at 1 on the round-1
+    # specialized state)
+    import numpy as np
+
+    from dgc_tpu.engine.bucketed import BucketedELLEngine
+    from dgc_tpu.models.generators import generate_rmat_graph
+    from dgc_tpu.utils.trajectory import record_trajectory
+
+    g = generate_rmat_graph(1500, avg_degree=10.0, seed=7)
+    traj = record_trajectory(g)
+    eng = BucketedELLEngine(g)
+    res = eng.attempt(g.max_degree + 1)
+    assert np.array_equal(traj.colors, res.colors[eng.perm])
+    assert res.supersteps == traj.supersteps + 1
+    assert traj.gather_floor() > 0
+    assert len(traj.steps[0].active_per_bucket) == len(traj.bucket_sizes)
+    # frontier is monotone non-increasing per bucket after step 1
+    pb = np.array([s.active_per_bucket for s in traj.steps])
+    assert (np.diff(pb, axis=0) <= 0).all()
