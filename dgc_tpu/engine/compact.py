@@ -105,22 +105,30 @@ def _pow2_ceil(n: int) -> int:
     return 1 << max(0, (int(n) - 1).bit_length())
 
 
-def default_stages(v: int) -> tuple:
+def default_stages(v: int, heavy_tail: bool = False) -> tuple:
     """((scale, run_down_to_threshold), ...); scale None = full-table phase.
     A compaction stage's flat pad is ``pow2(scale)`` rows.
 
-    Four ×4 rungs (v/4 → v/16 → v/64 → v/256): a stage's per-superstep
-    cost is bound by its *static* pad, not the live frontier, so each
-    missing rung makes every superstep in its span pay up to 4× its
-    frontier's gather volume. High-color sweeps (heavy-tail/RMAT graphs
-    take ~2·C supersteps for C colors — the dense core serializes one
-    color class per round) spend most supersteps far down the ladder; the
-    200k-RMAT trace showed the v/16→v/256 gap alone holding 19 of 68
-    supersteps at 4× weight. Rungs below v/256 measured ≈ nothing (the
-    flat region is inert for the heavy-tail long tail) while each extra
-    rung is another compiled stage body."""
+    A stage's per-superstep cost is bound by its *static* pad, not the
+    live frontier, so each missing rung makes every superstep in its span
+    pay up to 4× its frontier's gather volume — but each extra rung is
+    another compiled stage body. Bounded-degree graphs get the measured
+    3-rung ladder (v/4 → v/16 → v/256; the 1M-uniform sweep collapses in
+    ~13 supersteps, deeper rungs bought ≈ nothing). Heavy-tailed graphs
+    (``heavy_tail``) add the v/64 rung: their high-color sweeps (~2·C
+    supersteps for C colors — the dense core serializes one color class
+    per round) spend many supersteps mid-ladder; the 200k-RMAT trace
+    showed the v/16→v/256 gap alone holding 19 of 68 supersteps at 4×
+    weight."""
     if v <= 1 << 14:
         return ((None, 0),)
+    if not heavy_tail:
+        return (
+            (None, v // 4),
+            (v // 4, v // 16),
+            (v // 16, v // 256),
+            (v // 256, 0),
+        )
     return (
         (None, v // 4),
         (v // 4, v // 16),
@@ -274,9 +282,10 @@ def hub_prune_cfg(rows: int, width: int, u_min: int = 128,
     u = max(u_min, min(width // u_div, 2048))
     if 2 * u > width:
         return None
-    # clamp to the bucket's rows: a pad above them would make rebase
-    # gather MORE than the full branch (dummy slots re-gather row 0)
-    return (min(_pow2_ceil(max(rows // 2, 32)), _pow2_ceil(rows)), u)
+    # clamp to the bucket's rows: a pad above them would make the rebase
+    # branch gather MORE than the full branch (dummy slots re-gather
+    # row 0), so pad ≤ rows always (pads need not be powers of two)
+    return (min(_pow2_ceil(max(rows // 2, 32)), rows), u)
 
 
 def _fresh_prune(buckets, hub_buckets: int, planes: tuple, hub_prune: tuple,
@@ -905,7 +914,8 @@ class CompactFrontierEngine(BucketedELLEngine):
         super().__init__(arrays, max_steps=max_steps, min_width=min_width, **kw)
         v = arrays.num_vertices
         if stages is None:
-            stages = default_stages(v)
+            cap = flat_cap if flat_cap is not None else self.FLAT_CAP
+            stages = default_stages(v, heavy_tail=arrays.max_degree > cap)
         # a compaction stage's scale must bound the frontier at entry
         # (the previous stage's exit threshold, or V at the start) — a
         # smaller scale would silently drop active vertices

@@ -165,17 +165,23 @@ def build_bucketed_rotation_tables(arrays: GraphArrays, n: int,
     n_beats = beats_rule(degrees[dst], dst, degrees[src], src)
     comb_e = encode_combined(gloc, n_beats)
 
+    # ONE lexsort by (rel, src) and contiguous slices per rotation — not a
+    # full-edge mask + sort per rotation, which is O(n·E) and grows the
+    # host build linearly with shard count at this engine's target scale
+    g_order = np.argsort(rel * np.int64(v_pad) + src, kind="stable")
+    rel_sorted = rel[g_order]
+    seg = np.searchsorted(rel_sorted, np.arange(n + 1, dtype=np.int64))
+    src_sorted, comb_sorted = src[g_order], comb_e[g_order]
+
     rot_buckets = []
     for r in range(n):
-        sel = rel == r
-        sr, er = src[sel], comb_e[sel]
+        sr_o = src_sorted[seg[r]: seg[r + 1]]
+        er_o = comb_sorted[seg[r]: seg[r + 1]]
         # rotation-degree per vertex; bucket rows by it
-        rdeg = np.bincount(sr, minlength=v_pad).astype(np.int64)
-        order = np.argsort(sr, kind="stable")
-        sr_o, er_o = sr[order], er[order]
+        rdeg = np.bincount(sr_o, minlength=v_pad).astype(np.int64)
         starts = np.zeros(v_pad + 1, np.int64)
         np.cumsum(rdeg, out=starts[1:])
-        max_rdeg = int(rdeg.max()) if len(sr) else 0
+        max_rdeg = int(rdeg.max()) if len(sr_o) else 0
         widths = _bucket_widths(max(max_rdeg, 1), min_width=min_width)
         buckets = []
         e_arange = np.arange(len(sr_o), dtype=np.int64)

@@ -35,7 +35,11 @@ assert info["global_devices"] == 2 * info["local_devices"], info
 
 from dgc_tpu.engine.base import AttemptStatus  # noqa: E402
 from dgc_tpu.engine.sharded import ShardedELLEngine  # noqa: E402
-from dgc_tpu.models.generators import generate_random_graph  # noqa: E402
+from dgc_tpu.engine.sharded_bucketed import ShardedBucketedEngine  # noqa: E402
+from dgc_tpu.models.generators import (  # noqa: E402
+    generate_random_graph,
+    generate_rmat_graph,
+)
 from dgc_tpu.parallel.mesh import make_mesh  # noqa: E402
 
 g = generate_random_graph(50, 5, seed=7)  # same seed on both processes
@@ -44,7 +48,14 @@ engine = ShardedELLEngine(g, mesh=mesh)
 res = engine.attempt(g.max_degree + 1)
 assert res.status == AttemptStatus.SUCCESS, res.status
 
+# heavy-tail engine over the same 2-process mesh (degree-dealt buckets,
+# frontier gating) — the multi-chip power-law path across real processes
+gr = generate_rmat_graph(256, avg_degree=6, seed=9, native=False)
+resb = ShardedBucketedEngine(gr, mesh=mesh).attempt(gr.max_degree + 1)
+assert resb.status == AttemptStatus.SUCCESS, resb.status
+
 with open(os.path.join(outdir, f"result_{pid}.json"), "w") as f:
     json.dump({"info": info, "colors": res.colors.tolist(),
-               "supersteps": res.supersteps}, f)
+               "supersteps": res.supersteps,
+               "rmat_colors": resb.colors.tolist()}, f)
 print(f"worker {pid} OK: {info}")

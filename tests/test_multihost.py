@@ -90,3 +90,13 @@ def test_two_process_distributed_smoke(tmp_path):
     g = generate_random_graph(50, 5, seed=7)
     ref = ShardedELLEngine(g, mesh=make_mesh(2)).attempt(g.max_degree + 1)
     assert np.array_equal(np.array(results[0]["colors"]), ref.colors)
+
+    # heavy-tail engine across processes: agrees between processes and with
+    # the single-device bucketed engine (its bit-identity reference)
+    from dgc_tpu.engine.bucketed import BucketedELLEngine
+    from dgc_tpu.models.generators import generate_rmat_graph
+
+    assert results[0]["rmat_colors"] == results[1]["rmat_colors"]
+    gr = generate_rmat_graph(256, avg_degree=6, seed=9, native=False)
+    refb = BucketedELLEngine(gr).attempt(gr.max_degree + 1)
+    assert np.array_equal(np.array(results[0]["rmat_colors"]), refb.colors)

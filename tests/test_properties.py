@@ -176,3 +176,22 @@ def test_fused_sweep_prefix_resume_exact(g):
     assert second.status == r2.status
     assert np.array_equal(second.colors, r2.colors)
     assert second.supersteps == r2.supersteps
+
+
+@settings(max_examples=40, deadline=None)
+@given(graphs())
+def test_pruned_hub_machinery_agreement(g):
+    # the round-3 hub machinery (row compaction, neighbor pruning, uncond
+    # small buckets) forced onto arbitrary graphs: every bucket becomes a
+    # hub bucket (flat_cap=1), pruning engages at tiny widths
+    # (prune_u_min=2), nothing is unconditioned (hub_uncond_entries=0) —
+    # colors must stay bit-identical to the plain bucketed engine
+    k0 = g.max_degree + 1
+    ref = BucketedELLEngine(g).attempt(k0)
+    eng = CompactFrontierEngine(
+        g, flat_cap=1, prune_u_min=2, hub_uncond_entries=0,
+        stages=((None, max(g.num_vertices // 2, 1)),
+                (_pow2_ceil(max(g.num_vertices // 2, 1)), 0)))
+    res = eng.attempt(k0)
+    assert res.status == ref.status
+    assert np.array_equal(res.colors, ref.colors)
