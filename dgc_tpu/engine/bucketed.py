@@ -82,6 +82,31 @@ def encode_combined(nbrs: np.ndarray, beats: np.ndarray) -> np.ndarray:
     return nbrs | (beats.astype(np.int32) << BEATS_BIT)
 
 
+def build_combined_rows(indptr, indices, degrees, row0: int, end: int,
+                        width: int, v: int, native: bool = False) -> np.ndarray:
+    """Combined (neighbor id | beats bit) ELL table for relabeled CSR rows
+    [row0, end) — the one table-build primitive behind every bucket and the
+    compact engine's flat table. ``native=True`` takes the C++ one-pass
+    builder (bit-identical; no full-table temporaries — the host-build hot
+    spot at 1M+, PERF.md), falling back to the NumPy reference chain."""
+    if native:
+        from dgc_tpu.native.bindings import build_combined_native
+
+        out = build_combined_native(indptr, indices, degrees, row0,
+                                    end - row0, width, v)
+        if out is not None:
+            return out
+    sub_indptr = indptr[row0: end + 1] - indptr[row0]
+    sub_indices = indices[indptr[row0]: indptr[end]]
+    nb, _ = csr_to_ell(sub_indptr, sub_indices, width=width, sentinel=v)
+    deg_pad = np.concatenate([degrees, np.array([-1], np.int32)])
+    n_deg = deg_pad[nb]
+    my_deg = degrees[row0: end, None]
+    my_ids = np.arange(row0, end, dtype=np.int32)[:, None]
+    beats = beats_rule(n_deg, nb, my_deg, my_ids)
+    return encode_combined(nb, beats)
+
+
 @dataclass
 class DegreeBuckets:
     """Degree-descending relabeled graph split into width buckets.
@@ -137,8 +162,6 @@ def build_degree_buckets(arrays: GraphArrays, min_width: int = 4,
         order = np.argsort(new_row * v + new_col, kind="stable")
         new_indices = new_col[order].astype(np.int32)
 
-    deg_pad = np.concatenate([deg_new, np.array([-1], np.int32)])
-
     # split rows into buckets by width (descending degrees → contiguous)
     widths_desc = sorted(widths, reverse=True)
     row0s, combined_list = [], []
@@ -150,15 +173,10 @@ def build_degree_buckets(arrays: GraphArrays, min_width: int = 4,
         if wi + 1 >= len(widths_desc):
             end = v  # last bucket takes the rest (incl. isolated)
         if end > row:
-            sub_indptr = new_indptr[row: end + 1] - new_indptr[row]
-            sub_indices = new_indices[new_indptr[row]: new_indptr[end]]
-            nb, _ = csr_to_ell(sub_indptr, sub_indices, width=width, sentinel=v)
-            n_deg = deg_pad[nb]
-            my_deg = deg_new[row:end, None]
-            my_ids = np.arange(row, end, dtype=np.int32)[:, None]
-            beats = beats_rule(n_deg, nb, my_deg, my_ids)
             row0s.append(row)
-            combined_list.append(encode_combined(nb, beats))
+            combined_list.append(build_combined_rows(
+                new_indptr, new_indices, deg_new, row, end, width, v,
+                native=native))
         row = end
     assert row == v, (row, v)
     return DegreeBuckets(

@@ -81,16 +81,15 @@ from dgc_tpu.engine.base import AttemptResult, AttemptStatus
 from dgc_tpu.engine.fused import finish_sweep_pair
 from dgc_tpu.engine.bucketed import (
     BucketedELLEngine,
+    build_combined_rows,
     decode_combined,
-    encode_combined,
     initial_packed,
     status_step,
 )
-from dgc_tpu.models.arrays import GraphArrays, csr_to_ell
+from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import forbidden_planes, num_planes_for
 from dgc_tpu.ops.speculative import (
     apply_update_mc,
-    beats_rule,
     neighbor_stats,
     speculative_update_mc,
 )
@@ -979,18 +978,14 @@ class CompactFrontierEngine(BucketedELLEngine):
             self.flat_planes = 0
             self.stage_ranges = ()
             return
-        # flat combined table over the flat region (relabeled CSR suffix)
+        # flat combined table over the flat region (relabeled CSR suffix);
+        # shares the buckets' table-build primitive (native one-pass C++
+        # above the same size threshold as the relabeler)
         w_flat = max(widths[hub:]) if hub < len(widths) else 1
         f0 = self.flat_row0
-        sub_indptr = self.rel_indptr[f0:] - self.rel_indptr[f0]
-        sub_indices = self.rel_indices[self.rel_indptr[f0]:]
-        nbrs, _ = csr_to_ell(sub_indptr, sub_indices, width=w_flat, sentinel=v)
-        deg_pad = np.concatenate([deg_rel, np.array([-1], np.int32)])
-        n_deg = deg_pad[nbrs]
-        my_deg = deg_rel[f0:, None]
-        my_ids = np.arange(f0, v, dtype=np.int32)[:, None]
-        beats = beats_rule(n_deg, nbrs, my_deg, my_ids)
-        combined = encode_combined(nbrs, beats)
+        combined = build_combined_rows(
+            self.rel_indptr, self.rel_indices, deg_rel, f0, v, w_flat, v,
+            native=len(self.rel_indices) >= 1_000_000)
         self.flat_ext = jnp.asarray(
             np.concatenate([combined, np.full((1, w_flat), v, np.int32)])
         )

@@ -71,7 +71,7 @@ def _load():
         # once and load via a distinct pid-unique path — re-dlopening the
         # canonical path would return the already-mapped stale object.
         # Keep the silent-fallback contract if recovery fails too.
-        if not hasattr(lib, "dgc_relabel_csr"):
+        if not hasattr(lib, "dgc_build_combined"):  # newest symbol
             fresh = f"{_LIB}.{os.getpid()}.reload"
             if not _build(load_path=fresh):
                 _load_failed = True
@@ -86,7 +86,7 @@ def _load():
                     os.unlink(fresh)  # mapping persists; dirent can go
                 except OSError:
                     pass
-            if not hasattr(lib, "dgc_relabel_csr"):
+            if not hasattr(lib, "dgc_build_combined"):  # newest symbol
                 _load_failed = True
                 return None
         lib.dgc_generate_fast.restype = ctypes.c_void_p
@@ -121,6 +121,15 @@ def _load():
         ]
         lib.dgc_free.restype = None
         lib.dgc_free.argtypes = [ctypes.c_void_p]
+        lib.dgc_build_combined.restype = ctypes.c_int32
+        lib.dgc_build_combined.argtypes = [
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(dtype=np.int64, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+        ]
         _lib = lib
         return _lib
 
@@ -205,3 +214,26 @@ def generate_rmat_native(node_count: int, avg_degree: float, seed: int | None = 
     h = lib.dgc_generate_rmat(node_count, avg_degree, _resolve_seed(seed), a, b, c,
                               -1 if max_degree is None else max_degree)
     return _extract(lib, h)
+
+
+def build_combined_native(indptr: np.ndarray, indices: np.ndarray,
+                          degrees: np.ndarray, row0: int, nrows: int,
+                          width: int, sentinel: int):
+    """One-pass combined (neighbor | beats<<30) ELL table for relabeled CSR
+    rows [row0, row0+nrows) — bit-identical to the NumPy
+    ``csr_to_ell`` + ``beats_rule`` + ``encode_combined`` chain, without its
+    full-table temporaries (the host-build hot spot at 1M+, PERF.md).
+    Returns int32[nrows, width] or None when the native library is
+    unavailable or fails."""
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.empty((nrows, width), dtype=np.int32)
+    rc = lib.dgc_build_combined(
+        int(indptr.shape[0]) - 1,
+        np.ascontiguousarray(indptr, dtype=np.int64),
+        np.ascontiguousarray(indices, dtype=np.int32),
+        np.ascontiguousarray(degrees, dtype=np.int32),
+        int(row0), int(nrows), int(width), int(sentinel), out,
+    )
+    return out if rc == 0 else None

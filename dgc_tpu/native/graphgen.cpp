@@ -281,6 +281,43 @@ void* dgc_relabel_csr(int64_t v, const int32_t* indptr, const int32_t* indices,
   DGC_GUARD_END
 }
 
+
+// Fill one bucket's combined (neighbor id | priority bit) ELL table in a
+// single pass over the relabeled CSR: out[r*width + j] = nbr | (beats << 30)
+// for the j-th neighbor of relabeled row row0+r, sentinel for pad slots.
+// beats = (deg[nbr], -nbr) > (deg[row], -row) — the (degree desc, id asc)
+// total order every engine derives its priorities from. Writes directly
+// into the caller's buffer (no handle) so the multi-GB tables of a 4M-
+// vertex power-law graph are built without NumPy's chain of full-size
+// temporaries (bool mask -> int32 cast -> shift -> or). Returns 0 on
+// success, 1 on failure (caller falls back to the NumPy path).
+int32_t dgc_build_combined(int64_t v, const int64_t* indptr,
+                           const int32_t* indices, const int32_t* degrees,
+                           int64_t row0, int64_t nrows, int64_t width,
+                           int32_t sentinel, int32_t* out) {
+  (void)v;
+  try {
+    for (int64_t r = 0; r < nrows; ++r) {
+      const int64_t g = row0 + r;
+      const int64_t b = indptr[g];
+      const int64_t d = indptr[g + 1] - b;
+      if (d > width) return 1;  // NumPy path raises here; never overrun
+      const int32_t my_deg = degrees[g];
+      int32_t* row = out + r * width;
+      for (int64_t j = 0; j < d; ++j) {
+        const int32_t nb = indices[b + j];
+        const int32_t nd = degrees[nb];
+        const bool beats = nd > my_deg || (nd == my_deg && (int64_t)nb < g);
+        row[j] = nb | ((int32_t)beats << 30);
+      }
+      for (int64_t j = d; j < width; ++j) row[j] = sentinel;
+    }
+    return 0;
+  } catch (...) {
+    return 1;
+  }
+}
+
 int64_t dgc_num_vertices(void* h) { return static_cast<DgcGraph*>(h)->num_vertices; }
 
 int64_t dgc_num_directed_edges(void* h) {
