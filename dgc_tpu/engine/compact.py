@@ -446,6 +446,9 @@ def _hub_dispatch(pe, ba_bi, pk_b, cb, p_b, k, v: int, ps_b=None,
         r = _bucket_update_rebase(pe, pk_b, cb, p_b, k, v, pad, u)
         return r[:4] + (r[4],)
 
+    if pad >= vb:  # pad covers the bucket: the full branch is unreachable
+        branch = jnp.where(ba_bi == 0, 0, jnp.where(ps_b[0] == 1, 1, 2))
+        return jax.lax.switch(branch, (skip, pruned, rebase), (pk_b, ps_b))
     branch = jnp.where(
         ba_bi == 0, 0,
         jnp.where(ps_b[0] == 1, 1, jnp.where(ba_bi <= pad, 2, 3)))
