@@ -61,7 +61,13 @@ from dgc_tpu.engine.bucketed import (
     initial_packed,
     status_step,
 )
-from dgc_tpu.engine.compact import _bucket_fail_valid, _compact_idx, _pow2_ceil
+from dgc_tpu.engine.compact import (
+    _bucket_fail_valid,
+    _compact_idx,
+    _hub_dispatch,
+    _pow2_ceil,
+    hub_prune_cfg,
+)
 from dgc_tpu.ops.speculative import speculative_update
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.parallel.mesh import (
@@ -140,6 +146,37 @@ def build_sharded_buckets(arrays: GraphArrays, n: int,
     )
 
 
+def shard_prune_cfg(slice_rows: int, width: int,
+                    uncond_entries: int = 1 << 17,
+                    u_min: int = 128, u_div: int = 4) -> tuple | None:
+    """Neighbor-pruning config ``(P, U)`` for one shard's bucket slice —
+    exactly the single-device hub rule (``engine.compact.hub_prune_cfg``)
+    applied to the slice, including its pad-to-rows clamp: a slice whose
+    pad covers its rows still prunes (the rebase costs what the full
+    branch would until the capture validates, then [P, U] thereafter).
+    Monotone confirmation is a global property, so the exactness argument
+    holds per shard unchanged."""
+    return hub_prune_cfg(slice_rows, width, u_min=u_min, u_div=u_div,
+                         uncond_entries=uncond_entries)
+
+
+def _fresh_shard_prune(tables_l, planes: tuple, prune_cfg: tuple, v_final: int):
+    """Per-bucket-slice pruned captures, initially invalid (fresh per
+    k-attempt — ``device_sweep_pair`` calls the attempt body per phase, so
+    captures never leak between the fused pair's attempts)."""
+    out = []
+    for tb, p_b, cfg in zip(tables_l, planes, prune_cfg):
+        if cfg is None:
+            out.append(None)
+            continue
+        p, u = cfg
+        out.append((jnp.int32(0),
+                    jnp.full((p,), tb.shape[0], jnp.int32),
+                    jnp.full((p, u), v_final, jnp.int32),
+                    jnp.zeros((p, p_b), jnp.uint32)))
+    return tuple(out)
+
+
 def shard_pad_for(slice_rows: int, width: int,
                   uncond_entries: int = 1 << 17) -> int:
     """Row-compaction pad for one shard's slice of a bucket (0 = run the
@@ -155,7 +192,7 @@ def shard_pad_for(slice_rows: int, width: int,
 
 
 def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
-                     pads: tuple):
+                     pads: tuple, prune=(), prune_cfg: tuple = ()):
     """One superstep over the shard's bucket slices with per-bucket live
     gating: an inert slice is skipped, a slice whose live count fits its
     pad runs row-compacted, everything else runs the full slice — each
@@ -166,12 +203,16 @@ def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
     ``bucketed_superstep`` by construction (shared ``speculative_update``
     core, shared ``_compact_idx`` slot idiom)."""
     packed_pad = jnp.concatenate([packed_g, jnp.array([-1], jnp.int32)])
+    v_final = packed_g.shape[0]
     new_parts, fail_parts, act_parts = [], [], []
+    prune_new = []
     row0 = 0
-    for tb, p_b, pad in zip(tables_l, planes, pads):
+    for bi, (tb, p_b, pad) in enumerate(zip(tables_l, planes, pads)):
         rows, w = tb.shape
         pk_b = jax.lax.dynamic_slice_in_dim(packed_l, row0, rows)
         fv = _bucket_fail_valid(w, p_b, k).astype(jnp.int32)
+        cfg = prune_cfg[bi] if bi < len(prune_cfg) else None
+        ps_b = prune[bi] if bi < len(prune) else None
 
         def full(pk_b, tb=tb, p_b=p_b, fv=fv):
             nb, beats = decode_combined(tb)
@@ -181,7 +222,18 @@ def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
                     jnp.sum(act_m.astype(jnp.int32)))
 
         if pad == 0:
-            r = full(pk_b)
+            r = full(pk_b) + (ps_b,)
+        elif cfg is not None:
+            # the single-device hub dispatcher, verbatim: ``packed_pad``
+            # stands in for the [V+2] extended state (it gathers
+            # ``pe[:v+1][nb]`` with v = v_final — exactly the all-gathered
+            # global state + the −1 sentinel slot); mc is dropped (no
+            # prefix-resume on this path)
+            act_b = (pk_b < 0) | ((pk_b & 1) == 1)
+            na = jnp.sum(act_b.astype(jnp.int32))
+            nb_, f, a, _, ps2 = _hub_dispatch(
+                packed_pad, na, pk_b, tb, p_b, k, v_final, ps_b, cfg)
+            r = (nb_, f, a, ps2)
         else:
             act_b = (pk_b < 0) | ((pk_b & 1) == 1)
             na = jnp.sum(act_b.astype(jnp.int32))
@@ -205,33 +257,39 @@ def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
             def live(pk_b, pad=pad, compact=compact, full=full, na=na):
                 return jax.lax.cond(na <= pad, compact, full, pk_b)
 
-            r = jax.lax.cond(na > 0, live, skip, pk_b)
+            r = jax.lax.cond(na > 0, live, skip, pk_b) + (ps_b,)
         new_parts.append(r[0])
         fail_parts.append(r[1])
         act_parts.append(r[2])
+        prune_new.append(r[3])
         row0 += rows
-    return jnp.concatenate(new_parts), sum(fail_parts), sum(act_parts)
+    return (jnp.concatenate(new_parts), sum(fail_parts), sum(act_parts),
+            tuple(prune_new))
 
 
 def _shard_attempt(tables_l, deg_l, k, planes: tuple, max_steps: int,
-                   v_final: int, pads: tuple = (), stall_window: int = 64):
+                   v_final: int, pads: tuple = (), prune_cfg: tuple = (),
+                   stall_window: int = 64):
     """One k-attempt on a shard: while_loop of all-gather + gated bucketed
     superstep + psum reductions. Returns (colors_l, steps, status)."""
     k = jnp.asarray(k, jnp.int32)
     if not pads:
         pads = tuple(0 for _ in tables_l)
+    if not prune_cfg:
+        prune_cfg = tuple(None for _ in tables_l)
+    prune0 = _fresh_shard_prune(tables_l, planes, prune_cfg, v_final)
     carry = (initial_packed(deg_l), jnp.int32(1), jnp.int32(_RUNNING),
-             jnp.int32(v_final + 1), jnp.int32(0))
+             jnp.int32(v_final + 1), jnp.int32(0), prune0)
 
     def cond(c):
-        _, _, status, _, _ = c
+        status = c[2]
         return status == _RUNNING
 
     def body(c):
-        packed_l, step, status, prev_active, stall = c
+        packed_l, step, status, prev_active, stall, prune = c
         packed_g = jax.lax.all_gather(packed_l, VERTEX_AXIS, tiled=True)
-        new_packed_l, fail_l, active_l = _gated_superstep(
-            packed_l, packed_g, tables_l, k, planes, pads
+        new_packed_l, fail_l, active_l, prune_new = _gated_superstep(
+            packed_l, packed_g, tables_l, k, planes, pads, prune, prune_cfg
         )
         fail_count = jax.lax.psum(fail_l, VERTEX_AXIS)
         active = jax.lax.psum(active_l, VERTEX_AXIS)
@@ -242,25 +300,29 @@ def _shard_attempt(tables_l, deg_l, k, planes: tuple, max_steps: int,
             (status == _RUNNING) & (step + 1 >= max_steps), _STALLED, status
         ).astype(jnp.int32)
         new_packed_l = jnp.where(any_fail, packed_l, new_packed_l)
-        return (new_packed_l, step + 1, status, active, stall)
+        prune_new = jax.tree.map(
+            lambda a, b: jnp.where(any_fail, a, b), prune, prune_new)
+        return (new_packed_l, step + 1, status, active, stall, prune_new)
 
-    packed_l, steps, status, _, _ = jax.lax.while_loop(cond, body, carry)
+    out = jax.lax.while_loop(cond, body, carry)
+    packed_l, steps, status = out[0], out[1], out[2]
     colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
     return colors_l, steps, status
 
 
 def _shard_attempt_body(tables_l, deg_l, k, *, planes: tuple, max_steps: int,
-                        v_final: int, pads: tuple = ()):
+                        v_final: int, pads: tuple = (),
+                        prune_cfg: tuple = ()):
     return _shard_attempt(tables_l, deg_l, k, planes, max_steps, v_final,
-                          pads=pads)
+                          pads=pads, prune_cfg=prune_cfg)
 
 
 def _shard_sweep_body(tables_l, deg_l, k0, *, planes: tuple, max_steps: int,
-                      v_final: int, pads: tuple = ()):
+                      v_final: int, pads: tuple = (), prune_cfg: tuple = ()):
     """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call."""
     return device_sweep_pair(
         lambda k: _shard_attempt(tables_l, deg_l, k, planes, max_steps,
-                                 v_final, pads=pads),
+                                 v_final, pads=pads, prune_cfg=prune_cfg),
         k0, VERTEX_AXIS,
     )
 
@@ -277,7 +339,8 @@ class ShardedBucketedEngine:
     def __init__(self, arrays: GraphArrays, num_shards: int | None = None,
                  mesh=None, max_steps: int | None = None, min_width: int = 4,
                  max_window_planes: int = MAX_WINDOW_PLANES,
-                 uncond_entries: int = 1 << 17):
+                 uncond_entries: int = 1 << 17,
+                 prune_u_min: int = 128, prune_u_div: int = 4):
         self.arrays = arrays
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
         n = self.mesh.shape[VERTEX_AXIS]
@@ -291,6 +354,12 @@ class ShardedBucketedEngine:
         # per-shard-slice frontier gating pads (0 = unconditioned slice)
         self.pads = tuple(
             shard_pad_for(s, t.shape[1], uncond_entries=uncond_entries)
+            for s, t in zip(lay.slice_sizes, lay.tables)
+        )
+        # per-slice neighbor-pruning captures (the hub rule per shard)
+        self.prune_cfg = tuple(
+            shard_prune_cfg(s, t.shape[1], uncond_entries=uncond_entries,
+                            u_min=prune_u_min, u_div=prune_u_div)
             for s, t in zip(lay.slice_sizes, lay.tables)
         )
         rows2d = NamedSharding(self.mesh, P(VERTEX_AXIS, None))
@@ -319,7 +388,8 @@ class ShardedBucketedEngine:
             in_specs=(tuple(P(VERTEX_AXIS, None) for _ in self.tables),
                       P(VERTEX_AXIS), P()),
             static_kwargs=dict(planes=self.planes, max_steps=self.max_steps,
-                               v_final=self.layout.v_final, pads=self.pads),
+                               v_final=self.layout.v_final, pads=self.pads,
+                               prune_cfg=self.prune_cfg),
         )
 
     def _finish(self, colors_final: np.ndarray, status, steps: int,

@@ -157,3 +157,28 @@ def test_shard_pad_for_thresholds():
     assert shard_pad_for(4096, 256) == 2048      # rows/2, pow2
     assert shard_pad_for(40, 8192) == 32         # floor pad, still < rows
     assert shard_pad_for(32, 8192) == 0          # pad would not be < rows
+
+
+@pytest.mark.parametrize("num_shards", [2, 8])
+def test_shard_neighbor_pruning_bit_identical(num_shards):
+    # force the pruned-capture ladder (tiny U) on every gated slice: the
+    # multi-chip engine with the full hub machinery must stay bit-identical
+    # to the single-device bucketed engine, attempts and fused sweep both
+    for g in (generate_rmat_graph(2048, avg_degree=8, seed=1, native=False),
+              generate_random_graph(1500, 10, seed=3)):
+        eng = ShardedBucketedEngine(g, num_shards=num_shards,
+                                    uncond_entries=0, prune_u_min=2)
+        assert any(c is not None for c in eng.prune_cfg)
+        ref = BucketedELLEngine(g)
+        k0 = g.max_degree + 1
+        r1, r2 = ref.attempt(k0), eng.attempt(k0)
+        assert r1.status == r2.status
+        assert np.array_equal(r1.colors, r2.colors)
+        first, second = ShardedBucketedEngine(
+            g, num_shards=num_shards, uncond_entries=0,
+            prune_u_min=2).sweep(k0)
+        assert np.array_equal(first.colors, r1.colors)
+        if second is not None and r1.colors_used > 1:
+            a2 = ref.attempt(r1.colors_used - 1)
+            assert second.status == a2.status
+            assert np.array_equal(second.colors, a2.colors)
