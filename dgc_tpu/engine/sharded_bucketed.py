@@ -64,6 +64,7 @@ from dgc_tpu.engine.bucketed import (
 from dgc_tpu.engine.compact import (
     _bucket_fail_valid,
     _compact_idx,
+    _fresh_prune,
     _hub_dispatch,
     _pow2_ceil,
     hub_prune_cfg,
@@ -163,18 +164,11 @@ def shard_prune_cfg(slice_rows: int, width: int,
 def _fresh_shard_prune(tables_l, planes: tuple, prune_cfg: tuple, v_final: int):
     """Per-bucket-slice pruned captures, initially invalid (fresh per
     k-attempt — ``device_sweep_pair`` calls the attempt body per phase, so
-    captures never leak between the fused pair's attempts)."""
-    out = []
-    for tb, p_b, cfg in zip(tables_l, planes, prune_cfg):
-        if cfg is None:
-            out.append(None)
-            continue
-        p, u = cfg
-        out.append((jnp.int32(0),
-                    jnp.full((p,), tb.shape[0], jnp.int32),
-                    jnp.full((p, u), v_final, jnp.int32),
-                    jnp.zeros((p, p_b), jnp.uint32)))
-    return tuple(out)
+    captures never leak between the fused pair's attempts). Delegates to
+    the single-device ``_fresh_prune`` so the exactness-critical initial
+    shapes (invalid flag, sentinel slots/lists, zero planes) stay
+    single-sourced."""
+    return _fresh_prune(tables_l, len(tables_l), planes, prune_cfg, v_final)
 
 
 def shard_pad_for(slice_rows: int, width: int,
@@ -221,9 +215,7 @@ def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
             return (new_b, jnp.sum(fail_m.astype(jnp.int32)) * fv,
                     jnp.sum(act_m.astype(jnp.int32)))
 
-        if pad == 0:
-            r = full(pk_b) + (ps_b,)
-        elif cfg is not None:
+        if cfg is not None:
             # the single-device hub dispatcher, verbatim: ``packed_pad``
             # stands in for the [V+2] extended state (it gathers
             # ``pe[:v+1][nb]`` with v = v_final — exactly the all-gathered
@@ -234,6 +226,8 @@ def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
             nb_, f, a, _, ps2 = _hub_dispatch(
                 packed_pad, na, pk_b, tb, p_b, k, v_final, ps_b, cfg)
             r = (nb_, f, a, ps2)
+        elif pad == 0:
+            r = full(pk_b) + (ps_b,)
         else:
             act_b = (pk_b < 0) | ((pk_b & 1) == 1)
             na = jnp.sum(act_b.astype(jnp.int32))

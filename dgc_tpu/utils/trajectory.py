@@ -146,3 +146,59 @@ def record_trajectory(arrays: GraphArrays, k: int | None = None,
 
     traj.colors = np.where(packed >= 0, packed >> 1, -1).astype(np.int32)
     return traj
+
+
+def _main(argv=None) -> int:
+    """``python -m dgc_tpu.utils.trajectory`` — replay a graph's exact-rule
+    frontier and print the per-superstep schedule-design quantities (the
+    CLI face of the instrument; same graph sources as ``dgc_tpu.cli``)."""
+    import argparse
+    import json
+    import sys
+
+    p = argparse.ArgumentParser(prog="dgc-tpu-trajectory")
+    p.add_argument("--input", help="graph JSON (reference schema)")
+    p.add_argument("--node-count", type=int)
+    p.add_argument("--max-degree", type=int)
+    p.add_argument("--gen-method", choices=["reference", "fast", "rmat"],
+                   default="reference")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--every", type=int, default=1,
+                   help="print every Nth superstep (summary always prints)")
+    args = p.parse_args(argv)
+    if args.every < 1:
+        p.error("--every must be >= 1")
+
+    if args.input:
+        from dgc_tpu.models.graph import Graph
+
+        arrays = Graph.deserialize(args.input).arrays
+    elif args.node_count:
+        # same flag semantics as dgc_tpu.cli: Graph.generate owns the
+        # max-degree → avg-degree mapping per method, so a trajectory
+        # measured here corresponds to the graph the CLI would color
+        from dgc_tpu.models.graph import Graph
+
+        arrays = Graph.generate(args.node_count, args.max_degree or 8,
+                                seed=args.seed,
+                                method=args.gen_method).arrays
+    else:
+        p.error("one of --input / --node-count is required")
+
+    traj = record_trajectory(arrays)
+    for s in traj.steps:
+        if (s.step - 1) % args.every == 0:
+            print(f"s{s.step:>4} active={s.active:>9} "
+                  f"sumdeg(active)={s.sum_deg_active:>11}")
+    print(json.dumps({
+        "supersteps": traj.supersteps,
+        "colors_used": int(traj.colors.max()) + 1,
+        "gather_floor": traj.gather_floor(),
+        "bucket_widths": traj.bucket_widths,
+        "bucket_sizes": traj.bucket_sizes,
+    }), file=sys.stdout)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

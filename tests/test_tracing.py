@@ -59,3 +59,22 @@ def test_trajectory_matches_engine():
     # frontier is monotone non-increasing per bucket after step 1
     pb = np.array([s.active_per_bucket for s in traj.steps])
     assert (np.diff(pb, axis=0) <= 0).all()
+
+
+def test_trajectory_cli_smoke(tmp_path, capsys):
+    # the module CLI prints per-step lines + one JSON summary, and accepts
+    # reference-schema graph files
+    import json
+
+    from dgc_tpu.models.graph import Graph
+    from dgc_tpu.models.generators import generate_random_graph
+    from dgc_tpu.utils.trajectory import _main
+
+    g = generate_random_graph(60, 6, seed=3)
+    path = tmp_path / "g.json"
+    Graph(g).serialize(str(path))
+    assert _main(["--input", str(path), "--every", "4"]) == 0
+    out = capsys.readouterr().out.strip().splitlines()
+    summary = json.loads(out[-1])
+    assert summary["supersteps"] >= 1 and summary["colors_used"] >= 1
+    assert summary["gather_floor"] > 0

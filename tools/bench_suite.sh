@@ -1,0 +1,33 @@
+#!/usr/bin/env bash
+# Full benchmark battery on the real TPU chip — the numbers PERF.md's
+# tables are maintained from. One command so a round (or a reviewer)
+# can reproduce every published figure:
+#
+#   bash tools/bench_suite.sh [outfile]
+#
+# Each bench.py invocation prints one JSON line (appended to the
+# outfile, default PERF_RUNS.jsonl) plus its stderr log. Heavy-tail
+# configs compile for minutes on first run; the persistent XLA cache
+# (.jax_cache) makes re-runs cheap. Order: cheapest first, so a flaky
+# tunnel still yields the headline numbers.
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-PERF_RUNS.jsonl}"
+
+run() {
+  echo "=== $* ===" >&2
+  python bench.py "$@" 2>&1 | tee /dev/stderr | grep '^{' >> "$OUT" || true
+}
+
+# headline (1M uniform) — warm, then cold-start (compile included)
+run
+run --include-compile
+
+# heavy-tail family (BASELINE config 5 shapes)
+run --gen rmat --nodes 200000
+run --gen rmat --nodes 500000
+run --gen rmat --nodes 1000000
+run --gen rmat --nodes 4000000 --avg-degree 32 --max-degree 256
+run --gen rmat --nodes 4000000 --avg-degree 32
+
+echo "done; JSON lines in $OUT" >&2
