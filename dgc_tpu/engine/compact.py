@@ -114,11 +114,12 @@ def default_stages(v: int, heavy_tail: bool = False) -> tuple:
     another compiled stage body. Bounded-degree graphs get the measured
     3-rung ladder (v/4 → v/16 → v/256; the 1M-uniform sweep collapses in
     ~13 supersteps, deeper rungs bought ≈ nothing). Heavy-tailed graphs
-    (``heavy_tail``) add the v/64 rung: their high-color sweeps (~2·C
-    supersteps for C colors — the dense core serializes one color class
-    per round) spend many supersteps mid-ladder; the 200k-RMAT trace
-    showed the v/16→v/256 gap alone holding 19 of 68 supersteps at 4×
-    weight."""
+    (``heavy_tail``) add the v/64 and v/1024 rungs: their high-color
+    sweeps (~2·C supersteps for C colors — the dense core serializes one
+    color class per round) dwell long both mid-ladder (the 200k-RMAT
+    trace showed the v/16→v/256 gap alone holding 19 of 68 supersteps at
+    4× weight) and at the leaf (the 1M-RMAT replay holds active ≤ v/1024
+    for 48 of 108 supersteps)."""
     if v <= 1 << 14:
         return ((None, 0),)
     if not heavy_tail:
@@ -133,7 +134,8 @@ def default_stages(v: int, heavy_tail: bool = False) -> tuple:
         (v // 4, v // 16),
         (v // 16, v // 64),
         (v // 64, v // 256),
-        (v // 256, 0),
+        (v // 256, v // 1024),
+        (v // 1024, 0),
     )
 
 
@@ -250,8 +252,10 @@ HUB_UNCOND_ENTRIES = 1 << 17
 
 def hub_prune_cfg(rows: int, width: int, u_min: int = 128,
                   u_div: int = 4,
-                  uncond_entries: int | None = None) -> tuple | None:
-    """Static neighbor-pruning config ``(P, U)`` for a hub bucket, or None.
+                  uncond_entries: int | None = None,
+                  p2_min: int = 32) -> tuple | None:
+    """Static neighbor-pruning config ``(P, U)`` or ``(P, U, P2)`` for a
+    hub bucket, or None.
 
     Row compaction shrinks the *row* axis, but a live hub row still
     re-gathers its full (up to Δ-wide) neighborhood every superstep even
@@ -274,7 +278,16 @@ def hub_prune_cfg(rows: int, width: int, u_min: int = 128,
     they gate is already cheaper than the full branch (it row-compacts).
     ``U`` = W/4 (capped at 2048) for the same reason: the measured
     max-unconfirmed-per-row crosses W/4 mid-sweep but W/16 only at the
-    very end."""
+    very end.
+
+    ``P2`` (when < P) enables the tier-2 re-capture: once the live count
+    fits P2, the pruned slot list row-compacts once more into a P2-pad
+    (same U — a pure row shrink, no width machinery). The 1M-RMAT replay
+    shows capture-time pads overhang the decaying live counts 10×+ for
+    most of the tail (the W=1024 core bucket: P=4096 vs live ≤ 512 from
+    ~s58 of 108), so the steady-state pruned gather P×U is mostly dummy
+    slots; P/8 re-engages the pad at the scale the tail actually runs at.
+    """
     if rows * width <= (HUB_UNCOND_ENTRIES if uncond_entries is None
                         else uncond_entries):
         return None
@@ -284,35 +297,46 @@ def hub_prune_cfg(rows: int, width: int, u_min: int = 128,
     # clamp to the bucket's rows: a pad above them would make the rebase
     # branch gather MORE than the full branch (dummy slots re-gather
     # row 0), so pad ≤ rows always (pads need not be powers of two)
-    return (min(_pow2_ceil(max(rows // 2, 32)), rows), u)
+    p = min(_pow2_ceil(max(rows // 2, 32)), rows)
+    p2 = min(_pow2_ceil(max(p // 8, p2_min)), rows)
+    return (p, u, p2) if p2 < p else (p, u)
 
 
 def _fresh_prune(buckets, hub_buckets: int, planes: tuple, hub_prune: tuple,
                  v: int) -> tuple:
-    """Per-hub-bucket pruned-mode state ``(valid, slots, comb, conf)`` (or
-    None where disabled), initially invalid. Built fresh per attempt — and
-    per fused-sweep phase: the confirm attempt runs at a smaller k where
-    confirmed colors differ, so attempt-1 captures must never leak across
-    (the prefix-resume ring deliberately does not record pruned state)."""
+    """Per-hub-bucket pruned-mode state (or None where disabled), initially
+    invalid: ``(tier, slots, comb, conf)`` — plus ``(slots2, comb2, conf2)``
+    when the cfg carries a tier-2 pad. ``tier`` is 0 (none), 1, or 2. Built
+    fresh per attempt — and per fused-sweep phase: the confirm attempt runs
+    at a smaller k where confirmed colors differ, so attempt-1 captures
+    must never leak across (the prefix-resume ring deliberately does not
+    record pruned state)."""
     out = []
     for bi in range(hub_buckets):
         cfg = hub_prune[bi] if bi < len(hub_prune) else None
         if cfg is None:
             out.append(None)
             continue
-        p, u = cfg
+        p, u = cfg[0], cfg[1]
         vb = buckets[bi].shape[0]
-        out.append((jnp.int32(0),
-                    jnp.full((p,), vb, jnp.int32),
-                    jnp.full((p, u), v, jnp.int32),
-                    jnp.zeros((p, planes[bi]), jnp.uint32)))
+        ps = (jnp.int32(0),
+              jnp.full((p,), vb, jnp.int32),
+              jnp.full((p, u), v, jnp.int32),
+              jnp.zeros((p, planes[bi]), jnp.uint32))
+        if len(cfg) == 3:
+            p2 = cfg[2]
+            ps = ps + (jnp.full((p2,), vb, jnp.int32),
+                       jnp.full((p2, u), v, jnp.int32),
+                       jnp.zeros((p2, planes[bi]), jnp.uint32))
+        out.append(ps)
     return tuple(out)
 
 
-def _bucket_update_pruned(pe, pk_b, ps_b, p_b, k, width: int, v: int):
-    """Superstep on the rebased slots via the pruned tables: static
-    confirmed-forbidden planes OR'd with a gather of only the ≤U
-    unconfirmed-at-rebase neighbors.
+def _bucket_update_pruned(pe, pk_b, tier, p_b, k, width: int, v: int):
+    """Superstep on the captured slots via the pruned tables
+    ``tier = (slots, comb, conf)`` (tier 1's rebase capture, or tier 2's
+    row-shrunk copy): static confirmed-forbidden planes OR'd with a gather
+    of only the ≤U unconfirmed-at-rebase neighbors.
 
     Exact by monotone confirmation (module docstring): every neighbor is
     either in the pruned list (gathered live — including ones that have
@@ -321,7 +345,7 @@ def _bucket_update_pruned(pe, pk_b, ps_b, p_b, k, width: int, v: int):
     neighbors are always unconfirmed, so clash detection sees all of them.
     Slots captured at rebase are a superset of currently-active rows
     (stale confirmed rows transition to themselves)."""
-    _, slots, comb, conf = ps_b
+    slots, comb, conf = tier
     vb = pk_b.shape[0]
     real = slots < vb
     idx_safe = jnp.where(real, slots, 0)
@@ -334,6 +358,34 @@ def _bucket_update_pruned(pe, pk_b, ps_b, p_b, k, width: int, v: int):
     new_b = pk_b.at[slots].set(new_slot, mode="drop")
     return _reduce_bucket_result(new_b, fail_mask, act_mask, mc, width,
                                  p_b, k)
+
+
+def _bucket_update_shrink(pe, pk_b, tier1, p_b, k, width: int, v: int,
+                          p2: int):
+    """Tier-2 re-capture + superstep: row-compact tier 1's slot list to a
+    ``p2``-pad (same U width — comb/conf rows are carried verbatim) and run
+    the pruned superstep on the shrunk tables.
+
+    Exact when the bucket's live count ≤ p2 (the dispatcher's gate): tier
+    1's slots are a superset of active rows (monotone confirmation), so the
+    active slots — all captured here by ``_compact_idx`` — still cover every
+    row that can change state; stale/dummy slots carry confirmed no-op
+    state. Returns the update tuple plus the tier-2 capture."""
+    slots1, comb1, conf1 = tier1
+    p1 = slots1.shape[0]
+    vb = pk_b.shape[0]
+    real1 = slots1 < vb
+    idx_safe = jnp.where(real1, slots1, 0)
+    pk_slot = jnp.where(real1, pk_b[idx_safe], 0)   # dummies: confirmed 0
+    act_slot = (pk_slot < 0) | ((pk_slot & 1) == 1)
+    sel = _compact_idx(act_slot, p2, p1)            # positions into tier 1
+    real2 = sel < p1
+    sel_safe = jnp.where(real2, sel, 0)
+    slots2 = jnp.where(real2, slots1[sel_safe], vb)
+    comb2 = jnp.where(real2[:, None], comb1[sel_safe], v)
+    conf2 = jnp.where(real2[:, None], conf1[sel_safe], 0)
+    tier2 = (slots2, comb2, conf2)
+    return _bucket_update_pruned(pe, pk_b, tier2, p_b, k, width, v) + (tier2,)
 
 
 def _bucket_update_rebase(pe, pk_b, cb, p_b, k, v: int, pad: int, u: int):
@@ -402,12 +454,12 @@ def _compact_core(pe, pk_b, cb, p_b, k, v: int, pad: int):
 def _hub_dispatch(pe, ba_bi, pk_b, cb, p_b, k, v: int, ps_b=None,
                   cfg: tuple | None = None, uncond: bool = False):
     """Cond ladder for one hub bucket: inert → skip; pruned-valid → gather
-    only the captured ≤U unconfirmed neighbors; small live count →
-    compacted rows (with pruned-state capture when ``cfg`` enables it);
-    else full bucket. ``uncond`` buckets (table ≤ ``HUB_UNCOND_ENTRIES``)
-    run the full update with no control flow at all — a device-side cond
-    costs more than the gather it would skip. Returns
-    (new_pk_b, fail, act, mc, ps_b')."""
+    only the captured ≤U unconfirmed neighbors (tier 2's row-shrunk pad
+    once the live count fits it); small live count → compacted rows (with
+    pruned-state capture when ``cfg`` enables it); else full bucket.
+    ``uncond`` buckets (table ≤ ``HUB_UNCOND_ENTRIES``) run the full update
+    with no control flow at all — a device-side cond costs more than the
+    gather it would skip. Returns (new_pk_b, fail, act, mc, ps_b')."""
     vb, w = cb.shape
 
     if uncond:
@@ -435,24 +487,46 @@ def _hub_dispatch(pe, ba_bi, pk_b, cb, p_b, k, v: int, ps_b=None,
 
         return jax.lax.cond(ba_bi > 0, live, skip, (pk_b, ps_b))
 
-    pad, u = cfg
+    pad, u = cfg[0], cfg[1]
+    p2 = cfg[2] if len(cfg) == 3 else None
 
     def pruned(op):
         pk_b, ps = op
-        return _bucket_update_pruned(pe, pk_b, ps, p_b, k, w, v) + (ps,)
+        return _bucket_update_pruned(pe, pk_b, ps[1:4], p_b, k, w, v) + (ps,)
 
     def rebase(op):
         pk_b, ps = op
         r = _bucket_update_rebase(pe, pk_b, cb, p_b, k, v, pad, u)
-        return r[:4] + (r[4],)
+        return r[:4] + (r[4] + ps[4:],)
 
-    if pad >= vb:  # pad covers the bucket: the full branch is unreachable
-        branch = jnp.where(ba_bi == 0, 0, jnp.where(ps_b[0] == 1, 1, 2))
-        return jax.lax.switch(branch, (skip, pruned, rebase), (pk_b, ps_b))
+    if p2 is None:
+        if pad >= vb:  # pad covers the bucket: the full branch is unreachable
+            branch = jnp.where(ba_bi == 0, 0, jnp.where(ps_b[0] == 1, 1, 2))
+            return jax.lax.switch(branch, (skip, pruned, rebase), (pk_b, ps_b))
+        branch = jnp.where(
+            ba_bi == 0, 0,
+            jnp.where(ps_b[0] == 1, 1, jnp.where(ba_bi <= pad, 2, 3)))
+        return jax.lax.switch(branch, (skip, pruned, rebase, full), (pk_b, ps_b))
+
+    def pruned2(op):
+        pk_b, ps = op
+        return _bucket_update_pruned(pe, pk_b, ps[4:7], p_b, k, w, v) + (ps,)
+
+    def shrink(op):
+        pk_b, ps = op
+        r = _bucket_update_shrink(pe, pk_b, ps[1:4], p_b, k, w, v, p2)
+        return r[:4] + ((jnp.int32(2),) + ps[1:4] + r[4],)
+
     branch = jnp.where(
         ba_bi == 0, 0,
-        jnp.where(ps_b[0] == 1, 1, jnp.where(ba_bi <= pad, 2, 3)))
-    return jax.lax.switch(branch, (skip, pruned, rebase, full), (pk_b, ps_b))
+        jnp.where(ps_b[0] == 2, 1,
+                  jnp.where((ps_b[0] == 1) & (ba_bi <= p2), 2,
+                            jnp.where(ps_b[0] == 1, 3,
+                                      jnp.where(ba_bi <= pad, 4, 5)))))
+    branches = (skip, pruned2, shrink, pruned, rebase, full)
+    if pad >= vb:  # pad covers the bucket: the full branch is unreachable
+        branches = branches[:5]
+    return jax.lax.switch(branch, branches, (pk_b, ps_b))
 
 
 def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
@@ -911,6 +985,7 @@ class CompactFrontierEngine(BucketedELLEngine):
                  max_window_planes: int | None = None,
                  flat_cap: int | None = None,
                  prune_u_min: int = 128, prune_u_div: int = 4,
+                 prune_p2_min: int = 32,
                  hub_uncond_entries: int | None = None):
         kw = {} if max_window_planes is None else {"max_window_planes": max_window_planes}
         super().__init__(arrays, max_steps=max_steps, min_width=min_width, **kw)
@@ -955,7 +1030,8 @@ class CompactFrontierEngine(BucketedELLEngine):
         self.hub_prune = tuple(
             hub_prune_cfg(sizes[bi], widths[bi],
                           u_min=prune_u_min, u_div=prune_u_div,
-                          uncond_entries=uncond_entries)
+                          uncond_entries=uncond_entries,
+                          p2_min=prune_p2_min)
             for bi in range(hub)
         )
         # small hub buckets run with no control flow at all (a device-side

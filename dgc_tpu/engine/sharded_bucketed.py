@@ -149,16 +149,19 @@ def build_sharded_buckets(arrays: GraphArrays, n: int,
 
 def shard_prune_cfg(slice_rows: int, width: int,
                     uncond_entries: int = 1 << 17,
-                    u_min: int = 128, u_div: int = 4) -> tuple | None:
-    """Neighbor-pruning config ``(P, U)`` for one shard's bucket slice —
-    exactly the single-device hub rule (``engine.compact.hub_prune_cfg``)
-    applied to the slice, including its pad-to-rows clamp: a slice whose
-    pad covers its rows still prunes (the rebase costs what the full
-    branch would until the capture validates, then [P, U] thereafter).
-    Monotone confirmation is a global property, so the exactness argument
-    holds per shard unchanged."""
+                    u_min: int = 128, u_div: int = 4,
+                    p2_min: int = 32) -> tuple | None:
+    """Neighbor-pruning config ``(P, U)`` / ``(P, U, P2)`` for one shard's
+    bucket slice — exactly the single-device hub rule
+    (``engine.compact.hub_prune_cfg``) applied to the slice, including its
+    pad-to-rows clamp (a slice whose pad covers its rows still prunes: the
+    rebase costs what the full branch would until the capture validates,
+    then [P, U] thereafter) and the tier-2 re-capture pad ``P2`` (the slot
+    list row-shrinks once the slice's live count fits it). Monotone
+    confirmation is a global property, so the exactness argument holds per
+    shard unchanged."""
     return hub_prune_cfg(slice_rows, width, u_min=u_min, u_div=u_div,
-                         uncond_entries=uncond_entries)
+                         uncond_entries=uncond_entries, p2_min=p2_min)
 
 
 def _fresh_shard_prune(tables_l, planes: tuple, prune_cfg: tuple, v_final: int):
@@ -334,7 +337,8 @@ class ShardedBucketedEngine:
                  mesh=None, max_steps: int | None = None, min_width: int = 4,
                  max_window_planes: int = MAX_WINDOW_PLANES,
                  uncond_entries: int = 1 << 17,
-                 prune_u_min: int = 128, prune_u_div: int = 4):
+                 prune_u_min: int = 128, prune_u_div: int = 4,
+                 prune_p2_min: int = 32):
         self.arrays = arrays
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
         n = self.mesh.shape[VERTEX_AXIS]
@@ -353,7 +357,8 @@ class ShardedBucketedEngine:
         # per-slice neighbor-pruning captures (the hub rule per shard)
         self.prune_cfg = tuple(
             shard_prune_cfg(s, t.shape[1], uncond_entries=uncond_entries,
-                            u_min=prune_u_min, u_div=prune_u_div)
+                            u_min=prune_u_min, u_div=prune_u_div,
+                            p2_min=prune_p2_min)
             for s, t in zip(lay.slice_sizes, lay.tables)
         )
         rows2d = NamedSharding(self.mesh, P(VERTEX_AXIS, None))

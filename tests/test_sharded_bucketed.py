@@ -182,3 +182,26 @@ def test_shard_neighbor_pruning_bit_identical(num_shards):
             a2 = ref.attempt(r1.colors_used - 1)
             assert second.status == a2.status
             assert np.array_equal(second.colors, a2.colors)
+
+
+@pytest.mark.parametrize("num_shards", [2, 8])
+def test_shard_tier2_recapture_bit_identical(num_shards):
+    # tiny p2_min forces len-3 (tier-2) prune configs on test-size slices:
+    # the shrink + pruned2 branches of the shared dispatcher must keep the
+    # multi-chip engine bit-identical to the single-device bucketed engine
+    g = generate_rmat_graph(2048, avg_degree=8, seed=1, native=False)
+    eng = ShardedBucketedEngine(g, num_shards=num_shards, uncond_entries=0,
+                                prune_u_min=2, prune_p2_min=2)
+    assert any(c is not None and len(c) == 3 for c in eng.prune_cfg), \
+        eng.prune_cfg
+    ref = BucketedELLEngine(g)
+    k0 = g.max_degree + 1
+    r1, r2 = ref.attempt(k0), eng.attempt(k0)
+    assert r1.status == r2.status
+    assert np.array_equal(r1.colors, r2.colors)
+    first, second = eng.sweep(k0)
+    assert np.array_equal(first.colors, r1.colors)
+    if second is not None and r1.colors_used > 1:
+        a2 = ref.attempt(r1.colors_used - 1)
+        assert second.status == a2.status
+        assert np.array_equal(second.colors, a2.colors)

@@ -15,8 +15,12 @@ cd "$(dirname "$0")/.."
 OUT="${1:-PERF_RUNS.jsonl}"
 
 run() {
-  echo "=== $* ===" >&2
-  python bench.py "$@" 2>&1 | tee /dev/stderr | grep '^{' >> "$OUT" || true
+  # everything goes through tee -a: when stderr is a redirected regular
+  # file, a plain tee would reopen it with O_TRUNC and wipe the log, and
+  # a bare `echo >&2` would write at the shell's own (stale) fd offset,
+  # garbling content tee appended after it
+  echo "=== $* ===" | tee -a /dev/stderr >/dev/null
+  python bench.py "$@" 2>&1 | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 }
 
 # headline (1M uniform) — warm, then cold-start (compile included)
