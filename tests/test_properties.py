@@ -178,20 +178,53 @@ def test_fused_sweep_prefix_resume_exact(g):
     assert second.supersteps == r2.supersteps
 
 
+def _forced_hub_engine(g, **extra):
+    """Every bucket a hub bucket (flat_cap=1), pruning at tiny widths
+    (prune_u_min=2), nothing unconditioned — the forced-hub configuration
+    shared by the hub-machinery agreement fuzzes."""
+    t0 = max(g.num_vertices // 2, 1)
+    return CompactFrontierEngine(
+        g, flat_cap=1, prune_u_min=2, hub_uncond_entries=0,
+        stages=((None, t0), (_pow2_ceil(t0), 0)), **extra)
+
+
 @settings(max_examples=40, deadline=None)
 @given(graphs())
 def test_pruned_hub_machinery_agreement(g):
     # the round-3 hub machinery (row compaction, neighbor pruning, uncond
-    # small buckets) forced onto arbitrary graphs: every bucket becomes a
-    # hub bucket (flat_cap=1), pruning engages at tiny widths
-    # (prune_u_min=2), nothing is unconditioned (hub_uncond_entries=0) —
-    # colors must stay bit-identical to the plain bucketed engine
+    # small buckets) forced onto arbitrary graphs — colors must stay
+    # bit-identical to the plain bucketed engine
     k0 = g.max_degree + 1
     ref = BucketedELLEngine(g).attempt(k0)
-    eng = CompactFrontierEngine(
-        g, flat_cap=1, prune_u_min=2, hub_uncond_entries=0,
-        stages=((None, max(g.num_vertices // 2, 1)),
-                (_pow2_ceil(max(g.num_vertices // 2, 1)), 0)))
-    res = eng.attempt(k0)
+    res = _forced_hub_engine(g).attempt(k0)
     assert res.status == ref.status
     assert np.array_equal(res.colors, ref.colors)
+
+
+@settings(max_examples=40, deadline=None)
+@given(graphs())
+def test_tier2_recapture_agreement(g):
+    # the tier-2 re-capture (shrink + pruned2 branches) forced onto
+    # arbitrary graphs: prune_p2_min=1 makes every prunable bucket carry a
+    # tier-2 pad, so the shrink gate and the carried tier-2 buffers are
+    # exercised across random shapes — colors must stay bit-identical to
+    # the plain bucketed engine, fused sweep included
+    k0 = g.max_degree + 1
+    ref = BucketedELLEngine(g)
+    eng = _forced_hub_engine(g, prune_p2_min=1)
+    r1 = ref.attempt(k0)
+    res = eng.attempt(k0)
+    assert res.status == r1.status
+    assert np.array_equal(res.colors, r1.colors)
+    first, second = eng.sweep(k0)
+    assert np.array_equal(first.colors, r1.colors)
+    if first.status != AttemptStatus.SUCCESS:
+        assert second is None
+        return
+    k2 = r1.colors_used - 1
+    if k2 < 1:
+        assert second.status == AttemptStatus.FAILURE and second.k == k2
+        return
+    a2 = ref.attempt(k2)
+    assert second.status == a2.status
+    assert np.array_equal(second.colors, a2.colors)
