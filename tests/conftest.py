@@ -44,6 +44,21 @@ from dgc_tpu.models.generators import generate_random_graph
 from dgc_tpu.models.graph import Graph
 
 
+@pytest.fixture(scope="module", autouse=True)
+def _clear_jax_caches_per_module():
+    """Bound the process-wide XLA executable footprint.
+
+    A full-suite run compiles hundreds of per-shape programs across the
+    engine modules (on an 8-device virtual CPU client); the accumulated
+    client state has produced a flaky SIGSEGV in whichever heavy jit user
+    runs last. Modules rarely share compiled shapes, so clearing between
+    modules costs only a handful of re-warms while keeping the footprint
+    bounded. (``test_properties.py`` additionally clears per test — it is
+    the heaviest compiler.)"""
+    yield
+    jax.clear_caches()
+
+
 @pytest.fixture(scope="session")
 def small_graphs():
     """Ensemble of small reference-semantics random graphs (varied seeds)."""

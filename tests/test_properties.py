@@ -18,6 +18,7 @@ Invariants:
 """
 
 import numpy as np
+import pytest
 from hypothesis import given, settings, strategies as st
 
 from dgc_tpu.engine.base import AttemptStatus
@@ -32,6 +33,22 @@ from dgc_tpu.ops.validate import validate_coloring
 # keep graphs small: every example builds jit caches only for shapes already
 # compiled (V padded via ELL) — runtime stays seconds, not minutes
 MAX_V = 24
+
+
+@pytest.fixture(autouse=True)
+def _clear_jax_caches():
+    """Drop compiled executables between property tests.
+
+    The fuzzes compile hundreds of tiny per-shape executables; late in a
+    full-suite process (on top of the 8-device mesh tests' programs) the
+    accumulated XLA CPU client state has produced a flaky SIGSEGV in the
+    last property test to run. Each test re-warms its own shapes quickly
+    (MAX_V = 24), so clearing per test costs little and keeps the
+    full-suite run inside a bounded executable footprint."""
+    yield
+    import jax
+
+    jax.clear_caches()
 
 
 @st.composite

@@ -7,9 +7,9 @@
 #
 # Each bench.py invocation prints one JSON line (appended to the
 # outfile, default PERF_RUNS.jsonl) plus its stderr log. Heavy-tail
-# configs compile for minutes on first run; the persistent XLA cache
-# (.jax_cache) makes re-runs cheap. Order: cheapest first, so a flaky
-# tunnel still yields the headline numbers.
+# configs compile for minutes on first run (and the axon tunnel compiles
+# remotely — no local cache engages). Order: most valuable first, so a
+# flaky tunnel still yields the headline and flagship-family numbers.
 set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-PERF_RUNS.jsonl}"
@@ -23,15 +23,15 @@ run() {
   python bench.py "$@" 2>&1 | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 }
 
-# headline (1M uniform) — warm, then cold-start (compile included)
+# headline (1M uniform, warm), then the heavy-tail family (BASELINE
+# config 5 shapes), then the cheaper configs and the cold start
 run
-run --include-compile
-
-# heavy-tail family (BASELINE config 5 shapes)
+run --gen rmat --nodes 1000000
+run --gen rmat --nodes 4000000 --avg-degree 32
+run --gen rmat --nodes 4000000 --avg-degree 32 --max-degree 256
 run --gen rmat --nodes 200000
 run --gen rmat --nodes 500000
-run --gen rmat --nodes 1000000
-run --gen rmat --nodes 4000000 --avg-degree 32 --max-degree 256
-run --gen rmat --nodes 4000000 --avg-degree 32
+run --nodes 100000                   # BASELINE config 3: 100k, one chip
+run --include-compile                # headline cold start
 
 echo "done; JSON lines in $OUT" >&2

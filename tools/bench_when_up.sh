@@ -1,0 +1,23 @@
+#!/usr/bin/env bash
+# Poll the axon tunnel with cheap probes; the moment device init succeeds,
+# delegate to bench_suite.sh (the one authoritative config list). Useful
+# when the tunnel is down and the battery should fire unattended on
+# recovery:
+#
+#   bash tools/bench_when_up.sh [outfile]
+set -u
+cd "$(dirname "$0")/.."
+
+probe() {
+  timeout 90 python - <<'EOF' >/dev/null 2>&1
+import jax
+assert jax.devices()
+EOF
+}
+
+until probe; do
+  echo "$(date -u +%H:%M:%S) tunnel still down" | tee -a /dev/stderr >/dev/null
+  sleep 240
+done
+echo "$(date -u +%H:%M:%S) tunnel up - starting battery" | tee -a /dev/stderr >/dev/null
+exec bash "$(dirname "$0")/bench_suite.sh" "$@"
