@@ -1,0 +1,164 @@
+"""Static-schedule pricing — gather-volume attribution for the compact
+engine, term by term, against the exact-rule trajectory's floor.
+
+``price_schedule`` walks a recorded ``Trajectory`` through a
+``CompactFrontierEngine``'s *actual* static configuration (stages, width
+ranges, hub split, prune/uncond/tier-2 parameters are read off the
+engine, so the model cannot drift from the code) and sums the element
+gathers each superstep would execute. The output is the table behind
+PERF.md's "1M-RMAT schedule audit": where the engine stands relative to
+the Σdeg(active) floor, and which machinery — full-table phase, stage
+ranges, hub full/rebase/pruned branches — carries the overhead. Every
+round-3 schedule decision (hub row compaction pads, pruned widths, the
+v/1024 ladder rung, tier-2 re-capture, and the *rejected* U-ladder /
+wide-capture variants) was priced with exactly this walk before any TPU
+time was spent on it.
+
+This is measurement tooling, not an engine: the branch emulation mirrors
+``engine.compact._hub_dispatch``'s gating (live-count thresholds, capture
+validity ``mu ≤ U``, tier transitions) but only *counts*; colors come
+from the trajectory replay.
+
+CLI::
+
+    python -m dgc_tpu.utils.schedule_model --node-count 1000000 \
+        --gen-method rmat --max-degree 16
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass, field
+
+from dgc_tpu.engine.compact import CompactFrontierEngine, _pow2_ceil
+from dgc_tpu.utils.trajectory import Trajectory, record_trajectory
+
+
+@dataclass
+class SchedulePrice:
+    """Per-term element-gather volumes for one k-attempt (see module
+    docstring); ``floor`` is the trajectory's Σdeg(active) lower bound."""
+
+    floor: int
+    terms: dict = field(default_factory=dict)
+    steps_per_stage: list = field(default_factory=list)
+    row_gathers: dict = field(default_factory=dict)
+
+    @property
+    def total(self) -> int:
+        return sum(self.terms.values())
+
+    def over_floor(self) -> float:
+        return self.total / self.floor if self.floor else float("inf")
+
+
+def price_schedule(engine: CompactFrontierEngine,
+                   traj: Trajectory) -> SchedulePrice:
+    """Price ``engine``'s static schedule along ``traj`` (same graph; both
+    use the degree-descending bucket relabeling, so bucket indices line
+    up). Returns per-term element-gather volumes and entry row-gathers."""
+    widths = [cb.shape[1] for cb in engine.combined_buckets]
+    sizes = [cb.shape[0] for cb in engine.combined_buckets]
+    if list(traj.bucket_widths) != widths or list(traj.bucket_sizes) != sizes:
+        raise ValueError("trajectory bucket layout != engine bucket layout")
+    hub = engine.hub_buckets
+    flat_total = sum(sz * w for sz, w in zip(sizes[hub:], widths[hub:]))
+    stages = engine.stages
+
+    p = SchedulePrice(floor=traj.gather_floor())
+    p.steps_per_stage = [0] * len(stages)
+    t = dict(full_flat=0, stage_flat=0, hub_full=0, hub_rebase=0,
+             hub_pruned=0, hub_shrink=0, hub_pruned2=0, hub_uncond=0)
+    rows = dict(stage_entry=0, hub_rebase=0, hub_shrink=0)
+    tier = [0] * hub
+    si = 0
+    for n, st in enumerate(traj.steps):
+        # stage transition before the step: the while conds gate on the
+        # CARRIED active count (engine.compact._staged_pipeline), which at
+        # step s equals the trajectory's start-of-step active — except at
+        # step 1, where the init carry is the v+1 sentinel, so the engine
+        # always executes step 1 in stage 0
+        while (n > 0 and si < len(stages) - 1
+               and st.active <= stages[si][1]):
+            si += 1
+            if stages[si][0] is not None:
+                rows["stage_entry"] += _pow2_ceil(stages[si][0])
+        p.steps_per_stage[si] += 1
+        scale = stages[si][0]
+        flat_live = sum(st.active_per_bucket[hub:]) > 0
+        if scale is None:
+            t["full_flat"] += flat_total  # flat region runs fused, no cond
+        elif (flat_live and si < len(engine.stage_ranges)
+              and engine.stage_ranges[si]):
+            t["stage_flat"] += sum((r1 - r0) * w for r0, r1, w, _pl
+                                   in engine.stage_ranges[si])
+
+        for bi in range(hub):
+            live = st.active_per_bucket[bi]
+            w, vb = widths[bi], sizes[bi]
+            if bi < len(engine.hub_uncond) and engine.hub_uncond[bi]:
+                t["hub_uncond"] += vb * w  # no control flow at all
+                continue
+            if live == 0:
+                continue  # cond-skipped: costs nothing
+            cfg = (engine.hub_prune[bi]
+                   if bi < len(engine.hub_prune) else None)
+            if cfg is None:
+                t["hub_full"] += vb * w
+                continue
+            pad, u = cfg[0], cfg[1]
+            p2 = cfg[2] if len(cfg) == 3 else None
+            if tier[bi] == 2:
+                t["hub_pruned2"] += p2 * u
+            elif tier[bi] == 1 and p2 is not None and live <= p2:
+                t["hub_shrink"] += p2 * u
+                rows["hub_shrink"] += p2
+                tier[bi] = 2
+            elif tier[bi] == 1:
+                t["hub_pruned"] += pad * u
+            elif live <= pad:
+                t["hub_rebase"] += pad * w
+                rows["hub_rebase"] += pad
+                if st.max_unconf_per_bucket[bi] <= u:
+                    tier[bi] = 1  # capture valid at this rebase
+            else:
+                t["hub_full"] += vb * w
+    p.terms = t
+    p.row_gathers = rows
+    return p
+
+
+def _main(argv=None) -> int:
+    """``python -m dgc_tpu.utils.schedule_model`` — replay + price one
+    graph and print the attribution table (same graph flags as the
+    trajectory CLI)."""
+    import argparse
+    import json
+    import sys
+
+    from dgc_tpu.utils.trajectory import add_graph_args, load_graph_args
+
+    ap = argparse.ArgumentParser(prog="dgc-tpu-schedule-model")
+    add_graph_args(ap)
+    args = ap.parse_args(argv)
+    arrays = load_graph_args(ap, args)
+
+    eng = CompactFrontierEngine(arrays)
+    traj = record_trajectory(arrays)
+    price = price_schedule(eng, traj)
+    for name, vol in price.terms.items():
+        if vol:
+            print(f"{name:12} {vol/1e6:10.1f}M", file=sys.stderr)
+    print(json.dumps({
+        "supersteps": traj.supersteps,
+        "steps_per_stage": price.steps_per_stage,
+        "gather_floor": price.floor,
+        "engine_total": price.total,
+        "over_floor": round(price.over_floor(), 3),
+        "terms": price.terms,
+        "row_gathers": price.row_gathers,
+    }))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())

@@ -148,6 +148,34 @@ def record_trajectory(arrays: GraphArrays, k: int | None = None,
     return traj
 
 
+def add_graph_args(p) -> None:
+    """The shared graph-source flags of the measurement CLIs (trajectory,
+    schedule_model) — one definition so a priced graph is always the
+    traced graph. Same semantics as ``dgc_tpu.cli``."""
+    p.add_argument("--input", help="graph JSON (reference schema)")
+    p.add_argument("--node-count", type=int)
+    p.add_argument("--max-degree", type=int)
+    p.add_argument("--gen-method", choices=["reference", "fast", "rmat"],
+                   default="reference")
+    p.add_argument("--seed", type=int, default=0)
+
+
+def load_graph_args(p, args) -> GraphArrays:
+    """Resolve ``add_graph_args`` flags to arrays (errors via the parser).
+    ``Graph.generate`` owns the max-degree → avg-degree mapping per
+    method, so a graph measured here corresponds to the one the CLI would
+    color."""
+    from dgc_tpu.models.graph import Graph
+
+    if args.input:
+        return Graph.deserialize(args.input).arrays
+    if args.node_count:
+        return Graph.generate(args.node_count, args.max_degree or 8,
+                              seed=args.seed,
+                              method=args.gen_method).arrays
+    p.error("one of --input / --node-count is required")
+
+
 def _main(argv=None) -> int:
     """``python -m dgc_tpu.utils.trajectory`` — replay a graph's exact-rule
     frontier and print the per-superstep schedule-design quantities (the
@@ -157,33 +185,13 @@ def _main(argv=None) -> int:
     import sys
 
     p = argparse.ArgumentParser(prog="dgc-tpu-trajectory")
-    p.add_argument("--input", help="graph JSON (reference schema)")
-    p.add_argument("--node-count", type=int)
-    p.add_argument("--max-degree", type=int)
-    p.add_argument("--gen-method", choices=["reference", "fast", "rmat"],
-                   default="reference")
-    p.add_argument("--seed", type=int, default=0)
+    add_graph_args(p)
     p.add_argument("--every", type=int, default=1,
                    help="print every Nth superstep (summary always prints)")
     args = p.parse_args(argv)
     if args.every < 1:
         p.error("--every must be >= 1")
-
-    if args.input:
-        from dgc_tpu.models.graph import Graph
-
-        arrays = Graph.deserialize(args.input).arrays
-    elif args.node_count:
-        # same flag semantics as dgc_tpu.cli: Graph.generate owns the
-        # max-degree → avg-degree mapping per method, so a trajectory
-        # measured here corresponds to the graph the CLI would color
-        from dgc_tpu.models.graph import Graph
-
-        arrays = Graph.generate(args.node_count, args.max_degree or 8,
-                                seed=args.seed,
-                                method=args.gen_method).arrays
-    else:
-        p.error("one of --input / --node-count is required")
+    arrays = load_graph_args(p, args)
 
     traj = record_trajectory(arrays)
     for s in traj.steps:

@@ -78,3 +78,33 @@ def test_trajectory_cli_smoke(tmp_path, capsys):
     summary = json.loads(out[-1])
     assert summary["supersteps"] >= 1 and summary["colors_used"] >= 1
     assert summary["gather_floor"] > 0
+
+
+def test_schedule_model_prices_engine_config():
+    # the pricing walk must read the engine's real static config and bound
+    # the trajectory floor from above; forced-hub params exercise the
+    # rebase/pruned/tier-2 emulation, and layout mismatch must be rejected
+    import pytest
+
+    from dgc_tpu.engine.compact import CompactFrontierEngine, _pow2_ceil
+    from dgc_tpu.models.generators import generate_rmat_graph
+    from dgc_tpu.utils.schedule_model import price_schedule
+    from dgc_tpu.utils.trajectory import record_trajectory
+
+    g = generate_rmat_graph(2000, avg_degree=10.0, seed=5)
+    t0 = max(g.num_vertices // 2, 1)
+    eng = CompactFrontierEngine(g, flat_cap=8, prune_u_min=4,
+                                prune_p2_min=4, hub_uncond_entries=0,
+                                stages=((None, t0), (_pow2_ceil(t0), 0)))
+    traj = record_trajectory(g)
+    price = price_schedule(eng, traj)
+    assert price.floor == traj.gather_floor() > 0
+    assert price.total >= price.floor  # a schedule cannot beat the floor
+    assert sum(price.steps_per_stage) == traj.supersteps
+    # forced-hub config must exercise the hub terms, not just the flat path
+    assert price.terms["hub_full"] + price.terms["hub_rebase"] > 0
+    assert price.terms["hub_pruned"] + price.terms["hub_pruned2"] >= 0
+
+    other = generate_rmat_graph(1000, avg_degree=8.0, seed=1)
+    with pytest.raises(ValueError, match="bucket layout"):
+        price_schedule(eng, record_trajectory(other))

@@ -15,9 +15,14 @@ assert jax.devices()
 EOF
 }
 
+# status lines go through tee -a, not `>&2`: under a `2> file` redirect
+# the shell's own fd offset is stale relative to content the delegated
+# suite later appends (see bench_suite.sh)
 until probe; do
   echo "$(date -u +%H:%M:%S) tunnel still down" | tee -a /dev/stderr >/dev/null
   sleep 240
 done
 echo "$(date -u +%H:%M:%S) tunnel up - starting battery" | tee -a /dev/stderr >/dev/null
-exec bash "$(dirname "$0")/bench_suite.sh" "$@"
+# we are in the repo root (cd above), so the suite path is fixed —
+# dirname "$0" would be wrong here after a relative invocation
+exec bash tools/bench_suite.sh "$@"
