@@ -1,0 +1,135 @@
+"""Decompose the staged kernels' effective gather rate on the real chip.
+
+PERF.md's audits price sweeps in element gathers, and the conversion to
+seconds uses an *effective* ~45-50M lookups/s measured end-to-end — half
+the raw 100-140M/s large-gather rate. This probe isolates where the
+factor goes by timing, on device, inside a ``lax.while_loop`` (the
+production setting — one iteration per superstep, loop-carried
+dependencies):
+
+1. the leaf-stage shape: [4096, 256] gather from a [1M] table;
+2. the mid-stage shape: [65536, 64];
+3. the stage-0-range shape: [262144, 40] (the v/4 stage's dominant range);
+4. a hub pruned chain: [4096, 256] + [1024, 512] + [128, 2048] per
+   iteration (one superstep's hub work, sequential deps via the carry);
+5. one loop-free 32M-element flat gather (``flat_reference_32M`` — the
+   large-gather rate the loop cases are compared against, rate vs rate);
+6. an empty while_loop (pure per-iteration overhead).
+
+Usage (tunnel must be up): python tools/rate_probe.py [iters]
+Prints one JSON line per case: {case, iters, total_elems, seconds,
+rate_M_per_s, per_iter_us}.
+"""
+import json
+import sys
+import time
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+
+def timed(fn, *args):
+    r = fn(*args)
+    jax.block_until_ready(r)
+    t0 = time.perf_counter()
+    r = fn(*args)
+    jax.block_until_ready(r)
+    return time.perf_counter() - t0
+
+
+def loop_gather(table, idx, iters):
+    """while_loop of gathers with a carried dependency (sum feeds the next
+    iteration's index offset mod V — defeats batching across iterations,
+    like a real superstep's state dependence)."""
+    v = table.shape[0]
+
+    def body(c):
+        i, acc = c
+        g = table[(idx + acc % v) % v]
+        return i + 1, acc + jnp.sum(g)
+
+    def cond(c):
+        return c[0] < iters
+
+    return jax.lax.while_loop(cond, body, (jnp.int32(0), jnp.int32(0)))[1]
+
+
+def main() -> int:
+    iters = int(sys.argv[1]) if len(sys.argv) > 1 else 100
+    v = 1_000_000
+    rng = np.random.default_rng(0)
+    table = jnp.asarray(rng.integers(0, 2**30, v, dtype=np.int64).astype(np.int32))
+    dev = jax.devices()[0]
+    print(f"# device: {dev.device_kind} ({dev.platform})", file=sys.stderr)
+
+    shapes = {
+        "leaf_4096x256": (4096, 256),
+        "mid_65536x64": (65536, 64),
+        "stage0_262144x40": (262144, 40),
+    }
+    out = []
+    for name, (r, w) in shapes.items():
+        idx = jnp.asarray(rng.integers(0, v, (r, w), dtype=np.int64).astype(np.int32))
+        f = jax.jit(loop_gather, static_argnums=2)
+        sec = timed(f, table, idx, iters)
+        elems = r * w * iters
+        out.append(dict(case=f"loop_{name}", iters=iters, total_elems=elems,
+                        seconds=round(sec, 4),
+                        rate_M_per_s=round(elems / sec / 1e6, 1),
+                        per_iter_us=round(sec / iters * 1e6, 1)))
+
+    # one loop-free large gather: the reference rate (rate vs rate — the
+    # loop cases above carry different total volumes by design)
+    flat_idx = jnp.asarray(
+        rng.integers(0, v, 32_000_000, dtype=np.int64).astype(np.int32))
+    g = jax.jit(lambda t, i: jnp.sum(t[i]))
+    sec1 = timed(g, table, flat_idx)
+    out.append(dict(case="flat_reference_32M", iters=1,
+                    total_elems=int(flat_idx.size),
+                    seconds=round(sec1, 4),
+                    rate_M_per_s=round(flat_idx.size / sec1 / 1e6, 1),
+                    per_iter_us=round(sec1 * 1e6, 1)))
+
+    # hub chain: three dependent gathers per iteration (one superstep's hub)
+    idxs = [jnp.asarray(rng.integers(0, v, s, dtype=np.int64).astype(np.int32))
+            for s in ((4096, 256), (1024, 512), (128, 2048))]
+
+    def chain(table, i0, i1, i2, iters):
+        def body(c):
+            i, acc = c
+            a = jnp.sum(table[(i0 + acc % 7) % v])
+            b = jnp.sum(table[(i1 + a % 5) % v])
+            d = jnp.sum(table[(i2 + b % 3) % v])
+            return i + 1, acc + d
+
+        return jax.lax.while_loop(lambda c: c[0] < iters, body,
+                                  (jnp.int32(0), jnp.int32(0)))[1]
+
+    f = jax.jit(chain, static_argnums=4)
+    sec = timed(f, table, *idxs, iters)
+    elems = sum(int(np.prod(s.shape)) for s in idxs) * iters
+    out.append(dict(case="loop_hub_chain3", iters=iters, total_elems=elems,
+                    seconds=round(sec, 4),
+                    rate_M_per_s=round(elems / sec / 1e6, 1),
+                    per_iter_us=round(sec / iters * 1e6, 1)))
+
+    # empty loop: pure per-iteration overhead
+    def empty(iters):
+        return jax.lax.while_loop(lambda c: c[0] < iters,
+                                  lambda c: (c[0] + 1, c[1] + 1),
+                                  (jnp.int32(0), jnp.int32(0)))[1]
+
+    f = jax.jit(empty, static_argnums=0)
+    sec = timed(f, iters * 10)
+    out.append(dict(case="empty_loop", iters=iters * 10, total_elems=0,
+                    seconds=round(sec, 5), rate_M_per_s=0.0,
+                    per_iter_us=round(sec / (iters * 10) * 1e6, 2)))
+
+    for o in out:
+        print(json.dumps(o))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
