@@ -51,6 +51,55 @@ class SchedulePrice:
         return self.total / self.floor if self.floor else float("inf")
 
 
+def program_complexity(engine: CompactFrontierEngine) -> dict:
+    """Compile-cost proxies for the engine's staged program: counts of
+    the structures that each become compiled XLA code. Heavy-tail compile
+    time tracks these (PERF.md: the uncond-small-buckets change deleted
+    most cond branches and halved the 200k-RMAT compile), so schedule
+    decisions should weigh a priced runtime win against the deltas here.
+
+    - ``stage_bodies``: while-loop bodies (full-table phase + one per
+      compaction stage) — the whole pipeline is instantiated once in the
+      phase-carried fused sweep;
+    - ``range_gathers``: Σ width-ranges across stages (one gather + one
+      update per range per stage body);
+    - ``hub_branches``: Σ compiled control-flow bodies dispatching the
+      hub — per stage body, each conditioned bucket contributes its
+      switch-ladder branches (``_hub_dispatch``: the full branch is
+      dropped when the prune pad covers the bucket), and compaction-stage
+      bodies add the outer do_hub/skip_hub cond pair per conditioned
+      bucket; uncond buckets compile with no control flow and count 0;
+    - ``uncond_buckets``: hub buckets compiled with no control flow.
+    """
+    from dgc_tpu.engine.compact import hub_pad_for
+
+    ladders = []                  # per conditioned bucket: ladder branches
+    for bi in range(engine.hub_buckets):
+        if bi < len(engine.hub_uncond) and engine.hub_uncond[bi]:
+            continue
+        cfg = engine.hub_prune[bi] if bi < len(engine.hub_prune) else None
+        vb = engine.combined_buckets[bi].shape[0]
+        if cfg is None:
+            pad = hub_pad_for(vb)
+            # cond(live) [+ cond(compact vs full)] — count the bodies
+            ladders.append(2 if pad == 0 else 4)
+        elif len(cfg) == 2:
+            ladders.append(3 if cfg[0] >= vb else 4)  # full dropped
+        else:
+            ladders.append(5 if cfg[0] >= vb else 6)
+    stage_bodies = len(engine.stages)
+    compaction_stages = sum(1 for s, _ in engine.stages if s is not None)
+    return dict(
+        stage_bodies=stage_bodies,
+        range_gathers=sum(len(r) for r in engine.stage_ranges if r),
+        hub_branches=(sum(ladders) * stage_bodies
+                      + 2 * len(ladders) * compaction_stages),
+        uncond_buckets=sum(1 for bi in range(engine.hub_buckets)
+                           if bi < len(engine.hub_uncond)
+                           and engine.hub_uncond[bi]),
+    )
+
+
 def price_schedule(engine: CompactFrontierEngine,
                    traj: Trajectory) -> SchedulePrice:
     """Price ``engine``'s static schedule along ``traj`` (same graph; both
@@ -156,6 +205,7 @@ def _main(argv=None) -> int:
         "over_floor": round(price.over_floor(), 3),
         "terms": price.terms,
         "row_gathers": price.row_gathers,
+        "complexity": program_complexity(eng),
     }))
     return 0
 

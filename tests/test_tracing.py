@@ -108,3 +108,39 @@ def test_schedule_model_prices_engine_config():
     other = generate_rmat_graph(1000, avg_degree=8.0, seed=1)
     with pytest.raises(ValueError, match="bucket layout"):
         price_schedule(eng, record_trajectory(other))
+
+
+def test_program_complexity_counts():
+    # exact hand-computed counts on a one-bucket forced-hub clique so an
+    # inverted cfg classification or a dropped ladder arm shifts the number
+    import numpy as np
+
+    from dgc_tpu.engine.compact import CompactFrontierEngine, _pow2_ceil
+    from dgc_tpu.models.arrays import GraphArrays
+    from dgc_tpu.utils.schedule_model import program_complexity
+
+    n = 48
+    edges = np.array([[i, j] for i in range(n) for j in range(i + 1, n)])
+    g = GraphArrays.from_edge_list(n, edges)
+    stages = ((None, n // 2), (_pow2_ceil(n // 2), 0))  # 1 full + 1 compaction
+    kw = dict(flat_cap=4, prune_u_min=8, hub_uncond_entries=0, stages=stages)
+
+    # tier-2 cfg: P=32 < rows=48 keeps the full branch -> 6-branch ladder;
+    # hub_branches = 6 ladder x 2 stage bodies + outer cond pair x 1
+    # compaction stage = 14
+    eng = CompactFrontierEngine(g, prune_p2_min=4, **kw)
+    assert eng.hub_buckets == 1 and len(eng.hub_prune[0]) == 3
+    c = program_complexity(eng)
+    assert c["stage_bodies"] == 2 and c["uncond_buckets"] == 0
+    assert c["hub_branches"] == 6 * 2 + 2 * 1 * 1
+
+    # len-2 cfg (tier-2 disabled): 4-branch ladder -> 4*2 + 2 = 10
+    eng2 = CompactFrontierEngine(g, prune_p2_min=1 << 20, **kw)
+    assert len(eng2.hub_prune[0]) == 2
+    assert program_complexity(eng2)["hub_branches"] == 4 * 2 + 2 * 1 * 1
+
+    # unconditioned bucket: no control flow at all
+    eng3 = CompactFrontierEngine(g, flat_cap=4, prune_u_min=8,
+                                 hub_uncond_entries=1 << 20, stages=stages)
+    c3 = program_complexity(eng3)
+    assert c3["uncond_buckets"] == 1 and c3["hub_branches"] == 0
