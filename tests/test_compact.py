@@ -631,3 +631,26 @@ def test_default_stages_heavy_tail_large():
             assert scale >= bound
         assert thresh < bound
         bound = thresh
+
+
+def test_compact_parity_with_reference_sim(small_graphs):
+    # the flagship engine's ±1 color-count contract against the
+    # reference's optimized semantics, WITH the compaction stages forced
+    # (default stages degenerate below 2^14 vertices) — compact relabels
+    # vertices (degree desc), so its tie-breaks differ per vertex from
+    # the unbucketed engines; the contract is at the count level
+    # (SURVEY §7.3), on the uniform ensemble plus a power-law draw
+    from dgc_tpu.engine.reference_sim import ReferenceSimEngine
+
+    graphs = list(small_graphs) + [
+        generate_rmat_graph(800, avg_degree=8.0, seed=4, native=False)
+    ]
+    for g in graphs:
+        a = find_minimal_coloring(
+            _forced_compact(g), g.max_degree + 1,
+            validate=make_validator(g)).minimal_colors
+        b = find_minimal_coloring(
+            ReferenceSimEngine(g), g.max_degree + 1,
+            validate=make_validator(g)).minimal_colors
+        assert a is not None and b is not None
+        assert abs(a - b) <= 1, (a, b)

@@ -169,3 +169,4 @@ def test_bipartite_two_colors():
     g = GraphArrays.from_edge_list(v, edges)
     res = _minimal(ELLEngine(g), g)
     assert res.minimal_colors == 2
+
