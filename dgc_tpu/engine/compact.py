@@ -565,14 +565,12 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
         ba_parts.append(a_b)
         prune_new.append(ps_b)
 
-    for bi in range(hub_buckets, len(buckets)):
-        cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
-        pk_b = jax.lax.dynamic_slice_in_dim(pk, row0, cb.shape[0])
-        new_b, f_b, a_b, m_b = _bucket_update(pe, pk_b, cb, p_b, k, v)
-        new_parts.append(new_b)
-        parts_fail.append(f_b)
-        parts_active.append(a_b)
-        parts_mc.append(m_b)
+    f_parts, f_fails, f_acts, f_mcs = _flat_buckets_step(
+        pe, pk, buckets, planes, row0s, hub_buckets, k, v)
+    new_parts.extend(f_parts)
+    parts_fail.extend(f_fails)
+    parts_active.extend(f_acts)
+    parts_mc.extend(f_mcs)
     if hub_buckets < len(buckets):
         ba_parts.append(sum(parts_active[hub_buckets:]))
 
@@ -605,6 +603,311 @@ def _empty_rec(v: int, nb: int, dummy: bool = False):
             jnp.zeros((_REC_SLOTS, max(nb, 1)), jnp.int32),
             jnp.full((_REC_SLOTS, 5), -1, jnp.int32),
             jnp.int32(0), jnp.int32(-1))
+
+
+def _make_recstep(record):
+    """The prefix-resume ring push, shared by both pipeline variants (one
+    definition so the resume contract cannot drift): push this superstep's
+    pre-state when it sets a new divergence-candidate (mc) record."""
+
+    def recstep(rec5, pe, ba, step, prev_active, stall, mc, any_fail):
+        if record is False:  # statically off (plain attempt): no dead work
+            return rec5
+        rpe, rba, rmeta, cnt, best = rec5
+        push = record & (mc > best) & ~any_fail
+        slot = jnp.where(push, cnt % _REC_SLOTS, 0).astype(jnp.int32)
+        old_pe = jax.lax.dynamic_slice_in_dim(rpe, slot, 1, axis=0)[0]
+        old_ba = jax.lax.dynamic_slice_in_dim(rba, slot, 1, axis=0)[0]
+        old_meta = jax.lax.dynamic_slice_in_dim(rmeta, slot, 1, axis=0)[0]
+        meta = jnp.stack([step, best, mc, stall, prev_active])
+        rpe = jax.lax.dynamic_update_slice_in_dim(
+            rpe, jnp.where(push, pe, old_pe)[None], slot, axis=0)
+        rba = jax.lax.dynamic_update_slice_in_dim(
+            rba, jnp.where(push, ba, old_ba)[None], slot, axis=0)
+        rmeta = jax.lax.dynamic_update_slice_in_dim(
+            rmeta, jnp.where(push, meta, old_meta)[None], slot, axis=0)
+        return (rpe, rba, rmeta, cnt + push.astype(jnp.int32),
+                jnp.where(push, mc, best))
+
+    return recstep
+
+
+def _superstep_epilogue(recstep, rec5, pe, ba, prune, new_pe, ba_new,
+                        prune_new, fail_count, active, mc, step,
+                        prev_active, stall, stall_window):
+    """Shared tail of every pipeline superstep body (one definition so the
+    fail-revert ordering, stall accounting, and rec-ring push cannot drift
+    between the sequential and unified pipelines): push the rec ring,
+    advance stall/status, and revert state on a failed superstep. Returns
+    (rec5, stall, status, new_pe, ba_new, prune_new)."""
+    any_fail = fail_count > 0
+    rec5 = recstep(rec5, pe, ba, step, prev_active, stall, mc, any_fail)
+    stall = jnp.where(active < prev_active, 0, stall + 1)
+    status = status_step(any_fail, active, stall, stall_window)
+    new_pe = jnp.where(any_fail, pe, new_pe)
+    ba_new = jnp.where(any_fail, ba, ba_new)
+    prune_new = jax.tree.map(
+        lambda a, b: jnp.where(any_fail, a, b), prune, prune_new)
+    return rec5, stall, status, new_pe, ba_new, prune_new
+
+
+def _flat_buckets_step(pe, pk, buckets, planes: tuple, row0s: tuple,
+                       nb_hub: int, k, v: int):
+    """One superstep of every flat bucket against the ``pe`` snapshot —
+    the single home of the fused flat-region loop (shared by
+    ``_hybrid_superstep`` and the unified pipeline's full-table branch so
+    the two cannot drift). ``pk`` is the caller's ``pe[:v]`` slice (passed
+    in so callers that already hold it don't trace a second slice).
+    Returns per-bucket lists (new_parts, fails, actives, mcs)."""
+    new_parts, fails, acts, mcs = [], [], [], []
+    for bi in range(nb_hub, len(buckets)):
+        cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
+        pk_b = jax.lax.dynamic_slice_in_dim(pk, row0, cb.shape[0])
+        new_b, f_b, a_b, m_b = _bucket_update(pe, pk_b, cb, p_b, k, v)
+        new_parts.append(new_b)
+        fails.append(f_b)
+        acts.append(a_b)
+        mcs.append(m_b)
+    return new_parts, fails, acts, mcs
+
+
+def _hub_region_step(pe, ba, new_pe, prune, buckets, planes: tuple,
+                     row0s: tuple, nb_hub: int, hub_prune: tuple,
+                     hub_uncond: tuple, k, v: int):
+    """One superstep of the hub region against the ``pe`` snapshot,
+    accumulating each bucket's rows into ``new_pe`` (disjoint row sets).
+    The single home of the cond-skipped hub loop — traced once per
+    pipeline by ``_unified_pipeline``. Returns
+    (new_pe, fails, actives, mcs, prune_new) with per-bucket lists."""
+    fails, actives, mcs = [], [], []
+    prune_new = []
+    for bi in range(nb_hub):
+        cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
+        vb = cb.shape[0]
+        cfg = hub_prune[bi] if bi < len(hub_prune) else None
+        uncond = bool(hub_uncond[bi]) if bi < len(hub_uncond) else False
+
+        # slice + write-back stay inside the cond: an inert hub bucket
+        # must cost *nothing* per superstep (module docstring invariant),
+        # not an O(rows) copy
+        def do_hub(op, cb=cb, p_b=p_b, row0=row0, vb=vb, bi=bi,
+                   cfg=cfg, uncond=uncond):
+            acc, ps = op
+            pk_b = jax.lax.dynamic_slice_in_dim(pe[:v], row0, vb)
+            new_b, f_b, a_b, m_b, ps2 = _hub_dispatch(
+                pe, ba[bi], pk_b, cb, p_b, k, v, ps, cfg, uncond=uncond)
+            return (jax.lax.dynamic_update_slice_in_dim(
+                acc, new_b, row0, axis=0), f_b, a_b, m_b, ps2)
+
+        def skip_hub(op):
+            acc, ps = op
+            return acc, jnp.int32(0), jnp.int32(0), jnp.int32(-1), ps
+
+        if uncond:  # no cond: costs less than the cond would
+            new_pe, f_b, a_b, m_b, ps2 = do_hub(
+                (new_pe, prune[bi] if bi < len(prune) else None))
+        else:
+            new_pe, f_b, a_b, m_b, ps2 = jax.lax.cond(
+                ba[bi] > 0, do_hub, skip_hub,
+                (new_pe, prune[bi] if bi < len(prune) else None))
+        fails.append(f_b)
+        actives.append(a_b)
+        mcs.append(m_b)
+        prune_new.append(ps2)
+    return new_pe, fails, actives, mcs, tuple(prune_new)
+
+
+def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
+                      planes: tuple, row0s: tuple, hub_buckets: int,
+                      flat_row0: int, flat_planes: int, stages: tuple,
+                      max_steps: int, init_bucket_active: tuple,
+                      stage_ranges: tuple = (), hub_prune: tuple = (),
+                      hub_uncond: tuple = (), stall_window: int = 64):
+    """Heavy-tail variant of ``_staged_pipeline``: ONE ``while_loop`` whose
+    body dispatches the flat region's work over a ``lax.switch`` of
+    per-stage bodies while the hub machinery — the dominant traced cost
+    (Σ dispatch-ladder branches, each with wide-table gathers and capture
+    logic) — and the rec-ring/status scaffolding are traced exactly once,
+    instead of once per stage body. At 200k RMAT the per-stage pipeline
+    lowers to 82k HLO ops of which ~42k are the 7 hub-ladder instances;
+    compile (the per-process cost under remote-compile deployments,
+    PERF.md) scales with that product.
+
+    The schedule is bit-identical to the per-stage loops: stage s of the
+    sequential pipeline runs exactly while ``thresh_s < active ≤
+    thresh_{s-1}`` (actives are monotone non-increasing), so the switch
+    index ``max{s: active ≤ thresh_{s-1}}`` replays the same stage for
+    every superstep, and recompaction fires on stage advance from the same
+    pre-superstep snapshot the sequential stage entry would use. The
+    compacted rows ride the carry as ``comb_c`` (int32[A0, W_flat], A0 =
+    the largest stage pad) + ``gidx`` (their global row ids); stage s
+    reads the static prefix ``[:pad_s]``, so narrower later stages never
+    see a wider stage's stale tail. The full-width transition row-gather
+    replaces the per-range clipped gathers of the sequential stage entry —
+    same rows, same values on every clipped prefix (row gathers are paid
+    per row, so the extra width is free at the measured rates), hence
+    every per-superstep input is bit-identical."""
+    v = degrees.shape[0]
+    k = jnp.asarray(k, jnp.int32)
+    nb_hub = hub_buckets
+    has_flat = nb_hub < len(buckets)
+    n_stages = len(stages)
+    threshs = tuple(int(t) for _, t in stages)
+    pads = tuple(None if s is None else _pow2_ceil(s) for s, _ in stages)
+    a0 = max((p for p in pads if p is not None), default=1)
+    v_flat = flat_ext.shape[0] - 1
+    w_flat = flat_ext.shape[1]
+
+    recstep = _make_recstep(record)
+
+    def desired_stage(active):
+        d = jnp.int32(0)
+        for s in range(1, n_stages):
+            d = jnp.where(active <= threshs[s - 1], jnp.int32(s), d)
+        return d
+
+    prune0 = _fresh_prune(buckets, nb_hub, planes, hub_prune, v)
+    comb0 = jnp.full((a0, w_flat), v, jnp.int32)      # dummy rows
+    gidx0 = jnp.full((a0,), v + 1, jnp.int32)         # dummy slot target
+    carry = ((init[0], init[1], jnp.int32(_RUNNING), init[2], init[3],
+              init[4]) + tuple(rec)
+             + (prune0, jnp.int32(-1), comb0, gidx0))
+
+    def cond(c):
+        step, status, active = c[1], c[2], c[3]
+        # the final stage runs down to ITS threshold (0 for every shipped
+        # ladder, but forced configs may stop early — the sequential
+        # pipeline then exits with the frontier unfinished and the fixup
+        # reports STALLED; match it exactly)
+        return ((status == _RUNNING) & (active > threshs[-1])
+                & (step < max_steps))
+
+    def body(c):
+        pe, step, status, prev_active, stall, ba = c[:6]
+        rec5, prune = c[6:11], c[11]
+        stage_idx, comb_c, gidx = c[12], c[13], c[14]
+
+        # --- stage advance + recompaction (from the pre-superstep pe) ---
+        desired = desired_stage(prev_active)
+
+        def make_trans(s):
+            pad_s = pads[s]
+            if pad_s is None:
+                return lambda op: op
+
+            def trans(op, pad_s=pad_s):
+                comb_c, gidx = op
+                pk = pe[:v]
+                act = (pk < 0) | ((pk & 1) == 1)
+                act_f = jax.lax.slice(act, (flat_row0,), (v,))
+                idx_f = _compact_idx(act_f, pad_s, v_flat)
+                comb_s = jnp.take(flat_ext, idx_f, axis=0)  # row gather
+                comb_c = jax.lax.dynamic_update_slice(comb_c, comb_s, (0, 0))
+                g_s = jnp.where(idx_f == v_flat, v + 1, idx_f + flat_row0)
+                gidx = jax.lax.dynamic_update_slice(gidx, g_s, (0,))
+                return comb_c, gidx
+
+            return trans
+
+        comb_c, gidx = jax.lax.cond(
+            desired > stage_idx,
+            lambda op: jax.lax.switch(
+                desired, [make_trans(s) for s in range(n_stages)], op),
+            lambda op: op,
+            (comb_c, gidx))
+        stage_idx = jnp.maximum(stage_idx, desired)
+
+        # --- flat-region superstep for the current stage (switch) ---
+        def make_flat(s):
+            scale = stages[s][0]
+            if not has_flat:
+                def none_flat(_):
+                    return pe, jnp.int32(0), jnp.int32(0), jnp.int32(-1)
+                return none_flat
+            if scale is None:
+                # full-table phase: all flat buckets fused, unconditioned
+                def full_flat(_):
+                    pk = pe[:v]
+                    new_parts, fails, acts, mcs = _flat_buckets_step(
+                        pe, pk, buckets, planes, row0s, nb_hub, k, v)
+                    new_flat = jnp.concatenate(new_parts)
+                    new_pe = jax.lax.dynamic_update_slice_in_dim(
+                        pe, new_flat, flat_row0, axis=0)
+                    return (new_pe, sum(fails), sum(acts),
+                            mcs[0] if len(mcs) == 1
+                            else jnp.max(jnp.stack(mcs)))
+                return full_flat
+
+            pad_s = pads[s]
+            ranges = (stage_ranges[s] if s < len(stage_ranges)
+                      and stage_ranges[s] else
+                      ((0, pad_s, w_flat, flat_planes),))
+
+            def staged_flat(op, pad_s=pad_s, ranges=ranges):
+                comb_c, gidx = op
+                gidx_s = jax.lax.slice(gidx, (0,), (pad_s,))
+
+                def do_flat(_):
+                    pk_a = pe[gidx_s]
+                    new_parts, mcs = [], []
+                    fail_t = jnp.int32(0)
+                    act_t = jnp.int32(0)
+                    for (r0, r1, w_r, p_r) in ranges:
+                        comb_r = jax.lax.slice(comb_c, (r0, 0), (r1, w_r))
+                        nbrs_r, beats_r = decode_combined(comb_r)
+                        pk_r = jax.lax.slice(pk_a, (r0,), (r1,))
+                        np_r = pe[nbrs_r]        # gather [r1-r0, w_r]
+                        new_r, fail_mask, act_mask, mc_r = (
+                            speculative_update_mc(pk_r, np_r, beats_r, k,
+                                                  p_r))
+                        new_parts.append(new_r)
+                        fail_t += jnp.sum(fail_mask.astype(jnp.int32))
+                        act_t += jnp.sum(act_mask.astype(jnp.int32))
+                        mcs.append(mc_r)
+                    new_a = (new_parts[0] if len(new_parts) == 1
+                             else jnp.concatenate(new_parts))
+                    mc = (mcs[0] if len(mcs) == 1
+                          else jnp.max(jnp.stack(mcs)))
+                    # dups only at V+1, same value
+                    return pe.at[gidx_s].set(new_a), fail_t, act_t, mc
+
+                def skip_any(_):
+                    return pe, jnp.int32(0), jnp.int32(0), jnp.int32(-1)
+
+                return jax.lax.cond(ba[nb_hub] > 0, do_flat, skip_any, None)
+
+            return staged_flat
+
+        new_pe, fail_f, act_fl, mc_f = jax.lax.switch(
+            stage_idx, [make_flat(s) for s in range(n_stages)],
+            (comb_c, gidx))
+
+        # --- hub region: traced ONCE for the whole pipeline ---
+        new_pe, h_fails, h_actives, h_mcs, prune_new = _hub_region_step(
+            pe, ba, new_pe, prune, buckets, planes, row0s, nb_hub,
+            hub_prune, hub_uncond, k, v)
+        ba_parts = list(h_actives)
+        if has_flat:
+            ba_parts.append(act_fl)
+        ba_new = jnp.stack(ba_parts) if ba_parts else ba
+
+        fail_count = sum([fail_f] + h_fails)
+        active = sum([act_fl] + h_actives)
+        mc = jnp.max(jnp.stack([mc_f] + h_mcs))
+        rec5, stall, status, new_pe, ba_new, prune_new = _superstep_epilogue(
+            recstep, rec5, pe, ba, prune, new_pe, ba_new, prune_new,
+            fail_count, active, mc, step, prev_active, stall, stall_window)
+        return ((new_pe, step + 1, status, active, stall, ba_new)
+                + rec5 + (prune_new, stage_idx, comb_c, gidx))
+
+    carry = jax.lax.while_loop(cond, body, carry)
+    pe, steps, status, active = carry[0], carry[1], carry[2], carry[3]
+    # fixups: nothing-to-do graphs and step-budget exhaustion
+    status = jnp.where(
+        (status == _RUNNING) & (active == 0), _SUCCESS,
+        jnp.where(status == _RUNNING, _STALLED, status),
+    ).astype(jnp.int32)
+    return pe, steps, status, tuple(carry[6:11])
 
 
 def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
@@ -647,29 +950,22 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
     nb_hub = hub_buckets
     has_flat = nb_hub < len(buckets)
 
+    if nb_hub > 0 and any(scale is not None for scale, _ in stages):
+        # heavy-tail configs take the unified loop: the hub machinery (the
+        # dominant traced cost) is traced once instead of once per stage
+        # body. Hub-free configs keep this path — their lowered HLO stays
+        # byte-identical (the measured 1M-uniform headline kernel).
+        return _unified_pipeline(
+            buckets, flat_ext, degrees, k, init, rec, record,
+            planes, row0s, hub_buckets, flat_row0, flat_planes, stages,
+            max_steps, init_bucket_active, stage_ranges, hub_prune,
+            hub_uncond, stall_window)
+
     prune0 = _fresh_prune(buckets, nb_hub, planes, hub_prune, v)
     carry = (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3],
              init[4]) + tuple(rec) + (prune0,)
 
-    def recstep(rec5, pe, ba, step, prev_active, stall, mc, any_fail):
-        """Push this superstep's pre-state when it sets a new mc record."""
-        if record is False:  # statically off (plain attempt): no dead work
-            return rec5
-        rpe, rba, rmeta, cnt, best = rec5
-        push = record & (mc > best) & ~any_fail
-        slot = jnp.where(push, cnt % _REC_SLOTS, 0).astype(jnp.int32)
-        old_pe = jax.lax.dynamic_slice_in_dim(rpe, slot, 1, axis=0)[0]
-        old_ba = jax.lax.dynamic_slice_in_dim(rba, slot, 1, axis=0)[0]
-        old_meta = jax.lax.dynamic_slice_in_dim(rmeta, slot, 1, axis=0)[0]
-        meta = jnp.stack([step, best, mc, stall, prev_active])
-        rpe = jax.lax.dynamic_update_slice_in_dim(
-            rpe, jnp.where(push, pe, old_pe)[None], slot, axis=0)
-        rba = jax.lax.dynamic_update_slice_in_dim(
-            rba, jnp.where(push, ba, old_ba)[None], slot, axis=0)
-        rmeta = jax.lax.dynamic_update_slice_in_dim(
-            rmeta, jnp.where(push, meta, old_meta)[None], slot, axis=0)
-        return (rpe, rba, rmeta, cnt + push.astype(jnp.int32),
-                jnp.where(push, mc, best))
+    recstep = _make_recstep(record)
 
     for si, (scale, thresh) in enumerate(stages):
         if scale is None:
@@ -684,22 +980,20 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                 new_pe, fail_count, active, ba_new, mc, prune_new = (
                     _hybrid_superstep(pe, ba, buckets, row0s, k, planes, v,
                                       nb_hub, prune, hub_prune, hub_uncond))
-                any_fail = fail_count > 0
-                rec5 = recstep(rec5, pe, ba, step, prev_active, stall, mc,
-                               any_fail)
-                stall = jnp.where(active < prev_active, 0, stall + 1)
-                status = status_step(any_fail, active, stall, stall_window)
-                new_pe = jnp.where(any_fail, pe, new_pe)
-                ba_new = jnp.where(any_fail, ba, ba_new)
-                prune_new = jax.tree.map(
-                    lambda a, b: jnp.where(any_fail, a, b), prune, prune_new)
+                (rec5, stall, status, new_pe, ba_new,
+                 prune_new) = _superstep_epilogue(
+                    recstep, rec5, pe, ba, prune, new_pe, ba_new, prune_new,
+                    fail_count, active, mc, step, prev_active, stall,
+                    stall_window)
                 return ((new_pe, step + 1, status, active, stall, ba_new)
                         + rec5 + (prune_new,))
 
             carry = jax.lax.while_loop(cond, body, carry)
             continue
 
-        # --- hybrid compaction stage: frontier ≤ scale at entry ---
+        # --- compaction stage (hub-free: hub>0 routes to the unified
+        # pipeline above, so the flat region is the whole graph here) ---
+        assert nb_hub == 0, "staged sequential pipeline requires hub-free"
         a_pad = _pow2_ceil(scale)
         v_flat = flat_ext.shape[0] - 1
         # width-ranged slots (see module docstring); fallback: one
@@ -732,6 +1026,10 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                 return (status == _RUNNING) & (active > thresh) & (step < max_steps)
 
             def body2(c2):
+                # hub > 0 with compaction stages always routes to
+                # ``_unified_pipeline`` (the _staged_pipeline dispatch), so
+                # this body only ever traces hub-free: the flat region IS
+                # the graph, prune state is the empty tuple, ba = [flat]
                 pe, step, status, prev_active, stall, ba = c2[:6]
                 rec5, prune = c2[6:11], c2[11]
                 # BSP snapshot semantics: all reads from ``pe``; writes
@@ -758,77 +1056,26 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                     return (acc.at[gidx].set(new_a),  # dups only at V+1, same value
                             fail_t, act_t, mc)
 
-                def skip_any(acc):
-                    return acc, jnp.int32(0), jnp.int32(0), jnp.int32(-1)
-
                 if not has_flat:
                     new_pe, fail_f, act_fl, mc_f = (
                         pe, jnp.int32(0), jnp.int32(0), jnp.int32(-1))
-                elif nb_hub == 0:
+                else:
                     # no hub: while-cond (active > thresh ≥ 0) already
                     # guarantees flat work exists — run uncond'd
                     new_pe, fail_f, act_fl, mc_f = do_flat(pe)
-                else:
-                    new_pe, fail_f, act_fl, mc_f = jax.lax.cond(
-                        ba[nb_hub] > 0, do_flat, skip_any, pe)
 
-                fails, actives, mcs_all = [fail_f], [act_fl], [mc_f]
-                ba_parts = []
-                prune_new = []
-                for bi in range(nb_hub):
-                    cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
-                    vb = cb.shape[0]
-                    cfg = hub_prune[bi] if bi < len(hub_prune) else None
-                    uncond = (bool(hub_uncond[bi])
-                              if bi < len(hub_uncond) else False)
-
-                    # slice + write-back stay inside the cond: an inert hub
-                    # bucket must cost *nothing* per superstep (module
-                    # docstring invariant), not an O(rows) copy
-                    def do_hub(op, cb=cb, p_b=p_b, row0=row0, vb=vb, bi=bi,
-                               cfg=cfg, uncond=uncond):
-                        acc, ps = op
-                        pk_b = jax.lax.dynamic_slice_in_dim(pe[:v], row0, vb)
-                        new_b, f_b, a_b, m_b, ps2 = _hub_dispatch(
-                            pe, ba[bi], pk_b, cb, p_b, k, v, ps, cfg,
-                            uncond=uncond)
-                        return (jax.lax.dynamic_update_slice_in_dim(
-                            acc, new_b, row0, axis=0), f_b, a_b, m_b, ps2)
-
-                    def skip_hub(op):
-                        acc, ps = op
-                        return (acc, jnp.int32(0), jnp.int32(0),
-                                jnp.int32(-1), ps)
-
-                    if uncond:  # no cond: costs less than the cond would
-                        new_pe, f_b, a_b, m_b, ps2 = do_hub(
-                            (new_pe, prune[bi] if bi < len(prune) else None))
-                    else:
-                        new_pe, f_b, a_b, m_b, ps2 = jax.lax.cond(
-                            ba[bi] > 0, do_hub, skip_hub,
-                            (new_pe, prune[bi] if bi < len(prune) else None))
-                    fails.append(f_b)
-                    actives.append(a_b)
-                    mcs_all.append(m_b)
-                    ba_parts.append(a_b)
-                    prune_new.append(ps2)
-                prune_new = tuple(prune_new)
-                if has_flat:
-                    ba_parts.append(act_fl)
-                ba_new = jnp.stack(ba_parts) if ba_parts else ba
-
-                fail_count = sum(fails)
-                active = sum(actives)
-                mc = jnp.max(jnp.stack(mcs_all))
-                any_fail = fail_count > 0
-                rec5 = recstep(rec5, pe, ba, step, prev_active, stall, mc,
-                               any_fail)
-                stall = jnp.where(active < prev_active, 0, stall + 1)
-                status = status_step(any_fail, active, stall, stall_window)
-                new_pe = jnp.where(any_fail, pe, new_pe)
-                ba_new = jnp.where(any_fail, ba, ba_new)
-                prune_new = jax.tree.map(
-                    lambda a, b: jnp.where(any_fail, a, b), prune, prune_new)
+                ba_new = jnp.stack([act_fl]) if has_flat else ba
+                # sum() over the singleton lists matches the pre-refactor
+                # trace exactly (an add-with-0 op) — keeps the measured
+                # hub-free kernels' lowered HLO byte-identical
+                fail_count = sum([fail_f])
+                active = sum([act_fl])
+                mc = jnp.max(jnp.stack([mc_f]))
+                (rec5, stall, status, new_pe, ba_new,
+                 prune_new) = _superstep_epilogue(
+                    recstep, rec5, pe, ba, prune, new_pe, ba_new, (),
+                    fail_count, active, mc, step, prev_active, stall,
+                    stall_window)
                 return ((new_pe, step + 1, status, active, stall, ba_new)
                         + rec5 + (prune_new,))
 
@@ -995,13 +1242,21 @@ class CompactFrontierEngine(BucketedELLEngine):
             stages = default_stages(v, heavy_tail=arrays.max_degree > cap)
         # a compaction stage's scale must bound the frontier at entry
         # (the previous stage's exit threshold, or V at the start) — a
-        # smaller scale would silently drop active vertices
+        # smaller scale would silently drop active vertices. Thresholds
+        # must be non-increasing: the ladder runs the frontier DOWN, and
+        # the unified pipeline's stage routing (max stage whose entry
+        # bound covers the frontier) is only equivalent to the sequential
+        # per-stage loops under that shape.
         bound = v
         for scale, thresh in stages:
             if scale is not None and scale < min(bound, v):
                 raise ValueError(
                     f"stage scale {scale} < possible frontier {min(bound, v)}; "
                     f"stages={stages}")
+            if thresh > bound:
+                raise ValueError(
+                    f"stage thresholds must be non-increasing, got {thresh} "
+                    f"after {bound}; stages={stages}")
             bound = thresh
         self.stages = stages
 

@@ -58,17 +58,20 @@ def program_complexity(engine: CompactFrontierEngine) -> dict:
     most cond branches and halved the 200k-RMAT compile), so schedule
     decisions should weigh a priced runtime win against the deltas here.
 
-    - ``stage_bodies``: while-loop bodies (full-table phase + one per
-      compaction stage) — the whole pipeline is instantiated once in the
-      phase-carried fused sweep;
+    - ``stage_bodies``: per-stage compiled bodies. Hub-free configs run
+      the sequential pipeline (one while-loop body per stage); heavy-tail
+      configs run the unified pipeline (``compact._unified_pipeline``) —
+      one while loop whose ``lax.switch`` carries one (smaller) flat body
+      per stage plus one recompaction body per compaction stage;
     - ``range_gathers``: Σ width-ranges across stages (one gather + one
       update per range per stage body);
     - ``hub_branches``: Σ compiled control-flow bodies dispatching the
-      hub — per stage body, each conditioned bucket contributes its
-      switch-ladder branches (``_hub_dispatch``: the full branch is
-      dropped when the prune pad covers the bucket), and compaction-stage
-      bodies add the outer do_hub/skip_hub cond pair per conditioned
-      bucket; uncond buckets compile with no control flow and count 0;
+      hub — each conditioned bucket contributes its switch-ladder
+      branches (``_hub_dispatch``: the full branch is dropped when the
+      prune pad covers the bucket) plus the outer do_hub/skip_hub cond
+      pair. Under the unified pipeline this is traced ONCE for the whole
+      program; the sequential pipeline (hub-free, so zero ladders)
+      would multiply it by ``stage_bodies`` — the round-3 compile lever;
     - ``uncond_buckets``: hub buckets compiled with no control flow.
     """
     from dgc_tpu.engine.compact import hub_pad_for
@@ -89,11 +92,14 @@ def program_complexity(engine: CompactFrontierEngine) -> dict:
             ladders.append(5 if cfg[0] >= vb else 6)
     stage_bodies = len(engine.stages)
     compaction_stages = sum(1 for s, _ in engine.stages if s is not None)
+    unified = engine.hub_buckets > 0 and compaction_stages > 0
+    hub_instances = 1 if unified else stage_bodies
     return dict(
-        stage_bodies=stage_bodies,
+        stage_bodies=stage_bodies + (compaction_stages if unified else 0),
         range_gathers=sum(len(r) for r in engine.stage_ranges if r),
-        hub_branches=(sum(ladders) * stage_bodies
-                      + 2 * len(ladders) * compaction_stages),
+        hub_branches=(sum(ladders) * hub_instances
+                      + 2 * len(ladders) * (1 if unified
+                                            else compaction_stages)),
         uncond_buckets=sum(1 for bi in range(engine.hub_buckets)
                            if bi < len(engine.hub_uncond)
                            and engine.hub_uncond[bi]),

@@ -654,3 +654,24 @@ def test_compact_parity_with_reference_sim(small_graphs):
             validate=make_validator(g)).minimal_colors
         assert a is not None and b is not None
         assert abs(a - b) <= 1, (a, b)
+
+
+def test_early_final_threshold_stalls_both_pipelines():
+    # a forced ladder whose FINAL stage stops at a nonzero threshold must
+    # not finish the coloring: both pipeline variants (sequential =
+    # hub-free, unified = hub > 0) exit with the frontier unfinished and
+    # report STALLED — the unified loop's exit condition gates on the last
+    # stage's run-down threshold, not on active == 0
+    g = generate_random_graph(600, 6, seed=11)
+    stages = ((None, 300), (512, 50))  # never runs below 50 actives
+    seq = CompactFrontierEngine(g, stages=stages)
+    assert seq.hub_buckets == 0
+    r_seq = seq.attempt(g.max_degree + 1)
+    assert r_seq.status == AttemptStatus.STALLED
+
+    gh = generate_rmat_graph(600, 6, seed=11, native=False)
+    uni = CompactFrontierEngine(gh, flat_cap=4, prune_u_min=8,
+                                hub_uncond_entries=0, stages=stages)
+    assert uni.hub_buckets > 0
+    r_uni = uni.attempt(gh.max_degree + 1)
+    assert r_uni.status == AttemptStatus.STALLED

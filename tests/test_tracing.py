@@ -125,19 +125,21 @@ def test_program_complexity_counts():
     stages = ((None, n // 2), (_pow2_ceil(n // 2), 0))  # 1 full + 1 compaction
     kw = dict(flat_cap=4, prune_u_min=8, hub_uncond_entries=0, stages=stages)
 
-    # tier-2 cfg: P=32 < rows=48 keeps the full branch -> 6-branch ladder;
-    # hub_branches = 6 ladder x 2 stage bodies + outer cond pair x 1
-    # compaction stage = 14
+    # tier-2 cfg: P=32 < rows=48 keeps the full branch -> 6-branch ladder.
+    # hub > 0 with compaction stages runs the UNIFIED pipeline: the ladder
+    # is traced once (+ one outer cond pair), and stage_bodies counts the
+    # switch's per-stage flat bodies plus one transition body per
+    # compaction stage: 2 + 1 = 3.
     eng = CompactFrontierEngine(g, prune_p2_min=4, **kw)
     assert eng.hub_buckets == 1 and len(eng.hub_prune[0]) == 3
     c = program_complexity(eng)
-    assert c["stage_bodies"] == 2 and c["uncond_buckets"] == 0
-    assert c["hub_branches"] == 6 * 2 + 2 * 1 * 1
+    assert c["stage_bodies"] == 3 and c["uncond_buckets"] == 0
+    assert c["hub_branches"] == 6 * 1 + 2 * 1
 
-    # len-2 cfg (tier-2 disabled): 4-branch ladder -> 4*2 + 2 = 10
+    # len-2 cfg (tier-2 disabled): 4-branch ladder -> 4*1 + 2 = 6
     eng2 = CompactFrontierEngine(g, prune_p2_min=1 << 20, **kw)
     assert len(eng2.hub_prune[0]) == 2
-    assert program_complexity(eng2)["hub_branches"] == 4 * 2 + 2 * 1 * 1
+    assert program_complexity(eng2)["hub_branches"] == 4 * 1 + 2 * 1
 
     # unconditioned bucket: no control flow at all
     eng3 = CompactFrontierEngine(g, flat_cap=4, prune_u_min=8,
