@@ -675,3 +675,43 @@ def test_early_final_threshold_stalls_both_pipelines():
     assert uni.hub_buckets > 0
     r_uni = uni.attempt(gh.max_degree + 1)
     assert r_uni.status == AttemptStatus.STALLED
+
+
+def test_unified_pipeline_matches_sequential_hub_free():
+    # drift guard between the two pipeline variants: force the UNIFIED
+    # pipeline onto a hub-free staged config (where the engine dispatches
+    # to the sequential per-stage loops) and require bit-identical
+    # (pe, steps, status). This pins exactly the contract the automatic
+    # dispatch can never exercise: same stage routing, same recompaction
+    # snapshots, same epilogue — on a graph both variants can run.
+    import jax
+
+    from dgc_tpu.engine.compact import (
+        _default_init,
+        _empty_rec,
+        _staged_pipeline,
+        _unified_pipeline,
+    )
+
+    g = generate_random_graph(1200, 8, seed=23)
+    eng = _forced_compact(g)
+    assert eng.hub_buckets == 0
+    kw = eng._kernel_kw()
+    k = g.max_degree + 1
+
+    def run(pipeline):
+        def fn(buckets, flat_ext, degrees, kk):
+            init = _default_init(degrees, kw["init_bucket_active"])
+            rec = _empty_rec(degrees.shape[0],
+                             len(kw["init_bucket_active"]), dummy=True)
+            pe, steps, status, _ = pipeline(
+                buckets, flat_ext, degrees, kk, init, rec, False, **kw)
+            return pe, steps, status
+        return jax.jit(fn)(tuple(eng.combined_buckets), eng.flat_ext,
+                           eng.degrees, k)
+
+    pe_s, steps_s, status_s = map(np.asarray, run(_staged_pipeline))
+    pe_u, steps_u, status_u = map(np.asarray, run(_unified_pipeline))
+    assert int(status_s) == int(status_u)
+    assert int(steps_s) == int(steps_u)
+    assert np.array_equal(pe_s, pe_u)
