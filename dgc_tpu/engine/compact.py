@@ -54,6 +54,14 @@ attempt kernel executes, inside one ``jax.jit``:
    tables and bit-identical results (each range's color window covers its
    width, so first-fit and failure detection stay exact per row).
 
+Heavy-tail (hub > 0) configs execute the staged schedule as ONE unified
+``while_loop`` dispatching per-stage flat bodies over a ``lax.switch``
+(``_unified_pipeline``) so the hub machinery traces once instead of once
+per stage body — 3-4× smaller compiled programs at the RMAT bench
+configs (PERF.md "Compile time"); hub-free configs keep the sequential
+per-stage loops and lower byte-identically to the measured headline
+kernel.
+
 Compaction and skipping are *exact*: a confirmed vertex can never become
 active again (demotion only applies to fresh vertices, and confirm/demote
 both read the same per-superstep snapshot), so the frontier is monotone
@@ -632,15 +640,32 @@ def _make_recstep(record):
     return recstep
 
 
+def restore_from_ring(rec, k, first, pe_i, ba_i, step_i, stall_i, act_i):
+    """Prefix-resume bracket restore, shared by the single-device sweep and
+    the sharded engines' port (``fused.device_sweep_pair_resumable``) so
+    the bracket predicate and meta layout cannot drift: overwrite the
+    scratch carry head with the ring entry whose (m_old, m_new] bracket
+    contains ``k`` (phase 1 only; a miss leaves the scratch start)."""
+    rpe, rba, rmeta, cnt, _ = rec
+    for j in range(_REC_SLOTS):
+        ok = (~first) & (j < cnt) & (rmeta[j, 1] < k) & (k <= rmeta[j, 2])
+        pe_i = jnp.where(ok, rpe[j], pe_i)
+        ba_i = jnp.where(ok, rba[j], ba_i)
+        step_i = jnp.where(ok, rmeta[j, 0], step_i)
+        stall_i = jnp.where(ok, rmeta[j, 3], stall_i)
+        act_i = jnp.where(ok, rmeta[j, 4], act_i)
+    return pe_i, ba_i, step_i, stall_i, act_i
+
+
 def _superstep_epilogue(recstep, rec5, pe, ba, prune, new_pe, ba_new,
-                        prune_new, fail_count, active, mc, step,
+                        prune_new, any_fail, active, mc, step,
                         prev_active, stall, stall_window):
     """Shared tail of every pipeline superstep body (one definition so the
     fail-revert ordering, stall accounting, and rec-ring push cannot drift
-    between the sequential and unified pipelines): push the rec ring,
-    advance stall/status, and revert state on a failed superstep. Returns
+    between the sequential/unified pipelines and the sharded engines'
+    ports, ``fused.shard_superstep_epilogue``): push the rec ring, advance
+    stall/status, and revert state on a failed superstep. Returns
     (rec5, stall, status, new_pe, ba_new, prune_new)."""
-    any_fail = fail_count > 0
     rec5 = recstep(rec5, pe, ba, step, prev_active, stall, mc, any_fail)
     stall = jnp.where(active < prev_active, 0, stall + 1)
     status = status_step(any_fail, active, stall, stall_window)
@@ -894,9 +919,10 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
         fail_count = sum([fail_f] + h_fails)
         active = sum([act_fl] + h_actives)
         mc = jnp.max(jnp.stack([mc_f] + h_mcs))
+        any_fail = fail_count > 0
         rec5, stall, status, new_pe, ba_new, prune_new = _superstep_epilogue(
             recstep, rec5, pe, ba, prune, new_pe, ba_new, prune_new,
-            fail_count, active, mc, step, prev_active, stall, stall_window)
+            any_fail, active, mc, step, prev_active, stall, stall_window)
         return ((new_pe, step + 1, status, active, stall, ba_new)
                 + rec5 + (prune_new, stage_idx, comb_c, gidx))
 
@@ -980,10 +1006,11 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                 new_pe, fail_count, active, ba_new, mc, prune_new = (
                     _hybrid_superstep(pe, ba, buckets, row0s, k, planes, v,
                                       nb_hub, prune, hub_prune, hub_uncond))
+                any_fail = fail_count > 0
                 (rec5, stall, status, new_pe, ba_new,
                  prune_new) = _superstep_epilogue(
                     recstep, rec5, pe, ba, prune, new_pe, ba_new, prune_new,
-                    fail_count, active, mc, step, prev_active, stall,
+                    any_fail, active, mc, step, prev_active, stall,
                     stall_window)
                 return ((new_pe, step + 1, status, active, stall, ba_new)
                         + rec5 + (prune_new,))
@@ -1071,10 +1098,11 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                 fail_count = sum([fail_f])
                 active = sum([act_fl])
                 mc = jnp.max(jnp.stack([mc_f]))
+                any_fail = fail_count > 0
                 (rec5, stall, status, new_pe, ba_new,
                  prune_new) = _superstep_epilogue(
                     recstep, rec5, pe, ba, prune, new_pe, ba_new, (),
-                    fail_count, active, mc, step, prev_active, stall,
+                    any_fail, active, mc, step, prev_active, stall,
                     stall_window)
                 return ((new_pe, step + 1, status, active, stall, ba_new)
                         + rec5 + (prune_new,))
@@ -1167,14 +1195,8 @@ def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
         # whose (m_old, m_new] bracket contains k (= k2), if still present
         pe_i, step_i, act_i, stall_i, ba_i = _default_init(
             degrees, init_bucket_active)
-        rpe, rba, rmeta, cnt, _ = rec
-        for j in range(_REC_SLOTS):
-            ok = (~first) & (j < cnt) & (rmeta[j, 1] < k) & (k <= rmeta[j, 2])
-            pe_i = jnp.where(ok, rpe[j], pe_i)
-            ba_i = jnp.where(ok, rba[j], ba_i)
-            step_i = jnp.where(ok, rmeta[j, 0], step_i)
-            stall_i = jnp.where(ok, rmeta[j, 3], stall_i)
-            act_i = jnp.where(ok, rmeta[j, 4], act_i)
+        pe_i, ba_i, step_i, stall_i, act_i = restore_from_ring(
+            rec, k, first, pe_i, ba_i, step_i, stall_i, act_i)
 
         pe, steps, status, rec = _staged_pipeline(
             *args, k, (pe_i, step_i, act_i, stall_i, ba_i), rec, first, **kw)

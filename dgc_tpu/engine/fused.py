@@ -30,7 +30,7 @@ def cached_shard_kernel(engine, body, name: str, window_key, in_specs,
     """(name, window_key)-cached ``jit(shard_map(body))`` with the shared
     out_specs convention: an ``attempt`` kernel returns (colors, steps,
     status); a ``sweep`` kernel returns that twice around the shard-invariant
-    ``used`` scalar (``device_sweep_pair``). One builder for every sharded
+    ``used`` scalar (``device_sweep_pair_resumable``). One builder for every sharded
     engine so the convention can't silently diverge per engine; the cache
     lives on ``engine._kernels`` and is evicted by the widen step."""
     key = (name, window_key)
@@ -62,29 +62,128 @@ def run_windowed(run: Callable, widen: Callable[[], bool], status_index=-1):
         return outs, status
 
 
-def device_sweep_pair(attempt_fn: Callable, k0, axis: str):
-    """Trace the fused pair inside a shard_map body.
+def shard_rec_empty(v_local: int, dummy: bool = False):
+    """Per-shard prefix-resume ring in ``compact._empty_rec``'s layout —
+    (ring_state, ring_ba, ring_meta, count, best) with a 1-wide dummy ``ba``
+    ring (the sharded engines carry no bucket-active vector; keeping the
+    single-device ring layout lets the push/bracket logic stay
+    single-sourced through ``compact._make_recstep``, whose slot count
+    ``_REC_SLOTS`` this ring must match). ``dummy=True`` gives 1-wide state
+    rings for kernels that statically never record."""
+    from dgc_tpu.engine.compact import _REC_SLOTS
 
-    ``attempt_fn(k) -> (colors_l, steps, status)`` is the engine's per-shard
-    k-attempt. Returns ``(colors1_l, steps1, status1, used, colors2_l,
-    steps2, status2)``; ``used`` is shard-invariant (``pmax`` over ``axis``),
-    so the ``cond`` control flow cannot diverge across shards. The second
-    triple echoes a skipped confirm as (colors1, 0, FAILURE) — the host
-    epilogue replaces it.
+    w = 1 if dummy else v_local
+    return (jnp.zeros((_REC_SLOTS, w), jnp.int32),
+            jnp.zeros((_REC_SLOTS, 1), jnp.int32),
+            jnp.full((_REC_SLOTS, 5), -1, jnp.int32),
+            jnp.int32(0), jnp.int32(-1))
+
+
+def shard_superstep_epilogue(recstep, rec5, packed_l, new_packed_l, prune,
+                             prune_new, any_fail, active, mc, step,
+                             prev_active, stall, stall_window: int,
+                             max_steps: int):
+    """Shared tail of every sharded pipeline superstep: delegates to the
+    single-device ``compact._superstep_epilogue`` (rec-ring push →
+    stall/status → fail revert, one definition so the ordering cannot
+    drift across the four pipelines) with the ring layout's dummy ``ba``
+    slot, then applies the sharded engines' max-steps STALLED clamp.
+    Returns (rec5, stall, status, new_packed_l, prune_new)."""
+    from dgc_tpu.engine.base import AttemptStatus
+    from dgc_tpu.engine.compact import _superstep_epilogue
+
+    ba_dummy = jnp.zeros((1,), jnp.int32)
+    rec5, stall, status, new_packed_l, _, prune_new = _superstep_epilogue(
+        recstep, rec5, packed_l, ba_dummy, prune, new_packed_l, ba_dummy,
+        prune_new, any_fail, active, mc, step, prev_active, stall,
+        stall_window)
+    status = jnp.where(
+        (status == AttemptStatus.RUNNING) & (step + 1 >= max_steps),
+        AttemptStatus.STALLED, status).astype(jnp.int32)
+    return rec5, stall, status, new_packed_l, prune_new
+
+
+def device_sweep_pair_resumable(pipeline_fn: Callable,
+                                default_init_fn: Callable, k0, axis: str,
+                                v_local: int):
+    """Phase-carried fused pair with prefix-resume — the multi-chip port of
+    ``compact._sweep_kernel_staged``'s machinery, shared by the sharded
+    engines.
+
+    ``pipeline_fn(k, init, rec, record) -> (packed_l, steps, status, rec)``
+    is the engine's per-shard k-attempt in resumable form: ``init`` is the
+    carry head ``(packed_l, step, active, stall)``, ``rec`` the per-shard
+    resume ring (``shard_rec_empty`` layout), ``record`` a traced bool.
+    ``default_init_fn() -> init`` builds the scratch start.
+
+    Both attempts run as ONE ``while_loop`` whose body is a single
+    ``pipeline_fn`` instance (the pipeline is traced once, not twice — the
+    same compile-size halving as the single-device sweep), and the confirm
+    attempt at k2 = used−1 fast-forwards past the prefix it shares with
+    attempt 1: the pipeline pushes the pre-state of each new-max-candidate
+    superstep into the ring (the push decision derives from pmax/psum'd
+    scalars, so every shard pushes at the same rounds and the per-shard
+    ring slices assemble a consistent global state), and phase 1 resumes
+    from the ring entry whose (m_old, m_new] bracket contains k2 — its
+    steps counter continues from the snapshot, so steps/status/colors all
+    match a scratch confirm exactly. A ring miss falls back to scratch.
+    Pruned-capture state is deliberately not recorded (fresh per phase):
+    the prune branches are schedule, not values, so the resumed run stays
+    bit-identical while captures rebuild.
+
+    Returns the sweep kernels' shared 7-tuple; shard-uniform control flow for
+    the same reason (``used``/statuses are pmax/psum-derived).
     """
-    colors1_l, steps1, status1 = attempt_fn(k0)
-    used = jax.lax.pmax(jnp.max(colors1_l, initial=-1), axis) + 1
-    k2 = used - 1
+    packed0, step0, act0, stall0 = default_init_fn()
+    zeros_l = jnp.zeros_like(packed0)
+    z = jnp.int32(0)
+    rec0 = shard_rec_empty(v_local)
+    init = (z, jnp.asarray(k0, jnp.int32),
+            zeros_l, z, z,                       # slot 1: packed1, steps1, status1
+            z,                                   # used
+            zeros_l, z, jnp.int32(_FAILURE)) + rec0  # slot 2 (skip default)
 
-    def second(_):
-        return attempt_fn(k2)
+    def cond(c):
+        return c[0] < 2
 
-    def skip(_):
-        return colors1_l, jnp.int32(0), jnp.int32(_FAILURE)
+    def body(c):
+        phase, k, p1, steps1, status1, used, p2, steps2, status2 = c[:9]
+        rec = c[9:]
+        first = phase == 0
 
-    run2 = (status1 == _SUCCESS) & (k2 >= 1)
-    colors2_l, steps2, status2 = jax.lax.cond(run2, second, skip, 0)
-    return colors1_l, steps1, status1, used, colors2_l, steps2, status2
+        from dgc_tpu.engine.compact import restore_from_ring
+
+        packed_i, step_i, act_i, stall_i = default_init_fn()
+        packed_i, _, step_i, stall_i, act_i = restore_from_ring(
+            rec, k, first, packed_i, jnp.zeros((1,), jnp.int32), step_i,
+            stall_i, act_i)
+
+        packed_l, steps, status, rec = pipeline_fn(
+            k, (packed_i, step_i, act_i, stall_i), rec, first)
+        colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1)
+        used_new = jnp.where(
+            first,
+            jax.lax.pmax(jnp.max(colors_l, initial=-1), axis) + 1,
+            used)
+        k2 = used_new - 1
+        run2 = first & (status == _SUCCESS) & (k2 >= 1)
+        sel = lambda a, b: jnp.where(first, a, b)
+        return (
+            jnp.where(run2, 1, 2).astype(jnp.int32),
+            jnp.where(run2, k2, k).astype(jnp.int32),
+            sel(packed_l, p1), sel(steps, steps1), sel(status, status1),
+            used_new,
+            # slot 2: phase 1 stores its result; phase 0 echoes attempt 1
+            # (the skipped-confirm contract; host fabricates k=0 FAILURE)
+            packed_l, jnp.where(first, z, steps),
+            jnp.where(first, jnp.int32(_FAILURE), status),
+        ) + tuple(rec)
+
+    out = jax.lax.while_loop(cond, body, init)
+    _, _, p1, steps1, status1, used, p2, steps2, status2 = out[:9]
+    c1 = jnp.where(p1 >= 0, p1 >> 1, -1).astype(jnp.int32)
+    c2 = jnp.where(p2 >= 0, p2 >> 1, -1).astype(jnp.int32)
+    return c1, steps1, status1, used, c2, steps2, status2
 
 
 def finish_sweep_pair(

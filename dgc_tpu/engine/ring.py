@@ -15,7 +15,7 @@ each local vertex, the block-local ids of its neighbors owned by the shard
 ``(me − r) mod n`` — exactly the block held after r ring rotations. The
 gather→reduce per rotation uses ``ops.speculative.neighbor_stats``, whose
 outputs OR-combine across rotations; the final transition is the shared
-``apply_update``, so results are bit-identical to the all-gather and
+``apply_update_mc``, so results are bit-identical to the all-gather and
 single-device engines on the same graph.
 
 Reference mapping: replaces ``collectAsMap`` + ``sc.broadcast`` of the full
@@ -39,14 +39,15 @@ from dgc_tpu.engine.base import (
 )
 from dgc_tpu.engine.fused import (
     cached_shard_kernel,
-    device_sweep_pair,
+    device_sweep_pair_resumable,
     finish_sweep_pair,
     run_windowed,
+    shard_rec_empty,
+    shard_superstep_epilogue,
 )
-from dgc_tpu.engine.bucketed import status_step
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import num_planes_for
-from dgc_tpu.ops.speculative import apply_update, beats_rule, neighbor_stats
+from dgc_tpu.ops.speculative import apply_update_mc, beats_rule, neighbor_stats
 from dgc_tpu.parallel.mesh import (
     VERTEX_AXIS,
     fetch_global,
@@ -211,42 +212,63 @@ def build_bucketed_rotation_tables(arrays: GraphArrays, n: int,
     return v_pad, vl, rot_buckets
 
 
-def _ring_drive(superstep, deg_l, n: int, max_steps: int,
-                stall_window: int = 64):
-    """Shared while-loop driver for both ring table layouts: carry layout,
-    stall/status transitions, max-steps STALLED clamp, and fail rollback
-    live here once so the flat and bucketed kernels cannot drift.
-    ``superstep(packed_l) -> (new_packed_l, any_fail, active)``."""
+def _ring_default_init(deg_l, n: int):
+    """Scratch carry head: isolated vertices pre-confirm to color 0."""
     vl = deg_l.shape[0]
     packed0_l = jnp.where(deg_l == 0, 0, -1).astype(jnp.int32)
+    return (packed0_l, jnp.int32(0), jnp.int32(n * vl + 1), jnp.int32(0))
+
+
+def _ring_drive(superstep, deg_l, n: int, max_steps: int,
+                stall_window: int = 64, init=None, rec=None, record=False):
+    """Shared while-loop driver for both ring table layouts: carry layout,
+    stall/status transitions, max-steps STALLED clamp, fail rollback, and
+    the prefix-resume ring push live here once so the flat and bucketed
+    kernels cannot drift. ``superstep(packed_l) -> (new_packed_l,
+    any_fail, active, mc)`` (mc pmax'd by the superstep). ``init``/
+    ``rec``/``record`` follow ``fused.device_sweep_pair_resumable``'s
+    pipeline contract; None means scratch / a statically-dead dummy ring.
+    Returns (packed_l, steps, status, rec)."""
+    from dgc_tpu.engine.compact import _make_recstep
+
+    vl = deg_l.shape[0]
+    if init is None:
+        init = _ring_default_init(deg_l, n)
+    if rec is None:
+        rec = shard_rec_empty(vl, dummy=True)
+    recstep = _make_recstep(record)
 
     def cond(carry):
-        _, _, status, _, _ = carry
-        return status == _RUNNING
+        return carry[2] == _RUNNING
 
     def body(carry):
-        packed_l, step, status, prev_active, stall = carry
-        new_packed_l, any_fail, active = superstep(packed_l)
-        stall = jnp.where(active < prev_active, 0, stall + 1)
-        status = status_step(any_fail, active, stall, stall_window)
-        status = jnp.where(
-            (status == _RUNNING) & (step + 1 >= max_steps), _STALLED, status
-        ).astype(jnp.int32)
-        new_packed_l = jnp.where(any_fail, packed_l, new_packed_l)
-        return (new_packed_l, step + 1, status, active, stall)
+        packed_l, step, status, prev_active, stall = carry[:5]
+        rec5 = carry[5:10]
+        new_packed_l, any_fail, active, mc = superstep(packed_l)
+        rec5, stall, status, new_packed_l, _ = shard_superstep_epilogue(
+            recstep, rec5, packed_l, new_packed_l, (), (), any_fail,
+            active, mc, step, prev_active, stall, stall_window, max_steps)
+        return (new_packed_l, step + 1, status, active, stall) + rec5
 
-    packed_l, steps, status, _, _ = jax.lax.while_loop(
+    out = jax.lax.while_loop(
         cond, body,
-        (packed0_l, jnp.int32(0), jnp.int32(_RUNNING),
-         jnp.int32(n * vl + 1), jnp.int32(0)),
+        (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3])
+        + tuple(rec),
     )
+    return out[0], out[1], out[2], tuple(out[5:10])
+
+
+def _drive_colors(drive_out):
+    """Plain-attempt epilogue: decode (colors_l, steps, status)."""
+    packed_l, steps, status, _ = drive_out
     colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
     return colors_l, steps, status
 
 
 def _ring_attempt(deg_l, tables_l, beats_l, k, num_planes: int,
                   max_degree: int, max_steps: int, n: int,
-                  stall_window: int = 64):
+                  stall_window: int = 64, init=None, rec=None,
+                  record=False):
     """One k-attempt on a shard. tables_l[r]: int32[vl, W_r] block-local
     neighbor ids for rotation r (sentinel = vl); deg_l: int32[vl].
 
@@ -278,20 +300,22 @@ def _ring_attempt(deg_l, tables_l, beats_l, k, num_planes: int,
             clash |= cl
             if r + 1 < n:
                 block = jax.lax.ppermute(block, VERTEX_AXIS, perm)
-        new_packed_l, fail_mask, active_mask = apply_update(
+        new_packed_l, fail_mask, active_mask, mc_l = apply_update_mc(
             packed_l, forb_all, forb_old, clash, k
         )
         fail_count = jax.lax.psum(jnp.sum(fail_mask.astype(jnp.int32)), VERTEX_AXIS)
         any_fail = (fail_count > 0) & fail_valid
         active = jax.lax.psum(jnp.sum(active_mask.astype(jnp.int32)), VERTEX_AXIS)
-        return new_packed_l, any_fail, active
+        return new_packed_l, any_fail, active, jax.lax.pmax(mc_l, VERTEX_AXIS)
 
-    return _ring_drive(superstep, deg_l, n, max_steps, stall_window)
+    return _ring_drive(superstep, deg_l, n, max_steps, stall_window,
+                       init=init, rec=rec, record=record)
 
 
 def _ring_attempt_bucketed(deg_l, rot_buckets_l, k, num_planes: int,
                            max_degree: int, max_steps: int, n: int,
-                           stall_window: int = 64):
+                           stall_window: int = 64, init=None, rec=None,
+                           record=False):
     """``_ring_attempt`` over degree-bucketed rotation tables.
 
     ``rot_buckets_l[r]`` is a tuple of ``(rows, comb)`` per-shard slices
@@ -335,45 +359,52 @@ def _ring_attempt_bucketed(deg_l, rot_buckets_l, k, num_planes: int,
                 clash = clash.at[rows].set(clash[rs] | cl, mode="drop")
             if r + 1 < n:
                 block = jax.lax.ppermute(block, VERTEX_AXIS, perm)
-        new_packed_l, fail_mask, active_mask = apply_update(
+        new_packed_l, fail_mask, active_mask, mc_l = apply_update_mc(
             packed_l, forb_all, forb_old, clash, k
         )
         fail_count = jax.lax.psum(jnp.sum(fail_mask.astype(jnp.int32)), VERTEX_AXIS)
         any_fail = (fail_count > 0) & fail_valid
         active = jax.lax.psum(jnp.sum(active_mask.astype(jnp.int32)), VERTEX_AXIS)
-        return new_packed_l, any_fail, active
+        return new_packed_l, any_fail, active, jax.lax.pmax(mc_l, VERTEX_AXIS)
 
-    return _ring_drive(superstep, deg_l, n, max_steps, stall_window)
+    return _ring_drive(superstep, deg_l, n, max_steps, stall_window,
+                       init=init, rec=rec, record=record)
 
 
 def _ring_attempt_bucketed_body(deg_l, rot_buckets_l, k, *, num_planes: int,
                                 max_degree: int, max_steps: int, n: int):
-    return _ring_attempt_bucketed(deg_l, rot_buckets_l, k, num_planes,
-                                  max_degree, max_steps, n)
+    return _drive_colors(_ring_attempt_bucketed(
+        deg_l, rot_buckets_l, k, num_planes, max_degree, max_steps, n))
 
 
 def _ring_sweep_bucketed_body(deg_l, rot_buckets_l, k0, *, num_planes: int,
                               max_degree: int, max_steps: int, n: int):
-    return device_sweep_pair(
-        lambda k: _ring_attempt_bucketed(deg_l, rot_buckets_l, k, num_planes,
-                                         max_degree, max_steps, n),
-        k0, VERTEX_AXIS,
+    return device_sweep_pair_resumable(
+        lambda k, init, rec, record: _ring_attempt_bucketed(
+            deg_l, rot_buckets_l, k, num_planes, max_degree, max_steps, n,
+            init=init, rec=rec, record=record),
+        lambda: _ring_default_init(deg_l, n),
+        k0, VERTEX_AXIS, deg_l.shape[0],
     )
 
 
 def _ring_attempt_body(deg_l, tables_l, beats_l, k, *, num_planes: int,
                        max_degree: int, max_steps: int, n: int):
-    return _ring_attempt(deg_l, tables_l, beats_l, k, num_planes,
-                         max_degree, max_steps, n)
+    return _drive_colors(_ring_attempt(deg_l, tables_l, beats_l, k,
+                                       num_planes, max_degree, max_steps, n))
 
 
 def _ring_sweep_body(deg_l, tables_l, beats_l, k0, *, num_planes: int,
                      max_degree: int, max_steps: int, n: int):
-    """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call."""
-    return device_sweep_pair(
-        lambda k: _ring_attempt(deg_l, tables_l, beats_l, k, num_planes,
-                                max_degree, max_steps, n),
-        k0, VERTEX_AXIS,
+    """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call —
+    phase-carried with prefix-resume (the pipeline traces once; the
+    confirm fast-forwards past the shared prefix)."""
+    return device_sweep_pair_resumable(
+        lambda k, init, rec, record: _ring_attempt(
+            deg_l, tables_l, beats_l, k, num_planes, max_degree, max_steps,
+            n, init=init, rec=rec, record=record),
+        lambda: _ring_default_init(deg_l, n),
+        k0, VERTEX_AXIS, deg_l.shape[0],
     )
 
 

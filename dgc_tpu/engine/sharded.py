@@ -43,14 +43,15 @@ from dgc_tpu.engine.base import (
 )
 from dgc_tpu.engine.fused import (
     cached_shard_kernel,
-    device_sweep_pair,
+    device_sweep_pair_resumable,
     finish_sweep_pair,
     run_windowed,
+    shard_rec_empty,
+    shard_superstep_epilogue,
 )
-from dgc_tpu.engine.bucketed import status_step
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import num_planes_for
-from dgc_tpu.ops.speculative import beats_rule, speculative_update
+from dgc_tpu.ops.speculative import beats_rule, speculative_update_mc
 from dgc_tpu.parallel.mesh import (
     VERTEX_AXIS,
     fetch_global,
@@ -61,37 +62,45 @@ from dgc_tpu.parallel.mesh import (
 
 def _shard_superstep(packed_l, nbrs_l, pre_beats, k, num_planes: int):
     """One speculative superstep on a shard: all_gather the packed state,
-    apply the shared core, psum the fail/active masks."""
+    apply the shared core, psum the fail/active masks (and pmax the
+    divergence candidate ``mc`` for the prefix-resume record rule)."""
     packed_g = jax.lax.all_gather(packed_l, VERTEX_AXIS, tiled=True)
     packed_pad = jnp.concatenate([packed_g, jnp.array([-1], jnp.int32)])
     np_ = packed_pad[nbrs_l]
-    new_packed_l, fail_mask, active_mask = speculative_update(
+    new_packed_l, fail_mask, active_mask, mc_l = speculative_update_mc(
         packed_l, np_, pre_beats, k, num_planes
     )
     any_fail = jax.lax.psum(jnp.sum(fail_mask.astype(jnp.int32)), VERTEX_AXIS) > 0
     active = jax.lax.psum(jnp.sum(active_mask.astype(jnp.int32)), VERTEX_AXIS)
-    return new_packed_l, any_fail, active
+    mc = jax.lax.pmax(mc_l, VERTEX_AXIS)
+    return new_packed_l, any_fail, active, mc
 
 _RUNNING = AttemptStatus.RUNNING
 _STALLED = AttemptStatus.STALLED
 
 
-def _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes: int, max_degree: int,
-                  max_steps: int, stall_window: int = 64):
-    """One k-attempt on a shard. nbrs_l: int32[Vl, W] with *global*
-    neighbor ids (sentinel = V_padded); deg_l: int32[Vl]; deg_g: int32[V].
+def _flat_pipeline(nbrs_l, deg_l, deg_g, k, init, rec, record,
+                   num_planes: int, max_degree: int, max_steps: int,
+                   stall_window: int = 64):
+    """One k-attempt on a shard in resumable form (carry head ``init`` =
+    (packed_l, step, active, stall); ``rec``/``record`` per
+    ``fused.device_sweep_pair_resumable``). nbrs_l: int32[Vl, W] with
+    *global* neighbor ids (sentinel = V_padded); deg_l: int32[Vl];
+    deg_g: int32[V].
 
     ``num_planes`` may be a *capped* color window (< Δ+1 colors): the
     failure flag is then suppressed unless ``k`` fits the window, so a
     capped window can never assert a wrong FAILURE — a starved attempt
     stops making progress, trips the stall counter, and exits STALLED for
-    the engine to widen the window and retry (the ``bucketed`` contract)."""
+    the engine to widen the window and retry (the ``bucketed`` contract).
+    Returns (packed_l, steps, status, rec)."""
+    from dgc_tpu.engine.compact import _make_recstep
+
     vl, w = nbrs_l.shape
     shard = jax.lax.axis_index(VERTEX_AXIS)
     my_ids = (shard * vl + jnp.arange(vl, dtype=jnp.int32)).astype(jnp.int32)
     k = jnp.asarray(k, jnp.int32)
 
-    packed0_l = jnp.where(deg_l == 0, 0, -1).astype(jnp.int32)
     fail_exact = 32 * num_planes >= max_degree + 1
     fail_valid = fail_exact | (k <= 32 * num_planes)
 
@@ -101,30 +110,45 @@ def _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes: int, max_degree: int,
     my_deg = deg_l[:, None]
     pre_beats = beats_rule(n_deg, nbrs_l, my_deg, my_ids[:, None])
 
+    recstep = _make_recstep(record)
+
     def cond(carry):
-        _, _, status, _, _ = carry
-        return status == _RUNNING
+        return carry[2] == _RUNNING
 
     def body(carry):
-        packed_l, step, status, prev_active, stall = carry
-        new_packed_l, any_fail, active = _shard_superstep(
+        packed_l, step, status, prev_active, stall = carry[:5]
+        rec5 = carry[5:10]
+        new_packed_l, any_fail, active, mc = _shard_superstep(
             packed_l, nbrs_l, pre_beats, k, num_planes
         )
         any_fail = any_fail & fail_valid
-        stall = jnp.where(active < prev_active, 0, stall + 1)
-        status = status_step(any_fail, active, stall, stall_window)
-        status = jnp.where(
-            (status == _RUNNING) & (step + 1 >= max_steps), _STALLED, status
-        ).astype(jnp.int32)
-        new_packed_l = jnp.where(any_fail, packed_l, new_packed_l)
-        return (new_packed_l, step + 1, status, active, stall)
+        rec5, stall, status, new_packed_l, _ = shard_superstep_epilogue(
+            recstep, rec5, packed_l, new_packed_l, (), (), any_fail,
+            active, mc, step, prev_active, stall, stall_window, max_steps)
+        return (new_packed_l, step + 1, status, active, stall) + rec5
 
-    packed_l, steps, status, _, _ = jax.lax.while_loop(
+    out = jax.lax.while_loop(
         cond, body,
-        (packed0_l, jnp.int32(0), jnp.int32(_RUNNING),
-         jnp.int32(nbrs_l.shape[0] * jax.lax.psum(1, VERTEX_AXIS) + 1),
-         jnp.int32(0)),
+        (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3])
+        + tuple(rec),
     )
+    return out[0], out[1], out[2], tuple(out[5:10])
+
+
+def _flat_default_init(nbrs_l, deg_l):
+    """Scratch carry head: isolated vertices pre-confirm to color 0."""
+    packed0_l = jnp.where(deg_l == 0, 0, -1).astype(jnp.int32)
+    v_pad = nbrs_l.shape[0] * jax.lax.psum(1, VERTEX_AXIS)
+    return (packed0_l, jnp.int32(0), jnp.int32(v_pad + 1), jnp.int32(0))
+
+
+def _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes: int, max_degree: int,
+                  max_steps: int, stall_window: int = 64):
+    """Plain k-attempt (no recording): (colors_l, steps, status)."""
+    rec = shard_rec_empty(deg_l.shape[0], dummy=True)
+    packed_l, steps, status, _ = _flat_pipeline(
+        nbrs_l, deg_l, deg_g, k, _flat_default_init(nbrs_l, deg_l), rec,
+        False, num_planes, max_degree, max_steps, stall_window=stall_window)
     colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
     return colors_l, steps, status
 
@@ -137,11 +161,15 @@ def _flat_attempt_body(nbrs_l, deg_l, deg_g, k, *, num_planes: int,
 
 def _flat_sweep_body(nbrs_l, deg_l, deg_g, k0, *, num_planes: int,
                      max_degree: int, max_steps: int):
-    """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call."""
-    return device_sweep_pair(
-        lambda k: _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes,
-                                max_degree, max_steps),
-        k0, VERTEX_AXIS,
+    """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call —
+    phase-carried with prefix-resume (the pipeline traces once; the
+    confirm fast-forwards past the shared prefix)."""
+    return device_sweep_pair_resumable(
+        lambda k, init, rec, record: _flat_pipeline(
+            nbrs_l, deg_l, deg_g, k, init, rec, record, num_planes,
+            max_degree, max_steps),
+        lambda: _flat_default_init(nbrs_l, deg_l),
+        k0, VERTEX_AXIS, deg_l.shape[0],
     )
 
 

@@ -48,9 +48,11 @@ from jax.sharding import NamedSharding, PartitionSpec as P
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus, empty_budget_failure
 from dgc_tpu.engine.fused import (
     cached_shard_kernel,
-    device_sweep_pair,
+    device_sweep_pair_resumable,
     finish_sweep_pair,
     run_windowed,
+    shard_rec_empty,
+    shard_superstep_epilogue,
 )
 from dgc_tpu.engine.bucketed import (
     MAX_WINDOW_PLANES,
@@ -59,7 +61,6 @@ from dgc_tpu.engine.bucketed import (
     decode_combined,
     encode_combined,
     initial_packed,
-    status_step,
 )
 from dgc_tpu.engine.compact import (
     _bucket_fail_valid,
@@ -69,7 +70,7 @@ from dgc_tpu.engine.compact import (
     _pow2_ceil,
     hub_prune_cfg,
 )
-from dgc_tpu.ops.speculative import speculative_update
+from dgc_tpu.ops.speculative import speculative_update_mc
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.parallel.mesh import (
     VERTEX_AXIS,
@@ -166,7 +167,7 @@ def shard_prune_cfg(slice_rows: int, width: int,
 
 def _fresh_shard_prune(tables_l, planes: tuple, prune_cfg: tuple, v_final: int):
     """Per-bucket-slice pruned captures, initially invalid (fresh per
-    k-attempt — ``device_sweep_pair`` calls the attempt body per phase, so
+    k-attempt — the sweep pipeline is invoked fresh per phase, so
     captures never leak between the fused pair's attempts). Delegates to
     the single-device ``_fresh_prune`` so the exactness-critical initial
     shapes (invalid flag, sentinel slots/lists, zero planes) stay
@@ -198,10 +199,12 @@ def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
     the same monotone-frontier argument as ``engine.compact``: inactive
     rows transition to themselves. Bit-identical to the ungated
     ``bucketed_superstep`` by construction (shared ``speculative_update``
-    core, shared ``_compact_idx`` slot idiom)."""
+    core, shared ``_compact_idx`` slot idiom). Also returns the shard's
+    max divergence candidate ``mc`` (−1 on skipped slices) — pmax'd by the
+    caller for the prefix-resume record rule."""
     packed_pad = jnp.concatenate([packed_g, jnp.array([-1], jnp.int32)])
     v_final = packed_g.shape[0]
-    new_parts, fail_parts, act_parts = [], [], []
+    new_parts, fail_parts, act_parts, mc_parts = [], [], [], []
     prune_new = []
     row0 = 0
     for bi, (tb, p_b, pad) in enumerate(zip(tables_l, planes, pads)):
@@ -213,22 +216,21 @@ def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
 
         def full(pk_b, tb=tb, p_b=p_b, fv=fv):
             nb, beats = decode_combined(tb)
-            new_b, fail_m, act_m = speculative_update(
+            new_b, fail_m, act_m, mc_b = speculative_update_mc(
                 pk_b, packed_pad[nb], beats, k, p_b)
             return (new_b, jnp.sum(fail_m.astype(jnp.int32)) * fv,
-                    jnp.sum(act_m.astype(jnp.int32)))
+                    jnp.sum(act_m.astype(jnp.int32)), mc_b)
 
         if cfg is not None:
             # the single-device hub dispatcher, verbatim: ``packed_pad``
             # stands in for the [V+2] extended state (it gathers
             # ``pe[:v+1][nb]`` with v = v_final — exactly the all-gathered
-            # global state + the −1 sentinel slot); mc is dropped (no
-            # prefix-resume on this path)
+            # global state + the −1 sentinel slot)
             act_b = (pk_b < 0) | ((pk_b & 1) == 1)
             na = jnp.sum(act_b.astype(jnp.int32))
-            nb_, f, a, _, ps2 = _hub_dispatch(
+            nb_, f, a, m, ps2 = _hub_dispatch(
                 packed_pad, na, pk_b, tb, p_b, k, v_final, ps_b, cfg)
-            r = (nb_, f, a, ps2)
+            r = (nb_, f, a, m, ps2)
         elif pad == 0:
             r = full(pk_b) + (ps_b,)
         else:
@@ -242,14 +244,14 @@ def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
                 idx_safe = jnp.where(real, idx, 0)
                 pk_slot = jnp.where(real, pk_b[idx_safe], 0)  # dummies inert
                 nb, beats = decode_combined(jnp.take(tb, idx_safe, axis=0))
-                new_slot, fail_m, act_m = speculative_update(
+                new_slot, fail_m, act_m, mc_b = speculative_update_mc(
                     pk_slot, packed_pad[nb], beats, k, p_b)
                 return (pk_b.at[idx].set(new_slot, mode="drop"),
                         jnp.sum(fail_m.astype(jnp.int32)) * fv,
-                        jnp.sum(act_m.astype(jnp.int32)))
+                        jnp.sum(act_m.astype(jnp.int32)), mc_b)
 
             def skip(pk_b):
-                return pk_b, jnp.int32(0), jnp.int32(0)
+                return pk_b, jnp.int32(0), jnp.int32(0), jnp.int32(-1)
 
             def live(pk_b, pad=pad, compact=compact, full=full, na=na):
                 return jax.lax.cond(na <= pad, compact, full, pk_b)
@@ -258,51 +260,75 @@ def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
         new_parts.append(r[0])
         fail_parts.append(r[1])
         act_parts.append(r[2])
-        prune_new.append(r[3])
+        mc_parts.append(r[3])
+        prune_new.append(r[4])
         row0 += rows
     return (jnp.concatenate(new_parts), sum(fail_parts), sum(act_parts),
-            tuple(prune_new))
+            jnp.max(jnp.stack(mc_parts)), tuple(prune_new))
 
 
-def _shard_attempt(tables_l, deg_l, k, planes: tuple, max_steps: int,
-                   v_final: int, pads: tuple = (), prune_cfg: tuple = (),
-                   stall_window: int = 64):
-    """One k-attempt on a shard: while_loop of all-gather + gated bucketed
-    superstep + psum reductions. Returns (colors_l, steps, status)."""
+def _shard_pipeline(tables_l, deg_l, k, init, rec, record, planes: tuple,
+                    max_steps: int, v_final: int, pads: tuple = (),
+                    prune_cfg: tuple = (), stall_window: int = 64):
+    """One k-attempt on a shard in resumable form: while_loop of all-gather
+    + gated bucketed superstep + psum/pmax reductions. ``init`` is the
+    carry head ``(packed_l, step, active, stall)`` (scratch or a resume
+    ring snapshot), ``rec`` the per-shard prefix-resume ring
+    (``fused.shard_rec_empty`` layout), ``record`` a traced bool (push the
+    pre-state of new-max-candidate supersteps — the push decision derives
+    from psum/pmax'd scalars, so every shard pushes at the same rounds).
+    Pruned captures are built fresh per invocation (never recorded — the
+    prune branches change the schedule, not the values). Returns
+    (packed_l, steps, status, rec)."""
+    from dgc_tpu.engine.compact import _make_recstep
+
     k = jnp.asarray(k, jnp.int32)
     if not pads:
         pads = tuple(0 for _ in tables_l)
     if not prune_cfg:
         prune_cfg = tuple(None for _ in tables_l)
     prune0 = _fresh_shard_prune(tables_l, planes, prune_cfg, v_final)
-    carry = (initial_packed(deg_l), jnp.int32(1), jnp.int32(_RUNNING),
-             jnp.int32(v_final + 1), jnp.int32(0), prune0)
+    recstep = _make_recstep(record)
+    carry = (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3],
+             prune0) + tuple(rec)
 
     def cond(c):
         status = c[2]
         return status == _RUNNING
 
     def body(c):
-        packed_l, step, status, prev_active, stall, prune = c
+        packed_l, step, status, prev_active, stall, prune = c[:6]
+        rec5 = c[6:11]
         packed_g = jax.lax.all_gather(packed_l, VERTEX_AXIS, tiled=True)
-        new_packed_l, fail_l, active_l, prune_new = _gated_superstep(
+        new_packed_l, fail_l, active_l, mc_l, prune_new = _gated_superstep(
             packed_l, packed_g, tables_l, k, planes, pads, prune, prune_cfg
         )
         fail_count = jax.lax.psum(fail_l, VERTEX_AXIS)
         active = jax.lax.psum(active_l, VERTEX_AXIS)
+        mc = jax.lax.pmax(mc_l, VERTEX_AXIS)
         any_fail = fail_count > 0
-        stall = jnp.where(active < prev_active, 0, stall + 1)
-        status = status_step(any_fail, active, stall, stall_window)
-        status = jnp.where(
-            (status == _RUNNING) & (step + 1 >= max_steps), _STALLED, status
-        ).astype(jnp.int32)
-        new_packed_l = jnp.where(any_fail, packed_l, new_packed_l)
-        prune_new = jax.tree.map(
-            lambda a, b: jnp.where(any_fail, a, b), prune, prune_new)
-        return (new_packed_l, step + 1, status, active, stall, prune_new)
+        (rec5, stall, status, new_packed_l,
+         prune_new) = shard_superstep_epilogue(
+            recstep, rec5, packed_l, new_packed_l, prune, prune_new,
+            any_fail, active, mc, step, prev_active, stall, stall_window,
+            max_steps)
+        return (new_packed_l, step + 1, status, active, stall,
+                prune_new) + rec5
 
     out = jax.lax.while_loop(cond, body, carry)
-    packed_l, steps, status = out[0], out[1], out[2]
+    return out[0], out[1], out[2], tuple(out[6:11])
+
+
+def _shard_attempt(tables_l, deg_l, k, planes: tuple, max_steps: int,
+                   v_final: int, pads: tuple = (), prune_cfg: tuple = (),
+                   stall_window: int = 64):
+    """Plain k-attempt (no recording): (colors_l, steps, status)."""
+    init = (initial_packed(deg_l), jnp.int32(1), jnp.int32(v_final + 1),
+            jnp.int32(0))
+    rec = shard_rec_empty(deg_l.shape[0], dummy=True)
+    packed_l, steps, status, _ = _shard_pipeline(
+        tables_l, deg_l, k, init, rec, False, planes, max_steps, v_final,
+        pads=pads, prune_cfg=prune_cfg, stall_window=stall_window)
     colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
     return colors_l, steps, status
 
@@ -316,11 +342,17 @@ def _shard_attempt_body(tables_l, deg_l, k, *, planes: tuple, max_steps: int,
 
 def _shard_sweep_body(tables_l, deg_l, k0, *, planes: tuple, max_steps: int,
                       v_final: int, pads: tuple = (), prune_cfg: tuple = ()):
-    """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call."""
-    return device_sweep_pair(
-        lambda k: _shard_attempt(tables_l, deg_l, k, planes, max_steps,
-                                 v_final, pads=pads, prune_cfg=prune_cfg),
-        k0, VERTEX_AXIS,
+    """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call —
+    phase-carried with prefix-resume (``device_sweep_pair_resumable``: the
+    pipeline traces once, and the confirm fast-forwards past the prefix it
+    shares with attempt 1)."""
+    return device_sweep_pair_resumable(
+        lambda k, init, rec, record: _shard_pipeline(
+            tables_l, deg_l, k, init, rec, record, planes, max_steps,
+            v_final, pads=pads, prune_cfg=prune_cfg),
+        lambda: (initial_packed(deg_l), jnp.int32(1),
+                 jnp.int32(v_final + 1), jnp.int32(0)),
+        k0, VERTEX_AXIS, deg_l.shape[0],
     )
 
 

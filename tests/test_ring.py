@@ -106,9 +106,13 @@ def test_ring_sweep_pair_matches_two_attempts(medium_graph):
     r1 = ref.attempt(g.max_degree + 1)
     r2 = ref.attempt(r1.colors_used - 1)
     assert first.status == r1.status and np.array_equal(first.colors, r1.colors)
+    assert first.supersteps == r1.supersteps
     assert second.k == r1.colors_used - 1
     assert second.status == r2.status
     assert np.array_equal(second.colors, r2.colors)
+    # prefix-resume: the fused confirm's superstep counter continues from
+    # the resume snapshot, so it matches a scratch confirm exactly
+    assert second.supersteps == r2.supersteps
 
 
 @needs8
@@ -182,4 +186,5 @@ def test_ring_bucketed_sweep_matches_attempts():
     if second is not None and r1.colors_used > 1:
         r2 = ref.attempt(r1.colors_used - 1)
         assert second.status == r2.status
+        assert second.supersteps == r2.supersteps
         assert np.array_equal(second.colors, r2.colors)

@@ -60,8 +60,34 @@ def test_sweep_pair_matches_two_attempts(medium_graph):
     r1 = ref.attempt(g.max_degree + 1)
     r2 = ref.attempt(r1.colors_used - 1)
     assert first.status == r1.status and np.array_equal(first.colors, r1.colors)
+    assert first.supersteps == r1.supersteps
     assert second.k == r1.colors_used - 1
     assert second.status == r2.status
+    assert np.array_equal(second.colors, r2.colors)
+    # prefix-resume contract: the fused confirm's superstep counter
+    # continues from the resume snapshot, so it matches a scratch confirm
+    assert second.supersteps == r2.supersteps
+
+
+@pytest.mark.parametrize("num_shards", [2, 8])
+def test_sweep_prefix_resume_exact_heavy_tail(num_shards):
+    # heavy-tail sweep with the full gating/pruning machinery forced on:
+    # the fused pair (confirm prefix-resumed from the ring) must equal two
+    # scratch attempts bit-for-bit INCLUDING superstep counts, at every
+    # mesh size — the multi-chip port of compact's prefix-resume fuzz
+    g = generate_rmat_graph(1536, avg_degree=8, seed=9, native=False)
+    k0 = g.max_degree + 1
+    eng = ShardedBucketedEngine(g, num_shards=num_shards, uncond_entries=0,
+                                prune_u_min=2)
+    first, second = eng.sweep(k0)
+    ref = ShardedBucketedEngine(g, num_shards=num_shards, uncond_entries=0,
+                                prune_u_min=2)
+    r1 = ref.attempt(k0)
+    assert first.status == r1.status and first.supersteps == r1.supersteps
+    assert np.array_equal(first.colors, r1.colors)
+    r2 = ref.attempt(r1.colors_used - 1)
+    assert second is not None and second.status == r2.status
+    assert second.supersteps == r2.supersteps
     assert np.array_equal(second.colors, r2.colors)
 
 
