@@ -41,8 +41,9 @@ def build_parser() -> argparse.ArgumentParser:
         choices=["ell", "ell-bucketed", "ell-compact", "dense", "sharded",
                  "sharded-bucketed", "sharded-ring", "reference-sim", "oracle",
                  "spark"],
-        default="ell",
-        help="coloring engine (default: ell — single-device jit'd ELL kernel)",
+        default="ell-compact",
+        help="coloring engine (default: ell-compact — the flagship staged "
+             "frontier-compacted kernel; any degree distribution)",
     )
     p.add_argument("--seed", type=int, default=None, help="generator seed")
     p.add_argument(
