@@ -51,11 +51,28 @@ assert res.status == AttemptStatus.SUCCESS, res.status
 # heavy-tail engine over the same 2-process mesh (degree-dealt buckets,
 # frontier gating) — the multi-chip power-law path across real processes
 gr = generate_rmat_graph(256, avg_degree=6, seed=9, native=False)
-resb = ShardedBucketedEngine(gr, mesh=mesh).attempt(gr.max_degree + 1)
+engb = ShardedBucketedEngine(gr, mesh=mesh)
+resb = engb.attempt(gr.max_degree + 1)
 assert resb.status == AttemptStatus.SUCCESS, resb.status
+
+# fused sweep with prefix-resume across the process boundary: the
+# ring-push decision is pmax/psum-derived (process-uniform), and the
+# confirm must match a scratch attempt exactly — superstep counter
+# included (the device_sweep_pair_resumable contract)
+s1, s2 = engb.sweep(gr.max_degree + 1)
+assert s1.supersteps == resb.supersteps, (s1.supersteps, resb.supersteps)
+assert s1.colors.tolist() == resb.colors.tolist()
+if resb.colors_used > 1:
+    # same-engine baseline: the sweep contract is "bit-identical to two
+    # attempt calls on THIS engine" (window-widening state included)
+    rc = engb.attempt(resb.colors_used - 1)
+    assert s2.status == rc.status and s2.supersteps == rc.supersteps, \
+        (s2.status, rc.status, s2.supersteps, rc.supersteps)
+    assert s2.colors.tolist() == rc.colors.tolist()
 
 with open(os.path.join(outdir, f"result_{pid}.json"), "w") as f:
     json.dump({"info": info, "colors": res.colors.tolist(),
                "supersteps": res.supersteps,
-               "rmat_colors": resb.colors.tolist()}, f)
+               "rmat_colors": resb.colors.tolist(),
+               "sweep_confirm_k": None if s2 is None else s2.k}, f)
 print(f"worker {pid} OK: {info}")

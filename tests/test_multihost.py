@@ -97,6 +97,10 @@ def test_two_process_distributed_smoke(tmp_path):
     from dgc_tpu.models.generators import generate_rmat_graph
 
     assert results[0]["rmat_colors"] == results[1]["rmat_colors"]
+    # the fused sweep's confirm budget must agree across processes (the
+    # ring-push/resume decisions are pmax/psum-derived, process-uniform)
+    assert results[0]["sweep_confirm_k"] == results[1]["sweep_confirm_k"]
     gr = generate_rmat_graph(256, avg_degree=6, seed=9, native=False)
     refb = BucketedELLEngine(gr).attempt(gr.max_degree + 1)
     assert np.array_equal(np.array(results[0]["rmat_colors"]), refb.colors)
+    assert results[0]["sweep_confirm_k"] == refb.colors_used - 1
