@@ -28,6 +28,58 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
 TARGET_SECONDS = 5.0  # BASELINE.json: "<5 s for 1M vertices, avg-degree 16"
 
 
+def _env_float(name: str, default: float) -> float:
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        print(f"# ignoring malformed {name}={raw!r}", file=sys.stderr)
+        return default
+
+
+# watchdog exit code: distinctive on purpose — argparse usage errors exit 2
+# and Python tracebacks exit 1, so callers (bench_suite.sh) can tell a
+# backend-loss abort apart from an ordinary bug
+ABORT_RC = 113
+
+
+def _start_watchdog(timeout_s: float, what: str, metric: str):
+    """Abort the process if ``what`` is still pending after ``timeout_s``.
+
+    Under the image's remote-tunnel backend, device init (and any remote
+    compile) BLOCKS indefinitely when the tunnel is down — there is no
+    exception to catch (the same hazard ``__graft_entry__.py`` documents
+    for the dry run) — so the bound comes from a watchdog thread around
+    the *real* work, not a separate probe: healthy runs cancel the timer
+    and pay no second backend init. Returns the Event to set on success.
+    """
+    import threading
+
+    done = threading.Event()
+
+    def _fire() -> None:
+        if done.wait(timeout_s):
+            return
+        diag = (
+            f"backend unreachable: {what} exceeded {timeout_s:.0f}s "
+            f"(JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '')!r} — tunnel down?)"
+        )
+        # one clearly-labeled failure line; rc!=0 so a missing number can
+        # never masquerade as a measurement (bench_suite.sh filters the
+        # null record out of its jsonl)
+        print(f"# BENCH ABORTED: {diag}", file=sys.stderr)
+        print(json.dumps({"metric": metric,
+                          "value": None, "unit": "s", "vs_baseline": 0.0,
+                          "error": diag}), flush=True)
+        sys.stderr.flush()
+        os._exit(ABORT_RC)
+
+    threading.Thread(target=_fire, daemon=True).start()
+    return done
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=1_000_000)
@@ -40,6 +92,19 @@ def main() -> int:
                    help="graph family: uniform random or power-law RMAT")
     p.add_argument("--seed", type=int, default=0)
     p.add_argument("--include-compile", action="store_true")
+    # 25 s default: an unreachable backend aborts fast for a standalone
+    # `python bench.py` (the driver's capture command); bench_suite.sh
+    # raises it via the env var to tolerate degraded-tunnel init times
+    p.add_argument("--probe-timeout", type=float,
+                   default=_env_float("DGC_TPU_BENCH_PROBE_TIMEOUT", 25.0),
+                   help="seconds to allow device init before declaring the "
+                        "backend unreachable; 0 disables the watchdog")
+    # a tunnel drop AFTER successful init (mid remote-compile or mid-sweep)
+    # also blocks forever; this bounds the whole standalone run
+    p.add_argument("--run-timeout", type=float,
+                   default=_env_float("DGC_TPU_BENCH_RUN_TIMEOUT", 5400.0),
+                   help="seconds to allow the whole run after device init; "
+                        "0 disables the deadline")
     args = p.parse_args()
 
     import jax
@@ -48,7 +113,17 @@ def main() -> int:
     from dgc_tpu.models.generators import generate_random_graph_fast, generate_rmat_graph
     from dgc_tpu.ops.validate import validate_coloring
 
+    # armed immediately before the first device touch (imports above are
+    # off the clock, so a slow cold import can't eat the init budget)
+    init_ok = (_start_watchdog(args.probe_timeout, "device init",
+                               "bench_aborted_backend_unreachable")
+               if args.probe_timeout > 0 else None)
     dev = jax.devices()[0]
+    if init_ok is not None:
+        init_ok.set()  # init succeeded; disarm the init watchdog
+    if args.run_timeout > 0:
+        _start_watchdog(args.run_timeout, "run after device init",
+                        "bench_aborted_run_deadline")
     print(f"# device: {dev.device_kind} ({dev.platform}) x{jax.local_device_count()}",
           file=sys.stderr)
 
