@@ -67,6 +67,13 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--sim-variant", choices=["optimized", "baseline"], default="optimized",
                    help="reference-sim backend: which reference engine's semantics")
+    p.add_argument(
+        "--no-reduce-colors",
+        action="store_true",
+        help="disable the top-class recolor post-pass (ops.reduce_colors); the "
+             "pass is validity-preserving, can only lower the color count, and "
+             "never runs for the reference-sim/oracle backends",
+    )
     return p
 
 
@@ -166,6 +173,13 @@ def _run(args, logger: RunLogger) -> int:
     def on_attempt(res, val):
         logger.attempt(res, val)
 
+    post_reduce = None
+    if not args.no_reduce_colors and args.backend not in ("reference-sim", "oracle"):
+        # the sim/oracle backends ARE the reference semantics — their count
+        # is the parity target, so the improvement pass never touches them
+        from dgc_tpu.engine.minimal_k import make_reducer
+        post_reduce = make_reducer(graph.arrays)
+
     result = find_minimal_coloring(
         engine,
         initial_k=k0,
@@ -173,7 +187,13 @@ def _run(args, logger: RunLogger) -> int:
         validate=make_validator(graph.arrays),
         on_attempt=on_attempt,
         checkpoint=checkpoint,
+        post_reduce=post_reduce,
     )
+
+    if result.minimal_colors is not None and result.swept_colors is not None \
+            and result.minimal_colors < result.swept_colors:
+        logger.event("post_reduce", from_colors=result.swept_colors,
+                     to_colors=result.minimal_colors)
 
     total_s = time.perf_counter() - t_start
     if result.colors is None:

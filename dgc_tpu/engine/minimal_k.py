@@ -37,6 +37,7 @@ class MinimalColoringResult:
     attempts: list[AttemptResult] = field(default_factory=list)
     wall_time_s: float = 0.0
     validation: ValidationResult | None = None
+    swept_colors: int | None = None   # count before the post_reduce pass (== minimal_colors when it didn't fire)
 
     @property
     def total_supersteps(self) -> int:
@@ -51,6 +52,7 @@ def find_minimal_coloring(
     validate: Callable | None = None,
     on_attempt: Callable[[AttemptResult, ValidationResult | None], None] | None = None,
     checkpoint=None,
+    post_reduce: Callable | None = None,
 ) -> MinimalColoringResult:
     """Run k-attempts until failure; return minimal count + last valid coloring.
 
@@ -58,7 +60,9 @@ def find_minimal_coloring(
     attempt (the reference calls ``validate_graph_coloring`` once per outer-k
     iteration, ``coloring.py:224``). ``checkpoint`` is an optional
     ``utils.checkpoint.CheckpointManager``; attempts completed in a previous
-    run are skipped on resume.
+    run are skipped on resume. ``post_reduce(colors) -> colors`` (see
+    ``ops.reduce_colors``) is applied to the final coloring; it may only
+    preserve validity and lower the count.
     """
     t0 = time.perf_counter()
     result = MinimalColoringResult(minimal_colors=None, colors=None)
@@ -117,12 +121,29 @@ def find_minimal_coloring(
 
     if best is not None and best.success:
         result.minimal_colors = best.colors_used
+        result.swept_colors = best.colors_used
         result.colors = best.colors
+        if post_reduce is not None:
+            reduced = post_reduce(best.colors)
+            reduced_used = int(reduced.max()) + 1
+            if reduced_used < result.minimal_colors:
+                result.minimal_colors = reduced_used
+                result.colors = reduced
         if validate is not None:
-            result.validation = validate(best.colors)
+            result.validation = validate(result.colors)
+            if not result.validation.valid:
+                raise AssertionError(
+                    f"post-reduce produced invalid coloring: {result.validation}"
+                )
     result.wall_time_s = time.perf_counter() - t0
     return result
 
 
 def make_validator(arrays) -> Callable[[np.ndarray], ValidationResult]:
     return lambda colors: validate_coloring(arrays.indptr, arrays.indices, colors)
+
+
+def make_reducer(arrays) -> Callable[[np.ndarray], np.ndarray]:
+    from dgc_tpu.ops.reduce_colors import reduce_color_count
+
+    return lambda colors: reduce_color_count(arrays.indptr, arrays.indices, colors)

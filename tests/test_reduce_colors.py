@@ -1,0 +1,100 @@
+"""Color-count reduction post-pass (ops.reduce_colors).
+
+The pass must (1) preserve validity unconditionally, (2) never raise the
+count, (3) actually eliminate removable top classes — including via Kempe
+swaps when first-fit alone is stuck — and (4) narrow the engines'
+heavy-tail gap vs the reference semantics to the ±1 contract
+(BASELINE.json; the reference's count is the last successful k,
+``/root/reference/coloring.py:226-231``).
+"""
+
+import numpy as np
+import pytest
+
+from dgc_tpu.engine.bucketed import BucketedELLEngine
+from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_reducer, make_validator
+from dgc_tpu.engine.reference_sim import ReferenceSimEngine
+from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.models.generators import generate_random_graph, generate_rmat_graph
+from dgc_tpu.ops.reduce_colors import eliminate_top_class, reduce_color_count
+from dgc_tpu.ops.validate import validate_coloring
+
+
+def _csr(edges, n):
+    adj = [[] for _ in range(n)]
+    for u, v in edges:
+        adj[u].append(v)
+        adj[v].append(u)
+    indptr = np.zeros(n + 1, np.int32)
+    for i, a in enumerate(adj):
+        indptr[i + 1] = indptr[i] + len(a)
+    indices = np.concatenate([np.sort(a) for a in adj if a] or
+                             [np.empty(0, np.int32)]).astype(np.int32)
+    return indptr, indices
+
+
+def test_path_top_class_removed_by_first_fit():
+    # 0-1-2 path colored 0,1,2: vertex 2 moves first-fit to color 0
+    indptr, indices = _csr([(0, 1), (1, 2)], 3)
+    out = reduce_color_count(indptr, indices, np.array([0, 1, 2], np.int32))
+    assert out.max() == 1
+    assert validate_coloring(indptr, indices, out).valid
+
+
+def test_triangle_is_irreducible():
+    indptr, indices = _csr([(0, 1), (1, 2), (0, 2)], 3)
+    colors = np.array([0, 1, 2], np.int32)
+    assert eliminate_top_class(indptr, indices, colors) is None
+    out = reduce_color_count(indptr, indices, colors)
+    assert np.array_equal(out, colors)
+
+
+def test_kempe_swap_frees_stubborn_vertex():
+    # star-of-paths: center v=0 colored 2 with neighbors 1 (color 0) and
+    # 2 (color 1); 1-3 and 2-4 extend paths so no color is free at v by
+    # first-fit alone after we also pin... build the classic case:
+    # v sees colors {0, 1}; neighbor 1 (color 0) sits on a 0-1 chain
+    # disjoint from neighbor 2 (color 1). Swapping chain {1,3} (0<->1)
+    # leaves v with no 0-colored neighbor -> v moves to 0.
+    indptr, indices = _csr([(0, 1), (0, 2), (1, 3), (2, 4)], 5)
+    colors = np.array([2, 0, 1, 1, 0], np.int32)
+    assert validate_coloring(indptr, indices, colors).valid
+    out = reduce_color_count(indptr, indices, colors)
+    assert out is not None and out.max() <= 1
+    assert validate_coloring(indptr, indices, out).valid
+
+
+def test_never_raises_count_and_preserves_validity(small_graphs):
+    for g in small_graphs:
+        res = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1,
+                                    validate=make_validator(g))
+        before = res.minimal_colors
+        out = reduce_color_count(g.indptr, g.indices, res.colors)
+        assert int(out.max()) + 1 <= before
+        assert validate_coloring(g.indptr, g.indices, out).valid
+
+
+def test_minimal_k_post_reduce_integration():
+    g = generate_rmat_graph(800, avg_degree=8.0, seed=28, native=False)
+    plain = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1,
+                                  validate=make_validator(g))
+    reduced = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1,
+                                    validate=make_validator(g),
+                                    post_reduce=make_reducer(g))
+    assert reduced.minimal_colors <= plain.minimal_colors
+    assert reduced.validation is not None and reduced.validation.valid
+    assert int(reduced.colors.max()) + 1 == reduced.minimal_colors
+
+
+def test_known_plus2_seeds_within_contract():
+    # seeds found by the round-4 scan where the bucketed engine lands +2
+    # above reference-sim without the pass; with it the gap must be <= 1
+    for seed in (28, 34, 44):
+        g = generate_rmat_graph(800, avg_degree=8.0, seed=seed, native=False)
+        a = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1,
+                                  validate=make_validator(g),
+                                  post_reduce=make_reducer(g))
+        b = find_minimal_coloring(ReferenceSimEngine(g), g.max_degree + 1,
+                                  validate=make_validator(g))
+        assert abs(a.minimal_colors - b.minimal_colors) <= 1, \
+            (seed, a.minimal_colors, b.minimal_colors)
