@@ -742,6 +742,28 @@ def _hub_region_step(pe, ba, new_pe, prune, buckets, planes: tuple,
     return new_pe, fails, actives, mcs, tuple(prune_new)
 
 
+def _check_stage_ladder(stages: tuple, v: int) -> None:
+    """A compaction stage's scale must bound the frontier at entry (the
+    previous stage's exit threshold, or V at the start) — a smaller scale
+    would silently drop active vertices. Thresholds must be non-increasing:
+    the ladder runs the frontier DOWN, and the unified pipeline's stage
+    routing (max stage whose entry bound covers the frontier) is only
+    equivalent to the sequential per-stage loops under that shape. Checked
+    here as well as in the engine constructor because both pipelines are
+    callable directly (tests do)."""
+    bound = v
+    for scale, thresh in stages:
+        if scale is not None and scale < min(bound, v):
+            raise ValueError(
+                f"stage scale {scale} < possible frontier {min(bound, v)}; "
+                f"stages={stages}")
+        if thresh > bound:
+            raise ValueError(
+                f"stage thresholds must be non-increasing, got {thresh} "
+                f"after {bound}; stages={stages}")
+        bound = thresh
+
+
 def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                       planes: tuple, row0s: tuple, hub_buckets: int,
                       flat_row0: int, flat_planes: int, stages: tuple,
@@ -773,6 +795,7 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
     per row, so the extra width is free at the measured rates), hence
     every per-superstep input is bit-identical."""
     v = degrees.shape[0]
+    _check_stage_ladder(stages, v)
     k = jnp.asarray(k, jnp.int32)
     nb_hub = hub_buckets
     has_flat = nb_hub < len(buckets)
@@ -972,6 +995,7 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
     run — exact either way.
     """
     v = degrees.shape[0]
+    _check_stage_ladder(stages, v)
     k = jnp.asarray(k, jnp.int32)
     nb_hub = hub_buckets
     has_flat = nb_hub < len(buckets)
@@ -1262,24 +1286,7 @@ class CompactFrontierEngine(BucketedELLEngine):
         if stages is None:
             cap = flat_cap if flat_cap is not None else self.FLAT_CAP
             stages = default_stages(v, heavy_tail=arrays.max_degree > cap)
-        # a compaction stage's scale must bound the frontier at entry
-        # (the previous stage's exit threshold, or V at the start) — a
-        # smaller scale would silently drop active vertices. Thresholds
-        # must be non-increasing: the ladder runs the frontier DOWN, and
-        # the unified pipeline's stage routing (max stage whose entry
-        # bound covers the frontier) is only equivalent to the sequential
-        # per-stage loops under that shape.
-        bound = v
-        for scale, thresh in stages:
-            if scale is not None and scale < min(bound, v):
-                raise ValueError(
-                    f"stage scale {scale} < possible frontier {min(bound, v)}; "
-                    f"stages={stages}")
-            if thresh > bound:
-                raise ValueError(
-                    f"stage thresholds must be non-increasing, got {thresh} "
-                    f"after {bound}; stages={stages}")
-            bound = thresh
+        _check_stage_ladder(stages, v)
         self.stages = stages
 
         sizes = [cb.shape[0] for cb in self.combined_buckets]

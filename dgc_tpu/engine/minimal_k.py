@@ -79,11 +79,13 @@ def find_minimal_coloring(
 
     # fused path: engines exposing sweep() run the jump-mode pair (find u,
     # confirm u−1 fails) in one device call; results are bit-identical to
-    # two attempt() calls. Strict mode, checkpointing, and a raised k_min
-    # floor (the fused confirm attempt can't honor a floor below u−1) use
-    # the per-attempt loop instead.
-    fused = (not strict_decrement and checkpoint is None and k_min <= 1
-             and hasattr(engine, "sweep"))
+    # two attempt() calls, so checkpointing keeps its per-attempt grain
+    # (each half is saved as it lands; a crash mid-pair resumes by
+    # re-sweeping from the saved next_k) and a raised k_min floor is
+    # honored by dropping the pair's sub-floor confirm attempt — exactly
+    # the attempt the per-attempt loop never makes. Only strict mode (the
+    # reference's one-by-one schedule) forgoes the fusion.
+    fused = not strict_decrement and hasattr(engine, "sweep")
 
     while not done and k >= k_min:
         pair = engine.sweep(k) if fused else (engine.attempt(k),)

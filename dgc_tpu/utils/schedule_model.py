@@ -42,6 +42,7 @@ class SchedulePrice:
     terms: dict = field(default_factory=dict)
     steps_per_stage: list = field(default_factory=list)
     row_gathers: dict = field(default_factory=dict)
+    per_step: list = field(default_factory=list)  # element gathers per superstep
 
     @property
     def total(self) -> int:
@@ -127,6 +128,7 @@ def price_schedule(engine: CompactFrontierEngine,
     tier = [0] * hub
     si = 0
     for n, st in enumerate(traj.steps):
+        step_base = sum(t.values())
         # stage transition before the step: the while conds gate on the
         # CARRIED active count (engine.compact._staged_pipeline), which at
         # step s equals the trajectory's start-of-step active — except at
@@ -177,9 +179,106 @@ def price_schedule(engine: CompactFrontierEngine,
                     tier[bi] = 1  # capture valid at this rebase
             else:
                 t["hub_full"] += vb * w
+        p.per_step.append(sum(t.values()) - step_base)
     p.terms = t
     p.row_gathers = rows
     return p
+
+
+@dataclass
+class EdgeTailPrice:
+    """Pricing of the hypothetical edge-budget (CSR-compacted) tail phase
+    (PERF.md "Remaining levers" #1) against the shipped staged schedule.
+
+    The phase replaces every superstep from ``entry_step`` on: active
+    vertices' adjacency is compacted into an edge buffer padded to a pow2
+    rung; each superstep then pays one element gather per buffer slot
+    plus a segmented OR-scan (Hillis–Steele over the padded buffer,
+    ``log2(rung)`` passes of ``planes`` u32 words per slot) to build the
+    per-vertex forbidden planes that XLA's missing scatter-OR would have
+    produced. Scan lane-work is converted to element-gather equivalents
+    at ``gather_rate / vpu_rate``. All volumes in element-gather
+    equivalents."""
+
+    entry_step: int | None       # best takeover superstep (None: never pays)
+    staged_tail: int             # staged schedule's cost for those steps
+    edge_tail: int               # edge-phase cost for those steps (incl. scan + rebuilds)
+    scan_part: int               # the OR-scan share inside edge_tail
+    rebuild_part: int            # rung (re)build share inside edge_tail
+    attempt_total_staged: int    # whole-attempt staged cost (price_schedule.total)
+
+    @property
+    def savings(self) -> int:
+        return self.staged_tail - self.edge_tail
+
+    @property
+    def attempt_speedup(self) -> float:
+        if self.attempt_total_staged == 0 or self.entry_step is None:
+            return 1.0
+        return self.attempt_total_staged / (
+            self.attempt_total_staged - self.savings)
+
+
+def price_edge_tail(price: SchedulePrice, traj: Trajectory,
+                    num_colors: int,
+                    gather_rate: float = 120e6,
+                    vpu_rate: float = 2.0e9) -> EdgeTailPrice:
+    """Find the best takeover step for the edge-budget tail phase.
+
+    ``price`` must come from :func:`price_schedule` on the same
+    trajectory (its ``per_step`` volumes are the staged side of the
+    comparison). The edge buffer rung for step s is
+    ``pow2_ceil(max_{t≥s} Σdeg(active_t))`` — rungs are non-increasing
+    (the same down-only shape the stage ladder enforces), and each rung
+    change pays a rebuild (edge-id gather + segment-id build ≈ 2 slots
+    per entry). ``vpu_rate`` is deliberately conservative (PERF.md
+    "Primitive rates": 1M×9-word elementwise ops land under 5 ms ⇒
+    ≥1.8G words/s)."""
+    import math
+
+    steps = traj.steps
+    n = len(steps)
+    planes_w32 = max(1, (num_colors + 31) // 32)
+    scan_eq_per_word = gather_rate / vpu_rate
+
+    # suffix-max Σdeg → per-step rung (non-increasing edge-buffer ladder)
+    sufmax = [0] * n
+    m = 0
+    for i in range(n - 1, -1, -1):
+        m = max(m, steps[i].sum_deg_active)
+        sufmax[i] = m
+    rung = [_pow2_ceil(max(1, s)) for s in sufmax]
+
+    # edge-phase cost from step s to the end (suffix sums)
+    per_edge_step = []
+    for i in range(n):
+        scan_words = rung[i] * planes_w32 * max(1, int(math.log2(rung[i])))
+        per_edge_step.append((rung[i], int(scan_words * scan_eq_per_word)))
+    best = EdgeTailPrice(entry_step=None, staged_tail=0, edge_tail=0,
+                         scan_part=0, rebuild_part=0,
+                         attempt_total_staged=price.total)
+    edge_suffix = 0
+    scan_suffix = 0
+    rebuild_suffix = 0
+    staged_suffix = 0
+    prev_rung = None
+    for i in range(n - 1, -1, -1):
+        g, sc = per_edge_step[i]
+        edge_suffix += g + sc
+        scan_suffix += sc
+        if prev_rung is not None and rung[i] != prev_rung:
+            rebuild_suffix += 2 * prev_rung  # the rung we shrink INTO
+        prev_rung = rung[i]
+        staged_suffix += price.per_step[i]
+        entry_rebuild = 2 * rung[i]
+        total_edge = edge_suffix + rebuild_suffix + entry_rebuild
+        if staged_suffix - total_edge > best.savings:
+            best = EdgeTailPrice(
+                entry_step=i, staged_tail=staged_suffix,
+                edge_tail=total_edge, scan_part=scan_suffix,
+                rebuild_part=rebuild_suffix + entry_rebuild,
+                attempt_total_staged=price.total)
+    return best
 
 
 def _main(argv=None) -> int:
@@ -203,6 +302,8 @@ def _main(argv=None) -> int:
     for name, vol in price.terms.items():
         if vol:
             print(f"{name:12} {vol/1e6:10.1f}M", file=sys.stderr)
+    ncol = int(traj.colors.max()) + 1 if traj.colors is not None else 64
+    tail = price_edge_tail(price, traj, ncol)
     print(json.dumps({
         "supersteps": traj.supersteps,
         "steps_per_stage": price.steps_per_stage,
@@ -212,6 +313,15 @@ def _main(argv=None) -> int:
         "terms": price.terms,
         "row_gathers": price.row_gathers,
         "complexity": program_complexity(eng),
+        "edge_tail": {
+            "entry_step": tail.entry_step,
+            "staged_tail": tail.staged_tail,
+            "edge_tail": tail.edge_tail,
+            "scan_part": tail.scan_part,
+            "rebuild_part": tail.rebuild_part,
+            "savings": tail.savings,
+            "attempt_speedup": round(tail.attempt_speedup, 4),
+        },
     }))
     return 0
 

@@ -9,13 +9,11 @@ heavy-tail gap vs the reference semantics to the ±1 contract
 """
 
 import numpy as np
-import pytest
 
 from dgc_tpu.engine.bucketed import BucketedELLEngine
 from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_reducer, make_validator
 from dgc_tpu.engine.reference_sim import ReferenceSimEngine
-from dgc_tpu.models.arrays import GraphArrays
-from dgc_tpu.models.generators import generate_random_graph, generate_rmat_graph
+from dgc_tpu.models.generators import generate_rmat_graph
 from dgc_tpu.ops.reduce_colors import eliminate_top_class, reduce_color_count
 from dgc_tpu.ops.validate import validate_coloring
 

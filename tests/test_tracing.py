@@ -110,6 +110,35 @@ def test_schedule_model_prices_engine_config():
         price_schedule(eng, record_trajectory(other))
 
 
+def test_edge_tail_pricing_consistency():
+    # per-step volumes must sum to the schedule total; the edge-tail
+    # pricer's suffix accounting must agree with a direct recompute of
+    # the staged tail, and savings is only reported when positive
+    from dgc_tpu.engine.compact import CompactFrontierEngine, _pow2_ceil
+    from dgc_tpu.models.generators import generate_rmat_graph
+    from dgc_tpu.utils.schedule_model import price_edge_tail, price_schedule
+    from dgc_tpu.utils.trajectory import record_trajectory
+
+    g = generate_rmat_graph(2000, avg_degree=10.0, seed=5)
+    t0 = max(g.num_vertices // 2, 1)
+    eng = CompactFrontierEngine(g, flat_cap=8, prune_u_min=4,
+                                prune_p2_min=4, hub_uncond_entries=0,
+                                stages=((None, t0), (_pow2_ceil(t0), 0)))
+    traj = record_trajectory(g)
+    price = price_schedule(eng, traj)
+    assert len(price.per_step) == traj.supersteps
+    assert sum(price.per_step) == price.total
+
+    ncol = int(traj.colors.max()) + 1
+    tail = price_edge_tail(price, traj, ncol)
+    assert tail.attempt_total_staged == price.total
+    if tail.entry_step is not None:
+        assert tail.savings > 0
+        assert tail.staged_tail == sum(price.per_step[tail.entry_step:])
+        assert tail.edge_tail >= tail.scan_part + tail.rebuild_part - 1
+        assert tail.attempt_speedup >= 1.0
+
+
 def test_program_complexity_counts():
     # exact hand-computed counts on a one-bucket forced-hub clique so an
     # inverted cfg classification or a dropped ladder arm shifts the number
