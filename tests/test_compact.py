@@ -8,6 +8,7 @@ test-size graphs (the default schedule only compacts above 2^14 vertices).
 """
 
 import numpy as np
+import pytest
 
 from dgc_tpu.engine.base import AttemptStatus
 from dgc_tpu.engine.bucketed import BucketedELLEngine
@@ -67,6 +68,7 @@ def test_compact_failure_below_minimal(medium_graph):
     assert r.status == AttemptStatus.FAILURE
 
 
+@pytest.mark.slow
 def test_compact_heavy_tail():
     g = generate_rmat_graph(2048, avg_degree=8, seed=1, native=False)
     res = find_minimal_coloring(
@@ -76,6 +78,7 @@ def test_compact_heavy_tail():
     assert validate_coloring(g.indptr, g.indices, res.colors).valid
 
 
+@pytest.mark.slow
 def test_compact_heavy_tail_takes_compacted_stages():
     # power-law graphs (Δ ≫ 256) used to fall back to the pure bucketed
     # schedule; the per-bucket compacted stages now handle any Δ natively —
@@ -441,6 +444,7 @@ def test_hub_dispatch_routes_to_pruned_branch():
     assert not np.all(np.asarray(full_b) == 0)
 
 
+@pytest.mark.slow
 def test_hub_prune_end_to_end_bit_identical():
     # clique + RMAT, pruning forced on (tiny u_min): attempts, fused sweep,
     # and the minimal-k driver all bit-match the bucketed engine
@@ -589,6 +593,7 @@ def test_hub_dispatch_tier2_routing():
     assert int(_rest[-1][0]) == 2  # stays tier 2
 
 
+@pytest.mark.slow
 def test_hub_prune_tier2_end_to_end_bit_identical():
     # tiny p2_min forces tier-2 configs on test-size graphs: attempts, the
     # fused sweep, and the minimal-k driver all bit-match the bucketed
@@ -633,6 +638,7 @@ def test_default_stages_heavy_tail_large():
         bound = thresh
 
 
+@pytest.mark.slow
 def test_compact_parity_with_reference_sim(small_graphs):
     # the flagship engine's ±1 color-count contract against the
     # reference's optimized semantics, WITH the compaction stages forced
@@ -656,6 +662,7 @@ def test_compact_parity_with_reference_sim(small_graphs):
         assert abs(a - b) <= 1, (a, b)
 
 
+@pytest.mark.slow
 def test_early_final_threshold_stalls_both_pipelines():
     # a forced ladder whose FINAL stage stops at a nonzero threshold must
     # not finish the coloring: both pipeline variants (sequential =

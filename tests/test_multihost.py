@@ -1,5 +1,6 @@
 """Multi-host init helper tests (single-process semantics)."""
 
+import pytest
 import jax
 
 from dgc_tpu.parallel.multihost import initialize_multihost, process_info
@@ -29,6 +30,7 @@ def test_process_info_shape():
     assert set(info) == {"process_index", "process_count", "local_devices", "global_devices"}
 
 
+@pytest.mark.slow
 def test_two_process_distributed_smoke(tmp_path):
     """Actually executes ``jax.distributed.initialize`` (the explicit-
     coordinator branch): two subprocesses, localhost coordinator, CPU

@@ -106,6 +106,7 @@ def test_k_monotonicity(g, k):
 
 @settings(max_examples=25, deadline=None)
 @given(graphs())
+@pytest.mark.slow
 def test_determinism_and_engine_agreement(g):
     k0 = g.max_degree + 1
     a = BucketedELLEngine(g).attempt(k0)
@@ -122,6 +123,7 @@ def test_determinism_and_engine_agreement(g):
 
 @settings(max_examples=15, deadline=None)
 @given(graphs(), st.integers(min_value=0, max_value=500))
+@pytest.mark.slow
 def test_arbitrary_k_is_graceful(g, k):
     # any user-supplied budget must produce a decisive status on every
     # engine — including k far beyond the plane/one-hot capacity, which is
@@ -169,6 +171,7 @@ def test_minimal_sweep_bracket(g):
 
 @settings(max_examples=25, deadline=None)
 @given(graphs())
+@pytest.mark.slow
 def test_fused_sweep_prefix_resume_exact(g):
     # the fused sweep's confirm attempt (prefix-resume from the rec ring)
     # must be indistinguishable from two scratch attempts on ANY graph:
@@ -207,6 +210,7 @@ def _forced_hub_engine(g, **extra):
 
 @settings(max_examples=40, deadline=None)
 @given(graphs())
+@pytest.mark.slow
 def test_pruned_hub_machinery_agreement(g):
     # the round-3 hub machinery (row compaction, neighbor pruning, uncond
     # small buckets) forced onto arbitrary graphs — colors must stay
@@ -220,6 +224,7 @@ def test_pruned_hub_machinery_agreement(g):
 
 @settings(max_examples=40, deadline=None)
 @given(graphs())
+@pytest.mark.slow
 def test_tier2_recapture_agreement(g):
     # the tier-2 re-capture (shrink + pruned2 branches) forced onto
     # arbitrary graphs: prune_p2_min=1 makes every prunable bucket carry a

@@ -8,6 +8,7 @@ heavy-tail gap vs the reference semantics to the ±1 contract
 ``/root/reference/coloring.py:226-231``).
 """
 
+import pytest
 import numpy as np
 
 from dgc_tpu.engine.bucketed import BucketedELLEngine
@@ -84,9 +85,12 @@ def test_minimal_k_post_reduce_integration():
     assert int(reduced.colors.max()) + 1 == reduced.minimal_colors
 
 
+@pytest.mark.slow
 def test_known_plus2_seeds_within_contract():
     # seeds found by the round-4 scan where the bucketed engine lands +2
-    # above reference-sim without the pass; with it the gap must be <= 1
+    # above reference-sim without the pass; with it the gap must be <= +1.
+    # The contract is one-sided (BASELINE.md amendment): fewer colors than
+    # the reference is a strictly better coloring, never a violation.
     for seed in (28, 34, 44):
         g = generate_rmat_graph(800, avg_degree=8.0, seed=seed, native=False)
         a = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1,
@@ -94,5 +98,5 @@ def test_known_plus2_seeds_within_contract():
                                   post_reduce=make_reducer(g))
         b = find_minimal_coloring(ReferenceSimEngine(g), g.max_degree + 1,
                                   validate=make_validator(g))
-        assert abs(a.minimal_colors - b.minimal_colors) <= 1, \
+        assert a.minimal_colors - b.minimal_colors <= 1, \
             (seed, a.minimal_colors, b.minimal_colors)

@@ -90,6 +90,7 @@ def test_ring_uneven_padding_and_isolated():
 
 
 @needs8
+@pytest.mark.slow
 def test_ring_heavy_tail():
     g = generate_rmat_graph(1024, avg_degree=6, seed=3, native=False)
     rr = RingHaloEngine(g, num_shards=8).attempt(g.max_degree + 1)
@@ -136,6 +137,7 @@ def test_ring_capped_window_widens_on_clique():
 # --- degree-bucketed rotation tables (heavy-tail ring support) ---
 
 
+@pytest.mark.slow
 def test_ring_bucketed_tables_bit_identical_rmat():
     # the VERDICT r2 stretch: ring tables ∝ Σdeg so the O(V/n)-state story
     # extends to power-law graphs. Colors must bit-match the flat ring form
@@ -172,6 +174,7 @@ def test_ring_bucketed_auto_selects_on_heavy_tail():
     assert not RingHaloEngine(flat, num_shards=2).bucket_tables
 
 
+@pytest.mark.slow
 def test_ring_bucketed_sweep_matches_attempts():
     import numpy as np
 

@@ -29,6 +29,7 @@ def test_bit_identical_to_bucketed(medium_graph, num_shards):
 
 
 @pytest.mark.parametrize("num_shards", [2, 8])
+@pytest.mark.slow
 def test_rmat_heavy_tail_multichip(num_shards):
     # the VERDICT r1 gap: power-law graphs on the multi-chip path. Δ here is
     # far beyond the flat sharded engine's practical plane budget.
@@ -70,6 +71,7 @@ def test_sweep_pair_matches_two_attempts(medium_graph):
 
 
 @pytest.mark.parametrize("num_shards", [2, 8])
+@pytest.mark.slow
 def test_sweep_prefix_resume_exact_heavy_tail(num_shards):
     # heavy-tail sweep with the full gating/pruning machinery forced on:
     # the fused pair (confirm prefix-resumed from the ring) must equal two
@@ -153,6 +155,7 @@ def test_layout_invariants():
 
 
 @pytest.mark.parametrize("num_shards", [2, 8])
+@pytest.mark.slow
 def test_frontier_gating_bit_identical(num_shards):
     # force the per-shard row-compaction/skip ladder onto every bucket
     # (uncond_entries=0): attempts, the fused sweep, and failure detection
@@ -186,6 +189,7 @@ def test_shard_pad_for_thresholds():
 
 
 @pytest.mark.parametrize("num_shards", [2, 8])
+@pytest.mark.slow
 def test_shard_neighbor_pruning_bit_identical(num_shards):
     # force the pruned-capture ladder (tiny U) on every gated slice: the
     # multi-chip engine with the full hub machinery must stay bit-identical
@@ -211,6 +215,7 @@ def test_shard_neighbor_pruning_bit_identical(num_shards):
 
 
 @pytest.mark.parametrize("num_shards", [2, 8])
+@pytest.mark.slow
 def test_shard_tier2_recapture_bit_identical(num_shards):
     # tiny p2_min forces len-3 (tier-2) prune configs on test-size slices:
     # the shrink + pruned2 branches of the shared dispatcher must keep the
