@@ -1,0 +1,169 @@
+"""Xplane-trace attribution for the staged kernels' gather-rate gap.
+
+PERF.md's audits price sweeps in element gathers; the conversion to
+seconds uses an *effective* ~45-55M lookups/s measured end-to-end — half
+the raw 100-140M/s large-gather rate (``tools/rate_probe.py``). This tool
+attributes the loss with a real profile instead of inference: it runs one
+k-attempt under ``jax.profiler.trace`` and aggregates device-plane XLA op
+time by category, so the question "is the lost time inside the gather
+fusions themselves, between them (scheduling/cond gaps), or in
+non-gather machinery?" gets a measured answer.
+
+Usage (CPU works for plumbing; rates only mean anything on the chip):
+
+    python tools/trace_attempt.py [--nodes N] [--gen rmat|fast]
+        [--backend ell-compact|ell-bucketed|ell] [--avg-degree D]
+        [--seed S] [--logdir DIR] [--top N]
+
+Prints one JSON object: total device time, a category breakdown
+(gather / scatter / while-overhead / collectives / elementwise-fusion /
+copy / other), idle time (trace span − Σop), and the top-N ops.
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
+    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+
+_CATEGORIES = (
+    # order matters: first match wins
+    ("gather", re.compile(r"gather|dynamic-slice(?!-update)|take", re.I)),
+    ("scatter", re.compile(r"scatter|dynamic-update-slice", re.I)),
+    ("collective", re.compile(r"all-gather|all-reduce|reduce-scatter|"
+                              r"collective|permute", re.I)),
+    ("copy", re.compile(r"copy|transpose|bitcast|reshape", re.I)),
+    ("while-ctrl", re.compile(r"while|condition|tuple|parameter|select-n", re.I)),
+    ("sort", re.compile(r"sort", re.I)),
+    ("fusion-elementwise", re.compile(r"fusion", re.I)),
+)
+
+
+def _categorize(name: str) -> str:
+    for cat, pat in _CATEGORIES:
+        if pat.search(name):
+            return cat
+    return "other"
+
+
+def attribute_xspace(xspace_path: str, top: int = 20) -> dict:
+    """Aggregate device-plane op durations from one ``.xplane.pb``."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(xspace_path, "rb") as f:
+        xs.ParseFromString(f.read())
+
+    # device planes: TPU (axon remote chip) or the host-CPU XLA plane when
+    # run off-chip for plumbing tests
+    planes = [p for p in xs.planes
+              if "/device:" in p.name or "TPU" in p.name]
+    if not planes:
+        planes = [p for p in xs.planes if "Host Threads" not in p.name]
+    # host/runtime scaffolding that shows up when the fallback picks a CPU
+    # plane (python frames, PjRt/thunk wrappers) — never real device ops
+    noise = re.compile(r"^\$|^PjRt|^Thunk|^PjitFunction|^XlaModule|"
+                       r"trace|__exit__")
+    per_op: dict[str, float] = {}
+    span_lo, span_hi = None, 0
+    for plane in planes:
+        meta = plane.event_metadata
+        for line in plane.lines:
+            for ev in line.events:
+                name = meta[ev.metadata_id].name
+                if noise.search(name):
+                    continue
+                dur = ev.duration_ps / 1e12
+                per_op[name] = per_op.get(name, 0.0) + dur
+                t0 = line.timestamp_ns * 1e-9 + ev.offset_ps / 1e12
+                span_lo = t0 if span_lo is None else min(span_lo, t0)
+                span_hi = max(span_hi, t0 + dur)
+
+    cats: dict[str, float] = {}
+    for name, dur in per_op.items():
+        cats[_categorize(name)] = cats.get(_categorize(name), 0.0) + dur
+    total = sum(per_op.values())
+    span = (span_hi - span_lo) if span_lo is not None else 0.0
+    top_ops = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
+    return {
+        "planes": [p.name for p in planes],
+        "device_op_time_s": round(total, 4),
+        "trace_span_s": round(span, 4),
+        "gap_time_s": round(max(0.0, span - total), 4),
+        "categories_s": {k: round(v, 4)
+                         for k, v in sorted(cats.items(), key=lambda kv: -kv[1])},
+        "top_ops": [{"op": n, "s": round(d, 4)} for n, d in top_ops],
+    }
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, default=200_000)
+    p.add_argument("--avg-degree", type=float, default=16.0)
+    p.add_argument("--gen", choices=["fast", "rmat"], default="rmat")
+    p.add_argument("--backend", choices=["ell-compact", "ell-bucketed", "ell"],
+                   default="ell-compact")
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--logdir", type=str, default="/tmp/dgc_trace")
+    p.add_argument("--top", type=int, default=20)
+    p.add_argument("--xspace", type=str, default=None,
+                   help="skip running; attribute an existing .xplane.pb")
+    args = p.parse_args()
+
+    if args.xspace:
+        print(json.dumps(attribute_xspace(args.xspace, args.top)))
+        return 0
+
+    import jax
+
+    from dgc_tpu.models.generators import (generate_random_graph_fast,
+                                           generate_rmat_graph)
+
+    gen = generate_rmat_graph if args.gen == "rmat" else generate_random_graph_fast
+    arrays = gen(args.nodes, avg_degree=args.avg_degree, seed=args.seed)
+    print(f"# graph V={arrays.num_vertices} E2={arrays.num_directed_edges} "
+          f"maxdeg={arrays.max_degree}", file=sys.stderr)
+
+    if args.backend == "ell-compact":
+        from dgc_tpu.engine.compact import CompactFrontierEngine as Eng
+    elif args.backend == "ell-bucketed":
+        from dgc_tpu.engine.bucketed import BucketedELLEngine as Eng
+    else:
+        from dgc_tpu.engine.superstep import ELLEngine as Eng
+    engine = Eng(arrays)
+    k0 = arrays.max_degree + 1
+
+    import time
+    t0 = time.perf_counter()
+    engine.attempt(k0)  # compile + warm outside the trace
+    print(f"# warmup {time.perf_counter() - t0:.1f}s", file=sys.stderr)
+
+    os.makedirs(args.logdir, exist_ok=True)
+    with jax.profiler.trace(args.logdir):
+        t0 = time.perf_counter()
+        res = engine.attempt(k0)
+        jax.block_until_ready(res.colors if hasattr(res.colors, "device")
+                              else res.supersteps)
+        wall = time.perf_counter() - t0
+    print(f"# traced attempt: {wall:.3f}s status={res.status}", file=sys.stderr)
+
+    paths = sorted(glob.glob(os.path.join(args.logdir, "**", "*.xplane.pb"),
+                             recursive=True), key=os.path.getmtime)
+    if not paths:
+        print("no .xplane.pb produced", file=sys.stderr)
+        return 1
+    out = attribute_xspace(paths[-1], args.top)
+    out["attempt_wall_s"] = round(wall, 4)
+    out["supersteps"] = int(res.supersteps)
+    print(json.dumps(out))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
