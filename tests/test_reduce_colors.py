@@ -2,9 +2,10 @@
 
 The pass must (1) preserve validity unconditionally, (2) never raise the
 count, (3) actually eliminate removable top classes — including via Kempe
-swaps when first-fit alone is stuck — and (4) narrow the engines'
-heavy-tail gap vs the reference semantics to the ±1 contract
-(BASELINE.json; the reference's count is the last successful k,
+swaps when first-fit alone is stuck — and (4) keep the engines inside the
+one-sided count contract vs the reference semantics: never more than
+reference + 1; fewer is an improvement (BASELINE.md round-4 amendment;
+the reference's count is the last successful k,
 ``/root/reference/coloring.py:226-231``).
 """
 
@@ -83,6 +84,27 @@ def test_minimal_k_post_reduce_integration():
     assert reduced.minimal_colors <= plain.minimal_colors
     assert reduced.validation is not None and reduced.validation.valid
     assert int(reduced.colors.max()) + 1 == reduced.minimal_colors
+
+
+@pytest.mark.slow
+def test_heavy_tail_parity_ensemble_one_sided():
+    # rolling regression net for the one-sided contract (BASELINE.md
+    # round-4 amendment): across a heavy-tail draw ensemble the engine
+    # count with the post-pass must never exceed reference + 1 (falling
+    # below is an improvement, not a violation)
+    import jax
+
+    for seed in range(30):
+        g = generate_rmat_graph(800, avg_degree=8.0, seed=seed, native=False)
+        a = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1,
+                                  validate=make_validator(g),
+                                  post_reduce=make_reducer(g))
+        b = find_minimal_coloring(ReferenceSimEngine(g), g.max_degree + 1,
+                                  validate=make_validator(g))
+        assert a.minimal_colors - b.minimal_colors <= 1, \
+            (seed, a.minimal_colors, b.minimal_colors)
+        if seed % 10 == 9:
+            jax.clear_caches()  # bound the per-shape executable footprint
 
 
 @pytest.mark.slow

@@ -9,9 +9,15 @@ time by category, so the question "is the lost time inside the gather
 fusions themselves, between them (scheduling/cond gaps), or in
 non-gather machinery?" gets a measured answer.
 
-Usage (CPU works for plumbing; rates only mean anything on the chip):
+Usage — on the chip (the real use), run with the image's default env:
 
     python tools/trace_attempt.py [--nodes N] [--gen rmat|fast]
+
+For CPU plumbing tests, scrub the sitecustomize path or the process dials
+the TPU tunnel regardless of JAX_PLATFORMS (see .claude/skills/verify):
+
+    PYTHONPATH=/root/repo JAX_PLATFORMS=cpu python tools/trace_attempt.py \
+        [--nodes N] [--gen rmat|fast]
         [--backend ell-compact|ell-bucketed|ell] [--avg-degree D]
         [--seed S] [--logdir DIR] [--top N]
 
@@ -29,8 +35,10 @@ import os
 import re
 import sys
 
-os.environ.setdefault("JAX_COMPILATION_CACHE_DIR", os.path.join(
-    os.path.dirname(os.path.dirname(os.path.abspath(__file__))), ".jax_cache"))
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)  # dgc_tpu is not an installed package
+os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
+                      os.path.join(_REPO, ".jax_cache"))
 
 _CATEGORIES = (
     # order matters: first match wins
@@ -52,8 +60,33 @@ def _categorize(name: str) -> str:
     return "other"
 
 
+def _line_self_times(evts: list, into: dict) -> None:
+    """Accumulate per-op SELF time (duration minus directly-nested child
+    durations) for one trace line into ``into``.
+
+    Trace lines nest events by time containment (a while op spans its body
+    ops; on TPU the XLA Ops line nests control flow around fusions), so a
+    plain sum double-counts every container. Stack-based interval nesting
+    gives exact self-times without hierarchy metadata.
+    """
+    evts.sort(key=lambda e: (e[0], -e[1]))
+    stack: list[list] = []  # [end, name, dur, child_sum]
+
+    def close(upto: float) -> None:
+        while stack and stack[-1][0] <= upto:
+            end, name, dur, csum = stack.pop()
+            into[name] = into.get(name, 0.0) + max(0.0, dur - csum)
+            if stack:
+                stack[-1][3] += dur
+
+    for off, dur, name in evts:
+        close(off)
+        stack.append([off + dur, name, dur, 0.0])
+    close(float("inf"))
+
+
 def attribute_xspace(xspace_path: str, top: int = 20) -> dict:
-    """Aggregate device-plane op durations from one ``.xplane.pb``."""
+    """Aggregate device-plane op SELF times from one ``.xplane.pb``."""
     from tensorflow.tsl.profiler.protobuf import xplane_pb2
 
     xs = xplane_pb2.XSpace()
@@ -65,29 +98,40 @@ def attribute_xspace(xspace_path: str, top: int = 20) -> dict:
     planes = [p for p in xs.planes
               if "/device:" in p.name or "TPU" in p.name]
     if not planes:
-        planes = [p for p in xs.planes if "Host Threads" not in p.name]
+        planes = [p for p in xs.planes if ":CPU" in p.name]
     # host/runtime scaffolding that shows up when the fallback picks a CPU
-    # plane (python frames, PjRt/thunk wrappers) — never real device ops
+    # plane (python frames, PjRt/thunk wrappers, transfer/marker events) —
+    # never real device ops. The module/step summary lines on TPU planes
+    # span the whole execution and are skipped wholesale below.
     noise = re.compile(r"^\$|^PjRt|^Thunk|^PjitFunction|^XlaModule|"
-                       r"trace|__exit__")
+                       r"^DevicePut|^np\.|^end: |^jit_|trace|__exit__")
     per_op: dict[str, float] = {}
     span_lo, span_hi = None, 0
     for plane in planes:
         meta = plane.event_metadata
-        for line in plane.lines:
+        lines = plane.lines
+        # TPU device planes carry an explicit "XLA Ops" line; when present
+        # it is the only line with real per-op events
+        op_lines = [l for l in lines if l.name == "XLA Ops"] or [
+            l for l in lines if l.name not in ("XLA Modules", "Steps",
+                                               "Framework Ops")]
+        for line in op_lines:
+            evts = []
             for ev in line.events:
                 name = meta[ev.metadata_id].name
                 if noise.search(name):
                     continue
                 dur = ev.duration_ps / 1e12
-                per_op[name] = per_op.get(name, 0.0) + dur
                 t0 = line.timestamp_ns * 1e-9 + ev.offset_ps / 1e12
+                evts.append((t0, dur, name))
                 span_lo = t0 if span_lo is None else min(span_lo, t0)
                 span_hi = max(span_hi, t0 + dur)
+            _line_self_times(evts, per_op)
 
     cats: dict[str, float] = {}
     for name, dur in per_op.items():
-        cats[_categorize(name)] = cats.get(_categorize(name), 0.0) + dur
+        cat = _categorize(name)
+        cats[cat] = cats.get(cat, 0.0) + dur
     total = sum(per_op.values())
     span = (span_hi - span_lo) if span_lo is not None else 0.0
     top_ops = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
