@@ -71,7 +71,7 @@ def _load():
         # once and load via a distinct pid-unique path — re-dlopening the
         # canonical path would return the already-mapped stale object.
         # Keep the silent-fallback contract if recovery fails too.
-        if not hasattr(lib, "dgc_build_combined"):  # newest symbol
+        if not hasattr(lib, "dgc_reduce_top_class"):  # newest symbol
             fresh = f"{_LIB}.{os.getpid()}.reload"
             if not _build(load_path=fresh):
                 _load_failed = True
@@ -86,7 +86,7 @@ def _load():
                     os.unlink(fresh)  # mapping persists; dirent can go
                 except OSError:
                     pass
-            if not hasattr(lib, "dgc_build_combined"):  # newest symbol
+            if not hasattr(lib, "dgc_reduce_top_class"):  # newest symbol
                 _load_failed = True
                 return None
         lib.dgc_generate_fast.restype = ctypes.c_void_p
@@ -129,6 +129,15 @@ def _load():
             np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
             ctypes.c_int64, ctypes.c_int64, ctypes.c_int64, ctypes.c_int32,
             np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+        ]
+        lib.dgc_reduce_top_class.restype = ctypes.c_int32
+        lib.dgc_reduce_top_class.argtypes = [
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+            ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
+            ctypes.POINTER(ctypes.c_int64),
         ]
         _lib = lib
         return _lib
@@ -237,3 +246,32 @@ def build_combined_native(indptr: np.ndarray, indices: np.ndarray,
         int(row0), int(nrows), int(width), int(sentinel), out,
     )
     return out if rc == 0 else None
+
+
+def reduce_top_class_native(indptr: np.ndarray, indices: np.ndarray,
+                            colors: np.ndarray, max_pair_tries: int,
+                            chain_cap: int, kempe_max_class: int,
+                            budget_remaining: int):
+    """Native ``eliminate_top_class`` (see ``ops.reduce_colors`` — the two
+    implementations are bit-identical by construction and tested so).
+
+    Returns ``(improved_colors | None, budget_remaining)``, or ``None``
+    (single value) when the native library is unavailable — the caller
+    then falls back to the Python path.
+    """
+    lib = _load()
+    if lib is None:
+        return None
+    out = np.ascontiguousarray(colors, dtype=np.int32).copy()
+    c = int(out.max())
+    budget = ctypes.c_int64(int(budget_remaining))
+    rc = lib.dgc_reduce_top_class(
+        int(indptr.shape[0]) - 1,
+        np.ascontiguousarray(indptr, dtype=np.int32),
+        np.ascontiguousarray(indices, dtype=np.int32),
+        out, c, int(max_pair_tries), int(chain_cap), int(kempe_max_class),
+        ctypes.byref(budget),
+    )
+    if rc < 0:
+        return None  # allocation failure inside the library: fall back
+    return (out if rc == 1 else None), int(budget.value)

@@ -332,4 +332,133 @@ void dgc_copy_csr(void* h, int32_t* indptr_out, int32_t* indices_out) {
 
 void dgc_free(void* h) { delete static_cast<DgcGraph*>(h); }
 
+// Kempe-assisted top-class elimination — the native fast path of
+// dgc_tpu/ops/reduce_colors.py::eliminate_top_class, bit-identical by
+// construction: phase 1 runs first-fit for every member of the top class
+// (members are pairwise non-adjacent, so in-place sequential assignment
+// equals the Python module's vectorized simultaneous scan); phase 2 walks
+// the stubborn residue with the same (count-stable-sorted a, b) pair order
+// and the same LIFO chain traversal, spending the same visit budget.
+// Returns 1 when the class emptied (colors updated in place), 0 when a
+// member resisted or the budget ran dry (colors then left PARTIALLY
+// modified — the caller passes a scratch copy, exactly like the Python
+// path), -1 on allocation failure.
+int32_t dgc_reduce_top_class(int64_t v, const int32_t* indptr,
+                             const int32_t* indices, int32_t* colors,
+                             int32_t c, int32_t max_pair_tries,
+                             int32_t chain_cap, int64_t kempe_max_class,
+                             int64_t* budget_remaining) {
+  try {
+    if (c < 1) return 0;
+    std::vector<int32_t> members;
+    for (int64_t i = 0; i < v; ++i)
+      if (colors[i] == c) members.push_back((int32_t)i);
+    bool kempe_ok = (int64_t)members.size() <= kempe_max_class;
+
+    // phase 1: first-fit below c for every member
+    std::vector<int32_t> used_epoch(c, -1);
+    std::vector<int32_t> stubborn;
+    int32_t epoch = 0;
+    for (int32_t m : members) {
+      ++epoch;
+      for (int32_t e = indptr[m]; e < indptr[m + 1]; ++e) {
+        int32_t nc = colors[indices[e]];
+        if (nc >= 0 && nc < c) used_epoch[nc] = epoch;
+      }
+      int32_t pick = -1;
+      for (int32_t col = 0; col < c; ++col)
+        if (used_epoch[col] != epoch) { pick = col; break; }
+      if (pick >= 0)
+        colors[m] = pick;
+      else
+        stubborn.push_back(m);
+    }
+    if (stubborn.empty()) return 1;
+    if (!kempe_ok) return 0;
+
+    // phase 2: Kempe moves for the stubborn residue
+    std::vector<int32_t> seen_epoch(v, -1), bn_epoch(v, -1);
+    std::vector<int32_t> stack, comp, counts(c);
+    int32_t ep = 0;
+    for (int32_t m : stubborn) {
+      // prior swaps may have freed a color here since phase 1
+      ++epoch;
+      for (int32_t e = indptr[m]; e < indptr[m + 1]; ++e) {
+        int32_t nc = colors[indices[e]];
+        if (nc >= 0 && nc < c) used_epoch[nc] = epoch;
+      }
+      int32_t pick = -1;
+      for (int32_t col = 0; col < c; ++col)
+        if (used_epoch[col] != epoch) { pick = col; break; }
+      if (pick >= 0) { colors[m] = pick; continue; }
+      if (*budget_remaining <= 0) return 0;
+
+      // (a, b) pairs cheapest-first: stable sort by neighbor-color count
+      std::fill(counts.begin(), counts.end(), 0);
+      for (int32_t e = indptr[m]; e < indptr[m + 1]; ++e) {
+        int32_t nc = colors[indices[e]];
+        if (nc >= 0 && nc < c) ++counts[nc];
+      }
+      std::vector<int32_t> order(c);
+      for (int32_t i = 0; i < c; ++i) order[i] = i;
+      std::stable_sort(order.begin(), order.end(),
+                       [&](int32_t x, int32_t y) { return counts[x] < counts[y]; });
+
+      bool moved = false;
+      int32_t tries = 0;
+      for (int32_t ai = 0; ai < c && !moved && tries <= max_pair_tries; ++ai) {
+        int32_t a = order[ai];
+        for (int32_t bi = 0; bi < c; ++bi) {
+          int32_t b = order[bi];
+          if (b == a) continue;
+          if (++tries > max_pair_tries) break;
+          // one chain attempt: swap every {a,b} component holding an
+          // a-colored neighbor of m, unless one also holds a b-neighbor
+          ++ep;
+          stack.clear();
+          comp.clear();
+          for (int32_t e = indptr[m]; e < indptr[m + 1]; ++e) {
+            int32_t w = indices[e];
+            if (colors[w] == b) bn_epoch[w] = ep;
+          }
+          for (int32_t e = indptr[m]; e < indptr[m + 1]; ++e) {
+            int32_t w = indices[e];
+            if (colors[w] == a) stack.push_back(w);
+          }
+          bool ok = true;
+          int64_t visited = 0;
+          while (!stack.empty()) {
+            int32_t u = stack.back();
+            stack.pop_back();
+            if (seen_epoch[u] == ep) continue;
+            seen_epoch[u] = ep;
+            ++visited;
+            if (colors[u] == b && bn_epoch[u] == ep) { ok = false; break; }
+            comp.push_back(u);
+            if ((int32_t)comp.size() > chain_cap) { ok = false; break; }
+            for (int32_t e = indptr[u]; e < indptr[u + 1]; ++e) {
+              int32_t w = indices[e];
+              int32_t cw = colors[w];
+              if ((cw == a || cw == b) && seen_epoch[w] != ep)
+                stack.push_back(w);
+            }
+          }
+          *budget_remaining -= visited;
+          if (ok) {
+            for (int32_t u : comp) colors[u] = (colors[u] == a) ? b : a;
+            colors[m] = a;
+            moved = true;
+            break;
+          }
+          if (*budget_remaining <= 0) return 0;
+        }
+      }
+      if (!moved) return 0;
+    }
+    return 1;
+  } catch (...) {
+    return -1;
+  }
+}
+
 }  // extern "C"

@@ -141,10 +141,17 @@ def _first_fit_members(indptr: np.ndarray, indices: np.ndarray,
     return first
 
 
+# shared by the Python path and the native call below — the two paths are
+# bit-identical only while these stay a single fact
+_MAX_PAIR_TRIES = 64
+_CHAIN_CAP = 1 << 14
+_KEMPE_MAX_CLASS = 1024
+
+
 def eliminate_top_class(indptr: np.ndarray, indices: np.ndarray,
-                        colors: np.ndarray, max_pair_tries: int = 64,
-                        chain_cap: int = 1 << 14,
-                        kempe_max_class: int = 1024,
+                        colors: np.ndarray, max_pair_tries: int = _MAX_PAIR_TRIES,
+                        chain_cap: int = _CHAIN_CAP,
+                        kempe_max_class: int = _KEMPE_MAX_CLASS,
                         budget: _WorkBudget | None = None) -> np.ndarray | None:
     """Try to empty the top color class (first-fit, then Kempe moves).
 
@@ -225,17 +232,55 @@ def eliminate_top_class(indptr: np.ndarray, indices: np.ndarray,
 _DEFAULT_WORK_LIMIT = 100_000
 
 
+# the native (C++) walk runs ~100x the Python BFS rate, so it affords a
+# 20x visit budget in far less wall-clock: measured ~0.9 s worst case at
+# 1M-uniform (all-failing chains), 8 ms typical at 1M-RMAT; every quality
+# win in the 300-draw ensembles landed under 200k visits
+_NATIVE_WORK_LIMIT = 2_000_000
+
+
 def reduce_color_count(indptr: np.ndarray, indices: np.ndarray,
                        colors: np.ndarray,
-                       work_limit: int = _DEFAULT_WORK_LIMIT) -> np.ndarray:
+                       work_limit: int | None = None,
+                       native: bool | None = None) -> np.ndarray:
     """Iteratively eliminate top color classes while every member can move.
 
     Always returns a valid coloring using ≤ the input's color count (the
     input itself when no class can be eliminated). ``work_limit`` bounds
-    total Kempe-walk vertex visits across all rounds.
+    total Kempe-walk vertex visits across all rounds. ``native=None``
+    auto-selects the C++ walk (``native.bindings.reduce_top_class_native``,
+    bit-identical at equal budgets) and falls back to the Python path.
     """
     colors = np.asarray(colors)
-    budget = _WorkBudget(work_limit)
+    fallback_limit = work_limit if work_limit is not None else _DEFAULT_WORK_LIMIT
+    if native is not False:
+        from dgc_tpu.native.bindings import reduce_top_class_native
+
+        remaining = work_limit if work_limit is not None else _NATIVE_WORK_LIMIT
+        result = colors
+        while True:
+            r = reduce_top_class_native(
+                indptr, indices, result, max_pair_tries=_MAX_PAIR_TRIES,
+                chain_cap=_CHAIN_CAP, kempe_max_class=_KEMPE_MAX_CLASS,
+                budget_remaining=remaining)
+            if r is None:  # library unavailable, or failed mid-run
+                break
+            nxt, remaining = r
+            if nxt is None:
+                return result
+            result = nxt
+        if native is True:
+            raise RuntimeError(
+                "native reduce requested but the library "
+                + ("failed mid-run" if result is not colors else "is unavailable"))
+        colors = result  # keep any progress the native rounds made
+        # visits the native rounds spent stay spent: the caller's
+        # work_limit bounds the TOTAL across both paths (when no explicit
+        # limit was given, also clamp to the cheaper Python default —
+        # the pure-Python walk must not inherit the native-scale budget)
+        fallback_limit = max(0, min(remaining, fallback_limit))
+
+    budget = _WorkBudget(fallback_limit)
     while True:
         nxt = eliminate_top_class(indptr, indices, colors, budget=budget)
         if nxt is None:

@@ -126,3 +126,32 @@ def test_build_combined_native_bit_identical():
                                       row0, row0 + cb.shape[0], cb.shape[1],
                                       v, native=True)
             assert np.array_equal(nat, cb)
+
+
+def test_reduce_top_class_native_bit_parity():
+    # the C++ Kempe walk must match the Python path bit-for-bit at EQUAL
+    # visit budgets (the default budgets differ on purpose — the native
+    # walk affords more — so parity is pinned at explicit limits)
+    import numpy as np
+    import pytest
+
+    from dgc_tpu.engine.bucketed import BucketedELLEngine
+    from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
+    from dgc_tpu.models.generators import generate_rmat_graph
+    from dgc_tpu.native.bindings import native_available
+    from dgc_tpu.ops.reduce_colors import reduce_color_count
+    from dgc_tpu.ops.validate import validate_coloring
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    for seed in (28, 34, 3):
+        g = generate_rmat_graph(800, avg_degree=8.0, seed=seed, native=False)
+        res = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1,
+                                    validate=make_validator(g))
+        for limit in (100_000, 3_000):
+            a = reduce_color_count(g.indptr, g.indices, res.colors,
+                                   work_limit=limit, native=True)
+            b = reduce_color_count(g.indptr, g.indices, res.colors,
+                                   work_limit=limit, native=False)
+            assert np.array_equal(a, b), (seed, limit)
+            assert validate_coloring(g.indptr, g.indices, a).valid
