@@ -255,9 +255,11 @@ def reduce_top_class_native(indptr: np.ndarray, indices: np.ndarray,
     """Native ``eliminate_top_class`` (see ``ops.reduce_colors`` — the two
     implementations are bit-identical by construction and tested so).
 
-    Returns ``(improved_colors | None, budget_remaining)``, or ``None``
-    (single value) when the native library is unavailable — the caller
-    then falls back to the Python path.
+    Returns ``(rc, improved_colors | None, budget_remaining)`` — rc 1:
+    class eliminated; 0: a member resisted; -1: the library failed mid-run
+    (budget_remaining still reflects visits it spent, so the caller's
+    total-work bound survives the fallback). Returns ``None`` (single
+    value) only when the library is unavailable.
     """
     lib = _load()
     if lib is None:
@@ -272,6 +274,4 @@ def reduce_top_class_native(indptr: np.ndarray, indices: np.ndarray,
         out, c, int(max_pair_tries), int(chain_cap), int(kempe_max_class),
         ctypes.byref(budget),
     )
-    if rc < 0:
-        return None  # allocation failure inside the library: fall back
-    return (out if rc == 1 else None), int(budget.value)
+    return int(rc), (out if rc == 1 else None), int(budget.value)

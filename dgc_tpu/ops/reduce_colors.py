@@ -263,9 +263,11 @@ def reduce_color_count(indptr: np.ndarray, indices: np.ndarray,
                 indptr, indices, result, max_pair_tries=_MAX_PAIR_TRIES,
                 chain_cap=_CHAIN_CAP, kempe_max_class=_KEMPE_MAX_CLASS,
                 budget_remaining=remaining)
-            if r is None:  # library unavailable, or failed mid-run
+            if r is None:  # library unavailable
                 break
-            nxt, remaining = r
+            rc, nxt, remaining = r
+            if rc < 0:  # failed mid-run; its spent visits still count
+                break
             if nxt is None:
                 return result
             result = nxt

@@ -7,7 +7,15 @@ tiny sharded k-attempt over the 2-process global mesh — the reference's
 cluster-config story (``/root/reference/coloring.py:190-199``) exercised for
 real rather than parsed.
 
-Usage: python tests/_multihost_worker.py PORT PROCESS_ID OUTDIR
+Usage: python tests/_multihost_worker.py PORT PROCESS_ID OUTDIR [MODE]
+
+MODE ``smoke`` (default): the engine/sweep assertions. MODE ``preempt``:
+minimal-k sweep with checkpointing where the FIRST launch of the pair
+self-terminates right after the fused pair's first half is checkpointed
+(a coordinated pod preemption); a relaunch with the same OUTDIR resumes
+from the per-process checkpoints and completes. The reference has no
+analog (SURVEY §5: no checkpointing) — this is the failure-recovery story
+the TPU build adds, exercised across real process boundaries.
 """
 
 import json
@@ -17,6 +25,7 @@ import sys
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
 port, pid, outdir = sys.argv[1], int(sys.argv[2]), sys.argv[3]
+mode = sys.argv[4] if len(sys.argv) > 4 else "smoke"
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
@@ -42,8 +51,45 @@ from dgc_tpu.models.generators import (  # noqa: E402
 )
 from dgc_tpu.parallel.mesh import make_mesh  # noqa: E402
 
-g = generate_random_graph(50, 5, seed=7)  # same seed on both processes
 mesh = make_mesh(len(jax.devices()))
+
+if mode == "preempt":
+    from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
+    from dgc_tpu.utils.checkpoint import CheckpointManager, graph_fingerprint
+
+    gp = generate_rmat_graph(256, avg_degree=6, seed=9, native=False)
+    eng = ShardedBucketedEngine(gp, mesh=mesh)
+    ckpt = CheckpointManager(
+        os.path.join(outdir, f"ck_{pid}"),
+        fingerprint=graph_fingerprint(gp, "sharded-bucketed", False),
+    )
+    first_launch = not os.path.exists(os.path.join(outdir, f"launched_{pid}"))
+    open(os.path.join(outdir, f"launched_{pid}"), "w").write("x")
+    calls = 0
+
+    def preempt(res, val):
+        # on_attempt fires BEFORE checkpoint.save, so dying on the SECOND
+        # callback leaves exactly the pair's first half saved — both
+        # processes reach this point together (the sweep's device call has
+        # already completed on both), so nobody hangs in a collective
+        global calls
+        calls += 1
+        if first_launch and calls == 2:
+            os._exit(7)
+
+    result = find_minimal_coloring(
+        eng, gp.max_degree + 1, validate=make_validator(gp),
+        checkpoint=ckpt, on_attempt=preempt,
+    )
+    with open(os.path.join(outdir, f"preempt_result_{pid}.json"), "w") as f:
+        json.dump({"minimal_colors": result.minimal_colors,
+                   "colors": result.colors.tolist(),
+                   "attempts": [[a.k, int(a.status)] for a in result.attempts],
+                   "info": process_info()}, f)
+    print(f"worker {pid} preempt-resume OK")
+    sys.exit(0)
+
+g = generate_random_graph(50, 5, seed=7)  # same seed on both processes
 engine = ShardedELLEngine(g, mesh=mesh)
 res = engine.attempt(g.max_degree + 1)
 assert res.status == AttemptStatus.SUCCESS, res.status

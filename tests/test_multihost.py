@@ -30,6 +30,49 @@ def test_process_info_shape():
     assert set(info) == {"process_index", "process_count", "local_devices", "global_devices"}
 
 
+def _launch_workers(tmp_path, mode=None):
+    """Launch the 2-process worker pair (fresh coordinator port) and wait.
+
+    Scrubs the backend-pinning sitecustomize and any forced device counts;
+    each process gets one CPU device so the global mesh spans processes. A
+    hung coordinator handshake must not leak workers, hence the kill in
+    the finally. Returns ``(returncodes, outputs)``.
+    """
+    import os
+    import socket
+    import subprocess
+    import sys
+
+    with socket.socket() as s:  # free port for the coordinator
+        s.bind(("localhost", 0))
+        port = s.getsockname()[1]
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    worker = os.path.join(repo, "tests", "_multihost_worker.py")
+    env = {k: v for k, v in os.environ.items()}
+    env.pop("PYTHONPATH", None)
+    env.pop("XLA_FLAGS", None)
+    env["JAX_PLATFORMS"] = "cpu"
+
+    procs = [
+        subprocess.Popen(
+            [sys.executable, worker, str(port), str(pid), str(tmp_path)]
+            + ([mode] if mode else []),
+            env=env, cwd=repo,
+            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
+        )
+        for pid in (0, 1)
+    ]
+    try:
+        outs = [p.communicate(timeout=300)[0] for p in procs]
+    finally:
+        for p in procs:
+            if p.poll() is None:
+                p.kill()
+                p.wait()
+    return [p.returncode for p in procs], outs
+
+
 @pytest.mark.slow
 def test_two_process_distributed_smoke(tmp_path):
     """Actually executes ``jax.distributed.initialize`` (the explicit-
@@ -40,43 +83,12 @@ def test_two_process_distributed_smoke(tmp_path):
     the reference's cluster-config story (coloring.py:190-199) exercised
     for real."""
     import json
-    import os
-    import socket
-    import subprocess
-    import sys
 
     import numpy as np
 
-    with socket.socket() as s:  # free port for the coordinator
-        s.bind(("localhost", 0))
-        port = s.getsockname()[1]
-
-    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
-    worker = os.path.join(repo, "tests", "_multihost_worker.py")
-    env = {k: v for k, v in os.environ.items()}
-    # scrub the backend-pinning sitecustomize and any forced device counts;
-    # each process gets one CPU device so the global mesh spans processes
-    env.pop("PYTHONPATH", None)
-    env.pop("XLA_FLAGS", None)
-    env["JAX_PLATFORMS"] = "cpu"
-
-    procs = [
-        subprocess.Popen(
-            [sys.executable, worker, str(port), str(pid), str(tmp_path)],
-            env=env, cwd=repo,
-            stdout=subprocess.PIPE, stderr=subprocess.STDOUT, text=True,
-        )
-        for pid in (0, 1)
-    ]
-    try:
-        outs = [p.communicate(timeout=300)[0] for p in procs]
-    finally:
-        for p in procs:  # a hung coordinator handshake must not leak workers
-            if p.poll() is None:
-                p.kill()
-                p.wait()
-    for p, out in zip(procs, outs):
-        assert p.returncode == 0, f"worker failed:\n{out}"
+    rcs, outs = _launch_workers(tmp_path)
+    for rc, out in zip(rcs, outs):
+        assert rc == 0, f"worker failed:\n{out}"
 
     results = [json.load(open(tmp_path / f"result_{pid}.json")) for pid in (0, 1)]
     for pid, r in enumerate(results):
@@ -106,3 +118,44 @@ def test_two_process_distributed_smoke(tmp_path):
     refb = BucketedELLEngine(gr).attempt(gr.max_degree + 1)
     assert np.array_equal(np.array(results[0]["rmat_colors"]), refb.colors)
     assert results[0]["sweep_confirm_k"] == refb.colors_used - 1
+
+
+@pytest.mark.slow
+def test_two_process_preemption_resume(tmp_path):
+    """Failure recovery across real process boundaries: a 2-process sweep
+    with checkpointing is preempted after the fused pair's first half
+    (both workers exit 7), relaunched with the same state dir, and must
+    complete bit-identically to an uninterrupted single-process sweep.
+    The reference delegates failure handling to Spark lineage (SURVEY §5);
+    this pins the TPU build's replacement story end to end."""
+    import json
+
+    import numpy as np
+
+    rcs, outs = _launch_workers(tmp_path, mode="preempt")
+    assert rcs == [7, 7], f"expected coordinated preemption:\n{outs}"
+    assert not (tmp_path / "preempt_result_0.json").exists()
+
+    rcs, outs = _launch_workers(tmp_path, mode="preempt")  # resume
+    assert rcs == [0, 0], f"resume failed:\n{outs}"
+
+    results = [json.load(open(tmp_path / f"preempt_result_{pid}.json"))
+               for pid in (0, 1)]
+    for key in ("minimal_colors", "colors", "attempts"):
+        assert results[0][key] == results[1][key], key
+    assert results[0]["info"]["process_count"] == 2
+
+    # bit-identical to an uninterrupted run: sharded-bucketed matches the
+    # single-device bucketed engine, whose sweep is the parity reference
+    from dgc_tpu.engine.bucketed import BucketedELLEngine
+    from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
+    from dgc_tpu.models.generators import generate_rmat_graph
+
+    gp = generate_rmat_graph(256, avg_degree=6, seed=9, native=False)
+    ref = find_minimal_coloring(BucketedELLEngine(gp), gp.max_degree + 1,
+                                validate=make_validator(gp))
+    assert results[0]["minimal_colors"] == ref.minimal_colors
+    assert np.array_equal(np.array(results[0]["colors"]), ref.colors)
+    # the resumed run re-executes only the confirm tail: restored first
+    # half + the re-swept remainder
+    assert results[0]["attempts"][0][0] == ref.attempts[0].k
