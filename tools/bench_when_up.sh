@@ -24,5 +24,10 @@ until probe; do
 done
 echo "$(date -u +%H:%M:%S) tunnel up - starting battery" | tee -a /dev/stderr >/dev/null
 # we are in the repo root (cd above), so the suite path is fixed —
-# dirname "$0" would be wrong here after a relative invocation
-exec bash tools/bench_suite.sh "$@"
+# dirname "$0" would be wrong here after a relative invocation.
+# evidence_suite = battery + rate probe + trace attribution + cold
+# compile; set DGC_TPU_BATTERY_ONLY=1 to run just the battery.
+if [ "${DGC_TPU_BATTERY_ONLY:-0}" = "1" ]; then
+  exec bash tools/bench_suite.sh "$@"
+fi
+exec bash tools/evidence_suite.sh "$@"

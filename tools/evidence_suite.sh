@@ -1,0 +1,40 @@
+#!/usr/bin/env bash
+# The round's full TPU evidence capture, one command:
+#   1. the benchmark battery (tools/bench_suite.sh — PERF.md's tables),
+#   2. the gather-rate probe (tools/rate_probe.py),
+#   3. an xplane trace attribution of the 200k-RMAT attempt
+#      (tools/trace_attempt.py — the rate-gap decomposition),
+#   4. a cold-compile measurement of the unified heavy-tail pipeline at
+#      1M-RMAT (the round-3 lever's first real-TPU number).
+# Run via tools/bench_when_up.sh to fire unattended on tunnel recovery:
+#   bash tools/bench_when_up.sh   # (watcher delegates here when EVIDENCE=1)
+# or directly once the tunnel is up:
+#   bash tools/evidence_suite.sh [outfile]
+set -u
+cd "$(dirname "$0")/.."
+OUT="${1:-PERF_RUNS.jsonl}"
+
+bash tools/bench_suite.sh "$OUT"
+battery_rc=$?
+
+# the probes are best-effort: a battery abort (rc 2) means the tunnel is
+# gone again — skip them rather than hang
+if [ "$battery_rc" -ne 2 ]; then
+  echo "=== rate probe ===" | tee -a /dev/stderr >/dev/null
+  timeout 1800 python tools/rate_probe.py 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> rate_probe_r4.jsonl || true
+
+  echo "=== trace attribution (200k RMAT attempt) ===" | tee -a /dev/stderr >/dev/null
+  timeout 3600 python tools/trace_attempt.py --nodes 200000 --gen rmat \
+    --logdir /tmp/dgc_trace_r4 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> trace_attr_r4.json || true
+
+  echo "=== cold compile, unified pipeline 1M-RMAT ===" | tee -a /dev/stderr >/dev/null
+  # fresh cache dir = genuinely cold compile; report warmup line only
+  JAX_COMPILATION_CACHE_DIR=$(mktemp -d) timeout 3600 \
+    python bench.py --gen rmat --nodes 1000000 --include-compile 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+fi
+
+echo "evidence capture done (battery rc=$battery_rc)" >&2
+exit "$battery_rc"
