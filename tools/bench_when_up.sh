@@ -1,8 +1,8 @@
 #!/usr/bin/env bash
 # Poll the axon tunnel with cheap probes; the moment device init succeeds,
-# delegate to bench_suite.sh (the one authoritative config list). Useful
-# when the tunnel is down and the battery should fire unattended on
-# recovery:
+# delegate to evidence_suite.sh (battery + probes; DGC_TPU_BATTERY_ONLY=1
+# for bench_suite.sh alone). Useful when the tunnel is down and the
+# capture should fire unattended on recovery:
 #
 #   bash tools/bench_when_up.sh [outfile]
 set -u

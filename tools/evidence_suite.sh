@@ -6,9 +6,9 @@
 #      (tools/trace_attempt.py — the rate-gap decomposition),
 #   4. a cold-compile measurement of the unified heavy-tail pipeline at
 #      1M-RMAT (the round-3 lever's first real-TPU number).
-# Run via tools/bench_when_up.sh to fire unattended on tunnel recovery:
-#   bash tools/bench_when_up.sh   # (watcher delegates here when EVIDENCE=1)
-# or directly once the tunnel is up:
+# tools/bench_when_up.sh delegates here BY DEFAULT on tunnel recovery
+# (set DGC_TPU_BATTERY_ONLY=1 there for just the battery); or run
+# directly once the tunnel is up:
 #   bash tools/evidence_suite.sh [outfile]
 set -u
 cd "$(dirname "$0")/.."
@@ -27,13 +27,18 @@ if [ "$battery_rc" -ne 2 ]; then
   echo "=== trace attribution (200k RMAT attempt) ===" | tee -a /dev/stderr >/dev/null
   timeout 3600 python tools/trace_attempt.py --nodes 200000 --gen rmat \
     --logdir /tmp/dgc_trace_r4 2>&1 \
-    | tee -a /dev/stderr | grep '^{' >> trace_attr_r4.json || true
+    | tee -a /dev/stderr | grep '^{' >> trace_attr_r4.jsonl || true
 
   echo "=== cold compile, unified pipeline 1M-RMAT ===" | tee -a /dev/stderr >/dev/null
-  # fresh cache dir = genuinely cold compile; report warmup line only
-  JAX_COMPILATION_CACHE_DIR=$(mktemp -d) timeout 3600 \
+  # fresh cache dir = genuinely cold compile (removed after); outer
+  # timeout sits ABOVE bench.py's 5400s in-process deadline so the
+  # cleaner labeled abort always wins; aborted records stay out of the
+  # jsonl like the battery's
+  COLD_CACHE=$(mktemp -d)
+  JAX_COMPILATION_CACHE_DIR="$COLD_CACHE" timeout 6000 \
     python bench.py --gen rmat --nodes 1000000 --include-compile 2>&1 \
-    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+    | tee -a /dev/stderr | grep '^{' | grep -v '"bench_aborted' >> "$OUT" || true
+  rm -rf "$COLD_CACHE"
 fi
 
 echo "evidence capture done (battery rc=$battery_rc)" >&2
