@@ -304,6 +304,18 @@ def _main(argv=None) -> int:
             print(f"{name:12} {vol/1e6:10.1f}M", file=sys.stderr)
     ncol = int(traj.colors.max()) + 1 if traj.colors is not None else 64
     tail = price_edge_tail(price, traj, ncol)
+
+    # honest seconds bracket: PERF.md round-3 predictions converted at the
+    # primitive large-gather rate and ran 2-3x optimistic against measured
+    # sweeps — the staged kernels' EFFECTIVE rate is ~45-55M lookups/s
+    # (PERF.md "Primitive rates" / rate_probe). Publish both endpoints so
+    # a prediction is a bracket, not a point estimate.
+    rows = sum(price.row_gathers.values())
+    pred = {
+        f"predicted_s_at_{int(r / 1e6)}M": round(
+            price.total / r + rows / 6e6, 2)
+        for r in (50e6, 120e6)
+    }
     print(json.dumps({
         "supersteps": traj.supersteps,
         "steps_per_stage": price.steps_per_stage,
@@ -312,6 +324,7 @@ def _main(argv=None) -> int:
         "over_floor": round(price.over_floor(), 3),
         "terms": price.terms,
         "row_gathers": price.row_gathers,
+        "attempt_seconds_bracket": pred,
         "complexity": program_complexity(eng),
         "edge_tail": {
             "entry_step": tail.entry_step,
