@@ -1,5 +1,7 @@
-"""JIT kernels and array ops: bitmask first-fit, ELL/dense supersteps, validation."""
+"""JIT kernels and array ops: bitmask first-fit, ELL/dense supersteps,
+validation, and the color-count reduction post-pass."""
 
+from dgc_tpu.ops.reduce_colors import reduce_color_count
 from dgc_tpu.ops.validate import validate_coloring, ValidationResult
 
-__all__ = ["validate_coloring", "ValidationResult"]
+__all__ = ["validate_coloring", "ValidationResult", "reduce_color_count"]
