@@ -1,0 +1,41 @@
+"""bench.py contract tests — the driver captures the round's number by
+running ``python bench.py`` and parsing ONE JSON line from stdout, so the
+line's schema is a hard interface, not an implementation detail."""
+
+import json
+import os
+import subprocess
+import sys
+
+
+def _run_bench(*args, env_extra=None):
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    env = {k: v for k, v in os.environ.items()}
+    env["PYTHONPATH"] = repo  # keep the axon sitecustomize off the path
+    env["JAX_PLATFORMS"] = "cpu"
+    env.update(env_extra or {})
+    return subprocess.run(
+        [sys.executable, os.path.join(repo, "bench.py"), *args],
+        env=env, cwd=repo, capture_output=True, text=True, timeout=600,
+    )
+
+
+def test_bench_emits_contract_json():
+    r = _run_bench("--nodes", "400", "--avg-degree", "6")
+    assert r.returncode == 0, r.stderr
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    # the driver contract fields
+    assert set(d) >= {"metric", "value", "unit", "vs_baseline"}
+    assert d["unit"] == "s" and d["value"] > 0
+    # round-4 companions: pass timed beside the sweep, counts unambiguous
+    assert d["post_reduce_colors"] <= d["sweep_colors"]
+    assert d["post_reduce_s"] >= 0
+
+
+def test_bench_help_is_robust_to_malformed_env():
+    r = _run_bench("--help", env_extra={"DGC_TPU_BENCH_PROBE_TIMEOUT": "junk",
+                                        "DGC_TPU_BENCH_RUN_TIMEOUT": ""})
+    assert r.returncode == 0, r.stderr
+    assert "--probe-timeout" in r.stdout
