@@ -114,6 +114,72 @@ def main() -> int:
                     rate_M_per_s=round(elems / sec / 1e6, 1),
                     per_iter_us=round(sec / iters * 1e6, 1)))
 
+    # switch-routed gather: the unified pipeline dispatches each
+    # superstep's stage body through lax.switch — measures whether the
+    # routing itself (branch selection, no fusion across the switch)
+    # taxes the same gather the plain loop case runs. Compare
+    # loop_switch3_mid vs loop_mid_65536x64: same shape, same volume.
+    idx_mid = jnp.asarray(
+        rng.integers(0, v, (65536, 64), dtype=np.int64).astype(np.int32))
+
+    def switched(table, idx, iters):
+        def mk(off):
+            def br(acc):
+                return jnp.sum(table[(idx + (acc + off) % v) % v])
+            return br
+
+        def body(c):
+            i, acc = c
+            s = jnp.sum(table[(idx_mid[0] + acc) % v]) % 3  # data-dep route
+            g = jax.lax.switch(s, [mk(0), mk(1), mk(2)], acc)
+            return i + 1, acc + g
+
+        return jax.lax.while_loop(lambda c: c[0] < iters, body,
+                                  (jnp.int32(0), jnp.int32(0)))[1]
+
+    f = jax.jit(switched, static_argnums=2)
+    sec = timed(f, table, idx_mid, iters)
+    elems = 65536 * 64 * iters
+    out.append(dict(case="loop_switch3_mid", iters=iters, total_elems=elems,
+                    seconds=round(sec, 4),
+                    rate_M_per_s=round(elems / sec / 1e6, 1),
+                    per_iter_us=round(sec / iters * 1e6, 1)))
+
+    # many-small vs one-large at EQUAL volume: eight dependent 512x64
+    # gathers per iteration vs one 4096x64 — isolates small-gather
+    # underutilization (the heavy-tail stage/hub shapes are small)
+    idx_small = [jnp.asarray(rng.integers(0, v, (512, 64),
+                                          dtype=np.int64).astype(np.int32))
+                 for _ in range(8)]
+
+    def many_small(table, iters, *idxs):
+        def body(c):
+            i, acc = c
+            for ix in idxs:  # dependent chain, like sequential hub buckets
+                acc = acc + jnp.sum(table[(ix + acc % 3) % v])
+            return i + 1, acc
+
+        return jax.lax.while_loop(lambda c: c[0] < iters, body,
+                                  (jnp.int32(0), jnp.int32(0)))[1]
+
+    f = jax.jit(many_small, static_argnums=1)
+    sec = timed(f, table, iters, *idx_small)
+    elems = 8 * 512 * 64 * iters
+    out.append(dict(case="loop_8x512x64_chain", iters=iters,
+                    total_elems=elems, seconds=round(sec, 4),
+                    rate_M_per_s=round(elems / sec / 1e6, 1),
+                    per_iter_us=round(sec / iters * 1e6, 1)))
+
+    idx_one = jnp.asarray(rng.integers(0, v, (4096, 64),
+                                       dtype=np.int64).astype(np.int32))
+    f = jax.jit(loop_gather, static_argnums=2)
+    sec = timed(f, table, idx_one, iters)
+    elems = 4096 * 64 * iters
+    out.append(dict(case="loop_4096x64_single", iters=iters,
+                    total_elems=elems, seconds=round(sec, 4),
+                    rate_M_per_s=round(elems / sec / 1e6, 1),
+                    per_iter_us=round(sec / iters * 1e6, 1)))
+
     # empty loop: pure per-iteration overhead
     def empty(iters):
         return jax.lax.while_loop(lambda c: c[0] < iters,
