@@ -264,7 +264,9 @@ def reduce_top_class_native(indptr: np.ndarray, indices: np.ndarray,
     lib = _load()
     if lib is None:
         return None
-    out = np.ascontiguousarray(colors, dtype=np.int32).copy()
+    # one guaranteed copy (scratch the C walk may leave partially modified),
+    # never two: ascontiguousarray().copy() would re-copy a non-contiguous input
+    out = np.array(colors, dtype=np.int32, order="C", copy=True)
     c = int(out.max())
     budget = ctypes.c_int64(int(budget_remaining))
     rc = lib.dgc_reduce_top_class(
