@@ -22,6 +22,14 @@ import numpy as np
 from dgc_tpu.models.graph import Graph
 from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
 from dgc_tpu.utils.logging import RunLogger
+from dgc_tpu.utils.watchdog import env_float, guarded_device_init
+
+# backends that touch JAX devices (and therefore hang, not raise, when the
+# remote tunnel is down); reference-sim/oracle are pure NumPy
+_JAX_BACKENDS = frozenset({
+    "ell", "ell-bucketed", "ell-compact", "dense",
+    "sharded", "sharded-bucketed", "sharded-ring",
+})
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -67,6 +75,19 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--sim-variant", choices=["optimized", "baseline"], default="optimized",
                    help="reference-sim backend: which reference engine's semantics")
+    # same outage armor as bench.py: under the image's remote-tunnel
+    # backend, device init BLOCKS forever (no exception) when the tunnel
+    # is down — without this the CLI hangs silently where the reference
+    # fails noisily on a missing Spark
+    p.add_argument(
+        "--probe-timeout", type=float,
+        default=env_float("DGC_TPU_CLI_PROBE_TIMEOUT", 25.0),
+        help="seconds to allow device init before declaring the backend "
+             "unreachable and exiting (rc 113); 0 disables the watchdog; "
+             "only device-backed backends probe (reference-sim/oracle are "
+             "host-only); the multi-host coordinator handshake is NOT "
+             "under this clock",
+    )
     p.add_argument(
         "--no-reduce-colors",
         action="store_true",
@@ -79,13 +100,29 @@ def build_parser() -> argparse.ArgumentParser:
 
 def make_engine(args, graph: Graph, logger=None):
     arrays = graph.arrays
-    if args.backend in ("sharded", "sharded-bucketed", "sharded-ring"):
-        # multi-host: no-op single-process; spans the pod when configured
-        from dgc_tpu.parallel.multihost import initialize_multihost, process_info
+    if args.backend in _JAX_BACKENDS:
+        # initialize_multihost must precede any backend init
+        # (parallel/multihost.py) and is NOT under the watchdog: its
+        # coordinator barrier legitimately blocks until every host joins
+        # (minutes on pod schedulers), which is not a dead tunnel.
+        if args.backend in ("sharded", "sharded-bucketed", "sharded-ring"):
+            # multi-host: no-op single-process; spans the pod when configured
+            from dgc_tpu.parallel.multihost import initialize_multihost, process_info
 
-        multi = initialize_multihost()
+            multi = initialize_multihost()
+            if logger is not None:
+                logger.event("distributed", multi_process=multi, **process_info())
+        # first device touch, bounded: a dead tunnel aborts with a labeled
+        # diagnostic instead of hanging the user's terminal forever
+        devices = guarded_device_init(
+            getattr(args, "probe_timeout",
+                    env_float("DGC_TPU_CLI_PROBE_TIMEOUT", 25.0)),
+            what=f"device init for --backend {args.backend}",
+        )
         if logger is not None:
-            logger.event("distributed", multi_process=multi, **process_info())
+            logger.event("devices", count=len(devices),
+                         platform=devices[0].platform,
+                         device_kind=devices[0].device_kind)
     if args.backend == "ell":
         from dgc_tpu.engine.superstep import ELLEngine
         return ELLEngine(arrays)
@@ -193,7 +230,8 @@ def _run(args, logger: RunLogger) -> int:
     if result.minimal_colors is not None and result.swept_colors is not None \
             and result.minimal_colors < result.swept_colors:
         logger.event("post_reduce", from_colors=result.swept_colors,
-                     to_colors=result.minimal_colors)
+                     to_colors=result.minimal_colors,
+                     time_s=round(result.post_reduce_s, 4))
 
     total_s = time.perf_counter() - t_start
     if result.colors is None:

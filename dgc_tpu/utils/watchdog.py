@@ -1,0 +1,103 @@
+"""Backend-outage watchdog — bounds operations that block forever when the
+remote device tunnel is down.
+
+Under the image's remote-tunnel backend, ``jax.devices()`` (and any remote
+compile) BLOCKS indefinitely when the tunnel is down — there is no
+exception to catch (the hazard ``__graft_entry__.py`` documents for the
+dry run) — so the bound comes from a watchdog thread around the *real*
+work, not a separate probe: healthy runs set the returned Event, cancel
+the timer, and pay no second backend init.
+
+Shared by ``bench.py`` (which prints a null JSON record on abort so a
+missing measurement can never masquerade as one) and ``dgc_tpu.cli`` (a
+labeled stderr diagnostic). Both exit ``ABORT_RC`` on abort. The
+reference fails noisily when Spark is absent (`coloring.py:190-198` —
+session creation raises); this is the equivalent noisy failure for a
+backend that hangs instead of raising.
+"""
+
+from __future__ import annotations
+
+import os
+import sys
+import threading
+from typing import Callable
+
+# watchdog exit code: distinctive on purpose — argparse usage errors exit 2
+# and Python tracebacks exit 1, so callers (bench_suite.sh, shell drivers)
+# can tell a backend-loss abort apart from an ordinary bug
+ABORT_RC = 113
+
+
+def env_float(name: str, default: float) -> float:
+    """Float from the environment; malformed values warn and fall back."""
+    raw = os.environ.get(name)
+    if not raw:
+        return default
+    try:
+        return float(raw)
+    except ValueError:
+        print(f"# ignoring malformed {name}={raw!r}", file=sys.stderr)
+        return default
+
+
+def start_watchdog(
+    timeout_s: float,
+    what: str,
+    *,
+    on_abort: Callable[[str], None] | None = None,
+    abort_rc: int = ABORT_RC,
+) -> threading.Event:
+    """Abort the process if ``what`` is still pending after ``timeout_s``.
+
+    Returns the Event to set when the guarded operation completes. If
+    ``on_abort`` is given it runs with the diagnostic string before the
+    process exits (e.g. bench.py prints its null JSON record there);
+    otherwise a labeled ERROR line goes to stderr. Exit is via
+    ``os._exit`` — the hung backend thread cannot be interrupted, so a
+    normal exit would block on it.
+    """
+    done = threading.Event()
+
+    def _fire() -> None:
+        if done.wait(timeout_s):
+            return
+        diag = (
+            f"backend unreachable: {what} exceeded {timeout_s:.0f}s "
+            f"(JAX_PLATFORMS={os.environ.get('JAX_PLATFORMS', '')!r} — tunnel down?)"
+        )
+        if on_abort is not None:
+            on_abort(diag)
+        else:
+            print(f"ERROR: {diag}", file=sys.stderr)
+        sys.stderr.flush()
+        os._exit(abort_rc)
+
+    threading.Thread(target=_fire, daemon=True).start()
+    return done
+
+
+def guarded_device_init(
+    timeout_s: float,
+    *,
+    what: str = "device init",
+    on_abort: Callable[[str], None] | None = None,
+):
+    """Run ``jax.devices()`` under a watchdog; returns the device list.
+
+    ``timeout_s <= 0`` disables the watchdog (the raw blocking behavior).
+    Healthy paths pay one cheap cached-device lookup; the first call does
+    the real backend init, which is exactly the operation that hangs on a
+    dead tunnel.
+    """
+    ok = (
+        start_watchdog(timeout_s, what, on_abort=on_abort)
+        if timeout_s and timeout_s > 0
+        else None
+    )
+    import jax
+
+    devices = jax.devices()
+    if ok is not None:
+        ok.set()
+    return devices

@@ -55,7 +55,7 @@ run() {
   # but still count as failures in the battery's exit code.
   if [ "$ABORTED" -ne 0 ]; then return 0; fi
   echo "=== $* ===" | tee -a /dev/stderr >/dev/null
-  "${OUTER[@]}" python bench.py "$@" 2>&1 \
+  ${OUTER[@]+"${OUTER[@]}"} python bench.py "$@" 2>&1 \
     | tee -a /dev/stderr | grep '^{' | grep -v '"bench_aborted' >> "$OUT"
   local rcs=("${PIPESTATUS[@]}")
   if [ "${rcs[0]}" -ne 0 ] || [ "${rcs[2]}" -ne 0 ] || [ "${rcs[3]}" -ne 0 ]; then
