@@ -38,6 +38,7 @@ class MinimalColoringResult:
     wall_time_s: float = 0.0
     validation: ValidationResult | None = None
     swept_colors: int | None = None   # count before the post_reduce pass (== minimal_colors when it didn't fire)
+    post_reduce_s: float = 0.0        # wall-clock of the post_reduce pass (0 when not run)
 
     @property
     def total_supersteps(self) -> int:
@@ -126,7 +127,9 @@ def find_minimal_coloring(
         result.swept_colors = best.colors_used
         result.colors = best.colors
         if post_reduce is not None:
+            t_reduce = time.perf_counter()
             reduced = post_reduce(best.colors)
+            result.post_reduce_s = time.perf_counter() - t_reduce
             reduced_used = int(reduced.max()) + 1
             if reduced_used < result.minimal_colors:
                 result.minimal_colors = reduced_used

@@ -32,6 +32,12 @@ def test_bench_emits_contract_json():
     # round-4 companions: pass timed beside the sweep, counts unambiguous
     assert d["post_reduce_colors"] <= d["sweep_colors"]
     assert d["post_reduce_s"] >= 0
+    # round-5: the user-visible wall-clock (sweep + pass + validation)
+    # must be published beside the sweep metric as an exact identity over
+    # the rounded fields, so headline and experienced time can't drift
+    assert d["validate_s"] >= 0
+    expected = round(d["value"] + d["post_reduce_s"] + d["validate_s"], 4)
+    assert abs(d["total_s"] - expected) < 1e-9, d
 
 
 def test_bench_help_is_robust_to_malformed_env():
