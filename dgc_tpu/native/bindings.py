@@ -264,6 +264,15 @@ def reduce_top_class_native(indptr: np.ndarray, indices: np.ndarray,
     lib = _load()
     if lib is None:
         return None
+    # the C walk is int32; a CSR with ≥2^31 directed edges — or ≥2^31
+    # vertices, which the indices values and the vertex-count argument
+    # would also overflow — would silently truncate in the casts below.
+    # Report unavailable so the caller's Python path (arbitrary dtype)
+    # handles it. No in-repo producer hits this (GraphArrays is int32
+    # throughout), but this is public API.
+    i32max = np.iinfo(np.int32).max
+    if int(indptr[-1]) > i32max or int(indptr.shape[0]) - 1 > i32max:
+        return None
     # one guaranteed copy (scratch the C walk may leave partially modified),
     # never two: ascontiguousarray().copy() would re-copy a non-contiguous input
     out = np.array(colors, dtype=np.int32, order="C", copy=True)

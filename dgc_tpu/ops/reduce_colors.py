@@ -239,6 +239,18 @@ _DEFAULT_WORK_LIMIT = 100_000
 _NATIVE_WORK_LIMIT = 2_000_000
 
 
+# diagnostic record of the last reduce_color_count call: which walk ran —
+# "native" (C walk completed), "python" (C library unavailable),
+# "native+python" (C walk made progress then fell back), or
+# "native-failed+python" (C walk failed mid-run with no progress; its
+# spent visits still shrank the Python budget) — and the visit budget each
+# was given. Default-mode output legitimately differs across machines
+# with/without the C toolchain (the native walk affords a 20x budget —
+# ADVICE r4); this makes a cross-machine count difference attributable.
+# bench.py prints it beside post_reduce.
+last_run: dict = {}
+
+
 def reduce_color_count(indptr: np.ndarray, indices: np.ndarray,
                        colors: np.ndarray,
                        work_limit: int | None = None,
@@ -253,17 +265,21 @@ def reduce_color_count(indptr: np.ndarray, indices: np.ndarray,
     """
     colors = np.asarray(colors)
     fallback_limit = work_limit if work_limit is not None else _DEFAULT_WORK_LIMIT
+    last_run.clear()
     if native is not False:
         from dgc_tpu.native.bindings import reduce_top_class_native
 
         remaining = work_limit if work_limit is not None else _NATIVE_WORK_LIMIT
+        last_run.update(path="native", native_budget=remaining)
+        unavailable = False
         result = colors
         while True:
             r = reduce_top_class_native(
                 indptr, indices, result, max_pair_tries=_MAX_PAIR_TRIES,
                 chain_cap=_CHAIN_CAP, kempe_max_class=_KEMPE_MAX_CLASS,
                 budget_remaining=remaining)
-            if r is None:  # library unavailable
+            if r is None:
+                unavailable = True
                 break
             rc, nxt, remaining = r
             if rc < 0:  # failed mid-run; its spent visits still count
@@ -271,18 +287,30 @@ def reduce_color_count(indptr: np.ndarray, indices: np.ndarray,
             if nxt is None:
                 return result
             result = nxt
+        progressed = result is not colors
         if native is True:
+            # the discriminator is tracked, not inferred from progress: a
+            # first-round mid-run failure is NOT "unavailable" (ADVICE r4)
             raise RuntimeError(
                 "native reduce requested but the library "
-                + ("failed mid-run" if result is not colors else "is unavailable"))
+                + ("is unavailable" if unavailable else "failed mid-run"))
         colors = result  # keep any progress the native rounds made
         # visits the native rounds spent stay spent: the caller's
         # work_limit bounds the TOTAL across both paths (when no explicit
         # limit was given, also clamp to the cheaper Python default —
         # the pure-Python walk must not inherit the native-scale budget)
         fallback_limit = max(0, min(remaining, fallback_limit))
+        if unavailable:
+            # no native walk ran at all — drop its budget from the record
+            last_run.clear()
+            last_run["path"] = "python"
+        else:
+            last_run["path"] = ("native+python" if progressed
+                                else "native-failed+python")
 
     budget = _WorkBudget(fallback_limit)
+    last_run.setdefault("path", "python")
+    last_run["python_budget"] = fallback_limit
     while True:
         nxt = eliminate_top_class(indptr, indices, colors, budget=budget)
         if nxt is None:

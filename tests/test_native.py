@@ -155,3 +155,18 @@ def test_reduce_top_class_native_bit_parity():
                                    work_limit=limit, native=False)
             assert np.array_equal(a, b), (seed, limit)
             assert validate_coloring(g.indptr, g.indices, a).valid
+
+
+def test_reduce_top_class_native_rejects_int32_overflow_csr():
+    # ADVICE r4: public API must not silently truncate a >2^31-edge CSR in
+    # the int32 cast — it reports unavailable so callers take the Python
+    # path. The CSR here is fake (only indptr[-1] matters for the guard).
+    from dgc_tpu.native.bindings import reduce_top_class_native
+
+    indptr = np.array([0, np.iinfo(np.int32).max + 5], dtype=np.int64)
+    indices = np.zeros(4, dtype=np.int32)  # never dereferenced past guard
+    colors = np.zeros(1, dtype=np.int32)
+    assert reduce_top_class_native(indptr, indices, colors,
+                                   max_pair_tries=1, chain_cap=1,
+                                   kempe_max_class=1,
+                                   budget_remaining=10) is None

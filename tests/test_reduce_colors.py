@@ -122,3 +122,51 @@ def test_known_plus2_seeds_within_contract():
                                   validate=make_validator(g))
         assert a.minimal_colors - b.minimal_colors <= 1, \
             (seed, a.minimal_colors, b.minimal_colors)
+
+
+def test_native_true_discriminates_unavailable_vs_midrun_failure(monkeypatch):
+    # ADVICE r4: the error message must report what actually happened, not
+    # infer it from whether any progress landed before the failure
+    import dgc_tpu.ops.reduce_colors as rc
+
+    indptr, indices = _csr([(0, 1), (1, 2)], 3)
+    colors = np.array([0, 1, 2], np.int32)
+
+    monkeypatch.setattr("dgc_tpu.native.bindings.reduce_top_class_native",
+                        lambda *a, **k: None)
+    with pytest.raises(RuntimeError, match="is unavailable"):
+        rc.reduce_color_count(indptr, indices, colors, native=True)
+
+    # first-round mid-run failure: no progress yet, but NOT "unavailable"
+    monkeypatch.setattr("dgc_tpu.native.bindings.reduce_top_class_native",
+                        lambda *a, **k: (-1, None, 0))
+    with pytest.raises(RuntimeError, match="failed mid-run"):
+        rc.reduce_color_count(indptr, indices, colors, native=True)
+
+
+def test_last_run_records_path_and_budget(monkeypatch):
+    import dgc_tpu.ops.reduce_colors as rc
+
+    indptr, indices = _csr([(0, 1), (1, 2)], 3)
+    colors = np.array([0, 1, 2], np.int32)
+
+    out = rc.reduce_color_count(indptr, indices, colors, native=False)
+    assert validate_coloring(indptr, indices, out).valid
+    assert rc.last_run["path"] == "python"
+    assert rc.last_run["python_budget"] > 0
+
+    # unavailable library in auto mode: falls back, and says so — with no
+    # stale native_budget for a walk that never ran
+    monkeypatch.setattr("dgc_tpu.native.bindings.reduce_top_class_native",
+                        lambda *a, **k: None)
+    rc.reduce_color_count(indptr, indices, colors)
+    assert rc.last_run["path"] == "python"
+    assert "native_budget" not in rc.last_run
+
+    # first-round mid-run failure in auto mode: attributed to the failed
+    # native walk (its spent visits shrank the Python budget), not progress
+    monkeypatch.setattr("dgc_tpu.native.bindings.reduce_top_class_native",
+                        lambda *a, **k: (-1, None, 70_000))
+    rc.reduce_color_count(indptr, indices, colors)
+    assert rc.last_run["path"] == "native-failed+python"
+    assert rc.last_run["python_budget"] == 70_000
