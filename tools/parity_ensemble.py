@@ -1,0 +1,99 @@
+"""Scale parity ensemble: flagship-family engine + recolor pass vs the
+reference semantics (vectorized ``ReferenceSimEngine``), many draws.
+
+The one-sided contract under test (BASELINE.md round-4 amendment): the
+engine's final color count must never exceed the reference's + 1; lower
+is an improvement. This tool makes the contract checkable at scales the
+loop-form sim made impractical (VERDICT r4 weak #6 / next #4):
+
+    PYTHONPATH=. JAX_PLATFORMS=cpu python tools/parity_ensemble.py \
+        --nodes 50000 --draws 30 --out tools/parity_50k.jsonl
+
+Engine: bucketed ELL (bit-identical counts to every other array engine —
+the speculative rule is single-sourced in ``ops.speculative``), chosen
+because its quantized bucket shapes reuse compiled executables across
+draws on CPU. Emits one JSON line per draw and a final summary line.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+
+
+def main() -> int:
+    p = argparse.ArgumentParser()
+    p.add_argument("--nodes", type=int, required=True)
+    p.add_argument("--draws", type=int, default=30)
+    p.add_argument("--avg-degree", type=float, default=16.0)
+    p.add_argument("--seed0", type=int, default=0)
+    p.add_argument("--out", type=str, default=None)
+    args = p.parse_args()
+
+    import jax
+
+    from dgc_tpu.engine.bucketed import BucketedELLEngine
+    from dgc_tpu.engine.minimal_k import (find_minimal_coloring, make_reducer,
+                                          make_validator)
+    from dgc_tpu.engine.reference_sim import ReferenceSimEngine
+    from dgc_tpu.models.generators import generate_rmat_graph
+
+    # mode "w": the artifact is one run's evidence — appending across runs
+    # (possibly across code versions) would make the summary contradict
+    # the records above it
+    out = open(args.out, "w") if args.out else None
+    gaps: list[int] = []
+    t_all = time.perf_counter()
+    try:
+        for i in range(args.draws):
+            seed = args.seed0 + i
+            g = generate_rmat_graph(args.nodes, avg_degree=args.avg_degree, seed=seed)
+            t0 = time.perf_counter()
+            a = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1,
+                                      validate=make_validator(g),
+                                      post_reduce=make_reducer(g))
+            t_eng = time.perf_counter() - t0
+            t0 = time.perf_counter()
+            b = find_minimal_coloring(ReferenceSimEngine(g), g.max_degree + 1,
+                                      validate=make_validator(g))
+            t_ref = time.perf_counter() - t0
+            gap = a.minimal_colors - b.minimal_colors
+            gaps.append(gap)
+            rec = {"nodes": args.nodes, "seed": seed, "max_degree": int(g.max_degree),
+                   "engine_colors": a.minimal_colors, "ref_colors": b.minimal_colors,
+                   "gap": gap, "engine_s": round(t_eng, 1), "ref_s": round(t_ref, 1)}
+            line = json.dumps(rec)
+            print(line, flush=True)
+            if out:
+                out.write(line + "\n")
+                out.flush()
+            if i % 5 == 4:
+                jax.clear_caches()  # bound per-shape executable footprint
+    finally:
+        # an interrupted run still gets a (partial=true) verdict line, so
+        # the artifact is never a bare list with no contract verdict
+        hist: dict[int, int] = {}
+        for gp in gaps:
+            hist[gp] = hist.get(gp, 0) + 1
+        summary = {
+            "summary": True, "nodes": args.nodes,
+            "draws": len(gaps), "draws_requested": args.draws,
+            "partial": len(gaps) < args.draws,
+            "gap_hist": {str(kk): hist[kk] for kk in sorted(hist)},
+            "max_gap": max(gaps) if gaps else None,
+            "le_ref": sum(1 for gp in gaps if gp <= 0),
+            "contract_ok": bool(gaps) and max(gaps) <= 1,
+            "total_s": round(time.perf_counter() - t_all, 1),
+        }
+        line = json.dumps(summary)
+        print(line, flush=True)
+        if out:
+            out.write(line + "\n")
+            out.close()
+    return 0 if summary["contract_ok"] and not summary["partial"] else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
