@@ -47,8 +47,7 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--backend",
         choices=["ell", "ell-bucketed", "ell-compact", "dense", "sharded",
-                 "sharded-bucketed", "sharded-ring", "reference-sim", "oracle",
-                 "spark"],
+                 "sharded-bucketed", "sharded-ring", "reference-sim", "oracle"],
         default="ell-compact",
         help="coloring engine (default: ell-compact — the flagship staged "
              "frontier-compacted kernel; any degree distribution)",
@@ -150,12 +149,13 @@ def make_engine(args, graph: Graph, logger=None):
     if args.backend == "oracle":
         from dgc_tpu.engine.oracle import OracleEngine
         return OracleEngine(arrays)
-    if args.backend == "spark":
-        raise SystemExit(
-            "--backend spark requires pyspark and the original reference engine; "
-            "this environment ships the TPU backends. Use --backend reference-sim "
-            "for the reference's BSP semantics without Spark."
-        )
+    # NOTE: there is deliberately no "spark" backend. A Spark execution
+    # path would mean either vendoring the reference scripts (this
+    # framework is standalone) or reimplementing them on an engine this
+    # image doesn't ship; the reference's two engine *semantics* are fully
+    # covered by --backend reference-sim --sim-variant {optimized,baseline}
+    # (the parity oracle every TPU engine is tested against). See README
+    # "Migrating from the reference".
     raise ValueError(args.backend)
 
 

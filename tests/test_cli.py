@@ -57,13 +57,18 @@ def test_cli_backends_agree_within_one(tiny_graph_json, tmp_path, backend):
     assert validate_coloring(g.arrays.indptr, g.arrays.indices, colors).valid
 
 
-def test_cli_spark_backend_gated(tiny_graph_json, tmp_path):
-    with pytest.raises(SystemExit):
+def test_cli_spark_backend_rejected_at_parse(tiny_graph_json, tmp_path, capsys):
+    # round-5: "spark" is no longer an enum value that always raises — it
+    # is rejected up front by argparse (rc 2) with the valid choices shown;
+    # reference-sim is the documented replica of the Spark semantics
+    with pytest.raises(SystemExit) as exc:
         main([
             "--input", str(tiny_graph_json),
             "--output-coloring", str(tmp_path / "c.json"),
             "--backend", "spark",
         ])
+    assert exc.value.code == 2
+    assert "reference-sim" in capsys.readouterr().err
 
 
 def test_cli_log_json(tiny_graph_json, tmp_path):
