@@ -71,7 +71,7 @@ def _load():
         # once and load via a distinct pid-unique path — re-dlopening the
         # canonical path would return the already-mapped stale object.
         # Keep the silent-fallback contract if recovery fails too.
-        if not hasattr(lib, "dgc_reduce_top_class"):  # newest symbol
+        if not hasattr(lib, "dgc_greedy_color"):  # newest symbol
             fresh = f"{_LIB}.{os.getpid()}.reload"
             if not _build(load_path=fresh):
                 _load_failed = True
@@ -86,7 +86,7 @@ def _load():
                     os.unlink(fresh)  # mapping persists; dirent can go
                 except OSError:
                     pass
-            if not hasattr(lib, "dgc_reduce_top_class"):  # newest symbol
+            if not hasattr(lib, "dgc_greedy_color"):  # newest symbol
                 _load_failed = True
                 return None
         lib.dgc_generate_fast.restype = ctypes.c_void_p
@@ -138,6 +138,14 @@ def _load():
             np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
             ctypes.c_int32, ctypes.c_int32, ctypes.c_int32, ctypes.c_int64,
             ctypes.POINTER(ctypes.c_int64),
+        ]
+        lib.dgc_greedy_color.restype = ctypes.c_int32
+        lib.dgc_greedy_color.argtypes = [
+            ctypes.c_int64,
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
+            np.ctypeslib.ndpointer(dtype=np.int32, flags="C_CONTIGUOUS"),
         ]
         _lib = lib
         return _lib
@@ -248,6 +256,18 @@ def build_combined_native(indptr: np.ndarray, indices: np.ndarray,
     return out if rc == 0 else None
 
 
+def csr_fits_int32(indptr: np.ndarray) -> bool:
+    """Whether a CSR is safe for the int32 native walks: ≥2^31 directed
+    edges — or ≥2^31 vertices, which the indices values and vertex-count
+    argument would also overflow — would silently truncate in the casts
+    the native entry points perform. Callers fall back to the Python
+    paths (arbitrary dtype) when this is False. No in-repo producer hits
+    the bound (GraphArrays is int32 throughout), but these are public API.
+    """
+    i32max = np.iinfo(np.int32).max
+    return int(indptr[-1]) <= i32max and int(indptr.shape[0]) - 1 <= i32max
+
+
 def reduce_top_class_native(indptr: np.ndarray, indices: np.ndarray,
                             colors: np.ndarray, max_pair_tries: int,
                             chain_cap: int, kempe_max_class: int,
@@ -264,14 +284,7 @@ def reduce_top_class_native(indptr: np.ndarray, indices: np.ndarray,
     lib = _load()
     if lib is None:
         return None
-    # the C walk is int32; a CSR with ≥2^31 directed edges — or ≥2^31
-    # vertices, which the indices values and the vertex-count argument
-    # would also overflow — would silently truncate in the casts below.
-    # Report unavailable so the caller's Python path (arbitrary dtype)
-    # handles it. No in-repo producer hits this (GraphArrays is int32
-    # throughout), but this is public API.
-    i32max = np.iinfo(np.int32).max
-    if int(indptr[-1]) > i32max or int(indptr.shape[0]) - 1 > i32max:
+    if not csr_fits_int32(indptr):
         return None
     # one guaranteed copy (scratch the C walk may leave partially modified),
     # never two: ascontiguousarray().copy() would re-copy a non-contiguous input
@@ -286,3 +299,28 @@ def reduce_top_class_native(indptr: np.ndarray, indices: np.ndarray,
         ctypes.byref(budget),
     )
     return int(rc), (out if rc == 1 else None), int(budget.value)
+
+
+def greedy_color_native(indptr: np.ndarray, indices: np.ndarray,
+                        order: np.ndarray) -> np.ndarray | None:
+    """Sequential first-fit greedy in the given vertex order (the recolor
+    pass's greedy-resweep tier; bit-identical to ``oracle.greedy_color``
+    given the same order — the order itself stays Python-computed so the
+    (degree desc, id asc) total order is a single fact). Returns int32[V]
+    colors, or None when the library is unavailable or the CSR exceeds
+    the int32 walk (same guard as ``reduce_top_class_native``)."""
+    lib = _load()
+    if lib is None:
+        return None
+    if not csr_fits_int32(indptr):
+        return None
+    v = int(indptr.shape[0]) - 1
+    out = np.empty(v, dtype=np.int32)
+    rc = lib.dgc_greedy_color(
+        v,
+        np.ascontiguousarray(indptr, dtype=np.int32),
+        np.ascontiguousarray(indices, dtype=np.int32),
+        np.ascontiguousarray(order, dtype=np.int32),
+        out,
+    )
+    return out if rc >= 0 else None

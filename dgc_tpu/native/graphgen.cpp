@@ -461,4 +461,37 @@ int32_t dgc_reduce_top_class(int64_t v, const int32_t* indptr,
   }
 }
 
+// Sequential first-fit greedy over CSR in the caller-supplied vertex
+// order — the native fast path of the recolor pass's greedy-resweep tier
+// (dgc_tpu/ops/reduce_colors.py) and bit-identical to
+// dgc_tpu/engine/oracle.py::greedy_color given the same order. The order
+// stays Python-computed (np.lexsort) so the (degree desc, id asc) total
+// order lives in exactly one place. colors_out must hold v entries; it is
+// fully overwritten. Returns the color count, or -1 on failure.
+int32_t dgc_greedy_color(int64_t v, const int32_t* indptr,
+                         const int32_t* indices, const int32_t* order,
+                         int32_t* colors_out) {
+  try {
+    for (int64_t i = 0; i < v; ++i) colors_out[i] = -1;
+    // stamp[c] == i  ⇔  color c seen among neighbors of the i-th vertex;
+    // first-fit colors never exceed the max degree < v
+    std::vector<int32_t> stamp(v + 1, -1);
+    int32_t maxc = -1;
+    for (int64_t i = 0; i < v; ++i) {
+      int32_t u = order[i];
+      for (int32_t e = indptr[u]; e < indptr[u + 1]; ++e) {
+        int32_t nc = colors_out[indices[e]];
+        if (nc >= 0) stamp[nc] = (int32_t)i;
+      }
+      int32_t col = 0;
+      while (stamp[col] == (int32_t)i) ++col;
+      colors_out[u] = col;
+      if (col > maxc) maxc = col;
+    }
+    return maxc + 1;
+  } catch (...) {
+    return -1;
+  }
+}
+
 }  // extern "C"
