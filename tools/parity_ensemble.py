@@ -28,6 +28,9 @@ def main() -> int:
     p.add_argument("--nodes", type=int, required=True)
     p.add_argument("--draws", type=int, default=30)
     p.add_argument("--avg-degree", type=float, default=16.0)
+    p.add_argument("--gen", choices=["rmat", "uniform"], default="rmat",
+                   help="graph family: power-law RMAT (heavy tail) or "
+                        "uniform random (the BASELINE headline family)")
     p.add_argument("--seed0", type=int, default=0)
     p.add_argument("--out", type=str, default=None)
     args = p.parse_args()
@@ -38,7 +41,8 @@ def main() -> int:
     from dgc_tpu.engine.minimal_k import (find_minimal_coloring, make_reducer,
                                           make_validator)
     from dgc_tpu.engine.reference_sim import ReferenceSimEngine
-    from dgc_tpu.models.generators import generate_rmat_graph
+    from dgc_tpu.models.generators import (generate_random_graph_fast,
+                                           generate_rmat_graph)
 
     # mode "w": the artifact is one run's evidence — appending across runs
     # (possibly across code versions) would make the summary contradict
@@ -49,7 +53,13 @@ def main() -> int:
     try:
         for i in range(args.draws):
             seed = args.seed0 + i
-            g = generate_rmat_graph(args.nodes, avg_degree=args.avg_degree, seed=seed)
+            if args.gen == "uniform":
+                g = generate_random_graph_fast(args.nodes,
+                                               avg_degree=args.avg_degree,
+                                               seed=seed)
+            else:
+                g = generate_rmat_graph(args.nodes, avg_degree=args.avg_degree,
+                                        seed=seed)
             t0 = time.perf_counter()
             a = find_minimal_coloring(BucketedELLEngine(g), g.max_degree + 1,
                                       validate=make_validator(g),
@@ -61,7 +71,8 @@ def main() -> int:
             t_ref = time.perf_counter() - t0
             gap = a.minimal_colors - b.minimal_colors
             gaps.append(gap)
-            rec = {"nodes": args.nodes, "seed": seed, "max_degree": int(g.max_degree),
+            rec = {"nodes": args.nodes, "gen": args.gen, "seed": seed,
+                   "max_degree": int(g.max_degree),
                    "engine_colors": a.minimal_colors, "ref_colors": b.minimal_colors,
                    "gap": gap, "engine_s": round(t_eng, 1), "ref_s": round(t_ref, 1)}
             line = json.dumps(rec)
@@ -78,7 +89,7 @@ def main() -> int:
         for gp in gaps:
             hist[gp] = hist.get(gp, 0) + 1
         summary = {
-            "summary": True, "nodes": args.nodes,
+            "summary": True, "nodes": args.nodes, "gen": args.gen,
             "draws": len(gaps), "draws_requested": args.draws,
             "partial": len(gaps) < args.draws,
             "gap_hist": {str(kk): hist[kk] for kk in sorted(hist)},
