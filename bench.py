@@ -34,18 +34,28 @@ from dgc_tpu.utils.watchdog import (env_float as _env_float,  # noqa: E402
                                     guarded_device_init, start_watchdog)
 
 
-def _bench_abort_record(metric: str):
+def _bench_abort_record(metric: str, phases: dict = None, context: dict = None):
     """on_abort callback that emits the null JSON record, so a missing
     measurement can never masquerade as one (bench_suite.sh filters the
-    null record out of its jsonl). The watchdog exits ABORT_RC after it."""
+    null record out of its jsonl). The watchdog exits ABORT_RC after it.
+
+    ``phases``/``context`` are live references the main flow keeps
+    updating: everything measured before the abort (graph gen, engine
+    build, partial warmup) and the probed backend/platform land in the
+    abort record instead of being lost with the process."""
 
     def _abort(diag: str) -> None:
         # one clearly-labeled failure line; rc!=0 (ABORT_RC) so callers
         # can tell a backend-loss abort apart from an ordinary bug
         print(f"# BENCH ABORTED: {diag}", file=sys.stderr)
-        print(json.dumps({"metric": metric,
-                          "value": None, "unit": "s", "vs_baseline": 0.0,
-                          "error": diag}), flush=True)
+        record = {"metric": metric,
+                  "value": None, "unit": "s", "vs_baseline": 0.0,
+                  "error": diag}
+        if context:
+            record.update(context)
+        if phases is not None:
+            record["phases"] = {k: round(v, 4) for k, v in phases.items()}
+        print(json.dumps(record), flush=True)
 
     return _abort
 
@@ -84,15 +94,26 @@ def main() -> int:
     from dgc_tpu.models.generators import generate_random_graph_fast, generate_rmat_graph
     from dgc_tpu.ops.validate import validate_coloring
 
+    # live references shared with the abort callbacks: a watchdog abort
+    # reports everything measured up to the kill instead of losing it
+    phases: dict = {}
+    context = {"backend": args.backend,
+               "platform": os.environ.get("JAX_PLATFORMS") or "default",
+               "probed": False}
+
     # armed immediately before the first device touch (imports above are
     # off the clock, so a slow cold import can't eat the init budget)
     dev = guarded_device_init(
         args.probe_timeout, what="device init",
-        on_abort=_bench_abort_record("bench_aborted_backend_unreachable"),
+        on_abort=_bench_abort_record("bench_aborted_backend_unreachable",
+                                     phases, context),
     )[0]
+    context["platform"] = dev.platform
+    context["probed"] = True
     if args.run_timeout > 0:
         start_watchdog(args.run_timeout, "run after device init",
-                       on_abort=_bench_abort_record("bench_aborted_run_deadline"))
+                       on_abort=_bench_abort_record(
+                           "bench_aborted_run_deadline", phases, context))
     print(f"# device: {dev.device_kind} ({dev.platform}) x{jax.local_device_count()}",
           file=sys.stderr)
 
@@ -108,6 +129,7 @@ def main() -> int:
             max_degree=args.max_degree,
         )
     t_gen = time.perf_counter() - t0
+    phases["gen_s"] = t_gen
     print(f"# graph: V={arrays.num_vertices} E2={arrays.num_directed_edges} "
           f"maxdeg={arrays.max_degree} gen={t_gen:.2f}s", file=sys.stderr)
 
@@ -136,7 +158,9 @@ def main() -> int:
 
         return ELLEngine(arrays)
 
+    t0 = time.perf_counter()
     engine = build_engine()
+    phases["engine_build_s"] = time.perf_counter() - t0
     k0 = arrays.max_degree + 1
 
     if not args.include_compile:
@@ -147,11 +171,13 @@ def main() -> int:
             engine.sweep(k0)
         else:
             engine.attempt(k0)
-        print(f"# warmup(compile+run)={time.perf_counter() - t0:.2f}s", file=sys.stderr)
+        phases["warmup_compile_s"] = time.perf_counter() - t0
+        print(f"# warmup(compile+run)={phases['warmup_compile_s']:.2f}s", file=sys.stderr)
 
     t0 = time.perf_counter()
     result = find_minimal_coloring(engine, initial_k=k0)
     elapsed = time.perf_counter() - t0
+    phases["sweep_s"] = elapsed
 
     t0 = time.perf_counter()
     val = validate_coloring(arrays.indptr, arrays.indices, result.colors)
@@ -179,6 +205,8 @@ def main() -> int:
     print(f"# post_reduce: {result.minimal_colors} -> {reduced_colors} colors "
           f"in {t_reduce:.3f}s {_rc.last_run}", file=sys.stderr)
 
+    phases["validate_s"] = t_validate
+    phases["reduce_s"] = t_reduce
     print(json.dumps({
         "metric": f"wall_clock_minimal_k_sweep_{args.nodes}v_avgdeg{args.avg_degree:g}"
                   f"{'_rmat' if args.gen == 'rmat' else ''}_{args.backend}",
@@ -189,6 +217,13 @@ def main() -> int:
         "post_reduce_colors": reduced_colors,
         "post_reduce_s": round(t_reduce, 4),
         "validate_s": round(t_validate, 4),
+        # per-phase breakdown beside the headline metric (obs subsystem):
+        # gen/engine-build/warmup-compile/sweep/validate/reduce — the same
+        # keys the abort records carry, so a degraded run's partial phases
+        # line up with a healthy run's full set
+        "phases": {k: round(v, 4) for k, v in phases.items()},
+        "backend": args.backend,
+        "platform": context["platform"],
         # the wall-clock a CLI user experiences: sweep + recolor pass +
         # ground-truth validation — published beside the sweep-only
         # headline so the two can never silently drift apart (VERDICT r4).

@@ -21,7 +21,13 @@ import numpy as np
 
 from dgc_tpu.models.graph import Graph
 from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
-from dgc_tpu.utils.logging import RunLogger
+from dgc_tpu.obs import (
+    MetricsRegistry,
+    ObservedEngine,
+    PhaseCollector,
+    RunLogger,
+    RunManifest,
+)
 from dgc_tpu.utils.watchdog import env_float, guarded_device_init
 
 # backends that touch JAX devices (and therefore hang, not raise, when the
@@ -67,6 +73,20 @@ def build_parser() -> argparse.ArgumentParser:
     )
     p.add_argument("--checkpoint-dir", type=str, default=None, help="checkpoint/resume directory")
     p.add_argument("--log-json", type=str, default=None, help="write structured JSONL run log")
+    # observability (dgc_tpu.obs): both flags enable in-kernel superstep
+    # telemetry — the fused kernels record per-superstep metrics in the
+    # while-loop carry and return the whole per-attempt trajectory in one
+    # transfer (no per-superstep host round-trips)
+    p.add_argument(
+        "--run-manifest", type=str, default=None,
+        help="write a single-JSON run manifest (graph/devices, per-attempt "
+             "superstep trajectories, compile/device/host phase breakdown, "
+             "final color count); render with tools/report_run.py",
+    )
+    p.add_argument(
+        "--metrics-prom", type=str, default=None,
+        help="write run metrics in Prometheus text exposition format",
+    )
     p.add_argument(
         "--compat-failed-output",
         action="store_true",
@@ -117,6 +137,7 @@ def make_engine(args, graph: Graph, logger=None):
             getattr(args, "probe_timeout",
                     env_float("DGC_TPU_CLI_PROBE_TIMEOUT", 25.0)),
             what=f"device init for --backend {args.backend}",
+            on_abort=getattr(args, "_on_watchdog_abort", None),
         )
         if logger is not None:
             logger.event("devices", count=len(devices),
@@ -173,28 +194,63 @@ def main(argv: list[str] | None = None) -> int:
         logger.close()
 
 
+def _write_obs_outputs(args, logger, manifest, phases, registry) -> None:
+    """Flush the manifest/metrics artifacts (normal exit AND watchdog
+    abort: a run that died mid-sweep still leaves its partial telemetry)."""
+    if manifest is not None and args.run_manifest:
+        manifest.finalize(phases=phases, registry=registry)
+        manifest.write(args.run_manifest)
+        logger.event("manifest_written", path=args.run_manifest)
+    if args.metrics_prom:
+        registry.write_prom(args.metrics_prom)
+        logger.event("metrics_written", path=args.metrics_prom)
+
+
 def _run(args, logger: RunLogger) -> int:
     t_start = time.perf_counter()
 
-    if args.input is not None:
-        try:
-            graph = Graph.deserialize(args.input)
-        except (OSError, ValueError, KeyError) as e:
-            # reference wraps the file load the same way (coloring.py:177-181)
-            print(f"Failed to load graph from {args.input}: {e}", file=sys.stderr)
-            return 2
-        logger.event("graph_loaded", path=args.input, vertices=graph.num_vertices,
-                     max_degree=graph.max_degree)
-    else:
-        graph = Graph.generate(args.node_count, args.max_degree, seed=args.seed,
-                               method=args.gen_method)
-        logger.event("graph_generated", vertices=graph.num_vertices,
-                     max_degree=graph.max_degree, method=args.gen_method, seed=args.seed)
-        if args.output_graph:
-            graph.serialize(args.output_graph)
-            logger.event("graph_saved", path=args.output_graph)
+    # obs subsystem: registry/phases always collect (cheap host-side);
+    # manifest + in-kernel trajectories are opt-in via the flags
+    registry = MetricsRegistry()
+    phases = PhaseCollector(logger=logger, registry=registry)
+    manifest = RunManifest()
+    logger.add_sink(manifest)
+    telemetry = bool(args.run_manifest or args.metrics_prom)
 
-    engine = make_engine(args, graph, logger=logger)
+    with phases.section("host_graph"):
+        if args.input is not None:
+            try:
+                graph = Graph.deserialize(args.input)
+            except (OSError, ValueError, KeyError) as e:
+                # reference wraps the file load the same way (coloring.py:177-181)
+                print(f"Failed to load graph from {args.input}: {e}", file=sys.stderr)
+                return 2
+            logger.event("graph_loaded", path=args.input, vertices=graph.num_vertices,
+                         max_degree=graph.max_degree)
+        else:
+            graph = Graph.generate(args.node_count, args.max_degree, seed=args.seed,
+                                   method=args.gen_method)
+            logger.event("graph_generated", vertices=graph.num_vertices,
+                         max_degree=graph.max_degree, method=args.gen_method, seed=args.seed)
+            if args.output_graph:
+                graph.serialize(args.output_graph)
+                logger.event("graph_saved", path=args.output_graph)
+
+    def on_watchdog_abort(diag: str) -> None:
+        # fold the abort into the same event stream and flush the partial
+        # manifest before the watchdog's os._exit (keeping the labeled
+        # stderr diagnostic the watchdog would otherwise print)
+        print(f"ERROR: {diag}", file=sys.stderr)
+        logger.event("watchdog_abort",
+                     what=f"device init for --backend {args.backend}",
+                     diag=diag, timeout_s=args.probe_timeout)
+        _write_obs_outputs(args, logger, manifest, phases, registry)
+
+    args._on_watchdog_abort = on_watchdog_abort
+    with phases.section("host_engine_build"):
+        engine = make_engine(args, graph, logger=logger)
+    engine = ObservedEngine(engine, phases=phases, registry=registry,
+                            record_trajectory=telemetry)
     checkpoint = None
     if args.checkpoint_dir:
         from dgc_tpu.utils.checkpoint import CheckpointManager, graph_fingerprint
@@ -217,15 +273,17 @@ def _run(args, logger: RunLogger) -> int:
         from dgc_tpu.engine.minimal_k import make_reducer
         post_reduce = make_reducer(graph.arrays)
 
-    result = find_minimal_coloring(
-        engine,
-        initial_k=k0,
-        strict_decrement=args.strict_decrement,
-        validate=make_validator(graph.arrays),
-        on_attempt=on_attempt,
-        checkpoint=checkpoint,
-        post_reduce=post_reduce,
-    )
+    with phases.section("sweep_total"):
+        result = find_minimal_coloring(
+            engine,
+            initial_k=k0,
+            strict_decrement=args.strict_decrement,
+            validate=make_validator(graph.arrays),
+            on_attempt=on_attempt,
+            checkpoint=checkpoint,
+            post_reduce=post_reduce,
+        )
+    phases.log_device_memory()
 
     if result.minimal_colors is not None and result.swept_colors is not None \
             and result.minimal_colors < result.swept_colors:
@@ -236,18 +294,25 @@ def _run(args, logger: RunLogger) -> int:
     total_s = time.perf_counter() - t_start
     if result.colors is None:
         logger.event("sweep_failed", initial_k=k0)
+        _write_obs_outputs(args, logger, manifest, phases, registry)
         print("No valid coloring found", file=sys.stderr)
         return 1
 
-    out_colors = result.colors
-    if args.compat_failed_output and result.attempts and not result.attempts[-1].success:
-        out_colors = result.attempts[-1].colors  # the reference's quirk output
-    graph.save_coloring(args.output_coloring, out_colors)
+    with phases.section("host_serialize"):
+        out_colors = result.colors
+        if args.compat_failed_output and result.attempts and not result.attempts[-1].success:
+            out_colors = result.attempts[-1].colors  # the reference's quirk output
+        graph.save_coloring(args.output_coloring, out_colors)
 
     # reference's summary prints (coloring.py:233-235)
     logger.event("sweep_done", minimal_colors=result.minimal_colors,
                  attempts=len(result.attempts), supersteps=result.total_supersteps,
                  wall_time_s=round(total_s, 4))
+    registry.gauge("dgc_minimal_colors",
+                   "final minimal color count").set(result.minimal_colors)
+    registry.gauge("dgc_sweep_wall_seconds",
+                   "wall time of the whole run").set(round(total_s, 4))
+    _write_obs_outputs(args, logger, manifest, phases, registry)
     print(f"Minimal number of colors: {result.minimal_colors}")
     print(f"Total time: {total_s:.4f} s")
     return 0

@@ -34,6 +34,9 @@ class AttemptResult:
     colors: np.ndarray       # int32[V]; valid coloring iff status == SUCCESS
     supersteps: int          # BSP rounds executed
     k: int                   # the color budget attempted
+    # in-kernel per-superstep telemetry (obs.kernel.SuperstepTrajectory),
+    # populated only when the engine ran with record_trajectory enabled
+    trajectory: object | None = None
 
     @property
     def success(self) -> bool:

@@ -95,6 +95,12 @@ from dgc_tpu.engine.bucketed import (
     status_step,
 )
 from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.obs.kernel import (
+    decode_trajectory,
+    make_trajstep,
+    traj_cap_for,
+    traj_empty,
+)
 from dgc_tpu.ops.bitmask import forbidden_planes, num_planes_for
 from dgc_tpu.ops.speculative import (
     apply_update_mc,
@@ -659,21 +665,26 @@ def restore_from_ring(rec, k, first, pe_i, ba_i, step_i, stall_i, act_i):
 
 def _superstep_epilogue(recstep, rec5, pe, ba, prune, new_pe, ba_new,
                         prune_new, any_fail, active, mc, step,
-                        prev_active, stall, stall_window):
+                        prev_active, stall, stall_window,
+                        trajstep=None, traj=None):
     """Shared tail of every pipeline superstep body (one definition so the
-    fail-revert ordering, stall accounting, and rec-ring push cannot drift
-    between the sequential/unified pipelines and the sharded engines'
-    ports, ``fused.shard_superstep_epilogue``): push the rec ring, advance
-    stall/status, and revert state on a failed superstep. Returns
-    (rec5, stall, status, new_pe, ba_new, prune_new)."""
+    fail-revert ordering, stall accounting, rec-ring push, and telemetry
+    write cannot drift between the sequential/unified pipelines and the
+    sharded engines' ports, ``fused.shard_superstep_epilogue``): push the
+    rec ring, record the trajectory row (pre-revert — a failed superstep's
+    observed active/fail counts are exactly what telemetry must show),
+    advance stall/status, and revert state on a failed superstep. Returns
+    (rec5, stall, status, new_pe, ba_new, prune_new, traj)."""
     rec5 = recstep(rec5, pe, ba, step, prev_active, stall, mc, any_fail)
+    if trajstep is not None:
+        traj = trajstep(traj, step, active, any_fail, mc, ba_new)
     stall = jnp.where(active < prev_active, 0, stall + 1)
     status = status_step(any_fail, active, stall, stall_window)
     new_pe = jnp.where(any_fail, pe, new_pe)
     ba_new = jnp.where(any_fail, ba, ba_new)
     prune_new = jax.tree.map(
         lambda a, b: jnp.where(any_fail, a, b), prune, prune_new)
-    return rec5, stall, status, new_pe, ba_new, prune_new
+    return rec5, stall, status, new_pe, ba_new, prune_new, traj
 
 
 def _flat_buckets_step(pe, pk, buckets, planes: tuple, row0s: tuple,
@@ -769,7 +780,8 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                       flat_row0: int, flat_planes: int, stages: tuple,
                       max_steps: int, init_bucket_active: tuple,
                       stage_ranges: tuple = (), hub_prune: tuple = (),
-                      hub_uncond: tuple = (), stall_window: int = 64):
+                      hub_uncond: tuple = (), stall_window: int = 64,
+                      traj=None, record_traj: bool = False):
     """Heavy-tail variant of ``_staged_pipeline``: ONE ``while_loop`` whose
     body dispatches the flat region's work over a ``lax.switch`` of
     per-stage bodies while the hub machinery — the dominant traced cost
@@ -807,6 +819,9 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
     w_flat = flat_ext.shape[1]
 
     recstep = _make_recstep(record)
+    trajstep = make_trajstep(record_traj)
+    if traj is None:
+        traj = traj_empty(1, nb=len(init_bucket_active), dummy=True)
 
     def desired_stage(active):
         d = jnp.int32(0)
@@ -819,7 +834,7 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
     gidx0 = jnp.full((a0,), v + 1, jnp.int32)         # dummy slot target
     carry = ((init[0], init[1], jnp.int32(_RUNNING), init[2], init[3],
               init[4]) + tuple(rec)
-             + (prune0, jnp.int32(-1), comb0, gidx0))
+             + (prune0, jnp.int32(-1), comb0, gidx0, traj))
 
     def cond(c):
         step, status, active = c[1], c[2], c[3]
@@ -833,7 +848,7 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
     def body(c):
         pe, step, status, prev_active, stall, ba = c[:6]
         rec5, prune = c[6:11], c[11]
-        stage_idx, comb_c, gidx = c[12], c[13], c[14]
+        stage_idx, comb_c, gidx, traj = c[12], c[13], c[14], c[15]
 
         # --- stage advance + recompaction (from the pre-superstep pe) ---
         desired = desired_stage(prev_active)
@@ -943,11 +958,13 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
         active = sum([act_fl] + h_actives)
         mc = jnp.max(jnp.stack([mc_f] + h_mcs))
         any_fail = fail_count > 0
-        rec5, stall, status, new_pe, ba_new, prune_new = _superstep_epilogue(
+        (rec5, stall, status, new_pe, ba_new, prune_new,
+         traj) = _superstep_epilogue(
             recstep, rec5, pe, ba, prune, new_pe, ba_new, prune_new,
-            any_fail, active, mc, step, prev_active, stall, stall_window)
+            any_fail, active, mc, step, prev_active, stall, stall_window,
+            trajstep, traj)
         return ((new_pe, step + 1, status, active, stall, ba_new)
-                + rec5 + (prune_new, stage_idx, comb_c, gidx))
+                + rec5 + (prune_new, stage_idx, comb_c, gidx, traj))
 
     carry = jax.lax.while_loop(cond, body, carry)
     pe, steps, status, active = carry[0], carry[1], carry[2], carry[3]
@@ -956,7 +973,7 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
         (status == _RUNNING) & (active == 0), _SUCCESS,
         jnp.where(status == _RUNNING, _STALLED, status),
     ).astype(jnp.int32)
-    return pe, steps, status, tuple(carry[6:11])
+    return pe, steps, status, tuple(carry[6:11]), carry[15]
 
 
 def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
@@ -965,10 +982,15 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                      flat_planes: int, stages: tuple, max_steps: int,
                      init_bucket_active: tuple, stage_ranges: tuple = (),
                      hub_prune: tuple = (), hub_uncond: tuple = (),
-                     stall_window: int = 64):
+                     stall_window: int = 64,
+                     traj=None, record_traj: bool = False):
     """One whole k-attempt as a traceable pipeline: cond-skipped full-table
     phase + hybrid (flat-compacted + live-hub) compaction stages. Returns
-    (packed_ext, steps, status, rec).
+    (packed_ext, steps, status, rec, traj).
+
+    ``traj``/``record_traj`` thread the in-kernel telemetry buffer
+    (``obs.kernel``) through every superstep's carry; off (the default) a
+    1-row dummy rides inert and the write is statically elided.
 
     ``buckets[b]``: int32[V_b, W_b] combined bucket table. ``flat_ext``:
     int32[V_flat+1, W_flat]
@@ -1009,13 +1031,16 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
             buckets, flat_ext, degrees, k, init, rec, record,
             planes, row0s, hub_buckets, flat_row0, flat_planes, stages,
             max_steps, init_bucket_active, stage_ranges, hub_prune,
-            hub_uncond, stall_window)
+            hub_uncond, stall_window, traj=traj, record_traj=record_traj)
 
+    if traj is None:
+        traj = traj_empty(1, nb=len(init_bucket_active), dummy=True)
     prune0 = _fresh_prune(buckets, nb_hub, planes, hub_prune, v)
     carry = (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3],
-             init[4]) + tuple(rec) + (prune0,)
+             init[4]) + tuple(rec) + (prune0, traj)
 
     recstep = _make_recstep(record)
+    trajstep = make_trajstep(record_traj)
 
     for si, (scale, thresh) in enumerate(stages):
         if scale is None:
@@ -1026,18 +1051,18 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
 
             def body(c):
                 pe, step, status, prev_active, stall, ba = c[:6]
-                rec5, prune = c[6:11], c[11]
+                rec5, prune, traj = c[6:11], c[11], c[12]
                 new_pe, fail_count, active, ba_new, mc, prune_new = (
                     _hybrid_superstep(pe, ba, buckets, row0s, k, planes, v,
                                       nb_hub, prune, hub_prune, hub_uncond))
                 any_fail = fail_count > 0
                 (rec5, stall, status, new_pe, ba_new,
-                 prune_new) = _superstep_epilogue(
+                 prune_new, traj) = _superstep_epilogue(
                     recstep, rec5, pe, ba, prune, new_pe, ba_new, prune_new,
                     any_fail, active, mc, step, prev_active, stall,
-                    stall_window)
+                    stall_window, trajstep, traj)
                 return ((new_pe, step + 1, status, active, stall, ba_new)
-                        + rec5 + (prune_new,))
+                        + rec5 + (prune_new, traj))
 
             carry = jax.lax.while_loop(cond, body, carry)
             continue
@@ -1082,7 +1107,7 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                 # this body only ever traces hub-free: the flat region IS
                 # the graph, prune state is the empty tuple, ba = [flat]
                 pe, step, status, prev_active, stall, ba = c2[:6]
-                rec5, prune = c2[6:11], c2[11]
+                rec5, prune, traj = c2[6:11], c2[11], c2[12]
                 # BSP snapshot semantics: all reads from ``pe``; writes
                 # accumulate in ``new_pe`` over disjoint row sets
 
@@ -1124,12 +1149,12 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                 mc = jnp.max(jnp.stack([mc_f]))
                 any_fail = fail_count > 0
                 (rec5, stall, status, new_pe, ba_new,
-                 prune_new) = _superstep_epilogue(
+                 prune_new, traj) = _superstep_epilogue(
                     recstep, rec5, pe, ba, prune, new_pe, ba_new, (),
                     any_fail, active, mc, step, prev_active, stall,
-                    stall_window)
+                    stall_window, trajstep, traj)
                 return ((new_pe, step + 1, status, active, stall, ba_new)
-                        + rec5 + (prune_new,))
+                        + rec5 + (prune_new, traj))
 
             return jax.lax.while_loop(cond2, body2, c)
 
@@ -1141,24 +1166,29 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
         (status == _RUNNING) & (active == 0), _SUCCESS,
         jnp.where(status == _RUNNING, _STALLED, status),
     ).astype(jnp.int32)
-    return pe, steps, status, tuple(carry[6:11])
+    return pe, steps, status, tuple(carry[6:11]), carry[12]
 
 
 _STATIC_NAMES = ("planes", "row0s", "hub_buckets", "flat_row0", "flat_planes",
                  "stages", "max_steps", "init_bucket_active", "stage_ranges",
-                 "hub_prune", "hub_uncond", "stall_window")
+                 "hub_prune", "hub_uncond", "stall_window", "record_traj",
+                 "traj_cap")
 
 
 @partial(jax.jit, static_argnames=_STATIC_NAMES)
-def _attempt_kernel_staged(buckets, flat_ext, degrees, k, **static_kw):
-    """Plain staged k-attempt (no recording): (pe, steps, status)."""
+def _attempt_kernel_staged(buckets, flat_ext, degrees, k,
+                           record_traj: bool = False, traj_cap: int = 1,
+                           **static_kw):
+    """Plain staged k-attempt (no prefix-resume recording):
+    (pe, steps, status, traj)."""
+    nb = len(static_kw["init_bucket_active"])
     init = _default_init(degrees, static_kw["init_bucket_active"])
-    rec = _empty_rec(degrees.shape[0], len(static_kw["init_bucket_active"]),
-                     dummy=True)
-    pe, steps, status, _ = _staged_pipeline(
+    rec = _empty_rec(degrees.shape[0], nb, dummy=True)
+    traj0 = traj_empty(traj_cap, nb=nb, dummy=not record_traj)
+    pe, steps, status, _, traj = _staged_pipeline(
         buckets, flat_ext, degrees, k, init, rec, False,
-        **static_kw)
-    return pe, steps, status
+        traj=traj0, record_traj=record_traj, **static_kw)
+    return pe, steps, status, traj
 
 
 @partial(jax.jit, static_argnames=_STATIC_NAMES)
@@ -1167,7 +1197,8 @@ def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
                          flat_planes: int, stages: tuple, max_steps: int,
                          init_bucket_active: tuple, stage_ranges: tuple = (),
                          hub_prune: tuple = (), hub_uncond: tuple = (),
-                         stall_window: int = 64):
+                         stall_window: int = 64,
+                         record_traj: bool = False, traj_cap: int = 1):
     """Fused minimal-k sweep: attempt(k0), then — still on device — the
     jump-mode confirm attempt at (colors_used − 1). One dispatch for what
     jump mode otherwise does in two (PERF.md lever: ~65 ms dispatch each).
@@ -1187,12 +1218,17 @@ def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
     steps/status/colors all match a scratch run exactly). A ring miss
     falls back to the scratch init.
 
-    Returns (pe1, steps1, status1, used, pe2, steps2, status2); the second
-    triple is the first repeated when the confirm attempt was skipped
-    (attempt 1 not successful, or used − 1 < 1 — the host fabricates the
-    trivial k=0 FAILURE in that case, matching ``attempt(0)``).
+    Returns (pe1, steps1, status1, used, pe2, steps2, status2, traj1,
+    traj2); the second triple is the first repeated when the confirm
+    attempt was skipped (attempt 1 not successful, or used − 1 < 1 — the
+    host fabricates the trivial k=0 FAILURE in that case, matching
+    ``attempt(0)``). ``traj1``/``traj2`` are the attempts' in-kernel
+    telemetry buffers (dummies when ``record_traj`` is off); a
+    prefix-resumed confirm records only its post-resume rows (the decoder's
+    ``first_step``).
     """
     v = degrees.shape[0]
+    nb = len(init_bucket_active)
     args = (buckets, flat_ext, degrees)
     kw = dict(planes=planes, row0s=row0s, hub_buckets=hub_buckets,
               flat_row0=flat_row0, flat_planes=flat_planes, stages=stages,
@@ -1201,18 +1237,20 @@ def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
               hub_uncond=hub_uncond, stall_window=stall_window)
     pe0 = jnp.zeros(v + 2, jnp.int32)
     z = jnp.int32(0)
-    rec0 = _empty_rec(v, len(init_bucket_active))
+    rec0 = _empty_rec(v, nb)
+    traj0 = traj_empty(traj_cap, nb=nb, dummy=not record_traj)
     init = (jnp.int32(0), jnp.asarray(k0, jnp.int32),
             pe0, z, z,          # slot 1: pe1, steps1, status1
             z,                  # used
-            pe0, z, jnp.int32(_FAILURE)) + rec0  # slot 2 (skip default)
+            pe0, z, jnp.int32(_FAILURE)) + rec0 + (traj0, traj0)  # slot 2
 
     def cond(c):
         return c[0] < 2
 
     def body(c):
         phase, k, pe1, steps1, status1, used, pe2, steps2, status2 = c[:9]
-        rec = c[9:]
+        rec = c[9:14]
+        traj1, traj2 = c[14], c[15]
         first = phase == 0
 
         # init: scratch for phase 0; phase 1 resumes from the ring entry
@@ -1222,8 +1260,9 @@ def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
         pe_i, ba_i, step_i, stall_i, act_i = restore_from_ring(
             rec, k, first, pe_i, ba_i, step_i, stall_i, act_i)
 
-        pe, steps, status, rec = _staged_pipeline(
-            *args, k, (pe_i, step_i, act_i, stall_i, ba_i), rec, first, **kw)
+        pe, steps, status, rec, traj = _staged_pipeline(
+            *args, k, (pe_i, step_i, act_i, stall_i, ba_i), rec, first,
+            traj=traj0, record_traj=record_traj, **kw)
         colors = jnp.where(pe[:v] >= 0, pe[:v] >> 1, -1)
         used_new = jnp.where(first, jnp.max(colors, initial=-1) + 1, used)
         k2 = used_new - 1
@@ -1238,12 +1277,13 @@ def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
             # (the skipped-confirm contract; host fabricates k=0 FAILURE)
             pe, jnp.where(first, z, steps),
             jnp.where(first, jnp.int32(_FAILURE), status),
-        ) + tuple(rec)
+        ) + tuple(rec) + (sel(traj, traj1), traj)
         return out
 
     out = jax.lax.while_loop(cond, body, init)
     (_, _, pe1, steps1, status1, used, pe2, steps2, status2) = out[:9]
-    return pe1, steps1, status1, used, pe2, steps2, status2
+    return (pe1, steps1, status1, used, pe2, steps2, status2,
+            out[14], out[15])
 
 
 class CompactFrontierEngine(BucketedELLEngine):
@@ -1282,6 +1322,9 @@ class CompactFrontierEngine(BucketedELLEngine):
                  hub_uncond_entries: int | None = None):
         kw = {} if max_window_planes is None else {"max_window_planes": max_window_planes}
         super().__init__(arrays, max_steps=max_steps, min_width=min_width, **kw)
+        # in-kernel telemetry switch (obs subsystem): compiles a recording
+        # variant of the kernels whose carry threads the trajectory buffer
+        self.record_trajectory = False
         v = arrays.num_vertices
         if stages is None:
             cap = flat_cap if flat_cap is not None else self.FLAT_CAP
@@ -1371,20 +1414,28 @@ class CompactFrontierEngine(BucketedELLEngine):
                     stage_ranges=self.stage_ranges,
                     hub_prune=self.hub_prune, hub_uncond=self.hub_uncond)
 
+    def _traj_kw(self) -> dict:
+        rec = self.record_trajectory
+        return dict(record_traj=rec,
+                    traj_cap=traj_cap_for(self.max_steps) if rec else 1)
+
     def attempt(self, k: int) -> AttemptResult:
         v = self.arrays.num_vertices
         if k < 1:
             return self._finish(np.full(v, -1, np.int32), AttemptStatus.FAILURE, 0, k)
         while True:  # window-cap retry loop (STALLED + capped hub buckets)
-            pe, steps, status = _attempt_kernel_staged(
+            pe, steps, status, traj = _attempt_kernel_staged(
                 self.combined_buckets, self.flat_ext, self.degrees, k,
-                **self._kernel_kw()
+                **self._traj_kw(), **self._kernel_kw()
             )
             status = AttemptStatus(int(status))
             if status == AttemptStatus.STALLED and self._maybe_widen_windows():
                 continue
             break
-        return self._finish(np.asarray(pe)[:v], status, int(steps), int(k))
+        res = self._finish(np.asarray(pe)[:v], status, int(steps), int(k))
+        if self.record_trajectory:
+            res.trajectory = decode_trajectory(traj, res.supersteps)
+        return res
 
     def sweep(self, k0: int) -> tuple[AttemptResult, AttemptResult | None]:
         """Fused jump-mode pair: attempt(k0) and the confirm attempt at
@@ -1395,18 +1446,26 @@ class CompactFrontierEngine(BucketedELLEngine):
         if k0 < 1:
             return self.attempt(k0), None
         while True:  # window-cap retry loop (STALLED + capped hub buckets)
-            pe1, steps1, status1, used, pe2, steps2, status2 = _sweep_kernel_staged(
+            (pe1, steps1, status1, used, pe2, steps2, status2,
+             traj1, traj2) = _sweep_kernel_staged(
                 self.combined_buckets, self.flat_ext, self.degrees, k0,
-                **self._kernel_kw()
+                **self._traj_kw(), **self._kernel_kw()
             )
             status1 = AttemptStatus(int(status1))
             if status1 == AttemptStatus.STALLED and self._maybe_widen_windows():
                 continue
             break
         first = self._finish(np.asarray(pe1)[:v], status1, int(steps1), int(k0))
+        if self.record_trajectory:
+            first.trajectory = decode_trajectory(traj1, first.supersteps)
+
+        def finish_second(k2):
+            res = self._finish(np.asarray(pe2)[:v],
+                               AttemptStatus(int(status2)), int(steps2), k2)
+            if self.record_trajectory:
+                res.trajectory = decode_trajectory(traj2, res.supersteps)
+            return res
+
         return finish_sweep_pair(
-            first, used, status2,
-            lambda k2: self._finish(np.asarray(pe2)[:v],
-                                    AttemptStatus(int(status2)), int(steps2), k2),
-            v, self.attempt,
+            first, used, status2, finish_second, v, self.attempt,
         )

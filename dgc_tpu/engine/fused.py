@@ -24,36 +24,51 @@ from dgc_tpu.parallel.mesh import VERTEX_AXIS, fetch_global
 _SUCCESS = AttemptStatus.SUCCESS
 _FAILURE = AttemptStatus.FAILURE
 
+# jax.shard_map (with check_vma) landed after 0.4.x; older images only have
+# the experimental module (whose flag is check_rep). One shim so every
+# sharded engine builds on both.
+if hasattr(jax, "shard_map"):
+    _shard_map = jax.shard_map
+    _SHARD_MAP_KW = {"check_vma": False}
+else:  # pragma: no cover - exercised only on older jax
+    from jax.experimental.shard_map import shard_map as _shard_map
+
+    _SHARD_MAP_KW = {"check_rep": False}
+
 
 def cached_shard_kernel(engine, body, name: str, window_key, in_specs,
                         static_kwargs: dict):
     """(name, window_key)-cached ``jit(shard_map(body))`` with the shared
     out_specs convention: an ``attempt`` kernel returns (colors, steps,
-    status); a ``sweep`` kernel returns that twice around the shard-invariant
-    ``used`` scalar (``device_sweep_pair_resumable``). One builder for every sharded
-    engine so the convention can't silently diverge per engine; the cache
-    lives on ``engine._kernels`` and is evicted by the widen step."""
+    status, traj); a ``sweep`` kernel returns the first triple twice around
+    the shard-invariant ``used`` scalar plus the pair's trajectory buffers
+    (``device_sweep_pair_resumable``). The telemetry buffers are
+    shard-invariant (every row is psum/pmax-derived), hence ``P()``. One
+    builder for every sharded engine so the convention can't silently
+    diverge per engine; the cache lives on ``engine._kernels`` and is
+    evicted by the widen step."""
     key = (name, window_key)
     if key not in engine._kernels:
         out_one = (P(VERTEX_AXIS), P(), P())
-        engine._kernels[key] = jax.jit(jax.shard_map(
+        engine._kernels[key] = jax.jit(_shard_map(
             partial(body, **static_kwargs),
             mesh=engine.mesh,
             in_specs=in_specs,
-            out_specs=out_one if name.startswith("attempt")
-            else out_one + (P(),) + out_one,
-            check_vma=False,
+            out_specs=out_one + (P(),) if name.startswith("attempt")
+            else out_one + (P(),) + out_one + (P(), P()),
+            **_SHARD_MAP_KW,
         ))
     return engine._kernels[key]
 
 
-def run_windowed(run: Callable, widen: Callable[[], bool], status_index=-1):
+def run_windowed(run: Callable, widen: Callable[[], bool], status_index=2):
     """Drive a capped-window kernel: run, and while it exits STALLED with a
     widenable window, widen and re-run (``run`` must re-fetch the kernel so
     it picks up the new window). ``status_index`` selects the status scalar
-    in the kernel's output tuple (attempt: last; fused sweep: the first
-    attempt's status, index 2). Returns ``(outs, status)`` — the shared
-    retry driver for every capped-window engine."""
+    in the kernel's output tuple (index 2 for both conventions: an
+    attempt's status, or the fused sweep's first-attempt status). Returns
+    ``(outs, status)`` — the shared retry driver for every capped-window
+    engine."""
     while True:
         outs = run()
         status = AttemptStatus(int(fetch_global(outs[status_index])))
@@ -82,39 +97,47 @@ def shard_rec_empty(v_local: int, dummy: bool = False):
 def shard_superstep_epilogue(recstep, rec5, packed_l, new_packed_l, prune,
                              prune_new, any_fail, active, mc, step,
                              prev_active, stall, stall_window: int,
-                             max_steps: int):
+                             max_steps: int, trajstep=None, traj=None):
     """Shared tail of every sharded pipeline superstep: delegates to the
     single-device ``compact._superstep_epilogue`` (rec-ring push →
     stall/status → fail revert, one definition so the ordering cannot
     drift across the four pipelines) with the ring layout's dummy ``ba``
-    slot, then applies the sharded engines' max-steps STALLED clamp.
-    Returns (rec5, stall, status, new_packed_l, prune_new)."""
+    slot, then applies the sharded engines' max-steps STALLED clamp and
+    records the telemetry row (``active``/``mc`` are psum/pmax-derived, so
+    the written buffer is shard-invariant; the sharded engines carry no
+    bucket-active vector, so no ``ba`` tail).
+    Returns (rec5, stall, status, new_packed_l, prune_new, traj)."""
     from dgc_tpu.engine.base import AttemptStatus
     from dgc_tpu.engine.compact import _superstep_epilogue
 
     ba_dummy = jnp.zeros((1,), jnp.int32)
-    rec5, stall, status, new_packed_l, _, prune_new = _superstep_epilogue(
+    rec5, stall, status, new_packed_l, _, prune_new, _ = _superstep_epilogue(
         recstep, rec5, packed_l, ba_dummy, prune, new_packed_l, ba_dummy,
         prune_new, any_fail, active, mc, step, prev_active, stall,
         stall_window)
+    if trajstep is not None:
+        traj = trajstep(traj, step, active, any_fail, mc)
     status = jnp.where(
         (status == AttemptStatus.RUNNING) & (step + 1 >= max_steps),
         AttemptStatus.STALLED, status).astype(jnp.int32)
-    return rec5, stall, status, new_packed_l, prune_new
+    return rec5, stall, status, new_packed_l, prune_new, traj
 
 
 def device_sweep_pair_resumable(pipeline_fn: Callable,
                                 default_init_fn: Callable, k0, axis: str,
-                                v_local: int):
+                                v_local: int, traj_factory: Callable = None):
     """Phase-carried fused pair with prefix-resume — the multi-chip port of
     ``compact._sweep_kernel_staged``'s machinery, shared by the sharded
     engines.
 
-    ``pipeline_fn(k, init, rec, record) -> (packed_l, steps, status, rec)``
-    is the engine's per-shard k-attempt in resumable form: ``init`` is the
-    carry head ``(packed_l, step, active, stall)``, ``rec`` the per-shard
-    resume ring (``shard_rec_empty`` layout), ``record`` a traced bool.
+    ``pipeline_fn(k, init, rec, record, traj) -> (packed_l, steps, status,
+    rec, traj)`` is the engine's per-shard k-attempt in resumable form:
+    ``init`` is the carry head ``(packed_l, step, active, stall)``, ``rec``
+    the per-shard resume ring (``shard_rec_empty`` layout), ``record`` a
+    traced bool, ``traj`` the in-kernel telemetry buffer (``obs.kernel``).
     ``default_init_fn() -> init`` builds the scratch start.
+    ``traj_factory() -> traj`` builds each attempt's fresh telemetry
+    buffer; None (telemetry off) threads an inert 1-row dummy.
 
     Both attempts run as ONE ``while_loop`` whose body is a single
     ``pipeline_fn`` instance (the pipeline is traced once, not twice — the
@@ -131,24 +154,30 @@ def device_sweep_pair_resumable(pipeline_fn: Callable,
     the prune branches are schedule, not values, so the resumed run stays
     bit-identical while captures rebuild.
 
-    Returns the sweep kernels' shared 7-tuple; shard-uniform control flow for
-    the same reason (``used``/statuses are pmax/psum-derived).
+    Returns the sweep kernels' shared 7-tuple + (traj1, traj2);
+    shard-uniform control flow for the same reason (``used``/statuses are
+    pmax/psum-derived).
     """
+    from dgc_tpu.obs.kernel import traj_empty
+
     packed0, step0, act0, stall0 = default_init_fn()
     zeros_l = jnp.zeros_like(packed0)
     z = jnp.int32(0)
     rec0 = shard_rec_empty(v_local)
+    traj0 = traj_factory() if traj_factory is not None else traj_empty(
+        1, dummy=True)
     init = (z, jnp.asarray(k0, jnp.int32),
             zeros_l, z, z,                       # slot 1: packed1, steps1, status1
             z,                                   # used
-            zeros_l, z, jnp.int32(_FAILURE)) + rec0  # slot 2 (skip default)
+            zeros_l, z, jnp.int32(_FAILURE)) + rec0 + (traj0, traj0)  # slot 2
 
     def cond(c):
         return c[0] < 2
 
     def body(c):
         phase, k, p1, steps1, status1, used, p2, steps2, status2 = c[:9]
-        rec = c[9:]
+        rec = c[9:14]
+        traj1 = c[14]
         first = phase == 0
 
         from dgc_tpu.engine.compact import restore_from_ring
@@ -158,8 +187,8 @@ def device_sweep_pair_resumable(pipeline_fn: Callable,
             rec, k, first, packed_i, jnp.zeros((1,), jnp.int32), step_i,
             stall_i, act_i)
 
-        packed_l, steps, status, rec = pipeline_fn(
-            k, (packed_i, step_i, act_i, stall_i), rec, first)
+        packed_l, steps, status, rec, traj = pipeline_fn(
+            k, (packed_i, step_i, act_i, stall_i), rec, first, traj0)
         colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1)
         used_new = jnp.where(
             first,
@@ -177,13 +206,13 @@ def device_sweep_pair_resumable(pipeline_fn: Callable,
             # (the skipped-confirm contract; host fabricates k=0 FAILURE)
             packed_l, jnp.where(first, z, steps),
             jnp.where(first, jnp.int32(_FAILURE), status),
-        ) + tuple(rec)
+        ) + tuple(rec) + (sel(traj, traj1), traj)
 
     out = jax.lax.while_loop(cond, body, init)
     _, _, p1, steps1, status1, used, p2, steps2, status2 = out[:9]
     c1 = jnp.where(p1 >= 0, p1 >> 1, -1).astype(jnp.int32)
     c2 = jnp.where(p2 >= 0, p2 >> 1, -1).astype(jnp.int32)
-    return c1, steps1, status1, used, c2, steps2, status2
+    return c1, steps1, status1, used, c2, steps2, status2, out[14], out[15]
 
 
 def finish_sweep_pair(

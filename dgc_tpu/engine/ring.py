@@ -220,55 +220,62 @@ def _ring_default_init(deg_l, n: int):
 
 
 def _ring_drive(superstep, deg_l, n: int, max_steps: int,
-                stall_window: int = 64, init=None, rec=None, record=False):
+                stall_window: int = 64, init=None, rec=None, record=False,
+                traj=None, record_traj: bool = False):
     """Shared while-loop driver for both ring table layouts: carry layout,
-    stall/status transitions, max-steps STALLED clamp, fail rollback, and
-    the prefix-resume ring push live here once so the flat and bucketed
-    kernels cannot drift. ``superstep(packed_l) -> (new_packed_l,
-    any_fail, active, mc)`` (mc pmax'd by the superstep). ``init``/
-    ``rec``/``record`` follow ``fused.device_sweep_pair_resumable``'s
-    pipeline contract; None means scratch / a statically-dead dummy ring.
-    Returns (packed_l, steps, status, rec)."""
+    stall/status transitions, max-steps STALLED clamp, fail rollback, the
+    prefix-resume ring push, and the telemetry write live here once so the
+    flat and bucketed kernels cannot drift. ``superstep(packed_l) ->
+    (new_packed_l, any_fail, active, mc)`` (mc pmax'd by the superstep).
+    ``init``/``rec``/``record``/``traj`` follow
+    ``fused.device_sweep_pair_resumable``'s pipeline contract; None means
+    scratch / a statically-dead dummy ring or buffer.
+    Returns (packed_l, steps, status, rec, traj)."""
     from dgc_tpu.engine.compact import _make_recstep
+    from dgc_tpu.obs.kernel import make_trajstep, traj_empty
 
     vl = deg_l.shape[0]
     if init is None:
         init = _ring_default_init(deg_l, n)
     if rec is None:
         rec = shard_rec_empty(vl, dummy=True)
+    if traj is None:
+        traj = traj_empty(1, dummy=True)
     recstep = _make_recstep(record)
+    trajstep = make_trajstep(record_traj)
 
     def cond(carry):
         return carry[2] == _RUNNING
 
     def body(carry):
         packed_l, step, status, prev_active, stall = carry[:5]
-        rec5 = carry[5:10]
+        rec5, traj = carry[5:10], carry[10]
         new_packed_l, any_fail, active, mc = superstep(packed_l)
-        rec5, stall, status, new_packed_l, _ = shard_superstep_epilogue(
+        rec5, stall, status, new_packed_l, _, traj = shard_superstep_epilogue(
             recstep, rec5, packed_l, new_packed_l, (), (), any_fail,
-            active, mc, step, prev_active, stall, stall_window, max_steps)
-        return (new_packed_l, step + 1, status, active, stall) + rec5
+            active, mc, step, prev_active, stall, stall_window, max_steps,
+            trajstep, traj)
+        return (new_packed_l, step + 1, status, active, stall) + rec5 + (traj,)
 
     out = jax.lax.while_loop(
         cond, body,
         (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3])
-        + tuple(rec),
+        + tuple(rec) + (traj,),
     )
-    return out[0], out[1], out[2], tuple(out[5:10])
+    return out[0], out[1], out[2], tuple(out[5:10]), out[10]
 
 
 def _drive_colors(drive_out):
-    """Plain-attempt epilogue: decode (colors_l, steps, status)."""
-    packed_l, steps, status, _ = drive_out
+    """Plain-attempt epilogue: decode (colors_l, steps, status, traj)."""
+    packed_l, steps, status, _, traj = drive_out
     colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
-    return colors_l, steps, status
+    return colors_l, steps, status, traj
 
 
 def _ring_attempt(deg_l, tables_l, beats_l, k, num_planes: int,
                   max_degree: int, max_steps: int, n: int,
                   stall_window: int = 64, init=None, rec=None,
-                  record=False):
+                  record=False, traj=None, record_traj: bool = False):
     """One k-attempt on a shard. tables_l[r]: int32[vl, W_r] block-local
     neighbor ids for rotation r (sentinel = vl); deg_l: int32[vl].
 
@@ -309,13 +316,15 @@ def _ring_attempt(deg_l, tables_l, beats_l, k, num_planes: int,
         return new_packed_l, any_fail, active, jax.lax.pmax(mc_l, VERTEX_AXIS)
 
     return _ring_drive(superstep, deg_l, n, max_steps, stall_window,
-                       init=init, rec=rec, record=record)
+                       init=init, rec=rec, record=record, traj=traj,
+                       record_traj=record_traj)
 
 
 def _ring_attempt_bucketed(deg_l, rot_buckets_l, k, num_planes: int,
                            max_degree: int, max_steps: int, n: int,
                            stall_window: int = 64, init=None, rec=None,
-                           record=False):
+                           record=False, traj=None,
+                           record_traj: bool = False):
     """``_ring_attempt`` over degree-bucketed rotation tables.
 
     ``rot_buckets_l[r]`` is a tuple of ``(rows, comb)`` per-shard slices
@@ -368,43 +377,62 @@ def _ring_attempt_bucketed(deg_l, rot_buckets_l, k, num_planes: int,
         return new_packed_l, any_fail, active, jax.lax.pmax(mc_l, VERTEX_AXIS)
 
     return _ring_drive(superstep, deg_l, n, max_steps, stall_window,
-                       init=init, rec=rec, record=record)
+                       init=init, rec=rec, record=record, traj=traj,
+                       record_traj=record_traj)
+
+
+def _traj0(record_traj: bool, traj_cap: int):
+    from dgc_tpu.obs.kernel import traj_empty
+
+    return traj_empty(traj_cap, dummy=not record_traj)
 
 
 def _ring_attempt_bucketed_body(deg_l, rot_buckets_l, k, *, num_planes: int,
-                                max_degree: int, max_steps: int, n: int):
+                                max_degree: int, max_steps: int, n: int,
+                                record_traj: bool = False, traj_cap: int = 1):
     return _drive_colors(_ring_attempt_bucketed(
-        deg_l, rot_buckets_l, k, num_planes, max_degree, max_steps, n))
+        deg_l, rot_buckets_l, k, num_planes, max_degree, max_steps, n,
+        traj=_traj0(record_traj, traj_cap), record_traj=record_traj))
 
 
 def _ring_sweep_bucketed_body(deg_l, rot_buckets_l, k0, *, num_planes: int,
-                              max_degree: int, max_steps: int, n: int):
+                              max_degree: int, max_steps: int, n: int,
+                              record_traj: bool = False, traj_cap: int = 1):
     return device_sweep_pair_resumable(
-        lambda k, init, rec, record: _ring_attempt_bucketed(
+        lambda k, init, rec, record, traj: _ring_attempt_bucketed(
             deg_l, rot_buckets_l, k, num_planes, max_degree, max_steps, n,
-            init=init, rec=rec, record=record),
+            init=init, rec=rec, record=record, traj=traj,
+            record_traj=record_traj),
         lambda: _ring_default_init(deg_l, n),
         k0, VERTEX_AXIS, deg_l.shape[0],
+        traj_factory=(lambda: _traj0(True, traj_cap))
+        if record_traj else None,
     )
 
 
 def _ring_attempt_body(deg_l, tables_l, beats_l, k, *, num_planes: int,
-                       max_degree: int, max_steps: int, n: int):
-    return _drive_colors(_ring_attempt(deg_l, tables_l, beats_l, k,
-                                       num_planes, max_degree, max_steps, n))
+                       max_degree: int, max_steps: int, n: int,
+                       record_traj: bool = False, traj_cap: int = 1):
+    return _drive_colors(_ring_attempt(
+        deg_l, tables_l, beats_l, k, num_planes, max_degree, max_steps, n,
+        traj=_traj0(record_traj, traj_cap), record_traj=record_traj))
 
 
 def _ring_sweep_body(deg_l, tables_l, beats_l, k0, *, num_planes: int,
-                     max_degree: int, max_steps: int, n: int):
+                     max_degree: int, max_steps: int, n: int,
+                     record_traj: bool = False, traj_cap: int = 1):
     """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call —
     phase-carried with prefix-resume (the pipeline traces once; the
     confirm fast-forwards past the shared prefix)."""
     return device_sweep_pair_resumable(
-        lambda k, init, rec, record: _ring_attempt(
+        lambda k, init, rec, record, traj: _ring_attempt(
             deg_l, tables_l, beats_l, k, num_planes, max_degree, max_steps,
-            n, init=init, rec=rec, record=record),
+            n, init=init, rec=rec, record=record, traj=traj,
+            record_traj=record_traj),
         lambda: _ring_default_init(deg_l, n),
         k0, VERTEX_AXIS, deg_l.shape[0],
+        traj_factory=(lambda: _traj0(True, traj_cap))
+        if record_traj else None,
     )
 
 
@@ -479,13 +507,22 @@ class RingHaloEngine:
         rows = NamedSharding(self.mesh, P(VERTEX_AXIS))
         self.deg_l = jax.device_put(deg_p, rows)
         self._kernels = {}
+        # in-kernel telemetry switch (obs subsystem): selects the _traj
+        # kernel variants whose carry threads the trajectory buffer
+        self.record_trajectory = False
 
     _maybe_widen_window = maybe_widen_window
 
     def _kernel(self, body, name: str):
+        from dgc_tpu.obs.kernel import traj_cap_for
+
+        rec = self.record_trajectory
+        name = name + "_traj" if rec else name
         static = dict(num_planes=self.num_planes,
                       max_degree=self.arrays.max_degree,
-                      max_steps=self.max_steps, n=self._n)
+                      max_steps=self.max_steps, n=self._n,
+                      record_traj=rec,
+                      traj_cap=traj_cap_for(self.max_steps) if rec else 1)
         if self.bucket_tables:
             in_specs = (P(VERTEX_AXIS),
                         tuple(tuple((P(VERTEX_AXIS, None),
@@ -519,16 +556,25 @@ class RingHaloEngine:
         return self._kernel(_ring_sweep_body, "sweep")(
             self.deg_l, self.tables, self.beats, k_eff)
 
+    def _decode_traj(self, traj, supersteps: int):
+        from dgc_tpu.obs.kernel import decode_trajectory
+
+        if not self.record_trajectory:
+            return None
+        return decode_trajectory(fetch_global(traj), supersteps)
+
     def attempt(self, k: int) -> AttemptResult:
         if k < 1:
             return empty_budget_failure(self.v_true, k)
         k_eff = clamp_budget(k, 32 * num_planes_for(self.arrays.max_degree + 1))
-        (colors, steps, _), status = run_windowed(
+        (colors, steps, _, traj), status = run_windowed(
             lambda: self._run_attempt(k_eff),
             self._maybe_widen_window,
         )
+        steps = int(fetch_global(steps))
         return AttemptResult(
-            status, fetch_global(colors)[: self.v_true], int(fetch_global(steps)), int(k)
+            status, fetch_global(colors)[: self.v_true], steps, int(k),
+            trajectory=self._decode_traj(traj, steps),
         )
 
     def sweep(self, k0: int) -> tuple[AttemptResult, AttemptResult | None]:
@@ -542,13 +588,18 @@ class RingHaloEngine:
             lambda: self._run_sweep(k_eff),
             self._maybe_widen_window, status_index=2,
         )
-        c1, steps1, _, used, c2, steps2, status2 = outs
+        c1, steps1, _, used, c2, steps2, status2, traj1, traj2 = outs
+        steps1 = int(fetch_global(steps1))
         first = AttemptResult(status1, fetch_global(c1)[: self.v_true],
-                              int(fetch_global(steps1)), int(k0))
+                              steps1, int(k0),
+                              trajectory=self._decode_traj(traj1, steps1))
+
+        def finish_second(k2):
+            steps = int(fetch_global(steps2))
+            return AttemptResult(AttemptStatus(int(fetch_global(status2))),
+                                 fetch_global(c2)[: self.v_true], steps, k2,
+                                 trajectory=self._decode_traj(traj2, steps))
+
         return finish_sweep_pair(
-            first, used, status2,
-            lambda k2: AttemptResult(AttemptStatus(int(fetch_global(status2))),
-                                     fetch_global(c2)[: self.v_true],
-                                     int(fetch_global(steps2)), k2),
-            self.v_true, self.attempt,
+            first, used, status2, finish_second, self.v_true, self.attempt,
         )

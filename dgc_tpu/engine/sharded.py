@@ -81,7 +81,8 @@ _STALLED = AttemptStatus.STALLED
 
 def _flat_pipeline(nbrs_l, deg_l, deg_g, k, init, rec, record,
                    num_planes: int, max_degree: int, max_steps: int,
-                   stall_window: int = 64):
+                   stall_window: int = 64, traj=None,
+                   record_traj: bool = False):
     """One k-attempt on a shard in resumable form (carry head ``init`` =
     (packed_l, step, active, stall); ``rec``/``record`` per
     ``fused.device_sweep_pair_resumable``). nbrs_l: int32[Vl, W] with
@@ -93,8 +94,9 @@ def _flat_pipeline(nbrs_l, deg_l, deg_g, k, init, rec, record,
     capped window can never assert a wrong FAILURE — a starved attempt
     stops making progress, trips the stall counter, and exits STALLED for
     the engine to widen the window and retry (the ``bucketed`` contract).
-    Returns (packed_l, steps, status, rec)."""
+    Returns (packed_l, steps, status, rec, traj)."""
     from dgc_tpu.engine.compact import _make_recstep
+    from dgc_tpu.obs.kernel import make_trajstep, traj_empty
 
     vl, w = nbrs_l.shape
     shard = jax.lax.axis_index(VERTEX_AXIS)
@@ -111,28 +113,32 @@ def _flat_pipeline(nbrs_l, deg_l, deg_g, k, init, rec, record,
     pre_beats = beats_rule(n_deg, nbrs_l, my_deg, my_ids[:, None])
 
     recstep = _make_recstep(record)
+    trajstep = make_trajstep(record_traj)
+    if traj is None:
+        traj = traj_empty(1, dummy=True)
 
     def cond(carry):
         return carry[2] == _RUNNING
 
     def body(carry):
         packed_l, step, status, prev_active, stall = carry[:5]
-        rec5 = carry[5:10]
+        rec5, traj = carry[5:10], carry[10]
         new_packed_l, any_fail, active, mc = _shard_superstep(
             packed_l, nbrs_l, pre_beats, k, num_planes
         )
         any_fail = any_fail & fail_valid
-        rec5, stall, status, new_packed_l, _ = shard_superstep_epilogue(
+        rec5, stall, status, new_packed_l, _, traj = shard_superstep_epilogue(
             recstep, rec5, packed_l, new_packed_l, (), (), any_fail,
-            active, mc, step, prev_active, stall, stall_window, max_steps)
-        return (new_packed_l, step + 1, status, active, stall) + rec5
+            active, mc, step, prev_active, stall, stall_window, max_steps,
+            trajstep, traj)
+        return (new_packed_l, step + 1, status, active, stall) + rec5 + (traj,)
 
     out = jax.lax.while_loop(
         cond, body,
         (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3])
-        + tuple(rec),
+        + tuple(rec) + (traj,),
     )
-    return out[0], out[1], out[2], tuple(out[5:10])
+    return out[0], out[1], out[2], tuple(out[5:10]), out[10]
 
 
 def _flat_default_init(nbrs_l, deg_l):
@@ -143,33 +149,45 @@ def _flat_default_init(nbrs_l, deg_l):
 
 
 def _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes: int, max_degree: int,
-                  max_steps: int, stall_window: int = 64):
-    """Plain k-attempt (no recording): (colors_l, steps, status)."""
+                  max_steps: int, stall_window: int = 64,
+                  record_traj: bool = False, traj_cap: int = 1):
+    """Plain k-attempt (no recording): (colors_l, steps, status, traj)."""
+    from dgc_tpu.obs.kernel import traj_empty
+
     rec = shard_rec_empty(deg_l.shape[0], dummy=True)
-    packed_l, steps, status, _ = _flat_pipeline(
+    packed_l, steps, status, _, traj = _flat_pipeline(
         nbrs_l, deg_l, deg_g, k, _flat_default_init(nbrs_l, deg_l), rec,
-        False, num_planes, max_degree, max_steps, stall_window=stall_window)
+        False, num_planes, max_degree, max_steps, stall_window=stall_window,
+        traj=traj_empty(traj_cap, dummy=not record_traj),
+        record_traj=record_traj)
     colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
-    return colors_l, steps, status
+    return colors_l, steps, status, traj
 
 
 def _flat_attempt_body(nbrs_l, deg_l, deg_g, k, *, num_planes: int,
-                       max_degree: int, max_steps: int):
+                       max_degree: int, max_steps: int,
+                       record_traj: bool = False, traj_cap: int = 1):
     return _flat_attempt(nbrs_l, deg_l, deg_g, k, num_planes, max_degree,
-                         max_steps)
+                         max_steps, record_traj=record_traj,
+                         traj_cap=traj_cap)
 
 
 def _flat_sweep_body(nbrs_l, deg_l, deg_g, k0, *, num_planes: int,
-                     max_degree: int, max_steps: int):
+                     max_degree: int, max_steps: int,
+                     record_traj: bool = False, traj_cap: int = 1):
     """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call —
     phase-carried with prefix-resume (the pipeline traces once; the
     confirm fast-forwards past the shared prefix)."""
+    from dgc_tpu.obs.kernel import traj_empty
+
     return device_sweep_pair_resumable(
-        lambda k, init, rec, record: _flat_pipeline(
+        lambda k, init, rec, record, traj: _flat_pipeline(
             nbrs_l, deg_l, deg_g, k, init, rec, record, num_planes,
-            max_degree, max_steps),
+            max_degree, max_steps, traj=traj, record_traj=record_traj),
         lambda: _flat_default_init(nbrs_l, deg_l),
         k0, VERTEX_AXIS, deg_l.shape[0],
+        traj_factory=(lambda: traj_empty(traj_cap))
+        if record_traj else None,
     )
 
 
@@ -224,6 +242,9 @@ class ShardedELLEngine:
         self.num_planes = min(num_planes_for(arrays.max_degree + 1),
                               max_window_planes)
         self.max_steps = max_steps if max_steps is not None else 2 * v_pad + 4
+        # in-kernel telemetry switch (obs subsystem): selects the _traj
+        # kernel variants whose carry threads the trajectory buffer
+        self.record_trajectory = False
 
         shard_rows = NamedSharding(self.mesh, P(VERTEX_AXIS))
         replicated = NamedSharding(self.mesh, P())
@@ -235,28 +256,43 @@ class ShardedELLEngine:
     _maybe_widen_window = maybe_widen_window
 
     def _kernel(self, body, name: str):
+        from dgc_tpu.obs.kernel import traj_cap_for
+
+        rec = self.record_trajectory
         return cached_shard_kernel(
-            self, body, name, self.num_planes,
+            self, body, name + "_traj" if rec else name, self.num_planes,
             in_specs=(P(VERTEX_AXIS, None), P(VERTEX_AXIS), P(), P()),
             static_kwargs=dict(num_planes=self.num_planes,
                                max_degree=self.arrays.max_degree,
-                               max_steps=self.max_steps),
+                               max_steps=self.max_steps,
+                               record_traj=rec,
+                               traj_cap=traj_cap_for(self.max_steps)
+                               if rec else 1),
         )
+
+    def _decode_traj(self, traj, supersteps: int):
+        from dgc_tpu.obs.kernel import decode_trajectory
+
+        if not self.record_trajectory:
+            return None
+        return decode_trajectory(fetch_global(traj), supersteps)
 
     def attempt(self, k: int) -> AttemptResult:
         if k < 1:
             return empty_budget_failure(self.v_true, k)
         k_eff = clamp_budget(k, 32 * num_planes_for(self.arrays.max_degree + 1))
-        (colors, steps, _), status = run_windowed(
+        (colors, steps, _, traj), status = run_windowed(
             lambda: self._kernel(_flat_attempt_body, "attempt")(
                 self.nbrs, self.deg_l, self.deg_g, k_eff),
             self._maybe_widen_window,
         )
+        steps = int(fetch_global(steps))
         return AttemptResult(
             status,
             fetch_global(colors)[: self.v_true],
-            int(fetch_global(steps)),
+            steps,
             int(k),
+            trajectory=self._decode_traj(traj, steps),
         )
 
     def sweep(self, k0: int) -> tuple[AttemptResult, AttemptResult | None]:
@@ -271,13 +307,19 @@ class ShardedELLEngine:
                 self.nbrs, self.deg_l, self.deg_g, k_eff),
             self._maybe_widen_window, status_index=2,
         )
-        c1, steps1, _, used, c2, steps2, status2 = outs
+        c1, steps1, _, used, c2, steps2, status2, traj1, traj2 = outs
+        steps1 = int(fetch_global(steps1))
         first = AttemptResult(status1, fetch_global(c1)[: self.v_true],
-                              int(fetch_global(steps1)), int(k0))
+                              steps1, int(k0),
+                              trajectory=self._decode_traj(traj1, steps1))
+
+        def finish_second(k2):
+            steps = int(fetch_global(steps2))
+            return AttemptResult(AttemptStatus(int(fetch_global(status2))),
+                                 fetch_global(c2)[: self.v_true],
+                                 steps, k2,
+                                 trajectory=self._decode_traj(traj2, steps))
+
         return finish_sweep_pair(
-            first, used, status2,
-            lambda k2: AttemptResult(AttemptStatus(int(fetch_global(status2))),
-                                     fetch_global(c2)[: self.v_true],
-                                     int(fetch_global(steps2)), k2),
-            self.v_true, self.attempt,
+            first, used, status2, finish_second, self.v_true, self.attempt,
         )

@@ -269,7 +269,8 @@ def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
 
 def _shard_pipeline(tables_l, deg_l, k, init, rec, record, planes: tuple,
                     max_steps: int, v_final: int, pads: tuple = (),
-                    prune_cfg: tuple = (), stall_window: int = 64):
+                    prune_cfg: tuple = (), stall_window: int = 64,
+                    traj=None, record_traj: bool = False):
     """One k-attempt on a shard in resumable form: while_loop of all-gather
     + gated bucketed superstep + psum/pmax reductions. ``init`` is the
     carry head ``(packed_l, step, active, stall)`` (scratch or a resume
@@ -279,18 +280,22 @@ def _shard_pipeline(tables_l, deg_l, k, init, rec, record, planes: tuple,
     from psum/pmax'd scalars, so every shard pushes at the same rounds).
     Pruned captures are built fresh per invocation (never recorded — the
     prune branches change the schedule, not the values). Returns
-    (packed_l, steps, status, rec)."""
+    (packed_l, steps, status, rec, traj)."""
     from dgc_tpu.engine.compact import _make_recstep
+    from dgc_tpu.obs.kernel import make_trajstep, traj_empty
 
     k = jnp.asarray(k, jnp.int32)
     if not pads:
         pads = tuple(0 for _ in tables_l)
     if not prune_cfg:
         prune_cfg = tuple(None for _ in tables_l)
+    if traj is None:
+        traj = traj_empty(1, dummy=True)
     prune0 = _fresh_shard_prune(tables_l, planes, prune_cfg, v_final)
     recstep = _make_recstep(record)
+    trajstep = make_trajstep(record_traj)
     carry = (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3],
-             prune0) + tuple(rec)
+             prune0) + tuple(rec) + (traj,)
 
     def cond(c):
         status = c[2]
@@ -298,7 +303,7 @@ def _shard_pipeline(tables_l, deg_l, k, init, rec, record, planes: tuple,
 
     def body(c):
         packed_l, step, status, prev_active, stall, prune = c[:6]
-        rec5 = c[6:11]
+        rec5, traj = c[6:11], c[11]
         packed_g = jax.lax.all_gather(packed_l, VERTEX_AXIS, tiled=True)
         new_packed_l, fail_l, active_l, mc_l, prune_new = _gated_superstep(
             packed_l, packed_g, tables_l, k, planes, pads, prune, prune_cfg
@@ -308,51 +313,64 @@ def _shard_pipeline(tables_l, deg_l, k, init, rec, record, planes: tuple,
         mc = jax.lax.pmax(mc_l, VERTEX_AXIS)
         any_fail = fail_count > 0
         (rec5, stall, status, new_packed_l,
-         prune_new) = shard_superstep_epilogue(
+         prune_new, traj) = shard_superstep_epilogue(
             recstep, rec5, packed_l, new_packed_l, prune, prune_new,
             any_fail, active, mc, step, prev_active, stall, stall_window,
-            max_steps)
+            max_steps, trajstep, traj)
         return (new_packed_l, step + 1, status, active, stall,
-                prune_new) + rec5
+                prune_new) + rec5 + (traj,)
 
     out = jax.lax.while_loop(cond, body, carry)
-    return out[0], out[1], out[2], tuple(out[6:11])
+    return out[0], out[1], out[2], tuple(out[6:11]), out[11]
 
 
 def _shard_attempt(tables_l, deg_l, k, planes: tuple, max_steps: int,
                    v_final: int, pads: tuple = (), prune_cfg: tuple = (),
-                   stall_window: int = 64):
-    """Plain k-attempt (no recording): (colors_l, steps, status)."""
+                   stall_window: int = 64, record_traj: bool = False,
+                   traj_cap: int = 1):
+    """Plain k-attempt (no recording): (colors_l, steps, status, traj)."""
+    from dgc_tpu.obs.kernel import traj_empty
+
     init = (initial_packed(deg_l), jnp.int32(1), jnp.int32(v_final + 1),
             jnp.int32(0))
     rec = shard_rec_empty(deg_l.shape[0], dummy=True)
-    packed_l, steps, status, _ = _shard_pipeline(
+    packed_l, steps, status, _, traj = _shard_pipeline(
         tables_l, deg_l, k, init, rec, False, planes, max_steps, v_final,
-        pads=pads, prune_cfg=prune_cfg, stall_window=stall_window)
+        pads=pads, prune_cfg=prune_cfg, stall_window=stall_window,
+        traj=traj_empty(traj_cap, dummy=not record_traj),
+        record_traj=record_traj)
     colors_l = jnp.where(packed_l >= 0, packed_l >> 1, -1).astype(jnp.int32)
-    return colors_l, steps, status
+    return colors_l, steps, status, traj
 
 
 def _shard_attempt_body(tables_l, deg_l, k, *, planes: tuple, max_steps: int,
                         v_final: int, pads: tuple = (),
-                        prune_cfg: tuple = ()):
+                        prune_cfg: tuple = (), record_traj: bool = False,
+                        traj_cap: int = 1):
     return _shard_attempt(tables_l, deg_l, k, planes, max_steps, v_final,
-                          pads=pads, prune_cfg=prune_cfg)
+                          pads=pads, prune_cfg=prune_cfg,
+                          record_traj=record_traj, traj_cap=traj_cap)
 
 
 def _shard_sweep_body(tables_l, deg_l, k0, *, planes: tuple, max_steps: int,
-                      v_final: int, pads: tuple = (), prune_cfg: tuple = ()):
+                      v_final: int, pads: tuple = (), prune_cfg: tuple = (),
+                      record_traj: bool = False, traj_cap: int = 1):
     """Fused jump-mode pair: attempt(k0) + confirm at used−1, one call —
     phase-carried with prefix-resume (``device_sweep_pair_resumable``: the
     pipeline traces once, and the confirm fast-forwards past the prefix it
     shares with attempt 1)."""
+    from dgc_tpu.obs.kernel import traj_empty
+
     return device_sweep_pair_resumable(
-        lambda k, init, rec, record: _shard_pipeline(
+        lambda k, init, rec, record, traj: _shard_pipeline(
             tables_l, deg_l, k, init, rec, record, planes, max_steps,
-            v_final, pads=pads, prune_cfg=prune_cfg),
+            v_final, pads=pads, prune_cfg=prune_cfg, traj=traj,
+            record_traj=record_traj),
         lambda: (initial_packed(deg_l), jnp.int32(1),
                  jnp.int32(v_final + 1), jnp.int32(0)),
         k0, VERTEX_AXIS, deg_l.shape[0],
+        traj_factory=(lambda: traj_empty(traj_cap))
+        if record_traj else None,
     )
 
 
@@ -399,6 +417,9 @@ class ShardedBucketedEngine:
             lay.deg_final, NamedSharding(self.mesh, P(VERTEX_AXIS))
         )
         self._kernels = {}
+        # in-kernel telemetry switch (obs subsystem): selects the _traj
+        # kernel variants whose carry threads the trajectory buffer
+        self.record_trajectory = False
 
     def _maybe_widen_windows(self) -> bool:
         """Same contract as ``BucketedELLEngine._maybe_widen_windows``:
@@ -414,13 +435,19 @@ class ShardedBucketedEngine:
         return True
 
     def _kernel(self, body, name: str):
+        from dgc_tpu.obs.kernel import traj_cap_for
+
+        rec = self.record_trajectory
         return cached_shard_kernel(
-            self, body, name, self.planes,
+            self, body, name + "_traj" if rec else name, self.planes,
             in_specs=(tuple(P(VERTEX_AXIS, None) for _ in self.tables),
                       P(VERTEX_AXIS), P()),
             static_kwargs=dict(planes=self.planes, max_steps=self.max_steps,
                                v_final=self.layout.v_final, pads=self.pads,
-                               prune_cfg=self.prune_cfg),
+                               prune_cfg=self.prune_cfg,
+                               record_traj=rec,
+                               traj_cap=traj_cap_for(self.max_steps)
+                               if rec else 1),
         )
 
     def _finish(self, colors_final: np.ndarray, status, steps: int,
@@ -430,15 +457,25 @@ class ShardedBucketedEngine:
         colors[self.layout.orig_of_final[real]] = colors_final[real]
         return AttemptResult(status, colors, int(steps), int(k))
 
+    def _decode_traj(self, traj, supersteps: int):
+        from dgc_tpu.obs.kernel import decode_trajectory
+
+        if not self.record_trajectory:
+            return None
+        return decode_trajectory(fetch_global(traj), supersteps)
+
     def attempt(self, k: int) -> AttemptResult:
         if k < 1:
             return empty_budget_failure(self.arrays.num_vertices, k)
-        (colors_f, steps, _), status = run_windowed(
+        (colors_f, steps, _, traj), status = run_windowed(
             lambda: self._kernel(_shard_attempt_body, "attempt")(
                 self.tables, self.deg_l, k),
             self._maybe_widen_windows,
         )
-        return self._finish(fetch_global(colors_f), status, int(fetch_global(steps)), k)
+        steps = int(fetch_global(steps))
+        res = self._finish(fetch_global(colors_f), status, steps, k)
+        res.trajectory = self._decode_traj(traj, steps)
+        return res
 
     def sweep(self, k0: int) -> tuple[AttemptResult, AttemptResult | None]:
         """Fused jump-mode pair in one device call (see
@@ -451,11 +488,20 @@ class ShardedBucketedEngine:
                 self.tables, self.deg_l, k0),
             self._maybe_widen_windows, status_index=2,
         )
-        c1, steps1, _, used, c2, steps2, status2 = outs
-        first = self._finish(fetch_global(c1), status1, int(fetch_global(steps1)), k0)
+        c1, steps1, _, used, c2, steps2, status2, traj1, traj2 = outs
+        steps1 = int(fetch_global(steps1))
+        first = self._finish(fetch_global(c1), status1, steps1, k0)
+        first.trajectory = self._decode_traj(traj1, steps1)
+
+        def finish_second(k2):
+            steps = int(fetch_global(steps2))
+            res = self._finish(fetch_global(c2),
+                               AttemptStatus(int(fetch_global(status2))),
+                               steps, k2)
+            res.trajectory = self._decode_traj(traj2, steps)
+            return res
+
         return finish_sweep_pair(
-            first, used, status2,
-            lambda k2: self._finish(fetch_global(c2),
-                                    AttemptStatus(int(fetch_global(status2))), int(fetch_global(steps2)), k2),
+            first, used, status2, finish_second,
             self.arrays.num_vertices, self.attempt,
         )

@@ -51,6 +51,12 @@ from dgc_tpu.engine.base import (
     empty_budget_failure,
 )
 from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.obs.kernel import (
+    decode_trajectory,
+    make_trajstep,
+    traj_cap_for,
+    traj_empty,
+)
 from dgc_tpu.ops.bitmask import num_planes_for
 from dgc_tpu.ops.speculative import beats_rule, speculative_update
 
@@ -71,9 +77,18 @@ def superstep(packed, nbrs, pre_beats, k, num_planes: int):
     return new_packed, jnp.any(fail_mask), jnp.sum(active_mask.astype(jnp.int32))
 
 
-@partial(jax.jit, static_argnames=("num_planes", "max_steps"))
-def _attempt_kernel(nbrs, degrees, k, num_planes: int, max_steps: int):
-    """One k-attempt. nbrs:int32[V,W] sentinel-padded with V; k dynamic."""
+@partial(jax.jit,
+         static_argnames=("num_planes", "max_steps", "record_traj", "traj_cap"))
+def _attempt_kernel(nbrs, degrees, k, num_planes: int, max_steps: int,
+                    record_traj: bool = False, traj_cap: int = 1):
+    """One k-attempt. nbrs:int32[V,W] sentinel-padded with V; k dynamic.
+
+    ``record_traj`` (static) threads the in-kernel trajectory buffer
+    (``obs.kernel``) through the while-loop carry: row ``step`` records the
+    superstep's (active, fail) pair, and the full per-attempt trajectory
+    returns with the result — one transfer per attempt, no per-superstep
+    host round-trips. Off (the default), a 1-row dummy rides the carry
+    inert and the write is statically elided."""
     v, w = nbrs.shape
     ids = jnp.arange(v, dtype=jnp.int32)
     k = jnp.asarray(k, jnp.int32)
@@ -90,13 +105,17 @@ def _attempt_kernel(nbrs, degrees, k, num_planes: int, max_steps: int):
     my_deg = degrees[:, None]
     pre_beats = beats_rule(n_deg, nbrs, my_deg, ids[:, None])
 
+    trajstep = make_trajstep(record_traj)
+    traj0 = traj_empty(traj_cap, dummy=not record_traj)
+
     def cond(carry):
-        _, _, status = carry
+        status = carry[2]
         return status == _RUNNING
 
     def body(carry):
-        packed, step, status = carry
+        packed, step, status, traj = carry
         new_packed, any_fail, active = superstep(packed, nbrs, pre_beats, k, num_planes)
+        traj = trajstep(traj, step, active, any_fail)
         status = jnp.where(
             any_fail,
             _FAILURE,
@@ -109,13 +128,13 @@ def _attempt_kernel(nbrs, degrees, k, num_planes: int, max_steps: int):
         # on failure the attempt is discarded; keep pre-step state
         # (reference returns without applying, coloring.py:104-108)
         new_packed = jnp.where(any_fail, packed, new_packed)
-        return (new_packed, step + 1, status)
+        return (new_packed, step + 1, status, traj)
 
-    packed, steps, status = jax.lax.while_loop(
-        cond, body, (packed0, jnp.int32(0), jnp.int32(_RUNNING))
+    packed, steps, status, traj = jax.lax.while_loop(
+        cond, body, (packed0, jnp.int32(0), jnp.int32(_RUNNING), traj0)
     )
     colors = jnp.where(packed >= 0, packed >> 1, -1).astype(jnp.int32)
-    return status, colors, steps
+    return status, colors, steps, traj
 
 
 class ELLEngine:
@@ -129,14 +148,22 @@ class ELLEngine:
         self.num_planes = num_planes_for(arrays.max_degree + 1)
         v = arrays.num_vertices
         self.max_steps = max_steps if max_steps is not None else 2 * v + 4
+        # in-kernel telemetry switch (obs subsystem); a separate compiled
+        # variant records the per-superstep trajectory in the loop carry
+        self.record_trajectory = False
 
     def attempt(self, k: int) -> AttemptResult:
         if k < 1:
             return empty_budget_failure(self.arrays.num_vertices, k)
         k_eff = clamp_budget(k, 32 * self.num_planes)
-        status, colors, steps = _attempt_kernel(
-            self.nbrs, self.degrees, k_eff, num_planes=self.num_planes, max_steps=self.max_steps
+        rec = self.record_trajectory
+        status, colors, steps, traj = _attempt_kernel(
+            self.nbrs, self.degrees, k_eff, num_planes=self.num_planes,
+            max_steps=self.max_steps, record_traj=rec,
+            traj_cap=traj_cap_for(self.max_steps) if rec else 1,
         )
+        steps = int(steps)
         return AttemptResult(
-            AttemptStatus(int(status)), np.asarray(colors), int(steps), int(k)
+            AttemptStatus(int(status)), np.asarray(colors), steps, int(k),
+            trajectory=decode_trajectory(traj, steps) if rec else None,
         )

@@ -1,0 +1,46 @@
+"""Unified telemetry subsystem.
+
+The reference's only observability is wall-clock prints around each
+k-iteration and per-superstep uncolored counts (``coloring.py:89,214-223``,
+SURVEY.md §5). This package makes every run fully inspectable **without
+leaving the fused fast path**:
+
+- ``obs.kernel`` — in-kernel superstep telemetry: a fixed-shape trajectory
+  buffer threaded through every engine's ``lax.while_loop`` carry, written
+  once per superstep on device and transferred to the host **once per
+  attempt** (no per-superstep round-trips — the whole point of the fused
+  kernels, PERF.md dispatch ~65 ms).
+- ``obs.metrics`` — ``MetricsRegistry`` of counters/gauges/histograms with
+  Prometheus-text and dict exporters.
+- ``obs.events`` — structured JSONL event stream (``RunLogger``) with
+  reference-parity console output.
+- ``obs.schema`` — the machine-checkable event schema
+  (``tools/validate_runlog.py`` enforces it).
+- ``obs.phases`` — host-side phase instrumentation: compile vs. device vs.
+  host wall-time per attempt, device memory stats.
+- ``obs.manifest`` — single-JSON run manifest (per-attempt superstep
+  trajectories, phase breakdown, final color count);
+  ``tools/report_run.py`` renders it.
+- ``obs.instrument`` — ``ObservedEngine``, the engine proxy that wires the
+  above into any backend without touching the minimal-k driver.
+
+``utils.logging`` and ``utils.tracing`` are backward-compatible shims over
+this package.
+"""
+
+from dgc_tpu.obs.events import RunLogger
+from dgc_tpu.obs.instrument import ObservedEngine
+from dgc_tpu.obs.kernel import SuperstepTrajectory, decode_trajectory
+from dgc_tpu.obs.manifest import RunManifest
+from dgc_tpu.obs.metrics import MetricsRegistry
+from dgc_tpu.obs.phases import PhaseCollector
+
+__all__ = [
+    "MetricsRegistry",
+    "ObservedEngine",
+    "PhaseCollector",
+    "RunLogger",
+    "RunManifest",
+    "SuperstepTrajectory",
+    "decode_trajectory",
+]

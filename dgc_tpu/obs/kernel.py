@@ -1,0 +1,138 @@
+"""In-kernel superstep telemetry.
+
+The fused engines run a whole k-attempt inside one ``lax.while_loop`` —
+between ``sweep_start`` and ``attempt`` they are black boxes unless the
+caller abandons the production kernel for the host-stepped
+``trace_attempt`` loop (one dispatch per superstep, ~65 ms each on TPU).
+This module records per-superstep metrics *inside* the loop instead: a
+fixed-shape int32 trajectory buffer rides the while-loop carry, each
+superstep writes one row, and the full per-attempt trajectory comes back
+in the kernel's output — **one device→host transfer per attempt**, zero
+extra dispatches.
+
+Buffer layout: ``int32[cap, TRAJ_COLS + nb]`` where row ``s`` holds the
+metrics of superstep ``s`` (the engine's step counter):
+
+- col 0: global active count after the superstep (the reference's
+  per-superstep uncolored print, ``coloring.py:89``);
+- col 1: 1 iff the superstep tripped the failure predicate (conflict —
+  some vertex's forbidden set covered [0, k));
+- col 2: the superstep's divergence candidate ``mc`` (max forbidden-set
+  fill any vertex saw; −1 where the engine does not compute it);
+- cols 3..3+nb: per-bucket active counts (bucket occupancy) for the
+  bucketed engines (``nb`` = the engine's bucket-active vector length,
+  0 for the flat engines).
+
+Unwritten rows keep the −1 fill, so the host decoder recovers the exact
+written span (a prefix-resumed confirm attempt starts mid-buffer; rows
+past ``cap`` are dropped on device — ``truncated`` flags it).
+
+Recording is a *static* choice: ``make_trajstep(False)`` is the identity
+and the dummy 1-row buffer rides the carry inert, so kernels compiled
+with telemetry off do no extra work (the ``_make_recstep`` pattern,
+``engine/compact.py``).
+"""
+
+from __future__ import annotations
+
+from dataclasses import dataclass
+
+import numpy as np
+
+TRAJ_COLS = 3          # active, fail, mc — before the bucket-active tail
+DEFAULT_TRAJ_CAP = 4096
+
+
+def traj_cap_for(max_steps: int, cap: int = DEFAULT_TRAJ_CAP) -> int:
+    """Static row budget for a kernel's trajectory buffer: the attempt's
+    step bound, clamped so an O(V) safety bound can't allocate an O(V)
+    buffer (sweeps converge in tens of supersteps; the cap is generous)."""
+    return max(1, min(int(max_steps) + 1, cap))
+
+
+def traj_empty(cap: int, nb: int = 0, dummy: bool = False):
+    """Fresh trajectory buffer (−1 fill = unwritten). ``dummy=True`` gives
+    the 1-row inert buffer for kernels compiled with telemetry off."""
+    import jax.numpy as jnp
+
+    rows = 1 if dummy else cap
+    return jnp.full((rows, TRAJ_COLS + nb), -1, jnp.int32)
+
+
+def make_trajstep(record):
+    """Per-superstep trajectory writer. ``record`` is a *python* bool:
+    False returns the identity (statically no-op — telemetry-off kernels
+    carry no live recording code), True returns the row write.
+
+    ``trajstep(traj, step, active, any_fail, mc, ba)`` writes row ``step``;
+    out-of-range steps (past the cap) drop on device. ``mc`` / ``ba`` may
+    be None where the engine does not compute them.
+    """
+    import jax.numpy as jnp
+
+    def trajstep(traj, step, active, any_fail, mc=None, ba=None):
+        if record is False:
+            return traj
+        cols = [jnp.asarray(active, jnp.int32),
+                jnp.asarray(any_fail, jnp.int32),
+                jnp.int32(-1) if mc is None else jnp.asarray(mc, jnp.int32)]
+        row = jnp.stack(cols)
+        if ba is not None:
+            row = jnp.concatenate([row, jnp.asarray(ba, jnp.int32)])
+        return traj.at[step].set(row, mode="drop")
+
+    return trajstep
+
+
+@dataclass
+class SuperstepTrajectory:
+    """Host-side decoded per-attempt trajectory."""
+
+    active: np.ndarray                 # int32[S] global actives per superstep
+    fail: np.ndarray                   # int32[S] failure flag per superstep
+    mc: np.ndarray                     # int32[S] divergence candidate (−1: n/a)
+    bucket_active: np.ndarray | None   # int32[S, nb] bucket occupancy, or None
+    first_step: int                    # step index of row 0 (resume offset)
+    truncated: bool                    # steps ran past the buffer cap
+
+    def __len__(self) -> int:
+        return len(self.active)
+
+    def to_dict(self) -> dict:
+        d = {
+            "active": self.active.tolist(),
+            "fail": self.fail.tolist(),
+            "mc": self.mc.tolist(),
+            "first_step": self.first_step,
+            "truncated": self.truncated,
+        }
+        if self.bucket_active is not None:
+            d["bucket_active"] = self.bucket_active.tolist()
+        return d
+
+
+def decode_trajectory(buf, supersteps: int | None = None) -> SuperstepTrajectory:
+    """Decode a device trajectory buffer into the written span.
+
+    Written rows have ``active >= 0`` (the −1 fill marks unwritten); the
+    span is contiguous. ``supersteps`` (the attempt's final step counter)
+    flags truncation when it ran past the buffer cap.
+    """
+    buf = np.asarray(buf)
+    written = buf[:, 0] >= 0
+    idx = np.flatnonzero(written)
+    if len(idx) == 0:
+        empty = np.zeros(0, np.int32)
+        return SuperstepTrajectory(empty, empty, empty, None, 0, False)
+    lo, hi = int(idx[0]), int(idx[-1]) + 1
+    span = buf[lo:hi]
+    nb = buf.shape[1] - TRAJ_COLS
+    truncated = bool(supersteps is not None and supersteps > buf.shape[0])
+    return SuperstepTrajectory(
+        active=span[:, 0].astype(np.int32),
+        fail=span[:, 1].astype(np.int32),
+        mc=span[:, 2].astype(np.int32),
+        bucket_active=span[:, TRAJ_COLS:].astype(np.int32) if nb > 0 else None,
+        first_step=lo,
+        truncated=truncated,
+    )

@@ -1,0 +1,94 @@
+"""Run manifest: one JSON document summarizing a whole run.
+
+The machine-readable artifact the reference never produces (its outputs
+are a coloring JSON and stdout prints): graph provenance, backend, device
+topology, per-attempt results **with their in-kernel superstep
+trajectories**, the host-phase timing breakdown (compile/device/host),
+metrics snapshot, and the final color count. Built incrementally as a
+``RunLogger`` sink — the manifest and the JSONL stream can never disagree
+because they observe the same events.
+
+``tools/report_run.py`` renders a manifest (or a raw JSONL log) into a
+human-readable sweep report.
+"""
+
+from __future__ import annotations
+
+import json
+from pathlib import Path
+
+MANIFEST_VERSION = 1
+
+# events folded into the manifest by copying their fields verbatim
+_INFO_EVENTS = {
+    "graph_loaded": "graph",
+    "graph_generated": "graph",
+    "devices": "devices",
+    "distributed": "distributed",
+    "sweep_start": "sweep",
+}
+
+
+class RunManifest:
+    """Incremental manifest builder; register with ``RunLogger.add_sink``."""
+
+    def __init__(self):
+        self.doc: dict = {
+            "manifest_version": MANIFEST_VERSION,
+            "graph": None,
+            "devices": None,
+            "distributed": None,
+            "sweep": None,
+            "attempts": [],
+            "phases": None,
+            "device_memory": [],
+            "aborts": [],
+            "result": None,
+            "metrics": None,
+        }
+
+    # -- RunLogger sink -------------------------------------------------
+    def __call__(self, record: dict) -> None:
+        kind = record.get("event")
+        fields = {k: v for k, v in record.items() if k not in ("t", "event")}
+        slot = _INFO_EVENTS.get(kind)
+        if slot is not None:
+            self.doc[slot] = fields
+        elif kind == "attempt":
+            self.doc["attempts"].append(dict(fields, trajectory=None))
+        elif kind == "trajectory":
+            # attach to the most recent attempt with a matching k
+            for att in reversed(self.doc["attempts"]):
+                if att.get("k") == fields.get("k") and att["trajectory"] is None:
+                    att["trajectory"] = {
+                        k: v for k, v in fields.items() if k != "k"}
+                    break
+        elif kind == "device_memory":
+            self.doc["device_memory"].append(fields)
+        elif kind == "watchdog_abort":
+            self.doc["aborts"].append(fields)
+        elif kind == "post_reduce":
+            self.doc["post_reduce"] = fields
+        elif kind in ("sweep_done", "sweep_failed"):
+            self.doc["result"] = dict(fields, event=kind)
+
+    # -- finalization ---------------------------------------------------
+    def finalize(self, phases=None, registry=None) -> dict:
+        if phases is not None:
+            self.doc["phases"] = phases.snapshot()
+        if registry is not None:
+            self.doc["metrics"] = registry.to_dict()
+        return self.doc
+
+    def write(self, path: str) -> None:
+        p = Path(path)
+        if str(p.parent) not in ("", "."):
+            p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(self.doc, indent=2, sort_keys=False) + "\n")
+
+
+def load_manifest(path: str) -> dict:
+    doc = json.loads(Path(path).read_text())
+    if not isinstance(doc, dict) or "manifest_version" not in doc:
+        raise ValueError(f"{path}: not a dgc_tpu run manifest")
+    return doc

@@ -1,0 +1,168 @@
+"""Metrics registry: counters, gauges, histograms, and their exporters.
+
+The operational layer the reference lacks entirely (SURVEY.md §5 — its
+only numbers are prints). One process-wide registry per run; exporters:
+
+- ``to_prometheus()`` — Prometheus text exposition format (``# HELP`` /
+  ``# TYPE`` + samples), for ``--metrics-prom`` and scrape sidecars;
+- ``to_dict()`` — plain JSON-able snapshot, embedded in the run manifest.
+
+No third-party client library: the container does not ship one, and the
+exposition format is a few lines of text.
+"""
+
+from __future__ import annotations
+
+import math
+import re
+from dataclasses import dataclass, field
+
+_NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
+
+# wall-time histogram buckets (seconds): spans compile (~10s) down to a
+# single superstep dispatch (~ms)
+DEFAULT_TIME_BUCKETS = (0.001, 0.005, 0.01, 0.05, 0.1, 0.5, 1.0, 5.0,
+                        10.0, 30.0, 60.0)
+
+
+def _fmt(v: float) -> str:
+    if v == math.inf:
+        return "+Inf"
+    if float(v).is_integer():
+        return str(int(v))
+    return repr(float(v))
+
+
+def _escape(v) -> str:
+    return str(v).replace("\\", "\\\\").replace('"', '\\"')
+
+
+def _labels_str(labels: dict) -> str:
+    if not labels:
+        return ""
+    inner = ",".join(f'{k}="{_escape(v)}"' for k, v in sorted(labels.items()))
+    return "{" + inner + "}"
+
+
+@dataclass
+class Counter:
+    name: str
+    help: str
+    labels: dict = field(default_factory=dict)
+    value: float = 0.0
+
+    def inc(self, v: float = 1.0) -> None:
+        if v < 0:
+            raise ValueError(f"counter {self.name} cannot decrease (inc {v})")
+        self.value += v
+
+
+@dataclass
+class Gauge:
+    name: str
+    help: str
+    labels: dict = field(default_factory=dict)
+    value: float = 0.0
+
+    def set(self, v: float) -> None:
+        self.value = float(v)
+
+
+@dataclass
+class Histogram:
+    name: str
+    help: str
+    labels: dict = field(default_factory=dict)
+    buckets: tuple = DEFAULT_TIME_BUCKETS
+    counts: list = None
+    total: float = 0.0
+    n: int = 0
+
+    def __post_init__(self):
+        if self.counts is None:
+            self.counts = [0] * (len(self.buckets) + 1)  # +1: +Inf
+
+    def observe(self, v: float) -> None:
+        self.total += float(v)
+        self.n += 1
+        for i, b in enumerate(self.buckets):
+            if v <= b:
+                self.counts[i] += 1
+                return
+        self.counts[-1] += 1
+
+
+class MetricsRegistry:
+    """Get-or-create registry keyed on (name, sorted labels)."""
+
+    def __init__(self):
+        self._metrics: dict = {}   # (name, labelkey) -> metric
+        self._meta: dict = {}      # name -> (kind, help)
+
+    def _get(self, cls, kind: str, name: str, help: str, labels: dict, **kw):
+        if not _NAME_RE.match(name):
+            raise ValueError(f"invalid metric name: {name!r}")
+        prior = self._meta.get(name)
+        if prior is not None and prior[0] != kind:
+            raise ValueError(
+                f"metric {name} already registered as {prior[0]}, not {kind}")
+        self._meta[name] = (kind, help or (prior[1] if prior else ""))
+        key = (name, tuple(sorted(labels.items())))
+        if key not in self._metrics:
+            self._metrics[key] = cls(name=name, help=help, labels=dict(labels), **kw)
+        return self._metrics[key]
+
+    def counter(self, name: str, help: str = "", **labels) -> Counter:
+        return self._get(Counter, "counter", name, help, labels)
+
+    def gauge(self, name: str, help: str = "", **labels) -> Gauge:
+        return self._get(Gauge, "gauge", name, help, labels)
+
+    def histogram(self, name: str, help: str = "",
+                  buckets: tuple = DEFAULT_TIME_BUCKETS, **labels) -> Histogram:
+        return self._get(Histogram, "histogram", name, help, labels,
+                         buckets=buckets)
+
+    def to_prometheus(self) -> str:
+        """Prometheus text exposition format, families grouped and
+        terminated with the required trailing newline."""
+        out = []
+        for name, (kind, help) in sorted(self._meta.items()):
+            out.append(f"# HELP {name} {help}")
+            out.append(f"# TYPE {name} {kind}")
+            for (n, _), m in sorted(self._metrics.items()):
+                if n != name:
+                    continue
+                if kind == "histogram":
+                    cum = 0
+                    for b, c in zip(tuple(m.buckets) + (math.inf,), m.counts):
+                        cum += c
+                        lab = dict(m.labels, le=_fmt(b))
+                        out.append(f"{name}_bucket{_labels_str(lab)} {cum}")
+                    out.append(f"{name}_sum{_labels_str(m.labels)} {_fmt(m.total)}")
+                    out.append(f"{name}_count{_labels_str(m.labels)} {m.n}")
+                else:
+                    out.append(f"{name}{_labels_str(m.labels)} {_fmt(m.value)}")
+        return "\n".join(out) + "\n"
+
+    def to_dict(self) -> dict:
+        """JSON-able snapshot (embedded in the run manifest)."""
+        snap = {}
+        for (name, labelkey), m in sorted(self._metrics.items()):
+            kind = self._meta[name][0]
+            key = name + _labels_str(dict(labelkey))
+            if kind == "histogram":
+                snap[key] = {"kind": kind, "sum": m.total, "count": m.n,
+                             "buckets": dict(zip(map(_fmt, m.buckets), m.counts[:-1])),
+                             "inf": m.counts[-1]}
+            else:
+                snap[key] = {"kind": kind, "value": m.value}
+        return snap
+
+    def write_prom(self, path: str) -> None:
+        from pathlib import Path
+
+        p = Path(path)
+        if p.parent != Path(""):
+            p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(self.to_prometheus())

@@ -1,0 +1,117 @@
+"""Machine-checkable schema of the JSONL run-log event stream.
+
+One entry per event kind: required fields (name → allowed types) and
+optional fields. ``tools/validate_runlog.py`` enforces this file against a
+log and exits nonzero on unknown kinds, unknown fields, missing required
+fields, or wrong types — so the schema cannot drift silently: adding an
+event or a field means adding it HERE (and the obs tests run the validator
+over every log they produce).
+
+Types use a small vocabulary: ``int``, ``float`` (accepts int), ``str``,
+``bool``, ``list``, ``dict``, ``null`` (None). A tuple means any-of.
+"""
+
+from __future__ import annotations
+
+NUM = ("int", "float")
+
+# event kind -> (required: {field: types}, optional: {field: types})
+EVENT_SCHEMAS: dict = {
+    "graph_loaded": (
+        {"path": "str", "vertices": "int", "max_degree": "int"}, {}),
+    "graph_generated": (
+        {"vertices": "int", "max_degree": "int", "method": "str",
+         "seed": ("int", "null")}, {}),
+    "graph_saved": ({"path": "str"}, {}),
+    "distributed": (
+        {"multi_process": "bool"},
+        {"process_index": "int", "process_count": "int",
+         "local_devices": "int", "global_devices": "int"}),
+    "devices": (
+        {"count": "int", "platform": "str", "device_kind": "str"},
+        {"memory_stats": ("dict", "null")}),
+    "sweep_start": (
+        {"backend": "str", "initial_k": "int", "strict_decrement": "bool"},
+        {}),
+    "attempt": (
+        {"k": "int", "status": "str", "supersteps": "int",
+         "colors_used": ("int", "null")},
+        {"valid": "bool", "uncolored": "int", "conflicts": "int"}),
+    "trajectory": (
+        {"k": "int", "active": "list", "fail": "list", "mc": "list",
+         "first_step": "int", "truncated": "bool"},
+        {"bucket_active": "list"}),
+    "phase": (
+        {"name": "str", "seconds": NUM},
+        {"k": "int", "attempt_index": "int", "warm": "bool"}),
+    "device_memory": (
+        {"device": "str"}, {"bytes_in_use": "int", "peak_bytes_in_use": "int",
+                            "bytes_limit": "int", "stats": ("dict", "null")}),
+    "watchdog_abort": (
+        {"what": "str", "diag": "str"}, {"timeout_s": NUM}),
+    "post_reduce": (
+        {"from_colors": "int", "to_colors": "int", "time_s": NUM}, {}),
+    "sweep_done": (
+        {"minimal_colors": "int", "attempts": "int", "supersteps": "int",
+         "wall_time_s": NUM}, {}),
+    "sweep_failed": ({"initial_k": "int"}, {}),
+    "manifest_written": ({"path": "str"}, {}),
+    "metrics_written": ({"path": "str"}, {}),
+}
+
+
+def _type_ok(value, ty) -> bool:
+    if isinstance(ty, tuple):
+        return any(_type_ok(value, t) for t in ty)
+    if ty == "null":
+        return value is None
+    if ty == "int":
+        return isinstance(value, int) and not isinstance(value, bool)
+    if ty == "float":
+        return (isinstance(value, (int, float))
+                and not isinstance(value, bool))
+    if ty == "str":
+        return isinstance(value, str)
+    if ty == "bool":
+        return isinstance(value, bool)
+    if ty == "list":
+        return isinstance(value, list)
+    if ty == "dict":
+        return isinstance(value, dict)
+    raise ValueError(f"unknown schema type {ty!r}")
+
+
+def validate_record(record) -> list[str]:
+    """Schema-check one parsed JSONL record; returns a list of problems
+    (empty = valid)."""
+    problems: list[str] = []
+    if not isinstance(record, dict):
+        return [f"record is not an object: {type(record).__name__}"]
+    t = record.get("t")
+    if not _type_ok(t, NUM):
+        problems.append(f"missing/invalid 't': {t!r}")
+    kind = record.get("event")
+    if not isinstance(kind, str):
+        return problems + [f"missing/invalid 'event': {kind!r}"]
+    schema = EVENT_SCHEMAS.get(kind)
+    if schema is None:
+        return problems + [f"unknown event kind {kind!r}"]
+    required, optional = schema
+    fields = {k: v for k, v in record.items() if k not in ("t", "event")}
+    for name, ty in required.items():
+        if name not in fields:
+            problems.append(f"{kind}: missing required field {name!r}")
+        elif not _type_ok(fields[name], ty):
+            problems.append(
+                f"{kind}: field {name!r} has wrong type "
+                f"({type(fields[name]).__name__}, want {ty})")
+    for name, value in fields.items():
+        if name in required:
+            continue
+        if name not in optional:
+            problems.append(f"{kind}: unknown field {name!r}")
+        elif not _type_ok(value, optional[name]):
+            problems.append(
+                f"{kind}: field {name!r} has wrong type "
+                f"({type(value).__name__}, want {optional[name]})")
+    return problems

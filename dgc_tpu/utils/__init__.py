@@ -4,4 +4,9 @@ The reference's observability is bare ``print()`` calls (uncolored counts,
 timings, validation booleans — ``coloring.py:89,107,153,160,222-224,233-235``)
 and it has no checkpointing at all (SURVEY.md §5). These modules provide the
 structured equivalents the build plan calls for (§7.2 step 7).
+
+The logging/tracing half now lives in ``dgc_tpu.obs`` (the unified
+telemetry subsystem — in-kernel superstep trajectories, run manifests,
+metrics exporters); ``utils.logging`` and ``utils.tracing`` remain as
+backward-compatible shims/oracles.
 """

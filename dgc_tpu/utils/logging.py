@@ -1,55 +1,9 @@
-"""Structured run logging with reference-parity console output.
-
-The reference prints per-superstep uncolored counts, per-k-iteration wall
-times, validation results, and final totals (``coloring.py:89,222-224,
-233-235``). ``RunLogger`` emits the same human-readable lines *and* an
-optional machine-readable JSONL stream (one event object per line) — the
-metrics/observability subsystem the reference lacks (SURVEY.md §5).
+"""Backward-compatible shim: ``RunLogger`` moved to ``dgc_tpu.obs.events``
+(the unified telemetry subsystem). Import from ``dgc_tpu.obs`` in new code.
 """
 
 from __future__ import annotations
 
-import json
-import sys
-import time
-from pathlib import Path
+from dgc_tpu.obs.events import RunLogger
 
-
-class RunLogger:
-    def __init__(self, jsonl_path: str | None = None, stream=None, echo: bool = True):
-        self.stream = stream if stream is not None else sys.stdout
-        self.echo = echo
-        self._jsonl = None
-        if jsonl_path:
-            Path(jsonl_path).parent.mkdir(parents=True, exist_ok=True)
-            self._jsonl = open(jsonl_path, "a")
-        self._t0 = time.perf_counter()
-
-    def event(self, kind: str, **fields) -> None:
-        record = {"t": round(time.perf_counter() - self._t0, 6), "event": kind, **fields}
-        if self._jsonl is not None:
-            self._jsonl.write(json.dumps(record) + "\n")
-            self._jsonl.flush()
-        if self.echo:
-            pretty = " ".join(f"{k}={v}" for k, v in fields.items())
-            print(f"[{record['t']:10.4f}s] {kind}: {pretty}", file=self.stream)
-
-    def attempt(self, res, val=None) -> None:
-        """Per-k-iteration line (reference prints elapsed time and validity
-        per outer iteration, ``coloring.py:222-224``)."""
-        fields = dict(
-            k=res.k,
-            status=res.status.name,
-            supersteps=res.supersteps,
-            colors_used=res.colors_used if res.success else None,
-        )
-        if val is not None:
-            fields["valid"] = val.valid
-            fields["uncolored"] = val.uncolored
-            fields["conflicts"] = val.conflicts
-        self.event("attempt", **fields)
-
-    def close(self) -> None:
-        if self._jsonl is not None:
-            self._jsonl.close()
-            self._jsonl = None
+__all__ = ["RunLogger"]

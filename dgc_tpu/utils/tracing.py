@@ -1,4 +1,4 @@
-"""Tracing/profiling subsystem.
+"""Tracing/profiling subsystem (part of ``dgc_tpu.obs``).
 
 The reference's tracing is wall-clock prints around each k-iteration and
 per-superstep uncolored counts (``coloring.py:89,214-223``, SURVEY.md §5).
@@ -8,8 +8,9 @@ Equivalents here:
 - ``trace_attempt``: run one k-attempt superstep-at-a-time (host-stepped
   loop over the jitted superstep instead of the fused ``lax.while_loop``),
   recording per-superstep active counts and wall times. Slower than the
-  fused kernel (one dispatch per superstep) — an observability mode, not
-  the production path.
+  fused kernel (one dispatch per superstep) — the ground-truth oracle the
+  in-kernel telemetry (``obs.kernel``, zero extra dispatches) is parity-
+  tested against, not the production observability path.
 - ``profile``: context manager around ``jax.profiler.trace`` for XLA-level
   traces when a trace dir is given.
 """

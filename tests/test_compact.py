@@ -245,9 +245,9 @@ def test_sweep_confirm_stall_falls_back_to_attempt(medium_graph, monkeypatch):
     orig = compact_mod._sweep_kernel_staged
 
     def stalled_confirm(*args, **kw):
-        pe1, steps1, status1, used, pe2, steps2, _ = orig(*args, **kw)
+        pe1, steps1, status1, used, pe2, steps2, _, traj1, traj2 = orig(*args, **kw)
         return (pe1, steps1, status1, used, pe2, steps2,
-                np.int32(AttemptStatus.STALLED))
+                np.int32(AttemptStatus.STALLED), traj1, traj2)
 
     monkeypatch.setattr(compact_mod, "_sweep_kernel_staged", stalled_confirm)
     first, second = eng.sweep(g.max_degree + 1)
@@ -711,7 +711,7 @@ def test_unified_pipeline_matches_sequential_hub_free():
             init = _default_init(degrees, kw["init_bucket_active"])
             rec = _empty_rec(degrees.shape[0],
                              len(kw["init_bucket_active"]), dummy=True)
-            pe, steps, status, _ = pipeline(
+            pe, steps, status, _, _ = pipeline(
                 buckets, flat_ext, degrees, kk, init, rec, False, **kw)
             return pe, steps, status
         return jax.jit(fn)(tuple(eng.combined_buckets), eng.flat_ext,

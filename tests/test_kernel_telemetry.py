@@ -1,0 +1,161 @@
+"""In-kernel superstep telemetry (obs.kernel threaded through the fused
+engines' while-loop carries).
+
+The contract under test: with ``record_trajectory`` enabled an engine
+returns the complete per-superstep trajectory **from the fused kernel**
+(one device call, one trajectory transfer per attempt — no host-stepped
+loop, no per-superstep round-trips), and the numbers match the
+host-stepped ``trace_attempt`` / NumPy-replay ground truths exactly.
+"""
+
+import numpy as np
+import pytest
+
+import dgc_tpu.engine.superstep as superstep_mod
+from dgc_tpu.engine.base import AttemptStatus
+from dgc_tpu.engine.compact import CompactFrontierEngine
+from dgc_tpu.engine.superstep import ELLEngine
+from dgc_tpu.models.generators import (
+    generate_random_graph_fast,
+    generate_rmat_graph,
+)
+from dgc_tpu.utils.tracing import trace_attempt
+
+
+@pytest.fixture(scope="module")
+def graph_10k():
+    return generate_random_graph_fast(10_000, avg_degree=8.0, seed=42)
+
+
+def test_ell_inkernel_matches_trace_attempt_10k(graph_10k):
+    # acceptance criterion: per-superstep active counts recorded by the
+    # fused kernel match the host-stepped trace_attempt ground truth
+    # EXACTLY on a seeded 10k-vertex graph — success and failure attempts
+    g = graph_10k
+    eng = ELLEngine(g)
+    eng.record_trajectory = True
+    k0 = g.max_degree + 1
+
+    res = eng.attempt(k0)
+    ref = trace_attempt(ELLEngine(g), k0)
+    assert res.status == AttemptStatus.SUCCESS
+    assert res.trajectory is not None
+    assert res.trajectory.active.tolist() == ref.active_per_step
+    assert len(res.trajectory) == res.supersteps
+    assert not res.trajectory.truncated
+    assert res.trajectory.fail.sum() == 0
+    assert res.trajectory.active[-1] == 0
+
+    k_fail = res.colors_used - 1
+    res_f = eng.attempt(k_fail)
+    ref_f = trace_attempt(ELLEngine(g), k_fail)
+    assert res_f.status == AttemptStatus.FAILURE == AttemptStatus(ref_f.status)
+    assert res_f.trajectory.active.tolist() == ref_f.active_per_step
+    # the conflict superstep is the last recorded row
+    assert res_f.trajectory.fail[-1] == 1
+    assert res_f.trajectory.fail[:-1].sum() == 0
+
+
+def test_ell_one_transfer_per_attempt(graph_10k, monkeypatch):
+    # acceptance criterion: a fused attempt with metrics enabled performs
+    # no per-superstep host transfers — the whole trajectory arrives from
+    # ONE kernel invocation (per-superstep dispatch would show up here as
+    # one call per superstep, the trace_attempt shape)
+    g = graph_10k
+    eng = ELLEngine(g)
+    eng.record_trajectory = True
+    calls = []
+    orig = superstep_mod._attempt_kernel
+
+    def counting_kernel(*args, **kw):
+        calls.append(kw.get("record_traj"))
+        return orig(*args, **kw)
+
+    monkeypatch.setattr(superstep_mod, "_attempt_kernel", counting_kernel)
+    res = eng.attempt(g.max_degree + 1)
+    assert calls == [True]
+    assert res.supersteps > 1  # multi-superstep attempt, single device call
+    assert len(res.trajectory) == res.supersteps
+
+
+def test_telemetry_off_is_inert(graph_10k):
+    # record_trajectory=False (the default) must stay the production path:
+    # no trajectory attached, identical colors/steps to the traced variant
+    g = graph_10k
+    plain = ELLEngine(g)
+    res_p = plain.attempt(g.max_degree + 1)
+    traced = ELLEngine(g)
+    traced.record_trajectory = True
+    res_t = traced.attempt(g.max_degree + 1)
+    assert res_p.trajectory is None
+    assert res_t.trajectory is not None
+    assert res_p.supersteps == res_t.supersteps
+    assert np.array_equal(res_p.colors, res_t.colors)
+
+
+def test_compact_trajectory_matches_replay():
+    # the staged/bucketed flagship: in-kernel actives must equal the exact
+    # NumPy trajectory replay (utils.trajectory), step for step. The
+    # replay logs the PRE-update frontier (including the round-1
+    # specialized state), the kernel logs each superstep's POST-update
+    # count — the same series shifted by one, plus the final converged row
+    from dgc_tpu.utils.trajectory import record_trajectory
+
+    g = generate_rmat_graph(1500, avg_degree=10.0, seed=7)
+    replay = record_trajectory(g)
+    eng = CompactFrontierEngine(g)
+    eng.record_trajectory = True
+    res = eng.attempt(g.max_degree + 1)
+    traj = res.trajectory
+    assert traj is not None
+    # engine counts the round-1 specialization as a superstep; rows span
+    # [first_step, supersteps)
+    assert traj.first_step + len(traj) == res.supersteps
+    replay_actives = [s.active for s in replay.steps]
+    assert traj.active[:-1].tolist() == replay_actives[1:]
+    assert traj.active[-1] == 0 and res.status == AttemptStatus.SUCCESS
+    # bucket occupancy rows (hub buckets + flat total) sum to the global
+    # active count every superstep
+    assert traj.bucket_active is not None
+    assert np.array_equal(traj.bucket_active.sum(axis=1), traj.active)
+
+
+def test_compact_sweep_trajectories_and_resume():
+    # the fused jump-mode pair returns BOTH attempts' trajectories in one
+    # device call; the prefix-resumed confirm records only its post-resume
+    # rows (first_step > 1) and the span still ends at its steps counter
+    g = generate_random_graph_fast(20_000, avg_degree=8.0, seed=1)
+    plain = CompactFrontierEngine(g)
+    p1, p2 = plain.sweep(g.max_degree + 1)
+
+    eng = CompactFrontierEngine(g)
+    eng.record_trajectory = True
+    first, second = eng.sweep(g.max_degree + 1)
+    assert first.status == AttemptStatus.SUCCESS
+    assert second.status == AttemptStatus.FAILURE
+    # telemetry must not perturb the sweep (bit-identical contract)
+    assert np.array_equal(first.colors, p1.colors)
+    assert first.supersteps == p1.supersteps
+    assert second.supersteps == p2.supersteps
+
+    t1, t2 = first.trajectory, second.trajectory
+    assert t1.first_step + len(t1) == first.supersteps
+    assert t2.first_step + len(t2) == second.supersteps
+    assert t1.fail.sum() == 0 and t2.fail[-1] == 1
+    # actives are monotone non-increasing after the first couple rounds
+    a = t1.active
+    assert all(x >= y for x, y in zip(a[1:], a[2:]))
+
+
+def test_trajectory_decode_handles_truncation():
+    from dgc_tpu.obs.kernel import decode_trajectory, traj_empty
+
+    buf = np.asarray(traj_empty(4))
+    buf = buf.copy()
+    buf[0] = [10, 0, -1]
+    buf[1] = [5, 0, -1]
+    t = decode_trajectory(buf, supersteps=9)  # ran past the 4-row cap
+    assert t.truncated
+    assert t.active.tolist() == [10, 5]
+    t2 = decode_trajectory(np.asarray(traj_empty(4)), supersteps=0)
+    assert len(t2) == 0 and not t2.truncated

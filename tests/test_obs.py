@@ -1,0 +1,244 @@
+"""Obs subsystem host layer: metrics exporters, event stream + schema,
+run manifest, report/validator tools, bench abort record."""
+
+import json
+import math
+import re
+
+import pytest
+
+from dgc_tpu.obs.events import RunLogger
+from dgc_tpu.obs.manifest import RunManifest, load_manifest
+from dgc_tpu.obs.metrics import MetricsRegistry
+from dgc_tpu.obs.schema import EVENT_SCHEMAS, validate_record
+
+
+# ---------------------------------------------------------------- metrics
+
+_SAMPLE_RE = re.compile(
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*(\{[a-zA-Z_][a-zA-Z0-9_]*="[^"]*"'
+    r'(,[a-zA-Z_][a-zA-Z0-9_]*="[^"]*")*\})? [0-9.eE+-]+$|'
+    r'^[a-zA-Z_:][a-zA-Z0-9_:]*_bucket\{.*le="(\+Inf|[0-9.eE+-]+)".*\} [0-9]+$')
+
+
+def test_prometheus_exposition_format_valid():
+    reg = MetricsRegistry()
+    reg.counter("dgc_attempts_total", "attempts", status="SUCCESS").inc()
+    reg.counter("dgc_attempts_total", "attempts", status="FAILURE").inc(2)
+    reg.gauge("dgc_minimal_colors", "final colors").set(7)
+    h = reg.histogram("dgc_attempt_seconds", "attempt wall", buckets=(0.1, 1.0))
+    h.observe(0.05)
+    h.observe(0.5)
+    h.observe(30.0)
+    text = reg.to_prometheus()
+    assert text.endswith("\n")
+    lines = text.splitlines()
+    # every family has HELP+TYPE exactly once, before its samples
+    for name, kind in (("dgc_attempts_total", "counter"),
+                       ("dgc_minimal_colors", "gauge"),
+                       ("dgc_attempt_seconds", "histogram")):
+        assert lines.count(f"# TYPE {name} {kind}") == 1
+        assert lines.index(f"# HELP {name} " + {"counter": "attempts",
+                                                "gauge": "final colors",
+                                                "histogram": "attempt wall"}[kind]) \
+            < lines.index(f"# TYPE {name} {kind}")
+    for line in lines:
+        if line.startswith("#"):
+            assert re.match(r"^# (HELP|TYPE) [a-zA-Z_:][a-zA-Z0-9_:]* ", line)
+        else:
+            assert _SAMPLE_RE.match(line), f"malformed sample line: {line!r}"
+    # histogram invariants: cumulative buckets, +Inf == count
+    assert 'dgc_attempt_seconds_bucket{le="0.1"} 1' in lines
+    assert 'dgc_attempt_seconds_bucket{le="1"} 2' in lines
+    assert 'dgc_attempt_seconds_bucket{le="+Inf"} 3' in lines
+    assert "dgc_attempt_seconds_count 3" in lines
+    [s] = [l for l in lines if l.startswith("dgc_attempt_seconds_sum")]
+    assert math.isclose(float(s.split()[1]), 30.55)
+
+
+def test_metrics_registry_guards():
+    reg = MetricsRegistry()
+    reg.counter("x_total", "x").inc()
+    with pytest.raises(ValueError):
+        reg.gauge("x_total", "re-registered as another kind")
+    with pytest.raises(ValueError):
+        reg.counter("bad name!", "invalid chars")
+    with pytest.raises(ValueError):
+        reg.counter("x_total", "x").inc(-1)
+    # same labels → same instance; snapshot is JSON-able
+    assert reg.counter("x_total", "x") is reg.counter("x_total", "x")
+    json.dumps(reg.to_dict())
+
+
+# ------------------------------------------------------- events + schema
+
+def test_runlogger_console_drops_none_jsonl_keeps_null(tmp_path, capsys):
+    # satellite regression: colors_used=None must vanish from the console
+    # line but stay a JSON null in the JSONL stream (stable schema)
+    path = tmp_path / "run.jsonl"
+    logger = RunLogger(jsonl_path=str(path))
+    logger.event("attempt", k=5, status="FAILURE", supersteps=3,
+                 colors_used=None)
+    logger.close()
+    console = capsys.readouterr().out
+    assert "colors_used" not in console
+    assert "k=5" in console and "status=FAILURE" in console
+    [rec] = [json.loads(l) for l in path.read_text().splitlines()]
+    # pin the JSONL schema: exact key set, null preserved
+    assert set(rec) == {"t", "event", "k", "status", "supersteps",
+                        "colors_used"}
+    assert rec["colors_used"] is None
+    assert validate_record(rec) == []
+
+
+def test_schema_validator_rejects_drift():
+    ok = {"t": 0.1, "event": "sweep_start", "backend": "ell",
+          "initial_k": 9, "strict_decrement": False}
+    assert validate_record(ok) == []
+    assert validate_record({"t": 0.1, "event": "no_such_event"})
+    assert validate_record(dict(ok, extra_field=1))      # unknown field
+    missing = dict(ok)
+    del missing["backend"]
+    assert validate_record(missing)                      # missing required
+    assert validate_record(dict(ok, initial_k="nine"))   # wrong type
+    assert validate_record("not an object")
+    # every declared schema is well-formed (types resolvable)
+    for kind, (req, opt) in EVENT_SCHEMAS.items():
+        rec = {"t": 0.0, "event": kind}
+        problems = validate_record(rec)
+        for name in req:
+            assert any(name in p for p in problems), (kind, name)
+
+
+# ------------------------------------------------ end-to-end CLI + tools
+
+@pytest.fixture(scope="module")
+def obs_run(tmp_path_factory):
+    """One small CLI run with every obs output enabled."""
+    from dgc_tpu.cli import main
+
+    tmp = tmp_path_factory.mktemp("obs_run")
+    paths = {
+        "colors": tmp / "colors.json",
+        "log": tmp / "run.jsonl",
+        "manifest": tmp / "manifest.json",
+        "prom": tmp / "metrics.prom",
+    }
+    rc = main([
+        "--node-count", "300", "--max-degree", "8", "--seed", "11",
+        "--backend", "ell-compact",
+        "--output-coloring", str(paths["colors"]),
+        "--log-json", str(paths["log"]),
+        "--run-manifest", str(paths["manifest"]),
+        "--metrics-prom", str(paths["prom"]),
+    ])
+    assert rc == 0
+    return paths
+
+
+def test_event_stream_complete_and_schema_clean(obs_run):
+    import sys
+    sys.path.insert(0, "tools")
+    from validate_runlog import validate_file
+
+    # the produced log passes the schema validator (drift guard wiring)
+    assert validate_file(str(obs_run["log"])) == []
+    events = [json.loads(l) for l in
+              obs_run["log"].read_text().splitlines()]
+    kinds = [e["event"] for e in events]
+    for expected in ("graph_generated", "devices", "sweep_start", "attempt",
+                     "trajectory", "phase", "sweep_done",
+                     "manifest_written", "metrics_written"):
+        assert expected in kinds, f"missing {expected} event"
+    # completeness: every attempt has a matching trajectory event whose
+    # span ends exactly at the attempt's superstep counter
+    attempts = [e for e in events if e["event"] == "attempt"]
+    trajs = [e for e in events if e["event"] == "trajectory"]
+    assert len(attempts) == len(trajs) >= 2
+    for att, tr in zip(attempts, trajs):
+        assert att["k"] == tr["k"]
+        assert tr["first_step"] + len(tr["active"]) == att["supersteps"]
+        assert len(tr["active"]) == len(tr["fail"]) == len(tr["mc"])
+
+
+def test_validate_runlog_cli_flags_bad_logs(tmp_path):
+    import sys
+    sys.path.insert(0, "tools")
+    import validate_runlog
+
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(
+        json.dumps({"t": 0.0, "event": "unknown_kind"}) + "\n"
+        + json.dumps({"t": 0.0, "event": "attempt", "k": 1}) + "\n"
+        + "{not json\n")
+    assert validate_runlog.main([str(bad)]) == 1
+    good = tmp_path / "good.jsonl"
+    good.write_text(json.dumps(
+        {"t": 0.0, "event": "sweep_failed", "initial_k": 3}) + "\n")
+    assert validate_runlog.main([str(good), "-q"]) == 0
+
+
+def test_manifest_roundtrip_and_report(obs_run, capsys):
+    import sys
+    sys.path.insert(0, "tools")
+    import report_run
+
+    doc = load_manifest(str(obs_run["manifest"]))
+    assert doc["graph"]["vertices"] == 300
+    assert doc["result"]["event"] == "sweep_done"
+    # per-attempt superstep trajectories present in the manifest
+    assert len(doc["attempts"]) >= 2
+    for att in doc["attempts"]:
+        assert att["trajectory"] is not None
+        assert att["trajectory"]["first_step"] \
+            + len(att["trajectory"]["active"]) == att["supersteps"]
+    # phase breakdown: compile (cold call) and host phases recorded
+    totals = doc["phases"]["totals"]
+    assert "compile" in totals and "host_graph" in totals
+    assert doc["metrics"], "metrics snapshot embedded"
+
+    # report renders both the manifest and the raw JSONL without error
+    assert report_run.main([str(obs_run["manifest"])]) == 0
+    out_m = capsys.readouterr().out
+    assert "RESULT:" in out_m and "attempts (" in out_m
+    assert report_run.main([str(obs_run["log"])]) == 0
+    out_l = capsys.readouterr().out
+    assert "RESULT:" in out_l
+
+    # prometheus artifact exists and carries the run's headline gauge
+    prom = obs_run["prom"].read_text()
+    assert "# TYPE dgc_minimal_colors gauge" in prom
+    assert "dgc_attempts_total" in prom
+
+
+def test_manifest_sink_incremental():
+    m = RunManifest()
+    m({"t": 0.0, "event": "sweep_start", "backend": "ell", "initial_k": 5,
+       "strict_decrement": False})
+    m({"t": 0.1, "event": "attempt", "k": 5, "status": "SUCCESS",
+       "supersteps": 4, "colors_used": 3})
+    m({"t": 0.2, "event": "trajectory", "k": 5, "active": [9, 3, 0],
+       "fail": [0, 0, 0], "mc": [1, 2, -1], "first_step": 1,
+       "truncated": False})
+    m({"t": 0.3, "event": "watchdog_abort", "what": "device init",
+       "diag": "tunnel down"})
+    assert m.doc["sweep"]["backend"] == "ell"
+    assert m.doc["attempts"][0]["trajectory"]["active"] == [9, 3, 0]
+    assert m.doc["aborts"][0]["diag"] == "tunnel down"
+
+
+def test_bench_abort_record_carries_partial_phases(capsys):
+    # satellite: the rc-113 abort JSON must include everything measured
+    # before the abort plus the probed backend/platform
+    import bench
+
+    phases = {"gen_s": 1.5, "engine_build_s": 0.25}
+    context = {"backend": "sharded", "platform": "proxy", "probed": True}
+    bench._bench_abort_record("bench_aborted_backend_unreachable",
+                              phases, context)("tunnel down")
+    err_then_out = capsys.readouterr()
+    assert "# BENCH ABORTED" in err_then_out.err
+    rec = json.loads(err_then_out.out.strip().splitlines()[-1])
+    assert rec["value"] is None and rec["vs_baseline"] == 0.0
+    assert rec["backend"] == "sharded" and rec["platform"] == "proxy"
+    assert rec["phases"] == {"gen_s": 1.5, "engine_build_s": 0.25}

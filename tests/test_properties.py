@@ -19,7 +19,11 @@ Invariants:
 
 import numpy as np
 import pytest
-from hypothesis import given, settings, strategies as st
+
+# hypothesis is an optional dev dependency: skip the whole module at
+# collection instead of erroring when it isn't installed
+pytest.importorskip("hypothesis")
+from hypothesis import given, settings, strategies as st  # noqa: E402
 
 from dgc_tpu.engine.base import AttemptStatus
 from dgc_tpu.engine.bucketed import BucketedELLEngine

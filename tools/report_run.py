@@ -1,0 +1,145 @@
+#!/usr/bin/env python
+"""Render a dgc_tpu run artifact into a human-readable sweep report.
+
+Input: a run manifest (``dgc-tpu --run-manifest out.json``) or a raw JSONL
+run log (``--log-json``) — a JSONL log is replayed through the same
+``RunManifest`` sink the CLI uses, so both inputs render identically.
+
+Usage: python tools/report_run.py MANIFEST_OR_JSONL [--width N]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dgc_tpu.obs.manifest import RunManifest, load_manifest  # noqa: E402
+
+_BARS = " ▁▂▃▄▅▆▇█"
+
+
+def sparkline(values, width: int = 48) -> str:
+    """Down-sampled unicode sparkline of a count series."""
+    if not values:
+        return ""
+    if len(values) > width:
+        stride = len(values) / width
+        values = [values[int(i * stride)] for i in range(width)]
+    peak = max(max(values), 1)
+    return "".join(_BARS[min(int(v / peak * (len(_BARS) - 1)), len(_BARS) - 1)]
+                   for v in values)
+
+
+def _load(path: str) -> dict:
+    if path.endswith(".jsonl"):
+        manifest = RunManifest()
+        with open(path) as fh:
+            for line in fh:
+                line = line.strip()
+                if line:
+                    manifest(json.loads(line))
+        return manifest.doc
+    return load_manifest(path)
+
+
+def render(doc: dict, width: int = 48) -> str:
+    out = []
+    add = out.append
+    add("=== dgc_tpu run report ===")
+    g = doc.get("graph")
+    if g:
+        add("graph:    " + ", ".join(f"{k}={v}" for k, v in g.items()))
+    d = doc.get("devices")
+    if d:
+        add(f"devices:  {d.get('count')}x {d.get('device_kind')} "
+            f"({d.get('platform')})")
+    s = doc.get("sweep")
+    if s:
+        add(f"sweep:    backend={s.get('backend')} initial_k={s.get('initial_k')} "
+            f"strict={s.get('strict_decrement')}")
+
+    attempts = doc.get("attempts") or []
+    if attempts:
+        add("")
+        add(f"attempts ({len(attempts)}):")
+        add(f"  {'k':>6} {'status':<8} {'steps':>6} {'colors':>7}  trajectory (active/superstep)")
+        for att in attempts:
+            traj = att.get("trajectory") or {}
+            active = traj.get("active") or []
+            spark = sparkline(active, width)
+            extra = ""
+            if traj.get("truncated"):
+                extra = " (truncated)"
+            elif traj.get("first_step", 0) > 1 and active:
+                extra = f" (resumed @s{traj['first_step']})"
+            colors = att.get("colors_used")
+            add(f"  {att.get('k', '?'):>6} {att.get('status', '?'):<8} "
+                f"{att.get('supersteps', '?'):>6} "
+                f"{colors if colors is not None else '-':>7}  {spark}{extra}")
+            if traj.get("fail") and any(traj["fail"]):
+                add(f"{'':>38}conflict superstep(s): "
+                    f"{[i + traj.get('first_step', 0) for i, f in enumerate(traj['fail']) if f]}")
+
+    ph = doc.get("phases") or {}
+    totals = ph.get("totals") or {}
+    if totals:
+        add("")
+        add("phase breakdown (s):")
+        span = sum(totals.values()) or 1.0
+        for name in sorted(totals, key=totals.get, reverse=True):
+            if name == "sweep_total":  # umbrella — overlaps compile/device
+                continue
+            v = totals[name]
+            add(f"  {name:<18} {v:>9.4f}  {'#' * max(1, int(v / span * 30))}")
+        if "sweep_total" in totals:
+            add(f"  {'(sweep_total)':<18} {totals['sweep_total']:>9.4f}")
+
+    for mem in doc.get("device_memory") or []:
+        if mem.get("bytes_in_use") is not None:
+            add(f"memory:   {mem.get('device')}: "
+                f"{mem['bytes_in_use'] / 1e6:.1f} MB in use"
+                + (f" (peak {mem['peak_bytes_in_use'] / 1e6:.1f} MB)"
+                   if mem.get("peak_bytes_in_use") is not None else ""))
+
+    for ab in doc.get("aborts") or []:
+        add(f"ABORT:    {ab.get('what')}: {ab.get('diag')}")
+
+    pr = doc.get("post_reduce")
+    if pr:
+        add(f"reduce:   {pr.get('from_colors')} -> {pr.get('to_colors')} colors "
+            f"in {pr.get('time_s')}s")
+
+    res = doc.get("result")
+    add("")
+    if res and res.get("event") == "sweep_done":
+        add(f"RESULT:   {res.get('minimal_colors')} colors, "
+            f"{res.get('attempts')} attempts, {res.get('supersteps')} supersteps, "
+            f"{res.get('wall_time_s')}s wall")
+    elif res:
+        add(f"RESULT:   FAILED (initial_k={res.get('initial_k')})")
+    else:
+        add("RESULT:   (run did not complete)")
+    return "\n".join(out) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("path", help="run manifest JSON or JSONL run log")
+    p.add_argument("--width", type=int, default=48,
+                   help="sparkline width (supersteps are down-sampled)")
+    args = p.parse_args(argv)
+    try:
+        doc = _load(args.path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: cannot load {args.path}: {e}", file=sys.stderr)
+        return 2
+    sys.stdout.write(render(doc, width=args.width))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

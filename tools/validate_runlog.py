@@ -1,0 +1,67 @@
+#!/usr/bin/env python
+"""Schema-check a JSONL run log against ``dgc_tpu.obs.schema``.
+
+Exits nonzero on any unknown event kind, unknown field, missing required
+field, wrong field type, or unparseable line — the drift guard the obs
+tests run over every log they produce, so an event emitted anywhere in the
+codebase without a matching schema entry fails CI instead of silently
+rotting the contract.
+
+Usage: python tools/validate_runlog.py RUNLOG.jsonl [...]
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dgc_tpu.obs.schema import validate_record  # noqa: E402
+
+
+def validate_file(path: str) -> list[str]:
+    """All schema problems in one JSONL log, prefixed with line numbers."""
+    problems: list[str] = []
+    with open(path) as fh:
+        for lineno, line in enumerate(fh, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError as e:
+                problems.append(f"{path}:{lineno}: unparseable JSON: {e}")
+                continue
+            for problem in validate_record(record):
+                problems.append(f"{path}:{lineno}: {problem}")
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("paths", nargs="+", help="JSONL run log(s)")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the per-file OK lines")
+    args = p.parse_args(argv)
+    rc = 0
+    for path in args.paths:
+        try:
+            problems = validate_file(path)
+        except OSError as e:
+            print(f"{path}: {e}", file=sys.stderr)
+            rc = 2
+            continue
+        if problems:
+            rc = 1
+            for problem in problems:
+                print(problem, file=sys.stderr)
+        elif not args.quiet:
+            print(f"{path}: OK")
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
