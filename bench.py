@@ -85,6 +85,18 @@ def main() -> int:
                    default=_env_float("DGC_TPU_BENCH_RUN_TIMEOUT", 5400.0),
                    help="seconds to allow the whole run after device init; "
                         "0 disables the deadline")
+    # resilience layer (dgc_tpu.resilience): retry/fault counts are
+    # published beside the phase breakdown either way; with both flags at
+    # zero the engine is driven directly (pre-resilience dispatch chain)
+    p.add_argument("--retries", type=int, default=0,
+                   help="transient-error retry budget around each "
+                        "attempt/sweep dispatch (0 = no retry proxy)")
+    p.add_argument("--attempt-timeout", type=float, default=0.0,
+                   help="soft watchdog seconds per attempt dispatch "
+                        "(0 = disabled)")
+    p.add_argument("--inject-faults", type=str, default=None, metavar="SPEC",
+                   help="deterministic fault schedule "
+                        "(POINT@N=KIND[:PARAM], dgc_tpu.resilience.faults)")
     args = p.parse_args()
 
     import jax
@@ -163,6 +175,23 @@ def main() -> int:
     phases["engine_build_s"] = time.perf_counter() - t0
     k0 = arrays.max_degree + 1
 
+    from dgc_tpu.resilience import faults as _faults
+    from dgc_tpu.resilience.supervisor import ResilienceStats, RetryingEngine
+
+    resilience_stats = ResilienceStats()
+    if args.inject_faults:
+        _faults.install(_faults.FaultPlane(
+            _faults.FaultSchedule.parse(args.inject_faults), hard_kill=True))
+    if args.retries > 0 or args.attempt_timeout > 0:
+        from dgc_tpu.resilience.retry import RetryBudget, RetryPolicy
+
+        engine = RetryingEngine(
+            engine, backend=args.backend,
+            policy=RetryPolicy(seed=args.seed),
+            budget=RetryBudget(args.retries),
+            attempt_timeout_s=args.attempt_timeout,
+            stats=resilience_stats)
+
     if not args.include_compile:
         t0 = time.perf_counter()
         # warm-up must compile the same kernels the measured sweep uses
@@ -222,6 +251,12 @@ def main() -> int:
         # keys the abort records carry, so a degraded run's partial phases
         # line up with a healthy run's full set
         "phases": {k: round(v, 4) for k, v in phases.items()},
+        # retry/fallback counts beside the phase breakdown (resilience
+        # subsystem); all-zero on a healthy run with the layer off
+        "resilience": {"retries": resilience_stats.retries,
+                       "attempt_timeouts": resilience_stats.attempt_timeouts,
+                       "fallbacks": resilience_stats.fallbacks,
+                       "faults_injected": resilience_stats.faults_injected},
         "backend": args.backend,
         "platform": context["platform"],
         # the wall-clock a CLI user experiences: sweep + recolor pass +

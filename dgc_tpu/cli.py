@@ -14,6 +14,7 @@ attempt's partial coloring (SURVEY.md §3.1 quirk); pass
 from __future__ import annotations
 
 import argparse
+import os
 import sys
 import time
 
@@ -28,6 +29,9 @@ from dgc_tpu.obs import (
     RunLogger,
     RunManifest,
 )
+from dgc_tpu.resilience import faults
+from dgc_tpu.resilience.supervisor import (SweepAbort, default_ladder,
+                                           supervise_sweep)
 from dgc_tpu.utils.watchdog import env_float, guarded_device_init
 
 # backends that touch JAX devices (and therefore hang, not raise, when the
@@ -36,6 +40,11 @@ _JAX_BACKENDS = frozenset({
     "ell", "ell-bucketed", "ell-compact", "dense",
     "sharded", "sharded-bucketed", "sharded-ring",
 })
+
+# every engine the driver can build — the --backend choices AND the valid
+# rung names for --fallback-ladder
+_ALL_BACKENDS = ("ell", "ell-bucketed", "ell-compact", "dense", "sharded",
+                 "sharded-bucketed", "sharded-ring", "reference-sim", "oracle")
 
 
 def build_parser() -> argparse.ArgumentParser:
@@ -52,8 +61,7 @@ def build_parser() -> argparse.ArgumentParser:
     # new flags
     p.add_argument(
         "--backend",
-        choices=["ell", "ell-bucketed", "ell-compact", "dense", "sharded",
-                 "sharded-bucketed", "sharded-ring", "reference-sim", "oracle"],
+        choices=list(_ALL_BACKENDS),
         default="ell-compact",
         help="coloring engine (default: ell-compact — the flagship staged "
              "frontier-compacted kernel; any degree distribution)",
@@ -106,6 +114,43 @@ def build_parser() -> argparse.ArgumentParser:
              "only device-backed backends probe (reference-sim/oracle are "
              "host-only); the multi-host coordinator handshake is NOT "
              "under this clock",
+    )
+    # resilience subsystem (dgc_tpu.resilience): any of these flags
+    # activates the supervised sweep; with all of them unset the driver
+    # runs the exact pre-resilience path (bit-identical output, zero
+    # overhead)
+    p.add_argument(
+        "--retries", type=int, default=0,
+        help="per-rung budget for retrying transient device errors with "
+             "exponential backoff (deterministic seeded jitter); 0 plus no "
+             "other resilience flag disables the supervised sweep entirely",
+    )
+    p.add_argument(
+        "--attempt-timeout", type=float, default=0.0,
+        help="soft watchdog (seconds) around each attempt/sweep dispatch: "
+             "an attempt exceeding it is abandoned and retried, then the "
+             "engine ladder takes over; 0 disables (the rc-113 process "
+             "watchdog still bounds device init)",
+    )
+    p.add_argument(
+        "--fallback-ladder", type=str, default=None, metavar="B1,B2,...",
+        help="comma-separated backends to degrade to, in order, when "
+             "--backend fails past its retry budget (default: the "
+             "canonical ladder suffix sharded -> ell -> ell-compact -> "
+             "reference-sim starting below --backend)",
+    )
+    p.add_argument(
+        "--inject-faults", type=str, default=None, metavar="SPEC",
+        help="deterministic fault schedule for chaos testing, e.g. "
+             "'attempt@2=transient,checkpoint_write@1=truncate' "
+             "(POINT@N=KIND[:PARAM]; see dgc_tpu.resilience.faults)",
+    )
+    p.add_argument(
+        "--skip-graph-validation", action="store_true",
+        help="skip the structural CSR validation of --input graphs "
+             "(out-of-range neighbors, non-monotonic indptr, self loops, "
+             "asymmetric edges) — for huge trusted inputs only; engines "
+             "produce garbage, not errors, on malformed graphs",
     )
     p.add_argument(
         "--no-reduce-colors",
@@ -191,6 +236,7 @@ def main(argv: list[str] | None = None) -> int:
     try:
         return _run(args, logger)
     finally:
+        faults.uninstall()  # in-process callers must not leak a fault plane
         logger.close()
 
 
@@ -227,6 +273,18 @@ def _run(args, logger: RunLogger) -> int:
                 return 2
             logger.event("graph_loaded", path=args.input, vertices=graph.num_vertices,
                          max_degree=graph.max_degree)
+            if not args.skip_graph_validation:
+                # engines assume a well-formed CSR and produce garbage
+                # colorings (not errors) on a malformed one — reject
+                # defective external inputs up front with structured errors
+                problems = graph.arrays.validate()
+                if problems:
+                    logger.event("graph_invalid", path=args.input,
+                                 problems=problems)
+                    for prob in problems:
+                        print(f"Invalid graph {args.input}: [{prob['code']}] "
+                              f"{prob['message']}", file=sys.stderr)
+                    return 2
         else:
             graph = Graph.generate(args.node_count, args.max_degree, seed=args.seed,
                                    method=args.gen_method)
@@ -247,17 +305,31 @@ def _run(args, logger: RunLogger) -> int:
         _write_obs_outputs(args, logger, manifest, phases, registry)
 
     args._on_watchdog_abort = on_watchdog_abort
-    with phases.section("host_engine_build"):
-        engine = make_engine(args, graph, logger=logger)
-    engine = ObservedEngine(engine, phases=phases, registry=registry,
-                            record_trajectory=telemetry)
-    checkpoint = None
-    if args.checkpoint_dir:
-        from dgc_tpu.utils.checkpoint import CheckpointManager, graph_fingerprint
-        checkpoint = CheckpointManager(
-            args.checkpoint_dir,
-            fingerprint=graph_fingerprint(graph.arrays, args.backend, args.strict_decrement),
-        )
+
+    # resilience layer: ANY resilience flag activates the supervised sweep;
+    # with all of them unset the driver takes the exact pre-resilience path
+    # below (bit-identical output, no proxy in the dispatch chain)
+    resilient = bool(args.retries > 0 or args.attempt_timeout > 0
+                     or args.fallback_ladder or args.inject_faults)
+    if args.inject_faults:
+        try:
+            schedule = faults.FaultSchedule.parse(args.inject_faults)
+        except ValueError as e:
+            print(f"Bad --inject-faults spec: {e}", file=sys.stderr)
+            return 2
+
+        def on_fire(rec):
+            logger.event("fault_injected", point=rec["point"],
+                         fault_kind=rec["kind"], occurrence=rec["occurrence"],
+                         param=rec["param"])
+            registry.counter("dgc_faults_injected_total",
+                             "faults fired by the injection plane",
+                             point=rec["point"], kind=rec["kind"]).inc()
+
+        # hard_kill: this is a real process, so an injected kill exits like
+        # a SIGKILL (rc 137, faults.KILL_RC) instead of raising
+        faults.install(faults.FaultPlane(schedule, hard_kill=True,
+                                         on_fire=on_fire))
 
     k0 = graph.initial_k()
     logger.event("sweep_start", backend=args.backend, initial_k=k0,
@@ -266,23 +338,87 @@ def _run(args, logger: RunLogger) -> int:
     def on_attempt(res, val):
         logger.attempt(res, val)
 
-    post_reduce = None
-    if not args.no_reduce_colors and args.backend not in ("reference-sim", "oracle"):
+    def make_post_reduce(backend: str):
         # the sim/oracle backends ARE the reference semantics — their count
         # is the parity target, so the improvement pass never touches them
+        if args.no_reduce_colors or backend in ("reference-sim", "oracle"):
+            return None
         from dgc_tpu.engine.minimal_k import make_reducer
-        post_reduce = make_reducer(graph.arrays)
+        return make_reducer(graph.arrays)
 
-    with phases.section("sweep_total"):
-        result = find_minimal_coloring(
-            engine,
-            initial_k=k0,
-            strict_decrement=args.strict_decrement,
-            validate=make_validator(graph.arrays),
-            on_attempt=on_attempt,
-            checkpoint=checkpoint,
-            post_reduce=post_reduce,
+    def make_ckpt(backend: str, per_rung: bool = False):
+        if not args.checkpoint_dir:
+            return None
+        from dgc_tpu.utils.checkpoint import CheckpointManager, graph_fingerprint
+        directory = (os.path.join(args.checkpoint_dir, f"rung_{backend}")
+                     if per_rung else args.checkpoint_dir)
+        return CheckpointManager(
+            directory,
+            fingerprint=graph_fingerprint(graph.arrays, backend,
+                                          args.strict_decrement),
         )
+
+    if resilient:
+        if args.fallback_ladder:
+            ladder_names = [args.backend] + [
+                b.strip() for b in args.fallback_ladder.split(",") if b.strip()]
+        else:
+            ladder_names = default_ladder(args.backend)
+        for name in ladder_names:
+            if name not in _ALL_BACKENDS:
+                print(f"Unknown backend {name!r} in --fallback-ladder "
+                      f"(choose from {', '.join(_ALL_BACKENDS)})", file=sys.stderr)
+                return 2
+
+        def rung_factory(name: str):
+            def build():
+                rung_args = argparse.Namespace(**vars(args))
+                rung_args.backend = name
+                with phases.section("host_engine_build"):
+                    eng = make_engine(rung_args, graph, logger=logger)
+                return ObservedEngine(eng, phases=phases, registry=registry,
+                                      record_trajectory=telemetry)
+            return build
+
+        from dgc_tpu.resilience.retry import RetryPolicy
+        with phases.section("sweep_total"):
+            try:
+                result, _stats = supervise_sweep(
+                    [(n, rung_factory(n)) for n in ladder_names],
+                    initial_k=k0,
+                    strict_decrement=args.strict_decrement,
+                    validate=make_validator(graph.arrays),
+                    on_attempt=on_attempt,
+                    # per-rung checkpoint namespaces: a killed run restarted
+                    # by its operator resumes whichever rung it died in
+                    make_checkpoint=lambda n: make_ckpt(n, per_rung=True),
+                    make_post_reduce=make_post_reduce,
+                    policy=RetryPolicy(seed=args.seed or 0),
+                    retry_budget=max(args.retries, 0),
+                    attempt_timeout_s=args.attempt_timeout,
+                    logger=logger, registry=registry,
+                )
+            except SweepAbort as ab:
+                logger.event("structured_abort", **ab.to_record())
+                _write_obs_outputs(args, logger, manifest, phases, registry)
+                print(f"ERROR: structured abort (rc {ab.rc}): {ab.reason}",
+                      file=sys.stderr)
+                return ab.rc
+    else:
+        with phases.section("host_engine_build"):
+            engine = make_engine(args, graph, logger=logger)
+        engine = ObservedEngine(engine, phases=phases, registry=registry,
+                                record_trajectory=telemetry)
+        with phases.section("sweep_total"):
+            result = find_minimal_coloring(
+                engine,
+                initial_k=k0,
+                strict_decrement=args.strict_decrement,
+                validate=make_validator(graph.arrays),
+                on_attempt=on_attempt,
+                checkpoint=make_ckpt(args.backend),
+                post_reduce=make_post_reduce(args.backend),
+            )
     phases.log_device_memory()
 
     if result.minimal_colors is not None and result.swept_colors is not None \

@@ -23,13 +23,26 @@ from dataclasses import dataclass
 import numpy as np
 
 
+class GraphValidationError(ValueError):
+    """Malformed CSR input; ``problems`` is the structured defect list
+    (each ``{"code", "message", "count"}``) from :meth:`GraphArrays.validate`."""
+
+    def __init__(self, problems: list[dict]):
+        self.problems = problems
+        super().__init__(
+            "; ".join(f"[{p['code']}] {p['message']}" for p in problems))
+
+
 @dataclass
 class GraphArrays:
     """CSR + derived stats for an undirected graph on [0, V).
 
     ``indices[indptr[v]:indptr[v+1]]`` are v's neighbors. Symmetric: u in
     N(v) iff v in N(u). No self loops, no duplicates (generator contract,
-    reference ``graph.py:35-38``).
+    reference ``graph.py:35-38``). The generators guarantee this by
+    construction; externally loaded graphs should go through
+    :meth:`validate` — the engines themselves assume a well-formed CSR and
+    produce garbage colorings (not errors) on a malformed one.
     """
 
     indptr: np.ndarray   # int32[V+1]
@@ -38,6 +51,82 @@ class GraphArrays:
     def __post_init__(self):
         self.indptr = np.asarray(self.indptr, dtype=np.int32)
         self.indices = np.asarray(self.indices, dtype=np.int32)
+
+    def validate(self) -> list[dict]:
+        """Structural check of the CSR invariants the engines rely on.
+
+        Returns a list of problems (empty = valid), each a structured
+        ``{"code", "message", "count"}`` record. Row-level checks are
+        skipped when the indptr structure itself is broken (their indexing
+        would be meaningless). Cost is a few vectorized passes over the
+        edge array — gate with ``--skip-graph-validation`` for huge
+        trusted inputs."""
+        problems: list[dict] = []
+
+        def bad(code: str, message: str, count: int = 1) -> None:
+            problems.append({"code": code, "message": message,
+                             "count": int(count)})
+
+        v = self.num_vertices
+        indptr = self.indptr.astype(np.int64)
+        indices = self.indices.astype(np.int64)
+        if len(self.indptr) < 1:
+            bad("indptr_empty", "indptr is empty (want length V+1 >= 1)")
+            return problems
+        if indptr[0] != 0:
+            bad("indptr_start", f"indptr[0] = {indptr[0]} (want 0)")
+        steps = np.diff(indptr)
+        n_dec = int((steps < 0).sum())
+        if n_dec:
+            first = int(np.argmax(steps < 0))
+            bad("indptr_nonmonotonic",
+                f"indptr decreases at {n_dec} position(s), first at row {first}",
+                n_dec)
+        if indptr[-1] != len(indices):
+            bad("indptr_end",
+                f"indptr[-1] = {indptr[-1]} != len(indices) = {len(indices)}")
+        out_of_range = (indices < 0) | (indices >= v)
+        n_oob = int(out_of_range.sum())
+        if n_oob:
+            example = int(indices[np.argmax(out_of_range)])
+            bad("indices_out_of_range",
+                f"{n_oob} neighbor id(s) outside [0, {v}), e.g. {example}",
+                n_oob)
+        if problems:
+            return problems  # row/edge checks need a sound structure
+
+        rows = np.repeat(np.arange(v, dtype=np.int64), steps)
+        self_loops = rows == indices
+        n_loops = int(self_loops.sum())
+        if n_loops:
+            example = int(rows[np.argmax(self_loops)])
+            bad("self_loops",
+                f"{n_loops} self loop(s), e.g. vertex {example}", n_loops)
+        key = rows * v + indices
+        uniq, counts = np.unique(key, return_counts=True)
+        n_dup = int(len(key) - len(uniq))
+        if n_dup:
+            example = int(uniq[np.argmax(counts > 1)])
+            bad("duplicate_edges",
+                f"{n_dup} duplicate neighbor entr(ies), e.g. edge "
+                f"({example // v}, {example % v})", n_dup)
+        # symmetry: the directed edge multiset must equal its transpose
+        rev = np.sort(indices * v + rows)
+        fwd = np.sort(key)
+        if len(fwd) != len(rev) or not np.array_equal(fwd, rev):
+            asym = np.setdiff1d(fwd, rev, assume_unique=False)
+            n_asym = int(len(asym)) or 1
+            example = int(asym[0]) if len(asym) else int(fwd[0])
+            bad("asymmetric_edges",
+                f"{n_asym} directed edge(s) missing their reverse, e.g. "
+                f"({example // v}, {example % v})", n_asym)
+        return problems
+
+    def validate_or_raise(self) -> "GraphArrays":
+        problems = self.validate()
+        if problems:
+            raise GraphValidationError(problems)
+        return self
 
     @property
     def num_vertices(self) -> int:

@@ -43,6 +43,8 @@ class RunManifest:
             "phases": None,
             "device_memory": [],
             "aborts": [],
+            "resilience": {"faults": [], "retries": [], "fallbacks": [],
+                           "resumes": []},
             "result": None,
             "metrics": None,
         }
@@ -65,8 +67,16 @@ class RunManifest:
                     break
         elif kind == "device_memory":
             self.doc["device_memory"].append(fields)
-        elif kind == "watchdog_abort":
-            self.doc["aborts"].append(fields)
+        elif kind in ("watchdog_abort", "structured_abort"):
+            self.doc["aborts"].append(dict(fields, event=kind))
+        elif kind == "fault_injected":
+            self.doc["resilience"]["faults"].append(fields)
+        elif kind == "retry":
+            self.doc["resilience"]["retries"].append(fields)
+        elif kind == "fallback":
+            self.doc["resilience"]["fallbacks"].append(fields)
+        elif kind == "checkpoint_resume":
+            self.doc["resilience"]["resumes"].append(fields)
         elif kind == "post_reduce":
             self.doc["post_reduce"] = fields
         elif kind in ("sweep_done", "sweep_failed"):

@@ -49,6 +49,25 @@ EVENT_SCHEMAS: dict = {
                             "bytes_limit": "int", "stats": ("dict", "null")}),
     "watchdog_abort": (
         {"what": "str", "diag": "str"}, {"timeout_s": NUM}),
+    # resilience subsystem (dgc_tpu.resilience): every fault, retry,
+    # fallback, resume, and structured abort flows through the same stream
+    # ("fault_kind", not "kind": RunLogger.event's first positional is kind)
+    "fault_injected": (
+        {"point": "str", "fault_kind": "str", "occurrence": "int"},
+        {"param": (*NUM, "null")}),
+    "retry": (
+        {"backend": "str", "k": "int", "error_class": "str", "error": "str",
+         "delay_s": NUM, "budget_left": "int"}, {}),
+    "fallback": (
+        {"from_backend": "str", "to_backend": "str", "error_class": "str",
+         "error": "str"}, {}),
+    "checkpoint_resume": (
+        {"backend": "str", "next_k": "int", "done": "bool"}, {}),
+    "structured_abort": (
+        {"reason": "str", "rc": "int"},
+        {"ladder": "list", "error": ("str", "null")}),
+    "graph_invalid": (
+        {"path": "str", "problems": "list"}, {}),
     "post_reduce": (
         {"from_colors": "int", "to_colors": "int", "time_s": NUM}, {}),
     "sweep_done": (

@@ -1,0 +1,51 @@
+"""Resilience subsystem: fault injection, retry/backoff, engine fallback.
+
+PR 1's ``dgc_tpu.obs`` gave runs eyes; this package gives them reflexes —
+a supervised execution layer around the minimal-k sweep that survives
+transient device errors, OOM, hangs, corrupt checkpoints, and process
+kills, or dies with a *structured* abort (never a garbage coloring):
+
+- ``resilience.faults`` — deterministic, seeded fault-injection plane
+  (named points, spec-string schedules, zero-overhead no-op when off);
+- ``resilience.retry`` — transient/resource/fatal error classifier plus
+  bounded exponential-backoff-with-jitter retry policy;
+- ``resilience.supervisor`` — the supervised sweep driver: per-attempt
+  soft watchdog, transient retries, per-rung checkpoint resume, and the
+  engine-fallback ladder (sharded → fused ELL → compact → reference-sim).
+
+``tools/chaos_sweep.py`` is the chaos harness that soaks the whole stack
+under seeded fault schedules and asserts bit-identical recovery or a
+structured abort.
+"""
+
+from dgc_tpu.resilience.faults import (FaultPlane, FaultSchedule, FaultSpec,
+                                       KILL_RC, SimulatedKill, fault_point)
+from dgc_tpu.resilience.retry import (ErrorClass, RetryBudget, RetryPolicy,
+                                      classify_error)
+from dgc_tpu.resilience.supervisor import (AttemptTimeout, DEFAULT_LADDER,
+                                           ResilienceStats, RetryingEngine,
+                                           RungFailure, STRUCTURED_ABORT_RC,
+                                           SweepAbort, default_ladder,
+                                           supervise_sweep)
+
+__all__ = [
+    "AttemptTimeout",
+    "DEFAULT_LADDER",
+    "ErrorClass",
+    "FaultPlane",
+    "FaultSchedule",
+    "FaultSpec",
+    "KILL_RC",
+    "ResilienceStats",
+    "RetryBudget",
+    "RetryPolicy",
+    "RetryingEngine",
+    "RungFailure",
+    "STRUCTURED_ABORT_RC",
+    "SimulatedKill",
+    "SweepAbort",
+    "classify_error",
+    "default_ladder",
+    "fault_point",
+    "supervise_sweep",
+]

@@ -1,0 +1,289 @@
+"""Deterministic, seeded fault-injection plane.
+
+The TPU port inherits none of Spark's fault tolerance (RDD lineage, task
+retry — SURVEY.md §5), so the resilience layer has to be *testable*: every
+failure mode the supervisor claims to survive must be reproducible on
+demand, on CPU, bit-for-bit. This module is that test plane — named
+injection points threaded through the real execution path:
+
+- ``device_init``       — first backend touch (``utils.watchdog.guarded_device_init``)
+- ``compile``           — a rung's first engine call (cold dispatch)
+- ``attempt``           — every attempt/sweep dispatch (``supervisor.RetryingEngine``)
+- ``transfer``          — device→host result transfer (after the engine call)
+- ``checkpoint_write``  — after ``CheckpointManager.save`` lands its files
+
+and fault *kinds* that mimic the production failure classes:
+
+- ``transient``  — an ``XlaRuntimeError``-shaped ``UNAVAILABLE`` error
+- ``oom``        — ``RESOURCE_EXHAUSTED`` (persistent per engine config:
+  the classifier sends these down the fallback ladder, not into retries)
+- ``fatal``      — an unclassifiable internal error
+- ``hang``       — block for ``param`` seconds (exercises the attempt
+  watchdog; default long enough that an unguarded run visibly wedges)
+- ``truncate``   — cut the checkpoint manifest short (torn write)
+- ``corrupt``    — scribble garbage into ``best_colors.npy``
+- ``kill``       — die mid-sweep: ``os._exit(KILL_RC)`` when the plane is
+  ``hard_kill`` (real process, chaos harness) or raise ``SimulatedKill``
+  (a ``BaseException`` no handler swallows) for in-process tests
+
+**Zero overhead when disabled**: every call site goes through
+:func:`fault_point`, which is a single module-global ``None`` check — no
+allocation, no locking, no schedule lookup — until :func:`install` arms a
+plane. Schedules are deterministic: a fault fires on the Nth hit of its
+point (1-based occurrence counting), so the same spec string replays the
+same failure at the same place every run.
+
+Spec grammar (CLI ``--inject-faults`` / chaos harness)::
+
+    SPEC   := entry ("," entry)*
+    entry  := POINT "@" OCCURRENCE "=" KIND [":" PARAM]
+    e.g.     "attempt@2=transient,checkpoint_write@1=truncate,attempt@3=hang:0.2"
+"""
+
+from __future__ import annotations
+
+import os
+import time
+from dataclasses import dataclass
+
+KILL_RC = 137  # simulated SIGKILL exit code (128 + 9), documented in README
+
+POINTS = ("device_init", "compile", "attempt", "transfer", "checkpoint_write")
+KINDS = ("transient", "oom", "fatal", "hang", "truncate", "corrupt", "kill")
+
+# kinds that act on checkpoint files need the checkpoint_write context
+_CHECKPOINT_KINDS = ("truncate", "corrupt")
+
+
+class FaultInjected(RuntimeError):
+    """Base of all injected errors; ``error_class`` drives the classifier."""
+
+    error_class = "transient"
+
+
+class InjectedTransientError(FaultInjected):
+    error_class = "transient"
+
+
+class InjectedResourceExhausted(FaultInjected):
+    error_class = "resource"
+
+
+class InjectedFatalError(FaultInjected):
+    error_class = "fatal"
+
+
+class SimulatedKill(BaseException):
+    """In-process stand-in for a SIGKILL: a ``BaseException`` so no retry
+    handler can swallow it — only the test harness catches it."""
+
+
+@dataclass(frozen=True)
+class FaultSpec:
+    point: str
+    occurrence: int          # fires on the Nth hit of ``point`` (1-based)
+    kind: str
+    param: float | None = None  # hang: seconds to block
+
+    def __post_init__(self):
+        if self.point not in POINTS:
+            raise ValueError(f"unknown fault point {self.point!r} (want one of {POINTS})")
+        if self.kind not in KINDS:
+            raise ValueError(f"unknown fault kind {self.kind!r} (want one of {KINDS})")
+        if self.occurrence < 1:
+            raise ValueError(f"occurrence must be >= 1, got {self.occurrence}")
+        if self.kind in _CHECKPOINT_KINDS and self.point != "checkpoint_write":
+            raise ValueError(f"{self.kind!r} only applies at checkpoint_write")
+
+    def to_token(self) -> str:
+        tok = f"{self.point}@{self.occurrence}={self.kind}"
+        if self.param is not None:
+            tok += f":{self.param:g}"
+        return tok
+
+    @classmethod
+    def parse_token(cls, token: str) -> "FaultSpec":
+        try:
+            head, kind = token.split("=", 1)
+            point, occ = head.split("@", 1)
+            param = None
+            if ":" in kind:
+                kind, raw = kind.split(":", 1)
+                param = float(raw)
+            return cls(point=point.strip(), occurrence=int(occ), kind=kind.strip(),
+                       param=param)
+        except ValueError as e:
+            raise ValueError(f"bad fault token {token!r} "
+                             f"(want POINT@N=KIND[:PARAM]): {e}") from e
+
+
+class FaultSchedule:
+    """An ordered set of :class:`FaultSpec`; parse/serialize round-trips."""
+
+    def __init__(self, specs: list[FaultSpec] | None = None):
+        self.specs = list(specs or [])
+
+    @classmethod
+    def parse(cls, spec: str) -> "FaultSchedule":
+        tokens = [t.strip() for t in spec.split(",") if t.strip()]
+        return cls([FaultSpec.parse_token(t) for t in tokens])
+
+    def to_spec(self) -> str:
+        return ",".join(s.to_token() for s in self.specs)
+
+    def __len__(self) -> int:
+        return len(self.specs)
+
+    def __iter__(self):
+        return iter(self.specs)
+
+    @classmethod
+    def random(cls, rng, n_faults: int = 2, *,
+               kinds: tuple = ("transient", "oom", "truncate", "corrupt",
+                               "kill", "hang"),
+               max_occurrence: int = 3,
+               hang_seconds: float = 0.2) -> "FaultSchedule":
+        """Draw a deterministic schedule from ``rng`` (``random.Random``).
+
+        Chaos-harness entry: every draw from the same seed is the same
+        schedule. Kinds are mapped to their natural points (checkpoint
+        kinds to ``checkpoint_write``, the rest to ``attempt``) and at most
+        one ``kill`` per schedule (the process only dies once)."""
+        specs: list[FaultSpec] = []
+        killed = False
+        for _ in range(n_faults):
+            kind = rng.choice(kinds)
+            if kind == "kill":
+                if killed:
+                    kind = "transient"
+                killed = True
+            point = "checkpoint_write" if kind in _CHECKPOINT_KINDS + ("kill",) \
+                else "attempt"
+            occ = rng.randint(1, max_occurrence)
+            param = hang_seconds if kind == "hang" else None
+            spec = FaultSpec(point=point, occurrence=occ, kind=kind, param=param)
+            if any(s.point == spec.point and s.occurrence == spec.occurrence
+                   for s in specs):
+                continue  # one fault per (point, occurrence) slot
+            specs.append(spec)
+        return cls(specs)
+
+
+class FaultPlane:
+    """Armed fault schedule: counts hits per point, fires matching specs.
+
+    ``on_fire(record)`` (if given) observes every fired fault — the CLI
+    routes it into the obs event stream. ``fired`` keeps the same records
+    for callers that poll (bench, tests)."""
+
+    def __init__(self, schedule: FaultSchedule, *, hard_kill: bool = False,
+                 on_fire=None):
+        self.schedule = schedule
+        self.hard_kill = hard_kill
+        self.on_fire = on_fire
+        self.fired: list[dict] = []
+        self._counts: dict[str, int] = {}
+
+    def fire(self, point: str, **ctx) -> None:
+        n = self._counts.get(point, 0) + 1
+        self._counts[point] = n
+        for spec in self.schedule:
+            if spec.point == point and spec.occurrence == n:
+                record = {"point": point, "kind": spec.kind, "occurrence": n,
+                          "param": spec.param}
+                self.fired.append(record)
+                if self.on_fire is not None:
+                    self.on_fire(record)
+                self._execute(spec, ctx)
+
+    # -- fault bodies ---------------------------------------------------
+
+    def _execute(self, spec: FaultSpec, ctx: dict) -> None:
+        kind = spec.kind
+        if kind == "transient":
+            raise InjectedTransientError(
+                f"INJECTED UNAVAILABLE: transient device error at "
+                f"{spec.point}@{spec.occurrence}")
+        if kind == "oom":
+            raise InjectedResourceExhausted(
+                f"INJECTED RESOURCE_EXHAUSTED: out of memory at "
+                f"{spec.point}@{spec.occurrence}")
+        if kind == "fatal":
+            raise InjectedFatalError(
+                f"INJECTED INTERNAL: unrecoverable error at "
+                f"{spec.point}@{spec.occurrence}")
+        if kind == "hang":
+            time.sleep(spec.param if spec.param is not None else 30.0)
+            return
+        if kind == "kill":
+            if self.hard_kill:
+                os._exit(KILL_RC)
+            raise SimulatedKill(f"injected kill at {spec.point}@{spec.occurrence}")
+        if kind in _CHECKPOINT_KINDS:
+            directory = ctx.get("directory")
+            if directory is None:
+                return  # nothing to corrupt at this call site
+            self._corrupt_checkpoint(str(directory), kind)
+            return
+        raise AssertionError(f"unhandled fault kind {kind!r}")
+
+    @staticmethod
+    def _corrupt_checkpoint(directory: str, kind: str) -> None:
+        from dgc_tpu.utils import checkpoint as _ck
+
+        if kind == "truncate":
+            # torn manifest write: keep the first half of the JSON
+            path = os.path.join(directory, _ck._MANIFEST)
+            if os.path.exists(path):
+                with open(path, "r+b") as fh:
+                    data = fh.read()
+                    fh.seek(0)
+                    fh.truncate(max(1, len(data) // 2))
+        else:  # corrupt: scribble over the colors payload
+            path = os.path.join(directory, _ck._COLORS)
+            if os.path.exists(path):
+                with open(path, "r+b") as fh:
+                    fh.seek(0)
+                    fh.write(b"\xde\xad\xbe\xef" * 4)
+
+
+# -- the global plane ----------------------------------------------------
+# fault_point() is on real hot-ish paths (per attempt dispatch, per
+# checkpoint write); when no plane is installed it must cost one global
+# load and one comparison — nothing else.
+
+_plane: FaultPlane | None = None
+
+
+def install(plane: FaultPlane) -> FaultPlane:
+    global _plane
+    _plane = plane
+    return plane
+
+
+def uninstall() -> None:
+    global _plane
+    _plane = None
+
+
+def active() -> FaultPlane | None:
+    return _plane
+
+
+def fault_point(name: str, **ctx) -> None:
+    """Injection hook. A no-op (one ``None`` check) unless a plane is armed."""
+    if _plane is not None:
+        _plane.fire(name, **ctx)
+
+
+class injected:
+    """``with injected(plane): ...`` — scoped install for tests."""
+
+    def __init__(self, plane: FaultPlane):
+        self.plane = plane
+
+    def __enter__(self) -> FaultPlane:
+        return install(self.plane)
+
+    def __exit__(self, *exc) -> None:
+        uninstall()

@@ -6,20 +6,38 @@ whether the sweep already hit its terminating failure — is persisted after
 every attempt as an ``.npz`` + JSON manifest pair, so a resumed run continues
 exactly where it stopped. State is tiny (one int32[V] vector), so plain
 atomic-rename files beat pulling in a full Orbax dependency here.
+
+Hardened against torn/corrupt state (resilience subsystem): the manifest
+records a SHA-256 of the colors payload, and ``restore()`` treats *any*
+defect — truncated/undecodable manifest, missing or partial
+``best_colors.npy``, checksum mismatch — as "no checkpoint" with a stderr
+warning instead of raising. A corrupt checkpoint can therefore cost a
+restart from k0, but can never crash a resume or hand it garbage state.
 """
 
 from __future__ import annotations
 
+import hashlib
 import json
 import os
+import sys
 from pathlib import Path
 
 import numpy as np
 
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.resilience import faults
 
 _MANIFEST = "sweep_state.json"
 _COLORS = "best_colors.npy"
+
+
+def _sha256_file(path: Path) -> str:
+    h = hashlib.sha256()
+    with open(path, "rb") as fh:
+        for chunk in iter(lambda: fh.read(1 << 20), b""):
+            h.update(chunk)
+    return h.hexdigest()
 
 
 class CheckpointManager:
@@ -48,30 +66,61 @@ class CheckpointManager:
         if best is not None:
             tmp = self.dir / ("tmp_" + _COLORS)  # np.save appends .npy to bare names
             np.save(tmp, best.colors)
+            state["colors_sha256"] = _sha256_file(tmp)
             os.replace(tmp, self.dir / _COLORS)
         tmp = self.dir / (_MANIFEST + ".tmp")
         tmp.write_text(json.dumps(state))
         os.replace(tmp, self.dir / _MANIFEST)
+        # resilience test plane: a schedule may truncate/corrupt what was
+        # just written, or kill the process at this attempt boundary
+        faults.fault_point("checkpoint_write", directory=str(self.dir))
+
+    def _reject(self, why: str):
+        print(f"# WARNING: ignoring checkpoint in {self.dir}: {why}",
+              file=sys.stderr)
+        return None
 
     def restore(self) -> tuple[int, AttemptResult | None, bool] | None:
-        """Returns (next_k, best_attempt, done) or None if no checkpoint."""
+        """Returns (next_k, best_attempt, done), or None if there is no
+        usable checkpoint — a corrupt/partial one is warned about and
+        treated as absent, never raised on."""
         manifest = self.dir / _MANIFEST
         if not manifest.exists():
             return None
-        state = json.loads(manifest.read_text())
+        try:
+            state = json.loads(manifest.read_text())
+        except (OSError, ValueError) as e:
+            return self._reject(f"unreadable manifest ({e})")
+        if not isinstance(state, dict) or "next_k" not in state:
+            return self._reject("manifest missing required fields")
         if state.get("fingerprint") != self.fingerprint:
             return None  # checkpoint belongs to a different graph/engine
         best = None
-        if state["best"] is not None:
-            colors = np.load(self.dir / _COLORS)
+        if state.get("best") is not None:
+            colors_path = self.dir / _COLORS
+            if not colors_path.exists():
+                return self._reject(f"manifest references missing {_COLORS}")
+            expected = state.get("colors_sha256")
+            if expected is not None and _sha256_file(colors_path) != expected:
+                return self._reject(f"{_COLORS} checksum mismatch (partial write?)")
+            try:
+                colors = np.load(colors_path)
+            except (OSError, ValueError) as e:
+                return self._reject(f"undecodable {_COLORS} ({e})")
             b = state["best"]
-            best = AttemptResult(
-                status=AttemptStatus(b["status"]),
-                colors=colors,
-                supersteps=b["supersteps"],
-                k=b["k"],
-            )
-        return int(state["next_k"]), best, bool(state["done"])
+            try:
+                best = AttemptResult(
+                    status=AttemptStatus(b["status"]),
+                    colors=colors,
+                    supersteps=b["supersteps"],
+                    k=b["k"],
+                )
+            except (KeyError, TypeError, ValueError) as e:
+                return self._reject(f"malformed best-attempt record ({e})")
+        try:
+            return int(state["next_k"]), best, bool(state["done"])
+        except (KeyError, TypeError, ValueError) as e:
+            return self._reject(f"malformed sweep state ({e})")
 
     def clear(self) -> None:
         for name in (_MANIFEST, _COLORS):

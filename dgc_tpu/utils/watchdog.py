@@ -95,6 +95,13 @@ def guarded_device_init(
         if timeout_s and timeout_s > 0
         else None
     )
+    # resilience test plane: simulate a hung/failing backend init (a no-op
+    # unless a fault schedule is armed); inside the watchdog window on
+    # purpose — an injected device-init hang must abort exactly like a
+    # dead tunnel
+    from dgc_tpu.resilience import faults
+
+    faults.fault_point("device_init")
     import jax
 
     devices = jax.devices()

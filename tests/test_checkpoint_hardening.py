@@ -1,0 +1,95 @@
+"""Checkpoint corruption regressions (resilience satellite): a truncated,
+corrupt, or partially-written checkpoint must restore as "no checkpoint"
+with a warning — never raise, never hand back garbage state."""
+
+import json
+
+import numpy as np
+import pytest
+
+from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.engine.minimal_k import find_minimal_coloring
+from dgc_tpu.engine.superstep import ELLEngine
+from dgc_tpu.models.generators import generate_random_graph
+from dgc_tpu.utils.checkpoint import _COLORS, _MANIFEST, CheckpointManager
+
+pytestmark = pytest.mark.chaos
+
+
+def _saved_ckpt(tmp_path, fingerprint="fp"):
+    ck = CheckpointManager(tmp_path / "ck", fingerprint=fingerprint)
+    best = AttemptResult(
+        status=AttemptStatus.SUCCESS,
+        colors=np.arange(32, dtype=np.int32) % 4,
+        supersteps=5, k=6)
+    ck.save(k=3, best=best, failed=False)
+    return ck, best
+
+
+def test_restore_roundtrip_with_checksum(tmp_path):
+    ck, best = _saved_ckpt(tmp_path)
+    state = json.loads((ck.dir / _MANIFEST).read_text())
+    assert len(state["colors_sha256"]) == 64  # checksum now in the manifest
+    k, restored, done = ck.restore()
+    assert k == 3 and not done
+    assert np.array_equal(restored.colors, best.colors)
+
+
+def test_truncated_manifest_is_no_checkpoint(tmp_path, capsys):
+    ck, _ = _saved_ckpt(tmp_path)
+    manifest = ck.dir / _MANIFEST
+    raw = manifest.read_text()
+    manifest.write_text(raw[: len(raw) // 2])  # torn write
+    assert ck.restore() is None
+    assert "ignoring checkpoint" in capsys.readouterr().err
+
+
+def test_corrupt_colors_payload_is_no_checkpoint(tmp_path, capsys):
+    ck, _ = _saved_ckpt(tmp_path)
+    with open(ck.dir / _COLORS, "r+b") as fh:
+        fh.seek(0)
+        fh.write(b"\xde\xad\xbe\xef" * 4)
+    assert ck.restore() is None
+    assert "checksum mismatch" in capsys.readouterr().err
+
+
+def test_missing_colors_file_is_no_checkpoint(tmp_path, capsys):
+    ck, _ = _saved_ckpt(tmp_path)
+    (ck.dir / _COLORS).unlink()
+    assert ck.restore() is None
+    assert "missing" in capsys.readouterr().err
+
+
+def test_manifest_missing_fields_is_no_checkpoint(tmp_path, capsys):
+    ck, _ = _saved_ckpt(tmp_path)
+    (ck.dir / _MANIFEST).write_text(json.dumps({"fingerprint": "fp"}))
+    assert ck.restore() is None
+    assert "missing required fields" in capsys.readouterr().err
+
+
+def test_legacy_manifest_without_checksum_still_restores(tmp_path):
+    # pre-hardening checkpoints carry no colors_sha256: accept them
+    ck, best = _saved_ckpt(tmp_path)
+    manifest = ck.dir / _MANIFEST
+    state = json.loads(manifest.read_text())
+    del state["colors_sha256"]
+    manifest.write_text(json.dumps(state))
+    k, restored, done = ck.restore()
+    assert k == 3 and np.array_equal(restored.colors, best.colors)
+
+
+def test_sweep_restarts_cleanly_after_corruption(tmp_path):
+    # end-to-end: a corrupted checkpoint costs a restart from k0, and the
+    # restarted sweep's result is bit-identical to an uncheckpointed run
+    g = generate_random_graph(100, 7, seed=3)
+    k0 = g.max_degree + 1
+    plain = find_minimal_coloring(ELLEngine(g), k0)
+
+    ck = CheckpointManager(tmp_path / "ck", fingerprint="fp")
+    find_minimal_coloring(ELLEngine(g), k0, checkpoint=ck)
+    manifest = ck.dir / _MANIFEST
+    manifest.write_text(manifest.read_text()[:10])
+
+    resumed = find_minimal_coloring(ELLEngine(g), k0, checkpoint=ck)
+    assert resumed.minimal_colors == plain.minimal_colors
+    assert np.array_equal(resumed.colors, plain.colors)

@@ -1,0 +1,128 @@
+"""CLI resilience flags end-to-end: supervised sweep, fault injection,
+structured abort, ladder, and the zero-overhead-off guarantee."""
+
+import json
+
+import pytest
+
+from dgc_tpu.cli import main
+from dgc_tpu.resilience.supervisor import STRUCTURED_ABORT_RC
+
+pytestmark = pytest.mark.chaos
+
+
+def _colors(path):
+    return json.load(open(path))
+
+
+def _gen_args(tmp_path, name, *extra):
+    return ["--node-count", "60", "--max-degree", "6", "--seed", "2",
+            "--output-coloring", str(tmp_path / name), "--backend",
+            "reference-sim", *extra]
+
+
+def test_resilient_no_faults_bit_identical_to_plain(tmp_path):
+    # resilience ON but quiet must not change the output (zero-overhead
+    # acceptance criterion, behavior half)
+    assert main(_gen_args(tmp_path, "plain.json")) == 0
+    assert main(_gen_args(tmp_path, "res.json", "--retries", "3",
+                          "--attempt-timeout", "30")) == 0
+    assert _colors(tmp_path / "plain.json") == _colors(tmp_path / "res.json")
+
+
+def test_transient_fault_recovered_bit_identical(tmp_path):
+    assert main(_gen_args(tmp_path, "plain.json")) == 0
+    log = tmp_path / "run.jsonl"
+    rc = main(_gen_args(tmp_path, "faulted.json", "--retries", "3",
+                        "--inject-faults", "attempt@1=transient",
+                        "--log-json", str(log)))
+    assert rc == 0
+    assert _colors(tmp_path / "plain.json") == _colors(tmp_path / "faulted.json")
+    kinds = [json.loads(l)["event"] for l in log.read_text().splitlines()]
+    assert "fault_injected" in kinds and "retry" in kinds
+
+
+def test_oom_falls_down_ladder(tmp_path):
+    # primary ell OOMs once -> ladder degrades; run still exits 0 with a
+    # valid coloring and the fallback is in the event stream
+    log = tmp_path / "run.jsonl"
+    rc = main(["--node-count", "60", "--max-degree", "6", "--seed", "2",
+               "--output-coloring", str(tmp_path / "c.json"),
+               "--backend", "ell", "--retries", "2",
+               "--inject-faults", "attempt@1=oom", "--log-json", str(log)])
+    assert rc == 0
+    events = [json.loads(l) for l in log.read_text().splitlines()]
+    fb = [e for e in events if e["event"] == "fallback"]
+    assert fb and fb[0]["from_backend"] == "ell"
+    assert fb[0]["error_class"] == "resource"
+
+
+def test_explicit_fallback_ladder(tmp_path):
+    log = tmp_path / "run.jsonl"
+    rc = main(["--node-count", "60", "--max-degree", "6", "--seed", "2",
+               "--output-coloring", str(tmp_path / "c.json"),
+               "--backend", "ell", "--fallback-ladder", "reference-sim",
+               "--inject-faults", "attempt@1=oom", "--log-json", str(log)])
+    assert rc == 0
+    events = [json.loads(l) for l in log.read_text().splitlines()]
+    fb = [e for e in events if e["event"] == "fallback"]
+    assert fb[0]["to_backend"] == "reference-sim"
+
+
+def test_exhausted_ladder_is_structured_abort(tmp_path, capsys):
+    log = tmp_path / "run.jsonl"
+    out = tmp_path / "c.json"
+    rc = main(_gen_args(tmp_path, "c.json", "--retries", "1",
+                        "--inject-faults", "attempt@1=fatal",
+                        "--log-json", str(log)))
+    assert rc == STRUCTURED_ABORT_RC == 114
+    assert "structured abort" in capsys.readouterr().err
+    assert not out.exists()  # no partial artifact, never garbage
+    events = [json.loads(l) for l in log.read_text().splitlines()]
+    ab = [e for e in events if e["event"] == "structured_abort"]
+    assert ab and ab[0]["rc"] == 114 and ab[0]["ladder"] == ["reference-sim"]
+
+
+def test_bad_fault_spec_rejected(tmp_path, capsys):
+    rc = main(_gen_args(tmp_path, "c.json", "--inject-faults", "bogus"))
+    assert rc == 2
+    assert "Bad --inject-faults" in capsys.readouterr().err
+
+
+def test_unknown_ladder_backend_rejected(tmp_path, capsys):
+    rc = main(_gen_args(tmp_path, "c.json", "--fallback-ladder", "warp-drive"))
+    assert rc == 2
+    assert "warp-drive" in capsys.readouterr().err
+
+
+def test_resilience_events_land_in_manifest_and_validate(tmp_path):
+    # the manifest's resilience slot + the JSONL both carry the events, and
+    # the log passes the obs schema drift guard
+    log = tmp_path / "run.jsonl"
+    man = tmp_path / "manifest.json"
+    rc = main(_gen_args(tmp_path, "c.json", "--retries", "3",
+                        "--inject-faults", "attempt@1=transient",
+                        "--log-json", str(log), "--run-manifest", str(man)))
+    assert rc == 0
+    from tools.validate_runlog import validate_file
+
+    assert validate_file(str(log)) == []
+    doc = json.load(open(man))
+    assert len(doc["resilience"]["faults"]) == 1
+    assert len(doc["resilience"]["retries"]) == 1
+    metrics = doc["metrics"]
+    assert any(k.startswith("dgc_retries_total") for k in metrics)
+
+
+def test_checkpoint_resume_event_on_restart(tmp_path):
+    # a resilient checkpointed run that already finished re-reports via a
+    # checkpoint_resume event on the next invocation
+    ck = tmp_path / "ck"
+    args = _gen_args(tmp_path, "c.json", "--retries", "1",
+                     "--checkpoint-dir", str(ck))
+    assert main(args) == 0
+    log = tmp_path / "second.jsonl"
+    assert main(args + ["--log-json", str(log)]) == 0
+    events = [json.loads(l) for l in log.read_text().splitlines()]
+    res = [e for e in events if e["event"] == "checkpoint_resume"]
+    assert res and res[0]["done"] is True

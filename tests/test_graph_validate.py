@@ -1,0 +1,113 @@
+"""GraphArrays.validate() input hardening (resilience satellite): malformed
+CSR must be rejected with structured errors instead of silently producing
+garbage colorings."""
+
+import json
+
+import numpy as np
+import pytest
+
+from dgc_tpu.models.arrays import GraphArrays, GraphValidationError
+from dgc_tpu.models.generators import generate_random_graph
+
+
+def _codes(problems):
+    return {p["code"] for p in problems}
+
+
+def test_generated_graph_is_valid(medium_graph):
+    assert medium_graph.validate() == []
+    assert medium_graph.validate_or_raise() is medium_graph
+
+
+def test_out_of_range_indices():
+    g = GraphArrays(indptr=[0, 1, 2], indices=[5, 0])  # 5 >= V=2
+    probs = g.validate()
+    assert "indices_out_of_range" in _codes(probs)
+    assert probs[0]["count"] == 1
+
+
+def test_negative_index_rejected():
+    g = GraphArrays(indptr=[0, 1, 2], indices=[-1, 0])
+    assert "indices_out_of_range" in _codes(g.validate())
+
+
+def test_non_monotonic_indptr():
+    g = GraphArrays(indptr=[0, 2, 1, 3], indices=[1, 2, 0])
+    assert "indptr_nonmonotonic" in _codes(g.validate())
+
+
+def test_indptr_end_mismatch():
+    g = GraphArrays(indptr=[0, 1, 4], indices=[1, 0])
+    assert "indptr_end" in _codes(g.validate())
+
+
+def test_self_loops():
+    # 0-0 self loop alongside a proper 0-1 edge
+    g = GraphArrays(indptr=[0, 2, 3], indices=[0, 1, 0])
+    assert "self_loops" in _codes(g.validate())
+
+
+def test_duplicate_edges():
+    g = GraphArrays(indptr=[0, 2, 4], indices=[1, 1, 0, 0])
+    assert "duplicate_edges" in _codes(g.validate())
+
+
+def test_asymmetric_edges():
+    # edge 0->1 with no 1->0
+    g = GraphArrays(indptr=[0, 1, 1], indices=[1])
+    probs = g.validate()
+    assert "asymmetric_edges" in _codes(probs)
+
+
+def test_validate_or_raise_carries_problems():
+    g = GraphArrays(indptr=[0, 1, 1], indices=[1])
+    with pytest.raises(GraphValidationError) as exc:
+        g.validate_or_raise()
+    assert exc.value.problems
+    assert "asymmetric" in str(exc.value)
+
+
+def test_cli_rejects_malformed_input(tmp_path, capsys):
+    # an input file with an asymmetric neighbor list: structured rc-2
+    # rejection unless --skip-graph-validation
+    from dgc_tpu.cli import main
+
+    bad = [{"id": 0, "neighbors": [1], "color": -1},
+           {"id": 1, "neighbors": [], "color": -1}]
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps(bad))
+    out = tmp_path / "c.json"
+
+    rc = main(["--input", str(path), "--output-coloring", str(out)])
+    assert rc == 2
+    assert "asymmetric_edges" in capsys.readouterr().err
+    assert not out.exists()
+
+    # trusted-input escape hatch: a VALID input skips the validation pass
+    # entirely and colors normally (the flag exists for huge trusted
+    # graphs; feeding it a malformed one is garbage-in-garbage-out)
+    g = generate_random_graph(20, 4, seed=1)
+    from dgc_tpu.models.graph import Graph
+
+    good = tmp_path / "good.json"
+    Graph(g).serialize(good)
+    rc = main(["--input", str(good), "--output-coloring", str(out),
+               "--skip-graph-validation", "--backend", "reference-sim"])
+    assert rc == 0
+    assert out.exists()
+
+
+def test_cli_graph_invalid_event_in_log(tmp_path):
+    from dgc_tpu.cli import main
+
+    bad = [{"id": 0, "neighbors": [0], "color": -1}]  # self loop
+    path = tmp_path / "bad.json"
+    path.write_text(json.dumps(bad))
+    log = tmp_path / "run.jsonl"
+    rc = main(["--input", str(path), "--output-coloring",
+               str(tmp_path / "c.json"), "--log-json", str(log)])
+    assert rc == 2
+    events = [json.loads(l) for l in log.read_text().splitlines()]
+    inv = [e for e in events if e["event"] == "graph_invalid"]
+    assert inv and inv[0]["problems"][0]["code"] == "self_loops"

@@ -1,0 +1,293 @@
+"""Resilience subsystem unit tests: fault plane, classifier, retry policy,
+supervised sweep, engine-fallback ladder. All CPU-fast and seeded
+(``chaos`` marker; they run in tier-1)."""
+
+import numpy as np
+import pytest
+
+from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
+from dgc_tpu.engine.reference_sim import ReferenceSimEngine
+from dgc_tpu.models.generators import generate_random_graph
+from dgc_tpu.ops.validate import validate_coloring
+from dgc_tpu.resilience import faults
+from dgc_tpu.resilience.faults import (FaultPlane, FaultSchedule, FaultSpec,
+                                       InjectedResourceExhausted,
+                                       InjectedTransientError, SimulatedKill)
+from dgc_tpu.resilience.retry import (ErrorClass, RetryBudget, RetryPolicy,
+                                      classify_error)
+from dgc_tpu.resilience.supervisor import (AttemptTimeout, RetryingEngine,
+                                           RungFailure, SweepAbort,
+                                           default_ladder, supervise_sweep)
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plane():
+    yield
+    faults.uninstall()
+
+
+def _graph(seed=5):
+    return generate_random_graph(80, 6, seed=seed)
+
+
+# ---------------- faults: spec plane ----------------
+
+
+def test_fault_spec_roundtrip():
+    sched = FaultSchedule.parse(
+        "attempt@2=transient, checkpoint_write@1=truncate,attempt@3=hang:0.5")
+    assert len(sched) == 3
+    assert sched.specs[0] == FaultSpec("attempt", 2, "transient")
+    assert sched.specs[2].param == 0.5
+    assert FaultSchedule.parse(sched.to_spec()).to_spec() == sched.to_spec()
+
+
+@pytest.mark.parametrize("bad", [
+    "attempt@0=transient",          # occurrence < 1
+    "nosuchpoint@1=transient",      # unknown point
+    "attempt@1=nosuchkind",         # unknown kind
+    "attempt@1=truncate",           # checkpoint kind at wrong point
+    "attempt=transient",            # missing occurrence
+])
+def test_fault_spec_rejects_malformed(bad):
+    with pytest.raises(ValueError):
+        FaultSchedule.parse(bad)
+
+
+def test_fault_point_noop_when_uninstalled():
+    # the disabled plane is a single None check: must never raise or record
+    faults.uninstall()
+    faults.fault_point("attempt")
+    faults.fault_point("checkpoint_write", directory="/nonexistent")
+    assert faults.active() is None
+
+
+def test_fault_fires_on_exact_occurrence():
+    plane = FaultPlane(FaultSchedule.parse("attempt@3=transient"))
+    with faults.injected(plane):
+        faults.fault_point("attempt")
+        faults.fault_point("attempt")
+        with pytest.raises(InjectedTransientError):
+            faults.fault_point("attempt")
+        faults.fault_point("attempt")  # occurrence 4: past the schedule
+    assert [f["occurrence"] for f in plane.fired] == [3]
+
+
+def test_random_schedules_are_deterministic():
+    import random
+
+    a = FaultSchedule.random(random.Random(42), n_faults=3)
+    b = FaultSchedule.random(random.Random(42), n_faults=3)
+    assert a.to_spec() == b.to_spec()
+    assert all(s.kind in faults.KINDS and s.point in faults.POINTS for s in a)
+
+
+def test_simulated_kill_is_base_exception():
+    plane = FaultPlane(FaultSchedule.parse("attempt@1=kill"), hard_kill=False)
+    with faults.injected(plane):
+        with pytest.raises(SimulatedKill):
+            faults.fault_point("attempt")
+    assert not isinstance(SimulatedKill("x"), Exception)
+
+
+# ---------------- retry: classifier + policy ----------------
+
+
+def test_classifier_on_injected_errors():
+    assert classify_error(InjectedTransientError("x")) is ErrorClass.TRANSIENT
+    assert classify_error(InjectedResourceExhausted("x")) is ErrorClass.RESOURCE
+
+
+def test_classifier_on_message_markers():
+    # real XlaRuntimeError isn't constructible without a device error, but
+    # classification is message-based by design (works through wrappers)
+    assert classify_error(RuntimeError(
+        "UNAVAILABLE: socket closed")) is ErrorClass.TRANSIENT
+    assert classify_error(RuntimeError(
+        "RESOURCE_EXHAUSTED: out of memory allocating 2G")) is ErrorClass.RESOURCE
+    assert classify_error(RuntimeError(
+        "INVALID_ARGUMENT: shape mismatch")) is ErrorClass.FATAL
+    assert classify_error(AssertionError("bad coloring")) is ErrorClass.FATAL
+
+
+def test_backoff_is_deterministic_and_bounded():
+    a = RetryPolicy(base_delay_s=0.1, max_delay_s=1.0, seed=7)
+    d1 = [next(iter_) for iter_ in [a.delays()] for _ in range(6)]
+    d2 = [next(iter_) for iter_ in [RetryPolicy(
+        base_delay_s=0.1, max_delay_s=1.0, seed=7).delays()] for _ in range(6)]
+    assert d1 == d2                      # seeded jitter replays exactly
+    assert all(0 <= d <= 1.5 for d in d1)  # bounded by max*(1+jitter)
+    assert d1[3] > d1[0] / 2             # roughly exponential growth
+
+
+def test_retry_budget_exhausts():
+    b = RetryBudget(2)
+    assert b.take() and b.take()
+    assert not b.take()
+    assert b.left == 0
+
+
+# ---------------- supervised engine: retry/timeout ----------------
+
+
+def _policy():
+    return RetryPolicy(base_delay_s=0.001, max_delay_s=0.002, seed=0)
+
+
+def test_retrying_engine_recovers_transient_bit_identical():
+    g = _graph()
+    plain = find_minimal_coloring(ReferenceSimEngine(g), g.max_degree + 1)
+    plane = FaultPlane(FaultSchedule.parse("attempt@1=transient,attempt@3=transient"))
+    with faults.injected(plane):
+        eng = RetryingEngine(ReferenceSimEngine(g), backend="reference-sim",
+                             policy=_policy(), budget=RetryBudget(3))
+        res = find_minimal_coloring(eng, g.max_degree + 1)
+    assert eng.stats.retries == 2
+    assert res.minimal_colors == plain.minimal_colors
+    assert np.array_equal(res.colors, plain.colors)
+
+
+def test_retrying_engine_raises_rung_failure_past_budget():
+    g = _graph()
+    plane = FaultPlane(FaultSchedule.parse(
+        "attempt@1=transient,attempt@2=transient,attempt@3=transient"))
+    with faults.injected(plane):
+        eng = RetryingEngine(ReferenceSimEngine(g), backend="reference-sim",
+                             policy=_policy(), budget=RetryBudget(1))
+        with pytest.raises(RungFailure) as exc:
+            eng.attempt(g.max_degree + 1)
+    assert exc.value.error_class is ErrorClass.TRANSIENT
+    assert eng.stats.retries == 1
+
+
+def test_retrying_engine_resource_error_skips_retries():
+    g = _graph()
+    plane = FaultPlane(FaultSchedule.parse("attempt@1=oom"))
+    with faults.injected(plane):
+        eng = RetryingEngine(ReferenceSimEngine(g), backend="reference-sim",
+                             policy=_policy(), budget=RetryBudget(5))
+        with pytest.raises(RungFailure) as exc:
+            eng.attempt(g.max_degree + 1)
+    assert exc.value.error_class is ErrorClass.RESOURCE
+    assert eng.stats.retries == 0  # no retry burned on a deterministic OOM
+
+
+def test_attempt_timeout_then_recovery():
+    g = _graph()
+    plain = find_minimal_coloring(ReferenceSimEngine(g), g.max_degree + 1)
+    plane = FaultPlane(FaultSchedule.parse("attempt@1=hang:5"))
+    with faults.injected(plane):
+        eng = RetryingEngine(ReferenceSimEngine(g), backend="reference-sim",
+                             policy=_policy(), budget=RetryBudget(2),
+                             attempt_timeout_s=0.1)
+        res = find_minimal_coloring(eng, g.max_degree + 1)
+    assert eng.stats.attempt_timeouts == 1
+    assert eng.stats.retries == 1
+    assert np.array_equal(res.colors, plain.colors)
+
+
+def test_attempt_timeout_past_budget_is_rung_failure():
+    g = _graph()
+    plane = FaultPlane(FaultSchedule.parse("attempt@1=hang:5,attempt@2=hang:5"))
+    with faults.injected(plane):
+        eng = RetryingEngine(ReferenceSimEngine(g), backend="reference-sim",
+                             policy=_policy(), budget=RetryBudget(1),
+                             attempt_timeout_s=0.1)
+        with pytest.raises(RungFailure) as exc:
+            eng.attempt(g.max_degree + 1)
+    assert isinstance(exc.value.cause, AttemptTimeout)
+
+
+# ---------------- supervisor: ladder ----------------
+
+
+def _ladder(g, *names):
+    from dgc_tpu.engine.superstep import ELLEngine
+
+    def factory(name):
+        if name == "ell":
+            return lambda: ELLEngine(g)
+        return lambda: ReferenceSimEngine(g)
+
+    return [(n, factory(n)) for n in names]
+
+
+def test_supervise_sweep_happy_path_matches_plain():
+    g = _graph()
+    plain = find_minimal_coloring(ReferenceSimEngine(g), g.max_degree + 1,
+                                  validate=make_validator(g))
+    result, stats = supervise_sweep(
+        _ladder(g, "reference-sim"), g.max_degree + 1,
+        validate=make_validator(g), policy=_policy())
+    assert stats.fallbacks == 0 and stats.retries == 0
+    assert stats.engine_used == "reference-sim"
+    assert result.minimal_colors == plain.minimal_colors
+    assert np.array_equal(result.colors, plain.colors)
+
+
+def test_supervise_sweep_falls_back_on_persistent_failure():
+    g = _graph()
+    # ell's first dispatch OOMs; RESOURCE is treated as persistent for the
+    # rung (no retry), so the ladder drops to reference-sim, whose own
+    # dispatches (occurrence 2+) are past the schedule
+    plane = FaultPlane(FaultSchedule.parse("attempt@1=oom"))
+    plain_sim = find_minimal_coloring(ReferenceSimEngine(g), g.max_degree + 1)
+    events = []
+
+    class Logger:
+        def event(self, kind, **fields):
+            events.append((kind, fields))
+
+    with faults.injected(plane):
+        result, stats = supervise_sweep(
+            _ladder(g, "ell", "reference-sim"), g.max_degree + 1,
+            validate=make_validator(g), policy=_policy(), logger=Logger())
+    assert stats.fallbacks == 1
+    assert stats.engine_used == "reference-sim"
+    assert stats.rungs_tried == ["ell", "reference-sim"]
+    assert result.minimal_colors == plain_sim.minimal_colors
+    assert np.array_equal(result.colors, plain_sim.colors)
+    kinds = [k for k, _ in events]
+    assert "fallback" in kinds
+    fb = dict(events[kinds.index("fallback")][1])
+    assert fb["from_backend"] == "ell" and fb["to_backend"] == "reference-sim"
+    assert fb["error_class"] == "resource"
+
+
+def test_supervise_sweep_exhausted_ladder_structured_abort():
+    g = _graph()
+    plane = FaultPlane(FaultSchedule(
+        [FaultSpec("attempt", i, "fatal") for i in range(1, 30)]))
+    with faults.injected(plane):
+        with pytest.raises(SweepAbort) as exc:
+            supervise_sweep(_ladder(g, "ell", "reference-sim"),
+                            g.max_degree + 1, policy=_policy())
+    ab = exc.value
+    assert ab.rc == 114
+    rec = ab.to_record()
+    assert rec["ladder"] == ["ell", "reference-sim"]
+    assert "INJECTED INTERNAL" in rec["error"]
+
+
+def test_supervise_sweep_factory_failure_degrades():
+    g = _graph()
+
+    def broken():
+        raise RuntimeError("UNAVAILABLE: device enumeration failed")
+
+    result, stats = supervise_sweep(
+        [("ell", broken)] + _ladder(g, "reference-sim"), g.max_degree + 1,
+        validate=make_validator(g), policy=_policy())
+    assert stats.fallbacks == 1
+    assert stats.engine_used == "reference-sim"
+    assert validate_coloring(g.indptr, g.indices, result.colors).valid
+
+
+def test_default_ladder_shapes():
+    assert default_ladder("sharded") == [
+        "sharded", "ell", "ell-compact", "reference-sim"]
+    assert default_ladder("ell-compact") == ["ell-compact", "reference-sim"]
+    assert default_ladder("reference-sim") == ["reference-sim"]
+    assert default_ladder("dense") == ["dense", "reference-sim"]

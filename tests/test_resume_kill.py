@@ -1,0 +1,119 @@
+"""Mid-sweep kill-and-resume coverage (resilience satellite).
+
+Interrupts a checkpointed sweep at *every* attempt boundary — via an
+injected kill at the ``checkpoint_write`` fault point, i.e. immediately
+after each attempt's state lands on disk — and asserts the resumed run
+executes exactly the attempts the uninterrupted run would have executed
+after that boundary, with bit-identical final colors. Covers jump mode,
+strict mode, and the fused-pair engine (where the boundary after the
+pair's first half is the mid-fused-pair state ``minimal_k.py:82-101``
+documents)."""
+
+import numpy as np
+import pytest
+
+from dgc_tpu.engine.minimal_k import find_minimal_coloring
+from dgc_tpu.engine.superstep import ELLEngine
+from dgc_tpu.models.generators import generate_random_graph
+from dgc_tpu.ops.validate import validate_coloring
+from dgc_tpu.resilience import faults
+from dgc_tpu.resilience.faults import (FaultPlane, FaultSchedule,
+                                       SimulatedKill)
+from dgc_tpu.utils.checkpoint import CheckpointManager
+
+pytestmark = pytest.mark.chaos
+
+
+@pytest.fixture(autouse=True)
+def _no_leaked_plane():
+    yield
+    faults.uninstall()
+
+
+def _engine(g, fused: bool):
+    if fused:
+        from dgc_tpu.engine.compact import CompactFrontierEngine
+
+        return CompactFrontierEngine(g)
+    return ELLEngine(g)
+
+
+def _seq(attempts):
+    return [(a.k, int(a.status), a.colors_used if a.success else None)
+            for a in attempts]
+
+
+def _run_with_kill_at(g, k0, boundary: int, *, strict: bool, fused: bool,
+                      ckpt_dir):
+    """One sweep killed right after attempt #``boundary`` checkpoints."""
+    executed = []
+    ckpt = CheckpointManager(ckpt_dir, fingerprint="fp")
+    plane = FaultPlane(
+        FaultSchedule.parse(f"checkpoint_write@{boundary}=kill"),
+        hard_kill=False)
+    with faults.injected(plane):
+        with pytest.raises(SimulatedKill):
+            find_minimal_coloring(
+                _engine(g, fused), k0, strict_decrement=strict,
+                on_attempt=lambda res, val: executed.append(res),
+                checkpoint=ckpt)
+    return executed, ckpt
+
+
+@pytest.mark.parametrize("strict,fused", [
+    (False, False),   # jump mode, per-attempt engine
+    (True, False),    # strict (reference) schedule
+    (False, True),    # jump mode, fused sweep() pair — incl. mid-pair kill
+])
+def test_kill_at_every_attempt_boundary_resumes_bit_identical(
+        tmp_path, strict, fused):
+    g = generate_random_graph(150, 8, seed=21)
+    k0 = g.max_degree + 1
+    full_executed = []
+    full = find_minimal_coloring(
+        _engine(g, fused), k0, strict_decrement=strict,
+        on_attempt=lambda res, val: full_executed.append(res))
+    n_attempts = len(full.attempts)
+    assert n_attempts >= 2
+
+    for boundary in range(1, n_attempts + 1):
+        pre, ckpt = _run_with_kill_at(
+            g, k0, boundary, strict=strict, fused=fused,
+            ckpt_dir=tmp_path / f"{strict}-{fused}-{boundary}")
+        assert len(pre) == boundary  # killed exactly at that boundary
+
+        resumed_executed = []
+        resumed = find_minimal_coloring(
+            _engine(g, fused), k0, strict_decrement=strict,
+            on_attempt=lambda res, val: resumed_executed.append(res),
+            checkpoint=ckpt)
+
+        # the combined executed-attempt sequence is exactly the
+        # uninterrupted run's sequence (the restored best is replayed
+        # into results but never re-executed, so it is not in either list)
+        assert _seq(pre) + _seq(resumed_executed) == _seq(full_executed), \
+            (strict, fused, boundary)
+        assert resumed.minimal_colors == full.minimal_colors
+        assert np.array_equal(resumed.colors, full.colors)
+        assert validate_coloring(g.indptr, g.indices, resumed.colors).valid
+
+
+def test_mid_fused_pair_state_is_the_documented_one(tmp_path):
+    # kill after the fused pair's FIRST half: the checkpoint must hold
+    # next_k = colors_used - 1 and not-done — the mid-pair resume state
+    # minimal_k.py documents; the resumed run re-sweeps from there
+    from dgc_tpu.engine.compact import CompactFrontierEngine
+
+    g = generate_random_graph(150, 8, seed=22)
+    k0 = g.max_degree + 1
+    full = find_minimal_coloring(CompactFrontierEngine(g), k0)
+    assert len(full.attempts) == 2  # the fused pair ran
+
+    pre, ckpt = _run_with_kill_at(g, k0, 1, strict=False, fused=True,
+                                  ckpt_dir=tmp_path / "midpair")
+    restored = ckpt.restore()
+    assert restored is not None
+    next_k, best, done = restored
+    assert not done
+    assert next_k == pre[0].colors_used - 1
+    assert np.array_equal(best.colors, pre[0].colors)

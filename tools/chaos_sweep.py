@@ -1,0 +1,276 @@
+#!/usr/bin/env python
+"""Chaos harness: soak the resilience subsystem under seeded fault schedules.
+
+Runs the CLI sweep N times, each under a different deterministic fault
+schedule (transient device errors, simulated OOM, checkpoint
+truncation/corruption, kill-mid-sweep, hangs), and asserts the resilience
+invariant for every run:
+
+    the run either finishes with a valid coloring **bit-identical to the
+    fault-free run of whichever engine produced it**, or exits with a
+    structured abort (rc 114) / watchdog abort (rc 113) — never a garbage
+    coloring, never a hang past the harness deadline, never an
+    unclassified crash.
+
+"Whichever engine produced it": retries and kill/resume recover on the
+primary backend, so those runs compare against the primary's fault-free
+coloring; a run that degraded down the engine ladder compares against the
+fault-free run of the rung it landed on (engine families are not
+per-vertex identical to each other — SURVEY §7.3 — but each engine is
+deterministic, so recovery must be invisible relative to its own
+fault-free output). A killed process (rc 137) is restarted the way an
+operator would — same command, same checkpoint dir, no fault schedule —
+and must resume to the identical result.
+
+Every run's JSONL log is schema-checked with ``tools/validate_runlog.py``
+(the obs drift guard), and the chaos report itself is schema-checked by
+:func:`validate_chaos_report` before it is written.
+
+Usage::
+
+    python tools/chaos_sweep.py --schedules 20 --nodes 1000 --max-degree 8 \\
+        --backend ell --report /tmp/chaos.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+import tempfile
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dgc_tpu.resilience.faults import KILL_RC, FaultSchedule  # noqa: E402
+from dgc_tpu.resilience.supervisor import STRUCTURED_ABORT_RC  # noqa: E402
+from dgc_tpu.utils.watchdog import ABORT_RC  # noqa: E402
+from tools.validate_runlog import validate_file  # noqa: E402
+
+CHAOS_REPORT_VERSION = 1
+
+# acceptable terminal states (everything else is a chaos failure)
+_OUTCOMES = ("ok", "structured_abort", "watchdog_abort",
+             "hang", "error", "mismatch")
+
+
+def _subprocess_env() -> dict:
+    """CPU-pinned, axon-sitecustomize-free env for CLI subprocesses (the
+    proven pattern from tests/test_cli_watchdog.py)."""
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    return env
+
+
+def _run_cli(argv: list[str], timeout_s: float) -> subprocess.CompletedProcess:
+    return subprocess.run(
+        [sys.executable, "-m", "dgc_tpu.cli", *argv],
+        env=_subprocess_env(), cwd=REPO, capture_output=True, text=True,
+        timeout=timeout_s,
+    )
+
+
+def _final_backend(log_path: str, primary: str) -> str:
+    """The engine that produced the run's output: the last fallback
+    event's target, or the primary backend when no fallback fired."""
+    backend = primary
+    try:
+        with open(log_path) as fh:
+            for line in fh:
+                try:
+                    rec = json.loads(line)
+                except json.JSONDecodeError:
+                    continue
+                if rec.get("event") == "fallback":
+                    backend = rec.get("to_backend", backend)
+    except OSError:
+        pass
+    return backend
+
+
+def validate_chaos_report(doc) -> list[str]:
+    """Structural check of a chaos report (the runlog-validator convention:
+    a list of problems, empty = well-formed)."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return ["report is not an object"]
+    if doc.get("chaos_report_version") != CHAOS_REPORT_VERSION:
+        problems.append("missing/wrong chaos_report_version")
+    if not isinstance(doc.get("config"), dict):
+        problems.append("missing config object")
+    schedules = doc.get("schedules")
+    if not isinstance(schedules, list) or not schedules:
+        problems.append("missing/empty schedules list")
+        schedules = []
+    for i, s in enumerate(schedules):
+        for field, ty in (("index", int), ("spec", str), ("outcome", str),
+                          ("rc", int), ("restarts", int),
+                          ("final_backend", str)):
+            if not isinstance(s.get(field), ty):
+                problems.append(f"schedules[{i}]: missing/invalid {field!r}")
+        if s.get("outcome") not in _OUTCOMES:
+            problems.append(f"schedules[{i}]: unknown outcome {s.get('outcome')!r}")
+        if s.get("outcome") == "ok" and s.get("bit_identical") is not True:
+            problems.append(f"schedules[{i}]: outcome ok but not bit_identical")
+    summary = doc.get("summary")
+    if not isinstance(summary, dict):
+        problems.append("missing summary object")
+    else:
+        for field in ("total", "ok", "structured_abort", "failed"):
+            if not isinstance(summary.get(field), int):
+                problems.append(f"summary: missing/invalid {field!r}")
+        if isinstance(schedules, list) and summary.get("total") != len(schedules):
+            problems.append("summary.total != len(schedules)")
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--schedules", type=int, default=20,
+                   help="number of seeded fault schedules to soak")
+    p.add_argument("--nodes", type=int, default=1000)
+    p.add_argument("--max-degree", type=int, default=8)
+    p.add_argument("--seed", type=int, default=0,
+                   help="master seed: graph AND every fault schedule derive "
+                        "from it deterministically")
+    p.add_argument("--backend", default="ell",
+                   help="primary engine under test (default: ell)")
+    p.add_argument("--fallback-ladder", default=None,
+                   help="forwarded to the CLI (default: canonical ladder)")
+    p.add_argument("--retries", type=int, default=3)
+    p.add_argument("--attempt-timeout", type=float, default=6.0)
+    p.add_argument("--max-faults", type=int, default=3,
+                   help="max faults drawn per schedule")
+    p.add_argument("--run-deadline", type=float, default=180.0,
+                   help="hard per-subprocess deadline (a run past it is a "
+                        "chaos failure: hang past the watchdog)")
+    p.add_argument("--report", default="chaos_report.json")
+    p.add_argument("--workdir", default=None,
+                   help="scratch dir (default: a fresh temp dir)")
+    p.add_argument("--keep-workdir", action="store_true")
+    args = p.parse_args(argv)
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="dgc_chaos_")
+    os.makedirs(workdir, exist_ok=True)
+    graph_path = os.path.join(workdir, "graph.json")
+
+    from dgc_tpu.models.graph import Graph
+
+    Graph.generate(args.nodes, args.max_degree, seed=args.seed,
+                   method="reference").serialize(graph_path)
+    print(f"# chaos: graph V={args.nodes} maxdeg={args.max_degree} "
+          f"seed={args.seed} backend={args.backend} workdir={workdir}",
+          file=sys.stderr)
+
+    baselines: dict[str, list] = {}
+
+    def baseline_colors(backend: str) -> list:
+        """Fault-free, resilience-off (pre-PR dispatch chain) reference
+        coloring for one backend, computed once."""
+        if backend not in baselines:
+            out = os.path.join(workdir, f"baseline_{backend}.json")
+            r = _run_cli(["--input", graph_path, "--output-coloring", out,
+                          "--backend", backend],
+                         timeout_s=args.run_deadline)
+            if r.returncode != 0:
+                raise RuntimeError(
+                    f"fault-free baseline for {backend} failed rc "
+                    f"{r.returncode}:\n{r.stderr}")
+            baselines[backend] = json.load(open(out))
+        return baselines[backend]
+
+    results = []
+    for i in range(args.schedules):
+        rng = random.Random(args.seed * 100_003 + i)
+        schedule = FaultSchedule.random(
+            rng, n_faults=rng.randint(1, args.max_faults),
+            hang_seconds=args.attempt_timeout + 2.0)
+        spec = schedule.to_spec()
+        out = os.path.join(workdir, f"colors_{i}.json")
+        log = os.path.join(workdir, f"run_{i}.jsonl")
+        ckpt = os.path.join(workdir, f"ckpt_{i}")
+        base_cmd = ["--input", graph_path, "--output-coloring", out,
+                    "--backend", args.backend,
+                    "--retries", str(args.retries),
+                    "--attempt-timeout", str(args.attempt_timeout),
+                    "--checkpoint-dir", ckpt, "--log-json", log]
+        if args.fallback_ladder:
+            base_cmd += ["--fallback-ladder", args.fallback_ladder]
+
+        entry = {"index": i, "spec": spec, "restarts": 0,
+                 "final_backend": args.backend, "bit_identical": None,
+                 "log_problems": 0}
+        try:
+            r = _run_cli(base_cmd + ["--inject-faults", spec],
+                         timeout_s=args.run_deadline)
+            rc = r.returncode
+            # an injected kill (rc 137) is what an operator restart cures:
+            # rerun the same command — checkpoint intact, no fault plane
+            while rc == KILL_RC and entry["restarts"] < 3:
+                entry["restarts"] += 1
+                r = _run_cli(base_cmd, timeout_s=args.run_deadline)
+                rc = r.returncode
+        except subprocess.TimeoutExpired:
+            entry.update(outcome="hang", rc=-1)
+            results.append(entry)
+            print(f"# [{i}] HANG  spec={spec}", file=sys.stderr)
+            continue
+
+        entry["rc"] = rc
+        entry["log_problems"] = len(validate_file(log)) if os.path.exists(log) else 0
+        if rc == 0:
+            final = _final_backend(log, args.backend)
+            entry["final_backend"] = final
+            identical = json.load(open(out)) == baseline_colors(final)
+            entry["bit_identical"] = identical
+            entry["outcome"] = "ok" if identical and not entry["log_problems"] \
+                else "mismatch"
+        elif rc == STRUCTURED_ABORT_RC:
+            entry["outcome"] = "structured_abort"
+        elif rc == ABORT_RC:
+            entry["outcome"] = "watchdog_abort"
+        else:
+            entry["outcome"] = "error"
+        results.append(entry)
+        print(f"# [{i}] {entry['outcome']:<16} rc={rc} restarts="
+              f"{entry['restarts']} engine={entry['final_backend']} "
+              f"spec={spec}", file=sys.stderr)
+
+    ok = sum(1 for e in results if e["outcome"] == "ok")
+    aborts = sum(1 for e in results
+                 if e["outcome"] in ("structured_abort", "watchdog_abort"))
+    failed = len(results) - ok - aborts
+    report = {
+        "chaos_report_version": CHAOS_REPORT_VERSION,
+        "config": {"schedules": args.schedules, "nodes": args.nodes,
+                   "max_degree": args.max_degree, "seed": args.seed,
+                   "backend": args.backend, "retries": args.retries,
+                   "attempt_timeout": args.attempt_timeout,
+                   "fallback_ladder": args.fallback_ladder},
+        "schedules": results,
+        "summary": {"total": len(results), "ok": ok,
+                    "structured_abort": aborts, "failed": failed},
+    }
+    problems = validate_chaos_report(report)
+    if problems:
+        for prob in problems:
+            print(f"# chaos report malformed: {prob}", file=sys.stderr)
+        failed += 1  # a malformed report is itself a harness failure
+    with open(args.report, "w") as fh:
+        json.dump(report, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps({"chaos": {"total": len(results), "ok": ok,
+                                "aborts": aborts, "failed": failed,
+                                "report": args.report}}))
+    if not args.keep_workdir and args.workdir is None:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return 0 if failed == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

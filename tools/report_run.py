@@ -105,8 +105,21 @@ def render(doc: dict, width: int = 48) -> str:
                 + (f" (peak {mem['peak_bytes_in_use'] / 1e6:.1f} MB)"
                    if mem.get("peak_bytes_in_use") is not None else ""))
 
+    res = doc.get("resilience") or {}
+    if any(res.get(k) for k in ("faults", "retries", "fallbacks", "resumes")):
+        add(f"resilience: {len(res.get('faults') or [])} fault(s) injected, "
+            f"{len(res.get('retries') or [])} retr(ies), "
+            f"{len(res.get('fallbacks') or [])} fallback(s), "
+            f"{len(res.get('resumes') or [])} resume(s)")
+        for fb in res.get("fallbacks") or []:
+            add(f"fallback: {fb.get('from_backend')} -> {fb.get('to_backend')} "
+                f"({fb.get('error_class')})")
+
     for ab in doc.get("aborts") or []:
-        add(f"ABORT:    {ab.get('what')}: {ab.get('diag')}")
+        if ab.get("event") == "structured_abort":
+            add(f"ABORT:    structured (rc {ab.get('rc')}): {ab.get('reason')}")
+        else:
+            add(f"ABORT:    {ab.get('what')}: {ab.get('diag')}")
 
     pr = doc.get("post_reduce")
     if pr:
