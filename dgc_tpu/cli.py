@@ -176,6 +176,12 @@ def make_engine(args, graph: Graph, logger=None):
             multi = initialize_multihost()
             if logger is not None:
                 logger.event("distributed", multi_process=multi, **process_info())
+        # imports are off the clock (bench.py behavior): a cold jax import
+        # can take tens of seconds on a slow filesystem, and the watchdog
+        # below must only time the device-backend handshake — otherwise a
+        # healthy backend behind a cold import misreports rc 113
+        import jax  # noqa: F401
+
         # first device touch, bounded: a dead tunnel aborts with a labeled
         # diagnostic instead of hanging the user's terminal forever
         devices = guarded_device_init(

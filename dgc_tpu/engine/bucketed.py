@@ -267,47 +267,64 @@ def bucketed_superstep(packed, combined_buckets, k, planes: tuple,
     return jnp.concatenate(new_parts), sum(fail_parts), sum(active_parts)
 
 
-@partial(jax.jit, static_argnames=("planes", "stall_window"),
+@partial(jax.jit, static_argnames=("planes", "stall_window", "record_traj"),
          donate_argnums=(2,))  # carry_in is consumed: chain in-place, no
                                # double-buffered [V] state across chunks
 def _attempt_kernel_bucketed(combined_buckets, degrees, carry_in, k,
-                             nsteps, planes: tuple, stall_window: int = 64):
+                             nsteps, planes: tuple, stall_window: int = 64,
+                             record_traj: bool = False):
     """Run up to ``nsteps`` (dynamic) supersteps from ``carry_in`` and return
     the carry — the host chains calls until the status leaves RUNNING, keeping
     any single device call bounded. ``carry_in`` is (packed, step, status,
-    prev_active, stall_rounds); pass ``initial_carry_bucketed`` to start.
+    prev_active, stall_rounds, traj); pass ``initial_carry_bucketed`` to
+    start. The in-kernel trajectory buffer (``obs.kernel``) rides the carry
+    ACROSS chunk calls — one decode at attempt end, zero extra transfers;
+    with ``record_traj`` off the 1-row dummy rides inert and the write is
+    statically elided.
 
     ``planes`` are the per-bucket color windows (``bucket_planes``): exact
     first-fit and failure semantics at any k, including power-law graphs
     where k0 = Δ+1 is five digits (SURVEY.md §7.3). ``stall_window`` is a
     defensive exit only — the priority total order guarantees the globally
     highest-priority active vertex confirms every superstep."""
+    from dgc_tpu.obs.kernel import make_trajstep
+
     k = jnp.asarray(k, jnp.int32)
     chunk_end = carry_in[1] + jnp.asarray(nsteps, jnp.int32)
+    trajstep = make_trajstep(record_traj)
+    # this engine's schedule is static: one neighbor gather per bucket,
+    # every superstep (the telemetry column the segmented compact engine
+    # collapses to O(1))
+    gcalls = jnp.int32(len(combined_buckets))
 
     def cond(carry):
-        _, step, status, _, _ = carry
+        _, step, status, _, _, _ = carry
         return (status == _RUNNING) & (step < chunk_end)
 
     def body(carry):
-        packed, step, status, prev_active, stall_rounds = carry
+        packed, step, status, prev_active, stall_rounds, traj = carry
         new_packed, fail_count, active = bucketed_superstep(
             packed, combined_buckets, k, planes
         )
         any_fail = fail_count > 0
+        traj = trajstep(traj, step, active, any_fail, gcalls=gcalls)
         stall_rounds = jnp.where(active < prev_active, 0, stall_rounds + 1)
         status = status_step(any_fail, active, stall_rounds, stall_window)
         new_packed = jnp.where(any_fail, packed, new_packed)
-        return (new_packed, step + 1, status, active, stall_rounds)
+        return (new_packed, step + 1, status, active, stall_rounds, traj)
 
     return jax.lax.while_loop(cond, body, carry_in)
 
 
-def initial_carry_bucketed(degrees):
+def initial_carry_bucketed(degrees, traj=None):
+    from dgc_tpu.obs.kernel import traj_empty
+
     v = degrees.shape[0]
+    if traj is None:
+        traj = traj_empty(1, dummy=True)
     # round-1 specialization: start from the known post-round-1 state
     return (initial_packed(degrees), jnp.int32(1), jnp.int32(_RUNNING),
-            jnp.int32(v + 1), jnp.int32(0))
+            jnp.int32(v + 1), jnp.int32(0), traj)
 
 
 class BucketedELLEngine:
@@ -335,6 +352,9 @@ class BucketedELLEngine:
         self.k_full = arrays.max_degree + 1
         self.max_steps = max_steps if max_steps is not None else 2 * v + 4
         self.chunk_steps = chunk_steps
+        # in-kernel telemetry switch (obs subsystem): the trajectory buffer
+        # rides the chunked kernel's carry across device calls
+        self.record_trajectory = False
 
     def _maybe_widen_windows(self) -> bool:
         """After a STALLED attempt: if any bucket's window is capped below
@@ -365,12 +385,20 @@ class BucketedELLEngine:
             return self._finish(
                 np.full(self.arrays.num_vertices, -1, np.int32),
                 AttemptStatus.FAILURE, 0, k)
+        from dgc_tpu.obs.kernel import (decode_trajectory, traj_cap_for,
+                                        traj_empty)
+
+        rec = self.record_trajectory
         while True:  # window-cap retry loop (STALLED + capped hub buckets)
-            carry = initial_carry_bucketed(self.degrees)
+            carry = initial_carry_bucketed(
+                self.degrees,
+                traj=traj_empty(traj_cap_for(self.max_steps))
+                if rec else None)
             while True:  # chunked superstep loop (bounded device calls)
                 carry = _attempt_kernel_bucketed(
                     self.combined_buckets, self.degrees,
                     carry, k, self.chunk_steps, planes=self.planes,
+                    record_traj=rec,
                 )
                 status = AttemptStatus(int(carry[2]))
                 steps = int(carry[1])
@@ -381,4 +409,7 @@ class BucketedELLEngine:
             if status == AttemptStatus.STALLED and self._maybe_widen_windows():
                 continue
             break
-        return self._finish(np.asarray(carry[0]), status, steps, int(k))
+        res = self._finish(np.asarray(carry[0]), status, steps, int(k))
+        if rec:
+            res.trajectory = decode_trajectory(carry[5], steps)
+        return res

@@ -46,21 +46,37 @@ attempt kernel executes, inside one ``jax.jit``:
    relabeled order, so slot i's row always belongs to a bucket at least as
    narrow as the bucket whose *worst-case* cumulative row count first
    covers i. The padded slot list is therefore split at static boundaries
-   ``q_b = min(cum flat-bucket sizes, A_pad)`` and each range is gathered
-   with its own clip width ``w_b`` (columns [0, w_b) of the same flat
-   table — ELL rows pack real neighbors leftmost, and a bucket-b row has
-   ≤ w_b of them). Stage gather volume drops from ``A_pad × W_flat`` to
+   ``q_b = min(cum flat-bucket sizes, A_pad)`` and each range keeps its
+   own clip width ``w_b`` (columns [0, w_b) of the same flat table — ELL
+   rows pack real neighbors leftmost, and a bucket-b row has ≤ w_b of
+   them). Stage gather volume drops from ``A_pad × W_flat`` to
    ``Σ_b (q_b − q_{b-1}) · w_b`` (−44% on the 1M benchmark) with no new
    tables and bit-identical results (each range's color window covers its
    width, so first-fit and failure detection stay exact per row).
+
+   **Segmented-gather execution** (``ops.segmented_gather``): the ranges
+   are not gathered one small gather apiece — at stage entry the clipped
+   rows flatten into ONE concatenated layout, and every superstep issues
+   a single large neighbor gather over it plus one forbidden-bitmask
+   reduction over the whole slot list. The same fold batches the
+   full-table phase's flat buckets and the unconditioned hub buckets
+   (one gather each per superstep instead of one per bucket): the many
+   small per-range/per-bucket gathers ran ~7× under the large-gather
+   primitive rate on heavy tails (PERF.md "Segmented-gather superstep
+   plan"). Bit-identical by construction — same entries, same widths,
+   same per-segment windows; only the gather batching changed — and the
+   per-superstep neighbor-gather call count lands in the trajectory
+   telemetry (``obs.kernel`` col 3).
 
 Heavy-tail (hub > 0) configs execute the staged schedule as ONE unified
 ``while_loop`` dispatching per-stage flat bodies over a ``lax.switch``
 (``_unified_pipeline``) so the hub machinery traces once instead of once
 per stage body — 3-4× smaller compiled programs at the RMAT bench
 configs (PERF.md "Compile time"); hub-free configs keep the sequential
-per-stage loops and lower byte-identically to the measured headline
-kernel.
+per-stage loops. (Results remain bit-identical to the measured headline
+kernel; its HLO is no longer byte-identical since the segmented-gather
+rewrite — the 1M-uniform headline row is queued for re-measurement,
+PERF.md.)
 
 Compaction and skipping are *exact*: a confirmed vertex can never become
 active again (demotion only applies to fresh vertices, and confirm/demote
@@ -102,6 +118,7 @@ from dgc_tpu.obs.kernel import (
     traj_empty,
 )
 from dgc_tpu.ops.bitmask import forbidden_planes, num_planes_for
+from dgc_tpu.ops import segmented_gather as seg
 from dgc_tpu.ops.speculative import (
     apply_update_mc,
     neighbor_stats,
@@ -207,9 +224,9 @@ def stage_slot_ranges(flat_sizes, flat_widths, a_pad: int) -> tuple:
 def _bucket_fail_valid(width: int, planes: int, k):
     """A window covering the bucket's degrees asserts failure exactly; a
     capped hub window must not unless k fits inside it (shared contract
-    with ``bucketed_superstep``)."""
-    fail_exact = 32 * planes >= width + 1
-    return fail_exact | (k <= 32 * planes)
+    with ``bucketed_superstep``; canonical form in
+    ``ops.segmented_gather.fail_gate``)."""
+    return seg.fail_gate(width, planes, k)
 
 
 def _reduce_bucket_result(new_b, fail_mask, act_mask, mc, width: int,
@@ -543,35 +560,111 @@ def _hub_dispatch(pe, ba_bi, pk_b, cb, p_b, k, v: int, ps_b=None,
     return jax.lax.switch(branch, branches, (pk_b, ps_b))
 
 
+class _SegCtx:
+    """Per-pipeline segmented-gather context (``ops.segmented_gather``):
+    the loop-invariant flat layouts + plans built ONCE per kernel
+    invocation (trace-time concats outside the while loop), so every
+    superstep's flat-region and unconditioned-hub work each issue a
+    single large gather instead of one per bucket/range.
+
+    - ``flat_plan``/``seg_flat``: the whole flat region (one segment per
+      flat bucket, in the degree-descending bucket order) — None when
+      there are no flat buckets;
+    - ``uncond_idx``/``uncond_plan``/``seg_uncond``: the unconditioned hub
+      buckets (table ≤ ``HUB_UNCOND_ENTRIES``), folded into one gather —
+      they run every superstep with no control flow, so batching them is
+      free; ``uncond_idx`` maps plan segments back to bucket indices.
+    """
+
+    def __init__(self, buckets, planes: tuple, row0s: tuple, nb_hub: int,
+                 hub_uncond: tuple):
+        self.flat_plan = None
+        self.seg_flat = None
+        if nb_hub < len(buckets):
+            flat = list(range(nb_hub, len(buckets)))
+            self.flat_plan = seg.plan_from_parts(
+                [buckets[bi].shape[0] for bi in flat],
+                [buckets[bi].shape[1] for bi in flat],
+                [planes[bi] for bi in flat])
+            self.seg_flat = seg.flatten_parts(
+                [buckets[bi] for bi in flat], self.flat_plan)
+        self.uncond_idx = tuple(
+            bi for bi in range(nb_hub)
+            if bi < len(hub_uncond) and hub_uncond[bi])
+        self.uncond_plan = None
+        self.seg_uncond = None
+        if self.uncond_idx:
+            self.uncond_plan = seg.plan_from_parts(
+                [buckets[bi].shape[0] for bi in self.uncond_idx],
+                [buckets[bi].shape[1] for bi in self.uncond_idx],
+                [planes[bi] for bi in self.uncond_idx])
+            self.seg_uncond = seg.flatten_parts(
+                [buckets[bi] for bi in self.uncond_idx], self.uncond_plan)
+
+
+def _uncond_hub_step(pe, pk, buckets, row0s: tuple, sc: _SegCtx, k):
+    """One superstep of every unconditioned hub bucket from ONE shared
+    segmented gather — bit-identical per bucket to ``_bucket_update``
+    (same tables, same windows, same ``_reduce_bucket_result`` gating;
+    ``ops.segmented_gather`` module docstring). Returns
+    ``{bi: (new_b, fail, act, mc)}``."""
+    if not sc.uncond_idx:
+        return {}
+    pk_parts = [
+        jax.lax.dynamic_slice_in_dim(pk, row0s[bi], buckets[bi].shape[0])
+        for bi in sc.uncond_idx
+    ]
+    pk_rows = (pk_parts[0] if len(pk_parts) == 1
+               else jnp.concatenate(pk_parts))
+    parts = seg.segmented_update_parts(
+        pe, sc.seg_uncond, sc.uncond_plan, pk_rows, k, decode_combined)
+    return {bi: parts[i] for i, bi in enumerate(sc.uncond_idx)}
+
+
 def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
                       hub_buckets: int, prune: tuple = (),
-                      hub_prune: tuple = (), hub_uncond: tuple = ()):
+                      hub_prune: tuple = (), hub_uncond: tuple = (),
+                      seg_ctx: _SegCtx | None = None):
     """One full-table superstep. The first ``hub_buckets`` buckets (the hub
     region: few rows, huge widths) are each wrapped in a ``lax.cond`` on
     their live active count ``ba[bi]`` (exact by frontier monotonicity) —
-    they confirm early and then cost *nothing*. The flat region runs fused,
-    no conds: on bounded-degree graphs (hub empty) this is the round-1
-    fused schedule with zero dispatch overhead — cond-wrapping every flat
-    bucket cost 70% per superstep on the 1M benchmark (round-2 regression,
-    2.86 s → 4.88 s) because flat buckets stay live for most of the sweep.
+    they confirm early and then cost *nothing*. The flat region runs fused
+    with no conds as ONE segmented gather + one bitmask reduction
+    (``ops.segmented_gather``): on bounded-degree graphs (hub empty) the
+    whole superstep is a single large neighbor gather — the per-bucket
+    gather decomposition this replaces ran ~7× under the large-gather
+    primitive rate on heavy tails (PERF.md "Effective rate").
+    Unconditioned hub buckets fold into a second shared gather
+    (``_uncond_hub_step``); conditioned hubs keep the dispatch ladder.
 
     ``ba`` is int32[hub_buckets (+1 if a flat region exists)]: per-hub-bucket
     actives, then the flat-region total. Returns
-    (new_pe, fail_count, active_count, ba_new, mc, prune_new)."""
+    (new_pe, fail_count, active_count, ba_new, mc, prune_new, gcalls) —
+    ``gcalls`` is the superstep's neighbor-state element-gather call count
+    (the telemetry column, ``obs.kernel``)."""
+    if seg_ctx is None:
+        seg_ctx = _SegCtx(buckets, planes, row0s, hub_buckets, hub_uncond)
     new_parts, parts_fail, parts_active, parts_mc = [], [], [], []
     ba_parts = []
     prune_new = []
     pk = pe[:v]
+    gcalls = jnp.int32(0)
 
+    un = _uncond_hub_step(pe, pk, buckets, row0s, seg_ctx, k)
+    if un:
+        gcalls = gcalls + 1
     for bi in range(hub_buckets):
-        cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
-        vb = cb.shape[0]
-        pk_b = jax.lax.dynamic_slice_in_dim(pk, row0, vb)
-        new_b, f_b, a_b, m_b, ps_b = _hub_dispatch(
-            pe, ba[bi], pk_b, cb, p_b, k, v,
-            prune[bi] if bi < len(prune) else None,
-            hub_prune[bi] if bi < len(hub_prune) else None,
-            uncond=bool(hub_uncond[bi]) if bi < len(hub_uncond) else False)
+        if bi in un:
+            new_b, f_b, a_b, m_b = un[bi]
+            ps_b = prune[bi] if bi < len(prune) else None
+        else:
+            cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
+            pk_b = jax.lax.dynamic_slice_in_dim(pk, row0, cb.shape[0])
+            new_b, f_b, a_b, m_b, ps_b = _hub_dispatch(
+                pe, ba[bi], pk_b, cb, p_b, k, v,
+                prune[bi] if bi < len(prune) else None,
+                hub_prune[bi] if bi < len(hub_prune) else None)
+            gcalls = gcalls + (ba[bi] > 0).astype(jnp.int32)
         new_parts.append(new_b)
         parts_fail.append(f_b)
         parts_active.append(a_b)
@@ -579,20 +672,25 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
         ba_parts.append(a_b)
         prune_new.append(ps_b)
 
-    f_parts, f_fails, f_acts, f_mcs = _flat_buckets_step(
-        pe, pk, buckets, planes, row0s, hub_buckets, k, v)
-    new_parts.extend(f_parts)
-    parts_fail.extend(f_fails)
-    parts_active.extend(f_acts)
-    parts_mc.extend(f_mcs)
-    if hub_buckets < len(buckets):
-        ba_parts.append(sum(parts_active[hub_buckets:]))
+    if seg_ctx.flat_plan is not None:
+        flat_row0 = row0s[hub_buckets]
+        pk_rows = jax.lax.dynamic_slice_in_dim(
+            pk, flat_row0, seg.plan_rows(seg_ctx.flat_plan))
+        new_flat, f_fl, a_fl, m_fl = seg.segmented_update(
+            pe, seg_ctx.seg_flat, seg_ctx.flat_plan, pk_rows, k,
+            decode_combined)
+        gcalls = gcalls + 1
+        new_parts.append(new_flat)
+        parts_fail.append(f_fl)
+        parts_active.append(a_fl)
+        parts_mc.append(m_fl)
+        ba_parts.append(a_fl)
 
-    new_pk = jnp.concatenate(new_parts)
+    new_pk = jnp.concatenate(new_parts) if len(new_parts) > 1 else new_parts[0]
     new_pe = jnp.concatenate([new_pk, jnp.array([-1, 0], jnp.int32)])
     mc = parts_mc[0] if len(parts_mc) == 1 else jnp.max(jnp.stack(parts_mc))
     return (new_pe, sum(parts_fail), sum(parts_active),
-            jnp.stack(ba_parts), mc, tuple(prune_new))
+            jnp.stack(ba_parts), mc, tuple(prune_new), gcalls)
 
 
 _REC_SLOTS = 4  # prefix-resume ring: pre-states of the last 4 record rounds
@@ -666,7 +764,7 @@ def restore_from_ring(rec, k, first, pe_i, ba_i, step_i, stall_i, act_i):
 def _superstep_epilogue(recstep, rec5, pe, ba, prune, new_pe, ba_new,
                         prune_new, any_fail, active, mc, step,
                         prev_active, stall, stall_window,
-                        trajstep=None, traj=None):
+                        trajstep=None, traj=None, gcalls=None):
     """Shared tail of every pipeline superstep body (one definition so the
     fail-revert ordering, stall accounting, rec-ring push, and telemetry
     write cannot drift between the sequential/unified pipelines and the
@@ -677,7 +775,8 @@ def _superstep_epilogue(recstep, rec5, pe, ba, prune, new_pe, ba_new,
     (rec5, stall, status, new_pe, ba_new, prune_new, traj)."""
     rec5 = recstep(rec5, pe, ba, step, prev_active, stall, mc, any_fail)
     if trajstep is not None:
-        traj = trajstep(traj, step, active, any_fail, mc, ba_new)
+        traj = trajstep(traj, step, active, any_fail, mc, ba_new,
+                        gcalls=gcalls)
     stall = jnp.where(active < prev_active, 0, stall + 1)
     status = status_step(any_fail, active, stall, stall_window)
     new_pe = jnp.where(any_fail, pe, new_pe)
@@ -687,51 +786,47 @@ def _superstep_epilogue(recstep, rec5, pe, ba, prune, new_pe, ba_new,
     return rec5, stall, status, new_pe, ba_new, prune_new, traj
 
 
-def _flat_buckets_step(pe, pk, buckets, planes: tuple, row0s: tuple,
-                       nb_hub: int, k, v: int):
-    """One superstep of every flat bucket against the ``pe`` snapshot —
-    the single home of the fused flat-region loop (shared by
-    ``_hybrid_superstep`` and the unified pipeline's full-table branch so
-    the two cannot drift). ``pk`` is the caller's ``pe[:v]`` slice (passed
-    in so callers that already hold it don't trace a second slice).
-    Returns per-bucket lists (new_parts, fails, actives, mcs)."""
-    new_parts, fails, acts, mcs = [], [], [], []
-    for bi in range(nb_hub, len(buckets)):
-        cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
-        pk_b = jax.lax.dynamic_slice_in_dim(pk, row0, cb.shape[0])
-        new_b, f_b, a_b, m_b = _bucket_update(pe, pk_b, cb, p_b, k, v)
-        new_parts.append(new_b)
-        fails.append(f_b)
-        acts.append(a_b)
-        mcs.append(m_b)
-    return new_parts, fails, acts, mcs
-
-
 def _hub_region_step(pe, ba, new_pe, prune, buckets, planes: tuple,
                      row0s: tuple, nb_hub: int, hub_prune: tuple,
-                     hub_uncond: tuple, k, v: int):
+                     hub_uncond: tuple, k, v: int,
+                     seg_ctx: _SegCtx | None = None):
     """One superstep of the hub region against the ``pe`` snapshot,
     accumulating each bucket's rows into ``new_pe`` (disjoint row sets).
     The single home of the cond-skipped hub loop — traced once per
-    pipeline by ``_unified_pipeline``. Returns
-    (new_pe, fails, actives, mcs, prune_new) with per-bucket lists."""
+    pipeline by ``_unified_pipeline``. Unconditioned buckets fold into
+    one shared segmented gather (``_uncond_hub_step``). Returns
+    (new_pe, fails, actives, mcs, prune_new, gcalls) with per-bucket
+    lists."""
     fails, actives, mcs = [], [], []
     prune_new = []
+    if seg_ctx is None:
+        seg_ctx = _SegCtx(buckets, planes, row0s, nb_hub, hub_uncond)
+    un = _uncond_hub_step(pe, pe[:v], buckets, row0s, seg_ctx, k)
+    gcalls = jnp.int32(1 if un else 0)
     for bi in range(nb_hub):
         cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
         vb = cb.shape[0]
         cfg = hub_prune[bi] if bi < len(hub_prune) else None
-        uncond = bool(hub_uncond[bi]) if bi < len(hub_uncond) else False
+
+        if bi in un:  # unconditioned: shared gather, no control flow
+            new_b, f_b, a_b, m_b = un[bi]
+            new_pe = jax.lax.dynamic_update_slice_in_dim(
+                new_pe, new_b, row0, axis=0)
+            ps2 = prune[bi] if bi < len(prune) else None
+            fails.append(f_b)
+            actives.append(a_b)
+            mcs.append(m_b)
+            prune_new.append(ps2)
+            continue
 
         # slice + write-back stay inside the cond: an inert hub bucket
         # must cost *nothing* per superstep (module docstring invariant),
         # not an O(rows) copy
-        def do_hub(op, cb=cb, p_b=p_b, row0=row0, vb=vb, bi=bi,
-                   cfg=cfg, uncond=uncond):
+        def do_hub(op, cb=cb, p_b=p_b, row0=row0, vb=vb, bi=bi, cfg=cfg):
             acc, ps = op
             pk_b = jax.lax.dynamic_slice_in_dim(pe[:v], row0, vb)
             new_b, f_b, a_b, m_b, ps2 = _hub_dispatch(
-                pe, ba[bi], pk_b, cb, p_b, k, v, ps, cfg, uncond=uncond)
+                pe, ba[bi], pk_b, cb, p_b, k, v, ps, cfg)
             return (jax.lax.dynamic_update_slice_in_dim(
                 acc, new_b, row0, axis=0), f_b, a_b, m_b, ps2)
 
@@ -739,18 +834,15 @@ def _hub_region_step(pe, ba, new_pe, prune, buckets, planes: tuple,
             acc, ps = op
             return acc, jnp.int32(0), jnp.int32(0), jnp.int32(-1), ps
 
-        if uncond:  # no cond: costs less than the cond would
-            new_pe, f_b, a_b, m_b, ps2 = do_hub(
-                (new_pe, prune[bi] if bi < len(prune) else None))
-        else:
-            new_pe, f_b, a_b, m_b, ps2 = jax.lax.cond(
-                ba[bi] > 0, do_hub, skip_hub,
-                (new_pe, prune[bi] if bi < len(prune) else None))
+        new_pe, f_b, a_b, m_b, ps2 = jax.lax.cond(
+            ba[bi] > 0, do_hub, skip_hub,
+            (new_pe, prune[bi] if bi < len(prune) else None))
+        gcalls = gcalls + (ba[bi] > 0).astype(jnp.int32)
         fails.append(f_b)
         actives.append(a_b)
         mcs.append(m_b)
         prune_new.append(ps2)
-    return new_pe, fails, actives, mcs, tuple(prune_new)
+    return new_pe, fails, actives, mcs, tuple(prune_new), gcalls
 
 
 def _check_stage_ladder(stages: tuple, v: int) -> None:
@@ -798,14 +890,18 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
     index ``max{s: active ≤ thresh_{s-1}}`` replays the same stage for
     every superstep, and recompaction fires on stage advance from the same
     pre-superstep snapshot the sequential stage entry would use. The
-    compacted rows ride the carry as ``comb_c`` (int32[A0, W_flat], A0 =
-    the largest stage pad) + ``gidx`` (their global row ids); stage s
-    reads the static prefix ``[:pad_s]``, so narrower later stages never
-    see a wider stage's stale tail. The full-width transition row-gather
-    replaces the per-range clipped gathers of the sequential stage entry —
-    same rows, same values on every clipped prefix (row gathers are paid
-    per row, so the extra width is free at the measured rates), hence
-    every per-superstep input is bit-identical."""
+    compacted rows ride the carry as the stage's **segmented-gather
+    layout** ``seg_c`` (int32[T_max], ``ops.segmented_gather``: the
+    width-ranges' clipped rows flattened into one vector, T_max = the
+    largest stage's layout) + ``gidx`` (their global row ids); the
+    transition rebuilds both from scratch, so stage s's static prefix
+    ``[:T_s]`` always holds exactly its own plan. The full-width
+    transition row-gather replaces the per-range clipped gathers of the
+    sequential stage entry — same rows, same values on every clipped
+    prefix (row gathers are paid per row, so the extra width is free at
+    the measured rates), hence every per-superstep input is
+    bit-identical; each stage superstep then issues ONE neighbor gather
+    over the layout instead of one per width range."""
     v = degrees.shape[0]
     _check_stage_ladder(stages, v)
     k = jnp.asarray(k, jnp.int32)
@@ -817,6 +913,15 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
     a0 = max((p for p in pads if p is not None), default=1)
     v_flat = flat_ext.shape[0] - 1
     w_flat = flat_ext.shape[1]
+    sc = _SegCtx(buckets, planes, row0s, nb_hub, hub_uncond)
+    # per-compaction-stage segmented plans (fallback: one full-width range)
+    plans = tuple(
+        None if pads[s] is None else seg.plan_from_ranges(
+            stage_ranges[s] if s < len(stage_ranges) and stage_ranges[s]
+            else ((0, pads[s], w_flat, flat_planes),))
+        for s in range(n_stages))
+    t_max = max((seg.plan_size(p) for p in plans if p is not None),
+                default=1)
 
     recstep = _make_recstep(record)
     trajstep = make_trajstep(record_traj)
@@ -830,11 +935,11 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
         return d
 
     prune0 = _fresh_prune(buckets, nb_hub, planes, hub_prune, v)
-    comb0 = jnp.full((a0, w_flat), v, jnp.int32)      # dummy rows
+    seg0 = jnp.full((t_max,), v, jnp.int32)           # dummy entries
     gidx0 = jnp.full((a0,), v + 1, jnp.int32)         # dummy slot target
     carry = ((init[0], init[1], jnp.int32(_RUNNING), init[2], init[3],
               init[4]) + tuple(rec)
-             + (prune0, jnp.int32(-1), comb0, gidx0, traj))
+             + (prune0, jnp.int32(-1), seg0, gidx0, traj))
 
     def cond(c):
         step, status, active = c[1], c[2], c[3]
@@ -848,7 +953,7 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
     def body(c):
         pe, step, status, prev_active, stall, ba = c[:6]
         rec5, prune = c[6:11], c[11]
-        stage_idx, comb_c, gidx, traj = c[12], c[13], c[14], c[15]
+        stage_idx, seg_c, gidx, traj = c[12], c[13], c[14], c[15]
 
         # --- stage advance + recompaction (from the pre-superstep pe) ---
         desired = desired_stage(prev_active)
@@ -858,26 +963,27 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
             if pad_s is None:
                 return lambda op: op
 
-            def trans(op, pad_s=pad_s):
-                comb_c, gidx = op
+            def trans(op, pad_s=pad_s, plan_s=plans[s]):
+                seg_c, gidx = op
                 pk = pe[:v]
                 act = (pk < 0) | ((pk & 1) == 1)
                 act_f = jax.lax.slice(act, (flat_row0,), (v,))
                 idx_f = _compact_idx(act_f, pad_s, v_flat)
                 comb_s = jnp.take(flat_ext, idx_f, axis=0)  # row gather
-                comb_c = jax.lax.dynamic_update_slice(comb_c, comb_s, (0, 0))
+                seg_s = seg.flatten_rows(comb_s, plan_s)
+                seg_c = jax.lax.dynamic_update_slice(seg_c, seg_s, (0,))
                 g_s = jnp.where(idx_f == v_flat, v + 1, idx_f + flat_row0)
                 gidx = jax.lax.dynamic_update_slice(gidx, g_s, (0,))
-                return comb_c, gidx
+                return seg_c, gidx
 
             return trans
 
-        comb_c, gidx = jax.lax.cond(
+        seg_c, gidx = jax.lax.cond(
             desired > stage_idx,
             lambda op: jax.lax.switch(
                 desired, [make_trans(s) for s in range(n_stages)], op),
             lambda op: op,
-            (comb_c, gidx))
+            (seg_c, gidx))
         stage_idx = jnp.maximum(stage_idx, desired)
 
         # --- flat-region superstep for the current stage (switch) ---
@@ -885,70 +991,55 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
             scale = stages[s][0]
             if not has_flat:
                 def none_flat(_):
-                    return pe, jnp.int32(0), jnp.int32(0), jnp.int32(-1)
+                    return pe, jnp.int32(0), jnp.int32(0), jnp.int32(-1), \
+                        jnp.int32(0)
                 return none_flat
             if scale is None:
-                # full-table phase: all flat buckets fused, unconditioned
+                # full-table phase: the whole flat region as ONE segmented
+                # gather + one bitmask reduction (ops.segmented_gather)
                 def full_flat(_):
-                    pk = pe[:v]
-                    new_parts, fails, acts, mcs = _flat_buckets_step(
-                        pe, pk, buckets, planes, row0s, nb_hub, k, v)
-                    new_flat = jnp.concatenate(new_parts)
+                    pk_rows = jax.lax.slice(pe, (flat_row0,), (v,))
+                    new_flat, fail, act, mc = seg.segmented_update(
+                        pe, sc.seg_flat, sc.flat_plan, pk_rows, k,
+                        decode_combined)
                     new_pe = jax.lax.dynamic_update_slice_in_dim(
                         pe, new_flat, flat_row0, axis=0)
-                    return (new_pe, sum(fails), sum(acts),
-                            mcs[0] if len(mcs) == 1
-                            else jnp.max(jnp.stack(mcs)))
+                    return new_pe, fail, act, mc, jnp.int32(1)
                 return full_flat
 
             pad_s = pads[s]
-            ranges = (stage_ranges[s] if s < len(stage_ranges)
-                      and stage_ranges[s] else
-                      ((0, pad_s, w_flat, flat_planes),))
+            plan_s = plans[s]
 
-            def staged_flat(op, pad_s=pad_s, ranges=ranges):
-                comb_c, gidx = op
+            def staged_flat(op, pad_s=pad_s, plan_s=plan_s):
+                seg_c, gidx = op
                 gidx_s = jax.lax.slice(gidx, (0,), (pad_s,))
+                seg_s = jax.lax.slice(seg_c, (0,), (seg.plan_size(plan_s),))
 
                 def do_flat(_):
                     pk_a = pe[gidx_s]
-                    new_parts, mcs = [], []
-                    fail_t = jnp.int32(0)
-                    act_t = jnp.int32(0)
-                    for (r0, r1, w_r, p_r) in ranges:
-                        comb_r = jax.lax.slice(comb_c, (r0, 0), (r1, w_r))
-                        nbrs_r, beats_r = decode_combined(comb_r)
-                        pk_r = jax.lax.slice(pk_a, (r0,), (r1,))
-                        np_r = pe[nbrs_r]        # gather [r1-r0, w_r]
-                        new_r, fail_mask, act_mask, mc_r = (
-                            speculative_update_mc(pk_r, np_r, beats_r, k,
-                                                  p_r))
-                        new_parts.append(new_r)
-                        fail_t += jnp.sum(fail_mask.astype(jnp.int32))
-                        act_t += jnp.sum(act_mask.astype(jnp.int32))
-                        mcs.append(mc_r)
-                    new_a = (new_parts[0] if len(new_parts) == 1
-                             else jnp.concatenate(new_parts))
-                    mc = (mcs[0] if len(mcs) == 1
-                          else jnp.max(jnp.stack(mcs)))
+                    new_a, fail_t, act_t, mc = seg.segmented_update(
+                        pe, seg_s, plan_s, pk_a, k, decode_combined)
                     # dups only at V+1, same value
-                    return pe.at[gidx_s].set(new_a), fail_t, act_t, mc
+                    return (pe.at[gidx_s].set(new_a), fail_t, act_t, mc,
+                            jnp.int32(1))
 
                 def skip_any(_):
-                    return pe, jnp.int32(0), jnp.int32(0), jnp.int32(-1)
+                    return (pe, jnp.int32(0), jnp.int32(0), jnp.int32(-1),
+                            jnp.int32(0))
 
                 return jax.lax.cond(ba[nb_hub] > 0, do_flat, skip_any, None)
 
             return staged_flat
 
-        new_pe, fail_f, act_fl, mc_f = jax.lax.switch(
+        new_pe, fail_f, act_fl, mc_f, gc_f = jax.lax.switch(
             stage_idx, [make_flat(s) for s in range(n_stages)],
-            (comb_c, gidx))
+            (seg_c, gidx))
 
         # --- hub region: traced ONCE for the whole pipeline ---
-        new_pe, h_fails, h_actives, h_mcs, prune_new = _hub_region_step(
+        (new_pe, h_fails, h_actives, h_mcs, prune_new,
+         gc_h) = _hub_region_step(
             pe, ba, new_pe, prune, buckets, planes, row0s, nb_hub,
-            hub_prune, hub_uncond, k, v)
+            hub_prune, hub_uncond, k, v, seg_ctx=sc)
         ba_parts = list(h_actives)
         if has_flat:
             ba_parts.append(act_fl)
@@ -962,9 +1053,9 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
          traj) = _superstep_epilogue(
             recstep, rec5, pe, ba, prune, new_pe, ba_new, prune_new,
             any_fail, active, mc, step, prev_active, stall, stall_window,
-            trajstep, traj)
+            trajstep, traj, gcalls=gc_f + gc_h)
         return ((new_pe, step + 1, status, active, stall, ba_new)
-                + rec5 + (prune_new, stage_idx, comb_c, gidx, traj))
+                + rec5 + (prune_new, stage_idx, seg_c, gidx, traj))
 
     carry = jax.lax.while_loop(cond, body, carry)
     pe, steps, status, active = carry[0], carry[1], carry[2], carry[3]
@@ -1041,10 +1132,12 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
 
     recstep = _make_recstep(record)
     trajstep = make_trajstep(record_traj)
+    sc = _SegCtx(buckets, planes, row0s, nb_hub, hub_uncond)
 
     for si, (scale, thresh) in enumerate(stages):
         if scale is None:
-            # --- full-table phase (hub cond-skipped, flat fused) ---
+            # --- full-table phase (hub cond-skipped, flat fused into one
+            # segmented gather) ---
             def cond(c, thresh=thresh):
                 step, status, active = c[1], c[2], c[3]
                 return (status == _RUNNING) & (active > thresh) & (step < max_steps)
@@ -1052,15 +1145,16 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
             def body(c):
                 pe, step, status, prev_active, stall, ba = c[:6]
                 rec5, prune, traj = c[6:11], c[11], c[12]
-                new_pe, fail_count, active, ba_new, mc, prune_new = (
+                new_pe, fail_count, active, ba_new, mc, prune_new, gc = (
                     _hybrid_superstep(pe, ba, buckets, row0s, k, planes, v,
-                                      nb_hub, prune, hub_prune, hub_uncond))
+                                      nb_hub, prune, hub_prune, hub_uncond,
+                                      seg_ctx=sc))
                 any_fail = fail_count > 0
                 (rec5, stall, status, new_pe, ba_new,
                  prune_new, traj) = _superstep_epilogue(
                     recstep, rec5, pe, ba, prune, new_pe, ba_new, prune_new,
                     any_fail, active, mc, step, prev_active, stall,
-                    stall_window, trajstep, traj)
+                    stall_window, trajstep, traj, gcalls=gc)
                 return ((new_pe, step + 1, status, active, stall, ba_new)
                         + rec5 + (prune_new, traj))
 
@@ -1077,9 +1171,10 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
         ranges = (stage_ranges[si] if si < len(stage_ranges)
                   and stage_ranges[si] else
                   ((0, a_pad, flat_ext.shape[1], flat_planes),))
+        plan_s = seg.plan_from_ranges(ranges)
 
         def run_stage(c, a_pad=a_pad, thresh=thresh, v_flat=v_flat,
-                      ranges=ranges):
+                      ranges=ranges, plan_s=plan_s):
             pe0 = c[0]
             pk = pe0[:v]
             act = (pk < 0) | ((pk & 1) == 1)
@@ -1088,13 +1183,17 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
             act_f = jax.lax.slice(act, (flat_row0,), (v,))
             idx_f = _compact_idx(act_f, a_pad, v_flat)
             # per-range row gathers, clipped to the range's width (ELL rows
-            # pack real neighbors leftmost; a range's rows have deg ≤ w_r)
-            range_tabs = []
+            # pack real neighbors leftmost; a range's rows have deg ≤ w_r),
+            # flattened into the stage's loop-invariant segmented layout:
+            # each superstep then issues ONE neighbor gather for the whole
+            # slot list instead of one per width range
+            seg_parts = []
             for (r0, r1, w_r, p_r) in ranges:
                 comb_r = jnp.take(flat_ext[:, :w_r],
                                   jax.lax.slice(idx_f, (r0,), (r1,)), axis=0)
-                nbrs_r, beats_r = decode_combined(comb_r)
-                range_tabs.append((r0, r1, w_r, p_r, nbrs_r, beats_r))
+                seg_parts.append(comb_r.reshape(-1))
+            seg_s = (seg_parts[0] if len(seg_parts) == 1
+                     else jnp.concatenate(seg_parts))
             gidx = jnp.where(idx_f == v_flat, v + 1, idx_f + flat_row0)
 
             def cond2(c2):
@@ -1113,22 +1212,8 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
 
                 def do_flat(acc):
                     pk_a = pe[gidx]
-                    new_parts, fail_t, act_t = [], jnp.int32(0), jnp.int32(0)
-                    mcs = []
-                    for (r0, r1, w_r, p_r, nbrs_r, beats_r) in range_tabs:
-                        pk_r = jax.lax.slice(pk_a, (r0,), (r1,))
-                        np_r = pe[nbrs_r]            # gather [r1-r0, w_r]
-                        new_r, fail_mask, act_mask, mc_r = speculative_update_mc(
-                            pk_r, np_r, beats_r, k, p_r
-                        )
-                        # p_r covers w_r+1 colors, so failure is exact here
-                        new_parts.append(new_r)
-                        fail_t += jnp.sum(fail_mask.astype(jnp.int32))
-                        act_t += jnp.sum(act_mask.astype(jnp.int32))
-                        mcs.append(mc_r)
-                    new_a = (new_parts[0] if len(new_parts) == 1
-                             else jnp.concatenate(new_parts))
-                    mc = mcs[0] if len(mcs) == 1 else jnp.max(jnp.stack(mcs))
+                    new_a, fail_t, act_t, mc = seg.segmented_update(
+                        pe, seg_s, plan_s, pk_a, k, decode_combined)
                     return (acc.at[gidx].set(new_a),  # dups only at V+1, same value
                             fail_t, act_t, mc)
 
@@ -1141,9 +1226,6 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                     new_pe, fail_f, act_fl, mc_f = do_flat(pe)
 
                 ba_new = jnp.stack([act_fl]) if has_flat else ba
-                # sum() over the singleton lists matches the pre-refactor
-                # trace exactly (an add-with-0 op) — keeps the measured
-                # hub-free kernels' lowered HLO byte-identical
                 fail_count = sum([fail_f])
                 active = sum([act_fl])
                 mc = jnp.max(jnp.stack([mc_f]))
@@ -1152,7 +1234,8 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                  prune_new, traj) = _superstep_epilogue(
                     recstep, rec5, pe, ba, prune, new_pe, ba_new, (),
                     any_fail, active, mc, step, prev_active, stall,
-                    stall_window, trajstep, traj)
+                    stall_window, trajstep, traj,
+                    gcalls=jnp.int32(1 if has_flat else 0))
                 return ((new_pe, step + 1, status, active, stall, ba_new)
                         + rec5 + (prune_new, traj))
 

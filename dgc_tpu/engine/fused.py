@@ -97,7 +97,8 @@ def shard_rec_empty(v_local: int, dummy: bool = False):
 def shard_superstep_epilogue(recstep, rec5, packed_l, new_packed_l, prune,
                              prune_new, any_fail, active, mc, step,
                              prev_active, stall, stall_window: int,
-                             max_steps: int, trajstep=None, traj=None):
+                             max_steps: int, trajstep=None, traj=None,
+                             gcalls=None):
     """Shared tail of every sharded pipeline superstep: delegates to the
     single-device ``compact._superstep_epilogue`` (rec-ring push →
     stall/status → fail revert, one definition so the ordering cannot
@@ -116,7 +117,7 @@ def shard_superstep_epilogue(recstep, rec5, packed_l, new_packed_l, prune,
         prune_new, any_fail, active, mc, step, prev_active, stall,
         stall_window)
     if trajstep is not None:
-        traj = trajstep(traj, step, active, any_fail, mc)
+        traj = trajstep(traj, step, active, any_fail, mc, gcalls=gcalls)
     status = jnp.where(
         (status == AttemptStatus.RUNNING) & (step + 1 >= max_steps),
         AttemptStatus.STALLED, status).astype(jnp.int32)

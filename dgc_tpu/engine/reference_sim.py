@@ -268,9 +268,12 @@ def _concat_ranges(indptr: np.ndarray, ids: np.ndarray, lens: np.ndarray) -> np.
     Requires every row non-empty — duplicate ``row_starts`` positions from
     zero-length rows would silently corrupt the offsets below. Both call
     sites satisfy this (isolated vertices are pre-colored at reset, so
-    uncolored/candidate vertices always have degree ≥ 1).
+    uncolored/candidate vertices always have degree ≥ 1). A real raise,
+    not an ``assert``: under ``python -O`` an assert vanishes and a
+    zero-length row would silently corrupt gather offsets (ADVICE r5 #4).
     """
-    assert (lens > 0).all(), "zero-length CSR row passed to _concat_ranges"
+    if not (lens > 0).all():
+        raise ValueError("zero-length CSR row passed to _concat_ranges")
     total = int(lens.sum())
     if total == 0:
         return np.empty(0, dtype=np.int64)

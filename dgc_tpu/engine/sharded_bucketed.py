@@ -70,6 +70,7 @@ from dgc_tpu.engine.compact import (
     _pow2_ceil,
     hub_prune_cfg,
 )
+from dgc_tpu.ops import segmented_gather as seg
 from dgc_tpu.ops.speculative import speculative_update_mc
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.parallel.mesh import (
@@ -189,26 +190,78 @@ def shard_pad_for(slice_rows: int, width: int,
     return pad if pad < slice_rows else 0
 
 
+class _ShardSegCtx:
+    """Per-pipeline segmented-gather context for one shard's bucket
+    slices (the sharded port of ``engine.compact._SegCtx``): the
+    unconditioned slices — ``pad == 0`` and no prune config, which run
+    their full table every superstep with no control flow — fold into ONE
+    flat layout so the superstep issues a single large gather for all of
+    them (``ops.segmented_gather``)."""
+
+    def __init__(self, tables_l, planes: tuple, pads: tuple,
+                 prune_cfg: tuple):
+        self.uncond_idx = tuple(
+            bi for bi in range(len(tables_l))
+            if pads[bi] == 0
+            and (bi >= len(prune_cfg) or prune_cfg[bi] is None))
+        self.plan = None
+        self.seg_flat = None
+        if self.uncond_idx:
+            self.plan = seg.plan_from_parts(
+                [tables_l[bi].shape[0] for bi in self.uncond_idx],
+                [tables_l[bi].shape[1] for bi in self.uncond_idx],
+                [planes[bi] for bi in self.uncond_idx])
+            self.seg_flat = seg.flatten_parts(
+                [tables_l[bi] for bi in self.uncond_idx], self.plan)
+
+
 def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
-                     pads: tuple, prune=(), prune_cfg: tuple = ()):
+                     pads: tuple, prune=(), prune_cfg: tuple = (),
+                     seg_ctx: _ShardSegCtx | None = None):
     """One superstep over the shard's bucket slices with per-bucket live
     gating: an inert slice is skipped, a slice whose live count fits its
     pad runs row-compacted, everything else runs the full slice — each
     shard independently (the branches contain no collectives, so
-    shard-divergent control flow is legal under ``shard_map``). Exact by
-    the same monotone-frontier argument as ``engine.compact``: inactive
-    rows transition to themselves. Bit-identical to the ungated
+    shard-divergent control flow is legal under ``shard_map``). The
+    unconditioned slices run as ONE segmented gather (``_ShardSegCtx``).
+    Exact by the same monotone-frontier argument as ``engine.compact``:
+    inactive rows transition to themselves. Bit-identical to the ungated
     ``bucketed_superstep`` by construction (shared ``speculative_update``
-    core, shared ``_compact_idx`` slot idiom). Also returns the shard's
-    max divergence candidate ``mc`` (−1 on skipped slices) — pmax'd by the
-    caller for the prefix-resume record rule."""
+    core, shared ``_compact_idx`` slot idiom, shared per-segment window
+    gating). Also returns the shard's max divergence candidate ``mc``
+    (−1 on skipped slices) — pmax'd by the caller for the prefix-resume
+    record rule — and the shard's neighbor-gather call count ``gc``."""
     packed_pad = jnp.concatenate([packed_g, jnp.array([-1], jnp.int32)])
     v_final = packed_g.shape[0]
+    if seg_ctx is None:
+        seg_ctx = _ShardSegCtx(tables_l, planes, pads, prune_cfg)
     new_parts, fail_parts, act_parts, mc_parts = [], [], [], []
     prune_new = []
+    row0s = []
     row0 = 0
+    for tb in tables_l:
+        row0s.append(row0)
+        row0 += tb.shape[0]
+
+    un = {}
+    gc = jnp.int32(0)
+    if seg_ctx.uncond_idx:
+        pk_parts = [
+            jax.lax.dynamic_slice_in_dim(packed_l, row0s[bi],
+                                         tables_l[bi].shape[0])
+            for bi in seg_ctx.uncond_idx
+        ]
+        pk_rows = (pk_parts[0] if len(pk_parts) == 1
+                   else jnp.concatenate(pk_parts))
+        parts = seg.segmented_update_parts(
+            packed_pad, seg_ctx.seg_flat, seg_ctx.plan, pk_rows, k,
+            decode_combined)
+        un = {bi: parts[i] for i, bi in enumerate(seg_ctx.uncond_idx)}
+        gc = gc + 1
+
     for bi, (tb, p_b, pad) in enumerate(zip(tables_l, planes, pads)):
         rows, w = tb.shape
+        row0 = row0s[bi]
         pk_b = jax.lax.dynamic_slice_in_dim(packed_l, row0, rows)
         fv = _bucket_fail_valid(w, p_b, k).astype(jnp.int32)
         cfg = prune_cfg[bi] if bi < len(prune_cfg) else None
@@ -221,7 +274,9 @@ def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
             return (new_b, jnp.sum(fail_m.astype(jnp.int32)) * fv,
                     jnp.sum(act_m.astype(jnp.int32)), mc_b)
 
-        if cfg is not None:
+        if bi in un:
+            r = un[bi] + (ps_b,)
+        elif cfg is not None:
             # the single-device hub dispatcher, verbatim: ``packed_pad``
             # stands in for the [V+2] extended state (it gathers
             # ``pe[:v+1][nb]`` with v = v_final — exactly the all-gathered
@@ -231,8 +286,12 @@ def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
             nb_, f, a, m, ps2 = _hub_dispatch(
                 packed_pad, na, pk_b, tb, p_b, k, v_final, ps_b, cfg)
             r = (nb_, f, a, m, ps2)
+            gc = gc + (na > 0).astype(jnp.int32)
         elif pad == 0:
+            # only reachable with an explicitly narrowed seg_ctx — the
+            # default context folds every such slice into ``un``
             r = full(pk_b) + (ps_b,)
+            gc = gc + 1
         else:
             act_b = (pk_b < 0) | ((pk_b & 1) == 1)
             na = jnp.sum(act_b.astype(jnp.int32))
@@ -257,14 +316,14 @@ def _gated_superstep(packed_l, packed_g, tables_l, k, planes: tuple,
                 return jax.lax.cond(na <= pad, compact, full, pk_b)
 
             r = jax.lax.cond(na > 0, live, skip, pk_b) + (ps_b,)
+            gc = gc + (na > 0).astype(jnp.int32)
         new_parts.append(r[0])
         fail_parts.append(r[1])
         act_parts.append(r[2])
         mc_parts.append(r[3])
         prune_new.append(r[4])
-        row0 += rows
     return (jnp.concatenate(new_parts), sum(fail_parts), sum(act_parts),
-            jnp.max(jnp.stack(mc_parts)), tuple(prune_new))
+            jnp.max(jnp.stack(mc_parts)), tuple(prune_new), gc)
 
 
 def _shard_pipeline(tables_l, deg_l, k, init, rec, record, planes: tuple,
@@ -294,6 +353,7 @@ def _shard_pipeline(tables_l, deg_l, k, init, rec, record, planes: tuple,
     prune0 = _fresh_shard_prune(tables_l, planes, prune_cfg, v_final)
     recstep = _make_recstep(record)
     trajstep = make_trajstep(record_traj)
+    seg_ctx = _ShardSegCtx(tables_l, planes, pads, prune_cfg)
     carry = (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3],
              prune0) + tuple(rec) + (traj,)
 
@@ -305,18 +365,24 @@ def _shard_pipeline(tables_l, deg_l, k, init, rec, record, planes: tuple,
         packed_l, step, status, prev_active, stall, prune = c[:6]
         rec5, traj = c[6:11], c[11]
         packed_g = jax.lax.all_gather(packed_l, VERTEX_AXIS, tiled=True)
-        new_packed_l, fail_l, active_l, mc_l, prune_new = _gated_superstep(
-            packed_l, packed_g, tables_l, k, planes, pads, prune, prune_cfg
+        (new_packed_l, fail_l, active_l, mc_l, prune_new,
+         gc_l) = _gated_superstep(
+            packed_l, packed_g, tables_l, k, planes, pads, prune, prune_cfg,
+            seg_ctx=seg_ctx
         )
         fail_count = jax.lax.psum(fail_l, VERTEX_AXIS)
         active = jax.lax.psum(active_l, VERTEX_AXIS)
         mc = jax.lax.pmax(mc_l, VERTEX_AXIS)
+        # per-shard gather-call counts can diverge (live gating is shard-
+        # local); record the pod's critical path — every shard waits on
+        # the slowest — and keep the telemetry buffer shard-invariant
+        gc = jax.lax.pmax(gc_l, VERTEX_AXIS)
         any_fail = fail_count > 0
         (rec5, stall, status, new_packed_l,
          prune_new, traj) = shard_superstep_epilogue(
             recstep, rec5, packed_l, new_packed_l, prune, prune_new,
             any_fail, active, mc, step, prev_active, stall, stall_window,
-            max_steps, trajstep, traj)
+            max_steps, trajstep, traj, gcalls=gc)
         return (new_packed_l, step + 1, status, active, stall,
                 prune_new) + rec5 + (traj,)
 

@@ -40,7 +40,7 @@ EVENT_SCHEMAS: dict = {
     "trajectory": (
         {"k": "int", "active": "list", "fail": "list", "mc": "list",
          "first_step": "int", "truncated": "bool"},
-        {"bucket_active": "list"}),
+        {"bucket_active": "list", "gather_calls": "list"}),
     "phase": (
         {"name": "str", "seconds": NUM},
         {"k": "int", "attempt_index": "int", "warm": "bool"}),

@@ -34,24 +34,42 @@ def plane_masks(k, num_planes: int) -> jnp.ndarray:
     return jnp.where(nbits >= 32, jnp.uint32(0xFFFFFFFF), jnp.where(nbits <= 0, jnp.uint32(0), partial))
 
 
-def forbidden_planes(neighbor_colors: jnp.ndarray, num_planes: int) -> jnp.ndarray:
+def forbidden_planes(neighbor_colors: jnp.ndarray, num_planes: int,
+                     unrolled: bool = False) -> jnp.ndarray:
     """Build forbidden bitmask planes from gathered neighbor colors.
 
     ``neighbor_colors``: int32[V, W]; negative entries (uncolored neighbors /
     ELL padding) contribute nothing. Returns uint32[V, P].
+
+    Default form: ONE plane-axis-vectorized masked OR-reduce over
+    ``[V, W, P]`` — O(1) lowered HLO ops per call site regardless of P
+    (XLA fuses the elementwise producer into the reduce, so nothing
+    rank-3 materializes and the lane work is identical). The historical
+    per-plane Python loop (``unrolled=True``) lowered ~5 ops × P per
+    site, which made capped 32-plane hub windows the dominant term of the
+    staged kernels' compile size (PERF.md "Compile time"); it is kept for
+    on-chip A/B when the tunnel returns. Results are bit-identical either
+    way: the same uint32 OR reduction over the same contributions.
     """
     nc = neighbor_colors
     valid = nc >= 0
     word = jnp.where(valid, nc >> 5, -1)
     bit = (nc & 31).astype(jnp.uint32)
     contrib = jnp.uint32(1) << bit
-    planes = []
-    for p in range(num_planes):
-        lane = jnp.where(valid & (word == p), contrib, jnp.uint32(0))
-        planes.append(
-            jax.lax.reduce(lane, np.uint32(0), jax.lax.bitwise_or, (1,))
-        )
-    return jnp.stack(planes, axis=-1)  # [V, P]
+    if unrolled:
+        planes = []
+        for p in range(num_planes):
+            lane = jnp.where(valid & (word == p), contrib, jnp.uint32(0))
+            planes.append(
+                jax.lax.reduce(lane, np.uint32(0), jax.lax.bitwise_or, (1,))
+            )
+        return jnp.stack(planes, axis=-1)  # [V, P]
+    plane_ids = jnp.arange(num_planes, dtype=jnp.int32)
+    # invalid entries carry word == −1, which matches no plane id — the
+    # ``valid`` mask is already folded into ``word``
+    lane3 = jnp.where(word[..., None] == plane_ids,
+                      contrib[..., None], jnp.uint32(0))  # [V, W, P]
+    return jax.lax.reduce(lane3, np.uint32(0), jax.lax.bitwise_or, (1,))
 
 
 def first_fit(forbidden: jnp.ndarray, k) -> tuple[jnp.ndarray, jnp.ndarray]:

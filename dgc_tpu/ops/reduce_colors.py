@@ -33,6 +33,9 @@ model").
 
 from __future__ import annotations
 
+import threading
+from collections.abc import MutableMapping
+
 import numpy as np
 
 
@@ -252,7 +255,47 @@ _NATIVE_WORK_LIMIT = 2_000_000
 # with/without the C toolchain (the native walk affords a 20x budget —
 # ADVICE r4); this makes a cross-machine count difference attributable.
 # bench.py prints it beside post_reduce.
-last_run: dict = {}
+#
+# Concurrency contract (ADVICE r5 #3): the record is THREAD-LOCAL — each
+# thread sees only the record of ITS last ``reduce_color_count`` call, so
+# concurrent post-passes (the resilience supervisor's attempt watchdog
+# runs engine work on worker threads) cannot interleave their key writes.
+# Read it from the same thread that ran the reduction, immediately after
+# the call; callers on other threads see an empty record.
+class _ThreadLocalRecord(MutableMapping):
+    """Dict-shaped view over per-thread storage (keeps the historical
+    ``last_run.update(...)`` / ``dict(last_run)`` call sites working)."""
+
+    def __init__(self):
+        self._local = threading.local()
+
+    @property
+    def _d(self) -> dict:
+        d = getattr(self._local, "d", None)
+        if d is None:
+            d = self._local.d = {}
+        return d
+
+    def __getitem__(self, k):
+        return self._d[k]
+
+    def __setitem__(self, k, v):
+        self._d[k] = v
+
+    def __delitem__(self, k):
+        del self._d[k]
+
+    def __iter__(self):
+        return iter(self._d)
+
+    def __len__(self):
+        return len(self._d)
+
+    def __repr__(self):
+        return repr(self._d)
+
+
+last_run: MutableMapping = _ThreadLocalRecord()
 
 
 def _kempe_reduce(indptr: np.ndarray, indices: np.ndarray,
@@ -378,6 +421,8 @@ def reduce_color_count(indptr: np.ndarray, indices: np.ndarray,
     input itself when nothing improves). ``work_limit`` bounds Kempe-walk
     vertex visits per tier. ``native=None`` auto-selects the C++ walks
     (bit-identical at equal budgets) and falls back to the Python paths.
+    The diagnostic ``last_run`` record this call fills is thread-local —
+    read it from the calling thread (see the ``last_run`` contract above).
 
     The greedy-resweep tier (round 5) exists because single-vertex Kempe
     moves have a structural ceiling: the 50k parity ensemble found draws

@@ -1,0 +1,264 @@
+"""Segmented-gather superstep plan — O(1) large gathers per superstep.
+
+The staged kernels historically decomposed each superstep's neighbor-state
+gather into many small per-range / per-bucket gathers (one XLA gather op
+per width range, per flat bucket, per unconditioned hub bucket). On TPU
+the element-gather *primitive* runs at ~100-140M lookups/s, but the
+measured effective rate of that decomposed schedule on heavy tails is
+~16.6M/s (PERF.md "Effective rate"): each small gather underutilizes the
+memory system and the while-loop scheduler serializes them. This module
+batches the decomposition away without touching the update rule:
+
+- A **plan** is a static tuple of :class:`Seg` descriptors — contiguous
+  row spans, each with its clip width, bitmask plane count, and offset
+  into one flat concatenated layout. Plans are built once (engine
+  construction for loop-invariant tables, stage rebase for compacted slot
+  lists) in the existing degree-descending relabeled order.
+- :func:`flatten_parts` / :func:`flatten_rows` lay the per-segment tables
+  out as ONE flat int32 vector (row-major within each segment, segments
+  in row order), so each superstep issues **one** element gather for the
+  whole plan (``jax.named_scope('seg_gather')`` labels it for
+  ``tools/trace_attempt.py`` self-time attribution).
+- :func:`segmented_update` / :func:`segmented_update_parts` run the exact
+  per-segment update semantics on static slices of the gathered vector:
+  same slots, same clip widths, same ``beats_rule`` priority bits, same
+  per-segment plane windows and capped-window failure gating — only the
+  gather *batching* changes, so results are bit-identical to the
+  per-range/per-bucket loops by construction.
+
+Exactness of the collapsed (single ``apply_update_mc``) path: a segment
+whose plane window covers its width + 1 colors (``fail_exact``) computes
+identical per-row outcomes at ANY plane count ≥ its own — a row has at
+most ``width`` forbidden colors, so its first-fit candidate always lands
+inside the window and zero-padding the stat planes to the plan-wide
+maximum adds only free bits *above* a bit that is already free (they can
+never be selected, and failure/no-free detection is unchanged). Capped
+segments (hub windows, ``bucket_planes`` cap) do NOT satisfy this — a
+padded free bit would un-defer a saturated capped row — so
+:func:`plan_collapsible` gates the collapsed path and the fallback runs
+one ``apply_update_mc`` per segment at its own plane count (still one
+gather).
+"""
+
+from __future__ import annotations
+
+from typing import NamedTuple
+
+import jax
+import jax.numpy as jnp
+
+from dgc_tpu.ops.speculative import apply_update_mc, neighbor_stats
+
+
+class Seg(NamedTuple):
+    """One static segment of a segmented-gather plan.
+
+    Rows ``[row0, row0 + rows)`` of the plan's row space are gathered at
+    ``width`` columns and reduced with ``planes`` bitmask planes;
+    ``flat0`` is the segment's offset into the flat concatenated layout.
+    """
+
+    row0: int
+    rows: int
+    width: int
+    planes: int
+    flat0: int
+
+
+def plan_from_ranges(ranges) -> tuple:
+    """Plan from stage width-ranges ``((r0, r1, width, planes), ...)``
+    (``engine.compact.stage_slot_ranges`` layout — contiguous, covering
+    ``[0, a_pad)``)."""
+    segs = []
+    flat0 = 0
+    for r0, r1, w, p in ranges:
+        segs.append(Seg(int(r0), int(r1) - int(r0), int(w), int(p), flat0))
+        flat0 += (int(r1) - int(r0)) * int(w)
+    _check_plan(tuple(segs))
+    return tuple(segs)
+
+
+def plan_from_parts(sizes, widths, planes) -> tuple:
+    """Plan over a run of contiguous table parts (flat buckets, uncond hub
+    buckets): part i owns rows ``[Σ sizes[:i], Σ sizes[:i+1])``."""
+    segs = []
+    row0 = flat0 = 0
+    for sz, w, p in zip(sizes, widths, planes):
+        segs.append(Seg(row0, int(sz), int(w), int(p), flat0))
+        row0 += int(sz)
+        flat0 += int(sz) * int(w)
+    _check_plan(tuple(segs))
+    return tuple(segs)
+
+
+def _check_plan(plan: tuple) -> None:
+    row = flat = 0
+    for s in plan:
+        if s.row0 != row or s.flat0 != flat:
+            raise ValueError(f"non-contiguous segmented plan: {plan}")
+        if s.rows < 0 or s.width < 1 or s.planes < 1:
+            raise ValueError(f"degenerate segment {s} in plan {plan}")
+        row = s.row0 + s.rows
+        flat = s.flat0 + s.rows * s.width
+
+def plan_rows(plan: tuple) -> int:
+    """Total rows covered by the plan."""
+    return sum(s.rows for s in plan)
+
+
+def plan_size(plan: tuple) -> int:
+    """Total flat entries — the plan's per-superstep element-gather
+    volume. Equal to the per-range/per-bucket schedule's Σ rows·width by
+    construction (the volume-invariance fact ``utils.schedule_model``
+    checks)."""
+    return sum(s.rows * s.width for s in plan)
+
+
+def plan_max_planes(plan: tuple) -> int:
+    return max(s.planes for s in plan)
+
+
+def fail_gate(width: int, planes: int, k):
+    """A window covering the segment's width asserts failure exactly; a
+    capped window must not unless k fits inside it. The canonical form of
+    the bucketed engines' capped-window failure contract
+    (``engine.bucketed.bucketed_superstep``,
+    ``engine.compact._bucket_fail_valid`` delegate here)."""
+    fail_exact = 32 * planes >= width + 1
+    return fail_exact | (k <= 32 * planes)
+
+
+def plan_collapsible(plan: tuple) -> bool:
+    """True when every segment's window covers its width — the collapsed
+    single-``apply_update_mc`` path is then bit-identical (module
+    docstring)."""
+    return all(32 * s.planes >= s.width + 1 for s in plan)
+
+
+def flatten_rows(comb, plan: tuple):
+    """Flatten plan segments out of one 2-D table ``comb`` whose rows are
+    the plan's row space (columns ≥ each segment's width are clipped —
+    ELL rows pack real neighbors leftmost). Returns int32[plan_size]."""
+    parts = [
+        jax.lax.slice(comb, (s.row0, 0), (s.row0 + s.rows, s.width))
+        .reshape(-1)
+        for s in plan
+    ]
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def flatten_parts(tables, plan: tuple):
+    """Flatten one 2-D table per segment (bucket tables) into the plan's
+    flat layout. Returns int32[plan_size]."""
+    parts = []
+    for tb, s in zip(tables, plan):
+        if tb.shape != (s.rows, s.width):
+            raise ValueError(f"table {tb.shape} != segment {s}")
+        parts.append(tb.reshape(-1))
+    return parts[0] if len(parts) == 1 else jnp.concatenate(parts)
+
+
+def segmented_gather(pe_src, seg_comb, decode):
+    """THE gather: one element gather of every segment's neighbor state.
+
+    ``seg_comb`` is the flat combined (neighbor id | beats bit) layout;
+    ``decode`` is ``engine.bucketed.decode_combined`` (passed in to keep
+    this module import-light). Returns ``(np_flat, beats_flat)``. The
+    ``seg_gather`` scope names the lowered ops so trace attribution can
+    report the fused gather's self-time separately from residual small
+    gathers.
+    """
+    nb, beats = decode(seg_comb)
+    with jax.named_scope("seg_gather"):
+        np_flat = pe_src[nb]
+    return np_flat, beats
+
+
+def _seg_stats(np_flat, beats_flat, plan: tuple, mycol):
+    """Per-segment ``neighbor_stats`` on static slices of the one gathered
+    vector — each segment at its OWN plane count (identical values to the
+    per-range/per-bucket loops). Returns per-segment lists
+    ``(forb_all, forb_old, clash)``."""
+    out = []
+    for s in plan:
+        blk = jax.lax.slice(np_flat, (s.flat0,),
+                            (s.flat0 + s.rows * s.width,))
+        blk = blk.reshape(s.rows, s.width)
+        bts = jax.lax.slice(beats_flat, (s.flat0,),
+                            (s.flat0 + s.rows * s.width,))
+        bts = bts.reshape(s.rows, s.width)
+        my = jax.lax.slice(mycol, (s.row0,), (s.row0 + s.rows,))
+        out.append(neighbor_stats(blk, bts, my, s.planes))
+    return out
+
+
+def _pad_planes(planes_arr, p: int):
+    have = planes_arr.shape[-1]
+    if have == p:
+        return planes_arr
+    pad = jnp.zeros(planes_arr.shape[:-1] + (p - have,), planes_arr.dtype)
+    return jnp.concatenate([planes_arr, pad], axis=-1)
+
+
+def segmented_update(pe_src, seg_comb, plan: tuple, pk_rows, k, decode):
+    """One whole-plan superstep: one gather + one forbidden-bitmask
+    reduction over the live set.
+
+    ``pk_rows`` is the packed state of the plan's rows (contiguous).
+    Returns ``(new_rows, fail_count, act_count, mc)`` — bit-identical to
+    running the per-segment loop (gated per segment by :func:`fail_gate`),
+    via the collapsed single ``apply_update_mc`` when
+    :func:`plan_collapsible` holds, else per-segment applies (module
+    docstring exactness argument).
+    """
+    np_flat, beats_flat = segmented_gather(pe_src, seg_comb, decode)
+    mycol = pk_rows >> 1
+    stats = _seg_stats(np_flat, beats_flat, plan, mycol)
+
+    if plan_collapsible(plan):
+        p = plan_max_planes(plan)
+        forb_all = jnp.concatenate([_pad_planes(fa, p) for fa, _, _ in stats])
+        forb_old = jnp.concatenate([_pad_planes(fo, p) for _, fo, _ in stats])
+        clash = jnp.concatenate([c for _, _, c in stats])
+        new_rows, fail_mask, act_mask, mc = apply_update_mc(
+            pk_rows, forb_all, forb_old, clash, k)
+        return (new_rows, jnp.sum(fail_mask.astype(jnp.int32)),
+                jnp.sum(act_mask.astype(jnp.int32)), mc)
+
+    parts = segmented_update_parts(
+        pe_src, seg_comb, plan, pk_rows, k, decode,
+        stats=(np_flat, beats_flat, stats))
+    new_rows = (parts[0][0] if len(parts) == 1
+                else jnp.concatenate([p_[0] for p_ in parts]))
+    fail = sum(p_[1] for p_ in parts)
+    act = sum(p_[2] for p_ in parts)
+    mc = (parts[0][3] if len(parts) == 1
+          else jnp.max(jnp.stack([p_[3] for p_ in parts])))
+    return new_rows, fail, act, mc
+
+
+def segmented_update_parts(pe_src, seg_comb, plan: tuple, pk_rows, k,
+                           decode, stats=None):
+    """Per-segment superstep results from ONE shared gather — for callers
+    that consume per-part outputs (the hub region's unconditioned buckets
+    scatter each bucket's rows separately). Returns a list of
+    ``(new_seg, fail_count, act_count, mc)`` per segment, with the
+    capped-window failure gate applied per segment (exactly
+    ``engine.compact._reduce_bucket_result``'s rule)."""
+    if stats is None:
+        np_flat, beats_flat = segmented_gather(pe_src, seg_comb, decode)
+        mycol = pk_rows >> 1
+        seg_stats = _seg_stats(np_flat, beats_flat, plan, mycol)
+    else:
+        _, _, seg_stats = stats
+    out = []
+    for s, (forb_all, forb_old, clash) in zip(plan, seg_stats):
+        pk_b = jax.lax.slice(pk_rows, (s.row0,), (s.row0 + s.rows,))
+        new_b, fail_mask, act_mask, mc = apply_update_mc(
+            pk_b, forb_all, forb_old, clash, k)
+        fv = fail_gate(s.width, s.planes, k)
+        out.append((new_b,
+                    jnp.sum(fail_mask.astype(jnp.int32))
+                    * fv.astype(jnp.int32),
+                    jnp.sum(act_mask.astype(jnp.int32)), mc))
+    return out

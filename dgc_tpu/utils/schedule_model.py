@@ -36,13 +36,25 @@ from dgc_tpu.utils.trajectory import Trajectory, record_trajectory
 @dataclass
 class SchedulePrice:
     """Per-term element-gather volumes for one k-attempt (see module
-    docstring); ``floor`` is the trajectory's Σdeg(active) lower bound."""
+    docstring); ``floor`` is the trajectory's Σdeg(active) lower bound.
+
+    ``per_step_calls`` / ``per_step_calls_unfused`` count the
+    neighbor-state element-gather CALLS each superstep issues under the
+    segmented-gather plan (``ops.segmented_gather`` — the shipped
+    schedule) and under the pre-segmentation decomposition (one gather
+    per width range / flat bucket / unconditioned hub bucket). Volume is
+    identical between the two BY CONSTRUCTION (same slots, same clip
+    widths — :func:`check_volume_invariance`); the call count is what the
+    fold collapses, and it is the model-side counterpart of the
+    trajectory's ``gather_calls`` telemetry column (``obs.kernel``)."""
 
     floor: int
     terms: dict = field(default_factory=dict)
     steps_per_stage: list = field(default_factory=list)
     row_gathers: dict = field(default_factory=dict)
     per_step: list = field(default_factory=list)  # element gathers per superstep
+    per_step_calls: list = field(default_factory=list)          # fused plan
+    per_step_calls_unfused: list = field(default_factory=list)  # pre-PR plan
 
     @property
     def total(self) -> int:
@@ -50,6 +62,19 @@ class SchedulePrice:
 
     def over_floor(self) -> float:
         return self.total / self.floor if self.floor else float("inf")
+
+    def calls_summary(self) -> dict:
+        """Fused-vs-unfused gather-call accounting for the attempt."""
+        fused = sum(self.per_step_calls)
+        unfused = sum(self.per_step_calls_unfused)
+        n = max(1, len(self.per_step_calls))
+        return {
+            "fused_total": fused,
+            "unfused_total": unfused,
+            "reduction": round(unfused / fused, 2) if fused else None,
+            "per_step_mean_fused": round(fused / n, 2),
+            "per_step_mean_unfused": round(unfused / n, 2),
+        }
 
 
 def program_complexity(engine: CompactFrontierEngine) -> dict:
@@ -64,8 +89,14 @@ def program_complexity(engine: CompactFrontierEngine) -> dict:
       configs run the unified pipeline (``compact._unified_pipeline``) —
       one while loop whose ``lax.switch`` carries one (smaller) flat body
       per stage plus one recompaction body per compaction stage;
-    - ``range_gathers``: Σ width-ranges across stages (one gather + one
-      update per range per stage body);
+    - ``range_gathers``: Σ width-ranges across stages — since the
+      segmented-gather plan these are static SLICES of each stage's one
+      fused gather (one gather op per stage body, ``ops.segmented_gather``),
+      so the count prices per-range stats code, not gather ops;
+    - ``seg_gather_sites``: distinct fused-gather sites in the program
+      (full-table flat fold + one per compaction stage body + the
+      unconditioned-hub fold) — the per-superstep gather-call ceiling the
+      plan collapses the flat/uncond work to;
     - ``hub_branches``: Σ compiled control-flow bodies dispatching the
       hub — each conditioned bucket contributes its switch-ladder
       branches (``_hub_dispatch``: the full branch is dropped when the
@@ -95,15 +126,18 @@ def program_complexity(engine: CompactFrontierEngine) -> dict:
     compaction_stages = sum(1 for s, _ in engine.stages if s is not None)
     unified = engine.hub_buckets > 0 and compaction_stages > 0
     hub_instances = 1 if unified else stage_bodies
+    n_uncond = sum(1 for bi in range(engine.hub_buckets)
+                   if bi < len(engine.hub_uncond) and engine.hub_uncond[bi])
+    has_flat = engine.hub_buckets < len(engine.combined_buckets)
     return dict(
         stage_bodies=stage_bodies + (compaction_stages if unified else 0),
         range_gathers=sum(len(r) for r in engine.stage_ranges if r),
+        seg_gather_sites=(int(has_flat) + compaction_stages
+                          + int(n_uncond > 0)),
         hub_branches=(sum(ladders) * hub_instances
                       + 2 * len(ladders) * (1 if unified
                                             else compaction_stages)),
-        uncond_buckets=sum(1 for bi in range(engine.hub_buckets)
-                           if bi < len(engine.hub_uncond)
-                           and engine.hub_uncond[bi]),
+        uncond_buckets=n_uncond,
     )
 
 
@@ -126,9 +160,16 @@ def price_schedule(engine: CompactFrontierEngine,
              hub_pruned=0, hub_shrink=0, hub_pruned2=0, hub_uncond=0)
     rows = dict(stage_entry=0, hub_rebase=0, hub_shrink=0)
     tier = [0] * hub
+    uncond_set = {bi for bi in range(hub)
+                  if bi < len(engine.hub_uncond) and engine.hub_uncond[bi]}
+    n_flat_buckets = len(sizes) - hub
     si = 0
     for n, st in enumerate(traj.steps):
         step_base = sum(t.values())
+        calls_f = calls_u = 0
+        if uncond_set:  # unconditioned hubs: every superstep, one fold
+            calls_f += 1
+            calls_u += len(uncond_set)
         # stage transition before the step: the while conds gate on the
         # CARRIED active count (engine.compact._staged_pipeline), which at
         # step s equals the trajectory's start-of-step active — except at
@@ -144,19 +185,26 @@ def price_schedule(engine: CompactFrontierEngine,
         flat_live = sum(st.active_per_bucket[hub:]) > 0
         if scale is None:
             t["full_flat"] += flat_total  # flat region runs fused, no cond
+            if n_flat_buckets:
+                calls_f += 1                 # one segmented gather
+                calls_u += n_flat_buckets    # one gather per flat bucket
         elif (flat_live and si < len(engine.stage_ranges)
               and engine.stage_ranges[si]):
             t["stage_flat"] += sum((r1 - r0) * w for r0, r1, w, _pl
                                    in engine.stage_ranges[si])
+            calls_f += 1                                  # one per superstep
+            calls_u += len(engine.stage_ranges[si])       # one per range
 
         for bi in range(hub):
             live = st.active_per_bucket[bi]
             w, vb = widths[bi], sizes[bi]
-            if bi < len(engine.hub_uncond) and engine.hub_uncond[bi]:
+            if bi in uncond_set:
                 t["hub_uncond"] += vb * w  # no control flow at all
                 continue
             if live == 0:
                 continue  # cond-skipped: costs nothing
+            calls_f += 1   # conditioned ladder: one gather per live bucket,
+            calls_u += 1   # fused and unfused alike
             cfg = (engine.hub_prune[bi]
                    if bi < len(engine.hub_prune) else None)
             if cfg is None:
@@ -180,9 +228,51 @@ def price_schedule(engine: CompactFrontierEngine,
             else:
                 t["hub_full"] += vb * w
         p.per_step.append(sum(t.values()) - step_base)
+        p.per_step_calls.append(calls_f)
+        p.per_step_calls_unfused.append(calls_u)
     p.terms = t
     p.row_gathers = rows
     return p
+
+
+def check_volume_invariance(engine: CompactFrontierEngine) -> dict:
+    """Verify the segmented-gather plans move EXACTLY the entries the
+    per-range/per-bucket decomposition moved — the gather-volume
+    invariance the bit-identity contract rides on. Returns the per-plan
+    sizes; raises ``AssertionError`` on any mismatch (a test locks this,
+    and the CLI prints the result so every PERF.md pricing row carries
+    it)."""
+    from dgc_tpu.ops import segmented_gather as seg
+
+    widths = [cb.shape[1] for cb in engine.combined_buckets]
+    sizes = [cb.shape[0] for cb in engine.combined_buckets]
+    hub = engine.hub_buckets
+    out = {}
+    if hub < len(sizes):
+        flat = list(range(hub, len(sizes)))
+        plan = seg.plan_from_parts([sizes[b] for b in flat],
+                                   [widths[b] for b in flat],
+                                   [engine.planes[b] for b in flat])
+        want = sum(sizes[b] * widths[b] for b in flat)
+        assert seg.plan_size(plan) == want, (seg.plan_size(plan), want)
+        out["full_flat"] = want
+    uncond = [b for b in range(hub)
+              if b < len(engine.hub_uncond) and engine.hub_uncond[b]]
+    if uncond:
+        plan = seg.plan_from_parts([sizes[b] for b in uncond],
+                                   [widths[b] for b in uncond],
+                                   [engine.planes[b] for b in uncond])
+        want = sum(sizes[b] * widths[b] for b in uncond)
+        assert seg.plan_size(plan) == want, (seg.plan_size(plan), want)
+        out["hub_uncond"] = want
+    for s_i, ranges in enumerate(engine.stage_ranges):
+        if not ranges:
+            continue
+        plan = seg.plan_from_ranges(ranges)
+        want = sum((r1 - r0) * w for r0, r1, w, _pl in ranges)
+        assert seg.plan_size(plan) == want, (seg.plan_size(plan), want)
+        out[f"stage_{s_i}"] = want
+    return out
 
 
 @dataclass
@@ -324,6 +414,8 @@ def _main(argv=None) -> int:
         "over_floor": round(price.over_floor(), 3),
         "terms": price.terms,
         "row_gathers": price.row_gathers,
+        "gather_calls": price.calls_summary(),
+        "volume_invariant": bool(check_volume_invariance(eng)),
         "attempt_seconds_bracket": pred,
         "complexity": program_complexity(eng),
         "edge_tail": {

@@ -202,3 +202,51 @@ def test_50k_scale_contract_on_former_violators():
         assert b.minimal_colors == ref_colors, (seed, b.minimal_colors)
         assert a.minimal_colors - b.minimal_colors <= 1, \
             (seed, a.minimal_colors, b.minimal_colors)
+
+
+def test_greedy_native_matches_python_bit_for_bit():
+    # ADVICE r5 #1: the native C++ greedy walk and the Python form claim
+    # bit-identity ("same Python-computed order") — pin it on real draws
+    import dgc_tpu.ops.reduce_colors as rc
+    from dgc_tpu.native.bindings import native_available
+
+    if not native_available():
+        pytest.skip("native library unavailable")
+    for seed in (0, 1, 2):
+        g = generate_rmat_graph(3000, avg_degree=8.0, seed=seed,
+                                native=False)
+        py = rc._greedy_seq(g.indptr, g.indices, native=False)
+        nat = rc._greedy_seq(g.indptr, g.indices, native=True)
+        assert py is not None and nat is not None
+        assert np.array_equal(py, nat), f"seed {seed}"
+        assert validate_coloring(g.indptr, g.indices, nat).valid
+
+
+def test_last_run_is_thread_local():
+    # ADVICE r5 #3: concurrent post-passes (the supervisor's watchdog
+    # threads) must not interleave their diagnostic records
+    import threading
+
+    import dgc_tpu.ops.reduce_colors as rc
+
+    indptr, indices = _csr([(0, 1), (1, 2)], 3)
+    colors = np.array([0, 1, 2], np.int32)
+    rc.reduce_color_count(indptr, indices, colors, native=False)
+    main_record = dict(rc.last_run)
+    assert main_record  # this thread sees its own record
+
+    seen = {}
+
+    def worker():
+        seen["before"] = dict(rc.last_run)   # fresh thread: empty view
+        rc.reduce_color_count(indptr, indices, colors, native=False)
+        rc.last_run["marker"] = "worker"
+        seen["after"] = dict(rc.last_run)
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen["before"] == {}
+    assert seen["after"].get("marker") == "worker"
+    # the worker's writes never leaked into this thread's record
+    assert dict(rc.last_run) == main_record

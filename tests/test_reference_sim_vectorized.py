@@ -92,3 +92,19 @@ def test_vectorized_is_default_and_faster_path_exists():
     assert eng.impl == "vectorized"
     with pytest.raises(ValueError):
         ReferenceSimEngine(arrays, impl="numba")
+
+
+def test_concat_ranges_rejects_zero_length_rows():
+    # ADVICE r5 #4: a real ValueError, not an assert — ``python -O``
+    # strips asserts and a zero-length row silently corrupts the offsets
+    from dgc_tpu.engine.reference_sim import _concat_ranges
+
+    indptr = np.array([0, 2, 2, 5], np.int64)
+    ids = np.array([0, 1, 2], np.int64)
+    lens = (indptr[ids + 1] - indptr[ids]).astype(np.int64)
+    with pytest.raises(ValueError, match="zero-length"):
+        _concat_ranges(indptr, ids, lens)
+    # the valid subset still works
+    ok = _concat_ranges(indptr, np.array([0, 2], np.int64),
+                        np.array([2, 3], np.int64))
+    assert ok.tolist() == [0, 1, 2, 3, 4]

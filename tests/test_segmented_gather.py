@@ -1,0 +1,217 @@
+"""Segmented-gather plan: construction invariants, bit-parity of the
+fused superstep against the per-range/per-bucket decomposition it
+replaces, volume invariance, and the compile-size regression lock."""
+
+import re
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+import pytest
+
+from dgc_tpu.engine.bucketed import decode_combined, encode_combined
+from dgc_tpu.models.generators import generate_rmat_graph
+from dgc_tpu.ops import segmented_gather as seg
+from dgc_tpu.ops.bitmask import forbidden_planes, num_planes_for
+from dgc_tpu.ops.speculative import speculative_update_mc
+
+
+def test_plan_from_ranges_layout_and_volume():
+    ranges = ((0, 128, 64, num_planes_for(65)),
+              (128, 512, 32, num_planes_for(33)),
+              (512, 1024, 8, num_planes_for(9)))
+    plan = seg.plan_from_ranges(ranges)
+    assert seg.plan_rows(plan) == 1024
+    # volume invariance by construction: the plan moves exactly the
+    # entries the per-range gathers moved
+    assert seg.plan_size(plan) == 128 * 64 + 384 * 32 + 512 * 8
+    offs = [s.flat0 for s in plan]
+    assert offs == [0, 128 * 64, 128 * 64 + 384 * 32]
+    assert seg.plan_collapsible(plan)
+
+
+def test_plan_rejects_gaps_and_degenerate_segments():
+    with pytest.raises(ValueError):
+        seg.plan_from_ranges(((0, 4, 8, 1), (6, 8, 4, 1)))  # row gap
+    with pytest.raises(ValueError):
+        seg.plan_from_parts([4], [0], [1])  # zero width
+
+
+def test_capped_window_plan_not_collapsible():
+    # a capped hub window (32·planes < width+1) must NOT take the
+    # collapsed single-apply path — a padded free bit would un-defer a
+    # saturated capped row
+    plan = seg.plan_from_parts([8, 16], [2048, 16], [32, 1])
+    assert not seg.plan_collapsible(plan)
+
+
+def _random_state(rng, v):
+    # packed states: confirmed (even), fresh (odd), uncolored (−1),
+    # plus the two sentinel slots of the extended layout
+    pk = rng.integers(-1, 12, v).astype(np.int32)
+    return jnp.asarray(np.concatenate([pk, [-1, 0]]).astype(np.int32))
+
+
+@pytest.mark.parametrize("capped", [False, True])
+def test_segmented_update_matches_per_range_loop(capped):
+    # the core bit-parity fact: one fused gather + (collapsed or
+    # per-segment) update == the historical per-range loop, row for row
+    rng = np.random.default_rng(7)
+    v = 512
+    widths = (32, 8) if not capped else (64, 8)
+    planes = tuple(num_planes_for(w + 1) for w in widths)
+    if capped:
+        planes = (1, planes[1])  # 32 colors < 64+1: capped window
+    sizes = (24, 40)
+    pe = _random_state(rng, v)
+    tabs, pk_parts = [], []
+    row0 = 0
+    for sz, w in zip(sizes, widths):
+        nb = rng.integers(0, v + 1, (sz, w)).astype(np.int32)  # v = pad
+        beats = rng.integers(0, 2, (sz, w)).astype(bool)
+        tabs.append(jnp.asarray(encode_combined(nb, beats)))
+        pk_parts.append(pe[row0: row0 + sz])
+        row0 += sz
+    plan = seg.plan_from_parts(sizes, widths, planes)
+    assert seg.plan_collapsible(plan) != capped
+    seg_flat = seg.flatten_parts(tabs, plan)
+    pk_rows = jnp.concatenate(pk_parts)
+    k = jnp.int32(9)
+
+    got = seg.segmented_update(pe, seg_flat, plan, pk_rows, k,
+                               decode_combined)
+
+    # reference: the pre-segmentation per-part loop
+    new_parts, fails, acts, mcs = [], [], [], []
+    for tb, p_b, pk_b, w in zip(tabs, planes, pk_parts, widths):
+        nb, beats = decode_combined(tb)
+        np_ = pe[nb]
+        new_b, fail_m, act_m, mc_b = speculative_update_mc(
+            pk_b, np_, beats, k, p_b)
+        fv = seg.fail_gate(w, p_b, k).astype(jnp.int32)
+        new_parts.append(new_b)
+        fails.append(jnp.sum(fail_m.astype(jnp.int32)) * fv)
+        acts.append(jnp.sum(act_m.astype(jnp.int32)))
+        mcs.append(mc_b)
+    want_new = np.asarray(jnp.concatenate(new_parts))
+    assert np.array_equal(np.asarray(got[0]), want_new)
+    assert int(got[1]) == int(sum(fails))
+    assert int(got[2]) == int(sum(acts))
+    assert int(got[3]) == int(jnp.max(jnp.stack(mcs)))
+
+
+def test_segmented_update_parts_matches_loop():
+    rng = np.random.default_rng(3)
+    v = 256
+    sizes, widths = (16, 32), (128, 4)
+    planes = (2, 1)  # first segment capped (32·2 < 129): gate applies
+    pe = _random_state(rng, v)
+    tabs = []
+    row0 = 0
+    pk_parts = []
+    for sz, w in zip(sizes, widths):
+        nb = rng.integers(0, v + 1, (sz, w)).astype(np.int32)
+        beats = rng.integers(0, 2, (sz, w)).astype(bool)
+        tabs.append(jnp.asarray(encode_combined(nb, beats)))
+        pk_parts.append(pe[row0: row0 + sz])
+        row0 += sz
+    plan = seg.plan_from_parts(sizes, widths, planes)
+    seg_flat = seg.flatten_parts(tabs, plan)
+    pk_rows = jnp.concatenate(pk_parts)
+    for k in (3, 40, 200):
+        parts = seg.segmented_update_parts(
+            pe, seg_flat, plan, pk_rows, jnp.int32(k), decode_combined)
+        for (tb, p_b, pk_b, w, got) in zip(tabs, planes, pk_parts, widths,
+                                           parts):
+            nb, beats = decode_combined(tb)
+            new_b, fail_m, act_m, mc_b = speculative_update_mc(
+                pk_b, pe[nb], beats, jnp.int32(k), p_b)
+            fv = seg.fail_gate(w, p_b, jnp.int32(k)).astype(jnp.int32)
+            assert np.array_equal(np.asarray(got[0]), np.asarray(new_b))
+            assert int(got[1]) == int(jnp.sum(fail_m.astype(jnp.int32)) * fv)
+            assert int(got[2]) == int(jnp.sum(act_m.astype(jnp.int32)))
+            assert int(got[3]) == int(mc_b)
+
+
+def test_flatten_rows_clips_to_segment_widths():
+    comb = jnp.arange(6 * 8, dtype=jnp.int32).reshape(6, 8)
+    plan = seg.plan_from_ranges(((0, 2, 8, 1), (2, 6, 4, 1)))
+    flat = np.asarray(seg.flatten_rows(comb, plan))
+    want = np.concatenate([np.arange(16),  # rows 0-1 full width
+                           np.asarray(comb)[2:, :4].reshape(-1)])
+    assert np.array_equal(flat, want)
+
+
+def test_forbidden_planes_vectorized_matches_unrolled():
+    # the plane-axis-vectorized OR-reduce (the compile-size lever) is the
+    # same uint32 reduction as the historical per-plane loop
+    rng = np.random.default_rng(0)
+    nc = jnp.asarray(rng.integers(-2, 300, (50, 33)).astype(np.int32))
+    for p in (1, 2, 10, 32):
+        assert np.array_equal(np.asarray(forbidden_planes(nc, p)),
+                              np.asarray(forbidden_planes(nc, p,
+                                                          unrolled=True)))
+
+
+def test_engine_volume_invariance_and_calls():
+    # the model-side acceptance facts on a real heavy-tail config: the
+    # segmented plans move exactly the volume the decomposed schedule
+    # moved, and the per-superstep gather-call count collapses
+    from dgc_tpu.engine.compact import CompactFrontierEngine
+    from dgc_tpu.utils.schedule_model import (check_volume_invariance,
+                                              price_schedule)
+    from dgc_tpu.utils.trajectory import record_trajectory
+
+    g = generate_rmat_graph(20_000, avg_degree=16.0, seed=0)
+    eng = CompactFrontierEngine(g)
+    assert eng.hub_buckets > 0 and len(eng.stages) > 1
+    vols = check_volume_invariance(eng)   # raises on any mismatch
+    assert "full_flat" in vols
+    traj = record_trajectory(g)
+    price = price_schedule(eng, traj)
+    s = price.calls_summary()
+    # hub-light config (every hub bucket unconditioned): the whole
+    # superstep folds to flat + uncond = 2 gathers
+    if not any(cfg for cfg in eng.hub_prune):
+        assert s["per_step_mean_fused"] <= 2.5
+        assert s["reduction"] >= 5.0
+    else:  # conditioned ladders keep their per-bucket gathers
+        assert s["reduction"] >= 1.8
+    # volume is schedule-identical by construction: per_step totals are
+    # unchanged by the fold, so the priced total must match the terms sum
+    assert price.total == sum(price.per_step)
+
+
+@pytest.mark.slow
+def test_hlo_opcount_regression_large():
+    # larger proxy of the compile-size lock below (kept out of tier-1)
+    _assert_hlo_budget(60_000, max_ops=11_000, max_gathers=90)
+
+
+def _assert_hlo_budget(v, max_ops, max_gathers):
+    from dgc_tpu.engine.compact import (CompactFrontierEngine,
+                                        _attempt_kernel_staged)
+
+    g = generate_rmat_graph(v, avg_degree=16.0, seed=0)
+    eng = CompactFrontierEngine(g)
+    assert eng.hub_buckets > 0
+    low = _attempt_kernel_staged.lower(
+        eng.combined_buckets, eng.flat_ext, eng.degrees, g.max_degree + 1,
+        **eng._traj_kw(), **eng._kernel_kw())
+    txt = low.as_text()
+    ops = len(re.findall(r"^\s+%?\w[\w.-]* = ", txt, re.M))
+    gathers = len(re.findall(r"stablehlo\.(?:dynamic_)?gather|\"gather",
+                             txt))
+    assert ops <= max_ops, f"lowered op count regressed: {ops} > {max_ops}"
+    assert gathers <= max_gathers, (
+        f"lowered gather count regressed: {gathers} > {max_gathers}")
+
+
+def test_hlo_opcount_regression():
+    # locks the segmented-plan compile-size win (tier-1, CPU lowering
+    # only): the pre-PR decomposition lowered 12754 ops / 160 gathers at
+    # this exact config (PERF.md "Segmented-gather plan"); the plan +
+    # vectorized plane reduce land at 5385 / 54. Budgets sit ~25% above
+    # the measured post-PR counts and well under half the pre-PR counts,
+    # so any drift back toward per-range/per-bucket lowering fails here.
+    _assert_hlo_budget(20_000, max_ops=6_700, max_gathers=80)

@@ -29,6 +29,18 @@ if [ "$battery_rc" -ne 2 ]; then
     --logdir /tmp/dgc_trace_r4 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> trace_attr_r4.jsonl || true
 
+  # segmented-gather plan rate measurement (PR 3, queued while the tunnel
+  # was down): the rate_probe run above already carries the A/B pair
+  # (loop_6range_chain vs loop_segmented_1flat — same volume, 6 dependent
+  # range gathers vs ONE fused gather); this trace attributes the staged
+  # kernel's seg_gather self-time end-to-end on the 1M-RMAT heavy tail.
+  # Expected per PERF.md "Segmented-gather plan": effective rate recovers
+  # from ~16.6M lookups/s toward the 100-140M/s primitive.
+  echo "=== segmented-plan trace (1M RMAT attempt) ===" | tee -a /dev/stderr >/dev/null
+  timeout 5400 python tools/trace_attempt.py --nodes 1000000 --gen rmat \
+    --logdir /tmp/dgc_trace_seg 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> trace_attr_seg.jsonl || true
+
   echo "=== cold compile, unified pipeline 1M-RMAT ===" | tee -a /dev/stderr >/dev/null
   # fresh cache dir = genuinely cold compile (removed after); outer
   # timeout sits ABOVE bench.py's 5400s in-process deadline so the

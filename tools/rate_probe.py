@@ -180,6 +180,55 @@ def main() -> int:
                     rate_M_per_s=round(elems / sec / 1e6, 1),
                     per_iter_us=round(sec / iters * 1e6, 1)))
 
+    # segmented plan A/B at EQUAL volume: the staged superstep's exact
+    # shapes — six width-ranged gathers (the pre-segmentation schedule:
+    # one dependent gather per range) vs ONE flat segmented gather over
+    # the identical index set (ops.segmented_gather). The ratio of these
+    # two cases IS the rate claim of the segmented-plan PR; run on chip
+    # the moment the tunnel returns (tools/evidence_suite.sh queues it).
+    range_shapes = ((512, 40), (1024, 48), (1024, 56), (512, 64),
+                    (512, 128), (512, 256))
+    idx_ranges = [jnp.asarray(rng.integers(0, v, s, dtype=np.int64)
+                              .astype(np.int32)) for s in range_shapes]
+
+    def range_chain(table, iters, *idxs):
+        def body(c):
+            i, acc = c
+            for ix in idxs:   # one gather per width range, dependent
+                acc = acc + jnp.sum(table[(ix + acc % 3) % v])
+            return i + 1, acc
+
+        return jax.lax.while_loop(lambda c: c[0] < iters, body,
+                                  (jnp.int32(0), jnp.int32(0)))[1]
+
+    f = jax.jit(range_chain, static_argnums=1)
+    sec = timed(f, table, iters, *idx_ranges)
+    vol = sum(r * w for r, w in range_shapes)
+    elems = vol * iters
+    out.append(dict(case="loop_6range_chain", iters=iters, total_elems=elems,
+                    seconds=round(sec, 4),
+                    rate_M_per_s=round(elems / sec / 1e6, 1),
+                    per_iter_us=round(sec / iters * 1e6, 1)))
+
+    idx_seg = jnp.concatenate([ix.reshape(-1) for ix in idx_ranges])
+
+    def seg_gather(table, idx, iters):
+        def body(c):
+            i, acc = c
+            with jax.named_scope("seg_gather"):
+                g = table[(idx + acc % v) % v]
+            return i + 1, acc + jnp.sum(g)
+
+        return jax.lax.while_loop(lambda c: c[0] < iters, body,
+                                  (jnp.int32(0), jnp.int32(0)))[1]
+
+    f = jax.jit(seg_gather, static_argnums=2)
+    sec = timed(f, table, idx_seg, iters)
+    out.append(dict(case="loop_segmented_1flat", iters=iters,
+                    total_elems=elems, seconds=round(sec, 4),
+                    rate_M_per_s=round(elems / sec / 1e6, 1),
+                    per_iter_us=round(sec / iters * 1e6, 1)))
+
     # empty loop: pure per-iteration overhead
     def empty(iters):
         return jax.lax.while_loop(lambda c: c[0] < iters,

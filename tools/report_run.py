@@ -83,6 +83,12 @@ def render(doc: dict, width: int = 48) -> str:
             if traj.get("fail") and any(traj["fail"]):
                 add(f"{'':>38}conflict superstep(s): "
                     f"{[i + traj.get('first_step', 0) for i, f in enumerate(traj['fail']) if f]}")
+            gc = [c for c in (traj.get("gather_calls") or []) if c >= 0]
+            if gc:
+                # the segmented-plan schedule metric (obs.kernel col 3):
+                # neighbor-gather calls the kernel issued per superstep
+                add(f"{'':>38}gather calls/superstep: "
+                    f"mean {sum(gc) / len(gc):.1f} max {max(gc)}")
 
     ph = doc.get("phases") or {}
     totals = ph.get("totals") or {}

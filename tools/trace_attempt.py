@@ -22,8 +22,12 @@ the TPU tunnel regardless of JAX_PLATFORMS (see .claude/skills/verify):
         [--seed S] [--logdir DIR] [--top N]
 
 Prints one JSON object: total device time, a category breakdown
-(gather / scatter / while-overhead / collectives / elementwise-fusion /
-copy / other), idle time (trace span − Σop), and the top-N ops.
+(segmented-gather / gather / scatter / while-overhead / collectives /
+elementwise-fusion / copy / other), idle time (trace span − Σop), and the
+top-N ops. ``segmented-gather`` is the fused O(1)-per-superstep gather of
+the segmented plan (``ops.segmented_gather``, named scope ``seg_gather``)
+— its self-time against the residual ``gather`` bucket is the measured
+answer to whether the plan recovered the heavy-tail gather rate.
 """
 
 from __future__ import annotations
@@ -42,6 +46,12 @@ os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
 
 _CATEGORIES = (
     # order matters: first match wins
+    # the segmented plan's fused gathers carry the ``seg_gather`` scope
+    # (ops.segmented_gather.segmented_gather wraps THE gather in
+    # jax.named_scope), so their self-time attributes separately from
+    # residual small gathers — the on-chip measurement of the plan's rate
+    # claim
+    ("segmented-gather", re.compile(r"seg_gather", re.I)),
     ("gather", re.compile(r"gather|dynamic-slice(?!-update)|take", re.I)),
     ("scatter", re.compile(r"scatter|dynamic-update-slice", re.I)),
     ("collective", re.compile(r"all-gather|all-reduce|reduce-scatter|"
@@ -109,7 +119,28 @@ def attribute_xspace(xspace_path: str, top: int = 20) -> dict:
     span_lo, span_hi = None, 0
     for plane in planes:
         meta = plane.event_metadata
+        smeta = plane.stat_metadata
         lines = plane.lines
+
+        def scoped_name(ev, name):
+            """Named-scope attribution: the lowered instruction NAME never
+            carries ``jax.named_scope`` labels — they live in the event's
+            op_name/tf_op stat (and in the event metadata's display name
+            on some backends). The segmented plan wraps its fused gather
+            in ``seg_gather``; prefix the op so the category split sees
+            it."""
+            hay = [meta[ev.metadata_id].display_name]
+            for st in ev.stats:
+                sm = smeta.get(st.metadata_id)
+                if sm is not None and sm.name in (
+                        "tf_op", "op_name", "hlo_op", "long_name"):
+                    hay.append(st.str_value
+                               or (smeta.get(st.ref_value).name
+                                   if st.ref_value else ""))
+            if any(h and "seg_gather" in h for h in hay):
+                return "seg_gather/" + name
+            return name
+
         # TPU device planes carry an explicit "XLA Ops" line; when present
         # it is the only line with real per-op events
         op_lines = [l for l in lines if l.name == "XLA Ops"] or [
@@ -123,7 +154,7 @@ def attribute_xspace(xspace_path: str, top: int = 20) -> dict:
                     continue
                 dur = ev.duration_ps / 1e12
                 t0 = line.timestamp_ns * 1e-9 + ev.offset_ps / 1e12
-                evts.append((t0, dur, name))
+                evts.append((t0, dur, scoped_name(ev, name)))
                 span_lo = t0 if span_lo is None else min(span_lo, t0)
                 span_hi = max(span_hi, t0 + dur)
             _line_self_times(evts, per_op)
