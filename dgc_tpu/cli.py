@@ -152,6 +152,28 @@ def build_parser() -> argparse.ArgumentParser:
              "asymmetric edges) — for huge trusted inputs only; engines "
              "produce garbage, not errors, on malformed graphs",
     )
+    # graph-adaptive schedule tuning (dgc_tpu.tune): every knob is
+    # result-invariant (schedules change, colors don't), so both flags are
+    # pure-perf; with both unset the engines run the exact shipped
+    # schedule (byte-identical lowered kernels)
+    p.add_argument(
+        "--tuned-config", type=str, default=None, metavar="PATH",
+        help="apply a tuned-config artifact (python -m dgc_tpu.tune) to "
+             "the engine's schedule; consumed by ell-compact and "
+             "sharded-bucketed (no-op elsewhere, with a warning); colors "
+             "stay bit-identical to the untuned engine",
+    )
+    p.add_argument(
+        "--auto-tune", action="store_true",
+        help="derive a per-graph schedule at startup from the chip-free "
+             "exact-rule replay (minutes at 1M+; prefer tuning once with "
+             "python -m dgc_tpu.tune and passing --tuned-config)",
+    )
+    p.add_argument(
+        "--auto-tune-out", type=str, default=None, metavar="PATH",
+        help="with --auto-tune: also save the derived config artifact "
+             "for reuse via --tuned-config",
+    )
     p.add_argument(
         "--no-reduce-colors",
         action="store_true",
@@ -162,8 +184,61 @@ def build_parser() -> argparse.ArgumentParser:
     return p
 
 
+# backends whose constructors accept tuned-schedule overrides
+_TUNABLE_BACKENDS = frozenset({"ell-compact", "sharded-bucketed"})
+
+
+def resolve_tuned_config(args, graph: Graph, logger=None, phases=None):
+    """Resolve ``--tuned-config`` / ``--auto-tune`` into a ``TunedConfig``
+    (or None) and record its provenance in the event stream — the run
+    manifest's ``tuning`` slot says exactly which config produced the
+    schedule. Raises ``ValueError`` on a malformed artifact."""
+    if not (args.tuned_config or args.auto_tune):
+        return None
+    import contextlib
+
+    section = (phases.section("host_auto_tune") if phases is not None
+               else contextlib.nullcontext())
+    if args.auto_tune:
+        from dgc_tpu.tune import tune_schedule
+
+        with section:
+            cfg = tune_schedule(graph.arrays)
+        if args.auto_tune_out:
+            cfg.save(args.auto_tune_out)
+        source, path, match = "auto-tune", args.auto_tune_out, True
+    else:
+        from dgc_tpu.tune import load_tuned_config
+
+        cfg = load_tuned_config(args.tuned_config)
+        source, path = "file", args.tuned_config
+        match = cfg.check_graph(graph.arrays, context=args.tuned_config)
+        if cfg.stages is not None:
+            # surface a ladder/graph mismatch HERE (clean rc 2) instead
+            # of as a traceback from deep inside the engine build
+            from dgc_tpu.engine.compact import _check_stage_ladder
+
+            _check_stage_ladder(cfg.stages, graph.arrays.num_vertices)
+    applies = args.backend in _TUNABLE_BACKENDS
+    if not applies:
+        print(f"warning: --backend {args.backend} has no tunable schedule; "
+              f"the tuned config is ignored there (tunable: "
+              f"{', '.join(sorted(_TUNABLE_BACKENDS))})", file=sys.stderr)
+    if logger is not None:
+        logger.event(
+            "tuned_config", source=source, path=path,
+            graph_shape_hash=cfg.graph_shape_hash, hash_match=match,
+            backend_applies=applies,
+            knobs={k: (list(map(list, v)) if k == "stages" else v)
+                   for k, v in cfg.knobs().items()},
+            win_total_pct=cfg.provenance.get("win_total_pct"))
+    return cfg
+
+
 def make_engine(args, graph: Graph, logger=None):
     arrays = graph.arrays
+    tuned = getattr(args, "_tuned_cfg", None)
+    tuned_kw = tuned.engine_kwargs(args.backend) if tuned else {}
     if args.backend in _JAX_BACKENDS:
         # initialize_multihost must precede any backend init
         # (parallel/multihost.py) and is NOT under the watchdog: its
@@ -202,7 +277,7 @@ def make_engine(args, graph: Graph, logger=None):
         return BucketedELLEngine(arrays)
     if args.backend == "ell-compact":
         from dgc_tpu.engine.compact import CompactFrontierEngine
-        return CompactFrontierEngine(arrays)
+        return CompactFrontierEngine(arrays, **tuned_kw)
     if args.backend == "dense":
         from dgc_tpu.engine.dense_engine import DenseEngine
         return DenseEngine(arrays)
@@ -211,7 +286,8 @@ def make_engine(args, graph: Graph, logger=None):
         return ShardedELLEngine(arrays, num_shards=args.shards)
     if args.backend == "sharded-bucketed":
         from dgc_tpu.engine.sharded_bucketed import ShardedBucketedEngine
-        return ShardedBucketedEngine(arrays, num_shards=args.shards)
+        return ShardedBucketedEngine(arrays, num_shards=args.shards,
+                                     **tuned_kw)
     if args.backend == "sharded-ring":
         from dgc_tpu.engine.ring import RingHaloEngine
         return RingHaloEngine(arrays, num_shards=args.shards)
@@ -236,6 +312,10 @@ def main(argv: list[str] | None = None) -> int:
     if args.input is None and (args.node_count is None or args.max_degree is None):
         # mutual-requirement validation (coloring.py:183-184)
         print("Either --input or both --node-count and --max-degree are required", file=sys.stderr)
+        return 2
+    if args.auto_tune and args.tuned_config:
+        print("--auto-tune and --tuned-config are mutually exclusive",
+              file=sys.stderr)
         return 2
 
     logger = RunLogger(jsonl_path=args.log_json)
@@ -299,6 +379,16 @@ def _run(args, logger: RunLogger) -> int:
             if args.output_graph:
                 graph.serialize(args.output_graph)
                 logger.event("graph_saved", path=args.output_graph)
+
+    # tuned schedule resolution (dgc_tpu.tune): BEFORE any engine build so
+    # every rung of a fallback ladder sees the same config; the manifest's
+    # "tuning" slot records the provenance
+    try:
+        args._tuned_cfg = resolve_tuned_config(args, graph, logger=logger,
+                                               phases=phases)
+    except ValueError as e:
+        print(f"Bad tuned config: {e}", file=sys.stderr)
+        return 2
 
     def on_watchdog_abort(diag: str) -> None:
         # fold the abort into the same event stream and flush the partial

@@ -170,7 +170,9 @@ def default_stages(v: int, heavy_tail: bool = False) -> tuple:
     )
 
 
-def stage_slot_ranges(flat_sizes, flat_widths, a_pad: int) -> tuple:
+def stage_slot_ranges(flat_sizes, flat_widths, a_pad: int,
+                      max_ranges: int = 6,
+                      coalesce_pct: int = 10) -> tuple:
     """Static width ranges for a compaction stage's padded slot list.
 
     Slots are filled in degree-descending relabeled order, so the row at
@@ -178,7 +180,15 @@ def stage_slot_ranges(flat_sizes, flat_widths, a_pad: int) -> tuple:
     b−1 — and cum actives through b can never exceed min(cum sizes, A_pad)
     by frontier monotonicity. Returns ``((start, stop, width, planes), …)``
     covering [0, a_pad); trailing slots past the flat region can only hold
-    dummy rows and take the narrowest width."""
+    dummy rows and take the narrowest width. ``max_ranges`` caps the range
+    count per stage and ``coalesce_pct`` is the merge budget below
+    (tunables since the auto-tuner, ``dgc_tpu.tune``; the shipped
+    defaults are the measured round-3 sizing)."""
+    if max_ranges < 1:
+        raise ValueError(f"max_ranges must be >= 1, got {max_ranges}")
+    if not 0 <= coalesce_pct <= 100:
+        raise ValueError(
+            f"coalesce_pct must be in [0, 100], got {coalesce_pct}")
     exact = []
     q = cum = 0
     for sz, w in zip(flat_sizes, flat_widths):
@@ -194,12 +204,13 @@ def stage_slot_ranges(flat_sizes, flat_widths, a_pad: int) -> tuple:
         exact.append((q, a_pad, w))
 
     # coalesce adjacent ranges (taking the wider width) while the volume
-    # overhead stays under 10% — one gather op per range, so dozens of
-    # exact ranges would trade compile time for negligible gather savings;
-    # then force down to ``max_ranges`` (cheapest merges first) so a wide
-    # bucket ladder (RMAT W_flat=256) can't explode the stage body
+    # overhead stays under ``coalesce_pct`` — one gather op per range, so
+    # dozens of exact ranges would trade compile time for negligible
+    # gather savings; then force down to ``max_ranges`` (cheapest merges
+    # first) so a wide bucket ladder (RMAT W_flat=256) can't explode the
+    # stage body
     exact_vol = sum((r1 - r0) * w for r0, r1, w in exact)
-    budget = exact_vol // 10
+    budget = exact_vol * coalesce_pct // 100
     ranges = []
     for r0, r1, w in exact:
         if ranges:
@@ -210,7 +221,6 @@ def stage_slot_ranges(flat_sizes, flat_widths, a_pad: int) -> tuple:
                 ranges[-1] = (p0, r1, pw)
                 continue
         ranges.append((r0, r1, w))
-    max_ranges = 6
     while len(ranges) > max_ranges:
         costs = [(ranges[i][2] - ranges[i + 1][2])
                  * (ranges[i + 1][1] - ranges[i + 1][0])
@@ -284,7 +294,9 @@ HUB_UNCOND_ENTRIES = 1 << 17
 def hub_prune_cfg(rows: int, width: int, u_min: int = 128,
                   u_div: int = 4,
                   uncond_entries: int | None = None,
-                  p2_min: int = 32) -> tuple | None:
+                  p2_min: int = 32,
+                  p_div: int = 2,
+                  p2_div: int = 8) -> tuple | None:
     """Static neighbor-pruning config ``(P, U)`` or ``(P, U, P2)`` for a
     hub bucket, or None.
 
@@ -318,7 +330,19 @@ def hub_prune_cfg(rows: int, width: int, u_min: int = 128,
     most of the tail (the W=1024 core bucket: P=4096 vs live ≤ 512 from
     ~s58 of 108), so the steady-state pruned gather P×U is mostly dummy
     slots; P/8 re-engages the pad at the scale the tail actually runs at.
+
+    ``p_div``/``p2_div`` expose the capture-pad (rows/P) and re-capture
+    (P/P2) divisors for the auto-tuner (``dgc_tpu.tune``); the defaults
+    are the measured round-3 sizing above. Divisors must be positive —
+    structured ``ValueError``, not assert, so malformed tuned configs
+    fail loudly under ``python -O`` too.
     """
+    for name, val in (("u_div", u_div), ("p_div", p_div),
+                      ("p2_div", p2_div)):
+        if not isinstance(val, int) or val < 1:
+            raise ValueError(
+                f"hub prune divisor {name} must be a positive int, "
+                f"got {val!r}")
     if rows * width <= (HUB_UNCOND_ENTRIES if uncond_entries is None
                         else uncond_entries):
         return None
@@ -328,9 +352,124 @@ def hub_prune_cfg(rows: int, width: int, u_min: int = 128,
     # clamp to the bucket's rows: a pad above them would make the rebase
     # branch gather MORE than the full branch (dummy slots re-gather
     # row 0), so pad ≤ rows always (pads need not be powers of two)
-    p = min(_pow2_ceil(max(rows // 2, 32)), rows)
-    p2 = min(_pow2_ceil(max(p // 8, p2_min)), rows)
+    p = min(_pow2_ceil(max(rows // p_div, 32)), rows)
+    p2 = min(_pow2_ceil(max(p // p2_div, p2_min)), rows)
     return (p, u, p2) if p2 < p else (p, u)
+
+
+DEFAULT_FLAT_CAP = 256
+DEFAULT_FLAT_BUDGET = 1 << 29  # table entries (×4 B = 2 GiB)
+
+
+def derive_schedule(sizes, widths, v: int, max_degree: int, *,
+                    stages: tuple | None = None,
+                    flat_cap: int | None = None,
+                    flat_budget: int | None = None,
+                    max_ranges: int = 6,
+                    range_coalesce_pct: int = 10,
+                    hub_uncond_entries: int | None = None,
+                    prune_u_min: int = 128, prune_u_div: int = 4,
+                    prune_p_div: int = 2,
+                    prune_p2_min: int = 32, prune_p2_div: int = 8,
+                    hub_prune_overrides: dict | None = None) -> dict:
+    """Pure derivation of the staged engine's static schedule from the
+    bucket layout (``sizes``/``widths`` in degree-descending order) and
+    the schedule knobs: stage ladder, hub/flat split, per-hub-bucket
+    prune/uncond configs, and per-stage width ranges.
+
+    ``hub_prune_overrides`` maps a hub-bucket index to per-bucket prune
+    knobs (subset of ``u_min``/``u_div``/``p_div``/``p2_min``/``p2_div``)
+    merged over the global scalars for that bucket — the auto-tuner's
+    finest lever: conditioned buckets differ 100× in rows/width, so one
+    scalar per knob leaves priced volume on the table (PERF.md
+    "Auto-tuned schedules").
+
+    The SINGLE source of the knob→schedule mapping, shared by
+    ``CompactFrontierEngine.__init__`` and the auto-tuner's chip-free
+    candidate pricing (``dgc_tpu.tune.search``) — so a candidate priced
+    by ``utils.schedule_model`` is exactly the schedule the engine would
+    execute under the same knobs. All knob validation lives here
+    (structured ``ValueError``s, ``python -O``-safe): tuned-config
+    artifacts feed arbitrary values through this path.
+
+    Returns ``dict(stages, row0s, hub_buckets, hub_prune, hub_uncond,
+    stage_ranges)``; ``stage_ranges`` is ``()`` when the ladder has no
+    compaction stage (mirroring the engine's ladder-free early-out)."""
+    cap = DEFAULT_FLAT_CAP if flat_cap is None else flat_cap
+    budget = DEFAULT_FLAT_BUDGET if flat_budget is None else flat_budget
+    uncond = (HUB_UNCOND_ENTRIES if hub_uncond_entries is None
+              else hub_uncond_entries)
+    for name, val, lo in (("flat_cap", cap, 1), ("flat_budget", budget, 1),
+                          ("max_ranges", max_ranges, 1),
+                          ("hub_uncond_entries", uncond, 0),
+                          ("prune_u_min", prune_u_min, 1),
+                          ("prune_p2_min", prune_p2_min, 1)):
+        if not isinstance(val, int) or isinstance(val, bool) or val < lo:
+            raise ValueError(f"{name} must be an int >= {lo}, got {val!r}")
+    if not isinstance(range_coalesce_pct, int) \
+            or isinstance(range_coalesce_pct, bool) \
+            or not 0 <= range_coalesce_pct <= 100:
+        raise ValueError(
+            f"range_coalesce_pct must be an int in [0, 100], "
+            f"got {range_coalesce_pct!r}")
+    if stages is None:
+        stages = default_stages(v, heavy_tail=max_degree > cap)
+    _check_stage_ladder(stages, v)
+
+    row0s = tuple(int(x) for x in
+                  np.concatenate([[0], np.cumsum(sizes[:-1])]))
+    # hub/flat split along the (width-descending) bucket order
+    hub = 0
+    while hub < len(widths):
+        w_flat = widths[hub]
+        rows = v - row0s[hub]
+        if w_flat <= cap and rows * w_flat <= budget:
+            break
+        hub += 1
+    overrides = hub_prune_overrides or {}
+    _OVR_KEYS = {"u_min", "u_div", "p_div", "p2_min", "p2_div"}
+    for bi, ovr in overrides.items():
+        if not isinstance(bi, int) or isinstance(bi, bool) or bi < 0:
+            raise ValueError(
+                f"hub_prune_overrides key must be a bucket index >= 0, "
+                f"got {bi!r}")
+        if not isinstance(ovr, dict) or set(ovr) - _OVR_KEYS:
+            raise ValueError(
+                f"hub_prune_overrides[{bi}] must be a dict with keys from "
+                f"{sorted(_OVR_KEYS)}, got {ovr!r}")
+        for k2, v2 in ovr.items():
+            if not isinstance(v2, int) or isinstance(v2, bool) or v2 < 1:
+                raise ValueError(
+                    f"hub_prune_overrides[{bi}][{k2!r}] must be an int "
+                    f">= 1, got {v2!r}")
+
+    def _prune_for(bi: int):
+        kw = dict(u_min=prune_u_min, u_div=prune_u_div,
+                  p2_min=prune_p2_min, p_div=prune_p_div,
+                  p2_div=prune_p2_div)
+        kw.update(overrides.get(bi, {}))
+        return hub_prune_cfg(sizes[bi], widths[bi],
+                             uncond_entries=uncond, **kw)
+
+    hub_prune = tuple(_prune_for(bi) for bi in range(hub))
+    hub_uncond = tuple(
+        sizes[bi] * widths[bi] <= uncond for bi in range(hub)
+    )
+    if all(scale is None for scale, _ in stages):
+        stage_ranges = ()
+    else:
+        flat_sizes = sizes[hub:]
+        flat_widths = widths[hub:]
+        stage_ranges = tuple(
+            None if scale is None else
+            stage_slot_ranges(flat_sizes, flat_widths, _pow2_ceil(scale),
+                              max_ranges=max_ranges,
+                              coalesce_pct=range_coalesce_pct)
+            for scale, _ in stages
+        )
+    return dict(stages=stages, row0s=row0s, hub_buckets=hub,
+                hub_prune=hub_prune, hub_uncond=hub_uncond,
+                stage_ranges=stage_ranges)
 
 
 def _fresh_prune(buckets, hub_buckets: int, planes: tuple, hub_prune: tuple,
@@ -853,12 +992,42 @@ def _check_stage_ladder(stages: tuple, v: int) -> None:
     routing (max stage whose entry bound covers the frontier) is only
     equivalent to the sequential per-stage loops under that shape. Checked
     here as well as in the engine constructor because both pipelines are
-    callable directly (tests do)."""
+    callable directly (tests do).
+
+    All failures are structured ``ValueError``s (never asserts — the
+    checks must survive ``python -O``, same contract as
+    ``reference_sim._concat_ranges``): tuned configs (``dgc_tpu.tune``)
+    feed arbitrary user-supplied ladders through here, so malformed input
+    — rungs above V, non-positive rungs, negative thresholds, a
+    non-monotone ladder — must fail loudly, not silently mis-schedule."""
+    if not stages:
+        raise ValueError("stage ladder is empty; need at least one stage")
     bound = v
     for scale, thresh in stages:
-        if scale is not None and scale < min(bound, v):
+        if scale is not None:
+            if not isinstance(scale, int) or isinstance(scale, bool):
+                raise ValueError(
+                    f"stage scale must be int or None, got {scale!r}; "
+                    f"stages={stages}")
+            if scale < 1:
+                raise ValueError(
+                    f"stage scale must be >= 1, got {scale}; "
+                    f"stages={stages}")
+            if scale > v:
+                raise ValueError(
+                    f"stage scale {scale} > num_vertices {v} (a rung "
+                    f"above V pads past the graph); stages={stages}")
+            if scale < min(bound, v):
+                raise ValueError(
+                    f"stage scale {scale} < possible frontier "
+                    f"{min(bound, v)}; stages={stages}")
+        if not isinstance(thresh, int) or isinstance(thresh, bool):
             raise ValueError(
-                f"stage scale {scale} < possible frontier {min(bound, v)}; "
+                f"stage threshold must be int, got {thresh!r}; "
+                f"stages={stages}")
+        if thresh < 0:
+            raise ValueError(
+                f"stage threshold must be >= 0, got {thresh}; "
                 f"stages={stages}")
         if thresh > bound:
             raise ValueError(
@@ -1393,8 +1562,8 @@ class CompactFrontierEngine(BucketedELLEngine):
     # in the hub runs as a cond'd full-bucket update while its live count
     # exceeds its pads — in the flat region its rows compact away with
     # the frontier instead.
-    FLAT_CAP = 256
-    FLAT_BUDGET = 1 << 29  # table entries (×4 B = 2 GiB)
+    FLAT_CAP = DEFAULT_FLAT_CAP
+    FLAT_BUDGET = DEFAULT_FLAT_BUDGET
 
     def __init__(self, arrays: GraphArrays, max_steps: int | None = None,
                  min_width: int = 4, stages: tuple | None = None,
@@ -1402,54 +1571,49 @@ class CompactFrontierEngine(BucketedELLEngine):
                  flat_cap: int | None = None,
                  prune_u_min: int = 128, prune_u_div: int = 4,
                  prune_p2_min: int = 32,
-                 hub_uncond_entries: int | None = None):
+                 hub_uncond_entries: int | None = None,
+                 max_ranges: int = 6, range_coalesce_pct: int = 10,
+                 prune_p_div: int = 2, prune_p2_div: int = 8,
+                 hub_prune_overrides: dict | None = None):
         kw = {} if max_window_planes is None else {"max_window_planes": max_window_planes}
         super().__init__(arrays, max_steps=max_steps, min_width=min_width, **kw)
         # in-kernel telemetry switch (obs subsystem): compiles a recording
         # variant of the kernels whose carry threads the trajectory buffer
         self.record_trajectory = False
         v = arrays.num_vertices
-        if stages is None:
-            cap = flat_cap if flat_cap is not None else self.FLAT_CAP
-            stages = default_stages(v, heavy_tail=arrays.max_degree > cap)
-        _check_stage_ladder(stages, v)
-        self.stages = stages
 
         sizes = [cb.shape[0] for cb in self.combined_buckets]
         widths = [cb.shape[1] for cb in self.combined_buckets]
-        self.row0s = tuple(int(x) for x in
-                           np.concatenate([[0], np.cumsum(sizes[:-1])]))
-        deg_rel = np.asarray(self.degrees)
-
-        # hub/flat split along the (width-descending) bucket order
-        cap = flat_cap if flat_cap is not None else self.FLAT_CAP
-        hub = 0
-        while hub < len(widths):
-            w_flat = widths[hub]
-            rows = v - self.row0s[hub]
-            if w_flat <= cap and rows * w_flat <= self.FLAT_BUDGET:
-                break
-            hub += 1
+        # knob → schedule mapping: single-sourced with the auto-tuner's
+        # candidate pricing (``derive_schedule`` docstring). The schedule
+        # knobs (ladder, hub split, prune divisors, uncond threshold,
+        # range cap) are all result-invariant: they reschedule the same
+        # exact update rule, so any values that pass validation produce
+        # colors bit-identical to ``BucketedELLEngine``.
+        sched = derive_schedule(
+            sizes, widths, v, int(arrays.max_degree),
+            stages=stages,
+            flat_cap=flat_cap if flat_cap is not None else self.FLAT_CAP,
+            flat_budget=self.FLAT_BUDGET, max_ranges=max_ranges,
+            range_coalesce_pct=range_coalesce_pct,
+            hub_uncond_entries=hub_uncond_entries,
+            prune_u_min=prune_u_min, prune_u_div=prune_u_div,
+            prune_p_div=prune_p_div, prune_p2_min=prune_p2_min,
+            prune_p2_div=prune_p2_div,
+            hub_prune_overrides=hub_prune_overrides)
+        self.stages = sched["stages"]
+        self.row0s = sched["row0s"]
+        hub = sched["hub_buckets"]
         self.hub_buckets = hub
         self.flat_row0 = self.row0s[hub] if hub < len(widths) else v
         # per-hub-bucket neighbor-pruning config (the heavy-tail long-tail
         # lever: tail supersteps gather the live core's edges, not the
-        # hub's full neighborhoods)
-        uncond_entries = (HUB_UNCOND_ENTRIES if hub_uncond_entries is None
-                          else hub_uncond_entries)
-        self.hub_prune = tuple(
-            hub_prune_cfg(sizes[bi], widths[bi],
-                          u_min=prune_u_min, u_div=prune_u_div,
-                          uncond_entries=uncond_entries,
-                          p2_min=prune_p2_min)
-            for bi in range(hub)
-        )
-        # small hub buckets run with no control flow at all (a device-side
-        # cond costs ~7-30 ms/execution, more than these buckets' gathers)
-        self.hub_uncond = tuple(
-            sizes[bi] * widths[bi] <= uncond_entries
-            for bi in range(hub)
-        )
+        # hub's full neighborhoods); small hub buckets run with no control
+        # flow at all (a device-side cond costs ~7-30 ms/execution, more
+        # than these buckets' gathers)
+        self.hub_prune = sched["hub_prune"]
+        self.hub_uncond = sched["hub_uncond"]
+        deg_rel = np.asarray(self.degrees)
 
         # live-count layout matching _hybrid_superstep: per-hub-bucket
         # actives, then one flat-region total
@@ -1462,10 +1626,10 @@ class CompactFrontierEngine(BucketedELLEngine):
                 int(np.count_nonzero(deg_rel[self.flat_row0:] > 0)))
         self.init_bucket_active = tuple(init_active)
 
+        self.stage_ranges = sched["stage_ranges"]
         if all(scale is None for scale, _ in self.stages):
             self.flat_ext = None
             self.flat_planes = 0
-            self.stage_ranges = ()
             return
         # flat combined table over the flat region (relabeled CSR suffix);
         # shares the buckets' table-build primitive (native one-pass C++
@@ -1479,14 +1643,6 @@ class CompactFrontierEngine(BucketedELLEngine):
             np.concatenate([combined, np.full((1, w_flat), v, np.int32)])
         )
         self.flat_planes = num_planes_for(w_flat + 1)
-        # static width ranges per compaction stage (module docstring §2)
-        flat_sizes = sizes[hub:]
-        flat_widths = widths[hub:]
-        self.stage_ranges = tuple(
-            None if scale is None else
-            stage_slot_ranges(flat_sizes, flat_widths, _pow2_ceil(scale))
-            for scale, _ in self.stages
-        )
 
     def _kernel_kw(self):
         return dict(planes=self.planes, row0s=self.row0s,

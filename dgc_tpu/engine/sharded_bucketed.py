@@ -152,7 +152,8 @@ def build_sharded_buckets(arrays: GraphArrays, n: int,
 def shard_prune_cfg(slice_rows: int, width: int,
                     uncond_entries: int = 1 << 17,
                     u_min: int = 128, u_div: int = 4,
-                    p2_min: int = 32) -> tuple | None:
+                    p2_min: int = 32, p_div: int = 2,
+                    p2_div: int = 8) -> tuple | None:
     """Neighbor-pruning config ``(P, U)`` / ``(P, U, P2)`` for one shard's
     bucket slice — exactly the single-device hub rule
     (``engine.compact.hub_prune_cfg``) applied to the slice, including its
@@ -161,9 +162,11 @@ def shard_prune_cfg(slice_rows: int, width: int,
     then [P, U] thereafter) and the tier-2 re-capture pad ``P2`` (the slot
     list row-shrinks once the slice's live count fits it). Monotone
     confirmation is a global property, so the exactness argument holds per
-    shard unchanged."""
+    shard unchanged. ``p_div``/``p2_div`` thread the tuned capture/prune
+    divisors (``dgc_tpu.tune``) through to the shared rule."""
     return hub_prune_cfg(slice_rows, width, u_min=u_min, u_div=u_div,
-                         uncond_entries=uncond_entries, p2_min=p2_min)
+                         uncond_entries=uncond_entries, p2_min=p2_min,
+                         p_div=p_div, p2_div=p2_div)
 
 
 def _fresh_shard_prune(tables_l, planes: tuple, prune_cfg: tuple, v_final: int):
@@ -454,7 +457,8 @@ class ShardedBucketedEngine:
                  max_window_planes: int = MAX_WINDOW_PLANES,
                  uncond_entries: int = 1 << 17,
                  prune_u_min: int = 128, prune_u_div: int = 4,
-                 prune_p2_min: int = 32):
+                 prune_p2_min: int = 32,
+                 prune_p_div: int = 2, prune_p2_div: int = 8):
         self.arrays = arrays
         self.mesh = mesh if mesh is not None else make_mesh(num_shards)
         n = self.mesh.shape[VERTEX_AXIS]
@@ -474,7 +478,8 @@ class ShardedBucketedEngine:
         self.prune_cfg = tuple(
             shard_prune_cfg(s, t.shape[1], uncond_entries=uncond_entries,
                             u_min=prune_u_min, u_div=prune_u_div,
-                            p2_min=prune_p2_min)
+                            p2_min=prune_p2_min, p_div=prune_p_div,
+                            p2_div=prune_p2_div)
             for s, t in zip(lay.slice_sizes, lay.tables)
         )
         rows2d = NamedSharding(self.mesh, P(VERTEX_AXIS, None))

@@ -26,6 +26,7 @@ _INFO_EVENTS = {
     "devices": "devices",
     "distributed": "distributed",
     "sweep_start": "sweep",
+    "tuned_config": "tuning",
 }
 
 
@@ -39,6 +40,7 @@ class RunManifest:
             "devices": None,
             "distributed": None,
             "sweep": None,
+            "tuning": None,
             "attempts": [],
             "phases": None,
             "device_memory": [],

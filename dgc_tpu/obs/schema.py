@@ -33,6 +33,12 @@ EVENT_SCHEMAS: dict = {
     "sweep_start": (
         {"backend": "str", "initial_k": "int", "strict_decrement": "bool"},
         {}),
+    # schedule auto-tuner (dgc_tpu.tune): which tuned config produced the
+    # engine schedule — lands in the manifest's "tuning" slot
+    "tuned_config": (
+        {"source": "str", "knobs": "dict", "backend_applies": "bool"},
+        {"path": ("str", "null"), "graph_shape_hash": ("str", "null"),
+         "hash_match": "bool", "win_total_pct": (*NUM, "null")}),
     "attempt": (
         {"k": "int", "status": "str", "supersteps": "int",
          "colors_used": ("int", "null")},

@@ -1,0 +1,40 @@
+"""Graph-adaptive schedule auto-tuner.
+
+The staged engines' schedule knobs — stage-ladder rungs, ranges-per-stage
+cap, the hub unconditioned threshold, capture/prune divisors, the
+flat/hub split cap — shipped as one-size-per-family constants measured on
+the round-3 bench graphs. The 1M-RMAT audit (PERF.md) prices those
+static configs at 1.65-1.85× the Σdeg(active) gather floor: the residual
+is per-GRAPH, not per-family. This package derives a per-graph
+configuration instead, searched chip-free against
+``utils.schedule_model.price_schedule`` (the exact-rule replay pricing —
+gather volume as the objective, ``program_complexity`` as the
+compile-size guard), and emits it as a versioned JSON artifact keyed by
+a graph-shape hash.
+
+Every knob is result-invariant by construction (the schedule changes the
+computation layout, never the update rule), so tuning is pure perf: a
+tuned engine's colors and superstep counts stay bit-identical to
+``ell-bucketed`` (``tools/bit_identity_ensemble.py --tuned-config``).
+
+Entry points:
+
+- ``python -m dgc_tpu.tune`` — tune a graph, write the artifact;
+- ``dgc-tpu --auto-tune`` / ``--tuned-config PATH`` — apply at run time;
+- :func:`tune_schedule` / :func:`tune_from_manifest` — library API
+  (build-time degree-profile replay, or recorded in-kernel trajectory
+  telemetry from a prior run's manifest).
+"""
+
+from dgc_tpu.tune.config import (  # noqa: F401
+    TUNED_CONFIG_VERSION,
+    TunedConfig,
+    graph_shape_hash,
+    load_tuned_config,
+)
+from dgc_tpu.tune.search import (  # noqa: F401
+    ScheduleView,
+    trajectory_from_manifest,
+    tune_from_manifest,
+    tune_schedule,
+)

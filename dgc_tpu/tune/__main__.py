@@ -1,0 +1,61 @@
+"""``python -m dgc_tpu.tune`` — derive + save a tuned-config artifact.
+
+Chip-free: the search runs entirely on the exact-rule NumPy replay (or a
+prior run's recorded telemetry via ``--from-manifest``), so schedules
+can be tuned while no accelerator is reachable. Same graph-source flags
+as the trajectory/schedule-model CLIs::
+
+    python -m dgc_tpu.tune --node-count 200000 --gen-method rmat \
+        --max-degree 16 --out tuned_200k.json
+    python -m dgc_tpu.tune --input g.json --from-manifest run.json \
+        --out tuned.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+from dgc_tpu.tune.config import TunedConfig
+from dgc_tpu.tune.search import tune_from_manifest, tune_schedule
+from dgc_tpu.utils.trajectory import add_graph_args, load_graph_args
+
+
+def _main(argv=None) -> int:
+    ap = argparse.ArgumentParser(prog="dgc-tpu-tune", description=__doc__)
+    add_graph_args(ap)
+    ap.add_argument("--out", type=str, default=None,
+                    help="write the tuned-config JSON artifact here "
+                         "(omit to just print the pricing summary)")
+    ap.add_argument("--from-manifest", type=str, default=None,
+                    help="derive from a prior run's manifest telemetry "
+                         "(--run-manifest with trajectories) instead of "
+                         "the build-time exact-rule replay")
+    ap.add_argument("--max-rungs", type=int, default=10,
+                    help="stage-ladder depth cap for the search")
+    args = ap.parse_args(argv)
+    arrays = load_graph_args(ap, args)
+
+    try:
+        if args.from_manifest:
+            cfg = tune_from_manifest(arrays, args.from_manifest,
+                                     max_rungs=args.max_rungs)
+        else:
+            cfg = tune_schedule(arrays, max_rungs=args.max_rungs)
+    except ValueError as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        cfg.save(args.out)
+        print(f"# tuned config written to {args.out}", file=sys.stderr)
+    print(json.dumps(cfg.to_dict()))
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(_main())
+
+
+def main() -> int:  # console-script entry (pyproject)
+    return _main()

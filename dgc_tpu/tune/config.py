@@ -1,0 +1,297 @@
+"""Tuned-config artifact: versioned JSON, strict loader, engine mapping.
+
+A ``TunedConfig`` holds exactly the schedule knobs the staged engines
+accept — every field optional (``None`` = keep the engine's shipped
+default, so the empty config is the exact current schedule) — plus
+provenance (how it was derived, what it priced at) and a
+``graph_shape_hash`` keying it to the graph it was tuned for. Applying a
+config to a different graph is legal (the knobs are result-invariant on
+ANY graph that passes ladder validation) but loses the modeled win, so
+the hash mismatch warns instead of failing.
+
+Loader contract (the hardening satellite): unknown keys, version
+mismatch, malformed stages, and non-positive divisors raise structured
+``ValueError``s — never asserts (``python -O`` safety, same contract as
+``reference_sim._concat_ranges`` and ``engine.compact._check_stage_ladder``).
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import warnings
+from dataclasses import dataclass, field
+from pathlib import Path
+
+TUNED_CONFIG_VERSION = 1
+
+# knob name -> CompactFrontierEngine constructor kwarg (identity today;
+# the level of indirection is the contract that the artifact schema does
+# not silently track engine-internal renames)
+_COMPACT_KWARGS = {
+    "stages": "stages",
+    "flat_cap": "flat_cap",
+    "max_ranges": "max_ranges",
+    "range_coalesce_pct": "range_coalesce_pct",
+    "hub_uncond_entries": "hub_uncond_entries",
+    "prune_u_min": "prune_u_min",
+    "prune_u_div": "prune_u_div",
+    "prune_p_div": "prune_p_div",
+    "prune_p2_min": "prune_p2_min",
+    "prune_p2_div": "prune_p2_div",
+    "hub_prune_overrides": "hub_prune_overrides",
+}
+
+# knob name -> ShardedBucketedEngine kwarg; the sharded engine has no
+# flat region (no ladder/ranges) — only the hub-rule knobs apply there
+_SHARDED_KWARGS = {
+    "hub_uncond_entries": "uncond_entries",
+    "prune_u_min": "prune_u_min",
+    "prune_u_div": "prune_u_div",
+    "prune_p_div": "prune_p_div",
+    "prune_p2_min": "prune_p2_min",
+    "prune_p2_div": "prune_p2_div",
+}
+
+_INT_KNOBS = ("flat_cap", "max_ranges", "range_coalesce_pct",
+              "hub_uncond_entries",
+              "prune_u_min", "prune_u_div", "prune_p_div",
+              "prune_p2_min", "prune_p2_div")
+
+_KNOWN_KEYS = frozenset(
+    ("version", "graph_shape_hash", "stages", "hub_prune_overrides",
+     "provenance") + _INT_KNOBS)
+
+# per-bucket override subkeys (hub_prune_cfg's tunable parameters)
+_OVERRIDE_KEYS = frozenset(("u_min", "u_div", "p_div", "p2_min", "p2_div"))
+
+
+def graph_shape_hash(arrays) -> str:
+    """Stable hash of the schedule-relevant graph shape: vertex/edge
+    counts, max degree, and the full degree histogram (the bucket layout
+    — hence every pad, range, and split the tuner prices — is a pure
+    function of it). Two graphs with equal hashes get identical
+    schedules from identical knobs."""
+    import numpy as np
+
+    deg = np.diff(np.asarray(arrays.indptr, dtype=np.int64))
+    hist = np.bincount(deg.astype(np.int64))
+    h = hashlib.sha256()
+    h.update(f"v={arrays.num_vertices};e2={len(arrays.indices)};"
+             f"maxdeg={int(arrays.max_degree)};".encode())
+    h.update(hist.astype(np.int64).tobytes())
+    return "dgcshape-" + h.hexdigest()[:24]
+
+
+def _check_stages_field(stages) -> tuple:
+    """Structural validation of a config's ``stages`` (JSON shape only —
+    the V-dependent checks run in ``_check_stage_ladder`` when the config
+    meets a graph). Returns the canonical tuple-of-tuples form."""
+    if not isinstance(stages, (list, tuple)) or not stages:
+        raise ValueError(
+            f"tuned config: stages must be a non-empty list, got {stages!r}")
+    out = []
+    for entry in stages:
+        if not isinstance(entry, (list, tuple)) or len(entry) != 2:
+            raise ValueError(
+                f"tuned config: each stage must be [scale|null, threshold], "
+                f"got {entry!r}")
+        scale, thresh = entry
+        if scale is not None and (not isinstance(scale, int)
+                                  or isinstance(scale, bool) or scale < 1):
+            raise ValueError(
+                f"tuned config: stage scale must be a positive int or null, "
+                f"got {scale!r}")
+        if not isinstance(thresh, int) or isinstance(thresh, bool) \
+                or thresh < 0:
+            raise ValueError(
+                f"tuned config: stage threshold must be an int >= 0, "
+                f"got {thresh!r}")
+        out.append((scale, thresh))
+    for (_, t0), (s1, t1) in zip(out, out[1:]):
+        if t1 > t0:
+            raise ValueError(
+                f"tuned config: stage thresholds must be non-increasing, "
+                f"got {t1} after {t0}")
+        if s1 is not None and s1 < t0:
+            raise ValueError(
+                f"tuned config: stage scale {s1} below its entry "
+                f"threshold {t0} (would drop active vertices)")
+    return tuple(out)
+
+
+@dataclass
+class TunedConfig:
+    """One graph's tuned schedule. ``None`` fields defer to the engine's
+    shipped defaults; a config with every knob None is exactly the
+    current static schedule."""
+
+    version: int = TUNED_CONFIG_VERSION
+    graph_shape_hash: str | None = None
+    stages: tuple | None = None
+    flat_cap: int | None = None
+    max_ranges: int | None = None
+    range_coalesce_pct: int | None = None
+    hub_uncond_entries: int | None = None
+    prune_u_min: int | None = None
+    prune_u_div: int | None = None
+    prune_p_div: int | None = None
+    prune_p2_min: int | None = None
+    prune_p2_div: int | None = None
+    hub_prune_overrides: dict | None = None   # bucket index -> knob dict
+    provenance: dict = field(default_factory=dict)
+
+    # -- engine application ---------------------------------------------
+    def knobs(self) -> dict:
+        """The non-None knob fields, by artifact name."""
+        out = {}
+        for name in ("stages", "hub_prune_overrides") + _INT_KNOBS:
+            val = getattr(self, name)
+            if val is not None:
+                out[name] = val
+        return out
+
+    def engine_kwargs(self, backend: str = "ell-compact") -> dict:
+        """Constructor overrides for ``backend`` (non-None knobs only —
+        all-unset maps to the exact shipped schedule). Unknown/untunable
+        backends get ``{}``: applying a tuned config there is a no-op,
+        not an error (the CLI warns)."""
+        table = {"ell-compact": _COMPACT_KWARGS,
+                 "sharded-bucketed": _SHARDED_KWARGS}.get(backend)
+        if table is None:
+            return {}
+        return {table[name]: val for name, val in self.knobs().items()
+                if name in table}
+
+    def check_graph(self, arrays, *, context: str = "") -> bool:
+        """Warn (and return False) when ``arrays`` is not the graph this
+        config was tuned for. The config still applies — knobs are
+        result-invariant everywhere — but the priced win does not carry."""
+        if self.graph_shape_hash is None:
+            return True
+        actual = graph_shape_hash(arrays)
+        if actual == self.graph_shape_hash:
+            return True
+        warnings.warn(
+            f"tuned config{' ' + context if context else ''} was derived "
+            f"for graph shape {self.graph_shape_hash} but is being applied "
+            f"to {actual}; schedules stay exact, the modeled win may not "
+            f"carry", stacklevel=2)
+        return False
+
+    # -- serialization --------------------------------------------------
+    def to_dict(self) -> dict:
+        doc: dict = {"version": self.version}
+        if self.graph_shape_hash is not None:
+            doc["graph_shape_hash"] = self.graph_shape_hash
+        for name, val in self.knobs().items():
+            if name == "stages":
+                doc[name] = [list(s) for s in val]
+            elif name == "hub_prune_overrides":
+                doc[name] = {str(bi): dict(ovr) for bi, ovr in val.items()}
+            else:
+                doc[name] = val
+        if self.provenance:
+            doc["provenance"] = self.provenance
+        return doc
+
+    def save(self, path: str) -> None:
+        p = Path(path)
+        if str(p.parent) not in ("", "."):
+            p.parent.mkdir(parents=True, exist_ok=True)
+        p.write_text(json.dumps(self.to_dict(), indent=2) + "\n")
+
+    @classmethod
+    def from_dict(cls, doc) -> "TunedConfig":
+        if not isinstance(doc, dict):
+            raise ValueError(
+                f"tuned config: expected a JSON object, got "
+                f"{type(doc).__name__}")
+        unknown = set(doc) - _KNOWN_KEYS
+        if unknown:
+            raise ValueError(
+                f"tuned config: unknown keys {sorted(unknown)} "
+                f"(known: {sorted(_KNOWN_KEYS)})")
+        version = doc.get("version")
+        if version != TUNED_CONFIG_VERSION:
+            raise ValueError(
+                f"tuned config: version {version!r} != supported "
+                f"{TUNED_CONFIG_VERSION} — re-emit with this build's "
+                f"`python -m dgc_tpu.tune`")
+        cfg = cls(version=version)
+        gh = doc.get("graph_shape_hash")
+        if gh is not None and not isinstance(gh, str):
+            raise ValueError(
+                f"tuned config: graph_shape_hash must be a string, "
+                f"got {gh!r}")
+        cfg.graph_shape_hash = gh
+        if "stages" in doc:
+            cfg.stages = _check_stages_field(doc["stages"])
+        for name in _INT_KNOBS:
+            if name not in doc:
+                continue
+            val = doc[name]
+            lo = 0 if name in ("hub_uncond_entries",
+                               "range_coalesce_pct") else 1
+            if not isinstance(val, int) or isinstance(val, bool) or val < lo:
+                raise ValueError(
+                    f"tuned config: {name} must be an int >= {lo}, "
+                    f"got {val!r}")
+            setattr(cfg, name, val)
+        if "hub_prune_overrides" in doc:
+            raw = doc["hub_prune_overrides"]
+            if not isinstance(raw, dict):
+                raise ValueError(
+                    f"tuned config: hub_prune_overrides must be an object, "
+                    f"got {raw!r}")
+            overrides: dict = {}
+            for key, ovr in raw.items():
+                try:
+                    bi = int(key)
+                except (TypeError, ValueError):
+                    bi = -1
+                if bi < 0:
+                    raise ValueError(
+                        f"tuned config: hub_prune_overrides key must be a "
+                        f"bucket index >= 0, got {key!r}")
+                if not isinstance(ovr, dict):
+                    raise ValueError(
+                        f"tuned config: hub_prune_overrides[{key}] must be "
+                        f"an object, got {ovr!r}")
+                unknown = set(ovr) - _OVERRIDE_KEYS
+                if unknown:
+                    raise ValueError(
+                        f"tuned config: hub_prune_overrides[{key}] has "
+                        f"unknown keys {sorted(unknown)} "
+                        f"(known: {sorted(_OVERRIDE_KEYS)})")
+                for k2, v2 in ovr.items():
+                    if not isinstance(v2, int) or isinstance(v2, bool) \
+                            or v2 < 1:
+                        raise ValueError(
+                            f"tuned config: hub_prune_overrides[{key}]"
+                            f"[{k2!r}] must be an int >= 1, got {v2!r}")
+                overrides[bi] = dict(ovr)
+            cfg.hub_prune_overrides = overrides
+        prov = doc.get("provenance", {})
+        if not isinstance(prov, dict):
+            raise ValueError(
+                f"tuned config: provenance must be an object, got {prov!r}")
+        cfg.provenance = prov
+        return cfg
+
+
+def load_tuned_config(path: str) -> TunedConfig:
+    """Load + strictly validate a tuned-config artifact (see module
+    docstring for the failure contract)."""
+    try:
+        text = Path(path).read_text()
+    except OSError as e:
+        raise ValueError(f"tuned config {path}: cannot read: {e}") from e
+    try:
+        doc = json.loads(text)
+    except json.JSONDecodeError as e:
+        raise ValueError(f"tuned config {path}: invalid JSON: {e}") from e
+    try:
+        return TunedConfig.from_dict(doc)
+    except ValueError as e:
+        raise ValueError(f"{path}: {e}") from e

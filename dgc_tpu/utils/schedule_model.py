@@ -55,6 +55,11 @@ class SchedulePrice:
     per_step: list = field(default_factory=list)  # element gathers per superstep
     per_step_calls: list = field(default_factory=list)          # fused plan
     per_step_calls_unfused: list = field(default_factory=list)  # pre-PR plan
+    # per-step CONDITIONED-hub branch decisions: list (one entry per
+    # superstep) of ``(bucket, live, branch, volume)`` tuples — what
+    # ``price_hub_fold`` consumes, recorded by the same walk so the two
+    # pricings cannot drift
+    hub_trace: list = field(default_factory=list)
 
     @property
     def total(self) -> int:
@@ -195,6 +200,7 @@ def price_schedule(engine: CompactFrontierEngine,
             calls_f += 1                                  # one per superstep
             calls_u += len(engine.stage_ranges[si])       # one per range
 
+        step_trace = []
         for bi in range(hub):
             live = st.active_per_bucket[bi]
             w, vb = widths[bi], sizes[bi]
@@ -202,6 +208,7 @@ def price_schedule(engine: CompactFrontierEngine,
                 t["hub_uncond"] += vb * w  # no control flow at all
                 continue
             if live == 0:
+                step_trace.append((bi, 0, "skip", 0))
                 continue  # cond-skipped: costs nothing
             calls_f += 1   # conditioned ladder: one gather per live bucket,
             calls_u += 1   # fused and unfused alike
@@ -209,24 +216,31 @@ def price_schedule(engine: CompactFrontierEngine,
                    if bi < len(engine.hub_prune) else None)
             if cfg is None:
                 t["hub_full"] += vb * w
+                step_trace.append((bi, live, "full", vb * w))
                 continue
             pad, u = cfg[0], cfg[1]
             p2 = cfg[2] if len(cfg) == 3 else None
             if tier[bi] == 2:
                 t["hub_pruned2"] += p2 * u
+                step_trace.append((bi, live, "pruned2", p2 * u))
             elif tier[bi] == 1 and p2 is not None and live <= p2:
                 t["hub_shrink"] += p2 * u
                 rows["hub_shrink"] += p2
                 tier[bi] = 2
+                step_trace.append((bi, live, "shrink", p2 * u))
             elif tier[bi] == 1:
                 t["hub_pruned"] += pad * u
+                step_trace.append((bi, live, "pruned", pad * u))
             elif live <= pad:
                 t["hub_rebase"] += pad * w
                 rows["hub_rebase"] += pad
                 if st.max_unconf_per_bucket[bi] <= u:
                     tier[bi] = 1  # capture valid at this rebase
+                step_trace.append((bi, live, "rebase", pad * w))
             else:
                 t["hub_full"] += vb * w
+                step_trace.append((bi, live, "full", vb * w))
+        p.hub_trace.append(step_trace)
         p.per_step.append(sum(t.values()) - step_base)
         p.per_step_calls.append(calls_f)
         p.per_step_calls_unfused.append(calls_u)
@@ -273,6 +287,101 @@ def check_volume_invariance(engine: CompactFrontierEngine) -> dict:
         assert seg.plan_size(plan) == want, (seg.plan_size(plan), want)
         out[f"stage_{s_i}"] = want
     return out
+
+
+def price_hub_fold(engine, traj: Trajectory,
+                   price: SchedulePrice | None = None) -> dict:
+    """Price the two conditioned-hub-fold designs from the ROADMAP — the
+    remaining per-superstep gather-call floor is the dispatch ladders'
+    one gather per live conditioned bucket, and the ROADMAP asks for
+    this pricing BEFORE any fold is built.
+
+    **Design A — sentinel-region fold**: the pruned ``[P, U]`` regions of
+    every cfg-carrying conditioned bucket join one static layout gathered
+    whenever any of them is in the pruned tier. Static shapes demand the
+    full region per bucket per superstep, so every folded bucket that is
+    NOT in steady-state pruned that step gathers waste: its whole
+    ``P×U`` when inert/rebasing/full (the real branch still runs
+    separately), and the pad overhang ``(P−P2)×U`` when tier-2 would have
+    shrunk it. ``a_extra_volume`` is that concession (the quantity strict
+    volume invariance forbids); ``a_calls_saved`` what the fold buys.
+
+    **Design B — gated all-captured fused branch**: one extra branch
+    fires only on supersteps where EVERY live conditioned bucket is in a
+    pruned tier, fusing their (exact, already-priced) pruned gathers into
+    one call — zero volume concession, but it only helps on those steps,
+    and costs one more traced hub instance (the flattened ``[P,U]``
+    layouts ride the carry, rebuilt at each capture).
+
+    Returns the numbers behind the go/no-go (PERF.md "Conditioned-hub
+    fold pricing"); derived from ``price.hub_trace`` so this walk can
+    never disagree with :func:`price_schedule`.
+    """
+    if price is None:
+        price = price_schedule(engine, traj)
+    foldable = {bi: engine.hub_prune[bi]
+                for bi in range(engine.hub_buckets)
+                if bi < len(engine.hub_prune)
+                and engine.hub_prune[bi] is not None}
+    steps = len(price.hub_trace)
+    a_extra = 0
+    a_steps_active = 0          # steps where the A-fold gathers at all
+    a_calls_saved = 0
+    b_steps_all_captured = 0
+    b_calls_saved = 0
+    ladder_calls = 0
+    for step_trace in price.hub_trace:
+        by_bucket = {bi: (live, branch) for bi, live, branch, _vol
+                     in step_trace}
+        live_branches = [br for _, (lv, br) in by_bucket.items()
+                         if br != "skip"]
+        ladder_calls += len(live_branches)
+        pruned_now = [bi for bi, (lv, br) in by_bucket.items()
+                      if br in ("pruned", "pruned2", "shrink")
+                      and bi in foldable]
+        if pruned_now:
+            a_steps_active += 1
+            a_calls_saved += len(pruned_now) - 1
+            for bi, cfg in foldable.items():
+                pad, u = cfg[0], cfg[1]
+                p2 = cfg[2] if len(cfg) == 3 else None
+                lv, br = by_bucket[bi]
+                if br == "pruned":
+                    continue                    # exact, already gathered
+                if br in ("pruned2", "shrink") and p2 is not None:
+                    a_extra += (pad - p2) * u   # fold undoes the shrink
+                else:
+                    a_extra += pad * u          # sentinel region, pure waste
+        if live_branches and all(br in ("pruned", "pruned2", "shrink")
+                                 for br in live_branches):
+            b_steps_all_captured += 1
+            b_calls_saved += len(live_branches) - 1
+    hub_volume = sum(price.terms[k] for k in
+                     ("hub_full", "hub_rebase", "hub_pruned",
+                      "hub_shrink", "hub_pruned2"))
+    return {
+        "steps": steps,
+        "cond_buckets": len([bi for bi in range(engine.hub_buckets)
+                             if not (bi < len(engine.hub_uncond)
+                                     and engine.hub_uncond[bi])]),
+        "foldable_buckets": len(foldable),
+        "ladder_volume": int(hub_volume),
+        "ladder_calls_total": int(ladder_calls),
+        "sentinel_fold": {
+            "extra_volume": int(a_extra),
+            "extra_vs_total_pct": round(100.0 * a_extra / price.total, 2)
+            if price.total else 0.0,
+            "steps_active": int(a_steps_active),
+            "calls_saved": int(a_calls_saved),
+        },
+        "all_captured_fused": {
+            "extra_volume": 0,
+            "steps_all_captured": int(b_steps_all_captured),
+            "steps_all_captured_pct": round(
+                100.0 * b_steps_all_captured / steps, 1) if steps else 0.0,
+            "calls_saved": int(b_calls_saved),
+        },
+    }
 
 
 @dataclass
@@ -383,10 +492,22 @@ def _main(argv=None) -> int:
 
     ap = argparse.ArgumentParser(prog="dgc-tpu-schedule-model")
     add_graph_args(ap)
+    ap.add_argument("--tuned-config", type=str, default=None,
+                    help="price the schedule under a tuned-config artifact "
+                         "(dgc_tpu.tune) instead of the shipped defaults")
     args = ap.parse_args(argv)
     arrays = load_graph_args(ap, args)
 
-    eng = CompactFrontierEngine(arrays)
+    eng_kwargs = {}
+    tuned_from = None
+    if args.tuned_config:
+        from dgc_tpu.tune.config import load_tuned_config
+
+        cfg = load_tuned_config(args.tuned_config)
+        cfg.check_graph(arrays, context=args.tuned_config)
+        eng_kwargs = cfg.engine_kwargs("ell-compact")
+        tuned_from = args.tuned_config
+    eng = CompactFrontierEngine(arrays, **eng_kwargs)
     traj = record_trajectory(arrays)
     price = price_schedule(eng, traj)
     for name, vol in price.terms.items():
@@ -418,6 +539,8 @@ def _main(argv=None) -> int:
         "volume_invariant": bool(check_volume_invariance(eng)),
         "attempt_seconds_bracket": pred,
         "complexity": program_complexity(eng),
+        "tuned_config": tuned_from,
+        "hub_fold": price_hub_fold(eng, traj, price),
         "edge_tail": {
             "entry_step": tail.entry_step,
             "staged_tail": tail.staged_tail,

@@ -1,0 +1,515 @@
+"""Schedule auto-tuner (dgc_tpu.tune): artifact round-trip, loader and
+ladder hardening, never-worse pricing, telemetry-driven mode, and the
+hub-fold pricing instrument."""
+
+from __future__ import annotations
+
+import json
+
+import numpy as np
+import pytest
+
+from dgc_tpu.engine.compact import (
+    CompactFrontierEngine,
+    _check_stage_ladder,
+    derive_schedule,
+    hub_prune_cfg,
+    stage_slot_ranges,
+)
+from dgc_tpu.models.generators import (
+    generate_random_graph_fast,
+    generate_rmat_graph,
+)
+from dgc_tpu.tune import (
+    TunedConfig,
+    graph_shape_hash,
+    load_tuned_config,
+    tune_schedule,
+)
+from dgc_tpu.tune.search import (
+    ScheduleView,
+    _objective,
+    bucket_layout,
+    trajectory_from_manifest,
+    tune_from_manifest,
+)
+from dgc_tpu.utils.schedule_model import (
+    price_hub_fold,
+    price_schedule,
+    program_complexity,
+)
+from dgc_tpu.utils.trajectory import record_trajectory
+
+
+@pytest.fixture(scope="module")
+def rmat20k():
+    return generate_rmat_graph(20_000, avg_degree=16.0, seed=1)
+
+
+@pytest.fixture(scope="module")
+def rmat20k_traj(rmat20k):
+    return record_trajectory(rmat20k)
+
+
+@pytest.fixture(scope="module")
+def tuned20k(rmat20k, rmat20k_traj):
+    return tune_schedule(rmat20k, rmat20k_traj)
+
+
+# -- ladder / knob hardening (structured ValueError, python -O safe) ----
+
+def test_ladder_rejects_non_monotone_thresholds():
+    with pytest.raises(ValueError, match="non-increasing"):
+        _check_stage_ladder(((None, 100), (100, 200), (200, 0)), 1000)
+
+
+def test_ladder_rejects_rung_above_v():
+    with pytest.raises(ValueError, match="> num_vertices"):
+        _check_stage_ladder(((None, 1000), (2048, 0)), 1000)
+
+
+def test_ladder_rejects_nonpositive_rung_and_thresh():
+    with pytest.raises(ValueError, match=">= 1"):
+        _check_stage_ladder(((None, 10), (0, 0)), 1000)
+    with pytest.raises(ValueError, match=">= 0"):
+        _check_stage_ladder(((None, -1),), 1000)
+
+
+def test_ladder_rejects_empty_and_non_int():
+    with pytest.raises(ValueError, match="empty"):
+        _check_stage_ladder((), 1000)
+    with pytest.raises(ValueError, match="int"):
+        _check_stage_ladder(((None, 10), ("64", 0)), 1000)
+
+
+def test_prune_divisor_zero_raises():
+    with pytest.raises(ValueError, match="u_div"):
+        hub_prune_cfg(10_000, 2048, u_div=0, uncond_entries=0)
+    with pytest.raises(ValueError, match="p_div"):
+        hub_prune_cfg(10_000, 2048, p_div=0, uncond_entries=0)
+    with pytest.raises(ValueError, match="p2_div"):
+        hub_prune_cfg(10_000, 2048, p2_div=-1, uncond_entries=0)
+
+
+def test_stage_slot_ranges_max_ranges_validated_and_applied():
+    sizes = [10, 100, 1000, 10_000, 50_000]
+    widths = [256, 128, 64, 32, 16]
+    with pytest.raises(ValueError, match="max_ranges"):
+        stage_slot_ranges(sizes, widths, 1 << 14, max_ranges=0)
+    wide = stage_slot_ranges(sizes, widths, 1 << 14, max_ranges=12)
+    tight = stage_slot_ranges(sizes, widths, 1 << 14, max_ranges=2)
+    assert len(tight) <= 2 and len(wide) >= len(tight)
+    # both still cover [0, pad) exactly
+    for rs in (wide, tight):
+        assert rs[0][0] == 0 and rs[-1][1] == 1 << 14
+        assert all(a[1] == b[0] for a, b in zip(rs, rs[1:]))
+
+
+def test_stage_slot_ranges_coalesce_budget():
+    sizes = [10, 100, 1000, 10_000, 50_000]
+    widths = [256, 128, 64, 32, 16]
+    with pytest.raises(ValueError, match="coalesce_pct"):
+        stage_slot_ranges(sizes, widths, 1 << 14, coalesce_pct=101)
+    exact = stage_slot_ranges(sizes, widths, 1 << 14, max_ranges=12,
+                              coalesce_pct=0)
+    merged = stage_slot_ranges(sizes, widths, 1 << 14, max_ranges=12,
+                               coalesce_pct=10)
+    vol = lambda rs: sum((r1 - r0) * w for r0, r1, w, _ in rs)
+    assert vol(exact) <= vol(merged)       # zero budget = exact pricing
+    assert len(exact) >= len(merged)       # ... at more compiled ranges
+    # the default (10) must reproduce the shipped pre-knob behavior
+    assert stage_slot_ranges(sizes, widths, 1 << 14) == \
+        stage_slot_ranges(sizes, widths, 1 << 14, coalesce_pct=10)
+
+
+def test_derive_schedule_rejects_bad_knobs():
+    with pytest.raises(ValueError, match="max_ranges"):
+        derive_schedule([100], [8], 100, 7, max_ranges=0)
+    with pytest.raises(ValueError, match="hub_uncond_entries"):
+        derive_schedule([100], [8], 100, 7, hub_uncond_entries=-1)
+    with pytest.raises(ValueError, match="flat_cap"):
+        derive_schedule([100], [8], 100, 7, flat_cap=0)
+
+
+# -- tuned-config artifact: loader contract -----------------------------
+
+def test_loader_rejects_unknown_keys(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"version": 1, "max_rangez": 4}))
+    with pytest.raises(ValueError, match="unknown keys"):
+        load_tuned_config(str(p))
+
+
+def test_loader_rejects_version_mismatch(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"version": 99}))
+    with pytest.raises(ValueError, match="version"):
+        load_tuned_config(str(p))
+    p.write_text(json.dumps({"max_ranges": 4}))  # version missing
+    with pytest.raises(ValueError, match="version"):
+        load_tuned_config(str(p))
+
+
+def test_loader_rejects_bad_stages_and_divisors(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(
+        {"version": 1, "stages": [[None, 100], [100, 200]]}))
+    with pytest.raises(ValueError, match="non-increasing"):
+        load_tuned_config(str(p))
+    p.write_text(json.dumps({"version": 1, "prune_u_div": 0}))
+    with pytest.raises(ValueError, match="prune_u_div"):
+        load_tuned_config(str(p))
+    p.write_text(json.dumps({"version": 1, "stages": [[None, "x"]]}))
+    with pytest.raises(ValueError, match="threshold"):
+        load_tuned_config(str(p))
+    p.write_text("{not json")
+    with pytest.raises(ValueError, match="invalid JSON"):
+        load_tuned_config(str(p))
+
+
+def test_rung_above_v_rejected_at_engine_apply(rmat20k, tmp_path):
+    # structurally valid artifact, but the rung exceeds this graph's V:
+    # the engine-side ladder check must catch it as a ValueError
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps(
+        {"version": 1, "stages": [[None, 10], [30000, 0]]}))
+    cfg = load_tuned_config(str(p))
+    with pytest.raises(ValueError, match="> num_vertices"):
+        CompactFrontierEngine(rmat20k, **cfg.engine_kwargs("ell-compact"))
+
+
+def test_loader_rejects_bad_overrides(tmp_path):
+    p = tmp_path / "cfg.json"
+    p.write_text(json.dumps({"version": 1, "hub_prune_overrides":
+                             {"0": {"u_divz": 4}}}))
+    with pytest.raises(ValueError, match="unknown keys"):
+        load_tuned_config(str(p))
+    p.write_text(json.dumps({"version": 1, "hub_prune_overrides":
+                             {"-1": {"u_div": 4}}}))
+    with pytest.raises(ValueError, match="bucket index"):
+        load_tuned_config(str(p))
+    p.write_text(json.dumps({"version": 1, "hub_prune_overrides":
+                             {"0": {"u_div": 0}}}))
+    with pytest.raises(ValueError, match="u_div"):
+        load_tuned_config(str(p))
+
+
+def test_override_roundtrip_and_derive_merge(tmp_path):
+    cfg = TunedConfig(prune_u_div=8,
+                      hub_prune_overrides={2: {"u_div": 2, "p2_min": 8}})
+    path = tmp_path / "ovr.json"
+    cfg.save(str(path))
+    loaded = load_tuned_config(str(path))
+    assert loaded.hub_prune_overrides == {2: {"u_div": 2, "p2_min": 8}}
+    # derive merges the override over the global scalar for that bucket
+    sizes = [8, 200, 900, 50_000, 100_000]
+    widths = [8192, 4096, 1024, 64, 8]
+    kw = dict(flat_cap=256, hub_uncond_entries=0)
+    merged = derive_schedule(sizes, widths, 160_000, 8192, prune_u_div=8,
+                             hub_prune_overrides={2: {"u_div": 2,
+                                                      "p2_min": 8}}, **kw)
+    direct_b2 = hub_prune_cfg(sizes[2], widths[2], u_div=2, p2_min=8,
+                              uncond_entries=0)
+    plain = derive_schedule(sizes, widths, 160_000, 8192, prune_u_div=8,
+                            **kw)
+    assert merged["hub_prune"][2] == direct_b2
+    assert merged["hub_prune"][0] == plain["hub_prune"][0]  # untouched
+    # out-of-hub indices are inert (configs stay exact on any graph)
+    spill = derive_schedule(sizes, widths, 160_000, 8192, prune_u_div=8,
+                            hub_prune_overrides={99: {"u_div": 2}}, **kw)
+    assert spill["hub_prune"] == plain["hub_prune"]
+    with pytest.raises(ValueError, match="hub_prune_overrides"):
+        derive_schedule(sizes, widths, 160_000, 8192,
+                        hub_prune_overrides={0: {"bogus": 2}}, **kw)
+
+
+# -- round-trip: emit -> save -> load -> engine kwargs ------------------
+
+def test_roundtrip_emit_load_engine_kwargs(tuned20k, rmat20k, tmp_path):
+    cfg = tuned20k
+    path = tmp_path / "tuned.json"
+    cfg.save(str(path))
+    loaded = load_tuned_config(str(path))
+    assert loaded.knobs() == cfg.knobs()
+    assert loaded.graph_shape_hash == cfg.graph_shape_hash
+    assert loaded.engine_kwargs("ell-compact") == \
+        cfg.engine_kwargs("ell-compact")
+    # the engine accepts the kwargs and adopts exactly the tuned schedule
+    eng = CompactFrontierEngine(rmat20k, **loaded.engine_kwargs("ell-compact"))
+    if cfg.stages is not None:
+        assert eng.stages == cfg.stages
+    # sharded mapping only carries hub knobs, and never the ladder
+    assert "stages" not in loaded.engine_kwargs("sharded-bucketed")
+    assert loaded.engine_kwargs("reference-sim") == {}
+
+
+def test_empty_config_is_shipped_schedule(rmat20k):
+    cfg = TunedConfig()
+    assert cfg.engine_kwargs("ell-compact") == {}
+    base = CompactFrontierEngine(rmat20k)
+    via = CompactFrontierEngine(rmat20k, **cfg.engine_kwargs("ell-compact"))
+    assert base.stages == via.stages
+    assert base.stage_ranges == via.stage_ranges
+    assert base.hub_prune == via.hub_prune
+    assert base.hub_uncond == via.hub_uncond
+
+
+def test_graph_hash_mismatch_warns(tuned20k):
+    other = generate_random_graph_fast(5_000, avg_degree=8.0, seed=3)
+    with pytest.warns(UserWarning, match="graph shape"):
+        assert tuned20k.check_graph(other) is False
+
+
+def test_graph_hash_match_silent(tuned20k, rmat20k):
+    import warnings
+
+    with warnings.catch_warnings():
+        warnings.simplefilter("error")
+        assert tuned20k.check_graph(rmat20k) is True
+
+
+# -- pricing guarantees -------------------------------------------------
+
+def _base_view(arrays, traj):
+    return ScheduleView.build(list(traj.bucket_sizes),
+                              list(traj.bucket_widths),
+                              arrays.num_vertices, int(arrays.max_degree))
+
+
+def test_tuner_never_worse_than_default(rmat20k, rmat20k_traj, tuned20k):
+    base = price_schedule(_base_view(rmat20k, rmat20k_traj), rmat20k_traj)
+    tuned_view = ScheduleView.build(
+        list(rmat20k_traj.bucket_sizes), list(rmat20k_traj.bucket_widths),
+        rmat20k.num_vertices, int(rmat20k.max_degree),
+        **{k: v for k, v in tuned20k.knobs().items()})
+    tuned = price_schedule(tuned_view, rmat20k_traj)
+    assert tuned.total <= base.total
+    assert _objective(tuned) <= _objective(base)
+    assert tuned20k.provenance["tuned"]["total"] == tuned.total
+    assert tuned20k.provenance["baseline"]["total"] == base.total
+
+
+def test_tuner_never_worse_on_uniform():
+    g = generate_random_graph_fast(20_000, avg_degree=16.0, seed=0)
+    traj = record_trajectory(g)
+    cfg = tune_schedule(g, traj)
+    base = price_schedule(_base_view(g, traj), traj)
+    view = ScheduleView.build(list(traj.bucket_sizes),
+                              list(traj.bucket_widths),
+                              g.num_vertices, int(g.max_degree),
+                              **cfg.knobs())
+    assert price_schedule(view, traj).total <= base.total
+
+
+def test_view_matches_real_engine(rmat20k, rmat20k_traj, tuned20k):
+    """The pricing view and a real engine built from the same knobs carry
+    the same static schedule — derive_schedule single-sourcing."""
+    knobs = tuned20k.knobs()
+    eng = CompactFrontierEngine(
+        rmat20k, **tuned20k.engine_kwargs("ell-compact"))
+    view = ScheduleView.build(
+        list(rmat20k_traj.bucket_sizes), list(rmat20k_traj.bucket_widths),
+        rmat20k.num_vertices, int(rmat20k.max_degree), **knobs)
+    assert view.stages == eng.stages
+    assert view.stage_ranges == eng.stage_ranges
+    assert view.hub_buckets == eng.hub_buckets
+    assert view.hub_prune == eng.hub_prune
+    assert view.hub_uncond == eng.hub_uncond
+    # and therefore identical prices from the instrument
+    pe = price_schedule(eng, rmat20k_traj)
+    pv = price_schedule(view, rmat20k_traj)
+    assert pe.total == pv.total and pe.terms == pv.terms
+    assert program_complexity(eng) == program_complexity(view)
+
+
+def test_bucket_layout_matches_buckets(rmat20k, rmat20k_traj):
+    sizes, widths = bucket_layout(rmat20k)
+    assert sizes == list(rmat20k_traj.bucket_sizes)
+    assert widths == list(rmat20k_traj.bucket_widths)
+
+
+def test_tuner_complexity_within_guard(tuned20k):
+    from dgc_tpu.tune.search import complexity_within
+
+    prov = tuned20k.provenance
+    assert complexity_within(prov["tuned"]["complexity"],
+                             prov["baseline"]["complexity"])
+
+
+# -- telemetry-driven mode (manifest trajectory) ------------------------
+
+def _manifest_doc_from_replay(arrays, traj, hub: int, n_flat: int):
+    """Fabricate the manifest shape the obs subsystem writes, from the
+    replay (hub-actives + flat-total layout, the compact engine's)."""
+    ba = []
+    for st in traj.steps:
+        row = [st.active_per_bucket[bi] for bi in range(hub)]
+        if n_flat:
+            row.append(sum(st.active_per_bucket[hub:]))
+        ba.append(row)
+    return {
+        "manifest_version": 1,
+        "attempts": [{
+            "k": int(arrays.max_degree + 1), "status": "SUCCESS",
+            "trajectory": {
+                "active": [st.active for st in traj.steps],
+                "bucket_active": ba, "first_step": 1, "truncated": False,
+            },
+        }],
+    }
+
+
+def test_trajectory_from_manifest_and_tune(rmat20k, rmat20k_traj):
+    sizes, widths = bucket_layout(rmat20k)
+    sched = derive_schedule(sizes, widths, rmat20k.num_vertices,
+                            int(rmat20k.max_degree))
+    hub = sched["hub_buckets"]
+    doc = _manifest_doc_from_replay(rmat20k, rmat20k_traj, hub,
+                                    len(sizes) - hub)
+    traj = trajectory_from_manifest(doc, rmat20k)
+    assert traj.supersteps == rmat20k_traj.supersteps
+    assert [s.active for s in traj.steps] == \
+        [s.active for s in rmat20k_traj.steps]
+    # hub occupancy carried through; flat liveness preserved
+    assert all(
+        t.active_per_bucket[:hub] == r.active_per_bucket[:hub]
+        and (sum(t.active_per_bucket[hub:]) > 0)
+        == (sum(r.active_per_bucket[hub:]) > 0)
+        for t, r in zip(traj.steps, rmat20k_traj.steps))
+
+    cfg = tune_from_manifest(rmat20k, doc)
+    assert cfg.provenance["source"] == "manifest"
+    # manifest mode never touches the hub/capture knobs
+    for k in ("hub_uncond_entries", "prune_u_div", "prune_p_div",
+              "prune_p2_div", "flat_cap"):
+        assert getattr(cfg, k) is None
+    # never-worse holds under the telemetry trajectory too
+    base = price_schedule(_base_view(rmat20k, traj), traj)
+    view = ScheduleView.build(list(traj.bucket_sizes),
+                              list(traj.bucket_widths),
+                              rmat20k.num_vertices,
+                              int(rmat20k.max_degree), **cfg.knobs())
+    assert price_schedule(view, traj).total <= base.total
+
+
+def test_trajectory_from_manifest_rejects_bad_layout(rmat20k):
+    doc = {"manifest_version": 1, "attempts": [{
+        "k": 10, "trajectory": {"active": [5], "bucket_active": [[1, 2]],
+                                "first_step": 1, "truncated": False}}]}
+    with pytest.raises(ValueError, match="bucket_active width"):
+        trajectory_from_manifest(doc, rmat20k)
+    with pytest.raises(ValueError, match="no untruncated"):
+        trajectory_from_manifest({"attempts": []}, rmat20k)
+
+
+# -- hub-fold pricing (ROADMAP: price before building) ------------------
+
+def test_price_hub_fold_invariants(rmat20k, rmat20k_traj):
+    view = _base_view(rmat20k, rmat20k_traj)
+    price = price_schedule(view, rmat20k_traj)
+    fold = price_hub_fold(view, rmat20k_traj, price)
+    assert fold["steps"] == rmat20k_traj.supersteps
+    # design B is exact by construction; design A pays a concession
+    assert fold["all_captured_fused"]["extra_volume"] == 0
+    assert fold["sentinel_fold"]["extra_volume"] >= 0
+    assert fold["sentinel_fold"]["calls_saved"] <= \
+        fold["ladder_calls_total"]
+    # call savings can never exceed the steps they fire on
+    assert fold["all_captured_fused"]["calls_saved"] <= \
+        fold["ladder_calls_total"]
+
+
+# -- graph shape hash ---------------------------------------------------
+
+def test_graph_shape_hash_stable_and_discriminating(rmat20k):
+    h1 = graph_shape_hash(rmat20k)
+    assert h1 == graph_shape_hash(rmat20k)
+    g2 = generate_rmat_graph(20_000, avg_degree=16.0, seed=2)
+    assert h1 != graph_shape_hash(g2)
+
+
+# -- CLI integration: flags, manifest provenance, schema ---------------
+
+def _tiny_cfg(tmp_path, **extra):
+    p = tmp_path / "tiny_cfg.json"
+    p.write_text(json.dumps(dict(
+        {"version": 1, "max_ranges": 4, "prune_u_div": 8}, **extra)))
+    return str(p)
+
+
+def test_cli_tuned_config_end_to_end(tmp_path):
+    from dgc_tpu.cli import main
+    from dgc_tpu.obs.schema import validate_record
+
+    out = tmp_path / "c.json"
+    man = tmp_path / "m.json"
+    log = tmp_path / "r.jsonl"
+    rc = main([
+        "--node-count", "60", "--max-degree", "8", "--seed", "2",
+        "--output-coloring", str(out), "--tuned-config",
+        _tiny_cfg(tmp_path), "--run-manifest", str(man),
+        "--log-json", str(log),
+    ])
+    assert rc == 0
+    doc = json.loads(man.read_text())
+    tu = doc["tuning"]
+    assert tu["source"] == "file" and tu["backend_applies"] is True
+    assert tu["knobs"] == {"max_ranges": 4, "prune_u_div": 8}
+    # the event stream stays schema-clean with the new event kind
+    problems = [p for line in log.read_text().splitlines() if line
+                for p in validate_record(json.loads(line))]
+    assert problems == []
+
+
+def test_cli_tuned_config_flags_validated(tmp_path):
+    from dgc_tpu.cli import main
+
+    out = str(tmp_path / "c.json")
+    rc = main(["--node-count", "40", "--max-degree", "6",
+               "--output-coloring", out,
+               "--auto-tune", "--tuned-config", _tiny_cfg(tmp_path)])
+    assert rc == 2
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"version": 1, "nope": 1}))
+    rc = main(["--node-count", "40", "--max-degree", "6",
+               "--output-coloring", out, "--tuned-config", str(bad)])
+    assert rc == 2
+
+
+def test_cli_auto_tune_saves_artifact(tmp_path):
+    from dgc_tpu.cli import main
+
+    out = tmp_path / "c.json"
+    man = tmp_path / "m.json"
+    saved = tmp_path / "derived.json"
+    rc = main([
+        "--node-count", "60", "--max-degree", "8", "--seed", "2",
+        "--output-coloring", str(out), "--auto-tune",
+        "--auto-tune-out", str(saved), "--run-manifest", str(man),
+    ])
+    assert rc == 0
+    assert json.loads(man.read_text())["tuning"]["source"] == "auto-tune"
+    cfg = load_tuned_config(str(saved))  # artifact round-trips the loader
+    assert cfg.version == 1 and cfg.graph_shape_hash
+
+
+# -- engine accepts the new knobs end-to-end (schedule invariance) ------
+
+def test_tuned_engine_bit_identical_small():
+    """A deliberately non-default config on a small heavy-tail graph:
+    colors and supersteps must equal the bucketed anchor's (the cheap
+    in-tree version of tools/bit_identity_ensemble.py --tuned-config)."""
+    from dgc_tpu.engine.bucketed import BucketedELLEngine
+
+    g = generate_rmat_graph(3_000, avg_degree=12.0, seed=5)
+    k0 = g.max_degree + 1
+    ref = BucketedELLEngine(g).attempt(k0)
+    eng = CompactFrontierEngine(
+        g, max_ranges=3, range_coalesce_pct=0,
+        hub_uncond_entries=1 << 14,
+        prune_u_div=8, prune_p_div=4, prune_p2_div=4,
+        hub_prune_overrides={0: {"u_div": 2, "p2_min": 4}},
+        stages=((None, 1024), (1024, 256), (256, 64), (64, 0)))
+    res = eng.attempt(k0)
+    assert np.array_equal(res.colors, ref.colors)
+    assert res.supersteps == ref.supersteps

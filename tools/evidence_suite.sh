@@ -41,6 +41,28 @@ if [ "$battery_rc" -ne 2 ]; then
     --logdir /tmp/dgc_trace_seg 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> trace_attr_seg.jsonl || true
 
+  # tuned-vs-static A/B (schedule auto-tuner, dgc_tpu.tune): same graph,
+  # shipped ladder vs the committed tuned config (tools/tuned_configs/,
+  # emitted chip-free by `python -m dgc_tpu.tune` — regenerate with
+  # --out if the generators change). The tuner's modeled wins
+  # (PERF.md "Auto-tuned schedules": −10.9% gather volume at 200k-RMAT,
+  # −9.2% at 1M-RMAT) land here as measured sweep wall-clock deltas;
+  # results are bit-identical by construction, so any color/superstep
+  # drift in these rows is a bug, not a tuning effect.
+  echo "=== tuned-vs-static A/B (200k RMAT) ===" | tee -a /dev/stderr >/dev/null
+  timeout 3600 python bench.py --gen rmat --nodes 200000 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+  timeout 3600 python bench.py --gen rmat --nodes 200000 \
+    --tuned-config tools/tuned_configs/rmat_200k.json 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+
+  echo "=== tuned-vs-static A/B (1M RMAT) ===" | tee -a /dev/stderr >/dev/null
+  timeout 7200 python bench.py --gen rmat --nodes 1000000 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+  timeout 7200 python bench.py --gen rmat --nodes 1000000 \
+    --tuned-config tools/tuned_configs/rmat_1m.json 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+
   echo "=== cold compile, unified pipeline 1M-RMAT ===" | tee -a /dev/stderr >/dev/null
   # fresh cache dir = genuinely cold compile (removed after); outer
   # timeout sits ABOVE bench.py's 5400s in-process deadline so the

@@ -61,6 +61,21 @@ def render(doc: dict, width: int = 48) -> str:
     if s:
         add(f"sweep:    backend={s.get('backend')} initial_k={s.get('initial_k')} "
             f"strict={s.get('strict_decrement')}")
+    tu = doc.get("tuning")
+    if tu:
+        # schedule auto-tuner provenance (dgc_tpu.tune): which config
+        # produced the engine schedule this run executed
+        knobs = tu.get("knobs") or {}
+        win = tu.get("win_total_pct")
+        add(f"tuning:   source={tu.get('source')}"
+            + (f" path={tu.get('path')}" if tu.get("path") else "")
+            + (f" modeled_win={win}%" if win is not None else "")
+            + ("" if tu.get("hash_match", True) else " [GRAPH-HASH MISMATCH]")
+            + ("" if tu.get("backend_applies", True) else " [backend ignores it]"))
+        if knobs:
+            add(f"          knobs: "
+                + ", ".join(f"{k}={'<ladder:%d rungs>' % len(v) if k == 'stages' else v}"
+                            for k, v in sorted(knobs.items())))
 
     attempts = doc.get("attempts") or []
     if attempts:
