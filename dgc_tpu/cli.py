@@ -308,6 +308,15 @@ def make_engine(args, graph: Graph, logger=None):
 
 
 def main(argv: list[str] | None = None) -> int:
+    # subcommand dispatch BEFORE the sweep parser: `dgc-tpu serve ...` is
+    # the batched multi-graph front-end (dgc_tpu.serve); without it the
+    # flag surface — and therefore every default run — is byte-identical
+    # to the pre-serve driver
+    raw = sys.argv[1:] if argv is None else argv
+    if raw and raw[0] == "serve":
+        from dgc_tpu.serve.cli import serve_main
+
+        return serve_main(raw[1:])
     args = build_parser().parse_args(argv)
     if args.input is None and (args.node_count is None or args.max_degree is None):
         # mutual-requirement validation (coloring.py:183-184)

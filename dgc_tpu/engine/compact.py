@@ -251,15 +251,35 @@ def _reduce_bucket_result(new_b, fail_mask, act_mask, mc, width: int,
             mc)
 
 
-def _bucket_update(pe, pk_b, cb, p_b, k, v: int):
+def _unconf_max(nb, np_, pk_rows, v: int, real=None):
+    """Max unconfirmed-neighbor count over the ACTIVE gathered rows —
+    the telemetry column (``obs.kernel`` col 4) the manifest-driven
+    tuner bounds hub capture validity with. A neighbor slot counts when
+    its table id is real (< ``v``) and its gathered state is not
+    confirmed; inactive rows contribute 0 (the exact-rule replay's
+    active-row semantics). ``real`` masks compaction dummy slots."""
+    realn = nb < v
+    if real is not None:
+        realn = realn & real[:, None]
+    cnt = jnp.sum(
+        (realn & ~((np_ >= 0) & ((np_ & 1) == 0))).astype(jnp.int32),
+        axis=1)
+    act = (pk_rows < 0) | ((pk_rows & 1) == 1)
+    return jnp.max(jnp.where(act, cnt, 0), initial=0)
+
+
+def _bucket_update(pe, pk_b, cb, p_b, k, v: int, with_unconf: bool = False):
     """One bucket's superstep against the ``pe`` snapshot. Returns
-    (new_pk_b, valid_fail_count, active_count, mc)."""
+    (new_pk_b, valid_fail_count, active_count, mc)[, unconf]."""
     w = cb.shape[1]
     nb, beats = decode_combined(cb)
     np_ = pe[: v + 1][nb]
     new_b, fail_mask, act_mask, mc = speculative_update_mc(
         pk_b, np_, beats, k, p_b)
-    return _reduce_bucket_result(new_b, fail_mask, act_mask, mc, w, p_b, k)
+    out = _reduce_bucket_result(new_b, fail_mask, act_mask, mc, w, p_b, k)
+    if with_unconf:
+        out = out + (_unconf_max(nb, np_, pk_b, v),)
+    return out
 
 
 def _compact_idx(act, pad: int, n: int):
@@ -502,7 +522,8 @@ def _fresh_prune(buckets, hub_buckets: int, planes: tuple, hub_prune: tuple,
     return tuple(out)
 
 
-def _bucket_update_pruned(pe, pk_b, tier, p_b, k, width: int, v: int):
+def _bucket_update_pruned(pe, pk_b, tier, p_b, k, width: int, v: int,
+                          with_unconf: bool = False):
     """Superstep on the captured slots via the pruned tables
     ``tier = (slots, comb, conf)`` (tier 1's rebase capture, or tier 2's
     row-shrunk copy): static confirmed-forbidden planes OR'd with a gather
@@ -526,12 +547,18 @@ def _bucket_update_pruned(pe, pk_b, tier, p_b, k, width: int, v: int):
     new_slot, fail_mask, act_mask, mc = apply_update_mc(
         pk_slot, forb_all | conf, forb_old | conf, clash, k)
     new_b = pk_b.at[slots].set(new_slot, mode="drop")
-    return _reduce_bucket_result(new_b, fail_mask, act_mask, mc, width,
-                                 p_b, k)
+    out = _reduce_bucket_result(new_b, fail_mask, act_mask, mc, width,
+                                p_b, k)
+    if with_unconf:
+        # the pruned table's real entries are exactly the rows' still-
+        # possibly-unconfirmed neighbors (capture invariant above), so
+        # this is the same count the full-width branch would see
+        out = out + (_unconf_max(nb, np_, pk_slot, v),)
+    return out
 
 
 def _bucket_update_shrink(pe, pk_b, tier1, p_b, k, width: int, v: int,
-                          p2: int):
+                          p2: int, with_unconf: bool = False):
     """Tier-2 re-capture + superstep: row-compact tier 1's slot list to a
     ``p2``-pad (same U width — comb/conf rows are carried verbatim) and run
     the pruned superstep on the shrunk tables.
@@ -555,10 +582,12 @@ def _bucket_update_shrink(pe, pk_b, tier1, p_b, k, width: int, v: int,
     comb2 = jnp.where(real2[:, None], comb1[sel_safe], v)
     conf2 = jnp.where(real2[:, None], conf1[sel_safe], 0)
     tier2 = (slots2, comb2, conf2)
-    return _bucket_update_pruned(pe, pk_b, tier2, p_b, k, width, v) + (tier2,)
+    return _bucket_update_pruned(pe, pk_b, tier2, p_b, k, width, v,
+                                 with_unconf) + (tier2,)
 
 
-def _bucket_update_rebase(pe, pk_b, cb, p_b, k, v: int, pad: int, u: int):
+def _bucket_update_rebase(pe, pk_b, cb, p_b, k, v: int, pad: int, u: int,
+                          with_unconf: bool = False):
     """``_bucket_update_compact`` + pruned-state capture from the same
     full-width gather (shared ``_compact_core``): the compacted active rows
     run their superstep, and the PRE-state snapshot yields (slots, ≤U-wide
@@ -584,10 +613,16 @@ def _bucket_update_rebase(pe, pk_b, cb, p_b, k, v: int, pad: int, u: int):
     comb_u = jnp.full((pad, u), v, jnp.int32).at[rows2d, col].set(
         cb_slot, mode="drop")
     conf = forbidden_planes(jnp.where(unconf | ~realn, -1, np_ >> 1), p_b)
-    return new_b, fail, act, mc, (ok.astype(jnp.int32), idx, comb_u, conf)
+    out = (new_b, fail, act, mc, (ok.astype(jnp.int32), idx, comb_u, conf))
+    if with_unconf:
+        # the compacted slots ARE the active rows; cnt is already the
+        # per-slot unconfirmed count of the same snapshot
+        out = out + (jnp.max(jnp.where(real, cnt, 0), initial=0),)
+    return out
 
 
-def _bucket_update_compact(pe, pk_b, cb, p_b, k, v: int, pad: int):
+def _bucket_update_compact(pe, pk_b, cb, p_b, k, v: int, pad: int,
+                           with_unconf: bool = False):
     """``_bucket_update`` on the bucket's ≤ ``pad`` active rows only.
 
     Exact when the bucket's live count ≤ pad (the caller's cond gate;
@@ -595,7 +630,13 @@ def _bucket_update_compact(pe, pk_b, cb, p_b, k, v: int, pad: int):
     themselves, so updating only active rows is the same superstep.
     Dummy slots carry confirmed-0 state (inert: no fail/active/mc
     contribution) and their writes scatter out of range (dropped)."""
-    new_b, fail, act, mc, _ = _compact_core(pe, pk_b, cb, p_b, k, v, pad)
+    new_b, fail, act, mc, (idx, real, cb_slot, np_) = _compact_core(
+        pe, pk_b, cb, p_b, k, v, pad)
+    if with_unconf:
+        nb, _ = decode_combined(cb_slot)
+        pk_slot = jnp.where(real, pk_b[jnp.where(real, idx, 0)], 0)
+        return new_b, fail, act, mc, _unconf_max(nb, np_, pk_slot, v,
+                                                 real=real)
     return new_b, fail, act, mc
 
 
@@ -622,26 +663,37 @@ def _compact_core(pe, pk_b, cb, p_b, k, v: int, pad: int):
 
 
 def _hub_dispatch(pe, ba_bi, pk_b, cb, p_b, k, v: int, ps_b=None,
-                  cfg: tuple | None = None, uncond: bool = False):
+                  cfg: tuple | None = None, uncond: bool = False,
+                  with_unconf: bool = False):
     """Cond ladder for one hub bucket: inert → skip; pruned-valid → gather
     only the captured ≤U unconfirmed neighbors (tier 2's row-shrunk pad
     once the live count fits it); small live count → compacted rows (with
     pruned-state capture when ``cfg`` enables it); else full bucket.
     ``uncond`` buckets (table ≤ ``HUB_UNCOND_ENTRIES``) run the full update
     with no control flow at all — a device-side cond costs more than the
-    gather it would skip. Returns (new_pk_b, fail, act, mc, ps_b')."""
+    gather it would skip. Returns (new_pk_b, fail, act, mc, ps_b')
+    [+ (unconf,) when ``with_unconf`` — a static telemetry choice, so
+    every cond/switch branch agrees on the tuple shape]."""
     vb, w = cb.shape
+    wu = with_unconf
+
+    def _tail(ps, extra):
+        # (…, ps') + the telemetry column when enabled
+        return (ps,) + extra if wu else (ps,)
 
     if uncond:
-        return _bucket_update(pe, pk_b, cb, p_b, k, v) + (ps_b,)
+        r = _bucket_update(pe, pk_b, cb, p_b, k, v, with_unconf=wu)
+        return r[:4] + _tail(ps_b, r[4:])
 
     def skip(op):
         pk_b, ps = op
-        return pk_b, jnp.int32(0), jnp.int32(0), jnp.int32(-1), ps
+        return (pk_b, jnp.int32(0), jnp.int32(0), jnp.int32(-1)) \
+            + _tail(ps, (jnp.int32(0),))
 
     def full(op):
         pk_b, ps = op
-        return _bucket_update(pe, pk_b, cb, p_b, k, v) + (ps,)
+        r = _bucket_update(pe, pk_b, cb, p_b, k, v, with_unconf=wu)
+        return r[:4] + _tail(ps, r[4:])
 
     if cfg is None:
         pad = hub_pad_for(vb)
@@ -650,7 +702,9 @@ def _hub_dispatch(pe, ba_bi, pk_b, cb, p_b, k, v: int, ps_b=None,
 
         def compact(op):
             pk_b, ps = op
-            return _bucket_update_compact(pe, pk_b, cb, p_b, k, v, pad) + (ps,)
+            r = _bucket_update_compact(pe, pk_b, cb, p_b, k, v, pad,
+                                       with_unconf=wu)
+            return r[:4] + _tail(ps, r[4:])
 
         def live(op):
             return jax.lax.cond(ba_bi <= pad, compact, full, op)
@@ -662,12 +716,15 @@ def _hub_dispatch(pe, ba_bi, pk_b, cb, p_b, k, v: int, ps_b=None,
 
     def pruned(op):
         pk_b, ps = op
-        return _bucket_update_pruned(pe, pk_b, ps[1:4], p_b, k, w, v) + (ps,)
+        r = _bucket_update_pruned(pe, pk_b, ps[1:4], p_b, k, w, v,
+                                  with_unconf=wu)
+        return r[:4] + _tail(ps, r[4:])
 
     def rebase(op):
         pk_b, ps = op
-        r = _bucket_update_rebase(pe, pk_b, cb, p_b, k, v, pad, u)
-        return r[:4] + (r[4] + ps[4:],)
+        r = _bucket_update_rebase(pe, pk_b, cb, p_b, k, v, pad, u,
+                                  with_unconf=wu)
+        return r[:4] + _tail(r[4] + ps[4:], r[5:])
 
     if p2 is None:
         if pad >= vb:  # pad covers the bucket: the full branch is unreachable
@@ -680,12 +737,17 @@ def _hub_dispatch(pe, ba_bi, pk_b, cb, p_b, k, v: int, ps_b=None,
 
     def pruned2(op):
         pk_b, ps = op
-        return _bucket_update_pruned(pe, pk_b, ps[4:7], p_b, k, w, v) + (ps,)
+        r = _bucket_update_pruned(pe, pk_b, ps[4:7], p_b, k, w, v,
+                                  with_unconf=wu)
+        return r[:4] + _tail(ps, r[4:])
 
     def shrink(op):
         pk_b, ps = op
-        r = _bucket_update_shrink(pe, pk_b, ps[1:4], p_b, k, w, v, p2)
-        return r[:4] + ((jnp.int32(2),) + ps[1:4] + r[4],)
+        r = _bucket_update_shrink(pe, pk_b, ps[1:4], p_b, k, w, v, p2,
+                                  with_unconf=wu)
+        # tier-2 capture rides LAST in r (``_bucket_update_shrink``);
+        # the telemetry scalar (when on) sits between mc and it
+        return r[:4] + _tail((jnp.int32(2),) + ps[1:4] + r[-1], r[4:5])
 
     branch = jnp.where(
         ba_bi == 0, 0,
@@ -741,29 +803,45 @@ class _SegCtx:
                 [buckets[bi] for bi in self.uncond_idx], self.uncond_plan)
 
 
-def _uncond_hub_step(pe, pk, buckets, row0s: tuple, sc: _SegCtx, k):
+def _uncond_hub_step(pe, pk, buckets, row0s: tuple, sc: _SegCtx, k,
+                     with_unconf: bool = False, v: int | None = None):
     """One superstep of every unconditioned hub bucket from ONE shared
     segmented gather — bit-identical per bucket to ``_bucket_update``
     (same tables, same windows, same ``_reduce_bucket_result`` gating;
     ``ops.segmented_gather`` module docstring). Returns
-    ``{bi: (new_b, fail, act, mc)}``."""
+    ``({bi: (new_b, fail, act, mc)}, unconf)`` — ``unconf`` is the
+    telemetry max-unconfirmed scalar, or None when off/empty."""
     if not sc.uncond_idx:
-        return {}
+        return {}, None
     pk_parts = [
         jax.lax.dynamic_slice_in_dim(pk, row0s[bi], buckets[bi].shape[0])
         for bi in sc.uncond_idx
     ]
     pk_rows = (pk_parts[0] if len(pk_parts) == 1
                else jnp.concatenate(pk_parts))
-    parts = seg.segmented_update_parts(
-        pe, sc.seg_uncond, sc.uncond_plan, pk_rows, k, decode_combined)
-    return {bi: parts[i] for i, bi in enumerate(sc.uncond_idx)}
+    unconf = None
+    if with_unconf:
+        np_flat, beats_flat = seg.segmented_gather(
+            pe, sc.seg_uncond, decode_combined)
+        stats = seg._seg_stats(np_flat, beats_flat, sc.uncond_plan,
+                               pk_rows >> 1)
+        parts = seg.segmented_update_parts(
+            pe, sc.seg_uncond, sc.uncond_plan, pk_rows, k, decode_combined,
+            stats=(np_flat, beats_flat, stats))
+        unconf = seg.plan_unconf_max(sc.seg_uncond, np_flat,
+                                     sc.uncond_plan, pk_rows, v,
+                                     decode_combined)
+    else:
+        parts = seg.segmented_update_parts(
+            pe, sc.seg_uncond, sc.uncond_plan, pk_rows, k, decode_combined)
+    return {bi: parts[i] for i, bi in enumerate(sc.uncond_idx)}, unconf
 
 
 def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
                       hub_buckets: int, prune: tuple = (),
                       hub_prune: tuple = (), hub_uncond: tuple = (),
-                      seg_ctx: _SegCtx | None = None):
+                      seg_ctx: _SegCtx | None = None,
+                      with_unconf: bool = False):
     """One full-table superstep. The first ``hub_buckets`` buckets (the hub
     region: few rows, huge widths) are each wrapped in a ``lax.cond`` on
     their live active count ``ba[bi]`` (exact by frontier monotonicity) —
@@ -778,20 +856,25 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
 
     ``ba`` is int32[hub_buckets (+1 if a flat region exists)]: per-hub-bucket
     actives, then the flat-region total. Returns
-    (new_pe, fail_count, active_count, ba_new, mc, prune_new, gcalls) —
-    ``gcalls`` is the superstep's neighbor-state element-gather call count
-    (the telemetry column, ``obs.kernel``)."""
+    (new_pe, fail_count, active_count, ba_new, mc, prune_new, gcalls,
+    unconf) — ``gcalls`` is the superstep's neighbor-state element-gather
+    call count and ``unconf`` its max-unconfirmed-neighbor scalar (None
+    when ``with_unconf`` is off; the telemetry columns, ``obs.kernel``)."""
     if seg_ctx is None:
         seg_ctx = _SegCtx(buckets, planes, row0s, hub_buckets, hub_uncond)
     new_parts, parts_fail, parts_active, parts_mc = [], [], [], []
     ba_parts = []
     prune_new = []
+    unconf_parts = []
     pk = pe[:v]
     gcalls = jnp.int32(0)
 
-    un = _uncond_hub_step(pe, pk, buckets, row0s, seg_ctx, k)
+    un, un_unconf = _uncond_hub_step(pe, pk, buckets, row0s, seg_ctx, k,
+                                     with_unconf=with_unconf, v=v)
     if un:
         gcalls = gcalls + 1
+        if un_unconf is not None:
+            unconf_parts.append(un_unconf)
     for bi in range(hub_buckets):
         if bi in un:
             new_b, f_b, a_b, m_b = un[bi]
@@ -799,10 +882,14 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
         else:
             cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
             pk_b = jax.lax.dynamic_slice_in_dim(pk, row0, cb.shape[0])
-            new_b, f_b, a_b, m_b, ps_b = _hub_dispatch(
+            out_b = _hub_dispatch(
                 pe, ba[bi], pk_b, cb, p_b, k, v,
                 prune[bi] if bi < len(prune) else None,
-                hub_prune[bi] if bi < len(hub_prune) else None)
+                hub_prune[bi] if bi < len(hub_prune) else None,
+                with_unconf=with_unconf)
+            new_b, f_b, a_b, m_b, ps_b = out_b[:5]
+            if with_unconf:
+                unconf_parts.append(out_b[5])
             gcalls = gcalls + (ba[bi] > 0).astype(jnp.int32)
         new_parts.append(new_b)
         parts_fail.append(f_b)
@@ -815,9 +902,12 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
         flat_row0 = row0s[hub_buckets]
         pk_rows = jax.lax.dynamic_slice_in_dim(
             pk, flat_row0, seg.plan_rows(seg_ctx.flat_plan))
-        new_flat, f_fl, a_fl, m_fl = seg.segmented_update(
+        out_fl = seg.segmented_update(
             pe, seg_ctx.seg_flat, seg_ctx.flat_plan, pk_rows, k,
-            decode_combined)
+            decode_combined, unconf_v=v if with_unconf else None)
+        new_flat, f_fl, a_fl, m_fl = out_fl[:4]
+        if with_unconf:
+            unconf_parts.append(out_fl[4])
         gcalls = gcalls + 1
         new_parts.append(new_flat)
         parts_fail.append(f_fl)
@@ -828,8 +918,10 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
     new_pk = jnp.concatenate(new_parts) if len(new_parts) > 1 else new_parts[0]
     new_pe = jnp.concatenate([new_pk, jnp.array([-1, 0], jnp.int32)])
     mc = parts_mc[0] if len(parts_mc) == 1 else jnp.max(jnp.stack(parts_mc))
+    unconf = (jnp.max(jnp.stack(unconf_parts)) if unconf_parts else
+              (jnp.int32(0) if with_unconf else None))
     return (new_pe, sum(parts_fail), sum(parts_active),
-            jnp.stack(ba_parts), mc, tuple(prune_new), gcalls)
+            jnp.stack(ba_parts), mc, tuple(prune_new), gcalls, unconf)
 
 
 _REC_SLOTS = 4  # prefix-resume ring: pre-states of the last 4 record rounds
@@ -903,7 +995,8 @@ def restore_from_ring(rec, k, first, pe_i, ba_i, step_i, stall_i, act_i):
 def _superstep_epilogue(recstep, rec5, pe, ba, prune, new_pe, ba_new,
                         prune_new, any_fail, active, mc, step,
                         prev_active, stall, stall_window,
-                        trajstep=None, traj=None, gcalls=None):
+                        trajstep=None, traj=None, gcalls=None,
+                        unconf=None):
     """Shared tail of every pipeline superstep body (one definition so the
     fail-revert ordering, stall accounting, rec-ring push, and telemetry
     write cannot drift between the sequential/unified pipelines and the
@@ -915,7 +1008,7 @@ def _superstep_epilogue(recstep, rec5, pe, ba, prune, new_pe, ba_new,
     rec5 = recstep(rec5, pe, ba, step, prev_active, stall, mc, any_fail)
     if trajstep is not None:
         traj = trajstep(traj, step, active, any_fail, mc, ba_new,
-                        gcalls=gcalls)
+                        gcalls=gcalls, unconf=unconf)
     stall = jnp.where(active < prev_active, 0, stall + 1)
     status = status_step(any_fail, active, stall, stall_window)
     new_pe = jnp.where(any_fail, pe, new_pe)
@@ -928,19 +1021,24 @@ def _superstep_epilogue(recstep, rec5, pe, ba, prune, new_pe, ba_new,
 def _hub_region_step(pe, ba, new_pe, prune, buckets, planes: tuple,
                      row0s: tuple, nb_hub: int, hub_prune: tuple,
                      hub_uncond: tuple, k, v: int,
-                     seg_ctx: _SegCtx | None = None):
+                     seg_ctx: _SegCtx | None = None,
+                     with_unconf: bool = False):
     """One superstep of the hub region against the ``pe`` snapshot,
     accumulating each bucket's rows into ``new_pe`` (disjoint row sets).
     The single home of the cond-skipped hub loop — traced once per
     pipeline by ``_unified_pipeline``. Unconditioned buckets fold into
     one shared segmented gather (``_uncond_hub_step``). Returns
-    (new_pe, fails, actives, mcs, prune_new, gcalls) with per-bucket
-    lists."""
+    (new_pe, fails, actives, mcs, prune_new, gcalls, unconf) with
+    per-bucket lists (``unconf`` None when ``with_unconf`` off)."""
     fails, actives, mcs = [], [], []
     prune_new = []
+    unconf_parts = []
     if seg_ctx is None:
         seg_ctx = _SegCtx(buckets, planes, row0s, nb_hub, hub_uncond)
-    un = _uncond_hub_step(pe, pe[:v], buckets, row0s, seg_ctx, k)
+    un, un_unconf = _uncond_hub_step(pe, pe[:v], buckets, row0s, seg_ctx, k,
+                                     with_unconf=with_unconf, v=v)
+    if un_unconf is not None:
+        unconf_parts.append(un_unconf)
     gcalls = jnp.int32(1 if un else 0)
     for bi in range(nb_hub):
         cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
@@ -964,24 +1062,31 @@ def _hub_region_step(pe, ba, new_pe, prune, buckets, planes: tuple,
         def do_hub(op, cb=cb, p_b=p_b, row0=row0, vb=vb, bi=bi, cfg=cfg):
             acc, ps = op
             pk_b = jax.lax.dynamic_slice_in_dim(pe[:v], row0, vb)
-            new_b, f_b, a_b, m_b, ps2 = _hub_dispatch(
-                pe, ba[bi], pk_b, cb, p_b, k, v, ps, cfg)
+            out_b = _hub_dispatch(
+                pe, ba[bi], pk_b, cb, p_b, k, v, ps, cfg,
+                with_unconf=with_unconf)
             return (jax.lax.dynamic_update_slice_in_dim(
-                acc, new_b, row0, axis=0), f_b, a_b, m_b, ps2)
+                acc, out_b[0], row0, axis=0),) + out_b[1:]
 
         def skip_hub(op):
             acc, ps = op
-            return acc, jnp.int32(0), jnp.int32(0), jnp.int32(-1), ps
+            out = (acc, jnp.int32(0), jnp.int32(0), jnp.int32(-1), ps)
+            return out + ((jnp.int32(0),) if with_unconf else ())
 
-        new_pe, f_b, a_b, m_b, ps2 = jax.lax.cond(
+        out_b = jax.lax.cond(
             ba[bi] > 0, do_hub, skip_hub,
             (new_pe, prune[bi] if bi < len(prune) else None))
+        new_pe, f_b, a_b, m_b, ps2 = out_b[:5]
+        if with_unconf:
+            unconf_parts.append(out_b[5])
         gcalls = gcalls + (ba[bi] > 0).astype(jnp.int32)
         fails.append(f_b)
         actives.append(a_b)
         mcs.append(m_b)
         prune_new.append(ps2)
-    return new_pe, fails, actives, mcs, tuple(prune_new), gcalls
+    unconf = (jnp.max(jnp.stack(unconf_parts)) if unconf_parts else
+              (jnp.int32(0) if with_unconf else None))
+    return new_pe, fails, actives, mcs, tuple(prune_new), gcalls, unconf
 
 
 def _check_stage_ladder(stages: tuple, v: int) -> None:
@@ -1156,24 +1261,28 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
         stage_idx = jnp.maximum(stage_idx, desired)
 
         # --- flat-region superstep for the current stage (switch) ---
+        wu = record_traj   # telemetry cols ride only recording kernels
+        zero_u = (jnp.int32(0),) if wu else ()
+
         def make_flat(s):
             scale = stages[s][0]
             if not has_flat:
                 def none_flat(_):
-                    return pe, jnp.int32(0), jnp.int32(0), jnp.int32(-1), \
-                        jnp.int32(0)
+                    return (pe, jnp.int32(0), jnp.int32(0), jnp.int32(-1),
+                            jnp.int32(0)) + zero_u
                 return none_flat
             if scale is None:
                 # full-table phase: the whole flat region as ONE segmented
                 # gather + one bitmask reduction (ops.segmented_gather)
                 def full_flat(_):
                     pk_rows = jax.lax.slice(pe, (flat_row0,), (v,))
-                    new_flat, fail, act, mc = seg.segmented_update(
+                    out = seg.segmented_update(
                         pe, sc.seg_flat, sc.flat_plan, pk_rows, k,
-                        decode_combined)
+                        decode_combined, unconf_v=v if wu else None)
+                    new_flat, fail, act, mc = out[:4]
                     new_pe = jax.lax.dynamic_update_slice_in_dim(
                         pe, new_flat, flat_row0, axis=0)
-                    return new_pe, fail, act, mc, jnp.int32(1)
+                    return (new_pe, fail, act, mc, jnp.int32(1)) + out[4:]
                 return full_flat
 
             pad_s = pads[s]
@@ -1186,29 +1295,32 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
 
                 def do_flat(_):
                     pk_a = pe[gidx_s]
-                    new_a, fail_t, act_t, mc = seg.segmented_update(
-                        pe, seg_s, plan_s, pk_a, k, decode_combined)
+                    out = seg.segmented_update(
+                        pe, seg_s, plan_s, pk_a, k, decode_combined,
+                        unconf_v=v if wu else None)
+                    new_a, fail_t, act_t, mc = out[:4]
                     # dups only at V+1, same value
                     return (pe.at[gidx_s].set(new_a), fail_t, act_t, mc,
-                            jnp.int32(1))
+                            jnp.int32(1)) + out[4:]
 
                 def skip_any(_):
                     return (pe, jnp.int32(0), jnp.int32(0), jnp.int32(-1),
-                            jnp.int32(0))
+                            jnp.int32(0)) + zero_u
 
                 return jax.lax.cond(ba[nb_hub] > 0, do_flat, skip_any, None)
 
             return staged_flat
 
-        new_pe, fail_f, act_fl, mc_f, gc_f = jax.lax.switch(
+        out_f = jax.lax.switch(
             stage_idx, [make_flat(s) for s in range(n_stages)],
             (seg_c, gidx))
+        new_pe, fail_f, act_fl, mc_f, gc_f = out_f[:5]
 
         # --- hub region: traced ONCE for the whole pipeline ---
         (new_pe, h_fails, h_actives, h_mcs, prune_new,
-         gc_h) = _hub_region_step(
+         gc_h, unconf_h) = _hub_region_step(
             pe, ba, new_pe, prune, buckets, planes, row0s, nb_hub,
-            hub_prune, hub_uncond, k, v, seg_ctx=sc)
+            hub_prune, hub_uncond, k, v, seg_ctx=sc, with_unconf=wu)
         ba_parts = list(h_actives)
         if has_flat:
             ba_parts.append(act_fl)
@@ -1218,11 +1330,12 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
         active = sum([act_fl] + h_actives)
         mc = jnp.max(jnp.stack([mc_f] + h_mcs))
         any_fail = fail_count > 0
+        unconf = (jnp.maximum(out_f[5], unconf_h) if wu else None)
         (rec5, stall, status, new_pe, ba_new, prune_new,
          traj) = _superstep_epilogue(
             recstep, rec5, pe, ba, prune, new_pe, ba_new, prune_new,
             any_fail, active, mc, step, prev_active, stall, stall_window,
-            trajstep, traj, gcalls=gc_f + gc_h)
+            trajstep, traj, gcalls=gc_f + gc_h, unconf=unconf)
         return ((new_pe, step + 1, status, active, stall, ba_new)
                 + rec5 + (prune_new, stage_idx, seg_c, gidx, traj))
 
@@ -1314,16 +1427,17 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
             def body(c):
                 pe, step, status, prev_active, stall, ba = c[:6]
                 rec5, prune, traj = c[6:11], c[11], c[12]
-                new_pe, fail_count, active, ba_new, mc, prune_new, gc = (
+                (new_pe, fail_count, active, ba_new, mc, prune_new, gc,
+                 unconf) = (
                     _hybrid_superstep(pe, ba, buckets, row0s, k, planes, v,
                                       nb_hub, prune, hub_prune, hub_uncond,
-                                      seg_ctx=sc))
+                                      seg_ctx=sc, with_unconf=record_traj))
                 any_fail = fail_count > 0
                 (rec5, stall, status, new_pe, ba_new,
                  prune_new, traj) = _superstep_epilogue(
                     recstep, rec5, pe, ba, prune, new_pe, ba_new, prune_new,
                     any_fail, active, mc, step, prev_active, stall,
-                    stall_window, trajstep, traj, gcalls=gc)
+                    stall_window, trajstep, traj, gcalls=gc, unconf=unconf)
                 return ((new_pe, step + 1, status, active, stall, ba_new)
                         + rec5 + (prune_new, traj))
 
@@ -1381,18 +1495,23 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
 
                 def do_flat(acc):
                     pk_a = pe[gidx]
-                    new_a, fail_t, act_t, mc = seg.segmented_update(
-                        pe, seg_s, plan_s, pk_a, k, decode_combined)
+                    out = seg.segmented_update(
+                        pe, seg_s, plan_s, pk_a, k, decode_combined,
+                        unconf_v=v if record_traj else None)
+                    new_a, fail_t, act_t, mc = out[:4]
                     return (acc.at[gidx].set(new_a),  # dups only at V+1, same value
-                            fail_t, act_t, mc)
+                            fail_t, act_t, mc) + out[4:]
 
                 if not has_flat:
                     new_pe, fail_f, act_fl, mc_f = (
                         pe, jnp.int32(0), jnp.int32(0), jnp.int32(-1))
+                    unconf = jnp.int32(0) if record_traj else None
                 else:
                     # no hub: while-cond (active > thresh ≥ 0) already
                     # guarantees flat work exists — run uncond'd
-                    new_pe, fail_f, act_fl, mc_f = do_flat(pe)
+                    out = do_flat(pe)
+                    new_pe, fail_f, act_fl, mc_f = out[:4]
+                    unconf = out[4] if record_traj else None
 
                 ba_new = jnp.stack([act_fl]) if has_flat else ba
                 fail_count = sum([fail_f])
@@ -1404,7 +1523,7 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                     recstep, rec5, pe, ba, prune, new_pe, ba_new, (),
                     any_fail, active, mc, step, prev_active, stall,
                     stall_window, trajstep, traj,
-                    gcalls=jnp.int32(1 if has_flat else 0))
+                    gcalls=jnp.int32(1 if has_flat else 0), unconf=unconf)
                 return ((new_pe, step + 1, status, active, stall, ba_new)
                         + rec5 + (prune_new, traj))
 

@@ -22,7 +22,14 @@ metrics of superstep ``s`` (the engine's step counter):
 - col 3: the superstep's neighbor-state element-gather call count (the
   segmented-plan schedule metric, ``ops.segmented_gather`` /
   ``utils.schedule_model``; −1 where the engine does not compute it);
-- cols 4..4+nb: per-bucket active counts (bucket occupancy) for the
+- col 4: the superstep's max unconfirmed-neighbor count over the rows it
+  gathered (the hub capture-validity bar ``engine.compact`` sizes its
+  pruned widths against; −1 where the engine does not compute it — today
+  only the single-device compact engine records it, and only when
+  telemetry is on). ``tune --from-manifest`` reads this column to bound
+  capture validity instead of pricing it pessimistically at bucket
+  width;
+- cols 5..5+nb: per-bucket active counts (bucket occupancy) for the
   bucketed engines (``nb`` = the engine's bucket-active vector length,
   0 for the flat engines).
 
@@ -42,8 +49,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
-TRAJ_COLS = 4          # active, fail, mc, gather_calls — before the
-                       # bucket-active tail
+TRAJ_COLS = 5          # active, fail, mc, gather_calls, max_unconf —
+                       # before the bucket-active tail
 DEFAULT_TRAJ_CAP = 4096
 
 
@@ -68,22 +75,24 @@ def make_trajstep(record):
     False returns the identity (statically no-op — telemetry-off kernels
     carry no live recording code), True returns the row write.
 
-    ``trajstep(traj, step, active, any_fail, mc, ba, gcalls=...)`` writes
-    row ``step``; out-of-range steps (past the cap) drop on device.
-    ``mc`` / ``ba`` / ``gcalls`` may be None where the engine does not
-    compute them.
+    ``trajstep(traj, step, active, any_fail, mc, ba, gcalls=...,
+    unconf=...)`` writes row ``step``; out-of-range steps (past the cap)
+    drop on device. ``mc`` / ``ba`` / ``gcalls`` / ``unconf`` may be None
+    where the engine does not compute them.
     """
     import jax.numpy as jnp
 
     def trajstep(traj, step, active, any_fail, mc=None, ba=None,
-                 gcalls=None):
+                 gcalls=None, unconf=None):
         if record is False:
             return traj
         cols = [jnp.asarray(active, jnp.int32),
                 jnp.asarray(any_fail, jnp.int32),
                 jnp.int32(-1) if mc is None else jnp.asarray(mc, jnp.int32),
                 jnp.int32(-1) if gcalls is None
-                else jnp.asarray(gcalls, jnp.int32)]
+                else jnp.asarray(gcalls, jnp.int32),
+                jnp.int32(-1) if unconf is None
+                else jnp.asarray(unconf, jnp.int32)]
         row = jnp.stack(cols)
         if ba is not None:
             row = jnp.concatenate([row, jnp.asarray(ba, jnp.int32)])
@@ -100,6 +109,7 @@ class SuperstepTrajectory:
     fail: np.ndarray                   # int32[S] failure flag per superstep
     mc: np.ndarray                     # int32[S] divergence candidate (−1: n/a)
     gather_calls: np.ndarray           # int32[S] neighbor-gather calls (−1: n/a)
+    max_unconf: np.ndarray             # int32[S] max unconfirmed nbrs (−1: n/a)
     bucket_active: np.ndarray | None   # int32[S, nb] bucket occupancy, or None
     first_step: int                    # step index of row 0 (resume offset)
     truncated: bool                    # steps ran past the buffer cap
@@ -113,6 +123,7 @@ class SuperstepTrajectory:
             "fail": self.fail.tolist(),
             "mc": self.mc.tolist(),
             "gather_calls": self.gather_calls.tolist(),
+            "max_unconf": self.max_unconf.tolist(),
             "first_step": self.first_step,
             "truncated": self.truncated,
         }
@@ -133,7 +144,8 @@ def decode_trajectory(buf, supersteps: int | None = None) -> SuperstepTrajectory
     idx = np.flatnonzero(written)
     if len(idx) == 0:
         empty = np.zeros(0, np.int32)
-        return SuperstepTrajectory(empty, empty, empty, empty, None, 0, False)
+        return SuperstepTrajectory(empty, empty, empty, empty, empty,
+                                   None, 0, False)
     lo, hi = int(idx[0]), int(idx[-1]) + 1
     span = buf[lo:hi]
     nb = buf.shape[1] - TRAJ_COLS
@@ -143,6 +155,7 @@ def decode_trajectory(buf, supersteps: int | None = None) -> SuperstepTrajectory
         fail=span[:, 1].astype(np.int32),
         mc=span[:, 2].astype(np.int32),
         gather_calls=span[:, 3].astype(np.int32),
+        max_unconf=span[:, 4].astype(np.int32),
         bucket_active=span[:, TRAJ_COLS:].astype(np.int32) if nb > 0 else None,
         first_step=lo,
         truncated=truncated,

@@ -83,6 +83,22 @@ class RunManifest:
             self.doc["post_reduce"] = fields
         elif kind in ("sweep_done", "sweep_failed"):
             self.doc["result"] = dict(fields, event=kind)
+        elif kind.startswith("serve_"):
+            # serving path (dgc_tpu.serve) — the slot appears only when
+            # serve events do, so non-serve manifests stay byte-identical
+            serve = self.doc.setdefault(
+                "serve", {"config": None, "batches": [], "requests": [],
+                          "health": None, "summary": None})
+            if kind == "serve_start":
+                serve["config"] = fields
+            elif kind == "serve_batch":
+                serve["batches"].append(fields)
+            elif kind == "serve_request":
+                serve["requests"].append(fields)
+            elif kind == "serve_health":
+                serve["health"] = fields
+            elif kind in ("serve_done", "serve_summary"):
+                serve["summary"] = dict(serve["summary"] or {}, **fields)
 
     # -- finalization ---------------------------------------------------
     def finalize(self, phases=None, registry=None) -> dict:

@@ -46,7 +46,8 @@ EVENT_SCHEMAS: dict = {
     "trajectory": (
         {"k": "int", "active": "list", "fail": "list", "mc": "list",
          "first_step": "int", "truncated": "bool"},
-        {"bucket_active": "list", "gather_calls": "list"}),
+        {"bucket_active": "list", "gather_calls": "list",
+         "max_unconf": "list"}),
     "phase": (
         {"name": "str", "seconds": NUM},
         {"k": "int", "attempt_index": "int", "warm": "bool"}),
@@ -82,6 +83,36 @@ EVENT_SCHEMAS: dict = {
     "sweep_failed": ({"initial_k": "int"}, {}),
     "manifest_written": ({"path": "str"}, {}),
     "metrics_written": ({"path": "str"}, {}),
+    # serving path (dgc_tpu.serve): micro-batching front-end lifecycle,
+    # per-batch occupancy/padding accounting, per-request latency, and
+    # the supervisor-rung-fed health snapshots
+    "serve_start": (
+        {"batch_max": "int", "window_ms": NUM, "queue_depth": "int",
+         "workers": "int"}, {}),
+    "serve_batch": (
+        {"shape_class": "str", "batch": "int", "occupancy": NUM,
+         "padding_waste": NUM},
+        {"b_pad": "int", "compile_cache": "str", "device_ms": NUM,
+         "queue_ms_max": NUM}),
+    "serve_request": (
+        {"request_id": "int", "status": "str", "queue_ms": NUM,
+         "service_ms": NUM},
+        {"minimal_colors": ("int", "null"), "v": "int",
+         "shape_class": ("str", "null"), "batched": "bool",
+         "attempts": "int", "error": ("str", "null")}),
+    "serve_health": (
+        {"ready": "bool", "queue_depth": "int"},
+        {"in_flight": "int", "capacity": "int", "degraded": "bool",
+         "backend": ("str", "null"), "rung": ("int", "null"),
+         "retry_pressure": "int"}),
+    "serve_done": (
+        {"requests": "int", "completed": "int", "failed": "int"},
+        {"rejected": "int"}),
+    "serve_summary": (
+        {"requests": "int", "completed": "int", "failed": "int",
+         "wall_s": NUM},
+        {"rejected": "int", "graphs_per_s": (*NUM, "null"),
+         "batches": "int", "compile_misses": "int", "compile_hits": "int"}),
 }
 
 

@@ -200,7 +200,32 @@ def _pad_planes(planes_arr, p: int):
     return jnp.concatenate([planes_arr, pad], axis=-1)
 
 
-def segmented_update(pe_src, seg_comb, plan: tuple, pk_rows, k, decode):
+def plan_unconf_max(seg_comb, np_flat, plan: tuple, pk_rows, v: int,
+                    decode):
+    """Max unconfirmed-neighbor count over the plan's ACTIVE rows, from
+    the already-gathered flat neighbor state — the telemetry column
+    (``obs.kernel`` col 4) that bounds hub capture validity. A neighbor
+    slot counts when it is real (id < ``v`` — the tables' pad sentinel
+    is ``v``) and its gathered state is not confirmed. Rows currently
+    inactive contribute 0 (the exact-rule replay's "over active rows"
+    semantics, ``utils.trajectory``)."""
+    nb, _ = decode(seg_comb)
+    unconf_flat = ((nb < v)
+                   & ~((np_flat >= 0) & ((np_flat & 1) == 0))
+                   ).astype(jnp.int32)
+    act = (pk_rows < 0) | ((pk_rows & 1) == 1)
+    mx = jnp.int32(0)
+    for s in plan:
+        blk = jax.lax.slice(unconf_flat, (s.flat0,),
+                            (s.flat0 + s.rows * s.width,))
+        cnt = jnp.sum(blk.reshape(s.rows, s.width), axis=1)
+        act_s = jax.lax.slice(act, (s.row0,), (s.row0 + s.rows,))
+        mx = jnp.maximum(mx, jnp.max(jnp.where(act_s, cnt, 0), initial=0))
+    return mx
+
+
+def segmented_update(pe_src, seg_comb, plan: tuple, pk_rows, k, decode,
+                     unconf_v: int | None = None):
     """One whole-plan superstep: one gather + one forbidden-bitmask
     reduction over the live set.
 
@@ -209,11 +234,15 @@ def segmented_update(pe_src, seg_comb, plan: tuple, pk_rows, k, decode):
     running the per-segment loop (gated per segment by :func:`fail_gate`),
     via the collapsed single ``apply_update_mc`` when
     :func:`plan_collapsible` holds, else per-segment applies (module
-    docstring exactness argument).
+    docstring exactness argument). ``unconf_v`` (the sentinel id ``v``,
+    telemetry only) appends :func:`plan_unconf_max` to the tuple.
     """
     np_flat, beats_flat = segmented_gather(pe_src, seg_comb, decode)
     mycol = pk_rows >> 1
     stats = _seg_stats(np_flat, beats_flat, plan, mycol)
+    unconf = (() if unconf_v is None else
+              (plan_unconf_max(seg_comb, np_flat, plan, pk_rows,
+                               unconf_v, decode),))
 
     if plan_collapsible(plan):
         p = plan_max_planes(plan)
@@ -223,7 +252,7 @@ def segmented_update(pe_src, seg_comb, plan: tuple, pk_rows, k, decode):
         new_rows, fail_mask, act_mask, mc = apply_update_mc(
             pk_rows, forb_all, forb_old, clash, k)
         return (new_rows, jnp.sum(fail_mask.astype(jnp.int32)),
-                jnp.sum(act_mask.astype(jnp.int32)), mc)
+                jnp.sum(act_mask.astype(jnp.int32)), mc) + unconf
 
     parts = segmented_update_parts(
         pe_src, seg_comb, plan, pk_rows, k, decode,
@@ -234,7 +263,7 @@ def segmented_update(pe_src, seg_comb, plan: tuple, pk_rows, k, decode):
     act = sum(p_[2] for p_ in parts)
     mc = (parts[0][3] if len(parts) == 1
           else jnp.max(jnp.stack([p_[3] for p_ in parts])))
-    return new_rows, fail, act, mc
+    return (new_rows, fail, act, mc) + unconf
 
 
 def segmented_update_parts(pe_src, seg_comb, plan: tuple, pk_rows, k,

@@ -110,6 +110,50 @@ class ResilienceStats:
                 "rungs_tried": list(self.rungs_tried)}
 
 
+class RungState:
+    """Thread-safe live view of the supervisor's position on the engine
+    ladder — the serving path's health/readiness feed (ROADMAP
+    "Serving-path hooks"): ``serve.queue.ServeFrontEnd`` exposes this
+    through its ``health()`` endpoint, so a pod probe sees "degraded to
+    rung 2 (ell-compact), 3 retries burned" instead of a silent slowdown.
+
+    ``degraded`` is True once any fallback happened; ``retry_pressure``
+    counts transient retries on the current rung; ``ready`` goes False
+    only when the ladder is exhausted (a degraded-but-serving process
+    stays ready)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.backend: str | None = None
+        self.rung_index: int = 0
+        self.retry_pressure: int = 0
+        self.degraded: bool = False
+        self.exhausted: bool = False
+
+    def on_rung(self, backend: str, index: int) -> None:
+        with self._lock:
+            self.backend = backend
+            self.rung_index = index
+            self.retry_pressure = 0
+            if index > 0:
+                self.degraded = True
+
+    def on_retry(self) -> None:
+        with self._lock:
+            self.retry_pressure += 1
+
+    def on_exhausted(self) -> None:
+        with self._lock:
+            self.exhausted = True
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"backend": self.backend, "rung": self.rung_index,
+                    "retry_pressure": self.retry_pressure,
+                    "degraded": self.degraded,
+                    "ready": not self.exhausted}
+
+
 class RetryingEngine:
     """Engine proxy: fault points + soft timeout + transient retry.
 
@@ -121,7 +165,8 @@ class RetryingEngine:
                  budget: RetryBudget | None = None,
                  attempt_timeout_s: float = 0.0,
                  logger=None, registry=None,
-                 stats: ResilienceStats | None = None):
+                 stats: ResilienceStats | None = None,
+                 rung_state: RungState | None = None):
         self._engine = engine
         self._backend = backend
         self._policy = policy or RetryPolicy()
@@ -131,6 +176,7 @@ class RetryingEngine:
         self._logger = logger
         self._registry = registry
         self.stats = stats if stats is not None else ResilienceStats()
+        self._rung_state = rung_state
         self._cold = True
         if hasattr(engine, "sweep"):
             self.sweep = self._sweep
@@ -203,6 +249,8 @@ class RetryingEngine:
                     raise RungFailure(self._backend, ecls, e) from e
                 delay = next(self._delays)
                 self.stats.retries += 1
+                if self._rung_state is not None:
+                    self._rung_state.on_retry()
                 if self._registry is not None:
                     self._registry.counter(
                         "dgc_retries_total", "transient-error retries",
@@ -230,6 +278,7 @@ def supervise_sweep(
     attempt_timeout_s: float = 0.0,
     logger=None,
     registry=None,
+    rung_state: RungState | None = None,
 ):
     """Run the minimal-k sweep down an engine ladder.
 
@@ -247,6 +296,8 @@ def supervise_sweep(
     names = [name for name, _ in ladder]
     for idx, (name, factory) in enumerate(ladder):
         stats.rungs_tried.append(name)
+        if rung_state is not None:
+            rung_state.on_rung(name, idx)
         try:
             engine = factory()
             ckpt = make_checkpoint(name) if make_checkpoint is not None else None
@@ -259,7 +310,8 @@ def supervise_sweep(
                 engine, backend=name, policy=policy,
                 budget=RetryBudget(retry_budget),
                 attempt_timeout_s=attempt_timeout_s,
-                logger=logger, registry=registry, stats=stats)
+                logger=logger, registry=registry, stats=stats,
+                rung_state=rung_state)
             result = find_minimal_coloring(
                 wrapped, initial_k,
                 strict_decrement=strict_decrement, k_min=k_min,
@@ -288,6 +340,8 @@ def supervise_sweep(
                 if logger is not None:
                     logger.event("fallback", from_backend=name, to_backend=nxt,
                                  error_class=ecls.value, error=str(cause))
+    if rung_state is not None:
+        rung_state.on_exhausted()
     raise SweepAbort(
         f"engine ladder exhausted after {len(names)} rung(s): "
         f"{' -> '.join(names)}",

@@ -1,0 +1,40 @@
+"""Batched multi-graph serving path (the throughput engine).
+
+Every engine before this one colors ONE graph per run: the minimal-k
+driver dispatches one fused sweep at a time, and the PR 3/4 levers attack
+that single sweep's gather volume. The serving regime the ROADMAP north
+star names — small/medium graphs arriving as requests — is dominated by a
+different cost entirely: per-request XLA compile (every graph's bucket
+layout is a fresh static shape), per-dispatch overhead, and the
+per-request host loop. This package amortizes all three:
+
+- :mod:`~dgc_tpu.serve.shape_classes` — pad arbitrary request graphs into
+  a small geometric ladder of ``(V_pad, W_pad)`` classes, so any request
+  stream hits a bounded set of compiled kernels;
+- :mod:`~dgc_tpu.serve.batched` — a ``jax.vmap``'d fused jump-mode sweep
+  (batch axis over graphs, per-graph phase/k/done bookkeeping in the
+  while-loop carry) that colors B graphs in ONE device dispatch,
+  per-graph bit-identical to the single-graph fused engines;
+- :mod:`~dgc_tpu.serve.engine` — the sweep scheduler: groups concurrent
+  sweep calls by shape class, pads batches, and owns the compile cache
+  (keyed by shape class × batch pad) plus the tuned-config cache hook;
+- :mod:`~dgc_tpu.serve.queue` — the micro-batching front-end: bounded
+  request queue with a batching window and backpressure, worker loop,
+  per-request latency accounting, health/readiness fed by the resilience
+  supervisor's rung state (``dgc-tpu serve`` CLI in
+  :mod:`~dgc_tpu.serve.cli`).
+"""
+
+from dgc_tpu.serve.shape_classes import (  # noqa: F401
+    DEFAULT_LADDER,
+    ShapeClass,
+    ShapeLadder,
+    pad_member,
+)
+from dgc_tpu.serve.engine import BatchScheduler, ServeError  # noqa: F401
+from dgc_tpu.serve.queue import (  # noqa: F401
+    QueueFull,
+    ServeFrontEnd,
+    ServeRequest,
+    ServeResult,
+)

@@ -1,0 +1,181 @@
+"""vmap'd fused jump-mode sweep — B graphs per device dispatch.
+
+One shape class's batch runs as ``jax.vmap`` over a single-graph fused
+pair (:func:`_sweep_pair_one`): the whole jump-mode sweep — attempt(k0),
+then the confirm attempt at (colors_used − 1) — is ONE flat
+``lax.while_loop`` whose carry holds each graph's phase, budget k, live
+attempt state, and both result slots. Under vmap the loop's batching
+rule runs the body until every graph's cond is false and freezes
+finished graphs with per-element selects, so graphs advance through
+their own supersteps, phase transitions, and per-graph ``max_steps``
+clamps independently — the per-graph done/superstep masking is the
+carry, not host logic.
+
+**Bit-identity contract** (locked by ``tools/serve_parity.jsonl`` and
+``tests/test_serve.py``): every graph's colors, superstep counts, and
+statuses are byte-identical to the single-graph fused engines
+(``CompactFrontierEngine.sweep`` / ``BucketedELLEngine``). The argument:
+
+- *Priority*: ``beats_rule``'s (degree desc, id asc) order is invariant
+  under the bucketed engines' stable degree-descending relabeling
+  (within equal degree the stable sort preserves id order; across
+  degrees ids don't matter), so the original-id ``beats`` masks here
+  adjudicate every conflict identically.
+- *Windows*: the class window covers ``W_pad + 1 ≥ deg + 1`` colors for
+  every row, so first-fit candidates, clash masks, and failure
+  detection match the bucketed engines' per-bucket windows per vertex
+  (free bits above a vertex's degree are never selected, and
+  ``fail_gate`` passes for covering windows — the
+  ``ops.segmented_gather`` collapsed-path argument).
+- *Padding*: dummy rows start confirmed (degree 0 → color 0), are
+  pointed at by no real row, and the sentinel slot holds −1 — zero
+  contribution to any count, mask, or status.
+- *Schedule*: one full-table superstep per round with the shared
+  ``speculative_update_mc`` core and ``status_step`` transition, the
+  same round-1 specialization, the same stall window, and the
+  single-graph ``max_steps = 2·V_real + 4`` carried per graph — so the
+  per-superstep aggregate counts (hence statuses, hence supersteps)
+  equal the single-graph engines'. The confirm attempt runs from
+  scratch, which the prefix-resume contract defines as bit-identical to
+  the resumed confirm (``engine.compact._sweep_kernel_staged``).
+
+The kernel records no in-kernel trajectory: serve telemetry is
+batch/request-grained (``obs`` ``serve_batch``/``serve_request``
+events), and the bit-identity ensemble checks serve telemetry on/off.
+"""
+
+from __future__ import annotations
+
+from functools import partial
+
+import jax
+import jax.numpy as jnp
+import numpy as np
+
+from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+from dgc_tpu.engine.bucketed import decode_combined, initial_packed, status_step
+from dgc_tpu.ops.speculative import speculative_update_mc
+
+_RUNNING = AttemptStatus.RUNNING
+_SUCCESS = AttemptStatus.SUCCESS
+_FAILURE = AttemptStatus.FAILURE
+_STALLED = AttemptStatus.STALLED
+
+DEFAULT_STALL_WINDOW = 64  # the engines' shared defensive exit
+
+
+def _sweep_pair_one(comb, degrees, k0, max_steps, *, planes: int,
+                    stall_window: int):
+    """One graph's fused jump-mode pair (vmapped by the batch kernel).
+
+    Returns ``(packed1, steps1, status1, used, packed2, steps2,
+    status2)`` — the fused sweep kernels' shared convention
+    (``engine.compact._sweep_kernel_staged``): slot 2 echoes the
+    all-zero scratch state when the confirm was skipped (host fabricates
+    the k=0 FAILURE, ``engine.fused.finish_sweep_pair``)."""
+    v = degrees.shape[0]
+    nbr, beats = decode_combined(comb)
+    packed0 = initial_packed(degrees)
+    zeros = jnp.zeros_like(packed0)
+    z = jnp.int32(0)
+    init = (z, jnp.asarray(k0, jnp.int32),
+            packed0, jnp.int32(1), jnp.int32(v + 1), z,  # live: packed, step, prev_active, stall
+            zeros, z, z,                                 # slot 1: packed1, steps1, status1
+            z,                                           # used
+            zeros, z, jnp.int32(_FAILURE))               # slot 2
+
+    def cond(c):
+        return c[0] < 2
+
+    def body(c):
+        (phase, k, packed, step, prev_active, stall,
+         p1, s1, st1, used, p2, s2, st2) = c
+        first = phase == 0
+
+        # --- one full-table superstep (BSP snapshot semantics) ---
+        pe = jnp.concatenate([packed, jnp.array([-1], jnp.int32)])
+        np_ = pe[nbr]
+        new_packed, fail_mask, act_mask, _mc = speculative_update_mc(
+            packed, np_, beats, k, planes)
+        fail_count = jnp.sum(fail_mask.astype(jnp.int32))
+        active = jnp.sum(act_mask.astype(jnp.int32))
+        any_fail = fail_count > 0
+        stall_new = jnp.where(active < prev_active, 0, stall + 1)
+        status_new = status_step(any_fail, active, stall_new, stall_window)
+        new_packed = jnp.where(any_fail, packed, new_packed)
+        step_new = step + 1
+
+        # the single-graph host loop's exit + STALLED clamp, per graph
+        fin = (status_new != _RUNNING) | (step_new >= max_steps)
+        status_fin = jnp.where((status_new == _RUNNING) & fin,
+                               jnp.int32(_STALLED), status_new)
+
+        # --- attempt boundary: store the slot, derive the confirm ---
+        colors = jnp.where(new_packed >= 0, new_packed >> 1, -1)
+        used_new = jnp.where(fin & first,
+                             jnp.max(colors, initial=-1) + 1, used)
+        k2 = used_new - 1
+        run2 = fin & first & (status_fin == _SUCCESS) & (k2 >= 1)
+
+        store1 = fin & first
+        store2 = fin & ~first
+        return (
+            jnp.where(fin, jnp.where(run2, 1, 2), phase).astype(jnp.int32),
+            jnp.where(run2, k2, k).astype(jnp.int32),
+            jnp.where(fin, packed0, new_packed),
+            jnp.where(fin, 1, step_new).astype(jnp.int32),
+            jnp.where(fin, v + 1, active).astype(jnp.int32),
+            jnp.where(fin, 0, stall_new).astype(jnp.int32),
+            jnp.where(store1, new_packed, p1),
+            jnp.where(store1, step_new, s1).astype(jnp.int32),
+            jnp.where(store1, status_fin, st1).astype(jnp.int32),
+            used_new,
+            jnp.where(store2, new_packed, p2),
+            jnp.where(store2, step_new, s2).astype(jnp.int32),
+            jnp.where(store2, status_fin, st2).astype(jnp.int32),
+        )
+
+    out = jax.lax.while_loop(cond, body, init)
+    (_, _, _, _, _, _, p1, s1, st1, used, p2, s2, st2) = out
+    return p1, s1, st1, used, p2, s2, st2
+
+
+@partial(jax.jit, static_argnames=("planes", "stall_window"))
+def batched_sweep_kernel(comb, degrees, k0, max_steps, planes: int,
+                         stall_window: int = DEFAULT_STALL_WINDOW):
+    """The class kernel: ``comb int32[B, V_pad, W_pad]``, ``degrees
+    int32[B, V_pad]``, per-graph ``k0``/``max_steps`` int32[B]. One jit
+    cache entry per (B, V_pad, W_pad, planes) — the serve compile cache's
+    key (``serve.engine``)."""
+    return jax.vmap(partial(_sweep_pair_one, planes=planes,
+                            stall_window=stall_window))(
+        comb, degrees, k0, max_steps)
+
+
+def finish_pair(member, p1, s1, st1, used, p2, s2, st2, attempt_fallback):
+    """Host epilogue for one member — mirrors the single-graph
+    ``CompactFrontierEngine.sweep`` + ``engine.fused.finish_sweep_pair``
+    contract exactly: no confirm after a non-success first attempt,
+    ``k2 < 1`` fabricates the trivial empty-budget FAILURE, a STALLED
+    confirm falls back to ``attempt_fallback(k2)`` (the single-graph
+    attempt owns the widen-and-retry loop; unreachable for covering
+    windows short of a genuine stall).
+
+    Colors are already in original vertex ids (no relabeling); rows past
+    the real V are padding and trimmed here."""
+    from dgc_tpu.engine.fused import finish_sweep_pair
+
+    v = member.num_vertices
+
+    def _finish(packed, status, steps, k) -> AttemptResult:
+        packed = np.asarray(packed)[:v]
+        colors = np.where(packed >= 0, packed >> 1, -1).astype(np.int32)
+        return AttemptResult(AttemptStatus(int(status)), colors,
+                             int(steps), int(k))
+
+    first = _finish(p1, st1, s1, member.k0)
+    return finish_sweep_pair(
+        first, int(used), int(st2),
+        lambda k2: _finish(p2, st2, s2, k2),
+        v, attempt_fallback,
+    )

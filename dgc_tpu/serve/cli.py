@@ -1,0 +1,204 @@
+"""``dgc-tpu serve`` — the micro-batching request-replay front-end CLI.
+
+Reads a JSONL request stream (one request per line), serves it through
+:class:`~dgc_tpu.serve.queue.ServeFrontEnd`, and writes one JSONL result
+line per request. Request lines are either
+
+- ``{"id": 3, "input": "graph.json"}`` — a reference-schema graph file;
+- ``{"id": 4, "node_count": 1000, "max_degree": 16, "seed": 5,
+  "gen_method": "fast"}`` — a generated graph (the CLI generator flags
+  as JSON fields).
+
+The CLI exists for offline replay (load tests, the bench harness, the
+1k-request soak) — a network listener is a thin shim over the same
+``ServeFrontEnd`` API. Observability mirrors the main driver:
+``--log-json`` / ``--run-manifest`` / ``--metrics-prom`` land the
+``serve_*`` events in the same stream/manifest/metrics the sweep CLI
+uses (``tools/report_run.py`` renders the serve section; ``tools/
+tail_run.py --follow`` watches it live while the loop runs).
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+import time
+from pathlib import Path
+
+import numpy as np
+
+from dgc_tpu.models.graph import Graph
+
+
+def build_serve_parser() -> argparse.ArgumentParser:
+    p = argparse.ArgumentParser(
+        prog="dgc-tpu serve",
+        description="Batched multi-graph serving front-end (request replay).",
+    )
+    p.add_argument("--requests", type=str, required=True,
+                   help="JSONL request stream (module docstring schema)")
+    p.add_argument("--results", type=str, default=None,
+                   help="write per-request JSONL results here "
+                        "(default: stdout)")
+    p.add_argument("--output-colorings", type=str, default=None,
+                   metavar="DIR",
+                   help="also save each ok request's coloring as "
+                        "DIR/<id>.json (reference coloring schema)")
+    p.add_argument("--batch-max", type=int, default=8,
+                   help="max graphs per batched dispatch (default 8)")
+    p.add_argument("--window-ms", type=float, default=2.0,
+                   help="micro-batching window in milliseconds: how long "
+                        "a pending sweep waits for same-class company "
+                        "(default 2)")
+    p.add_argument("--queue-depth", type=int, default=64,
+                   help="bounded request queue capacity; submissions "
+                        "beyond it shed with backpressure (default 64)")
+    p.add_argument("--workers", type=int, default=None,
+                   help="in-flight request bound (default: --batch-max)")
+    p.add_argument("--submit-timeout", type=float, default=30.0,
+                   help="seconds a submission may wait for queue space "
+                        "before it is rejected (default 30)")
+    p.add_argument("--no-reduce-colors", action="store_true",
+                   help="disable the recolor post-pass (CLI parity)")
+    p.add_argument("--no-validate", action="store_true",
+                   help="skip ground-truth validation per request")
+    p.add_argument("--auto-tune", action="store_true",
+                   help="tune single-graph fallback schedules, cached by "
+                        "graph-shape hash (recurring shapes skip the "
+                        "replay)")
+    p.add_argument("--tuned-cache-dir", type=str, default=None,
+                   help="on-disk tuned-config cache directory "
+                        "(with --auto-tune)")
+    p.add_argument("--log-json", type=str, default=None,
+                   help="write the structured JSONL run log")
+    p.add_argument("--run-manifest", type=str, default=None,
+                   help="write the run manifest (serve slot included)")
+    p.add_argument("--metrics-prom", type=str, default=None,
+                   help="write metrics in Prometheus text format")
+    return p
+
+
+def _load_request_graph(doc: dict) -> Graph:
+    if "input" in doc:
+        return Graph.deserialize(doc["input"])
+    if "node_count" in doc and "max_degree" in doc:
+        return Graph.generate(int(doc["node_count"]), int(doc["max_degree"]),
+                              seed=doc.get("seed"),
+                              method=doc.get("gen_method", "fast"))
+    raise ValueError(
+        "request needs either 'input' or 'node_count'+'max_degree'")
+
+
+def serve_main(argv: list[str] | None = None) -> int:
+    args = build_serve_parser().parse_args(argv)
+
+    from dgc_tpu.obs import MetricsRegistry, RunLogger, RunManifest
+    from dgc_tpu.serve.queue import QueueFull, ServeFrontEnd
+
+    logger = RunLogger(jsonl_path=args.log_json)
+    registry = MetricsRegistry()
+    manifest = RunManifest()
+    logger.add_sink(manifest)
+    tuned_cache = None
+    if args.auto_tune and args.tuned_cache_dir:
+        from dgc_tpu.tune.cache import TunedConfigCache
+
+        tuned_cache = TunedConfigCache(args.tuned_cache_dir)
+
+    try:
+        lines = Path(args.requests).read_text().splitlines()
+    except OSError as e:
+        print(f"Cannot read --requests {args.requests}: {e}",
+              file=sys.stderr)
+        return 2
+    requests = []
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+            if not isinstance(doc, dict):
+                raise ValueError("request line must be a JSON object")
+            requests.append((doc.get("id", lineno), doc))
+        except (json.JSONDecodeError, ValueError) as e:
+            print(f"{args.requests}:{lineno}: bad request: {e}",
+                  file=sys.stderr)
+            return 2
+
+    out_dir = Path(args.output_colorings) if args.output_colorings else None
+    if out_dir is not None:
+        out_dir.mkdir(parents=True, exist_ok=True)
+    results_fh = open(args.results, "w") if args.results else sys.stdout
+
+    front = ServeFrontEnd(
+        batch_max=args.batch_max, window_s=args.window_ms / 1e3,
+        queue_depth=args.queue_depth, workers=args.workers,
+        validate=not args.no_validate,
+        post_reduce=not args.no_reduce_colors,
+        auto_tune=args.auto_tune, tuned_cache=tuned_cache,
+        logger=logger, registry=registry,
+    ).start()
+
+    t0 = time.perf_counter()
+    bad = 0
+    tickets = []
+    graphs = {}
+    for rid, doc in requests:
+        try:
+            graph = _load_request_graph(doc)
+        except (OSError, ValueError, KeyError) as e:
+            bad += 1
+            results_fh.write(json.dumps(
+                {"id": rid, "status": "error",
+                 "error": f"bad request: {e}"}) + "\n")
+            continue
+        graphs[rid] = graph
+        try:
+            tickets.append(front.submit(graph.arrays, request_id=rid,
+                                        timeout=args.submit_timeout))
+        except QueueFull as e:
+            bad += 1
+            results_fh.write(json.dumps(
+                {"id": rid, "status": "rejected", "error": str(e)}) + "\n")
+    for ticket in tickets:
+        res = ticket.result()
+        rid = res.request_id
+        rec = {"id": rid, "status": res.status,
+               "minimal_colors": res.minimal_colors,
+               "queue_ms": round(res.queue_s * 1e3, 3),
+               "service_ms": round(res.service_s * 1e3, 3),
+               "batched": res.batched, "shape_class": res.shape_class,
+               "error": res.error}
+        if res.ok and out_dir is not None:
+            path = out_dir / f"{rid}.json"
+            graphs[rid].save_coloring(path, np.asarray(res.colors))
+            rec["coloring"] = str(path)
+        if not res.ok:
+            bad += 1
+        results_fh.write(json.dumps(rec) + "\n")
+    front.health(emit=True)
+    front.shutdown(drain=True)
+    wall = time.perf_counter() - t0
+
+    done = front.stats["completed"]
+    logger.event("serve_summary", requests=len(requests), completed=done,
+                 failed=front.stats["failed"],
+                 rejected=front.stats["rejected"],
+                 wall_s=round(wall, 4),
+                 graphs_per_s=round(done / wall, 3) if wall > 0 else None,
+                 batches=front.scheduler.stats["batches"],
+                 compile_misses=front.scheduler.stats["compile_misses"],
+                 compile_hits=front.scheduler.stats["compile_hits"])
+    if args.run_manifest:
+        manifest.finalize(registry=registry)
+        manifest.write(args.run_manifest)
+        logger.event("manifest_written", path=args.run_manifest)
+    if args.metrics_prom:
+        registry.write_prom(args.metrics_prom)
+        logger.event("metrics_written", path=args.metrics_prom)
+    if results_fh is not sys.stdout:
+        results_fh.close()
+    logger.close()
+    return 1 if bad else 0

@@ -1,0 +1,274 @@
+"""Batch scheduler: shape-class batching, compile cache, fallback path.
+
+The front-end (``serve.queue``) runs one ``find_minimal_coloring`` per
+request on a worker thread — the exact jump-mode driver the CLI uses, so
+attempt sequences, validation, and the recolor post-pass are the
+single-graph semantics by construction. Each worker's engine is a
+:class:`BatchMemberEngine` proxy whose ``sweep(k)`` does not dispatch:
+it enqueues the (member, k) call with the :class:`BatchScheduler` and
+blocks. The scheduler's dispatcher thread collects concurrent sweep
+calls for the *same shape class* inside the batching window, pads the
+batch to a power-of-two ``b_pad``, and runs them all in ONE
+``batched_sweep_kernel`` dispatch.
+
+Caches (the per-request costs this path amortizes):
+
+- **compile cache** — one executable per ``(class, b_pad)``; recurring
+  shapes skip XLA entirely (hit/miss lands in the ``serve_batch``
+  event);
+- **tuned-config cache** (``dgc_tpu.tune.cache``) — the single-graph
+  fallback path (graphs beyond the shape ladder) keys tuned schedules by
+  graph-shape hash, so recurring shapes skip the tuner replay too (the
+  ROADMAP serving-path item).
+
+The fallback path also feeds the resilience supervisor's rung state when
+a ladder is configured: a request that degrades off its primary engine
+flips the front-end's health (``resilience.supervisor.RungState``).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+
+import numpy as np
+
+from dgc_tpu.engine.base import AttemptResult, empty_budget_failure
+from dgc_tpu.serve.batched import (
+    DEFAULT_STALL_WINDOW,
+    batched_sweep_kernel,
+    finish_pair,
+)
+from dgc_tpu.serve.shape_classes import dummy_member, padding_waste
+
+
+class ServeError(RuntimeError):
+    """A request the serving path cannot complete (engine error after
+    fallback, scheduler shut down mid-call)."""
+
+
+def _pow2_ceil(n: int) -> int:
+    return 1 << max(0, (int(n) - 1).bit_length())
+
+
+class _SweepCall:
+    __slots__ = ("member", "k", "done", "result", "error", "t_enqueue")
+
+    def __init__(self, member, k):
+        self.member = member
+        self.k = int(k)
+        self.done = threading.Event()
+        self.result = None
+        self.error = None
+        self.t_enqueue = time.perf_counter()
+
+
+class BatchScheduler:
+    """Groups concurrent sweep calls by shape class into one dispatch.
+
+    ``window_s`` is the micro-batching window: once a class has a
+    pending call, the dispatcher waits up to the window for more of the
+    same class (or ``batch_max``) before dispatching — the classic
+    latency-for-throughput knob. ``on_batch(record)`` observes every
+    dispatch (the front-end forwards it into the obs event stream)."""
+
+    def __init__(self, *, batch_max: int = 8, window_s: float = 0.002,
+                 stall_window: int = DEFAULT_STALL_WINDOW,
+                 on_batch=None):
+        if batch_max < 1:
+            raise ValueError(f"batch_max must be >= 1, got {batch_max}")
+        self.batch_max = int(batch_max)
+        self.window_s = float(window_s)
+        self.stall_window = int(stall_window)
+        self.on_batch = on_batch
+        self._lock = threading.Condition()
+        self._pending: dict = {}   # class -> [_SweepCall]
+        self._kernels: dict = {}   # (v_pad, w_pad, planes, b_pad) -> fn
+        self._dummies: dict = {}   # class -> ServeMember
+        self._stop = False
+        self._thread = None
+        self.stats = {"batches": 0, "sweeps": 0, "compile_hits": 0,
+                      "compile_misses": 0}
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "BatchScheduler":
+        if self._thread is None:
+            self._thread = threading.Thread(target=self._loop, daemon=True,
+                                            name="dgc-serve-batcher")
+            self._thread.start()
+        return self
+
+    def stop(self) -> None:
+        with self._lock:
+            self._stop = True
+            self._lock.notify_all()
+        if self._thread is not None:
+            self._thread.join(timeout=30)
+            self._thread = None
+        # calls stranded by shutdown fail loudly, not silently
+        with self._lock:
+            for calls in self._pending.values():
+                for call in calls:
+                    call.error = ServeError("batch scheduler stopped")
+                    call.done.set()
+            self._pending.clear()
+
+    # -- submission (worker threads) ------------------------------------
+    def sweep(self, member, k: int):
+        """Blocking batched sweep: returns the raw per-member kernel
+        outputs ``(p1, s1, st1, used, p2, s2, st2)``."""
+        call = _SweepCall(member, k)
+        with self._lock:
+            if self._stop:
+                raise ServeError("batch scheduler stopped")
+            self._pending.setdefault(member.cls, []).append(call)
+            self._lock.notify_all()
+        call.done.wait()
+        if call.error is not None:
+            raise call.error
+        return call.result
+
+    # -- dispatcher -----------------------------------------------------
+    def _take_batch(self):
+        """Wait for work, honor the batching window, pop one class's
+        batch. Returns (cls, calls) or None on stop."""
+        with self._lock:
+            while not self._stop and not self._pending:
+                self._lock.wait()
+            if self._stop:
+                return None
+            # window: give same-class calls a chance to coalesce
+            cls = next(iter(self._pending))
+            if self.window_s > 0 and len(self._pending[cls]) < self.batch_max:
+                deadline = time.perf_counter() + self.window_s
+                while (not self._stop
+                       and len(self._pending.get(cls) or []) < self.batch_max):
+                    left = deadline - time.perf_counter()
+                    if left <= 0:
+                        break
+                    self._lock.wait(timeout=left)
+                if self._stop:
+                    return None
+                if cls not in self._pending:   # drained by a concurrent pop
+                    return self._take_batch()
+            calls = self._pending[cls][: self.batch_max]
+            rest = self._pending[cls][self.batch_max:]
+            if rest:
+                self._pending[cls] = rest
+            else:
+                del self._pending[cls]
+            return cls, calls
+
+    def _loop(self) -> None:
+        while True:
+            got = self._take_batch()
+            if got is None:
+                return
+            cls, calls = got
+            try:
+                self._dispatch(cls, calls)
+            except Exception as e:  # pragma: no cover - defensive
+                for call in calls:
+                    call.error = ServeError(f"batched dispatch failed: {e}")
+                    call.done.set()
+
+    def _kernel_for(self, cls, b_pad: int):
+        key = (cls.v_pad, cls.w_pad, cls.planes, b_pad)
+        hit = key in self._kernels
+        if not hit:
+            self._kernels[key] = lambda *a: batched_sweep_kernel(
+                *a, planes=cls.planes, stall_window=self.stall_window)
+            self.stats["compile_misses"] += 1
+        else:
+            self.stats["compile_hits"] += 1
+        return self._kernels[key], hit
+
+    def _dispatch(self, cls, calls) -> None:
+        b = len(calls)
+        b_pad = min(_pow2_ceil(b), self.batch_max)
+        if b_pad < b:   # batch_max not a power of two: pad up past it
+            b_pad = _pow2_ceil(b)
+        members = [c.member for c in calls]
+        fill = b_pad - b
+        if fill:
+            dummy = self._dummies.get(cls)
+            if dummy is None:
+                dummy = self._dummies[cls] = dummy_member(cls)
+            members = members + [dummy] * fill
+        comb = np.stack([m.comb for m in members])
+        degrees = np.stack([m.degrees for m in members])
+        k0 = np.array([c.k for c in calls] + [1] * fill, np.int32)
+        max_steps = np.array([m.max_steps for m in members], np.int32)
+
+        kernel, cache_hit = self._kernel_for(cls, b_pad)
+        t0 = time.perf_counter()
+        p1, s1, st1, used, p2, s2, st2 = kernel(comb, degrees, k0, max_steps)
+        st2 = np.asarray(st2)   # one transfer point for the epilogues
+        device_s = time.perf_counter() - t0
+
+        queue_ms_max = max(
+            (t0 - c.t_enqueue) * 1e3 for c in calls)
+        self.stats["batches"] += 1
+        self.stats["sweeps"] += b
+        if self.on_batch is not None:
+            self.on_batch({
+                "shape_class": cls.name, "batch": b, "b_pad": int(b_pad),
+                "occupancy": round(b / b_pad, 4),
+                "padding_waste": padding_waste([c.member for c in calls],
+                                               cls, b_pad),
+                "compile_cache": "hit" if cache_hit else "miss",
+                "device_ms": round(device_s * 1e3, 3),
+                "queue_ms_max": round(queue_ms_max, 3),
+            })
+        for i, call in enumerate(calls):
+            call.result = (p1[i], s1[i], st1[i], int(np.asarray(used)[i]),
+                           p2[i], s2[i], int(st2[i]))
+            call.done.set()
+
+
+class BatchMemberEngine:
+    """Per-request engine proxy: the ``sweep``/``attempt`` protocol over
+    the batch scheduler, so ``find_minimal_coloring`` drives the batched
+    path exactly like any fused engine."""
+
+    def __init__(self, member, scheduler: BatchScheduler):
+        self.member = member
+        self.scheduler = scheduler
+        self._fallback = None
+
+    # the STALLED-confirm fallback owns the widen-and-retry loop; with
+    # covering class windows it is reachable only on a genuine stall
+    def _fallback_engine(self):
+        if self._fallback is None:
+            from dgc_tpu.engine.compact import CompactFrontierEngine
+
+            self._fallback = CompactFrontierEngine(self.member.arrays)
+        return self._fallback
+
+    def attempt(self, k: int) -> AttemptResult:
+        v = self.member.num_vertices
+        if k < 1:
+            return empty_budget_failure(v, k)
+        return self._fallback_engine().attempt(k)
+
+    def sweep(self, k0: int):
+        if k0 < 1:
+            return self.attempt(k0), None
+        out = self.scheduler.sweep(self.member, k0)
+        member = _KMember(self.member, k0)
+        return finish_pair(member, *out, self.attempt)
+
+
+class _KMember:
+    """View of a member at a non-default budget (``finish_pair`` reads
+    ``k0``/``num_vertices`` only)."""
+
+    __slots__ = ("member", "k0")
+
+    def __init__(self, member, k0: int):
+        self.member = member
+        self.k0 = int(k0)
+
+    @property
+    def num_vertices(self) -> int:
+        return self.member.num_vertices

@@ -1,0 +1,389 @@
+"""Micro-batching serve front-end: queue, workers, latency, health.
+
+The request path (Orca-style continuous batching, scaled to this
+workload's grain): ``submit()`` enqueues a request into a bounded queue
+(**backpressure**: a full queue raises :class:`QueueFull` immediately or
+after the caller's timeout — load sheds at the edge instead of OOMing
+the process). Worker threads pop requests and each runs the exact
+single-graph minimal-k driver (``find_minimal_coloring``, jump mode,
+validation + recolor post-pass as the CLI defaults) over a
+:class:`~dgc_tpu.serve.engine.BatchMemberEngine` proxy — so N concurrent
+requests' sweep dispatches coalesce in the
+:class:`~dgc_tpu.serve.engine.BatchScheduler`'s batching window and run
+as vmapped batches, while every per-request semantic stays the
+single-graph path's.
+
+Graphs beyond the shape ladder (or a batched dispatch that errors) take
+the **single-graph fallback**: a supervised sweep down an engine ladder
+(``resilience.supervisor``) whose rung state feeds :meth:`health` — the
+ROADMAP serving-path hook. Every request and batch lands in the obs
+event stream (``serve_request`` / ``serve_batch`` / ``serve_health``),
+the metrics registry, and the manifest's ``serve`` slot.
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from collections import deque
+from dataclasses import dataclass, field
+
+import numpy as np
+
+from dgc_tpu.engine.minimal_k import (find_minimal_coloring, make_reducer,
+                                      make_validator)
+from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.resilience.supervisor import RungState, SweepAbort, supervise_sweep
+from dgc_tpu.serve.engine import BatchMemberEngine, BatchScheduler, ServeError
+from dgc_tpu.serve.shape_classes import DEFAULT_LADDER, ShapeLadder, pad_member
+
+
+class QueueFull(RuntimeError):
+    """Backpressure signal: the bounded request queue is at capacity."""
+
+
+@dataclass
+class ServeRequest:
+    request_id: int
+    arrays: GraphArrays
+    t_submit: float = field(default_factory=time.perf_counter)
+
+
+@dataclass
+class ServeResult:
+    request_id: int
+    status: str                      # "ok" | "failed" | "error"
+    colors: np.ndarray | None
+    minimal_colors: int | None
+    attempts: list                   # [(k, status_name, supersteps), ...]
+    queue_s: float
+    service_s: float
+    batched: bool
+    shape_class: str | None
+    error: str | None = None
+
+    @property
+    def ok(self) -> bool:
+        return self.status == "ok"
+
+
+class ServeTicket:
+    """Handle returned by ``submit``; ``result()`` blocks for completion."""
+
+    def __init__(self, request: ServeRequest):
+        self.request = request
+        self._done = threading.Event()
+        self._result: ServeResult | None = None
+
+    def _complete(self, result: ServeResult) -> None:
+        self._result = result
+        self._done.set()
+
+    def result(self, timeout: float | None = None) -> ServeResult:
+        if not self._done.wait(timeout):
+            raise TimeoutError(
+                f"request {self.request.request_id} still in flight")
+        return self._result
+
+
+# the serve fallback ladder: flagship single-device engine first, CPU
+# reference last (single-graph; never the sharded rungs — a request
+# path must not grab the pod)
+def _default_fallback_factories(arrays):
+    def compact():
+        from dgc_tpu.engine.compact import CompactFrontierEngine
+
+        return CompactFrontierEngine(arrays)
+
+    def bucketed():
+        from dgc_tpu.engine.bucketed import BucketedELLEngine
+
+        return BucketedELLEngine(arrays)
+
+    def refsim():
+        from dgc_tpu.engine.reference_sim import ReferenceSimEngine
+
+        return ReferenceSimEngine(arrays)
+
+    return [("ell-compact", compact), ("ell-bucketed", bucketed),
+            ("reference-sim", refsim)]
+
+
+class ServeFrontEnd:
+    """Bounded-queue micro-batching server over the batch scheduler.
+
+    ``queue_depth`` bounds admitted-but-unstarted requests; ``workers``
+    bounds in-flight requests (default ``batch_max`` so one full batch
+    can always form). ``validate``/``post_reduce`` default on — the CLI
+    driver's semantics. ``auto_tune`` threads the shape-hash tuned-config
+    cache (``tune.cache``) through the fallback path's engine build.
+    ``fallback_factories(arrays) -> [(name, factory), ...]`` overrides
+    the fallback ladder (tests inject failing rungs to exercise the
+    health flip)."""
+
+    def __init__(self, *, ladder: ShapeLadder = DEFAULT_LADDER,
+                 batch_max: int = 8, window_s: float = 0.002,
+                 queue_depth: int = 64, workers: int | None = None,
+                 validate: bool = True, post_reduce: bool = True,
+                 auto_tune: bool = False, tuned_cache=None,
+                 retries: int = 0,
+                 fallback_factories=None,
+                 logger=None, registry=None,
+                 rung_state: RungState | None = None):
+        if queue_depth < 1:
+            raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
+        self.ladder = ladder
+        self.batch_max = int(batch_max)
+        self.queue_depth = int(queue_depth)
+        self.workers = int(workers) if workers is not None else self.batch_max
+        self.validate = validate
+        self.post_reduce = post_reduce
+        self.retries = int(retries)
+        self.auto_tune = auto_tune
+        self._tuned_cache = tuned_cache
+        if auto_tune and tuned_cache is None:
+            from dgc_tpu.tune.cache import TunedConfigCache
+
+            self._tuned_cache = TunedConfigCache()
+        self._fallback_factories = (fallback_factories
+                                    or _default_fallback_factories)
+        self.logger = logger
+        self.registry = registry
+        self.rung_state = rung_state if rung_state is not None else RungState()
+        self.scheduler = BatchScheduler(batch_max=batch_max,
+                                        window_s=window_s,
+                                        on_batch=self._on_batch)
+        self._lock = threading.Condition()
+        self._queue: deque = deque()
+        self._threads: list = []
+        self._in_flight = 0
+        self._next_id = 0
+        self._started = False
+        self._draining = False
+        self.stats = {"submitted": 0, "completed": 0, "failed": 0,
+                      "rejected": 0, "fallbacks": 0}
+
+    # -- obs plumbing ---------------------------------------------------
+    def _event(self, kind: str, **fields) -> None:
+        if self.logger is not None:
+            self.logger.event(kind, **fields)
+
+    def _on_batch(self, record: dict) -> None:
+        self._event("serve_batch", **record)
+        if self.registry is not None:
+            self.registry.counter(
+                "dgc_serve_batches_total", "batched sweep dispatches",
+                shape_class=record["shape_class"]).inc()
+
+    # -- lifecycle ------------------------------------------------------
+    def start(self) -> "ServeFrontEnd":
+        if self._started:
+            return self
+        self._started = True
+        self.scheduler.start()
+        for i in range(self.workers):
+            t = threading.Thread(target=self._worker, daemon=True,
+                                 name=f"dgc-serve-worker-{i}")
+            t.start()
+            self._threads.append(t)
+        self._event("serve_start", batch_max=self.batch_max,
+                    window_ms=round(self.scheduler.window_s * 1e3, 3),
+                    queue_depth=self.queue_depth, workers=self.workers)
+        return self
+
+    def shutdown(self, drain: bool = True, timeout: float = 60.0) -> None:
+        """Stop accepting; with ``drain`` finish everything admitted
+        first (the queue-semantics contract: no admitted request is
+        dropped), then stop workers and the batch dispatcher."""
+        with self._lock:
+            self._draining = True
+            if not drain:
+                for req, ticket in self._queue:
+                    ticket._complete(self._error_result(
+                        req, "front-end shut down before dispatch"))
+                    self.stats["failed"] += 1
+                self._queue.clear()
+            self._lock.notify_all()
+        deadline = time.perf_counter() + timeout
+        for t in self._threads:
+            t.join(timeout=max(0.0, deadline - time.perf_counter()))
+        self._threads.clear()
+        self.scheduler.stop()
+        self._event("serve_done", requests=self.stats["submitted"],
+                    completed=self.stats["completed"],
+                    failed=self.stats["failed"],
+                    rejected=self.stats["rejected"])
+
+    # -- submission -----------------------------------------------------
+    def submit(self, arrays: GraphArrays, request_id: int | None = None,
+               timeout: float = 0.0) -> ServeTicket:
+        """Admit one request; raises :class:`QueueFull` when the bounded
+        queue stays full past ``timeout`` (0 = reject immediately)."""
+        if not self._started:
+            raise ServeError("front-end not started")
+        with self._lock:
+            if self._draining:
+                raise ServeError("front-end shutting down")
+            if len(self._queue) >= self.queue_depth and timeout > 0:
+                deadline = time.perf_counter() + timeout
+                while (len(self._queue) >= self.queue_depth
+                       and not self._draining):
+                    left = deadline - time.perf_counter()
+                    if left <= 0 or not self._lock.wait(timeout=left):
+                        break
+            if self._draining:
+                raise ServeError("front-end shutting down")
+            if len(self._queue) >= self.queue_depth:
+                self.stats["rejected"] += 1
+                if self.registry is not None:
+                    self.registry.counter(
+                        "dgc_serve_rejected_total",
+                        "requests shed by queue backpressure").inc()
+                raise QueueFull(
+                    f"queue at capacity ({self.queue_depth})")
+            if request_id is None:
+                request_id = self._next_id
+            self._next_id = max(self._next_id, request_id) + 1
+            req = ServeRequest(request_id=request_id, arrays=arrays)
+            ticket = ServeTicket(req)
+            self._queue.append((req, ticket))
+            self.stats["submitted"] += 1
+            self._lock.notify_all()
+        return ticket
+
+    # -- health/readiness -----------------------------------------------
+    def health(self, emit: bool = False) -> dict:
+        """Liveness/readiness snapshot. ``ready`` is False before
+        ``start``, while draining, and once the fallback supervisor's
+        ladder is exhausted (the rung-state feed); ``degraded`` flags a
+        fallback below the primary engine."""
+        rung = self.rung_state.snapshot()
+        with self._lock:
+            doc = {
+                "ready": (self._started and not self._draining
+                          and rung["ready"]),
+                "queue_depth": len(self._queue),
+                "in_flight": self._in_flight,
+                "capacity": self.queue_depth,
+                "degraded": rung["degraded"],
+                "backend": rung["backend"],
+                "rung": rung["rung"],
+                "retry_pressure": rung["retry_pressure"],
+            }
+        if emit:
+            self._event("serve_health", **doc)
+        if self.registry is not None:
+            self.registry.gauge("dgc_serve_queue_depth",
+                                "requests waiting").set(doc["queue_depth"])
+        return doc
+
+    # -- workers --------------------------------------------------------
+    def _error_result(self, req: ServeRequest, msg: str) -> ServeResult:
+        return ServeResult(
+            request_id=req.request_id, status="error", colors=None,
+            minimal_colors=None, attempts=[], queue_s=0.0, service_s=0.0,
+            batched=False, shape_class=None, error=msg)
+
+    def _worker(self) -> None:
+        while True:
+            with self._lock:
+                while not self._queue and not self._draining:
+                    self._lock.wait()
+                if not self._queue:
+                    return      # draining and empty: worker retires
+                req, ticket = self._queue.popleft()
+                self._in_flight += 1
+                self._lock.notify_all()   # wake blocked submitters
+            try:
+                result = self._serve_one(req)
+            except Exception as e:
+                result = self._error_result(req, f"{type(e).__name__}: {e}")
+            finally:
+                with self._lock:
+                    self._in_flight -= 1
+            if result.status == "ok":
+                self.stats["completed"] += 1
+            else:
+                self.stats["failed"] += 1
+            self._event(
+                "serve_request", request_id=req.request_id,
+                status=result.status,
+                queue_ms=round(result.queue_s * 1e3, 3),
+                service_ms=round(result.service_s * 1e3, 3),
+                minimal_colors=result.minimal_colors,
+                v=int(req.arrays.num_vertices),
+                shape_class=result.shape_class,
+                batched=result.batched,
+                attempts=len(result.attempts),
+                error=result.error)
+            if self.registry is not None:
+                self.registry.counter("dgc_serve_requests_total",
+                                      "served requests",
+                                      status=result.status).inc()
+            ticket._complete(result)
+
+    def _serve_one(self, req: ServeRequest) -> ServeResult:
+        t_start = time.perf_counter()
+        queue_s = t_start - req.t_submit
+        arrays = req.arrays
+        cls = self.ladder.class_for(arrays.num_vertices, arrays.max_degree)
+        batched = cls is not None
+        attempts: list = []
+
+        def on_attempt(res, val):
+            attempts.append((int(res.k), res.status.name,
+                             int(res.supersteps)))
+
+        validate = make_validator(arrays) if self.validate else None
+        post_reduce = make_reducer(arrays) if self.post_reduce else None
+
+        if batched:
+            try:
+                engine = BatchMemberEngine(pad_member(arrays, cls),
+                                           self.scheduler)
+                result = find_minimal_coloring(
+                    engine, initial_k=engine.member.k0,
+                    validate=validate, on_attempt=on_attempt,
+                    post_reduce=post_reduce)
+            except ServeError:
+                batched = False   # scheduler refused: single-graph path
+        if not batched:
+            result = self._fallback_sweep(arrays, validate, on_attempt,
+                                          post_reduce)
+        service_s = time.perf_counter() - t_start
+        ok = result.colors is not None
+        return ServeResult(
+            request_id=req.request_id, status="ok" if ok else "failed",
+            colors=result.colors, minimal_colors=result.minimal_colors,
+            attempts=attempts, queue_s=queue_s, service_s=service_s,
+            batched=batched, shape_class=cls.name if cls else None)
+
+    def _fallback_sweep(self, arrays, validate, on_attempt, post_reduce):
+        """Single-graph path for graphs beyond the shape ladder: a
+        supervised sweep down the fallback ladder, rung state feeding
+        :meth:`health`. The tuned-config cache (when auto-tuning) keys
+        the first rung's schedule by graph-shape hash — recurring shapes
+        skip the replay (ROADMAP serving-path item)."""
+        self.stats["fallbacks"] += 1
+        tuned_kw: dict = {}
+        if self._tuned_cache is not None and self.auto_tune:
+            tuned_kw = self._tuned_cache.get_or_tune(arrays).engine_kwargs(
+                "ell-compact")
+        factories = self._fallback_factories(arrays)
+        if tuned_kw:
+            name0, fac0 = factories[0]
+            if name0 == "ell-compact":
+                def tuned_compact():
+                    from dgc_tpu.engine.compact import CompactFrontierEngine
+
+                    return CompactFrontierEngine(arrays, **tuned_kw)
+                factories = [(name0, tuned_compact)] + factories[1:]
+        k0 = int(arrays.max_degree) + 1
+        result, _stats = supervise_sweep(
+            factories, initial_k=k0,
+            validate=validate, on_attempt=on_attempt,
+            make_post_reduce=(lambda name: post_reduce),
+            retry_budget=self.retries,
+            logger=self.logger, registry=self.registry,
+            rung_state=self.rung_state)
+        return result

@@ -23,7 +23,10 @@ Entry points:
 - ``dgc-tpu --auto-tune`` / ``--tuned-config PATH`` — apply at run time;
 - :func:`tune_schedule` / :func:`tune_from_manifest` — library API
   (build-time degree-profile replay, or recorded in-kernel trajectory
-  telemetry from a prior run's manifest).
+  telemetry from a prior run's manifest);
+- :class:`~dgc_tpu.tune.cache.TunedConfigCache` — shape-hash-keyed
+  config cache for request paths (recurring graph shapes skip the
+  replay; the serving path's single-graph fallback uses it).
 """
 
 from dgc_tpu.tune.config import (  # noqa: F401
@@ -32,6 +35,7 @@ from dgc_tpu.tune.config import (  # noqa: F401
     graph_shape_hash,
     load_tuned_config,
 )
+from dgc_tpu.tune.cache import TunedConfigCache  # noqa: F401
 from dgc_tpu.tune.search import (  # noqa: F401
     ScheduleView,
     trajectory_from_manifest,

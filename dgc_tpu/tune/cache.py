@@ -1,0 +1,99 @@
+"""Tuned-config cache keyed by graph-shape hash (the serving-path item).
+
+The tuner's replay costs seconds-to-minutes per graph — fine for a batch
+job, fatal for a request path. But the tuned schedule is a pure function
+of the graph *shape* (``config.graph_shape_hash``: degree histogram +
+V/E/Δ), so recurring request shapes can reuse the artifact: first sight
+pays the replay once, every later request with the same shape hash gets
+the config back in a dict lookup (plus an optional on-disk artifact
+directory shared across processes — the same versioned JSON
+``python -m dgc_tpu.tune`` emits, so a cache directory doubles as a
+config registry you can inspect or pre-seed).
+
+Used by the serve fallback path (``dgc_tpu.serve.engine``) when
+auto-tuning is enabled; ``get_or_tune`` is also the programmatic
+entry point for any driver that colors many same-shaped graphs.
+"""
+
+from __future__ import annotations
+
+import threading
+from pathlib import Path
+
+from dgc_tpu.tune.config import TunedConfig, graph_shape_hash, load_tuned_config
+
+
+class TunedConfigCache:
+    """In-memory (+ optional on-disk) cache of tuned configs by shape.
+
+    ``cache_dir`` (optional) persists every tuned artifact as
+    ``<hash>.json`` and is consulted on memory misses — a warm directory
+    makes a fresh serving process skip the replay for every shape it has
+    ever seen. Thread-safe; concurrent misses on the same shape tune
+    once (per-hash locks), which is the serving-path case: a burst of
+    same-shaped requests must not fan out into N replays."""
+
+    def __init__(self, cache_dir: str | None = None):
+        self._dir = Path(cache_dir) if cache_dir else None
+        if self._dir is not None:
+            self._dir.mkdir(parents=True, exist_ok=True)
+        self._mem: dict[str, TunedConfig] = {}
+        self._lock = threading.Lock()
+        self._tuning: dict[str, threading.Lock] = {}
+        self.stats = {"hits": 0, "misses": 0, "disk_hits": 0}
+
+    def __len__(self) -> int:
+        return len(self._mem)
+
+    def _path(self, shape: str) -> Path | None:
+        return None if self._dir is None else self._dir / f"{shape}.json"
+
+    def get(self, arrays) -> TunedConfig | None:
+        """Cached config for this graph's shape, or None (no tuning)."""
+        shape = graph_shape_hash(arrays)
+        with self._lock:
+            cfg = self._mem.get(shape)
+        if cfg is not None:
+            self.stats["hits"] += 1
+            return cfg
+        path = self._path(shape)
+        if path is not None and path.exists():
+            cfg = load_tuned_config(str(path))
+            with self._lock:
+                self._mem[shape] = cfg
+            self.stats["disk_hits"] += 1
+            return cfg
+        return None
+
+    def put(self, arrays, cfg: TunedConfig) -> None:
+        shape = graph_shape_hash(arrays)
+        with self._lock:
+            self._mem[shape] = cfg
+        path = self._path(shape)
+        if path is not None:
+            cfg.save(str(path))
+
+    def get_or_tune(self, arrays, tune=None) -> TunedConfig:
+        """Config for this shape, tuning on first sight.
+
+        ``tune(arrays) -> TunedConfig`` defaults to the build-time
+        replay (``dgc_tpu.tune.tune_schedule``). Per-shape locking: a
+        burst of same-shaped misses replays once."""
+        cached = self.get(arrays)
+        if cached is not None:
+            return cached
+        shape = graph_shape_hash(arrays)
+        with self._lock:
+            gate = self._tuning.setdefault(shape, threading.Lock())
+        with gate:
+            cached = self.get(arrays)   # a peer finished while we waited
+            if cached is not None:
+                return cached
+            if tune is None:
+                from dgc_tpu.tune import tune_schedule
+
+                tune = tune_schedule
+            cfg = tune(arrays)
+            self.stats["misses"] += 1
+            self.put(arrays, cfg)
+            return cfg

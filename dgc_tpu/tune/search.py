@@ -248,12 +248,15 @@ def trajectory_from_manifest(doc_or_path, arrays,
     schedule_model" path, costing zero replay time.
 
     Uses the highest-k attempt with an untruncated from-scratch
-    trajectory (the analogue of the replay's default k = Δ+1). The
-    kernel buffer records occupancy only, so ``sum_deg_active`` is 0
-    (the floor is unavailable — objectives compare totals, which never
-    read it) and ``max_unconf_per_bucket`` is pessimistically the bucket
-    width (capture-validity pricing is constant across ladder
-    candidates, which is all this mode tunes)."""
+    trajectory (the analogue of the replay's default k = Δ+1).
+    ``sum_deg_active`` is 0 (the floor is unavailable — objectives
+    compare totals, which never read it). ``max_unconf_per_bucket``
+    comes from the in-kernel ``max_unconf`` column when the manifest
+    carries it (obs.kernel col 4 — a global per-superstep maximum, so
+    each bucket gets ``min(width, max_unconf)``: a conservative but
+    superstep-exact capture-validity bound); manifests recorded before
+    the column pessimistically price it at the bucket width, which
+    restricts that mode to ladder-family knobs."""
     if isinstance(doc_or_path, (str, bytes)):
         from dgc_tpu.obs.manifest import load_manifest
 
@@ -274,6 +277,7 @@ def trajectory_from_manifest(doc_or_path, arrays,
     t = att["trajectory"]
     active = t["active"]
     ba = t["bucket_active"]
+    mu = t.get("max_unconf") or []
 
     sizes, widths = bucket_layout(arrays, min_width=min_width)
     nb = len(ba[0]) if ba else 0
@@ -299,11 +303,14 @@ def trajectory_from_manifest(doc_or_path, arrays,
                 f"manifest bucket_active width {nb} matches neither the "
                 f"per-bucket layout ({len(sizes)}) nor the compact hub "
                 f"layout ({expect_compact}) for this graph")
+        mu_i = int(mu[i]) if i < len(mu) else -1
         traj.steps.append(TrajectoryStep(
             step=i + int(t.get("first_step", 1) or 1),
             active=int(a), sum_deg_active=0,
             active_per_bucket=per_bucket,
-            max_unconf_per_bucket=[int(w) for w in widths]))
+            max_unconf_per_bucket=[
+                min(int(w), mu_i) if mu_i >= 0 else int(w)
+                for w in widths]))
     return traj
 
 
@@ -542,9 +549,18 @@ def tune_schedule(arrays, traj: Trajectory | None = None, *,
 def tune_from_manifest(arrays, doc_or_path, *,
                        min_width: int = 4, **kw) -> TunedConfig:
     """Trajectory-telemetry-driven tuning: reuse a prior run's recorded
-    bucket-occupancy series instead of the build-time replay (ladder
-    knobs only — see :func:`trajectory_from_manifest`)."""
+    bucket-occupancy series instead of the build-time replay. When the
+    manifest carries the in-kernel ``max_unconf`` column (obs.kernel
+    col 4), capture validity is priced from the recorded maxima and the
+    hub knobs are searched too; older manifests (width-pessimistic
+    capture pricing) stay ladder-only
+    (:func:`trajectory_from_manifest`)."""
     traj = trajectory_from_manifest(doc_or_path, arrays,
                                     min_width=min_width)
+    has_unconf = any(
+        any(u < w for u, w in zip(st.max_unconf_per_bucket,
+                                  traj.bucket_widths))
+        for st in traj.steps)
     return tune_schedule(arrays, traj, source="manifest",
-                         search_hub=False, min_width=min_width, **kw)
+                         search_hub=kw.pop("search_hub", has_unconf),
+                         min_width=min_width, **kw)

@@ -40,6 +40,56 @@ def test_bench_emits_contract_json():
     assert abs(d["total_s"] - expected) < 1e-9, d
 
 
+def test_bench_abort_record_carries_partial_phases():
+    """rc-113 contract: the backend-unreachable null record must carry
+    the probed context and the partial per-phase breakdown collected
+    before the abort — not only the error metric. Simulated with the
+    fault plane's device_init hang under a short probe watchdog."""
+    r = _run_bench("--nodes", "400", "--avg-degree", "6",
+                   "--inject-faults", "device_init@1=hang:30",
+                   "--probe-timeout", "2")
+    assert r.returncode == 113, (r.returncode, r.stderr)
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    assert d["metric"] == "bench_aborted_backend_unreachable"
+    assert d["value"] is None and d["vs_baseline"] == 0.0
+    # the partial breakdown + context, not only the error metric
+    assert "phases" in d and isinstance(d["phases"], dict)
+    assert d["backend"] == "ell-compact" and d["probed"] is False
+    assert "# BENCH ABORTED" in r.stderr
+
+
+def test_serve_throughput_shares_the_abort_contract():
+    """--serve-throughput must reuse the same rc-113 record shape
+    (satellite contract: serve metrics abort exactly like sweep
+    metrics, partial phases included)."""
+    r = _run_bench("--serve-throughput", "--nodes", "300",
+                   "--serve-graphs", "1", "--serve-batch-sizes", "1",
+                   "--inject-faults", "device_init@1=hang:30",
+                   "--probe-timeout", "2")
+    assert r.returncode == 113, (r.returncode, r.stderr)
+    d = json.loads([l for l in r.stdout.splitlines()
+                    if l.startswith("{")][0])
+    assert d["metric"] == "serve_aborted_backend_unreachable"
+    assert "phases" in d and d["backend"] == "serve"
+
+
+def test_serve_throughput_contract_json():
+    r = _run_bench("--serve-throughput", "--nodes", "400",
+                   "--avg-degree", "6", "--serve-graphs", "2",
+                   "--serve-batch-sizes", "1,2")
+    assert r.returncode == 0, r.stderr
+    lines = [l for l in r.stdout.splitlines() if l.startswith("{")]
+    assert len(lines) == 1, r.stdout
+    d = json.loads(lines[0])
+    assert d["unit"] == "graphs/s" and d["value"] > 0
+    assert d["parity_ok"] is True
+    assert set(d["batches"]) == {"1", "2"}
+    assert d["sequential_graphs_per_s"] > 0
+    assert "sequential_s" in d["phases"] and "serve_b2_s" in d["phases"]
+
+
 def test_bench_help_is_robust_to_malformed_env():
     r = _run_bench("--help", env_extra={"DGC_TPU_BENCH_PROBE_TIMEOUT": "junk",
                                         "DGC_TPU_BENCH_RUN_TIMEOUT": ""})

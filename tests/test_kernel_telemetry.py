@@ -152,8 +152,8 @@ def test_trajectory_decode_handles_truncation():
 
     buf = np.asarray(traj_empty(4))
     buf = buf.copy()
-    buf[0] = [10, 0, -1, -1]
-    buf[1] = [5, 0, -1, -1]
+    buf[0] = [10, 0, -1, -1, -1]
+    buf[1] = [5, 0, -1, -1, -1]
     t = decode_trajectory(buf, supersteps=9)  # ran past the 4-row cap
     assert t.truncated
     assert t.active.tolist() == [10, 5]
@@ -203,3 +203,27 @@ def test_compact_gather_calls_column_matches_model():
     # see test_compact_trajectory_matches_replay); the call counts align
     # on the shared span
     assert t.gather_calls[:-1].tolist() == price.per_step_calls[1:]
+
+
+def test_compact_max_unconf_column_matches_replay():
+    # col 4 (max unconfirmed neighbors over active rows) must equal the
+    # exact-rule replay's per-superstep maxima EXACTLY — both are
+    # pre-update snapshot views, so there is no row lag here (unlike the
+    # post-update actives). This is the column tune --from-manifest
+    # bounds hub capture validity with.
+    from dgc_tpu.engine.bucketed import BucketedELLEngine
+    from dgc_tpu.engine.compact import CompactFrontierEngine as Eng
+    from dgc_tpu.utils.trajectory import record_trajectory
+
+    g = generate_rmat_graph(20_000, avg_degree=16.0, seed=0)
+    eng = Eng(g)
+    eng.record_trajectory = True
+    t = eng.attempt(g.max_degree + 1).trajectory
+    replay = record_trajectory(g)
+    want = [max(st.max_unconf_per_bucket) for st in replay.steps]
+    assert t.max_unconf.tolist() == want[:len(t.max_unconf)]
+    # engines that don't compute the column record the -1 sentinel
+    b = BucketedELLEngine(g)
+    b.record_trajectory = True
+    tb = b.attempt(g.max_degree + 1).trajectory
+    assert (tb.max_unconf == -1).all()

@@ -1,0 +1,468 @@
+"""Serving-path tests (dgc_tpu.serve): shape classes, batched parity,
+queue semantics, health, CLI subcommand. Tier-1 fast under
+``JAX_PLATFORMS=cpu`` with the ``serve`` marker; the 1k-request soak is
+``slow``."""
+
+import json
+import os
+import subprocess
+import sys
+import threading
+
+import numpy as np
+import pytest
+
+from dgc_tpu.engine.compact import CompactFrontierEngine
+from dgc_tpu.engine.minimal_k import (find_minimal_coloring, make_reducer,
+                                      make_validator)
+from dgc_tpu.models.generators import (generate_random_graph,
+                                       generate_random_graph_fast,
+                                       generate_rmat_graph)
+from dgc_tpu.serve.engine import BatchMemberEngine, BatchScheduler
+from dgc_tpu.serve.queue import QueueFull, ServeFrontEnd
+from dgc_tpu.serve.shape_classes import (DEFAULT_LADDER, ShapeClass,
+                                         ShapeLadder, dummy_member,
+                                         pad_member)
+
+pytestmark = pytest.mark.serve
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _single_graph_reference(g):
+    """The parity target: the single-graph fused jump-mode sweep with the
+    CLI defaults (validate + recolor pass)."""
+    attempts = []
+    res = find_minimal_coloring(
+        CompactFrontierEngine(g), initial_k=g.max_degree + 1,
+        validate=make_validator(g),
+        on_attempt=lambda r, v: attempts.append(
+            (int(r.k), r.status.name, int(r.supersteps))),
+        post_reduce=make_reducer(g))
+    return res, attempts
+
+
+# -- shape classes ------------------------------------------------------
+
+def test_shape_class_selection():
+    ladder = DEFAULT_LADDER
+    cls = ladder.class_for(1500, 19)
+    assert (cls.v_pad, cls.w_pad) == (2048, 32)
+    assert ladder.class_for(2049, 19).v_pad == 8192
+    assert ladder.class_for(1500, 33).w_pad == 64
+    # beyond the ladder: single-graph fallback
+    assert ladder.class_for(10**7, 10) is None
+    assert ladder.class_for(100, 5000) is None
+    # every class window covers its width (the bit-identity precondition)
+    for c in ladder.classes():
+        assert 32 * c.planes >= c.w_pad + 1
+
+
+def test_shape_ladder_validation():
+    with pytest.raises(ValueError):
+        ShapeLadder(v_rungs=(), w_rungs=(8,))
+    with pytest.raises(ValueError):
+        ShapeLadder(v_rungs=(1024, 512), w_rungs=(8,))
+    with pytest.raises(ValueError):   # width rung needing > 32 planes
+        ShapeLadder(v_rungs=(1024,), w_rungs=(2048,))
+
+
+def test_pad_member_invariants():
+    g = generate_random_graph(60, 6, seed=0)
+    cls = DEFAULT_LADDER.class_for(g.num_vertices, g.max_degree)
+    m = pad_member(g.arrays if hasattr(g, "arrays") else g, cls)
+    assert m.comb.shape == (cls.v_pad, cls.w_pad)
+    assert m.degrees.shape == (cls.v_pad,)
+    v = m.num_vertices
+    assert (m.degrees[v:] == 0).all()
+    # pad rows are all-sentinel (no real row points at them either)
+    nbr = m.comb & ((1 << 30) - 1)
+    assert (nbr[v:] == cls.v_pad).all()
+    assert (nbr[(nbr < cls.v_pad)] < v).all()
+    assert m.k0 == int(np.max(m.degrees)) + 1
+    assert m.max_steps == 2 * v + 4
+    with pytest.raises(ValueError):
+        pad_member(g.arrays if hasattr(g, "arrays") else g,
+                   ShapeClass(32, 2))
+
+
+# -- batched sweeps: bit-identity with the single-graph fused engine ----
+
+def test_batched_minimal_k_matches_single_graph():
+    sched = BatchScheduler(batch_max=4, window_s=0.01).start()
+    try:
+        for seed, gen in [(0, "uniform"), (1, "rmat"), (2, "uniform"),
+                          (3, "rmat")]:
+            g = (generate_random_graph_fast(700, avg_degree=8, seed=seed)
+                 if gen == "uniform"
+                 else generate_rmat_graph(700, avg_degree=8, seed=seed))
+            cls = DEFAULT_LADDER.class_for(g.num_vertices, g.max_degree)
+            engine = BatchMemberEngine(pad_member(g, cls), sched)
+            got_attempts = []
+            got = find_minimal_coloring(
+                engine, initial_k=engine.member.k0,
+                validate=make_validator(g),
+                on_attempt=lambda r, v: got_attempts.append(
+                    (int(r.k), r.status.name, int(r.supersteps))),
+                post_reduce=make_reducer(g))
+            want, want_attempts = _single_graph_reference(g)
+            assert got.minimal_colors == want.minimal_colors
+            assert np.array_equal(got.colors, want.colors)
+            assert got_attempts == want_attempts
+    finally:
+        sched.stop()
+
+
+def test_batch_composition_invariance():
+    """The same graph colored alone, and inside batches of different
+    company/position, yields byte-identical output."""
+    g = generate_random_graph_fast(900, avg_degree=8, seed=7)
+    others = [generate_random_graph_fast(500 + 100 * i, avg_degree=6,
+                                         seed=20 + i) for i in range(3)]
+
+    def run_batch(graphs):
+        fe = ServeFrontEnd(batch_max=4, window_s=0.05,
+                           queue_depth=16).start()
+        try:
+            tickets = [fe.submit(x) for x in graphs]
+            return [t.result(timeout=300) for t in tickets]
+        finally:
+            fe.shutdown()
+
+    alone = run_batch([g])[0]
+    first = run_batch([g] + others)[0]
+    last = run_batch(others + [g])[-1]
+    for r in (alone, first, last):
+        assert r.ok
+        assert r.minimal_colors == alone.minimal_colors
+        assert np.array_equal(r.colors, alone.colors)
+        assert r.attempts == alone.attempts
+
+
+def test_dummy_member_is_inert():
+    cls = ShapeClass(2048, 8)
+    m = dummy_member(cls)
+    assert m.k0 == 1 and (m.degrees == 0).all()
+    # a dummy co-member never perturbs a real graph's result: batch of 1
+    # real graph pads with dummies internally (b_pad rounding)
+    g = generate_random_graph_fast(600, avg_degree=6, seed=3)
+    sched = BatchScheduler(batch_max=8, window_s=0.0).start()
+    try:
+        engine = BatchMemberEngine(
+            pad_member(g, DEFAULT_LADDER.class_for(g.num_vertices,
+                                                   g.max_degree)), sched)
+        got = find_minimal_coloring(engine, initial_k=engine.member.k0)
+    finally:
+        sched.stop()
+    want, _ = _single_graph_reference(g)
+    # compare the swept count (got ran without the recolor post-pass)
+    assert got.minimal_colors == want.swept_colors
+
+
+def test_compile_cache_hits_on_recurring_shapes():
+    sched = BatchScheduler(batch_max=2, window_s=0.0).start()
+    try:
+        for seed in range(3):
+            g = generate_random_graph_fast(800, avg_degree=8, seed=seed)
+            cls = DEFAULT_LADDER.class_for(g.num_vertices, g.max_degree)
+            engine = BatchMemberEngine(pad_member(g, cls), sched)
+            find_minimal_coloring(engine, initial_k=engine.member.k0)
+    finally:
+        sched.stop()
+    assert sched.stats["compile_misses"] >= 1
+    # recurring shape: later sweeps reuse the class kernel
+    assert sched.stats["compile_hits"] > sched.stats["compile_misses"]
+
+
+# -- queue semantics ----------------------------------------------------
+
+def test_backpressure_and_drain(monkeypatch):
+    gate = threading.Event()
+    done_one = threading.Event()
+    real_serve = ServeFrontEnd._serve_one
+
+    def gated(self, req):
+        done_one.set()
+        gate.wait(30)
+        return real_serve(self, req)
+
+    monkeypatch.setattr(ServeFrontEnd, "_serve_one", gated)
+    fe = ServeFrontEnd(batch_max=1, workers=1, queue_depth=1,
+                       window_s=0.0).start()
+    g = generate_random_graph_fast(300, avg_degree=6, seed=0)
+    t1 = fe.submit(g)                     # taken by the (gated) worker
+    assert done_one.wait(10)
+    t2 = fe.submit(g)                     # fills the queue_depth-1 queue
+    with pytest.raises(QueueFull):        # backpressure: immediate shed
+        fe.submit(g)
+    with pytest.raises(QueueFull):        # and after a bounded wait
+        fe.submit(g, timeout=0.05)
+    assert fe.stats["rejected"] == 2
+    gate.set()                            # release; drain must finish all
+    fe.shutdown(drain=True)
+    assert t1.result(timeout=10).ok and t2.result(timeout=10).ok
+    assert fe.stats["completed"] == 2
+
+
+def test_batching_window_coalesces_concurrent_requests():
+    fe = ServeFrontEnd(batch_max=4, window_s=0.25, queue_depth=16).start()
+    try:
+        graphs = [generate_random_graph_fast(600, avg_degree=6, seed=s)
+                  for s in range(4)]
+        tickets = [fe.submit(g) for g in graphs]
+        results = [t.result(timeout=300) for t in tickets]
+        assert all(r.ok for r in results)
+    finally:
+        fe.shutdown()
+    # 4 same-class requests inside one window -> one batched dispatch
+    # for the opening sweep round (subsequent rounds may split as
+    # requests finish at different times)
+    assert fe.scheduler.stats["batches"] < fe.scheduler.stats["sweeps"]
+
+
+def test_health_flips_when_supervisor_degrades():
+    # a 1-rung ladder too small for any real graph forces the fallback
+    # path; a failing first rung then degrades the supervisor
+    tiny = ShapeLadder(v_rungs=(8,), w_rungs=(4,))
+
+    def factories(arrays):
+        def broken():
+            raise RuntimeError("primary engine down")
+
+        def bucketed():
+            from dgc_tpu.engine.bucketed import BucketedELLEngine
+
+            return BucketedELLEngine(arrays)
+
+        return [("ell-compact", broken), ("ell-bucketed", bucketed)]
+
+    fe = ServeFrontEnd(ladder=tiny, batch_max=2, queue_depth=8,
+                       fallback_factories=factories).start()
+    try:
+        assert fe.health()["ready"] and not fe.health()["degraded"]
+        g = generate_random_graph(60, 6, seed=1)
+        res = fe.submit(g).result(timeout=300)
+        assert res.ok and not res.batched
+        h = fe.health()
+        assert h["degraded"] is True
+        assert h["backend"] == "ell-bucketed" and h["rung"] == 1
+        assert h["ready"] is True      # degraded but still serving
+        # parity holds on the fallback path too
+        want, _ = _single_graph_reference(g)
+        assert res.minimal_colors == want.minimal_colors
+    finally:
+        fe.shutdown()
+
+
+def test_shutdown_without_drain_fails_queued_requests(monkeypatch):
+    gate = threading.Event()
+    taken = threading.Event()
+    real_serve = ServeFrontEnd._serve_one
+
+    def gated(self, req):
+        taken.set()
+        gate.wait(30)
+        return real_serve(self, req)
+
+    monkeypatch.setattr(ServeFrontEnd, "_serve_one", gated)
+    fe = ServeFrontEnd(batch_max=1, workers=1, queue_depth=4,
+                       window_s=0.0).start()
+    g = generate_random_graph_fast(300, avg_degree=6, seed=0)
+    t1 = fe.submit(g)
+    assert taken.wait(10)
+    t2 = fe.submit(g)
+    gate.set()
+    fe.shutdown(drain=False)
+    assert t2.result(timeout=10).status == "error"
+    assert t1.result(timeout=10).ok      # in-flight request still lands
+
+
+# -- rung state unit ----------------------------------------------------
+
+def test_rung_state_snapshot():
+    from dgc_tpu.resilience.supervisor import RungState
+
+    rs = RungState()
+    rs.on_rung("sharded", 0)
+    assert rs.snapshot() == {"backend": "sharded", "rung": 0,
+                             "retry_pressure": 0, "degraded": False,
+                             "ready": True}
+    rs.on_retry()
+    rs.on_rung("ell", 1)
+    snap = rs.snapshot()
+    assert snap["degraded"] and snap["retry_pressure"] == 0
+    rs.on_exhausted()
+    assert rs.snapshot()["ready"] is False
+
+
+# -- obs integration ----------------------------------------------------
+
+def test_serve_events_validate_against_schema(tmp_path):
+    from dgc_tpu.obs import MetricsRegistry, RunLogger, RunManifest
+
+    log = tmp_path / "serve.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    manifest = RunManifest()
+    logger.add_sink(manifest)
+    fe = ServeFrontEnd(batch_max=2, window_s=0.02, queue_depth=8,
+                       logger=logger, registry=MetricsRegistry()).start()
+    try:
+        tickets = [fe.submit(generate_random_graph_fast(
+            500, avg_degree=6, seed=s)) for s in range(3)]
+        for t in tickets:
+            assert t.result(timeout=300).ok
+        fe.health(emit=True)
+    finally:
+        fe.shutdown()
+    logger.close()
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from validate_runlog import validate_file
+
+    assert validate_file(str(log)) == []
+    serve = manifest.doc["serve"]
+    assert serve["config"]["batch_max"] == 2
+    assert len(serve["requests"]) == 3
+    assert serve["batches"] and all(
+        0 < b["occupancy"] <= 1 for b in serve["batches"])
+    assert serve["summary"]["completed"] == 3
+    # a non-serve manifest never grows the slot (all-defaults-off)
+    assert "serve" not in RunManifest().doc
+
+
+def test_report_run_renders_serve_section(tmp_path, capsys):
+    from dgc_tpu.obs import RunLogger
+
+    log = tmp_path / "serve.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    fe = ServeFrontEnd(batch_max=2, window_s=0.0, queue_depth=8,
+                       logger=logger).start()
+    try:
+        fe.submit(generate_random_graph_fast(400, avg_degree=6,
+                                             seed=0)).result(timeout=300)
+    finally:
+        fe.shutdown()
+    logger.close()
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    import report_run
+
+    rc = report_run.main([str(log)])
+    assert rc == 0
+    out = capsys.readouterr().out
+    assert "serve:" in out and "requests: 1" in out
+
+
+# -- tuned-config cache -------------------------------------------------
+
+def test_tuned_config_cache_keys_by_shape(tmp_path):
+    from dgc_tpu.tune import TunedConfig
+    from dgc_tpu.tune.cache import TunedConfigCache
+
+    calls = []
+
+    def fake_tune(arrays):
+        calls.append(arrays.num_vertices)
+        from dgc_tpu.tune.config import graph_shape_hash
+
+        return TunedConfig(graph_shape_hash=graph_shape_hash(arrays))
+
+    cache = TunedConfigCache(cache_dir=str(tmp_path))
+    g1 = generate_random_graph_fast(500, avg_degree=6, seed=1)
+    g2 = generate_random_graph_fast(500, avg_degree=6, seed=1)  # same shape
+    g3 = generate_random_graph_fast(500, avg_degree=6, seed=2)  # new shape
+    cfg1 = cache.get_or_tune(g1, tune=fake_tune)
+    cfg2 = cache.get_or_tune(g2, tune=fake_tune)
+    assert cfg1 is cfg2 and calls == [500]     # recurring shape: no replay
+    cache.get_or_tune(g3, tune=fake_tune)
+    assert len(calls) == 2
+    # a fresh process (new cache object) hits the on-disk artifact
+    cold = TunedConfigCache(cache_dir=str(tmp_path))
+    got = cold.get_or_tune(g1, tune=fake_tune)
+    assert len(calls) == 2 and got.graph_shape_hash == cfg1.graph_shape_hash
+    assert cold.stats["disk_hits"] == 1
+
+
+# -- CLI subcommand -----------------------------------------------------
+
+def _run_cli(args, timeout=600):
+    env = dict(os.environ)
+    env["PYTHONPATH"] = REPO
+    env["JAX_PLATFORMS"] = "cpu"
+    return subprocess.run(
+        [sys.executable, "-m", "dgc_tpu.cli", *args],
+        env=env, cwd=REPO, capture_output=True, text=True, timeout=timeout)
+
+
+def test_serve_cli_end_to_end(tmp_path):
+    reqs = tmp_path / "requests.jsonl"
+    reqs.write_text("\n".join(
+        json.dumps({"id": i, "node_count": 80, "max_degree": 6, "seed": i})
+        for i in range(3)) + "\n")
+    results = tmp_path / "results.jsonl"
+    log = tmp_path / "run.jsonl"
+    manifest = tmp_path / "manifest.json"
+    out_dir = tmp_path / "colorings"
+    r = _run_cli(["serve", "--requests", str(reqs),
+                  "--results", str(results),
+                  "--output-colorings", str(out_dir),
+                  "--log-json", str(log),
+                  "--run-manifest", str(manifest),
+                  "--batch-max", "2", "--window-ms", "20"])
+    assert r.returncode == 0, r.stderr
+    lines = [json.loads(x) for x in results.read_text().splitlines()]
+    assert len(lines) == 3 and all(x["status"] == "ok" for x in lines)
+    assert all((out_dir / f"{x['id']}.json").exists() for x in lines)
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from validate_runlog import validate_file
+
+    assert validate_file(str(log)) == []
+    doc = json.loads(manifest.read_text())
+    assert doc["serve"]["summary"]["completed"] == 3
+
+
+def test_serve_cli_bad_request_file(tmp_path):
+    reqs = tmp_path / "requests.jsonl"
+    reqs.write_text("not json\n")
+    r = _run_cli(["serve", "--requests", str(reqs)])
+    assert r.returncode == 2
+
+
+def test_cli_without_serve_subcommand_unchanged(tmp_path):
+    # the all-defaults-off invariant: the plain driver still runs and the
+    # serve flags don't exist on it
+    out = tmp_path / "c.json"
+    r = _run_cli(["--node-count", "30", "--max-degree", "4", "--seed", "1",
+                  "--backend", "reference-sim",
+                  "--output-coloring", str(out)])
+    assert r.returncode == 0, r.stderr
+    assert out.exists()
+    r2 = _run_cli(["--batch-max", "2", "--output-coloring", str(out)])
+    assert r2.returncode == 2       # unknown flag outside the subcommand
+
+
+# -- soak ---------------------------------------------------------------
+
+@pytest.mark.slow
+def test_thousand_request_soak():
+    fe = ServeFrontEnd(batch_max=8, window_s=0.005, queue_depth=256).start()
+    try:
+        graphs = [generate_random_graph_fast(200 + (s % 5) * 50,
+                                             avg_degree=6, seed=s)
+                  for s in range(40)]
+        tickets = []
+        for i in range(1000):
+            tickets.append(fe.submit(graphs[i % len(graphs)],
+                                     timeout=60.0))
+        results = [t.result(timeout=900) for t in tickets]
+    finally:
+        fe.shutdown()
+    assert all(r.ok for r in results)
+    # determinism across the whole replay: same graph -> same answer
+    by_graph = {}
+    for i, r in enumerate(results):
+        key = i % len(graphs)
+        if key in by_graph:
+            assert r.minimal_colors == by_graph[key].minimal_colors
+            assert np.array_equal(r.colors, by_graph[key].colors)
+        else:
+            by_graph[key] = r
+    assert fe.scheduler.stats["batches"] < 1000  # batching actually batched

@@ -1,0 +1,68 @@
+"""tools/tail_run.py — incremental report rendering over a growing log."""
+
+import json
+import os
+import subprocess
+import sys
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, os.path.join(REPO, "tools"))
+
+
+def _events():
+    return [
+        {"t": 0.1, "event": "graph_generated", "vertices": 60,
+         "max_degree": 6, "method": "reference", "seed": 1},
+        {"t": 0.2, "event": "sweep_start", "backend": "ell-compact",
+         "initial_k": 7, "strict_decrement": False},
+        {"t": 0.5, "event": "attempt", "k": 7, "status": "SUCCESS",
+         "supersteps": 5, "colors_used": 4},
+        {"t": 0.9, "event": "sweep_done", "minimal_colors": 4,
+         "attempts": 2, "supersteps": 9, "wall_time_s": 0.8},
+    ]
+
+
+def test_follower_incremental_and_partial_lines(tmp_path):
+    from tail_run import LogFollower
+
+    log = tmp_path / "run.jsonl"
+    f = LogFollower(str(log))
+    assert f.poll() == 0                       # file may not exist yet
+    ev = _events()
+    log.write_text(json.dumps(ev[0]) + "\n")
+    assert f.poll() == 1 and not f.done
+    # a torn (half-written) line stays buffered until completed
+    half = json.dumps(ev[1])
+    with open(log, "a") as fh:
+        fh.write(half[:20])
+    assert f.poll() == 0
+    with open(log, "a") as fh:
+        fh.write(half[20:] + "\n" + json.dumps(ev[2]) + "\n"
+                 + json.dumps(ev[3]) + "\n")
+    assert f.poll() == 3
+    assert f.done                              # sweep_done is terminal
+    assert f.manifest.doc["result"]["minimal_colors"] == 4
+
+
+def test_tail_once_renders_report(tmp_path):
+    log = tmp_path / "run.jsonl"
+    log.write_text("\n".join(json.dumps(e) for e in _events()) + "\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tail_run.py"),
+         str(log), "--once"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "RESULT:   4 colors" in r.stdout
+    assert "ell-compact" in r.stdout
+
+
+def test_tail_follow_exits_on_terminal_event(tmp_path):
+    log = tmp_path / "run.jsonl"
+    log.write_text("\n".join(json.dumps(e) for e in _events()) + "\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tail_run.py"),
+         str(log), "--interval", "0.05", "--grace", "0.1", "--no-clear",
+         "--timeout", "30"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "RESULT:   4 colors" in r.stdout

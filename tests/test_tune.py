@@ -392,6 +392,30 @@ def test_trajectory_from_manifest_and_tune(rmat20k, rmat20k_traj):
     assert price_schedule(view, traj).total <= base.total
 
 
+def test_trajectory_from_manifest_uses_max_unconf(rmat20k, rmat20k_traj):
+    """The in-kernel max_unconf column (obs.kernel col 4) bounds capture
+    validity per superstep: ``max_unconf_per_bucket`` becomes
+    min(width, recorded max) instead of the width-pessimistic bound, and
+    its presence unlocks the hub-knob search in manifest mode."""
+    sizes, widths = bucket_layout(rmat20k)
+    sched = derive_schedule(sizes, widths, rmat20k.num_vertices,
+                            int(rmat20k.max_degree))
+    hub = sched["hub_buckets"]
+    doc = _manifest_doc_from_replay(rmat20k, rmat20k_traj, hub,
+                                    len(sizes) - hub)
+    mu = [min(40 + 3 * i, int(rmat20k.max_degree))
+          for i in range(rmat20k_traj.supersteps)]
+    doc["attempts"][0]["trajectory"]["max_unconf"] = mu
+    traj = trajectory_from_manifest(doc, rmat20k)
+    for st, m in zip(traj.steps, mu):
+        assert st.max_unconf_per_bucket == [min(w, m) for w in widths]
+    # without the column: pessimistic widths (pre-column manifests)
+    del doc["attempts"][0]["trajectory"]["max_unconf"]
+    traj0 = trajectory_from_manifest(doc, rmat20k)
+    assert all(st.max_unconf_per_bucket == [int(w) for w in widths]
+               for st in traj0.steps)
+
+
 def test_trajectory_from_manifest_rejects_bad_layout(rmat20k):
     doc = {"manifest_version": 1, "attempts": [{
         "k": 10, "trajectory": {"active": [5], "bucket_active": [[1, 2]],

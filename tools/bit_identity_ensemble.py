@@ -25,6 +25,15 @@ checks it the hard way: colors AND superstep counts must still equal
 committed run under a non-default config; the graph-shape-hash mismatch
 across draws is expected and warns — schedules stay exact on any graph).
 
+``--serve`` switches to the serving-path ensemble (``dgc_tpu.serve``):
+seeded draws spanning ≥2 shape classes and mixed real sizes within a
+class are ALL submitted concurrently to one micro-batching front-end
+(mixed-size batches by construction), once with obs telemetry attached
+and once without, and every draw's colors / minimal count / attempt
+sequence must be byte-identical to the single-graph fused jump-mode
+sweep (``CompactFrontierEngine`` + ``find_minimal_coloring``) — the
+batched-vs-single contract ``tools/serve_parity.jsonl`` commits.
+
 One JSON line per draw, nonzero exit on any mismatch.
 """
 
@@ -37,6 +46,104 @@ import time
 import warnings
 
 
+def serve_mode(args) -> int:
+    import numpy as np
+
+    from dgc_tpu.engine.compact import CompactFrontierEngine
+    from dgc_tpu.engine.minimal_k import (find_minimal_coloring,
+                                          make_reducer, make_validator)
+    from dgc_tpu.models.generators import (generate_random_graph_fast,
+                                           generate_rmat_graph)
+    from dgc_tpu.obs import MetricsRegistry, RunLogger
+    from dgc_tpu.serve.queue import ServeFrontEnd
+    from dgc_tpu.serve.shape_classes import DEFAULT_LADDER
+
+    # mixed real sizes landing in two shape classes (v2048 and v8192),
+    # alternating uniform/RMAT — batches mix sizes within a class
+    sizes = (1500, 2000, 5000, 8000)
+    draws = []
+    for i in range(args.draws):
+        seed = args.seed0 + i
+        v = sizes[i % len(sizes)]
+        gen = "rmat" if i % 2 else "uniform"
+        g = (generate_random_graph_fast(v, avg_degree=args.avg_degree,
+                                        seed=seed)
+             if gen == "uniform" else
+             generate_rmat_graph(v, avg_degree=args.avg_degree, seed=seed))
+        draws.append((i, seed, gen, g))
+
+    def run_front_end(telemetry: bool):
+        logger = registry = None
+        if telemetry:
+            import io
+
+            logger = RunLogger(stream=io.StringIO(), echo=False)
+            registry = MetricsRegistry()
+        fe = ServeFrontEnd(batch_max=4, window_s=0.05,
+                           queue_depth=4 * args.draws,
+                           logger=logger, registry=registry).start()
+        try:
+            tickets = [fe.submit(g.arrays if hasattr(g, "arrays") else g,
+                                 request_id=i) for i, _, _, g in draws]
+            return [t.result(timeout=600) for t in tickets]
+        finally:
+            fe.shutdown()
+
+    with_obs = run_front_end(telemetry=True)
+    without_obs = run_front_end(telemetry=False)
+
+    out = open(args.out, "w") if args.out else None
+    bad = 0
+    for (i, seed, gen, g), r_obs, r_plain in zip(draws, with_obs,
+                                                 without_obs):
+        t0 = time.perf_counter()
+        attempts = []
+        ref = find_minimal_coloring(
+            CompactFrontierEngine(g), initial_k=g.max_degree + 1,
+            validate=make_validator(g),
+            on_attempt=lambda res, val: attempts.append(
+                (int(res.k), res.status.name, int(res.supersteps))),
+            post_reduce=make_reducer(g))
+        cls = DEFAULT_LADDER.class_for(g.num_vertices, g.max_degree)
+        checks = {
+            "colors_vs_single": bool(
+                r_obs.ok and np.array_equal(r_obs.colors, ref.colors)),
+            "minimal_k_vs_single": r_obs.minimal_colors == ref.minimal_colors,
+            "attempts_vs_single": list(map(tuple, r_obs.attempts)) == attempts,
+            "telemetry_inert": bool(
+                r_plain.ok
+                and np.array_equal(r_obs.colors, r_plain.colors)
+                and r_obs.minimal_colors == r_plain.minimal_colors
+                and r_obs.attempts == r_plain.attempts),
+        }
+        # informational, not a pass/fail check: fallback draws (beyond
+        # the shape ladder) legitimately serve unbatched — the parity
+        # contract must hold on BOTH paths
+        rec = dict(draw=i, seed=seed, gen=gen, v=g.num_vertices,
+                   max_degree=int(g.max_degree),
+                   shape_class=cls.name if cls else None,
+                   batched=bool(r_obs.batched),
+                   minimal_colors=r_obs.minimal_colors,
+                   seconds=round(time.perf_counter() - t0, 2), **checks)
+        line = json.dumps(rec)
+        print(line)
+        if out:
+            out.write(line + "\n")
+        if not all(checks.values()):
+            bad += 1
+    classes = {c.name if c is not None else "fallback"
+               for c in (DEFAULT_LADDER.class_for(g.num_vertices,
+                                                  g.max_degree)
+                         for _, _, _, g in draws)}
+    summary = dict(draws=args.draws, mismatches=bad,
+                   shape_classes=sorted(classes))
+    print(json.dumps(summary))
+    if out:
+        out.write(json.dumps(summary) + "\n")
+        out.close()
+    return 1 if bad else 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=20_000)
@@ -47,7 +154,12 @@ def main() -> int:
     p.add_argument("--tuned-config", type=str, default=None,
                    help="tuned-config artifact applied to every compact "
                         "engine (bit-identity must hold under ANY config)")
+    p.add_argument("--serve", action="store_true",
+                   help="serving-path ensemble: batched front-end vs the "
+                        "single-graph fused sweep (module docstring)")
     args = p.parse_args()
+    if args.serve:
+        return serve_mode(args)
 
     import numpy as np
 

@@ -56,6 +56,19 @@ if [ "$battery_rc" -ne 2 ]; then
     --tuned-config tools/tuned_configs/rmat_200k.json 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
+  # serve-throughput A/B (PR 5, dgc_tpu.serve): graphs/s of the batched
+  # vmap'd front-end vs sequential single-graph sweeps of the same 20k
+  # graphs, batch 1/8/32. The CPU row (PERF.md "Batched throughput")
+  # measured 8.0x at batch-8 with batch-1 nearly equal (1-core host is
+  # compute-bound once compile is amortized) and batch-32 regressing on
+  # straggler sync; the TPU question is whether lane-parallel batching
+  # opens the batch-8/batch-1 ratio and rehabilitates batch-32. Results
+  # are color-parity-checked in-run (parity_ok in the JSON line).
+  echo "=== serve throughput A/B (20k class, batch 1/8/32) ===" | tee -a /dev/stderr >/dev/null
+  timeout 3600 python bench.py --serve-throughput \
+    --serve-graphs 8 --serve-batch-sizes 1,8,32 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+
   echo "=== tuned-vs-static A/B (1M RMAT) ===" | tee -a /dev/stderr >/dev/null
   timeout 7200 python bench.py --gen rmat --nodes 1000000 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true

@@ -104,6 +104,51 @@ def render(doc: dict, width: int = 48) -> str:
                 # neighbor-gather calls the kernel issued per superstep
                 add(f"{'':>38}gather calls/superstep: "
                     f"mean {sum(gc) / len(gc):.1f} max {max(gc)}")
+            mu = [c for c in (traj.get("max_unconf") or []) if c >= 0]
+            if mu:
+                # the capture-validity bar (obs.kernel col 4): max
+                # unconfirmed neighbors any gathered row saw
+                add(f"{'':>38}max unconfirmed nbrs: "
+                    f"peak {max(mu)} final {mu[-1]}")
+
+    sv = doc.get("serve")
+    if sv:
+        add("")
+        cfg = sv.get("config") or {}
+        add(f"serve:    batch_max={cfg.get('batch_max')} "
+            f"window_ms={cfg.get('window_ms')} "
+            f"queue_depth={cfg.get('queue_depth')}")
+        batches = sv.get("batches") or []
+        if batches:
+            occ = [b.get("occupancy", 0) for b in batches]
+            waste = [b.get("padding_waste", 0) for b in batches]
+            misses = sum(1 for b in batches
+                         if b.get("compile_cache") == "miss")
+            add(f"  batches: {len(batches)} "
+                f"(mean occupancy {sum(occ) / len(occ):.2f}, mean padding "
+                f"waste {sum(waste) / len(waste):.2f}, "
+                f"{misses} compile miss(es))")
+            add(f"  occupancy/batch: {sparkline(occ, width)}")
+        reqs = sv.get("requests") or []
+        if reqs:
+            lat = sorted(r.get("service_ms", 0) for r in reqs)
+            q = sorted(r.get("queue_ms", 0) for r in reqs)
+            p = lambda xs, f: xs[min(len(xs) - 1, int(f * len(xs)))]
+            add(f"  requests: {len(reqs)} "
+                f"(service p50 {p(lat, .5):.1f} ms, p95 {p(lat, .95):.1f} "
+                f"ms; queue p95 {p(q, .95):.1f} ms)")
+        summ = sv.get("summary")
+        if summ:
+            gps = summ.get("graphs_per_s")
+            add(f"  summary: {summ.get('completed')}/{summ.get('requests')} "
+                f"ok, {summ.get('failed')} failed, "
+                f"{summ.get('rejected', 0)} shed"
+                + (f", {gps} graphs/s" if gps is not None else ""))
+        hl = sv.get("health")
+        if hl is not None and (not hl.get("ready") or hl.get("degraded")):
+            add(f"  health: ready={hl.get('ready')} "
+                f"degraded={hl.get('degraded')} "
+                f"backend={hl.get('backend')} rung={hl.get('rung')}")
 
     ph = doc.get("phases") or {}
     totals = ph.get("totals") or {}
@@ -155,6 +200,12 @@ def render(doc: dict, width: int = 48) -> str:
             f"{res.get('wall_time_s')}s wall")
     elif res:
         add(f"RESULT:   FAILED (initial_k={res.get('initial_k')})")
+    elif sv and sv.get("summary"):
+        summ = sv["summary"]
+        add(f"RESULT:   serve loop done — "
+            f"{summ.get('completed')}/{summ.get('requests')} requests ok"
+            + (f", {summ.get('graphs_per_s')} graphs/s"
+               if summ.get("graphs_per_s") is not None else ""))
     else:
         add("RESULT:   (run did not complete)")
     return "\n".join(out) + "\n"

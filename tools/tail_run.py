@@ -1,0 +1,136 @@
+#!/usr/bin/env python
+"""Live-tail a JSONL run log and render the report while the run runs.
+
+``tools/report_run.py`` renders a finished artifact; this follows a
+growing ``--log-json`` stream (a sweep mid-flight, a serve loop under
+load) and re-renders the same report incrementally: new lines are fed
+through the identical ``RunManifest`` sink, so the live view and the
+post-hoc report can never disagree. The ROADMAP telemetry follow-on
+("live tailing").
+
+    python tools/tail_run.py RUN.jsonl              # follow until done
+    python tools/tail_run.py RUN.jsonl --once       # render now, exit
+
+Follow mode clears the screen between frames (disable with
+``--no-clear``), exits when the stream reaches a terminal event
+(``sweep_done`` / ``sweep_failed`` / ``serve_summary`` /
+``structured_abort``) plus ``--grace`` seconds, or on Ctrl-C. A log
+path that does not exist yet is waited for — start the tail before the
+run.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dgc_tpu.obs.manifest import RunManifest  # noqa: E402
+from tools.report_run import render  # noqa: E402
+
+_TERMINAL = {"sweep_done", "sweep_failed", "serve_summary",
+             "structured_abort", "watchdog_abort"}
+
+
+class LogFollower:
+    """Incremental JSONL reader feeding a ``RunManifest`` sink.
+
+    Tolerates a partially-written last line (no trailing newline yet):
+    it stays buffered until the writer finishes it. ``poll()`` returns
+    the number of new events consumed; ``done`` flips on a terminal
+    event."""
+
+    def __init__(self, path: str):
+        self.path = path
+        self.manifest = RunManifest()
+        self.done = False
+        self.events = 0
+        self._pos = 0
+        self._buf = ""
+
+    def poll(self) -> int:
+        try:
+            with open(self.path) as fh:
+                fh.seek(self._pos)
+                chunk = fh.read()
+                self._pos = fh.tell()
+        except OSError:
+            return 0
+        if not chunk:
+            return 0
+        self._buf += chunk
+        new = 0
+        while "\n" in self._buf:
+            line, self._buf = self._buf.split("\n", 1)
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                record = json.loads(line)
+            except json.JSONDecodeError:
+                continue  # torn write; the writer re-emits whole lines only
+            self.manifest(record)
+            new += 1
+            self.events += 1
+            if record.get("event") in _TERMINAL:
+                self.done = True
+        return new
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("path", help="JSONL run log (--log-json output)")
+    p.add_argument("--once", action="store_true",
+                   help="render the current state once and exit (tests)")
+    p.add_argument("--interval", type=float, default=0.5,
+                   help="poll interval in seconds (default 0.5)")
+    p.add_argument("--width", type=int, default=48,
+                   help="sparkline width (report_run contract)")
+    p.add_argument("--no-clear", action="store_true",
+                   help="append frames instead of clearing the screen")
+    p.add_argument("--grace", type=float, default=1.0,
+                   help="seconds to keep tailing after a terminal event "
+                        "(late trajectory/manifest lines)")
+    p.add_argument("--timeout", type=float, default=0.0,
+                   help="give up after this many seconds (0 = forever)")
+    args = p.parse_args(argv)
+
+    follower = LogFollower(args.path)
+    if args.once:
+        follower.poll()
+        sys.stdout.write(render(follower.manifest.doc, width=args.width))
+        return 0
+
+    t0 = time.monotonic()
+    t_done = None
+    try:
+        while True:
+            new = follower.poll()
+            if new:
+                frame = render(follower.manifest.doc, width=args.width)
+                if not args.no_clear:
+                    sys.stdout.write("\x1b[2J\x1b[H")
+                sys.stdout.write(
+                    frame + f"[tail] {follower.events} events from "
+                            f"{args.path}\n")
+                sys.stdout.flush()
+            if follower.done:
+                if t_done is None:
+                    t_done = time.monotonic()
+                elif time.monotonic() - t_done >= args.grace:
+                    return 0
+            if args.timeout and time.monotonic() - t0 > args.timeout:
+                print(f"[tail] timeout after {args.timeout:g}s",
+                      file=sys.stderr)
+                return 3
+            time.sleep(args.interval)
+    except KeyboardInterrupt:
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
