@@ -810,7 +810,9 @@ def _uncond_hub_step(pe, pk, buckets, row0s: tuple, sc: _SegCtx, k,
     (same tables, same windows, same ``_reduce_bucket_result`` gating;
     ``ops.segmented_gather`` module docstring). Returns
     ``({bi: (new_b, fail, act, mc)}, unconf)`` — ``unconf`` is the
-    telemetry max-unconfirmed scalar, or None when off/empty."""
+    telemetry ``{bi: max-unconfirmed scalar}`` map (one entry per plan
+    segment, the per-bucket capture-validity column), or None when
+    off/empty."""
     if not sc.uncond_idx:
         return {}, None
     pk_parts = [
@@ -828,9 +830,10 @@ def _uncond_hub_step(pe, pk, buckets, row0s: tuple, sc: _SegCtx, k,
         parts = seg.segmented_update_parts(
             pe, sc.seg_uncond, sc.uncond_plan, pk_rows, k, decode_combined,
             stats=(np_flat, beats_flat, stats))
-        unconf = seg.plan_unconf_max(sc.seg_uncond, np_flat,
-                                     sc.uncond_plan, pk_rows, v,
-                                     decode_combined)
+        per_seg = seg.plan_unconf_per_segment(
+            sc.seg_uncond, np_flat, sc.uncond_plan, pk_rows, v,
+            decode_combined)
+        unconf = {bi: per_seg[i] for i, bi in enumerate(sc.uncond_idx)}
     else:
         parts = seg.segmented_update_parts(
             pe, sc.seg_uncond, sc.uncond_plan, pk_rows, k, decode_combined)
@@ -858,8 +861,11 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
     actives, then the flat-region total. Returns
     (new_pe, fail_count, active_count, ba_new, mc, prune_new, gcalls,
     unconf) — ``gcalls`` is the superstep's neighbor-state element-gather
-    call count and ``unconf`` its max-unconfirmed-neighbor scalar (None
-    when ``with_unconf`` is off; the telemetry columns, ``obs.kernel``)."""
+    call count and ``unconf`` its per-bucket max-unconfirmed-neighbor
+    VECTOR in the ``ba`` layout (hub buckets, then the flat-region
+    total; None when ``with_unconf`` is off — the telemetry columns,
+    ``obs.kernel``: col 4 takes the vector's max, the per-bucket tail
+    takes the vector)."""
     if seg_ctx is None:
         seg_ctx = _SegCtx(buckets, planes, row0s, hub_buckets, hub_uncond)
     new_parts, parts_fail, parts_active, parts_mc = [], [], [], []
@@ -873,12 +879,12 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
                                      with_unconf=with_unconf, v=v)
     if un:
         gcalls = gcalls + 1
-        if un_unconf is not None:
-            unconf_parts.append(un_unconf)
     for bi in range(hub_buckets):
         if bi in un:
             new_b, f_b, a_b, m_b = un[bi]
             ps_b = prune[bi] if bi < len(prune) else None
+            if with_unconf:
+                unconf_parts.append(un_unconf[bi])
         else:
             cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
             pk_b = jax.lax.dynamic_slice_in_dim(pk, row0, cb.shape[0])
@@ -918,8 +924,7 @@ def _hybrid_superstep(pe, ba, buckets, row0s, k, planes: tuple, v: int,
     new_pk = jnp.concatenate(new_parts) if len(new_parts) > 1 else new_parts[0]
     new_pe = jnp.concatenate([new_pk, jnp.array([-1, 0], jnp.int32)])
     mc = parts_mc[0] if len(parts_mc) == 1 else jnp.max(jnp.stack(parts_mc))
-    unconf = (jnp.max(jnp.stack(unconf_parts)) if unconf_parts else
-              (jnp.int32(0) if with_unconf else None))
+    unconf = jnp.stack(unconf_parts) if with_unconf else None
     return (new_pe, sum(parts_fail), sum(parts_active),
             jnp.stack(ba_parts), mc, tuple(prune_new), gcalls, unconf)
 
@@ -1029,7 +1034,9 @@ def _hub_region_step(pe, ba, new_pe, prune, buckets, planes: tuple,
     pipeline by ``_unified_pipeline``. Unconditioned buckets fold into
     one shared segmented gather (``_uncond_hub_step``). Returns
     (new_pe, fails, actives, mcs, prune_new, gcalls, unconf) with
-    per-bucket lists (``unconf`` None when ``with_unconf`` off)."""
+    per-bucket lists (``unconf`` a per-hub-bucket list in bucket order —
+    the per-bucket capture-validity telemetry — or None when
+    ``with_unconf`` off)."""
     fails, actives, mcs = [], [], []
     prune_new = []
     unconf_parts = []
@@ -1037,8 +1044,6 @@ def _hub_region_step(pe, ba, new_pe, prune, buckets, planes: tuple,
         seg_ctx = _SegCtx(buckets, planes, row0s, nb_hub, hub_uncond)
     un, un_unconf = _uncond_hub_step(pe, pe[:v], buckets, row0s, seg_ctx, k,
                                      with_unconf=with_unconf, v=v)
-    if un_unconf is not None:
-        unconf_parts.append(un_unconf)
     gcalls = jnp.int32(1 if un else 0)
     for bi in range(nb_hub):
         cb, p_b, row0 = buckets[bi], planes[bi], row0s[bi]
@@ -1054,6 +1059,8 @@ def _hub_region_step(pe, ba, new_pe, prune, buckets, planes: tuple,
             actives.append(a_b)
             mcs.append(m_b)
             prune_new.append(ps2)
+            if with_unconf:
+                unconf_parts.append(un_unconf[bi])
             continue
 
         # slice + write-back stay inside the cond: an inert hub bucket
@@ -1084,8 +1091,7 @@ def _hub_region_step(pe, ba, new_pe, prune, buckets, planes: tuple,
         actives.append(a_b)
         mcs.append(m_b)
         prune_new.append(ps2)
-    unconf = (jnp.max(jnp.stack(unconf_parts)) if unconf_parts else
-              (jnp.int32(0) if with_unconf else None))
+    unconf = unconf_parts if with_unconf else None
     return new_pe, fails, actives, mcs, tuple(prune_new), gcalls, unconf
 
 
@@ -1330,7 +1336,11 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
         active = sum([act_fl] + h_actives)
         mc = jnp.max(jnp.stack([mc_f] + h_mcs))
         any_fail = fail_count > 0
-        unconf = (jnp.maximum(out_f[5], unconf_h) if wu else None)
+        # per-bucket unconf vector in the ba layout (hub buckets, then
+        # the flat-region total) — obs.kernel's doubled bucket tail
+        unconf = (jnp.stack(list(unconf_h)
+                            + ([out_f[5]] if has_flat else []))
+                  if wu else None)
         (rec5, stall, status, new_pe, ba_new, prune_new,
          traj) = _superstep_epilogue(
             recstep, rec5, pe, ba, prune, new_pe, ba_new, prune_new,
@@ -1505,13 +1515,16 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                 if not has_flat:
                     new_pe, fail_f, act_fl, mc_f = (
                         pe, jnp.int32(0), jnp.int32(0), jnp.int32(-1))
-                    unconf = jnp.int32(0) if record_traj else None
+                    unconf = jnp.zeros_like(ba) if record_traj else None
                 else:
                     # no hub: while-cond (active > thresh ≥ 0) already
                     # guarantees flat work exists — run uncond'd
                     out = do_flat(pe)
                     new_pe, fail_f, act_fl, mc_f = out[:4]
-                    unconf = out[4] if record_traj else None
+                    # per-bucket vector layout (obs.kernel): hub-free, so
+                    # the ba layout is the single flat-region slot
+                    unconf = (jnp.stack([out[4]]) if record_traj
+                              else None)
 
                 ba_new = jnp.stack([act_fl]) if has_flat else ba
                 fail_count = sum([fail_f])
@@ -1555,7 +1568,8 @@ def _attempt_kernel_staged(buckets, flat_ext, degrees, k,
     nb = len(static_kw["init_bucket_active"])
     init = _default_init(degrees, static_kw["init_bucket_active"])
     rec = _empty_rec(degrees.shape[0], nb, dummy=True)
-    traj0 = traj_empty(traj_cap, nb=nb, dummy=not record_traj)
+    traj0 = traj_empty(traj_cap, nb=nb, dummy=not record_traj,
+                       unconf_b=record_traj)
     pe, steps, status, _, traj = _staged_pipeline(
         buckets, flat_ext, degrees, k, init, rec, False,
         traj=traj0, record_traj=record_traj, **static_kw)
@@ -1609,7 +1623,8 @@ def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
     pe0 = jnp.zeros(v + 2, jnp.int32)
     z = jnp.int32(0)
     rec0 = _empty_rec(v, nb)
-    traj0 = traj_empty(traj_cap, nb=nb, dummy=not record_traj)
+    traj0 = traj_empty(traj_cap, nb=nb, dummy=not record_traj,
+                       unconf_b=record_traj)
     init = (jnp.int32(0), jnp.asarray(k0, jnp.int32),
             pe0, z, z,          # slot 1: pe1, steps1, status1
             z,                  # used
@@ -1792,7 +1807,8 @@ class CompactFrontierEngine(BucketedELLEngine):
             break
         res = self._finish(np.asarray(pe)[:v], status, int(steps), int(k))
         if self.record_trajectory:
-            res.trajectory = decode_trajectory(traj, res.supersteps)
+            res.trajectory = decode_trajectory(traj, res.supersteps,
+                                               unconf_b=True)
         return res
 
     def sweep(self, k0: int) -> tuple[AttemptResult, AttemptResult | None]:
@@ -1815,13 +1831,15 @@ class CompactFrontierEngine(BucketedELLEngine):
             break
         first = self._finish(np.asarray(pe1)[:v], status1, int(steps1), int(k0))
         if self.record_trajectory:
-            first.trajectory = decode_trajectory(traj1, first.supersteps)
+            first.trajectory = decode_trajectory(traj1, first.supersteps,
+                                                 unconf_b=True)
 
         def finish_second(k2):
             res = self._finish(np.asarray(pe2)[:v],
                                AttemptStatus(int(status2)), int(steps2), k2)
             if self.record_trajectory:
-                res.trajectory = decode_trajectory(traj2, res.supersteps)
+                res.trajectory = decode_trajectory(traj2, res.supersteps,
+                                                   unconf_b=True)
             return res
 
         return finish_sweep_pair(

@@ -31,7 +31,14 @@ metrics of superstep ``s`` (the engine's step counter):
   width;
 - cols 5..5+nb: per-bucket active counts (bucket occupancy) for the
   bucketed engines (``nb`` = the engine's bucket-active vector length,
-  0 for the flat engines).
+  0 for the flat engines);
+- cols 5+nb..5+2·nb (only when the engine records a per-bucket unconf
+  *vector* — the compact engine with telemetry on): per-bucket max
+  unconfirmed-neighbor counts in the same ``nb`` layout as the
+  bucket-active tail (hub buckets, then the flat-region total). Col 4
+  is then exactly the vector's max — kept for layout compatibility —
+  while ``tune --from-manifest`` reads the tail to bound each hub
+  bucket's capture validity separately instead of by the global max.
 
 Unwritten rows keep the −1 fill, so the host decoder recovers the exact
 written span (a prefix-resumed confirm attempt starts mid-buffer; rows
@@ -61,13 +68,17 @@ def traj_cap_for(max_steps: int, cap: int = DEFAULT_TRAJ_CAP) -> int:
     return max(1, min(int(max_steps) + 1, cap))
 
 
-def traj_empty(cap: int, nb: int = 0, dummy: bool = False):
+def traj_empty(cap: int, nb: int = 0, dummy: bool = False,
+               unconf_b: bool = False):
     """Fresh trajectory buffer (−1 fill = unwritten). ``dummy=True`` gives
-    the 1-row inert buffer for kernels compiled with telemetry off."""
+    the 1-row inert buffer for kernels compiled with telemetry off.
+    ``unconf_b=True`` doubles the bucket tail for engines that record the
+    per-bucket max-unconf vector beside bucket occupancy."""
     import jax.numpy as jnp
 
     rows = 1 if dummy else cap
-    return jnp.full((rows, TRAJ_COLS + nb), -1, jnp.int32)
+    return jnp.full((rows, TRAJ_COLS + nb * (2 if unconf_b else 1)),
+                    -1, jnp.int32)
 
 
 def make_trajstep(record):
@@ -78,7 +89,10 @@ def make_trajstep(record):
     ``trajstep(traj, step, active, any_fail, mc, ba, gcalls=...,
     unconf=...)`` writes row ``step``; out-of-range steps (past the cap)
     drop on device. ``mc`` / ``ba`` / ``gcalls`` / ``unconf`` may be None
-    where the engine does not compute them.
+    where the engine does not compute them. ``unconf`` may be a scalar
+    (col 4 only) or a per-bucket VECTOR in the bucket-active layout —
+    the vector lands in the per-bucket tail and its max in col 4 (the
+    buffer must then be ``traj_empty(..., unconf_b=True)``).
     """
     import jax.numpy as jnp
 
@@ -86,6 +100,10 @@ def make_trajstep(record):
                  gcalls=None, unconf=None):
         if record is False:
             return traj
+        unconf_vec = None
+        if unconf is not None and getattr(unconf, "ndim", 0) == 1:
+            unconf_vec = jnp.asarray(unconf, jnp.int32)
+            unconf = jnp.max(unconf_vec, initial=0)
         cols = [jnp.asarray(active, jnp.int32),
                 jnp.asarray(any_fail, jnp.int32),
                 jnp.int32(-1) if mc is None else jnp.asarray(mc, jnp.int32),
@@ -96,6 +114,8 @@ def make_trajstep(record):
         row = jnp.stack(cols)
         if ba is not None:
             row = jnp.concatenate([row, jnp.asarray(ba, jnp.int32)])
+        if unconf_vec is not None:
+            row = jnp.concatenate([row, unconf_vec])
         return traj.at[step].set(row, mode="drop")
 
     return trajstep
@@ -113,6 +133,8 @@ class SuperstepTrajectory:
     bucket_active: np.ndarray | None   # int32[S, nb] bucket occupancy, or None
     first_step: int                    # step index of row 0 (resume offset)
     truncated: bool                    # steps ran past the buffer cap
+    max_unconf_bucket: np.ndarray | None = None  # int32[S, nb] per-bucket
+                                       # max unconf (bucket-active layout)
 
     def __len__(self) -> int:
         return len(self.active)
@@ -129,15 +151,20 @@ class SuperstepTrajectory:
         }
         if self.bucket_active is not None:
             d["bucket_active"] = self.bucket_active.tolist()
+        if self.max_unconf_bucket is not None:
+            d["max_unconf_bucket"] = self.max_unconf_bucket.tolist()
         return d
 
 
-def decode_trajectory(buf, supersteps: int | None = None) -> SuperstepTrajectory:
+def decode_trajectory(buf, supersteps: int | None = None,
+                      unconf_b: bool = False) -> SuperstepTrajectory:
     """Decode a device trajectory buffer into the written span.
 
     Written rows have ``active >= 0`` (the −1 fill marks unwritten); the
     span is contiguous. ``supersteps`` (the attempt's final step counter)
-    flags truncation when it ran past the buffer cap.
+    flags truncation when it ran past the buffer cap. ``unconf_b`` marks
+    a doubled bucket tail (``traj_empty(..., unconf_b=True)``): the
+    second ``nb`` columns decode as the per-bucket max-unconf vector.
     """
     buf = np.asarray(buf)
     written = buf[:, 0] >= 0
@@ -148,7 +175,8 @@ def decode_trajectory(buf, supersteps: int | None = None) -> SuperstepTrajectory
                                    None, 0, False)
     lo, hi = int(idx[0]), int(idx[-1]) + 1
     span = buf[lo:hi]
-    nb = buf.shape[1] - TRAJ_COLS
+    tail = buf.shape[1] - TRAJ_COLS
+    nb = tail // 2 if unconf_b else tail
     truncated = bool(supersteps is not None and supersteps > buf.shape[0])
     return SuperstepTrajectory(
         active=span[:, 0].astype(np.int32),
@@ -156,7 +184,11 @@ def decode_trajectory(buf, supersteps: int | None = None) -> SuperstepTrajectory
         mc=span[:, 2].astype(np.int32),
         gather_calls=span[:, 3].astype(np.int32),
         max_unconf=span[:, 4].astype(np.int32),
-        bucket_active=span[:, TRAJ_COLS:].astype(np.int32) if nb > 0 else None,
+        bucket_active=(span[:, TRAJ_COLS:TRAJ_COLS + nb].astype(np.int32)
+                       if nb > 0 else None),
         first_step=lo,
         truncated=truncated,
+        max_unconf_bucket=(
+            span[:, TRAJ_COLS + nb:TRAJ_COLS + 2 * nb].astype(np.int32)
+            if unconf_b and nb > 0 else None),
     )

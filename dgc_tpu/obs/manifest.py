@@ -83,16 +83,25 @@ class RunManifest:
             self.doc["post_reduce"] = fields
         elif kind in ("sweep_done", "sweep_failed"):
             self.doc["result"] = dict(fields, event=kind)
-        elif kind.startswith("serve_"):
+        elif kind.startswith("serve_") or kind == "lane_recycled":
             # serving path (dgc_tpu.serve) — the slot appears only when
             # serve events do, so non-serve manifests stay byte-identical
             serve = self.doc.setdefault(
-                "serve", {"config": None, "batches": [], "requests": [],
+                "serve", {"config": None, "batches": [], "slices": [],
+                          "recycles": 0, "requests": [], "warmup": None,
                           "health": None, "summary": None})
             if kind == "serve_start":
                 serve["config"] = fields
             elif kind == "serve_batch":
                 serve["batches"].append(fields)
+            elif kind == "serve_slice":
+                # lane-recycling occupancy series (continuous mode) —
+                # tools/report_run.py renders it over time
+                serve["slices"].append(fields)
+            elif kind == "lane_recycled":
+                serve["recycles"] += 1
+            elif kind == "serve_warmup":
+                serve["warmup"] = fields
             elif kind == "serve_request":
                 serve["requests"].append(fields)
             elif kind == "serve_health":

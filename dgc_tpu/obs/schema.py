@@ -47,7 +47,7 @@ EVENT_SCHEMAS: dict = {
         {"k": "int", "active": "list", "fail": "list", "mc": "list",
          "first_step": "int", "truncated": "bool"},
         {"bucket_active": "list", "gather_calls": "list",
-         "max_unconf": "list"}),
+         "max_unconf": "list", "max_unconf_bucket": "list"}),
     "phase": (
         {"name": "str", "seconds": NUM},
         {"k": "int", "attempt_index": "int", "warm": "bool"}),
@@ -88,12 +88,28 @@ EVENT_SCHEMAS: dict = {
     # the supervisor-rung-fed health snapshots
     "serve_start": (
         {"batch_max": "int", "window_ms": NUM, "queue_depth": "int",
-         "workers": "int"}, {}),
+         "workers": "int"},
+        {"mode": "str", "slice_steps": ("int", "null"),
+         "affinity": "bool"}),
     "serve_batch": (
         {"shape_class": "str", "batch": "int", "occupancy": NUM,
          "padding_waste": NUM},
         {"b_pad": "int", "compile_cache": "str", "device_ms": NUM,
-         "queue_ms_max": NUM}),
+         "queue_ms_max": NUM, "straggler_waste": NUM,
+         "depth_buckets": "int"}),
+    # continuous batching (lane recycling): one serve_slice per sliced
+    # kernel dispatch, one lane_recycled per completed sweep swapped out
+    "serve_slice": (
+        {"shape_class": "str", "live": "int", "b_pad": "int",
+         "occupancy": NUM},
+        {"done": "int", "admitted": "int", "slice_steps": "int",
+         "compile_cache": "str", "device_ms": NUM}),
+    "lane_recycled": (
+        {"shape_class": "str", "lane": "int"},
+        {"k": "int", "depth_bucket": "int", "slices": "int",
+         "queue_ms": NUM, "service_ms": NUM}),
+    "serve_warmup": (
+        {"classes": "int", "kernels": "int", "seconds": NUM}, {}),
     "serve_request": (
         {"request_id": "int", "status": "str", "queue_ms": NUM,
          "service_ms": NUM},
@@ -112,7 +128,9 @@ EVENT_SCHEMAS: dict = {
         {"requests": "int", "completed": "int", "failed": "int",
          "wall_s": NUM},
         {"rejected": "int", "graphs_per_s": (*NUM, "null"),
-         "batches": "int", "compile_misses": "int", "compile_hits": "int"}),
+         "batches": "int", "compile_misses": "int", "compile_hits": "int",
+         "slices": "int", "recycles": "int", "mode": "str",
+         "warmup_s": (*NUM, "null"), "warmed_kernels": ("int", "null")}),
 }
 
 

@@ -200,28 +200,38 @@ def _pad_planes(planes_arr, p: int):
     return jnp.concatenate([planes_arr, pad], axis=-1)
 
 
-def plan_unconf_max(seg_comb, np_flat, plan: tuple, pk_rows, v: int,
-                    decode):
-    """Max unconfirmed-neighbor count over the plan's ACTIVE rows, from
-    the already-gathered flat neighbor state — the telemetry column
-    (``obs.kernel`` col 4) that bounds hub capture validity. A neighbor
-    slot counts when it is real (id < ``v`` — the tables' pad sentinel
-    is ``v``) and its gathered state is not confirmed. Rows currently
-    inactive contribute 0 (the exact-rule replay's "over active rows"
-    semantics, ``utils.trajectory``)."""
+def plan_unconf_per_segment(seg_comb, np_flat, plan: tuple, pk_rows,
+                            v: int, decode) -> list:
+    """Per-segment max unconfirmed-neighbor counts over each segment's
+    ACTIVE rows, from the already-gathered flat neighbor state — the
+    telemetry columns (``obs.kernel`` col 4 + the per-bucket tail) that
+    bound hub capture validity per bucket. A neighbor slot counts when
+    it is real (id < ``v`` — the tables' pad sentinel is ``v``) and its
+    gathered state is not confirmed. Rows currently inactive contribute
+    0 (the exact-rule replay's "over active rows" semantics,
+    ``utils.trajectory``). Returns one int32 scalar per plan segment."""
     nb, _ = decode(seg_comb)
     unconf_flat = ((nb < v)
                    & ~((np_flat >= 0) & ((np_flat & 1) == 0))
                    ).astype(jnp.int32)
     act = (pk_rows < 0) | ((pk_rows & 1) == 1)
-    mx = jnp.int32(0)
+    out = []
     for s in plan:
         blk = jax.lax.slice(unconf_flat, (s.flat0,),
                             (s.flat0 + s.rows * s.width,))
         cnt = jnp.sum(blk.reshape(s.rows, s.width), axis=1)
         act_s = jax.lax.slice(act, (s.row0,), (s.row0 + s.rows,))
-        mx = jnp.maximum(mx, jnp.max(jnp.where(act_s, cnt, 0), initial=0))
-    return mx
+        out.append(jnp.max(jnp.where(act_s, cnt, 0), initial=0))
+    return out
+
+
+def plan_unconf_max(seg_comb, np_flat, plan: tuple, pk_rows, v: int,
+                    decode):
+    """Whole-plan max of :func:`plan_unconf_per_segment` (the scalar
+    telemetry form for single-segment consumers)."""
+    parts = plan_unconf_per_segment(seg_comb, np_flat, plan, pk_rows, v,
+                                    decode)
+    return parts[0] if len(parts) == 1 else jnp.max(jnp.stack(parts))
 
 
 def segmented_update(pe_src, seg_comb, plan: tuple, pk_rows, k, decode,

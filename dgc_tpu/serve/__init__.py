@@ -13,11 +13,18 @@ per-request host loop. This package amortizes all three:
   stream hits a bounded set of compiled kernels;
 - :mod:`~dgc_tpu.serve.batched` — a ``jax.vmap``'d fused jump-mode sweep
   (batch axis over graphs, per-graph phase/k/done bookkeeping in the
-  while-loop carry) that colors B graphs in ONE device dispatch,
-  per-graph bit-identical to the single-graph fused engines;
-- :mod:`~dgc_tpu.serve.engine` — the sweep scheduler: groups concurrent
-  sweep calls by shape class, pads batches, and owns the compile cache
-  (keyed by shape class × batch pad) plus the tuned-config cache hook;
+  while-loop carry) that colors B graphs per dispatch, per-graph
+  bit-identical to the single-graph fused engines — as one
+  batch-complete dispatch (sync mode) or as bounded superstep *slices*
+  whose full per-lane carry re-enters from the host
+  (``batched_slice_kernel`` — the continuous-batching kernel);
+- :mod:`~dgc_tpu.serve.engine` — the sweep scheduler: **lane recycling**
+  (default): each class owns an adaptive lane pool, finished lanes swap
+  queued requests in at every slice boundary, and predicted-depth
+  **affinity batching** co-schedules requests that finish together;
+  plus the sync batch-complete dispatch as the A/B baseline, the
+  compile cache (keyed class × batch pad × slice), startup pre-warm of
+  a class's whole pad ladder, and the tuned-config cache hook;
 - :mod:`~dgc_tpu.serve.queue` — the micro-batching front-end: bounded
   request queue with a batching window and backpressure, worker loop,
   per-request latency accounting, health/readiness fed by the resilience

@@ -11,6 +11,24 @@ their own supersteps, phase transitions, and per-graph ``max_steps``
 clamps independently — the per-graph done/superstep masking is the
 carry, not host logic.
 
+**Lane recycling** (continuous batching): :func:`batched_slice_kernel`
+runs the SAME per-lane superstep body (:func:`_superstep_body` — one
+definition, so the sliced and unsliced kernels cannot drift) for at most
+``slice_steps`` supersteps per invocation and returns the full per-lane
+carry to the host. The scheduler (``serve.engine``) swaps each ``done``
+lane's result out and a queued request in — writing the lane's
+``comb``/``degrees``/``k0``/``max_steps`` inputs and raising its
+``reset`` flag; the kernel re-initializes flagged lanes from those
+inputs before slicing, so the host never fabricates device state. No
+host callbacks: the loop is re-entered from ordinary host Python, which
+keeps it deterministic, resumable, and CPU-testable. Slicing is
+result-invariant by construction: a lane's carry round-trips exactly
+(int32, no precision), the body is shared, and the unsliced loop's cond
+(``phase < 2``) is the slice cond minus the budget — so the sequence of
+superstep bodies applied to any lane is identical however the budget
+partitions it (locked across recycling boundaries by
+``tools/serve_parity.jsonl`` and ``tests/test_serve.py``).
+
 **Bit-identity contract** (locked by ``tools/serve_parity.jsonl`` and
 ``tests/test_serve.py``): every graph's colors, superstep counts, and
 statuses are byte-identical to the single-graph fused engines
@@ -38,14 +56,20 @@ statuses are byte-identical to the single-graph fused engines
   equal the single-graph engines'. The confirm attempt runs from
   scratch, which the prefix-resume contract defines as bit-identical to
   the resumed confirm (``engine.compact._sweep_kernel_staged``).
+- *Lanes don't interact*: under vmap every lane's carry element is
+  selected on its OWN cond only — a neighbor lane finishing, resetting,
+  or idling changes nothing in another lane's per-superstep values, so
+  recycling a lane mid-batch leaves its co-residents byte-identical.
 
 The kernel records no in-kernel trajectory: serve telemetry is
-batch/request-grained (``obs`` ``serve_batch``/``serve_request``
-events), and the bit-identity ensemble checks serve telemetry on/off.
+slice/request-grained (``obs`` ``serve_slice``/``lane_recycled``/
+``serve_batch``/``serve_request`` events), and the bit-identity ensemble
+checks serve telemetry on/off.
 """
 
 from __future__ import annotations
 
+import math
 from functools import partial
 
 import jax
@@ -63,6 +87,81 @@ _STALLED = AttemptStatus.STALLED
 
 DEFAULT_STALL_WINDOW = 64  # the engines' shared defensive exit
 
+# per-lane carry layout (the slice kernel's host<->device contract):
+# (phase, k, packed, step, prev_active, stall,   -- live sweep state
+#  p1, s1, st1, used, p2, s2, st2)               -- jump-pair result slots
+CARRY_LEN = 13
+_OUT0 = 6          # index of the first result slot (p1) in the carry
+
+
+def _fresh_lane(degrees, k0):
+    """A lane's carry at sweep start — phase 0, budget ``k0``, round-1
+    state. The unsliced kernel's init and the slice kernel's ``reset``
+    branch share this one definition."""
+    v = degrees.shape[0]
+    packed0 = initial_packed(degrees)
+    zeros = jnp.zeros_like(packed0)
+    z = jnp.int32(0)
+    return (z, jnp.asarray(k0, jnp.int32),
+            packed0, jnp.int32(1), jnp.int32(v + 1), z,  # live sweep state
+            zeros, z, z,                                 # slot 1
+            z,                                           # used
+            zeros, z, jnp.int32(_FAILURE))               # slot 2
+
+
+def _superstep_body(c, nbr, beats, packed0, max_steps, v: int, *,
+                    planes: int, stall_window: int):
+    """ONE superstep + attempt-boundary transition of one lane's carry —
+    the single body both :func:`_sweep_pair_one` (unsliced) and
+    :func:`batched_slice_kernel` (sliced) loop over, so the two cannot
+    drift (the recycling bit-identity precondition)."""
+    (phase, k, packed, step, prev_active, stall,
+     p1, s1, st1, used, p2, s2, st2) = c
+    first = phase == 0
+
+    # --- one full-table superstep (BSP snapshot semantics) ---
+    pe = jnp.concatenate([packed, jnp.array([-1], jnp.int32)])
+    np_ = pe[nbr]
+    new_packed, fail_mask, act_mask, _mc = speculative_update_mc(
+        packed, np_, beats, k, planes)
+    fail_count = jnp.sum(fail_mask.astype(jnp.int32))
+    active = jnp.sum(act_mask.astype(jnp.int32))
+    any_fail = fail_count > 0
+    stall_new = jnp.where(active < prev_active, 0, stall + 1)
+    status_new = status_step(any_fail, active, stall_new, stall_window)
+    new_packed = jnp.where(any_fail, packed, new_packed)
+    step_new = step + 1
+
+    # the single-graph host loop's exit + STALLED clamp, per graph
+    fin = (status_new != _RUNNING) | (step_new >= max_steps)
+    status_fin = jnp.where((status_new == _RUNNING) & fin,
+                           jnp.int32(_STALLED), status_new)
+
+    # --- attempt boundary: store the slot, derive the confirm ---
+    colors = jnp.where(new_packed >= 0, new_packed >> 1, -1)
+    used_new = jnp.where(fin & first,
+                         jnp.max(colors, initial=-1) + 1, used)
+    k2 = used_new - 1
+    run2 = fin & first & (status_fin == _SUCCESS) & (k2 >= 1)
+
+    store1 = fin & first
+    store2 = fin & ~first
+    return (
+        jnp.where(fin, jnp.where(run2, 1, 2), phase).astype(jnp.int32),
+        jnp.where(run2, k2, k).astype(jnp.int32),
+        jnp.where(fin, packed0, new_packed),
+        jnp.where(fin, 1, step_new).astype(jnp.int32),
+        jnp.where(fin, v + 1, active).astype(jnp.int32),
+        jnp.where(fin, 0, stall_new).astype(jnp.int32),
+        jnp.where(store1, new_packed, p1),
+        jnp.where(store1, step_new, s1).astype(jnp.int32),
+        jnp.where(store1, status_fin, st1).astype(jnp.int32),
+        used_new,
+        jnp.where(store2, new_packed, p2),
+        jnp.where(store2, step_new, s2).astype(jnp.int32),
+        jnp.where(store2, status_fin, st2).astype(jnp.int32),
+    )
+
 
 def _sweep_pair_one(comb, degrees, k0, max_steps, *, planes: int,
                     stall_window: int):
@@ -76,80 +175,122 @@ def _sweep_pair_one(comb, degrees, k0, max_steps, *, planes: int,
     v = degrees.shape[0]
     nbr, beats = decode_combined(comb)
     packed0 = initial_packed(degrees)
-    zeros = jnp.zeros_like(packed0)
-    z = jnp.int32(0)
-    init = (z, jnp.asarray(k0, jnp.int32),
-            packed0, jnp.int32(1), jnp.int32(v + 1), z,  # live: packed, step, prev_active, stall
-            zeros, z, z,                                 # slot 1: packed1, steps1, status1
-            z,                                           # used
-            zeros, z, jnp.int32(_FAILURE))               # slot 2
 
     def cond(c):
         return c[0] < 2
 
     def body(c):
-        (phase, k, packed, step, prev_active, stall,
-         p1, s1, st1, used, p2, s2, st2) = c
-        first = phase == 0
+        return _superstep_body(c, nbr, beats, packed0, max_steps, v,
+                               planes=planes, stall_window=stall_window)
 
-        # --- one full-table superstep (BSP snapshot semantics) ---
-        pe = jnp.concatenate([packed, jnp.array([-1], jnp.int32)])
-        np_ = pe[nbr]
-        new_packed, fail_mask, act_mask, _mc = speculative_update_mc(
-            packed, np_, beats, k, planes)
-        fail_count = jnp.sum(fail_mask.astype(jnp.int32))
-        active = jnp.sum(act_mask.astype(jnp.int32))
-        any_fail = fail_count > 0
-        stall_new = jnp.where(active < prev_active, 0, stall + 1)
-        status_new = status_step(any_fail, active, stall_new, stall_window)
-        new_packed = jnp.where(any_fail, packed, new_packed)
-        step_new = step + 1
+    out = jax.lax.while_loop(cond, body, _fresh_lane(degrees, k0))
+    return out[_OUT0:]
 
-        # the single-graph host loop's exit + STALLED clamp, per graph
-        fin = (status_new != _RUNNING) | (step_new >= max_steps)
-        status_fin = jnp.where((status_new == _RUNNING) & fin,
-                               jnp.int32(_STALLED), status_new)
 
-        # --- attempt boundary: store the slot, derive the confirm ---
-        colors = jnp.where(new_packed >= 0, new_packed >> 1, -1)
-        used_new = jnp.where(fin & first,
-                             jnp.max(colors, initial=-1) + 1, used)
-        k2 = used_new - 1
-        run2 = fin & first & (status_fin == _SUCCESS) & (k2 >= 1)
+def _slice_one(comb, degrees, k0, max_steps, reset, carry, *, planes: int,
+               slice_steps: int, stall_window: int):
+    """At most ``slice_steps`` supersteps of one lane's sweep. A lane
+    flagged ``reset`` re-initializes from its (freshly host-written)
+    inputs first; a lane whose phase is already 2 (done / idle) does no
+    work — its carry passes through untouched."""
+    v = degrees.shape[0]
+    nbr, beats = decode_combined(comb)
+    packed0 = initial_packed(degrees)
+    fresh = reset != 0
+    carry = jax.tree.map(
+        lambda f, c: jnp.where(fresh, f, c), _fresh_lane(degrees, k0),
+        tuple(carry))
 
-        store1 = fin & first
-        store2 = fin & ~first
-        return (
-            jnp.where(fin, jnp.where(run2, 1, 2), phase).astype(jnp.int32),
-            jnp.where(run2, k2, k).astype(jnp.int32),
-            jnp.where(fin, packed0, new_packed),
-            jnp.where(fin, 1, step_new).astype(jnp.int32),
-            jnp.where(fin, v + 1, active).astype(jnp.int32),
-            jnp.where(fin, 0, stall_new).astype(jnp.int32),
-            jnp.where(store1, new_packed, p1),
-            jnp.where(store1, step_new, s1).astype(jnp.int32),
-            jnp.where(store1, status_fin, st1).astype(jnp.int32),
-            used_new,
-            jnp.where(store2, new_packed, p2),
-            jnp.where(store2, step_new, s2).astype(jnp.int32),
-            jnp.where(store2, status_fin, st2).astype(jnp.int32),
-        )
+    def cond(c):
+        return (c[1] < 2) & (c[0] < slice_steps)
 
-    out = jax.lax.while_loop(cond, body, init)
-    (_, _, _, _, _, _, p1, s1, st1, used, p2, s2, st2) = out
-    return p1, s1, st1, used, p2, s2, st2
+    def body(c):
+        new = _superstep_body(c[1:], nbr, beats, packed0, max_steps, v,
+                              planes=planes, stall_window=stall_window)
+        return (c[0] + 1,) + new
+
+    out = jax.lax.while_loop(cond, body, (jnp.int32(0),) + carry)
+    return out[1:]
 
 
 @partial(jax.jit, static_argnames=("planes", "stall_window"))
 def batched_sweep_kernel(comb, degrees, k0, max_steps, planes: int,
                          stall_window: int = DEFAULT_STALL_WINDOW):
-    """The class kernel: ``comb int32[B, V_pad, W_pad]``, ``degrees
-    int32[B, V_pad]``, per-graph ``k0``/``max_steps`` int32[B]. One jit
-    cache entry per (B, V_pad, W_pad, planes) — the serve compile cache's
-    key (``serve.engine``)."""
+    """The batch-synchronous class kernel: ``comb int32[B, V_pad,
+    W_pad]``, ``degrees int32[B, V_pad]``, per-graph ``k0``/``max_steps``
+    int32[B]. One jit cache entry per (B, V_pad, W_pad, planes) — the
+    serve compile cache's key (``serve.engine``). Every lane runs its
+    whole jump-mode pair; the dispatch returns when the LAST lane
+    finishes (the straggler sync lane recycling removes)."""
     return jax.vmap(partial(_sweep_pair_one, planes=planes,
                             stall_window=stall_window))(
         comb, degrees, k0, max_steps)
+
+
+@partial(jax.jit, static_argnames=("planes", "slice_steps", "stall_window"))
+def batched_slice_kernel(comb, degrees, k0, max_steps, reset, carry,
+                         planes: int, slice_steps: int,
+                         stall_window: int = DEFAULT_STALL_WINDOW):
+    """The continuous-batching class kernel: one bounded slice of every
+    lane's sweep. Inputs as :func:`batched_sweep_kernel` plus ``reset
+    int32[B]`` (1 = re-init the lane from its inputs) and the per-lane
+    ``carry`` (:data:`CARRY_LEN`-tuple, batch-leading). Returns the
+    advanced carry; the host reads ``carry[0] >= 2`` as the done mask.
+    One jit cache entry per (B, V_pad, W_pad, planes, slice_steps)."""
+    return jax.vmap(partial(_slice_one, planes=planes,
+                            slice_steps=slice_steps,
+                            stall_window=stall_window))(
+        comb, degrees, k0, max_steps, reset, carry)
+
+
+def idle_carry(b_pad: int, v_pad: int):
+    """Host-side all-idle lane carry (phase 2, inert): the continuous
+    pool's starting state and the shape every resize pads with. Plain
+    numpy — the kernel's first invocation uploads it."""
+    pk = np.zeros((b_pad, v_pad), np.int32)
+    z = np.zeros(b_pad, np.int32)
+    return (np.full(b_pad, 2, np.int32), np.ones(b_pad, np.int32),
+            pk.copy(), z.copy(), z.copy(), z.copy(),
+            pk.copy(), z.copy(), z.copy(), z.copy(),
+            pk.copy(), z.copy(), np.full(b_pad, int(_FAILURE), np.int32))
+
+
+def lane_outputs(carry_np, lane: int):
+    """Extract one done lane's ``(p1, s1, st1, used, p2, s2, st2)`` —
+    the sweep-result convention ``finish_pair`` consumes — from a
+    host-materialized carry."""
+    p1, s1, st1, used, p2, s2, st2 = (carry_np[j][lane]
+                                      for j in range(_OUT0, CARRY_LEN))
+    return p1, s1, st1, int(used), p2, s2, int(st2)
+
+
+# -- slice-size policy ----------------------------------------------------
+
+# Per-dispatch overhead vs per-superstep compute, by backend: the slice
+# size S trades them. Too small and the fixed dispatch cost (kernel
+# launch + carry round-trip; ~65 ms/call measured on TPU, PERF.md
+# "Primitive rates"; sub-ms on CPU) dominates each slice; too large and
+# a finished lane idles up to S supersteps before the host can recycle
+# it (recycling latency ≈ S·superstep_s). The policy sizes S so dispatch
+# overhead stays ≤ ``overhead_frac`` of slice compute, clamped to
+# [lo, hi] — the pricing argument is written out in PERF.md
+# "Continuous batching".
+_DISPATCH_OVERHEAD_S = {"tpu": 65e-3, "gpu": 10e-3, "cpu": 0.6e-3}
+_ENTRIES_PER_S = {"tpu": 1.0e10, "gpu": 5e9, "cpu": 1.5e8}
+
+
+def auto_slice_steps(entries: int, b_pad: int, platform: str | None = None,
+                     *, overhead_frac: float = 0.125, lo: int = 4,
+                     hi: int = 64) -> int:
+    """Priced slice size for a pool of ``b_pad`` lanes of a class with
+    ``entries`` gathered table entries per lane-superstep
+    (``ShapeClass.entries()``)."""
+    plat = platform or jax.default_backend()
+    overhead = _DISPATCH_OVERHEAD_S.get(plat, 1e-3)
+    rate = _ENTRIES_PER_S.get(plat, 5e8)
+    superstep_s = max(b_pad * entries / rate, 1e-9)
+    s = math.ceil(overhead / (overhead_frac * superstep_s))
+    return int(min(hi, max(lo, s)))
 
 
 def finish_pair(member, p1, s1, st1, used, p2, s2, st2, attempt_fallback):

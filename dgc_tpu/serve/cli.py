@@ -11,7 +11,12 @@ line per request. Request lines are either
 
 The CLI exists for offline replay (load tests, the bench harness, the
 1k-request soak) — a network listener is a thin shim over the same
-``ServeFrontEnd`` API. Observability mirrors the main driver:
+``ServeFrontEnd`` API. Dispatch defaults to continuous batching (lane
+recycling; ``--serve-mode sync`` keeps the batch-complete baseline),
+``--slice-steps`` sizes the recycling slice (default: priced against
+dispatch overhead), and ``--warm-classes`` pre-compiles the named shape
+classes' pad ladders before the replay clock starts (warmup reported
+separately in ``serve_summary``). Observability mirrors the main driver:
 ``--log-json`` / ``--run-manifest`` / ``--metrics-prom`` land the
 ``serve_*`` events in the same stream/manifest/metrics the sweep CLI
 uses (``tools/report_run.py`` renders the serve section; ``tools/
@@ -46,7 +51,26 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="also save each ok request's coloring as "
                         "DIR/<id>.json (reference coloring schema)")
     p.add_argument("--batch-max", type=int, default=8,
-                   help="max graphs per batched dispatch (default 8)")
+                   help="max graphs per batched dispatch / lane pool "
+                        "(default 8)")
+    p.add_argument("--serve-mode", choices=["continuous", "sync"],
+                   default="continuous",
+                   help="continuous (default): lane recycling — finished "
+                        "lanes swap in queued requests at every slice "
+                        "boundary; sync: PR 5 batch-complete dispatch "
+                        "(the A/B baseline)")
+    p.add_argument("--slice-steps", type=str, default="auto",
+                   help="supersteps per continuous-mode slice, or 'auto' "
+                        "to price the slice against dispatch overhead "
+                        "per (class, pool width) (default auto)")
+    p.add_argument("--no-affinity", action="store_true",
+                   help="disable predicted-depth affinity batching "
+                        "(co-scheduling similar-depth requests)")
+    p.add_argument("--warm-classes", type=str, default=None,
+                   metavar="CLS1,CLS2,...",
+                   help="pre-compile these shape classes' kernel pad "
+                        "ladders at startup (e.g. v32768w64); warmup "
+                        "time is reported separately in serve_summary")
     p.add_argument("--window-ms", type=float, default=2.0,
                    help="micro-batching window in milliseconds: how long "
                         "a pending sweep waits for same-class company "
@@ -132,14 +156,38 @@ def serve_main(argv: list[str] | None = None) -> int:
         out_dir.mkdir(parents=True, exist_ok=True)
     results_fh = open(args.results, "w") if args.results else sys.stdout
 
+    if args.slice_steps != "auto":
+        try:
+            args.slice_steps = int(args.slice_steps)
+        except ValueError:
+            print(f"--slice-steps must be an integer or 'auto', got "
+                  f"{args.slice_steps!r}", file=sys.stderr)
+            return 2
     front = ServeFrontEnd(
         batch_max=args.batch_max, window_s=args.window_ms / 1e3,
         queue_depth=args.queue_depth, workers=args.workers,
+        mode=args.serve_mode,
+        slice_steps=(None if args.slice_steps == "auto"
+                     else args.slice_steps),
+        affinity=not args.no_affinity,
         validate=not args.no_validate,
         post_reduce=not args.no_reduce_colors,
         auto_tune=args.auto_tune, tuned_cache=tuned_cache,
         logger=logger, registry=registry,
     ).start()
+
+    # compile warmup runs (and is reported) OUTSIDE the serve clock: the
+    # one-off wide-batch XLA compile must not masquerade as first-batch
+    # service latency (PERF.md "Continuous batching")
+    warmup = None
+    if args.warm_classes:
+        try:
+            warmup = front.warm(
+                [c for c in args.warm_classes.split(",") if c.strip()])
+        except ValueError as e:
+            print(f"--warm-classes: {e}", file=sys.stderr)
+            front.shutdown(drain=False)
+            return 2
 
     t0 = time.perf_counter()
     bad = 0
@@ -189,6 +237,11 @@ def serve_main(argv: list[str] | None = None) -> int:
                  wall_s=round(wall, 4),
                  graphs_per_s=round(done / wall, 3) if wall > 0 else None,
                  batches=front.scheduler.stats["batches"],
+                 slices=front.scheduler.stats["slices"],
+                 recycles=front.scheduler.stats["recycles"],
+                 mode=front.scheduler.mode,
+                 warmup_s=warmup["seconds"] if warmup else None,
+                 warmed_kernels=warmup["kernels"] if warmup else None,
                  compile_misses=front.scheduler.stats["compile_misses"],
                  compile_hits=front.scheduler.stats["compile_hits"])
     if args.run_manifest:

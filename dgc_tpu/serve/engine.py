@@ -1,4 +1,4 @@
-"""Batch scheduler: shape-class batching, compile cache, fallback path.
+"""Batch scheduler: lane recycling, affinity batching, compile cache.
 
 The front-end (``serve.queue``) runs one ``find_minimal_coloring`` per
 request on a worker thread — the exact jump-mode driver the CLI uses, so
@@ -6,16 +6,44 @@ attempt sequences, validation, and the recolor post-pass are the
 single-graph semantics by construction. Each worker's engine is a
 :class:`BatchMemberEngine` proxy whose ``sweep(k)`` does not dispatch:
 it enqueues the (member, k) call with the :class:`BatchScheduler` and
-blocks. The scheduler's dispatcher thread collects concurrent sweep
-calls for the *same shape class* inside the batching window, pads the
-batch to a power-of-two ``b_pad``, and runs them all in ONE
-``batched_sweep_kernel`` dispatch.
+blocks.
+
+Two dispatch modes:
+
+- ``mode="continuous"`` (default) — **lane recycling**: each shape class
+  owns a :class:`_LanePool` of at most ``batch_max`` lanes. The
+  dispatcher runs the sliced kernel (``serve.batched
+  .batched_slice_kernel``) for at most ``slice_steps`` supersteps,
+  reads the per-lane carry back, swaps every ``done`` lane's result out
+  and a queued request in (``reset`` flag; the kernel re-inits the lane
+  from its inputs), and re-enters — lanes stay hot the way LLM servers
+  keep sequence slots hot, so a finished graph never waits out a
+  straggler's supersteps. The pool's width adapts to demand
+  (power-of-two pads up to ``batch_max``), so a draining tail doesn't
+  burn idle-lane compute either. ``slice_steps=None`` prices the slice
+  size per (class, pool width) against dispatch overhead
+  (``serve.batched.auto_slice_steps``).
+- ``mode="sync"`` — the PR 5 batch-synchronous dispatch (one whole
+  jump-mode pair per batch, the dispatch returns when the LAST member
+  finishes), kept as the A/B baseline for ``bench.py
+  --serve-throughput`` and the queued TPU evidence.
+
+**Affinity batching** rides both modes: pending calls carry a predicted
+sweep-depth bucket (the budget ``k``'s bit length — deeper budgets sweep
+more supersteps and more colors), and the scheduler co-schedules calls
+of the same bucket so lanes finish near-simultaneously (sync mode: the
+largest same-bucket group forms the batch; continuous mode: free lanes
+prefer the bucket closest to the pool's live median). A starvation guard
+falls back to FIFO for any call older than ``50 × window_s``.
 
 Caches (the per-request costs this path amortizes):
 
-- **compile cache** — one executable per ``(class, b_pad)``; recurring
-  shapes skip XLA entirely (hit/miss lands in the ``serve_batch``
-  event);
+- **compile cache** — one executable per ``(class, b_pad[, slice])``;
+  recurring shapes skip XLA entirely (hit/miss lands in the
+  ``serve_batch``/``serve_slice`` events). :meth:`BatchScheduler
+  .warm_class` pre-compiles a class's whole power-of-two pad ladder at
+  startup (the ``--warm-classes`` flag), so the one-off wide-batch
+  compile penalty lands in reported warmup, not first-batch latency;
 - **tuned-config cache** (``dgc_tpu.tune.cache``) — the single-graph
   fallback path (graphs beyond the shape ladder) keys tuned schedules by
   graph-shape hash, so recurring shapes skip the tuner replay too (the
@@ -35,11 +63,21 @@ import numpy as np
 
 from dgc_tpu.engine.base import AttemptResult, empty_budget_failure
 from dgc_tpu.serve.batched import (
+    CARRY_LEN,
     DEFAULT_STALL_WINDOW,
+    auto_slice_steps,
+    batched_slice_kernel,
     batched_sweep_kernel,
     finish_pair,
+    idle_carry,
+    lane_outputs,
 )
-from dgc_tpu.serve.shape_classes import dummy_member, padding_waste
+from dgc_tpu.serve.shape_classes import (dummy_member, pad_ladder,
+                                         padding_waste)
+
+# FIFO takes over affinity ordering for calls older than this many
+# batching windows — affinity may reorder, never starve
+_STARVE_WINDOWS = 50.0
 
 
 class ServeError(RuntimeError):
@@ -51,49 +89,202 @@ def _pow2_ceil(n: int) -> int:
     return 1 << max(0, (int(n) - 1).bit_length())
 
 
+def depth_bucket(k: int) -> int:
+    """Predicted-sweep-depth affinity key for a budget-``k`` sweep call:
+    the bit length of ``k``. Supersteps scale with the color count the
+    sweep must serialize, and ``k0 = Δ+1`` (then the confirm's ``u−1``)
+    tracks it — so co-scheduling equal-bit-length budgets makes lanes
+    finish near-simultaneously without pre-running anything."""
+    return max(1, int(k)).bit_length()
+
+
 class _SweepCall:
-    __slots__ = ("member", "k", "done", "result", "error", "t_enqueue")
+    __slots__ = ("member", "k", "depth", "done", "result", "error",
+                 "t_enqueue")
 
     def __init__(self, member, k):
         self.member = member
         self.k = int(k)
+        self.depth = depth_bucket(k)
         self.done = threading.Event()
         self.result = None
         self.error = None
         self.t_enqueue = time.perf_counter()
 
 
-class BatchScheduler:
-    """Groups concurrent sweep calls by shape class into one dispatch.
+class _LanePool:
+    """One shape class's host-side lane state (continuous mode): the
+    kernel's inputs (mutated only when a lane is swapped), the device
+    carry (round-tripped every slice), and the per-lane call bookkeeping.
+    Owned by the dispatcher thread — no locking."""
 
-    ``window_s`` is the micro-batching window: once a class has a
-    pending call, the dispatcher waits up to the window for more of the
-    same class (or ``batch_max``) before dispatching — the classic
-    latency-for-throughput knob. ``on_batch(record)`` observes every
-    dispatch (the front-end forwards it into the obs event stream)."""
+    __slots__ = ("cls", "b_pad", "comb", "degrees", "k0", "max_steps",
+                 "reset", "carry", "calls", "t_fill", "slices_in",
+                 "_dev_inputs", "_dirty", "_dummy")
+
+    def __init__(self, cls, b_pad: int, dummy):
+        self.cls = cls
+        self._dummy = dummy
+        self.b_pad = 0
+        self.calls = []
+        self.t_fill = []
+        self.slices_in = []
+        self._resize(b_pad)
+
+    def _resize(self, b_pad: int) -> None:
+        """(Re)allocate at ``b_pad`` lanes, compacting live lanes into
+        the low indices (lane identity is per-slice, not per-request —
+        the call list follows the carry rows)."""
+        keep = [i for i, c in enumerate(self.calls) if c is not None]
+        assert len(keep) <= b_pad, "resize would drop live lanes"
+        cls, dummy = self.cls, self._dummy
+        comb = np.repeat(dummy.comb[None], b_pad, axis=0)
+        degrees = np.zeros((b_pad, cls.v_pad), np.int32)
+        k0 = np.ones(b_pad, np.int32)
+        max_steps = np.full(b_pad, dummy.max_steps, np.int32)
+        reset = np.zeros(b_pad, np.int32)
+        carry = idle_carry(b_pad, cls.v_pad)
+        old_carry = None
+        if keep:
+            old_carry = tuple(np.asarray(a) for a in self.carry)
+        calls = [None] * b_pad
+        t_fill = [0.0] * b_pad
+        slices_in = [0] * b_pad
+        for new_i, old_i in enumerate(keep):
+            comb[new_i] = self.comb[old_i]
+            degrees[new_i] = self.degrees[old_i]
+            k0[new_i] = self.k0[old_i]
+            max_steps[new_i] = self.max_steps[old_i]
+            reset[new_i] = self.reset[old_i]
+            for j in range(CARRY_LEN):
+                carry[j][new_i] = old_carry[j][old_i]
+            calls[new_i] = self.calls[old_i]
+            t_fill[new_i] = self.t_fill[old_i]
+            slices_in[new_i] = self.slices_in[old_i]
+        self.b_pad = b_pad
+        self.comb, self.degrees = comb, degrees
+        self.k0, self.max_steps, self.reset = k0, max_steps, reset
+        self.carry = carry
+        self.calls, self.t_fill, self.slices_in = calls, t_fill, slices_in
+        self._dev_inputs = None
+        self._dirty = []
+
+    @property
+    def live(self) -> int:
+        return sum(1 for c in self.calls if c is not None)
+
+    def live_depths(self) -> list:
+        return [c.depth for c in self.calls if c is not None]
+
+    def reserve(self, n: int) -> None:
+        """Grow ONCE to fit ``n`` more seats (a resize reallocates the
+        host arrays and forces a full device re-upload — growing by
+        doubling per seat would pay that per pad during a ramp)."""
+        need = self.live + n
+        if need > self.b_pad:
+            self._resize(_pow2_ceil(need))
+
+    def fill(self, call: _SweepCall) -> int:
+        """Seat ``call`` in a free lane (growing the pool if every lane
+        is taken); the kernel re-inits the lane from these inputs on the
+        next slice (``reset``)."""
+        try:
+            lane = self.calls.index(None)
+        except ValueError:
+            self._resize(self.b_pad * 2)
+            lane = self.calls.index(None)
+        m = call.member
+        self.comb[lane] = m.comb
+        self.degrees[lane] = m.degrees
+        self.k0[lane] = call.k
+        self.max_steps[lane] = m.max_steps
+        self.reset[lane] = 1
+        self.calls[lane] = call
+        self.t_fill[lane] = time.perf_counter()
+        self.slices_in[lane] = 0
+        self._dirty.append(lane)
+        return lane
+
+    def dev_inputs(self):
+        """The (comb, degrees) device mirror, re-uploaded only on slices
+        where a swap (or resize) actually mutated the host copy — the
+        steady state between recycles re-uses the same device buffers
+        (no per-slice upload of the big table stack)."""
+        import jax
+
+        if self._dev_inputs is None or self._dirty:
+            self._dev_inputs = (jax.device_put(self.comb),
+                                jax.device_put(self.degrees))
+            self._dirty = []
+        return self._dev_inputs
+
+    def maybe_shrink(self) -> None:
+        """Shrink to the live set's power-of-two pad as soon as a pad
+        boundary is crossed — every slice of a draining tail otherwise
+        pays idle-lane compute for the whole dead width (the compute is
+        per-lane whether or not the lane holds work; the CPU batch-width
+        curve is bandwidth-bound on exactly this). The caller skips this
+        while the class still has queued work (the freed lanes are about
+        to refill — shrinking would just re-grow and re-upload). Growth
+        re-doubles on demand (``fill``/``reserve``), and every pow2
+        pad's kernel is pre-warmed by ``warm_class``, so the resize
+        itself is host-array bookkeeping plus one device re-upload."""
+        target = _pow2_ceil(max(self.live, 1))
+        if target < self.b_pad:
+            self._resize(target)
+
+
+class BatchScheduler:
+    """Groups concurrent sweep calls by shape class; dispatches them as
+    recycled lane slices (continuous mode) or whole-pair batches (sync
+    mode) — see the module docstring for the two modes.
+
+    ``window_s`` is the micro-batching window: a class with pending
+    calls but no live lanes waits up to the window for more of the same
+    class (or ``batch_max``) before first dispatch — the classic
+    latency-for-throughput knob; once lanes are live, recycling picks
+    new calls up at every slice boundary with no extra wait.
+    ``on_batch(record)`` observes every sync dispatch and
+    ``on_event(kind, record)`` every continuous slice / lane swap (the
+    front-end forwards both into the obs event stream)."""
 
     def __init__(self, *, batch_max: int = 8, window_s: float = 0.002,
                  stall_window: int = DEFAULT_STALL_WINDOW,
-                 on_batch=None):
+                 mode: str = "continuous", slice_steps: int | None = None,
+                 affinity: bool = True,
+                 on_batch=None, on_event=None):
         if batch_max < 1:
             raise ValueError(f"batch_max must be >= 1, got {batch_max}")
+        if mode not in ("continuous", "sync"):
+            raise ValueError(f"mode must be continuous|sync, got {mode!r}")
+        if slice_steps is not None and int(slice_steps) < 1:
+            raise ValueError(
+                f"slice_steps must be >= 1 or None (auto), got {slice_steps}")
         self.batch_max = int(batch_max)
         self.window_s = float(window_s)
         self.stall_window = int(stall_window)
+        self.mode = mode
+        self.slice_steps = None if slice_steps is None else int(slice_steps)
+        self.affinity = bool(affinity)
         self.on_batch = on_batch
+        self.on_event = on_event
         self._lock = threading.Condition()
         self._pending: dict = {}   # class -> [_SweepCall]
-        self._kernels: dict = {}   # (v_pad, w_pad, planes, b_pad) -> fn
+        self._kernels: dict = {}   # compile-cache key -> fn
         self._dummies: dict = {}   # class -> ServeMember
+        self._pools: dict = {}     # class -> _LanePool (dispatcher-owned)
         self._stop = False
         self._thread = None
         self.stats = {"batches": 0, "sweeps": 0, "compile_hits": 0,
-                      "compile_misses": 0}
+                      "compile_misses": 0, "slices": 0, "recycles": 0,
+                      "max_live": 0}
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "BatchScheduler":
         if self._thread is None:
-            self._thread = threading.Thread(target=self._loop, daemon=True,
+            target = (self._loop_continuous if self.mode == "continuous"
+                      else self._loop_sync)
+            self._thread = threading.Thread(target=target, daemon=True,
                                             name="dgc-serve-batcher")
             self._thread.start()
         return self
@@ -105,13 +296,18 @@ class BatchScheduler:
         if self._thread is not None:
             self._thread.join(timeout=30)
             self._thread = None
-        # calls stranded by shutdown fail loudly, not silently
+        # calls stranded by shutdown fail loudly, not silently —
+        # pending AND in-lane (the dispatcher has exited; pools are safe
+        # to touch)
         with self._lock:
-            for calls in self._pending.values():
-                for call in calls:
-                    call.error = ServeError("batch scheduler stopped")
-                    call.done.set()
+            stranded = [c for calls in self._pending.values() for c in calls]
             self._pending.clear()
+        for pool in self._pools.values():
+            stranded.extend(c for c in pool.calls if c is not None)
+        self._pools.clear()
+        for call in stranded:
+            call.error = ServeError("batch scheduler stopped")
+            call.done.set()
 
     # -- submission (worker threads) ------------------------------------
     def sweep(self, member, k: int):
@@ -128,10 +324,245 @@ class BatchScheduler:
             raise call.error
         return call.result
 
-    # -- dispatcher -----------------------------------------------------
+    # -- warmup ---------------------------------------------------------
+    def warm_class(self, cls) -> int:
+        """Pre-compile a class's whole power-of-two pad ladder (every
+        ``b_pad`` the adaptive pool can visit, up to ``batch_max``) by
+        running each kernel once on all-dummy lanes — the one-off
+        wide-batch XLA compile lands here instead of in first-batch
+        latency. Returns the number of kernels warmed. Call before
+        ``start()`` or from the dispatching thread's quiet periods; the
+        jit cache is process-global so warming races nothing."""
+        dummy = self._dummies.get(cls)
+        if dummy is None:
+            dummy = self._dummies[cls] = dummy_member(cls)
+        warmed = 0
+        for b in pad_ladder(self.batch_max):
+            comb = np.repeat(dummy.comb[None], b, axis=0)
+            degrees = np.zeros((b, cls.v_pad), np.int32)
+            k0 = np.ones(b, np.int32)
+            max_steps = np.full(b, dummy.max_steps, np.int32)
+            if self.mode == "continuous":
+                kernel, _ = self._slice_kernel_for(cls, b)
+                reset = np.ones(b, np.int32)
+                kernel(comb, degrees, k0, max_steps, reset,
+                       idle_carry(b, cls.v_pad))
+            else:
+                kernel, _ = self._kernel_for(cls, b)
+                kernel(comb, degrees, k0, max_steps)
+            warmed += 1
+        return warmed
+
+    # -- affinity -------------------------------------------------------
+    def _affinity_order(self, calls: list, live_depths: list) -> list:
+        """Order a class's pending calls for seating: same-depth-bucket
+        calls together (nearest the live lanes' median bucket first in
+        continuous mode; largest group first when the pool is empty),
+        FIFO within a bucket, and strict FIFO for anything waiting past
+        the starvation guard."""
+        if not self.affinity or len(calls) <= 1:
+            return list(calls)
+        now = time.perf_counter()
+        guard = _STARVE_WINDOWS * max(self.window_s, 1e-3)
+        starving = [c for c in calls if now - c.t_enqueue > guard]
+        if starving:
+            return sorted(calls, key=lambda c: c.t_enqueue)
+        if live_depths:
+            target = sorted(live_depths)[len(live_depths) // 2]
+            key = lambda c: (abs(c.depth - target), c.depth, c.t_enqueue)
+        else:
+            groups: dict = {}
+            for c in calls:
+                groups[c.depth] = groups.get(c.depth, 0) + 1
+            key = lambda c: (-groups[c.depth], c.depth, c.t_enqueue)
+        return sorted(calls, key=key)
+
+    # -- compile caches -------------------------------------------------
+    def _kernel_for(self, cls, b_pad: int):
+        key = ("sync", cls.v_pad, cls.w_pad, cls.planes, b_pad)
+        hit = key in self._kernels
+        if not hit:
+            self._kernels[key] = lambda *a: batched_sweep_kernel(
+                *a, planes=cls.planes, stall_window=self.stall_window)
+            self.stats["compile_misses"] += 1
+        else:
+            self.stats["compile_hits"] += 1
+        return self._kernels[key], hit
+
+    def _slice_kernel_for(self, cls, b_pad: int):
+        s = (self.slice_steps if self.slice_steps is not None
+             else auto_slice_steps(cls.entries(), b_pad))
+        key = ("slice", cls.v_pad, cls.w_pad, cls.planes, b_pad, s)
+        hit = key in self._kernels
+        if not hit:
+            self._kernels[key] = lambda *a: batched_slice_kernel(
+                *a, planes=cls.planes, slice_steps=s,
+                stall_window=self.stall_window)
+            self.stats["compile_misses"] += 1
+        else:
+            self.stats["compile_hits"] += 1
+        return self._kernels[key], hit
+
+    def resolved_slice_steps(self, cls, b_pad: int) -> int:
+        return (self.slice_steps if self.slice_steps is not None
+                else auto_slice_steps(cls.entries(), b_pad))
+
+    # =====================================================================
+    # continuous mode: lane recycling
+    # =====================================================================
+    def _wait_for_work(self):
+        """Block until there is something to do. Returns False on stop.
+        When a class has pending calls but no live lanes yet, honor the
+        batching window (coalesce the first fill) — unless another class
+        already has live lanes to keep slicing."""
+        with self._lock:
+            while (not self._stop and not self._pending
+                   and not any(p.live for p in self._pools.values())):
+                self._lock.wait()
+            if self._stop:
+                return False
+            if (self.window_s > 0 and self._pending
+                    and not any(p.live for p in self._pools.values())):
+                cls = next(iter(self._pending))
+                if len(self._pending[cls]) < self.batch_max:
+                    deadline = time.perf_counter() + self.window_s
+                    while (not self._stop
+                           and len(self._pending.get(cls) or [])
+                           < self.batch_max):
+                        left = deadline - time.perf_counter()
+                        if left <= 0:
+                            break
+                        self._lock.wait(timeout=left)
+            return not self._stop
+
+    def _pop_pending(self, cls, free: int, live_depths: list) -> list:
+        with self._lock:
+            calls = self._pending.get(cls)
+            if not calls:
+                return []
+            ordered = self._affinity_order(calls, live_depths)
+            take = ordered[:free]
+            rest = [c for c in calls if c not in take]
+            if rest:
+                self._pending[cls] = rest
+            else:
+                self._pending.pop(cls, None)
+            return take
+
+    def _loop_continuous(self) -> None:
+        while True:
+            if not self._wait_for_work():
+                return
+            with self._lock:
+                classes = set(self._pending)
+            classes.update(c for c, p in self._pools.items() if p.live)
+            # deterministic service order (sets hash-order otherwise)
+            for cls in sorted(classes, key=lambda c: c.name):
+                if self._stop:
+                    return
+                try:
+                    self._service_class(cls)
+                except Exception as e:  # pragma: no cover - defensive
+                    pool = self._pools.pop(cls, None)
+                    failed = [c for c in (pool.calls if pool else [])
+                              if c is not None]
+                    with self._lock:
+                        failed.extend(self._pending.pop(cls, []))
+                    for call in failed:
+                        call.error = ServeError(
+                            f"batched dispatch failed: {e}")
+                        call.done.set()
+
+    def _service_class(self, cls) -> None:
+        """One slice of one class's pool: seat queued calls in free
+        lanes, run the sliced kernel, deliver every done lane, shrink a
+        draining pool."""
+        pool = self._pools.get(cls)
+        if pool is None:
+            dummy = self._dummies.get(cls)
+            if dummy is None:
+                dummy = self._dummies[cls] = dummy_member(cls)
+            pool = self._pools[cls] = _LanePool(cls, 1, dummy)
+
+        free = self.batch_max - pool.live
+        admitted = 0
+        if free > 0:
+            take = self._pop_pending(cls, free, pool.live_depths())
+            if take:
+                pool.reserve(len(take))   # ONE resize for the whole wave
+            for call in take:
+                pool.fill(call)
+                admitted += 1
+        live = pool.live
+        if live == 0:
+            self._pools.pop(cls, None)
+            return
+        # shrink a draining tail — but not while queued work is about to
+        # refill the freed lanes (shrink→grow thrash re-uploads tables)
+        with self._lock:
+            has_pending = bool(self._pending.get(cls))
+        if not has_pending:
+            pool.maybe_shrink()
+
+        kernel, cache_hit = self._slice_kernel_for(cls, pool.b_pad)
+        slice_steps = self.resolved_slice_steps(cls, pool.b_pad)
+        comb_dev, degrees_dev = pool.dev_inputs()
+        t0 = time.perf_counter()
+        carry = kernel(comb_dev, degrees_dev, pool.k0, pool.max_steps,
+                       pool.reset, pool.carry)
+        phase = np.asarray(carry[0])   # forces the dispatch; tiny
+        device_s = time.perf_counter() - t0
+        pool.carry = carry
+        pool.reset[:] = 0
+        for i in range(pool.b_pad):
+            pool.slices_in[i] += 1
+
+        done_lanes = [i for i in range(pool.b_pad)
+                      if pool.calls[i] is not None and phase[i] >= 2]
+        if done_lanes:
+            carry_np = tuple(np.asarray(a) for a in carry)
+            now = time.perf_counter()
+            for lane in done_lanes:
+                call = pool.calls[lane]
+                call.result = lane_outputs(carry_np, lane)
+                call.done.set()
+                pool.calls[lane] = None
+                self.stats["sweeps"] += 1
+                self.stats["recycles"] += 1
+                if self.on_event is not None:
+                    self.on_event("lane_recycled", {
+                        "shape_class": cls.name, "lane": int(lane),
+                        "k": call.k, "depth_bucket": call.depth,
+                        "slices": int(pool.slices_in[lane]),
+                        "queue_ms": round(
+                            (pool.t_fill[lane] - call.t_enqueue) * 1e3, 3),
+                        "service_ms": round(
+                            (now - pool.t_fill[lane]) * 1e3, 3),
+                    })
+
+        self.stats["batches"] += 1
+        self.stats["slices"] += 1
+        self.stats["max_live"] = max(self.stats["max_live"], live)
+        if self.on_event is not None:
+            self.on_event("serve_slice", {
+                "shape_class": cls.name, "live": int(live),
+                "b_pad": int(pool.b_pad),
+                "occupancy": round(live / pool.b_pad, 4),
+                "done": len(done_lanes), "admitted": int(admitted),
+                "slice_steps": int(slice_steps),
+                "compile_cache": "hit" if cache_hit else "miss",
+                "device_ms": round(device_s * 1e3, 3),
+            })
+        if pool.live == 0:
+            self._pools.pop(cls, None)
+
+    # =====================================================================
+    # sync mode: the PR 5 batch-complete dispatch (the A/B baseline)
+    # =====================================================================
     def _take_batch(self):
         """Wait for work, honor the batching window, pop one class's
-        batch. Returns (cls, calls) or None on stop."""
+        batch (the largest same-depth affinity group when enabled).
+        Returns (cls, calls) or None on stop."""
         with self._lock:
             while not self._stop and not self._pending:
                 self._lock.wait()
@@ -151,15 +582,16 @@ class BatchScheduler:
                     return None
                 if cls not in self._pending:   # drained by a concurrent pop
                     return self._take_batch()
-            calls = self._pending[cls][: self.batch_max]
-            rest = self._pending[cls][self.batch_max:]
+            ordered = self._affinity_order(self._pending[cls], [])
+            calls = ordered[: self.batch_max]
+            rest = [c for c in self._pending[cls] if c not in calls]
             if rest:
                 self._pending[cls] = rest
             else:
                 del self._pending[cls]
             return cls, calls
 
-    def _loop(self) -> None:
+    def _loop_sync(self) -> None:
         while True:
             got = self._take_batch()
             if got is None:
@@ -171,17 +603,6 @@ class BatchScheduler:
                 for call in calls:
                     call.error = ServeError(f"batched dispatch failed: {e}")
                     call.done.set()
-
-    def _kernel_for(self, cls, b_pad: int):
-        key = (cls.v_pad, cls.w_pad, cls.planes, b_pad)
-        hit = key in self._kernels
-        if not hit:
-            self._kernels[key] = lambda *a: batched_sweep_kernel(
-                *a, planes=cls.planes, stall_window=self.stall_window)
-            self.stats["compile_misses"] += 1
-        else:
-            self.stats["compile_hits"] += 1
-        return self._kernels[key], hit
 
     def _dispatch(self, cls, calls) -> None:
         b = len(calls)
@@ -210,12 +631,25 @@ class BatchScheduler:
             (t0 - c.t_enqueue) * 1e3 for c in calls)
         self.stats["batches"] += 1
         self.stats["sweeps"] += b
+        self.stats["max_live"] = max(self.stats["max_live"], b)
         if self.on_batch is not None:
+            # straggler waste: the fraction of dispatched real-lane
+            # supersteps spent re-running already-finished lanes while
+            # the slowest member swept on (the cost lane recycling
+            # removes; 0.0 for b == 1)
+            steps = (np.asarray(s1)[:b].astype(np.int64)
+                     + np.asarray(s2)[:b].astype(np.int64))
+            smax = int(steps.max()) if b else 0
+            waste = (round(1.0 - float(steps.mean()) / smax, 4)
+                     if smax > 0 else 0.0)
+            depths = {c.depth for c in calls}
             self.on_batch({
                 "shape_class": cls.name, "batch": b, "b_pad": int(b_pad),
                 "occupancy": round(b / b_pad, 4),
                 "padding_waste": padding_waste([c.member for c in calls],
                                                cls, b_pad),
+                "straggler_waste": waste,
+                "depth_buckets": len(depths),
                 "compile_cache": "hit" if cache_hit else "miss",
                 "device_ms": round(device_s * 1e3, 3),
                 "queue_ms_max": round(queue_ms_max, 3),

@@ -1,17 +1,19 @@
 """Micro-batching serve front-end: queue, workers, latency, health.
 
-The request path (Orca-style continuous batching, scaled to this
-workload's grain): ``submit()`` enqueues a request into a bounded queue
-(**backpressure**: a full queue raises :class:`QueueFull` immediately or
-after the caller's timeout — load sheds at the edge instead of OOMing
-the process). Worker threads pop requests and each runs the exact
-single-graph minimal-k driver (``find_minimal_coloring``, jump mode,
-validation + recolor post-pass as the CLI defaults) over a
+The request path (Orca-style continuous batching): ``submit()``
+enqueues a request into a bounded queue (**backpressure**: a full queue
+raises :class:`QueueFull` immediately or after the caller's timeout —
+load sheds at the edge instead of OOMing the process). Worker threads
+pop requests and each runs the exact single-graph minimal-k driver
+(``find_minimal_coloring``, jump mode, validation + recolor post-pass as
+the CLI defaults) over a
 :class:`~dgc_tpu.serve.engine.BatchMemberEngine` proxy — so N concurrent
 requests' sweep dispatches coalesce in the
-:class:`~dgc_tpu.serve.engine.BatchScheduler`'s batching window and run
-as vmapped batches, while every per-request semantic stays the
-single-graph path's.
+:class:`~dgc_tpu.serve.engine.BatchScheduler` and run as vmapped lane
+slices (``mode="continuous"``, the default: finished lanes recycle into
+queued requests at every slice boundary) or whole-pair batches
+(``mode="sync"``, the batch-synchronous A/B baseline), while every
+per-request semantic stays the single-graph path's.
 
 Graphs beyond the shape ladder (or a batched dispatch that errors) take
 the **single-graph fallback**: a supervised sweep down an engine ladder
@@ -124,6 +126,8 @@ class ServeFrontEnd:
     def __init__(self, *, ladder: ShapeLadder = DEFAULT_LADDER,
                  batch_max: int = 8, window_s: float = 0.002,
                  queue_depth: int = 64, workers: int | None = None,
+                 mode: str = "continuous", slice_steps: int | None = None,
+                 affinity: bool = True,
                  validate: bool = True, post_reduce: bool = True,
                  auto_tune: bool = False, tuned_cache=None,
                  retries: int = 0,
@@ -152,7 +156,10 @@ class ServeFrontEnd:
         self.rung_state = rung_state if rung_state is not None else RungState()
         self.scheduler = BatchScheduler(batch_max=batch_max,
                                         window_s=window_s,
-                                        on_batch=self._on_batch)
+                                        mode=mode, slice_steps=slice_steps,
+                                        affinity=affinity,
+                                        on_batch=self._on_batch,
+                                        on_event=self._on_sched_event)
         self._lock = threading.Condition()
         self._queue: deque = deque()
         self._threads: list = []
@@ -175,6 +182,22 @@ class ServeFrontEnd:
                 "dgc_serve_batches_total", "batched sweep dispatches",
                 shape_class=record["shape_class"]).inc()
 
+    def _on_sched_event(self, kind: str, record: dict) -> None:
+        """Continuous-mode scheduler telemetry (``serve_slice`` per slice
+        dispatch, ``lane_recycled`` per lane swap) into the same event
+        stream / registry the batch records use."""
+        self._event(kind, **record)
+        if self.registry is None:
+            return
+        if kind == "serve_slice":
+            self.registry.counter(
+                "dgc_serve_slices_total", "sliced lane dispatches",
+                shape_class=record["shape_class"]).inc()
+        elif kind == "lane_recycled":
+            self.registry.counter(
+                "dgc_serve_recycles_total", "lane swaps (sweeps completed)",
+                shape_class=record["shape_class"]).inc()
+
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "ServeFrontEnd":
         if self._started:
@@ -188,8 +211,34 @@ class ServeFrontEnd:
             self._threads.append(t)
         self._event("serve_start", batch_max=self.batch_max,
                     window_ms=round(self.scheduler.window_s * 1e3, 3),
-                    queue_depth=self.queue_depth, workers=self.workers)
+                    queue_depth=self.queue_depth, workers=self.workers,
+                    mode=self.scheduler.mode,
+                    slice_steps=self.scheduler.slice_steps,
+                    affinity=self.scheduler.affinity)
         return self
+
+    def warm(self, class_names: list) -> dict:
+        """Pre-compile the named shape classes' kernel pad ladders
+        (``--warm-classes``): every power-of-two batch pad the scheduler
+        can dispatch at, so the one-off wide-batch XLA compile lands in
+        reported warmup instead of first-batch latency. Returns
+        ``{"classes": n, "kernels": m, "seconds": s}`` (also emitted as
+        the ``serve_summary`` event's ``warmup_s`` by callers)."""
+        by_name = {c.name: c for c in self.ladder.classes()}
+        unknown = [n for n in class_names if n not in by_name]
+        if unknown:
+            raise ValueError(
+                f"unknown shape class(es) {unknown}; ladder has "
+                f"{sorted(by_name)}")
+        t0 = time.perf_counter()
+        kernels = 0
+        for name in class_names:
+            kernels += self.scheduler.warm_class(by_name[name])
+        seconds = time.perf_counter() - t0
+        doc = {"classes": len(class_names), "kernels": kernels,
+               "seconds": round(seconds, 4)}
+        self._event("serve_warmup", **doc)
+        return doc
 
     def shutdown(self, drain: bool = True, timeout: float = 60.0) -> None:
         """Stop accepting; with ``drain`` finish everything admitted
@@ -243,7 +292,10 @@ class ServeFrontEnd:
                     f"queue at capacity ({self.queue_depth})")
             if request_id is None:
                 request_id = self._next_id
-            self._next_id = max(self._next_id, request_id) + 1
+            if isinstance(request_id, int):
+                # non-int ids (e.g. string ids from a JSONL replay) skip
+                # the auto-id bookkeeping; they are carried through as-is
+                self._next_id = max(self._next_id, request_id) + 1
             req = ServeRequest(request_id=request_id, arrays=arrays)
             ticket = ServeTicket(req)
             self._queue.append((req, ticket))

@@ -166,6 +166,25 @@ def dummy_member(cls: ShapeClass) -> ServeMember:
     )
 
 
+def pad_ladder(batch_max: int) -> tuple:
+    """Every batch pad a ``batch_max``-lane scheduler can dispatch at,
+    widest first: the power-of-two ladder (the adaptive lane pool grows
+    by doubling and shrinks to the live set's pad; sync mode pads
+    partial batches up to pow2), plus ``batch_max`` itself when it is
+    not a power of two (sync full batches dispatch unpadded at it).
+    This IS the compiled-kernel pad set per class — what
+    ``--warm-classes`` pre-compiles."""
+    b = 1 << max(0, (int(batch_max) - 1).bit_length())
+    pads = []
+    while b >= 1:
+        pads.append(b)
+        b //= 2
+    if batch_max not in pads:
+        pads.append(int(batch_max))
+        pads.sort(reverse=True)
+    return tuple(pads)
+
+
 def padding_waste(members: list, cls: ShapeClass, b_pad: int) -> float:
     """Fraction of the dispatched ``b_pad × V_pad × W_pad`` gather
     footprint that is padding (dummy members, dummy rows, ELL pad slots)

@@ -251,12 +251,17 @@ def trajectory_from_manifest(doc_or_path, arrays,
     trajectory (the analogue of the replay's default k = Δ+1).
     ``sum_deg_active`` is 0 (the floor is unavailable — objectives
     compare totals, which never read it). ``max_unconf_per_bucket``
-    comes from the in-kernel ``max_unconf`` column when the manifest
-    carries it (obs.kernel col 4 — a global per-superstep maximum, so
-    each bucket gets ``min(width, max_unconf)``: a conservative but
-    superstep-exact capture-validity bound); manifests recorded before
-    the column pessimistically price it at the bucket width, which
-    restricts that mode to ladder-family knobs."""
+    comes from the in-kernel ``max_unconf_bucket`` tail when the
+    manifest carries it (obs.kernel per-bucket columns, the compact
+    layout: one value per hub bucket, then the flat-region total shared
+    by every flat bucket — each hub bucket's capture validity is bounded
+    by ITS OWN superstep-exact maximum, not the global max); older
+    manifests with only the scalar ``max_unconf`` column (col 4, the
+    global per-superstep maximum) give each bucket
+    ``min(width, max_unconf)`` — conservative but still
+    superstep-exact; manifests recorded before either column
+    pessimistically price it at the bucket width, which restricts that
+    mode to ladder-family knobs."""
     if isinstance(doc_or_path, (str, bytes)):
         from dgc_tpu.obs.manifest import load_manifest
 
@@ -278,6 +283,7 @@ def trajectory_from_manifest(doc_or_path, arrays,
     active = t["active"]
     ba = t["bucket_active"]
     mu = t.get("max_unconf") or []
+    mub = t.get("max_unconf_bucket") or []
 
     sizes, widths = bucket_layout(arrays, min_width=min_width)
     nb = len(ba[0]) if ba else 0
@@ -304,13 +310,23 @@ def trajectory_from_manifest(doc_or_path, arrays,
                 f"per-bucket layout ({len(sizes)}) nor the compact hub "
                 f"layout ({expect_compact}) for this graph")
         mu_i = int(mu[i]) if i < len(mu) else -1
+        mub_i = mub[i] if i < len(mub) else None
+        if mub_i is not None and len(mub_i) == nb == expect_compact:
+            # per-bucket tail (compact layout): each hub bucket bounded
+            # by ITS OWN maximum; flat buckets share the flat-slot value
+            flat_u = int(mub_i[hub]) if hub < len(mub_i) else -1
+            unconf_pb = []
+            for bi, w in enumerate(widths):
+                u = int(mub_i[bi]) if bi < hub else flat_u
+                unconf_pb.append(min(int(w), u) if u >= 0 else int(w))
+        else:
+            unconf_pb = [min(int(w), mu_i) if mu_i >= 0 else int(w)
+                         for w in widths]
         traj.steps.append(TrajectoryStep(
             step=i + int(t.get("first_step", 1) or 1),
             active=int(a), sum_deg_active=0,
             active_per_bucket=per_bucket,
-            max_unconf_per_bucket=[
-                min(int(w), mu_i) if mu_i >= 0 else int(w)
-                for w in widths]))
+            max_unconf_per_bucket=unconf_pb))
     return traj
 
 

@@ -227,3 +227,35 @@ def test_compact_max_unconf_column_matches_replay():
     b.record_trajectory = True
     tb = b.attempt(g.max_degree + 1).trajectory
     assert (tb.max_unconf == -1).all()
+    assert tb.max_unconf_bucket is None
+
+
+def test_compact_max_unconf_bucket_tail_matches_replay():
+    # the per-bucket tail (compact ba layout: one column per hub bucket,
+    # then the flat-region total) must equal the exact-rule replay's
+    # per-bucket maxima EXACTLY — each hub bucket by ITS OWN maximum
+    # (what tune --from-manifest now bounds capture validity with,
+    # instead of the global col-4 max), the flat slot by the max over
+    # the flat buckets. Col 4 stays the tail's row-max.
+    from dgc_tpu.engine.compact import CompactFrontierEngine as Eng
+    from dgc_tpu.utils.trajectory import record_trajectory
+
+    g = generate_rmat_graph(20_000, avg_degree=16.0, seed=0)
+    eng = Eng(g)
+    eng.record_trajectory = True
+    t = eng.attempt(g.max_degree + 1).trajectory
+    replay = record_trajectory(g)
+    hub = eng.hub_buckets
+    mub = t.max_unconf_bucket
+    assert mub is not None
+    assert mub.shape[1] == hub + 1       # hub buckets + flat total
+    rows = min(len(mub), len(replay.steps))
+    assert rows > 0
+    for bi in range(hub):
+        want = [st.max_unconf_per_bucket[bi] for st in replay.steps]
+        assert mub[:rows, bi].tolist() == want[:rows], f"hub bucket {bi}"
+    want_flat = [max(st.max_unconf_per_bucket[hub:])
+                 for st in replay.steps]
+    assert mub[:rows, hub].tolist() == want_flat[:rows]
+    # col 4 is exactly the tail's per-row max (layout compatibility)
+    assert t.max_unconf[:rows].tolist() == mub[:rows].max(axis=1).tolist()

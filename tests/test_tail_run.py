@@ -56,6 +56,50 @@ def test_tail_once_renders_report(tmp_path):
     assert "ell-compact" in r.stdout
 
 
+def test_tail_renders_serve_slices_and_recycles(tmp_path):
+    """The live tail renders the lane-recycling telemetry: occupancy
+    over time from serve_slice events plus the lane_recycled count
+    (same render as report_run — the two can never disagree)."""
+    events = [
+        {"t": 0.1, "event": "serve_start", "batch_max": 4,
+         "window_ms": 2.0, "queue_depth": 16, "workers": 4,
+         "mode": "continuous", "slice_steps": None, "affinity": True},
+        {"t": 0.2, "event": "serve_slice", "shape_class": "v2048w32",
+         "live": 4, "b_pad": 4, "occupancy": 1.0, "done": 0,
+         "admitted": 4, "slice_steps": 4, "compile_cache": "miss",
+         "device_ms": 12.5},
+        {"t": 0.3, "event": "serve_slice", "shape_class": "v2048w32",
+         "live": 4, "b_pad": 4, "occupancy": 1.0, "done": 2,
+         "admitted": 0, "slice_steps": 4, "compile_cache": "hit",
+         "device_ms": 11.0},
+        {"t": 0.35, "event": "lane_recycled", "shape_class": "v2048w32",
+         "lane": 1, "k": 9, "depth_bucket": 4, "slices": 2,
+         "queue_ms": 1.0, "service_ms": 25.0},
+        {"t": 0.36, "event": "lane_recycled", "shape_class": "v2048w32",
+         "lane": 3, "k": 17, "depth_bucket": 5, "slices": 2,
+         "queue_ms": 0.5, "service_ms": 24.0},
+        {"t": 0.9, "event": "serve_summary", "requests": 2,
+         "completed": 2, "failed": 0, "wall_s": 0.8, "mode": "continuous",
+         "slices": 2, "recycles": 2, "graphs_per_s": 2.5},
+    ]
+    log = tmp_path / "serve.jsonl"
+    log.write_text("\n".join(json.dumps(e) for e in events) + "\n")
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "tail_run.py"),
+         str(log), "--once"],
+        capture_output=True, text=True, timeout=120)
+    assert r.returncode == 0, r.stderr
+    assert "mode=continuous" in r.stdout
+    assert "slices: 2" in r.stdout and "2 lane recycle(s)" in r.stdout
+    assert "occupancy/slice:" in r.stdout
+    # serve_summary stays a terminal event for --follow (unchanged), and
+    # the schema accepts every event above
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from validate_runlog import validate_file
+
+    assert validate_file(str(log)) == []
+
+
 def test_tail_follow_exits_on_terminal_event(tmp_path):
     log = tmp_path / "run.jsonl"
     log.write_text("\n".join(json.dumps(e) for e in _events()) + "\n")

@@ -416,6 +416,35 @@ def test_trajectory_from_manifest_uses_max_unconf(rmat20k, rmat20k_traj):
                for st in traj0.steps)
 
 
+def test_trajectory_from_manifest_prefers_per_bucket_unconf(
+        rmat20k, rmat20k_traj):
+    """The per-bucket ``max_unconf_bucket`` tail (compact ba layout)
+    bounds each hub bucket by ITS OWN recorded maximum — tighter than
+    the global-scalar fallback whenever hub maxima differ — and the
+    flat buckets share the flat-slot value."""
+    sizes, widths = bucket_layout(rmat20k)
+    sched = derive_schedule(sizes, widths, rmat20k.num_vertices,
+                            int(rmat20k.max_degree))
+    hub = sched["hub_buckets"]
+    nb = hub + (1 if hub < len(sizes) else 0)
+    doc = _manifest_doc_from_replay(rmat20k, rmat20k_traj, hub,
+                                    len(sizes) - hub)
+    # distinct per-hub values so the per-bucket path is distinguishable
+    # from any global max; scalar column present AND stale on purpose —
+    # the per-bucket tail must win
+    mub = [[7 + 5 * b + (i % 3) for b in range(nb)]
+           for i in range(rmat20k_traj.supersteps)]
+    doc["attempts"][0]["trajectory"]["max_unconf_bucket"] = mub
+    doc["attempts"][0]["trajectory"]["max_unconf"] = [
+        10**6] * rmat20k_traj.supersteps
+    traj = trajectory_from_manifest(doc, rmat20k)
+    for st, row in zip(traj.steps, mub):
+        flat_u = row[hub] if hub < len(row) else None
+        for bi, w in enumerate(widths):
+            want = row[bi] if bi < hub else flat_u
+            assert st.max_unconf_per_bucket[bi] == min(int(w), want)
+
+
 def test_trajectory_from_manifest_rejects_bad_layout(rmat20k):
     doc = {"manifest_version": 1, "attempts": [{
         "k": 10, "trajectory": {"active": [5], "bucket_active": [[1, 2]],

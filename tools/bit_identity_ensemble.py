@@ -34,6 +34,15 @@ sequence must be byte-identical to the single-graph fused jump-mode
 sweep (``CompactFrontierEngine`` + ``find_minimal_coloring``) — the
 batched-vs-single contract ``tools/serve_parity.jsonl`` commits.
 
+The serve ensemble runs the CONTINUOUS (lane recycling) dispatch mode
+with ``--serve-slice-steps`` forced small (default 2), so every draw's
+sweep crosses many slice re-entry boundaries and lanes recycle
+mid-batch — the bit-identity contract is proven ACROSS recycling
+boundaries, not just within one dispatch (the summary line records the
+lane-recycle count as evidence recycling actually exercised).
+``--serve-mode sync`` re-runs the same ensemble through the
+batch-complete dispatch (the PR 5 baseline).
+
 One JSON line per draw, nonzero exit on any mismatch.
 """
 
@@ -81,16 +90,21 @@ def serve_mode(args) -> int:
             registry = MetricsRegistry()
         fe = ServeFrontEnd(batch_max=4, window_s=0.05,
                            queue_depth=4 * args.draws,
+                           mode=args.serve_mode,
+                           slice_steps=(args.serve_slice_steps
+                                        if args.serve_mode == "continuous"
+                                        else None),
                            logger=logger, registry=registry).start()
         try:
             tickets = [fe.submit(g.arrays if hasattr(g, "arrays") else g,
                                  request_id=i) for i, _, _, g in draws]
-            return [t.result(timeout=600) for t in tickets]
+            return ([t.result(timeout=600) for t in tickets],
+                    dict(fe.scheduler.stats))
         finally:
             fe.shutdown()
 
-    with_obs = run_front_end(telemetry=True)
-    without_obs = run_front_end(telemetry=False)
+    with_obs, stats_obs = run_front_end(telemetry=True)
+    without_obs, _ = run_front_end(telemetry=False)
 
     out = open(args.out, "w") if args.out else None
     bad = 0
@@ -136,7 +150,13 @@ def serve_mode(args) -> int:
                                                   g.max_degree)
                          for _, _, _, g in draws)}
     summary = dict(draws=args.draws, mismatches=bad,
-                   shape_classes=sorted(classes))
+                   shape_classes=sorted(classes),
+                   mode=args.serve_mode,
+                   slice_steps=(args.serve_slice_steps
+                                if args.serve_mode == "continuous"
+                                else None),
+                   recycles=stats_obs.get("recycles", 0),
+                   slices=stats_obs.get("slices", 0))
     print(json.dumps(summary))
     if out:
         out.write(json.dumps(summary) + "\n")
@@ -157,6 +177,14 @@ def main() -> int:
     p.add_argument("--serve", action="store_true",
                    help="serving-path ensemble: batched front-end vs the "
                         "single-graph fused sweep (module docstring)")
+    p.add_argument("--serve-mode", choices=["continuous", "sync"],
+                   default="continuous",
+                   help="dispatch mode for --serve (default continuous — "
+                        "lane recycling exercised)")
+    p.add_argument("--serve-slice-steps", type=int, default=2,
+                   help="continuous-mode slice size for --serve; the "
+                        "small default forces many recycling boundaries "
+                        "per sweep (default 2)")
     args = p.parse_args()
     if args.serve:
         return serve_mode(args)

@@ -56,17 +56,29 @@ if [ "$battery_rc" -ne 2 ]; then
     --tuned-config tools/tuned_configs/rmat_200k.json 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
-  # serve-throughput A/B (PR 5, dgc_tpu.serve): graphs/s of the batched
+  # serve-throughput A/B (PR 5/6, dgc_tpu.serve): graphs/s of the batched
   # vmap'd front-end vs sequential single-graph sweeps of the same 20k
-  # graphs, batch 1/8/32. The CPU row (PERF.md "Batched throughput")
-  # measured 8.0x at batch-8 with batch-1 nearly equal (1-core host is
-  # compute-bound once compile is amortized) and batch-32 regressing on
-  # straggler sync; the TPU question is whether lane-parallel batching
-  # opens the batch-8/batch-1 ratio and rehabilitates batch-32. Results
-  # are color-parity-checked in-run (parity_ok in the JSON line).
-  echo "=== serve throughput A/B (20k class, batch 1/8/32) ===" | tee -a /dev/stderr >/dev/null
-  timeout 3600 python bench.py --serve-throughput \
-    --serve-graphs 8 --serve-batch-sizes 1,8,32 2>&1 \
+  # graphs, batch 1/8/32, CONTINUOUS (lane recycling, PR 6) vs SYNC
+  # (batch-complete, PR 5) measured over the same graphs — the
+  # continuous-vs-batch-synchronous A/B. The CPU rows (PERF.md
+  # "Continuous batching") are bandwidth-bound on one core; the TPU
+  # questions are (a) whether lane-parallel batching opens the
+  # batch-8/batch-1 ratio (the ~65 ms/dispatch amortization) and
+  # (b) how much lane recycling beats the straggler-synced batch-32 when
+  # lanes are PARALLEL hardware, not serial work — there every idle
+  # straggler lane is a wasted parallel lane, exactly what recycling
+  # reclaims. Slice size is the auto policy (serve.batched
+  # .auto_slice_steps prices ~65 ms dispatch overhead on-chip). Results
+  # are color-parity-checked in-run (parity_ok in the JSON line), and
+  # the monotone_curve flag records the no-cliff acceptance over
+  # multi-lane widths.
+  # 64-graph stream (2× the widest pool) so every width gets refill
+  # overlap — a burst equal to the pool width under-measures wide pools
+  # (the ramp has nothing to overlap into; PERF.md methodology note)
+  echo "=== serve throughput A/B (20k class, batch 1/8/32, continuous vs sync) ===" | tee -a /dev/stderr >/dev/null
+  timeout 5400 python bench.py --serve-throughput \
+    --serve-graphs 64 --serve-batch-sizes 1,8,32 \
+    --serve-modes continuous,sync 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
   echo "=== tuned-vs-static A/B (1M RMAT) ===" | tee -a /dev/stderr >/dev/null

@@ -117,17 +117,40 @@ def render(doc: dict, width: int = 48) -> str:
         cfg = sv.get("config") or {}
         add(f"serve:    batch_max={cfg.get('batch_max')} "
             f"window_ms={cfg.get('window_ms')} "
-            f"queue_depth={cfg.get('queue_depth')}")
+            f"queue_depth={cfg.get('queue_depth')}"
+            + (f" mode={cfg.get('mode')}" if cfg.get("mode") else ""))
+        warm = sv.get("warmup")
+        if warm:
+            add(f"  warmup: {warm.get('kernels')} kernel(s) over "
+                f"{warm.get('classes')} class(es) in "
+                f"{warm.get('seconds')}s (off the serve clock)")
+        slices = sv.get("slices") or []
+        if slices:
+            # lane recycling (continuous mode): pool occupancy over time
+            # — the live-lanes/pool-width ratio per sliced dispatch, plus
+            # how many sweeps recycled through the pool
+            occ = [s.get("occupancy", 0) for s in slices]
+            add(f"  slices: {len(slices)} "
+                f"(mean lane occupancy {sum(occ) / len(occ):.2f}, "
+                f"{sv.get('recycles', 0)} lane recycle(s), "
+                f"{sum(1 for s in slices if s.get('compile_cache') == 'miss')}"
+                f" compile miss(es))")
+            add(f"  occupancy/slice: {sparkline(occ, width)}")
         batches = sv.get("batches") or []
         if batches:
             occ = [b.get("occupancy", 0) for b in batches]
             waste = [b.get("padding_waste", 0) for b in batches]
             misses = sum(1 for b in batches
                          if b.get("compile_cache") == "miss")
+            straggle = [b["straggler_waste"] for b in batches
+                        if b.get("straggler_waste") is not None]
             add(f"  batches: {len(batches)} "
                 f"(mean occupancy {sum(occ) / len(occ):.2f}, mean padding "
                 f"waste {sum(waste) / len(waste):.2f}, "
-                f"{misses} compile miss(es))")
+                + (f"mean straggler waste "
+                   f"{sum(straggle) / len(straggle):.2f}, " if straggle
+                   else "")
+                + f"{misses} compile miss(es))")
             add(f"  occupancy/batch: {sparkline(occ, width)}")
         reqs = sv.get("requests") or []
         if reqs:
