@@ -189,6 +189,25 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
                    for i in range(len(curve) - 1))
     b_head = max(batches, key=lambda b: batches[b])
     speedup = batches[b_head] / seq_gps if seq_gps else 0.0
+
+    # SLO tripwire (--slo-thresholds): gate the measured headline
+    # against the committed trajectory's thresholds (tools/slo_check
+    # shares the rule with the manifest-based gate); violations flip the
+    # exit code exactly like a parity failure — a perf regression fails
+    # the bench run, it does not just lower a number in a JSON line
+    slo = None
+    if args.slo_thresholds:
+        from tools.slo_check import check_bench_record
+
+        thresholds = json.loads(open(args.slo_thresholds).read())
+        record_head = {"value": batches[b_head],
+                       "speedup_vs_sequential": round(speedup, 2)}
+        violations = check_bench_record(record_head, thresholds)
+        slo = {"pass": not violations, "violations": violations,
+               "thresholds": args.slo_thresholds}
+        for v in violations:
+            print(f"# SLO VIOLATION: {v}", file=sys.stderr)
+
     print(json.dumps({
         "metric": f"serve_throughput_{args.nodes}v_avgdeg"
                   f"{args.avg_degree:g}"
@@ -206,11 +225,14 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
         "slice_steps": args.serve_slice_steps,
         "monotone_curve": monotone,
         "parity_ok": parity_ok,
+        "slo": slo,
         "shape_class": cls.name if cls else None,
         "phases": {k: round(v, 4) for k, v in phases.items()},
         "backend": "serve",
         "platform": context["platform"],
     }))
+    if slo is not None and not slo["pass"]:
+        return 1
     return 0 if parity_ok else 1
 
 
@@ -282,6 +304,13 @@ def main() -> int:
                    help="supersteps per continuous-mode slice, or "
                         "'auto' to price against dispatch overhead "
                         "(default auto)")
+    p.add_argument("--slo-thresholds", type=str, default=None,
+                   metavar="JSON",
+                   help="SLO gate for the serve measurement "
+                        "(tools/slo_check.py thresholds schema; "
+                        "graphs_per_s_min / speedup_vs_sequential_min "
+                        "apply) — violations exit nonzero, the "
+                        "perf-regression tripwire")
     args = p.parse_args()
     if args.nodes is None:
         args.nodes = 20_000 if args.serve_throughput else 1_000_000

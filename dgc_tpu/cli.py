@@ -96,6 +96,14 @@ def build_parser() -> argparse.ArgumentParser:
         help="write run metrics in Prometheus text exposition format",
     )
     p.add_argument(
+        "--superstep-timing", action="store_true",
+        help="record per-superstep in-kernel wall time into the "
+             "trajectory buffer's timing column (engines that support "
+             "it: ell-compact); requires --run-manifest or "
+             "--metrics-prom (which switch trajectories on); rendered "
+             "by tools/report_run.py",
+    )
+    p.add_argument(
         "--compat-failed-output",
         action="store_true",
         help="reproduce the reference's quirk of saving the failed attempt's partial coloring",
@@ -481,6 +489,9 @@ def _run(args, logger: RunLogger) -> int:
                 rung_args.backend = name
                 with phases.section("host_engine_build"):
                     eng = make_engine(rung_args, graph, logger=logger)
+                if (args.superstep_timing and telemetry
+                        and hasattr(eng, "record_timing")):
+                    eng.record_timing = True
                 return ObservedEngine(eng, phases=phases, registry=registry,
                                       record_trajectory=telemetry)
             return build
@@ -512,6 +523,10 @@ def _run(args, logger: RunLogger) -> int:
     else:
         with phases.section("host_engine_build"):
             engine = make_engine(args, graph, logger=logger)
+        if (args.superstep_timing and telemetry
+                and hasattr(engine, "record_timing")):
+            # the trajectory buffer's col-5 timing column (obs.devclock)
+            engine.record_timing = True
         engine = ObservedEngine(engine, phases=phases, registry=registry,
                                 record_trajectory=telemetry)
         with phases.section("sweep_total"):

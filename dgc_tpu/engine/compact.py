@@ -1153,7 +1153,8 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                       max_steps: int, init_bucket_active: tuple,
                       stage_ranges: tuple = (), hub_prune: tuple = (),
                       hub_uncond: tuple = (), stall_window: int = 64,
-                      traj=None, record_traj: bool = False):
+                      traj=None, record_traj: bool = False,
+                      traj_timing: bool = False):
     """Heavy-tail variant of ``_staged_pipeline``: ONE ``while_loop`` whose
     body dispatches the flat region's work over a ``lax.switch`` of
     per-stage bodies while the hub machinery — the dominant traced cost
@@ -1204,7 +1205,7 @@ def _unified_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                 default=1)
 
     recstep = _make_recstep(record)
-    trajstep = make_trajstep(record_traj)
+    trajstep = make_trajstep(record_traj, timing=traj_timing)
     if traj is None:
         traj = traj_empty(1, nb=len(init_bucket_active), dummy=True)
 
@@ -1366,7 +1367,8 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
                      init_bucket_active: tuple, stage_ranges: tuple = (),
                      hub_prune: tuple = (), hub_uncond: tuple = (),
                      stall_window: int = 64,
-                     traj=None, record_traj: bool = False):
+                     traj=None, record_traj: bool = False,
+                     traj_timing: bool = False):
     """One whole k-attempt as a traceable pipeline: cond-skipped full-table
     phase + hybrid (flat-compacted + live-hub) compaction stages. Returns
     (packed_ext, steps, status, rec, traj).
@@ -1414,7 +1416,8 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
             buckets, flat_ext, degrees, k, init, rec, record,
             planes, row0s, hub_buckets, flat_row0, flat_planes, stages,
             max_steps, init_bucket_active, stage_ranges, hub_prune,
-            hub_uncond, stall_window, traj=traj, record_traj=record_traj)
+            hub_uncond, stall_window, traj=traj, record_traj=record_traj,
+            traj_timing=traj_timing)
 
     if traj is None:
         traj = traj_empty(1, nb=len(init_bucket_active), dummy=True)
@@ -1423,7 +1426,7 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
              init[4]) + tuple(rec) + (prune0, traj)
 
     recstep = _make_recstep(record)
-    trajstep = make_trajstep(record_traj)
+    trajstep = make_trajstep(record_traj, timing=traj_timing)
     sc = _SegCtx(buckets, planes, row0s, nb_hub, hub_uncond)
 
     for si, (scale, thresh) in enumerate(stages):
@@ -1556,13 +1559,13 @@ def _staged_pipeline(buckets, flat_ext, degrees, k, init, rec, record,
 _STATIC_NAMES = ("planes", "row0s", "hub_buckets", "flat_row0", "flat_planes",
                  "stages", "max_steps", "init_bucket_active", "stage_ranges",
                  "hub_prune", "hub_uncond", "stall_window", "record_traj",
-                 "traj_cap")
+                 "traj_cap", "traj_timing")
 
 
 @partial(jax.jit, static_argnames=_STATIC_NAMES)
 def _attempt_kernel_staged(buckets, flat_ext, degrees, k,
                            record_traj: bool = False, traj_cap: int = 1,
-                           **static_kw):
+                           traj_timing: bool = False, **static_kw):
     """Plain staged k-attempt (no prefix-resume recording):
     (pe, steps, status, traj)."""
     nb = len(static_kw["init_bucket_active"])
@@ -1572,7 +1575,8 @@ def _attempt_kernel_staged(buckets, flat_ext, degrees, k,
                        unconf_b=record_traj)
     pe, steps, status, _, traj = _staged_pipeline(
         buckets, flat_ext, degrees, k, init, rec, False,
-        traj=traj0, record_traj=record_traj, **static_kw)
+        traj=traj0, record_traj=record_traj, traj_timing=traj_timing,
+        **static_kw)
     return pe, steps, status, traj
 
 
@@ -1583,7 +1587,8 @@ def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
                          init_bucket_active: tuple, stage_ranges: tuple = (),
                          hub_prune: tuple = (), hub_uncond: tuple = (),
                          stall_window: int = 64,
-                         record_traj: bool = False, traj_cap: int = 1):
+                         record_traj: bool = False, traj_cap: int = 1,
+                         traj_timing: bool = False):
     """Fused minimal-k sweep: attempt(k0), then — still on device — the
     jump-mode confirm attempt at (colors_used − 1). One dispatch for what
     jump mode otherwise does in two (PERF.md lever: ~65 ms dispatch each).
@@ -1648,7 +1653,8 @@ def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
 
         pe, steps, status, rec, traj = _staged_pipeline(
             *args, k, (pe_i, step_i, act_i, stall_i, ba_i), rec, first,
-            traj=traj0, record_traj=record_traj, **kw)
+            traj=traj0, record_traj=record_traj, traj_timing=traj_timing,
+            **kw)
         colors = jnp.where(pe[:v] >= 0, pe[:v] >> 1, -1)
         used_new = jnp.where(first, jnp.max(colors, initial=-1) + 1, used)
         k2 = used_new - 1
@@ -1712,8 +1718,12 @@ class CompactFrontierEngine(BucketedELLEngine):
         kw = {} if max_window_planes is None else {"max_window_planes": max_window_planes}
         super().__init__(arrays, max_steps=max_steps, min_width=min_width, **kw)
         # in-kernel telemetry switch (obs subsystem): compiles a recording
-        # variant of the kernels whose carry threads the trajectory buffer
+        # variant of the kernels whose carry threads the trajectory buffer;
+        # record_timing additionally samples the in-kernel clock per
+        # superstep into the buffer's col-5 timing column (obs.devclock —
+        # requires record_trajectory; statically off by default)
         self.record_trajectory = False
+        self.record_timing = False
         v = arrays.num_vertices
 
         sizes = [cb.shape[0] for cb in self.combined_buckets]
@@ -1790,7 +1800,8 @@ class CompactFrontierEngine(BucketedELLEngine):
     def _traj_kw(self) -> dict:
         rec = self.record_trajectory
         return dict(record_traj=rec,
-                    traj_cap=traj_cap_for(self.max_steps) if rec else 1)
+                    traj_cap=traj_cap_for(self.max_steps) if rec else 1,
+                    traj_timing=bool(rec and self.record_timing))
 
     def attempt(self, k: int) -> AttemptResult:
         v = self.arrays.num_vertices

@@ -23,24 +23,37 @@ leaving the fused fast path**:
   ``tools/report_run.py`` renders it.
 - ``obs.instrument`` — ``ObservedEngine``, the engine proxy that wires the
   above into any backend without touching the minimal-k driver.
+- ``obs.trace`` — request-scoped distributed tracing: spans
+  (trace/span/parent, monotonic µs) emitted into the same JSONL stream;
+  ``tools/export_trace.py`` renders them Perfetto-loadable.
+- ``obs.devclock`` — the in-kernel clock behind the trajectory buffer's
+  timing column and the serve slice kernel's per-lane device time.
+- ``obs.httpd`` — live Prometheus scrape endpoint (``--metrics-port``)
+  over the thread-safe registry.
 
 ``utils.logging`` and ``utils.tracing`` are backward-compatible shims over
 this package.
 """
 
 from dgc_tpu.obs.events import RunLogger
+from dgc_tpu.obs.httpd import MetricsHTTPServer
 from dgc_tpu.obs.instrument import ObservedEngine
 from dgc_tpu.obs.kernel import SuperstepTrajectory, decode_trajectory
 from dgc_tpu.obs.manifest import RunManifest
 from dgc_tpu.obs.metrics import MetricsRegistry
 from dgc_tpu.obs.phases import PhaseCollector
+from dgc_tpu.obs.trace import NULL_TRACER, Tracer, tracer_for
 
 __all__ = [
+    "MetricsHTTPServer",
     "MetricsRegistry",
+    "NULL_TRACER",
     "ObservedEngine",
     "PhaseCollector",
     "RunLogger",
     "RunManifest",
     "SuperstepTrajectory",
+    "Tracer",
     "decode_trajectory",
+    "tracer_for",
 ]

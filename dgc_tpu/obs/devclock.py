@@ -1,0 +1,78 @@
+"""Monotonic clock readable from inside jitted kernels.
+
+The trajectory buffer's timing column (``obs.kernel`` col 5) and the
+serve slice kernel's per-lane device-time slots need a timestamp taken
+*inside* a ``lax.while_loop`` body — between supersteps, on whatever is
+executing the kernel — under the same one-transfer-per-attempt contract
+as every other telemetry column: the timestamps ride the carry/buffer
+and come back with the kernel's normal outputs.
+
+JAX exposes no device cycle-counter op, so the portable implementation
+is a ``pure_callback`` that samples ``time.perf_counter_ns`` on the
+host, sequenced after the superstep's reduction by a data dependency on
+its output. On CPU (where kernel and host share a clock domain) this IS
+the superstep wall clock to sub-µs accuracy; on TPU it measures the
+host-observed superstep boundary (callback hop included), which still
+splits in-loop compute from dispatch overhead — the split
+``auto_slice_steps`` recalibration needs. The queued XPlane self-time
+probe (``tools/evidence_suite.sh``) cross-checks the column against
+``trace_attempt`` op self-times on real hardware; a native cycle-counter
+primitive can replace ``_read`` behind the same helpers without touching
+any caller.
+
+Timestamps are 31-bit microseconds (int32 without sign games, wraps
+every ~35 min); ``wrap_delta_us`` recovers deltas across the wrap. The
+timing path is *statically* opt-in everywhere (``make_trajstep(...,
+timing=...)``, ``batched_slice_kernel(..., timing=...)``): kernels
+compiled without it contain no callback and are byte-identical to the
+pre-timing kernels.
+"""
+
+from __future__ import annotations
+
+import time
+
+import numpy as np
+
+# 31-bit µs mask: values stay non-negative in int32 (the trajectory
+# buffer's −1 fill keeps meaning "unwritten") and wrap every ~35.8 min
+US_MASK = 0x7FFFFFFF
+
+
+def host_clock_us() -> int:
+    """Masked monotonic microseconds on the host clock."""
+    return (time.perf_counter_ns() // 1000) & US_MASK
+
+
+def wrap_delta_us(t0, t1):
+    """Wrap-safe ``t1 − t0`` for masked timestamps (host side; works
+    elementwise on numpy arrays)."""
+    return (t1 - t0) & US_MASK
+
+
+def kernel_clock_us(dep):
+    """Masked µs timestamp as an int32 traced value, sequenced after
+    ``dep`` (pass a value computed by the work being timed — the data
+    dependency keeps the sample at the superstep boundary).
+
+    Under ``vmap`` the callback runs once per loop iteration and the
+    timestamp broadcasts across the batch (``vmap_method=
+    "broadcast_all"``) — all lanes of a batched superstep share one
+    clock read, which is both cheap and exactly the semantics wanted:
+    the batch's supersteps are lockstep.
+    """
+    import jax
+
+    def _now(d):
+        return np.full(np.shape(d), host_clock_us(), np.int32)
+
+    return jax.pure_callback(
+        _now, jax.ShapeDtypeStruct((), np.dtype(np.int32)), dep,
+        vmap_method="broadcast_all")
+
+
+def wrap_delta_us_jax(t0, t1):
+    """Wrap-safe delta as a traced int32 (kernel side)."""
+    import jax.numpy as jnp
+
+    return (t1 - t0) & jnp.int32(US_MASK)

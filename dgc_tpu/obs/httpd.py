@@ -1,0 +1,81 @@
+"""Minimal live Prometheus scrape endpoint over ``MetricsRegistry``.
+
+The first rung of the ROADMAP network-serving item: until now the
+registry's Prometheus exposition only ever reached disk
+(``--metrics-prom`` writes a file at exit), so a live ``dgc-tpu serve``
+run was invisible to a scraper. This serves ``GET /metrics`` (and ``/``)
+straight from ``registry.to_prometheus()`` — the registry is
+thread-safe, so the scrape observes a consistent point-in-time snapshot
+while worker threads keep mutating — plus ``GET /healthz`` from an
+optional health callback (the front-end's readiness snapshot as JSON).
+
+Stdlib only (``http.server``), one daemon thread, ephemeral-port
+friendly (``port=0`` binds any free port; read ``.port`` back — the
+tests' pattern). Not a general web server: two routes, GET only,
+loopback by default.
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+
+PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
+
+
+class MetricsHTTPServer:
+    """``MetricsHTTPServer(registry, port=9100).start()`` → live
+    ``/metrics`` scrape endpoint; ``close()`` stops it. ``health_fn``
+    (optional, ``() -> dict``) backs ``/healthz``."""
+
+    def __init__(self, registry, port: int = 0, host: str = "127.0.0.1",
+                 health_fn=None):
+        self.registry = registry
+        self.health_fn = health_fn
+        outer = self
+
+        class _Handler(BaseHTTPRequestHandler):
+            def do_GET(self):  # noqa: N802 (http.server convention)
+                path = self.path.split("?", 1)[0]
+                if path in ("/", "/metrics"):
+                    body = outer.registry.to_prometheus().encode()
+                    ctype = PROM_CONTENT_TYPE
+                elif path == "/healthz" and outer.health_fn is not None:
+                    body = (json.dumps(outer.health_fn()) + "\n").encode()
+                    ctype = "application/json"
+                else:
+                    self.send_error(404)
+                    return
+                self.send_response(200)
+                self.send_header("Content-Type", ctype)
+                self.send_header("Content-Length", str(len(body)))
+                self.end_headers()
+                self.wfile.write(body)
+
+            def log_message(self, fmt, *args):  # scrapes are not run events
+                pass
+
+        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        self._server.daemon_threads = True
+        self._thread: threading.Thread | None = None
+
+    @property
+    def port(self) -> int:
+        """The bound port (useful with ``port=0``)."""
+        return self._server.server_address[1]
+
+    def start(self) -> "MetricsHTTPServer":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._server.serve_forever, daemon=True,
+                name="dgc-metrics-httpd")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._server.shutdown()
+        self._server.server_close()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None

@@ -29,10 +29,17 @@ metrics of superstep ``s`` (the engine's step counter):
   telemetry is on). ``tune --from-manifest`` reads this column to bound
   capture validity instead of pricing it pessimistically at bucket
   width;
-- cols 5..5+nb: per-bucket active counts (bucket occupancy) for the
+- col 5: the superstep's in-kernel clock timestamp (masked monotonic µs,
+  ``obs.devclock``; −1 where timing is not recorded — a *statically*
+  separate opt-in via ``make_trajstep(..., timing=True)``, so
+  timing-off kernels carry no clock read). The host decoder differences
+  consecutive timestamps into per-superstep wall time (``step_us``) —
+  the ROADMAP per-superstep on-device wall-time column, splitting slice
+  time into superstep compute vs dispatch overhead;
+- cols 6..6+nb: per-bucket active counts (bucket occupancy) for the
   bucketed engines (``nb`` = the engine's bucket-active vector length,
   0 for the flat engines);
-- cols 5+nb..5+2·nb (only when the engine records a per-bucket unconf
+- cols 6+nb..6+2·nb (only when the engine records a per-bucket unconf
   *vector* — the compact engine with telemetry on): per-bucket max
   unconfirmed-neighbor counts in the same ``nb`` layout as the
   bucket-active tail (hub buckets, then the flat-region total). Col 4
@@ -56,8 +63,8 @@ from dataclasses import dataclass
 
 import numpy as np
 
-TRAJ_COLS = 5          # active, fail, mc, gather_calls, max_unconf —
-                       # before the bucket-active tail
+TRAJ_COLS = 6          # active, fail, mc, gather_calls, max_unconf,
+                       # ts_us — before the bucket-active tail
 DEFAULT_TRAJ_CAP = 4096
 
 
@@ -81,7 +88,7 @@ def traj_empty(cap: int, nb: int = 0, dummy: bool = False,
                     -1, jnp.int32)
 
 
-def make_trajstep(record):
+def make_trajstep(record, timing: bool = False):
     """Per-superstep trajectory writer. ``record`` is a *python* bool:
     False returns the identity (statically no-op — telemetry-off kernels
     carry no live recording code), True returns the row write.
@@ -93,6 +100,12 @@ def make_trajstep(record):
     (col 4 only) or a per-bucket VECTOR in the bucket-active layout —
     the vector lands in the per-bucket tail and its max in col 4 (the
     buffer must then be ``traj_empty(..., unconf_b=True)``).
+
+    ``timing`` (a python bool, static like ``record``) additionally
+    samples the in-kernel clock (``obs.devclock.kernel_clock_us``,
+    sequenced after the superstep via a dependency on ``active``) into
+    col 5; off, the column keeps its −1 fill and the kernel contains no
+    clock read.
     """
     import jax.numpy as jnp
 
@@ -104,13 +117,20 @@ def make_trajstep(record):
         if unconf is not None and getattr(unconf, "ndim", 0) == 1:
             unconf_vec = jnp.asarray(unconf, jnp.int32)
             unconf = jnp.max(unconf_vec, initial=0)
+        if timing:
+            from dgc_tpu.obs.devclock import kernel_clock_us
+
+            ts = kernel_clock_us(jnp.asarray(active, jnp.int32))
+        else:
+            ts = jnp.int32(-1)
         cols = [jnp.asarray(active, jnp.int32),
                 jnp.asarray(any_fail, jnp.int32),
                 jnp.int32(-1) if mc is None else jnp.asarray(mc, jnp.int32),
                 jnp.int32(-1) if gcalls is None
                 else jnp.asarray(gcalls, jnp.int32),
                 jnp.int32(-1) if unconf is None
-                else jnp.asarray(unconf, jnp.int32)]
+                else jnp.asarray(unconf, jnp.int32),
+                ts]
         row = jnp.stack(cols)
         if ba is not None:
             row = jnp.concatenate([row, jnp.asarray(ba, jnp.int32)])
@@ -135,6 +155,9 @@ class SuperstepTrajectory:
     truncated: bool                    # steps ran past the buffer cap
     max_unconf_bucket: np.ndarray | None = None  # int32[S, nb] per-bucket
                                        # max unconf (bucket-active layout)
+    step_us: np.ndarray | None = None  # int32[S] per-superstep in-kernel wall
+                                       # µs (col-5 timestamp deltas; −1 where
+                                       # unattributable — the span's first row)
 
     def __len__(self) -> int:
         return len(self.active)
@@ -153,6 +176,8 @@ class SuperstepTrajectory:
             d["bucket_active"] = self.bucket_active.tolist()
         if self.max_unconf_bucket is not None:
             d["max_unconf_bucket"] = self.max_unconf_bucket.tolist()
+        if self.step_us is not None:
+            d["step_us"] = self.step_us.tolist()
         return d
 
 
@@ -178,6 +203,17 @@ def decode_trajectory(buf, supersteps: int | None = None,
     tail = buf.shape[1] - TRAJ_COLS
     nb = tail // 2 if unconf_b else tail
     truncated = bool(supersteps is not None and supersteps > buf.shape[0])
+    # col-5 timestamps → per-superstep deltas: row i's wall time is
+    # ts[i] − ts[i−1] (wrap-safe), leaving the span's first row −1 (its
+    # predecessor timestamp is outside the recorded span)
+    ts = span[:, 5].astype(np.int32)
+    step_us = None
+    if (ts >= 0).any():
+        from dgc_tpu.obs.devclock import wrap_delta_us
+
+        step_us = np.full(len(ts), -1, np.int32)
+        ok = (ts[1:] >= 0) & (ts[:-1] >= 0)
+        step_us[1:][ok] = wrap_delta_us(ts[:-1][ok], ts[1:][ok])
     return SuperstepTrajectory(
         active=span[:, 0].astype(np.int32),
         fail=span[:, 1].astype(np.int32),
@@ -191,4 +227,5 @@ def decode_trajectory(buf, supersteps: int | None = None,
         max_unconf_bucket=(
             span[:, TRAJ_COLS + nb:TRAJ_COLS + 2 * nb].astype(np.int32)
             if unconf_b and nb > 0 else None),
+        step_us=step_us,
     )

@@ -83,7 +83,8 @@ class RunManifest:
             self.doc["post_reduce"] = fields
         elif kind in ("sweep_done", "sweep_failed"):
             self.doc["result"] = dict(fields, event=kind)
-        elif kind.startswith("serve_") or kind == "lane_recycled":
+        elif (kind.startswith("serve_")
+              or kind in ("lane_recycled", "slice_recalibrated")):
             # serving path (dgc_tpu.serve) — the slot appears only when
             # serve events do, so non-serve manifests stay byte-identical
             serve = self.doc.setdefault(
@@ -100,6 +101,9 @@ class RunManifest:
                 serve["slices"].append(fields)
             elif kind == "lane_recycled":
                 serve["recycles"] += 1
+            elif kind == "slice_recalibrated":
+                # measured slice-size re-pricing (timing mode)
+                serve.setdefault("recalibrations", []).append(fields)
             elif kind == "serve_warmup":
                 serve["warmup"] = fields
             elif kind == "serve_request":

@@ -15,6 +15,7 @@ from __future__ import annotations
 
 import math
 import re
+import threading
 from dataclasses import dataclass, field
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
@@ -50,11 +51,17 @@ class Counter:
     help: str
     labels: dict = field(default_factory=dict)
     value: float = 0.0
+    # serve worker threads mutate concurrently with exporter reads; the
+    # per-metric lock makes each update/read atomic (MetricsRegistry's
+    # lock only guards the get-or-create dict)
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def inc(self, v: float = 1.0) -> None:
         if v < 0:
             raise ValueError(f"counter {self.name} cannot decrease (inc {v})")
-        self.value += v
+        with self._lock:
+            self.value += v
 
 
 @dataclass
@@ -63,9 +70,12 @@ class Gauge:
     help: str
     labels: dict = field(default_factory=dict)
     value: float = 0.0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def set(self, v: float) -> None:
-        self.value = float(v)
+        with self._lock:
+            self.value = float(v)
 
 
 @dataclass
@@ -77,40 +87,80 @@ class Histogram:
     counts: list = None
     total: float = 0.0
     n: int = 0
+    _lock: threading.Lock = field(default_factory=threading.Lock,
+                                  repr=False, compare=False)
 
     def __post_init__(self):
         if self.counts is None:
             self.counts = [0] * (len(self.buckets) + 1)  # +1: +Inf
 
     def observe(self, v: float) -> None:
-        self.total += float(v)
-        self.n += 1
+        with self._lock:
+            self.total += float(v)
+            self.n += 1
+            for i, b in enumerate(self.buckets):
+                if v <= b:
+                    self.counts[i] += 1
+                    return
+            self.counts[-1] += 1
+
+    def quantile(self, q: float) -> float | None:
+        """Bucket-interpolated quantile estimate — the
+        ``histogram_quantile`` rule: find the bucket the q·n-th
+        observation falls in, interpolate linearly inside its
+        ``(lower, upper]`` bounds (lower = previous edge, 0 before the
+        first — observations are assumed non-negative, which every
+        latency/time series here is). A quantile landing in the +Inf
+        overflow bucket clamps to the largest finite edge. ``None`` when
+        empty."""
+        if not 0.0 <= q <= 1.0:
+            raise ValueError(f"quantile must be in [0, 1], got {q}")
+        with self._lock:
+            n = self.n
+            counts = list(self.counts)
+        if n == 0:
+            return None
+        target = q * n
+        cum = 0.0
+        lo = 0.0
         for i, b in enumerate(self.buckets):
-            if v <= b:
-                self.counts[i] += 1
-                return
-        self.counts[-1] += 1
+            c = counts[i]
+            if c > 0 and cum + c >= target:
+                return lo + (b - lo) * max(0.0, target - cum) / c
+            cum += c
+            lo = b
+        return float(self.buckets[-1]) if self.buckets else None
 
 
 class MetricsRegistry:
-    """Get-or-create registry keyed on (name, sorted labels)."""
+    """Get-or-create registry keyed on (name, sorted labels).
+
+    Thread-safe: the serve worker pool (``serve.queue`` threads) and the
+    batch dispatcher mutate counters/histograms concurrently with
+    exporter reads (the ``--metrics-port`` scrape endpoint, manifest
+    finalization). The registry lock guards the get-or-create maps; each
+    metric's own lock makes updates and exporter reads atomic."""
 
     def __init__(self):
         self._metrics: dict = {}   # (name, labelkey) -> metric
         self._meta: dict = {}      # name -> (kind, help)
+        self._lock = threading.RLock()
 
     def _get(self, cls, kind: str, name: str, help: str, labels: dict, **kw):
         if not _NAME_RE.match(name):
             raise ValueError(f"invalid metric name: {name!r}")
-        prior = self._meta.get(name)
-        if prior is not None and prior[0] != kind:
-            raise ValueError(
-                f"metric {name} already registered as {prior[0]}, not {kind}")
-        self._meta[name] = (kind, help or (prior[1] if prior else ""))
-        key = (name, tuple(sorted(labels.items())))
-        if key not in self._metrics:
-            self._metrics[key] = cls(name=name, help=help, labels=dict(labels), **kw)
-        return self._metrics[key]
+        with self._lock:
+            prior = self._meta.get(name)
+            if prior is not None and prior[0] != kind:
+                raise ValueError(
+                    f"metric {name} already registered as {prior[0]}, "
+                    f"not {kind}")
+            self._meta[name] = (kind, help or (prior[1] if prior else ""))
+            key = (name, tuple(sorted(labels.items())))
+            if key not in self._metrics:
+                self._metrics[key] = cls(name=name, help=help,
+                                         labels=dict(labels), **kw)
+            return self._metrics[key]
 
     def counter(self, name: str, help: str = "", **labels) -> Counter:
         return self._get(Counter, "counter", name, help, labels)
@@ -123,40 +173,62 @@ class MetricsRegistry:
         return self._get(Histogram, "histogram", name, help, labels,
                          buckets=buckets)
 
+    def _snapshot(self):
+        with self._lock:
+            return sorted(self._metrics.items()), dict(self._meta)
+
+    def histograms(self, name: str) -> list:
+        """All label variants of one histogram family (the serve tier's
+        per-shape-class latency summaries read these)."""
+        metrics, meta = self._snapshot()
+        if meta.get(name, (None,))[0] != "histogram":
+            return []
+        return [m for (n, _), m in metrics if n == name]
+
     def to_prometheus(self) -> str:
         """Prometheus text exposition format, families grouped and
         terminated with the required trailing newline."""
         out = []
-        for name, (kind, help) in sorted(self._meta.items()):
+        metrics, meta = self._snapshot()
+        for name, (kind, help) in sorted(meta.items()):
             out.append(f"# HELP {name} {help}")
             out.append(f"# TYPE {name} {kind}")
-            for (n, _), m in sorted(self._metrics.items()):
+            for (n, _), m in metrics:
                 if n != name:
                     continue
-                if kind == "histogram":
-                    cum = 0
-                    for b, c in zip(tuple(m.buckets) + (math.inf,), m.counts):
-                        cum += c
-                        lab = dict(m.labels, le=_fmt(b))
-                        out.append(f"{name}_bucket{_labels_str(lab)} {cum}")
-                    out.append(f"{name}_sum{_labels_str(m.labels)} {_fmt(m.total)}")
-                    out.append(f"{name}_count{_labels_str(m.labels)} {m.n}")
-                else:
-                    out.append(f"{name}{_labels_str(m.labels)} {_fmt(m.value)}")
+                with m._lock:
+                    if kind == "histogram":
+                        cum = 0
+                        for b, c in zip(tuple(m.buckets) + (math.inf,),
+                                        m.counts):
+                            cum += c
+                            lab = dict(m.labels, le=_fmt(b))
+                            out.append(
+                                f"{name}_bucket{_labels_str(lab)} {cum}")
+                        out.append(f"{name}_sum{_labels_str(m.labels)} "
+                                   f"{_fmt(m.total)}")
+                        out.append(f"{name}_count{_labels_str(m.labels)} "
+                                   f"{m.n}")
+                    else:
+                        out.append(f"{name}{_labels_str(m.labels)} "
+                                   f"{_fmt(m.value)}")
         return "\n".join(out) + "\n"
 
     def to_dict(self) -> dict:
         """JSON-able snapshot (embedded in the run manifest)."""
         snap = {}
-        for (name, labelkey), m in sorted(self._metrics.items()):
-            kind = self._meta[name][0]
+        metrics, meta = self._snapshot()
+        for (name, labelkey), m in metrics:
+            kind = meta[name][0]
             key = name + _labels_str(dict(labelkey))
-            if kind == "histogram":
-                snap[key] = {"kind": kind, "sum": m.total, "count": m.n,
-                             "buckets": dict(zip(map(_fmt, m.buckets), m.counts[:-1])),
-                             "inf": m.counts[-1]}
-            else:
-                snap[key] = {"kind": kind, "value": m.value}
+            with m._lock:
+                if kind == "histogram":
+                    snap[key] = {"kind": kind, "sum": m.total, "count": m.n,
+                                 "buckets": dict(zip(map(_fmt, m.buckets),
+                                                     m.counts[:-1])),
+                                 "inf": m.counts[-1]}
+                else:
+                    snap[key] = {"kind": kind, "value": m.value}
         return snap
 
     def write_prom(self, path: str) -> None:

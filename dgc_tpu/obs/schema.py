@@ -47,7 +47,17 @@ EVENT_SCHEMAS: dict = {
         {"k": "int", "active": "list", "fail": "list", "mc": "list",
          "first_step": "int", "truncated": "bool"},
         {"bucket_active": "list", "gather_calls": "list",
-         "max_unconf": "list", "max_unconf_bucket": "list"}),
+         "max_unconf": "list", "max_unconf_bucket": "list",
+         "step_us": "list"}),
+    # request-scoped tracing (obs.trace): begin/end records of one span;
+    # ``tools/validate_runlog.py`` additionally checks the structural
+    # invariants (parent-before-child, every opened span closed) and
+    # this schema rejects unknown span fields — per-span data lives in
+    # the ``attrs`` dict, never in new top-level fields
+    "span": (
+        {"name": "str", "ph": "str", "trace": "str", "span": "str",
+         "ts_us": "int"},
+        {"parent": ("str", "null"), "attrs": ("dict", "null")}),
     "phase": (
         {"name": "str", "seconds": NUM},
         {"k": "int", "attempt_index": "int", "warm": "bool"}),
@@ -90,7 +100,7 @@ EVENT_SCHEMAS: dict = {
         {"batch_max": "int", "window_ms": NUM, "queue_depth": "int",
          "workers": "int"},
         {"mode": "str", "slice_steps": ("int", "null"),
-         "affinity": "bool"}),
+         "affinity": "bool", "timing": "bool", "tracing": "bool"}),
     "serve_batch": (
         {"shape_class": "str", "batch": "int", "occupancy": NUM,
          "padding_waste": NUM},
@@ -103,11 +113,21 @@ EVENT_SCHEMAS: dict = {
         {"shape_class": "str", "live": "int", "b_pad": "int",
          "occupancy": NUM},
         {"done": "int", "admitted": "int", "slice_steps": "int",
-         "compile_cache": "str", "device_ms": NUM}),
+         "compile_cache": "str", "device_ms": NUM,
+         # in-kernel timing split (slice kernel timing slots): superstep
+         # compute vs dispatch overhead within device_ms
+         "sstep_ms": NUM, "overhead_ms": NUM}),
     "lane_recycled": (
         {"shape_class": "str", "lane": "int"},
         {"k": "int", "depth_bucket": "int", "slices": "int",
-         "queue_ms": NUM, "service_ms": NUM}),
+         "queue_ms": NUM, "service_ms": NUM, "device_us": "int"}),
+    # slice-size recalibration from the measured overhead/compute split
+    # (timing mode, slice_steps auto): once per shape class
+    "slice_recalibrated": (
+        {"shape_class": "str", "from_steps": "int", "to_steps": "int"},
+        {"overhead_ms": NUM, "sstep_ms": NUM, "samples": "int"}),
+    # live scrape endpoint (obs.httpd) bound for this run
+    "metrics_server": ({"port": "int"}, {"host": "str"}),
     "serve_warmup": (
         {"classes": "int", "kernels": "int", "seconds": NUM}, {}),
     "serve_request": (
@@ -130,7 +150,10 @@ EVENT_SCHEMAS: dict = {
         {"rejected": "int", "graphs_per_s": (*NUM, "null"),
          "batches": "int", "compile_misses": "int", "compile_hits": "int",
          "slices": "int", "recycles": "int", "mode": "str",
-         "warmup_s": (*NUM, "null"), "warmed_kernels": ("int", "null")}),
+         "warmup_s": (*NUM, "null"), "warmed_kernels": ("int", "null"),
+         # per-shape-class latency summary (bucket-interpolated
+         # histogram quantiles, ms): {class: {p50, p95, p99, count}}
+         "latency_ms": "dict", "recals": "int"}),
 }
 
 

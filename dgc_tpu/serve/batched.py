@@ -64,7 +64,14 @@ statuses are byte-identical to the single-graph fused engines
 The kernel records no in-kernel trajectory: serve telemetry is
 slice/request-grained (``obs`` ``serve_slice``/``lane_recycled``/
 ``serve_batch``/``serve_request`` events), and the bit-identity ensemble
-checks serve telemetry on/off.
+checks serve telemetry on/off. **In-kernel timing** (the single-graph
+trajectory buffer's col-5 contract, ``obs.devclock``) rides the carry's
+two trailing slots when the slice kernel is compiled with
+``timing=True``: each live superstep's wall-µs accumulates per lane, so
+the scheduler can split host-observed slice time into in-kernel
+superstep compute vs dispatch overhead (the ``auto_slice_steps``
+recalibration input) — sweep outputs are byte-identical timing on/off
+because the clock feeds only the timing slots.
 """
 
 from __future__ import annotations
@@ -89,9 +96,15 @@ DEFAULT_STALL_WINDOW = 64  # the engines' shared defensive exit
 
 # per-lane carry layout (the slice kernel's host<->device contract):
 # (phase, k, packed, step, prev_active, stall,   -- live sweep state
-#  p1, s1, st1, used, p2, s2, st2)               -- jump-pair result slots
-CARRY_LEN = 13
+#  p1, s1, st1, used, p2, s2, st2,               -- jump-pair result slots
+#  t_us, t_prev)                                 -- in-kernel timing slots
+# The timing slots ride inert (zeros) unless the kernel is compiled with
+# ``timing=True`` (obs.devclock): t_us accumulates the lane's live
+# superstep wall-µs, t_prev holds the last superstep's clock sample.
+CARRY_LEN = 15
 _OUT0 = 6          # index of the first result slot (p1) in the carry
+_N_OUT = 7         # result slots p1..st2
+T_US = 13          # index of the accumulated device-µs timing slot
 
 
 def _fresh_lane(degrees, k0):
@@ -106,17 +119,25 @@ def _fresh_lane(degrees, k0):
             packed0, jnp.int32(1), jnp.int32(v + 1), z,  # live sweep state
             zeros, z, z,                                 # slot 1
             z,                                           # used
-            zeros, z, jnp.int32(_FAILURE))               # slot 2
+            zeros, z, jnp.int32(_FAILURE),               # slot 2
+            z, z)                                        # timing slots
 
 
 def _superstep_body(c, nbr, beats, packed0, max_steps, v: int, *,
-                    planes: int, stall_window: int):
+                    planes: int, stall_window: int, timing: bool = False):
     """ONE superstep + attempt-boundary transition of one lane's carry —
     the single body both :func:`_sweep_pair_one` (unsliced) and
     :func:`batched_slice_kernel` (sliced) loop over, so the two cannot
-    drift (the recycling bit-identity precondition)."""
+    drift (the recycling bit-identity precondition).
+
+    ``timing`` (static) samples the in-kernel clock after the superstep
+    (``obs.devclock``, the same column contract as the single-graph
+    engines' trajectory col 5) and accumulates the lane's live wall-µs
+    into the ``t_us`` carry slot — the values feed only the timing
+    slots, so colors/steps/statuses are byte-identical timing on or off.
+    """
     (phase, k, packed, step, prev_active, stall,
-     p1, s1, st1, used, p2, s2, st2) = c
+     p1, s1, st1, used, p2, s2, st2, t_us, t_prev) = c
     first = phase == 0
 
     # --- one full-table superstep (BSP snapshot semantics) ---
@@ -144,6 +165,18 @@ def _superstep_body(c, nbr, beats, packed0, max_steps, v: int, *,
     k2 = used_new - 1
     run2 = fin & first & (status_fin == _SUCCESS) & (k2 >= 1)
 
+    if timing:
+        from dgc_tpu.obs.devclock import kernel_clock_us, wrap_delta_us_jax
+
+        # sequenced after the superstep's reduction (dep on `active`);
+        # a fresh lane's first superstep is unattributable (t_prev == 0
+        # sentinel) and the vmap'd while_loop's select already freezes
+        # finished lanes' slots
+        ts = kernel_clock_us(active)
+        t_us = t_us + jnp.where(t_prev > 0,
+                                wrap_delta_us_jax(t_prev, ts), 0)
+        t_prev = ts
+
     store1 = fin & first
     store2 = fin & ~first
     return (
@@ -160,6 +193,7 @@ def _superstep_body(c, nbr, beats, packed0, max_steps, v: int, *,
         jnp.where(store2, new_packed, p2),
         jnp.where(store2, step_new, s2).astype(jnp.int32),
         jnp.where(store2, status_fin, st2).astype(jnp.int32),
+        t_us, t_prev,
     )
 
 
@@ -184,11 +218,11 @@ def _sweep_pair_one(comb, degrees, k0, max_steps, *, planes: int,
                                planes=planes, stall_window=stall_window)
 
     out = jax.lax.while_loop(cond, body, _fresh_lane(degrees, k0))
-    return out[_OUT0:]
+    return out[_OUT0:_OUT0 + _N_OUT]
 
 
 def _slice_one(comb, degrees, k0, max_steps, reset, carry, *, planes: int,
-               slice_steps: int, stall_window: int):
+               slice_steps: int, stall_window: int, timing: bool):
     """At most ``slice_steps`` supersteps of one lane's sweep. A lane
     flagged ``reset`` re-initializes from its (freshly host-written)
     inputs first; a lane whose phase is already 2 (done / idle) does no
@@ -200,13 +234,24 @@ def _slice_one(comb, degrees, k0, max_steps, reset, carry, *, planes: int,
     carry = jax.tree.map(
         lambda f, c: jnp.where(fresh, f, c), _fresh_lane(degrees, k0),
         tuple(carry))
+    if timing:
+        from dgc_tpu.obs.devclock import kernel_clock_us
+
+        # seed the clock at slice entry for lanes without a prior sample
+        # (fresh seats and first-slice lanes), so their first superstep
+        # is attributed from the slice boundary
+        ts0 = kernel_clock_us(carry[0])
+        live = carry[0] < 2
+        t_prev = jnp.where(live & (carry[14] == 0), ts0, carry[14])
+        carry = carry[:14] + (t_prev,)
 
     def cond(c):
         return (c[1] < 2) & (c[0] < slice_steps)
 
     def body(c):
         new = _superstep_body(c[1:], nbr, beats, packed0, max_steps, v,
-                              planes=planes, stall_window=stall_window)
+                              planes=planes, stall_window=stall_window,
+                              timing=timing)
         return (c[0] + 1,) + new
 
     out = jax.lax.while_loop(cond, body, (jnp.int32(0),) + carry)
@@ -227,19 +272,25 @@ def batched_sweep_kernel(comb, degrees, k0, max_steps, planes: int,
         comb, degrees, k0, max_steps)
 
 
-@partial(jax.jit, static_argnames=("planes", "slice_steps", "stall_window"))
+@partial(jax.jit, static_argnames=("planes", "slice_steps", "stall_window",
+                                   "timing"))
 def batched_slice_kernel(comb, degrees, k0, max_steps, reset, carry,
                          planes: int, slice_steps: int,
-                         stall_window: int = DEFAULT_STALL_WINDOW):
+                         stall_window: int = DEFAULT_STALL_WINDOW,
+                         timing: bool = False):
     """The continuous-batching class kernel: one bounded slice of every
     lane's sweep. Inputs as :func:`batched_sweep_kernel` plus ``reset
     int32[B]`` (1 = re-init the lane from its inputs) and the per-lane
     ``carry`` (:data:`CARRY_LEN`-tuple, batch-leading). Returns the
     advanced carry; the host reads ``carry[0] >= 2`` as the done mask.
-    One jit cache entry per (B, V_pad, W_pad, planes, slice_steps)."""
+    ``timing`` (static) accumulates each lane's live superstep wall-µs
+    into carry slot :data:`T_US` (``obs.devclock``; the scheduler's
+    dispatch-overhead split) — the sweep outputs are byte-identical
+    either way. One jit cache entry per (B, V_pad, W_pad, planes,
+    slice_steps, timing)."""
     return jax.vmap(partial(_slice_one, planes=planes,
                             slice_steps=slice_steps,
-                            stall_window=stall_window))(
+                            stall_window=stall_window, timing=timing))(
         comb, degrees, k0, max_steps, reset, carry)
 
 
@@ -252,7 +303,8 @@ def idle_carry(b_pad: int, v_pad: int):
     return (np.full(b_pad, 2, np.int32), np.ones(b_pad, np.int32),
             pk.copy(), z.copy(), z.copy(), z.copy(),
             pk.copy(), z.copy(), z.copy(), z.copy(),
-            pk.copy(), z.copy(), np.full(b_pad, int(_FAILURE), np.int32))
+            pk.copy(), z.copy(), np.full(b_pad, int(_FAILURE), np.int32),
+            z.copy(), z.copy())
 
 
 def lane_outputs(carry_np, lane: int):
@@ -260,7 +312,7 @@ def lane_outputs(carry_np, lane: int):
     the sweep-result convention ``finish_pair`` consumes — from a
     host-materialized carry."""
     p1, s1, st1, used, p2, s2, st2 = (carry_np[j][lane]
-                                      for j in range(_OUT0, CARRY_LEN))
+                                      for j in range(_OUT0, _OUT0 + _N_OUT))
     return p1, s1, st1, int(used), p2, s2, int(st2)
 
 
@@ -279,6 +331,19 @@ _DISPATCH_OVERHEAD_S = {"tpu": 65e-3, "gpu": 10e-3, "cpu": 0.6e-3}
 _ENTRIES_PER_S = {"tpu": 1.0e10, "gpu": 5e9, "cpu": 1.5e8}
 
 
+def priced_slice_steps(overhead_s: float, superstep_s: float, *,
+                       overhead_frac: float = 0.125, lo: int = 4,
+                       hi: int = 64) -> int:
+    """The slice-size pricing rule itself: the smallest S keeping the
+    per-dispatch overhead ≤ ``overhead_frac`` of slice compute, clamped
+    to [lo, hi]. ``auto_slice_steps`` feeds it the static per-backend
+    model; the scheduler's timing-column recalibration
+    (``serve.engine.BatchScheduler``) feeds it MEASURED overhead and
+    superstep seconds instead."""
+    s = math.ceil(overhead_s / (overhead_frac * max(superstep_s, 1e-9)))
+    return int(min(hi, max(lo, s)))
+
+
 def auto_slice_steps(entries: int, b_pad: int, platform: str | None = None,
                      *, overhead_frac: float = 0.125, lo: int = 4,
                      hi: int = 64) -> int:
@@ -289,8 +354,8 @@ def auto_slice_steps(entries: int, b_pad: int, platform: str | None = None,
     overhead = _DISPATCH_OVERHEAD_S.get(plat, 1e-3)
     rate = _ENTRIES_PER_S.get(plat, 5e8)
     superstep_s = max(b_pad * entries / rate, 1e-9)
-    s = math.ceil(overhead / (overhead_frac * superstep_s))
-    return int(min(hi, max(lo, s)))
+    return priced_slice_steps(overhead, superstep_s,
+                              overhead_frac=overhead_frac, lo=lo, hi=hi)
 
 
 def finish_pair(member, p1, s1, st1, used, p2, s2, st2, attempt_fallback):

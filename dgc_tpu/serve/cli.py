@@ -100,6 +100,22 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="write the run manifest (serve slot included)")
     p.add_argument("--metrics-prom", type=str, default=None,
                    help="write metrics in Prometheus text format")
+    p.add_argument("--metrics-port", type=int, default=None,
+                   metavar="PORT",
+                   help="serve the live metrics registry in Prometheus "
+                        "text format at http://127.0.0.1:PORT/metrics "
+                        "(0 = any free port; also /healthz) while the "
+                        "replay runs")
+    p.add_argument("--kernel-timing", action="store_true",
+                   help="compile the slice kernels' in-kernel timing "
+                        "variant: per-lane superstep wall time in the "
+                        "carry, the sstep/overhead split in serve_slice "
+                        "events, and measured slice-size recalibration "
+                        "(continuous mode)")
+    p.add_argument("--no-trace", action="store_true",
+                   help="disable request-scoped span tracing (spans are "
+                        "emitted into --log-json by default; "
+                        "tools/export_trace.py renders them)")
     return p
 
 
@@ -170,11 +186,33 @@ def serve_main(argv: list[str] | None = None) -> int:
         slice_steps=(None if args.slice_steps == "auto"
                      else args.slice_steps),
         affinity=not args.no_affinity,
+        timing=args.kernel_timing, trace=not args.no_trace,
         validate=not args.no_validate,
         post_reduce=not args.no_reduce_colors,
         auto_tune=args.auto_tune, tuned_cache=tuned_cache,
         logger=logger, registry=registry,
     ).start()
+
+    # live scrape endpoint (obs.httpd): GET /metrics serves the registry
+    # in Prometheus text format for the whole replay — the ROADMAP
+    # "Prometheus scrape of the existing metrics registry" rung
+    metrics_server = None
+    if args.metrics_port is not None:
+        from dgc_tpu.obs import MetricsHTTPServer
+
+        try:
+            metrics_server = MetricsHTTPServer(
+                registry, port=args.metrics_port,
+                health_fn=lambda: front.health()).start()
+        except OSError as e:
+            print(f"--metrics-port: cannot bind {args.metrics_port}: {e}",
+                  file=sys.stderr)
+            front.shutdown(drain=False)
+            return 2
+        logger.event("metrics_server", port=metrics_server.port,
+                     host="127.0.0.1")
+        print(f"# metrics: http://127.0.0.1:{metrics_server.port}/metrics",
+              file=sys.stderr)
 
     # compile warmup runs (and is reported) OUTSIDE the serve clock: the
     # one-off wide-batch XLA compile must not masquerade as first-batch
@@ -231,6 +269,12 @@ def serve_main(argv: list[str] | None = None) -> int:
     wall = time.perf_counter() - t0
 
     done = front.stats["completed"]
+    summary_kw = {}
+    latency = front.latency_summary()
+    if latency is not None:
+        summary_kw["latency_ms"] = latency
+    if front.scheduler.stats.get("recals"):
+        summary_kw["recals"] = front.scheduler.stats["recals"]
     logger.event("serve_summary", requests=len(requests), completed=done,
                  failed=front.stats["failed"],
                  rejected=front.stats["rejected"],
@@ -243,7 +287,10 @@ def serve_main(argv: list[str] | None = None) -> int:
                  warmup_s=warmup["seconds"] if warmup else None,
                  warmed_kernels=warmup["kernels"] if warmup else None,
                  compile_misses=front.scheduler.stats["compile_misses"],
-                 compile_hits=front.scheduler.stats["compile_hits"])
+                 compile_hits=front.scheduler.stats["compile_hits"],
+                 **summary_kw)
+    if metrics_server is not None:
+        metrics_server.close()
     if args.run_manifest:
         manifest.finalize(registry=registry)
         manifest.write(args.run_manifest)

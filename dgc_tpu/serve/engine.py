@@ -62,15 +62,18 @@ import time
 import numpy as np
 
 from dgc_tpu.engine.base import AttemptResult, empty_budget_failure
+from dgc_tpu.obs.trace import NULL_TRACER
 from dgc_tpu.serve.batched import (
     CARRY_LEN,
     DEFAULT_STALL_WINDOW,
+    T_US,
     auto_slice_steps,
     batched_slice_kernel,
     batched_sweep_kernel,
     finish_pair,
     idle_carry,
     lane_outputs,
+    priced_slice_steps,
 )
 from dgc_tpu.serve.shape_classes import (dummy_member, pad_ladder,
                                          padding_waste)
@@ -100,9 +103,9 @@ def depth_bucket(k: int) -> int:
 
 class _SweepCall:
     __slots__ = ("member", "k", "depth", "done", "result", "error",
-                 "t_enqueue")
+                 "t_enqueue", "span", "lane_span", "device_us")
 
-    def __init__(self, member, k):
+    def __init__(self, member, k, span=None):
         self.member = member
         self.k = int(k)
         self.depth = depth_bucket(k)
@@ -110,6 +113,12 @@ class _SweepCall:
         self.result = None
         self.error = None
         self.t_enqueue = time.perf_counter()
+        # request-scoped tracing (obs.trace): the sweep span begun at
+        # enqueue; the lane span the dispatcher opens when the call is
+        # seated (closed at recycle/delivery)
+        self.span = span
+        self.lane_span = None
+        self.device_us = None      # in-kernel superstep µs (timing mode)
 
 
 class _LanePool:
@@ -120,7 +129,7 @@ class _LanePool:
 
     __slots__ = ("cls", "b_pad", "comb", "degrees", "k0", "max_steps",
                  "reset", "carry", "calls", "t_fill", "slices_in",
-                 "_dev_inputs", "_dirty", "_dummy")
+                 "t_seen", "_dev_inputs", "_dirty", "_dummy")
 
     def __init__(self, cls, b_pad: int, dummy):
         self.cls = cls
@@ -150,6 +159,7 @@ class _LanePool:
         calls = [None] * b_pad
         t_fill = [0.0] * b_pad
         slices_in = [0] * b_pad
+        t_seen = np.zeros(b_pad, np.int64)
         for new_i, old_i in enumerate(keep):
             comb[new_i] = self.comb[old_i]
             degrees[new_i] = self.degrees[old_i]
@@ -161,11 +171,13 @@ class _LanePool:
             calls[new_i] = self.calls[old_i]
             t_fill[new_i] = self.t_fill[old_i]
             slices_in[new_i] = self.slices_in[old_i]
+            t_seen[new_i] = self.t_seen[old_i]
         self.b_pad = b_pad
         self.comb, self.degrees = comb, degrees
         self.k0, self.max_steps, self.reset = k0, max_steps, reset
         self.carry = carry
         self.calls, self.t_fill, self.slices_in = calls, t_fill, slices_in
+        self.t_seen = t_seen
         self._dev_inputs = None
         self._dirty = []
 
@@ -202,6 +214,7 @@ class _LanePool:
         self.calls[lane] = call
         self.t_fill[lane] = time.perf_counter()
         self.slices_in[lane] = 0
+        self.t_seen[lane] = 0   # reset re-zeroes the lane's timing slot
         self._dirty.append(lane)
         return lane
 
@@ -251,8 +264,9 @@ class BatchScheduler:
     def __init__(self, *, batch_max: int = 8, window_s: float = 0.002,
                  stall_window: int = DEFAULT_STALL_WINDOW,
                  mode: str = "continuous", slice_steps: int | None = None,
-                 affinity: bool = True,
-                 on_batch=None, on_event=None):
+                 affinity: bool = True, timing: bool = False,
+                 recal_min_slices: int = 8,
+                 on_batch=None, on_event=None, tracer=None):
         if batch_max < 1:
             raise ValueError(f"batch_max must be >= 1, got {batch_max}")
         if mode not in ("continuous", "sync"):
@@ -266,18 +280,28 @@ class BatchScheduler:
         self.mode = mode
         self.slice_steps = None if slice_steps is None else int(slice_steps)
         self.affinity = bool(affinity)
+        # in-kernel timing (obs.devclock): compiles the slice kernels'
+        # timing variant, splits slice wall time into superstep compute
+        # vs dispatch overhead, and — with slice_steps auto — re-prices
+        # the slice size ONCE per class from the measured split after
+        # ``recal_min_slices`` full slices (one recompile, then frozen)
+        self.timing = bool(timing)
+        self.recal_min_slices = int(recal_min_slices)
         self.on_batch = on_batch
         self.on_event = on_event
+        self.tracer = tracer if tracer is not None else NULL_TRACER
         self._lock = threading.Condition()
         self._pending: dict = {}   # class -> [_SweepCall]
         self._kernels: dict = {}   # compile-cache key -> fn
         self._dummies: dict = {}   # class -> ServeMember
         self._pools: dict = {}     # class -> _LanePool (dispatcher-owned)
+        self._timing_acc: dict = {}  # class -> [n, overhead_s, iter_s]
+        self._recal: dict = {}     # class -> measured slice_steps override
         self._stop = False
         self._thread = None
         self.stats = {"batches": 0, "sweeps": 0, "compile_hits": 0,
                       "compile_misses": 0, "slices": 0, "recycles": 0,
-                      "max_live": 0}
+                      "max_live": 0, "recals": 0}
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "BatchScheduler":
@@ -306,22 +330,36 @@ class BatchScheduler:
             stranded.extend(c for c in pool.calls if c is not None)
         self._pools.clear()
         for call in stranded:
+            if call.lane_span is not None:
+                call.lane_span.end({"error": "scheduler stopped"})
             call.error = ServeError("batch scheduler stopped")
             call.done.set()
 
     # -- submission (worker threads) ------------------------------------
     def sweep(self, member, k: int):
         """Blocking batched sweep: returns the raw per-member kernel
-        outputs ``(p1, s1, st1, used, p2, s2, st2)``."""
-        call = _SweepCall(member, k)
-        with self._lock:
-            if self._stop:
-                raise ServeError("batch scheduler stopped")
-            self._pending.setdefault(member.cls, []).append(call)
-            self._lock.notify_all()
-        call.done.wait()
-        if call.error is not None:
-            raise call.error
+        outputs ``(p1, s1, st1, used, p2, s2, st2)``. The sweep span
+        (parent: the calling thread's current span — the worker's
+        ``serve`` span via ``Tracer.current``) brackets enqueue through
+        result delivery; the dispatcher opens a child ``lane`` span per
+        seating."""
+        span = self.tracer.begin("sweep", attrs={"k": int(k),
+                                                 "cls": member.cls.name})
+        call = _SweepCall(member, k, span=span)
+        try:
+            with self._lock:
+                if self._stop:
+                    raise ServeError("batch scheduler stopped")
+                self._pending.setdefault(member.cls, []).append(call)
+                self._lock.notify_all()
+            call.done.wait()
+            if call.error is not None:
+                raise call.error
+        except BaseException as e:
+            span.end({"error": f"{type(e).__name__}: {e}"})
+            raise
+        span.end({"device_us": call.device_us}
+                 if call.device_us is not None else None)
         return call.result
 
     # -- warmup ---------------------------------------------------------
@@ -390,22 +428,56 @@ class BatchScheduler:
         return self._kernels[key], hit
 
     def _slice_kernel_for(self, cls, b_pad: int):
-        s = (self.slice_steps if self.slice_steps is not None
-             else auto_slice_steps(cls.entries(), b_pad))
-        key = ("slice", cls.v_pad, cls.w_pad, cls.planes, b_pad, s)
+        s = self.resolved_slice_steps(cls, b_pad)
+        key = ("slice", cls.v_pad, cls.w_pad, cls.planes, b_pad, s,
+               self.timing)
         hit = key in self._kernels
         if not hit:
             self._kernels[key] = lambda *a: batched_slice_kernel(
                 *a, planes=cls.planes, slice_steps=s,
-                stall_window=self.stall_window)
+                stall_window=self.stall_window, timing=self.timing)
             self.stats["compile_misses"] += 1
         else:
             self.stats["compile_hits"] += 1
         return self._kernels[key], hit
 
     def resolved_slice_steps(self, cls, b_pad: int) -> int:
-        return (self.slice_steps if self.slice_steps is not None
-                else auto_slice_steps(cls.entries(), b_pad))
+        if self.slice_steps is not None:
+            return self.slice_steps
+        recal = self._recal.get(cls)
+        if recal is not None:
+            return recal
+        return auto_slice_steps(cls.entries(), b_pad)
+
+    def _timing_sample(self, cls, overhead_s: float, iter_s: float) -> None:
+        """One full slice's measured (dispatch overhead, per-superstep
+        seconds); after ``recal_min_slices`` samples the class's slice
+        size is re-priced ONCE from the measured split (slice_steps auto
+        only — an explicit --slice-steps is never overridden)."""
+        acc = self._timing_acc.setdefault(cls, [0, 0.0, 0.0])
+        acc[0] += 1
+        acc[1] += overhead_s
+        acc[2] += iter_s
+        if (self.slice_steps is not None or cls in self._recal
+                or acc[0] < self.recal_min_slices):
+            return
+        overhead = acc[1] / acc[0]
+        iter_mean = acc[2] / acc[0]
+        s_new = priced_slice_steps(overhead, iter_mean)
+        s_old = auto_slice_steps(cls.entries(),
+                                 self._pools[cls].b_pad
+                                 if cls in self._pools else 1)
+        self._recal[cls] = s_new
+        if s_new != s_old:
+            self.stats["recals"] += 1
+            if self.on_event is not None:
+                self.on_event("slice_recalibrated", {
+                    "shape_class": cls.name, "from_steps": int(s_old),
+                    "to_steps": int(s_new),
+                    "overhead_ms": round(overhead * 1e3, 3),
+                    "sstep_ms": round(iter_mean * 1e3, 3),
+                    "samples": int(acc[0]),
+                })
 
     # =====================================================================
     # continuous mode: lane recycling
@@ -469,6 +541,8 @@ class BatchScheduler:
                     with self._lock:
                         failed.extend(self._pending.pop(cls, []))
                     for call in failed:
+                        if call.lane_span is not None:
+                            call.lane_span.end({"error": "dispatch failed"})
                         call.error = ServeError(
                             f"batched dispatch failed: {e}")
                         call.done.set()
@@ -491,7 +565,10 @@ class BatchScheduler:
             if take:
                 pool.reserve(len(take))   # ONE resize for the whole wave
             for call in take:
-                pool.fill(call)
+                lane = pool.fill(call)
+                call.lane_span = self.tracer.begin(
+                    "lane", parent=call.span,
+                    attrs={"lane": int(lane), "b_pad": int(pool.b_pad)})
                 admitted += 1
         live = pool.live
         if live == 0:
@@ -507,6 +584,10 @@ class BatchScheduler:
         kernel, cache_hit = self._slice_kernel_for(cls, pool.b_pad)
         slice_steps = self.resolved_slice_steps(cls, pool.b_pad)
         comb_dev, degrees_dev = pool.dev_inputs()
+        slice_span = self.tracer.begin(
+            "slice", trace="sched",
+            attrs={"cls": cls.name, "live": int(live),
+                   "b_pad": int(pool.b_pad)})
         t0 = time.perf_counter()
         carry = kernel(comb_dev, degrees_dev, pool.k0, pool.max_steps,
                        pool.reset, pool.carry)
@@ -517,6 +598,21 @@ class BatchScheduler:
         for i in range(pool.b_pad):
             pool.slices_in[i] += 1
 
+        # in-kernel timing split (the slice kernel's T_US carry slot):
+        # per-lane accumulated superstep µs; the per-slice in-kernel wall
+        # is the max lane delta (the longest-live lane sees every
+        # iteration), overhead = host wall − in-kernel wall
+        sstep_s = overhead_s = None
+        t_acc = None
+        if self.timing:
+            t_acc = np.asarray(carry[T_US]).astype(np.int64)
+            deltas = t_acc - pool.t_seen
+            live_mask = np.array([c is not None for c in pool.calls])
+            sstep_s = (float(deltas[live_mask].max()) / 1e6
+                       if live_mask.any() else 0.0)
+            overhead_s = max(0.0, device_s - sstep_s)
+            pool.t_seen = t_acc.copy()
+
         done_lanes = [i for i in range(pool.b_pad)
                       if pool.calls[i] is not None and phase[i] >= 2]
         if done_lanes:
@@ -525,12 +621,18 @@ class BatchScheduler:
             for lane in done_lanes:
                 call = pool.calls[lane]
                 call.result = lane_outputs(carry_np, lane)
+                if t_acc is not None:
+                    call.device_us = int(t_acc[lane])
+                if call.lane_span is not None:
+                    call.lane_span.end(
+                        {"slices": int(pool.slices_in[lane]),
+                         "device_us": call.device_us})
                 call.done.set()
                 pool.calls[lane] = None
                 self.stats["sweeps"] += 1
                 self.stats["recycles"] += 1
                 if self.on_event is not None:
-                    self.on_event("lane_recycled", {
+                    rec = {
                         "shape_class": cls.name, "lane": int(lane),
                         "k": call.k, "depth_bucket": call.depth,
                         "slices": int(pool.slices_in[lane]),
@@ -538,13 +640,17 @@ class BatchScheduler:
                             (pool.t_fill[lane] - call.t_enqueue) * 1e3, 3),
                         "service_ms": round(
                             (now - pool.t_fill[lane]) * 1e3, 3),
-                    })
+                    }
+                    if call.device_us is not None:
+                        rec["device_us"] = call.device_us
+                    self.on_event("lane_recycled", rec)
 
         self.stats["batches"] += 1
         self.stats["slices"] += 1
         self.stats["max_live"] = max(self.stats["max_live"], live)
+        slice_span.end({"done": len(done_lanes), "admitted": int(admitted)})
         if self.on_event is not None:
-            self.on_event("serve_slice", {
+            rec = {
                 "shape_class": cls.name, "live": int(live),
                 "b_pad": int(pool.b_pad),
                 "occupancy": round(live / pool.b_pad, 4),
@@ -552,7 +658,16 @@ class BatchScheduler:
                 "slice_steps": int(slice_steps),
                 "compile_cache": "hit" if cache_hit else "miss",
                 "device_ms": round(device_s * 1e3, 3),
-            })
+            }
+            if sstep_s is not None:
+                rec["sstep_ms"] = round(sstep_s * 1e3, 3)
+                rec["overhead_ms"] = round(overhead_s * 1e3, 3)
+            self.on_event("serve_slice", rec)
+        # recalibration samples: full slices only (no lane finished
+        # early), where every live lane ran exactly slice_steps bodies
+        if (self.timing and cache_hit and not done_lanes and live > 0
+                and sstep_s is not None and sstep_s > 0):
+            self._timing_sample(cls, overhead_s, sstep_s / slice_steps)
         if pool.live == 0:
             self._pools.pop(cls, None)
 
@@ -622,10 +737,14 @@ class BatchScheduler:
         max_steps = np.array([m.max_steps for m in members], np.int32)
 
         kernel, cache_hit = self._kernel_for(cls, b_pad)
+        batch_span = self.tracer.begin(
+            "batch", trace="sched",
+            attrs={"cls": cls.name, "batch": int(b), "b_pad": int(b_pad)})
         t0 = time.perf_counter()
         p1, s1, st1, used, p2, s2, st2 = kernel(comb, degrees, k0, max_steps)
         st2 = np.asarray(st2)   # one transfer point for the epilogues
         device_s = time.perf_counter() - t0
+        batch_span.end()
 
         queue_ms_max = max(
             (t0 - c.t_enqueue) * 1e3 for c in calls)

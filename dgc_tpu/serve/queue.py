@@ -35,6 +35,7 @@ import numpy as np
 from dgc_tpu.engine.minimal_k import (find_minimal_coloring, make_reducer,
                                       make_validator)
 from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.obs.trace import NULL_TRACER, tracer_for
 from dgc_tpu.resilience.supervisor import RungState, SweepAbort, supervise_sweep
 from dgc_tpu.serve.engine import BatchMemberEngine, BatchScheduler, ServeError
 from dgc_tpu.serve.shape_classes import DEFAULT_LADDER, ShapeLadder, pad_member
@@ -49,6 +50,10 @@ class ServeRequest:
     request_id: int
     arrays: GraphArrays
     t_submit: float = field(default_factory=time.perf_counter)
+    # request-scoped tracing (obs.trace): the root span covering the
+    # request's whole life and the queue-wait child, begun at submit
+    root_span: object = None
+    queue_span: object = None
 
 
 @dataclass
@@ -128,6 +133,7 @@ class ServeFrontEnd:
                  queue_depth: int = 64, workers: int | None = None,
                  mode: str = "continuous", slice_steps: int | None = None,
                  affinity: bool = True,
+                 timing: bool = False, trace: bool = True,
                  validate: bool = True, post_reduce: bool = True,
                  auto_tune: bool = False, tuned_cache=None,
                  retries: int = 0,
@@ -153,13 +159,18 @@ class ServeFrontEnd:
                                     or _default_fallback_factories)
         self.logger = logger
         self.registry = registry
+        # request-scoped tracing: spans ride the same JSONL stream as
+        # every other event (a run logger is the only sink), so tracing
+        # is on exactly when a logger is attached unless trace=False
+        self.tracer = tracer_for(logger) if trace else NULL_TRACER
         self.rung_state = rung_state if rung_state is not None else RungState()
         self.scheduler = BatchScheduler(batch_max=batch_max,
                                         window_s=window_s,
                                         mode=mode, slice_steps=slice_steps,
-                                        affinity=affinity,
+                                        affinity=affinity, timing=timing,
                                         on_batch=self._on_batch,
-                                        on_event=self._on_sched_event)
+                                        on_event=self._on_sched_event,
+                                        tracer=self.tracer)
         self._lock = threading.Condition()
         self._queue: deque = deque()
         self._threads: list = []
@@ -214,7 +225,9 @@ class ServeFrontEnd:
                     queue_depth=self.queue_depth, workers=self.workers,
                     mode=self.scheduler.mode,
                     slice_steps=self.scheduler.slice_steps,
-                    affinity=self.scheduler.affinity)
+                    affinity=self.scheduler.affinity,
+                    timing=self.scheduler.timing,
+                    tracing=self.tracer.enabled)
         return self
 
     def warm(self, class_names: list) -> dict:
@@ -248,6 +261,9 @@ class ServeFrontEnd:
             self._draining = True
             if not drain:
                 for req, ticket in self._queue:
+                    if req.queue_span is not None:
+                        req.queue_span.end({"error": "shutdown"})
+                        req.root_span.end({"status": "error"})
                     ticket._complete(self._error_result(
                         req, "front-end shut down before dispatch"))
                     self.stats["failed"] += 1
@@ -297,11 +313,40 @@ class ServeFrontEnd:
                 # the auto-id bookkeeping; they are carried through as-is
                 self._next_id = max(self._next_id, request_id) + 1
             req = ServeRequest(request_id=request_id, arrays=arrays)
+            # trace root + queue-wait child: begun under the admission
+            # lock (the worker popping this request must find the spans
+            # in place), trace id = the request id
+            req.root_span = self.tracer.begin(
+                "request", trace=f"req-{request_id}",
+                attrs={"v": int(arrays.num_vertices)})
+            req.queue_span = self.tracer.begin("queue",
+                                               parent=req.root_span)
             ticket = ServeTicket(req)
             self._queue.append((req, ticket))
             self.stats["submitted"] += 1
             self._lock.notify_all()
         return ticket
+
+    # -- latency summary -------------------------------------------------
+    def latency_summary(self) -> dict | None:
+        """Per-shape-class service-latency summary from the registry's
+        histograms: ``{class: {p50, p95, p99, count}}`` in milliseconds
+        (bucket-interpolated quantiles — ``Histogram.quantile``). None
+        when no registry is attached or nothing was observed (the
+        ``serve_summary`` event's optional ``latency_ms`` slot)."""
+        if self.registry is None:
+            return None
+        out = {}
+        for h in self.registry.histograms("dgc_serve_service_seconds"):
+            if h.n == 0:
+                continue
+            out[h.labels.get("shape_class", "?")] = {
+                "p50": round(h.quantile(0.50) * 1e3, 3),
+                "p95": round(h.quantile(0.95) * 1e3, 3),
+                "p99": round(h.quantile(0.99) * 1e3, 3),
+                "count": h.n,
+            }
+        return out or None
 
     # -- health/readiness -----------------------------------------------
     def health(self, emit: bool = False) -> dict:
@@ -346,13 +391,22 @@ class ServeFrontEnd:
                 req, ticket = self._queue.popleft()
                 self._in_flight += 1
                 self._lock.notify_all()   # wake blocked submitters
+            if req.queue_span is not None:
+                req.queue_span.end()
+            serve_span = self.tracer.begin("serve", parent=req.root_span)
+            # the worker's current span: BatchScheduler.sweep (reached
+            # via find_minimal_coloring → BatchMemberEngine, which
+            # cannot thread a span argument) parents its sweep span here
+            self.tracer.push(serve_span)
             try:
                 result = self._serve_one(req)
             except Exception as e:
                 result = self._error_result(req, f"{type(e).__name__}: {e}")
             finally:
+                self.tracer.pop(serve_span)
                 with self._lock:
                     self._in_flight -= 1
+            serve_span.end({"status": result.status})
             if result.status == "ok":
                 self.stats["completed"] += 1
             else:
@@ -372,6 +426,20 @@ class ServeFrontEnd:
                 self.registry.counter("dgc_serve_requests_total",
                                       "served requests",
                                       status=result.status).inc()
+                # per-shape-class latency histograms (the SLO layer's
+                # source of truth; exported live via --metrics-port and
+                # summarized into serve_summary.latency_ms)
+                cls_label = result.shape_class or "fallback"
+                self.registry.histogram(
+                    "dgc_serve_service_seconds",
+                    "request service time by shape class",
+                    shape_class=cls_label).observe(result.service_s)
+                self.registry.histogram(
+                    "dgc_serve_queue_seconds",
+                    "request queue wait by shape class",
+                    shape_class=cls_label).observe(result.queue_s)
+            if req.root_span is not None:
+                req.root_span.end({"status": result.status})
             ticket._complete(result)
 
     def _serve_one(self, req: ServeRequest) -> ServeResult:

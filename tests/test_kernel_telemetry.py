@@ -152,8 +152,8 @@ def test_trajectory_decode_handles_truncation():
 
     buf = np.asarray(traj_empty(4))
     buf = buf.copy()
-    buf[0] = [10, 0, -1, -1, -1]
-    buf[1] = [5, 0, -1, -1, -1]
+    buf[0] = [10, 0, -1, -1, -1, -1]
+    buf[1] = [5, 0, -1, -1, -1, -1]
     t = decode_trajectory(buf, supersteps=9)  # ran past the 4-row cap
     assert t.truncated
     assert t.active.tolist() == [10, 5]
@@ -259,3 +259,80 @@ def test_compact_max_unconf_bucket_tail_matches_replay():
     assert mub[:rows, hub].tolist() == want_flat[:rows]
     # col 4 is exactly the tail's per-row max (layout compatibility)
     assert t.max_unconf[:rows].tolist() == mub[:rows].max(axis=1).tolist()
+
+
+def test_compact_timing_column_and_inertness():
+    # the col-5 timing column (obs.devclock): with record_timing on, the
+    # decoded trajectory carries per-superstep in-kernel wall µs (every
+    # row past the first attributable, plausible magnitudes) and the
+    # sweep results stay byte-identical to the timing-off kernel; with
+    # timing off the column keeps its -1 fill and decodes to None
+    g = generate_rmat_graph(1500, avg_degree=10.0, seed=7)
+    timed = CompactFrontierEngine(g)
+    timed.record_trajectory = True
+    timed.record_timing = True
+    t1, t2 = timed.sweep(g.max_degree + 1)
+
+    plain = CompactFrontierEngine(g)
+    plain.record_trajectory = True
+    p1, p2 = plain.sweep(g.max_degree + 1)
+
+    assert np.array_equal(t1.colors, p1.colors)
+    assert t1.supersteps == p1.supersteps
+    assert (t2 is None) == (p2 is None)
+    if t2 is not None:
+        assert np.array_equal(t2.colors, p2.colors)
+        assert t2.supersteps == p2.supersteps
+
+    su = t1.trajectory.step_us
+    assert su is not None and len(su) == len(t1.trajectory)
+    assert su[0] == -1                      # span head: no predecessor ts
+    assert (su[1:] >= 0).all()              # every later row attributed
+    total_s = su[su >= 0].sum() / 1e6
+    assert 0 < total_s < 120                # sane magnitude for a CPU sweep
+    # all other columns byte-identical to the timing-off recording
+    assert np.array_equal(t1.trajectory.active, p1.trajectory.active)
+    assert np.array_equal(t1.trajectory.fail, p1.trajectory.fail)
+    assert p1.trajectory.step_us is None
+    # timing without trajectories is a no-op (the _traj_kw gate)
+    off = CompactFrontierEngine(g)
+    off.record_timing = True
+    o1, _ = off.sweep(g.max_degree + 1)
+    assert o1.trajectory is None
+    assert np.array_equal(o1.colors, p1.colors)
+
+
+def test_timing_column_flows_to_manifest_and_report(tmp_path, capsys):
+    # --superstep-timing end to end: CLI flag → engine → trajectory event
+    # step_us (schema-clean) → manifest → report_run's device-time line
+    import json
+    import sys
+
+    from dgc_tpu.cli import main
+
+    sys.path.insert(0, "tools")
+    import report_run
+    from validate_runlog import validate_file
+
+    log = tmp_path / "run.jsonl"
+    manifest = tmp_path / "m.json"
+    rc = main([
+        "--node-count", "300", "--max-degree", "8", "--seed", "11",
+        "--backend", "ell-compact",
+        "--output-coloring", str(tmp_path / "c.json"),
+        "--log-json", str(log),
+        "--run-manifest", str(manifest),
+        "--superstep-timing",
+    ])
+    capsys.readouterr()
+    assert rc == 0
+    assert validate_file(str(log)) == []
+    trajs = [json.loads(l) for l in log.read_text().splitlines()
+             if '"trajectory"' in l]
+    trajs = [t for t in trajs if t.get("event") == "trajectory"]
+    assert trajs and all("step_us" in t for t in trajs)
+    assert any(u >= 0 for t in trajs for u in t["step_us"])
+    doc = json.loads(manifest.read_text())
+    assert doc["attempts"][0]["trajectory"]["step_us"]
+    assert report_run.main([str(manifest)]) == 0
+    assert "device time/superstep" in capsys.readouterr().out

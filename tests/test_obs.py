@@ -56,6 +56,143 @@ def test_prometheus_exposition_format_valid():
     assert math.isclose(float(s.split()[1]), 30.55)
 
 
+def test_metrics_registry_thread_safety_hammer():
+    """Satellite: worker threads mutate counters/histograms while a
+    reader exports concurrently — final values exact, no exceptions in
+    any thread (the serve worker-pool/exporter race)."""
+    import threading
+
+    reg = MetricsRegistry()
+    n_threads, n_iter = 8, 400
+    errors = []
+    go = threading.Event()
+
+    def writer(tid):
+        try:
+            go.wait()
+            for i in range(n_iter):
+                reg.counter("ham_total", "hammered", thread=str(tid)).inc()
+                reg.counter("ham_shared_total", "shared").inc(2)
+                reg.gauge("ham_gauge", "g").set(i)
+                reg.histogram("ham_seconds", "h",
+                              buckets=(0.1, 1.0)).observe(0.05 * (i % 40))
+        except Exception as e:  # pragma: no cover - the failure signal
+            errors.append(e)
+
+    def reader():
+        try:
+            go.wait()
+            for _ in range(60):
+                text = reg.to_prometheus()
+                assert text.endswith("\n")
+                json.dumps(reg.to_dict())
+                reg.histogram("ham_seconds", "h",
+                              buckets=(0.1, 1.0)).quantile(0.95)
+        except Exception as e:  # pragma: no cover
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)] + \
+              [threading.Thread(target=reader) for _ in range(2)]
+    for t in threads:
+        t.start()
+    go.set()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    assert reg.counter("ham_shared_total", "shared").value \
+        == 2 * n_threads * n_iter
+    h = reg.histogram("ham_seconds", "h", buckets=(0.1, 1.0))
+    assert h.n == n_threads * n_iter
+    assert sum(h.counts) == h.n
+
+
+def test_histogram_bucket_edges_and_overflow():
+    """Satellite: exact v == bucket boundary lands IN that bucket
+    (Prometheus ``le`` semantics), above-everything lands in +Inf."""
+    reg = MetricsRegistry()
+    h = reg.histogram("edge_seconds", "edges", buckets=(0.1, 1.0, 10.0))
+    for v in (0.1, 1.0, 10.0):      # exact edges: inclusive upper bound
+        h.observe(v)
+    assert h.counts == [1, 1, 1, 0]
+    h.observe(10.0000001)           # just past the last finite edge
+    h.observe(1e9)
+    assert h.counts[-1] == 2
+    h.observe(0.0)                  # zero falls in the first bucket
+    assert h.counts[0] == 2
+    assert h.n == 6 and sum(h.counts) == 6
+    # exposition stays cumulative and +Inf == count
+    lines = reg.to_prometheus().splitlines()
+    assert 'edge_seconds_bucket{le="10"} 4' in lines
+    assert 'edge_seconds_bucket{le="+Inf"} 6' in lines
+
+
+def test_histogram_quantiles_against_numpy():
+    """Satellite: bucket-interpolated p50/p95/p99 track NumPy's exact
+    percentiles of the same samples within a bucket width."""
+    import numpy as np
+
+    rng = np.random.default_rng(3)
+    edges = tuple(float(e) for e in np.linspace(5, 500, 100))
+    h = MetricsRegistry().histogram("q_seconds", "q", buckets=edges)
+    samples = rng.uniform(10.0, 400.0, size=5000)
+    for v in samples:
+        h.observe(float(v))
+    for q in (0.50, 0.95, 0.99):
+        got = h.quantile(q)
+        want = float(np.percentile(samples, q * 100))
+        width = edges[1] - edges[0]
+        assert abs(got - want) <= width, (q, got, want)
+    # known small sample, exact hand-check: 4 obs in (0, 10] buckets
+    h2 = MetricsRegistry().histogram("q2", "q", buckets=(10.0,))
+    for v in (1, 2, 3, 4):
+        h2.observe(v)
+    # all mass in the first bucket → linear ramp over (0, 10]
+    assert h2.quantile(0.5) == pytest.approx(5.0)
+    assert h2.quantile(1.0) == pytest.approx(10.0)
+    # +Inf clamp: everything past the last edge reports the last edge
+    h3 = MetricsRegistry().histogram("q3", "q", buckets=(1.0,))
+    h3.observe(100.0)
+    assert h3.quantile(0.99) == 1.0
+    assert h3.quantile(0.5) == 1.0
+    assert MetricsRegistry().histogram("q4", "q").quantile(0.5) is None
+    with pytest.raises(ValueError):
+        h3.quantile(1.5)
+
+
+def test_metrics_http_endpoint_serves_live_registry():
+    """--metrics-port acceptance: GET /metrics returns the CURRENT
+    registry in Prometheus text format while it keeps mutating."""
+    import urllib.request
+
+    from dgc_tpu.obs.httpd import MetricsHTTPServer
+
+    reg = MetricsRegistry()
+    reg.counter("live_total", "live").inc(3)
+    srv = MetricsHTTPServer(reg, port=0,
+                            health_fn=lambda: {"ready": True}).start()
+    try:
+        url = f"http://127.0.0.1:{srv.port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            body = resp.read().decode()
+            assert resp.headers["Content-Type"].startswith("text/plain")
+        assert "# TYPE live_total counter" in body
+        assert "live_total 3" in body
+        # live: a later scrape sees the mutation
+        reg.counter("live_total", "live").inc()
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert "live_total 4" in resp.read().decode()
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/healthz", timeout=10) as resp:
+            assert json.loads(resp.read()) == {"ready": True}
+        import urllib.error
+        with pytest.raises(urllib.error.HTTPError):
+            urllib.request.urlopen(
+                f"http://127.0.0.1:{srv.port}/nope", timeout=10)
+    finally:
+        srv.close()
+
+
 def test_metrics_registry_guards():
     reg = MetricsRegistry()
     reg.counter("x_total", "x").inc()

@@ -8,6 +8,7 @@ import os
 import subprocess
 import sys
 import threading
+import time
 
 import numpy as np
 import pytest
@@ -222,6 +223,91 @@ def test_slice_kernel_bit_identical_to_sweep_kernel():
         got = [np.asarray(a) for a in carry[6:]]
         for g_arr, w_arr in zip(got, want):
             assert np.array_equal(g_arr, w_arr), f"slice_steps={s}"
+
+
+def test_slice_kernel_timing_variant_bit_identical():
+    """The timing-compiled slice kernel (in-kernel clock accumulating
+    per-lane superstep µs into the carry's timing slots) returns result
+    slots byte-identical to the untimed kernel, and real lanes
+    accumulate positive device time."""
+    import numpy as np
+
+    from dgc_tpu.serve.batched import (T_US, batched_slice_kernel,
+                                       batched_sweep_kernel, idle_carry)
+
+    cls = ShapeClass(2048, 32)
+    graphs = [generate_random_graph_fast(700, avg_degree=8, seed=s)
+              for s in range(3)]
+    members = [pad_member(g, cls) for g in graphs] + [dummy_member(cls)]
+    comb = np.stack([m.comb for m in members])
+    degrees = np.stack([m.degrees for m in members])
+    k0 = np.array([m.k0 for m in members], np.int32)
+    max_steps = np.array([m.max_steps for m in members], np.int32)
+
+    want = [np.asarray(o) for o in batched_sweep_kernel(
+        comb, degrees, k0, max_steps, planes=cls.planes)]
+
+    carry = idle_carry(4, cls.v_pad)
+    reset = np.ones(4, np.int32)
+    for _ in range(1000):
+        carry = batched_slice_kernel(comb, degrees, k0, max_steps,
+                                     reset, carry, planes=cls.planes,
+                                     slice_steps=3, timing=True)
+        reset = np.zeros(4, np.int32)
+        if (np.asarray(carry[0]) >= 2).all():
+            break
+    else:
+        raise AssertionError("timed slice loop did not converge")
+    got = [np.asarray(a) for a in carry[6:13]]
+    for g_arr, w_arr in zip(got, want):
+        assert np.array_equal(g_arr, w_arr)
+    t_us = np.asarray(carry[T_US])
+    assert (t_us[:3] > 0).all()        # real lanes accumulated device µs
+    assert (t_us >= 0).all()
+
+
+def test_priced_slice_steps_and_measured_recalibration():
+    """The slice-size pricing rule on measured numbers, and the
+    scheduler's once-per-class recalibration: after recal_min_slices
+    full timed slices, resolved_slice_steps freezes to the re-priced
+    value and a slice_recalibrated event is emitted."""
+    from dgc_tpu.serve.batched import auto_slice_steps, priced_slice_steps
+    from dgc_tpu.serve.engine import BatchScheduler
+
+    # the pricing rule itself: overhead ≤ 1/8 of slice compute, clamped
+    assert priced_slice_steps(0.064, 0.008) == 64
+    assert priced_slice_steps(0.0001, 0.1) == 4        # lo clamp
+    assert priced_slice_steps(10.0, 0.001) == 64       # hi clamp
+    assert priced_slice_steps(0.01, 0.004) == 20
+    # auto_slice_steps delegates to it (model-fed)
+    assert auto_slice_steps(10_000, 1, "cpu") >= 4
+
+    cls = ShapeClass(2048, 32)
+    events = []
+    sched = BatchScheduler(timing=True, slice_steps=None,
+                           recal_min_slices=3,
+                           on_event=lambda k, r: events.append((k, r)))
+    s0 = sched.resolved_slice_steps(cls, 1)
+    # feed three measured (overhead, per-superstep) samples whose priced
+    # size differs from the model's
+    for _ in range(3):
+        sched._timing_sample(cls, overhead_s=0.050, iter_s=0.001)
+    s1 = sched.resolved_slice_steps(cls, 1)
+    assert s1 == priced_slice_steps(0.050, 0.001)
+    assert s1 != s0 or sched.stats["recals"] == 0
+    if s1 != s0:
+        assert sched.stats["recals"] == 1
+        [(kind, rec)] = [e for e in events if e[0] == "slice_recalibrated"]
+        assert rec["shape_class"] == cls.name
+        assert rec["to_steps"] == s1 and rec["samples"] == 3
+    # frozen: more samples never re-price
+    for _ in range(10):
+        sched._timing_sample(cls, overhead_s=0.001, iter_s=0.1)
+    assert sched.resolved_slice_steps(cls, 1) == s1
+    # an explicit slice_steps is never overridden
+    sched2 = BatchScheduler(timing=True, slice_steps=5, recal_min_slices=1)
+    sched2._timing_sample(cls, overhead_s=0.050, iter_s=0.001)
+    assert sched2.resolved_slice_steps(cls, 4) == 5
 
 
 def _serve_all(graphs, telemetry: bool, **fe_kwargs):
@@ -708,6 +794,104 @@ def test_serve_cli_warm_classes_and_modes(tmp_path):
     doc3 = json.loads((tmp_path / "m3.json").read_text())
     assert doc3["serve"]["summary"]["mode"] == "sync"
     assert doc3["serve"]["batches"]
+
+
+def test_serve_cli_metrics_port_and_kernel_timing(tmp_path):
+    """Acceptance: during a live ``dgc-tpu serve`` run an HTTP GET on
+    --metrics-port returns the current registry in Prometheus text
+    format including the per-class latency histograms; --kernel-timing
+    lands the sstep/overhead split in the slice events and the latency
+    summary in serve_summary."""
+    import io
+    import urllib.request
+
+    from dgc_tpu.serve.cli import serve_main
+
+    reqs = tmp_path / "requests.jsonl"
+    reqs.write_text("\n".join(
+        json.dumps({"id": i, "node_count": 800, "max_degree": 8,
+                    "seed": i, "gen_method": "fast"})
+        for i in range(8)) + "\n")
+    log = tmp_path / "run.jsonl"
+    manifest = tmp_path / "manifest.json"
+    rc_box = {}
+    # the CLI's echo logger binds sys.stdout at construction — point it
+    # at a plain buffer so the background replay never races pytest's
+    # per-test capture teardown
+    quiet = io.StringIO()
+
+    def run():
+        rc_box["rc"] = serve_main([
+            "--requests", str(reqs),
+            "--results", str(tmp_path / "results.jsonl"),
+            "--log-json", str(log), "--run-manifest", str(manifest),
+            "--batch-max", "2", "--window-ms", "10",
+            "--slice-steps", "1", "--kernel-timing",
+            "--metrics-port", "0", "--no-validate"])
+
+    was_stdout, sys.stdout = sys.stdout, quiet
+    try:
+        t = threading.Thread(target=run)
+        t.start()
+        # the CLI logs the bound ephemeral port as a metrics_server event
+        port = None
+        deadline = time.perf_counter() + 120
+        while port is None and time.perf_counter() < deadline:
+            if log.exists():
+                for line in log.read_text().splitlines():
+                    try:
+                        rec = json.loads(line)
+                    except json.JSONDecodeError:
+                        continue
+                    if rec.get("event") == "metrics_server":
+                        port = rec["port"]
+                        break
+            time.sleep(0.05)
+        assert port, "metrics_server event never appeared"
+        # live scrapes while the replay runs: every GET returns the
+        # CURRENT registry; once requests start completing the per-class
+        # latency histograms appear in the exposition
+        url = f"http://127.0.0.1:{port}/metrics"
+        with urllib.request.urlopen(url, timeout=10) as resp:
+            assert resp.status == 200
+            assert resp.headers["Content-Type"].startswith("text/plain")
+        saw_histograms = False
+        while t.is_alive() and not saw_histograms:
+            try:
+                with urllib.request.urlopen(url, timeout=10) as resp:
+                    body = resp.read().decode()
+            except OSError:   # server already closed: replay finished
+                break
+            saw_histograms = "dgc_serve_service_seconds_bucket" in body
+            if not saw_histograms:
+                time.sleep(0.05)
+        assert saw_histograms, \
+            "live scrape never showed the latency histograms"
+        t.join(timeout=600)
+        assert not t.is_alive(), "serve replay did not finish"
+    finally:
+        sys.stdout = was_stdout
+    assert rc_box.get("rc") == 0
+
+    # post-hoc: the scrape-visible registry carried the per-class
+    # latency histograms by run end (the request histograms fill as
+    # requests complete; the live scrape above may predate the first)
+    doc = json.loads(manifest.read_text())
+    assert any(k.startswith("dgc_serve_service_seconds")
+               for k in doc["metrics"])
+    summary = doc["serve"]["summary"]
+    assert summary["latency_ms"], "per-class latency summary missing"
+    for cls, lm in summary["latency_ms"].items():
+        assert lm["p50"] <= lm["p95"] <= lm["p99"]
+    # kernel timing: the slice events carry the sstep/overhead split
+    timed = [s for s in doc["serve"]["slices"]
+             if s.get("sstep_ms") is not None]
+    assert timed and all(s["overhead_ms"] >= 0 for s in timed)
+    # and the log (spans included) is schema- and structure-clean
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from validate_runlog import validate_file
+
+    assert validate_file(str(log)) == []
 
 
 def test_serve_cli_bad_request_file(tmp_path):

@@ -1,0 +1,149 @@
+"""SLO gate (tools/slo_check.py): percentile estimator, the
+manifest-based gate (pass / injected violation → nonzero), the bench
+tripwire rule, and the metrics-histogram fallback. Tier-1 smoke."""
+
+import json
+import sys
+
+import numpy as np
+import pytest
+
+sys.path.insert(0, "tools")
+
+import slo_check  # noqa: E402
+
+
+def test_percentile_matches_numpy_linear_interpolation():
+    rng = np.random.default_rng(7)
+    for n in (1, 2, 5, 100):
+        xs = rng.uniform(0, 500, size=n).tolist()
+        for q in (0.0, 0.5, 0.95, 0.99, 1.0):
+            got = slo_check.percentile(xs, q)
+            want = float(np.percentile(xs, q * 100))
+            assert got == pytest.approx(want, rel=1e-12), (n, q)
+    assert slo_check.percentile([], 0.5) is None
+
+
+def _serve_doc(service_ms, queue_ms=None, cls="v2048w16",
+               gps=1.5, failed=0):
+    queue_ms = queue_ms if queue_ms is not None else [1.0] * len(service_ms)
+    reqs = [{"request_id": i, "status": "ok", "service_ms": s,
+             "queue_ms": q, "shape_class": cls}
+            for i, (s, q) in enumerate(zip(service_ms, queue_ms))]
+    return {
+        "manifest_version": 1,
+        "serve": {
+            "requests": reqs,
+            "summary": {"requests": len(reqs), "completed": len(reqs) - failed,
+                        "failed": failed, "graphs_per_s": gps},
+        },
+    }
+
+
+def test_check_serve_doc_passes_and_catches_violations():
+    doc = _serve_doc([10.0, 20.0, 30.0, 40.0], gps=2.0)
+    ok = {"service_ms": {"p50": 100}, "queue_ms": {"p95": 50},
+          "graphs_per_s_min": 1.0, "failure_rate_max": 0.0}
+    assert slo_check.check_serve_doc(doc, ok) == []
+
+    # p95 violation
+    v = slo_check.check_serve_doc(doc, {"service_ms": {"p95": 20}})
+    assert len(v) == 1 and "p95" in v[0] and "service_ms" in v[0]
+
+    # throughput + failure-rate violations
+    doc_bad = _serve_doc([10.0], gps=0.2, failed=1)
+    v = slo_check.check_serve_doc(
+        doc_bad, {"graphs_per_s_min": 1.0, "failure_rate_max": 0.0})
+    assert any("throughput" in x for x in v)
+    assert any("failure rate" in x for x in v)
+
+    # per-class gate only sees its class
+    doc2 = _serve_doc([500.0] * 4, cls="v8192w64")
+    v = slo_check.check_serve_doc(
+        doc2, {"classes": {"v8192w64": {"service_ms": {"p50": 100}}}})
+    assert len(v) == 1 and "class v8192w64" in v[0]
+    assert slo_check.check_serve_doc(
+        doc2, {"classes": {"v2048w16": {"service_ms": {"p50": 100}}}})
+    # (thresholds over a class with no samples are themselves a finding)
+
+    # unknown quantile names are reported, not silently skipped
+    v = slo_check.check_serve_doc(doc, {"service_ms": {"p42": 1}})
+    assert any("unknown quantile" in x for x in v)
+
+
+def test_histogram_fallback_when_no_request_list():
+    # manifest without serve.requests: gate over the metrics snapshot's
+    # bucket counts (bucket-midpoint expansion)
+    doc = {
+        "manifest_version": 1,
+        "serve": {"requests": [], "summary": {}},
+        "metrics": {
+            'dgc_serve_service_seconds{shape_class="v2048w16"}': {
+                "kind": "histogram", "sum": 1.0, "count": 4,
+                "buckets": {"0.01": 2, "0.1": 2}, "inf": 0},
+        },
+    }
+    assert slo_check.check_serve_doc(doc, {"service_ms": {"p95": 100}}) == []
+    v = slo_check.check_serve_doc(doc, {"service_ms": {"p95": 20}})
+    assert len(v) == 1 and "p95" in v[0]
+
+
+def test_slo_check_cli_gate(tmp_path, capsys):
+    """The tier-1 smoke the ISSUE asks for: clean run passes (rc 0), an
+    injected violation exits nonzero (rc 1), bad inputs rc 2."""
+    manifest = tmp_path / "run.json"
+    manifest.write_text(json.dumps(_serve_doc([10.0, 15.0, 20.0], gps=3.0)))
+
+    ok = tmp_path / "ok.json"
+    ok.write_text(json.dumps({"service_ms": {"p99": 100},
+                              "graphs_per_s_min": 1.0}))
+    assert slo_check.main([str(manifest), "--thresholds", str(ok)]) == 0
+    assert "SLO PASS" in capsys.readouterr().out
+
+    bad = tmp_path / "bad.json"
+    bad.write_text(json.dumps({"service_ms": {"p50": 5},
+                               "graphs_per_s_min": 99.0}))
+    assert slo_check.main([str(manifest), "--thresholds", str(bad)]) == 1
+    err = capsys.readouterr().err
+    assert err.count("SLO VIOLATION") == 2
+
+    assert slo_check.main([str(tmp_path / "missing.json"),
+                           "--thresholds", str(ok)]) == 2
+    notjson = tmp_path / "notjson"
+    notjson.write_text("[1, 2]")
+    assert slo_check.main([str(manifest), "--thresholds",
+                           str(notjson)]) == 2
+
+
+def test_slo_check_reads_jsonl_runlog(tmp_path):
+    # the JSONL form replays through RunManifest (report_run convention),
+    # tolerating a torn tail
+    log = tmp_path / "run.jsonl"
+    events = [
+        {"t": 0.1, "event": "serve_request", "request_id": 1,
+         "status": "ok", "queue_ms": 1.0, "service_ms": 12.0,
+         "shape_class": "v2048w16"},
+        {"t": 0.2, "event": "serve_summary", "requests": 1, "completed": 1,
+         "failed": 0, "wall_s": 0.5, "graphs_per_s": 2.0},
+    ]
+    log.write_text("\n".join(json.dumps(e) for e in events)
+                   + "\n" + '{"torn')
+    th = tmp_path / "th.json"
+    th.write_text(json.dumps({"service_ms": {"p50": 100},
+                              "graphs_per_s_min": 1.0}))
+    assert slo_check.main([str(log), "--thresholds", str(th)]) == 0
+    th.write_text(json.dumps({"graphs_per_s_min": 10.0}))
+    assert slo_check.main([str(log), "--thresholds", str(th)]) == 1
+
+
+def test_check_bench_record_tripwire():
+    rec = {"value": 1.2, "speedup_vs_sequential": 6.5}
+    assert slo_check.check_bench_record(
+        rec, {"graphs_per_s_min": 1.0,
+              "speedup_vs_sequential_min": 3.0}) == []
+    v = slo_check.check_bench_record(
+        rec, {"graphs_per_s_min": 2.0, "speedup_vs_sequential_min": 8.0})
+    assert len(v) == 2
+    v = slo_check.check_bench_record(
+        {"value": 1.0}, {"speedup_vs_sequential_min": 3.0})
+    assert len(v) == 1 and "no speedup" in v[0]

@@ -1,0 +1,327 @@
+"""Request-scoped tracing (dgc_tpu.obs.trace): span model, run-log
+structural validation, Perfetto export, and serve-path propagation —
+every submit yields exactly one closed span tree, across recycle
+boundaries, with the full telemetry stack byte-inert on results."""
+
+import io
+import json
+import sys
+
+import numpy as np
+import pytest
+
+from dgc_tpu.obs.events import RunLogger
+from dgc_tpu.obs.schema import validate_record
+from dgc_tpu.obs.trace import NULL_TRACER, Tracer, tracer_for
+
+sys.path.insert(0, "tools")
+
+
+def _collect_tracer():
+    records = []
+
+    def emit(kind, **fields):
+        records.append({"t": 0.0, "event": kind, **fields})
+
+    return Tracer(emit), records
+
+
+# ---------------------------------------------------------------- tracer
+
+def test_span_begin_end_emits_schema_clean_records():
+    tracer, records = _collect_tracer()
+    root = tracer.begin("request", trace="req-1", attrs={"v": 10})
+    child = tracer.begin("queue", parent=root)
+    child.end()
+    root.end({"status": "ok"})
+    assert [r["ph"] for r in records] == ["B", "B", "E", "E"]
+    for rec in records:
+        assert validate_record(rec) == [], rec
+    b_root, b_child, e_child, e_root = records
+    assert b_root["trace"] == b_child["trace"] == "req-1"
+    assert b_child["parent"] == b_root["span"]
+    assert b_root["parent"] is None
+    assert b_root["attrs"] == {"v": 10}
+    assert e_root["attrs"] == {"status": "ok"}
+    # µs clocks are monotone over the emission order
+    ts = [r["ts_us"] for r in records]
+    assert ts == sorted(ts)
+
+
+def test_span_end_is_idempotent_and_ids_unique():
+    tracer, records = _collect_tracer()
+    spans = [tracer.begin(f"s{i}", trace="t") for i in range(5)]
+    for s in spans:
+        s.end()
+        s.end()   # second end must not emit
+    assert sum(1 for r in records if r["ph"] == "E") == 5
+    assert len({r["span"] for r in records}) == 5
+
+
+def test_thread_local_current_span_propagation():
+    import threading
+
+    tracer, _ = _collect_tracer()
+    outer = tracer.begin("outer", trace="t")
+    tracer.push(outer)
+    assert tracer.current() is outer
+    # a child begun with no explicit parent inherits the current span
+    child = tracer.begin("child")
+    assert child.parent == outer.span_id and child.trace == "t"
+    # other threads see their own (empty) stack
+    seen = {}
+
+    def worker():
+        seen["current"] = tracer.current()
+
+    t = threading.Thread(target=worker)
+    t.start()
+    t.join()
+    assert seen["current"] is None
+    tracer.pop(outer)
+    assert tracer.current() is None
+
+
+def test_null_tracer_is_inert():
+    span = NULL_TRACER.begin("anything", trace="x", attrs={"a": 1})
+    span.end({"b": 2})
+    NULL_TRACER.push(span)
+    assert NULL_TRACER.current() is None
+    assert not NULL_TRACER.enabled
+    assert tracer_for(None) is NULL_TRACER
+
+
+def test_context_manager_form():
+    tracer, records = _collect_tracer()
+    with tracer.begin("step", trace="t"):
+        assert tracer.current() is not None
+    assert [r["ph"] for r in records] == ["B", "E"]
+    assert tracer.current() is None
+
+
+# ------------------------------------------- validator: span structure
+
+def _span(ph, trace, span, name="s", parent=None, ts=0):
+    return json.dumps({"t": 0.0, "event": "span", "name": name, "ph": ph,
+                       "trace": trace, "span": span, "parent": parent,
+                       "ts_us": ts, "attrs": None})
+
+
+def test_validate_runlog_span_structure(tmp_path):
+    from validate_runlog import validate_file
+
+    good = tmp_path / "good.jsonl"
+    good.write_text("\n".join([
+        _span("B", "req-1", "s1", "request"),
+        _span("B", "req-1", "s2", "queue", parent="s1"),
+        _span("E", "req-1", "s2", "queue"),
+        _span("E", "req-1", "s1", "request"),
+    ]) + "\n")
+    assert validate_file(str(good)) == []
+
+    # parent-before-child: child begins before its parent exists
+    orphan = tmp_path / "orphan.jsonl"
+    orphan.write_text("\n".join([
+        _span("B", "req-1", "s2", "queue", parent="s1"),
+        _span("E", "req-1", "s2", "queue"),
+    ]) + "\n")
+    assert any("before its parent" in p for p in validate_file(str(orphan)))
+
+    # every opened span must close
+    unclosed = tmp_path / "unclosed.jsonl"
+    unclosed.write_text(_span("B", "req-1", "s1", "request") + "\n")
+    assert any("never closed" in p for p in validate_file(str(unclosed)))
+
+    # end without begin / double begin / double end
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text("\n".join([
+        _span("E", "req-1", "sX"),
+        _span("B", "req-1", "s1"),
+        _span("B", "req-1", "s1"),
+        _span("E", "req-1", "s1"),
+        _span("E", "req-1", "s1"),
+    ]) + "\n")
+    problems = validate_file(str(bad))
+    assert any("ends without a begin" in p for p in problems)
+    assert any("begun twice" in p for p in problems)
+    assert any("ended twice" in p for p in problems)
+
+    # unknown span fields are schema-rejected (satellite contract)
+    rec = json.loads(_span("B", "req-1", "s1"))
+    rec["lane_id"] = 3
+    assert any("unknown field" in p for p in validate_record(rec))
+
+
+def test_validate_runlog_tolerates_torn_tail(tmp_path):
+    from validate_runlog import validate_file
+
+    log = tmp_path / "torn.jsonl"
+    # a live log caught mid-write: complete line + torn tail, no newline
+    log.write_text(
+        json.dumps({"t": 0.0, "event": "sweep_failed", "initial_k": 3})
+        + "\n" + '{"t": 1.0, "event": "span", "na')
+    assert validate_file(str(log)) == []
+    # the same torn text WITH a trailing newline is a real error
+    log.write_text(
+        json.dumps({"t": 0.0, "event": "sweep_failed", "initial_k": 3})
+        + "\n" + '{"t": 1.0, "event": "span", "na\n')
+    assert any("unparseable" in p for p in validate_file(str(log)))
+
+
+# -------------------------------------------------------- export_trace
+
+def test_export_trace_pairs_and_filters(tmp_path, capsys):
+    import export_trace
+
+    log = tmp_path / "run.jsonl"
+    log.write_text("\n".join([
+        _span("B", "req-1", "s1", "request", ts=100),
+        _span("B", "req-1", "s2", "queue", parent="s1", ts=110),
+        _span("E", "req-1", "s2", "queue", ts=150),
+        _span("B", "req-2", "s3", "request", ts=120),
+        _span("E", "req-1", "s1", "request", ts=200),
+        # req-2's request span never closes (crashed producer)
+    ]) + "\n")
+    out = tmp_path / "trace.json"
+    assert export_trace.main([str(log), "-o", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert {e["name"] for e in xs} == {"request", "queue"}
+    req1 = [e for e in xs if e["args"].get("span") == "s1"][0]
+    assert req1["ts"] == 100 and req1["dur"] == 100
+    q = [e for e in xs if e["name"] == "queue"][0]
+    assert q["args"]["parent"] == "s1"
+    unclosed = [e for e in xs if e["args"].get("unclosed")]
+    assert len(unclosed) == 1 and unclosed[0]["args"]["span"] == "s3"
+    # two traces → two process tracks, with name metadata
+    pids = {e["pid"] for e in doc["traceEvents"] if e["ph"] == "X"}
+    assert len(pids) == 2
+    names = {e["args"]["name"] for e in doc["traceEvents"]
+             if e["ph"] == "M"}
+    assert names == {"req-1", "req-2"}
+
+    # --trace filter: only req-1 spans
+    assert export_trace.main([str(log), "--trace", "req-1",
+                              "-o", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert all(e["args"].get("span") in ("s1", "s2")
+               for e in doc["traceEvents"] if e["ph"] == "X")
+
+    # a log with no spans is reported, rc 1
+    empty = tmp_path / "empty.jsonl"
+    empty.write_text(json.dumps(
+        {"t": 0.0, "event": "sweep_failed", "initial_k": 3}) + "\n")
+    assert export_trace.main([str(empty)]) == 1
+
+
+# ------------------------------------------------- serve-path propagation
+
+@pytest.mark.serve
+def test_every_submit_yields_one_closed_span_tree(tmp_path):
+    """slice_steps=1 worst case: every superstep is a recycle boundary,
+    so lane spans cross the maximum number of slices — each request must
+    still produce exactly one closed, well-parented span tree."""
+    from validate_runlog import validate_file
+
+    from dgc_tpu.models.generators import generate_random_graph_fast
+    from dgc_tpu.obs import MetricsRegistry
+    from dgc_tpu.serve.queue import ServeFrontEnd
+
+    log = tmp_path / "serve.jsonl"
+    logger = RunLogger(jsonl_path=str(log), stream=io.StringIO(),
+                       echo=False)
+    fe = ServeFrontEnd(batch_max=4, window_s=0.02, mode="continuous",
+                       slice_steps=1, timing=True,
+                       logger=logger, registry=MetricsRegistry()).start()
+    graphs = [generate_random_graph_fast(1200, avg_degree=6, seed=s)
+              for s in range(5)]
+    tickets = [fe.submit(g, request_id=i) for i, g in enumerate(graphs)]
+    results = [t.result(timeout=300) for t in tickets]
+    fe.shutdown()
+    logger.close()
+    assert all(r.ok for r in results)
+
+    # structural validation over the real log (drift guard wiring)
+    assert validate_file(str(log)) == []
+
+    spans = [json.loads(l) for l in log.read_text().splitlines()
+             if '"span"' in l]
+    spans = [s for s in spans if s.get("event") == "span"]
+    by_trace: dict = {}
+    for s in spans:
+        by_trace.setdefault(s["trace"], []).append(s)
+    # one request trace per submit, plus the scheduler's own track
+    req_traces = {t for t in by_trace if t.startswith("req-")}
+    assert req_traces == {f"req-{i}" for i in range(5)}
+    for i in range(5):
+        recs = by_trace[f"req-{i}"]
+        begins = {s["span"]: s for s in recs if s["ph"] == "B"}
+        ends = {s["span"] for s in recs if s["ph"] == "E"}
+        assert set(begins) == ends, f"req-{i}: unclosed spans"
+        names = [s["name"] for s in recs if s["ph"] == "B"]
+        # exactly one root, and the batched path's full lifecycle
+        roots = [s for s in begins.values() if s["parent"] is None]
+        assert len(roots) == 1 and roots[0]["name"] == "request"
+        assert names.count("request") == 1
+        for expected in ("queue", "serve", "sweep", "lane"):
+            assert expected in names, f"req-{i}: missing {expected} span"
+        # parentage chains to the root
+        for s in begins.values():
+            hops = 0
+            cur = s
+            while cur["parent"] is not None:
+                cur = begins[cur["parent"]]
+                hops += 1
+                assert hops < 10
+    # scheduler slice spans share the dedicated track
+    assert any(s["name"] == "slice" for s in by_trace.get("sched", []))
+
+    # export is Perfetto-loadable JSON with one track per request
+    import export_trace
+
+    out = tmp_path / "trace.json"
+    assert export_trace.main([str(log), "-o", str(out)]) == 0
+    doc = json.loads(out.read_text())
+    assert len({e["pid"] for e in doc["traceEvents"]}) >= 6
+    assert all(not e["args"].get("unclosed")
+               for e in doc["traceEvents"] if e["ph"] == "X")
+
+
+@pytest.mark.serve
+def test_full_telemetry_stack_is_result_inert(tmp_path):
+    """Tracing + in-kernel timing + events on vs everything off: colors,
+    minimal counts, and attempt sequences byte-identical (the serve
+    parity contract extended to the PR 7 stack)."""
+    from dgc_tpu.models.generators import generate_random_graph_fast
+    from dgc_tpu.obs import MetricsRegistry
+    from dgc_tpu.serve.queue import ServeFrontEnd
+
+    graphs = [generate_random_graph_fast(1200, avg_degree=6, seed=40 + s)
+              for s in range(4)]
+
+    def run(telemetry: bool):
+        logger = registry = None
+        if telemetry:
+            logger = RunLogger(jsonl_path=str(tmp_path / "t.jsonl"),
+                               stream=io.StringIO(), echo=False)
+            registry = MetricsRegistry()
+        fe = ServeFrontEnd(batch_max=4, window_s=0.02, mode="continuous",
+                           slice_steps=2, timing=telemetry,
+                           trace=telemetry, logger=logger,
+                           registry=registry).start()
+        try:
+            tickets = [fe.submit(g, request_id=i)
+                       for i, g in enumerate(graphs)]
+            return [t.result(timeout=300) for t in tickets]
+        finally:
+            fe.shutdown()
+            if logger is not None:
+                logger.close()
+
+    with_obs = run(True)
+    without = run(False)
+    for a, b in zip(with_obs, without):
+        assert a.ok and b.ok
+        assert a.minimal_colors == b.minimal_colors
+        assert np.array_equal(a.colors, b.colors)
+        assert a.attempts == b.attempts

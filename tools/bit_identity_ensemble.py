@@ -82,6 +82,11 @@ def serve_mode(args) -> int:
         draws.append((i, seed, gen, g))
 
     def run_front_end(telemetry: bool):
+        # telemetry=True runs the FULL observability stack — JSONL event
+        # stream, metrics registry, request-scoped span tracing, and the
+        # slice kernels' in-kernel timing variant — so the
+        # telemetry_inert check locks colors/attempts byte-identical
+        # with all of it on vs all of it off (the PR 7 acceptance bar)
         logger = registry = None
         if telemetry:
             import io
@@ -94,6 +99,7 @@ def serve_mode(args) -> int:
                            slice_steps=(args.serve_slice_steps
                                         if args.serve_mode == "continuous"
                                         else None),
+                           timing=telemetry, trace=telemetry,
                            logger=logger, registry=registry).start()
         try:
             tickets = [fe.submit(g.arrays if hasattr(g, "arrays") else g,
@@ -156,7 +162,8 @@ def serve_mode(args) -> int:
                                 if args.serve_mode == "continuous"
                                 else None),
                    recycles=stats_obs.get("recycles", 0),
-                   slices=stats_obs.get("slices", 0))
+                   slices=stats_obs.get("slices", 0),
+                   telemetry="events+metrics+trace+kernel_timing")
     print(json.dumps(summary))
     if out:
         out.write(json.dumps(summary) + "\n")

@@ -81,6 +81,23 @@ if [ "$battery_rc" -ne 2 ]; then
     --serve-modes continuous,sync 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
+  # in-kernel timing column cross-check (PR 7, obs.devclock): the same
+  # 200k-RMAT attempt run twice — once with --superstep-timing recording
+  # the trajectory buffer's col-5 device wall-time, once through the
+  # host-stepped trace_attempt xplane capture above — so the timing
+  # column's per-superstep µs can be compared against the XPlane op
+  # self-times (trace_attr_r4.jsonl). Expected: the column's total
+  # in-kernel ms ≈ the xplane device self-time sum within the callback
+  # hop overhead; a large gap means the TPU timing path needs the native
+  # cycle-counter primitive before the column's absolute values are
+  # trusted on-chip (CPU values are exact either way).
+  echo "=== timing-column vs xplane self-time (200k RMAT) ===" | tee -a /dev/stderr >/dev/null
+  timeout 3600 python -m dgc_tpu.cli --node-count 200000 --max-degree 64 \
+    --gen-method rmat --seed 7 --backend ell-compact \
+    --output-coloring /tmp/dgc_timing_xcheck.json \
+    --run-manifest timing_xcheck_r7.json --superstep-timing 2>&1 \
+    | tee -a /dev/stderr >/dev/null || true
+
   echo "=== tuned-vs-static A/B (1M RMAT) ===" | tee -a /dev/stderr >/dev/null
   timeout 7200 python bench.py --gen rmat --nodes 1000000 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true

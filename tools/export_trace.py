@@ -1,0 +1,139 @@
+#!/usr/bin/env python
+"""Export a run log's span events to Perfetto-loadable chrome-trace JSON.
+
+Reads a JSONL run log (``--log-json``) containing ``span`` events
+(``dgc_tpu.obs.trace``) and writes the Chrome Trace Event Format JSON
+that https://ui.perfetto.dev (and chrome://tracing) load directly: one
+process track per trace id — so one request's whole life (queue wait,
+worker service, batched sweep, lane seating, every recycle boundary) is
+one clickable trace, with the scheduler's ``slice``/``batch`` spans on
+their own ``sched`` track aligned on the same clock.
+
+Begin/end pairs become complete ("X") events; a span whose end never
+arrived (a crashed or still-running producer) is emitted with zero
+duration and ``args.unclosed = true`` so it is visible, not dropped.
+Torn trailing lines (a live log mid-write) are tolerated.
+
+Usage:
+    python tools/export_trace.py RUN.jsonl -o trace.json
+    python tools/export_trace.py RUN.jsonl --trace req-7   # one request
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import sys
+
+
+def read_spans(path: str) -> list[dict]:
+    """All span events in one JSONL log, in file order; the final line
+    may be torn (no newline yet) and is ignored if unparseable."""
+    spans = []
+    with open(path) as fh:
+        raw = fh.read()
+    lines = raw.split("\n")
+    torn_tail = not raw.endswith("\n")
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if torn_tail and i == len(lines) - 1:
+                continue
+            raise ValueError(f"{path}:{i + 1}: unparseable JSON line")
+        if isinstance(rec, dict) and rec.get("event") == "span":
+            spans.append(rec)
+    return spans
+
+
+def to_chrome_trace(spans: list[dict], trace_filter: str | None = None) -> dict:
+    """Pair B/E records into complete events; one pid per trace id.
+
+    Within a trace every span goes on tid 1 — request spans are properly
+    nested by construction (request ⊃ queue/serve ⊃ sweep ⊃ lane), which
+    is exactly the containment Perfetto stacks slices by."""
+    open_spans: dict = {}    # (trace, span) -> begin record
+    events: list = []
+    pids: dict = {}          # trace id -> pid
+
+    def pid_for(trace: str) -> int:
+        if trace not in pids:
+            pids[trace] = len(pids) + 1
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pids[trace], "tid": 0,
+                           "args": {"name": trace}})
+        return pids[trace]
+
+    for rec in spans:
+        trace = rec.get("trace")
+        if trace_filter is not None and trace != trace_filter:
+            continue
+        key = (trace, rec.get("span"))
+        if rec.get("ph") == "B":
+            open_spans[key] = rec
+        elif rec.get("ph") == "E":
+            begin = open_spans.pop(key, None)
+            if begin is None:
+                continue   # end without begin: validator territory
+            args = dict(begin.get("attrs") or {})
+            args.update(rec.get("attrs") or {})
+            args["span"] = rec.get("span")
+            if begin.get("parent"):
+                args["parent"] = begin["parent"]
+            events.append({
+                "ph": "X", "name": begin.get("name", "?"), "cat": "dgc",
+                "pid": pid_for(trace), "tid": 1,
+                "ts": begin.get("ts_us", 0),
+                "dur": max(0, rec.get("ts_us", 0) - begin.get("ts_us", 0)),
+                "args": args,
+            })
+    for (trace, span_id), begin in open_spans.items():
+        args = dict(begin.get("attrs") or {})
+        args.update(span=span_id, unclosed=True)
+        events.append({
+            "ph": "X", "name": begin.get("name", "?"), "cat": "dgc",
+            "pid": pid_for(trace), "tid": 1,
+            "ts": begin.get("ts_us", 0), "dur": 0, "args": args,
+        })
+    events.sort(key=lambda e: (e["pid"], e.get("ts", 0)))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("path", help="JSONL run log with span events")
+    p.add_argument("-o", "--out", default=None,
+                   help="output trace JSON (default: stdout)")
+    p.add_argument("--trace", default=None, metavar="TRACE_ID",
+                   help="export only this trace (e.g. req-7)")
+    args = p.parse_args(argv)
+    try:
+        spans = read_spans(args.path)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if not spans:
+        print(f"{args.path}: no span events (tracing off, or not a serve "
+              f"log?)", file=sys.stderr)
+        return 1
+    doc = to_chrome_trace(spans, trace_filter=args.trace)
+    if not doc["traceEvents"]:
+        print(f"--trace {args.trace}: no matching spans", file=sys.stderr)
+        return 1
+    out = json.dumps(doc, indent=1)
+    if args.out:
+        with open(args.out, "w") as fh:
+            fh.write(out + "\n")
+        n = sum(1 for e in doc["traceEvents"] if e["ph"] == "X")
+        print(f"{args.out}: {n} span(s), "
+              f"{len({e['pid'] for e in doc['traceEvents']})} track(s)")
+    else:
+        print(out)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -110,6 +110,13 @@ def render(doc: dict, width: int = 48) -> str:
                 # unconfirmed neighbors any gathered row saw
                 add(f"{'':>38}max unconfirmed nbrs: "
                     f"peak {max(mu)} final {mu[-1]}")
+            su = [c for c in (traj.get("step_us") or []) if c >= 0]
+            if su:
+                # the in-kernel timing column (obs.kernel col 5):
+                # per-superstep wall µs measured inside the while loop
+                add(f"{'':>38}device time/superstep: "
+                    f"mean {sum(su) / len(su):.0f} µs max {max(su)} µs "
+                    f"(in-kernel total {sum(su) / 1e3:.1f} ms)")
 
     sv = doc.get("serve")
     if sv:
@@ -136,6 +143,21 @@ def render(doc: dict, width: int = 48) -> str:
                 f"{sum(1 for s in slices if s.get('compile_cache') == 'miss')}"
                 f" compile miss(es))")
             add(f"  occupancy/slice: {sparkline(occ, width)}")
+            ss = [s["sstep_ms"] for s in slices
+                  if s.get("sstep_ms") is not None]
+            ov = [s["overhead_ms"] for s in slices
+                  if s.get("overhead_ms") is not None]
+            if ss:
+                # in-kernel timing split (slice kernel timing slots):
+                # superstep compute vs dispatch overhead per slice
+                add(f"  timing/slice: superstep {sum(ss) / len(ss):.1f} ms, "
+                    f"dispatch overhead {sum(ov) / len(ov):.1f} ms "
+                    f"(mean over {len(ss)} timed slice(s))")
+        for rc_ in sv.get("recalibrations") or []:
+            add(f"  slice recalibrated: {rc_.get('shape_class')} "
+                f"{rc_.get('from_steps')} -> {rc_.get('to_steps')} steps "
+                f"(measured overhead {rc_.get('overhead_ms')} ms, "
+                f"superstep {rc_.get('sstep_ms')} ms)")
         batches = sv.get("batches") or []
         if batches:
             occ = [b.get("occupancy", 0) for b in batches]
@@ -159,8 +181,17 @@ def render(doc: dict, width: int = 48) -> str:
             p = lambda xs, f: xs[min(len(xs) - 1, int(f * len(xs)))]
             add(f"  requests: {len(reqs)} "
                 f"(service p50 {p(lat, .5):.1f} ms, p95 {p(lat, .95):.1f} "
-                f"ms; queue p95 {p(q, .95):.1f} ms)")
+                f"ms, p99 {p(lat, .99):.1f} ms; "
+                f"queue p95 {p(q, .95):.1f} ms)")
         summ = sv.get("summary")
+        if summ and summ.get("latency_ms"):
+            # the SLO layer's per-shape-class histogram summary
+            # (serve_summary.latency_ms, bucket-interpolated quantiles)
+            for cls in sorted(summ["latency_ms"]):
+                lm = summ["latency_ms"][cls]
+                add(f"  slo {cls}: p50 {lm.get('p50')} ms, "
+                    f"p95 {lm.get('p95')} ms, p99 {lm.get('p99')} ms "
+                    f"({lm.get('count')} request(s))")
         if summ:
             gps = summ.get("graphs_per_s")
             add(f"  summary: {summ.get('completed')}/{summ.get('requests')} "

@@ -1,0 +1,238 @@
+#!/usr/bin/env python
+"""SLO gate: check a serve run's artifact against a thresholds file.
+
+Exit 0 when every objective holds, 1 on any violation (each printed to
+stderr), 2 on usage/load errors — the perf-regression tripwire
+``bench.py --serve-modes --slo-thresholds`` and the evidence suite run
+against the committed BENCH/BASELINE trajectory.
+
+Input: a run manifest (``--run-manifest``) or a raw JSONL run log
+(replayed through the same ``RunManifest`` sink, the ``report_run``
+convention). Latency percentiles are computed from the manifest's exact
+per-request records (``serve.requests[*].service_ms/queue_ms``,
+linear-interpolated — the same estimator NumPy's default percentile
+uses), falling back to the metrics snapshot's bucket-interpolated
+histograms when the request list is absent.
+
+Thresholds file (JSON; every key optional — absent means unchecked):
+
+    {
+      "service_ms": {"p50": 100, "p95": 250, "p99": 400},
+      "queue_ms":   {"p95": 50},
+      "graphs_per_s_min": 0.5,
+      "failure_rate_max": 0.0,
+      "classes": {"v32768w64": {"service_ms": {"p95": 300}}}
+    }
+
+Top-level ``service_ms``/``queue_ms`` gate the whole request population;
+``classes`` adds per-shape-class gates over that class's requests.
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dgc_tpu.obs.manifest import RunManifest, load_manifest  # noqa: E402
+
+_QUANTS = {"p50": 0.50, "p95": 0.95, "p99": 0.99}
+
+
+def percentile(values: list, q: float) -> float | None:
+    """Linear-interpolated percentile of a sample (NumPy's default
+    method, dependency-free)."""
+    if not values:
+        return None
+    xs = sorted(float(v) for v in values)
+    if len(xs) == 1:
+        return xs[0]
+    pos = q * (len(xs) - 1)
+    lo = int(pos)
+    hi = min(lo + 1, len(xs) - 1)
+    return xs[lo] + (xs[hi] - xs[lo]) * (pos - lo)
+
+
+def load_doc(path: str) -> dict:
+    if path.endswith(".jsonl"):
+        manifest = RunManifest()
+        with open(path) as fh:
+            raw = fh.read()
+        lines = raw.split("\n")
+        torn_tail = not raw.endswith("\n")
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                manifest(json.loads(line))
+            except json.JSONDecodeError:
+                if torn_tail and i == len(lines) - 1:
+                    continue   # live log mid-write
+                raise
+        return manifest.doc
+    return load_manifest(path)
+
+
+def _gate_latencies(violations: list, label: str, thresholds: dict,
+                    samples: dict) -> None:
+    """Check {metric: {pXX: limit}} thresholds against {metric: values}."""
+    for metric in ("service_ms", "queue_ms"):
+        limits = thresholds.get(metric)
+        if not limits:
+            continue
+        values = samples.get(metric) or []
+        if not values:
+            violations.append(
+                f"{label}: {metric} thresholds given but no samples")
+            continue
+        for pname, limit in limits.items():
+            q = _QUANTS.get(pname)
+            if q is None:
+                violations.append(
+                    f"{label}: unknown quantile {pname!r} "
+                    f"(use {sorted(_QUANTS)})")
+                continue
+            got = percentile(values, q)
+            if got > float(limit):
+                violations.append(
+                    f"{label}: {metric} {pname} = {got:.1f} ms "
+                    f"> {float(limit):.1f} ms "
+                    f"(n={len(values)})")
+
+
+def _histogram_samples(doc: dict) -> dict:
+    """Fallback when the manifest carries no request list: approximate
+    the overall population from the metrics snapshot's
+    ``dgc_serve_service_seconds`` histograms via bucket interpolation
+    (``obs.metrics.Histogram`` semantics) — returns {metric: values}
+    shaped like request samples by expanding each bucket at its
+    interpolation midpoint."""
+    metrics = doc.get("metrics") or {}
+    out: dict = {"service_ms": [], "queue_ms": []}
+    names = {"dgc_serve_service_seconds": "service_ms",
+             "dgc_serve_queue_seconds": "queue_ms"}
+    for key, snap in metrics.items():
+        base = key.split("{", 1)[0]
+        metric = names.get(base)
+        if metric is None or snap.get("kind") != "histogram":
+            continue
+        lo = 0.0
+        for edge, count in snap.get("buckets", {}).items():
+            hi = float(edge)
+            out[metric].extend([(lo + hi) / 2 * 1e3] * int(count))
+            lo = hi
+        out[metric].extend([lo * 1e3] * int(snap.get("inf", 0)))
+    return out
+
+
+def check_serve_doc(doc: dict, thresholds: dict) -> list[str]:
+    """All SLO violations of one run document (empty = pass)."""
+    violations: list[str] = []
+    serve = doc.get("serve") or {}
+    requests = [r for r in (serve.get("requests") or [])
+                if r.get("status") != "rejected"]
+    if requests:
+        samples = {
+            "service_ms": [r["service_ms"] for r in requests
+                           if r.get("service_ms") is not None],
+            "queue_ms": [r["queue_ms"] for r in requests
+                         if r.get("queue_ms") is not None],
+        }
+    else:
+        samples = _histogram_samples(doc)
+    _gate_latencies(violations, "overall", thresholds, samples)
+
+    for cls, sub in (thresholds.get("classes") or {}).items():
+        cls_reqs = [r for r in requests if r.get("shape_class") == cls]
+        _gate_latencies(
+            violations, f"class {cls}", sub,
+            {"service_ms": [r["service_ms"] for r in cls_reqs
+                            if r.get("service_ms") is not None],
+             "queue_ms": [r["queue_ms"] for r in cls_reqs
+                          if r.get("queue_ms") is not None]})
+
+    summary = serve.get("summary") or {}
+    gps_min = thresholds.get("graphs_per_s_min")
+    if gps_min is not None:
+        gps = summary.get("graphs_per_s")
+        if gps is None:
+            violations.append("graphs_per_s_min given but the run has no "
+                              "serve summary throughput")
+        elif gps < float(gps_min):
+            violations.append(f"throughput: {gps} graphs/s "
+                              f"< {float(gps_min)} graphs/s")
+    fail_max = thresholds.get("failure_rate_max")
+    if fail_max is not None:
+        total = summary.get("requests") or len(requests)
+        failed = summary.get("failed")
+        if failed is None:
+            failed = sum(1 for r in requests if r.get("status") != "ok")
+        if total:
+            rate = failed / total
+            if rate > float(fail_max):
+                violations.append(
+                    f"failure rate: {failed}/{total} = {rate:.3f} "
+                    f"> {float(fail_max)}")
+    return violations
+
+
+def check_bench_record(record: dict, thresholds: dict) -> list[str]:
+    """The bench-tripwire variant: gate one ``bench.py --serve-modes``
+    JSON record (graphs/s headline + speedup) against the same
+    thresholds file — ``graphs_per_s_min`` and
+    ``speedup_vs_sequential_min`` apply."""
+    violations: list[str] = []
+    gps_min = thresholds.get("graphs_per_s_min")
+    if gps_min is not None and record.get("value") is not None:
+        if record["value"] < float(gps_min):
+            violations.append(
+                f"bench throughput: {record['value']} graphs/s "
+                f"< {float(gps_min)} graphs/s")
+    sp_min = thresholds.get("speedup_vs_sequential_min")
+    if sp_min is not None:
+        sp = record.get("speedup_vs_sequential")
+        if sp is None:
+            violations.append("speedup_vs_sequential_min given but the "
+                              "record has no speedup")
+        elif sp < float(sp_min):
+            violations.append(f"bench speedup: {sp}x sequential "
+                              f"< {float(sp_min)}x")
+    return violations
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("path", help="run manifest JSON or JSONL run log")
+    p.add_argument("--thresholds", required=True,
+                   help="SLO thresholds JSON (module docstring schema)")
+    p.add_argument("-q", "--quiet", action="store_true",
+                   help="suppress the PASS line")
+    args = p.parse_args(argv)
+    try:
+        doc = load_doc(args.path)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: cannot load {args.path}: {e}", file=sys.stderr)
+        return 2
+    try:
+        thresholds = json.loads(open(args.thresholds).read())
+        if not isinstance(thresholds, dict):
+            raise ValueError("thresholds must be a JSON object")
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"error: cannot load {args.thresholds}: {e}", file=sys.stderr)
+        return 2
+    violations = check_serve_doc(doc, thresholds)
+    if violations:
+        for v in violations:
+            print(f"SLO VIOLATION: {v}", file=sys.stderr)
+        return 1
+    if not args.quiet:
+        print(f"{args.path}: SLO PASS ({args.thresholds})")
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -7,6 +7,13 @@ tests run over every log they produce, so an event emitted anywhere in the
 codebase without a matching schema entry fails CI instead of silently
 rotting the contract.
 
+``span`` events additionally get structural validation (the tracing
+contract, ``dgc_tpu.obs.trace``): a child span must begin after its
+parent began, no span may begin or end twice, every end must match an
+open begin, and every opened span must be closed by end of log. A torn
+trailing line (a live log caught mid-write, no newline yet) is tolerated
+— the tail-follower convention — but torn lines elsewhere still fail.
+
 Usage: python tools/validate_runlog.py RUNLOG.jsonl [...]
 """
 
@@ -22,21 +29,75 @@ sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 from dgc_tpu.obs.schema import validate_record  # noqa: E402
 
 
+class _SpanChecker:
+    """Structural span invariants over one log's event order."""
+
+    def __init__(self):
+        self._open: dict = {}    # (trace, span) -> name
+        self._begun: set = set()
+
+    def feed(self, record: dict) -> list[str]:
+        problems: list[str] = []
+        trace, span = record.get("trace"), record.get("span")
+        key = (trace, span)
+        ph = record.get("ph")
+        name = record.get("name")
+        if ph == "B":
+            if key in self._begun:
+                problems.append(
+                    f"span {span} ({name}) in trace {trace} begun twice")
+            self._begun.add(key)
+            self._open[key] = name
+            parent = record.get("parent")
+            if parent is not None and (trace, parent) not in self._begun:
+                problems.append(
+                    f"span {span} ({name}) begins before its parent "
+                    f"{parent} in trace {trace}")
+        elif ph == "E":
+            if key not in self._open:
+                problems.append(
+                    f"span {span} ({name}) in trace {trace} "
+                    + ("ended twice" if key in self._begun
+                       else "ends without a begin"))
+            else:
+                del self._open[key]
+        else:
+            problems.append(f"span {span}: unknown ph {ph!r} (want B|E)")
+        return problems
+
+    def finish(self) -> list[str]:
+        return [f"span {span} ({name}) in trace {trace} never closed"
+                for (trace, span), name in sorted(
+                    self._open.items(), key=lambda kv: str(kv[0]))]
+
+
 def validate_file(path: str) -> list[str]:
-    """All schema problems in one JSONL log, prefixed with line numbers."""
+    """All schema and span-structure problems in one JSONL log, prefixed
+    with line numbers."""
     problems: list[str] = []
+    spans = _SpanChecker()
     with open(path) as fh:
-        for lineno, line in enumerate(fh, 1):
-            line = line.strip()
-            if not line:
-                continue
-            try:
-                record = json.loads(line)
-            except json.JSONDecodeError as e:
-                problems.append(f"{path}:{lineno}: unparseable JSON: {e}")
-                continue
-            for problem in validate_record(record):
+        raw = fh.read()
+    lines = raw.split("\n")
+    torn_tail = not raw.endswith("\n")
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            record = json.loads(line)
+        except json.JSONDecodeError as e:
+            if torn_tail and lineno == len(lines):
+                continue   # live log caught mid-write; writer re-emits
+            problems.append(f"{path}:{lineno}: unparseable JSON: {e}")
+            continue
+        for problem in validate_record(record):
+            problems.append(f"{path}:{lineno}: {problem}")
+        if isinstance(record, dict) and record.get("event") == "span":
+            for problem in spans.feed(record):
                 problems.append(f"{path}:{lineno}: {problem}")
+    for problem in spans.finish():
+        problems.append(f"{path}: {problem}")
     return problems
 
 
