@@ -1,0 +1,25 @@
+"""dgc-lint: repo-specific static analysis (``tools/dgc_lint.py``).
+
+Four AST-based passes prove the structural invariants the runtime
+harnesses (parity ensembles, ``validate_runlog``, hammer tests) only
+*sample*:
+
+- ``staging`` — no host effects inside traced kernel code (rules KS*);
+- ``layout_check`` — every pack/unpack/index site agrees with
+  ``dgc_tpu.layout`` (rules LY*);
+- ``schema_check`` — emit sites ↔ ``obs.schema`` in both directions
+  (rules SC*);
+- ``locks`` — ``# guarded-by:`` lock discipline over the threaded tier
+  (rules LK*).
+
+``run.run_passes`` binds the passes to the repo's file sets; the CLI
+(``tools/dgc_lint.py``) adds the committed-baseline workflow and the
+``--strict`` gate tier-1 runs.
+"""
+
+from dgc_tpu.analysis.common import (Finding, SourceModule, load_baseline,
+                                     split_baseline, write_baseline)
+from dgc_tpu.analysis.run import PASSES, run_passes
+
+__all__ = ["Finding", "SourceModule", "PASSES", "run_passes",
+           "load_baseline", "split_baseline", "write_baseline"]

@@ -1,0 +1,156 @@
+"""Shared infrastructure for the dgc-lint static-analysis passes.
+
+A pass operates on :class:`SourceModule` objects — parsed AST plus the
+comment map the annotation conventions live in — and returns
+:class:`Finding` objects. Findings are identified by ``(rule, file,
+detail)``; the committed baseline (``tools/dgc_lint_baseline.json``)
+holds accepted exceptions as exactly those triples, so line-number drift
+never churns the baseline.
+
+In-source conventions (all comments, all greppable):
+
+- ``# dgc-lint: ok RULE[,RULE...]`` on a line waives those rules for
+  findings anchored to that line;
+- ``# dgc-lint: traced`` on a ``def`` line declares the function
+  kernel-traced (staging pass seeds that call-graph analysis cannot
+  discover, e.g. closures returned into a kernel);
+- ``# dgc-lint: threaded`` on a ``class`` line opts a lock-free class
+  into the lock-discipline pass;
+- ``# dgc-lint: owned-by NAME`` on a ``class`` line documents that every
+  attribute of the class is confined to one thread (NAME names it);
+- ``# guarded-by: NAME`` on an attribute's assignment line binds the
+  attribute to lock attribute NAME (or a thread-confinement pseudo-owner
+  — ``dgc_tpu.analysis.locks``).
+"""
+
+from __future__ import annotations
+
+import ast
+import io
+import json
+import re
+import tokenize
+from dataclasses import dataclass
+from pathlib import Path
+
+_WAIVE_RE = re.compile(r"dgc-lint:\s*ok\s+([A-Z0-9, ]+)")
+
+
+@dataclass(frozen=True)
+class Finding:
+    """One lint finding. ``detail`` is the stable fingerprint half (no
+    line numbers inside it); ``line`` is for display only."""
+
+    rule: str
+    file: str
+    line: int
+    detail: str
+
+    def key(self) -> tuple:
+        return (self.rule, self.file, self.detail)
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}: {self.rule} {self.detail}"
+
+
+class SourceModule:
+    """One parsed source file: AST, raw lines, and per-line comments."""
+
+    def __init__(self, rel: str, text: str):
+        self.rel = rel
+        self.text = text
+        self.lines = text.splitlines()
+        self.tree = ast.parse(text, filename=rel)
+        self.comments: dict[int, str] = {}
+        try:
+            for tok in tokenize.generate_tokens(io.StringIO(text).readline):
+                if tok.type == tokenize.COMMENT:
+                    self.comments[tok.start[0]] = tok.string
+        except tokenize.TokenError:  # torn file: AST parsed, comments best-effort
+            pass
+
+    @classmethod
+    def load(cls, root: Path, rel: str) -> "SourceModule":
+        return cls(rel, (root / rel).read_text())
+
+    def comment_on(self, line: int) -> str:
+        """The comment on ``line``, or on the line above — but only when
+        the line above is a pure comment line (a trailing comment on the
+        previous *statement* must not bleed onto this one)."""
+        own = self.comments.get(line)
+        if own:
+            return own
+        above = self.comments.get(line - 1)
+        if above and 1 <= line - 1 <= len(self.lines) \
+                and self.lines[line - 2].lstrip().startswith("#"):
+            return above
+        return ""
+
+    def waived(self, line: int, rule: str) -> bool:
+        m = _WAIVE_RE.search(self.comments.get(line, ""))
+        if m is None:
+            return False
+        return rule in {r.strip() for r in m.group(1).split(",")}
+
+    def marker(self, line: int, name: str) -> bool:
+        """True when ``# dgc-lint: NAME`` annotates ``line`` (same line
+        or the line above)."""
+        return f"dgc-lint: {name}" in self.comment_on(line)
+
+    def finding(self, rule: str, node_or_line, detail: str) -> Finding | None:
+        line = getattr(node_or_line, "lineno", node_or_line)
+        if self.waived(line, rule):
+            return None
+        return Finding(rule, self.rel, int(line), detail)
+
+
+def module_constants(mod: SourceModule) -> dict[str, int]:
+    """Top-level ``NAME = <int literal>`` assignments (the layout
+    module's contract: plain literals, statically readable)."""
+    out: dict[str, int] = {}
+    for node in mod.tree.body:
+        targets = []
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None:
+            targets = [node.target] if isinstance(node.target, ast.Name) else []
+            value = node.value
+        else:
+            continue
+        try:
+            v = ast.literal_eval(value)
+        except (ValueError, TypeError, SyntaxError):
+            continue
+        if isinstance(v, int) and not isinstance(v, bool):
+            for t in targets:
+                out[t.id] = v
+    return out
+
+
+def load_baseline(path: Path) -> set[tuple]:
+    """Accepted-findings baseline: a JSON list of {rule, file, detail}."""
+    if not path.exists():
+        return set()
+    entries = json.loads(path.read_text())
+    if not isinstance(entries, list):
+        raise ValueError(f"baseline {path} is not a JSON list")
+    out = set()
+    for e in entries:
+        out.add((e["rule"], e["file"], e["detail"]))
+    return out
+
+
+def write_baseline(path: Path, findings: list[Finding]) -> None:
+    entries = [{"rule": f.rule, "file": f.file, "detail": f.detail}
+               for f in sorted(findings, key=lambda f: f.key())]
+    path.write_text(json.dumps(entries, indent=1) + "\n")
+
+
+def split_baseline(findings: list[Finding], baseline: set[tuple]):
+    """(new, accepted, stale-baseline-entries)."""
+    keys = {f.key() for f in findings}
+    new = [f for f in findings if f.key() not in baseline]
+    accepted = [f for f in findings if f.key() in baseline]
+    stale = sorted(baseline - keys)
+    return new, accepted, stale

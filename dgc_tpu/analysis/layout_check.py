@@ -1,0 +1,352 @@
+"""Carry/trajectory layout contract checker.
+
+The serve slice carry and the trajectory buffer row are fixed-shape
+int32 contracts whose lengths and slot ids live in ``dgc_tpu/layout.py``
+(single-sourced; plain integer literals). This pass statically verifies
+that every site which *packs*, *unpacks*, or *indexes* one of those
+buffers agrees with the layout module — the property that has been
+hand-maintained through every buffer growth (carry 13→15 in PR 7,
+trajectory row 4→5→6 in PRs 3/5/7) becomes machine-checked.
+
+Rules:
+
+- **LY001** pack/unpack arity — a declared pack site's ``return
+  (tuple...)`` literal, or a declared unpack site's ``(a, b, ...) =
+  buf`` destructuring, disagrees with the length constant (the "widened
+  the carry, forgot a site" failure);
+- **LY002** stale/out-of-bounds index — a declared index constant, a
+  constant-index subscript on a declared buffer variable, or a declared
+  ``lo + n ≤ LEN`` span invariant is out of bounds;
+- **LY003** shared-body violation — the sliced and unsliced kernels must
+  reach ONE common superstep-core function (the PR 6 "cannot drift by
+  construction" claim, now a checked property);
+- **LY004** layout constant redefined outside the layout module
+  (single-sourcing enforcement);
+- **LY005** row-build width — a declared row-building list literal (the
+  trajectory writer's column stack) disagrees with the row width
+  constant.
+
+Specs (:class:`BufferSpec`) describe the sites by (module, function,
+variable) name so fixtures can exercise every rule on synthetic sources;
+``DEFAULT_SPECS`` binds the repo's two real buffers.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from dgc_tpu.analysis.common import (Finding, SourceModule,
+                                     module_constants)
+
+
+@dataclass
+class BufferSpec:
+    """One buffer's layout contract, by name."""
+
+    name: str                       # display name ("serve-carry")
+    length_const: str               # e.g. "CARRY_LEN"
+    module: str                     # repo-rel module owning pack/unpack
+    pack_functions: tuple = ()      # return-tuple arity == LEN
+    unpack_functions: tuple = ()    # (func, param): "(a,..) = param" arity
+    index_consts: tuple = ()        # constants that must be < LEN
+    var_names: tuple = ()           # int-literal subscripts bounds-checked
+    extra_modules: tuple = ()       # more modules scanned for LY002
+    shared_body: tuple = ()         # (roots tuple, core fn name) for LY003
+    row_builds: tuple = ()          # (func, list var): list arity == LEN
+
+
+DEFAULT_SPECS = (
+    BufferSpec(
+        name="serve-carry",
+        length_const="CARRY_LEN",
+        module="dgc_tpu/serve/batched.py",
+        pack_functions=("_fresh_lane", "_superstep_body", "idle_carry"),
+        unpack_functions=(("_superstep_body", "c"),),
+        index_consts=("CARRY_PHASE", "CARRY_K", "CARRY_PACKED",
+                      "CARRY_STEP", "CARRY_PREV_ACTIVE", "CARRY_STALL",
+                      "CARRY_P1", "CARRY_S1", "CARRY_ST1", "CARRY_USED",
+                      "CARRY_P2", "CARRY_S2", "CARRY_ST2", "T_US",
+                      "T_PREV", "OUT0"),
+        var_names=("carry", "carry_np"),
+        extra_modules=("dgc_tpu/serve/engine.py", "tests/test_serve.py"),
+        shared_body=(("batched_sweep_kernel", "batched_slice_kernel"),
+                     "speculative_update_mc"),
+    ),
+    BufferSpec(
+        name="traj-row",
+        length_const="TRAJ_COLS",
+        module="dgc_tpu/obs/kernel.py",
+        index_consts=("COL_ACTIVE", "COL_FAIL", "COL_MC",
+                      "COL_GATHER_CALLS", "COL_MAX_UNCONF", "COL_TS_US"),
+        row_builds=(("make_trajstep", "cols"),),
+    ),
+)
+
+# span invariants: lo + n must cover at most LEN slots
+SPAN_INVARIANTS = {
+    "serve-carry": (("OUT0", "N_OUT"),),
+}
+
+
+def _functions(mod: SourceModule) -> dict[str, ast.FunctionDef]:
+    return {n.name: n for n in ast.walk(mod.tree)
+            if isinstance(n, ast.FunctionDef)}
+
+
+def _const_index(node: ast.AST, consts: dict) -> int | None:
+    """A subscript index that is statically an int (literal or layout
+    constant name); None when dynamic."""
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name) and node.id in consts:
+        return consts[node.id]
+    if isinstance(node, ast.UnaryOp) and isinstance(node.op, ast.USub):
+        inner = _const_index(node.operand, consts)
+        return None if inner is None else -inner
+    return None
+
+
+def _check_call_graph_shared_body(mod: SourceModule, spec: BufferSpec,
+                                  out: list[Finding]) -> None:
+    roots, core = spec.shared_body
+    funcs = _functions(mod)
+    # callers of `core` by simple name reference
+    core_callers = []
+    for name, node in funcs.items():
+        for n in ast.walk(node):
+            if isinstance(n, ast.Call) and (
+                    (isinstance(n.func, ast.Name) and n.func.id == core)
+                    or (isinstance(n.func, ast.Attribute)
+                        and n.func.attr == core)):
+                core_callers.append(name)
+                break
+    if len(set(core_callers)) != 1:
+        f = mod.finding(
+            "LY003", 1,
+            f"{spec.name}: superstep core '{core}' must be called from "
+            f"exactly ONE function (shared body), found "
+            f"{sorted(set(core_callers)) or 'none'}")
+        if f is not None:
+            out.append(f)
+        return
+    body_fn = core_callers[0]
+    # every root must reach body_fn through name references
+    refs = {name: {n.id for n in ast.walk(node)
+                   if isinstance(n, ast.Name)}
+            for name, node in funcs.items()}
+    for root in roots:
+        if root not in funcs:
+            f = mod.finding("LY003", 1,
+                            f"{spec.name}: kernel root '{root}' not found")
+            if f is not None:
+                out.append(f)
+            continue
+        seen, frontier = {root}, [root]
+        while frontier:
+            cur = frontier.pop()
+            for name in refs.get(cur, ()):
+                if name in funcs and name not in seen:
+                    seen.add(name)
+                    frontier.append(name)
+        if body_fn not in seen:
+            f = mod.finding(
+                "LY003", funcs[root].lineno,
+                f"{spec.name}: kernel root '{root}' does not reach the "
+                f"shared superstep body '{body_fn}'")
+            if f is not None:
+                out.append(f)
+
+
+def _check_indices(mod: SourceModule, spec: BufferSpec, length: int,
+                   consts: dict, out: list[Finding]) -> None:
+    """LY002 over one module: literal/constant subscripts on declared
+    buffer variables, including slice bounds."""
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.Subscript):
+            continue
+        base = node.value
+        if not (isinstance(base, ast.Name)
+                and base.id in spec.var_names):
+            continue
+        sl = node.slice
+        if isinstance(sl, ast.Slice):
+            for edge in (sl.lower, sl.upper):
+                if edge is None:
+                    continue
+                v = _const_index(edge, consts)
+                if v is not None and not (-length <= v <= length):
+                    f = mod.finding(
+                        "LY002", node,
+                        f"{spec.name}: slice edge {v} outside "
+                        f"[0, {spec.length_const}={length}] on "
+                        f"'{base.id}'")
+                    if f is not None:
+                        out.append(f)
+            continue
+        v = _const_index(sl, consts)
+        if v is not None and not (-length <= v < length):
+            f = mod.finding(
+                "LY002", node,
+                f"{spec.name}: index {v} out of bounds for "
+                f"{spec.length_const}={length} on '{base.id}'")
+            if f is not None:
+                out.append(f)
+
+
+def check_layout(layout_mod: SourceModule,
+                 modules: dict[str, SourceModule],
+                 specs=DEFAULT_SPECS,
+                 span_invariants=None) -> list[Finding]:
+    """Run the layout pass. ``modules`` maps repo-relative path →
+    SourceModule for every module any spec references (missing ones are
+    skipped — the caller controls the file set)."""
+    if span_invariants is None:
+        span_invariants = SPAN_INVARIANTS
+    consts = module_constants(layout_mod)
+    out: list[Finding] = []
+
+    # LY004: single-sourcing — no layout constant redefined elsewhere
+    for rel, mod in modules.items():
+        if rel == layout_mod.rel:
+            continue
+        for name, _v in module_constants(mod).items():
+            if name in consts:
+                f = mod.finding(
+                    "LY004", _assign_line(mod, name),
+                    f"layout constant '{name}' redefined outside "
+                    f"{layout_mod.rel}")
+                if f is not None:
+                    out.append(f)
+
+    for spec in specs:
+        if spec.length_const not in consts:
+            f = layout_mod.finding(
+                "LY002", 1,
+                f"{spec.name}: length constant '{spec.length_const}' "
+                f"missing from {layout_mod.rel}")
+            if f is not None:
+                out.append(f)
+            continue
+        length = consts[spec.length_const]
+
+        # LY002: declared index constants in range
+        for cname in spec.index_consts:
+            if cname not in consts:
+                f = layout_mod.finding(
+                    "LY002", 1,
+                    f"{spec.name}: index constant '{cname}' missing "
+                    f"from {layout_mod.rel}")
+                if f is not None:
+                    out.append(f)
+            elif not (0 <= consts[cname] < length):
+                f = layout_mod.finding(
+                    "LY002", _assign_line(layout_mod, cname),
+                    f"{spec.name}: stale index {cname}={consts[cname]} "
+                    f"out of bounds for {spec.length_const}={length}")
+                if f is not None:
+                    out.append(f)
+
+        # LY002: declared span invariants (lo + n <= LEN)
+        for lo_name, n_name in span_invariants.get(spec.name, ()):
+            lo, n = consts.get(lo_name), consts.get(n_name)
+            if lo is not None and n is not None and lo + n > length:
+                f = layout_mod.finding(
+                    "LY002", _assign_line(layout_mod, n_name),
+                    f"{spec.name}: span {lo_name}+{n_name}="
+                    f"{lo + n} exceeds {spec.length_const}={length}")
+                if f is not None:
+                    out.append(f)
+
+        mod = modules.get(spec.module)
+        if mod is None:
+            continue
+        funcs = _functions(mod)
+
+        # LY001: pack-site return-tuple arity
+        for fname in spec.pack_functions:
+            node = funcs.get(fname)
+            if node is None:
+                f = mod.finding("LY001", 1,
+                                f"{spec.name}: pack site '{fname}' "
+                                f"not found")
+                if f is not None:
+                    out.append(f)
+                continue
+            for ret in ast.walk(node):
+                if isinstance(ret, ast.Return) \
+                        and isinstance(ret.value, ast.Tuple):
+                    arity = len(ret.value.elts)
+                    if arity != length:
+                        f = mod.finding(
+                            "LY001", ret,
+                            f"{spec.name}: '{fname}' packs {arity} "
+                            f"slots, {spec.length_const}={length}")
+                        if f is not None:
+                            out.append(f)
+
+        # LY001: unpack-site destructuring arity
+        for fname, param in spec.unpack_functions:
+            node = funcs.get(fname)
+            if node is None:
+                continue
+            for stmt in ast.walk(node):
+                if (isinstance(stmt, ast.Assign)
+                        and isinstance(stmt.value, ast.Name)
+                        and stmt.value.id == param):
+                    for t in stmt.targets:
+                        if isinstance(t, ast.Tuple):
+                            arity = len(t.elts)
+                            if arity != length:
+                                f = mod.finding(
+                                    "LY001", stmt,
+                                    f"{spec.name}: '{fname}' unpacks "
+                                    f"{arity} slots from '{param}', "
+                                    f"{spec.length_const}={length}")
+                                if f is not None:
+                                    out.append(f)
+
+        # LY005: row-build list width
+        for fname, varname in spec.row_builds:
+            node = funcs.get(fname)
+            if node is None:
+                continue
+            for stmt in ast.walk(node):
+                if (isinstance(stmt, ast.Assign)
+                        and isinstance(stmt.value, ast.List)
+                        and any(isinstance(t, ast.Name)
+                                and t.id == varname
+                                for t in stmt.targets)):
+                    arity = len(stmt.value.elts)
+                    if arity != length:
+                        f = mod.finding(
+                            "LY005", stmt,
+                            f"{spec.name}: '{fname}' builds a "
+                            f"{arity}-column row, "
+                            f"{spec.length_const}={length}")
+                        if f is not None:
+                            out.append(f)
+
+        # LY002: constant subscripts on buffer variables
+        for rel in (spec.module,) + spec.extra_modules:
+            m = modules.get(rel)
+            if m is not None:
+                _check_indices(m, spec, length, consts, out)
+
+        # LY003: shared superstep body
+        if spec.shared_body:
+            _check_call_graph_shared_body(mod, spec, out)
+    return out
+
+
+def _assign_line(mod: SourceModule, name: str) -> int:
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            if any(isinstance(t, ast.Name) and t.id == name
+                   for t in node.targets):
+                return node.lineno
+        elif isinstance(node, ast.AnnAssign):
+            if isinstance(node.target, ast.Name) and node.target.id == name:
+                return node.lineno
+    return 1

@@ -1,0 +1,275 @@
+"""Lock-discipline pass over the threaded serve/obs tier.
+
+The serve front-end runs worker threads, a batch-dispatcher thread, and
+scrape/exporter threads against shared registries — PR 7 retrofitted
+locks onto ``MetricsRegistry`` after a race was found. This pass makes
+the locking *conventions* machine-checked:
+
+- An attribute annotated ``# guarded-by: <lock>`` on its assignment
+  line (any method, or a dataclass field line) must only be read or
+  written inside a lexical ``with self.<lock>:`` scope, in every method
+  except ``__init__`` / ``__post_init__`` / ``__new__`` (construction
+  precedes sharing). ``threading.Condition`` wraps an RLock, so nested
+  ``with`` is fine and the checker only requires lexical containment.
+- ``# guarded-by:`` may instead name a *pseudo-owner* (``dispatcher``,
+  ``owner``, ``caller``, ``worker``, or ``init`` for
+  construction-frozen state) — a documented thread-confinement claim;
+  the checker verifies nothing but the annotation must name either a
+  lock attribute of the class or a known pseudo-owner (**LK003**
+  otherwise).
+- Classes that own a lock (``threading.Lock`` / ``RLock`` /
+  ``Condition`` / ``Semaphore`` attribute, or a dataclass
+  ``field(default_factory=threading.Lock)``), or that carry
+  ``# dgc-lint: threaded`` on the class line, are *shared-state scopes*:
+  every mutable-initialized or method-reassigned attribute WITHOUT a
+  ``guarded-by`` annotation is reported (**LK002**) — unannotated shared
+  mutable state is exactly how the retrofitted races got in. A
+  ``# dgc-lint: owned-by NAME`` class marker blankets every attribute
+  of the class as NAME-confined.
+
+Rules:
+
+- **LK001** guarded attribute accessed outside ``with <its lock>``;
+- **LK002** unannotated shared mutable attribute on a threaded class;
+- **LK003** ``guarded-by`` names neither a lock attribute nor a known
+  pseudo-owner.
+
+Scope limits (honest ones): only ``self.<attr>`` accesses are checked —
+cross-object accesses (``m.counts`` under ``m._lock`` in the registry
+exporters) and attribute aliasing are out of reach of a lexical
+checker, and the runtime hammer tests stay the authority there.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+
+from dgc_tpu.analysis.common import Finding, SourceModule
+
+LOCK_TYPES = {"Lock", "RLock", "Condition", "Semaphore",
+              "BoundedSemaphore"}
+PSEUDO_OWNERS = {"dispatcher", "owner", "caller", "worker", "init"}
+INIT_METHODS = {"__init__", "__post_init__", "__new__"}
+MUTABLE_CALLS = {"dict", "list", "set", "deque", "defaultdict",
+                 "OrderedDict", "Counter"}
+
+_GUARD_RE = re.compile(r"guarded-by:\s*([A-Za-z_][\w]*)")
+_OWNED_RE = re.compile(r"dgc-lint:\s*owned-by\s+([A-Za-z_][\w]*)")
+
+
+def _is_lock_ctor(node: ast.AST) -> bool:
+    """``threading.Lock()`` / ``Lock()`` /
+    ``field(default_factory=threading.Lock)``."""
+    if not isinstance(node, ast.Call):
+        return False
+    f = node.func
+    if isinstance(f, ast.Attribute) and f.attr in LOCK_TYPES:
+        return True
+    if isinstance(f, ast.Name) and f.id in LOCK_TYPES:
+        return True
+    if isinstance(f, ast.Name) and f.id == "field":
+        for kw in node.keywords:
+            if kw.arg == "default_factory":
+                v = kw.value
+                if isinstance(v, ast.Attribute) and v.attr in LOCK_TYPES:
+                    return True
+                if isinstance(v, ast.Name) and v.id in LOCK_TYPES:
+                    return True
+    return False
+
+
+def _is_mutable_init(node: ast.AST) -> bool:
+    if isinstance(node, (ast.Dict, ast.List, ast.Set, ast.ListComp,
+                         ast.DictComp, ast.SetComp)):
+        return True
+    if isinstance(node, ast.Call):
+        f = node.func
+        name = f.id if isinstance(f, ast.Name) else (
+            f.attr if isinstance(f, ast.Attribute) else None)
+        if name in MUTABLE_CALLS:
+            return True
+        if isinstance(f, ast.Name) and f.id == "field":
+            for kw in node.keywords:
+                if kw.arg == "default_factory":
+                    v = kw.value
+                    vn = v.id if isinstance(v, ast.Name) else (
+                        v.attr if isinstance(v, ast.Attribute) else None)
+                    if vn in MUTABLE_CALLS:
+                        return True
+    return False
+
+
+class _ClassInfo:
+    def __init__(self, mod: SourceModule, node: ast.ClassDef):
+        self.mod = mod
+        self.node = node
+        self.locks: set = set()
+        self.guards: dict[str, tuple[str, int]] = {}  # attr -> (guard, line)
+        self.attr_def_line: dict[str, int] = {}
+        self.mutable_attrs: set = set()
+        self.reassigned: dict[str, int] = {}   # attr -> non-init store line
+        self.threaded_marker = mod.marker(node.lineno, "threaded")
+        m = _OWNED_RE.search(mod.comment_on(node.lineno))
+        self.owned_by = m.group(1) if m else None
+        self._scan()
+
+    def _guard_on(self, line: int, end_line: int | None = None) -> str | None:
+        """A guarded-by annotation on the statement's first line, the
+        line above it, or any continuation line (multi-line dict
+        initializers carry the comment on their closing line)."""
+        for ln in range(line, (end_line or line) + 1):
+            m = _GUARD_RE.search(self.mod.comment_on(ln))
+            if m:
+                return m.group(1)
+        return None
+
+    def _note_attr(self, attr: str, value: ast.AST, line: int,
+                   in_init: bool, end_line: int | None = None) -> None:
+        self.attr_def_line.setdefault(attr, line)
+        guard = self._guard_on(line, end_line)
+        if guard is not None and attr not in self.guards:
+            self.guards[attr] = (guard, line)
+        if value is not None:
+            if _is_lock_ctor(value):
+                self.locks.add(attr)
+            elif _is_mutable_init(value):
+                self.mutable_attrs.add(attr)
+        if not in_init:
+            self.reassigned.setdefault(attr, line)
+
+    def _scan(self) -> None:
+        for stmt in self.node.body:
+            # dataclass-style class-level fields
+            if isinstance(stmt, ast.AnnAssign) \
+                    and isinstance(stmt.target, ast.Name):
+                self._note_attr(stmt.target.id, stmt.value, stmt.lineno,
+                                in_init=True, end_line=stmt.end_lineno)
+            elif isinstance(stmt, ast.Assign):
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and t.id != "__slots__":
+                        self._note_attr(t.id, stmt.value, stmt.lineno,
+                                        in_init=True,
+                                        end_line=stmt.end_lineno)
+        for meth in self.methods():
+            in_init = meth.name in INIT_METHODS
+            for sub in ast.walk(meth):
+                target = None
+                value = None
+                if isinstance(sub, ast.Assign):
+                    value = sub.value
+                    targets = sub.targets
+                elif isinstance(sub, ast.AugAssign):
+                    value = None
+                    targets = [sub.target]
+                elif isinstance(sub, ast.AnnAssign):
+                    value = sub.value
+                    targets = [sub.target]
+                else:
+                    continue
+                for t in targets:
+                    if (isinstance(t, ast.Attribute)
+                            and isinstance(t.value, ast.Name)
+                            and t.value.id == "self"):
+                        target = t.attr
+                        self._note_attr(target, value, sub.lineno,
+                                        in_init=in_init,
+                                        end_line=sub.end_lineno)
+
+    def methods(self):
+        return [n for n in self.node.body
+                if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+
+    def finalize(self) -> None:
+        """A lock attribute never guards itself (an adjacent line's
+        annotation can bleed onto it via the line-above convention)."""
+        for lk in self.locks:
+            self.guards.pop(lk, None)
+
+    def in_scope(self) -> bool:
+        return bool(self.locks) or self.threaded_marker \
+            or self.owned_by is not None
+
+
+def _with_locks(item: ast.withitem) -> str | None:
+    """``with self.<lock>:`` → the lock attribute name."""
+    e = item.context_expr
+    if isinstance(e, ast.Attribute) and isinstance(e.value, ast.Name) \
+            and e.value.id == "self":
+        return e.attr
+    return None
+
+
+def _check_method(cls: _ClassInfo, meth: ast.FunctionDef,
+                  out: list[Finding]) -> None:
+    lock_guarded = {attr: g for attr, (g, _l) in cls.guards.items()
+                    if g in cls.locks}
+
+    def visit(node: ast.AST, held: frozenset) -> None:
+        if isinstance(node, ast.With):
+            inner = held
+            for item in node.items:
+                lk = _with_locks(item)
+                if lk is not None:
+                    inner = inner | {lk}
+            for child in node.body:
+                visit(child, inner)
+            return
+        if (isinstance(node, ast.Attribute)
+                and isinstance(node.value, ast.Name)
+                and node.value.id == "self"
+                and node.attr in lock_guarded
+                and lock_guarded[node.attr] not in held):
+            f = cls.mod.finding(
+                "LK001", node,
+                f"{cls.node.name}.{node.attr} accessed in "
+                f"{meth.name}() without holding "
+                f"'{lock_guarded[node.attr]}'")
+            if f is not None:
+                out.append(f)
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in meth.body:
+        visit(stmt, frozenset())
+
+
+def check_locks(modules: list[SourceModule]) -> list[Finding]:
+    out: list[Finding] = []
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.ClassDef):
+                continue
+            cls = _ClassInfo(mod, node)
+            cls.finalize()
+            if not cls.in_scope():
+                continue
+            # LK003: guard names must resolve
+            for attr, (guard, line) in cls.guards.items():
+                if guard not in cls.locks and guard not in PSEUDO_OWNERS:
+                    f = mod.finding(
+                        "LK003", line,
+                        f"{node.name}.{attr} guarded-by '{guard}' which "
+                        f"is neither a lock attribute nor a pseudo-owner "
+                        f"{sorted(PSEUDO_OWNERS)}")
+                    if f is not None:
+                        out.append(f)
+            # LK002: unannotated shared mutable attributes
+            if cls.owned_by is None:
+                shared = (cls.mutable_attrs
+                          | set(cls.reassigned)) - set(cls.guards)
+                for attr in sorted(shared - cls.locks):
+                    line = cls.reassigned.get(
+                        attr, cls.attr_def_line.get(attr, node.lineno))
+                    f = mod.finding(
+                        "LK002", line,
+                        f"{node.name}.{attr} is shared mutable state "
+                        f"with no guarded-by annotation")
+                    if f is not None:
+                        out.append(f)
+            # LK001: guarded accesses under their lock
+            for meth in cls.methods():
+                if meth.name in INIT_METHODS:
+                    continue
+                _check_method(cls, meth, out)
+    return out

@@ -1,0 +1,77 @@
+"""Repo-level driver: binds the four passes to their file sets.
+
+The pass implementations are file-set-agnostic (fixture tests feed them
+synthetic sources); THIS module encodes what "the repo" means:
+
+- **staging** walks the kernel tier — the serve batch kernel, every
+  engine, every op, and the two obs modules whose code runs inside
+  traced kernels;
+- **layout** checks ``dgc_tpu/layout.py`` against its consumers (and
+  the serve tests' constant-index subscripts);
+- **schema** cross-checks every emit site in the package, ``bench.py``
+  and ``tools/`` against ``obs.schema.EVENT_SCHEMAS``;
+- **locks** covers the threaded tier: metrics registry, scrape
+  endpoint, serve front-end, batch scheduler.
+"""
+
+from __future__ import annotations
+
+from pathlib import Path
+
+from dgc_tpu.analysis.common import Finding, SourceModule
+from dgc_tpu.analysis.layout_check import check_layout
+from dgc_tpu.analysis.locks import check_locks
+from dgc_tpu.analysis.schema_check import check_schema
+from dgc_tpu.analysis.staging import check_staging
+
+STAGING_GLOBS = ("dgc_tpu/serve/batched.py", "dgc_tpu/engine/*.py",
+                 "dgc_tpu/ops/*.py", "dgc_tpu/obs/kernel.py",
+                 "dgc_tpu/obs/devclock.py")
+LAYOUT_FILES = ("dgc_tpu/layout.py", "dgc_tpu/serve/batched.py",
+                "dgc_tpu/serve/engine.py", "dgc_tpu/obs/kernel.py",
+                "tests/test_serve.py")
+SCHEMA_GLOBS = ("dgc_tpu/**/*.py", "bench.py", "tools/*.py")
+LOCK_FILES = ("dgc_tpu/obs/metrics.py", "dgc_tpu/obs/httpd.py",
+              "dgc_tpu/serve/queue.py", "dgc_tpu/serve/engine.py")
+
+PASSES = ("staging", "layout", "schema", "locks")
+
+
+def _expand(root: Path, patterns) -> list[str]:
+    out: list[str] = []
+    for pat in patterns:
+        if any(ch in pat for ch in "*?["):
+            out.extend(sorted(str(p.relative_to(root))
+                              for p in root.glob(pat)
+                              if p.name != "__init__.py" or "**" in pat))
+        else:
+            out.append(pat)
+    seen: set = set()
+    uniq = []
+    for rel in out:
+        if rel not in seen and (root / rel).exists():
+            seen.add(rel)
+            uniq.append(rel)
+    return uniq
+
+
+def _load(root: Path, rels) -> list[SourceModule]:
+    return [SourceModule.load(root, rel) for rel in rels]
+
+
+def run_passes(root: Path, passes=PASSES) -> list[Finding]:
+    findings: list[Finding] = []
+    if "staging" in passes:
+        findings += check_staging(_load(root, _expand(root, STAGING_GLOBS)))
+    if "layout" in passes:
+        rels = _expand(root, LAYOUT_FILES)
+        mods = {rel: SourceModule.load(root, rel) for rel in rels}
+        findings += check_layout(mods["dgc_tpu/layout.py"], mods)
+    if "schema" in passes:
+        from dgc_tpu.obs.schema import EVENT_SCHEMAS
+
+        findings += check_schema(_load(root, _expand(root, SCHEMA_GLOBS)),
+                                 EVENT_SCHEMAS)
+    if "locks" in passes:
+        findings += check_locks(_load(root, _expand(root, LOCK_FILES)))
+    return sorted(findings, key=lambda f: (f.file, f.line, f.rule))

@@ -1,0 +1,236 @@
+"""Event-schema cross-check: emit sites vs ``obs.schema``.
+
+``obs/schema.py`` is the machine-checkable contract of the run-log
+JSONL stream, enforced at *runtime* by ``tools/validate_runlog.py``.
+This pass enforces it at *lint* time against the source: every event
+kind and field name passed to a run-log emitter must exist in the
+schema, and every schema entry must have at least one emit site — so
+schema drift (a renamed field, a new event missing its entry, a dead
+entry left behind by a refactor) fails ``dgc_lint --strict`` in seconds
+instead of surfacing as a ``validate_runlog`` failure on a produced log.
+
+Emit sites are calls whose callee name is one of
+``event`` / ``_event`` / ``on_event`` / ``_emit_fn`` with a string-
+literal event kind as the first argument (variable-kind forwarders are
+skipped — their literal-kind producers are the checked sites). Fields
+come from keyword arguments, from ``**d`` / second-positional dict
+arguments where ``d`` is a function-local dict built from literals
+(``d = {...}`` / ``d = dict(...)`` / ``d["key"] = ...``), with anything
+else marking the site *open* (unknown extra fields possible → only the
+collected names are checked, missing-required is not).
+
+Rules:
+
+- **SC001** emit of an event kind missing from the schema;
+- **SC002** emit field not in the kind's required ∪ optional set;
+- **SC003** closed emit site missing a required field;
+- **SC004** schema entry never emitted anywhere (dead entry).
+
+``t`` and ``event`` are the envelope fields ``RunLogger.event`` itself
+adds; an emit site supplying either is an SC002.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dgc_tpu.analysis.common import Finding, SourceModule
+
+EMIT_NAMES = {"event", "_event", "on_event", "_emit_fn"}
+ENVELOPE = {"t", "event"}
+
+
+def _callee_name(func: ast.AST) -> str | None:
+    if isinstance(func, ast.Name):
+        return func.id
+    if isinstance(func, ast.Attribute):
+        return func.attr
+    return None
+
+
+class _DictTracker:
+    """Literal-key tracking of function-local dict variables.
+
+    Flow-sensitive by source line: a variable rebound to a fresh dict
+    mid-function (the scheduler reuses ``rec`` for successive events)
+    resolves, at each emit site, to the latest base assignment at or
+    above the site plus the subscript-stores between the two."""
+
+    def __init__(self, func_node: ast.AST):
+        # var -> [(line, keys, open)] base assignments (source order)
+        self.bases: dict[str, list] = {}
+        # var -> [(line, key-or-None)] subscript stores (None = dynamic)
+        self.adds: dict[str, list] = {}
+        for stmt in ast.walk(func_node):
+            if not isinstance(stmt, ast.Assign) or len(stmt.targets) != 1:
+                continue
+            t = stmt.targets[0]
+            if isinstance(t, ast.Name):
+                got = self._literal_dict(stmt.value)
+                if got is not None:
+                    self.bases.setdefault(t.id, []).append(
+                        (stmt.lineno, *got))
+                elif t.id in self.bases:
+                    self.bases[t.id].append((stmt.lineno, set(), True))
+            elif (isinstance(t, ast.Subscript)
+                    and isinstance(t.value, ast.Name)):
+                key = (t.slice.value
+                       if isinstance(t.slice, ast.Constant)
+                       and isinstance(t.slice.value, str) else None)
+                self.adds.setdefault(t.value.id, []).append(
+                    (stmt.lineno, key))
+        for entries in self.bases.values():
+            entries.sort(key=lambda e: e[0])
+
+    def _literal_dict(self, value: ast.AST):
+        if isinstance(value, ast.Dict):
+            keys: set = set()
+            opened = False
+            for k in value.keys:
+                if k is None:                      # {**other}
+                    opened = True
+                elif isinstance(k, ast.Constant) and isinstance(k.value,
+                                                                 str):
+                    keys.add(k.value)
+                else:
+                    opened = True
+            return keys, opened
+        if (isinstance(value, ast.Call)
+                and isinstance(value.func, ast.Name)
+                and value.func.id == "dict"):
+            keys = {kw.arg for kw in value.keywords if kw.arg}
+            opened = any(kw.arg is None for kw in value.keywords) \
+                or bool(value.args)
+            return keys, opened
+        return None
+
+    def fields_of(self, node: ast.AST, at_line: int):
+        """(keys, open) for a ``**node`` / positional-dict argument as
+        of ``at_line``."""
+        if isinstance(node, ast.Name) and node.id in self.bases:
+            base = None
+            for entry in self.bases[node.id]:
+                if entry[0] <= at_line:
+                    base = entry
+            if base is None:
+                return set(), True
+            line0, keys, opened = base[0], set(base[1]), base[2]
+            for line, key in self.adds.get(node.id, ()):
+                if line0 < line <= at_line:
+                    if key is None:
+                        opened = True
+                    else:
+                        keys.add(key)
+            return keys, opened
+        got = self._literal_dict(node)
+        if got is not None:
+            return got
+        return set(), True
+
+
+def _emit_sites(mod: SourceModule):
+    """Yield (call node, enclosing function node, kind, fields, open)."""
+    funcs = [n for n in ast.walk(mod.tree)
+             if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+    owner: dict[int, ast.AST] = {}
+    for fn in funcs:
+        for n in ast.walk(fn):
+            owner.setdefault(id(n), fn)
+    trackers: dict[int, _DictTracker] = {}
+    for call in ast.walk(mod.tree):
+        if not isinstance(call, ast.Call):
+            continue
+        if _callee_name(call.func) not in EMIT_NAMES:
+            continue
+        if not (call.args and isinstance(call.args[0], ast.Constant)
+                and isinstance(call.args[0].value, str)):
+            continue           # variable-kind forwarder: skip
+        kind = call.args[0].value
+        fn = owner.get(id(call))
+        tracker = None
+        if fn is not None:
+            tracker = trackers.get(id(fn))
+            if tracker is None:
+                tracker = trackers[id(fn)] = _DictTracker(fn)
+        fields: set = set()
+        opened = False
+        for kw in call.keywords:
+            if kw.arg is not None:
+                fields.add(kw.arg)
+            elif tracker is not None:                      # **expr
+                keys, op = tracker.fields_of(kw.value, call.lineno)
+                fields |= keys
+                opened |= op
+            else:
+                opened = True
+        for arg in call.args[1:2]:       # on_event(kind, record) form
+            if tracker is not None:
+                keys, op = tracker.fields_of(arg, call.lineno)
+                fields |= keys
+                opened |= op
+            else:
+                opened = True
+        yield call, kind, fields, opened
+
+
+def check_schema(modules: list[SourceModule], schemas: dict,
+                 require_all_emitted: bool = True) -> list[Finding]:
+    """Cross-check emit sites in ``modules`` against ``schemas`` (the
+    ``obs.schema.EVENT_SCHEMAS`` mapping: kind → (required, optional))."""
+    out: list[Finding] = []
+    emitted: set = set()
+    for mod in modules:
+        for call, kind, fields, opened in _emit_sites(mod):
+            emitted.add(kind)
+            if kind not in schemas:
+                f = mod.finding("SC001", call,
+                                f"emit of unknown event kind '{kind}'")
+                if f is not None:
+                    out.append(f)
+                continue
+            required, optional = schemas[kind]
+            known = set(required) | set(optional)
+            for name in sorted(fields):
+                if name in ENVELOPE:
+                    f = mod.finding(
+                        "SC002", call,
+                        f"'{kind}' emit supplies envelope field "
+                        f"'{name}' (RunLogger adds it)")
+                    if f is not None:
+                        out.append(f)
+                elif name not in known:
+                    f = mod.finding(
+                        "SC002", call,
+                        f"'{kind}' emit field '{name}' not in schema")
+                    if f is not None:
+                        out.append(f)
+            if not opened:
+                missing = sorted(set(required) - fields)
+                if missing:
+                    f = mod.finding(
+                        "SC003", call,
+                        f"'{kind}' emit missing required field(s) "
+                        f"{missing}")
+                    if f is not None:
+                        out.append(f)
+    if require_all_emitted:
+        schema_mod = next((m for m in modules
+                           if m.rel.endswith("obs/schema.py")), None)
+        for kind in sorted(set(schemas) - emitted):
+            target = schema_mod or (modules[0] if modules else None)
+            if target is None:
+                break
+            line = _schema_entry_line(target, kind) if schema_mod else 1
+            f = target.finding(
+                "SC004", line,
+                f"schema entry '{kind}' has no emit site (dead entry)")
+            if f is not None:
+                out.append(f)
+    return out
+
+
+def _schema_entry_line(mod: SourceModule, kind: str) -> int:
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Constant) and node.value == kind:
+            return node.lineno
+    return 1

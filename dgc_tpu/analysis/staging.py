@@ -1,0 +1,524 @@
+"""Kernel staging lint: host effects inside traced kernel code.
+
+The fused engines run whole k-attempts inside ``jax.jit`` +
+``lax.while_loop`` bodies. Code in those bodies executes at *trace*
+time: a ``time.time()`` call bakes one frozen timestamp into the
+compiled kernel, an unseeded ``np.random`` draw bakes one compile-variant
+constant (breaking the bit-identity ensembles), ``.item()``/``int()`` on
+a tracer either crashes or silently forces a device sync, and a Python
+``if`` on a tracer is a ``TracerBoolConversionError`` waiting for the
+first input that reaches the branch. All of these are *structural*
+properties of the source — this pass finds them without running
+anything.
+
+How the traced region is computed:
+
+- **Seeds**: functions decorated with ``jax.jit`` (any spelling,
+  including ``partial(jax.jit, static_argnames=...)``), functions (or
+  lambdas / ``partial(f, ...)``) passed to ``lax.while_loop`` /
+  ``scan`` / ``fori_loop`` / ``vmap`` / ``pmap`` / ``switch`` /
+  ``cond`` / ``shard_map`` / ``pjit``, and functions whose ``def`` line
+  carries ``# dgc-lint: traced`` (closures returned into kernels, e.g.
+  ``obs.kernel.make_trajstep``'s ``trajstep``).
+- **Propagation**: the traced set closes over the static call graph —
+  name references resolved through module-local scopes and explicit
+  imports across the analyzed file set. Nested ``def``s of a traced
+  function are traced.
+- **Host escapes**: a callable passed as the first argument to
+  ``pure_callback`` / ``io_callback`` / ``debug.callback`` runs on the
+  host by construction — it (and everything only it reaches) is
+  excluded. This is exactly how ``obs.devclock`` samples the wall clock
+  legally from inside a kernel.
+
+Tracer taint (for the value-sensitive rules): a *directly seeded*
+function's parameters are tracers unless statically known — keyword-only
+parameters, parameters annotated ``int``/``bool``/``str``/``float``,
+and names listed in the ``jit`` decorator's ``static_argnames``.
+Transitively traced helpers routinely take static plan/config objects
+positionally, so their parameters are NOT assumed tracers; instead, any
+value produced by a ``jax``/``jnp``/``lax`` call is a tracer wherever it
+flows. Taint propagates through assignments, and a tainted name only
+counts in a *value* position — ``x is None``, ``x.shape``/``x.ndim``,
+``len(x)``/``isinstance(x, ...)`` are static trace-time introspection,
+not tracer reads.
+
+Rules:
+
+- **KS001** ``time.*`` called under trace (frozen-at-compile clock; use
+  ``obs.devclock.kernel_clock_us``'s callback pattern instead);
+- **KS002** ``print`` under trace (runs once at trace time; use
+  ``jax.debug.print``);
+- **KS003** unseeded randomness under trace (``random.*`` /
+  ``np.random.*`` bake per-compile constants; use ``jax.random`` keys);
+- **KS004** host materialization of a tracer (``.item()``, ``int()`` /
+  ``float()`` / ``bool()`` on a tainted value, ``np.*`` called on a
+  tainted value);
+- **KS005** Python ``if``/``while`` on a tracer-tainted test (needs
+  ``jnp.where`` / ``lax.cond``);
+- **KS006** in-place subscript mutation of a tracer-tainted array
+  (needs ``.at[...].set``).
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass, field
+
+from dgc_tpu.analysis.common import Finding, SourceModule
+
+TRACE_ENTRY_ATTRS = {"while_loop", "scan", "fori_loop", "vmap", "pmap",
+                     "switch", "cond", "shard_map", "pjit"}
+CALLBACK_ATTRS = {"pure_callback", "io_callback", "callback"}
+STATIC_ANNOTATIONS = {"int", "bool", "str", "float"}
+
+# numpy attributes that are static/metadata at trace time (dtypes,
+# shape introspection, scalar constants) — never a host escape
+NP_STATIC_ALLOW = {
+    "int8", "int16", "int32", "int64", "uint8", "uint16", "uint32",
+    "uint64", "float16", "float32", "float64", "bool_", "integer",
+    "floating", "number", "dtype", "shape", "ndim", "size", "iinfo",
+    "finfo", "pi", "inf", "nan", "newaxis",
+}
+
+
+def _dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` attribute chains as a dotted string (None otherwise)."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+@dataclass
+class _Func:
+    """One function definition inside the analyzed file set."""
+
+    mod: SourceModule
+    node: ast.AST                      # FunctionDef | Lambda
+    qualname: str
+    parent: "_Func | None" = None
+    children: dict = field(default_factory=dict)   # name -> _Func
+    traced: bool = False
+    direct_seed: bool = False          # params are known tracers
+    callback_host: bool = False
+    static_argnames: set = field(default_factory=set)
+
+    @property
+    def key(self) -> tuple:
+        return (self.mod.rel, self.qualname)
+
+
+class _ModuleIndex:
+    """Name resolution for one module: imports + function scopes."""
+
+    def __init__(self, mod: SourceModule):
+        self.mod = mod
+        self.imports: dict[str, str] = {}       # alias -> dotted target
+        self.top: dict[str, _Func] = {}          # top-level name -> _Func
+        self.funcs: list[_Func] = []
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.Import):
+                for a in node.names:
+                    self.imports[a.asname or a.name.split(".")[0]] = (
+                        a.name if a.asname else a.name.split(".")[0])
+            elif isinstance(node, ast.ImportFrom) and node.module:
+                for a in node.names:
+                    self.imports[a.asname or a.name] = (
+                        f"{node.module}.{a.name}")
+        self._index_funcs(mod.tree, None, "")
+
+    def _index_funcs(self, node: ast.AST, parent: _Func | None,
+                     prefix: str) -> None:
+        for child in ast.iter_child_nodes(node):
+            if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                qn = f"{prefix}{child.name}"
+                fn = _Func(self.mod, child, qn, parent)
+                self.funcs.append(fn)
+                if parent is None:
+                    self.top[child.name] = fn
+                else:
+                    parent.children[child.name] = fn
+                self._index_funcs(child, fn, f"{qn}.")
+            elif isinstance(child, ast.ClassDef):
+                # methods participate like top-level functions of a
+                # nested namespace; traced methods are rare but legal
+                self._index_funcs(child, parent, f"{prefix}{child.name}.")
+            else:
+                self._index_funcs(child, parent, prefix)
+
+    def resolve_local(self, fn: _Func | None, name: str) -> _Func | None:
+        scope = fn
+        while scope is not None:
+            if name in scope.children:
+                return scope.children[name]
+            scope = scope.parent
+        return self.top.get(name)
+
+
+def _rel_to_dotted(rel: str) -> str:
+    return rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+
+
+def _walk_skip_funcs(node: ast.AST):
+    """Walk skipping function bodies (they scan themselves)."""
+    for child in ast.iter_child_nodes(node):
+        if isinstance(child, (ast.FunctionDef, ast.AsyncFunctionDef)):
+            continue
+        yield child
+        yield from _walk_skip_funcs(child)
+
+
+class StagingAnalysis:
+    """Whole-file-set staging analysis; ``run()`` returns findings."""
+
+    def __init__(self, modules: list[SourceModule]):
+        self.indexes = {m.rel: _ModuleIndex(m) for m in modules}
+        self.by_dotted = {_rel_to_dotted(m.rel): self.indexes[m.rel]
+                          for m in modules}
+        self.funcs: dict[tuple, _Func] = {}
+        for idx in self.indexes.values():
+            for fn in idx.funcs:
+                self.funcs[fn.key] = fn
+        self.traced_lambdas: list[tuple[_ModuleIndex, _Func | None,
+                                        ast.Lambda]] = []
+
+    # -- resolution -----------------------------------------------------
+    def _resolve(self, idx: _ModuleIndex, fn: _Func | None,
+                 node: ast.AST) -> _Func | None:
+        """Resolve a reference (Name / Attribute / partial(...) call) to
+        a function in the analyzed set, if statically possible."""
+        if isinstance(node, ast.Call):        # partial(f, ...) and kin
+            for arg in node.args[:1]:
+                return self._resolve(idx, fn, arg)
+            return None
+        if isinstance(node, ast.Name):
+            local = idx.resolve_local(fn, node.id)
+            if local is not None:
+                return local
+            dotted = idx.imports.get(node.id)
+            if dotted and "." in dotted:
+                mod_name, _, sym = dotted.rpartition(".")
+                target = self.by_dotted.get(mod_name)
+                if target is not None:
+                    return target.top.get(sym)
+            return None
+        if isinstance(node, ast.Attribute) and isinstance(node.value,
+                                                          ast.Name):
+            base = idx.imports.get(node.value.id)
+            target = self.by_dotted.get(base or "")
+            if target is not None:
+                return target.top.get(node.attr)
+        return None
+
+    # -- seeds ----------------------------------------------------------
+    def _is_jit_ref(self, idx: _ModuleIndex, node: ast.AST) -> bool:
+        d = _dotted(node)
+        if d is None:
+            return False
+        last = d.rsplit(".", 1)[-1]
+        return last == "jit" or d == "jit"
+
+    def _decorator_static_argnames(self, dec: ast.Call) -> set:
+        for kw in dec.keywords:
+            if kw.arg == "static_argnames":
+                try:
+                    v = ast.literal_eval(kw.value)
+                except (ValueError, SyntaxError):
+                    return set()
+                return {v} if isinstance(v, str) else set(v)
+        return set()
+
+    def _collect_seeds(self) -> None:
+        for rel, idx in self.indexes.items():
+            for fn in idx.funcs:
+                if not isinstance(fn.node, (ast.FunctionDef,
+                                            ast.AsyncFunctionDef)):
+                    continue
+                for dec in fn.node.decorator_list:
+                    if self._is_jit_ref(idx, dec):
+                        fn.traced = fn.direct_seed = True
+                    elif (isinstance(dec, ast.Call)
+                          and (self._is_jit_ref(idx, dec.func)
+                               or any(self._is_jit_ref(idx, a)
+                                      for a in dec.args))):
+                        fn.traced = fn.direct_seed = True
+                        fn.static_argnames |= (
+                            self._decorator_static_argnames(dec))
+                if idx.mod.marker(fn.node.lineno, "traced"):
+                    fn.traced = fn.direct_seed = True
+            # functions passed to trace entry points / host callbacks;
+            # the module-level scan skips function bodies (each function
+            # scans its own — no double registration of lambdas)
+            for fn in [None] + idx.funcs:
+                if fn is None:
+                    body_iter = _walk_skip_funcs(idx.mod.tree)
+                else:
+                    body_iter = self._own_nodes(fn)
+                for call in body_iter:
+                    if not isinstance(call, ast.Call):
+                        continue
+                    d = _dotted(call.func) or ""
+                    last = d.rsplit(".", 1)[-1]
+                    if last in CALLBACK_ATTRS:
+                        for arg in call.args[:1]:
+                            target = self._resolve(idx, fn, arg)
+                            if target is not None:
+                                target.callback_host = True
+                    elif last in TRACE_ENTRY_ATTRS:
+                        for arg in call.args:
+                            if isinstance(arg, ast.Lambda):
+                                self.traced_lambdas.append((idx, fn, arg))
+                                continue
+                            target = self._resolve(idx, fn, arg)
+                            if target is not None:
+                                target.traced = True
+                                target.direct_seed = True
+
+    def _propagate(self) -> None:
+        changed = True
+        while changed:
+            changed = False
+            for fn in self.funcs.values():
+                if not fn.traced or fn.callback_host:
+                    continue
+                # nested defs of a traced function are traced
+                for child in fn.children.values():
+                    if not child.traced and not child.callback_host:
+                        child.traced = True
+                        changed = True
+                idx = self.indexes[fn.mod.rel]
+                for node in self._own_nodes(fn):
+                    target = None
+                    if isinstance(node, (ast.Name, ast.Attribute)):
+                        target = self._resolve(idx, fn, node)
+                    if (target is not None and not target.traced
+                            and not target.callback_host):
+                        target.traced = True
+                        changed = True
+
+    def _own_nodes(self, fn: _Func):
+        """AST nodes of ``fn``'s body, excluding nested function/lambda
+        bodies (those are analyzed as their own traced entries)."""
+        skip_roots = tuple(c.node for c in fn.children.values())
+
+        def walk(node):
+            for child in ast.iter_child_nodes(node):
+                if child in skip_roots or isinstance(
+                        child, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                ast.Lambda)):
+                    continue
+                yield child
+                yield from walk(child)
+
+        yield from walk(fn.node)
+
+    # -- taint ----------------------------------------------------------
+    def _static_params(self, fn: _Func) -> set:
+        node = fn.node
+        static = set(fn.static_argnames)
+        args = node.args
+        static |= {a.arg for a in args.kwonlyargs}
+        for a in list(args.args) + list(args.posonlyargs):
+            ann = a.annotation
+            names = set()
+            if isinstance(ann, ast.Name):
+                names.add(ann.id)
+            elif isinstance(ann, ast.Constant) and isinstance(ann.value,
+                                                              str):
+                names.update(p.strip() for p in ann.value.split("|"))
+            elif isinstance(ann, ast.BinOp):    # "int | None" style
+                for part in ast.walk(ann):
+                    if isinstance(part, ast.Name):
+                        names.add(part.id)
+            if names and names <= (STATIC_ANNOTATIONS | {"None"}):
+                static.add(a.arg)
+        return static
+
+    def _jax_call_heads(self, idx: _ModuleIndex) -> set:
+        """Aliases whose call results are tracers inside traced code
+        (``jnp``/``lax``/``jax`` modules and symbols imported from
+        them)."""
+        heads = set()
+        for alias, dotted in idx.imports.items():
+            if dotted == "jax" or dotted.startswith("jax."):
+                heads.add(alias)
+        return heads
+
+    def _taint(self, fn: _Func) -> set:
+        node = fn.node
+        idx = self.indexes[fn.mod.rel]
+        jax_heads = self._jax_call_heads(idx)
+        tainted: set = set()
+        if fn.direct_seed:
+            args = node.args
+            params = [a.arg for a in
+                      list(args.posonlyargs) + list(args.args)
+                      + list(args.kwonlyargs)]
+            if args.vararg:
+                params.append(args.vararg.arg)
+            static = self._static_params(fn)
+            tainted = {p for p in params if p not in static}
+
+        def expr_tainted(e: ast.AST) -> bool:
+            for n in ast.walk(e):
+                if isinstance(n, ast.Name) and n.id in tainted:
+                    return True
+                if isinstance(n, ast.Call):
+                    d = _dotted(n.func) or ""
+                    if d.split(".", 1)[0] in jax_heads:
+                        return True
+            return False
+
+        def add_target(t: ast.AST) -> bool:
+            added = False
+            for n in ast.walk(t):
+                if isinstance(n, ast.Name) and n.id not in tainted:
+                    tainted.add(n.id)
+                    added = True
+            return added
+
+        changed = True
+        while changed:
+            changed = False
+            for stmt in self._own_nodes(fn):
+                if isinstance(stmt, ast.Assign):
+                    if expr_tainted(stmt.value):
+                        for t in stmt.targets:
+                            changed |= add_target(t)
+                elif isinstance(stmt, ast.AugAssign):
+                    if expr_tainted(stmt.value) or expr_tainted(stmt.target):
+                        changed |= add_target(stmt.target)
+                elif isinstance(stmt, ast.AnnAssign) and stmt.value:
+                    if expr_tainted(stmt.value):
+                        changed |= add_target(stmt.target)
+                elif isinstance(stmt, ast.For):
+                    if expr_tainted(stmt.iter):
+                        changed |= add_target(stmt.target)
+        return tainted
+
+    # -- detectors ------------------------------------------------------
+    def _np_aliases(self, idx: _ModuleIndex) -> set:
+        return {alias for alias, dotted in idx.imports.items()
+                if dotted in ("numpy", "np") or dotted == "numpy"}
+
+    def _check_body(self, idx: _ModuleIndex, fn_label: str, nodes,
+                    tainted: set, mod: SourceModule,
+                    out: list[Finding]) -> None:
+        np_aliases = self._np_aliases(idx)
+        time_aliases = {alias for alias, dotted in idx.imports.items()
+                        if dotted == "time"}
+        time_syms = {alias for alias, dotted in idx.imports.items()
+                     if dotted.startswith("time.")}
+        rand_aliases = {alias for alias, dotted in idx.imports.items()
+                        if dotted == "random"}
+
+        def emit(rule, node, detail):
+            f = mod.finding(rule, node, f"{fn_label}: {detail}")
+            if f is not None:
+                out.append(f)
+
+        def tainted_expr(e):
+            """A tainted name in a *value* position. Identity tests
+            (``x is None``), shape/dtype metadata reads, and static
+            introspection calls are trace-time-legal, so names inside
+            them are neutralized."""
+            neutral: set = set()
+            for n in ast.walk(e):
+                # is/is not: identity, never a tracer read; in/not in:
+                # dict/tuple key membership over tracer *values* is the
+                # repo idiom (`bi in un`) — static at trace time
+                if isinstance(n, ast.Compare) and all(
+                        isinstance(op, (ast.Is, ast.IsNot, ast.In,
+                                        ast.NotIn))
+                        for op in n.ops):
+                    for sub in ast.walk(n):
+                        neutral.add(id(sub))
+                elif isinstance(n, ast.Attribute) and n.attr in (
+                        "ndim", "shape", "dtype", "size"):
+                    for sub in ast.walk(n.value):
+                        neutral.add(id(sub))
+                elif isinstance(n, ast.Call):
+                    cname = (n.func.id if isinstance(n.func, ast.Name)
+                             else None)
+                    if cname in ("len", "getattr", "isinstance",
+                                 "hasattr", "type", "callable"):
+                        for a in n.args:
+                            for sub in ast.walk(a):
+                                neutral.add(id(sub))
+            return any(isinstance(n, ast.Name) and n.id in tainted
+                       and id(n) not in neutral
+                       for n in ast.walk(e))
+
+        for node in nodes:
+            if isinstance(node, ast.Call):
+                d = _dotted(node.func) or ""
+                head = d.split(".", 1)[0]
+                if head in time_aliases or d in time_syms:
+                    emit("KS001", node,
+                         f"host clock call '{d}' under trace")
+                elif isinstance(node.func, ast.Name) \
+                        and node.func.id == "print":
+                    emit("KS002", node, "print under trace")
+                elif head in rand_aliases:
+                    emit("KS003", node,
+                         f"unseeded random call '{d}' under trace")
+                elif head in np_aliases and ".random." in f".{d}.":
+                    emit("KS003", node,
+                         f"unseeded numpy random call '{d}' under trace")
+                elif head in np_aliases and "." in d:
+                    attr = d.split(".", 1)[1].split(".")[0]
+                    if (attr not in NP_STATIC_ALLOW
+                            and any(tainted_expr(a) for a in node.args)):
+                        emit("KS004", node,
+                             f"host numpy call '{d}' on a traced value")
+                elif (isinstance(node.func, ast.Attribute)
+                        and node.func.attr == "item"):
+                    emit("KS004", node,
+                         ".item() forces a host sync under trace")
+                elif (isinstance(node.func, ast.Name)
+                        and node.func.id in ("int", "float", "bool")
+                        and node.args
+                        and tainted_expr(node.args[0])):
+                    emit("KS004", node,
+                         f"{node.func.id}() on a traced value")
+            elif isinstance(node, (ast.If, ast.While)):
+                if tainted_expr(node.test):
+                    kw = "if" if isinstance(node, ast.If) else "while"
+                    emit("KS005", node,
+                         f"python '{kw}' on a traced value")
+            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+                targets = (node.targets if isinstance(node, ast.Assign)
+                           else [node.target])
+                for t in targets:
+                    if (isinstance(t, ast.Subscript)
+                            and tainted_expr(t.value)):
+                        emit("KS006", node,
+                             "in-place subscript store on a traced value")
+
+    def run(self) -> list[Finding]:
+        self._collect_seeds()
+        self._propagate()
+        out: list[Finding] = []
+        for fn in self.funcs.values():
+            if not fn.traced or fn.callback_host:
+                continue
+            idx = self.indexes[fn.mod.rel]
+            tainted = self._taint(fn)
+            self._check_body(idx, fn.qualname, self._own_nodes(fn),
+                             tainted, fn.mod, out)
+        for idx, fn, lam in self.traced_lambdas:
+            params = {a.arg for a in lam.args.args}
+            label = (f"{fn.qualname}.<lambda>" if fn is not None
+                     else "<lambda>")
+            self._check_body(idx, label, ast.walk(lam.body), params,
+                             idx.mod, out)
+        return out
+
+
+def check_staging(modules: list[SourceModule]) -> list[Finding]:
+    """The staging pass over one coherent file set (the kernel tier)."""
+    return StagingAnalysis(modules).run()

@@ -18,8 +18,6 @@ import os
 import sys
 import time
 
-import numpy as np
-
 from dgc_tpu.models.graph import Graph
 from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
 from dgc_tpu.obs import (

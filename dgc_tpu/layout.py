@@ -1,0 +1,71 @@
+"""Single source of truth for cross-module buffer layouts.
+
+Two fixed-shape int32 contracts cross module (and host/device)
+boundaries and have historically been hand-maintained in lockstep at
+every growth (PR 6 grew the serve carry 13 slots, PR 7 to 15; PR 3/5/7
+grew the trajectory row 4→5→6 columns):
+
+- the **serve slice carry** — the per-lane state tuple
+  ``serve.batched.batched_slice_kernel`` round-trips host↔device every
+  slice (packed/unpacked in ``serve.batched``, indexed by the scheduler
+  in ``serve.engine`` and by the serve tests);
+- the **trajectory buffer row** — the per-superstep telemetry row the
+  fused engines write inside their while-loops (``obs.kernel``), whose
+  column ids the host decoder, the emitters, and ``tune
+  --from-manifest`` all share.
+
+Every slot/column id and length lives HERE and nowhere else; the static
+layout checker (``dgc_tpu.analysis.layout_check``, ``tools/dgc_lint.py``
+rule family ``LY``) verifies that every pack site, unpack site, and
+constant-index subscript into these buffers agrees with this module —
+so widening a buffer without updating a consumer fails lint in seconds
+instead of surfacing as a parity mismatch on a queued TPU run.
+
+Plain integer literals only: the checker reads this file statically
+(``ast.literal_eval``), so no arithmetic, no imports, no derivation.
+The invariant tests live in ``tests/test_dgc_lint.py``.
+"""
+
+from __future__ import annotations
+
+# -- serve slice carry (serve.batched, one tuple element per slot) --------
+#
+# (phase, k, packed, step, prev_active, stall,   -- live sweep state
+#  p1, s1, st1, used, p2, s2, st2,               -- jump-pair result slots
+#  t_us, t_prev)                                 -- in-kernel timing slots
+CARRY_PHASE = 0        # 0 first attempt, 1 confirm, >=2 done/idle
+CARRY_K = 1            # live color budget
+CARRY_PACKED = 2       # packed per-vertex color/freshness state
+CARRY_STEP = 3         # superstep counter within the attempt
+CARRY_PREV_ACTIVE = 4  # previous superstep's active count (stall window)
+CARRY_STALL = 5        # stall counter
+CARRY_P1 = 6           # result slot 1: packed colors
+CARRY_S1 = 7           # result slot 1: supersteps
+CARRY_ST1 = 8          # result slot 1: status
+CARRY_USED = 9         # colors used by attempt 1 (confirm budget source)
+CARRY_P2 = 10          # result slot 2: packed colors
+CARRY_S2 = 11          # result slot 2: supersteps
+CARRY_ST2 = 12         # result slot 2: status
+T_US = 13              # accumulated live superstep wall-µs (timing mode)
+T_PREV = 14            # last in-kernel clock sample (timing mode)
+CARRY_LEN = 15
+
+OUT0 = 6               # first result slot (== CARRY_P1)
+N_OUT = 7              # result slots p1..st2
+
+# -- trajectory buffer row (obs.kernel, one column per metric) ------------
+COL_ACTIVE = 0         # global active count after the superstep
+COL_FAIL = 1           # failure-predicate flag
+COL_MC = 2             # divergence candidate (max forbidden-set fill)
+COL_GATHER_CALLS = 3   # neighbor-state element-gather call count
+COL_MAX_UNCONF = 4     # max unconfirmed-neighbor count over gathered rows
+COL_TS_US = 5          # in-kernel clock timestamp (obs.devclock)
+TRAJ_COLS = 6          # fixed columns before the bucket-active tail
+
+# unwritten-row / not-recorded fill for both buffers' telemetry values
+TRAJ_FILL = -1
+
+# 31-bit µs mask (obs.devclock): clock samples stored in COL_TS_US / T_US
+# must stay non-negative in int32 so they never collide with the
+# TRAJ_FILL sentinel — a layout constraint, hence defined here
+US_MASK = 0x7FFFFFFF

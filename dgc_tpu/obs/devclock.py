@@ -35,8 +35,10 @@ import time
 import numpy as np
 
 # 31-bit µs mask: values stay non-negative in int32 (the trajectory
-# buffer's −1 fill keeps meaning "unwritten") and wrap every ~35.8 min
-US_MASK = 0x7FFFFFFF
+# buffer's −1 fill keeps meaning "unwritten") and wrap every ~35.8 min.
+# Single-sourced in ``dgc_tpu.layout`` beside the column/slot ids the
+# masked samples land in.
+from dgc_tpu.layout import US_MASK
 
 
 def host_clock_us() -> int:

@@ -24,10 +24,12 @@ from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-class MetricsHTTPServer:
+class MetricsHTTPServer:   # dgc-lint: threaded
     """``MetricsHTTPServer(registry, port=9100).start()`` → live
     ``/metrics`` scrape endpoint; ``close()`` stops it. ``health_fn``
-    (optional, ``() -> dict``) backs ``/healthz``."""
+    (optional, ``() -> dict``) backs ``/healthz``. Handler threads only
+    ever read the construction-frozen registry/health_fn refs; the
+    server/thread handles belong to the owning thread."""
 
     def __init__(self, registry, port: int = 0, host: str = "127.0.0.1",
                  health_fn=None):
@@ -58,7 +60,7 @@ class MetricsHTTPServer:
 
         self._server = ThreadingHTTPServer((host, int(port)), _Handler)
         self._server.daemon_threads = True
-        self._thread: threading.Thread | None = None
+        self._thread: threading.Thread | None = None   # guarded-by: owner
 
     @property
     def port(self) -> int:

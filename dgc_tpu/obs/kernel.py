@@ -63,8 +63,12 @@ from dataclasses import dataclass
 
 import numpy as np
 
-TRAJ_COLS = 6          # active, fail, mc, gather_calls, max_unconf,
-                       # ts_us — before the bucket-active tail
+# column ids + row width single-sourced in ``dgc_tpu.layout`` (COL_*):
+# active, fail, mc, gather_calls, max_unconf, ts_us — before the
+# bucket-active tail
+from dgc_tpu.layout import (COL_ACTIVE, COL_FAIL, COL_GATHER_CALLS, COL_MC,
+                            COL_MAX_UNCONF, COL_TS_US, TRAJ_COLS, TRAJ_FILL)
+
 DEFAULT_TRAJ_CAP = 4096
 
 
@@ -85,7 +89,7 @@ def traj_empty(cap: int, nb: int = 0, dummy: bool = False,
 
     rows = 1 if dummy else cap
     return jnp.full((rows, TRAJ_COLS + nb * (2 if unconf_b else 1)),
-                    -1, jnp.int32)
+                    TRAJ_FILL, jnp.int32)
 
 
 def make_trajstep(record, timing: bool = False):
@@ -109,6 +113,7 @@ def make_trajstep(record, timing: bool = False):
     """
     import jax.numpy as jnp
 
+    # dgc-lint: traced — this closure runs inside the engines' kernels
     def trajstep(traj, step, active, any_fail, mc=None, ba=None,
                  gcalls=None, unconf=None):
         if record is False:
@@ -192,7 +197,7 @@ def decode_trajectory(buf, supersteps: int | None = None,
     second ``nb`` columns decode as the per-bucket max-unconf vector.
     """
     buf = np.asarray(buf)
-    written = buf[:, 0] >= 0
+    written = buf[:, COL_ACTIVE] >= 0
     idx = np.flatnonzero(written)
     if len(idx) == 0:
         empty = np.zeros(0, np.int32)
@@ -203,23 +208,23 @@ def decode_trajectory(buf, supersteps: int | None = None,
     tail = buf.shape[1] - TRAJ_COLS
     nb = tail // 2 if unconf_b else tail
     truncated = bool(supersteps is not None and supersteps > buf.shape[0])
-    # col-5 timestamps → per-superstep deltas: row i's wall time is
+    # timestamp column → per-superstep deltas: row i's wall time is
     # ts[i] − ts[i−1] (wrap-safe), leaving the span's first row −1 (its
     # predecessor timestamp is outside the recorded span)
-    ts = span[:, 5].astype(np.int32)
+    ts = span[:, COL_TS_US].astype(np.int32)
     step_us = None
     if (ts >= 0).any():
         from dgc_tpu.obs.devclock import wrap_delta_us
 
-        step_us = np.full(len(ts), -1, np.int32)
+        step_us = np.full(len(ts), TRAJ_FILL, np.int32)
         ok = (ts[1:] >= 0) & (ts[:-1] >= 0)
         step_us[1:][ok] = wrap_delta_us(ts[:-1][ok], ts[1:][ok])
     return SuperstepTrajectory(
-        active=span[:, 0].astype(np.int32),
-        fail=span[:, 1].astype(np.int32),
-        mc=span[:, 2].astype(np.int32),
-        gather_calls=span[:, 3].astype(np.int32),
-        max_unconf=span[:, 4].astype(np.int32),
+        active=span[:, COL_ACTIVE].astype(np.int32),
+        fail=span[:, COL_FAIL].astype(np.int32),
+        mc=span[:, COL_MC].astype(np.int32),
+        gather_calls=span[:, COL_GATHER_CALLS].astype(np.int32),
+        max_unconf=span[:, COL_MAX_UNCONF].astype(np.int32),
         bucket_active=(span[:, TRAJ_COLS:TRAJ_COLS + nb].astype(np.int32)
                        if nb > 0 else None),
         first_step=lo,

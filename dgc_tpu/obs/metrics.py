@@ -49,8 +49,8 @@ def _labels_str(labels: dict) -> str:
 class Counter:
     name: str
     help: str
-    labels: dict = field(default_factory=dict)
-    value: float = 0.0
+    labels: dict = field(default_factory=dict)   # guarded-by: init
+    value: float = 0.0                           # guarded-by: _lock
     # serve worker threads mutate concurrently with exporter reads; the
     # per-metric lock makes each update/read atomic (MetricsRegistry's
     # lock only guards the get-or-create dict)
@@ -68,8 +68,8 @@ class Counter:
 class Gauge:
     name: str
     help: str
-    labels: dict = field(default_factory=dict)
-    value: float = 0.0
+    labels: dict = field(default_factory=dict)   # guarded-by: init
+    value: float = 0.0                           # guarded-by: _lock
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
 
@@ -82,11 +82,11 @@ class Gauge:
 class Histogram:
     name: str
     help: str
-    labels: dict = field(default_factory=dict)
-    buckets: tuple = DEFAULT_TIME_BUCKETS
-    counts: list = None
-    total: float = 0.0
-    n: int = 0
+    labels: dict = field(default_factory=dict)   # guarded-by: init
+    buckets: tuple = DEFAULT_TIME_BUCKETS        # guarded-by: init
+    counts: list = None                          # guarded-by: _lock
+    total: float = 0.0                           # guarded-by: _lock
+    n: int = 0                                   # guarded-by: _lock
     _lock: threading.Lock = field(default_factory=threading.Lock,
                                   repr=False, compare=False)
 
@@ -142,8 +142,8 @@ class MetricsRegistry:
     metric's own lock makes updates and exporter reads atomic."""
 
     def __init__(self):
-        self._metrics: dict = {}   # (name, labelkey) -> metric
-        self._meta: dict = {}      # name -> (kind, help)
+        self._metrics: dict = {}   # (name, labelkey) -> metric; guarded-by: _lock
+        self._meta: dict = {}      # name -> (kind, help); guarded-by: _lock
         self._lock = threading.RLock()
 
     def _get(self, cls, kind: str, name: str, help: str, labels: dict, **kw):

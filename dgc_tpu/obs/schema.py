@@ -130,8 +130,11 @@ EVENT_SCHEMAS: dict = {
     "metrics_server": ({"port": "int"}, {"host": "str"}),
     "serve_warmup": (
         {"classes": "int", "kernels": "int", "seconds": NUM}, {}),
+    # request_id accepts str: JSONL replay ids round-trip verbatim (the
+    # PR 6 non-int-id contract, tests/test_serve.py) — found by driving
+    # a string-id replay through validate_runlog
     "serve_request": (
-        {"request_id": "int", "status": "str", "queue_ms": NUM,
+        {"request_id": ("int", "str"), "status": "str", "queue_ms": NUM,
          "service_ms": NUM},
         {"minimal_colors": ("int", "null"), "v": "int",
          "shape_class": ("str", "null"), "batched": "bool",

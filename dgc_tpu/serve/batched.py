@@ -85,6 +85,8 @@ import numpy as np
 
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
 from dgc_tpu.engine.bucketed import decode_combined, initial_packed, status_step
+from dgc_tpu.layout import (CARRY_LEN, CARRY_PHASE, N_OUT, OUT0, T_PREV,
+                            T_US)
 from dgc_tpu.ops.speculative import speculative_update_mc
 
 _RUNNING = AttemptStatus.RUNNING
@@ -95,16 +97,13 @@ _STALLED = AttemptStatus.STALLED
 DEFAULT_STALL_WINDOW = 64  # the engines' shared defensive exit
 
 # per-lane carry layout (the slice kernel's host<->device contract):
+# single-sourced in ``dgc_tpu.layout`` (slot ids CARRY_*/T_US/T_PREV) —
 # (phase, k, packed, step, prev_active, stall,   -- live sweep state
 #  p1, s1, st1, used, p2, s2, st2,               -- jump-pair result slots
 #  t_us, t_prev)                                 -- in-kernel timing slots
 # The timing slots ride inert (zeros) unless the kernel is compiled with
 # ``timing=True`` (obs.devclock): t_us accumulates the lane's live
 # superstep wall-µs, t_prev holds the last superstep's clock sample.
-CARRY_LEN = 15
-_OUT0 = 6          # index of the first result slot (p1) in the carry
-_N_OUT = 7         # result slots p1..st2
-T_US = 13          # index of the accumulated device-µs timing slot
 
 
 def _fresh_lane(degrees, k0):
@@ -218,7 +217,7 @@ def _sweep_pair_one(comb, degrees, k0, max_steps, *, planes: int,
                                planes=planes, stall_window=stall_window)
 
     out = jax.lax.while_loop(cond, body, _fresh_lane(degrees, k0))
-    return out[_OUT0:_OUT0 + _N_OUT]
+    return out[OUT0:OUT0 + N_OUT]
 
 
 def _slice_one(comb, degrees, k0, max_steps, reset, carry, *, planes: int,
@@ -240,10 +239,10 @@ def _slice_one(comb, degrees, k0, max_steps, reset, carry, *, planes: int,
         # seed the clock at slice entry for lanes without a prior sample
         # (fresh seats and first-slice lanes), so their first superstep
         # is attributed from the slice boundary
-        ts0 = kernel_clock_us(carry[0])
-        live = carry[0] < 2
-        t_prev = jnp.where(live & (carry[14] == 0), ts0, carry[14])
-        carry = carry[:14] + (t_prev,)
+        ts0 = kernel_clock_us(carry[CARRY_PHASE])
+        live = carry[CARRY_PHASE] < 2
+        t_prev = jnp.where(live & (carry[T_PREV] == 0), ts0, carry[T_PREV])
+        carry = carry[:T_PREV] + (t_prev,)
 
     def cond(c):
         return (c[1] < 2) & (c[0] < slice_steps)
@@ -312,7 +311,7 @@ def lane_outputs(carry_np, lane: int):
     the sweep-result convention ``finish_pair`` consumes — from a
     host-materialized carry."""
     p1, s1, st1, used, p2, s2, st2 = (carry_np[j][lane]
-                                      for j in range(_OUT0, _OUT0 + _N_OUT))
+                                      for j in range(OUT0, OUT0 + N_OUT))
     return p1, s1, st1, int(used), p2, s2, int(st2)
 
 

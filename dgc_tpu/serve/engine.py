@@ -62,11 +62,10 @@ import time
 import numpy as np
 
 from dgc_tpu.engine.base import AttemptResult, empty_budget_failure
+from dgc_tpu.layout import CARRY_LEN, CARRY_PHASE, T_US
 from dgc_tpu.obs.trace import NULL_TRACER
 from dgc_tpu.serve.batched import (
-    CARRY_LEN,
     DEFAULT_STALL_WINDOW,
-    T_US,
     auto_slice_steps,
     batched_slice_kernel,
     batched_sweep_kernel,
@@ -121,11 +120,13 @@ class _SweepCall:
         self.device_us = None      # in-kernel superstep µs (timing mode)
 
 
-class _LanePool:
+class _LanePool:   # dgc-lint: owned-by dispatcher
     """One shape class's host-side lane state (continuous mode): the
     kernel's inputs (mutated only when a lane is swapped), the device
     carry (round-tripped every slice), and the per-lane call bookkeeping.
-    Owned by the dispatcher thread — no locking."""
+    Owned by the dispatcher thread — no locking (the ``owned-by``
+    marker above is the checked claim; ``BatchScheduler.stop`` touches
+    pools only after joining the dispatcher)."""
 
     __slots__ = ("cls", "b_pad", "comb", "degrees", "k0", "max_steps",
                  "reset", "carry", "calls", "t_fill", "slices_in",
@@ -290,18 +291,21 @@ class BatchScheduler:
         self.on_batch = on_batch
         self.on_event = on_event
         self.tracer = tracer if tracer is not None else NULL_TRACER
+        # the Condition wraps an RLock, so guarded sections nest freely
         self._lock = threading.Condition()
-        self._pending: dict = {}   # class -> [_SweepCall]
-        self._kernels: dict = {}   # compile-cache key -> fn
-        self._dummies: dict = {}   # class -> ServeMember
-        self._pools: dict = {}     # class -> _LanePool (dispatcher-owned)
-        self._timing_acc: dict = {}  # class -> [n, overhead_s, iter_s]
-        self._recal: dict = {}     # class -> measured slice_steps override
-        self._stop = False
-        self._thread = None
+        self._pending: dict = {}   # class -> [_SweepCall]; guarded-by: _lock
+        self._kernels: dict = {}   # compile-cache key -> fn; guarded-by: _lock
+        self._dummies: dict = {}   # class -> ServeMember; guarded-by: _lock
+        self._pools: dict = {}     # class -> _LanePool; guarded-by: dispatcher
+        self._timing_acc: dict = {}  # cls -> [n, ovh, it]; guarded-by: dispatcher
+        self._recal: dict = {}     # cls -> slice_steps; guarded-by: _lock
+        self._stop = False         # guarded-by: _lock
+        self._thread = None        # guarded-by: owner
+        # mutated by the dispatcher AND the warm path (front-end caller
+        # thread), read live by serve_summary/bench
         self.stats = {"batches": 0, "sweeps": 0, "compile_hits": 0,
                       "compile_misses": 0, "slices": 0, "recycles": 0,
-                      "max_live": 0, "recals": 0}
+                      "max_live": 0, "recals": 0}   # guarded-by: _lock
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "BatchScheduler":
@@ -371,9 +375,10 @@ class BatchScheduler:
         latency. Returns the number of kernels warmed. Call before
         ``start()`` or from the dispatching thread's quiet periods; the
         jit cache is process-global so warming races nothing."""
-        dummy = self._dummies.get(cls)
-        if dummy is None:
-            dummy = self._dummies[cls] = dummy_member(cls)
+        with self._lock:
+            dummy = self._dummies.get(cls)
+            if dummy is None:
+                dummy = self._dummies[cls] = dummy_member(cls)
         warmed = 0
         for b in pad_ladder(self.batch_max):
             comb = np.repeat(dummy.comb[None], b, axis=0)
@@ -416,35 +421,42 @@ class BatchScheduler:
         return sorted(calls, key=key)
 
     # -- compile caches -------------------------------------------------
+    # the kernel cache and its hit/miss stats are mutated by BOTH the
+    # dispatcher thread (every dispatch) and the warm path (the
+    # front-end's caller thread, possibly while serving) — the found
+    # dgc-lint LK finding this section now locks against
     def _kernel_for(self, cls, b_pad: int):
         key = ("sync", cls.v_pad, cls.w_pad, cls.planes, b_pad)
-        hit = key in self._kernels
-        if not hit:
-            self._kernels[key] = lambda *a: batched_sweep_kernel(
-                *a, planes=cls.planes, stall_window=self.stall_window)
-            self.stats["compile_misses"] += 1
-        else:
-            self.stats["compile_hits"] += 1
-        return self._kernels[key], hit
+        with self._lock:
+            hit = key in self._kernels
+            if not hit:
+                self._kernels[key] = lambda *a: batched_sweep_kernel(
+                    *a, planes=cls.planes, stall_window=self.stall_window)
+                self.stats["compile_misses"] += 1
+            else:
+                self.stats["compile_hits"] += 1
+            return self._kernels[key], hit
 
     def _slice_kernel_for(self, cls, b_pad: int):
         s = self.resolved_slice_steps(cls, b_pad)
         key = ("slice", cls.v_pad, cls.w_pad, cls.planes, b_pad, s,
                self.timing)
-        hit = key in self._kernels
-        if not hit:
-            self._kernels[key] = lambda *a: batched_slice_kernel(
-                *a, planes=cls.planes, slice_steps=s,
-                stall_window=self.stall_window, timing=self.timing)
-            self.stats["compile_misses"] += 1
-        else:
-            self.stats["compile_hits"] += 1
-        return self._kernels[key], hit
+        with self._lock:
+            hit = key in self._kernels
+            if not hit:
+                self._kernels[key] = lambda *a: batched_slice_kernel(
+                    *a, planes=cls.planes, slice_steps=s,
+                    stall_window=self.stall_window, timing=self.timing)
+                self.stats["compile_misses"] += 1
+            else:
+                self.stats["compile_hits"] += 1
+            return self._kernels[key], hit
 
     def resolved_slice_steps(self, cls, b_pad: int) -> int:
         if self.slice_steps is not None:
             return self.slice_steps
-        recal = self._recal.get(cls)
+        with self._lock:
+            recal = self._recal.get(cls)
         if recal is not None:
             return recal
         return auto_slice_steps(cls.entries(), b_pad)
@@ -458,8 +470,10 @@ class BatchScheduler:
         acc[0] += 1
         acc[1] += overhead_s
         acc[2] += iter_s
-        if (self.slice_steps is not None or cls in self._recal
-                or acc[0] < self.recal_min_slices):
+        with self._lock:
+            done = (self.slice_steps is not None or cls in self._recal
+                    or acc[0] < self.recal_min_slices)
+        if done:
             return
         overhead = acc[1] / acc[0]
         iter_mean = acc[2] / acc[0]
@@ -467,9 +481,11 @@ class BatchScheduler:
         s_old = auto_slice_steps(cls.entries(),
                                  self._pools[cls].b_pad
                                  if cls in self._pools else 1)
-        self._recal[cls] = s_new
+        with self._lock:
+            self._recal[cls] = s_new
         if s_new != s_old:
-            self.stats["recals"] += 1
+            with self._lock:
+                self.stats["recals"] += 1
             if self.on_event is not None:
                 self.on_event("slice_recalibrated", {
                     "shape_class": cls.name, "from_steps": int(s_old),
@@ -530,8 +546,9 @@ class BatchScheduler:
             classes.update(c for c, p in self._pools.items() if p.live)
             # deterministic service order (sets hash-order otherwise)
             for cls in sorted(classes, key=lambda c: c.name):
-                if self._stop:
-                    return
+                with self._lock:
+                    if self._stop:
+                        return
                 try:
                     self._service_class(cls)
                 except Exception as e:  # pragma: no cover - defensive
@@ -553,9 +570,10 @@ class BatchScheduler:
         draining pool."""
         pool = self._pools.get(cls)
         if pool is None:
-            dummy = self._dummies.get(cls)
-            if dummy is None:
-                dummy = self._dummies[cls] = dummy_member(cls)
+            with self._lock:
+                dummy = self._dummies.get(cls)
+                if dummy is None:
+                    dummy = self._dummies[cls] = dummy_member(cls)
             pool = self._pools[cls] = _LanePool(cls, 1, dummy)
 
         free = self.batch_max - pool.live
@@ -591,7 +609,7 @@ class BatchScheduler:
         t0 = time.perf_counter()
         carry = kernel(comb_dev, degrees_dev, pool.k0, pool.max_steps,
                        pool.reset, pool.carry)
-        phase = np.asarray(carry[0])   # forces the dispatch; tiny
+        phase = np.asarray(carry[CARRY_PHASE])   # forces the dispatch; tiny
         device_s = time.perf_counter() - t0
         pool.carry = carry
         pool.reset[:] = 0
@@ -629,8 +647,9 @@ class BatchScheduler:
                          "device_us": call.device_us})
                 call.done.set()
                 pool.calls[lane] = None
-                self.stats["sweeps"] += 1
-                self.stats["recycles"] += 1
+                with self._lock:
+                    self.stats["sweeps"] += 1
+                    self.stats["recycles"] += 1
                 if self.on_event is not None:
                     rec = {
                         "shape_class": cls.name, "lane": int(lane),
@@ -645,9 +664,10 @@ class BatchScheduler:
                         rec["device_us"] = call.device_us
                     self.on_event("lane_recycled", rec)
 
-        self.stats["batches"] += 1
-        self.stats["slices"] += 1
-        self.stats["max_live"] = max(self.stats["max_live"], live)
+        with self._lock:
+            self.stats["batches"] += 1
+            self.stats["slices"] += 1
+            self.stats["max_live"] = max(self.stats["max_live"], live)
         slice_span.end({"done": len(done_lanes), "admitted": int(admitted)})
         if self.on_event is not None:
             rec = {
@@ -727,9 +747,10 @@ class BatchScheduler:
         members = [c.member for c in calls]
         fill = b_pad - b
         if fill:
-            dummy = self._dummies.get(cls)
-            if dummy is None:
-                dummy = self._dummies[cls] = dummy_member(cls)
+            with self._lock:
+                dummy = self._dummies.get(cls)
+                if dummy is None:
+                    dummy = self._dummies[cls] = dummy_member(cls)
             members = members + [dummy] * fill
         comb = np.stack([m.comb for m in members])
         degrees = np.stack([m.degrees for m in members])
@@ -748,9 +769,10 @@ class BatchScheduler:
 
         queue_ms_max = max(
             (t0 - c.t_enqueue) * 1e3 for c in calls)
-        self.stats["batches"] += 1
-        self.stats["sweeps"] += b
-        self.stats["max_live"] = max(self.stats["max_live"], b)
+        with self._lock:
+            self.stats["batches"] += 1
+            self.stats["sweeps"] += b
+            self.stats["max_live"] = max(self.stats["max_live"], b)
         if self.on_batch is not None:
             # straggler waste: the fraction of dispatched real-lane
             # supersteps spent re-running already-finished lanes while

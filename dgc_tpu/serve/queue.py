@@ -36,7 +36,7 @@ from dgc_tpu.engine.minimal_k import (find_minimal_coloring, make_reducer,
                                       make_validator)
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.obs.trace import NULL_TRACER, tracer_for
-from dgc_tpu.resilience.supervisor import RungState, SweepAbort, supervise_sweep
+from dgc_tpu.resilience.supervisor import RungState, supervise_sweep
 from dgc_tpu.serve.engine import BatchMemberEngine, BatchScheduler, ServeError
 from dgc_tpu.serve.shape_classes import DEFAULT_LADDER, ShapeLadder, pad_member
 
@@ -171,15 +171,17 @@ class ServeFrontEnd:
                                         on_batch=self._on_batch,
                                         on_event=self._on_sched_event,
                                         tracer=self.tracer)
+        # the Condition wraps an RLock, so guarded sections nest freely
         self._lock = threading.Condition()
-        self._queue: deque = deque()
-        self._threads: list = []
-        self._in_flight = 0
-        self._next_id = 0
-        self._started = False
-        self._draining = False
+        self._queue: deque = deque()   # guarded-by: _lock
+        self._threads: list = []       # guarded-by: owner
+        self._in_flight = 0            # guarded-by: _lock
+        self._next_id = 0              # guarded-by: _lock
+        self._started = False          # guarded-by: _lock
+        self._draining = False         # guarded-by: _lock
+        # mutated by every worker thread, read live by health/summary
         self.stats = {"submitted": 0, "completed": 0, "failed": 0,
-                      "rejected": 0, "fallbacks": 0}
+                      "rejected": 0, "fallbacks": 0}   # guarded-by: _lock
 
     # -- obs plumbing ---------------------------------------------------
     def _event(self, kind: str, **fields) -> None:
@@ -211,9 +213,10 @@ class ServeFrontEnd:
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "ServeFrontEnd":
-        if self._started:
-            return self
-        self._started = True
+        with self._lock:
+            if self._started:
+                return self
+            self._started = True
         self.scheduler.start()
         for i in range(self.workers):
             t = threading.Thread(target=self._worker, daemon=True,
@@ -274,19 +277,21 @@ class ServeFrontEnd:
             t.join(timeout=max(0.0, deadline - time.perf_counter()))
         self._threads.clear()
         self.scheduler.stop()
-        self._event("serve_done", requests=self.stats["submitted"],
-                    completed=self.stats["completed"],
-                    failed=self.stats["failed"],
-                    rejected=self.stats["rejected"])
+        with self._lock:
+            st = dict(self.stats)
+        self._event("serve_done", requests=st["submitted"],
+                    completed=st["completed"],
+                    failed=st["failed"],
+                    rejected=st["rejected"])
 
     # -- submission -----------------------------------------------------
     def submit(self, arrays: GraphArrays, request_id: int | None = None,
                timeout: float = 0.0) -> ServeTicket:
         """Admit one request; raises :class:`QueueFull` when the bounded
         queue stays full past ``timeout`` (0 = reject immediately)."""
-        if not self._started:
-            raise ServeError("front-end not started")
         with self._lock:
+            if not self._started:
+                raise ServeError("front-end not started")
             if self._draining:
                 raise ServeError("front-end shutting down")
             if len(self._queue) >= self.queue_depth and timeout > 0:
@@ -407,10 +412,13 @@ class ServeFrontEnd:
                 with self._lock:
                     self._in_flight -= 1
             serve_span.end({"status": result.status})
-            if result.status == "ok":
-                self.stats["completed"] += 1
-            else:
-                self.stats["failed"] += 1
+            # dgc-lint LK001 fix: workers race each other (and the
+            # shutdown/summary readers) on these counters
+            with self._lock:
+                if result.status == "ok":
+                    self.stats["completed"] += 1
+                else:
+                    self.stats["failed"] += 1
             self._event(
                 "serve_request", request_id=req.request_id,
                 status=result.status,
@@ -484,7 +492,8 @@ class ServeFrontEnd:
         :meth:`health`. The tuned-config cache (when auto-tuning) keys
         the first rung's schedule by graph-shape hash — recurring shapes
         skip the replay (ROADMAP serving-path item)."""
-        self.stats["fallbacks"] += 1
+        with self._lock:
+            self.stats["fallbacks"] += 1
         tuned_kw: dict = {}
         if self._tuned_cache is not None and self.auto_tune:
             tuned_kw = self._tuned_cache.get_or_tune(arrays).engine_kwargs(

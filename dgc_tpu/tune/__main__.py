@@ -17,7 +17,6 @@ import argparse
 import json
 import sys
 
-from dgc_tpu.tune.config import TunedConfig
 from dgc_tpu.tune.search import tune_from_manifest, tune_schedule
 from dgc_tpu.utils.trajectory import add_graph_args, load_graph_args
 

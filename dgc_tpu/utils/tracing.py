@@ -23,7 +23,6 @@ from dataclasses import dataclass, field
 
 import jax
 import jax.numpy as jnp
-import numpy as np
 
 from dgc_tpu.ops.speculative import beats_rule
 

@@ -193,6 +193,7 @@ def test_slice_kernel_bit_identical_to_sweep_kernel():
     sweep (shared ``_superstep_body``)."""
     import numpy as np
 
+    from dgc_tpu.layout import CARRY_PHASE, OUT0
     from dgc_tpu.serve.batched import (batched_slice_kernel,
                                        batched_sweep_kernel, idle_carry)
 
@@ -216,11 +217,11 @@ def test_slice_kernel_bit_identical_to_sweep_kernel():
                                          reset, carry, planes=cls.planes,
                                          slice_steps=s)
             reset = np.zeros(4, np.int32)
-            if (np.asarray(carry[0]) >= 2).all():
+            if (np.asarray(carry[CARRY_PHASE]) >= 2).all():
                 break
         else:
             raise AssertionError("slice loop did not converge")
-        got = [np.asarray(a) for a in carry[6:]]
+        got = [np.asarray(a) for a in carry[OUT0:]]
         for g_arr, w_arr in zip(got, want):
             assert np.array_equal(g_arr, w_arr), f"slice_steps={s}"
 
@@ -232,7 +233,8 @@ def test_slice_kernel_timing_variant_bit_identical():
     accumulate positive device time."""
     import numpy as np
 
-    from dgc_tpu.serve.batched import (T_US, batched_slice_kernel,
+    from dgc_tpu.layout import CARRY_PHASE, N_OUT, OUT0, T_US
+    from dgc_tpu.serve.batched import (batched_slice_kernel,
                                        batched_sweep_kernel, idle_carry)
 
     cls = ShapeClass(2048, 32)
@@ -254,11 +256,11 @@ def test_slice_kernel_timing_variant_bit_identical():
                                      reset, carry, planes=cls.planes,
                                      slice_steps=3, timing=True)
         reset = np.zeros(4, np.int32)
-        if (np.asarray(carry[0]) >= 2).all():
+        if (np.asarray(carry[CARRY_PHASE]) >= 2).all():
             break
     else:
         raise AssertionError("timed slice loop did not converge")
-    got = [np.asarray(a) for a in carry[6:13]]
+    got = [np.asarray(a) for a in carry[OUT0:OUT0 + N_OUT]]
     for g_arr, w_arr in zip(got, want):
         assert np.array_equal(g_arr, w_arr)
     t_us = np.asarray(carry[T_US])
@@ -513,6 +515,30 @@ def test_string_request_ids_round_trip():
         assert r_auto.ok and isinstance(r_auto.request_id, int)
     finally:
         fe.shutdown()
+
+
+def test_string_request_id_events_pass_schema():
+    """The serve_request event a string-id request emits must validate —
+    the schema typed request_id int-only while the front-end accepted
+    str ids, so every JSONL replay's run log failed validate_runlog
+    (found driving a replay end-to-end; schema fixed to (int, str))."""
+    from dgc_tpu.obs.events import RunLogger
+    from dgc_tpu.obs.schema import validate_record
+
+    records = []
+    logger = RunLogger(echo=False)
+    logger.add_sink(records.append)
+    fe = ServeFrontEnd(batch_max=2, window_s=0.0, queue_depth=8,
+                       logger=logger).start()
+    try:
+        g = generate_random_graph_fast(300, avg_degree=6, seed=3)
+        assert fe.submit(g, request_id="req-s").result(timeout=300).ok
+    finally:
+        fe.shutdown()
+    reqs = [r for r in records if r.get("event") == "serve_request"]
+    assert reqs and reqs[0]["request_id"] == "req-s"
+    for rec in records:
+        assert validate_record(rec) == [], rec
 
 
 def test_batching_window_coalesces_concurrent_requests():

@@ -1,0 +1,103 @@
+#!/usr/bin/env python
+"""dgc-lint: the repo's static-analysis gate (``dgc_tpu.analysis``).
+
+Runs the four AST passes (kernel staging KS*, carry/layout LY*, event
+schema SC*, lock discipline LK*) over the package and compares the
+findings against the committed baseline of accepted exceptions.
+
+Usage:
+  python tools/dgc_lint.py                 # report all findings
+  python tools/dgc_lint.py --strict        # exit 1 on any non-baselined
+  python tools/dgc_lint.py --passes locks  # one pass only
+  python tools/dgc_lint.py --write-baseline  # accept current findings
+
+Exit codes: 0 clean (or all findings baselined), 1 non-baselined
+findings under ``--strict``, 2 usage/load error.
+
+The baseline (``tools/dgc_lint_baseline.json``) keys findings by
+``(rule, file, detail)`` — no line numbers, so unrelated edits never
+churn it. A stale baseline entry (accepted finding that no longer
+fires) is reported so the baseline shrinks monotonically; under
+``--strict`` staleness is a warning, not a failure.
+"""
+
+from __future__ import annotations
+
+import argparse
+import os
+import sys
+from pathlib import Path
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+from dgc_tpu.analysis import (PASSES, load_baseline, run_passes,  # noqa: E402
+                              split_baseline, write_baseline)
+
+
+def main(argv=None) -> int:
+    ap = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    ap.add_argument("--root", default=None,
+                    help="repo root (default: this script's parent repo)")
+    ap.add_argument("--baseline", default=None,
+                    help="baseline JSON (default: tools/"
+                         "dgc_lint_baseline.json under the root)")
+    ap.add_argument("--passes", default=",".join(PASSES),
+                    help=f"comma-separated subset of {','.join(PASSES)}")
+    ap.add_argument("--strict", action="store_true",
+                    help="exit 1 on any non-baselined finding")
+    ap.add_argument("--write-baseline", action="store_true",
+                    help="accept all current findings into the baseline")
+    args = ap.parse_args(argv)
+
+    root = Path(args.root) if args.root else \
+        Path(__file__).resolve().parent.parent
+    if not (root / "dgc_tpu").is_dir():
+        print(f"dgc_lint: no dgc_tpu package under {root}",
+              file=sys.stderr)
+        return 2
+    passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
+    unknown = [p for p in passes if p not in PASSES]
+    if unknown:
+        print(f"dgc_lint: unknown pass(es) {unknown}; "
+              f"have {list(PASSES)}", file=sys.stderr)
+        return 2
+    baseline_path = Path(args.baseline) if args.baseline else \
+        root / "tools" / "dgc_lint_baseline.json"
+
+    try:
+        findings = run_passes(root, passes)
+    except (OSError, SyntaxError) as e:
+        print(f"dgc_lint: cannot analyze: {e}", file=sys.stderr)
+        return 2
+
+    if args.write_baseline:
+        write_baseline(baseline_path, findings)
+        print(f"dgc_lint: wrote {len(findings)} accepted finding(s) to "
+              f"{baseline_path}")
+        return 0
+
+    try:
+        baseline = load_baseline(baseline_path)
+    except (OSError, ValueError, KeyError) as e:
+        print(f"dgc_lint: bad baseline {baseline_path}: {e}",
+              file=sys.stderr)
+        return 2
+    new, accepted, stale = split_baseline(findings, baseline)
+
+    for f in new:
+        print(f)
+    if accepted:
+        print(f"dgc_lint: {len(accepted)} baselined finding(s) suppressed")
+    for rule, file, detail in stale:
+        print(f"dgc_lint: stale baseline entry {rule} {file}: {detail} "
+              f"(no longer fires — remove it)", file=sys.stderr)
+    npass = len(passes)
+    print(f"dgc_lint: {npass} pass(es), {len(findings)} finding(s), "
+          f"{len(new)} new")
+    if new and args.strict:
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    sys.exit(main())

@@ -14,6 +14,16 @@ set -u
 cd "$(dirname "$0")/.."
 OUT="${1:-PERF_RUNS.jsonl}"
 
+# gate 0 — static analysis: the structural invariants every evidence run
+# leans on (kernel staging, carry/traj layout, event schema, lock
+# discipline) must hold BEFORE burning device time. Same gate as the
+# pre-commit hook and the tier-1 test (tests/test_dgc_lint.py).
+echo "=== dgc_lint --strict ===" >&2
+if ! python tools/dgc_lint.py --strict; then
+  echo "evidence_suite: dgc_lint --strict failed — fix or baseline before capturing evidence" >&2
+  exit 3
+fi
+
 bash tools/bench_suite.sh "$OUT"
 battery_rc=$?
 
