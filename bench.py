@@ -74,7 +74,10 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
     ``--serve-modes`` grows the measurement into a batch-width curve per
     dispatch mode: ``continuous`` (lane recycling — the shipped default)
     and ``sync`` (the PR 5 batch-complete dispatch) measured over the
-    same graphs is the continuous-vs-batch-synchronous A/B. Emits ONE
+    same graphs is the continuous-vs-batch-synchronous A/B, and the
+    ``+nostage`` / ``+devcarry`` token variants grow it into the
+    staged-vs-full-table and host-mirror-vs-device-resident-carry A/Bs
+    (per-mode transfer accounting lands in ``transfers``). Emits ONE
     JSON line on the shared bench contract (value = graphs/s at the
     primary mode's best batch; ``vs_baseline`` = speedup over sequential
     / the 3× acceptance bar; ``batches`` = the primary mode's curve,
@@ -97,9 +100,19 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
     batch_sizes = sorted({int(b) for b in
                           args.serve_batch_sizes.split(",") if b.strip()})
     modes = [m.strip() for m in args.serve_modes.split(",") if m.strip()]
+    # mode tokens: base dispatch mode + optional "+"-joined variants —
+    # "continuous+nostage" (full-table kernels: the staged-vs-full A/B
+    # arm) and "continuous+devcarry" (device-resident carry: the
+    # transfer-accounting A/B arm)
+    mode_cfg = {}
     for m in modes:
-        if m not in ("continuous", "sync"):
+        base, *flags = m.split("+")
+        bad = [f for f in flags if f not in ("nostage", "devcarry")]
+        if base not in ("continuous", "sync") or bad:
             raise SystemExit(f"--serve-modes: unknown mode {m!r}")
+        mode_cfg[m] = dict(mode=base,
+                           stages="off" if "nostage" in flags else "auto",
+                           device_carry="devcarry" in flags)
     slice_steps = (None if args.serve_slice_steps == "auto"
                    else int(args.serve_slice_steps))
     n = max(args.serve_graphs, max(batch_sizes))
@@ -134,14 +147,19 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
           f"({seq_gps:.2f} graphs/s)", file=sys.stderr)
 
     mode_curves: dict = {m: {} for m in modes}
+    transfers: dict = {m: {} for m in modes}
     parity_ok = True
     for mode in modes:
+        cfg = mode_cfg[mode]
         for b in batch_sizes:
-            fe = ServeFrontEnd(batch_max=b, workers=b, mode=mode,
+            fe = ServeFrontEnd(batch_max=b, workers=b, mode=cfg["mode"],
+                               stages=cfg["stages"],
+                               device_carry=cfg["device_carry"],
                                slice_steps=slice_steps,
                                window_s=args.serve_window_ms / 1e3,
                                queue_depth=max(64, 2 * n)).start()
-            key = f"{'' if mode == modes[0] else mode + '_'}b{b}"
+            key = (f"{'' if mode == modes[0] else mode + '_'}b{b}"
+                   .replace("+", "_"))
             try:
                 t0 = time.perf_counter()
                 if cls is not None:
@@ -153,14 +171,28 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
                 for t in [fe.submit(g) for g in warm_graphs[:b]]:
                     t.result(timeout=600)
                 phases[f"serve_warm_{key}_s"] = time.perf_counter() - t0
+                fe.scheduler.reset_transfer_stats()   # exclude warm traffic
                 t0 = time.perf_counter()
                 tickets = [fe.submit(g) for g in graphs]
                 results = [t.result(timeout=600) for t in tickets]
                 elapsed = time.perf_counter() - t0
+                sched_stats = dict(fe.scheduler.stats)
             finally:
                 fe.shutdown()
             phases[f"serve_{key}_s"] = elapsed
             mode_curves[mode][str(b)] = round(n / elapsed, 3)
+            # measured per-slice host<->device traffic (the
+            # --device-carry A/B evidence; PERF.md "Staged serve sweeps")
+            slices = max(1, sched_stats.get("slices", 0)
+                         or sched_stats.get("batches", 0))
+            transfers[mode][str(b)] = {
+                "h2d_mb": round(sched_stats["h2d_bytes"] / 1e6, 3),
+                "d2h_mb": round(sched_stats["d2h_bytes"] / 1e6, 3),
+                "slices": sched_stats.get("slices", 0),
+                "bytes_per_slice": round(
+                    (sched_stats["h2d_bytes"]
+                     + sched_stats["d2h_bytes"]) / slices, 1),
+            }
             for r, s in zip(results, seq):
                 if (not r.ok or r.minimal_colors != s.minimal_colors
                         or not np.array_equal(r.colors, s.colors)):
@@ -221,6 +253,7 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
         "sequential_graphs_per_s": round(seq_gps, 3),
         "batches": batches,
         "modes": mode_curves,
+        "transfers": transfers,
         "serve_mode": modes[0],
         "slice_steps": args.serve_slice_steps,
         "monotone_curve": monotone,
@@ -299,7 +332,13 @@ def main() -> int:
                    help="dispatch modes to measure, first is the "
                         "headline (continuous = lane recycling, sync = "
                         "batch-complete; 'continuous,sync' is the "
-                        "continuous-vs-batch-synchronous A/B)")
+                        "continuous-vs-batch-synchronous A/B). Variants "
+                        "suffix with '+': '+nostage' compiles the "
+                        "full-table kernels (staged-vs-full A/B) and "
+                        "'+devcarry' keeps the carry device-resident "
+                        "(transfer A/B) — e.g. "
+                        "'continuous,continuous+nostage,"
+                        "continuous+devcarry'")
     p.add_argument("--serve-slice-steps", type=str, default="auto",
                    help="supersteps per continuous-mode slice, or "
                         "'auto' to price against dispatch overhead "

@@ -11,9 +11,13 @@ trajectory row 4→5→6 in PRs 3/5/7) becomes machine-checked.
 Rules:
 
 - **LY001** pack/unpack arity — a declared pack site's ``return
-  (tuple...)`` literal, or a declared unpack site's ``(a, b, ...) =
-  buf`` destructuring, disagrees with the length constant (the "widened
-  the carry, forgot a site" failure);
+  (tuple...)`` literal, a declared tuple-assignment pack
+  (``pack_assigns``), a declared concatenated-tuple pack
+  (``concat_packs``: ``(a, b) + rec + (traj,)`` chains whose named
+  parts have declared arities — the sharded pipelines' idiom), or a
+  declared unpack site's ``(a, b, ...) = buf`` destructuring,
+  disagrees with the length constant (the "widened the carry, forgot a
+  site" failure);
 - **LY002** stale/out-of-bounds index — a declared index constant, a
   constant-index subscript on a declared buffer variable, or a declared
   ``lo + n ≤ LEN`` span invariant is out of bounds;
@@ -48,6 +52,10 @@ class BufferSpec:
     length_const: str               # e.g. "CARRY_LEN"
     module: str                     # repo-rel module owning pack/unpack
     pack_functions: tuple = ()      # return-tuple arity == LEN
+    pack_assigns: tuple = ()        # (func, var): "var = (tuple...)" arity
+    concat_packs: tuple = ()        # (func, ((name, arity), ...)): every
+    #                                 resolvable "(..) + name + (..)" Add
+    #                                 chain in func must have arity LEN
     unpack_functions: tuple = ()    # (func, param): "(a,..) = param" arity
     index_consts: tuple = ()        # constants that must be < LEN
     var_names: tuple = ()           # int-literal subscripts bounds-checked
@@ -61,16 +69,19 @@ DEFAULT_SPECS = (
         name="serve-carry",
         length_const="CARRY_LEN",
         module="dgc_tpu/serve/batched.py",
-        pack_functions=("_fresh_lane", "_superstep_body", "idle_carry"),
+        pack_functions=("_fresh_lanes", "idle_carry"),
+        pack_assigns=(("_superstep_body", "new"),),
         unpack_functions=(("_superstep_body", "c"),),
         index_consts=("CARRY_PHASE", "CARRY_K", "CARRY_PACKED",
                       "CARRY_STEP", "CARRY_PREV_ACTIVE", "CARRY_STALL",
                       "CARRY_P1", "CARRY_S1", "CARRY_ST1", "CARRY_USED",
                       "CARRY_P2", "CARRY_S2", "CARRY_ST2", "T_US",
-                      "T_PREV", "OUT0"),
-        var_names=("carry", "carry_np"),
+                      "T_PREV", "CARRY_RUNG", "CARRY_NC",
+                      "CARRY_IDX_RUNG", "CARRY_IDX", "OUT0"),
+        var_names=("carry", "out_src"),
         extra_modules=("dgc_tpu/serve/engine.py", "tests/test_serve.py"),
-        shared_body=(("batched_sweep_kernel", "batched_slice_kernel"),
+        shared_body=(("batched_sweep_kernel", "batched_slice_kernel",
+                      "batched_slice_kernel_donated"),
                      "speculative_update_mc"),
     ),
     BufferSpec(
@@ -81,12 +92,90 @@ DEFAULT_SPECS = (
                       "COL_GATHER_CALLS", "COL_MAX_UNCONF", "COL_TS_US"),
         row_builds=(("make_trajstep", "cols"),),
     ),
+    # the sharded pipelines' resumable carries (ROADMAP static-analysis
+    # follow-on): the pack sites are concatenated-tuple chains — the
+    # head literal + the prefix-resume ring + the trajectory buffer —
+    # whose named parts carry declared arities
+    BufferSpec(
+        name="sharded-carry",
+        length_const="SH_CARRY_LEN",
+        module="dgc_tpu/engine/sharded.py",
+        concat_packs=(("_flat_pipeline",
+                       (("rec5", 5), ("rec", 5), ("traj", 1))),),
+        index_consts=("SH_PACKED", "SH_STEP", "SH_STATUS",
+                      "SH_PREV_ACTIVE", "SH_STALL", "SH_REC0", "SH_TRAJ"),
+        var_names=("carry", "carry0", "out"),
+    ),
+    BufferSpec(
+        name="sharded-bucketed-carry",
+        length_const="SB_CARRY_LEN",
+        module="dgc_tpu/engine/sharded_bucketed.py",
+        concat_packs=(("_shard_pipeline",
+                       (("rec5", 5), ("rec", 5), ("traj", 1))),),
+        index_consts=("SB_PACKED", "SB_STEP", "SB_STATUS",
+                      "SB_PREV_ACTIVE", "SB_STALL", "SB_PRUNE",
+                      "SB_REC0", "SB_TRAJ"),
+        var_names=("c", "carry", "out"),
+    ),
 )
 
 # span invariants: lo + n must cover at most LEN slots
 SPAN_INVARIANTS = {
     "serve-carry": (("OUT0", "N_OUT"),),
+    "sharded-carry": (("SH_REC0", "SH_N_REC"),),
+    "sharded-bucketed-carry": (("SB_REC0", "SB_N_REC"),),
 }
+
+
+def _concat_arity(node: ast.AST, parts: dict) -> int | None:
+    """Static arity of a tuple-concatenation expression: literal tuples
+    count their elements, declared names (and ``tuple(name)`` wrappers)
+    contribute their declared arity, ``+`` sums both sides. None when
+    any part is unresolvable (not a pack site — skipped, never guessed).
+    """
+    if isinstance(node, ast.Tuple):
+        return len(node.elts)
+    if isinstance(node, ast.Name) and node.id in parts:
+        return parts[node.id]
+    if isinstance(node, ast.Call) and isinstance(node.func, ast.Name) \
+            and node.func.id == "tuple" and len(node.args) == 1:
+        return _concat_arity(node.args[0], parts)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, ast.Add):
+        left = _concat_arity(node.left, parts)
+        right = _concat_arity(node.right, parts)
+        return None if left is None or right is None else left + right
+    return None
+
+
+def _check_concat_packs(mod: SourceModule, spec: BufferSpec, length: int,
+                        funcs: dict, out: list[Finding]) -> None:
+    """LY001 over concatenated-tuple pack chains: every maximal ``+``
+    chain inside the declared function whose arity resolves through the
+    declared part arities must pack exactly LEN slots."""
+    for fname, part_list in spec.concat_packs:
+        node = funcs.get(fname)
+        if node is None:
+            f = mod.finding("LY001", 1,
+                            f"{spec.name}: concat pack site '{fname}' "
+                            f"not found")
+            if f is not None:
+                out.append(f)
+            continue
+        parts = dict(part_list)
+        adds = [n for n in ast.walk(node)
+                if isinstance(n, ast.BinOp) and isinstance(n.op, ast.Add)]
+        inner = {id(n.left) for n in adds} | {id(n.right) for n in adds}
+        for n in adds:
+            if id(n) in inner:
+                continue   # operand of a larger chain — only check roots
+            arity = _concat_arity(n, parts)
+            if arity is not None and arity != length:
+                f = mod.finding(
+                    "LY001", n,
+                    f"{spec.name}: '{fname}' packs {arity} slots in a "
+                    f"tuple-concat chain, {spec.length_const}={length}")
+                if f is not None:
+                    out.append(f)
 
 
 def _functions(mod: SourceModule) -> dict[str, ast.FunctionDef]:
@@ -285,6 +374,35 @@ def check_layout(layout_mod: SourceModule,
                             f"slots, {spec.length_const}={length}")
                         if f is not None:
                             out.append(f)
+
+        # LY001: tuple-assignment pack sites ("var = (a, b, ...)")
+        for fname, varname in spec.pack_assigns:
+            node = funcs.get(fname)
+            if node is None:
+                f = mod.finding("LY001", 1,
+                                f"{spec.name}: pack site '{fname}' "
+                                f"not found")
+                if f is not None:
+                    out.append(f)
+                continue
+            for stmt in ast.walk(node):
+                if (isinstance(stmt, ast.Assign)
+                        and isinstance(stmt.value, ast.Tuple)
+                        and any(isinstance(t, ast.Name) and t.id == varname
+                                for t in stmt.targets)):
+                    arity = len(stmt.value.elts)
+                    if arity != length:
+                        f = mod.finding(
+                            "LY001", stmt,
+                            f"{spec.name}: '{fname}' packs {arity} "
+                            f"slots into '{varname}', "
+                            f"{spec.length_const}={length}")
+                        if f is not None:
+                            out.append(f)
+
+        # LY001: concatenated-tuple pack chains
+        if spec.concat_packs:
+            _check_concat_packs(mod, spec, length, funcs, out)
 
         # LY001: unpack-site destructuring arity
         for fname, param in spec.unpack_functions:

@@ -29,6 +29,8 @@ STAGING_GLOBS = ("dgc_tpu/serve/batched.py", "dgc_tpu/engine/*.py",
                  "dgc_tpu/obs/devclock.py")
 LAYOUT_FILES = ("dgc_tpu/layout.py", "dgc_tpu/serve/batched.py",
                 "dgc_tpu/serve/engine.py", "dgc_tpu/obs/kernel.py",
+                "dgc_tpu/engine/sharded.py",
+                "dgc_tpu/engine/sharded_bucketed.py",
                 "tests/test_serve.py")
 SCHEMA_GLOBS = ("dgc_tpu/**/*.py", "bench.py", "tools/*.py")
 LOCK_FILES = ("dgc_tpu/obs/metrics.py", "dgc_tpu/obs/httpd.py",

@@ -492,6 +492,51 @@ def derive_schedule(sizes, widths, v: int, max_degree: int, *,
                 stage_ranges=stage_ranges)
 
 
+def serve_stage_rungs(v: int) -> tuple:
+    """Default stage ladder for the batched SERVE kernels — denser at
+    the top than :func:`default_stages` because the serve cost model
+    differs: a serve stage superstep re-gathers its compacted rows from
+    the class table (one row gather per superstep — the serve carry
+    holds the slot list, not a flattened sub-table), so a rung's volume
+    is ``pad × W`` against the full table's ``V × W`` and the v/2 rung
+    already halves superstep cost; and compaction itself is a
+    stage-entry event (``serve.batched._rebuild_idx``), so extra rungs
+    cost one compiled switch branch each, not per-superstep passes. The
+    same full-table floor as ``default_stages`` (v ≤ 2^14: compaction
+    can't pay below it)."""
+    if v <= 1 << 14:
+        return ((None, 0),)
+    return ((None, v // 2), (v // 2, v // 4), (v // 4, v // 16),
+            (v // 16, v // 64), (v // 64, v // 256), (v // 256, 0))
+
+
+def class_stage_schedule(v_pad: int, w_pad: int, *,
+                         stages: tuple | None = None) -> dict:
+    """Stage schedule for a batched-serve shape class (``dgc_tpu.serve
+    .shape_classes.ShapeClass``): the class is ONE flat bucket in
+    original-id order (``v_pad`` rows × ``w_pad`` ELL columns, window
+    covering every width), so the derivation is :func:`derive_schedule`
+    on a one-bucket layout — the serve ladder and the single-graph
+    ladder share ``default_stages``/``_check_stage_ladder``/
+    ``stage_slot_ranges`` and cannot drift. ``flat_cap`` is pinned at
+    the class width so the single bucket is always flat (the serve
+    kernel has no hub machinery; its window is never capped by
+    construction, ``serve.shape_classes`` module docstring).
+
+    Returns ``dict(stages, pads)``: ``pads[s]`` is the compaction pad
+    (``pow2(scale)``) of stage ``s``, None for the full-table stage. A
+    ladder-free class (``serve_stage_rungs`` below its staging floor, or
+    an explicit single full-table stage) returns ``pads == (None,)`` —
+    the caller can compile the plain full-table kernel unchanged."""
+    sched = derive_schedule((v_pad,), (w_pad,), v_pad, w_pad,
+                            stages=(serve_stage_rungs(v_pad)
+                                    if stages is None else stages),
+                            flat_cap=max(int(w_pad), DEFAULT_FLAT_CAP))
+    st = sched["stages"]
+    pads = tuple(None if s is None else _pow2_ceil(s) for s, _ in st)
+    return dict(stages=st, pads=pads)
+
+
 def _fresh_prune(buckets, hub_buckets: int, planes: tuple, hub_prune: tuple,
                  v: int) -> tuple:
     """Per-hub-bucket pruned-mode state (or None where disabled), initially

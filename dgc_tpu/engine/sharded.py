@@ -49,6 +49,7 @@ from dgc_tpu.engine.fused import (
     shard_rec_empty,
     shard_superstep_epilogue,
 )
+from dgc_tpu.layout import SH_PACKED, SH_REC0, SH_STATUS, SH_STEP, SH_TRAJ
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.ops.bitmask import num_planes_for
 from dgc_tpu.ops.speculative import beats_rule, speculative_update_mc
@@ -117,12 +118,15 @@ def _flat_pipeline(nbrs_l, deg_l, deg_g, k, init, rec, record,
     if traj is None:
         traj = traj_empty(1, dummy=True)
 
+    # carry layout single-sourced in ``dgc_tpu.layout`` (SH_* slot ids):
+    # (packed_l, step, status, prev_active, stall) + rec ring + traj —
+    # the pack/unpack sites below are spec'd by the dgc-lint layout pass
     def cond(carry):
-        return carry[2] == _RUNNING
+        return carry[SH_STATUS] == _RUNNING
 
     def body(carry):
-        packed_l, step, status, prev_active, stall = carry[:5]
-        rec5, traj = carry[5:10], carry[10]
+        packed_l, step, status, prev_active, stall = carry[:SH_REC0]
+        rec5, traj = carry[SH_REC0:SH_TRAJ], carry[SH_TRAJ]
         new_packed_l, any_fail, active, mc = _shard_superstep(
             packed_l, nbrs_l, pre_beats, k, num_planes
         )
@@ -133,12 +137,11 @@ def _flat_pipeline(nbrs_l, deg_l, deg_g, k, init, rec, record,
             trajstep, traj)
         return (new_packed_l, step + 1, status, active, stall) + rec5 + (traj,)
 
-    out = jax.lax.while_loop(
-        cond, body,
-        (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3])
-        + tuple(rec) + (traj,),
-    )
-    return out[0], out[1], out[2], tuple(out[5:10]), out[10]
+    carry0 = (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3]) \
+        + tuple(rec) + (traj,)
+    out = jax.lax.while_loop(cond, body, carry0)
+    return (out[SH_PACKED], out[SH_STEP], out[SH_STATUS],
+            tuple(out[SH_REC0:SH_TRAJ]), out[SH_TRAJ])
 
 
 def _flat_default_init(nbrs_l, deg_l):

@@ -70,6 +70,7 @@ from dgc_tpu.engine.compact import (
     _pow2_ceil,
     hub_prune_cfg,
 )
+from dgc_tpu.layout import SB_PACKED, SB_REC0, SB_STATUS, SB_STEP, SB_TRAJ
 from dgc_tpu.ops import segmented_gather as seg
 from dgc_tpu.ops.speculative import speculative_update_mc
 from dgc_tpu.models.arrays import GraphArrays
@@ -357,16 +358,19 @@ def _shard_pipeline(tables_l, deg_l, k, init, rec, record, planes: tuple,
     recstep = _make_recstep(record)
     trajstep = make_trajstep(record_traj)
     seg_ctx = _ShardSegCtx(tables_l, planes, pads, prune_cfg)
+    # carry layout single-sourced in ``dgc_tpu.layout`` (SB_* slot ids):
+    # (packed_l, step, status, prev_active, stall, prune) + rec ring +
+    # traj — pack/unpack sites spec'd by the dgc-lint layout pass
     carry = (init[0], init[1], jnp.int32(_RUNNING), init[2], init[3],
              prune0) + tuple(rec) + (traj,)
 
     def cond(c):
-        status = c[2]
+        status = c[SB_STATUS]
         return status == _RUNNING
 
     def body(c):
-        packed_l, step, status, prev_active, stall, prune = c[:6]
-        rec5, traj = c[6:11], c[11]
+        packed_l, step, status, prev_active, stall, prune = c[:SB_REC0]
+        rec5, traj = c[SB_REC0:SB_TRAJ], c[SB_TRAJ]
         packed_g = jax.lax.all_gather(packed_l, VERTEX_AXIS, tiled=True)
         (new_packed_l, fail_l, active_l, mc_l, prune_new,
          gc_l) = _gated_superstep(
@@ -390,7 +394,8 @@ def _shard_pipeline(tables_l, deg_l, k, init, rec, record, planes: tuple,
                 prune_new) + rec5 + (traj,)
 
     out = jax.lax.while_loop(cond, body, carry)
-    return out[0], out[1], out[2], tuple(out[6:11]), out[11]
+    return (out[SB_PACKED], out[SB_STEP], out[SB_STATUS],
+            tuple(out[SB_REC0:SB_TRAJ]), out[SB_TRAJ])
 
 
 def _shard_attempt(tables_l, deg_l, k, planes: tuple, max_steps: int,

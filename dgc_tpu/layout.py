@@ -1,9 +1,9 @@
 """Single source of truth for cross-module buffer layouts.
 
-Two fixed-shape int32 contracts cross module (and host/device)
+These fixed-shape int32 contracts cross module (and host/device)
 boundaries and have historically been hand-maintained in lockstep at
-every growth (PR 6 grew the serve carry 13 slots, PR 7 to 15; PR 3/5/7
-grew the trajectory row 4→5→6 columns):
+every growth (PR 6 grew the serve carry 13 slots, PR 7 to 15, PR 9 to
+17; PR 3/5/7 grew the trajectory row 4→5→6 columns):
 
 - the **serve slice carry** — the per-lane state tuple
   ``serve.batched.batched_slice_kernel`` round-trips host↔device every
@@ -12,7 +12,11 @@ grew the trajectory row 4→5→6 columns):
 - the **trajectory buffer row** — the per-superstep telemetry row the
   fused engines write inside their while-loops (``obs.kernel``), whose
   column ids the host decoder, the emitters, and ``tune
-  --from-manifest`` all share.
+  --from-manifest`` all share;
+- the **sharded pipeline carries** — the resumable while-loop carries of
+  ``engine/sharded.py`` and ``engine/sharded_bucketed.py``, whose head
+  slots, prefix-resume ring span, and trailing trajectory slot are
+  sliced by name at every pack/unpack site.
 
 Every slot/column id and length lives HERE and nowhere else; the static
 layout checker (``dgc_tpu.analysis.layout_check``, ``tools/dgc_lint.py``
@@ -32,7 +36,8 @@ from __future__ import annotations
 #
 # (phase, k, packed, step, prev_active, stall,   -- live sweep state
 #  p1, s1, st1, used, p2, s2, st2,               -- jump-pair result slots
-#  t_us, t_prev)                                 -- in-kernel timing slots
+#  t_us, t_prev,                                 -- in-kernel timing slots
+#  rung, nc, idx_rung, idx)                      -- frontier-ladder stage state
 CARRY_PHASE = 0        # 0 first attempt, 1 confirm, >=2 done/idle
 CARRY_K = 1            # live color budget
 CARRY_PACKED = 2       # packed per-vertex color/freshness state
@@ -48,10 +53,42 @@ CARRY_S2 = 11          # result slot 2: supersteps
 CARRY_ST2 = 12         # result slot 2: status
 T_US = 13              # accumulated live superstep wall-µs (timing mode)
 T_PREV = 14            # last in-kernel clock sample (timing mode)
-CARRY_LEN = 15
+CARRY_RUNG = 15        # compaction-stage ladder rung the lane has reached
+CARRY_NC = 16          # lane's live frontier after its last superstep
+CARRY_IDX_RUNG = 17    # rung the lane's compacted slot list was built at
+CARRY_IDX = 18         # compacted slot list (int32[A0]; dummy = V_pad)
+CARRY_LEN = 19
 
 OUT0 = 6               # first result slot (== CARRY_P1)
 N_OUT = 7              # result slots p1..st2
+
+# -- sharded flat-pipeline carry (engine/sharded.py `_flat_pipeline`) -----
+#
+# (packed_l, step, status, prev_active, stall,   -- live sweep state
+#  rec...,                                       -- prefix-resume ring (5)
+#  traj)                                         -- trajectory buffer
+SH_PACKED = 0
+SH_STEP = 1
+SH_STATUS = 2
+SH_PREV_ACTIVE = 3
+SH_STALL = 4
+SH_REC0 = 5            # first prefix-resume ring slot
+SH_N_REC = 5           # ring slots (engine.fused.shard_rec_empty layout)
+SH_TRAJ = 10           # trajectory buffer rides last
+SH_CARRY_LEN = 11
+
+# -- sharded bucketed-pipeline carry (engine/sharded_bucketed.py
+#    `_shard_pipeline`) — the flat layout plus the pruned-capture state ---
+SB_PACKED = 0
+SB_STEP = 1
+SB_STATUS = 2
+SB_PREV_ACTIVE = 3
+SB_STALL = 4
+SB_PRUNE = 5           # per-hub-bucket pruned-capture state
+SB_REC0 = 6            # first prefix-resume ring slot
+SB_N_REC = 5           # ring slots (engine.fused.shard_rec_empty layout)
+SB_TRAJ = 11           # trajectory buffer rides last
+SB_CARRY_LEN = 12
 
 # -- trajectory buffer row (obs.kernel, one column per metric) ------------
 COL_ACTIVE = 0         # global active count after the superstep

@@ -100,13 +100,18 @@ EVENT_SCHEMAS: dict = {
         {"batch_max": "int", "window_ms": NUM, "queue_depth": "int",
          "workers": "int"},
         {"mode": "str", "slice_steps": ("int", "null"),
-         "affinity": "bool", "timing": "bool", "tracing": "bool"}),
+         "affinity": "bool", "timing": "bool", "tracing": "bool",
+         # staged frontier ladder + device-resident carry (PR 9)
+         "stages": "str", "device_carry": "bool"}),
     "serve_batch": (
         {"shape_class": "str", "batch": "int", "occupancy": NUM,
          "padding_waste": NUM},
         {"b_pad": "int", "compile_cache": "str", "device_ms": NUM,
          "queue_ms_max": NUM, "straggler_waste": NUM,
-         "depth_buckets": "int"}),
+         "depth_buckets": "int",
+         # compiled stage-branch count of the class's ladder (1 = the
+         # full-table kernel; sync mode has no mid-sweep rung visibility)
+         "stage_bodies": "int"}),
     # continuous batching (lane recycling): one serve_slice per sliced
     # kernel dispatch, one lane_recycled per completed sweep swapped out
     "serve_slice": (
@@ -116,7 +121,15 @@ EVENT_SCHEMAS: dict = {
          "compile_cache": "str", "device_ms": NUM,
          # in-kernel timing split (slice kernel timing slots): superstep
          # compute vs dispatch overhead within device_ms
-         "sstep_ms": NUM, "overhead_ms": NUM}),
+         "sstep_ms": NUM, "overhead_ms": NUM,
+         # stage-occupancy telemetry (CARRY_RUNG/CARRY_NC carry slots):
+         # ladder rung range over live lanes, their summed frontier, and
+         # frontier / gathered-slot occupancy for the slice
+         "stage_min": "int", "stage_max": "int", "frontier": "int",
+         "stage_occupancy": NUM,
+         # per-slice host<->device transfer accounting (the
+         # --device-carry A/B evidence; serve_summary totals them)
+         "h2d_bytes": "int", "d2h_bytes": "int"}),
     "lane_recycled": (
         {"shape_class": "str", "lane": "int"},
         {"k": "int", "depth_bucket": "int", "slices": "int",
@@ -125,11 +138,16 @@ EVENT_SCHEMAS: dict = {
     # (timing mode, slice_steps auto): once per shape class
     "slice_recalibrated": (
         {"shape_class": "str", "from_steps": "int", "to_steps": "int"},
-        {"overhead_ms": NUM, "sstep_ms": NUM, "samples": "int"}),
+        {"overhead_ms": NUM, "sstep_ms": NUM, "samples": "int",
+         # ladder rung the pricing window sampled (post-ladder median)
+         "rung": "int"}),
     # live scrape endpoint (obs.httpd) bound for this run
     "metrics_server": ({"port": "int"}, {"host": "str"}),
     "serve_warmup": (
-        {"classes": "int", "kernels": "int", "seconds": NUM}, {}),
+        {"classes": "int", "kernels": "int", "seconds": NUM},
+        # compiled stage branches across the warmed kernels (the staged
+        # ladder's compile-cache growth, priced in PERF.md)
+        {"stage_bodies": "int"}),
     # request_id accepts str: JSONL replay ids round-trip verbatim (the
     # PR 6 non-int-id contract, tests/test_serve.py) — found by driving
     # a string-id replay through validate_runlog
@@ -156,7 +174,9 @@ EVENT_SCHEMAS: dict = {
          "warmup_s": (*NUM, "null"), "warmed_kernels": ("int", "null"),
          # per-shape-class latency summary (bucket-interpolated
          # histogram quantiles, ms): {class: {p50, p95, p99, count}}
-         "latency_ms": "dict", "recals": "int"}),
+         "latency_ms": "dict", "recals": "int",
+         # whole-run host<->device transfer totals (serve_slice sums)
+         "h2d_mb": NUM, "d2h_mb": NUM}),
 }
 
 

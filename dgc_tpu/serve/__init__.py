@@ -11,13 +11,18 @@ per-request host loop. This package amortizes all three:
 - :mod:`~dgc_tpu.serve.shape_classes` — pad arbitrary request graphs into
   a small geometric ladder of ``(V_pad, W_pad)`` classes, so any request
   stream hits a bounded set of compiled kernels;
-- :mod:`~dgc_tpu.serve.batched` — a ``jax.vmap``'d fused jump-mode sweep
+- :mod:`~dgc_tpu.serve.batched` — a hand-batched fused jump-mode sweep
   (batch axis over graphs, per-graph phase/k/done bookkeeping in the
   while-loop carry) that colors B graphs per dispatch, per-graph
   bit-identical to the single-graph fused engines — as one
   batch-complete dispatch (sync mode) or as bounded superstep *slices*
   whose full per-lane carry re-enters from the host
-  (``batched_slice_kernel`` — the continuous-batching kernel);
+  (``batched_slice_kernel`` — the continuous-batching kernel; the
+  donated variant keeps the carry device-resident). Supersteps run the
+  **staged frontier ladder**: per shape class a static compaction-stage
+  schedule (shared with ``engine.compact``) gathers only each lane's
+  live frontier once it decays below the ladder's thresholds, executed
+  at the batch's shallowest live rung via one scalar ``lax.switch``;
 - :mod:`~dgc_tpu.serve.engine` — the sweep scheduler: **lane recycling**
   (default): each class owns an adaptive lane pool, finished lanes swap
   queued requests in at every slice boundary, and predicted-depth

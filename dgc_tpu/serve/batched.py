@@ -1,21 +1,51 @@
-"""vmap'd fused jump-mode sweep — B graphs per device dispatch.
+"""Batched fused jump-mode sweep — B graphs per device dispatch.
 
-One shape class's batch runs as ``jax.vmap`` over a single-graph fused
-pair (:func:`_sweep_pair_one`): the whole jump-mode sweep — attempt(k0),
-then the confirm attempt at (colors_used − 1) — is ONE flat
-``lax.while_loop`` whose carry holds each graph's phase, budget k, live
-attempt state, and both result slots. Under vmap the loop's batching
-rule runs the body until every graph's cond is false and freezes
-finished graphs with per-element selects, so graphs advance through
-their own supersteps, phase transitions, and per-graph ``max_steps``
-clamps independently — the per-graph done/superstep masking is the
-carry, not host logic.
+One shape class's batch runs as a single hand-batched
+``lax.while_loop`` over batch-leading carry arrays: the whole jump-mode
+sweep — attempt(k0), then the confirm attempt at (colors_used − 1) — is
+ONE loop whose carry holds each lane's phase, budget k, live attempt
+state, and both result slots. Every per-lane carry element is updated
+through its OWN live mask only (finished lanes freeze via elementwise
+selects — exactly what ``vmap``'s while-loop batching rule lowers to,
+written out by hand), so graphs advance through their own supersteps,
+phase transitions, and per-graph ``max_steps`` clamps independently.
+
+The loop is hand-batched (not ``vmap`` of a per-lane loop) for ONE
+reason: the **staged frontier ladder**. The single-graph engine's
+biggest win (PERF.md: superstep volume ∝ frontier size, not V) needs a
+``lax.switch`` over per-stage bodies, and a *batched* switch predicate
+executes every branch — only a SCALAR stage index runs one body. So the
+batch executes at the shallowest rung any live lane still needs
+(``r_exec = min`` over live lanes' rungs), which is exact for every
+lane: a compaction pad covering a shallower rung covers every deeper
+lane's (monotone non-increasing) frontier a fortiori, and running a
+lane at a shallower stage than its frontier allows changes no value
+(the full-table superstep is the rung-0 body). Each lane still tracks
+its own rung and compacted-slot count in the carry
+(:data:`dgc_tpu.layout.CARRY_RUNG` / :data:`~dgc_tpu.layout.CARRY_NC`).
+
+**Staged supersteps** (``stages`` static arg — the ladder from
+``engine.compact.class_stage_schedule``, shared with the single-graph
+engine's ``default_stages``/``derive_schedule``): stage s > 0 compacts
+each lane's active rows (uncolored ∪ fresh) into a ``pads[s]``-slot
+index list (``engine.compact._compact_idx`` — the same exactness-
+critical idiom), row-gathers only those rows of the lane's table, and
+updates only them. Exactness is the compact engine's argument verbatim:
+a confirmed vertex can never re-activate, so every row that could
+change state is in the compacted set, non-compacted rows are fixpoints
+of the update, and the per-superstep fail/active aggregates — hence
+statuses, hence superstep counts — equal the full-table superstep's.
+Stage routing replays ``engine.compact._unified_pipeline``: desired
+rung = max stage whose entry threshold covers the lane's previous
+active count, monotone per attempt, reset to 0 at the attempt boundary
+(the confirm's frontier jumps back to full table). ``stages=None``
+compiles the PR 5/6 full-table-only kernel.
 
 **Lane recycling** (continuous batching): :func:`batched_slice_kernel`
-runs the SAME per-lane superstep body (:func:`_superstep_body` — one
-definition, so the sliced and unsliced kernels cannot drift) for at most
-``slice_steps`` supersteps per invocation and returns the full per-lane
-carry to the host. The scheduler (``serve.engine``) swaps each ``done``
+runs the SAME per-superstep body (:func:`_superstep_body` — one
+definition, so the sliced and unsliced kernels cannot drift) for at
+most ``slice_steps`` supersteps per invocation and returns the full
+per-lane carry. The scheduler (``serve.engine``) swaps each ``done``
 lane's result out and a queued request in — writing the lane's
 ``comb``/``degrees``/``k0``/``max_steps`` inputs and raising its
 ``reset`` flag; the kernel re-initializes flagged lanes from those
@@ -24,10 +54,17 @@ host callbacks: the loop is re-entered from ordinary host Python, which
 keeps it deterministic, resumable, and CPU-testable. Slicing is
 result-invariant by construction: a lane's carry round-trips exactly
 (int32, no precision), the body is shared, and the unsliced loop's cond
-(``phase < 2``) is the slice cond minus the budget — so the sequence of
-superstep bodies applied to any lane is identical however the budget
-partitions it (locked across recycling boundaries by
-``tools/serve_parity.jsonl`` and ``tests/test_serve.py``).
+(any lane's ``phase < 2``) is the slice cond minus the budget — so the
+sequence of superstep bodies applied to any lane is identical however
+the budget partitions it (locked across recycling AND stage boundaries
+by ``tools/serve_parity.jsonl`` and ``tests/test_serve.py``).
+:func:`batched_slice_kernel_donated` is the same kernel compiled with
+the carry buffers donated (``donate_argnums``) — the device-resident
+carry mode: the scheduler keeps the carry on device across slice
+boundaries, re-seats lanes with :func:`seat_lane_kernel` (an on-device
+scatter of ONE lane's inputs instead of re-uploading the batch's
+tables), and transfers only the per-lane phase/rung/nc scheduling
+scalars per slice.
 
 **Bit-identity contract** (locked by ``tools/serve_parity.jsonl`` and
 ``tests/test_serve.py``): every graph's colors, superstep counts, and
@@ -47,27 +84,36 @@ statuses are byte-identical to the single-graph fused engines
   ``ops.segmented_gather`` collapsed-path argument).
 - *Padding*: dummy rows start confirmed (degree 0 → color 0), are
   pointed at by no real row, and the sentinel slot holds −1 — zero
-  contribution to any count, mask, or status.
-- *Schedule*: one full-table superstep per round with the shared
+  contribution to any count, mask, or status. Compaction dummy slots
+  gather a fabricated all-sentinel row (``jnp.take`` fill mode) around
+  a confirmed-0 state and scatter with ``mode="drop"`` — inert by the
+  same argument.
+- *Schedule*: one superstep per round with the shared
   ``speculative_update_mc`` core and ``status_step`` transition, the
   same round-1 specialization, the same stall window, and the
   single-graph ``max_steps = 2·V_real + 4`` carried per graph — so the
   per-superstep aggregate counts (hence statuses, hence supersteps)
-  equal the single-graph engines'. The confirm attempt runs from
-  scratch, which the prefix-resume contract defines as bit-identical to
-  the resumed confirm (``engine.compact._sweep_kernel_staged``).
-- *Lanes don't interact*: under vmap every lane's carry element is
-  selected on its OWN cond only — a neighbor lane finishing, resetting,
-  or idling changes nothing in another lane's per-superstep values, so
-  recycling a lane mid-batch leaves its co-residents byte-identical.
+  equal the single-graph engines'. The staged ladder changes only which
+  rows are *gathered*, never the update rule or its inputs. The confirm
+  attempt runs from scratch, which the prefix-resume contract defines
+  as bit-identical to the resumed confirm
+  (``engine.compact._sweep_kernel_staged``).
+- *Lanes don't interact*: every lane's carry element is selected on its
+  OWN live mask, and the shared executed rung only widens (never
+  narrows) a lane's compaction pad — a neighbor lane finishing,
+  resetting, or idling changes nothing in another lane's per-superstep
+  values, so recycling a lane mid-batch leaves its co-residents
+  byte-identical.
 
 The kernel records no in-kernel trajectory: serve telemetry is
 slice/request-grained (``obs`` ``serve_slice``/``lane_recycled``/
-``serve_batch``/``serve_request`` events), and the bit-identity ensemble
-checks serve telemetry on/off. **In-kernel timing** (the single-graph
-trajectory buffer's col-5 contract, ``obs.devclock``) rides the carry's
-two trailing slots when the slice kernel is compiled with
-``timing=True``: each live superstep's wall-µs accumulates per lane, so
+``serve_batch``/``serve_request`` events — ``serve_slice`` now carries
+the stage-occupancy fields read from the rung/nc carry slots), and the
+bit-identity ensemble checks serve telemetry on/off. **In-kernel
+timing** (the single-graph trajectory buffer's col-5 contract,
+``obs.devclock``) rides the carry's two timing slots when the slice
+kernel is compiled with ``timing=True``: each live superstep's wall-µs
+accumulates per lane (one shared clock read per batched superstep), so
 the scheduler can split host-observed slice time into in-kernel
 superstep compute vs dispatch overhead (the ``auto_slice_steps``
 recalibration input) — sweep outputs are byte-identical timing on/off
@@ -77,6 +123,7 @@ because the clock feeds only the timing slots.
 from __future__ import annotations
 
 import math
+import os
 from functools import partial
 
 import jax
@@ -85,6 +132,7 @@ import numpy as np
 
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
 from dgc_tpu.engine.bucketed import decode_combined, initial_packed, status_step
+from dgc_tpu.engine.compact import _check_stage_ladder, _compact_idx
 from dgc_tpu.layout import (CARRY_LEN, CARRY_PHASE, N_OUT, OUT0, T_PREV,
                             T_US)
 from dgc_tpu.ops.speculative import speculative_update_mc
@@ -100,156 +148,357 @@ DEFAULT_STALL_WINDOW = 64  # the engines' shared defensive exit
 # single-sourced in ``dgc_tpu.layout`` (slot ids CARRY_*/T_US/T_PREV) —
 # (phase, k, packed, step, prev_active, stall,   -- live sweep state
 #  p1, s1, st1, used, p2, s2, st2,               -- jump-pair result slots
-#  t_us, t_prev)                                 -- in-kernel timing slots
+#  t_us, t_prev,                                 -- in-kernel timing slots
+#  rung, nc)                                     -- ladder stage state
 # The timing slots ride inert (zeros) unless the kernel is compiled with
-# ``timing=True`` (obs.devclock): t_us accumulates the lane's live
-# superstep wall-µs, t_prev holds the last superstep's clock sample.
+# ``timing=True`` (obs.devclock); rung/nc track the lane's compaction-
+# stage rung and last compacted slot count (v_pad for full-table).
 
 
-def _fresh_lane(degrees, k0):
-    """A lane's carry at sweep start — phase 0, budget ``k0``, round-1
-    state. The unsliced kernel's init and the slice kernel's ``reset``
-    branch share this one definition."""
-    v = degrees.shape[0]
+def _resolve_stages(stages, v: int):
+    """Validated ``(stages, pads, a0)`` for a kernel's static ladder
+    arg. None compiles the full-table-only schedule; an explicit ladder
+    is validated by the single-graph engine's ``_check_stage_ladder``
+    (the serve and engine ladders share one validity rule). ``a0`` is
+    the carried slot-list width: the widest compaction pad (1 when the
+    ladder is full-table only — the idx slot rides as a 1-wide inert
+    column so the carry layout is shape-stable per class)."""
+    if stages is None:
+        stages = ((None, 0),)
+    else:
+        stages = tuple((None if s is None else int(s), int(t))
+                       for s, t in stages)
+    _check_stage_ladder(stages, v)
+    if stages[0][0] is not None:
+        raise ValueError(
+            f"serve stage ladder must open with a full-table stage "
+            f"(scale None), got {stages!r}")
+    pads = tuple(None if s is None else
+                 1 << max(0, (int(s) - 1).bit_length()) for s, _ in stages)
+    a0 = max((p for p in pads if p is not None), default=1)
+    return stages, pads, a0
+
+
+def stage_idx_width(stages) -> int:
+    """The carried compacted-slot-list width (``CARRY_IDX``) a ladder
+    implies — the host-side twin of ``_resolve_stages``' ``a0``, used by
+    the scheduler/tests to size ``idle_carry``."""
+    if stages is None:
+        return 1
+    return max((1 << max(0, (int(s) - 1).bit_length())
+                for s, _ in stages if s is not None), default=1)
+
+
+def _fresh_lanes(degrees, k0, a0: int):
+    """The batch's carry at sweep start — every lane at phase 0, budget
+    ``k0``, round-1 state, ladder rung 0, slot list unbuilt. The
+    unsliced kernel's init and the slice kernel's ``reset`` branch share
+    this one definition."""
+    b, v = degrees.shape
     packed0 = initial_packed(degrees)
-    zeros = jnp.zeros_like(packed0)
-    z = jnp.int32(0)
-    return (z, jnp.asarray(k0, jnp.int32),
-            packed0, jnp.int32(1), jnp.int32(v + 1), z,  # live sweep state
-            zeros, z, z,                                 # slot 1
-            z,                                           # used
-            zeros, z, jnp.int32(_FAILURE),               # slot 2
-            z, z)                                        # timing slots
+    zeros_v = jnp.zeros_like(packed0)
+    z = jnp.zeros((b,), jnp.int32)
+    return (z, jnp.asarray(k0, jnp.int32).reshape(b),
+            packed0, jnp.full((b,), 1, jnp.int32),
+            jnp.full((b,), v + 1, jnp.int32), z,        # live sweep state
+            zeros_v, z, z,                              # slot 1
+            z,                                          # used
+            zeros_v, z, jnp.full((b,), int(_FAILURE), jnp.int32),  # slot 2
+            z, z,                                       # timing slots
+            z, z,                                       # rung, frontier
+            z, jnp.full((b, a0), v, jnp.int32))         # idx_rung, idx
 
 
-def _superstep_body(c, nbr, beats, packed0, max_steps, v: int, *,
-                    planes: int, stall_window: int, timing: bool = False):
-    """ONE superstep + attempt-boundary transition of one lane's carry —
-    the single body both :func:`_sweep_pair_one` (unsliced) and
-    :func:`batched_slice_kernel` (sliced) loop over, so the two cannot
-    drift (the recycling bit-identity precondition).
+def _lane_superstep_math(pk_rows, np_, beats, k, planes: int):
+    """The ONE call site of the shared conflict-rule core for the serve
+    kernels (the dgc-lint LY003 shared-body anchor): every stage branch
+    — full-table and compacted — funnels its gathered inputs through
+    here, so the branches cannot apply different update rules."""
+    return speculative_update_mc(pk_rows, np_, beats, k, planes)
 
-    ``timing`` (static) samples the in-kernel clock after the superstep
-    (``obs.devclock``, the same column contract as the single-graph
-    engines' trajectory col 5) and accumulates the lane's live wall-µs
-    into the ``t_us`` carry slot — the values feed only the timing
-    slots, so colors/steps/statuses are byte-identical timing on or off.
+
+def _full_lane_superstep(pk, cb, kk, *, planes: int, v: int):
+    """One lane's full-table superstep (ladder rung 0): gather every
+    row's neighbor state against the BSP snapshot. The sentinel slot
+    (table id ``v``) lands via the gather's fill value — identical to
+    the historical ``concatenate([pk, [-1]])`` extension without the
+    per-superstep O(V) copy."""
+    nbr, beats = decode_combined(cb)
+    np_ = jnp.take(pk, nbr, mode="fill", fill_value=-1)
+    new_pk, fail_m, act_m, _mc = _lane_superstep_math(pk, np_, beats, kk,
+                                                      planes)
+    return (new_pk, jnp.sum(fail_m.astype(jnp.int32)),
+            jnp.sum(act_m.astype(jnp.int32)))
+
+
+def _staged_lane_superstep(pk, idx, kk, cb, *, planes: int, v: int,
+                           pad: int):
+    """One lane's compacted superstep at a ladder rung with pad ``pad``:
+    row-gather only the carried slot list's rows of the lane's table and
+    update only them. The slot list was built at stage entry
+    (:func:`_rebuild_idx` — the compact engine's stage-transition
+    recompaction, not a per-superstep cost) and covers every row that
+    can change state by frontier monotonicity: entries beyond the build
+    are dummies (``v``), which gather a fabricated all-sentinel row
+    (take-fill) around a confirmed-0 state — inert in every mask and
+    count — and whose writes drop."""
+    cb_c = jnp.take(cb, idx, axis=0, mode="fill",
+                    fill_value=v)               # encode(nbr=v, beats=0)
+    pk_c = jnp.take(pk, idx, mode="fill", fill_value=0)
+    nbr, beats = decode_combined(cb_c)
+    np_ = jnp.take(pk, nbr, mode="fill", fill_value=-1)
+    new_c, fail_m, act_m, _mc = _lane_superstep_math(pk_c, np_, beats, kk,
+                                                     planes)
+    new_pk = pk.at[idx].set(new_c, mode="drop")
+    return (new_pk, jnp.sum(fail_m.astype(jnp.int32)),
+            jnp.sum(act_m.astype(jnp.int32)))
+
+
+def _rebuild_idx(pk, *, v: int, pad: int, a0: int):
+    """One lane's stage-entry recompaction: the ≤ ``pad`` active rows'
+    ids in the low slots, dummy (``v``) everywhere else — the WHOLE
+    ``a0``-wide carried buffer is rewritten, so a later shallower
+    executed rung reading a wider prefix sees only real entries plus
+    dummies (never stale slots; the shared-rung exactness
+    precondition)."""
+    act = (pk < 0) | ((pk & 1) == 1)
+    idx = _compact_idx(act, pad, v)
+    if a0 > pad:
+        idx = jnp.concatenate([idx, jnp.full((a0 - pad,), v, jnp.int32)])
+    return idx
+
+
+def _superstep_body(c, comb, packed0, max_steps, v: int, *,
+                    planes: int, stall_window: int, stages: tuple,
+                    pads: tuple, a0: int, timing: bool = False):
+    """ONE batched superstep + attempt-boundary transition — the single
+    body :func:`batched_sweep_kernel`, :func:`batched_slice_kernel`, and
+    :func:`batched_slice_kernel_donated` all loop over, so the sliced,
+    unsliced, and donated kernels cannot drift (the recycling
+    bit-identity precondition).
+
+    Stage routing (``engine.compact._unified_pipeline`` semantics): a
+    lane's desired rung is the deepest stage whose entry threshold
+    covers its previous active count, its carried rung advances
+    monotonically within an attempt, and the batch executes ONE
+    ``lax.switch`` branch at the minimum live rung — exact for every
+    lane (wider pads cover deeper frontiers; the full-table body is
+    rung 0). Finished lanes freeze through the trailing live-mask
+    selects — the hand-written form of vmap's while-loop batching rule.
+
+    ``timing`` (static) samples the in-kernel clock once per batched
+    superstep (``obs.devclock``, the same column contract as the
+    single-graph engines' trajectory col 5) and accumulates each live
+    lane's wall-µs into the ``t_us`` carry slot — the values feed only
+    the timing slots, so colors/steps/statuses are byte-identical
+    timing on or off.
     """
     (phase, k, packed, step, prev_active, stall,
-     p1, s1, st1, used, p2, s2, st2, t_us, t_prev) = c
+     p1, s1, st1, used, p2, s2, st2, t_us, t_prev, rung, nc,
+     idx_rung, idx) = c
+    live = phase < 2
     first = phase == 0
+    n_stages = len(stages)
+    threshs = tuple(int(t) for _, t in stages)
 
-    # --- one full-table superstep (BSP snapshot semantics) ---
-    pe = jnp.concatenate([packed, jnp.array([-1], jnp.int32)])
-    np_ = pe[nbr]
-    new_packed, fail_mask, act_mask, _mc = speculative_update_mc(
-        packed, np_, beats, k, planes)
-    fail_count = jnp.sum(fail_mask.astype(jnp.int32))
-    active = jnp.sum(act_mask.astype(jnp.int32))
+    # --- stage routing: per-lane desired rung, scalar executed rung ---
+    desired = jnp.zeros_like(rung)
+    for s in range(1, n_stages):
+        desired = jnp.where(prev_active <= threshs[s - 1],
+                            jnp.int32(s), desired)
+    rung_now = jnp.maximum(rung, desired)
+    r_exec = jnp.min(jnp.where(live, rung_now, jnp.int32(n_stages - 1)))
+
+    def _make_branch(s: int):
+        pad = pads[s]
+        if pad is None:
+            def full_branch(idx_op):
+                out = jax.vmap(partial(
+                    _full_lane_superstep, planes=planes, v=v))(packed,
+                                                               comb, k)
+                return out + (idx_op, idx_rung)
+            return full_branch
+
+        def staged_branch(idx_op, pad=pad, s=s):
+            # stage-entry recompaction (the compact engine's stage
+            # transition): only lanes whose carried slot list was built
+            # at a SHALLOWER rung rebuild — a steady-rung superstep
+            # never pays the O(V) compaction pass
+            need = live & (idx_rung < s)
+            idx_new = jax.lax.cond(
+                jnp.any(need),
+                lambda op: jnp.where(
+                    need[:, None],
+                    jax.vmap(partial(_rebuild_idx, v=v, pad=pad,
+                                     a0=a0))(packed), op),
+                lambda op: op,
+                idx_op)
+            out = jax.vmap(partial(
+                _staged_lane_superstep, planes=planes, v=v, pad=pad))(
+                packed, idx_new[:, :pad], k, comb)
+            return out + (idx_new,
+                          jnp.where(need, jnp.int32(s), idx_rung))
+        return staged_branch
+
+    if n_stages == 1:
+        (new_packed, fail_count, active,
+         idx_new, idx_rung_new) = _make_branch(0)(idx)
+    else:
+        (new_packed, fail_count, active,
+         idx_new, idx_rung_new) = jax.lax.switch(
+            r_exec, [_make_branch(s) for s in range(n_stages)], idx)
+    nc_new = active
+
+    # --- shared transition ---
+    #
+    # The per-lane [B]-vector bookkeeping runs unconditionally (cheap);
+    # every [B, V]-sized pass is guarded by a SCALAR ``lax.cond`` on
+    # whether it can matter this superstep, because in the staged deep
+    # rungs those fixed O(V) passes — not the compacted gather — would
+    # otherwise dominate superstep cost. Each guard is exact by
+    # construction: the skipped select is the identity whenever its
+    # predicate is false for every live lane (frozen lanes are restored
+    # by the trailing freeze, itself skipped only when no lane is
+    # frozen).
     any_fail = fail_count > 0
     stall_new = jnp.where(active < prev_active, 0, stall + 1)
     status_new = status_step(any_fail, active, stall_new, stall_window)
-    new_packed = jnp.where(any_fail, packed, new_packed)
+    # failed supersteps revert the table (rare: guard the [B,V] select)
+    new_packed = jax.lax.cond(
+        jnp.any(any_fail & live),
+        lambda op: jnp.where(any_fail[:, None], op[0], op[1]),
+        lambda op: op[1],
+        (packed, new_packed))
     step_new = step + 1
 
     # the single-graph host loop's exit + STALLED clamp, per graph
     fin = (status_new != _RUNNING) | (step_new >= max_steps)
     status_fin = jnp.where((status_new == _RUNNING) & fin,
                            jnp.int32(_STALLED), status_new)
+    store1 = fin & first
+    store2 = fin & ~first
 
     # --- attempt boundary: store the slot, derive the confirm ---
-    colors = jnp.where(new_packed >= 0, new_packed >> 1, -1)
-    used_new = jnp.where(fin & first,
-                         jnp.max(colors, initial=-1) + 1, used)
+    # (colors max, result-slot stores, packed re-init: all [B,V] work
+    # that only matters on a live lane's boundary superstep)
+    def _boundary(op):
+        new_pk, p1_o, p2_o = op
+        colors = jnp.where(new_pk >= 0, new_pk >> 1, -1)
+        used_b = jnp.where(store1,
+                           jnp.max(colors, axis=1, initial=-1) + 1, used)
+        return (jnp.where(fin[:, None], packed0, new_pk),
+                jnp.where(store1[:, None], new_pk, p1_o),
+                jnp.where(store2[:, None], new_pk, p2_o),
+                used_b)
+
+    packed_new, p1_new, p2_new, used_new = jax.lax.cond(
+        jnp.any(fin & live), _boundary,
+        lambda op: op + (used,), (new_packed, p1, p2))
     k2 = used_new - 1
     run2 = fin & first & (status_fin == _SUCCESS) & (k2 >= 1)
 
     if timing:
         from dgc_tpu.obs.devclock import kernel_clock_us, wrap_delta_us_jax
 
-        # sequenced after the superstep's reduction (dep on `active`);
-        # a fresh lane's first superstep is unattributable (t_prev == 0
-        # sentinel) and the vmap'd while_loop's select already freezes
-        # finished lanes' slots
-        ts = kernel_clock_us(active)
+        # one shared clock read per batched superstep, sequenced after
+        # the reductions (dep on the active counts); a fresh lane's
+        # first superstep is unattributable (t_prev == 0 sentinel)
+        ts = kernel_clock_us(jnp.sum(active))
         t_us = t_us + jnp.where(t_prev > 0,
                                 wrap_delta_us_jax(t_prev, ts), 0)
-        t_prev = ts
+        t_prev = jnp.where(live, ts, t_prev)
 
-    store1 = fin & first
-    store2 = fin & ~first
-    return (
+    new = (
         jnp.where(fin, jnp.where(run2, 1, 2), phase).astype(jnp.int32),
         jnp.where(run2, k2, k).astype(jnp.int32),
-        jnp.where(fin, packed0, new_packed),
+        packed_new,
         jnp.where(fin, 1, step_new).astype(jnp.int32),
         jnp.where(fin, v + 1, active).astype(jnp.int32),
         jnp.where(fin, 0, stall_new).astype(jnp.int32),
-        jnp.where(store1, new_packed, p1),
+        p1_new,
         jnp.where(store1, step_new, s1).astype(jnp.int32),
         jnp.where(store1, status_fin, st1).astype(jnp.int32),
         used_new,
-        jnp.where(store2, new_packed, p2),
+        p2_new,
         jnp.where(store2, step_new, s2).astype(jnp.int32),
         jnp.where(store2, status_fin, st2).astype(jnp.int32),
         t_us, t_prev,
+        jnp.where(fin, 0, rung_now).astype(jnp.int32),
+        nc_new.astype(jnp.int32),
+        # an attempt boundary invalidates the slot list (the confirm's
+        # frontier jumps back to full table); the buffer itself is inert
+        # until the next stage-entry rebuild overwrites it
+        jnp.where(fin, 0, idx_rung_new).astype(jnp.int32),
+        idx_new,
     )
 
+    # freeze finished lanes: each element selected on its OWN live mask
+    # ([B] slots inline — cheap; the wide slots only when some lane is
+    # actually frozen — and the idx buffer not at all: a frozen lane's
+    # slot list is consulted again only after a reset re-init)
+    frozen_any = ~jnp.all(live)
+    out = tuple(
+        n if n.ndim > 1 else jnp.where(live, n, o)
+        for n, o in zip(new, c))
 
-def _sweep_pair_one(comb, degrees, k0, max_steps, *, planes: int,
-                    stall_window: int):
-    """One graph's fused jump-mode pair (vmapped by the batch kernel).
+    def _freeze_wide(op):
+        return tuple(jnp.where(live[:, None], n, o) for n, o in op)
 
-    Returns ``(packed1, steps1, status1, used, packed2, steps2,
-    status2)`` — the fused sweep kernels' shared convention
-    (``engine.compact._sweep_kernel_staged``): slot 2 echoes the
-    all-zero scratch state when the confirm was skipped (host fabricates
-    the k=0 FAILURE, ``engine.fused.finish_sweep_pair``)."""
-    v = degrees.shape[0]
-    nbr, beats = decode_combined(comb)
+    wide = ((new[2], c[2]), (new[6], c[6]), (new[10], c[10]))
+    pk_f, p1_f, p2_f = jax.lax.cond(
+        frozen_any, _freeze_wide, lambda op: tuple(n for n, _ in op), wide)
+    return out[:2] + (pk_f,) + out[3:6] + (p1_f,) + out[7:10] \
+        + (p2_f,) + out[11:]
+
+
+def _sweep_kernel(comb, degrees, k0, max_steps, *, planes: int,
+                  stall_window: int, stages):
+    v = degrees.shape[1]
+    stages, pads, a0 = _resolve_stages(stages, v)
     packed0 = initial_packed(degrees)
 
     def cond(c):
-        return c[0] < 2
+        return jnp.any(c[CARRY_PHASE] < 2)
 
     def body(c):
-        return _superstep_body(c, nbr, beats, packed0, max_steps, v,
-                               planes=planes, stall_window=stall_window)
+        return _superstep_body(c, comb, packed0, max_steps, v,
+                               planes=planes, stall_window=stall_window,
+                               stages=stages, pads=pads, a0=a0)
 
-    out = jax.lax.while_loop(cond, body, _fresh_lane(degrees, k0))
+    out = jax.lax.while_loop(cond, body, _fresh_lanes(degrees, k0, a0))
     return out[OUT0:OUT0 + N_OUT]
 
 
-def _slice_one(comb, degrees, k0, max_steps, reset, carry, *, planes: int,
-               slice_steps: int, stall_window: int, timing: bool):
-    """At most ``slice_steps`` supersteps of one lane's sweep. A lane
-    flagged ``reset`` re-initializes from its (freshly host-written)
-    inputs first; a lane whose phase is already 2 (done / idle) does no
-    work — its carry passes through untouched."""
-    v = degrees.shape[0]
-    nbr, beats = decode_combined(comb)
+def _slice_kernel(comb, degrees, k0, max_steps, reset, carry, *,
+                  planes: int, slice_steps: int, stall_window: int,
+                  timing: bool, stages):
+    v = degrees.shape[1]
+    stages, pads, a0 = _resolve_stages(stages, v)
     packed0 = initial_packed(degrees)
     fresh = reset != 0
-    carry = jax.tree.map(
-        lambda f, c: jnp.where(fresh, f, c), _fresh_lane(degrees, k0),
-        tuple(carry))
+    carry = tuple(
+        jnp.where(fresh if jnp.ndim(f) == 1 else fresh[:, None], f,
+                  jnp.asarray(c))
+        for f, c in zip(_fresh_lanes(degrees, k0, a0), carry))
     if timing:
         from dgc_tpu.obs.devclock import kernel_clock_us
 
         # seed the clock at slice entry for lanes without a prior sample
         # (fresh seats and first-slice lanes), so their first superstep
         # is attributed from the slice boundary
-        ts0 = kernel_clock_us(carry[CARRY_PHASE])
-        live = carry[CARRY_PHASE] < 2
-        t_prev = jnp.where(live & (carry[T_PREV] == 0), ts0, carry[T_PREV])
-        carry = carry[:T_PREV] + (t_prev,)
+        ts0 = kernel_clock_us(jnp.sum(carry[CARRY_PHASE]))
+        alive = carry[CARRY_PHASE] < 2
+        t_prev = jnp.where(alive & (carry[T_PREV] == 0), ts0,
+                           carry[T_PREV])
+        carry = carry[:T_PREV] + (t_prev,) + carry[T_PREV + 1:]
 
     def cond(c):
-        return (c[1] < 2) & (c[0] < slice_steps)
+        return (c[0] < slice_steps) & jnp.any(c[1 + CARRY_PHASE] < 2)
 
     def body(c):
-        new = _superstep_body(c[1:], nbr, beats, packed0, max_steps, v,
+        new = _superstep_body(c[1:], comb, packed0, max_steps, v,
                               planes=planes, stall_window=stall_window,
+                              stages=stages, pads=pads, a0=a0,
                               timing=timing)
         return (c[0] + 1,) + new
 
@@ -257,62 +506,166 @@ def _slice_one(comb, degrees, k0, max_steps, reset, carry, *, planes: int,
     return out[1:]
 
 
-@partial(jax.jit, static_argnames=("planes", "stall_window"))
+@partial(jax.jit, static_argnames=("planes", "stall_window", "stages"))
 def batched_sweep_kernel(comb, degrees, k0, max_steps, planes: int,
-                         stall_window: int = DEFAULT_STALL_WINDOW):
+                         stall_window: int = DEFAULT_STALL_WINDOW,
+                         stages=None):
     """The batch-synchronous class kernel: ``comb int32[B, V_pad,
     W_pad]``, ``degrees int32[B, V_pad]``, per-graph ``k0``/``max_steps``
-    int32[B]. One jit cache entry per (B, V_pad, W_pad, planes) — the
-    serve compile cache's key (``serve.engine``). Every lane runs its
-    whole jump-mode pair; the dispatch returns when the LAST lane
-    finishes (the straggler sync lane recycling removes)."""
-    return jax.vmap(partial(_sweep_pair_one, planes=planes,
-                            stall_window=stall_window))(
-        comb, degrees, k0, max_steps)
+    int32[B]. One jit cache entry per (B, V_pad, W_pad, planes, stages)
+    — the serve compile cache's key (``serve.engine``). Every lane runs
+    its whole jump-mode pair; the dispatch returns when the LAST lane
+    finishes (the straggler sync lane recycling removes). ``stages``
+    (static ladder tuple or None) compiles the staged frontier ladder —
+    module docstring."""
+    return _sweep_kernel(comb, degrees, k0, max_steps, planes=planes,
+                         stall_window=stall_window, stages=stages)
 
 
 @partial(jax.jit, static_argnames=("planes", "slice_steps", "stall_window",
-                                   "timing"))
+                                   "timing", "stages"))
 def batched_slice_kernel(comb, degrees, k0, max_steps, reset, carry,
                          planes: int, slice_steps: int,
                          stall_window: int = DEFAULT_STALL_WINDOW,
-                         timing: bool = False):
+                         timing: bool = False, stages=None):
     """The continuous-batching class kernel: one bounded slice of every
     lane's sweep. Inputs as :func:`batched_sweep_kernel` plus ``reset
     int32[B]`` (1 = re-init the lane from its inputs) and the per-lane
     ``carry`` (:data:`CARRY_LEN`-tuple, batch-leading). Returns the
-    advanced carry; the host reads ``carry[0] >= 2`` as the done mask.
-    ``timing`` (static) accumulates each lane's live superstep wall-µs
-    into carry slot :data:`T_US` (``obs.devclock``; the scheduler's
-    dispatch-overhead split) — the sweep outputs are byte-identical
-    either way. One jit cache entry per (B, V_pad, W_pad, planes,
-    slice_steps, timing)."""
-    return jax.vmap(partial(_slice_one, planes=planes,
-                            slice_steps=slice_steps,
-                            stall_window=stall_window, timing=timing))(
-        comb, degrees, k0, max_steps, reset, carry)
+    advanced carry; the host reads ``carry[CARRY_PHASE] >= 2`` as the
+    done mask and ``CARRY_RUNG``/``CARRY_NC`` as the stage-occupancy
+    telemetry. ``timing`` (static) accumulates each lane's live
+    superstep wall-µs into carry slot :data:`T_US` (``obs.devclock``;
+    the scheduler's dispatch-overhead split) — the sweep outputs are
+    byte-identical either way. One jit cache entry per (B, V_pad,
+    W_pad, planes, slice_steps, timing, stages)."""
+    return _slice_kernel(comb, degrees, k0, max_steps, reset, carry,
+                         planes=planes, slice_steps=slice_steps,
+                         stall_window=stall_window, timing=timing,
+                         stages=stages)
 
 
-def idle_carry(b_pad: int, v_pad: int):
+# True in-place donation of the device-resident buffers is OPT-IN
+# (DGC_TPU_DONATE_CARRY=1): jax 0.4.37's XLA-CPU executable
+# serialization drops the input-output aliasing a donated kernel
+# declares, so an executable LOADED from a persistent compilation cache
+# (JAX_COMPILATION_CACHE_DIR — bench.py sets one by default) applies
+# the caller-side donation bookkeeping against a non-aliasing
+# executable and corrupts the heap. Reproduced deterministically: a
+# fresh-compile process is clean, the next process (cache hit) aborts
+# in glibc ("largebin double linked list corrupted") on the first
+# donated dispatch. The device-resident carry contract — the carry
+# never round-trips host↔device — holds either way; donation only adds
+# in-place buffer reuse, the memory lever to re-test on real TPUs (and
+# after an upstream fix) behind this flag.
+_DONATE_CARRY = os.environ.get("DGC_TPU_DONATE_CARRY") == "1"
+_SLICE_STATICS = ("planes", "slice_steps", "stall_window", "timing",
+                  "stages")
+_donated_slice_jit = partial(
+    jax.jit, static_argnames=_SLICE_STATICS,
+    **({"donate_argnums": (5,)} if _DONATE_CARRY else {}))
+_donated_seat_jit = partial(
+    jax.jit, **({"donate_argnums": (0, 1, 2, 3)} if _DONATE_CARRY else {}))
+
+
+@_donated_slice_jit
+def batched_slice_kernel_donated(comb, degrees, k0, max_steps, reset, carry,
+                                 planes: int, slice_steps: int,
+                                 stall_window: int = DEFAULT_STALL_WINDOW,
+                                 timing: bool = False, stages=None):
+    """:func:`batched_slice_kernel` compiled for the device-resident
+    carry dispatch (``--device-carry``): the scheduler passes device
+    arrays, replaces its reference with the returned carry, and never
+    touches the old buffers again — so the carry crosses the host
+    boundary zero times per slice. With ``DGC_TPU_DONATE_CARRY=1`` the
+    carry buffers are additionally DONATED and re-entered in place
+    (see :data:`_DONATE_CARRY` for why that is opt-in)."""
+    return _slice_kernel(comb, degrees, k0, max_steps, reset, carry,
+                         planes=planes, slice_steps=slice_steps,
+                         stall_window=stall_window, timing=timing,
+                         stages=stages)
+
+
+@_donated_seat_jit
+def seat_lane_kernel(comb, degrees, k0, max_steps, reset, lane,
+                     m_comb, m_degrees, m_k0, m_max_steps):
+    """On-device lane seating (device-resident carry mode): scatter ONE
+    swapped lane's inputs into the batch input stacks and raise its
+    reset flag — the per-seat host→device traffic is one lane's table
+    row instead of the whole ``[B, V_pad, W_pad]`` stack re-upload the
+    host-mirror path pays. ``reset`` is never donated: the scheduler
+    passes its cached all-zeros buffer and must keep it valid for the
+    next post-slice rearm."""
+    return (comb.at[lane].set(m_comb), degrees.at[lane].set(m_degrees),
+            k0.at[lane].set(m_k0), max_steps.at[lane].set(m_max_steps),
+            reset.at[lane].set(1))
+
+
+@jax.jit
+def permute_carry_kernel(carry, base, src, dst):
+    """On-device carry compaction for a pool resize (device-resident
+    carry mode): move the kept lanes' carry rows ``src`` of the old
+    carry into rows ``dst`` of the idle ``base`` carry — no host
+    round-trip of the packed tables.
+
+    ``base`` MUST be per-slot-distinct device buffers (``device_put`` of
+    the numpy :func:`idle_carry`, whose slots are distinct arrays):
+    the outputs seed the next DONATED slice call, and XLA CSE would
+    collapse equal-valued constant slots built on device into one
+    buffer — donating one buffer through two carry slots corrupts the
+    heap (observed as a glibc abort on the CPU backend)."""
+    return tuple(b.at[dst].set(a[src]) for a, b in zip(carry, base))
+
+
+@jax.jit
+def resize_inputs_kernel(comb, degrees, k0, max_steps, src,
+                         dummy_comb, dummy_degrees, dummy_k0, dummy_ms):
+    """On-device input-stack resize (device-resident carry mode): row
+    ``i`` of the new stacks is old lane ``src[i]``, or the class dummy
+    when ``src[i]`` indexes past the old width — the kept lanes move on
+    device and only the (pool-cached) dummy row ever crossed the bus.
+    Reset flags come back all-zero: seats pending at resize time are
+    re-scattered by ``seat_lane_kernel`` afterwards."""
+    comb_ext = jnp.concatenate([comb, dummy_comb[None]], axis=0)
+    degrees_ext = jnp.concatenate([degrees, dummy_degrees[None]], axis=0)
+    k0_ext = jnp.concatenate([k0, dummy_k0[None]])
+    ms_ext = jnp.concatenate([max_steps, dummy_ms[None]])
+    return (comb_ext[src], degrees_ext[src], k0_ext[src], ms_ext[src],
+            jnp.zeros(src.shape[0], jnp.int32))
+
+
+def idle_carry(b_pad: int, v_pad: int, a_pad: int = 1):
     """Host-side all-idle lane carry (phase 2, inert): the continuous
     pool's starting state and the shape every resize pads with. Plain
-    numpy — the kernel's first invocation uploads it."""
+    numpy — the kernel's first invocation uploads it. ``a_pad`` is the
+    class ladder's carried slot-list width (:func:`stage_idx_width`; 1
+    for full-table-only kernels)."""
     pk = np.zeros((b_pad, v_pad), np.int32)
     z = np.zeros(b_pad, np.int32)
     return (np.full(b_pad, 2, np.int32), np.ones(b_pad, np.int32),
             pk.copy(), z.copy(), z.copy(), z.copy(),
             pk.copy(), z.copy(), z.copy(), z.copy(),
             pk.copy(), z.copy(), np.full(b_pad, int(_FAILURE), np.int32),
-            z.copy(), z.copy())
+            z.copy(), z.copy(),
+            z.copy(), z.copy(),
+            z.copy(), np.full((b_pad, a_pad), v_pad, np.int32))
 
 
-def lane_outputs(carry_np, lane: int):
+def lane_outputs(carry, lane: int):
     """Extract one done lane's ``(p1, s1, st1, used, p2, s2, st2)`` —
-    the sweep-result convention ``finish_pair`` consumes — from a
-    host-materialized carry."""
-    p1, s1, st1, used, p2, s2, st2 = (carry_np[j][lane]
+    the sweep-result convention ``finish_pair`` consumes. Works on a
+    host-materialized carry (numpy tuple — free) and on a
+    device-resident carry (jax arrays — transfers ONLY this lane's two
+    result rows and five scalars, the device-carry contract)."""
+    p1, s1, st1, used, p2, s2, st2 = (np.asarray(carry[j][lane])
                                       for j in range(OUT0, OUT0 + N_OUT))
-    return p1, s1, st1, int(used), p2, s2, int(st2)
+    return p1, int(s1), int(st1), int(used), p2, int(s2), int(st2)
+
+
+def carry_nbytes(carry) -> int:
+    """Total byte size of a carry tuple (transfer accounting; every slot
+    is int32, and ``.size`` touches no device data)."""
+    return int(sum(int(a.size) * 4 for a in carry))
 
 
 # -- slice-size policy ----------------------------------------------------
@@ -325,7 +678,10 @@ def lane_outputs(carry_np, lane: int):
 # it (recycling latency ≈ S·superstep_s). The policy sizes S so dispatch
 # overhead stays ≤ ``overhead_frac`` of slice compute, clamped to
 # [lo, hi] — the pricing argument is written out in PERF.md
-# "Continuous batching".
+# "Continuous batching". With the staged ladder, per-superstep compute
+# DECAYS as frontiers collapse, so the measured recalibration
+# (``serve.engine.BatchScheduler._timing_sample``) prices against the
+# post-ladder median, not the expensive full-table opening slices.
 _DISPATCH_OVERHEAD_S = {"tpu": 65e-3, "gpu": 10e-3, "cpu": 0.6e-3}
 _ENTRIES_PER_S = {"tpu": 1.0e10, "gpu": 5e9, "cpu": 1.5e8}
 
@@ -338,7 +694,7 @@ def priced_slice_steps(overhead_s: float, superstep_s: float, *,
     to [lo, hi]. ``auto_slice_steps`` feeds it the static per-backend
     model; the scheduler's timing-column recalibration
     (``serve.engine.BatchScheduler``) feeds it MEASURED overhead and
-    superstep seconds instead."""
+    post-ladder-median superstep seconds instead."""
     s = math.ceil(overhead_s / (overhead_frac * max(superstep_s, 1e-9)))
     return int(min(hi, max(lo, s)))
 

@@ -66,6 +66,22 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--no-affinity", action="store_true",
                    help="disable predicted-depth affinity batching "
                         "(co-scheduling similar-depth requests)")
+    p.add_argument("--serve-stages", choices=["auto", "off"],
+                   default="auto",
+                   help="staged frontier ladder in the batched kernels: "
+                        "auto (default) derives each shape class's "
+                        "compaction-stage ladder from the single-graph "
+                        "engine's schedule machinery (per-class tuned "
+                        "artifacts in --tuned-cache-dir override it); "
+                        "off compiles the full-table kernels (the "
+                        "staged-vs-full A/B arm)")
+    p.add_argument("--device-carry", action="store_true",
+                   help="device-resident lane carry (continuous mode): "
+                        "donated slice kernels re-enter the carry in "
+                        "place, lane seating is an on-device scatter of "
+                        "one lane's inputs, and per-slice host↔device "
+                        "traffic drops to the scheduling scalars plus "
+                        "done lanes' result rows")
     p.add_argument("--warm-classes", type=str, default=None,
                    metavar="CLS1,CLS2,...",
                    help="pre-compile these shape classes' kernel pad "
@@ -141,7 +157,10 @@ def serve_main(argv: list[str] | None = None) -> int:
     manifest = RunManifest()
     logger.add_sink(manifest)
     tuned_cache = None
-    if args.auto_tune and args.tuned_cache_dir:
+    if args.tuned_cache_dir:
+        # the cache directory serves two layers: per-shape fallback
+        # schedules (--auto-tune) and per-class serve stage ladders
+        # (serve-<class>.json artifacts, consulted by --serve-stages auto)
         from dgc_tpu.tune.cache import TunedConfigCache
 
         tuned_cache = TunedConfigCache(args.tuned_cache_dir)
@@ -186,6 +205,7 @@ def serve_main(argv: list[str] | None = None) -> int:
         slice_steps=(None if args.slice_steps == "auto"
                      else args.slice_steps),
         affinity=not args.no_affinity,
+        stages=args.serve_stages, device_carry=args.device_carry,
         timing=args.kernel_timing, trace=not args.no_trace,
         validate=not args.no_validate,
         post_reduce=not args.no_reduce_colors,
@@ -288,6 +308,8 @@ def serve_main(argv: list[str] | None = None) -> int:
                  warmed_kernels=warmup["kernels"] if warmup else None,
                  compile_misses=front.scheduler.stats["compile_misses"],
                  compile_hits=front.scheduler.stats["compile_hits"],
+                 h2d_mb=round(front.scheduler.stats["h2d_bytes"] / 1e6, 3),
+                 d2h_mb=round(front.scheduler.stats["d2h_bytes"] / 1e6, 3),
                  **summary_kw)
     if metrics_server is not None:
         metrics_server.close()

@@ -62,20 +62,27 @@ import time
 import numpy as np
 
 from dgc_tpu.engine.base import AttemptResult, empty_budget_failure
-from dgc_tpu.layout import CARRY_LEN, CARRY_PHASE, T_US
+from dgc_tpu.layout import (CARRY_LEN, CARRY_NC, CARRY_PHASE, CARRY_RUNG,
+                            T_US)
 from dgc_tpu.obs.trace import NULL_TRACER
 from dgc_tpu.serve.batched import (
     DEFAULT_STALL_WINDOW,
     auto_slice_steps,
     batched_slice_kernel,
+    batched_slice_kernel_donated,
     batched_sweep_kernel,
+    carry_nbytes,
     finish_pair,
     idle_carry,
     lane_outputs,
+    permute_carry_kernel,
     priced_slice_steps,
+    resize_inputs_kernel,
+    seat_lane_kernel,
+    stage_idx_width,
 )
 from dgc_tpu.serve.shape_classes import (dummy_member, pad_ladder,
-                                         padding_waste)
+                                         padding_waste, stage_schedule_for)
 
 # FIFO takes over affinity ordering for calls older than this many
 # batching windows — affinity may reorder, never starve
@@ -126,37 +133,68 @@ class _LanePool:   # dgc-lint: owned-by dispatcher
     carry (round-tripped every slice), and the per-lane call bookkeeping.
     Owned by the dispatcher thread — no locking (the ``owned-by``
     marker above is the checked claim; ``BatchScheduler.stop`` touches
-    pools only after joining the dispatcher)."""
+    pools only after joining the dispatcher).
+
+    ``device=True`` is the **device-resident carry** mode
+    (``--device-carry``): the carry never round-trips — the slice kernel
+    is the donated variant re-entering the same buffers in place, lane
+    seating is an on-device scatter of ONE lane's inputs
+    (``serve.batched.seat_lane_kernel``) instead of a full table-stack
+    re-upload, a pool resize permutes the carry on device, and the only
+    per-slice device→host traffic is the phase/rung/nc scheduling
+    scalars plus each DONE lane's two result rows. ``h2d``/``d2h``
+    count every host↔device byte either mode actually moves — the
+    transfer accounting the ``serve_slice`` events and PERF.md publish.
+    """
 
     __slots__ = ("cls", "b_pad", "comb", "degrees", "k0", "max_steps",
                  "reset", "carry", "calls", "t_fill", "slices_in",
-                 "t_seen", "_dev_inputs", "_dirty", "_dummy")
+                 "t_seen", "_dev_inputs", "_dirty", "_dummy", "device",
+                 "_dev", "_zeros_reset", "_dummy_dev", "h2d", "d2h",
+                 "a_pad")
 
-    def __init__(self, cls, b_pad: int, dummy):
+    def __init__(self, cls, b_pad: int, dummy, device: bool = False,
+                 a_pad: int = 1):
         self.cls = cls
         self._dummy = dummy
+        self.device = bool(device)
+        self.a_pad = int(a_pad)   # the class ladder's CARRY_IDX width
         self.b_pad = 0
         self.calls = []
         self.t_fill = []
         self.slices_in = []
+        self.h2d = 0
+        self.d2h = 0
+        self._dev = None
+        self._dummy_dev = None    # device mirror of the class dummy row
         self._resize(b_pad)
 
     def _resize(self, b_pad: int) -> None:
         """(Re)allocate at ``b_pad`` lanes, compacting live lanes into
         the low indices (lane identity is per-slice, not per-request —
-        the call list follows the carry rows)."""
+        the call list follows the carry rows). In device mode the carry
+        rows move ON DEVICE (``permute_carry_kernel``); the input stacks
+        re-upload from the host mirrors (resizes are pad-boundary rare,
+        the steady state never pays this)."""
         keep = [i for i, c in enumerate(self.calls) if c is not None]
         assert len(keep) <= b_pad, "resize would drop live lanes"
         cls, dummy = self.cls, self._dummy
+        old_b = self.b_pad
         comb = np.repeat(dummy.comb[None], b_pad, axis=0)
         degrees = np.zeros((b_pad, cls.v_pad), np.int32)
         k0 = np.ones(b_pad, np.int32)
         max_steps = np.full(b_pad, dummy.max_steps, np.int32)
         reset = np.zeros(b_pad, np.int32)
-        carry = idle_carry(b_pad, cls.v_pad)
+        carry = idle_carry(b_pad, cls.v_pad, self.a_pad)
         old_carry = None
+        dev_old = None
         if keep:
-            old_carry = tuple(np.asarray(a) for a in self.carry)
+            if self.device and not isinstance(self.carry[0], np.ndarray):
+                dev_old = self.carry
+            else:
+                if not isinstance(self.carry[0], np.ndarray):
+                    self.d2h += carry_nbytes(self.carry)
+                old_carry = tuple(np.asarray(a) for a in self.carry)
         calls = [None] * b_pad
         t_fill = [0.0] * b_pad
         slices_in = [0] * b_pad
@@ -167,12 +205,44 @@ class _LanePool:   # dgc-lint: owned-by dispatcher
             k0[new_i] = self.k0[old_i]
             max_steps[new_i] = self.max_steps[old_i]
             reset[new_i] = self.reset[old_i]
-            for j in range(CARRY_LEN):
-                carry[j][new_i] = old_carry[j][old_i]
+            if old_carry is not None:
+                for j in range(CARRY_LEN):
+                    carry[j][new_i] = old_carry[j][old_i]
             calls[new_i] = self.calls[old_i]
             t_fill[new_i] = self.t_fill[old_i]
             slices_in[new_i] = self.slices_in[old_i]
             t_seen[new_i] = self.t_seen[old_i]
+        if dev_old is not None:
+            import jax
+
+            # device-resident resize: the carry rows and the input
+            # stacks move on device. The permute base uploads the small
+            # idle carry from host — its slots must be DISTINCT buffers
+            # because they seed the next donated slice call
+            # (permute_carry_kernel docstring: CSE'd equal-constant
+            # slots would be donated twice and corrupt the heap)
+            base = tuple(jax.device_put(a) for a in carry)
+            self.h2d += carry_nbytes(base)
+            src = np.asarray(keep, np.int32)
+            dst = np.arange(len(keep), dtype=np.int32)
+            carry = permute_carry_kernel(dev_old, base, src, dst)
+        new_dev = None
+        if dev_old is not None and self._dev is not None:
+            import jax
+
+            if self._dummy_dev is None:
+                self._dummy_dev = (jax.device_put(dummy.comb),
+                                   jax.device_put(
+                                       np.zeros(cls.v_pad, np.int32)))
+                self.h2d += dummy.comb.nbytes + cls.v_pad * 4
+            src_map = np.full(b_pad, old_b, np.int32)   # old_b = dummy
+            for new_i, old_i in enumerate(keep):
+                src_map[new_i] = old_i
+            new_dev = resize_inputs_kernel(
+                *self._dev[:4], src_map,
+                self._dummy_dev[0], self._dummy_dev[1],
+                np.int32(1), np.int32(dummy.max_steps))
+            dirty_new = [keep.index(l) for l in self._dirty if l in keep]
         self.b_pad = b_pad
         self.comb, self.degrees = comb, degrees
         self.k0, self.max_steps, self.reset = k0, max_steps, reset
@@ -180,7 +250,13 @@ class _LanePool:   # dgc-lint: owned-by dispatcher
         self.calls, self.t_fill, self.slices_in = calls, t_fill, slices_in
         self.t_seen = t_seen
         self._dev_inputs = None
-        self._dirty = []
+        self._zeros_reset = None
+        if new_dev is not None:
+            self._dev = new_dev
+            self._dirty = dirty_new
+        else:
+            self._dev = None
+            self._dirty = []
 
     @property
     def live(self) -> int:
@@ -229,8 +305,56 @@ class _LanePool:   # dgc-lint: owned-by dispatcher
         if self._dev_inputs is None or self._dirty:
             self._dev_inputs = (jax.device_put(self.comb),
                                 jax.device_put(self.degrees))
+            self.h2d += self.comb.nbytes + self.degrees.nbytes
             self._dirty = []
         return self._dev_inputs
+
+    def dev_state(self):
+        """Device-carry mode's kernel inputs ``(comb, degrees, k0,
+        max_steps, reset)``, maintained incrementally: a first call (or
+        post-resize call) uploads the stacks once; afterwards every
+        seated lane lands as ONE on-device row scatter
+        (``seat_lane_kernel``) whose host→device traffic is that lane's
+        table row — the full-stack re-upload the host-mirror path pays
+        per swap never recurs."""
+        import jax
+
+        if self._zeros_reset is None:
+            self._zeros_reset = jax.device_put(
+                np.zeros(self.b_pad, np.int32))
+        if self._dev is None:
+            self._dev = (jax.device_put(self.comb),
+                         jax.device_put(self.degrees),
+                         jax.device_put(self.k0),
+                         jax.device_put(self.max_steps),
+                         jax.device_put(self.reset))
+            self.h2d += (self.comb.nbytes + self.degrees.nbytes
+                         + self.k0.nbytes + self.max_steps.nbytes
+                         + self.reset.nbytes)
+            self._dirty = []
+        elif self._dirty:
+            comb, degrees, k0, max_steps, reset = self._dev
+            for lane in self._dirty:
+                comb, degrees, k0, max_steps, reset = seat_lane_kernel(
+                    comb, degrees, k0, max_steps, reset,
+                    np.int32(lane), self.comb[lane], self.degrees[lane],
+                    np.int32(self.k0[lane]),
+                    np.int32(self.max_steps[lane]))
+                self.h2d += (self.comb[lane].nbytes
+                             + self.degrees[lane].nbytes + 12)
+            self._dev = (comb, degrees, k0, max_steps, reset)
+            self._dirty = []
+        return self._dev
+
+    def rearm(self, carry) -> None:
+        """Post-slice bookkeeping: adopt the advanced carry and lower
+        every reset flag (device mode swaps in the cached zeros buffer —
+        no transfer; host mode zeroes the mirror array)."""
+        self.carry = carry
+        self.reset[:] = 0
+        if self._dev is not None:
+            comb, degrees, k0, max_steps, _ = self._dev
+            self._dev = (comb, degrees, k0, max_steps, self._zeros_reset)
 
     def maybe_shrink(self) -> None:
         """Shrink to the live set's power-of-two pad as soon as a pad
@@ -267,6 +391,8 @@ class BatchScheduler:
                  mode: str = "continuous", slice_steps: int | None = None,
                  affinity: bool = True, timing: bool = False,
                  recal_min_slices: int = 8,
+                 stages="auto", device_carry: bool = False,
+                 tuned_cache=None,
                  on_batch=None, on_event=None, tracer=None):
         if batch_max < 1:
             raise ValueError(f"batch_max must be >= 1, got {batch_max}")
@@ -275,17 +401,36 @@ class BatchScheduler:
         if slice_steps is not None and int(slice_steps) < 1:
             raise ValueError(
                 f"slice_steps must be >= 1 or None (auto), got {slice_steps}")
+        if not (stages in ("auto", "off") or isinstance(stages, tuple)):
+            raise ValueError(
+                f"stages must be 'auto', 'off', or a stage ladder tuple, "
+                f"got {stages!r}")
         self.batch_max = int(batch_max)
         self.window_s = float(window_s)
         self.stall_window = int(stall_window)
         self.mode = mode
         self.slice_steps = None if slice_steps is None else int(slice_steps)
         self.affinity = bool(affinity)
+        # staged frontier ladder (serve.batched module docstring):
+        # "auto" derives each class's ladder (tuned-cache per-class
+        # override first, then engine.compact.class_stage_schedule);
+        # "off" compiles the full-table kernels (the A/B arm); an
+        # explicit ladder tuple applies to every class
+        self.stages = stages
+        # device-resident carry (continuous mode): donated slice kernel,
+        # on-device lane seating, per-slice transfers reduced to the
+        # scheduling scalars + done lanes' result rows
+        self.device_carry = bool(device_carry)
+        self._tuned_cache = tuned_cache
         # in-kernel timing (obs.devclock): compiles the slice kernels'
         # timing variant, splits slice wall time into superstep compute
         # vs dispatch overhead, and — with slice_steps auto — re-prices
         # the slice size ONCE per class from the measured split after
-        # ``recal_min_slices`` full slices (one recompile, then frozen)
+        # ``recal_min_slices`` full slices at the deepest ladder rung
+        # reached (one recompile, then frozen). Staged supersteps get
+        # cheaper as frontiers decay, so the sample window restarts
+        # whenever a deeper rung appears and the pricing uses the
+        # post-ladder MEDIAN, never the expensive opening slices.
         self.timing = bool(timing)
         self.recal_min_slices = int(recal_min_slices)
         self.on_batch = on_batch
@@ -296,8 +441,9 @@ class BatchScheduler:
         self._pending: dict = {}   # class -> [_SweepCall]; guarded-by: _lock
         self._kernels: dict = {}   # compile-cache key -> fn; guarded-by: _lock
         self._dummies: dict = {}   # class -> ServeMember; guarded-by: _lock
+        self._class_stages: dict = {}  # class -> stages|None; guarded-by: _lock
         self._pools: dict = {}     # class -> _LanePool; guarded-by: dispatcher
-        self._timing_acc: dict = {}  # cls -> [n, ovh, it]; guarded-by: dispatcher
+        self._timing_acc: dict = {}  # cls -> window dict; guarded-by: dispatcher
         self._recal: dict = {}     # cls -> slice_steps; guarded-by: _lock
         self._stop = False         # guarded-by: _lock
         self._thread = None        # guarded-by: owner
@@ -305,7 +451,8 @@ class BatchScheduler:
         # thread), read live by serve_summary/bench
         self.stats = {"batches": 0, "sweeps": 0, "compile_hits": 0,
                       "compile_misses": 0, "slices": 0, "recycles": 0,
-                      "max_live": 0, "recals": 0}   # guarded-by: _lock
+                      "max_live": 0, "recals": 0,
+                      "h2d_bytes": 0, "d2h_bytes": 0}   # guarded-by: _lock
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "BatchScheduler":
@@ -367,18 +514,24 @@ class BatchScheduler:
         return call.result
 
     # -- warmup ---------------------------------------------------------
-    def warm_class(self, cls) -> int:
+    def warm_class(self, cls) -> dict:
         """Pre-compile a class's whole power-of-two pad ladder (every
         ``b_pad`` the adaptive pool can visit, up to ``batch_max``) by
         running each kernel once on all-dummy lanes — the one-off
         wide-batch XLA compile lands here instead of in first-batch
-        latency. Returns the number of kernels warmed. Call before
-        ``start()`` or from the dispatching thread's quiet periods; the
-        jit cache is process-global so warming races nothing."""
+        latency. Returns ``{"kernels", "stage_bodies", "seconds"}`` —
+        ``stage_bodies`` is the class ladder's compiled stage-branch
+        count per kernel (the compile-cache growth the denser ladder is
+        priced against: a staged kernel traces one superstep body per
+        rung, and ``seconds`` is where that cost lands — PERF.md
+        "Staged serve sweeps"). Call before ``start()`` or from the
+        dispatching thread's quiet periods; the jit cache is
+        process-global so warming races nothing."""
         with self._lock:
             dummy = self._dummies.get(cls)
             if dummy is None:
                 dummy = self._dummies[cls] = dummy_member(cls)
+        t0 = time.perf_counter()
         warmed = 0
         for b in pad_ladder(self.batch_max):
             comb = np.repeat(dummy.comb[None], b, axis=0)
@@ -389,12 +542,16 @@ class BatchScheduler:
                 kernel, _ = self._slice_kernel_for(cls, b)
                 reset = np.ones(b, np.int32)
                 kernel(comb, degrees, k0, max_steps, reset,
-                       idle_carry(b, cls.v_pad))
+                       idle_carry(b, cls.v_pad,
+                                  stage_idx_width(self.stages_for(cls))))
             else:
                 kernel, _ = self._kernel_for(cls, b)
                 kernel(comb, degrees, k0, max_steps)
             warmed += 1
-        return warmed
+        stages = self.stages_for(cls)
+        return {"kernels": warmed,
+                "stage_bodies": len(stages) if stages else 1,
+                "seconds": time.perf_counter() - t0}
 
     # -- affinity -------------------------------------------------------
     def _affinity_order(self, calls: list, live_depths: list) -> list:
@@ -420,18 +577,57 @@ class BatchScheduler:
             key = lambda c: (-groups[c.depth], c.depth, c.t_enqueue)
         return sorted(calls, key=key)
 
+    def reset_transfer_stats(self) -> None:
+        """Zero the h2d/d2h byte counters (bench harnesses call this
+        after warmup so the published transfer accounting covers only
+        the measured stream)."""
+        with self._lock:
+            self.stats["h2d_bytes"] = 0
+            self.stats["d2h_bytes"] = 0
+
+    # -- stage-ladder resolution ----------------------------------------
+    def stages_for(self, cls):
+        """The staged-frontier-ladder schedule this scheduler compiles
+        for ``cls`` (None = full-table kernel). Resolution order: an
+        explicit ladder / "off" override, then a per-class tuned-config
+        artifact from the tuned cache (``tune.cache.TunedConfigCache
+        .class_config`` — the serve-side tuned-ladder hook), then the
+        engine-derived default (``shape_classes.stage_schedule_for``).
+        Cached per class; the result is part of every kernel-cache key.
+        """
+        if self.stages == "off":
+            return None
+        if isinstance(self.stages, tuple):
+            return stage_schedule_for(cls, self.stages)
+        with self._lock:
+            if cls in self._class_stages:
+                return self._class_stages[cls]
+        st = None
+        if self._tuned_cache is not None:
+            cfg_fn = getattr(self._tuned_cache, "class_config", None)
+            cfg = cfg_fn(cls) if cfg_fn is not None else None
+            if cfg is not None and cfg.stages:
+                st = stage_schedule_for(cls, cfg.stages)
+        if st is None:
+            st = stage_schedule_for(cls, "auto")
+        with self._lock:
+            self._class_stages[cls] = st
+        return st
+
     # -- compile caches -------------------------------------------------
     # the kernel cache and its hit/miss stats are mutated by BOTH the
     # dispatcher thread (every dispatch) and the warm path (the
     # front-end's caller thread, possibly while serving) — the found
     # dgc-lint LK finding this section now locks against
     def _kernel_for(self, cls, b_pad: int):
-        key = ("sync", cls.v_pad, cls.w_pad, cls.planes, b_pad)
+        stages = self.stages_for(cls)
+        key = ("sync", cls.v_pad, cls.w_pad, cls.planes, b_pad, stages)
         with self._lock:
             hit = key in self._kernels
             if not hit:
                 self._kernels[key] = lambda *a: batched_sweep_kernel(
-                    *a, planes=cls.planes, stall_window=self.stall_window)
+                    *a, planes=cls.planes, stall_window=self.stall_window,
+                    stages=stages)
                 self.stats["compile_misses"] += 1
             else:
                 self.stats["compile_hits"] += 1
@@ -439,14 +635,18 @@ class BatchScheduler:
 
     def _slice_kernel_for(self, cls, b_pad: int):
         s = self.resolved_slice_steps(cls, b_pad)
+        stages = self.stages_for(cls)
+        kern = (batched_slice_kernel_donated if self.device_carry
+                else batched_slice_kernel)
         key = ("slice", cls.v_pad, cls.w_pad, cls.planes, b_pad, s,
-               self.timing)
+               self.timing, stages, self.device_carry)
         with self._lock:
             hit = key in self._kernels
             if not hit:
-                self._kernels[key] = lambda *a: batched_slice_kernel(
+                self._kernels[key] = lambda *a: kern(
                     *a, planes=cls.planes, slice_steps=s,
-                    stall_window=self.stall_window, timing=self.timing)
+                    stall_window=self.stall_window, timing=self.timing,
+                    stages=stages)
                 self.stats["compile_misses"] += 1
             else:
                 self.stats["compile_hits"] += 1
@@ -461,23 +661,43 @@ class BatchScheduler:
             return recal
         return auto_slice_steps(cls.entries(), b_pad)
 
-    def _timing_sample(self, cls, overhead_s: float, iter_s: float) -> None:
+    def _timing_sample(self, cls, overhead_s: float, iter_s: float,
+                      rung: int = 0) -> None:
         """One full slice's measured (dispatch overhead, per-superstep
-        seconds); after ``recal_min_slices`` samples the class's slice
-        size is re-priced ONCE from the measured split (slice_steps auto
-        only — an explicit --slice-steps is never overridden)."""
-        acc = self._timing_acc.setdefault(cls, [0, 0.0, 0.0])
-        acc[0] += 1
-        acc[1] += overhead_s
-        acc[2] += iter_s
+        seconds) at ladder rung ``rung`` (the slice's minimum live
+        rung); after ``recal_min_slices`` samples at the deepest rung
+        seen, the class's slice size is re-priced ONCE from the MEDIAN
+        of that window (slice_steps auto only — an explicit
+        --slice-steps is never overridden).
+
+        The window restarts whenever a deeper rung appears and shallower
+        late samples are skipped: staged supersteps get cheaper as the
+        frontier decays, so pricing against the opening full-table
+        slices (the pre-PR 9 one-shot mean) over-estimated superstep
+        cost and under-sized the slice for the whole post-ladder tail —
+        the recalibration must track where the sweep actually spends its
+        slices, the post-ladder regime."""
+        acc = self._timing_acc.setdefault(
+            cls, {"rung": -1, "ovh": [], "it": []})
+        if rung > acc["rung"]:
+            acc["rung"] = rung
+            acc["ovh"] = []
+            acc["it"] = []
+        elif rung < acc["rung"]:
+            return   # a recycled lane dragged the pool back up-ladder
+        acc["ovh"].append(overhead_s)
+        acc["it"].append(iter_s)
+        n = len(acc["it"])
         with self._lock:
             done = (self.slice_steps is not None or cls in self._recal
-                    or acc[0] < self.recal_min_slices)
+                    or n < self.recal_min_slices)
         if done:
             return
-        overhead = acc[1] / acc[0]
-        iter_mean = acc[2] / acc[0]
-        s_new = priced_slice_steps(overhead, iter_mean)
+        import statistics
+
+        overhead = statistics.median(acc["ovh"])
+        iter_med = statistics.median(acc["it"])
+        s_new = priced_slice_steps(overhead, iter_med)
         s_old = auto_slice_steps(cls.entries(),
                                  self._pools[cls].b_pad
                                  if cls in self._pools else 1)
@@ -491,8 +711,8 @@ class BatchScheduler:
                     "shape_class": cls.name, "from_steps": int(s_old),
                     "to_steps": int(s_new),
                     "overhead_ms": round(overhead * 1e3, 3),
-                    "sstep_ms": round(iter_mean * 1e3, 3),
-                    "samples": int(acc[0]),
+                    "sstep_ms": round(iter_med * 1e3, 3),
+                    "samples": int(n), "rung": int(acc["rung"]),
                 })
 
     # =====================================================================
@@ -574,7 +794,9 @@ class BatchScheduler:
                 dummy = self._dummies.get(cls)
                 if dummy is None:
                     dummy = self._dummies[cls] = dummy_member(cls)
-            pool = self._pools[cls] = _LanePool(cls, 1, dummy)
+            pool = self._pools[cls] = _LanePool(
+                cls, 1, dummy, device=self.device_carry,
+                a_pad=stage_idx_width(self.stages_for(cls)))
 
         free = self.batch_max - pool.live
         admitted = 0
@@ -601,18 +823,39 @@ class BatchScheduler:
 
         kernel, cache_hit = self._slice_kernel_for(cls, pool.b_pad)
         slice_steps = self.resolved_slice_steps(cls, pool.b_pad)
-        comb_dev, degrees_dev = pool.dev_inputs()
         slice_span = self.tracer.begin(
             "slice", trace="sched",
             attrs={"cls": cls.name, "live": int(live),
                    "b_pad": int(pool.b_pad)})
         t0 = time.perf_counter()
-        carry = kernel(comb_dev, degrees_dev, pool.k0, pool.max_steps,
-                       pool.reset, pool.carry)
-        phase = np.asarray(carry[CARRY_PHASE])   # forces the dispatch; tiny
+        if self.device_carry:
+            # device-resident carry: every input lives on device (lane
+            # seats landed as on-device scatters), the carry buffers are
+            # DONATED and re-entered in place — pool.carry is replaced
+            # below and the donated arrays never touched again
+            comb_dev, degrees_dev, k0_in, ms_in, reset_in = pool.dev_state()
+            if isinstance(pool.carry[0], np.ndarray):
+                pool.h2d += carry_nbytes(pool.carry)   # first upload only
+        else:
+            comb_dev, degrees_dev = pool.dev_inputs()
+            k0_in, ms_in, reset_in = pool.k0, pool.max_steps, pool.reset
+            # the host-mirror path re-uploads the scheduling vectors
+            # every slice (numpy → device) and the carry once (its first
+            # invocation; afterwards the returned device arrays re-enter)
+            pool.h2d += (pool.k0.nbytes + pool.max_steps.nbytes
+                         + pool.reset.nbytes)
+            if isinstance(pool.carry[0], np.ndarray):
+                pool.h2d += carry_nbytes(pool.carry)
+        carry = kernel(comb_dev, degrees_dev, k0_in, ms_in, reset_in,
+                       pool.carry)
+        # the per-lane scheduling scalars — the ONLY unconditional
+        # device→host transfer per slice: done mask + stage telemetry
+        phase = np.asarray(carry[CARRY_PHASE])   # forces the dispatch
+        rung = np.asarray(carry[CARRY_RUNG])
+        nc = np.asarray(carry[CARRY_NC])
+        pool.d2h += 3 * phase.nbytes
         device_s = time.perf_counter() - t0
-        pool.carry = carry
-        pool.reset[:] = 0
+        pool.rearm(carry)
         for i in range(pool.b_pad):
             pool.slices_in[i] += 1
 
@@ -624,6 +867,7 @@ class BatchScheduler:
         t_acc = None
         if self.timing:
             t_acc = np.asarray(carry[T_US]).astype(np.int64)
+            pool.d2h += phase.nbytes
             deltas = t_acc - pool.t_seen
             live_mask = np.array([c is not None for c in pool.calls])
             sstep_s = (float(deltas[live_mask].max()) / 1e6
@@ -634,11 +878,18 @@ class BatchScheduler:
         done_lanes = [i for i in range(pool.b_pad)
                       if pool.calls[i] is not None and phase[i] >= 2]
         if done_lanes:
-            carry_np = tuple(np.asarray(a) for a in carry)
+            if self.device_carry:
+                # transfer ONLY the done lanes' result slots (two packed
+                # rows + five scalars apiece) — the carry stays resident
+                out_src = carry
+                pool.d2h += len(done_lanes) * (2 * cls.v_pad + 5) * 4
+            else:
+                out_src = tuple(np.asarray(a) for a in carry)
+                pool.d2h += carry_nbytes(out_src)
             now = time.perf_counter()
             for lane in done_lanes:
                 call = pool.calls[lane]
-                call.result = lane_outputs(carry_np, lane)
+                call.result = lane_outputs(out_src, lane)
                 if t_acc is not None:
                     call.device_us = int(t_acc[lane])
                 if call.lane_span is not None:
@@ -664,10 +915,32 @@ class BatchScheduler:
                         rec["device_us"] = call.device_us
                     self.on_event("lane_recycled", rec)
 
+        # stage-occupancy telemetry from the rung/nc carry slots: which
+        # ladder rungs the live lanes sit at, their summed frontier, and
+        # frontier / gathered-slot occupancy (1.0 = every gathered slot
+        # held a live frontier row; full-table slices sit at frontier/V)
+        live_idx = [i for i in range(pool.b_pad)
+                    if pool.calls[i] is not None]
+        stages = self.stages_for(cls)
+        stage_pads = ([cls.v_pad if s is None else _pow2_ceil(s)
+                       for s, _ in stages] if stages else [cls.v_pad])
+        rung_min = rung_max = 0
+        frontier = slot_total = 0
+        if live_idx:
+            rungs = [int(rung[i]) for i in live_idx]
+            rung_min, rung_max = min(rungs), max(rungs)
+            frontier = int(sum(int(nc[i]) for i in live_idx))
+            slot_total = sum(stage_pads[min(r, len(stage_pads) - 1)]
+                             for r in rungs)
+
+        h2d, d2h = pool.h2d, pool.d2h
+        pool.h2d = pool.d2h = 0
         with self._lock:
             self.stats["batches"] += 1
             self.stats["slices"] += 1
             self.stats["max_live"] = max(self.stats["max_live"], live)
+            self.stats["h2d_bytes"] += h2d
+            self.stats["d2h_bytes"] += d2h
         slice_span.end({"done": len(done_lanes), "admitted": int(admitted)})
         if self.on_event is not None:
             rec = {
@@ -678,16 +951,24 @@ class BatchScheduler:
                 "slice_steps": int(slice_steps),
                 "compile_cache": "hit" if cache_hit else "miss",
                 "device_ms": round(device_s * 1e3, 3),
+                "stage_min": int(rung_min), "stage_max": int(rung_max),
+                "frontier": int(frontier),
+                "stage_occupancy": (round(frontier / slot_total, 4)
+                                    if slot_total else 0.0),
+                "h2d_bytes": int(h2d), "d2h_bytes": int(d2h),
             }
             if sstep_s is not None:
                 rec["sstep_ms"] = round(sstep_s * 1e3, 3)
                 rec["overhead_ms"] = round(overhead_s * 1e3, 3)
             self.on_event("serve_slice", rec)
         # recalibration samples: full slices only (no lane finished
-        # early), where every live lane ran exactly slice_steps bodies
+        # early), where every live lane ran exactly slice_steps bodies;
+        # tagged with the slice's minimum live rung so the pricing
+        # window tracks the post-ladder regime (_timing_sample)
         if (self.timing and cache_hit and not done_lanes and live > 0
                 and sstep_s is not None and sstep_s > 0):
-            self._timing_sample(cls, overhead_s, sstep_s / slice_steps)
+            self._timing_sample(cls, overhead_s, sstep_s / slice_steps,
+                                rung=rung_min)
         if pool.live == 0:
             self._pools.pop(cls, None)
 
@@ -784,6 +1065,7 @@ class BatchScheduler:
             waste = (round(1.0 - float(steps.mean()) / smax, 4)
                      if smax > 0 else 0.0)
             depths = {c.depth for c in calls}
+            stages = self.stages_for(cls)
             self.on_batch({
                 "shape_class": cls.name, "batch": b, "b_pad": int(b_pad),
                 "occupancy": round(b / b_pad, 4),
@@ -794,6 +1076,7 @@ class BatchScheduler:
                 "compile_cache": "hit" if cache_hit else "miss",
                 "device_ms": round(device_s * 1e3, 3),
                 "queue_ms_max": round(queue_ms_max, 3),
+                "stage_bodies": len(stages) if stages else 1,
             })
         for i, call in enumerate(calls):
             call.result = (p1[i], s1[i], st1[i], int(np.asarray(used)[i]),

@@ -122,8 +122,13 @@ class ServeFrontEnd:
     ``queue_depth`` bounds admitted-but-unstarted requests; ``workers``
     bounds in-flight requests (default ``batch_max`` so one full batch
     can always form). ``validate``/``post_reduce`` default on — the CLI
-    driver's semantics. ``auto_tune`` threads the shape-hash tuned-config
-    cache (``tune.cache``) through the fallback path's engine build.
+    driver's semantics. ``stages`` ("auto"/"off"/explicit ladder) and
+    ``device_carry`` configure the batched kernels' staged frontier
+    ladder and device-resident carry (``serve.batched`` module
+    docstring). ``auto_tune`` threads the shape-hash tuned-config
+    cache (``tune.cache``) through the fallback path's engine build;
+    the same cache's per-class ``serve-<class>.json`` artifacts
+    override derived stage ladders.
     ``fallback_factories(arrays) -> [(name, factory), ...]`` overrides
     the fallback ladder (tests inject failing rungs to exercise the
     health flip)."""
@@ -133,6 +138,7 @@ class ServeFrontEnd:
                  queue_depth: int = 64, workers: int | None = None,
                  mode: str = "continuous", slice_steps: int | None = None,
                  affinity: bool = True,
+                 stages="auto", device_carry: bool = False,
                  timing: bool = False, trace: bool = True,
                  validate: bool = True, post_reduce: bool = True,
                  auto_tune: bool = False, tuned_cache=None,
@@ -164,10 +170,16 @@ class ServeFrontEnd:
         # is on exactly when a logger is attached unless trace=False
         self.tracer = tracer_for(logger) if trace else NULL_TRACER
         self.rung_state = rung_state if rung_state is not None else RungState()
+        # the tuned cache serves BOTH paths: the fallback engine's
+        # per-shape schedules (auto_tune) and the batched kernels'
+        # per-class stage ladders (BatchScheduler.stages_for)
         self.scheduler = BatchScheduler(batch_max=batch_max,
                                         window_s=window_s,
                                         mode=mode, slice_steps=slice_steps,
                                         affinity=affinity, timing=timing,
+                                        stages=stages,
+                                        device_carry=device_carry,
+                                        tuned_cache=self._tuned_cache,
                                         on_batch=self._on_batch,
                                         on_event=self._on_sched_event,
                                         tracer=self.tracer)
@@ -230,6 +242,10 @@ class ServeFrontEnd:
                     slice_steps=self.scheduler.slice_steps,
                     affinity=self.scheduler.affinity,
                     timing=self.scheduler.timing,
+                    stages=(self.scheduler.stages
+                            if isinstance(self.scheduler.stages, str)
+                            else "custom"),
+                    device_carry=self.scheduler.device_carry,
                     tracing=self.tracer.enabled)
         return self
 
@@ -248,10 +264,14 @@ class ServeFrontEnd:
                 f"{sorted(by_name)}")
         t0 = time.perf_counter()
         kernels = 0
+        stage_bodies = 0
         for name in class_names:
-            kernels += self.scheduler.warm_class(by_name[name])
+            w = self.scheduler.warm_class(by_name[name])
+            kernels += w["kernels"]
+            stage_bodies += w["stage_bodies"]
         seconds = time.perf_counter() - t0
         doc = {"classes": len(class_names), "kernels": kernels,
+               "stage_bodies": stage_bodies,
                "seconds": round(seconds, 4)}
         self._event("serve_warmup", **doc)
         return doc

@@ -103,6 +103,33 @@ class ShapeLadder:
 DEFAULT_LADDER = ShapeLadder()
 
 
+def stage_schedule_for(cls: ShapeClass, spec="auto"):
+    """The staged-frontier-ladder schedule a shape class's batched
+    kernels compile (``serve.batched`` ``stages`` static arg), or None
+    for the plain full-table kernel.
+
+    ``spec``: ``"auto"`` derives the class ladder from the single-graph
+    engine's machinery (``engine.compact.class_stage_schedule`` — one
+    flat bucket of ``v_pad × w_pad``, so the serve ladder and the
+    engine ladder share ``default_stages`` and the validity rule);
+    ``"off"`` disables staging (the full-table A/B arm); an explicit
+    stages tuple is validated and applied to this class as-is (tuned
+    per-class ladders, tests). A derived ladder with no compaction
+    stage (small classes below the staging floor) normalizes to None so
+    the compiled kernel is byte-identical to the unstaged one."""
+    if spec == "off":
+        return None
+    from dgc_tpu.engine.compact import class_stage_schedule
+
+    explicit = None if spec == "auto" else tuple(
+        (None if s is None else int(s), int(t)) for s, t in spec)
+    stages = class_stage_schedule(cls.v_pad, cls.w_pad,
+                                  stages=explicit)["stages"]
+    if all(scale is None for scale, _ in stages):
+        return None
+    return stages
+
+
 @dataclass
 class ServeMember:
     """One request graph padded into its shape class.

@@ -13,6 +13,13 @@ config registry you can inspect or pre-seed).
 Used by the serve fallback path (``dgc_tpu.serve.engine``) when
 auto-tuning is enabled; ``get_or_tune`` is also the programmatic
 entry point for any driver that colors many same-shaped graphs.
+
+The cache directory doubles as a **per-class artifact registry** for
+the batched serving path: ``serve-<class>.json`` files (``class_key``)
+carry stage ladders for whole shape classes — the batched kernels are
+compiled per padded class, not per graph, so their tuned ladders key by
+class name (``class_config``; consulted by ``BatchScheduler
+.stages_for`` under the default ``--serve-stages auto``).
 """
 
 from __future__ import annotations
@@ -72,6 +79,38 @@ class TunedConfigCache:
         path = self._path(shape)
         if path is not None:
             cfg.save(str(path))
+
+    # -- per-class artifacts (the serve stage-ladder hook) ---------------
+    @staticmethod
+    def class_key(cls) -> str:
+        """Cache key of a serve shape class's per-class artifact: the
+        batched kernels are compiled per CLASS (padded shape), not per
+        graph, so their tuned stage ladders key by class name instead of
+        graph-shape hash — ``serve-v32768w64.json`` in a cache
+        directory is a pre-seedable class ladder."""
+        return f"serve-{cls.name}"
+
+    def class_config(self, cls) -> TunedConfig | None:
+        """Tuned config for a serve shape class (None = no artifact).
+        Consulted by ``serve.engine.BatchScheduler.stages_for`` when the
+        ladder policy is ``auto``: an artifact's ``stages`` knob
+        overrides the engine-derived class ladder (validated by the same
+        ``_check_stage_ladder`` rule at kernel build, so a malformed
+        artifact fails loudly, not silently mis-schedules)."""
+        shape = self.class_key(cls)
+        with self._lock:
+            cfg = self._mem.get(shape)
+        if cfg is not None:
+            self.stats["hits"] += 1
+            return cfg
+        path = self._path(shape)
+        if path is not None and path.exists():
+            cfg = load_tuned_config(str(path))
+            with self._lock:
+                self._mem[shape] = cfg
+            self.stats["disk_hits"] += 1
+            return cfg
+        return None
 
     def get_or_tune(self, arrays, tune=None) -> TunedConfig:
         """Config for this shape, tuning on first sight.

@@ -252,7 +252,7 @@ def test_layout_widened_carry_catches_stale_sites_on_real_tree():
     real pack/unpack sites: every one of them must light up — the
     hand-maintained-lockstep failure the pass exists to catch."""
     real = (ROOT / "dgc_tpu" / "layout.py").read_text()
-    widened = re.sub(r"^CARRY_LEN = 15$", "CARRY_LEN = 16", real,
+    widened = re.sub(r"^CARRY_LEN = 19$", "CARRY_LEN = 20", real,
                      flags=re.M)
     assert widened != real
     layout = SourceModule("dgc_tpu/layout.py", widened)
@@ -262,19 +262,86 @@ def test_layout_widened_carry_catches_stale_sites_on_real_tree():
         mods[rel] = SourceModule.load(ROOT, rel)
     got = check_layout(layout, mods, specs=DEFAULT_SPECS)
     arity = [f for f in got if f.rule == "LY001"]
-    # _fresh_lane + idle_carry + _superstep_body pack/unpack all stale
+    # _fresh_lanes + idle_carry + _superstep_body pack/unpack all stale
     assert len(arity) >= 4
     assert {f.file for f in arity} == {"dgc_tpu/serve/batched.py"}
 
 
 def test_layout_stale_index_constant_on_real_tree():
     real = (ROOT / "dgc_tpu" / "layout.py").read_text()
-    stale = re.sub(r"^T_US = 13\b", "T_US = 15", real, flags=re.M)
+    stale = re.sub(r"^T_US = 13\b", "T_US = 19", real, flags=re.M)
     assert stale != real
     layout = SourceModule("dgc_tpu/layout.py", stale)
     got = check_layout(layout, {"dgc_tpu/layout.py": layout},
                        specs=DEFAULT_SPECS)
     assert any(f.rule == "LY002" and "T_US" in f.detail for f in got)
+
+
+def test_layout_widened_sharded_carry_catches_pack_sites_on_real_tree():
+    """Widen SH_CARRY_LEN / SB_CARRY_LEN on the REAL layout module
+    without touching the sharded pipelines: their concatenated-tuple
+    pack chains (head literal + prefix-resume ring + trajectory slot)
+    must light up — the new concat-pack rule proves the sharded carries
+    the same lockstep property the serve carry has had since PR 8."""
+    from dgc_tpu.analysis.run import LAYOUT_FILES
+
+    real = (ROOT / "dgc_tpu" / "layout.py").read_text()
+    for const, module, fn in (
+            ("SH_CARRY_LEN = 11", "dgc_tpu/engine/sharded.py",
+             "_flat_pipeline"),
+            ("SB_CARRY_LEN = 12", "dgc_tpu/engine/sharded_bucketed.py",
+             "_shard_pipeline")):
+        name, _, val = const.partition(" = ")
+        widened = re.sub(rf"^{const}$", f"{name} = {int(val) + 1}", real,
+                         flags=re.M)
+        assert widened != real
+        layout = SourceModule("dgc_tpu/layout.py", widened)
+        mods = {"dgc_tpu/layout.py": layout}
+        for rel in LAYOUT_FILES:
+            if rel != "dgc_tpu/layout.py":
+                mods[rel] = SourceModule.load(ROOT, rel)
+        got = check_layout(layout, mods, specs=DEFAULT_SPECS)
+        arity = [f for f in got if f.rule == "LY001" and f.file == module]
+        # both pack sites: the init carry assign + the body's return
+        assert len(arity) >= 2, (const, got)
+        assert all(fn in f.detail for f in arity)
+
+
+def test_layout_stale_sharded_index_on_real_tree():
+    """A stale sharded slot id (SB_TRAJ pushed past SB_CARRY_LEN) is an
+    LY002 on the real tree."""
+    real = (ROOT / "dgc_tpu" / "layout.py").read_text()
+    stale = re.sub(r"^SB_TRAJ = 11\b", "SB_TRAJ = 12", real, flags=re.M)
+    assert stale != real
+    layout = SourceModule("dgc_tpu/layout.py", stale)
+    got = check_layout(layout, {"dgc_tpu/layout.py": layout},
+                       specs=DEFAULT_SPECS)
+    assert any(f.rule == "LY002" and "SB_TRAJ" in f.detail for f in got)
+
+
+def test_layout_concat_pack_rule_fixture():
+    """The concat-pack arity rule on synthetic sources: resolvable
+    chains with wrong arity flag; unresolvable chains are skipped (never
+    guessed)."""
+    layout = SourceModule("fix/layout.py", "LEN = 4\n")
+    spec = BufferSpec(name="cc", length_const="LEN", module="fix/m.py",
+                      concat_packs=(("pipe", (("rec", 2),)),))
+    bad = SourceModule("fix/m.py", (
+        "def pipe(rec, mystery):\n"
+        "    carry = (1, 2) + rec\n"            # 4 — ok
+        "    out = (1,) + rec\n"                # 3 — flagged
+        "    other = (1,) + mystery\n"          # unresolvable — skipped
+        "    return (1, 2) + rec + (3,)\n"))    # 5 — flagged
+    got = check_layout(layout, {m.rel: m for m in (layout, bad)},
+                       specs=(spec,), span_invariants={})
+    assert len([f for f in got if f.rule == "LY001"]) == 2
+    good = SourceModule("fix/m.py", (
+        "def pipe(rec):\n"
+        "    carry = (1, 2) + rec\n"
+        "    return (0,) + tuple(rec) + (9,)\n"))
+    got = check_layout(layout, {m.rel: m for m in (layout, good)},
+                       specs=(spec,), span_invariants={})
+    assert got == []
 
 
 def test_layout_real_tree_is_clean():

@@ -385,6 +385,336 @@ def test_three_slice_recycled_batch_end_to_end():
     assert stats["recycles"] == stats["sweeps"] >= 3
 
 
+# -- staged frontier ladder + device-resident carry (PR 9) --------------
+
+# a 3-rung ladder valid for the v2048 test class — small graphs cross
+# every stage transition in a handful of supersteps
+_TEST_STAGES = ((None, 512), (512, 128), (128, 0))
+
+
+def _class_batch(cls, n_real=3, seed0=0):
+    graphs = [generate_random_graph_fast(700, avg_degree=8, seed=seed0 + s)
+              for s in range(n_real)]
+    members = [pad_member(g, cls) for g in graphs] + [dummy_member(cls)]
+    comb = np.stack([m.comb for m in members])
+    degrees = np.stack([m.degrees for m in members])
+    k0 = np.array([m.k0 for m in members], np.int32)
+    max_steps = np.array([m.max_steps for m in members], np.int32)
+    return comb, degrees, k0, max_steps
+
+
+def test_stage_schedule_resolution():
+    """Class ladders come from the single-graph engine's schedule
+    machinery: small classes are ladder-free, big classes get
+    default_stages' rungs, 'off' and explicit ladders override."""
+    from dgc_tpu.engine.compact import serve_stage_rungs
+    from dgc_tpu.serve.engine import BatchScheduler
+    from dgc_tpu.serve.shape_classes import stage_schedule_for
+
+    assert stage_schedule_for(ShapeClass(2048, 8)) is None
+    assert stage_schedule_for(ShapeClass(8192, 32)) is None
+    big = ShapeClass(32768, 64)
+    assert stage_schedule_for(big) == serve_stage_rungs(32768)
+    assert stage_schedule_for(big)[0] == (None, 16384)   # v/2 top rung
+    assert stage_schedule_for(big, "off") is None
+    assert stage_schedule_for(ShapeClass(2048, 8),
+                              _TEST_STAGES) == _TEST_STAGES
+
+    sched = BatchScheduler(stages="off")
+    assert sched.stages_for(big) is None
+    sched2 = BatchScheduler(stages=_TEST_STAGES)
+    assert sched2.stages_for(ShapeClass(2048, 8)) == _TEST_STAGES
+    sched3 = BatchScheduler()   # auto
+    assert sched3.stages_for(ShapeClass(2048, 8)) is None
+    assert sched3.stages_for(big) == serve_stage_rungs(32768)
+    with pytest.raises(ValueError):
+        BatchScheduler(stages="bogus")
+    # malformed explicit ladders fail loudly at kernel build (the
+    # engine's _check_stage_ladder rule, shared)
+    with pytest.raises(ValueError):
+        stage_schedule_for(ShapeClass(2048, 8), ((None, 512), (64, 0)))
+
+
+def test_staged_sweep_kernel_bit_identical_to_full_table():
+    """The staged ladder changes only which rows are gathered: the
+    staged batch kernel's outputs equal the full-table kernel's byte for
+    byte (colors, steps, statuses, used)."""
+    from dgc_tpu.serve.batched import batched_sweep_kernel
+
+    cls = ShapeClass(2048, 32)
+    comb, degrees, k0, max_steps = _class_batch(cls)
+    want = [np.asarray(o) for o in batched_sweep_kernel(
+        comb, degrees, k0, max_steps, planes=cls.planes)]
+    got = [np.asarray(o) for o in batched_sweep_kernel(
+        comb, degrees, k0, max_steps, planes=cls.planes,
+        stages=_TEST_STAGES)]
+    for g_arr, w_arr in zip(got, want):
+        assert np.array_equal(g_arr, w_arr)
+
+
+def test_staged_slice_kernel_stage_boundaries_at_s1():
+    """slice_steps=1 makes EVERY superstep a slice re-entry — including
+    the supersteps landing exactly on every compaction-stage transition
+    and the attempt boundary's rung reset — and the re-entered staged
+    kernel still equals the unstaged unsliced kernel byte for byte. The
+    rung/nc carry slots actually walk the ladder."""
+    from dgc_tpu.layout import CARRY_NC, CARRY_PHASE, CARRY_RUNG, OUT0
+    from dgc_tpu.serve.batched import (batched_slice_kernel,
+                                       batched_sweep_kernel, idle_carry,
+                                       stage_idx_width)
+
+    cls = ShapeClass(2048, 32)
+    comb, degrees, k0, max_steps = _class_batch(cls)
+    want = [np.asarray(o) for o in batched_sweep_kernel(
+        comb, degrees, k0, max_steps, planes=cls.planes)]
+    carry = idle_carry(4, cls.v_pad, stage_idx_width(_TEST_STAGES))
+    reset = np.ones(4, np.int32)
+    rungs_seen = set()
+    for _ in range(2000):
+        carry = batched_slice_kernel(comb, degrees, k0, max_steps,
+                                     reset, carry, planes=cls.planes,
+                                     slice_steps=1, stages=_TEST_STAGES)
+        reset = np.zeros(4, np.int32)
+        rungs_seen.update(np.asarray(carry[CARRY_RUNG]).tolist())
+        nc = np.asarray(carry[CARRY_NC])
+        assert (nc >= 0).all() and (nc <= cls.v_pad).all()
+        if (np.asarray(carry[CARRY_PHASE]) >= 2).all():
+            break
+    else:
+        raise AssertionError("staged S=1 slice loop did not converge")
+    assert {0, 1, 2} <= rungs_seen    # the ladder was actually walked
+    got = [np.asarray(a) for a in carry[OUT0:]]
+    for g_arr, w_arr in zip(got, want):
+        assert np.array_equal(g_arr, w_arr)
+
+
+def test_reset_lane_reinit_mid_ladder():
+    """A lane reset while it sits mid-ladder (rung > 0) re-initializes
+    to rung 0 and sweeps its NEW graph bit-identically — and the
+    co-resident lanes (dragged back to full-table by the shared
+    executed rung) still finish byte-identical to their solo sweeps."""
+    from dgc_tpu.layout import CARRY_PHASE, CARRY_RUNG, OUT0, N_OUT
+    from dgc_tpu.serve.batched import (batched_slice_kernel,
+                                       batched_sweep_kernel, idle_carry,
+                                       stage_idx_width)
+
+    cls = ShapeClass(2048, 32)
+    comb, degrees, k0, max_steps = _class_batch(cls)
+    new_graph = generate_random_graph_fast(900, avg_degree=9, seed=77)
+    new_m = pad_member(new_graph, cls)
+    want = [np.asarray(o) for o in batched_sweep_kernel(
+        comb, degrees, k0, max_steps, planes=cls.planes)]
+    want_new = [np.asarray(o) for o in batched_sweep_kernel(
+        new_m.comb[None], new_m.degrees[None],
+        np.array([new_m.k0], np.int32),
+        np.array([new_m.max_steps], np.int32), planes=cls.planes)]
+
+    carry = idle_carry(4, cls.v_pad, stage_idx_width(_TEST_STAGES))
+    reset = np.ones(4, np.int32)
+    for _ in range(2000):
+        carry = batched_slice_kernel(comb, degrees, k0, max_steps,
+                                     reset, carry, planes=cls.planes,
+                                     slice_steps=1, stages=_TEST_STAGES)
+        reset = np.zeros(4, np.int32)
+        if int(np.asarray(carry[CARRY_RUNG])[0]) > 0:
+            break
+    else:
+        raise AssertionError("lane 0 never climbed the ladder")
+    # swap lane 0's inputs for the new graph mid-ladder
+    comb[0] = new_m.comb
+    degrees[0] = new_m.degrees
+    k0[0] = new_m.k0
+    max_steps[0] = new_m.max_steps
+    reset = np.array([1, 0, 0, 0], np.int32)
+    for _ in range(2000):
+        carry = batched_slice_kernel(comb, degrees, k0, max_steps,
+                                     reset, carry, planes=cls.planes,
+                                     slice_steps=1, stages=_TEST_STAGES)
+        reset = np.zeros(4, np.int32)
+        if (np.asarray(carry[CARRY_PHASE]) >= 2).all():
+            break
+    else:
+        raise AssertionError("post-swap slice loop did not converge")
+    got = [np.asarray(a) for a in carry[OUT0:OUT0 + N_OUT]]
+    for j in range(N_OUT):
+        assert np.array_equal(got[j][0], want_new[j][0])   # the new graph
+        for lane in (1, 2, 3):                             # co-residents
+            assert np.array_equal(got[j][lane], want[j][lane])
+
+
+def test_staged_timing_variant_byte_identical():
+    """Staged kernels with the in-kernel clock compiled in return result
+    slots byte-identical to the untimed staged kernels (telemetry on/off
+    byte-equality at the stage boundaries)."""
+    from dgc_tpu.layout import CARRY_PHASE, OUT0, N_OUT, T_US
+    from dgc_tpu.serve.batched import (batched_slice_kernel, idle_carry,
+                                       stage_idx_width)
+
+    cls = ShapeClass(2048, 32)
+    comb, degrees, k0, max_steps = _class_batch(cls)
+    outs = []
+    for timing in (False, True):
+        carry = idle_carry(4, cls.v_pad, stage_idx_width(_TEST_STAGES))
+        reset = np.ones(4, np.int32)
+        for _ in range(2000):
+            carry = batched_slice_kernel(comb, degrees, k0, max_steps,
+                                         reset, carry, planes=cls.planes,
+                                         slice_steps=2, timing=timing,
+                                         stages=_TEST_STAGES)
+            reset = np.zeros(4, np.int32)
+            if (np.asarray(carry[CARRY_PHASE]) >= 2).all():
+                break
+        else:
+            raise AssertionError("timed staged loop did not converge")
+        outs.append([np.asarray(a) for a in carry[OUT0:OUT0 + N_OUT]])
+        if timing:
+            t_us = np.asarray(carry[T_US])
+            assert (t_us[:3] > 0).all() and (t_us >= 0).all()
+    for a, b in zip(*outs):
+        assert np.array_equal(a, b)
+
+
+def test_device_carry_end_to_end_parity():
+    """--device-carry end to end: donated slice kernels, on-device lane
+    seating, and per-lane result extraction — colors / minimal-k /
+    attempt sequences stay byte-identical to the single-graph sweep,
+    and the measured device→host bytes undercut the host-mirror path."""
+    graphs = [generate_random_graph_fast(500 + 150 * i, avg_degree=6,
+                                         seed=90 + i) for i in range(6)]
+
+    def run(device_carry):
+        fe = ServeFrontEnd(batch_max=3, window_s=0.05, queue_depth=16,
+                           slice_steps=2, stages=_TEST_STAGES,
+                           device_carry=device_carry).start()
+        try:
+            tickets = [fe.submit(g) for g in graphs]
+            return ([t.result(timeout=300) for t in tickets],
+                    dict(fe.scheduler.stats))
+        finally:
+            fe.shutdown()
+
+    dev_results, dev_stats = run(True)
+    host_results, host_stats = run(False)
+    assert dev_stats["recycles"] >= 6
+    for g, r_d, r_h in zip(graphs, dev_results, host_results):
+        want, want_attempts = _single_graph_reference(g)
+        for r in (r_d, r_h):
+            assert r.ok
+            assert r.minimal_colors == want.minimal_colors
+            assert np.array_equal(r.colors, want.colors)
+            assert [tuple(a) for a in r.attempts] == want_attempts
+    # transfer accounting: both directions counted, device mode strictly
+    # cheaper on the downlink (no full-carry materialization per done)
+    assert dev_stats["h2d_bytes"] > 0 and dev_stats["d2h_bytes"] > 0
+    assert dev_stats["d2h_bytes"] < host_stats["d2h_bytes"]
+
+
+def test_serve_slice_stage_fields_validate(tmp_path):
+    """serve_slice events carry the stage-occupancy + transfer fields
+    and the whole log stays schema-clean; serve_summary totals the
+    transfer bytes."""
+    from dgc_tpu.obs import RunLogger
+    from dgc_tpu.obs.schema import validate_record
+
+    records = []
+    logger = RunLogger(echo=False)
+    logger.add_sink(records.append)
+    fe = ServeFrontEnd(batch_max=2, window_s=0.02, queue_depth=8,
+                       slice_steps=1, stages=_TEST_STAGES,
+                       logger=logger).start()
+    try:
+        tickets = [fe.submit(generate_random_graph_fast(
+            600, avg_degree=6, seed=s)) for s in range(3)]
+        for t in tickets:
+            assert t.result(timeout=300).ok
+    finally:
+        fe.shutdown()
+    for rec in records:
+        assert validate_record(rec) == [], rec
+    slices = [r for r in records if r.get("event") == "serve_slice"]
+    assert slices
+    assert all("stage_min" in s and "stage_max" in s and "frontier" in s
+               and "stage_occupancy" in s for s in slices)
+    assert any(s["stage_max"] > 0 for s in slices)   # ladder engaged
+    assert all(s["h2d_bytes"] >= 0 and s["d2h_bytes"] >= 0
+               for s in slices)
+    assert sum(s["h2d_bytes"] for s in slices) > 0
+    starts = [r for r in records if r.get("event") == "serve_start"]
+    assert starts and starts[0]["stages"] == "custom"
+    assert starts[0]["device_carry"] is False
+
+
+def test_class_ladder_from_tuned_cache(tmp_path):
+    """A per-class tuned artifact (serve-<class>.json in the cache
+    directory) overrides the derived class ladder under stages='auto' —
+    the serve-side tuned-ladder hook."""
+    from dgc_tpu.serve.engine import BatchScheduler
+    from dgc_tpu.tune import TunedConfig
+    from dgc_tpu.tune.cache import TunedConfigCache
+
+    cls = ShapeClass(2048, 8)
+    cache = TunedConfigCache(cache_dir=str(tmp_path))
+    assert cache.class_config(cls) is None
+    TunedConfig(graph_shape_hash=TunedConfigCache.class_key(cls),
+                stages=_TEST_STAGES).save(
+        str(tmp_path / f"{TunedConfigCache.class_key(cls)}.json"))
+    cfg = cache.class_config(cls)
+    assert cfg is not None and cfg.stages == _TEST_STAGES
+    sched = BatchScheduler(tuned_cache=cache)   # stages="auto"
+    assert sched.stages_for(cls) == _TEST_STAGES
+    # derived default without the artifact: this class is ladder-free
+    assert BatchScheduler().stages_for(cls) is None
+    # the override is also parity-safe end to end
+    fe = ServeFrontEnd(batch_max=2, window_s=0.02, queue_depth=8,
+                       slice_steps=2, tuned_cache=cache).start()
+    try:
+        g = generate_random_graph_fast(600, avg_degree=6, seed=5)
+        res = fe.submit(g).result(timeout=300)
+    finally:
+        fe.shutdown()
+    want, _ = _single_graph_reference(g)
+    assert res.ok and res.minimal_colors == want.minimal_colors
+    assert np.array_equal(res.colors, want.colors)
+
+
+def test_recalibration_uses_post_ladder_median():
+    """The slice-size recalibration prices the post-ladder regime: the
+    sample window restarts when a deeper rung appears (the expensive
+    full-table opening slices never skew the median), shallower late
+    samples are skipped, and the priced size comes from the median of
+    the deepest-rung window."""
+    import statistics
+
+    from dgc_tpu.serve.batched import priced_slice_steps
+    from dgc_tpu.serve.engine import BatchScheduler
+
+    cls = ShapeClass(2048, 32)
+    events = []
+    sched = BatchScheduler(timing=True, slice_steps=None,
+                           recal_min_slices=3,
+                           on_event=lambda k, r: events.append((k, r)))
+    # expensive full-table samples at rung 0 …
+    for _ in range(2):
+        sched._timing_sample(cls, overhead_s=0.004, iter_s=0.030, rung=0)
+    # … then the ladder engages: cheap post-ladder samples at rung 2
+    post = [0.0011, 0.0009, 0.0010]
+    for it in post:
+        sched._timing_sample(cls, overhead_s=0.004, iter_s=it, rung=2)
+    s1 = sched.resolved_slice_steps(cls, 1)
+    assert s1 == priced_slice_steps(0.004, statistics.median(post))
+    # a rung-0 sample BEFORE the recal fired would have been skipped,
+    # and the window was exactly the rung-2 samples
+    [(kind, rec)] = [e for e in events if e[0] == "slice_recalibrated"]
+    assert rec["samples"] == 3 and rec["rung"] == 2
+    # the pre-ladder mean would have priced a much larger slice: the
+    # median of the post-ladder window is what froze
+    assert s1 != priced_slice_steps(0.004, 0.030)
+    # frozen: more samples never re-price
+    for _ in range(5):
+        sched._timing_sample(cls, overhead_s=0.1, iter_s=0.1, rung=2)
+    assert sched.resolved_slice_steps(cls, 1) == s1
+
+
 def test_depth_bucket_and_affinity_order():
     from dgc_tpu.serve.engine import (_SweepCall, BatchScheduler,
                                       depth_bucket)
@@ -430,7 +760,7 @@ def test_warm_classes_precompiles_pad_ladder(tmp_path):
         with pytest.raises(ValueError):
             fe.warm(["nope"])
         doc = fe.warm(["v2048w8"])
-        assert doc == {"classes": 1, "kernels": 3,
+        assert doc == {"classes": 1, "kernels": 3, "stage_bodies": 1,
                        "seconds": doc["seconds"]}   # pads 4, 2, 1
         assert doc["seconds"] > 0
         misses_after_warm = fe.scheduler.stats["compile_misses"]
@@ -766,7 +1096,8 @@ def test_serve_cli_end_to_end(tmp_path):
                   "--output-colorings", str(out_dir),
                   "--log-json", str(log),
                   "--run-manifest", str(manifest),
-                  "--batch-max", "2", "--window-ms", "20"])
+                  "--batch-max", "2", "--window-ms", "20",
+                  "--device-carry"])
     assert r.returncode == 0, r.stderr
     lines = [json.loads(x) for x in results.read_text().splitlines()]
     assert len(lines) == 3 and all(x["status"] == "ok" for x in lines)
@@ -811,15 +1142,18 @@ def test_serve_cli_warm_classes_and_modes(tmp_path):
     r2 = _run_cli(["serve", "--requests", str(reqs),
                    "--warm-classes", "nope"])
     assert r2.returncode == 2 and "unknown shape class" in r2.stderr
-    # sync mode end-to-end (the A/B baseline stays drivable)
+    # sync mode end-to-end (the A/B baseline stays drivable), with the
+    # staged ladder disabled (--serve-stages off: the full-table arm)
     r3 = _run_cli(["serve", "--requests", str(reqs),
                    "--results", str(tmp_path / "r3.jsonl"),
                    "--run-manifest", str(tmp_path / "m3.json"),
-                   "--serve-mode", "sync", "--batch-max", "2"])
+                   "--serve-mode", "sync", "--batch-max", "2",
+                   "--serve-stages", "off"])
     assert r3.returncode == 0, r3.stderr
     doc3 = json.loads((tmp_path / "m3.json").read_text())
     assert doc3["serve"]["summary"]["mode"] == "sync"
     assert doc3["serve"]["batches"]
+    assert doc3["serve"]["config"]["stages"] == "off"
 
 
 def test_serve_cli_metrics_port_and_kernel_timing(tmp_path):

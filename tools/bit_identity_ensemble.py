@@ -41,7 +41,12 @@ mid-batch — the bit-identity contract is proven ACROSS recycling
 boundaries, not just within one dispatch (the summary line records the
 lane-recycle count as evidence recycling actually exercised).
 ``--serve-mode sync`` re-runs the same ensemble through the
-batch-complete dispatch (the PR 5 baseline).
+batch-complete dispatch (the PR 5 baseline). The staged frontier
+ladder runs at its shipped default (``stages="auto"``), and the draw
+sizes reach the v32768 class where the ladder actually engages — so the
+committed ensemble also locks bit-identity across compaction-stage
+boundaries; ``--serve-device-carry`` re-runs it with the donated
+device-resident carry.
 
 One JSON line per draw, nonzero exit on any mismatch.
 """
@@ -67,9 +72,14 @@ def serve_mode(args) -> int:
     from dgc_tpu.serve.queue import ServeFrontEnd
     from dgc_tpu.serve.shape_classes import DEFAULT_LADDER
 
-    # mixed real sizes landing in two shape classes (v2048 and v8192),
-    # alternating uniform/RMAT — batches mix sizes within a class
-    sizes = (1500, 2000, 5000, 8000)
+    # mixed real sizes landing in three shape classes (v2048, v8192, and
+    # the STAGED v32768 class — the frontier ladder engages there, so
+    # this ensemble proves bit-identity across compaction-stage
+    # boundaries too), alternating uniform/RMAT — batches mix sizes
+    # within a class (20k RMAT draws exceed the width ladder and take
+    # the single-graph fallback: the parity contract must hold on both
+    # paths)
+    sizes = (1500, 2000, 5000, 8000, 20000, 24000)
     draws = []
     for i in range(args.draws):
         seed = args.seed0 + i
@@ -99,6 +109,7 @@ def serve_mode(args) -> int:
                            slice_steps=(args.serve_slice_steps
                                         if args.serve_mode == "continuous"
                                         else None),
+                           device_carry=args.serve_device_carry,
                            timing=telemetry, trace=telemetry,
                            logger=logger, registry=registry).start()
         try:
@@ -163,6 +174,8 @@ def serve_mode(args) -> int:
                                 else None),
                    recycles=stats_obs.get("recycles", 0),
                    slices=stats_obs.get("slices", 0),
+                   stages="auto",
+                   device_carry=bool(args.serve_device_carry),
                    telemetry="events+metrics+trace+kernel_timing")
     print(json.dumps(summary))
     if out:
@@ -192,6 +205,11 @@ def main() -> int:
                    help="continuous-mode slice size for --serve; the "
                         "small default forces many recycling boundaries "
                         "per sweep (default 2)")
+    p.add_argument("--serve-device-carry", action="store_true",
+                   help="run the --serve ensemble with the "
+                        "device-resident carry (donated slice kernels + "
+                        "on-device lane seating) — bit-identity must "
+                        "hold there too")
     args = p.parse_args()
     if args.serve:
         return serve_mode(args)

@@ -91,6 +91,21 @@ if [ "$battery_rc" -ne 2 ]; then
     --serve-modes continuous,sync 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
+  # staged-ladder + device-carry serve A/B (PR 9): the same 64-graph
+  # stream through (a) the staged frontier ladder vs the full-table
+  # kernels (+nostage) and (b) host-mirror vs device-resident carry
+  # (+devcarry). CPU rows in PERF.md "Staged serve sweeps"; the TPU
+  # questions are how much the ladder's frontier-proportional supersteps
+  # recover of the ~65 ms/dispatch-amortized batch throughput, and the
+  # measured per-slice transfer bytes with the donated carry (the
+  # `transfers` slot of the JSON line) where PCIe, not a shared memory
+  # bus, prices every host round-trip.
+  echo "=== serve staged/devcarry A/B (20k class, batch 1/8/32) ===" | tee -a /dev/stderr >/dev/null
+  timeout 7200 python bench.py --serve-throughput \
+    --serve-graphs 64 --serve-batch-sizes 1,8,32 \
+    --serve-modes continuous,continuous+nostage,continuous+devcarry 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+
   # in-kernel timing column cross-check (PR 7, obs.devclock): the same
   # 200k-RMAT attempt run twice — once with --superstep-timing recording
   # the trajectory buffer's col-5 device wall-time, once through the

@@ -143,6 +143,26 @@ def render(doc: dict, width: int = 48) -> str:
                 f"{sum(1 for s in slices if s.get('compile_cache') == 'miss')}"
                 f" compile miss(es))")
             add(f"  occupancy/slice: {sparkline(occ, width)}")
+            # staged frontier ladder (CARRY_RUNG/CARRY_NC telemetry):
+            # the rung the pool executed at over time, and how full the
+            # compacted gather slots ran
+            rungs = [s["stage_max"] for s in slices
+                     if s.get("stage_max") is not None]
+            if rungs and max(rungs) > 0:
+                so = [s["stage_occupancy"] for s in slices
+                      if s.get("stage_occupancy") is not None]
+                fr = [s["frontier"] for s in slices
+                      if s.get("frontier") is not None]
+                add(f"  stages: deepest rung {max(rungs)} "
+                    f"(mean stage occupancy {sum(so) / len(so):.2f}, "
+                    f"peak frontier {max(fr)})")
+                add(f"  rung/slice: {sparkline(rungs, width)}")
+            h2d = sum(s.get("h2d_bytes", 0) for s in slices)
+            d2h = sum(s.get("d2h_bytes", 0) for s in slices)
+            if h2d or d2h:
+                add(f"  transfers: {h2d / 1e6:.1f} MB host→device, "
+                    f"{d2h / 1e6:.1f} MB device→host "
+                    f"({(h2d + d2h) / len(slices) / 1e3:.1f} KB/slice)")
             ss = [s["sstep_ms"] for s in slices
                   if s.get("sstep_ms") is not None]
             ov = [s["overhead_ms"] for s in slices

@@ -5,8 +5,10 @@
 growing ``--log-json`` stream (a sweep mid-flight, a serve loop under
 load) and re-renders the same report incrementally: new lines are fed
 through the identical ``RunManifest`` sink, so the live view and the
-post-hoc report can never disagree. The ROADMAP telemetry follow-on
-("live tailing").
+post-hoc report can never disagree (continuous-mode serve runs get the
+lane-occupancy, staged-ladder rung/stage-occupancy, and host↔device
+transfer series live). The ROADMAP telemetry follow-on ("live
+tailing").
 
     python tools/tail_run.py RUN.jsonl              # follow until done
     python tools/tail_run.py RUN.jsonl --once       # render now, exit
