@@ -176,7 +176,10 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
                 tickets = [fe.submit(g) for g in graphs]
                 results = [t.result(timeout=600) for t in tickets]
                 elapsed = time.perf_counter() - t0
-                sched_stats = dict(fe.scheduler.stats)
+                # locked copy (dgc-lint LK004): a bare dict(stats) here
+                # raced the dispatcher's post-delivery bookkeeping —
+                # ticket.result() returns before the slice's stats land
+                sched_stats = fe.scheduler.stats_snapshot()
             finally:
                 fe.shutdown()
             phases[f"serve_{key}_s"] = elapsed

@@ -1,8 +1,7 @@
 """dgc-lint: repo-specific static analysis (``tools/dgc_lint.py``).
 
-Four AST-based passes prove the structural invariants the runtime
-harnesses (parity ensembles, ``validate_runlog``, hammer tests) only
-*sample*:
+Five passes prove the structural invariants the runtime harnesses
+(parity ensembles, ``validate_runlog``, hammer tests) only *sample*:
 
 - ``staging`` — no host effects inside traced kernel code (rules KS*);
 - ``layout_check`` — every pack/unpack/index site agrees with
@@ -10,16 +9,27 @@ harnesses (parity ensembles, ``validate_runlog``, hammer tests) only
 - ``schema_check`` — emit sites ↔ ``obs.schema`` in both directions
   (rules SC*);
 - ``locks`` — ``# guarded-by:`` lock discipline over the threaded tier
-  (rules LK*).
+  (rules LK*), including the cross-object points-to pass
+  (``pointsto``, LK004) and the ``DGC_TPU_LOCK_ASSERTS=1`` runtime
+  hook (``lockassert``);
+- ``transfer_check`` — donation/transfer discipline over the serve
+  tier's device buffers (rules TR*): post-donation reads, CSE-aliasable
+  donated slots, device-carry host-materialization whitelist, stale
+  donated caches, and the ``DGC_TPU_DONATE_CARRY`` gate contract.
 
-``run.run_passes`` binds the passes to the repo's file sets; the CLI
-(``tools/dgc_lint.py``) adds the committed-baseline workflow and the
-``--strict`` gate tier-1 runs.
+``run.run_report`` binds the passes to the repo's file sets; the CLI
+(``tools/dgc_lint.py``) adds the committed-baseline workflow, the
+``--strict`` gate tier-1 runs, dead-waiver warnings, and the ``--fix``
+autofixer (``fixer``: guarded-by insertion from with-scope evidence,
+bare-carry-index → named-slot rewrites; ``--fix --check`` is the CI
+mode).
 """
 
 from dgc_tpu.analysis.common import (Finding, SourceModule, load_baseline,
                                      split_baseline, write_baseline)
-from dgc_tpu.analysis.run import PASSES, run_passes
+from dgc_tpu.analysis.run import (LOCK_FILES, LAYOUT_FILES, PASSES,
+                                  LintReport, run_passes, run_report)
 
 __all__ = ["Finding", "SourceModule", "PASSES", "run_passes",
+           "run_report", "LintReport", "LOCK_FILES", "LAYOUT_FILES",
            "load_baseline", "split_baseline", "write_baseline"]

@@ -62,12 +62,24 @@ class SourceModule:
         self.lines = text.splitlines()
         self.tree = ast.parse(text, filename=rel)
         self.comments: dict[int, str] = {}
+        # waiver accounting: every `# dgc-lint: ok RULE` comment by line,
+        # and the (line, rule) pairs that actually suppressed a finding —
+        # the CLI warns about waivers that matched nothing (dead waivers
+        # rot exactly like stale baseline entries)
+        self.waivers: dict[int, set[str]] = {}
+        self.waivers_used: set[tuple[int, str]] = set()
         try:
             for tok in tokenize.generate_tokens(io.StringIO(text).readline):
                 if tok.type == tokenize.COMMENT:
                     self.comments[tok.start[0]] = tok.string
         except tokenize.TokenError:  # torn file: AST parsed, comments best-effort
             pass
+        for line, comment in self.comments.items():
+            m = _WAIVE_RE.search(comment)
+            if m is not None:
+                self.waivers[line] = {r.strip()
+                                      for r in m.group(1).split(",")
+                                      if r.strip()}
 
     @classmethod
     def load(cls, root: Path, rel: str) -> "SourceModule":
@@ -87,10 +99,20 @@ class SourceModule:
         return ""
 
     def waived(self, line: int, rule: str) -> bool:
-        m = _WAIVE_RE.search(self.comments.get(line, ""))
-        if m is None:
-            return False
-        return rule in {r.strip() for r in m.group(1).split(",")}
+        if rule in self.waivers.get(line, ()):
+            self.waivers_used.add((line, rule))
+            return True
+        return False
+
+    def unused_waivers(self) -> list[tuple[int, str]]:
+        """(line, rule) waivers that suppressed nothing in the passes
+        run so far over THIS module instance."""
+        out = []
+        for line, rules in self.waivers.items():
+            for rule in sorted(rules):
+                if (line, rule) not in self.waivers_used:
+                    out.append((line, rule))
+        return sorted(out)
 
     def marker(self, line: int, name: str) -> bool:
         """True when ``# dgc-lint: NAME`` annotates ``line`` (same line
@@ -126,6 +148,113 @@ def module_constants(mod: SourceModule) -> dict[str, int]:
             for t in targets:
                 out[t.id] = v
     return out
+
+
+def module_tuple_constants(mod: SourceModule) -> dict[str, tuple]:
+    """Top-level ``NAME = (<int literals>)`` assignments (the layout
+    module's whitelist tuples, e.g. the device-carry d2h slot set)."""
+    out: dict[str, tuple] = {}
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            targets = [t for t in node.targets if isinstance(t, ast.Name)]
+            value = node.value
+        elif isinstance(node, ast.AnnAssign) and node.value is not None \
+                and isinstance(node.target, ast.Name):
+            targets, value = [node.target], node.value
+        else:
+            continue
+        try:
+            v = ast.literal_eval(value)
+        except (ValueError, TypeError, SyntaxError):
+            continue
+        if isinstance(v, tuple) and v and all(
+                isinstance(e, int) and not isinstance(e, bool) for e in v):
+            for t in targets:
+                out[t.id] = v
+    return out
+
+
+def dotted(node: ast.AST) -> str | None:
+    """``a.b.c`` attribute chains as a dotted string (None otherwise).
+    Shared by the staging and transfer passes."""
+    parts = []
+    while isinstance(node, ast.Attribute):
+        parts.append(node.attr)
+        node = node.value
+    if isinstance(node, ast.Name):
+        parts.append(node.id)
+        return ".".join(reversed(parts))
+    return None
+
+
+def module_imports(mod: SourceModule) -> dict[str, str]:
+    """alias → dotted import target for one module (``import a.b as c``
+    → ``c: a.b``; ``from a import b`` → ``b: a.b``)."""
+    imports: dict[str, str] = {}
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.Import):
+            for a in node.names:
+                imports[a.asname or a.name.split(".")[0]] = (
+                    a.name if a.asname else a.name.split(".")[0])
+        elif isinstance(node, ast.ImportFrom) and node.module:
+            for a in node.names:
+                imports[a.asname or a.name] = f"{node.module}.{a.name}"
+    return imports
+
+
+def _rel_dotted(rel: str) -> str:
+    return rel[:-3].replace("/", ".") if rel.endswith(".py") else rel
+
+
+class SymbolTable:
+    """Cross-module symbol resolution over one analyzed file set: the
+    call-graph substrate the dataflow passes (transfer, points-to)
+    share. Resolves a ``Name`` / ``Attribute`` reference at a call site
+    to the *defining* module and top-level ``def`` / ``class`` node,
+    following the file set's explicit imports."""
+
+    def __init__(self, modules: list[SourceModule]):
+        self.modules = modules
+        self.imports = {m.rel: module_imports(m) for m in modules}
+        self.by_dotted = {_rel_dotted(m.rel): m for m in modules}
+        self.top: dict[str, dict[str, ast.AST]] = {}
+        for m in modules:
+            names: dict[str, ast.AST] = {}
+            for node in m.tree.body:
+                if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                                     ast.ClassDef)):
+                    names[node.name] = node
+                elif isinstance(node, ast.Assign):
+                    for t in node.targets:
+                        if isinstance(t, ast.Name):
+                            names[t.id] = node
+            self.top[m.rel] = names
+
+    def resolve(self, mod: SourceModule,
+                ref: ast.AST) -> tuple[SourceModule, ast.AST] | None:
+        """(defining module, top-level node) for a reference, if it
+        statically resolves inside the file set; None otherwise."""
+        if isinstance(ref, ast.Name):
+            local = self.top[mod.rel].get(ref.id)
+            if local is not None:
+                return mod, local
+            target = self.imports[mod.rel].get(ref.id)
+            if target and "." in target:
+                owner, _, sym = target.rpartition(".")
+                owner_mod = self.by_dotted.get(owner)
+                if owner_mod is not None:
+                    node = self.top[owner_mod.rel].get(sym)
+                    if node is not None:
+                        return owner_mod, node
+            return None
+        if isinstance(ref, ast.Attribute) and isinstance(ref.value, ast.Name):
+            base = self.imports[mod.rel].get(ref.value.id)
+            owner_mod = self.by_dotted.get(base or "")
+            if owner_mod is not None:
+                node = self.top[owner_mod.rel].get(ref.attr)
+                if node is not None:
+                    return owner_mod, node
+        return None
 
 
 def load_baseline(path: Path) -> set[tuple]:

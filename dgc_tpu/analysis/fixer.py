@@ -1,0 +1,282 @@
+"""``dgc-lint --fix``: the autofixer for mechanically-derivable fixes.
+
+Two fix kinds, both line-local (diff-minimal) and idempotent (a second
+run plans zero fixes):
+
+- **guarded-by insertion** — an LK002 finding (unannotated shared
+  mutable attribute on a lock-owning class) where EVERY non-init access
+  of the attribute, across every method, sits inside ``with
+  self.<L>:`` for one consistent lock ``L`` is evidence the attribute
+  is L-guarded in fact; the fix appends ``# guarded-by: L`` to the
+  attribute's defining line. Ambiguous evidence (two locks, any
+  unlocked access) plans nothing — the autofixer never guesses.
+- **named-slot rewrite** — a bare integer subscript on a declared
+  layout buffer variable (``carry[15]``) becomes the layout constant of
+  that value (``carry[CARRY_RUNG]``), using each ``BufferSpec``'s
+  ``index_consts`` order as the deterministic tiebreak (``CARRY_P1``
+  wins over the equal-valued ``OUT0``). The rewrite only fires when the
+  module already imports the constant from ``dgc_tpu.layout`` or the
+  fix can extend an existing single-line ``from dgc_tpu.layout import
+  (...)``; otherwise it is skipped with a note, never half-applied.
+
+``plan_fixes`` is pure (no writes); ``apply_fixes`` rewrites the
+files. ``--fix --check`` (CI mode) plans and exits non-zero iff any
+fix would be applied.
+"""
+
+from __future__ import annotations
+
+import ast
+import re
+from dataclasses import dataclass
+from pathlib import Path
+
+from dgc_tpu.analysis.common import (SourceModule, module_constants,
+                                     module_imports)
+
+_LAYOUT_IMPORT_RE = re.compile(
+    r"^from dgc_tpu\.layout import \(?([A-Za-z0-9_, \n]+?)\)?$",
+    re.M)
+
+
+@dataclass
+class Fix:
+    """One planned single-line edit."""
+
+    file: str
+    line: int                   # 1-indexed
+    old: str                    # exact current line text
+    new: str
+    kind: str                   # "guarded-by" | "named-slot" | "import"
+    note: str
+
+    def __str__(self) -> str:
+        return f"{self.file}:{self.line}: [{self.kind}] {self.note}"
+
+
+# ---------------------------------------------------------------------------
+# guarded-by insertion
+# ---------------------------------------------------------------------------
+
+def _with_lock_spans(meth: ast.AST):
+    """(lock_name, node) for every ``with self.<lock>:`` block."""
+    for node in ast.walk(meth):
+        if isinstance(node, ast.With):
+            for item in node.items:
+                e = item.context_expr
+                if isinstance(e, ast.Attribute) \
+                        and isinstance(e.value, ast.Name) \
+                        and e.value.id == "self":
+                    yield e.attr, node
+
+
+def _locks_held_at(meth: ast.AST, target: ast.AST) -> set:
+    """Lock names whose ``with self.<lock>:`` block lexically contains
+    ``target`` (by node identity)."""
+    held = set()
+    for lock, block in _with_lock_spans(meth):
+        for sub in ast.walk(block):
+            if sub is target:
+                held.add(lock)
+                break
+    return held
+
+
+def _plan_guard_fixes(mod: SourceModule, out: list[Fix]) -> None:
+    from dgc_tpu.analysis.locks import INIT_METHODS, _ClassInfo
+
+    for node in ast.walk(mod.tree):
+        if not isinstance(node, ast.ClassDef):
+            continue
+        cls = _ClassInfo(mod, node)
+        cls.finalize()
+        if not cls.locks or cls.owned_by is not None:
+            continue
+        candidates = (cls.mutable_attrs | set(cls.reassigned)) \
+            - set(cls.guards) - cls.locks
+        for attr in sorted(candidates):
+            evidence: set = set()
+            consistent = True
+            for meth in cls.methods():
+                if meth.name in INIT_METHODS:
+                    continue
+                for sub in ast.walk(meth):
+                    if (isinstance(sub, ast.Attribute)
+                            and isinstance(sub.value, ast.Name)
+                            and sub.value.id == "self"
+                            and sub.attr == attr):
+                        held = _locks_held_at(meth, sub) & cls.locks
+                        if not held:
+                            consistent = False
+                            break
+                        evidence |= held
+                if not consistent:
+                    break
+            if not consistent or len(evidence) != 1:
+                continue                 # ambiguous: never guess
+            lock = next(iter(evidence))
+            line_no = cls.attr_def_line.get(attr)
+            if line_no is None or line_no > len(mod.lines):
+                continue
+            old = mod.lines[line_no - 1]
+            if "guarded-by" in old:
+                continue                 # already annotated (idempotence)
+            if "#" in old:
+                new = f"{old}; guarded-by: {lock}"
+            else:
+                new = f"{old}   # guarded-by: {lock}"
+            out.append(Fix(mod.rel, line_no, old, new, "guarded-by",
+                           f"annotate {node.name}.{attr} as guarded-by "
+                           f"{lock} (every access holds it)"))
+
+
+# ---------------------------------------------------------------------------
+# named-slot rewrite
+# ---------------------------------------------------------------------------
+
+def _slot_names(spec, consts: dict) -> dict[int, str]:
+    """value → constant name, first-declared wins (CARRY_P1 over the
+    equal-valued OUT0)."""
+    names: dict[int, str] = {}
+    for cname in spec.index_consts:
+        v = consts.get(cname)
+        if v is not None and v not in names:
+            names[v] = cname
+    return names
+
+
+def _ensure_import(mod: SourceModule, needed: set,
+                   out: list[Fix]) -> bool:
+    """True when every needed constant is importable: already bound in
+    the module, or added to an existing single-line layout import (one
+    planned Fix). False → the caller skips its rewrites."""
+    bound = set(module_imports(mod)) | set(module_constants(mod))
+    missing = sorted(n for n in needed if n not in bound)
+    if not missing:
+        return True
+    for i, line in enumerate(mod.lines):
+        m = re.match(r"^(from dgc_tpu\.layout import \()([^)]*)(\).*)$",
+                     line)
+        if m:
+            have = [s.strip() for s in m.group(2).split(",") if s.strip()]
+            merged = sorted(set(have) | set(missing))
+            new = f"{m.group(1)}{', '.join(merged)}{m.group(3)}"
+            if len(new) <= 79:
+                out.append(Fix(mod.rel, i + 1, line, new, "import",
+                               f"import {', '.join(missing)} from "
+                               f"dgc_tpu.layout"))
+                return True
+        m = re.match(r"^from dgc_tpu\.layout import ([A-Za-z0-9_, ]+)$",
+                     line)
+        if m:
+            have = [s.strip() for s in m.group(1).split(",") if s.strip()]
+            merged = sorted(set(have) | set(missing))
+            new = f"from dgc_tpu.layout import {', '.join(merged)}"
+            if len(new) <= 79:
+                out.append(Fix(mod.rel, i + 1, line, new, "import",
+                               f"import {', '.join(missing)} from "
+                               f"dgc_tpu.layout"))
+                return True
+    return False
+
+
+def _plan_slot_fixes(layout_mod: SourceModule,
+                     modules: dict[str, SourceModule],
+                     specs, out: list[Fix]) -> None:
+    consts = module_constants(layout_mod)
+    for spec in specs:
+        names = _slot_names(spec, consts)
+        if not names:
+            continue
+        for rel in (spec.module,) + tuple(spec.extra_modules):
+            mod = modules.get(rel)
+            if mod is None or mod.rel == layout_mod.rel:
+                continue
+            planned: list[tuple] = []    # (line, col, end_col, name, v)
+            for node in ast.walk(mod.tree):
+                if not isinstance(node, ast.Subscript):
+                    continue
+                base = node.value
+                if not (isinstance(base, ast.Name)
+                        and base.id in spec.var_names):
+                    continue
+                sl = node.slice
+                if isinstance(sl, ast.Constant) \
+                        and isinstance(sl.value, int) \
+                        and not isinstance(sl.value, bool) \
+                        and sl.value in names \
+                        and sl.lineno == sl.end_lineno:
+                    planned.append((sl.lineno, sl.col_offset,
+                                    sl.end_col_offset, names[sl.value],
+                                    sl.value))
+            if not planned:
+                continue
+            if not _ensure_import(mod, {n for _, _, _, n, _ in planned},
+                                  out):
+                continue                 # no import surface: skip whole file
+            by_line: dict[int, list] = {}
+            for entry in planned:
+                by_line.setdefault(entry[0], []).append(entry)
+            for line_no, entries in sorted(by_line.items()):
+                old = new = mod.lines[line_no - 1]
+                for _ln, col, end_col, name, _v in sorted(
+                        entries, key=lambda e: -e[1]):
+                    new = new[:col] + name + new[end_col:]
+                out.append(Fix(
+                    mod.rel, line_no, old, new, "named-slot",
+                    f"rewrite bare {spec.name} index(es) "
+                    f"{sorted({e[4] for e in entries})} to named "
+                    f"slot(s)"))
+
+
+# ---------------------------------------------------------------------------
+# driver
+# ---------------------------------------------------------------------------
+
+def plan_fixes(root: Path, lock_files, layout_files,
+               specs=None) -> list[Fix]:
+    """Plan every applicable fix over the repo's lint file sets (pure —
+    nothing is written)."""
+    from dgc_tpu.analysis.layout_check import DEFAULT_SPECS
+
+    if specs is None:
+        specs = DEFAULT_SPECS
+    out: list[Fix] = []
+    for rel in lock_files:
+        if (root / rel).exists():
+            _plan_guard_fixes(SourceModule.load(root, rel), out)
+    layout_rel = layout_files[0]
+    if (root / layout_rel).exists():
+        layout_mod = SourceModule.load(root, layout_rel)
+        modules = {rel: SourceModule.load(root, rel)
+                   for rel in layout_files if (root / rel).exists()}
+        _plan_slot_fixes(layout_mod, modules, specs, out)
+    return sorted(out, key=lambda f: (f.file, f.line))
+
+
+def apply_fixes(root: Path, fixes: list[Fix]) -> int:
+    """Apply planned fixes; returns the number of lines rewritten. A
+    fix whose ``old`` line no longer matches is skipped (the plan went
+    stale) — re-run to re-plan."""
+    applied = 0
+    by_file: dict[str, list[Fix]] = {}
+    for fix in fixes:
+        by_file.setdefault(fix.file, []).append(fix)
+    for rel, file_fixes in by_file.items():
+        path = root / rel
+        lines = path.read_text().splitlines(keepends=True)
+        changed = False
+        for fix in file_fixes:
+            idx = fix.line - 1
+            if idx >= len(lines):
+                continue
+            raw = lines[idx]
+            ending = raw[len(raw.rstrip("\n\r")):]
+            if raw.rstrip("\n\r") != fix.old:
+                continue                 # stale plan: skip, never guess
+            lines[idx] = fix.new + ending
+            changed = True
+            applied += 1
+        if changed:
+            path.write_text("".join(lines))
+    return applied

@@ -1,0 +1,152 @@
+"""Opt-in runtime enforcement of ``# guarded-by:`` annotations.
+
+``DGC_TPU_LOCK_ASSERTS=1`` turns the lock-discipline *annotations* into
+checked *assertions*: every attribute annotated ``# guarded-by: <lock>``
+(where ``<lock>`` is a real lock attribute, not a thread-confinement
+pseudo-owner) is wrapped in a data descriptor that raises
+:class:`LockAssertionError` on any read or write performed without the
+instance's lock held — after construction (``__init__`` precedes
+sharing, exactly the static pass's exemption).
+
+This is the runtime half of the cross-object story: the static
+points-to pass (``dgc_tpu.analysis.pointsto``, rule LK004) proves what
+it can resolve; an alias it cannot track still hits the descriptor at
+runtime. The hook is wired into ``MetricsRegistry._get`` so the tests'
+registries enforce the convention end-to-end when the variable is set
+(``obs.metrics``); any class can be wrapped explicitly with
+:func:`lock_checked`.
+
+Held-ness is approximate by necessity: ``threading.Lock`` exposes only
+``locked()`` (held by *someone*), while ``RLock``/``Condition`` expose
+owner-accurate ``_is_owned()``. Good enough to catch the seeded
+unlocked write the tests plant — and never a false alarm under the
+convention's own rules, since a conforming access holds the lock.
+"""
+
+from __future__ import annotations
+
+import inspect
+import os
+import threading
+
+ENV_FLAG = "DGC_TPU_LOCK_ASSERTS"
+
+
+class LockAssertionError(AssertionError):
+    """A guarded attribute was touched without its lock held."""
+
+
+def enabled() -> bool:
+    return os.environ.get(ENV_FLAG) == "1"
+
+
+def _held(lock) -> bool:
+    owned = getattr(lock, "_is_owned", None)
+    if owned is not None:               # RLock / Condition: owner-exact
+        return bool(owned())
+    locked = getattr(lock, "locked", None)
+    if locked is not None:              # Lock: held by someone
+        return bool(locked())
+    return True                          # unknown lock type: never block
+
+
+class _GuardedAttr:
+    """Data descriptor enforcing held-lock access on one attribute.
+    Values live in the instance ``__dict__`` under a mangled key; the
+    check arms only after ``__init__`` completes (``_la_armed``)."""
+
+    def __init__(self, name: str, lock_attr: str):
+        self.name = name
+        self.lock_attr = lock_attr
+        self.slot = f"_la_{name}"
+
+    def _check(self, obj, verb: str) -> None:
+        if not obj.__dict__.get("_la_armed"):
+            return
+        lock = getattr(obj, self.lock_attr, None)
+        if lock is not None and not _held(lock):
+            raise LockAssertionError(
+                f"{type(obj).__name__}.{self.name} {verb} without "
+                f"holding '{self.lock_attr}' (guarded-by annotation; "
+                f"set {ENV_FLAG}=0 to disable runtime lock asserts)")
+
+    def __get__(self, obj, owner=None):
+        if obj is None:
+            return self
+        self._check(obj, "read")
+        try:
+            return obj.__dict__[self.slot]
+        except KeyError:
+            raise AttributeError(self.name) from None
+
+    def __set__(self, obj, value):
+        self._check(obj, "write")
+        obj.__dict__[self.slot] = value
+
+    def __delete__(self, obj):
+        self._check(obj, "delete")
+        obj.__dict__.pop(self.slot, None)
+
+
+def _lock_guards_of(cls) -> dict[str, str]:
+    """attr → lock attribute, from the class's ``# guarded-by:``
+    annotations (lock-backed guards only; pseudo-owners are
+    thread-confinement claims with nothing to assert)."""
+    from dgc_tpu.analysis.common import SourceModule
+    from dgc_tpu.analysis.locks import _ClassInfo
+
+    try:
+        source = inspect.getsource(inspect.getmodule(cls))
+    except (OSError, TypeError):
+        return {}
+    import ast
+
+    mod = SourceModule(getattr(cls, "__module__", "<runtime>") + ".py",
+                       source)
+    for node in ast.walk(mod.tree):
+        if isinstance(node, ast.ClassDef) and node.name == cls.__name__:
+            info = _ClassInfo(mod, node)
+            info.finalize()
+            return {attr: guard
+                    for attr, (guard, _line) in info.guards.items()
+                    if guard in info.locks}
+    return {}
+
+
+def lock_checked(cls, guards: dict[str, str] | None = None):
+    """A subclass of ``cls`` whose guarded attributes assert held locks
+    (see module docstring). ``guards`` overrides the annotation scan —
+    fixtures pass it explicitly. Idempotent: wrapping a wrapped class
+    returns it unchanged."""
+    if getattr(cls, "_la_wrapped", False):
+        return cls
+    if guards is None:
+        guards = _lock_guards_of(cls)
+    if not guards:
+        return cls
+
+    namespace = {"_la_wrapped": True}
+    for attr, lock_attr in sorted(guards.items()):
+        namespace[attr] = _GuardedAttr(attr, lock_attr)
+
+    base_init = cls.__init__
+
+    def __init__(self, *args, **kwargs):
+        base_init(self, *args, **kwargs)
+        # arm AFTER construction: __init__ precedes sharing (the static
+        # pass's INIT_METHODS exemption, enforced dynamically)
+        self.__dict__["_la_armed"] = True
+
+    namespace["__init__"] = __init__
+    wrapped = type(cls.__name__, (cls,), namespace)
+    wrapped.__qualname__ = cls.__qualname__
+    wrapped.__module__ = cls.__module__
+    return wrapped
+
+
+def maybe_checked(cls, guards: dict[str, str] | None = None):
+    """``lock_checked(cls)`` when ``DGC_TPU_LOCK_ASSERTS=1``, else
+    ``cls`` unchanged — the zero-overhead production path."""
+    if not enabled():
+        return cls
+    return lock_checked(cls, guards)

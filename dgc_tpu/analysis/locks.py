@@ -32,12 +32,18 @@ Rules:
 - **LK001** guarded attribute accessed outside ``with <its lock>``;
 - **LK002** unannotated shared mutable attribute on a threaded class;
 - **LK003** ``guarded-by`` names neither a lock attribute nor a known
-  pseudo-owner.
+  pseudo-owner;
+- **LK004** cross-object: a guarded attribute of a *pointee* (``m.n``
+  where ``m`` points to a ``Histogram``) accessed outside ``with
+  m.<its lock>:`` — discharged by the field-sensitive points-to pass
+  (``dgc_tpu.analysis.pointsto``), which closed the PR 8 scope limit
+  ("cross-object accesses are out of reach of a lexical checker").
 
-Scope limits (honest ones): only ``self.<attr>`` accesses are checked —
-cross-object accesses (``m.counts`` under ``m._lock`` in the registry
-exporters) and attribute aliasing are out of reach of a lexical
-checker, and the runtime hammer tests stay the authority there.
+Remaining scope limits (honest ones): the points-to pass only tracks
+allocations, annotated parameters, and field/return flow it can resolve
+inside the file set — an untracked alias is silently skipped, and the
+runtime hammer tests (plus the ``DGC_TPU_LOCK_ASSERTS=1`` runtime hook,
+``dgc_tpu.analysis.lockassert``) stay the authority there.
 """
 
 from __future__ import annotations
@@ -234,6 +240,20 @@ def _check_method(cls: _ClassInfo, meth: ast.FunctionDef,
         visit(stmt, frozenset())
 
 
+def class_infos_of(modules: list[SourceModule]) -> dict[str, _ClassInfo]:
+    """Every class in the file set, scanned for locks/guards — the
+    registry the points-to pass discharges LK004 obligations against
+    (first definition of a name wins)."""
+    infos: dict[str, _ClassInfo] = {}
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if isinstance(node, ast.ClassDef) and node.name not in infos:
+                cls = _ClassInfo(mod, node)
+                cls.finalize()
+                infos[node.name] = cls
+    return infos
+
+
 def check_locks(modules: list[SourceModule]) -> list[Finding]:
     out: list[Finding] = []
     for mod in modules:
@@ -272,4 +292,8 @@ def check_locks(modules: list[SourceModule]) -> list[Finding]:
                 if meth.name in INIT_METHODS:
                     continue
                 _check_method(cls, meth, out)
+    # LK004: cross-object guarded attributes via the points-to pass
+    from dgc_tpu.analysis.pointsto import check_pointsto
+
+    out += check_pointsto(modules, class_infos_of(modules))
     return out

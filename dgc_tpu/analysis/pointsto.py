@@ -1,0 +1,593 @@
+"""Field-sensitive intra-procedural points-to analysis for the lock
+pass (rule **LK004** — cross-object guarded attributes).
+
+PR 8's lock checker was lexical: only ``self.<attr>`` accesses under
+``with self.<lock>:`` were in reach, and the registry exporters'
+
+    for (n, _), m in self._snapshot()[0]:
+        with m._lock:
+            ... m.counts ...
+
+pattern — the pointee's OWN lock guarding the pointee's attributes —
+was explicitly out of scope (the ROADMAP cross-object-lock follow-on).
+This module closes it with a small abstract interpreter:
+
+- **Allocation sites**: ``ClassName(...)`` calls resolving (through the
+  file set's imports) to an analyzed class.
+- **Fields** are class-level abstract cells, merged over every method:
+  ``self.f = X`` joins ``X``'s abstract value into ``(class, f)``;
+  ``self.f[k] = X`` / ``self.f.append(X)`` join into the cell's
+  *element*. Parameter **annotations** naming an analyzed class seed
+  objects (annotations are trusted, the repo's convention), and
+  intra-class ``self.m(args)`` call sites propagate argument abstracts
+  into parameter abstracts — which is how ``MetricsRegistry._get``'s
+  ``cls(...)`` allocation resolves to {Counter, Gauge, Histogram}.
+- **Method returns** are abstract values too (``return self`` makes a
+  builder chain like ``ServeFrontEnd(...).start()`` track), resolved by
+  method NAME across the file set when the receiver's class is unknown
+  — one analyzed class defining ``histograms`` is enough to type
+  ``self.registry.histograms(...)``'s elements.
+- **Containers** track one element abstract plus an ``items()``-pair
+  flag, through ``sorted``/``list``/``tuple``/subscripts/iteration and
+  single-generator comprehensions; iterating an ``items()`` container
+  binds the LAST name in a tuple loop target to the element.
+
+The check: an attribute read/write ``x.attr`` where ``x``'s points-to
+set contains a class whose ``attr`` carries ``# guarded-by: <lock>``
+(a real lock attribute, not a pseudo-owner) must sit lexically inside
+``with x.<lock>:`` on the SAME name. Unknown points-to sets are skipped
+— the pass is deliberately precise-not-sound (a finding is real;
+silence proves nothing), and the hammer tests remain the authority for
+what it cannot see. Pseudo-owner and ``owned-by`` annotations discharge
+the obligation exactly as they do for ``self`` accesses.
+"""
+
+from __future__ import annotations
+
+import ast
+from dataclasses import dataclass
+
+from dgc_tpu.analysis.common import Finding, SourceModule, module_imports
+
+_PASSTHROUGH_CALLS = {"sorted", "list", "tuple", "iter", "reversed", "set"}
+_ELEM_METHODS = {"values"}
+_PAIR_METHODS = {"items"}
+_APPEND_METHODS = {"append", "add", "appendleft"}
+
+
+@dataclass
+class AVal:
+    """Abstract value: the classes this value may BE an instance of,
+    the element abstract if it is a container, and whether iteration
+    yields (key, element) pairs (``dict.items()``)."""
+
+    objs: frozenset = frozenset()
+    elem: "AVal | None" = None
+    pair: bool = False
+    tuple_elems: tuple = ()
+
+    def join(self, other: "AVal | None") -> "AVal":
+        if other is None:
+            return self
+        elem = self.elem.join(other.elem) if self.elem and other.elem \
+            else (self.elem or other.elem)
+        if self.tuple_elems and other.tuple_elems \
+                and len(self.tuple_elems) == len(other.tuple_elems):
+            tup = tuple(a.join(b) for a, b in zip(self.tuple_elems,
+                                                  other.tuple_elems))
+        else:
+            tup = self.tuple_elems or other.tuple_elems
+        return AVal(self.objs | other.objs, elem,
+                    self.pair or other.pair, tup)
+
+    @property
+    def empty(self) -> bool:
+        return not self.objs and self.elem is None \
+            and not self.tuple_elems
+
+
+EMPTY = AVal()
+
+
+class ClassDB:
+    """Every analyzed class: its lock/guard info (``locks._ClassInfo``)
+    plus the abstract field, parameter, and return cells the fixpoint
+    fills in."""
+
+    def __init__(self, modules: list[SourceModule], class_infos: dict):
+        # class_infos: name -> locks._ClassInfo (first definition wins)
+        self.modules = modules
+        self.infos = class_infos
+        self.imports = {m.rel: module_imports(m) for m in modules}
+        self.fields: dict[tuple, AVal] = {}       # (cls, field) -> AVal
+        self.params: dict[tuple, AVal] = {}       # (cls, meth, param)
+        self.returns: dict[tuple, AVal] = {}      # (cls, meth) -> AVal
+        self.methods: dict[str, list] = {}        # meth name -> [cls...]
+        for cname, info in class_infos.items():
+            for meth in info.methods():
+                self.methods.setdefault(meth.name, []).append(cname)
+
+    def is_class(self, mod: SourceModule, name: str) -> str | None:
+        """Resolve a simple name at a use site to an analyzed class —
+        local definition first, then an explicit import; an import from
+        OUTSIDE the file set (e.g. ``collections.Counter``) never
+        resolves to an analyzed class of the same name."""
+        imp = self.imports[mod.rel].get(name)
+        if imp is not None:
+            owner = imp.rsplit(".", 1)[0].replace(".", "/") + ".py"
+            if not any(m.rel.endswith(owner) or m.rel == owner
+                       for m in self.modules):
+                return None
+        return name if name in self.infos else None
+
+    def guard_of(self, cname: str, attr: str) -> str | None:
+        """The LOCK attribute guarding ``attr`` on class ``cname``;
+        None when unguarded, pseudo-owned, or class-blanket-owned."""
+        info = self.infos.get(cname)
+        if info is None or info.owned_by is not None:
+            return None
+        got = info.guards.get(attr)
+        if got is None:
+            return None
+        guard = got[0]
+        return guard if guard in info.locks else None
+
+    def is_method(self, cname: str, attr: str) -> bool:
+        info = self.infos.get(cname)
+        return info is not None and any(m.name == attr
+                                        for m in info.methods())
+
+
+class _Evaluator:
+    """Evaluates expressions to AVals in one method/function scope."""
+
+    def __init__(self, db: ClassDB, mod: SourceModule,
+                 cname: str | None, env: dict):
+        self.db = db
+        self.mod = mod
+        self.cname = cname
+        self.env = env
+
+    def eval(self, node: ast.AST, depth: int = 0) -> AVal:
+        if depth > 8 or node is None:
+            return EMPTY
+        if isinstance(node, ast.Name):
+            return self.env.get(node.id, EMPTY)
+        if isinstance(node, ast.Attribute):
+            base = self.eval(node.value, depth + 1)
+            out = EMPTY
+            bases = set(base.objs)
+            if isinstance(node.value, ast.Name) and node.value.id == "self" \
+                    and self.cname is not None:
+                bases.add(self.cname)
+            for cname in bases:
+                cell = self.db.fields.get((cname, node.attr))
+                if cell is not None:
+                    out = out.join(cell)
+            return out
+        if isinstance(node, ast.Call):
+            return self._eval_call(node, depth)
+        if isinstance(node, ast.Subscript):
+            base = self.eval(node.value, depth + 1)
+            if isinstance(node.slice, ast.Constant) \
+                    and isinstance(node.slice.value, int) \
+                    and base.tuple_elems:
+                i = node.slice.value
+                if -len(base.tuple_elems) <= i < len(base.tuple_elems):
+                    return base.tuple_elems[i]
+            return base.elem or EMPTY
+        if isinstance(node, ast.Tuple):
+            return AVal(tuple_elems=tuple(self.eval(e, depth + 1)
+                                          for e in node.elts))
+        if isinstance(node, (ast.ListComp, ast.GeneratorExp, ast.SetComp)):
+            if len(node.generators) == 1:
+                gen = node.generators[0]
+                saved = dict(self.env)
+                self._bind_iter(gen.target, self.eval(gen.iter, depth + 1))
+                elem = self.eval(node.elt, depth + 1)
+                self.env.clear()
+                self.env.update(saved)
+                return AVal(elem=elem) if not elem.empty else EMPTY
+            return EMPTY
+        if isinstance(node, ast.IfExp):
+            return self.eval(node.body, depth + 1).join(
+                self.eval(node.orelse, depth + 1))
+        if isinstance(node, ast.BoolOp):
+            out = EMPTY
+            for v in node.values:
+                out = out.join(self.eval(v, depth + 1))
+            return out
+        return EMPTY
+
+    def _eval_call(self, node: ast.Call, depth: int) -> AVal:
+        f = node.func
+        if isinstance(f, ast.Name):
+            if f.id in _PASSTHROUGH_CALLS and node.args:
+                return self.eval(node.args[0], depth + 1)
+            cname = self.db.is_class(self.mod, f.id)
+            if cname is not None:
+                return AVal(objs=frozenset({cname}))
+            return EMPTY
+        if isinstance(f, ast.Attribute):
+            recv = self.eval(f.value, depth + 1)
+            if isinstance(f.value, ast.Name) and f.value.id == "self" \
+                    and self.cname is not None:
+                recv = recv.join(AVal(objs=frozenset({self.cname})))
+            if f.attr in _PAIR_METHODS:
+                return AVal(elem=recv.elem, pair=True) if recv.elem \
+                    else EMPTY
+            if f.attr in _ELEM_METHODS:
+                return AVal(elem=recv.elem) if recv.elem else EMPTY
+            # method return abstracts: receiver classes first, then
+            # unique-name resolution across the file set
+            targets = [c for c in recv.objs
+                       if self.db.is_method(c, f.attr)]
+            if not targets:
+                owners = self.db.methods.get(f.attr, [])
+                if len(owners) == 1:
+                    targets = owners
+            out = EMPTY
+            for cname in targets:
+                ret = self.db.returns.get((cname, f.attr))
+                if ret is not None:
+                    out = out.join(ret)
+            return out
+        return EMPTY
+
+    def _bind_iter(self, target: ast.AST, container: AVal) -> None:
+        """Bind a for-loop / comprehension target from a container's
+        element abstract: a pair container binds the LAST name of a
+        tuple target to the element; otherwise the single name."""
+        elem = container.elem
+        if elem is None:
+            return
+        if isinstance(target, ast.Name):
+            self.env[target.id] = self.env.get(target.id, EMPTY).join(elem)
+            return
+        if container.pair or isinstance(target, ast.Tuple):
+            # the element rides in the syntactically LAST slot of the
+            # tuple target (`for (key, _), m in d.items()` binds m)
+            last = target
+            while isinstance(last, ast.Tuple) and last.elts:
+                last = last.elts[-1]
+            if isinstance(last, ast.Name):
+                self.env[last.id] = self.env.get(last.id,
+                                                 EMPTY).join(elem)
+
+
+def _seed_params(db: ClassDB, mod: SourceModule, cname: str | None,
+                 func: ast.AST) -> dict:
+    env: dict = {}
+    if cname is not None:
+        env["self"] = AVal(objs=frozenset({cname}))
+    args = func.args
+    for a in list(args.posonlyargs) + list(args.args) \
+            + list(args.kwonlyargs):
+        seeded = EMPTY
+        ann = a.annotation
+        if ann is not None:
+            for n in ast.walk(ann):
+                if isinstance(n, ast.Name):
+                    c = db.is_class(mod, n.id)
+                    if c is not None:
+                        seeded = seeded.join(AVal(objs=frozenset({c})))
+                elif isinstance(n, ast.Constant) \
+                        and isinstance(n.value, str):
+                    for part in n.value.replace("|", " ").split():
+                        c = db.is_class(mod, part.strip())
+                        if c is not None:
+                            seeded = seeded.join(
+                                AVal(objs=frozenset({c})))
+        if cname is not None:
+            seeded = seeded.join(db.params.get((cname, func.name, a.arg),
+                                               EMPTY))
+        if not seeded.empty:
+            env[a.arg] = seeded
+    return env
+
+
+def _flow_method(db: ClassDB, mod: SourceModule, cname: str | None,
+                 func: ast.AST) -> tuple[dict, AVal]:
+    """One abstract pass over a function body: returns (final env, the
+    joined return abstract). Field/param cells are updated in place."""
+    env = _seed_params(db, mod, cname, func)
+    ev = _Evaluator(db, mod, cname, env)
+    ret = EMPTY
+
+    def flow(stmts):
+        nonlocal ret
+        for stmt in stmts:
+            if isinstance(stmt, ast.Assign):
+                val = ev.eval(stmt.value)
+                for t in stmt.targets:
+                    _store(t, val)
+            elif isinstance(stmt, ast.AnnAssign) and stmt.value is not None:
+                _store(stmt.target, ev.eval(stmt.value))
+            elif isinstance(stmt, ast.For):
+                ev._bind_iter(stmt.target, ev.eval(stmt.iter))
+                flow(stmt.body)
+                flow(stmt.orelse)
+            elif isinstance(stmt, (ast.If, ast.While)):
+                flow(stmt.body)
+                flow(stmt.orelse)
+            elif isinstance(stmt, ast.With):
+                flow(stmt.body)
+            elif isinstance(stmt, ast.Try):
+                flow(stmt.body)
+                for h in stmt.handlers:
+                    flow(h.body)
+                flow(stmt.orelse)
+                flow(stmt.finalbody)
+            elif isinstance(stmt, ast.Return) and stmt.value is not None:
+                ret = ret.join(ev.eval(stmt.value))
+            elif isinstance(stmt, ast.Expr):
+                _side_effects(stmt.value)
+
+    def _store(target, val: AVal):
+        if isinstance(target, ast.Name):
+            env[target.id] = env.get(target.id, EMPTY).join(val)
+        elif isinstance(target, ast.Tuple):
+            for i, t in enumerate(target.elts):
+                if val.tuple_elems and i < len(val.tuple_elems):
+                    _store(t, val.tuple_elems[i])
+                else:
+                    _store(t, val.elem or EMPTY)
+        elif isinstance(target, ast.Attribute) \
+                and isinstance(target.value, ast.Name):
+            owners = set()
+            if target.value.id == "self" and cname is not None:
+                owners.add(cname)
+            owners |= env.get(target.value.id, EMPTY).objs
+            for owner in owners:
+                key = (owner, target.attr)
+                db.fields[key] = db.fields.get(key, EMPTY).join(val)
+        elif isinstance(target, ast.Subscript):
+            base = target.value
+            if isinstance(base, ast.Attribute) \
+                    and isinstance(base.value, ast.Name):
+                owners = set()
+                if base.value.id == "self" and cname is not None:
+                    owners.add(cname)
+                owners |= env.get(base.value.id, EMPTY).objs
+                for owner in owners:
+                    key = (owner, base.attr)
+                    cell = db.fields.get(key, EMPTY)
+                    db.fields[key] = AVal(
+                        cell.objs, (cell.elem or EMPTY).join(val),
+                        cell.pair, cell.tuple_elems)
+
+    def _side_effects(expr):
+        # self.f.append(x) / intra-class self.m(args) param propagation
+        if not isinstance(expr, ast.Call):
+            return
+        f = expr.func
+        if isinstance(f, ast.Attribute):
+            if f.attr in _APPEND_METHODS and expr.args \
+                    and isinstance(f.value, ast.Attribute) \
+                    and isinstance(f.value.value, ast.Name) \
+                    and f.value.value.id == "self" and cname is not None:
+                key = (cname, f.value.attr)
+                cell = db.fields.get(key, EMPTY)
+                db.fields[key] = AVal(
+                    cell.objs,
+                    (cell.elem or EMPTY).join(ev.eval(expr.args[0])),
+                    cell.pair, cell.tuple_elems)
+
+    def _propagate_calls(node):
+        # every self.m(arg, ...) call site feeds param abstracts
+        if cname is None:
+            return
+        for call in ast.walk(node):
+            if not isinstance(call, ast.Call):
+                continue
+            f = call.func
+            if isinstance(f, ast.Attribute) \
+                    and isinstance(f.value, ast.Name) \
+                    and f.value.id == "self" \
+                    and self_has_method(f.attr):
+                meth = method_node(f.attr)
+                names = [a.arg for a in meth.args.args][1:]  # skip self
+                for i, arg in enumerate(call.args):
+                    if i < len(names):
+                        val = ev.eval(arg)
+                        if isinstance(arg, ast.Name):
+                            c = db.is_class(mod, arg.id)
+                            if c is not None and arg.id not in env:
+                                # a CLASS passed as a value: calling it
+                                # allocates that class
+                                val = val.join(
+                                    AVal(objs=frozenset({f"type:{c}"})))
+                        if not val.empty:
+                            key = (cname, f.attr, names[i])
+                            db.params[key] = db.params.get(
+                                key, EMPTY).join(val)
+
+    def self_has_method(name: str) -> bool:
+        info = db.infos.get(cname)
+        return info is not None and any(m.name == name
+                                        for m in info.methods())
+
+    def method_node(name: str):
+        info = db.infos.get(cname)
+        for m in info.methods():
+            if m.name == name:
+                return m
+        return None
+
+    flow(func.body)
+    _propagate_calls(func)
+    return env, ret
+
+
+def build_db(modules: list[SourceModule], class_infos: dict,
+             iterations: int = 4) -> ClassDB:
+    """Fixpoint over field / parameter / return abstracts. AVal joins
+    only grow, so a few iterations converge for the shapes here."""
+    db = ClassDB(modules, class_infos)
+    mod_of = {}
+    for m in modules:
+        for node in ast.walk(m.tree):
+            if isinstance(node, ast.ClassDef) and node.name in class_infos \
+                    and node.name not in mod_of:
+                mod_of[node.name] = m
+    for _ in range(iterations):
+        for cname, info in class_infos.items():
+            mod = mod_of.get(cname)
+            if mod is None:
+                continue
+            for meth in info.methods():
+                env, ret = _flow_method(db, mod, cname, meth)
+                # REPLACE, don't join: the fresh evaluation reflects the
+                # latest field/param cells; joining would pin stale
+                # container snapshots from earlier iterations
+                db.returns[(cname, meth.name)] = ret
+        # type-valued params resolved INSIDE the fixpoint so a later
+        # iteration's return abstracts see the allocations (`cls(...)`
+        # stores in MetricsRegistry._get feed counter()'s return)
+        _resolve_type_params(db, mod_of, class_infos)
+    return db
+
+
+def _resolve_type_params(db: ClassDB, mod_of: dict,
+                         class_infos: dict) -> None:
+    for (cname, meth_name, pname), aval in list(db.params.items()):
+        classes = {o.split(":", 1)[1] for o in aval.objs
+                   if isinstance(o, str) and o.startswith("type:")}
+        if not classes:
+            continue
+        info = class_infos.get(cname)
+        mod = mod_of.get(cname)
+        if info is None or mod is None:
+            continue
+        meth = next((m for m in info.methods() if m.name == meth_name),
+                    None)
+        if meth is None:
+            continue
+        alloc = AVal(objs=frozenset(classes))
+        # re-run the method with the param bound to the allocation
+        # result wherever it is CALLED: approximate by binding the
+        # param name to EMPTY but treating `pname(...)` as `alloc`
+        for node in ast.walk(meth):
+            if isinstance(node, ast.Assign) \
+                    and isinstance(node.value, ast.Call) \
+                    and isinstance(node.value.func, ast.Name) \
+                    and node.value.func.id == pname:
+                for t in node.targets:
+                    if isinstance(t, ast.Subscript) \
+                            and isinstance(t.value, ast.Attribute) \
+                            and isinstance(t.value.value, ast.Name) \
+                            and t.value.value.id == "self":
+                        key = (cname, t.value.attr)
+                        cell = db.fields.get(key, EMPTY)
+                        db.fields[key] = AVal(
+                            cell.objs, (cell.elem or EMPTY).join(alloc),
+                            cell.pair, cell.tuple_elems)
+
+
+# ---------------------------------------------------------------------------
+# the LK004 check
+# ---------------------------------------------------------------------------
+
+def check_pointsto(modules: list[SourceModule],
+                   class_infos: dict) -> list[Finding]:
+    """Cross-object guarded-attribute discipline (LK004) over the file
+    set, given the per-class lock info the lexical pass computed."""
+    db = build_db(modules, class_infos)
+    out: list[Finding] = []
+    for mod in modules:
+        # module-level code and every function (incl. methods: the
+        # lexical pass owns self-accesses, this pass everything else)
+        scopes: list[tuple[str | None, str, ast.AST]] = [
+            (None, "<module>", mod.tree)]
+        for node in ast.walk(mod.tree):
+            if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                cname = None
+                for cls_node in ast.walk(mod.tree):
+                    if isinstance(cls_node, ast.ClassDef) \
+                            and node in cls_node.body:
+                        cname = cls_node.name
+                        break
+                scopes.append((cname, node.name, node))
+        for cname, label, scope in scopes:
+            _check_scope(db, mod, cname, label, scope, out)
+    return out
+
+
+def _check_scope(db: ClassDB, mod: SourceModule, cname: str | None,
+                 label: str, scope: ast.AST, out: list[Finding]) -> None:
+    if isinstance(scope, ast.Module):
+        env: dict = {}
+        ev = _Evaluator(db, mod, None, env)
+        for stmt in scope.body:
+            if isinstance(stmt, ast.Assign):
+                val = ev.eval(stmt.value)
+                for t in stmt.targets:
+                    if isinstance(t, ast.Name) and not val.empty:
+                        env[t.id] = env.get(t.id, EMPTY).join(val)
+        body = [s for s in scope.body
+                if not isinstance(s, (ast.FunctionDef,
+                                      ast.AsyncFunctionDef, ast.ClassDef))]
+    else:
+        env, _ = _flow_method(db, mod, cname, scope)
+        ev = _Evaluator(db, mod, cname, env)
+        body = scope.body
+
+    def _base_key(e: ast.AST) -> str | None:
+        """Dotted key for a lock-holder base expression: a Name, or an
+        attribute chain rooted at a Name (``front.scheduler``)."""
+        parts = []
+        while isinstance(e, ast.Attribute):
+            parts.append(e.attr)
+            e = e.value
+        if isinstance(e, ast.Name):
+            parts.append(e.id)
+            return ".".join(reversed(parts))
+        return None
+
+    def visit(node: ast.AST, held: frozenset) -> None:
+        if isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef,
+                             ast.Lambda)):
+            return                      # nested scopes checked separately
+        if isinstance(node, ast.With):
+            inner = held
+            for item in node.items:
+                e = item.context_expr
+                if isinstance(e, ast.Attribute):
+                    base = _base_key(e.value)
+                    if base is not None:
+                        inner = inner | {(base, e.attr)}
+            for child in node.body:
+                visit(child, inner)
+            return
+        if isinstance(node, ast.Attribute):
+            base = _base_key(node.value)
+            if base is not None and base != "self":
+                aval = ev.eval(node.value)
+                guards = set()
+                for c in aval.objs:
+                    if isinstance(c, str) and not c.startswith("type:"):
+                        if db.is_method(c, node.attr):
+                            guards = set()
+                            break
+                        g = db.guard_of(c, node.attr)
+                        if g is not None:
+                            guards.add(g)
+                owners = "/".join(sorted(
+                    c for c in aval.objs
+                    if isinstance(c, str)
+                    and db.guard_of(c, node.attr) is not None))
+                for g in sorted(guards):
+                    if (base, g) not in held:
+                        f = mod.finding(
+                            "LK004", node,
+                            f"{label}: '{base}.{node.attr}' is guarded "
+                            f"by the pointee's '{g}' ({owners}) but "
+                            f"accessed outside 'with {base}.{g}:'")
+                        if f is not None:
+                            out.append(f)
+                        break
+        for child in ast.iter_child_nodes(node):
+            visit(child, held)
+
+    for stmt in body:
+        visit(stmt, frozenset())

@@ -1,4 +1,4 @@
-"""Repo-level driver: binds the four passes to their file sets.
+"""Repo-level driver: binds the five passes to their file sets.
 
 The pass implementations are file-set-agnostic (fixture tests feed them
 synthetic sources); THIS module encodes what "the repo" means:
@@ -11,18 +11,32 @@ synthetic sources); THIS module encodes what "the repo" means:
 - **schema** cross-checks every emit site in the package, ``bench.py``
   and ``tools/`` against ``obs.schema.EVENT_SCHEMAS``;
 - **locks** covers the threaded tier: metrics registry, scrape
-  endpoint, serve front-end, batch scheduler.
+  endpoint, serve front-end, batch scheduler — plus the serve CLI and
+  ``bench.py``, whose cross-object reads of the scheduler's counters
+  the points-to pass (LK004) reaches;
+- **transfer** runs the donation/transfer discipline rules (TR*) over
+  the serve tier's device-buffer dataflow, with the carry-slot
+  whitelist read from ``dgc_tpu/layout.py`` (``D2H_SLOTS``).
+
+Every file is parsed ONCE per run into a shared cache — both for speed
+and so waiver-use accounting (``# dgc-lint: ok RULE`` comments that
+suppressed nothing) aggregates across passes instead of fragmenting
+over per-pass module copies.
 """
 
 from __future__ import annotations
 
+from dataclasses import dataclass, field
 from pathlib import Path
 
-from dgc_tpu.analysis.common import Finding, SourceModule
+from dgc_tpu.analysis.common import (Finding, SourceModule,
+                                     module_constants,
+                                     module_tuple_constants)
 from dgc_tpu.analysis.layout_check import check_layout
 from dgc_tpu.analysis.locks import check_locks
 from dgc_tpu.analysis.schema_check import check_schema
 from dgc_tpu.analysis.staging import check_staging
+from dgc_tpu.analysis.transfer_check import check_transfer
 
 STAGING_GLOBS = ("dgc_tpu/serve/batched.py", "dgc_tpu/engine/*.py",
                  "dgc_tpu/ops/*.py", "dgc_tpu/obs/kernel.py",
@@ -34,9 +48,25 @@ LAYOUT_FILES = ("dgc_tpu/layout.py", "dgc_tpu/serve/batched.py",
                 "tests/test_serve.py")
 SCHEMA_GLOBS = ("dgc_tpu/**/*.py", "bench.py", "tools/*.py")
 LOCK_FILES = ("dgc_tpu/obs/metrics.py", "dgc_tpu/obs/httpd.py",
-              "dgc_tpu/serve/queue.py", "dgc_tpu/serve/engine.py")
+              "dgc_tpu/serve/queue.py", "dgc_tpu/serve/engine.py",
+              "dgc_tpu/serve/cli.py", "bench.py")
+TRANSFER_FILES = ("dgc_tpu/serve/batched.py", "dgc_tpu/serve/engine.py")
 
-PASSES = ("staging", "layout", "schema", "locks")
+PASSES = ("staging", "layout", "schema", "locks", "transfer")
+
+# rule-family prefix per pass: scopes the dead-waiver warning to the
+# passes that actually ran
+PASS_PREFIX = {"staging": "KS", "layout": "LY", "schema": "SC",
+               "locks": "LK", "transfer": "TR"}
+
+
+@dataclass
+class LintReport:
+    """One lint run's full result: findings plus hygiene diagnostics."""
+
+    findings: list = field(default_factory=list)
+    # (file, line, rule) waivers that suppressed nothing
+    unused_waivers: list = field(default_factory=list)
 
 
 def _expand(root: Path, patterns) -> list[str]:
@@ -57,23 +87,55 @@ def _expand(root: Path, patterns) -> list[str]:
     return uniq
 
 
-def _load(root: Path, rels) -> list[SourceModule]:
-    return [SourceModule.load(root, rel) for rel in rels]
+class _ModuleCache:
+    def __init__(self, root: Path):
+        self.root = root
+        self.mods: dict[str, SourceModule] = {}
+
+    def get(self, rel: str) -> SourceModule:
+        if rel not in self.mods:
+            self.mods[rel] = SourceModule.load(self.root, rel)
+        return self.mods[rel]
+
+    def load(self, rels) -> list[SourceModule]:
+        return [self.get(rel) for rel in rels]
 
 
-def run_passes(root: Path, passes=PASSES) -> list[Finding]:
+def run_report(root: Path, passes=PASSES) -> LintReport:
+    """Run the selected passes; returns findings + hygiene data."""
+    cache = _ModuleCache(root)
     findings: list[Finding] = []
     if "staging" in passes:
-        findings += check_staging(_load(root, _expand(root, STAGING_GLOBS)))
+        findings += check_staging(cache.load(_expand(root, STAGING_GLOBS)))
     if "layout" in passes:
         rels = _expand(root, LAYOUT_FILES)
-        mods = {rel: SourceModule.load(root, rel) for rel in rels}
+        mods = {rel: cache.get(rel) for rel in rels}
         findings += check_layout(mods["dgc_tpu/layout.py"], mods)
     if "schema" in passes:
         from dgc_tpu.obs.schema import EVENT_SCHEMAS
 
-        findings += check_schema(_load(root, _expand(root, SCHEMA_GLOBS)),
+        findings += check_schema(cache.load(_expand(root, SCHEMA_GLOBS)),
                                  EVENT_SCHEMAS)
     if "locks" in passes:
-        findings += check_locks(_load(root, _expand(root, LOCK_FILES)))
-    return sorted(findings, key=lambda f: (f.file, f.line, f.rule))
+        findings += check_locks(cache.load(_expand(root, LOCK_FILES)))
+    if "transfer" in passes:
+        layout_mod = cache.get("dgc_tpu/layout.py")
+        d2h = module_tuple_constants(layout_mod).get("D2H_SLOTS", ())
+        findings += check_transfer(
+            cache.load(_expand(root, TRANSFER_FILES)),
+            layout_consts=module_constants(layout_mod),
+            d2h_slots=d2h)
+    prefixes = {PASS_PREFIX[p] for p in passes if p in PASS_PREFIX}
+    unused = []
+    for rel in sorted(cache.mods):
+        mod = cache.mods[rel]
+        for line, rule in mod.unused_waivers():
+            if any(rule.startswith(p) for p in prefixes):
+                unused.append((rel, line, rule))
+    return LintReport(
+        findings=sorted(findings, key=lambda f: (f.file, f.line, f.rule)),
+        unused_waivers=unused)
+
+
+def run_passes(root: Path, passes=PASSES) -> list[Finding]:
+    return run_report(root, passes).findings

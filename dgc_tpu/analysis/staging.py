@@ -65,9 +65,16 @@ import ast
 from dataclasses import dataclass, field
 
 from dgc_tpu.analysis.common import Finding, SourceModule
+from dgc_tpu.analysis.common import dotted as _dotted
 
 TRACE_ENTRY_ATTRS = {"while_loop", "scan", "fori_loop", "vmap", "pmap",
-                     "switch", "cond", "shard_map", "pjit"}
+                     "switch", "cond", "shard_map", "pjit",
+                     # Pallas: the kernel body handed to pallas_call is
+                     # traced like any other kernel (Pallas-readiness —
+                     # ROADMAP static-analysis follow-on); pl.program_id
+                     # and friends are jax-module calls, hence device-side
+                     # values, by the existing taint rules
+                     "pallas_call"}
 CALLBACK_ATTRS = {"pure_callback", "io_callback", "callback"}
 STATIC_ANNOTATIONS = {"int", "bool", "str", "float"}
 
@@ -79,18 +86,6 @@ NP_STATIC_ALLOW = {
     "floating", "number", "dtype", "shape", "ndim", "size", "iinfo",
     "finfo", "pi", "inf", "nan", "newaxis",
 }
-
-
-def _dotted(node: ast.AST) -> str | None:
-    """``a.b.c`` attribute chains as a dotted string (None otherwise)."""
-    parts = []
-    while isinstance(node, ast.Attribute):
-        parts.append(node.attr)
-        node = node.value
-    if isinstance(node, ast.Name):
-        parts.append(node.id)
-        return ".".join(reversed(parts))
-    return None
 
 
 @dataclass
@@ -105,6 +100,9 @@ class _Func:
     traced: bool = False
     direct_seed: bool = False          # params are known tracers
     callback_host: bool = False
+    pallas: bool = False               # seeded via pallas_call: Ref
+    #                                    subscript stores are the output
+    #                                    idiom, so KS006 is exempt
     static_argnames: set = field(default_factory=set)
 
     @property
@@ -277,6 +275,8 @@ class StagingAnalysis:
                             if target is not None:
                                 target.traced = True
                                 target.direct_seed = True
+                                if last == "pallas_call":
+                                    target.pallas = True
 
     def _propagate(self) -> None:
         changed = True
@@ -407,7 +407,8 @@ class StagingAnalysis:
 
     def _check_body(self, idx: _ModuleIndex, fn_label: str, nodes,
                     tainted: set, mod: SourceModule,
-                    out: list[Finding]) -> None:
+                    out: list[Finding],
+                    allow_subscript_store: bool = False) -> None:
         np_aliases = self._np_aliases(idx)
         time_aliases = {alias for alias, dotted in idx.imports.items()
                         if dotted == "time"}
@@ -490,7 +491,8 @@ class StagingAnalysis:
                     kw = "if" if isinstance(node, ast.If) else "while"
                     emit("KS005", node,
                          f"python '{kw}' on a traced value")
-            elif isinstance(node, (ast.Assign, ast.AugAssign)):
+            elif isinstance(node, (ast.Assign, ast.AugAssign)) \
+                    and not allow_subscript_store:
                 targets = (node.targets if isinstance(node, ast.Assign)
                            else [node.target])
                 for t in targets:
@@ -509,7 +511,8 @@ class StagingAnalysis:
             idx = self.indexes[fn.mod.rel]
             tainted = self._taint(fn)
             self._check_body(idx, fn.qualname, self._own_nodes(fn),
-                             tainted, fn.mod, out)
+                             tainted, fn.mod, out,
+                             allow_subscript_store=fn.pallas)
         for idx, fn, lam in self.traced_lambdas:
             params = {a.arg for a in lam.args.args}
             label = (f"{fn.qualname}.<lambda>" if fn is not None

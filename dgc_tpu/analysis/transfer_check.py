@@ -1,0 +1,709 @@
+"""Transfer/donation discipline pass over the serve tier (rules TR*).
+
+PR 9's device-resident carry donates buffers back into XLA
+(``donate_argnums``): a donated buffer is dead the moment the call is
+issued, and the ONE rule that kept the heap intact — the buffers fed to
+a donating call must be distinct allocation sites, because XLA CSE
+collapses equal-valued constants into one buffer and donating it twice
+corrupts glibc's heap (PERF.md; ``permute_carry_kernel``'s docstring) —
+lived in comments until this pass. These rules make the discipline
+machine-checked, intra-procedurally, over the serve tier's dataflow:
+
+- **TR001** — a donated argument is *read* after the donating call
+  (including the next iteration of an enclosing loop) without being
+  rebound from the call's result. A donated buffer is garbage the
+  instant the dispatch is issued.
+- **TR002** — two donated (or donation-seeding) argument slots of one
+  call share an allocation site: the same name twice, a ``(x,) * k``
+  repetition, or two syntactically-equal device-constant constructions
+  (``jnp.zeros``/``ones``/``full``/… — exactly what XLA CSE merges into
+  one buffer, the PR 9 heap corruption). Donation-seeding callees whose
+  *outputs* feed a later donated call opt in with a
+  ``# dgc-lint: distinct-buffers`` marker on their ``def`` line
+  (``permute_carry_kernel``).
+- **TR003** — host materialization of the device carry
+  (``np.asarray``/``np.array``/``np.copy``/``jax.device_get``/
+  ``__array__``) in device-carry context, on a slot outside the
+  ``layout.D2H_SLOTS`` whitelist (the scheduling scalars, the timing
+  slot, and the per-lane result span) or on the whole carry. Statements
+  in the ``else`` of a ``device_carry``/``device`` conditional are the
+  host-mirror path and exempt.
+- **TR004** — a *cached* buffer (an attribute such as ``self._dev``)
+  is passed in a donated position and the attribute is never refreshed
+  after the call: the cache now holds a dead buffer for the next
+  invocation.
+- **TR005** — a ``donate_argnums`` configuration that is not gated
+  behind the ``DGC_TPU_DONATE_CARRY`` opt-in with a non-donated
+  fallback twin (the jax-0.4.37 persistent-cache aliasing bug makes
+  unconditional donation a latent abort — ``serve.batched``).
+
+How donating callees are found: a ``jax.jit``/``partial(jax.jit, ...)``
+decoration carrying ``donate_argnums`` (including through a module-level
+decorator alias like ``_donated_slice_jit``) yields the donated
+positions; a function whose name ends in ``_donated`` is donating with
+unknown positions (TR002 then checks every positional argument); the
+``distinct-buffers`` marker adds donation-*seeding* callees. Call sites
+resolve through the file set's imports (``common.SymbolTable`` — the
+same call-graph substrate the staging pass closes over). Pallas bodies
+need no special-casing here: ``pl.program_id`` and friends are
+device-side values, and none of the host-materializer names match them
+— the queued Pallas gather/bitmask kernel lints on arrival.
+
+Scope limits (honest ones): the analysis is intra-procedural — a kernel
+reference laundered through a compile cache (``self._kernels[key]``) is
+not resolved, and the runtime parity ensembles stay the authority
+there. Findings skip ``*args`` splats rather than guessing.
+"""
+
+from __future__ import annotations
+
+import ast
+
+from dgc_tpu.analysis.common import (Finding, SourceModule, SymbolTable,
+                                     dotted, module_imports)
+
+DONATE_GATE = "DGC_TPU_DONATE_CARRY"
+MATERIALIZER_NP = {"asarray", "array", "copy"}
+MATERIALIZER_JAX = {"device_get"}
+DEVICE_CONST_ATTRS = {"zeros", "ones", "full", "arange", "zeros_like",
+                      "ones_like", "full_like", "empty"}
+DEFAULT_CARRY_VARS = ("carry", "out_src")
+DEFAULT_DEVICE_ATTRS = ("device_carry", "device")
+
+
+def _access_key(node: ast.AST) -> str | None:
+    """Stable key for a Name or dotted-attribute access (``pool.carry``
+    → ``"pool.carry"``); None for anything else."""
+    if isinstance(node, ast.Name):
+        return node.id
+    if isinstance(node, ast.Attribute):
+        return dotted(node)
+    return None
+
+
+def _donate_positions(expr: ast.AST) -> tuple | None:
+    """The donated argument positions declared anywhere inside ``expr``
+    (a decorator expression): ``donate_argnums=<tuple|int>`` keyword or
+    a ``{"donate_argnums": ...}`` dict key. None when absent."""
+    for node in ast.walk(expr):
+        if isinstance(node, ast.keyword) and node.arg == "donate_argnums":
+            return _as_positions(node.value)
+        if isinstance(node, ast.Dict):
+            for k, v in zip(node.keys, node.values):
+                if isinstance(k, ast.Constant) \
+                        and k.value == "donate_argnums":
+                    return _as_positions(v)
+    return None
+
+
+def _as_positions(value: ast.AST) -> tuple | None:
+    try:
+        v = ast.literal_eval(value)
+    except (ValueError, SyntaxError):
+        return None
+    if isinstance(v, int):
+        return (v,)
+    if isinstance(v, tuple) and all(isinstance(e, int) for e in v):
+        return v
+    return None
+
+
+class _Donator:
+    """One donating (or donation-seeding) callee."""
+
+    __slots__ = ("name", "positions", "distinct_only")
+
+    def __init__(self, name: str, positions: tuple | None,
+                 distinct_only: bool = False):
+        self.name = name
+        self.positions = positions      # None = unknown → TR002 over all
+        self.distinct_only = distinct_only
+
+
+def _collect_donators(modules: list[SourceModule],
+                      table: SymbolTable) -> dict[tuple, _Donator]:
+    """(module rel, qualname) → _Donator for every donating callee in
+    the file set."""
+    out: dict[tuple, _Donator] = {}
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, (ast.FunctionDef, ast.AsyncFunctionDef)):
+                continue
+            positions = None
+            for dec in node.decorator_list:
+                positions = _donate_positions(dec)
+                if positions is None and isinstance(dec, ast.Name):
+                    resolved = table.resolve(mod, dec)
+                    if resolved is not None \
+                            and isinstance(resolved[1], ast.Assign):
+                        positions = _donate_positions(resolved[1].value)
+                if positions is not None:
+                    break
+            donates = positions is not None \
+                or node.name.endswith("_donated")
+            distinct = mod.marker(node.lineno, "distinct-buffers")
+            if donates or distinct:
+                out[(mod.rel, node.name)] = _Donator(
+                    node.name, positions, distinct_only=not donates)
+    return out
+
+
+# ---------------------------------------------------------------------------
+# TR002: distinct allocation sites per donated slot
+# ---------------------------------------------------------------------------
+
+def _local_assigns(func: ast.AST) -> dict[str, list[ast.AST]]:
+    out: dict[str, list] = {}
+    for node in ast.walk(func):
+        if isinstance(node, ast.Assign):
+            for t in node.targets:
+                if isinstance(t, ast.Name):
+                    out.setdefault(t.id, []).append(node.value)
+    return out
+
+
+def _is_device_const(node: ast.AST, jax_heads: set) -> bool:
+    if not isinstance(node, ast.Call):
+        return False
+    d = dotted(node.func) or ""
+    head, _, attr = d.partition(".")
+    return head in jax_heads and attr.split(".")[-1] in DEVICE_CONST_ATTRS
+
+
+def _slot_descriptors(expr: ast.AST, assigns: dict, jax_heads: set,
+                      _depth: int = 0) -> list:
+    """Allocation-site descriptors for the slots an argument expression
+    contributes: ``("rep", ...)`` for tuple repetition, ``("const",
+    dump)`` for a CSE-able device constant, ``("name", id)`` for a
+    name, and ``("opaque", id(node))`` (never equal) otherwise."""
+    if isinstance(expr, ast.Tuple):
+        out = []
+        for e in expr.elts:
+            out.extend(_slot_descriptors(e, assigns, jax_heads, _depth))
+        return out
+    if isinstance(expr, ast.BinOp) and isinstance(expr.op, ast.Mult):
+        for side in (expr.left, expr.right):
+            if isinstance(side, ast.Tuple) and side.elts:
+                return [("rep",)] * 2       # (x,) * k: guaranteed aliasing
+    if isinstance(expr, ast.Name):
+        targets = assigns.get(expr.id, [])
+        if len(targets) == 1 and _depth < 2:
+            inner = targets[0]
+            if isinstance(inner, (ast.Tuple, ast.BinOp)) \
+                    or _is_device_const(inner, jax_heads):
+                return _slot_descriptors(inner, assigns, jax_heads,
+                                         _depth + 1)
+        return [("name", expr.id)]
+    if _is_device_const(expr, jax_heads):
+        return [("const", ast.dump(expr))]
+    key = _access_key(expr)
+    if key is not None:
+        return [("name", key)]
+    return [("opaque", id(expr))]
+
+
+def _check_tr002(mod: SourceModule, func_label: str, call: ast.Call,
+                 donator: _Donator, assigns: dict, jax_heads: set,
+                 out: list[Finding]) -> None:
+    if any(isinstance(a, ast.Starred) for a in call.args):
+        return                          # splat: positions unresolvable
+    if donator.positions is not None and not donator.distinct_only:
+        checked = [call.args[p] for p in donator.positions
+                   if p < len(call.args)]
+    else:
+        checked = list(call.args)
+    descriptors: list = []
+    for arg in checked:
+        descriptors.extend(_slot_descriptors(arg, assigns, jax_heads))
+    seen: set = set()
+    flagged = False
+    for d in descriptors:
+        if d[0] == "rep":
+            flagged = True
+            break
+        if d[0] in ("name", "const") and d in seen:
+            flagged = True
+            break
+        seen.add(d)
+    if flagged:
+        f = mod.finding(
+            "TR002", call,
+            f"{func_label}: buffers fed to '{donator.name}' share an "
+            f"allocation site (XLA CSE would donate one buffer through "
+            f"two slots — the PR 9 heap corruption)")
+        if f is not None:
+            out.append(f)
+
+
+# ---------------------------------------------------------------------------
+# TR001 / TR004: post-donation reads, stale caches
+# ---------------------------------------------------------------------------
+
+class _DonationScan:
+    """Linear intra-procedural scan of one function body: poisons
+    donated argument keys at each donating call, flags later reads
+    (TR001) and never-refreshed attribute caches (TR004)."""
+
+    def __init__(self, mod: SourceModule, label: str, resolve_call,
+                 out: list[Finding]):
+        self.mod = mod
+        self.label = label
+        self.resolve_call = resolve_call      # Call -> _Donator | None
+        self.out = out
+        self.reported: set = set()
+
+    # -- helpers --------------------------------------------------------
+    def _donating_calls(self, stmt: ast.AST):
+        for node in ast.walk(stmt):
+            if isinstance(node, ast.Call):
+                donator = self.resolve_call(node)
+                if donator is not None and not donator.distinct_only \
+                        and donator.positions is not None:
+                    yield node, donator
+
+    def _donated_keys(self, call: ast.Call, donator: _Donator):
+        if any(isinstance(a, ast.Starred) for a in call.args):
+            return
+        for p in donator.positions:
+            if p < len(call.args):
+                key = _access_key(call.args[p])
+                if key is not None and key != "self":
+                    yield key, call.args[p]
+
+    def _targets_of(self, stmt: ast.AST) -> set:
+        keys: set = set()
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                for n in ast.walk(t):
+                    key = _access_key(n)
+                    if key is not None:
+                        keys.add(key)
+        elif isinstance(stmt, ast.For):
+            for n in ast.walk(stmt.target):
+                key = _access_key(n)
+                if key is not None:
+                    keys.add(key)
+        return keys
+
+    def _reads_of(self, stmt: ast.AST) -> list:
+        """(key, node) for every Name/dotted-Attribute read in the
+        statement, excluding assignment-target occurrences."""
+        skip: set = set()
+
+        def _skip_target(t: ast.AST) -> None:
+            # store contexts are rebinds, not reads — but a subscript
+            # store's *base* is still read (kept out of skip)
+            if isinstance(t, (ast.Name, ast.Attribute)):
+                skip.add(id(t))
+            elif isinstance(t, (ast.Tuple, ast.List, ast.Starred)):
+                for sub in ast.iter_child_nodes(t):
+                    _skip_target(sub)
+
+        if isinstance(stmt, (ast.Assign, ast.AugAssign, ast.AnnAssign)):
+            targets = (stmt.targets if isinstance(stmt, ast.Assign)
+                       else [stmt.target])
+            for t in targets:
+                _skip_target(t)
+        reads = []
+        covered: set = set()
+        for node in ast.walk(stmt):
+            if id(node) in skip or id(node) in covered:
+                continue
+            if isinstance(node, ast.Attribute):
+                key = dotted(node)
+                if key is not None:
+                    for sub in ast.walk(node):
+                        covered.add(id(sub))
+                    reads.append((key, node))
+            elif isinstance(node, ast.Name):
+                reads.append((node.id, node))
+        return reads
+
+    def _flag_read(self, key: str, node: ast.AST, info: dict) -> None:
+        fp = (key, node.lineno)
+        if fp in self.reported:
+            return
+        self.reported.add(fp)
+        f = self.mod.finding(
+            "TR001", node,
+            f"{self.label}: '{key}' read after being donated to "
+            f"'{info[key]}' (a donated buffer is dead once the call "
+            f"is issued)")
+        if f is not None:
+            self.out.append(f)
+
+    # -- the scan -------------------------------------------------------
+    def scan_block(self, stmts, poisoned: dict) -> dict:
+        """``poisoned`` maps access key → donating callee name; returns
+        the poison state after the block."""
+        for stmt in stmts:
+            # reads against the poison state BEFORE this statement — a
+            # donating call's own arguments are the donation, not a
+            # post-donation read. A dotted read whose PREFIX is poisoned
+            # (`carry.sum()` after `carry` was donated) counts.
+            if poisoned:
+                for key, node in self._reads_of(stmt):
+                    hit = key if key in poisoned else next(
+                        (p for p in poisoned
+                         if key.startswith(p + ".")), None)
+                    if hit is not None:
+                        self._flag_read(hit, node, poisoned)
+            if isinstance(stmt, ast.If):
+                p_body = self.scan_block(stmt.body, dict(poisoned))
+                p_else = self.scan_block(stmt.orelse, dict(poisoned))
+                poisoned = {**p_body, **p_else}
+                continue
+            if isinstance(stmt, (ast.For, ast.While)):
+                p_after = self.scan_block(stmt.body, dict(poisoned))
+                fresh = {k: v for k, v in p_after.items()
+                         if k not in poisoned}
+                if fresh:
+                    # loop-carried donation: keys donated in the body
+                    # and still poisoned at its end are read by the next
+                    # iteration's statements
+                    self.scan_block(stmt.body, dict(fresh))
+                poisoned = self.scan_block(stmt.orelse, p_after)
+                continue
+            if isinstance(stmt, (ast.With, ast.Try)):
+                for block in ([stmt.body]
+                              + ([h.body for h in stmt.handlers]
+                                 if isinstance(stmt, ast.Try) else [])
+                              + ([stmt.orelse, stmt.finalbody]
+                                 if isinstance(stmt, ast.Try) else [])):
+                    poisoned = self.scan_block(block, poisoned)
+                continue
+            # donations in this statement
+            for call, donator in self._donating_calls(stmt):
+                for key, _arg in self._donated_keys(call, donator):
+                    poisoned[key] = donator.name
+            # rebinds clear poison (the donated name now holds the
+            # call's result, or a fresh value)
+            for key in self._targets_of(stmt):
+                poisoned.pop(key, None)
+        return poisoned
+
+    def run(self, func: ast.AST) -> None:
+        body = func.body if hasattr(func, "body") else []
+        final = self.scan_block(list(body), {})
+        for key, fname in sorted(final.items()):
+            if "." in key:              # attribute cache never refreshed
+                f = self.mod.finding(
+                    "TR004", getattr(func, "lineno", 1),
+                    f"{self.label}: cached buffer '{key}' donated to "
+                    f"'{fname}' and never refreshed — the cache holds a "
+                    f"dead buffer for the next call")
+                if f is not None:
+                    self.out.append(f)
+
+
+# ---------------------------------------------------------------------------
+# TR003: device-carry host materialization outside the whitelist
+# ---------------------------------------------------------------------------
+
+def _const_eval(node: ast.AST, consts: dict) -> int | None:
+    if isinstance(node, ast.Constant) and isinstance(node.value, int) \
+            and not isinstance(node.value, bool):
+        return node.value
+    if isinstance(node, ast.Name):
+        return consts.get(node.id)
+    if isinstance(node, ast.BinOp) and isinstance(node.op, (ast.Add,
+                                                            ast.Sub)):
+        lo = _const_eval(node.left, consts)
+        hi = _const_eval(node.right, consts)
+        if lo is None or hi is None:
+            return None
+        return lo + hi if isinstance(node.op, ast.Add) else lo - hi
+    return None
+
+
+class _MaterializeScan:
+    """Per-function TR003 scan with device-branch sensitivity."""
+
+    def __init__(self, mod: SourceModule, label: str, consts: dict,
+                 d2h_slots: set, carry_vars: tuple, device_attrs: tuple,
+                 np_heads: set, jax_heads: set, out: list[Finding]):
+        self.mod = mod
+        self.label = label
+        self.consts = dict(consts)
+        self.d2h = set(d2h_slots)
+        self.carry_vars = carry_vars
+        self.device_attrs = device_attrs
+        self.np_heads = np_heads
+        self.jax_heads = jax_heads
+        self.out = out
+        # loop-variable domains: `for j in range(A, B)` with resolvable
+        # bounds lets `carry[j]` check the whole span
+        self.ranges: dict[str, tuple] = {}
+        # names bound by iterating the carry (whole-buffer aliases)
+        self.elem_aliases: set = set()
+
+    def _is_carry(self, node: ast.AST) -> bool:
+        if isinstance(node, ast.Name):
+            return node.id in self.carry_vars
+        if isinstance(node, ast.Attribute):
+            return node.attr in self.carry_vars
+        return False
+
+    def _is_device_test(self, test: ast.AST) -> bool:
+        for n in ast.walk(test):
+            if isinstance(n, ast.Attribute) and n.attr in self.device_attrs:
+                return True
+            if isinstance(n, ast.Name) and n.id in self.device_attrs:
+                return True
+        return False
+
+    def _bind_iter(self, target: ast.AST, it: ast.AST) -> None:
+        if self._is_carry(it) and isinstance(target, ast.Name):
+            self.elem_aliases.add(target.id)
+        if (isinstance(it, ast.Call) and isinstance(it.func, ast.Name)
+                and it.func.id == "range" and isinstance(target, ast.Name)):
+            args = it.args
+            lo = 0 if len(args) == 1 else _const_eval(args[0], self.consts)
+            hi = _const_eval(args[-1] if len(args) > 1 else args[0],
+                             self.consts)
+            if lo is not None and hi is not None:
+                self.ranges[target.id] = (lo, hi)
+
+    def _materializes(self, call: ast.Call) -> bool:
+        d = dotted(call.func) or ""
+        head, _, rest = d.partition(".")
+        attr = rest.split(".")[-1]
+        if head in self.np_heads and attr in MATERIALIZER_NP:
+            return True
+        if head in self.jax_heads and attr in MATERIALIZER_JAX:
+            return True
+        return isinstance(call.func, ast.Attribute) \
+            and call.func.attr == "__array__"
+
+    def _slot_of(self, node: ast.AST):
+        """(carry_base, slot_index_node) when ``node`` subscripts the
+        carry (possibly through chained subscripts); None otherwise."""
+        inner = node
+        idx = None
+        while isinstance(inner, ast.Subscript):
+            idx = inner.slice
+            inner = inner.value
+        if idx is not None and self._is_carry(inner):
+            return inner, idx
+        return None
+
+    def _check_call(self, call: ast.Call) -> None:
+        if not self._materializes(call) or not call.args:
+            return
+        arg = call.args[0]
+        slot = self._slot_of(arg)
+        if slot is None:
+            whole = self._is_carry(arg) or (
+                isinstance(arg, ast.Name) and arg.id in self.elem_aliases)
+            if whole:
+                f = self.mod.finding(
+                    "TR003", call,
+                    f"{self.label}: whole-carry host materialization in "
+                    f"device-carry context (the transfer contract allows "
+                    f"only the layout.D2H_SLOTS scalars)")
+                if f is not None:
+                    self.out.append(f)
+            return
+        _base, idx = slot
+        v = _const_eval(idx, self.consts)
+        bad: list = []
+        if v is not None:
+            if v not in self.d2h:
+                bad = [v]
+        elif isinstance(idx, ast.Name) and idx.id in self.ranges:
+            lo, hi = self.ranges[idx.id]
+            bad = [s for s in range(lo, hi) if s not in self.d2h]
+        else:
+            return                     # dynamic slot: never guessed
+        if bad:
+            f = self.mod.finding(
+                "TR003", call,
+                f"{self.label}: device-carry slot {bad[0]} materialized "
+                f"on host but not whitelisted in layout.D2H_SLOTS")
+            if f is not None:
+                self.out.append(f)
+
+    def scan(self, stmts, device: bool) -> None:
+        for stmt in stmts:
+            if isinstance(stmt, ast.If) and self._is_device_test(stmt.test):
+                self.scan(stmt.body, device)
+                self.scan(stmt.orelse, False)     # host-mirror path
+                continue
+            if isinstance(stmt, ast.If):
+                if device:
+                    for n in ast.walk(stmt.test):
+                        if isinstance(n, ast.Call):
+                            self._check_call(n)
+                self.scan(stmt.body, device)
+                self.scan(stmt.orelse, device)
+                continue
+            if isinstance(stmt, ast.For):
+                self._bind_iter(stmt.target, stmt.iter)
+                self.scan(stmt.body, device)
+                self.scan(stmt.orelse, device)
+                continue
+            if isinstance(stmt, (ast.While, ast.With, ast.Try)):
+                blocks = [getattr(stmt, "body", [])]
+                if isinstance(stmt, ast.Try):
+                    blocks += [h.body for h in stmt.handlers]
+                    blocks += [stmt.orelse, stmt.finalbody]
+                else:
+                    blocks += [getattr(stmt, "orelse", [])]
+                for b in blocks:
+                    self.scan(b, device)
+                continue
+            if not device:
+                continue
+            for node in ast.walk(stmt):
+                if isinstance(node, (ast.GeneratorExp, ast.ListComp,
+                                     ast.SetComp)):
+                    for gen in node.generators:
+                        self._bind_iter(gen.target, gen.iter)
+                elif isinstance(node, ast.Call):
+                    self._check_call(node)
+
+
+# ---------------------------------------------------------------------------
+# TR005: donation gated behind DGC_TPU_DONATE_CARRY
+# ---------------------------------------------------------------------------
+
+def _gate_names(mod: SourceModule) -> set:
+    gates: set = set()
+    for node in mod.tree.body:
+        if isinstance(node, ast.Assign):
+            if any(isinstance(n, ast.Constant) and n.value == DONATE_GATE
+                   for n in ast.walk(node.value)):
+                for t in node.targets:
+                    if isinstance(t, ast.Name):
+                        gates.add(t.id)
+    return gates
+
+
+def _mentions_gate(test: ast.AST, gates: set) -> bool:
+    for n in ast.walk(test):
+        if isinstance(n, ast.Name) and n.id in gates:
+            return True
+        if isinstance(n, ast.Constant) and n.value == DONATE_GATE:
+            return True
+    return False
+
+
+def _check_tr005(mod: SourceModule, out: list[Finding]) -> None:
+    gates = _gate_names(mod)
+    parents: dict[int, ast.AST] = {}
+    for node in ast.walk(mod.tree):
+        for child in ast.iter_child_nodes(node):
+            parents[id(child)] = node
+    for node in ast.walk(mod.tree):
+        is_donate = (isinstance(node, ast.keyword)
+                     and node.arg == "donate_argnums") or (
+            isinstance(node, ast.Constant)
+            and node.value == "donate_argnums")
+        if not is_donate:
+            continue
+        gated = False
+        twin = True
+        cur = node
+        while id(cur) in parents:
+            parent = parents[id(cur)]
+            if isinstance(parent, (ast.IfExp, ast.If)) \
+                    and _mentions_gate(parent.test, gates):
+                gated = True
+                if isinstance(parent, ast.IfExp):
+                    other = (parent.orelse if cur is not parent.orelse
+                             else parent.body)
+                    twin = not any(
+                        isinstance(n, ast.Constant)
+                        and n.value == "donate_argnums"
+                        or isinstance(n, ast.keyword)
+                        and n.arg == "donate_argnums"
+                        for n in ast.walk(other))
+                break
+            cur = parent
+        if not gated:
+            f = mod.finding(
+                "TR005", getattr(node, "lineno",
+                                 getattr(node.value, "lineno", 1)
+                                 if isinstance(node, ast.keyword) else 1),
+                f"donate_argnums not gated behind {DONATE_GATE} "
+                f"(unconditional donation; the persistent-cache aliasing "
+                f"bug makes this a latent heap corruption)")
+            if f is not None:
+                out.append(f)
+        elif not twin:
+            f = mod.finding(
+                "TR005", getattr(node, "lineno",
+                                 getattr(node.value, "lineno", 1)
+                                 if isinstance(node, ast.keyword) else 1),
+                f"{DONATE_GATE}-gated donation has no non-donated "
+                f"fallback twin (both branches donate)")
+            if f is not None:
+                out.append(f)
+
+
+# ---------------------------------------------------------------------------
+# the pass
+# ---------------------------------------------------------------------------
+
+def check_transfer(modules: list[SourceModule], *,
+                   layout_consts: dict | None = None,
+                   d2h_slots=None,
+                   carry_vars: tuple = DEFAULT_CARRY_VARS,
+                   device_attrs: tuple = DEFAULT_DEVICE_ATTRS
+                   ) -> list[Finding]:
+    """Run the transfer/donation pass over one coherent file set.
+    ``layout_consts`` are the layout module's integer constants (slot
+    names resolvable at subscripts); ``d2h_slots`` the TR003 whitelist
+    (``layout.D2H_SLOTS``)."""
+    layout_consts = dict(layout_consts or {})
+    d2h = set(d2h_slots if d2h_slots is not None else ())
+    table = SymbolTable(modules)
+    donators = _collect_donators(modules, table)
+    out: list[Finding] = []
+
+    for mod in modules:
+        imports = module_imports(mod)
+        np_heads = {a for a, d in imports.items() if d == "numpy"}
+        jax_heads = {a for a, d in imports.items()
+                     if d == "jax" or d.startswith("jax.")}
+
+        def resolve_call(call: ast.Call, mod=mod):
+            name = None
+            if isinstance(call.func, ast.Name):
+                name = call.func.id
+            elif isinstance(call.func, ast.Attribute):
+                name = call.func.attr
+            resolved = table.resolve(mod, call.func)
+            if resolved is not None and hasattr(resolved[1], "name"):
+                d = donators.get((resolved[0].rel, resolved[1].name))
+                if d is not None:
+                    return d
+            if name is not None and name.endswith("_donated"):
+                return _Donator(name, None)
+            if name is not None:
+                local = donators.get((mod.rel, name))
+                if local is not None:
+                    return local
+            return None
+
+        funcs = [(n, n.name) for n in ast.walk(mod.tree)
+                 if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
+        for func, label in funcs:
+            assigns = _local_assigns(func)
+            # TR002 at every donating call site
+            for node in ast.walk(func):
+                if isinstance(node, ast.Call):
+                    donator = resolve_call(node)
+                    if donator is not None:
+                        _check_tr002(mod, label, node, donator, assigns,
+                                     jax_heads, out)
+            # TR001/TR004 linear scan
+            _DonationScan(mod, label, resolve_call, out).run(func)
+            # TR003 materialization scan
+            _MaterializeScan(mod, label, layout_consts, d2h, carry_vars,
+                             device_attrs, np_heads, jax_heads,
+                             out).scan(func.body, True)
+        _check_tr005(mod, out)
+    return sorted(out, key=lambda f: (f.file, f.line, f.rule))

@@ -62,6 +62,17 @@ CARRY_LEN = 19
 OUT0 = 6               # first result slot (== CARRY_P1)
 N_OUT = 7              # result slots p1..st2
 
+# --device-carry host-read whitelist (dgc-lint transfer pass, TR003):
+# the ONLY carry slots the dispatcher may materialize on the host per
+# slice — the phase/rung/nc scheduling scalars + the timing slot — plus
+# the per-lane result span [OUT0, OUT0+N_OUT) that ``lane_outputs``
+# reads at delivery. Any other slot crossing device→host in
+# device-carry mode defeats the transfer contract (PERF.md "Staged
+# serve sweeps + device-resident carry"). Plain literals (the checker
+# reads this file statically): CARRY_PHASE, T_US, CARRY_RUNG, CARRY_NC,
+# then CARRY_P1..CARRY_ST2.
+D2H_SLOTS = (0, 13, 15, 16, 6, 7, 8, 9, 10, 11, 12)
+
 # -- sharded flat-pipeline carry (engine/sharded.py `_flat_pipeline`) -----
 #
 # (packed_l, step, status, prev_active, stall,   -- live sweep state

@@ -14,9 +14,12 @@ exposition format is a few lines of text.
 from __future__ import annotations
 
 import math
+import os
 import re
 import threading
 from dataclasses import dataclass, field
+
+_LOCK_ASSERTS = os.environ.get("DGC_TPU_LOCK_ASSERTS") == "1"
 
 _NAME_RE = re.compile(r"^[a-zA-Z_:][a-zA-Z0-9_:]*$")
 
@@ -149,6 +152,14 @@ class MetricsRegistry:
     def _get(self, cls, kind: str, name: str, help: str, labels: dict, **kw):
         if not _NAME_RE.match(name):
             raise ValueError(f"invalid metric name: {name!r}")
+        # DGC_TPU_LOCK_ASSERTS=1 (tests): metric instances enforce their
+        # guarded-by annotations at runtime — an unlocked read/write of
+        # value/counts/total/n raises instead of racing silently
+        # (dgc_tpu.analysis.lockassert; identity when the flag is off)
+        if _LOCK_ASSERTS:
+            from dgc_tpu.analysis.lockassert import maybe_checked
+
+            cls = maybe_checked(cls)
         with self._lock:
             prior = self._meta.get(name)
             if prior is not None and prior[0] != kind:

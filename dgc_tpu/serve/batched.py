@@ -602,7 +602,7 @@ def seat_lane_kernel(comb, degrees, k0, max_steps, reset, lane,
 
 
 @jax.jit
-def permute_carry_kernel(carry, base, src, dst):
+def permute_carry_kernel(carry, base, src, dst):  # dgc-lint: distinct-buffers
     """On-device carry compaction for a pool resize (device-resident
     carry mode): move the kept lanes' carry rows ``src`` of the old
     carry into rows ``dst`` of the idle ``base`` carry — no host

@@ -288,28 +288,32 @@ def serve_main(argv: list[str] | None = None) -> int:
     front.shutdown(drain=True)
     wall = time.perf_counter() - t0
 
-    done = front.stats["completed"]
+    # locked snapshots (dgc-lint LK004): the bare front.stats /
+    # scheduler.stats reads raced the worker/dispatcher counters
+    st = front.stats_snapshot()
+    sst = front.scheduler.stats_snapshot()
+    done = st["completed"]
     summary_kw = {}
     latency = front.latency_summary()
     if latency is not None:
         summary_kw["latency_ms"] = latency
-    if front.scheduler.stats.get("recals"):
-        summary_kw["recals"] = front.scheduler.stats["recals"]
+    if sst.get("recals"):
+        summary_kw["recals"] = sst["recals"]
     logger.event("serve_summary", requests=len(requests), completed=done,
-                 failed=front.stats["failed"],
-                 rejected=front.stats["rejected"],
+                 failed=st["failed"],
+                 rejected=st["rejected"],
                  wall_s=round(wall, 4),
                  graphs_per_s=round(done / wall, 3) if wall > 0 else None,
-                 batches=front.scheduler.stats["batches"],
-                 slices=front.scheduler.stats["slices"],
-                 recycles=front.scheduler.stats["recycles"],
+                 batches=sst["batches"],
+                 slices=sst["slices"],
+                 recycles=sst["recycles"],
                  mode=front.scheduler.mode,
                  warmup_s=warmup["seconds"] if warmup else None,
                  warmed_kernels=warmup["kernels"] if warmup else None,
-                 compile_misses=front.scheduler.stats["compile_misses"],
-                 compile_hits=front.scheduler.stats["compile_hits"],
-                 h2d_mb=round(front.scheduler.stats["h2d_bytes"] / 1e6, 3),
-                 d2h_mb=round(front.scheduler.stats["d2h_bytes"] / 1e6, 3),
+                 compile_misses=sst["compile_misses"],
+                 compile_hits=sst["compile_hits"],
+                 h2d_mb=round(sst["h2d_bytes"] / 1e6, 3),
+                 d2h_mb=round(sst["d2h_bytes"] / 1e6, 3),
                  **summary_kw)
     if metrics_server is not None:
         metrics_server.close()

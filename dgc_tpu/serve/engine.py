@@ -585,6 +585,14 @@ class BatchScheduler:
             self.stats["h2d_bytes"] = 0
             self.stats["d2h_bytes"] = 0
 
+    def stats_snapshot(self) -> dict:
+        """Locked copy of the live counters — the only safe way to read
+        ``stats`` from outside the dispatcher thread (a bare
+        ``dict(sched.stats)`` races the dispatcher's post-delivery
+        bookkeeping; found by dgc-lint LK004)."""
+        with self._lock:
+            return dict(self.stats)
+
     # -- stage-ladder resolution ----------------------------------------
     def stages_for(self, cls):
         """The staged-frontier-ladder schedule this scheduler compiles

@@ -35,6 +35,7 @@ import numpy as np
 from dgc_tpu.engine.minimal_k import (find_minimal_coloring, make_reducer,
                                       make_validator)
 from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.obs.metrics import MetricsRegistry
 from dgc_tpu.obs.trace import NULL_TRACER, tracer_for
 from dgc_tpu.resilience.supervisor import RungState, supervise_sweep
 from dgc_tpu.serve.engine import BatchMemberEngine, BatchScheduler, ServeError
@@ -144,7 +145,7 @@ class ServeFrontEnd:
                  auto_tune: bool = False, tuned_cache=None,
                  retries: int = 0,
                  fallback_factories=None,
-                 logger=None, registry=None,
+                 logger=None, registry: MetricsRegistry | None = None,
                  rung_state: RungState | None = None):
         if queue_depth < 1:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
@@ -363,15 +364,29 @@ class ServeFrontEnd:
             return None
         out = {}
         for h in self.registry.histograms("dgc_serve_service_seconds"):
-            if h.n == 0:
+            # n read once under the pointee's lock (dgc-lint LK004: the
+            # bare `h.n` reads raced worker observe()s — the count could
+            # change between the emptiness check and the summary line);
+            # quantile() takes the same lock internally, so it must run
+            # OUTSIDE this with-block
+            with h._lock:
+                n = h.n
+            if n == 0:
                 continue
             out[h.labels.get("shape_class", "?")] = {
                 "p50": round(h.quantile(0.50) * 1e3, 3),
                 "p95": round(h.quantile(0.95) * 1e3, 3),
                 "p99": round(h.quantile(0.99) * 1e3, 3),
-                "count": h.n,
+                "count": n,
             }
         return out or None
+
+    def stats_snapshot(self) -> dict:
+        """Locked copy of the request counters — the safe read for
+        summaries and harnesses (dgc-lint LK004: bare ``front.stats``
+        reads race the worker threads' counter updates)."""
+        with self._lock:
+            return dict(self.stats)
 
     # -- health/readiness -----------------------------------------------
     def health(self, emit: bool = False) -> dict:

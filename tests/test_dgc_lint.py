@@ -731,6 +731,10 @@ def test_ruff_and_mypy_config_present():
     ruff = cfg["tool"]["ruff"]
     assert "F" in ruff["lint"]["select"]
     assert "E9" in ruff["lint"]["select"]
+    # the dgc-lint v2 ratchet: flake8-bugbear on, with the two named
+    # noisy members deliberately ignored (B007/B905)
+    assert "B" in ruff["lint"]["select"]
+    assert "B007" in ruff["lint"]["ignore"]
     mypy = cfg["tool"]["mypy"]
     assert mypy["ignore_missing_imports"] is True
 

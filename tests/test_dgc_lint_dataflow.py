@@ -1,0 +1,816 @@
+"""dgc-lint v2 (whole-program dataflow): transfer/donation rules TR*,
+the cross-object points-to lock rule LK004, the DGC_TPU_LOCK_ASSERTS
+runtime hook, the --fix autofixer, and the baseline/waiver hygiene.
+
+Every TR rule gets a positive and a negative fixture; the acceptance
+mutations re-introduce the PR 9 CSE'd-equal-constant donation aliasing
+(TR002) and a seeded post-donation read (TR001) against the REAL tree;
+the points-to pass runs against the real ``obs/metrics.py`` exporter
+loop both clean (discharge) and with the latency-summary fix stripped
+(fires).
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import shutil
+import subprocess
+import sys
+import threading
+from pathlib import Path
+
+import pytest
+
+from dgc_tpu.analysis.common import (SourceModule, module_constants,
+                                     module_tuple_constants)
+from dgc_tpu.analysis.locks import check_locks
+from dgc_tpu.analysis.staging import check_staging
+from dgc_tpu.analysis.transfer_check import check_transfer
+
+ROOT = Path(__file__).resolve().parent.parent
+
+
+def rules_of(findings):
+    return {f.rule for f in findings}
+
+
+def _layout():
+    layout = SourceModule.load(ROOT, "dgc_tpu/layout.py")
+    return (module_constants(layout),
+            module_tuple_constants(layout)["D2H_SLOTS"])
+
+
+def _transfer(mods, consts=None, d2h=()):
+    return check_transfer(mods, layout_consts=consts or {}, d2h_slots=d2h)
+
+
+# the fixture gates its donation exactly like serve.batched does, so
+# the TR001/TR004 fixtures don't also trip the TR005 gate rule
+DONATED_FIXTURE_HEADER = '''
+import os
+import jax
+import jax.numpy as jnp
+from functools import partial
+
+_DONATE = os.environ.get("DGC_TPU_DONATE_CARRY") == "1"
+
+@partial(jax.jit, **({"donate_argnums": (0,)} if _DONATE else {}))
+def step_donated(carry, x):
+    return carry + x
+'''
+
+
+# ---------------------------------------------------------------------------
+# TR001: post-donation reads
+# ---------------------------------------------------------------------------
+
+def test_tr001_read_after_donation_fires():
+    src = DONATED_FIXTURE_HEADER + '''
+def drive(carry, x):
+    out = step_donated(carry, x)
+    return carry.sum() + out          # TR001: carry is dead
+'''
+    got = _transfer([SourceModule("fix/t.py", src)])
+    assert rules_of(got) == {"TR001"}
+    assert "carry" in got[0].detail
+
+
+def test_tr001_rebind_from_result_is_clean():
+    src = DONATED_FIXTURE_HEADER + '''
+def drive(carry, xs):
+    for x in xs:
+        carry = step_donated(carry, x)    # rebound every iteration
+    return carry
+'''
+    assert _transfer([SourceModule("fix/t.py", src)]) == []
+
+
+def test_tr001_loop_without_rebind_fires():
+    src = DONATED_FIXTURE_HEADER + '''
+def drive(carry, xs):
+    acc = []
+    for x in xs:
+        acc.append(step_donated(carry, x))   # TR001 on iteration 2
+    return acc
+'''
+    got = _transfer([SourceModule("fix/t.py", src)])
+    assert "TR001" in rules_of(got)
+
+
+def test_tr001_branch_merge_keeps_poison():
+    src = DONATED_FIXTURE_HEADER + '''
+def drive(carry, x, flag: bool):
+    if flag:
+        out = step_donated(carry, x)
+    else:
+        out = carry + 1
+    return carry + out                # TR001: poisoned on one path
+'''
+    got = _transfer([SourceModule("fix/t.py", src)])
+    assert "TR001" in rules_of(got)
+
+
+# ---------------------------------------------------------------------------
+# TR002: distinct allocation sites
+# ---------------------------------------------------------------------------
+
+def test_tr002_repeated_name_fires():
+    src = DONATED_FIXTURE_HEADER + '''
+@partial(jax.jit, donate_argnums=(0, 1))
+def pair_donated(a, b):
+    return a + b
+
+def drive(z):
+    return pair_donated(z, z)         # TR002: same buffer twice
+'''
+    got = _transfer([SourceModule("fix/t.py", src)])
+    assert "TR002" in rules_of(got)
+
+
+def test_tr002_tuple_repetition_and_equal_constants_fire():
+    src = '''
+import jax
+import jax.numpy as jnp
+
+def permute_kernel(carry, base, src, dst):  # dgc-lint: distinct-buffers
+    return tuple(b.at[dst].set(a[src]) for a, b in zip(carry, base))
+
+def resize_rep(old, src, dst, n):
+    zeros = jnp.zeros((4,), jnp.int32)
+    base = (zeros,) * n
+    return permute_kernel(old, base, src, dst)     # TR002: repetition
+
+def resize_cse(old, src, dst):
+    base = (jnp.zeros((4,), jnp.int32), jnp.zeros((4,), jnp.int32))
+    return permute_kernel(old, base, src, dst)     # TR002: CSE-equal
+'''
+    got = _transfer([SourceModule("fix/t.py", src)])
+    assert [f.rule for f in got] == ["TR002", "TR002"]
+
+
+def test_tr002_distinct_device_puts_are_clean():
+    src = '''
+import jax
+import numpy as np
+
+def permute_kernel(carry, base, src, dst):  # dgc-lint: distinct-buffers
+    return tuple(b.at[dst].set(a[src]) for a, b in zip(carry, base))
+
+def resize(old, idle, src, dst):
+    base = tuple(jax.device_put(a) for a in idle)   # distinct buffers
+    return permute_kernel(old, base, src, dst)
+'''
+    assert _transfer([SourceModule("fix/t.py", src)]) == []
+
+
+# ---------------------------------------------------------------------------
+# TR003: device-carry host materialization
+# ---------------------------------------------------------------------------
+
+TR3_CONSTS = {"GOOD": 0, "BAD": 2}
+TR3_D2H = (0,)
+
+
+def test_tr003_whitelisted_slot_is_clean_bad_slot_fires():
+    src = '''
+import numpy as np
+
+def service(self, kernel, carry):
+    if self.device_carry:
+        phase = np.asarray(carry[GOOD])       # whitelisted
+        extra = np.asarray(carry[BAD])        # TR003
+    return carry
+'''
+    got = _transfer([SourceModule("fix/t.py", src)], TR3_CONSTS, TR3_D2H)
+    assert [f.rule for f in got] == ["TR003"]
+    assert "slot 2" in got[0].detail
+
+
+def test_tr003_host_mirror_else_branch_is_exempt():
+    src = '''
+import numpy as np
+
+def service(self, carry):
+    if self.device_carry:
+        phase = np.asarray(carry[GOOD])
+    else:
+        out = tuple(np.asarray(a) for a in carry)   # host path: exempt
+    return carry
+'''
+    assert _transfer([SourceModule("fix/t.py", src)],
+                     TR3_CONSTS, TR3_D2H) == []
+
+
+def test_tr003_whole_carry_materialization_fires():
+    src = '''
+import numpy as np
+
+def service(self, carry):
+    if self.device_carry:
+        out = tuple(np.asarray(a) for a in carry)   # TR003: whole carry
+    return carry
+'''
+    got = _transfer([SourceModule("fix/t.py", src)], TR3_CONSTS, TR3_D2H)
+    assert [f.rule for f in got] == ["TR003"]
+    assert "whole-carry" in got[0].detail
+
+
+def test_tr003_static_range_span_checked():
+    consts = {"OUT0": 1, "N_OUT": 2}
+    src = '''
+import numpy as np
+
+def lane_outputs(carry, lane):
+    return tuple(np.asarray(carry[j][lane])
+                 for j in range(OUT0, OUT0 + N_OUT))
+'''
+    # span {1, 2} fully whitelisted: clean
+    assert _transfer([SourceModule("fix/t.py", src)], consts,
+                     (1, 2)) == []
+    # slot 2 missing from the whitelist: fires
+    got = _transfer([SourceModule("fix/t.py", src)], consts, (1,))
+    assert [f.rule for f in got] == ["TR003"]
+
+
+# ---------------------------------------------------------------------------
+# TR004: stale donated caches
+# ---------------------------------------------------------------------------
+
+def test_tr004_unrefreshed_attribute_cache_fires():
+    src = DONATED_FIXTURE_HEADER + '''
+def seat(self, x):
+    out = step_donated(self._dev, x)   # TR004: self._dev never refreshed
+    return out
+'''
+    got = _transfer([SourceModule("fix/t.py", src)])
+    assert rules_of(got) == {"TR004"}
+    assert "self._dev" in got[0].detail
+
+
+def test_tr004_refreshed_attribute_cache_is_clean():
+    src = DONATED_FIXTURE_HEADER + '''
+def seat(self, x):
+    out = step_donated(self._dev, x)
+    self._dev = out                    # refreshed from the result
+    return out
+'''
+    assert _transfer([SourceModule("fix/t.py", src)]) == []
+
+
+# ---------------------------------------------------------------------------
+# TR005: the DGC_TPU_DONATE_CARRY gate
+# ---------------------------------------------------------------------------
+
+def test_tr005_ungated_donation_fires():
+    src = '''
+import jax
+from functools import partial
+
+_jit = partial(jax.jit, donate_argnums=(0,))    # TR005: ungated
+'''
+    got = _transfer([SourceModule("fix/t.py", src)])
+    assert rules_of(got) == {"TR005"}
+
+
+def test_tr005_gated_with_fallback_twin_is_clean():
+    src = '''
+import os
+import jax
+from functools import partial
+
+_DONATE = os.environ.get("DGC_TPU_DONATE_CARRY") == "1"
+_jit = partial(jax.jit, **({"donate_argnums": (0,)} if _DONATE else {}))
+'''
+    assert _transfer([SourceModule("fix/t.py", src)]) == []
+
+
+def test_tr005_both_branches_donating_fires():
+    src = '''
+import os
+import jax
+from functools import partial
+
+_DONATE = os.environ.get("DGC_TPU_DONATE_CARRY") == "1"
+_jit = partial(jax.jit, **({"donate_argnums": (0,)} if _DONATE
+                           else {"donate_argnums": (0, 1)}))
+'''
+    got = _transfer([SourceModule("fix/t.py", src)])
+    assert rules_of(got) == {"TR005"}
+    assert "fallback twin" in got[0].detail
+
+
+# ---------------------------------------------------------------------------
+# the real tree + the acceptance mutations
+# ---------------------------------------------------------------------------
+
+def _real_transfer(engine_text=None):
+    consts, d2h = _layout()
+    mods = [SourceModule.load(ROOT, "dgc_tpu/serve/batched.py")]
+    if engine_text is None:
+        mods.append(SourceModule.load(ROOT, "dgc_tpu/serve/engine.py"))
+    else:
+        mods.append(SourceModule("dgc_tpu/serve/engine.py", engine_text))
+    return check_transfer(mods, layout_consts=consts, d2h_slots=d2h)
+
+
+def test_transfer_real_serve_tier_is_clean():
+    assert _real_transfer() == []
+
+
+def test_tr002_mutation_pr9_cse_aliasing_is_caught():
+    """Acceptance: re-introduce the PR 9 heap corruption — a shared
+    ``jnp.zeros`` constant fed through every slot of the permute base —
+    and TR002 must catch it."""
+    real = (ROOT / "dgc_tpu/serve/engine.py").read_text()
+    mut = real.replace(
+        "            base = tuple(jax.device_put(a) for a in carry)",
+        "            zeros = jnp.zeros((b_pad,), jnp.int32)\n"
+        "            base = (zeros,) * CARRY_LEN")
+    assert mut != real, "mutation anchor out of sync with engine.py"
+    got = [f for f in _real_transfer(mut) if f.rule == "TR002"]
+    assert len(got) == 1
+    assert "permute_carry_kernel" in got[0].detail
+
+
+def test_tr001_mutation_post_donation_read_is_caught():
+    """Acceptance: break the seat loop's rebinding so the donated input
+    stacks are re-read on the next iteration — TR001 must catch it."""
+    real = (ROOT / "dgc_tpu/serve/engine.py").read_text()
+    mut = real.replace(
+        "                comb, degrees, k0, max_steps, reset = "
+        "seat_lane_kernel(",
+        "                out = seat_lane_kernel(")
+    assert mut != real, "mutation anchor out of sync with engine.py"
+    got = [f for f in _real_transfer(mut) if f.rule == "TR001"]
+    assert got, "seeded post-donation read not caught"
+    assert any("seat_lane_kernel" in f.detail for f in got)
+
+
+def test_tr003_mutation_unwhitelisted_slot_is_caught():
+    real = (ROOT / "dgc_tpu/serve/engine.py").read_text()
+    mut = real.replace(
+        "        nc = np.asarray(carry[CARRY_NC])",
+        "        nc = np.asarray(carry[CARRY_NC])\n"
+        "        pk = np.asarray(carry[CARRY_PACKED])")
+    assert mut != real
+    got = [f for f in _real_transfer(mut) if f.rule == "TR003"]
+    assert got and "slot 2" in got[0].detail
+
+
+def test_tr005_mutation_ungated_donation_is_caught():
+    real = (ROOT / "dgc_tpu/serve/batched.py").read_text()
+    mut = real.replace(
+        '    **({"donate_argnums": (5,)} if _DONATE_CARRY else {}))',
+        '    donate_argnums=(5,))')
+    assert mut != real
+    consts, d2h = _layout()
+    mods = [SourceModule("dgc_tpu/serve/batched.py", mut),
+            SourceModule.load(ROOT, "dgc_tpu/serve/engine.py")]
+    got = [f for f in check_transfer(mods, layout_consts=consts,
+                                     d2h_slots=d2h)
+           if f.rule == "TR005"]
+    assert got
+
+
+# ---------------------------------------------------------------------------
+# Pallas readiness (staging pass)
+# ---------------------------------------------------------------------------
+
+def test_staging_pallas_kernel_body_is_traced():
+    src = '''
+import time
+import jax
+from jax.experimental import pallas as pl
+
+def gather_kernel(x_ref, o_ref):
+    i = pl.program_id(0)               # device-side: clean
+    t = time.time()                    # KS001: host clock under trace
+    o_ref[...] = x_ref[...]
+
+def run(x):
+    return pl.pallas_call(gather_kernel, out_shape=x)(x)
+'''
+    got = check_staging([SourceModule("fix/p.py", src)])
+    assert rules_of(got) == {"KS001"}
+
+
+# ---------------------------------------------------------------------------
+# points-to pass (LK004)
+# ---------------------------------------------------------------------------
+
+PT_FIXTURE = '''
+import threading
+
+class Metric:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0               # guarded-by: _lock
+
+class Registry:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._metrics = {}       # guarded-by: _lock
+
+    def get(self, name):
+        with self._lock:
+            if name not in self._metrics:
+                self._metrics[name] = Metric()
+            return self._metrics[name]
+
+    def export(self):
+        with self._lock:
+            items = sorted(self._metrics.items())
+        out = []
+        for k, m in items:
+            %s
+        return out
+'''
+
+
+def test_pointsto_unlocked_pointee_access_fires():
+    src = PT_FIXTURE % "out.append((k, m.n))           # LK004"
+    got = [f for f in check_locks([SourceModule("fix/pt.py", src)])
+           if f.rule == "LK004"]
+    assert len(got) == 1
+    assert "m.n" in got[0].detail and "_lock" in got[0].detail
+
+
+def test_pointsto_locked_pointee_access_discharges():
+    src = PT_FIXTURE % ("with m._lock:\n"
+                        "                out.append((k, m.n))")
+    assert [f for f in check_locks([SourceModule("fix/pt.py", src)])
+            if f.rule == "LK004"] == []
+
+
+def test_pointsto_annotated_parameter_seeds_classes():
+    src = '''
+import threading
+
+class Metric:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.n = 0               # guarded-by: _lock
+
+class Reader:
+    def __init__(self, metric: Metric):
+        self.metric = metric
+
+    def peek(self):
+        return self.metric.n     # LK004 via the annotation
+'''
+    got = [f for f in check_locks([SourceModule("fix/pt.py", src)])
+           if f.rule == "LK004"]
+    assert len(got) == 1
+
+
+def test_pointsto_real_metrics_exporters_discharge():
+    """The real registry exporters (`with m._lock:` over the snapshot
+    loop) and the fixed latency summary must be clean — the ROADMAP
+    cross-object follow-on, closed."""
+    from dgc_tpu.analysis.run import LOCK_FILES
+
+    mods = [SourceModule.load(ROOT, rel) for rel in LOCK_FILES]
+    assert [f for f in check_locks(mods) if f.rule == "LK004"] == []
+
+
+def test_pointsto_seeded_unlocked_histogram_read_fires():
+    """Strip the latency-summary lock fix back to its pre-fix form: the
+    unlocked ``h.n`` reads raced worker observe()s (the real finding
+    this PR fixed)."""
+    rel = "dgc_tpu/serve/queue.py"
+    real = (ROOT / rel).read_text()
+    broken = real.replace("""            with h._lock:
+                n = h.n
+            if n == 0:
+                continue""", """            if h.n == 0:
+                continue""").replace('"count": n,', '"count": h.n,')
+    assert broken != real, "fixture out of sync with queue.py"
+    mods = [SourceModule.load(ROOT, "dgc_tpu/obs/metrics.py"),
+            SourceModule(rel, broken)]
+    got = [f for f in check_locks(mods) if f.rule == "LK004"]
+    assert len(got) == 2
+    assert all("h.n" in f.detail for f in got)
+
+
+def test_pointsto_seeded_unlocked_scheduler_stats_fires():
+    """Strip the bench.py stats-snapshot fix: a bare dict(stats) read
+    races the dispatcher (the second real finding this PR fixed)."""
+    rel = "bench.py"
+    real = (ROOT / rel).read_text()
+    broken = real.replace("sched_stats = fe.scheduler.stats_snapshot()",
+                          "sched_stats = dict(fe.scheduler.stats)")
+    assert broken != real, "fixture out of sync with bench.py"
+    mods = [SourceModule.load(ROOT, "dgc_tpu/serve/queue.py"),
+            SourceModule.load(ROOT, "dgc_tpu/serve/engine.py"),
+            SourceModule(rel, broken)]
+    got = [f for f in check_locks(mods) if f.rule == "LK004"]
+    assert any("fe.scheduler.stats" in f.detail for f in got)
+
+
+# ---------------------------------------------------------------------------
+# runtime lock asserts (DGC_TPU_LOCK_ASSERTS)
+# ---------------------------------------------------------------------------
+
+def test_lock_asserts_catch_seeded_unlocked_write():
+    from dgc_tpu.analysis.lockassert import (LockAssertionError,
+                                             lock_checked)
+    from dgc_tpu.obs.metrics import Counter
+
+    C = lock_checked(Counter)
+    c = C(name="x", help="h")
+    c.inc(1.0)                        # locked path: fine
+    with c._lock:
+        assert c.value == 1.0         # locked read: fine
+    with pytest.raises(LockAssertionError):
+        c.value = 5.0                 # seeded unlocked write
+    with pytest.raises(LockAssertionError):
+        _ = c.value                   # unlocked read
+    assert lock_checked(C) is C       # idempotent
+
+
+def test_lock_asserts_internally_locked_paths_pass():
+    from dgc_tpu.analysis.lockassert import lock_checked
+    from dgc_tpu.obs.metrics import Histogram
+
+    H = lock_checked(Histogram)
+    h = H(name="x", help="h")
+    h.observe(0.01)
+    h.observe(0.2)
+    assert h.quantile(0.5) is not None
+
+
+def test_lock_asserts_registry_path_via_env(tmp_path):
+    """DGC_TPU_LOCK_ASSERTS=1 makes MetricsRegistry-made metrics
+    enforce; exporters (which hold each metric's lock) still work."""
+    code = (
+        "from dgc_tpu.obs.metrics import MetricsRegistry\n"
+        "from dgc_tpu.analysis.lockassert import LockAssertionError\n"
+        "reg = MetricsRegistry()\n"
+        "c = reg.counter('dgc_t_total', 'h')\n"
+        "c.inc()\n"
+        "assert 'dgc_t_total 1' in reg.to_prometheus()\n"
+        "try:\n"
+        "    c.value += 1\n"
+        "    raise SystemExit('unlocked write passed')\n"
+        "except LockAssertionError:\n"
+        "    print('OK')\n")
+    r = subprocess.run([sys.executable, "-c", code],
+                       capture_output=True, text=True, cwd=ROOT,
+                       env={"PATH": "/usr/bin:/bin",
+                            "DGC_TPU_LOCK_ASSERTS": "1",
+                            "PYTHONPATH": str(ROOT)},
+                       timeout=120)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "OK" in r.stdout
+
+
+def test_lock_asserts_off_is_identity():
+    from dgc_tpu.analysis.lockassert import maybe_checked
+    from dgc_tpu.obs.metrics import Counter
+
+    assert maybe_checked(Counter) is Counter
+
+
+# ---------------------------------------------------------------------------
+# --fix: autofixer
+# ---------------------------------------------------------------------------
+
+def _copy_tree(tmp_path) -> Path:
+    root = tmp_path / "repo"
+    for rel in ("dgc_tpu", "tools", "tests"):
+        shutil.copytree(ROOT / rel, root / rel,
+                        ignore=shutil.ignore_patterns("__pycache__"))
+    (root / "bench.py").write_text((ROOT / "bench.py").read_text())
+    return root
+
+
+def _run_lint(root, *args):
+    return subprocess.run(
+        [sys.executable, str(root / "tools" / "dgc_lint.py"),
+         "--root", str(root), *args],
+        capture_output=True, text=True, cwd=ROOT, timeout=300)
+
+
+def test_fix_lifecycle_guard_insertion_and_named_slot(tmp_path):
+    """Seed a stripped guarded-by annotation and a bare carry index;
+    --fix --check exits 1, --fix applies both, the second --fix is a
+    no-op (idempotence), and --strict is clean again."""
+    root = _copy_tree(tmp_path)
+    q = root / "dgc_tpu/serve/queue.py"
+    src = q.read_text()
+    broken = src.replace(
+        '                      "rejected": 0, "fallbacks": 0}   '
+        '# guarded-by: _lock',
+        '                      "rejected": 0, "fallbacks": 0}')
+    assert broken != src, "guard anchor out of sync with queue.py"
+    q.write_text(broken)
+    e = root / "dgc_tpu/serve/engine.py"
+    src = e.read_text()
+    broken = src.replace("nc = np.asarray(carry[CARRY_NC])",
+                         "nc = np.asarray(carry[16])")
+    assert broken != src, "slot anchor out of sync with engine.py"
+    e.write_text(broken)
+
+    r = _run_lint(root, "--fix", "--check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "guarded-by" in r.stdout and "named-slot" in r.stdout
+
+    r = _run_lint(root, "--fix")
+    assert r.returncode == 0
+    assert "applied 2 fix(es)" in r.stdout
+    assert "carry[CARRY_NC]" in (root / "dgc_tpu/serve/engine.py"
+                                 ).read_text()
+    assert "# guarded-by: _lock" in (root / "dgc_tpu/serve/queue.py"
+                                     ).read_text()
+
+    r = _run_lint(root, "--fix", "--check")     # idempotent
+    assert r.returncode == 0
+    assert "0 fix(es) pending" in r.stdout
+    r = _run_lint(root, "--strict")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
+def test_fix_never_guesses_ambiguous_lock(tmp_path):
+    """An attribute accessed under TWO different locks (or once without
+    any) plans no guarded-by fix."""
+    from dgc_tpu.analysis.fixer import plan_fixes
+
+    root = tmp_path / "r"
+    (root / "tools").mkdir(parents=True)
+    (root / "dgc_tpu").mkdir()
+    (root / "m.py").write_text('''
+import threading
+
+class Box:
+    def __init__(self):
+        self._a = threading.Lock()
+        self._b = threading.Lock()
+        self.items = []
+    def one(self):
+        with self._a:
+            self.items.append(1)
+    def two(self):
+        with self._b:
+            self.items.append(2)
+
+class Leaky:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.cache = {}
+    def put(self, k, v):
+        self.cache[k] = v            # unlocked access: no evidence
+''')
+    (root / "layout.py").write_text("LEN = 1\n")
+    fixes = plan_fixes(root, ("m.py",), ("layout.py",), specs=())
+    assert fixes == []
+
+
+def test_fix_check_requires_fix_flag():
+    r = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "dgc_lint.py"), "--check"],
+        capture_output=True, text=True, cwd=ROOT, timeout=120)
+    assert r.returncode == 2
+    assert "--check requires --fix" in r.stderr
+
+
+def test_fix_clean_tree_is_noop():
+    r = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "dgc_lint.py"),
+         "--fix", "--check"],
+        capture_output=True, text=True, cwd=ROOT, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "0 fix(es) pending" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# baseline hygiene + waivers
+# ---------------------------------------------------------------------------
+
+def test_write_baseline_prunes_stale_entries(tmp_path):
+    """Seed a violation, accept it, fix it, re-write: the stale entry
+    is pruned and reported."""
+    root = _copy_tree(tmp_path)
+    target = root / "dgc_tpu" / "serve" / "queue.py"
+    src = target.read_text()
+    broken = src.replace(
+        "        with self._lock:\n"
+        "            self.stats[\"fallbacks\"] += 1",
+        "        self.stats[\"fallbacks\"] += 1")
+    assert broken != src
+    target.write_text(broken)
+    r = _run_lint(root, "--write-baseline")
+    assert r.returncode == 0
+    base = json.loads((root / "tools/dgc_lint_baseline.json").read_text())
+    assert len(base) >= 1
+    # fix the violation: the accepted entry goes stale
+    target.write_text(src)
+    r = _run_lint(root)
+    assert "stale baseline entry" in r.stderr
+    r = _run_lint(root, "--write-baseline")
+    assert "pruned" in r.stdout
+    base = json.loads((root / "tools/dgc_lint_baseline.json").read_text())
+    assert base == []
+
+
+def test_waived_finding_never_enters_baseline(tmp_path):
+    """baseline×waiver round-trip: a waived violation produces no
+    finding, so --write-baseline writes nothing for it and --strict
+    stays green on the waiver alone."""
+    root = _copy_tree(tmp_path)
+    target = root / "dgc_tpu" / "serve" / "queue.py"
+    src = target.read_text()
+    broken = src.replace(
+        "        with self._lock:\n"
+        "            self.stats[\"fallbacks\"] += 1",
+        "        self.stats[\"fallbacks\"] += 1  # dgc-lint: ok LK001")
+    assert broken != src
+    target.write_text(broken)
+    r = _run_lint(root, "--strict")
+    assert r.returncode == 0, r.stdout + r.stderr
+    r = _run_lint(root, "--write-baseline")
+    base = json.loads((root / "tools/dgc_lint_baseline.json").read_text())
+    assert all(e["rule"] != "LK001" for e in base)
+
+
+def test_dead_waiver_warns(tmp_path):
+    """A waiver that suppresses nothing is reported — dead waivers rot
+    exactly like stale baseline entries."""
+    root = _copy_tree(tmp_path)
+    target = root / "dgc_tpu" / "serve" / "queue.py"
+    src = target.read_text()
+    marked = src.replace(
+        "        self.ladder = ladder",
+        "        self.ladder = ladder  # dgc-lint: ok LK001")
+    assert marked != src
+    target.write_text(marked)
+    r = _run_lint(root)
+    assert r.returncode == 0
+    assert "matches no finding" in r.stderr
+    assert "LK001" in r.stderr
+
+
+def test_unknown_pass_rejected_and_transfer_selectable():
+    r = subprocess.run(
+        [sys.executable, str(ROOT / "tools" / "dgc_lint.py"),
+         "--passes", "transfer", "--strict"],
+        capture_output=True, text=True, cwd=ROOT, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    assert "1 pass(es)" in r.stdout
+
+
+# ---------------------------------------------------------------------------
+# regression tests for the real findings fixed in this PR
+# ---------------------------------------------------------------------------
+
+@pytest.mark.serve
+def test_latency_summary_consistent_under_concurrent_observes():
+    """queue.py's latency summary read h.n unlocked pre-fix; hammered
+    observes must never desync the emptiness check from the count."""
+    from dgc_tpu.obs.metrics import MetricsRegistry
+    from dgc_tpu.serve.queue import ServeFrontEnd
+
+    front = ServeFrontEnd.__new__(ServeFrontEnd)
+    front.registry = MetricsRegistry()
+    h = front.registry.histogram("dgc_serve_service_seconds",
+                                 shape_class="t")
+    stop = threading.Event()
+
+    def hammer():
+        while not stop.is_set():
+            h.observe(0.01)
+
+    threads = [threading.Thread(target=hammer) for _ in range(4)]
+    for t in threads:
+        t.start()
+    try:
+        for _ in range(200):
+            out = front.latency_summary()
+            if out is not None:
+                assert out["t"]["count"] >= 1
+    finally:
+        stop.set()
+        for t in threads:
+            t.join()
+    assert front.latency_summary()["t"]["count"] == h.n
+
+
+@pytest.mark.serve
+def test_scheduler_stats_snapshot_is_locked_copy():
+    from dgc_tpu.serve.engine import BatchScheduler
+
+    sched = BatchScheduler(batch_max=2, mode="sync")
+    snap = sched.stats_snapshot()
+    assert snap == sched.stats and snap is not sched.stats
+    snap["batches"] = 99
+    assert sched.stats["batches"] == 0
+
+
+@pytest.mark.serve
+def test_front_end_stats_snapshot_is_locked_copy():
+    from dgc_tpu.serve.queue import ServeFrontEnd
+
+    front = ServeFrontEnd(batch_max=2, queue_depth=4, workers=1,
+                          validate=False, post_reduce=False)
+    snap = front.stats_snapshot()
+    assert snap == front.stats and snap is not front.stats

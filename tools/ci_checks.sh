@@ -1,0 +1,28 @@
+#!/usr/bin/env bash
+# The repo's lint job, one entrypoint for every gate (pre-commit,
+# evidence_suite.sh gate 0, CI):
+#   1. dgc-lint --strict           — the five static passes vs the baseline
+#   2. dgc-lint --fix --check      — no mechanical fix may be pending
+#   3. ruff check (if installed)   — the generic layer (pyproject config)
+# Fast (AST only, no kernels compiled) — seconds, not minutes.
+set -u
+cd "$(dirname "$0")/.."
+rc=0
+
+echo "=== dgc_lint --strict ===" >&2
+python tools/dgc_lint.py --strict || rc=1
+
+echo "=== dgc_lint --fix --check ===" >&2
+python tools/dgc_lint.py --fix --check || {
+  echo "ci_checks: mechanical fixes pending — run 'python tools/dgc_lint.py --fix'" >&2
+  rc=1
+}
+
+if command -v ruff >/dev/null 2>&1; then
+  echo "=== ruff check ===" >&2
+  ruff check dgc_tpu tools bench.py || rc=1
+else
+  echo "ci_checks: ruff not installed — skipping (config in pyproject.toml)" >&2
+fi
+
+exit $rc

@@ -1,8 +1,9 @@
 #!/usr/bin/env python
 """dgc-lint: the repo's static-analysis gate (``dgc_tpu.analysis``).
 
-Runs the four AST passes (kernel staging KS*, carry/layout LY*, event
-schema SC*, lock discipline LK*) over the package and compares the
+Runs the five passes (kernel staging KS*, carry/layout LY*, event
+schema SC*, lock discipline LK* incl. the cross-object points-to rule,
+transfer/donation discipline TR*) over the package and compares the
 findings against the committed baseline of accepted exceptions.
 
 Usage:
@@ -10,15 +11,26 @@ Usage:
   python tools/dgc_lint.py --strict        # exit 1 on any non-baselined
   python tools/dgc_lint.py --passes locks  # one pass only
   python tools/dgc_lint.py --write-baseline  # accept current findings
+  python tools/dgc_lint.py --fix           # apply mechanical fixes
+  python tools/dgc_lint.py --fix --check   # CI: exit 1 iff a fix pends
 
-Exit codes: 0 clean (or all findings baselined), 1 non-baselined
-findings under ``--strict``, 2 usage/load error.
+Exit codes: 0 clean (or all findings baselined / no fixes pending),
+1 non-baselined findings under ``--strict`` or pending fixes under
+``--fix --check``, 2 usage/load error.
 
 The baseline (``tools/dgc_lint_baseline.json``) keys findings by
 ``(rule, file, detail)`` — no line numbers, so unrelated edits never
-churn it. A stale baseline entry (accepted finding that no longer
-fires) is reported so the baseline shrinks monotonically; under
-``--strict`` staleness is a warning, not a failure.
+churn it. Stale baseline entries (accepted findings that no longer
+fire) are reported on every run and PRUNED by ``--write-baseline``
+(the written file holds exactly the current findings). Per-line
+waivers (``# dgc-lint: ok RULE``) that suppress nothing are warned
+about — dead waivers rot like stale baseline entries.
+
+``--fix`` applies the two mechanically-derivable fixes (``dgc_tpu
+.analysis.fixer``): inserting ``# guarded-by:`` annotations where
+every access already holds one consistent lock, and rewriting bare
+integer carry indices to ``dgc_tpu.layout`` named slots. Both are
+idempotent and line-local; ``--fix --check`` plans without writing.
 """
 
 from __future__ import annotations
@@ -30,7 +42,8 @@ from pathlib import Path
 
 sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
 
-from dgc_tpu.analysis import (PASSES, load_baseline, run_passes,  # noqa: E402
+from dgc_tpu.analysis import (LAYOUT_FILES, LOCK_FILES,  # noqa: E402
+                              PASSES, load_baseline, run_report,
                               split_baseline, write_baseline)
 
 
@@ -46,7 +59,14 @@ def main(argv=None) -> int:
     ap.add_argument("--strict", action="store_true",
                     help="exit 1 on any non-baselined finding")
     ap.add_argument("--write-baseline", action="store_true",
-                    help="accept all current findings into the baseline")
+                    help="accept all current findings into the baseline "
+                         "(prunes stale entries)")
+    ap.add_argument("--fix", action="store_true",
+                    help="apply mechanical fixes (guarded-by insertion, "
+                         "named-slot rewrites)")
+    ap.add_argument("--check", action="store_true",
+                    help="with --fix: plan only, exit 1 iff any fix "
+                         "would be applied")
     args = ap.parse_args(argv)
 
     root = Path(args.root) if args.root else \
@@ -54,6 +74,9 @@ def main(argv=None) -> int:
     if not (root / "dgc_tpu").is_dir():
         print(f"dgc_lint: no dgc_tpu package under {root}",
               file=sys.stderr)
+        return 2
+    if args.check and not args.fix:
+        print("dgc_lint: --check requires --fix", file=sys.stderr)
         return 2
     passes = tuple(p.strip() for p in args.passes.split(",") if p.strip())
     unknown = [p for p in passes if p not in PASSES]
@@ -64,16 +87,44 @@ def main(argv=None) -> int:
     baseline_path = Path(args.baseline) if args.baseline else \
         root / "tools" / "dgc_lint_baseline.json"
 
+    if args.fix:
+        from dgc_tpu.analysis.fixer import apply_fixes, plan_fixes
+
+        try:
+            fixes = plan_fixes(root, LOCK_FILES, LAYOUT_FILES)
+        except (OSError, SyntaxError) as e:
+            print(f"dgc_lint: cannot plan fixes: {e}", file=sys.stderr)
+            return 2
+        for fix in fixes:
+            print(fix)
+        if args.check:
+            print(f"dgc_lint: {len(fixes)} fix(es) pending")
+            return 1 if fixes else 0
+        applied = apply_fixes(root, fixes)
+        print(f"dgc_lint: applied {applied} fix(es)")
+        return 0
+
     try:
-        findings = run_passes(root, passes)
+        report = run_report(root, passes)
     except (OSError, SyntaxError) as e:
         print(f"dgc_lint: cannot analyze: {e}", file=sys.stderr)
         return 2
+    findings = report.findings
+    for rel, line, rule in report.unused_waivers:
+        print(f"dgc_lint: waiver '{rule}' at {rel}:{line} matches no "
+              f"finding (dead waiver — remove it)", file=sys.stderr)
 
     if args.write_baseline:
+        try:
+            old = load_baseline(baseline_path)
+        except (OSError, ValueError, KeyError):
+            old = set()
+        keys = {f.key() for f in findings}
+        pruned = len(old - keys)
         write_baseline(baseline_path, findings)
-        print(f"dgc_lint: wrote {len(findings)} accepted finding(s) to "
-              f"{baseline_path}")
+        print(f"dgc_lint: wrote {len(keys)} accepted finding(s) to "
+              f"{baseline_path}"
+              + (f" (pruned {pruned} stale)" if pruned else ""))
         return 0
 
     try:
@@ -90,7 +141,8 @@ def main(argv=None) -> int:
         print(f"dgc_lint: {len(accepted)} baselined finding(s) suppressed")
     for rule, file, detail in stale:
         print(f"dgc_lint: stale baseline entry {rule} {file}: {detail} "
-              f"(no longer fires — remove it)", file=sys.stderr)
+              f"(no longer fires — remove it or --write-baseline)",
+              file=sys.stderr)
     npass = len(passes)
     print(f"dgc_lint: {npass} pass(es), {len(findings)} finding(s), "
           f"{len(new)} new")

@@ -16,11 +16,14 @@ OUT="${1:-PERF_RUNS.jsonl}"
 
 # gate 0 — static analysis: the structural invariants every evidence run
 # leans on (kernel staging, carry/traj layout, event schema, lock
-# discipline) must hold BEFORE burning device time. Same gate as the
-# pre-commit hook and the tier-1 test (tests/test_dgc_lint.py).
-echo "=== dgc_lint --strict ===" >&2
-if ! python tools/dgc_lint.py --strict; then
-  echo "evidence_suite: dgc_lint --strict failed — fix or baseline before capturing evidence" >&2
+# discipline incl. the cross-object points-to rule, transfer/donation
+# discipline) must hold BEFORE burning device time. One entrypoint
+# (tools/ci_checks.sh) shared with the pre-commit hook and the tier-1
+# test (tests/test_dgc_lint.py): dgc-lint --strict, --fix --check, and
+# ruff where installed.
+echo "=== lint gate (tools/ci_checks.sh) ===" >&2
+if ! bash tools/ci_checks.sh; then
+  echo "evidence_suite: lint gate failed — fix, apply --fix, or baseline before capturing evidence" >&2
   exit 3
 fi
 

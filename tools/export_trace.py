@@ -40,10 +40,11 @@ def read_spans(path: str) -> list[dict]:
             continue
         try:
             rec = json.loads(line)
-        except json.JSONDecodeError:
+        except json.JSONDecodeError as e:
             if torn_tail and i == len(lines) - 1:
                 continue
-            raise ValueError(f"{path}:{i + 1}: unparseable JSON line")
+            raise ValueError(
+                f"{path}:{i + 1}: unparseable JSON line") from e
         if isinstance(rec, dict) and rec.get("event") == "span":
             spans.append(rec)
     return spans
