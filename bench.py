@@ -34,7 +34,8 @@ from dgc_tpu.utils.watchdog import (env_float as _env_float,  # noqa: E402
                                     guarded_device_init, start_watchdog)
 
 
-def _bench_abort_record(metric: str, phases: dict = None, context: dict = None):
+def _bench_abort_record(metric: str, phases: dict = None, context: dict = None,
+                        recorder=None, flightrec_dir: str = "."):
     """on_abort callback that emits the null JSON record, so a missing
     measurement can never masquerade as one (bench_suite.sh filters the
     null record out of its jsonl). The watchdog exits ABORT_RC after it.
@@ -42,12 +43,20 @@ def _bench_abort_record(metric: str, phases: dict = None, context: dict = None):
     ``phases``/``context`` are live references the main flow keeps
     updating: everything measured before the abort (graph gen, engine
     build, partial warmup) and the probed backend/platform land in the
-    abort record instead of being lost with the process."""
+    abort record instead of being lost with the process. ``recorder``
+    (obs.flightrec) additionally lands the event tail on disk — the
+    rc-113 leg of the abort-capture contract."""
 
     def _abort(diag: str) -> None:
         # one clearly-labeled failure line; rc!=0 (ABORT_RC) so callers
         # can tell a backend-loss abort apart from an ordinary bug
         print(f"# BENCH ABORTED: {diag}", file=sys.stderr)
+        if recorder is not None:
+            try:
+                path = recorder.dump(flightrec_dir, reason="watchdog_abort")
+                print(f"# flight recorder dumped to {path}", file=sys.stderr)
+            except OSError as e:   # diagnostics never mask the abort
+                print(f"# flight recorder dump failed: {e}", file=sys.stderr)
         record = {"metric": metric,
                   "value": None, "unit": "s", "vs_baseline": 0.0,
                   "error": diag}
@@ -60,7 +69,24 @@ def _bench_abort_record(metric: str, phases: dict = None, context: dict = None):
     return _abort
 
 
-def _serve_throughput(args, phases: dict, context: dict) -> int:
+def _perf_db_check(args, record: dict) -> dict | None:
+    """``--perf-db``: append the measured record to the perf-history
+    ledger and return the regression verdict (None when the flag is
+    off). The verdict rides IN the printed record (``perf_db`` slot) and
+    flips the exit code — the ``slo_check``-style tripwire, but against
+    the key's own measured history instead of static thresholds."""
+    if not args.perf_db:
+        return None
+    from tools.perf_db import record_and_check, render_verdict
+
+    verdict = record_and_check(args.perf_db, record,
+                               threshold=args.perf_db_threshold)
+    print(f"# {render_verdict(verdict)}", file=sys.stderr)
+    return verdict
+
+
+def _serve_throughput(args, phases: dict, context: dict,
+                      recorder=None) -> int:
     """``--serve-throughput``: graphs/s of the batched serving path vs
     sequential single-graph sweeps of the SAME graphs — the serving
     regime's metric (request cost = engine build + per-graph compile +
@@ -95,6 +121,18 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
     from dgc_tpu.serve.queue import ServeFrontEnd
     from dgc_tpu.serve.shape_classes import DEFAULT_LADDER
 
+    # flight-recorder wiring (obs.flightrec): a quiet event stream feeds
+    # the ring so an rc-113 abort mid-measurement dumps the serve tier's
+    # final events; spans stay off — bench never traced, and the
+    # recorder's measured overhead (PERF.md "Flight recorder overhead")
+    # is the event+ring cost, the same thing a production loop pays
+    serve_logger = None
+    if recorder is not None:
+        from dgc_tpu.obs import RunLogger
+
+        serve_logger = RunLogger(jsonl_path=None, echo=False)
+        serve_logger.add_sink(recorder)
+
     gen = (generate_rmat_graph if args.gen == "rmat"
            else generate_random_graph_fast)
     batch_sizes = sorted({int(b) for b in
@@ -126,6 +164,11 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
     phases["gen_s"] = time.perf_counter() - t0
     cls = DEFAULT_LADDER.class_for(graphs[0].num_vertices,
                                    max(g.max_degree for g in graphs))
+    # the perf ledger's shape key (tools/perf_db.py): identical shapes
+    # across rounds compare; a changed generator/degree mix does not
+    from dgc_tpu.tune.config import graph_shape_hash
+
+    context["graph_shape_hash"] = graph_shape_hash(graphs[0])
     print(f"# serve-throughput: {n} graphs V={graphs[0].num_vertices} "
           f"class={cls.name if cls else 'FALLBACK'} modes={modes}",
           file=sys.stderr)
@@ -157,7 +200,8 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
                                device_carry=cfg["device_carry"],
                                slice_steps=slice_steps,
                                window_s=args.serve_window_ms / 1e3,
-                               queue_depth=max(64, 2 * n)).start()
+                               queue_depth=max(64, 2 * n),
+                               logger=serve_logger, trace=False).start()
             key = (f"{'' if mode == modes[0] else mode + '_'}b{b}"
                    .replace("+", "_"))
             try:
@@ -232,7 +276,7 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
     # the bench run, it does not just lower a number in a JSON line
     slo = None
     if args.slo_thresholds:
-        from tools.slo_check import check_bench_record
+        from tools.slo_check import ViolationHooks, check_bench_record
 
         thresholds = json.loads(open(args.slo_thresholds).read())
         record_head = {"value": batches[b_head],
@@ -242,8 +286,17 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
                "thresholds": args.slo_thresholds}
         for v in violations:
             print(f"# SLO VIOLATION: {v}", file=sys.stderr)
+        if violations and recorder is not None:
+            # SLO-violation capture (PR 11): the event tail that led up
+            # to the violation lands beside the violation itself
+            fired = ViolationHooks(
+                recorder=recorder, dump_dir=args.flightrec_dir,
+                logger=serve_logger).fire(violations)
+            if fired.get("dump"):
+                print(f"# flight recorder dumped to {fired['dump']}",
+                      file=sys.stderr)
 
-    print(json.dumps({
+    record = {
         "metric": f"serve_throughput_{args.nodes}v_avgdeg"
                   f"{args.avg_degree:g}"
                   f"{'_rmat' if args.gen == 'rmat' else ''}"
@@ -266,8 +319,15 @@ def _serve_throughput(args, phases: dict, context: dict) -> int:
         "phases": {k: round(v, 4) for k, v in phases.items()},
         "backend": "serve",
         "platform": context["platform"],
-    }))
+        "graph_shape_hash": context.get("graph_shape_hash"),
+    }
+    perf = _perf_db_check(args, record)
+    if perf is not None:
+        record["perf_db"] = perf
+    print(json.dumps(record))
     if slo is not None and not slo["pass"]:
+        return 1
+    if perf is not None and perf.get("regression"):
         return 1
     return 0 if parity_ok else 1
 
@@ -353,6 +413,27 @@ def main() -> int:
                         "graphs_per_s_min / speedup_vs_sequential_min "
                         "apply) — violations exit nonzero, the "
                         "perf-regression tripwire")
+    # perf-history ledger (tools/perf_db.py): append this run's record
+    # and gate it against the key's own measured history — the
+    # regression tripwire that needs no hand-written thresholds
+    p.add_argument("--perf-db", type=str, default=None, metavar="JSONL",
+                   help="append the measured record to this perf-history "
+                        "ledger and exit nonzero when it regresses past "
+                        "the key's median baseline (tools/perf_db.py)")
+    p.add_argument("--perf-db-threshold", type=float, default=0.10,
+                   help="perf-db regression threshold as a fraction "
+                        "(default 0.10 = 10%% worse than median)")
+    # flight recorder (dgc_tpu.obs.flightrec): serve-mode event tail +
+    # rc-113 abort dumps; --no-flight-recorder is the overhead A/B arm
+    # (PERF.md 'Flight recorder overhead')
+    p.add_argument("--no-flight-recorder", action="store_true",
+                   help="disable the always-on flight-recorder ring "
+                        "(the overhead-measurement A/B arm)")
+    p.add_argument("--flightrec-dir", type=str,
+                   default=os.environ.get("DGC_TPU_FLIGHTREC_DIR", "."),
+                   help="directory abort-path flight-recorder dumps "
+                        "land in (default: $DGC_TPU_FLIGHTREC_DIR or "
+                        "the current directory)")
     args = p.parse_args()
     if args.nodes is None:
         args.nodes = 20_000 if args.serve_throughput else 1_000_000
@@ -385,24 +466,36 @@ def main() -> int:
         _faults.install(_faults.FaultPlane(
             _faults.FaultSchedule.parse(args.inject_faults), hard_kill=True))
 
+    # flight recorder: armed before the watchdogs so an rc-113 abort at
+    # ANY later point can land the event tail (serve mode feeds it a
+    # quiet event stream; the ring is empty but the metrics trailer
+    # still lands for the sweep mode, which has no event stream)
+    recorder = None
+    if not args.no_flight_recorder:
+        from dgc_tpu.obs import FlightRecorder
+
+        recorder = FlightRecorder()
+
     # armed immediately before the first device touch (imports above are
     # off the clock, so a slow cold import can't eat the init budget)
     dev = guarded_device_init(
         args.probe_timeout, what="device init",
         on_abort=_bench_abort_record(f"{mode}_aborted_backend_unreachable",
-                                     phases, context),
+                                     phases, context, recorder,
+                                     args.flightrec_dir),
     )[0]
     context["platform"] = dev.platform
     context["probed"] = True
     if args.run_timeout > 0:
         start_watchdog(args.run_timeout, "run after device init",
                        on_abort=_bench_abort_record(
-                           f"{mode}_aborted_run_deadline", phases, context))
+                           f"{mode}_aborted_run_deadline", phases, context,
+                           recorder, args.flightrec_dir))
     print(f"# device: {dev.device_kind} ({dev.platform}) x{jax.local_device_count()}",
           file=sys.stderr)
 
     if args.serve_throughput:
-        return _serve_throughput(args, phases, context)
+        return _serve_throughput(args, phases, context, recorder=recorder)
 
     t0 = time.perf_counter()
     if args.gen == "rmat":
@@ -518,7 +611,8 @@ def main() -> int:
 
     phases["validate_s"] = t_validate
     phases["reduce_s"] = t_reduce
-    print(json.dumps({
+    from dgc_tpu.tune.config import graph_shape_hash
+    record = {
         "metric": f"wall_clock_minimal_k_sweep_{args.nodes}v_avgdeg{args.avg_degree:g}"
                   f"{'_rmat' if args.gen == 'rmat' else ''}_{args.backend}",
         "value": round(elapsed, 4),
@@ -549,8 +643,18 @@ def main() -> int:
         # total_s == value + post_reduce_s + validate_s holds exactly.
         "total_s": round(round(elapsed, 4) + round(t_reduce, 4)
                          + round(t_validate, 4), 4),
-    }))
-    return 0
+        # the perf ledger's shape key (tools/perf_db.py --perf-db);
+        # include_compile changes what the number MEANS, so it is part
+        # of the ledger's config hash — a cold-compile row never
+        # baselines a warm one
+        "graph_shape_hash": graph_shape_hash(arrays),
+        "include_compile": args.include_compile,
+    }
+    perf = _perf_db_check(args, record)
+    if perf is not None:
+        record["perf_db"] = perf
+    print(json.dumps(record))
+    return 1 if perf is not None and perf.get("regression") else 0
 
 
 if __name__ == "__main__":

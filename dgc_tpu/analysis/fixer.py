@@ -1,7 +1,7 @@
 """``dgc-lint --fix``: the autofixer for mechanically-derivable fixes.
 
-Two fix kinds, both line-local (diff-minimal) and idempotent (a second
-run plans zero fixes):
+Three fix kinds, all diff-minimal and idempotent (a second run plans
+zero fixes):
 
 - **guarded-by insertion** — an LK002 finding (unannotated shared
   mutable attribute on a lock-owning class) where EVERY non-init access
@@ -19,8 +19,18 @@ run plans zero fixes):
   fix can extend an existing single-line ``from dgc_tpu.layout import
   (...)``; otherwise it is skipped with a note, never half-applied.
 
+- **dead-schema removal** — an SC004 finding (a ``EVENT_SCHEMAS`` entry
+  with no emit site anywhere in the schema file set) is mechanically
+  removable: the fix deletes the entry's ``"kind": (...),`` lines from
+  ``obs/schema.py``. The dead set is recomputed from the SOURCE tree
+  (the schema file's dict literal vs every emit site), not the imported
+  module, so a just-deleted entry cannot ghost back in; comments
+  between entries are left alone (group comments describe their
+  surviving neighbors).
+
 ``plan_fixes`` is pure (no writes); ``apply_fixes`` rewrites the
-files. ``--fix --check`` (CI mode) plans and exits non-zero iff any
+files (deletions applied bottom-up so earlier line numbers stay
+valid). ``--fix --check`` (CI mode) plans and exits non-zero iff any
 fix would be applied.
 """
 
@@ -41,14 +51,18 @@ _LAYOUT_IMPORT_RE = re.compile(
 
 @dataclass
 class Fix:
-    """One planned single-line edit."""
+    """One planned edit: a single-line rewrite, or — when ``new`` is
+    None — a deletion of lines ``line..end_line`` (dead-schema
+    removal)."""
 
     file: str
     line: int                   # 1-indexed
-    old: str                    # exact current line text
-    new: str
+    old: str                    # exact current text of the first line
+    new: str | None             # None = delete line..end_line
     kind: str                   # "guarded-by" | "named-slot" | "import"
+    #                           #   | "dead-schema"
     note: str
+    end_line: int | None = None  # deletion span end (inclusive)
 
     def __str__(self) -> str:
         return f"{self.file}:{self.line}: [{self.kind}] {self.note}"
@@ -230,6 +244,67 @@ def _plan_slot_fixes(layout_mod: SourceModule,
 
 
 # ---------------------------------------------------------------------------
+# dead-schema removal (SC004)
+# ---------------------------------------------------------------------------
+
+SCHEMA_REL = "dgc_tpu/obs/schema.py"
+
+
+def _schema_entry_spans(mod: SourceModule) -> dict[str, tuple]:
+    """kind → (first_line, last_line) of its ``EVENT_SCHEMAS`` entry
+    (key through the end of the value tuple, 1-indexed inclusive)."""
+    spans: dict[str, tuple] = {}
+    for node in ast.walk(mod.tree):
+        # the real file declares ``EVENT_SCHEMAS: dict = {...}``
+        # (AnnAssign); plain ``EVENT_SCHEMAS = {...}`` matches too
+        if isinstance(node, ast.Assign) and len(node.targets) == 1:
+            target, value_node = node.targets[0], node.value
+        elif isinstance(node, ast.AnnAssign):
+            target, value_node = node.target, node.value
+        else:
+            continue
+        if not (isinstance(target, ast.Name)
+                and target.id == "EVENT_SCHEMAS"
+                and isinstance(value_node, ast.Dict)):
+            continue
+        for key, value in zip(value_node.keys, value_node.values):
+            if isinstance(key, ast.Constant) and isinstance(key.value, str):
+                spans[key.value] = (key.lineno, value.end_lineno)
+    return spans
+
+
+def _plan_dead_schema_fixes(root: Path, out: list[Fix]) -> None:
+    """Plan removal of SC004 dead entries: schema-file keys with no emit
+    site across the schema pass's file set. Everything is recomputed
+    from SOURCE (the entry spans from the schema file's AST, the emit
+    sites from the same walker the SC pass uses), so the plan is exact
+    and a second run plans nothing."""
+    from dgc_tpu.analysis.run import SCHEMA_GLOBS, _expand
+    from dgc_tpu.analysis.schema_check import _emit_sites
+
+    if not (root / SCHEMA_REL).exists():
+        return
+    schema_mod = SourceModule.load(root, SCHEMA_REL)
+    spans = _schema_entry_spans(schema_mod)
+    if not spans:
+        return
+    emitted: set = set()
+    for rel in _expand(root, SCHEMA_GLOBS):
+        for _call, kind, _fields, _open in _emit_sites(
+                SourceModule.load(root, rel)):
+            emitted.add(kind)
+    for kind in sorted(set(spans) - emitted):
+        first, last = spans[kind]
+        if first > len(schema_mod.lines):
+            continue
+        out.append(Fix(schema_mod.rel, first, schema_mod.lines[first - 1],
+                       None, "dead-schema",
+                       f"remove dead schema entry '{kind}' "
+                       f"(no emit site; lines {first}-{last})",
+                       end_line=last))
+
+
+# ---------------------------------------------------------------------------
 # driver
 # ---------------------------------------------------------------------------
 
@@ -251,13 +326,15 @@ def plan_fixes(root: Path, lock_files, layout_files,
         modules = {rel: SourceModule.load(root, rel)
                    for rel in layout_files if (root / rel).exists()}
         _plan_slot_fixes(layout_mod, modules, specs, out)
+    _plan_dead_schema_fixes(root, out)
     return sorted(out, key=lambda f: (f.file, f.line))
 
 
 def apply_fixes(root: Path, fixes: list[Fix]) -> int:
-    """Apply planned fixes; returns the number of lines rewritten. A
-    fix whose ``old`` line no longer matches is skipped (the plan went
-    stale) — re-run to re-plan."""
+    """Apply planned fixes; returns the number of edits landed. A fix
+    whose ``old`` line no longer matches is skipped (the plan went
+    stale) — re-run to re-plan. Per file, fixes apply bottom-up so a
+    deletion never shifts the line numbers of fixes above it."""
     applied = 0
     by_file: dict[str, list[Fix]] = {}
     for fix in fixes:
@@ -266,7 +343,7 @@ def apply_fixes(root: Path, fixes: list[Fix]) -> int:
         path = root / rel
         lines = path.read_text().splitlines(keepends=True)
         changed = False
-        for fix in file_fixes:
+        for fix in sorted(file_fixes, key=lambda f: -f.line):
             idx = fix.line - 1
             if idx >= len(lines):
                 continue
@@ -274,7 +351,10 @@ def apply_fixes(root: Path, fixes: list[Fix]) -> int:
             ending = raw[len(raw.rstrip("\n\r")):]
             if raw.rstrip("\n\r") != fix.old:
                 continue                 # stale plan: skip, never guess
-            lines[idx] = fix.new + ending
+            if fix.new is None:          # deletion span (dead-schema)
+                del lines[idx:(fix.end_line or fix.line)]
+            else:
+                lines[idx] = fix.new + ending
             changed = True
             applied += 1
         if changed:

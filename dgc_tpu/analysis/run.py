@@ -48,6 +48,7 @@ LAYOUT_FILES = ("dgc_tpu/layout.py", "dgc_tpu/serve/batched.py",
                 "tests/test_serve.py")
 SCHEMA_GLOBS = ("dgc_tpu/**/*.py", "bench.py", "tools/*.py")
 LOCK_FILES = ("dgc_tpu/obs/metrics.py", "dgc_tpu/obs/httpd.py",
+              "dgc_tpu/obs/flightrec.py",
               "dgc_tpu/serve/queue.py", "dgc_tpu/serve/engine.py",
               "dgc_tpu/serve/cli.py", "bench.py")
 TRANSFER_FILES = ("dgc_tpu/serve/batched.py", "dgc_tpu/serve/engine.py")

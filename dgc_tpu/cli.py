@@ -93,6 +93,37 @@ def build_parser() -> argparse.ArgumentParser:
         "--metrics-prom", type=str, default=None,
         help="write run metrics in Prometheus text exposition format",
     )
+    # flight recorder (dgc_tpu.obs.flightrec): ALWAYS on — a bounded
+    # in-memory ring of the last N events/spans, dumped to a
+    # schema-valid JSONL on structured aborts (rc 113/114/137), on
+    # SIGUSR1, and via obs.httpd's /debug/flightrec — so a crashed run
+    # leaves its final event tail even when --log-json was off
+    p.add_argument(
+        "--flightrec-capacity", type=int, default=512,
+        help="events retained in the in-memory flight-recorder ring "
+             "(default 512; 0 disables the recorder entirely)",
+    )
+    p.add_argument(
+        "--flightrec-dir", type=str,
+        default=os.environ.get("DGC_TPU_FLIGHTREC_DIR", "."),
+        help="directory abort/SIGUSR1 flight-recorder dumps land in "
+             "(default: $DGC_TPU_FLIGHTREC_DIR or the current directory)",
+    )
+    # programmatic profiler windows (dgc_tpu.obs.profiler): the
+    # hand-run tools/trace_attempt.py capture as a run-native flag
+    p.add_argument(
+        "--profile-window", type=str, default=None, metavar="K[:W]",
+        help="capture engine dispatches K..K+W-1 under a jax.profiler "
+             "window (1-based; the fused engines sweep in ONE dispatch, "
+             "so '1' captures the whole sweep); emits a profile_window "
+             "event linking the .xplane.pb artifact — consume it with "
+             "tools/xplane_split.py",
+    )
+    p.add_argument(
+        "--profile-logdir", type=str, default="/tmp/dgc_profile",
+        help="profiler artifact directory for --profile-window "
+             "(default /tmp/dgc_profile)",
+    )
     p.add_argument(
         "--superstep-timing", action="store_true",
         help="record per-superstep in-kernel wall time into the "
@@ -364,6 +395,33 @@ def _run(args, logger: RunLogger) -> int:
     logger.add_sink(manifest)
     telemetry = bool(args.run_manifest or args.metrics_prom)
 
+    # flight recorder: always-on retrospective capture (obs.flightrec) —
+    # the ring rides the same event stream as every sink, so it holds
+    # the tail whether or not --log-json is writing
+    recorder = None
+    if getattr(args, "flightrec_capacity", 512) > 0:
+        from dgc_tpu.obs.flightrec import FlightRecorder, install_sigusr1
+
+        recorder = FlightRecorder(capacity=args.flightrec_capacity,
+                                  registry=registry)
+        logger.add_sink(recorder)
+        install_sigusr1(recorder, args.flightrec_dir, logger=logger)
+
+    # programmatic profiler window (obs.profiler): armed here, wrapped
+    # around the engine(s) below, closed before the obs outputs flush so
+    # the manifest links the artifact
+    profile_window = None
+    if getattr(args, "profile_window", None):
+        from dgc_tpu.obs import profiler as _profiler
+
+        try:
+            first, count = _profiler.parse_window(args.profile_window)
+        except ValueError as e:
+            print(f"Bad --profile-window: {e}", file=sys.stderr)
+            return 2
+        profile_window = _profiler.DispatchWindow(
+            first, count, args.profile_logdir, logger=logger)
+
     with phases.section("host_graph"):
         if args.input is not None:
             try:
@@ -406,13 +464,17 @@ def _run(args, logger: RunLogger) -> int:
         return 2
 
     def on_watchdog_abort(diag: str) -> None:
-        # fold the abort into the same event stream and flush the partial
-        # manifest before the watchdog's os._exit (keeping the labeled
-        # stderr diagnostic the watchdog would otherwise print)
+        # fold the abort into the same event stream, land the flight
+        # recorder's tail, and flush the partial manifest before the
+        # watchdog's os._exit (keeping the labeled stderr diagnostic the
+        # watchdog would otherwise print)
         print(f"ERROR: {diag}", file=sys.stderr)
         logger.event("watchdog_abort",
                      what=f"device init for --backend {args.backend}",
                      diag=diag, timeout_s=args.probe_timeout)
+        if recorder is not None:
+            recorder.dump(args.flightrec_dir, reason="watchdog_abort",
+                          logger=logger)
         _write_obs_outputs(args, logger, manifest, phases, registry)
 
     args._on_watchdog_abort = on_watchdog_abort
@@ -436,6 +498,13 @@ def _run(args, logger: RunLogger) -> int:
             registry.counter("dgc_faults_injected_total",
                              "faults fired by the injection plane",
                              point=rec["point"], kind=rec["kind"]).inc()
+            # an injected kill os._exit(137)s the instant on_fire
+            # returns — land the flight recorder's tail first (the
+            # fault_injected record above rides in it), the rc-137 leg
+            # of the abort-capture contract
+            if rec["kind"] == "kill" and recorder is not None:
+                recorder.dump(args.flightrec_dir, reason="injected_kill",
+                              logger=logger)
 
         # hard_kill: this is a real process, so an injected kill exits like
         # a SIGKILL (rc 137, faults.KILL_RC) instead of raising
@@ -490,8 +559,13 @@ def _run(args, logger: RunLogger) -> int:
                 if (args.superstep_timing and telemetry
                         and hasattr(eng, "record_timing")):
                     eng.record_timing = True
-                return ObservedEngine(eng, phases=phases, registry=registry,
-                                      record_trajectory=telemetry)
+                obs_eng = ObservedEngine(eng, phases=phases,
+                                         registry=registry,
+                                         record_trajectory=telemetry)
+                # every rung shares ONE dispatch counter, so the window
+                # means "the Kth dispatch of the run" across fallbacks
+                return (profile_window.wrap(obs_eng)
+                        if profile_window is not None else obs_eng)
             return build
 
         from dgc_tpu.resilience.retry import RetryPolicy
@@ -511,9 +585,17 @@ def _run(args, logger: RunLogger) -> int:
                     retry_budget=max(args.retries, 0),
                     attempt_timeout_s=args.attempt_timeout,
                     logger=logger, registry=registry,
+                    # rc-114 capture: the supervisor emits the
+                    # structured_abort event and dumps the recorder's
+                    # tail itself, so every supervise_sweep caller (the
+                    # serve fallback path included) gets the same
+                    # abort-capture contract
+                    flight_recorder=recorder,
+                    flightrec_dir=args.flightrec_dir,
                 )
             except SweepAbort as ab:
-                logger.event("structured_abort", **ab.to_record())
+                if profile_window is not None:
+                    profile_window.close()
                 _write_obs_outputs(args, logger, manifest, phases, registry)
                 print(f"ERROR: structured abort (rc {ab.rc}): {ab.reason}",
                       file=sys.stderr)
@@ -527,6 +609,8 @@ def _run(args, logger: RunLogger) -> int:
             engine.record_timing = True
         engine = ObservedEngine(engine, phases=phases, registry=registry,
                                 record_trajectory=telemetry)
+        if profile_window is not None:
+            engine = profile_window.wrap(engine)
         with phases.section("sweep_total"):
             result = find_minimal_coloring(
                 engine,
@@ -538,6 +622,11 @@ def _run(args, logger: RunLogger) -> int:
                 post_reduce=make_post_reduce(args.backend),
             )
     phases.log_device_memory()
+    if profile_window is not None:
+        # a sweep that converged before dispatch K+W-1 leaves the window
+        # open; close() stops it and emits the profile_window event so
+        # the manifest flush below links the artifact either way
+        profile_window.close()
 
     if result.minimal_colors is not None and result.swept_colors is not None \
             and result.minimal_colors < result.swept_colors:

@@ -29,13 +29,21 @@ leaving the fused fast path**:
 - ``obs.devclock`` — the in-kernel clock behind the trajectory buffer's
   timing column and the serve slice kernel's per-lane device time.
 - ``obs.httpd`` — live Prometheus scrape endpoint (``--metrics-port``)
-  over the thread-safe registry.
+  over the thread-safe registry, plus the ``/debug/flightrec`` and
+  ``/debug/profile`` diagnostics routes.
+- ``obs.flightrec`` — always-on bounded event ring dumped to
+  schema-valid JSONL on structured aborts / SIGUSR1 / demand (the
+  retrospective layer).
+- ``obs.profiler`` — programmatic ``jax.profiler`` windows
+  (``--profile-window``, SLO-violation triggers, timed HTTP grabs)
+  emitting manifest-linked artifacts for ``tools/xplane_split.py``.
 
 ``utils.logging`` and ``utils.tracing`` are backward-compatible shims over
 this package.
 """
 
 from dgc_tpu.obs.events import RunLogger
+from dgc_tpu.obs.flightrec import FlightRecorder, install_sigusr1
 from dgc_tpu.obs.httpd import MetricsHTTPServer
 from dgc_tpu.obs.instrument import ObservedEngine
 from dgc_tpu.obs.kernel import SuperstepTrajectory, decode_trajectory
@@ -45,6 +53,7 @@ from dgc_tpu.obs.phases import PhaseCollector
 from dgc_tpu.obs.trace import NULL_TRACER, Tracer, tracer_for
 
 __all__ = [
+    "FlightRecorder",
     "MetricsHTTPServer",
     "MetricsRegistry",
     "NULL_TRACER",
@@ -55,5 +64,6 @@ __all__ = [
     "SuperstepTrajectory",
     "Tracer",
     "decode_trajectory",
+    "install_sigusr1",
     "tracer_for",
 ]

@@ -1,0 +1,185 @@
+"""Flight recorder: always-on bounded ring over the run-log stream.
+
+PRs 1 and 7 made the *live* path observable — but only when somebody
+asked (``--log-json``), and an rc-113/114/137 abort takes the unflushed
+event tail with it. This module is the retrospective half: a bounded,
+thread-safe in-memory ring that retains the last N event records (spans
+included — they ride the same stream) even when JSONL logging is off,
+and dumps them to a **schema-valid** JSONL file the moment something
+goes wrong:
+
+- structured aborts — rc 113 (``utils.watchdog``), rc 114
+  (``resilience.supervisor.SweepAbort``), rc 137 (injected kill) — wired
+  through the CLI/bench abort callbacks and ``supervise_sweep``;
+- SLO-gate violations (``tools/slo_check.ViolationHooks``);
+- SIGUSR1 (:func:`install_sigusr1` — poke a live process for its tail);
+- on demand via ``GET /debug/flightrec`` (``obs.httpd``).
+
+The dump is a valid run log: every retained record already passed
+through ``RunLogger`` (per-record schema holds by construction), and
+:meth:`FlightRecorder.render` re-establishes the *structural* span
+invariants ``tools/validate_runlog.py`` enforces — span records whose
+begin was evicted from the ring, or whose end never arrived (the
+in-flight work at abort time), are dropped from the body and accounted
+in the trailing ``flightrec_dump`` record (``open_spans`` carries the
+in-flight span names: exactly the "what was it doing" answer an abort
+tail is for). The trailer also embeds a point-in-time metrics snapshot
+when a registry is attached.
+
+Steady-state cost is one lock + one dict copy + one deque append per
+event (measured ≤ 2% on the batch-8 serve benchmark, PERF.md "Flight
+recorder overhead"); ``dump`` snapshots under the lock and does all
+rendering/IO outside it, so writers never block on disk.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import threading
+from collections import deque
+from pathlib import Path
+
+DEFAULT_CAPACITY = 512
+
+
+class FlightRecorder:
+    """Bounded ring of run-log records; register with
+    ``RunLogger.add_sink``. Thread-safe: serve workers, the batch
+    dispatcher, and scrape threads all emit concurrently with dumps."""
+
+    def __init__(self, capacity: int = DEFAULT_CAPACITY, registry=None):
+        self.capacity = int(capacity)          # guarded-by: init
+        self.registry = registry               # guarded-by: init
+        self._ring: deque = deque(maxlen=max(1, int(capacity)))  # guarded-by: _lock
+        self._seen = 0                         # guarded-by: _lock
+        self._dumps = 0                        # guarded-by: _lock
+        self._lock = threading.Lock()
+
+    # -- RunLogger sink -------------------------------------------------
+    def __call__(self, record: dict) -> None:
+        rec = dict(record)   # writers may reuse/mutate their dicts
+        with self._lock:
+            self._ring.append(rec)
+            self._seen += 1
+
+    def snapshot(self) -> tuple:
+        """(records, seen) — a consistent copy for rendering/inspection."""
+        with self._lock:
+            return [dict(r) for r in self._ring], self._seen
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._ring)
+
+    # -- rendering ------------------------------------------------------
+    @staticmethod
+    def _sanitize_spans(records: list) -> tuple:
+        """Drop span records that would break the validator's structural
+        invariants in a truncated window: an end whose begin was evicted,
+        a begin that never ended (in-flight at dump time), or a begin
+        whose parent's begin was itself dropped. Returns
+        (kept_records, dropped_count, open_span_names)."""
+        ended = set()
+        for rec in records:
+            if rec.get("event") == "span" and rec.get("ph") == "E":
+                ended.add((rec.get("trace"), rec.get("span")))
+        kept: list = []
+        kept_spans: set = set()
+        open_spans: list = []
+        dropped = 0
+        for rec in records:
+            if rec.get("event") != "span":
+                kept.append(rec)
+                continue
+            key = (rec.get("trace"), rec.get("span"))
+            ph = rec.get("ph")
+            if ph == "B":
+                parent = rec.get("parent")
+                parent_ok = parent is None or \
+                    (rec.get("trace"), parent) in kept_spans
+                if key in ended and parent_ok:
+                    kept_spans.add(key)
+                    kept.append(rec)
+                else:
+                    dropped += 1
+                    if key not in ended:
+                        open_spans.append(str(rec.get("name")))
+            elif ph == "E" and key in kept_spans:
+                kept.append(rec)
+            else:
+                dropped += 1
+        return kept, dropped, open_spans
+
+    def render(self, reason: str, *, trigger: str | None = None,
+               path: str | None = None) -> tuple:
+        """(jsonl_text, trailer_fields): the span-sanitized window plus
+        the self-describing ``flightrec_dump`` trailer record (metrics
+        snapshot included when a registry is attached)."""
+        records, seen = self.snapshot()
+        kept, dropped, open_spans = self._sanitize_spans(records)
+        trailer = {
+            "path": path,
+            "reason": reason,
+            "records": len(kept),
+            "seen": seen,
+            "capacity": self.capacity,
+            "dropped_spans": dropped,
+            "open_spans": open_spans,
+            "trigger": trigger,
+            "metrics": (self.registry.to_dict()
+                        if self.registry is not None else None),
+        }
+        t_last = kept[-1].get("t", 0.0) if kept else 0.0
+        lines = [json.dumps(r) for r in kept]
+        lines.append(json.dumps(
+            {"t": t_last, "event": "flightrec_dump", **trailer}))
+        return "\n".join(lines) + "\n", trailer
+
+    # -- dumping --------------------------------------------------------
+    def dump(self, directory: str = ".", *, reason: str = "manual",
+             trigger: str | None = None, logger=None,
+             path: str | None = None) -> str:
+        """Write the ring to a JSONL file; returns the path. ``logger``
+        (optional) receives the same ``flightrec_dump`` event into the
+        live stream so the run manifest links the dump."""
+        if path is None:
+            with self._lock:
+                n = self._dumps
+                self._dumps += 1
+            path = os.path.join(
+                directory, f"flightrec_{os.getpid()}_{reason}_{n}.jsonl")
+        p = Path(path)
+        if str(p.parent) not in ("", "."):
+            p.parent.mkdir(parents=True, exist_ok=True)
+        text, trailer = self.render(reason, trigger=trigger, path=str(path))
+        with open(path, "w") as fh:
+            fh.write(text)
+            fh.flush()
+            os.fsync(fh.fileno())   # abort paths os._exit right after
+        if logger is not None:
+            # live-stream copy drops the bulky metrics snapshot (it is
+            # in the dump file; the manifest embeds its own at finalize)
+            logger.event("flightrec_dump",
+                         **dict(trailer, metrics=None))
+        return str(path)
+
+
+def install_sigusr1(recorder: FlightRecorder, directory: str = ".",
+                    logger=None) -> bool:
+    """Dump the ring on SIGUSR1 (main thread only; returns False when
+    the platform has no SIGUSR1 or this is not the main thread)."""
+    import signal
+
+    if not hasattr(signal, "SIGUSR1"):
+        return False
+
+    def _handler(signum, frame):
+        path = recorder.dump(directory, reason="sigusr1", logger=logger)
+        print(f"# flight recorder dumped to {path}", flush=True)
+
+    try:
+        signal.signal(signal.SIGUSR1, _handler)
+    except ValueError:        # not the main thread
+        return False
+    return True

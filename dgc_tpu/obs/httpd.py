@@ -9,9 +9,17 @@ thread-safe, so the scrape observes a consistent point-in-time snapshot
 while worker threads keep mutating — plus ``GET /healthz`` from an
 optional health callback (the front-end's readiness snapshot as JSON).
 
+PR 11 adds the debug surface of the retrospective layer: ``GET
+/debug/flightrec`` streams the flight recorder's ring as schema-valid
+JSONL (``?file=1`` dumps it to disk instead and returns the path) and
+``GET /debug/profile?ms=N`` holds a ``jax.profiler`` window open for N
+milliseconds over whatever the process is executing and returns the
+artifact location — both live-process diagnostics a hung or slow serve
+loop can be asked for without restarting it.
+
 Stdlib only (``http.server``), one daemon thread, ephemeral-port
 friendly (``port=0`` binds any free port; read ``.port`` back — the
-tests' pattern). Not a general web server: two routes, GET only,
+tests' pattern). Not a general web server: four routes, GET only,
 loopback by default.
 """
 
@@ -20,6 +28,11 @@ from __future__ import annotations
 import json
 import threading
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
+from urllib.parse import parse_qs
+
+# /debug/profile bounds: long enough for a useful window, short enough
+# that a fat-fingered request cannot wedge the handler pool
+MAX_PROFILE_MS = 60_000.0
 
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
@@ -27,24 +40,63 @@ PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 class MetricsHTTPServer:   # dgc-lint: threaded
     """``MetricsHTTPServer(registry, port=9100).start()`` → live
     ``/metrics`` scrape endpoint; ``close()`` stops it. ``health_fn``
-    (optional, ``() -> dict``) backs ``/healthz``. Handler threads only
-    ever read the construction-frozen registry/health_fn refs; the
-    server/thread handles belong to the owning thread."""
+    (optional, ``() -> dict``) backs ``/healthz``; ``recorder``
+    (optional ``FlightRecorder``) backs ``/debug/flightrec``;
+    ``profiler`` (optional ``(ms) -> dict | None``, e.g. a bound
+    ``obs.profiler.timed_window``) backs ``/debug/profile``. Handler
+    threads only ever read the construction-frozen refs (the recorder
+    and the profiler guard their own state); the server/thread handles
+    belong to the owning thread."""
 
     def __init__(self, registry, port: int = 0, host: str = "127.0.0.1",
-                 health_fn=None):
+                 health_fn=None, recorder=None, profiler=None,
+                 flightrec_dir: str = "."):
         self.registry = registry
         self.health_fn = health_fn
+        self.recorder = recorder
+        self.profiler = profiler
+        self.flightrec_dir = flightrec_dir
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
             def do_GET(self):  # noqa: N802 (http.server convention)
-                path = self.path.split("?", 1)[0]
+                path, _, query = self.path.partition("?")
+                q = parse_qs(query)
                 if path in ("/", "/metrics"):
                     body = outer.registry.to_prometheus().encode()
                     ctype = PROM_CONTENT_TYPE
                 elif path == "/healthz" and outer.health_fn is not None:
                     body = (json.dumps(outer.health_fn()) + "\n").encode()
+                    ctype = "application/json"
+                elif path == "/debug/flightrec" \
+                        and outer.recorder is not None:
+                    if q.get("file", ["0"])[0] in ("1", "true"):
+                        dumped = outer.recorder.dump(
+                            outer.flightrec_dir, reason="http",
+                            trigger=self.client_address[0])
+                        body = (json.dumps({"path": dumped}) + "\n").encode()
+                        ctype = "application/json"
+                    else:
+                        text, _trailer = outer.recorder.render(
+                            "http", trigger=self.client_address[0])
+                        body = text.encode()
+                        ctype = "application/jsonl"
+                elif path == "/debug/profile" \
+                        and outer.profiler is not None:
+                    try:
+                        ms = float(q.get("ms", ["500"])[0])
+                    except ValueError:
+                        self.send_error(400, "ms must be a number")
+                        return
+                    if not 0 < ms <= MAX_PROFILE_MS:
+                        self.send_error(
+                            400, f"ms must be in (0, {MAX_PROFILE_MS:g}]")
+                        return
+                    result = outer.profiler(ms)
+                    if result is None:   # a window is already open
+                        self.send_error(409, "a profile window is open")
+                        return
+                    body = (json.dumps(result) + "\n").encode()
                     ctype = "application/json"
                 else:
                     self.send_error(404)

@@ -81,6 +81,18 @@ class RunManifest:
             self.doc["resilience"]["resumes"].append(fields)
         elif kind == "post_reduce":
             self.doc["post_reduce"] = fields
+        # diagnose-after-the-fact layer (PR 11): flight-recorder dumps,
+        # profiler-window artifacts, the timing cross-check verdict, and
+        # perf-ledger verdicts — slots appear only when the events do,
+        # so prior manifests stay byte-identical
+        elif kind == "flightrec_dump":
+            self.doc.setdefault("flightrec", []).append(fields)
+        elif kind == "profile_window":
+            self.doc.setdefault("profiles", []).append(fields)
+        elif kind == "timing_crosscheck":
+            self.doc["timing_crosscheck"] = fields
+        elif kind == "perf_regression":
+            self.doc.setdefault("perf", []).append(fields)
         elif kind in ("sweep_done", "sweep_failed"):
             self.doc["result"] = dict(fields, event=kind)
         elif (kind.startswith("serve_")

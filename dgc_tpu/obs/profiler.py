@@ -1,0 +1,187 @@
+"""Programmatic profiler windows: ``jax.profiler`` traces on a trigger.
+
+Profiling was a hand-run one-off (``tools/trace_attempt.py`` drives its
+own graph + engine under ``jax.profiler.trace``); this module makes
+capture windows part of the run machinery, so the artifact the queued
+xplane self-time cross-check needs (``tools/xplane_split.py``) comes out
+of an ordinary run:
+
+- ``--profile-window K[:W]`` (CLI): wrap engine dispatches K..K+W−1 in
+  one ``jax.profiler`` window (:class:`DispatchWindow` — for the fused
+  engines one dispatch is a whole sweep, so ``1`` captures the run);
+- SLO-violation trigger: ``tools/slo_check.ViolationHooks`` calls
+  :func:`timed_window` when a gate trips, capturing whatever the process
+  is executing right then;
+- ``GET /debug/profile?ms=`` (``obs.httpd``): a timed window over a live
+  serve process.
+
+Every window emits a ``profile_window`` event (logdir, the located
+``.xplane.pb`` artifact, wall seconds, trigger) into the run-log stream,
+so the run manifest links its profile artifacts and
+``tools/xplane_split.py`` can consume them by manifest path alone.
+
+Only one window can be open per process (a ``jax.profiler`` limit); the
+module-level lock makes concurrent triggers (an HTTP request racing an
+SLO hook) fail soft — the loser gets ``None``, never a crashed run.
+"""
+
+from __future__ import annotations
+
+import glob
+import os
+import threading
+import time
+
+_lock = threading.Lock()   # serializes start/stop of the one process window
+_active = False            # guarded by _lock
+
+
+def find_xplane(logdir: str) -> str | None:
+    """Newest ``.xplane.pb`` under a profiler logdir (None when the
+    backend produced none)."""
+    paths = sorted(glob.glob(os.path.join(logdir, "**", "*.xplane.pb"),
+                             recursive=True), key=os.path.getmtime)
+    return paths[-1] if paths else None
+
+
+def parse_window(spec: str) -> tuple:
+    """``"K"`` or ``"K:W"`` → (first_dispatch, count), both ≥ 1."""
+    head, _, tail = str(spec).partition(":")
+    first = int(head)
+    count = int(tail) if tail else 1
+    if first < 1 or count < 1:
+        raise ValueError(f"--profile-window wants K[:W] with K,W >= 1, "
+                         f"got {spec!r}")
+    return first, count
+
+
+def _try_begin() -> bool:
+    global _active
+    with _lock:
+        if _active:
+            return False
+        _active = True
+        return True
+
+
+def _end() -> None:
+    global _active
+    with _lock:
+        _active = False
+
+
+def _start_trace(logdir: str) -> bool:
+    if not _try_begin():
+        return False
+    os.makedirs(logdir, exist_ok=True)
+    import jax
+
+    try:
+        jax.profiler.start_trace(logdir)
+    except Exception:
+        _end()
+        raise
+    return True
+
+
+def _stop_trace(logdir: str, t0: float, trigger: str, logger=None,
+                **extra) -> dict:
+    import jax
+
+    try:
+        jax.profiler.stop_trace()
+    finally:
+        _end()
+    seconds = round(time.perf_counter() - t0, 4)
+    out = {"trigger": trigger, "logdir": logdir, "seconds": seconds,
+           "xplane": find_xplane(logdir), **extra}
+    if logger is not None:
+        logger.event("profile_window", **out)
+    return out
+
+
+def timed_window(logdir: str, ms: float, *, trigger: str = "timed",
+                 logger=None) -> dict | None:
+    """Hold a profiler window open for ``ms`` milliseconds (whatever the
+    process executes meanwhile is captured). Returns the
+    ``profile_window`` fields, or None when a window is already open."""
+    if not _start_trace(logdir):
+        return None
+    t0 = time.perf_counter()
+    time.sleep(max(0.0, float(ms)) / 1e3)
+    return _stop_trace(logdir, t0, trigger, logger, ms=float(ms))
+
+
+class DispatchWindow:
+    """One profiler window over engine dispatches K..K+W−1.
+
+    ``wrap(engine)`` returns a counting proxy; every wrapped engine (a
+    fallback ladder builds one per rung) shares THIS object's dispatch
+    counter, so the window means "the Kth dispatch of the run", not of
+    one rung. ``close()`` stops a window the run ended inside (a sweep
+    that converged early) and emits the event either way. Single-owner
+    state: the CLI driver dispatches from one thread."""
+
+    def __init__(self, first: int, count: int, logdir: str, logger=None):
+        self.first = first
+        self.count = count
+        self.logdir = logdir
+        self.logger = logger
+        self._n = 0          # dispatches seen
+        self._t0 = 0.0
+        self._open = False
+        self.result: dict | None = None
+
+    def wrap(self, engine) -> "_WindowedEngine":
+        return _WindowedEngine(engine, self)
+
+    def _enter_dispatch(self) -> None:
+        self._n += 1
+        if self._n == self.first and not self._open and self.result is None:
+            if _start_trace(self.logdir):
+                self._open = True
+                self._t0 = time.perf_counter()
+
+    def _exit_dispatch(self) -> None:
+        if self._open and self._n >= self.first + self.count - 1:
+            self._finish()
+
+    def close(self) -> dict | None:
+        """Stop an open window (run ended early) — idempotent."""
+        if self._open:
+            self._finish()
+        return self.result
+
+    def _finish(self) -> None:
+        self._open = False
+        self.result = _stop_trace(
+            self.logdir, self._t0, "window", self.logger,
+            first=self.first, count=self.count)
+
+
+class _WindowedEngine:
+    """Engine proxy counting dispatches into a shared
+    :class:`DispatchWindow` (the ``ObservedEngine`` proxy convention:
+    ``sweep`` only exists when the wrapped engine has one)."""
+
+    def __init__(self, engine, window: DispatchWindow):
+        self._engine = engine
+        self._window = window
+        if hasattr(engine, "sweep"):
+            self.sweep = self._sweep
+
+    def __getattr__(self, name):
+        return getattr(self._engine, name)
+
+    def _call(self, fn):
+        self._window._enter_dispatch()
+        try:
+            return fn()
+        finally:
+            self._window._exit_dispatch()
+
+    def attempt(self, k: int):
+        return self._call(lambda: self._engine.attempt(k))
+
+    def _sweep(self, k0: int):
+        return self._call(lambda: self._engine.sweep(k0))

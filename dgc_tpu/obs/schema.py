@@ -165,6 +165,35 @@ EVENT_SCHEMAS: dict = {
     "serve_done": (
         {"requests": "int", "completed": "int", "failed": "int"},
         {"rejected": "int"}),
+    # flight recorder (obs.flightrec): the self-describing trailer of a
+    # ring dump — emitted into the live stream (metrics omitted there)
+    # AND as the dump file's last record (metrics snapshot embedded)
+    "flightrec_dump": (
+        {"reason": "str", "records": "int"},
+        {"path": ("str", "null"), "seen": "int", "capacity": "int",
+         "dropped_spans": "int", "open_spans": "list",
+         "trigger": ("str", "null"), "metrics": ("dict", "null")}),
+    # programmatic profiler windows (obs.profiler): one event per closed
+    # window; ``xplane`` is the located artifact tools/xplane_split.py
+    # consumes (null when the backend produced none)
+    "profile_window": (
+        {"trigger": "str", "logdir": "str", "seconds": NUM},
+        {"xplane": ("str", "null"), "first": "int", "count": "int",
+         "ms": NUM}),
+    # devclock timing column vs xplane op self-time cross-check
+    # (tools/xplane_split.py --manifest): coverage = in_kernel/xplane
+    "timing_crosscheck": (
+        {"in_kernel_ms": NUM, "xplane_ms": NUM, "verdict": "str"},
+        {"coverage": (*NUM, "null"), "lo": NUM, "hi": NUM,
+         "xplane": ("str", "null"), "attempts": "int",
+         "supersteps": "int", "platform": ("str", "null")}),
+    # perf-history ledger verdict (tools/perf_db.py): median-vs-baseline
+    # regression check over the (shape, config, host) key's history
+    "perf_regression": (
+        {"metric": "str", "value": (*NUM, "null"), "regression": "bool"},
+        {"baseline_median": (*NUM, "null"), "delta_pct": (*NUM, "null"),
+         "samples": "int", "better": "str", "threshold_pct": NUM,
+         "db": ("str", "null"), "unit": ("str", "null")}),
     "serve_summary": (
         {"requests": "int", "completed": "int", "failed": "int",
          "wall_s": NUM},

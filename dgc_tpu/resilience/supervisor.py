@@ -37,6 +37,7 @@ rc-113 watchdog's job.
 
 from __future__ import annotations
 
+import sys
 import threading
 import time
 from dataclasses import dataclass, field
@@ -279,6 +280,8 @@ def supervise_sweep(
     logger=None,
     registry=None,
     rung_state: RungState | None = None,
+    flight_recorder=None,
+    flightrec_dir: str = ".",
 ):
     """Run the minimal-k sweep down an engine ladder.
 
@@ -289,7 +292,13 @@ def supervise_sweep(
     checkpoint manager and recolor post-pass.
 
     Returns ``(MinimalColoringResult, ResilienceStats)``; raises
-    :class:`SweepAbort` when every rung failed.
+    :class:`SweepAbort` when every rung failed. The terminal abort is
+    emitted into the event stream HERE (when ``logger`` is given) and —
+    when a ``flight_recorder`` (``obs.flightrec``) is attached — the
+    recorder's event tail is dumped to ``flightrec_dir`` with the
+    ``structured_abort`` record included, so an rc-114 exit always
+    leaves its final pre-abort events on disk even when JSONL logging
+    was off.
     """
     stats = ResilienceStats()
     last_error: BaseException | None = None
@@ -342,10 +351,19 @@ def supervise_sweep(
                                  error_class=ecls.value, error=str(cause))
     if rung_state is not None:
         rung_state.on_exhausted()
-    raise SweepAbort(
+    ab = SweepAbort(
         f"engine ladder exhausted after {len(names)} rung(s): "
         f"{' -> '.join(names)}",
         ladder=names, last_error=last_error)
+    if logger is not None:
+        logger.event("structured_abort", **ab.to_record())
+    if flight_recorder is not None:
+        try:
+            flight_recorder.dump(flightrec_dir, reason="structured_abort",
+                                 logger=logger)
+        except OSError as e:   # diagnostics must not mask the abort
+            print(f"# flight recorder dump failed: {e}", file=sys.stderr)
+    raise ab
 
 
 def default_ladder(backend: str) -> list[str]:

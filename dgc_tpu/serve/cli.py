@@ -27,6 +27,7 @@ from __future__ import annotations
 
 import argparse
 import json
+import os
 import sys
 import time
 from pathlib import Path
@@ -122,6 +123,20 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "text format at http://127.0.0.1:PORT/metrics "
                         "(0 = any free port; also /healthz) while the "
                         "replay runs")
+    p.add_argument("--flightrec-capacity", type=int, default=512,
+                   help="events retained in the always-on flight-"
+                        "recorder ring (0 disables); dump via SIGUSR1 "
+                        "or GET /debug/flightrec on --metrics-port")
+    p.add_argument("--flightrec-dir", type=str,
+                   default=os.environ.get("DGC_TPU_FLIGHTREC_DIR", "."),
+                   help="directory flight-recorder dumps land in "
+                        "(default: $DGC_TPU_FLIGHTREC_DIR or the "
+                        "current directory)")
+    p.add_argument("--profile-logdir", type=str,
+                   default="/tmp/dgc_profile",
+                   help="jax.profiler artifact directory for GET "
+                        "/debug/profile?ms= on --metrics-port "
+                        "(tools/xplane_split.py consumes the artifact)")
     p.add_argument("--kernel-timing", action="store_true",
                    help="compile the slice kernels' in-kernel timing "
                         "variant: per-lane superstep wall time in the "
@@ -156,6 +171,17 @@ def serve_main(argv: list[str] | None = None) -> int:
     registry = MetricsRegistry()
     manifest = RunManifest()
     logger.add_sink(manifest)
+    # flight recorder (obs.flightrec): always-on event-tail retention —
+    # a serve loop killed mid-load leaves its last N events on SIGUSR1 /
+    # the /debug/flightrec route even when --log-json is off
+    recorder = None
+    if args.flightrec_capacity > 0:
+        from dgc_tpu.obs import FlightRecorder, install_sigusr1
+
+        recorder = FlightRecorder(capacity=args.flightrec_capacity,
+                                  registry=registry)
+        logger.add_sink(recorder)
+        install_sigusr1(recorder, args.flightrec_dir, logger=logger)
     tuned_cache = None
     if args.tuned_cache_dir:
         # the cache directory serves two layers: per-shape fallback
@@ -218,12 +244,20 @@ def serve_main(argv: list[str] | None = None) -> int:
     # "Prometheus scrape of the existing metrics registry" rung
     metrics_server = None
     if args.metrics_port is not None:
-        from dgc_tpu.obs import MetricsHTTPServer
+        from dgc_tpu.obs import MetricsHTTPServer, profiler
 
         try:
             metrics_server = MetricsHTTPServer(
                 registry, port=args.metrics_port,
-                health_fn=lambda: front.health()).start()
+                health_fn=lambda: front.health(),
+                # live diagnostics (PR 11): GET /debug/flightrec streams
+                # the ring; GET /debug/profile?ms= opens a timed
+                # jax.profiler window over the running loop
+                recorder=recorder,
+                flightrec_dir=args.flightrec_dir,
+                profiler=lambda ms: profiler.timed_window(
+                    args.profile_logdir, ms, trigger="http",
+                    logger=logger)).start()
         except OSError as e:
             print(f"--metrics-port: cannot bind {args.metrics_port}: {e}",
                   file=sys.stderr)

@@ -27,6 +27,17 @@ if (
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
 
+# flight-recorder dumps (obs.flightrec) default to the process cwd — the
+# right breadcrumb for a real aborted run, the wrong one for a test suite
+# whose abort-path subprocesses run with cwd=repo-root. Route every dump
+# a test doesn't explicitly place into a scratch dir (the CLIs read this
+# env as their --flightrec-dir default; subprocesses inherit it).
+import tempfile
+
+os.environ.setdefault(
+    "DGC_TPU_FLIGHTREC_DIR",
+    tempfile.mkdtemp(prefix="dgc_flightrec_test_"))
+
 import jax
 
 try:

@@ -683,6 +683,75 @@ def test_fix_clean_tree_is_noop():
     assert "0 fix(es) pending" in r.stdout
 
 
+def test_fix_dead_schema_fixture(tmp_path):
+    """SC004 autofix on a synthetic tree: only the entry with no emit
+    site is planned; multi-line entries delete their whole span; live
+    entries survive byte-for-byte."""
+    from dgc_tpu.analysis.fixer import apply_fixes, plan_fixes
+
+    root = tmp_path / "r"
+    (root / "dgc_tpu" / "obs").mkdir(parents=True)
+    (root / "tools").mkdir()
+    schema = root / "dgc_tpu" / "obs" / "schema.py"
+    schema.write_text('''EVENT_SCHEMAS: dict = {
+    "alive": ({"x": "int"}, {}),
+    # a group comment that must survive
+    "dead_multiline": (
+        {"a": "int", "b": "str"},
+        {"c": ("int", "null")}),
+    "alive_too": ({"y": "int"}, {}),
+}
+''')
+    (root / "dgc_tpu" / "emit.py").write_text(
+        "def go(logger):\n"
+        "    logger.event('alive', x=1)\n"
+        "    logger.event('alive_too', y=2)\n")
+    (root / "layout.py").write_text("LEN = 1\n")
+    fixes = plan_fixes(root, (), ("layout.py",), specs=())
+    assert [f.kind for f in fixes] == ["dead-schema"]
+    assert "dead_multiline" in fixes[0].note
+    assert (fixes[0].line, fixes[0].end_line) == (4, 6)
+    assert apply_fixes(root, fixes) == 1
+    assert schema.read_text() == '''EVENT_SCHEMAS: dict = {
+    "alive": ({"x": "int"}, {}),
+    # a group comment that must survive
+    "alive_too": ({"y": "int"}, {}),
+}
+'''
+    # idempotent: the second plan is empty
+    assert plan_fixes(root, (), ("layout.py",), specs=()) == []
+
+
+def test_fix_dead_schema_real_tree_lifecycle(tmp_path):
+    """Satellite (carried ROADMAP follow-on): inject a dead entry into
+    the REAL schema file — --fix --check exits 1 naming it, --fix
+    removes exactly that entry (the file returns byte-identical to the
+    committed tree), and a second --fix plans nothing."""
+    root = _copy_tree(tmp_path)
+    schema = root / "dgc_tpu" / "obs" / "schema.py"
+    pristine = schema.read_text()
+    anchor = '    "serve_summary": ('
+    assert anchor in pristine
+    schema.write_text(pristine.replace(
+        anchor,
+        '    "zombie_event": (\n'
+        '        {"foo": "int"},\n'
+        '        {"bar": ("str", "null")}),\n' + anchor))
+
+    r = _run_lint(root, "--fix", "--check")
+    assert r.returncode == 1, r.stdout + r.stderr
+    assert "dead-schema" in r.stdout and "zombie_event" in r.stdout
+
+    r = _run_lint(root, "--fix")
+    assert r.returncode == 0 and "applied 1 fix(es)" in r.stdout
+    assert schema.read_text() == pristine
+
+    r = _run_lint(root, "--fix", "--check")     # idempotent
+    assert r.returncode == 0 and "0 fix(es) pending" in r.stdout
+    r = _run_lint(root, "--strict")
+    assert r.returncode == 0, r.stdout + r.stderr
+
+
 # ---------------------------------------------------------------------------
 # baseline hygiene + waivers
 # ---------------------------------------------------------------------------

@@ -1,0 +1,364 @@
+"""Flight recorder: ring semantics, span sanitation, dump validity
+under concurrency, abort-path dumps (rc 114/137), and the httpd debug
+routes — the PR 11 retrospective-capture contract."""
+
+import json
+import os
+import signal
+import subprocess
+import sys
+import threading
+
+import pytest
+
+from dgc_tpu.obs.events import RunLogger
+from dgc_tpu.obs.flightrec import FlightRecorder, install_sigusr1
+from dgc_tpu.obs.metrics import MetricsRegistry
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from tools.validate_runlog import validate_file  # noqa: E402
+
+
+def _logger_with_ring(capacity=64, registry=None):
+    logger = RunLogger(jsonl_path=None, echo=False)
+    rec = FlightRecorder(capacity=capacity, registry=registry)
+    logger.add_sink(rec)
+    return logger, rec
+
+
+# ------------------------------------------------------------------ ring
+
+def test_ring_retains_last_n_events():
+    logger, rec = _logger_with_ring(capacity=8)
+    for i in range(50):
+        logger.event("graph_saved", path=f"g{i}.json")
+    records, seen = rec.snapshot()
+    assert seen == 50 and len(records) == 8
+    assert [r["path"] for r in records] == [f"g{i}.json" for i in range(42, 50)]
+
+
+def test_ring_holds_events_when_jsonl_logging_is_off(tmp_path):
+    """The point of the recorder: no --log-json, yet the tail exists."""
+    logger, rec = _logger_with_ring()
+    logger.event("sweep_start", backend="ell", initial_k=9,
+                 strict_decrement=False)
+    logger.event("sweep_failed", initial_k=9)
+    path = rec.dump(str(tmp_path), reason="manual", logger=logger)
+    assert validate_file(path) == []
+    kinds = [json.loads(l)["event"] for l in open(path)]
+    assert kinds == ["sweep_start", "sweep_failed", "flightrec_dump"]
+
+
+def test_dump_trailer_embeds_metrics_snapshot(tmp_path):
+    reg = MetricsRegistry()
+    reg.counter("dgc_retries_total", "retries").inc(3)
+    logger, rec = _logger_with_ring(registry=reg)
+    logger.event("graph_saved", path="g.json")
+    path = rec.dump(str(tmp_path), reason="manual")
+    trailer = json.loads(open(path).read().splitlines()[-1])
+    assert trailer["event"] == "flightrec_dump"
+    assert trailer["metrics"]["dgc_retries_total"]["value"] == 3.0
+    assert trailer["records"] == 1 and trailer["seen"] == 1
+    assert validate_file(path) == []
+
+
+def test_live_stream_dump_event_omits_metrics(tmp_path):
+    """The live-stream copy of flightrec_dump drops the bulky metrics
+    snapshot (the dump file keeps it)."""
+    reg = MetricsRegistry()
+    reg.counter("c_total", "c").inc()
+    logger, rec = _logger_with_ring(registry=reg)
+    seen = []
+    logger.add_sink(seen.append)
+    logger.event("graph_saved", path="g.json")
+    rec.dump(str(tmp_path), reason="manual", logger=logger)
+    dump_events = [r for r in seen if r["event"] == "flightrec_dump"]
+    assert len(dump_events) == 1 and dump_events[0]["metrics"] is None
+
+
+# ------------------------------------------------------------------ spans
+
+def test_dump_sanitizes_truncated_spans(tmp_path):
+    """An end whose begin was evicted, and a begin still open at dump
+    time, are dropped from the body (validator-clean) and accounted in
+    the trailer — open spans by name: the in-flight work at abort."""
+    logger, rec = _logger_with_ring(capacity=4)
+    from dgc_tpu.obs.trace import Tracer
+
+    tracer = Tracer(logger.event)
+    s1 = tracer.begin("evicted")      # B will be evicted by capacity 4
+    s2 = tracer.begin("kept", parent=None)
+    s2.end()
+    s1.end()                          # E retained, B evicted
+    s3 = tracer.begin("inflight")     # never ended
+    logger.event("graph_saved", path="g.json")
+    path = rec.dump(str(tmp_path), reason="manual")
+    assert validate_file(path) == [], open(path).read()
+    trailer = json.loads(open(path).read().splitlines()[-1])
+    assert "inflight" in trailer["open_spans"]
+    assert trailer["dropped_spans"] >= 2      # orphan E + open B
+    del s3
+
+
+def test_dump_drops_children_of_dropped_parents(tmp_path):
+    """A child span whose parent's begin left the window must go too —
+    the validator's parent-before-child invariant."""
+    logger, rec = _logger_with_ring(capacity=3)
+    from dgc_tpu.obs.trace import Tracer
+
+    tracer = Tracer(logger.event)
+    parent = tracer.begin("parent")
+    child = tracer.begin("child", parent=parent)
+    child.end()
+    parent.end()
+    # capacity 3 retains: child B, child E, parent E — parent B evicted
+    path = rec.dump(str(tmp_path), reason="manual")
+    assert validate_file(path) == [], open(path).read()
+    body = [json.loads(l) for l in open(path)]
+    assert not any(r.get("event") == "span" for r in body)
+
+
+# ------------------------------------------------------------- concurrency
+
+def test_multi_writer_hammer_and_dump_under_load(tmp_path):
+    """Satellite: worker threads emit while dumps fire concurrently —
+    every dump file is byte-valid JSONL, schema-clean, with a coherent
+    trailer; no exceptions in any thread."""
+    logger, rec = _logger_with_ring(capacity=128)
+    n_threads, n_iter, n_dumps = 6, 300, 12
+    errors, paths = [], []
+    go = threading.Event()
+
+    def writer(tid):
+        try:
+            go.wait()
+            for i in range(n_iter):
+                logger.event("lane_recycled", shape_class="v400w8",
+                             lane=tid, k=i)
+        except Exception as e:  # pragma: no cover - failure signal
+            errors.append(e)
+
+    def dumper():
+        try:
+            go.wait()
+            for i in range(n_dumps):
+                paths.append(rec.dump(str(tmp_path), reason="manual"))
+        except Exception as e:  # pragma: no cover - failure signal
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(t,))
+               for t in range(n_threads)] + [threading.Thread(target=dumper)]
+    for t in threads:
+        t.start()
+    go.set()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    assert len(paths) == n_dumps and len(set(paths)) == n_dumps
+    for path in paths:
+        assert validate_file(path) == [], path
+        lines = open(path).read().splitlines()
+        trailer = json.loads(lines[-1])
+        assert trailer["event"] == "flightrec_dump"
+        assert trailer["records"] == len(lines) - 1
+    records, seen = rec.snapshot()
+    assert seen == n_threads * n_iter
+    assert len(records) == 128
+
+
+# ----------------------------------------------------------------- sigusr1
+
+@pytest.mark.skipif(not hasattr(signal, "SIGUSR1"),
+                    reason="platform has no SIGUSR1")
+def test_sigusr1_dumps_the_ring(tmp_path, capsys):
+    logger, rec = _logger_with_ring()
+    logger.event("graph_saved", path="g.json")
+    assert install_sigusr1(rec, str(tmp_path), logger=logger) is True
+    try:
+        os.kill(os.getpid(), signal.SIGUSR1)
+        dumps = [p for p in os.listdir(tmp_path)
+                 if p.startswith("flightrec_") and "sigusr1" in p]
+        assert len(dumps) == 1
+        assert validate_file(str(tmp_path / dumps[0])) == []
+    finally:
+        signal.signal(signal.SIGUSR1, signal.SIG_DFL)
+
+
+# -------------------------------------------------------------- abort paths
+
+def test_supervise_sweep_abort_dumps_recorder(tmp_path):
+    """rc-114 leg: ladder exhaustion emits structured_abort AND lands
+    the recorder's tail — the abort record rides inside the dump."""
+    from dgc_tpu.resilience.supervisor import SweepAbort, supervise_sweep
+
+    logger, rec = _logger_with_ring()
+    logger.event("sweep_start", backend="boom", initial_k=5,
+                 strict_decrement=False)
+
+    def boom():
+        raise RuntimeError("INTERNAL: no device")
+
+    with pytest.raises(SweepAbort):
+        supervise_sweep([("boom", boom)], initial_k=5, retry_budget=0,
+                        logger=logger, flight_recorder=rec,
+                        flightrec_dir=str(tmp_path))
+    dumps = [p for p in os.listdir(tmp_path) if p.startswith("flightrec_")]
+    assert len(dumps) == 1
+    path = str(tmp_path / dumps[0])
+    assert validate_file(path) == []
+    kinds = [json.loads(l)["event"] for l in open(path)]
+    assert kinds[0] == "sweep_start"
+    assert "structured_abort" in kinds      # the abort itself is in the tail
+    assert kinds[-1] == "flightrec_dump"
+
+
+def test_injected_kill_leaves_schema_valid_dump(tmp_path):
+    """rc-137 leg (acceptance): a chaos-plane kill at device_init
+    os._exit(137)s, yet the dump lands with the final pre-abort events
+    intact — graph_generated, sweep_start, then the fault itself."""
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "dgc_tpu.cli",
+         "--node-count", "400", "--max-degree", "8",
+         "--gen-method", "fast", "--seed", "1", "--backend", "ell",
+         "--output-coloring", str(tmp_path / "col.json"),
+         "--inject-faults", "device_init@1=kill",
+         "--flightrec-dir", str(tmp_path)],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 137, (r.returncode, r.stderr)
+    dumps = [p for p in os.listdir(tmp_path)
+             if p.startswith("flightrec_") and "injected_kill" in p]
+    assert len(dumps) == 1, r.stderr
+    path = str(tmp_path / dumps[0])
+    assert validate_file(path) == []
+    kinds = [json.loads(l)["event"] for l in open(path)]
+    # the tail is intact and ordered: the run's life up to the kill
+    assert kinds[:2] == ["graph_generated", "sweep_start"]
+    assert kinds[-2] == "fault_injected"
+    assert kinds[-1] == "flightrec_dump"
+
+
+def test_flightrec_capacity_zero_disables(tmp_path):
+    """--flightrec-capacity 0: no recorder, no dump on abort — the
+    pre-PR escape hatch."""
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    r = subprocess.run(
+        [sys.executable, "-m", "dgc_tpu.cli",
+         "--node-count", "400", "--max-degree", "8",
+         "--gen-method", "fast", "--backend", "ell",
+         "--output-coloring", str(tmp_path / "col.json"),
+         "--inject-faults", "device_init@1=kill",
+         "--flightrec-capacity", "0",
+         "--flightrec-dir", str(tmp_path)],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 137
+    assert not [p for p in os.listdir(tmp_path)
+                if p.startswith("flightrec_")]
+
+
+# ------------------------------------------------------------- httpd routes
+
+def test_httpd_debug_flightrec_route(tmp_path):
+    import urllib.request
+
+    from dgc_tpu.obs.httpd import MetricsHTTPServer
+
+    reg = MetricsRegistry()
+    logger, rec = _logger_with_ring(registry=reg)
+    logger.event("graph_saved", path="g.json")
+    srv = MetricsHTTPServer(reg, port=0, recorder=rec,
+                            flightrec_dir=str(tmp_path)).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        body = urllib.request.urlopen(f"{base}/debug/flightrec",
+                                      timeout=10).read().decode()
+        lines = [json.loads(l) for l in body.splitlines()]
+        assert lines[0]["event"] == "graph_saved"
+        assert lines[-1]["event"] == "flightrec_dump"
+        # ?file=1 dumps to disk and returns the path
+        out = json.loads(urllib.request.urlopen(
+            f"{base}/debug/flightrec?file=1", timeout=10).read())
+        assert os.path.exists(out["path"])
+        assert validate_file(out["path"]) == []
+        # /metrics still serves (the pre-PR routes are untouched)
+        prom = urllib.request.urlopen(f"{base}/metrics",
+                                      timeout=10).read().decode()
+        assert prom.endswith("\n")
+    finally:
+        srv.close()
+
+
+def test_httpd_debug_routes_404_when_unwired():
+    import urllib.error
+    import urllib.request
+
+    from dgc_tpu.obs.httpd import MetricsHTTPServer
+
+    srv = MetricsHTTPServer(MetricsRegistry(), port=0).start()
+    try:
+        for route in ("/debug/flightrec", "/debug/profile?ms=10"):
+            with pytest.raises(urllib.error.HTTPError) as ei:
+                urllib.request.urlopen(
+                    f"http://127.0.0.1:{srv.port}{route}", timeout=10)
+            assert ei.value.code == 404
+    finally:
+        srv.close()
+
+
+def test_httpd_debug_profile_route_bounds_and_capture(tmp_path):
+    """/debug/profile?ms= opens a real profiler window (CPU backend) and
+    rejects out-of-range ms with 400."""
+    import urllib.error
+    import urllib.request
+
+    from dgc_tpu.obs import profiler
+    from dgc_tpu.obs.httpd import MetricsHTTPServer
+
+    logdir = str(tmp_path / "prof")
+    logger, rec = _logger_with_ring()
+    srv = MetricsHTTPServer(
+        MetricsRegistry(), port=0,
+        profiler=lambda ms: profiler.timed_window(
+            logdir, ms, trigger="http", logger=logger)).start()
+    try:
+        base = f"http://127.0.0.1:{srv.port}"
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/debug/profile?ms=0", timeout=10)
+        assert ei.value.code == 400
+        with pytest.raises(urllib.error.HTTPError) as ei:
+            urllib.request.urlopen(f"{base}/debug/profile?ms=999999",
+                                   timeout=10)
+        assert ei.value.code == 400
+        out = json.loads(urllib.request.urlopen(
+            f"{base}/debug/profile?ms=30", timeout=60).read())
+        assert out["trigger"] == "http" and out["seconds"] >= 0.03
+        # the window event reached the ring too
+        records, _ = rec.snapshot()
+        assert any(r["event"] == "profile_window" for r in records)
+    finally:
+        srv.close()
+
+
+# ---------------------------------------------------------------- SLO hooks
+
+def test_slo_violation_hooks_dump_and_profile(tmp_path):
+    """tools/slo_check.ViolationHooks: a tripped gate dumps the ring and
+    opens a profiler window; a clean gate fires nothing."""
+    from tools.slo_check import ViolationHooks
+
+    logger, rec = _logger_with_ring()
+    logger.event("serve_done", requests=4, completed=3, failed=1)
+    hooks = ViolationHooks(recorder=rec, dump_dir=str(tmp_path),
+                           profile_logdir=str(tmp_path / "prof"),
+                           profile_ms=20, logger=logger)
+    assert hooks.fire([]) == {"dump": None, "profile": None}
+    out = hooks.fire(["failure rate: 1/4 > 0.0"])
+    assert out["dump"] and os.path.exists(out["dump"])
+    assert validate_file(out["dump"]) == []
+    assert out["profile"] is not None
+    assert out["profile"]["trigger"] == "slo_violation"
+    records, _ = rec.snapshot()
+    kinds = [r["event"] for r in records]
+    assert "flightrec_dump" in kinds and "profile_window" in kinds

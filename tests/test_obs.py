@@ -379,3 +379,87 @@ def test_bench_abort_record_carries_partial_phases(capsys):
     assert rec["value"] is None and rec["vs_baseline"] == 0.0
     assert rec["backend"] == "sharded" and rec["platform"] == "proxy"
     assert rec["phases"] == {"gen_s": 1.5, "engine_build_s": 0.25}
+
+
+# --------------------------------- PR 11: retrospective-layer event kinds
+
+def test_new_diagnostic_kinds_validate():
+    """flightrec_dump / profile_window / timing_crosscheck /
+    perf_regression are schema-enforced like every other kind."""
+    ok = [
+        {"t": 0.1, "event": "flightrec_dump", "reason": "manual",
+         "records": 3, "path": None, "open_spans": [], "metrics": None},
+        {"t": 0.1, "event": "profile_window", "trigger": "window",
+         "logdir": "/tmp/p", "seconds": 0.5, "xplane": None, "first": 1},
+        {"t": 0.1, "event": "timing_crosscheck", "in_kernel_ms": 10.0,
+         "xplane_ms": 12.0, "verdict": "ok", "coverage": 0.83},
+        {"t": 0.1, "event": "perf_regression", "metric": "m",
+         "value": 1.0, "regression": False, "baseline_median": None},
+    ]
+    for rec in ok:
+        assert validate_record(rec) == [], rec
+    assert validate_record({"t": 0.1, "event": "flightrec_dump",
+                            "reason": "x"})          # missing records
+    assert validate_record({"t": 0.1, "event": "timing_crosscheck",
+                            "in_kernel_ms": 1.0, "xplane_ms": 2.0,
+                            "verdict": "ok", "bogus": 1})
+
+
+def test_validate_runlog_semantic_field_enforcement(tmp_path):
+    """Beyond types: counts non-negative, verdict vocabulary closed,
+    regression verdicts carry their baseline (tools/validate_runlog)."""
+    import sys
+    sys.path.insert(0, "tools")
+    from validate_runlog import _semantic_problems
+
+    assert _semantic_problems(
+        {"event": "flightrec_dump", "reason": "x", "records": -1})
+    assert _semantic_problems(
+        {"event": "profile_window", "seconds": -0.1})
+    assert _semantic_problems(
+        {"event": "timing_crosscheck", "verdict": "maybe"})
+    assert _semantic_problems(
+        {"event": "perf_regression", "regression": True,
+         "baseline_median": None})
+    assert _semantic_problems(
+        {"event": "perf_regression", "regression": True,
+         "baseline_median": 1.0}) == []
+    bad = tmp_path / "bad.jsonl"
+    bad.write_text(json.dumps(
+        {"t": 0.0, "event": "timing_crosscheck", "in_kernel_ms": 1.0,
+         "xplane_ms": 2.0, "verdict": "maybe"}) + "\n")
+    import validate_runlog
+
+    assert validate_runlog.main([str(bad)]) == 1
+
+
+def test_manifest_and_report_carry_diagnostic_slots(capsys):
+    """The manifest grows flightrec/profiles/timing_crosscheck/perf
+    slots only when the events appear, and report_run renders them."""
+    import sys
+    sys.path.insert(0, "tools")
+    from report_run import render
+
+    m = RunManifest()
+    base_keys = set(m.doc)
+    m({"t": 0.0, "event": "sweep_start", "backend": "ell", "initial_k": 5,
+       "strict_decrement": False})
+    assert set(m.doc) == base_keys          # no events, no new slots
+    m({"t": 0.1, "event": "profile_window", "trigger": "window",
+       "logdir": "/tmp/p", "seconds": 1.5, "xplane": "/tmp/p/x.xplane.pb"})
+    m({"t": 0.2, "event": "timing_crosscheck", "in_kernel_ms": 100.0,
+       "xplane_ms": 130.0, "verdict": "ok", "coverage": 0.77})
+    m({"t": 0.3, "event": "flightrec_dump", "reason": "sigusr1",
+       "records": 12, "path": "/tmp/fr.jsonl", "open_spans": ["queue"]})
+    m({"t": 0.4, "event": "perf_regression", "metric": "m", "value": 2.0,
+       "unit": "s", "regression": True, "baseline_median": 1.0,
+       "delta_pct": 100.0, "samples": 3})
+    assert m.doc["profiles"][0]["xplane"] == "/tmp/p/x.xplane.pb"
+    assert m.doc["timing_crosscheck"]["verdict"] == "ok"
+    assert m.doc["flightrec"][0]["records"] == 12
+    assert m.doc["perf"][0]["regression"] is True
+    text = render(m.doc)
+    assert "profile:" in text and "x.xplane.pb" in text
+    assert "xcheck:" in text and "OK" in text
+    assert "flightrec:" in text and "1 span(s) in flight" in text
+    assert "perf:" in text and "REGRESSION" in text

@@ -1,0 +1,159 @@
+"""Perf-history ledger (tools/perf_db.py) + the bench --perf-db
+tripwire: append-only round trip, direction-aware median regression
+verdicts, slo_check-style exit codes, and the acceptance leg — two
+bench runs with an injected slowdown flag a regression (exit != 0)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from tools import perf_db  # noqa: E402
+
+
+def _rec(value, metric="m", unit="s", **kw):
+    return dict({"metric": metric, "value": value, "unit": unit,
+                 "backend": "ell-compact", "platform": "cpu"}, **kw)
+
+
+# --------------------------------------------------------------- round trip
+
+def test_ledger_appends_and_reloads(tmp_path):
+    db = str(tmp_path / "db.jsonl")
+    for v in (1.0, 1.1, 0.9):
+        perf_db.record_and_check(db, _rec(v), host="h1")
+    entries = perf_db.load(db)
+    assert [e["value"] for e in entries] == [1.0, 1.1, 0.9]
+    key = perf_db.entry_key(_rec(1.0), host="h1")
+    assert perf_db.history_values(entries, key) == [1.0, 1.1, 0.9]
+    # the ledger is self-describing: each entry embeds its verdict
+    assert entries[0]["verdict"]["samples"] == 0
+    assert entries[2]["verdict"]["samples"] == 2
+
+
+def test_ledger_tolerates_torn_tail(tmp_path):
+    db = tmp_path / "db.jsonl"
+    perf_db.record_and_check(str(db), _rec(1.0))
+    with open(db, "a") as fh:
+        fh.write('{"key": {"metric": "m"}, "val')   # killed mid-append
+    assert len(perf_db.load(str(db))) == 1
+
+
+def test_key_separates_config_host_and_shape(tmp_path):
+    db = str(tmp_path / "db.jsonl")
+    perf_db.record_and_check(db, _rec(1.0), host="h1")
+    # different host / tuned config / shape hash → fresh baselines
+    for variant in (dict(host="h2"),
+                    dict(host="h1", extra={"tuned_config": "t.json"}),
+                    dict(host="h1", extra={"graph_shape_hash": "dgcshape-x"})):
+        v = perf_db.record_and_check(
+            db, _rec(99.0, **variant.get("extra", {})),
+            host=variant["host"])
+        assert v["samples"] == 0 and not v["regression"], variant
+
+
+def test_direction_aware_regression():
+    # seconds: bigger is worse
+    v = perf_db.check([1.0, 1.0, 1.0], 1.2, "lower", threshold=0.1)
+    assert v["regression"] and v["delta_pct"] == pytest.approx(20.0)
+    assert not perf_db.check([1.0], 1.05, "lower", threshold=0.1)["regression"]
+    # throughput: smaller is worse
+    v = perf_db.check([10.0, 10.0], 8.0, "higher", threshold=0.1)
+    assert v["regression"] and v["delta_pct"] == pytest.approx(20.0)
+    assert not perf_db.check([10.0], 11.0, "higher", threshold=0.1)["regression"]
+    # an IMPROVEMENT is never a regression in either direction
+    assert not perf_db.check([1.0], 0.5, "lower")["regression"]
+    assert not perf_db.check([10.0], 20.0, "higher")["regression"]
+
+
+def test_abort_records_never_enter_the_ledger(tmp_path):
+    db = str(tmp_path / "db.jsonl")
+    v = perf_db.record_and_check(db, _rec(None))
+    assert not v["regression"]
+    assert not os.path.exists(db) or perf_db.load(db) == []
+
+
+def test_perf_regression_event_is_schema_valid(tmp_path):
+    from dgc_tpu.obs.events import RunLogger
+    from tools.validate_runlog import validate_file
+
+    db = str(tmp_path / "db.jsonl")
+    log = str(tmp_path / "run.jsonl")
+    logger = RunLogger(jsonl_path=log, echo=False)
+    perf_db.record_and_check(db, _rec(1.0), logger=logger)
+    perf_db.record_and_check(db, _rec(5.0), logger=logger)
+    logger.close()
+    assert validate_file(log) == []
+    events = [json.loads(l) for l in open(log)]
+    assert events[-1]["event"] == "perf_regression"
+    assert events[-1]["regression"] is True
+
+
+# --------------------------------------------------------------------- CLI
+
+def _cli(*args, stdin=None):
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "perf_db.py"), *args],
+        input=stdin, capture_output=True, text=True, cwd=ROOT, timeout=120)
+
+
+def test_cli_add_and_report_exit_codes(tmp_path):
+    db = str(tmp_path / "db.jsonl")
+    r = _cli("add", "--db", db, stdin=json.dumps(_rec(1.0)))
+    assert r.returncode == 0, r.stderr
+    assert "baseline seeded" in r.stderr
+    r = _cli("add", "--db", db, stdin=json.dumps(_rec(1.01)))
+    assert r.returncode == 0
+    r = _cli("add", "--db", db, "--dry-run", stdin=json.dumps(_rec(9.0)))
+    assert r.returncode == 1
+    assert "REGRESSION" in r.stderr
+    assert len(perf_db.load(db)) == 2          # dry-run appended nothing
+    r = _cli("add", "--db", db, stdin="not json")
+    assert r.returncode == 2
+    r = _cli("report", "--db", db)
+    assert r.returncode == 0 and "2 run(s)" in r.stdout
+
+
+# ------------------------------------------------------ bench integration
+
+def _run_bench(tmp_path, *args):
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    return subprocess.run(
+        [sys.executable, os.path.join(ROOT, "bench.py"), *args],
+        env=env, cwd=str(tmp_path), capture_output=True, text=True,
+        timeout=600)
+
+
+@pytest.mark.slow
+def test_bench_perf_db_flags_injected_slowdown(tmp_path):
+    """Acceptance leg: two bench runs over the same key, the second with
+    an injected slowdown (a chaos-plane hang inside the measured sweep
+    dispatch) — the second exits nonzero and the printed record carries
+    the regression verdict."""
+    db = str(tmp_path / "perf.jsonl")
+    base = ("--nodes", "400", "--avg-degree", "6", "--retries", "1",
+            "--perf-db", db)
+    r1 = _run_bench(tmp_path, *base)
+    assert r1.returncode == 0, r1.stderr
+    d1 = json.loads([l for l in r1.stdout.splitlines()
+                     if l.startswith("{")][0])
+    assert d1["perf_db"]["samples"] == 0       # baseline seeded
+
+    # occurrence 2 = the measured sweep dispatch (1 = warmup)
+    r2 = _run_bench(tmp_path, *base,
+                    "--inject-faults", "attempt@2=hang:1.5")
+    assert r2.returncode == 1, (r2.returncode, r2.stderr)
+    d2 = json.loads([l for l in r2.stdout.splitlines()
+                     if l.startswith("{")][0])
+    assert d2["perf_db"]["regression"] is True
+    assert d2["perf_db"]["delta_pct"] > 10
+    assert "REGRESSION" in r2.stderr
+    # both runs landed in the ledger under one key
+    entries = perf_db.load(db)
+    assert len(entries) == 2
+    assert entries[0]["key"] == entries[1]["key"]

@@ -1,0 +1,221 @@
+"""Profiler windows (obs.profiler) + xplane self-time split and the
+devclock timing-column cross-check (tools/xplane_split.py)."""
+
+import json
+import os
+import subprocess
+import sys
+
+import pytest
+
+ROOT = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, ROOT)
+
+from dgc_tpu.obs import profiler  # noqa: E402
+from dgc_tpu.obs.events import RunLogger  # noqa: E402
+from dgc_tpu.obs.manifest import RunManifest  # noqa: E402
+
+
+def _has_xplane_proto() -> bool:
+    try:
+        from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: F401
+        return True
+    except ImportError:
+        return False
+
+
+needs_proto = pytest.mark.skipif(not _has_xplane_proto(),
+                                 reason="tsl xplane protobuf unavailable")
+
+
+# ------------------------------------------------------------- window spec
+
+def test_parse_window_forms():
+    assert profiler.parse_window("1") == (1, 1)
+    assert profiler.parse_window("3:4") == (3, 4)
+    for bad in ("0", "1:0", "-1", "x", "1:y", ""):
+        with pytest.raises(ValueError):
+            profiler.parse_window(bad)
+
+
+def test_timed_window_emits_event_and_single_flight(tmp_path):
+    logger = RunLogger(jsonl_path=None, echo=False)
+    manifest = RunManifest()
+    logger.add_sink(manifest)
+    out = profiler.timed_window(str(tmp_path / "p"), 20, trigger="test",
+                                logger=logger)
+    assert out is not None and out["seconds"] >= 0.02
+    assert manifest.doc["profiles"][0]["trigger"] == "test"
+    # single-flight: a second window while one is open returns None
+    assert profiler._try_begin() is True
+    try:
+        assert profiler.timed_window(str(tmp_path / "q"), 10) is None
+    finally:
+        profiler._end()
+
+
+def test_dispatch_window_wraps_kth_dispatch(tmp_path, monkeypatch):
+    """The proxy counts dispatches across wrapped engines (ladder rungs
+    share the counter) and opens/closes the window around K..K+W-1;
+    close() finishes an early-converged run's still-open window."""
+    calls = []
+    monkeypatch.setattr(profiler, "_start_trace",
+                        lambda logdir: calls.append("start") or True)
+    monkeypatch.setattr(
+        profiler, "_stop_trace",
+        lambda logdir, t0, trigger, logger=None, **kw:
+            calls.append("stop") or {"trigger": trigger, **kw})
+
+    class Eng:
+        def attempt(self, k):
+            calls.append(f"a{k}")
+            return k
+
+    win = profiler.DispatchWindow(2, 2, str(tmp_path), logger=None)
+    e1 = win.wrap(Eng())
+    e1.attempt(1)
+    e2 = win.wrap(Eng())      # a second rung: same counter
+    e2.attempt(2)
+    e2.attempt(3)
+    e2.attempt(4)
+    assert calls == ["a1", "start", "a2", "a3", "stop", "a4"]
+    assert win.result["first"] == 2 and win.result["count"] == 2
+    win.close()               # idempotent after finish
+    assert calls[-1] == "a4"
+
+    calls.clear()
+    win2 = profiler.DispatchWindow(1, 99, str(tmp_path))
+    we = win2.wrap(Eng())
+    we.attempt(1)
+    assert calls == ["start", "a1"]
+    win2.close()              # run ended inside the window
+    assert calls[-1] == "stop"
+
+
+def test_dispatch_window_proxy_mirrors_sweep_detection():
+    class Fused:
+        def sweep(self, k0):
+            return ["swept"]
+
+        def attempt(self, k):
+            return k
+
+    class Plain:
+        def attempt(self, k):
+            return k
+
+    win = profiler.DispatchWindow(99, 1, "/tmp/unused")
+    assert hasattr(win.wrap(Fused()), "sweep")
+    assert not hasattr(win.wrap(Plain()), "sweep")
+
+
+# ----------------------------------------------------- xplane split library
+
+@needs_proto
+def test_attribute_xspace_filters_compile_scaffolding(tmp_path):
+    """A cold-window CPU capture must attribute EXECUTED ops, not the
+    jit compile passes that ride the python/codegen thread lines."""
+    import jax
+    import jax.numpy as jnp
+
+    logdir = str(tmp_path / "prof")
+    with jax.profiler.trace(logdir):
+        x = jnp.arange(4096)
+        y = jax.jit(lambda v: (v * 3 + 1).sum())(x)
+        jax.block_until_ready(y)
+    from tools.xplane_split import attribute_xspace, resolve_artifact
+
+    split = attribute_xspace(resolve_artifact(logdir))
+    assert split["device_op_time_s"] >= 0
+    for op in split["top_ops"]:
+        assert "Compile" not in op["op"], split["top_ops"]
+        assert "TaskDispatcher" not in op["op"], split["top_ops"]
+
+
+def test_resolve_artifact_forms(tmp_path):
+    from tools.xplane_split import resolve_artifact
+
+    pb = tmp_path / "a" / "x.xplane.pb"
+    pb.parent.mkdir()
+    pb.write_bytes(b"")
+    assert resolve_artifact(str(pb)) == str(pb)
+    assert resolve_artifact(str(tmp_path)) == str(pb)
+    man = tmp_path / "m.json"
+    man.write_text(json.dumps(
+        {"manifest_version": 1,
+         "profiles": [{"xplane": None}, {"xplane": str(pb)}]}))
+    assert resolve_artifact(str(man)) == str(pb)
+    man2 = tmp_path / "m2.json"
+    man2.write_text(json.dumps({"manifest_version": 1, "profiles": []}))
+    with pytest.raises(ValueError):
+        resolve_artifact(str(man2))
+    empty = tmp_path / "empty"
+    empty.mkdir()
+    with pytest.raises(ValueError):
+        resolve_artifact(str(empty))
+
+
+def test_in_kernel_ms_and_crosscheck_rule():
+    from tools.xplane_split import crosscheck, in_kernel_ms
+
+    doc = {"attempts": [
+        {"trajectory": {"step_us": [-1, 500, 500]}},
+        {"trajectory": {"step_us": [1000]}},
+        {"trajectory": None},
+    ]}
+    ms, attempts, steps = in_kernel_ms(doc)
+    assert (ms, attempts, steps) == (2.0, 2, 3)
+
+    v = crosscheck({"device_op_time_s": 0.004}, 2.0)
+    assert v["verdict"] == "ok" and v["coverage"] == 0.5
+    v = crosscheck({"device_op_time_s": 0.004}, 0.2)
+    assert v["verdict"] == "divergent"
+    v = crosscheck({"device_op_time_s": 0.004}, 8.0)   # column > device
+    assert v["verdict"] == "divergent"
+    v = crosscheck({"device_op_time_s": 0.0}, 1.0)     # no device time
+    assert v["verdict"] == "divergent" and v["coverage"] is None
+
+
+# ------------------------------------------------- end-to-end CPU crosscheck
+
+@needs_proto
+@pytest.mark.slow
+def test_cli_profile_window_to_crosscheck_verdict(tmp_path):
+    """Acceptance leg: a CPU run of --profile-window + xplane_split
+    emits a schema-valid ok timing_crosscheck verdict (the devclock
+    column and the CPU plane share a clock domain)."""
+    env = dict(os.environ, PYTHONPATH=ROOT, JAX_PLATFORMS="cpu")
+    man = tmp_path / "man.json"
+    r = subprocess.run(
+        [sys.executable, "-m", "dgc_tpu.cli",
+         "--node-count", "4000", "--max-degree", "16",
+         "--gen-method", "fast", "--seed", "3", "--backend", "ell-compact",
+         "--output-coloring", str(tmp_path / "col.json"),
+         "--run-manifest", str(man), "--superstep-timing",
+         "--profile-window", "1:99",
+         "--profile-logdir", str(tmp_path / "prof"),
+         "--flightrec-dir", str(tmp_path)],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=420)
+    assert r.returncode == 0, r.stderr
+    doc = json.loads(man.read_text())
+    assert doc["profiles"] and doc["profiles"][0]["xplane"]
+
+    xc_log = tmp_path / "xc.jsonl"
+    r = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "xplane_split.py"),
+         str(man), "--emit-runlog", str(xc_log), "--strict"],
+        env=env, cwd=ROOT, capture_output=True, text=True, timeout=300)
+    assert r.returncode == 0, r.stdout + r.stderr
+    out = json.loads(r.stdout.splitlines()[-1])
+    verdict = out["timing_crosscheck"]
+    assert verdict["verdict"] == "ok", verdict
+    assert 0 < verdict["in_kernel_ms"] <= verdict["xplane_ms"] * 1.25
+    from tools.validate_runlog import validate_file
+
+    assert validate_file(str(xc_log)) == []
+    # the verdict renders in the run report
+    rep = subprocess.run(
+        [sys.executable, os.path.join(ROOT, "tools", "report_run.py"),
+         str(man)], env=env, cwd=ROOT, capture_output=True, text=True,
+        timeout=120)
+    assert rep.returncode == 0 and "profile:" in rep.stdout

@@ -4,7 +4,13 @@
 #   1. dgc-lint --strict           — the five static passes vs the baseline
 #   2. dgc-lint --fix --check      — no mechanical fix may be pending
 #   3. ruff check (if installed)   — the generic layer (pyproject config)
-# Fast (AST only, no kernels compiled) — seconds, not minutes.
+#   4. retrospective-layer CPU smoke (PR 11, skip with DGC_TPU_CI_NO_SMOKE=1):
+#      a tiny profile window -> tools/xplane_split.py -> a parsing
+#      timing_crosscheck verdict, and a perf-ledger round trip with a
+#      forced regression exiting nonzero.
+# Steps 1-3 are AST-only (seconds); step 4 compiles one toy kernel on
+# CPU (~1 min cold) — the only gate that proves the profiler plumbing
+# end-to-end before device time is spent.
 set -u
 cd "$(dirname "$0")/.."
 rc=0
@@ -23,6 +29,60 @@ if command -v ruff >/dev/null 2>&1; then
   ruff check dgc_tpu tools bench.py || rc=1
 else
   echo "ci_checks: ruff not installed — skipping (config in pyproject.toml)" >&2
+fi
+
+if [ "${DGC_TPU_CI_NO_SMOKE:-0}" != "1" ]; then
+  echo "=== retrospective-layer CPU smoke ===" >&2
+  SMOKE_DIR=$(mktemp -d)
+  # profile window -> xplane split -> crosscheck verdict parses; the
+  # xplane protobuf is optional on minimal images — absent skips, never
+  # fails (the tier-1 tests carry the same skip)
+  if python - <<'EOF' 2>/dev/null
+from tensorflow.tsl.profiler.protobuf import xplane_pb2  # noqa: F401
+EOF
+  then
+    if JAX_PLATFORMS=cpu timeout 300 python -m dgc_tpu.cli \
+        --node-count 2000 --max-degree 12 --gen-method fast --seed 3 \
+        --backend ell-compact \
+        --output-coloring "$SMOKE_DIR/col.json" \
+        --run-manifest "$SMOKE_DIR/man.json" --superstep-timing \
+        --profile-window 1:99 --profile-logdir "$SMOKE_DIR/prof" \
+        --flightrec-dir "$SMOKE_DIR" >/dev/null 2>&1 \
+      && JAX_PLATFORMS=cpu timeout 120 python tools/xplane_split.py \
+        "$SMOKE_DIR/man.json" --emit-runlog "$SMOKE_DIR/xc.jsonl" \
+        2>/dev/null | python -c '
+import json, sys
+d = json.load(sys.stdin)
+v = d["timing_crosscheck"]
+assert v["verdict"] in ("ok", "divergent") and v["in_kernel_ms"] > 0, v
+print("ci_checks: crosscheck verdict %s (coverage %s)"
+      % (v["verdict"], v["coverage"]), file=sys.stderr)
+' \
+      && timeout 60 python tools/validate_runlog.py -q "$SMOKE_DIR/xc.jsonl"
+    then
+      echo "ci_checks: profile-window -> xplane_split smoke OK" >&2
+    else
+      echo "ci_checks: profile-window -> xplane_split smoke FAILED" >&2
+      rc=1
+    fi
+  else
+    echo "ci_checks: tsl xplane protobuf unavailable — skipping profiler smoke" >&2
+  fi
+
+  # perf-ledger round trip: seed a baseline, then a 2x slower record
+  # must exit 1 (the regression tripwire contract)
+  if echo '{"metric":"ci_smoke","value":1.0,"unit":"s","backend":"x","platform":"cpu"}' \
+      | timeout 60 python tools/perf_db.py add --db "$SMOKE_DIR/perf.jsonl" >/dev/null 2>&1 \
+    && ! echo '{"metric":"ci_smoke","value":2.0,"unit":"s","backend":"x","platform":"cpu"}' \
+      | timeout 60 python tools/perf_db.py add --db "$SMOKE_DIR/perf.jsonl" >/dev/null 2>&1 \
+    && timeout 60 python tools/perf_db.py report --db "$SMOKE_DIR/perf.jsonl" >/dev/null
+  then
+    echo "ci_checks: perf_db round-trip smoke OK" >&2
+  else
+    echo "ci_checks: perf_db round-trip smoke FAILED" >&2
+    rc=1
+  fi
+  rm -rf "$SMOKE_DIR"
 fi
 
 exit $rc

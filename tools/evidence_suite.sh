@@ -63,10 +63,10 @@ if [ "$battery_rc" -ne 2 ]; then
   # results are bit-identical by construction, so any color/superstep
   # drift in these rows is a bug, not a tuning effect.
   echo "=== tuned-vs-static A/B (200k RMAT) ===" | tee -a /dev/stderr >/dev/null
-  timeout 3600 python bench.py --gen rmat --nodes 200000 2>&1 \
+  timeout 3600 python bench.py --gen rmat --nodes 200000 --perf-db PERF_DB.jsonl 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
   timeout 3600 python bench.py --gen rmat --nodes 200000 \
-    --tuned-config tools/tuned_configs/rmat_200k.json 2>&1 \
+    --tuned-config tools/tuned_configs/rmat_200k.json --perf-db PERF_DB.jsonl 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
   # serve-throughput A/B (PR 5/6, dgc_tpu.serve): graphs/s of the batched
@@ -91,7 +91,7 @@ if [ "$battery_rc" -ne 2 ]; then
   echo "=== serve throughput A/B (20k class, batch 1/8/32, continuous vs sync) ===" | tee -a /dev/stderr >/dev/null
   timeout 5400 python bench.py --serve-throughput \
     --serve-graphs 64 --serve-batch-sizes 1,8,32 \
-    --serve-modes continuous,sync 2>&1 \
+    --serve-modes continuous,sync --perf-db PERF_DB.jsonl 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
   # staged-ladder + device-carry serve A/B (PR 9): the same 64-graph
@@ -106,31 +106,35 @@ if [ "$battery_rc" -ne 2 ]; then
   echo "=== serve staged/devcarry A/B (20k class, batch 1/8/32) ===" | tee -a /dev/stderr >/dev/null
   timeout 7200 python bench.py --serve-throughput \
     --serve-graphs 64 --serve-batch-sizes 1,8,32 \
-    --serve-modes continuous,continuous+nostage,continuous+devcarry 2>&1 \
+    --serve-modes continuous,continuous+nostage,continuous+devcarry --perf-db PERF_DB.jsonl 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
-  # in-kernel timing column cross-check (PR 7, obs.devclock): the same
-  # 200k-RMAT attempt run twice — once with --superstep-timing recording
-  # the trajectory buffer's col-5 device wall-time, once through the
-  # host-stepped trace_attempt xplane capture above — so the timing
-  # column's per-superstep µs can be compared against the XPlane op
-  # self-times (trace_attr_r4.jsonl). Expected: the column's total
-  # in-kernel ms ≈ the xplane device self-time sum within the callback
-  # hop overhead; a large gap means the TPU timing path needs the native
-  # cycle-counter primitive before the column's absolute values are
-  # trusted on-chip (CPU values are exact either way).
+  # in-kernel timing column cross-check (PR 7 queued it, PR 11 tooled
+  # it): ONE 200k-RMAT run with --superstep-timing (the trajectory
+  # buffer's col-5 device wall-time) AND a --profile-window over every
+  # dispatch, then tools/xplane_split.py consumes the manifest-linked
+  # artifact and emits the timing_crosscheck verdict — the measured
+  # answer to whether the callback-based clock is trustworthy on-chip
+  # (CPU verdict: ok at coverage ~0.8, PERF.md "Timing-column vs xplane
+  # cross-check"). A divergent TPU verdict routes to the ROADMAP native
+  # cycle-counter follow-on before the column's absolute values are
+  # trusted there.
   echo "=== timing-column vs xplane self-time (200k RMAT) ===" | tee -a /dev/stderr >/dev/null
   timeout 3600 python -m dgc_tpu.cli --node-count 200000 --max-degree 64 \
     --gen-method rmat --seed 7 --backend ell-compact \
     --output-coloring /tmp/dgc_timing_xcheck.json \
-    --run-manifest timing_xcheck_r7.json --superstep-timing 2>&1 \
+    --run-manifest timing_xcheck_r7.json --superstep-timing \
+    --profile-window 1:99 --profile-logdir /tmp/dgc_profile_xcheck 2>&1 \
     | tee -a /dev/stderr >/dev/null || true
+  timeout 600 python tools/xplane_split.py timing_xcheck_r7.json \
+    --emit-runlog timing_crosscheck_r7.jsonl 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> trace_attr_r4.jsonl || true
 
   echo "=== tuned-vs-static A/B (1M RMAT) ===" | tee -a /dev/stderr >/dev/null
-  timeout 7200 python bench.py --gen rmat --nodes 1000000 2>&1 \
+  timeout 7200 python bench.py --gen rmat --nodes 1000000 --perf-db PERF_DB.jsonl 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
   timeout 7200 python bench.py --gen rmat --nodes 1000000 \
-    --tuned-config tools/tuned_configs/rmat_1m.json 2>&1 \
+    --tuned-config tools/tuned_configs/rmat_1m.json --perf-db PERF_DB.jsonl 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
   echo "=== cold compile, unified pipeline 1M-RMAT ===" | tee -a /dev/stderr >/dev/null
@@ -140,7 +144,7 @@ if [ "$battery_rc" -ne 2 ]; then
   # jsonl like the battery's
   COLD_CACHE=$(mktemp -d)
   JAX_COMPILATION_CACHE_DIR="$COLD_CACHE" timeout 6000 \
-    python bench.py --gen rmat --nodes 1000000 --include-compile 2>&1 \
+    python bench.py --gen rmat --nodes 1000000 --include-compile --perf-db PERF_DB.jsonl 2>&1 \
     | tee -a /dev/stderr | grep '^{' | grep -v '"bench_aborted' >> "$OUT" || true
   rm -rf "$COLD_CACHE"
 fi

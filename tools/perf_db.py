@@ -1,0 +1,269 @@
+#!/usr/bin/env python
+"""Perf-history ledger: append-only JSONL of bench results + regression
+verdicts.
+
+Every CPU-measured serve win is "a prediction, not a result" (ROADMAP
+item 5) partly because nothing persists performance over time — BENCH_*
+.json files are loose snapshots nobody compares. This ledger makes the
+trajectory a data structure: ``bench.py --perf-db PATH`` (and this CLI)
+append one entry per measurement, keyed by
+
+    (metric, graph-shape hash, config hash, host, platform, backend)
+
+and every append is checked against the **median of the key's prior
+entries**: a value worse than ``median × (1 + threshold)`` (direction-
+aware — seconds want lower, graphs/s want higher) is a regression, and
+the check exits nonzero exactly like ``tools/slo_check.py`` — a perf
+regression fails the run, it does not just lower a number in a file.
+When the axon tunnel returns, the evidence battery's rows land here and
+the next round can ask "faster or slower than last round?" of a store
+instead of a human.
+
+Entry schema (one JSON object per line)::
+
+    {"key": {"metric", "shape", "config", "host", "platform", "backend"},
+     "value", "unit", "better": "lower"|"higher",
+     "verdict": {...perf_regression fields...}, "record": {...}}
+
+``config`` hashes the measurement-relevant knobs of the bench record
+(mode/slice/tuning/compile flags) so a tuned run never compares against
+an untuned baseline; ``record`` keeps the full bench JSON line for
+forensics.
+
+CLI:
+  python tools/perf_db.py add --db PERF_DB.jsonl [--record FILE|-]
+      [--threshold 0.10] [--dry-run]       # exit 1 on regression
+  python tools/perf_db.py report --db PERF_DB.jsonl [--metric SUBSTR]
+"""
+
+from __future__ import annotations
+
+import argparse
+import hashlib
+import json
+import os
+import socket
+import sys
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+DEFAULT_THRESHOLD = 0.10
+
+# bench-record fields that change what the number MEANS (two entries are
+# comparable history only when all of these match); the metric string
+# already encodes nodes/avg-degree/generator/backend/batch
+_CONFIG_FIELDS = ("metric", "unit", "backend", "platform", "serve_mode",
+                  "slice_steps", "tuned_config", "shape_class",
+                  "include_compile")
+
+# units where smaller is better; rates are better bigger
+_LOWER_IS_BETTER_UNITS = ("s", "ms", "us", "bytes")
+
+
+def config_hash(record: dict) -> str:
+    """Stable hash of the measurement-relevant bench-record config."""
+    cfg = {k: record.get(k) for k in _CONFIG_FIELDS}
+    blob = json.dumps(cfg, sort_keys=True).encode()
+    return "dgccfg-" + hashlib.sha256(blob).hexdigest()[:16]
+
+
+def better_direction(record: dict) -> str:
+    unit = record.get("unit")
+    return "lower" if unit in _LOWER_IS_BETTER_UNITS else "higher"
+
+
+def entry_key(record: dict, *, host: str | None = None) -> dict:
+    return {
+        "metric": record.get("metric"),
+        "shape": record.get("graph_shape_hash"),
+        "config": config_hash(record),
+        "host": host or socket.gethostname(),
+        "platform": record.get("platform"),
+        "backend": record.get("backend"),
+    }
+
+
+def load(path: str) -> list:
+    """All parseable entries of a ledger (a torn trailing line — a run
+    killed mid-append — is tolerated like every JSONL reader here)."""
+    if not os.path.exists(path):
+        return []
+    with open(path) as fh:
+        raw = fh.read()
+    lines = raw.split("\n")
+    torn_tail = not raw.endswith("\n")
+    out = []
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            out.append(json.loads(line))
+        except json.JSONDecodeError:
+            if torn_tail and i == len(lines) - 1:
+                continue
+            raise
+    return out
+
+
+def history_values(entries: list, key: dict) -> list:
+    """Prior values of one key, in append order (None values — abort
+    records — never enter the ledger, but skip defensively)."""
+    return [e["value"] for e in entries
+            if e.get("key") == key and e.get("value") is not None]
+
+
+def _median(xs: list) -> float:
+    ys = sorted(xs)
+    n = len(ys)
+    return ys[n // 2] if n % 2 else (ys[n // 2 - 1] + ys[n // 2]) / 2.0
+
+
+def check(baseline: list, value: float, better: str,
+          threshold: float = DEFAULT_THRESHOLD) -> dict:
+    """Regression verdict of ``value`` against the key's history
+    (``perf_regression`` event fields, obs.schema). No history → no
+    verdict to render, never a regression (the first entry seeds the
+    baseline)."""
+    if not baseline:
+        return {"regression": False, "baseline_median": None,
+                "delta_pct": None, "samples": 0, "better": better,
+                "threshold_pct": round(threshold * 100, 2)}
+    med = _median(baseline)
+    # delta_pct > 0 always means WORSE, whichever way better points
+    if better == "lower":
+        delta = (value - med) / med if med else 0.0
+    else:
+        delta = (med - value) / med if med else 0.0
+    return {"regression": delta > threshold,
+            "baseline_median": round(med, 6),
+            "delta_pct": round(delta * 100, 2),
+            "samples": len(baseline), "better": better,
+            "threshold_pct": round(threshold * 100, 2)}
+
+
+def record_and_check(db_path: str, record: dict, *,
+                     threshold: float = DEFAULT_THRESHOLD,
+                     host: str | None = None, append: bool = True,
+                     logger=None) -> dict:
+    """Append one bench record to the ledger and return its verdict
+    (appended WITH the verdict, so the ledger is self-describing).
+    Records without a measured value (abort records) are not appended
+    and get a no-verdict result. ``logger`` (optional) emits the
+    ``perf_regression`` event into a run-log stream."""
+    value = record.get("value")
+    verdict = {"metric": record.get("metric"), "value": value,
+               "unit": record.get("unit"), "db": db_path}
+    if value is None:
+        verdict.update(check([], 0.0, better_direction(record), threshold))
+        verdict["regression"] = False
+        return verdict
+    key = entry_key(record, host=host)
+    entries = load(db_path)
+    baseline = history_values(entries, key)
+    verdict.update(check(baseline, float(value), better_direction(record),
+                         threshold))
+    if append:
+        entry = {"key": key, "value": value, "unit": record.get("unit"),
+                 "better": verdict["better"],
+                 "verdict": {k: verdict[k] for k in
+                             ("regression", "baseline_median", "delta_pct",
+                              "samples", "threshold_pct")},
+                 "record": record}
+        with open(db_path, "a") as fh:
+            fh.write(json.dumps(entry) + "\n")
+    if logger is not None:
+        logger.event("perf_regression", metric=verdict["metric"],
+                     value=value, regression=verdict["regression"],
+                     baseline_median=verdict["baseline_median"],
+                     delta_pct=verdict["delta_pct"],
+                     samples=verdict["samples"], better=verdict["better"],
+                     threshold_pct=verdict["threshold_pct"],
+                     db=db_path, unit=record.get("unit"))
+    return verdict
+
+
+def render_verdict(verdict: dict) -> str:
+    """One human line (bench prints it to stderr beside the JSON)."""
+    if verdict.get("samples", 0) == 0:
+        return (f"perf-db: {verdict.get('metric')} = "
+                f"{verdict.get('value')} {verdict.get('unit') or ''} "
+                f"(first entry for this key — baseline seeded)")
+    word = "REGRESSION" if verdict["regression"] else "ok"
+    return (f"perf-db: {verdict.get('metric')} = {verdict.get('value')} "
+            f"{verdict.get('unit') or ''} vs median "
+            f"{verdict['baseline_median']} over {verdict['samples']} "
+            f"run(s): {verdict['delta_pct']:+.1f}% "
+            f"({verdict['better']} is better) -> {word}")
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    sub = p.add_subparsers(dest="cmd", required=True)
+    pa = sub.add_parser("add", help="append a bench record + check")
+    pa.add_argument("--db", required=True, help="ledger JSONL path")
+    pa.add_argument("--record", default="-",
+                    help="bench JSON record file, or - for stdin")
+    pa.add_argument("--threshold", type=float, default=DEFAULT_THRESHOLD,
+                    help="regression threshold as a fraction "
+                         f"(default {DEFAULT_THRESHOLD})")
+    pa.add_argument("--host", default=None,
+                    help="override the host key (default: hostname)")
+    pa.add_argument("--dry-run", action="store_true",
+                    help="check without appending")
+    pr = sub.add_parser("report", help="render the ledger's history")
+    pr.add_argument("--db", required=True)
+    pr.add_argument("--metric", default=None,
+                    help="substring filter on the metric name")
+    args = p.parse_args(argv)
+
+    if args.cmd == "report":
+        try:
+            entries = load(args.db)
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load {args.db}: {e}", file=sys.stderr)
+            return 2
+        by_key: dict = {}
+        for e in entries:
+            k = json.dumps(e.get("key"), sort_keys=True)
+            by_key.setdefault(k, []).append(e)
+        for k in sorted(by_key):
+            key = json.loads(k)
+            if args.metric and args.metric not in (key.get("metric") or ""):
+                continue
+            vals = [e["value"] for e in by_key[k]]
+            last = by_key[k][-1]
+            v = last.get("verdict") or {}
+            print(f"{key.get('metric')} [{key.get('platform')}/"
+                  f"{key.get('host')} {key.get('config')}]: "
+                  f"{len(vals)} run(s), median {_median(vals):.6g}, "
+                  f"last {vals[-1]:.6g}"
+                  + (f" ({v.get('delta_pct'):+.1f}%"
+                     f"{' REGRESSION' if v.get('regression') else ''})"
+                     if v.get("delta_pct") is not None else ""))
+        return 0
+
+    try:
+        raw = (sys.stdin.read() if args.record == "-"
+               else open(args.record).read())
+        record = json.loads(raw.strip().splitlines()[-1])
+        if not isinstance(record, dict):
+            raise ValueError("record must be a JSON object")
+    except (OSError, ValueError, IndexError) as e:
+        print(f"error: cannot load record: {e}", file=sys.stderr)
+        return 2
+    try:
+        verdict = record_and_check(args.db, record,
+                                   threshold=args.threshold,
+                                   host=args.host,
+                                   append=not args.dry_run)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    print(render_verdict(verdict), file=sys.stderr)
+    print(json.dumps(verdict))
+    return 1 if verdict.get("regression") else 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -255,6 +255,34 @@ def render(doc: dict, width: int = 48) -> str:
             add(f"fallback: {fb.get('from_backend')} -> {fb.get('to_backend')} "
                 f"({fb.get('error_class')})")
 
+    # diagnose-after-the-fact pointers (PR 11): where the dumps and
+    # profile artifacts landed, and what the ledger said
+    for pw in doc.get("profiles") or []:
+        add(f"profile:  [{pw.get('trigger')}] {pw.get('seconds')}s window "
+            f"-> {pw.get('xplane') or pw.get('logdir') + ' (no artifact)'}")
+    xc = doc.get("timing_crosscheck")
+    if xc:
+        add(f"xcheck:   timing column {xc.get('in_kernel_ms')} ms vs "
+            f"xplane {xc.get('xplane_ms')} ms self-time "
+            f"(coverage {xc.get('coverage')}) -> "
+            f"{str(xc.get('verdict')).upper()}")
+    for fr in doc.get("flightrec") or []:
+        add(f"flightrec: {fr.get('records')} event(s) "
+            f"({fr.get('reason')}"
+            + (f", {len(fr.get('open_spans'))} span(s) in flight"
+               if fr.get("open_spans") else "")
+            + f") -> {fr.get('path')}")
+    for pv in doc.get("perf") or []:
+        if pv.get("samples"):
+            word = "REGRESSION" if pv.get("regression") else "ok"
+            add(f"perf:     {pv.get('metric')} = {pv.get('value')} "
+                f"{pv.get('unit') or ''} vs median "
+                f"{pv.get('baseline_median')} over {pv.get('samples')} "
+                f"run(s): {pv.get('delta_pct'):+.1f}% -> {word}")
+        else:
+            add(f"perf:     {pv.get('metric')} = {pv.get('value')} "
+                f"{pv.get('unit') or ''} (baseline seeded)")
+
     for ab in doc.get("aborts") or []:
         if ab.get("event") == "structured_abort":
             add(f"ABORT:    structured (rc {ab.get('rc')}): {ab.get('reason')}")

@@ -204,6 +204,48 @@ def check_bench_record(record: dict, thresholds: dict) -> list[str]:
     return violations
 
 
+class ViolationHooks:
+    """What to do the instant an SLO gate trips (PR 11 retrospective
+    layer): dump the flight recorder's event tail and/or open a short
+    profiler window over whatever the process is still executing.
+
+    In-process gates (``bench.py --slo-thresholds``, a live serve loop
+    checking itself) construct one and call :meth:`fire` with the
+    violation list; the standalone post-hoc CLI has nothing live to
+    capture and never fires hooks. Both actions are best-effort — a
+    diagnostics failure must never mask the violation exit code."""
+
+    def __init__(self, *, recorder=None, dump_dir: str = ".",
+                 profile_logdir: str | None = None,
+                 profile_ms: float = 0.0, logger=None):
+        self.recorder = recorder
+        self.dump_dir = dump_dir
+        self.profile_logdir = profile_logdir
+        self.profile_ms = float(profile_ms)
+        self.logger = logger
+
+    def fire(self, violations: list) -> dict:
+        """Returns {"dump": path|None, "profile": fields|None}."""
+        out: dict = {"dump": None, "profile": None}
+        if not violations:
+            return out
+        if self.recorder is not None:
+            try:
+                out["dump"] = self.recorder.dump(
+                    self.dump_dir, reason="slo_violation",
+                    trigger=violations[0], logger=self.logger)
+            except OSError as e:
+                print(f"# slo hooks: flightrec dump failed: {e}",
+                      file=sys.stderr)
+        if self.profile_ms > 0 and self.profile_logdir:
+            from dgc_tpu.obs import profiler
+
+            out["profile"] = profiler.timed_window(
+                self.profile_logdir, self.profile_ms,
+                trigger="slo_violation", logger=self.logger)
+        return out
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
     p.add_argument("path", help="run manifest JSON or JSONL run log")

@@ -36,7 +36,6 @@ import argparse
 import glob
 import json
 import os
-import re
 import sys
 
 _REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
@@ -44,137 +43,10 @@ sys.path.insert(0, _REPO)  # dgc_tpu is not an installed package
 os.environ.setdefault("JAX_COMPILATION_CACHE_DIR",
                       os.path.join(_REPO, ".jax_cache"))
 
-_CATEGORIES = (
-    # order matters: first match wins
-    # the segmented plan's fused gathers carry the ``seg_gather`` scope
-    # (ops.segmented_gather.segmented_gather wraps THE gather in
-    # jax.named_scope), so their self-time attributes separately from
-    # residual small gathers — the on-chip measurement of the plan's rate
-    # claim
-    ("segmented-gather", re.compile(r"seg_gather", re.I)),
-    ("gather", re.compile(r"gather|dynamic-slice(?!-update)|take", re.I)),
-    ("scatter", re.compile(r"scatter|dynamic-update-slice", re.I)),
-    ("collective", re.compile(r"all-gather|all-reduce|reduce-scatter|"
-                              r"collective|permute", re.I)),
-    ("copy", re.compile(r"copy|transpose|bitcast|reshape", re.I)),
-    ("while-ctrl", re.compile(r"while|condition|tuple|parameter|select-n", re.I)),
-    ("sort", re.compile(r"sort", re.I)),
-    ("fusion-elementwise", re.compile(r"fusion", re.I)),
-)
-
-
-def _categorize(name: str) -> str:
-    for cat, pat in _CATEGORIES:
-        if pat.search(name):
-            return cat
-    return "other"
-
-
-def _line_self_times(evts: list, into: dict) -> None:
-    """Accumulate per-op SELF time (duration minus directly-nested child
-    durations) for one trace line into ``into``.
-
-    Trace lines nest events by time containment (a while op spans its body
-    ops; on TPU the XLA Ops line nests control flow around fusions), so a
-    plain sum double-counts every container. Stack-based interval nesting
-    gives exact self-times without hierarchy metadata.
-    """
-    evts.sort(key=lambda e: (e[0], -e[1]))
-    stack: list[list] = []  # [end, name, dur, child_sum]
-
-    def close(upto: float) -> None:
-        while stack and stack[-1][0] <= upto:
-            end, name, dur, csum = stack.pop()
-            into[name] = into.get(name, 0.0) + max(0.0, dur - csum)
-            if stack:
-                stack[-1][3] += dur
-
-    for off, dur, name in evts:
-        close(off)
-        stack.append([off + dur, name, dur, 0.0])
-    close(float("inf"))
-
-
-def attribute_xspace(xspace_path: str, top: int = 20) -> dict:
-    """Aggregate device-plane op SELF times from one ``.xplane.pb``."""
-    from tensorflow.tsl.profiler.protobuf import xplane_pb2
-
-    xs = xplane_pb2.XSpace()
-    with open(xspace_path, "rb") as f:
-        xs.ParseFromString(f.read())
-
-    # device planes: TPU (axon remote chip) or the host-CPU XLA plane when
-    # run off-chip for plumbing tests
-    planes = [p for p in xs.planes
-              if "/device:" in p.name or "TPU" in p.name]
-    if not planes:
-        planes = [p for p in xs.planes if ":CPU" in p.name]
-    # host/runtime scaffolding that shows up when the fallback picks a CPU
-    # plane (python frames, PjRt/thunk wrappers, transfer/marker events) —
-    # never real device ops. The module/step summary lines on TPU planes
-    # span the whole execution and are skipped wholesale below.
-    noise = re.compile(r"^\$|^PjRt|^Thunk|^PjitFunction|^XlaModule|"
-                       r"^DevicePut|^np\.|^end: |^jit_|trace|__exit__")
-    per_op: dict[str, float] = {}
-    span_lo, span_hi = None, 0
-    for plane in planes:
-        meta = plane.event_metadata
-        smeta = plane.stat_metadata
-        lines = plane.lines
-
-        def scoped_name(ev, name):
-            """Named-scope attribution: the lowered instruction NAME never
-            carries ``jax.named_scope`` labels — they live in the event's
-            op_name/tf_op stat (and in the event metadata's display name
-            on some backends). The segmented plan wraps its fused gather
-            in ``seg_gather``; prefix the op so the category split sees
-            it."""
-            hay = [meta[ev.metadata_id].display_name]
-            for st in ev.stats:
-                sm = smeta.get(st.metadata_id)
-                if sm is not None and sm.name in (
-                        "tf_op", "op_name", "hlo_op", "long_name"):
-                    hay.append(st.str_value
-                               or (smeta.get(st.ref_value).name
-                                   if st.ref_value else ""))
-            if any(h and "seg_gather" in h for h in hay):
-                return "seg_gather/" + name
-            return name
-
-        # TPU device planes carry an explicit "XLA Ops" line; when present
-        # it is the only line with real per-op events
-        op_lines = [l for l in lines if l.name == "XLA Ops"] or [
-            l for l in lines if l.name not in ("XLA Modules", "Steps",
-                                               "Framework Ops")]
-        for line in op_lines:
-            evts = []
-            for ev in line.events:
-                name = meta[ev.metadata_id].name
-                if noise.search(name):
-                    continue
-                dur = ev.duration_ps / 1e12
-                t0 = line.timestamp_ns * 1e-9 + ev.offset_ps / 1e12
-                evts.append((t0, dur, scoped_name(ev, name)))
-                span_lo = t0 if span_lo is None else min(span_lo, t0)
-                span_hi = max(span_hi, t0 + dur)
-            _line_self_times(evts, per_op)
-
-    cats: dict[str, float] = {}
-    for name, dur in per_op.items():
-        cat = _categorize(name)
-        cats[cat] = cats.get(cat, 0.0) + dur
-    total = sum(per_op.values())
-    span = (span_hi - span_lo) if span_lo is not None else 0.0
-    top_ops = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
-    return {
-        "planes": [p.name for p in planes],
-        "device_op_time_s": round(total, 4),
-        "trace_span_s": round(span, 4),
-        "gap_time_s": round(max(0.0, span - total), 4),
-        "categories_s": {k: round(v, 4)
-                         for k, v in sorted(cats.items(), key=lambda kv: -kv[1])},
-        "top_ops": [{"op": n, "s": round(d, 4)} for n, d in top_ops],
-    }
+# the attribution library moved to tools/xplane_split.py (PR 11) so any
+# profiler-window artifact — not just this driver's — gets the same
+# category split; this driver keeps its run-one-attempt CLI contract
+from tools.xplane_split import attribute_xspace  # noqa: E402
 
 
 def main() -> int:

@@ -71,6 +71,36 @@ class _SpanChecker:
                     self._open.items(), key=lambda kv: str(kv[0]))]
 
 
+def _semantic_problems(record: dict) -> list[str]:
+    """Value-level enforcement beyond the type schema for the PR 11
+    diagnose-after-the-fact kinds: counts non-negative, verdict strings
+    from the closed vocabulary, and a regression verdict must carry the
+    baseline it regressed against."""
+    kind = record.get("event")
+    problems: list[str] = []
+    if kind == "flightrec_dump":
+        if isinstance(record.get("records"), int) and record["records"] < 0:
+            problems.append(f"flightrec_dump: records {record['records']} < 0")
+        if isinstance(record.get("dropped_spans"), int) \
+                and record["dropped_spans"] < 0:
+            problems.append("flightrec_dump: dropped_spans < 0")
+    elif kind == "profile_window":
+        if isinstance(record.get("seconds"), (int, float)) \
+                and record["seconds"] < 0:
+            problems.append(f"profile_window: seconds {record['seconds']} < 0")
+    elif kind == "timing_crosscheck":
+        if record.get("verdict") not in ("ok", "divergent"):
+            problems.append(
+                f"timing_crosscheck: verdict {record.get('verdict')!r} "
+                f"not in ('ok', 'divergent')")
+    elif kind == "perf_regression":
+        if record.get("regression") is True \
+                and record.get("baseline_median") is None:
+            problems.append("perf_regression: regression=true without a "
+                            "baseline_median")
+    return problems
+
+
 def validate_file(path: str) -> list[str]:
     """All schema and span-structure problems in one JSONL log, prefixed
     with line numbers."""
@@ -93,6 +123,9 @@ def validate_file(path: str) -> list[str]:
             continue
         for problem in validate_record(record):
             problems.append(f"{path}:{lineno}: {problem}")
+        if isinstance(record, dict):
+            for problem in _semantic_problems(record):
+                problems.append(f"{path}:{lineno}: {problem}")
         if isinstance(record, dict) and record.get("event") == "span":
             for problem in spans.feed(record):
                 problems.append(f"{path}:{lineno}: {problem}")

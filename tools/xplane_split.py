@@ -1,0 +1,314 @@
+#!/usr/bin/env python
+"""Xplane self-time attribution + devclock timing-column cross-check.
+
+The category-attribution machinery ``tools/trace_attempt.py`` grew for
+the gather-rate question, factored into a reusable library that consumes
+ANY profiler-window artifact (``obs.profiler`` windows, ``--profile-
+window`` CLI captures, ``/debug/profile`` grabs, or a raw logdir /
+``.xplane.pb``), renders the self-time split (segmented-gather / gather
+/ scatter / while-ctrl / copy / other + idle), and — given the run's
+manifest — cross-checks the split against the in-kernel devclock timing
+column (``obs.devclock``, trajectory col 5), emitting the
+``timing_crosscheck`` verdict ``evidence_suite.sh`` has queued since
+PR 7. Runnable on CPU today: the CPU plane's self-times and the
+callback-based clock share a clock domain, so the CPU verdict calibrates
+how much to trust the column before a chip ever sees it.
+
+Verdict rule: ``coverage = in_kernel_ms / xplane_ms`` (the while-loop
+supersteps the column times are a SUBSET of the device ops in the trace
+— compile-adjacent executions, transfers, and host scaffolding are in
+the xplane but not the column, so coverage ≤ ~1 is healthy). The verdict
+is ``ok`` when ``lo <= coverage <= hi`` (defaults 0.25/1.25 — the
+CPU-measured envelope, PERF.md "Timing-column vs xplane cross-check"),
+``divergent`` otherwise: a column reporting more time than the device
+executed, or almost none of it, means the clock path cannot be trusted
+on that backend.
+
+Usage:
+  python tools/xplane_split.py ARTIFACT [--top N]
+  python tools/xplane_split.py ARTIFACT --manifest RUN.json \
+      [--lo 0.25] [--hi 1.25] [--emit-runlog LOG.jsonl] [--strict]
+
+ARTIFACT: a ``.xplane.pb``, a profiler logdir, or a run manifest whose
+``profiles`` slot links one (the last window wins). Prints one JSON
+object: the split, plus ``timing_crosscheck`` when a manifest with a
+timing column was given. ``--strict`` exits 1 on a divergent verdict;
+``--emit-runlog`` appends the verdict event to a JSONL run log
+(schema-checked by tools/validate_runlog.py like every other event).
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import re
+import sys
+
+_REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, _REPO)  # dgc_tpu is not an installed package
+
+_CATEGORIES = (
+    # order matters: first match wins
+    # the segmented plan's fused gathers carry the ``seg_gather`` scope
+    # (ops.segmented_gather.segmented_gather wraps THE gather in
+    # jax.named_scope), so their self-time attributes separately from
+    # residual small gathers — the on-chip measurement of the plan's rate
+    # claim
+    ("segmented-gather", re.compile(r"seg_gather", re.I)),
+    ("gather", re.compile(r"gather|dynamic-slice(?!-update)|take", re.I)),
+    ("scatter", re.compile(r"scatter|dynamic-update-slice", re.I)),
+    ("collective", re.compile(r"all-gather|all-reduce|reduce-scatter|"
+                              r"collective|permute", re.I)),
+    ("copy", re.compile(r"copy|transpose|bitcast|reshape", re.I)),
+    ("while-ctrl", re.compile(r"while|condition|tuple|parameter|select-n", re.I)),
+    ("sort", re.compile(r"sort", re.I)),
+    ("fusion-elementwise", re.compile(r"fusion", re.I)),
+)
+
+
+def _categorize(name: str) -> str:
+    for cat, pat in _CATEGORIES:
+        if pat.search(name):
+            return cat
+    return "other"
+
+
+def _line_self_times(evts: list, into: dict) -> None:
+    """Accumulate per-op SELF time (duration minus directly-nested child
+    durations) for one trace line into ``into``.
+
+    Trace lines nest events by time containment (a while op spans its body
+    ops; on TPU the XLA Ops line nests control flow around fusions), so a
+    plain sum double-counts every container. Stack-based interval nesting
+    gives exact self-times without hierarchy metadata.
+    """
+    evts.sort(key=lambda e: (e[0], -e[1]))
+    stack: list[list] = []  # [end, name, dur, child_sum]
+
+    def close(upto: float) -> None:
+        while stack and stack[-1][0] <= upto:
+            end, name, dur, csum = stack.pop()
+            into[name] = into.get(name, 0.0) + max(0.0, dur - csum)
+            if stack:
+                stack[-1][3] += dur
+
+    for off, dur, name in evts:
+        close(off)
+        stack.append([off + dur, name, dur, 0.0])
+    close(float("inf"))
+
+
+def attribute_xspace(xspace_path: str, top: int = 20) -> dict:
+    """Aggregate device-plane op SELF times from one ``.xplane.pb``."""
+    from tensorflow.tsl.profiler.protobuf import xplane_pb2
+
+    xs = xplane_pb2.XSpace()
+    with open(xspace_path, "rb") as f:
+        xs.ParseFromString(f.read())
+
+    # device planes: TPU (axon remote chip) or the host-CPU XLA plane when
+    # run off-chip for plumbing tests
+    planes = [p for p in xs.planes
+              if "/device:" in p.name or "TPU" in p.name]
+    if not planes:
+        planes = [p for p in xs.planes if ":CPU" in p.name]
+    # host/runtime scaffolding that shows up when the fallback picks a CPU
+    # plane (python frames, PjRt/thunk wrappers, transfer/marker events) —
+    # never real device ops. The module/step summary lines on TPU planes
+    # span the whole execution and are skipped wholesale below.
+    noise = re.compile(r"^\$|^PjRt|^Thunk|^PjitFunction|^XlaModule|"
+                       r"^DevicePut|^np\.|^end: |^jit_|trace|__exit__")
+    per_op: dict[str, float] = {}
+    span_lo, span_hi = None, 0
+    for plane in planes:
+        meta = plane.event_metadata
+        smeta = plane.stat_metadata
+        lines = plane.lines
+
+        def scoped_name(ev, name):
+            """Named-scope attribution: the lowered instruction NAME never
+            carries ``jax.named_scope`` labels — they live in the event's
+            op_name/tf_op stat (and in the event metadata's display name
+            on some backends). The segmented plan wraps its fused gather
+            in ``seg_gather``; prefix the op so the category split sees
+            it."""
+            hay = [meta[ev.metadata_id].display_name]
+            for st in ev.stats:
+                sm = smeta.get(st.metadata_id)
+                if sm is not None and sm.name in (
+                        "tf_op", "op_name", "hlo_op", "long_name"):
+                    hay.append(st.str_value
+                               or (smeta.get(st.ref_value).name
+                                   if st.ref_value else ""))
+            if any(h and "seg_gather" in h for h in hay):
+                return "seg_gather/" + name
+            return name
+
+        # TPU device planes carry an explicit "XLA Ops" line; when present
+        # it is the only line with real per-op events. On the CPU
+        # fallback plane the executed ops live on the ``tf_XLA*`` thread
+        # lines — the ``python`` frame line and the llvm-codegen thread
+        # carry compile passes (JitCompiler/lower_*/simplify-*) that
+        # would otherwise masquerade as device time in a cold window
+        op_lines = [l for l in lines if l.name == "XLA Ops"] or [
+            l for l in lines if l.name.startswith("tf_XLA")] or [
+            l for l in lines if l.name not in ("XLA Modules", "Steps",
+                                               "Framework Ops")]
+        for line in op_lines:
+            evts = []
+            for ev in line.events:
+                name = meta[ev.metadata_id].name
+                if noise.search(name):
+                    continue
+                dur = ev.duration_ps / 1e12
+                t0 = line.timestamp_ns * 1e-9 + ev.offset_ps / 1e12
+                evts.append((t0, dur, scoped_name(ev, name)))
+                span_lo = t0 if span_lo is None else min(span_lo, t0)
+                span_hi = max(span_hi, t0 + dur)
+            _line_self_times(evts, per_op)
+
+    cats: dict[str, float] = {}
+    for name, dur in per_op.items():
+        cat = _categorize(name)
+        cats[cat] = cats.get(cat, 0.0) + dur
+    total = sum(per_op.values())
+    span = (span_hi - span_lo) if span_lo is not None else 0.0
+    top_ops = sorted(per_op.items(), key=lambda kv: -kv[1])[:top]
+    return {
+        "planes": [p.name for p in planes],
+        "device_op_time_s": round(total, 4),
+        "trace_span_s": round(span, 4),
+        "gap_time_s": round(max(0.0, span - total), 4),
+        "categories_s": {k: round(v, 4)
+                         for k, v in sorted(cats.items(), key=lambda kv: -kv[1])},
+        "top_ops": [{"op": n, "s": round(d, 4)} for n, d in top_ops],
+    }
+
+
+# ---------------------------------------------------------------------------
+# artifact resolution + cross-check
+# ---------------------------------------------------------------------------
+
+def resolve_artifact(path: str) -> str:
+    """ARTIFACT → a ``.xplane.pb`` path. Accepts the file itself, a
+    profiler logdir, or a run manifest whose ``profiles`` slot links a
+    window (last window with an artifact wins). Raises ValueError."""
+    if path.endswith(".xplane.pb"):
+        return path
+    if os.path.isdir(path):
+        found = sorted(glob.glob(os.path.join(path, "**", "*.xplane.pb"),
+                                 recursive=True), key=os.path.getmtime)
+        if not found:
+            raise ValueError(f"no .xplane.pb under logdir {path}")
+        return found[-1]
+    if path.endswith(".json"):
+        doc = json.loads(open(path).read())
+        for prof in reversed(doc.get("profiles") or []):
+            xp = prof.get("xplane")
+            if xp:
+                if not os.path.isabs(xp):
+                    xp = os.path.join(os.path.dirname(path) or ".", xp)
+                return xp
+        raise ValueError(f"manifest {path} links no profile artifact")
+    raise ValueError(f"not an .xplane.pb, logdir, or manifest: {path}")
+
+
+def in_kernel_ms(doc: dict) -> tuple:
+    """(total_ms, attempts_with_column, supersteps_timed) summed over the
+    manifest's trajectory timing columns (``step_us``, −1 = unwritten)."""
+    total_us = 0
+    attempts = 0
+    steps = 0
+    for att in doc.get("attempts") or []:
+        traj = att.get("trajectory") or {}
+        col = [u for u in (traj.get("step_us") or []) if u >= 0]
+        if col:
+            attempts += 1
+            steps += len(col)
+            total_us += sum(col)
+    return total_us / 1e3, attempts, steps
+
+
+def crosscheck(split: dict, kernel_ms: float, *, attempts: int = 0,
+               supersteps: int = 0, lo: float = 0.25, hi: float = 1.25,
+               xplane: str | None = None) -> dict:
+    """The ``timing_crosscheck`` verdict fields (obs.schema)."""
+    xp_ms = split.get("device_op_time_s", 0.0) * 1e3
+    coverage = (kernel_ms / xp_ms) if xp_ms > 0 else None
+    ok = coverage is not None and lo <= coverage <= hi
+    return {
+        "in_kernel_ms": round(kernel_ms, 3),
+        "xplane_ms": round(xp_ms, 3),
+        "coverage": round(coverage, 4) if coverage is not None else None,
+        "lo": lo, "hi": hi,
+        "verdict": "ok" if ok else "divergent",
+        "attempts": attempts, "supersteps": supersteps,
+        "xplane": xplane,
+    }
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("artifact",
+                   help=".xplane.pb, profiler logdir, or run manifest")
+    p.add_argument("--manifest", type=str, default=None,
+                   help="run manifest with trajectory timing columns "
+                        "(--superstep-timing) to cross-check against; "
+                        "defaults to ARTIFACT when that is a manifest")
+    p.add_argument("--top", type=int, default=20,
+                   help="top-N ops in the split (default 20)")
+    p.add_argument("--lo", type=float, default=0.25,
+                   help="coverage lower bound for an ok verdict")
+    p.add_argument("--hi", type=float, default=1.25,
+                   help="coverage upper bound for an ok verdict")
+    p.add_argument("--emit-runlog", type=str, default=None, metavar="JSONL",
+                   help="append the timing_crosscheck event to this run log")
+    p.add_argument("--strict", action="store_true",
+                   help="exit 1 on a divergent verdict")
+    args = p.parse_args(argv)
+
+    manifest_path = args.manifest
+    if manifest_path is None and args.artifact.endswith(".json"):
+        manifest_path = args.artifact
+    try:
+        xplane = resolve_artifact(args.artifact)
+        split = attribute_xspace(xplane, args.top)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+
+    out = dict(split, xplane=xplane)
+    verdict = None
+    if manifest_path is not None:
+        try:
+            doc = json.loads(open(manifest_path).read())
+        except (OSError, ValueError) as e:
+            print(f"error: cannot load manifest {manifest_path}: {e}",
+                  file=sys.stderr)
+            return 2
+        kernel_ms, attempts, steps = in_kernel_ms(doc)
+        if attempts == 0:
+            print(f"error: {manifest_path} has no trajectory timing "
+                  f"column (run with --superstep-timing)", file=sys.stderr)
+            return 2
+        verdict = crosscheck(split, kernel_ms, attempts=attempts,
+                             supersteps=steps, lo=args.lo, hi=args.hi,
+                             xplane=xplane)
+        out["timing_crosscheck"] = verdict
+        if args.emit_runlog:
+            from dgc_tpu.obs.events import RunLogger
+
+            logger = RunLogger(jsonl_path=args.emit_runlog, echo=False)
+            logger.event("timing_crosscheck", **verdict)
+            logger.close()
+
+    print(json.dumps(out))
+    if args.strict and verdict is not None and verdict["verdict"] != "ok":
+        return 1
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())
