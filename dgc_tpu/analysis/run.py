@@ -50,7 +50,13 @@ SCHEMA_GLOBS = ("dgc_tpu/**/*.py", "bench.py", "tools/*.py")
 LOCK_FILES = ("dgc_tpu/obs/metrics.py", "dgc_tpu/obs/httpd.py",
               "dgc_tpu/obs/flightrec.py",
               "dgc_tpu/serve/queue.py", "dgc_tpu/serve/engine.py",
-              "dgc_tpu/serve/cli.py", "bench.py")
+              "dgc_tpu/serve/cli.py",
+              # network front door (PR 12): listener threads mutate the
+              # tenant buckets/quotas and ticket table that exporters
+              # and worker callbacks read — LK* incl. points-to (LK004)
+              "dgc_tpu/serve/netfront/admission.py",
+              "dgc_tpu/serve/netfront/listener.py",
+              "tools/soak.py", "bench.py")
 TRANSFER_FILES = ("dgc_tpu/serve/batched.py", "dgc_tpu/serve/engine.py")
 
 PASSES = ("staging", "layout", "schema", "locks", "transfer")

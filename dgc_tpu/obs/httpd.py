@@ -1,32 +1,33 @@
-"""Minimal live Prometheus scrape endpoint over ``MetricsRegistry``.
+"""Shared HTTP plumbing + the live Prometheus scrape endpoint.
 
-The first rung of the ROADMAP network-serving item: until now the
-registry's Prometheus exposition only ever reached disk
-(``--metrics-prom`` writes a file at exit), so a live ``dgc-tpu serve``
-run was invisible to a scraper. This serves ``GET /metrics`` (and ``/``)
-straight from ``registry.to_prometheus()`` — the registry is
-thread-safe, so the scrape observes a consistent point-in-time snapshot
-while worker threads keep mutating — plus ``GET /healthz`` from an
-optional health callback (the front-end's readiness snapshot as JSON).
+PR 12 generalizes what used to be a metrics-only server into the repo's
+one HTTP substrate: :class:`RoutingHTTPServer` is a threaded stdlib
+listener with a method+path route table, and :func:`mount_observability`
+registers the observability surface (``/metrics``, ``/healthz``,
+``/debug/flightrec``, ``/debug/profile``) on ANY such listener — so the
+network front door (``dgc_tpu.serve.netfront``) serves application
+traffic and the scrape/debug routes from ONE port with one server,
+while :class:`MetricsHTTPServer` keeps the PR 7/11 standalone-scraper
+API as a thin wrapper over the same plumbing.
 
-PR 11 adds the debug surface of the retrospective layer: ``GET
-/debug/flightrec`` streams the flight recorder's ring as schema-valid
-JSONL (``?file=1`` dumps it to disk instead and returns the path) and
-``GET /debug/profile?ms=N`` holds a ``jax.profiler`` window open for N
-milliseconds over whatever the process is executing and returns the
-artifact location — both live-process diagnostics a hung or slow serve
-loop can be asked for without restarting it.
+Handlers take a :class:`Request` (method, path, parsed query, headers,
+body) and return a :class:`Response` (status, body, content type, extra
+headers) or a :class:`StreamingResponse` (an iterator of byte chunks
+written with chunked transfer encoding — the netfront per-attempt
+progress stream). Handler threads must only touch thread-safe state;
+the route table itself is frozen before ``start()``.
 
-Stdlib only (``http.server``), one daemon thread, ephemeral-port
-friendly (``port=0`` binds any free port; read ``.port`` back — the
-tests' pattern). Not a general web server: four routes, GET only,
-loopback by default.
+Stdlib only (``http.server``), one daemon accept thread plus one thread
+per connection, ephemeral-port friendly (``port=0`` binds any free
+port; read ``.port`` back — the tests' pattern). Not a general web
+server: a handful of routes, GET/POST only, loopback by default.
 """
 
 from __future__ import annotations
 
 import json
 import threading
+from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
 
@@ -34,96 +35,192 @@ from urllib.parse import parse_qs
 # that a fat-fingered request cannot wedge the handler pool
 MAX_PROFILE_MS = 60_000.0
 
+# request bodies beyond this are refused outright (413): the inline
+# graph schema is small; nothing legitimate ships megabytes per request
+MAX_BODY_BYTES = 8 << 20
+
 PROM_CONTENT_TYPE = "text/plain; version=0.0.4; charset=utf-8"
 
 
-class MetricsHTTPServer:   # dgc-lint: threaded
-    """``MetricsHTTPServer(registry, port=9100).start()`` → live
-    ``/metrics`` scrape endpoint; ``close()`` stops it. ``health_fn``
-    (optional, ``() -> dict``) backs ``/healthz``; ``recorder``
-    (optional ``FlightRecorder``) backs ``/debug/flightrec``;
-    ``profiler`` (optional ``(ms) -> dict | None``, e.g. a bound
-    ``obs.profiler.timed_window``) backs ``/debug/profile``. Handler
-    threads only ever read the construction-frozen refs (the recorder
-    and the profiler guard their own state); the server/thread handles
-    belong to the owning thread."""
+@dataclass
+class Request:
+    """One parsed HTTP request as handlers see it."""
 
-    def __init__(self, registry, port: int = 0, host: str = "127.0.0.1",
-                 health_fn=None, recorder=None, profiler=None,
-                 flightrec_dir: str = "."):
-        self.registry = registry
-        self.health_fn = health_fn
-        self.recorder = recorder
-        self.profiler = profiler
-        self.flightrec_dir = flightrec_dir
+    method: str
+    path: str                       # path only, query string stripped
+    query: dict                     # parse_qs result
+    headers: object                 # email.message.Message (case-insensitive)
+    body: bytes
+    client: str                     # peer address string
+
+    def json(self):
+        """The body parsed as JSON (``{}`` when empty); raises
+        ``ValueError`` on malformed input — handlers map it to 400."""
+        if not self.body:
+            return {}
+        doc = json.loads(self.body.decode("utf-8"))
+        return doc
+
+
+@dataclass
+class Response:
+    status: int = 200
+    body: bytes | str = b""
+    ctype: str = "application/json"
+    headers: tuple = ()             # extra (name, value) pairs
+
+    def encoded(self) -> bytes:
+        return self.body.encode() if isinstance(self.body, str) else self.body
+
+
+def json_response(doc, status: int = 200, headers: tuple = ()) -> Response:
+    return Response(status=status, body=json.dumps(doc) + "\n",
+                    headers=headers)
+
+
+class StreamingResponse:
+    """Chunked-transfer body: ``chunks`` is an iterator of ``bytes``;
+    each yielded chunk is flushed to the client immediately (the
+    netfront ``/v1/stream`` per-attempt progress feed)."""
+
+    def __init__(self, chunks, ctype: str = "application/jsonl",
+                 status: int = 200, headers: tuple = ()):
+        self.chunks = chunks
+        self.ctype = ctype
+        self.status = status
+        self.headers = headers
+
+
+class RoutingHTTPServer:   # dgc-lint: threaded
+    """``RoutingHTTPServer(port=0).route(...).start()`` — the shared
+    threaded listener every HTTP surface mounts onto. Routes are exact
+    ``(method, path)`` matches, or prefix matches for parameterized
+    paths (``route("GET", "/v1/result/", fn, prefix=True)`` receives
+    ``/v1/result/<anything>``). The route table is owner-mutated before
+    ``start()`` and only read by handler threads afterwards; everything
+    a handler touches beyond it must be thread-safe."""
+
+    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+        self._exact: dict = {}      # (method, path) -> fn; guarded-by: init
+        self._prefix: list = []     # (method, prefix, fn); guarded-by: init
         outer = self
 
         class _Handler(BaseHTTPRequestHandler):
-            def do_GET(self):  # noqa: N802 (http.server convention)
-                path, _, query = self.path.partition("?")
-                q = parse_qs(query)
-                if path in ("/", "/metrics"):
-                    body = outer.registry.to_prometheus().encode()
-                    ctype = PROM_CONTENT_TYPE
-                elif path == "/healthz" and outer.health_fn is not None:
-                    body = (json.dumps(outer.health_fn()) + "\n").encode()
-                    ctype = "application/json"
-                elif path == "/debug/flightrec" \
-                        and outer.recorder is not None:
-                    if q.get("file", ["0"])[0] in ("1", "true"):
-                        dumped = outer.recorder.dump(
-                            outer.flightrec_dir, reason="http",
-                            trigger=self.client_address[0])
-                        body = (json.dumps({"path": dumped}) + "\n").encode()
-                        ctype = "application/json"
-                    else:
-                        text, _trailer = outer.recorder.render(
-                            "http", trigger=self.client_address[0])
-                        body = text.encode()
-                        ctype = "application/jsonl"
-                elif path == "/debug/profile" \
-                        and outer.profiler is not None:
-                    try:
-                        ms = float(q.get("ms", ["500"])[0])
-                    except ValueError:
-                        self.send_error(400, "ms must be a number")
-                        return
-                    if not 0 < ms <= MAX_PROFILE_MS:
-                        self.send_error(
-                            400, f"ms must be in (0, {MAX_PROFILE_MS:g}]")
-                        return
-                    result = outer.profiler(ms)
-                    if result is None:   # a window is already open
-                        self.send_error(409, "a profile window is open")
-                        return
-                    body = (json.dumps(result) + "\n").encode()
-                    ctype = "application/json"
-                else:
+            protocol_version = "HTTP/1.1"
+
+            def _dispatch(self, method: str) -> None:
+                path, _, qs = self.path.partition("?")
+                fn = outer._resolve(method, path)
+                if fn is None:
                     self.send_error(404)
                     return
-                self.send_response(200)
-                self.send_header("Content-Type", ctype)
+                try:
+                    length = int(self.headers.get("Content-Length") or 0)
+                except ValueError:
+                    self.send_error(400, "bad Content-Length")
+                    return
+                if length > MAX_BODY_BYTES:
+                    self.send_error(413, "request body too large")
+                    return
+                body = self.rfile.read(length) if length else b""
+                req = Request(method=method, path=path, query=parse_qs(qs),
+                              headers=self.headers, body=body,
+                              client=self.client_address[0])
+                try:
+                    resp = fn(req)
+                except Exception as e:   # handler bug ≠ dead listener
+                    self.send_error(
+                        500, f"{type(e).__name__}: {e}"[:200])
+                    return
+                if isinstance(resp, StreamingResponse):
+                    self._stream(resp)
+                else:
+                    self._respond(resp)
+
+            def _respond(self, resp: Response) -> None:
+                body = resp.encoded()
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.ctype)
                 self.send_header("Content-Length", str(len(body)))
+                for name, value in resp.headers:
+                    self.send_header(name, str(value))
                 self.end_headers()
                 self.wfile.write(body)
 
-            def log_message(self, fmt, *args):  # scrapes are not run events
+            def _stream(self, resp: StreamingResponse) -> None:
+                self.send_response(resp.status)
+                self.send_header("Content-Type", resp.ctype)
+                self.send_header("Transfer-Encoding", "chunked")
+                for name, value in resp.headers:
+                    self.send_header(name, str(value))
+                self.end_headers()
+                try:
+                    for chunk in resp.chunks:
+                        if not chunk:
+                            continue
+                        self.wfile.write(b"%x\r\n" % len(chunk))
+                        self.wfile.write(chunk)
+                        self.wfile.write(b"\r\n")
+                        self.wfile.flush()
+                except OSError:
+                    self.close_connection = True   # client hung up
+                finally:
+                    try:
+                        self.wfile.write(b"0\r\n\r\n")
+                    except OSError:
+                        pass   # client hung up mid-stream
+
+            def do_GET(self):   # noqa: N802 (http.server convention)
+                self._dispatch("GET")
+
+            def do_POST(self):  # noqa: N802
+                self._dispatch("POST")
+
+            def log_message(self, fmt, *args):  # requests are run events
                 pass
 
-        self._server = ThreadingHTTPServer((host, int(port)), _Handler)
+        class _Server(ThreadingHTTPServer):
+            # socketserver's default listen backlog is 5 — a thundering
+            # herd of concurrent connects (the 1000-client soak) gets
+            # connection-refused before a handler thread ever spawns.
+            # The kernel clamps this to net.core.somaxconn.
+            request_queue_size = 1024
+
+        self._server = _Server((host, int(port)), _Handler)
         self._server.daemon_threads = True
         self._thread: threading.Thread | None = None   # guarded-by: owner
 
+    # -- route table (owner thread, pre-start) --------------------------
+    def route(self, method: str, path: str, fn,
+              prefix: bool = False) -> "RoutingHTTPServer":
+        if prefix:
+            self._prefix.append((method, path, fn))
+            # longest prefix wins at resolve time
+            self._prefix.sort(key=lambda t: -len(t[1]))
+        else:
+            self._exact[(method, path)] = fn
+        return self
+
+    def _resolve(self, method: str, path: str):
+        fn = self._exact.get((method, path))
+        if fn is not None:
+            return fn
+        for m, pre, fn in self._prefix:
+            if m == method and path.startswith(pre):
+                return fn
+        return None
+
+    # -- lifecycle ------------------------------------------------------
     @property
     def port(self) -> int:
         """The bound port (useful with ``port=0``)."""
         return self._server.server_address[1]
 
-    def start(self) -> "MetricsHTTPServer":
+    def start(self) -> "RoutingHTTPServer":
         if self._thread is None:
             self._thread = threading.Thread(
                 target=self._server.serve_forever, daemon=True,
-                name="dgc-metrics-httpd")
+                name="dgc-httpd")
             self._thread.start()
         return self
 
@@ -133,3 +230,94 @@ class MetricsHTTPServer:   # dgc-lint: threaded
         if self._thread is not None:
             self._thread.join(timeout=5)
             self._thread = None
+
+
+def mount_observability(server: RoutingHTTPServer, *, registry,
+                        health_fn=None, recorder=None, profiler=None,
+                        flightrec_dir: str = ".") -> RoutingHTTPServer:
+    """Register the observability surface on ``server``: ``/metrics``
+    (and ``/``) from ``registry.to_prometheus()``, ``/healthz`` from
+    ``health_fn() -> dict``, ``/debug/flightrec`` from a
+    ``FlightRecorder``, ``/debug/profile?ms=N`` from a profiler callable
+    (``(ms) -> dict | None``, e.g. a bound ``obs.profiler
+    .timed_window``). Backends left ``None`` are simply not mounted
+    (404). The registry/recorder/profiler guard their own state, so the
+    handlers are thread-safe by construction."""
+
+    def metrics(req: Request) -> Response:
+        return Response(body=registry.to_prometheus(),
+                        ctype=PROM_CONTENT_TYPE)
+
+    server.route("GET", "/metrics", metrics)
+    server.route("GET", "/", metrics)
+
+    if health_fn is not None:
+        server.route("GET", "/healthz",
+                     lambda req: json_response(health_fn()))
+
+    if recorder is not None:
+        def flightrec(req: Request) -> Response:
+            if req.query.get("file", ["0"])[0] in ("1", "true"):
+                dumped = recorder.dump(flightrec_dir, reason="http",
+                                       trigger=req.client)
+                return json_response({"path": dumped})
+            text, _trailer = recorder.render("http", trigger=req.client)
+            return Response(body=text, ctype="application/jsonl")
+
+        server.route("GET", "/debug/flightrec", flightrec)
+
+    if profiler is not None:
+        def profile(req: Request) -> Response:
+            try:
+                ms = float(req.query.get("ms", ["500"])[0])
+            except ValueError:
+                return json_response({"error": "ms must be a number"},
+                                     status=400)
+            if not 0 < ms <= MAX_PROFILE_MS:
+                return json_response(
+                    {"error": f"ms must be in (0, {MAX_PROFILE_MS:g}]"},
+                    status=400)
+            result = profiler(ms)
+            if result is None:   # a window is already open
+                return json_response({"error": "a profile window is open"},
+                                     status=409)
+            return json_response(result)
+
+        server.route("GET", "/debug/profile", profile)
+    return server
+
+
+class MetricsHTTPServer:   # dgc-lint: threaded
+    """``MetricsHTTPServer(registry, port=9100).start()`` → live
+    ``/metrics`` scrape endpoint; ``close()`` stops it. ``health_fn``
+    (optional, ``() -> dict``) backs ``/healthz``; ``recorder``
+    (optional ``FlightRecorder``) backs ``/debug/flightrec``;
+    ``profiler`` (optional ``(ms) -> dict | None``) backs
+    ``/debug/profile``. Since PR 12 this is a thin wrapper over
+    :class:`RoutingHTTPServer` + :func:`mount_observability` — the
+    netfront listener mounts the identical routes on its own port."""
+
+    def __init__(self, registry, port: int = 0, host: str = "127.0.0.1",
+                 health_fn=None, recorder=None, profiler=None,
+                 flightrec_dir: str = "."):
+        self.registry = registry
+        self.health_fn = health_fn
+        self.recorder = recorder
+        self.profiler = profiler
+        self.flightrec_dir = flightrec_dir
+        self._server = mount_observability(
+            RoutingHTTPServer(port=port, host=host), registry=registry,
+            health_fn=health_fn, recorder=recorder, profiler=profiler,
+            flightrec_dir=flightrec_dir)
+
+    @property
+    def port(self) -> int:
+        """The bound port (useful with ``port=0``)."""
+        return self._server.port
+
+    def start(self) -> "MetricsHTTPServer":
+        self._server.start()
+        return self
+
+    def close(self) -> None:
+        self._server.close()

@@ -95,6 +95,24 @@ class RunManifest:
             self.doc.setdefault("perf", []).append(fields)
         elif kind in ("sweep_done", "sweep_failed"):
             self.doc["result"] = dict(fields, event=kind)
+        # network front door (serve.netfront, PR 12): per-tenant
+        # admit/reject AGGREGATES (a soak emits thousands of decisions —
+        # the manifest keeps counts, the JSONL keeps every event) plus
+        # the drain record; the slot appears only when net_* events do
+        elif kind in ("net_admit", "net_reject", "net_drain"):
+            nf = self.doc.setdefault("netfront",
+                                     {"tenants": {}, "drain": None})
+            if kind == "net_drain":
+                nf["drain"] = fields
+            else:
+                t = nf["tenants"].setdefault(
+                    fields.get("tenant", "?"),
+                    {"admitted": 0, "rejected": {}})
+                if kind == "net_admit":
+                    t["admitted"] += 1
+                else:
+                    reason = fields.get("reason", "?")
+                    t["rejected"][reason] = t["rejected"].get(reason, 0) + 1
         elif (kind.startswith("serve_")
               or kind in ("lane_recycled", "slice_recalibrated")):
             # serving path (dgc_tpu.serve) — the slot appears only when

@@ -143,6 +143,23 @@ EVENT_SCHEMAS: dict = {
          "rung": "int"}),
     # live scrape endpoint (obs.httpd) bound for this run
     "metrics_server": ({"port": "int"}, {"host": "str"}),
+    # network front door (serve.netfront): one event per admission
+    # decision and one per graceful drain. Semantic enforcement (reason
+    # vocabulary, non-negative counts/delays) lives in
+    # tools/validate_runlog.py; tools/report_run.py renders the
+    # per-tenant breakdown
+    "net_admit": (
+        {"tenant": "str", "ticket": "str"},
+        {"tier": "str", "priority": "int", "in_flight": "int",
+         "v": "int"}),
+    "net_reject": (
+        {"tenant": "str", "reason": "str"},
+        {"retry_after_s": NUM, "queue_depth": "int", "capacity": "int",
+         "tokens_left": NUM, "in_flight": "int", "limit": "int"}),
+    "net_drain": (
+        {"in_flight": "int", "queued": "int"},
+        {"completed": "int", "failed": "int", "timeout_s": NUM,
+         "wall_s": NUM}),
     "serve_warmup": (
         {"classes": "int", "kernels": "int", "seconds": NUM},
         # compiled stage branches across the warmed kernels (the staged

@@ -34,7 +34,13 @@ per-request host loop. This package amortizes all three:
   request queue with a batching window and backpressure, worker loop,
   per-request latency accounting, health/readiness fed by the resilience
   supervisor's rung state (``dgc-tpu serve`` CLI in
-  :mod:`~dgc_tpu.serve.cli`).
+  :mod:`~dgc_tpu.serve.cli`);
+- :mod:`~dgc_tpu.serve.netfront` — the network front door (PR 12): an
+  HTTP listener (submit / poll / stream / drain) with multi-tenant
+  admission control (token buckets, concurrency quotas, priority
+  tiers) ahead of the bounded queue, sharing one port with the
+  ``/metrics`` + ``/healthz`` + debug surface. Imported lazily — the
+  offline replay path never pays for it.
 """
 
 from dgc_tpu.serve.shape_classes import (  # noqa: F401

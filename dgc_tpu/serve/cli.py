@@ -9,9 +9,15 @@ line per request. Request lines are either
   "gen_method": "fast"}`` — a generated graph (the CLI generator flags
   as JSON fields).
 
-The CLI exists for offline replay (load tests, the bench harness, the
-1k-request soak) — a network listener is a thin shim over the same
-``ServeFrontEnd`` API. Dispatch defaults to continuous batching (lane
+The CLI runs two modes over the same ``ServeFrontEnd``: offline replay
+(``--requests``, for load tests, the bench harness, the 1k-request
+soak) and network mode (``--listen PORT`` + optional ``--tenants``,
+PR 12) — the :mod:`dgc_tpu.serve.netfront` listener serving ``POST
+/v1/color`` / ``GET /v1/result`` / ``GET /v1/stream`` / ``POST
+/admin/drain`` plus ``/metrics``, ``/healthz`` and the debug routes on
+ONE port, with per-tenant admission control ahead of the queue
+(``tools/soak.py`` is the many-client harness over it). Dispatch
+defaults to continuous batching (lane
 recycling; ``--serve-mode sync`` keeps the batch-complete baseline),
 ``--slice-steps`` sizes the recycling slice (default: priced against
 dispatch overhead), and ``--warm-classes`` pre-compiles the named shape
@@ -42,8 +48,25 @@ def build_serve_parser() -> argparse.ArgumentParser:
         prog="dgc-tpu serve",
         description="Batched multi-graph serving front-end (request replay).",
     )
-    p.add_argument("--requests", type=str, required=True,
-                   help="JSONL request stream (module docstring schema)")
+    p.add_argument("--requests", type=str, default=None,
+                   help="JSONL request stream (module docstring schema); "
+                        "required unless --listen is given")
+    p.add_argument("--listen", type=int, default=None, metavar="PORT",
+                   help="network mode (serve.netfront): listen for "
+                        "POST /v1/color submissions on this port "
+                        "(0 = any free port) instead of replaying a "
+                        "file; /metrics, /healthz and the debug routes "
+                        "mount on the SAME port; runs until POST "
+                        "/admin/drain (or Ctrl-C) drains the front end")
+    p.add_argument("--listen-host", type=str, default="127.0.0.1",
+                   help="bind address for --listen (default loopback; "
+                        "0.0.0.0 exposes the listener)")
+    p.add_argument("--tenants", type=str, default=None, metavar="JSON",
+                   help="tenant admission config for --listen: a path "
+                        "to (or inline) JSON {'default': {...}, "
+                        "'tenants': {name: {rate, burst, "
+                        "max_concurrency, tier|priority}}}; absent = "
+                        "permissive single-tenant admission")
     p.add_argument("--results", type=str, default=None,
                    help="write per-request JSONL results here "
                         "(default: stdout)")
@@ -150,6 +173,90 @@ def build_serve_parser() -> argparse.ArgumentParser:
     return p
 
 
+def _listen_main(args, front, logger, registry, manifest, recorder,
+                 warmup) -> int:
+    """Network mode (``--listen``): stand the netfront listener over
+    the started front end and serve until a drain completes (``POST
+    /admin/drain`` or Ctrl-C). Application and observability routes
+    share the one listener port; the run log / manifest / metrics
+    artifacts mirror the replay mode's."""
+    from dgc_tpu.obs import profiler
+    from dgc_tpu.serve.netfront import (AdmissionController, NetFront,
+                                        load_tenant_configs)
+
+    configs = None
+    if args.tenants:
+        try:
+            raw = args.tenants
+            if not raw.lstrip().startswith("{"):
+                raw = Path(raw).read_text()
+            configs = load_tenant_configs(json.loads(raw))
+        except (OSError, ValueError, json.JSONDecodeError) as e:
+            print(f"--tenants: {e}", file=sys.stderr)
+            front.shutdown(drain=False)
+            return 2
+    admission = AdmissionController(configs, registry=registry,
+                                    logger=logger)
+    try:
+        nf = NetFront(front, admission=admission, registry=registry,
+                      logger=logger, recorder=recorder,
+                      flightrec_dir=args.flightrec_dir,
+                      profiler=lambda ms: profiler.timed_window(
+                          args.profile_logdir, ms, trigger="http",
+                          logger=logger),
+                      host=args.listen_host, port=args.listen).start()
+    except OSError as e:
+        print(f"--listen: cannot bind {args.listen}: {e}",
+              file=sys.stderr)
+        front.shutdown(drain=False)
+        return 2
+    logger.event("metrics_server", port=nf.port, host=args.listen_host)
+    print(f"# listening: http://{args.listen_host}:{nf.port}/v1/color "
+          f"(metrics on /metrics, drain via POST /admin/drain)",
+          file=sys.stderr)
+    t0 = time.perf_counter()
+    try:
+        while not nf.drained.wait(timeout=1.0):
+            pass
+    except KeyboardInterrupt:
+        print("# interrupt: draining...", file=sys.stderr)
+        nf.drain()
+    wall = time.perf_counter() - t0
+    front.health(emit=True)
+    st = front.stats_snapshot()
+    sst = front.scheduler.stats_snapshot()
+    summary_kw = {}
+    latency = front.latency_summary()
+    if latency is not None:
+        summary_kw["latency_ms"] = latency
+    if sst.get("recals"):
+        summary_kw["recals"] = sst["recals"]
+    done = st["completed"]
+    logger.event("serve_summary", requests=st["submitted"],
+                 completed=done, failed=st["failed"],
+                 rejected=st["rejected"], wall_s=round(wall, 4),
+                 graphs_per_s=round(done / wall, 3) if wall > 0 else None,
+                 batches=sst["batches"], slices=sst["slices"],
+                 recycles=sst["recycles"], mode=front.scheduler.mode,
+                 warmup_s=warmup["seconds"] if warmup else None,
+                 warmed_kernels=warmup["kernels"] if warmup else None,
+                 compile_misses=sst["compile_misses"],
+                 compile_hits=sst["compile_hits"],
+                 h2d_mb=round(sst["h2d_bytes"] / 1e6, 3),
+                 d2h_mb=round(sst["d2h_bytes"] / 1e6, 3),
+                 **summary_kw)
+    nf.close()
+    if args.run_manifest:
+        manifest.finalize(registry=registry)
+        manifest.write(args.run_manifest)
+        logger.event("manifest_written", path=args.run_manifest)
+    if args.metrics_prom:
+        registry.write_prom(args.metrics_prom)
+        logger.event("metrics_written", path=args.metrics_prom)
+    logger.close()
+    return 0
+
+
 def _load_request_graph(doc: dict) -> Graph:
     if "input" in doc:
         return Graph.deserialize(doc["input"])
@@ -163,6 +270,10 @@ def _load_request_graph(doc: dict) -> Graph:
 
 def serve_main(argv: list[str] | None = None) -> int:
     args = build_serve_parser().parse_args(argv)
+    if args.requests is None and args.listen is None:
+        print("one of --requests (replay) or --listen PORT (network "
+              "mode) is required", file=sys.stderr)
+        return 2
 
     from dgc_tpu.obs import MetricsRegistry, RunLogger, RunManifest
     from dgc_tpu.serve.queue import QueueFull, ServeFrontEnd
@@ -191,26 +302,27 @@ def serve_main(argv: list[str] | None = None) -> int:
 
         tuned_cache = TunedConfigCache(args.tuned_cache_dir)
 
-    try:
-        lines = Path(args.requests).read_text().splitlines()
-    except OSError as e:
-        print(f"Cannot read --requests {args.requests}: {e}",
-              file=sys.stderr)
-        return 2
     requests = []
-    for lineno, line in enumerate(lines, 1):
-        line = line.strip()
-        if not line:
-            continue
+    if args.requests is not None:
         try:
-            doc = json.loads(line)
-            if not isinstance(doc, dict):
-                raise ValueError("request line must be a JSON object")
-            requests.append((doc.get("id", lineno), doc))
-        except (json.JSONDecodeError, ValueError) as e:
-            print(f"{args.requests}:{lineno}: bad request: {e}",
+            lines = Path(args.requests).read_text().splitlines()
+        except OSError as e:
+            print(f"Cannot read --requests {args.requests}: {e}",
                   file=sys.stderr)
             return 2
+        for lineno, line in enumerate(lines, 1):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                doc = json.loads(line)
+                if not isinstance(doc, dict):
+                    raise ValueError("request line must be a JSON object")
+                requests.append((doc.get("id", lineno), doc))
+            except (json.JSONDecodeError, ValueError) as e:
+                print(f"{args.requests}:{lineno}: bad request: {e}",
+                      file=sys.stderr)
+                return 2
 
     out_dir = Path(args.output_colorings) if args.output_colorings else None
     if out_dir is not None:
@@ -241,9 +353,14 @@ def serve_main(argv: list[str] | None = None) -> int:
 
     # live scrape endpoint (obs.httpd): GET /metrics serves the registry
     # in Prometheus text format for the whole replay — the ROADMAP
-    # "Prometheus scrape of the existing metrics registry" rung
+    # "Prometheus scrape of the existing metrics registry" rung. In
+    # --listen mode the SAME routes mount on the application listener
+    # (one port, one server) and a separate scrape port is redundant.
     metrics_server = None
-    if args.metrics_port is not None:
+    if args.metrics_port is not None and args.listen is not None:
+        print("# --metrics-port ignored with --listen: /metrics mounts "
+              "on the listener port", file=sys.stderr)
+    elif args.metrics_port is not None:
         from dgc_tpu.obs import MetricsHTTPServer, profiler
 
         try:
@@ -280,6 +397,10 @@ def serve_main(argv: list[str] | None = None) -> int:
             print(f"--warm-classes: {e}", file=sys.stderr)
             front.shutdown(drain=False)
             return 2
+
+    if args.listen is not None:
+        return _listen_main(args, front, logger, registry, manifest,
+                            recorder, warmup)
 
     t0 = time.perf_counter()
     bad = 0

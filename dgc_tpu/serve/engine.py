@@ -107,14 +107,26 @@ def depth_bucket(k: int) -> int:
     return max(1, int(k)).bit_length()
 
 
-class _SweepCall:
-    __slots__ = ("member", "k", "depth", "done", "result", "error",
-                 "t_enqueue", "span", "lane_span", "device_us")
+def priority_window(window_s: float, priority: int) -> float:
+    """Effective micro-batching window when the highest-priority pending
+    call has tier ``priority``: a paid tier halves the wait per tier
+    (``window / 2^priority``) — it trades batching density for first-
+    dispatch latency, which is exactly what the tier buys. Priority 0
+    (the free tier) keeps the configured window untouched."""
+    if priority <= 0:
+        return window_s
+    return window_s / (1 << min(int(priority), 6))
 
-    def __init__(self, member, k, span=None):
+
+class _SweepCall:
+    __slots__ = ("member", "k", "depth", "priority", "done", "result",
+                 "error", "t_enqueue", "span", "lane_span", "device_us")
+
+    def __init__(self, member, k, span=None, priority=0):
         self.member = member
         self.k = int(k)
         self.depth = depth_bucket(k)
+        self.priority = max(0, int(priority))
         self.done = threading.Event()
         self.result = None
         self.error = None
@@ -487,16 +499,18 @@ class BatchScheduler:
             call.done.set()
 
     # -- submission (worker threads) ------------------------------------
-    def sweep(self, member, k: int):
+    def sweep(self, member, k: int, priority: int = 0):
         """Blocking batched sweep: returns the raw per-member kernel
         outputs ``(p1, s1, st1, used, p2, s2, st2)``. The sweep span
         (parent: the calling thread's current span — the worker's
         ``serve`` span via ``Tracer.current``) brackets enqueue through
         result delivery; the dispatcher opens a child ``lane`` span per
-        seating."""
+        seating. ``priority`` > 0 (netfront paid tiers) seats first in
+        affinity ordering and shortens the batching window
+        (:func:`priority_window`)."""
         span = self.tracer.begin("sweep", attrs={"k": int(k),
                                                  "cls": member.cls.name})
-        call = _SweepCall(member, k, span=span)
+        call = _SweepCall(member, k, span=span, priority=priority)
         try:
             with self._lock:
                 if self._stop:
@@ -555,11 +569,13 @@ class BatchScheduler:
 
     # -- affinity -------------------------------------------------------
     def _affinity_order(self, calls: list, live_depths: list) -> list:
-        """Order a class's pending calls for seating: same-depth-bucket
-        calls together (nearest the live lanes' median bucket first in
-        continuous mode; largest group first when the pool is empty),
-        FIFO within a bucket, and strict FIFO for anything waiting past
-        the starvation guard."""
+        """Order a class's pending calls for seating: priority tier
+        first (a paid call seats before any lower tier), then
+        same-depth-bucket calls together (nearest the live lanes'
+        median bucket first in continuous mode; largest group first
+        when the pool is empty), FIFO within a bucket, and strict FIFO
+        for anything waiting past the starvation guard (affinity AND
+        priority may reorder, never starve)."""
         if not self.affinity or len(calls) <= 1:
             return list(calls)
         now = time.perf_counter()
@@ -569,12 +585,14 @@ class BatchScheduler:
             return sorted(calls, key=lambda c: c.t_enqueue)
         if live_depths:
             target = sorted(live_depths)[len(live_depths) // 2]
-            key = lambda c: (abs(c.depth - target), c.depth, c.t_enqueue)
+            key = lambda c: (-c.priority, abs(c.depth - target), c.depth,
+                             c.t_enqueue)
         else:
             groups: dict = {}
             for c in calls:
                 groups[c.depth] = groups.get(c.depth, 0) + 1
-            key = lambda c: (-groups[c.depth], c.depth, c.t_enqueue)
+            key = lambda c: (-c.priority, -groups[c.depth], c.depth,
+                             c.t_enqueue)
         return sorted(calls, key=key)
 
     def reset_transfer_stats(self) -> None:
@@ -739,9 +757,16 @@ class BatchScheduler:
                 return False
             if (self.window_s > 0 and self._pending
                     and not any(p.live for p in self._pools.values())):
-                cls = next(iter(self._pending))
+                # the highest-priority pending call picks the class AND
+                # shortens the wait (priority_window): a paid tier pays
+                # less first-dispatch latency for batching company
+                cls = max(self._pending, key=lambda c: max(
+                    x.priority for x in self._pending[c]))
+                window = priority_window(
+                    self.window_s,
+                    max(x.priority for x in self._pending[cls]))
                 if len(self._pending[cls]) < self.batch_max:
-                    deadline = time.perf_counter() + self.window_s
+                    deadline = time.perf_counter() + window
                     while (not self._stop
                            and len(self._pending.get(cls) or [])
                            < self.batch_max):
@@ -992,10 +1017,15 @@ class BatchScheduler:
                 self._lock.wait()
             if self._stop:
                 return None
-            # window: give same-class calls a chance to coalesce
-            cls = next(iter(self._pending))
+            # window: give same-class calls a chance to coalesce (the
+            # highest-priority pending call picks the class and shortens
+            # the window — priority_window)
+            cls = max(self._pending, key=lambda c: max(
+                x.priority for x in self._pending[c]))
+            window = priority_window(
+                self.window_s, max(x.priority for x in self._pending[cls]))
             if self.window_s > 0 and len(self._pending[cls]) < self.batch_max:
-                deadline = time.perf_counter() + self.window_s
+                deadline = time.perf_counter() + window
                 while (not self._stop
                        and len(self._pending.get(cls) or []) < self.batch_max):
                     left = deadline - time.perf_counter()
@@ -1097,9 +1127,11 @@ class BatchMemberEngine:
     the batch scheduler, so ``find_minimal_coloring`` drives the batched
     path exactly like any fused engine."""
 
-    def __init__(self, member, scheduler: BatchScheduler):
+    def __init__(self, member, scheduler: BatchScheduler,
+                 priority: int = 0):
         self.member = member
         self.scheduler = scheduler
+        self.priority = max(0, int(priority))
         self._fallback = None
 
     # the STALLED-confirm fallback owns the widen-and-retry loop; with
@@ -1120,7 +1152,8 @@ class BatchMemberEngine:
     def sweep(self, k0: int):
         if k0 < 1:
             return self.attempt(k0), None
-        out = self.scheduler.sweep(self.member, k0)
+        out = self.scheduler.sweep(self.member, k0,
+                                   priority=self.priority)
         member = _KMember(self.member, k0)
         return finish_pair(member, *out, self.attempt)
 

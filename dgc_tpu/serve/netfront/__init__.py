@@ -1,0 +1,40 @@
+"""Network front door: HTTP listener + multi-tenant admission control.
+
+The production request path over :class:`~dgc_tpu.serve.queue
+.ServeFrontEnd` (ROADMAP item 1 — "the single biggest gap between
+'serving tier' and 'service'"). Everything below the socket already
+existed: bounded queue with :class:`~dgc_tpu.serve.queue.QueueFull`
+backpressure, worker pool + continuous batching with lane recycling,
+per-class latency histograms, live Prometheus ``/metrics`` +
+``/healthz``. This package adds the surface itself:
+
+- ``listener`` — :class:`NetFront`: ``POST /v1/color`` (submit → ticket
+  id; backpressure → 429 + ``Retry-After`` with structured context),
+  ``GET /v1/result/<id>`` (poll), ``GET /v1/stream/<id>`` (chunked
+  per-attempt progress from the ``on_attempt`` hook), ``POST
+  /admin/drain`` (graceful rolling-restart drain over
+  ``ServeFrontEnd.shutdown(drain=True)``) — and the observability
+  routes (``/metrics``, ``/healthz``, ``/debug/flightrec``,
+  ``/debug/profile``) mounted on the SAME listener via
+  ``obs.httpd.mount_observability`` (one port, one server).
+- ``admission`` — :class:`AdmissionController`: per-tenant token
+  buckets and concurrency quotas AHEAD of the bounded queue, priority
+  tiers fed into the batch scheduler's affinity path (a paid tier
+  shortens its batching window and jumps the request queue), and
+  per-tenant metrics labels in the shared
+  :class:`~dgc_tpu.obs.metrics.MetricsRegistry` so ``/metrics`` breaks
+  out tenants.
+
+``tools/soak.py`` is the many-client soak harness over this package;
+its run log feeds ``tools/slo_check.py`` and its record feeds
+``tools/perf_db.py`` — multi-tenant serving under load as a ledgered
+number.
+"""
+
+from dgc_tpu.serve.netfront.admission import (AdmissionController,
+                                              AdmissionReject, TenantConfig,
+                                              load_tenant_configs)
+from dgc_tpu.serve.netfront.listener import NetFront
+
+__all__ = ["AdmissionController", "AdmissionReject", "NetFront",
+           "TenantConfig", "load_tenant_configs"]

@@ -1,0 +1,250 @@
+"""Multi-tenant admission control ahead of the bounded serve queue.
+
+The bounded queue (``serve.queue``) protects the PROCESS — one global
+``QueueFull`` when the whole tier is saturated. This layer protects
+TENANTS from each other before a request ever reaches that queue:
+
+- **token buckets** — each tenant refills at ``rate`` requests/second
+  up to ``burst``; an empty bucket rejects with a computed
+  ``retry_after_s`` ((1 − tokens)/rate — the exact time the next token
+  lands), so a well-behaved client backs off precisely instead of
+  hammering;
+- **concurrency quotas** — at most ``max_concurrency`` of a tenant's
+  requests in flight (admitted, not yet completed) at once, so one
+  tenant's slow graphs cannot occupy every worker lane;
+- **priority tiers** — ``tier`` ("free"/"paid"/"premium", or an
+  explicit ``priority`` int) rides each admitted request into
+  ``ServeFrontEnd.submit`` and the batch scheduler's affinity path:
+  a paid tier jumps the request queue and shortens its batching window
+  (``serve.engine.priority_window``).
+
+Every decision lands in the obs stream (``net_admit`` / ``net_reject``
+— schema-enforced, semantic fields checked by
+``tools/validate_runlog.py``) and the shared metrics registry with a
+``tenant`` label, so ``/metrics`` breaks out tenants.
+
+Thread model: listener handler threads call :meth:`AdmissionController
+.admit` concurrently; completion callbacks call :meth:`release` from
+worker threads; exporters read :meth:`snapshot`. All tenant state is
+guarded by the controller's lock (dgc-lint LK/points-to coverage —
+``netfront`` is in the lock pass's file set).
+"""
+
+from __future__ import annotations
+
+import threading
+import time
+from dataclasses import dataclass
+
+# named tiers -> scheduler priority (an explicit ``priority`` int in a
+# tenant config overrides the mapping)
+TIER_PRIORITY = {"free": 0, "standard": 0, "paid": 1, "premium": 2}
+
+# reject reasons — the closed vocabulary tools/validate_runlog.py
+# enforces on net_reject events (and the 429/503 body's "reason")
+REJECT_REASONS = ("rate_limited", "concurrency", "queue_full", "draining")
+
+
+class AdmissionReject(RuntimeError):
+    """A request refused ahead of the queue, with machine-readable
+    backpressure context (the 429 body + ``net_reject`` fields)."""
+
+    def __init__(self, tenant: str, reason: str,
+                 retry_after_s: float | None = None, **context):
+        super().__init__(f"tenant {tenant!r} rejected: {reason}")
+        assert reason in REJECT_REASONS, reason
+        self.tenant = tenant
+        self.reason = reason
+        self.retry_after_s = retry_after_s
+        self.context = context
+
+    def to_fields(self) -> dict:
+        doc = {"tenant": self.tenant, "reason": self.reason}
+        if self.retry_after_s is not None:
+            doc["retry_after_s"] = round(float(self.retry_after_s), 4)
+        doc.update(self.context)
+        return doc
+
+
+@dataclass(frozen=True)
+class TenantConfig:
+    """One tenant's admission policy. ``rate=None`` disables the token
+    bucket; ``max_concurrency=None`` disables the quota (the defaults:
+    admission present but permissive)."""
+
+    name: str = "default"
+    rate: float | None = None            # tokens (requests) per second
+    burst: float = 10.0                  # bucket capacity
+    max_concurrency: int | None = None   # in-flight bound
+    tier: str = "free"
+    priority: int | None = None          # overrides the tier mapping
+
+    def resolved_priority(self) -> int:
+        if self.priority is not None:
+            return max(0, int(self.priority))
+        return TIER_PRIORITY.get(self.tier, 0)
+
+    def validate(self) -> None:
+        if self.rate is not None and self.rate <= 0:
+            raise ValueError(f"tenant {self.name}: rate must be > 0")
+        if self.burst <= 0:
+            raise ValueError(f"tenant {self.name}: burst must be > 0")
+        if self.max_concurrency is not None and self.max_concurrency < 1:
+            raise ValueError(
+                f"tenant {self.name}: max_concurrency must be >= 1")
+
+
+def load_tenant_configs(doc: dict) -> dict[str, TenantConfig]:
+    """Parse the tenants document (the ``--tenants`` JSON schema)::
+
+        {"default": {"rate": 100, "burst": 50, "max_concurrency": 16},
+         "tenants": {"acme": {"tier": "paid", "rate": 500},
+                     "scraper": {"rate": 2, "burst": 2}}}
+
+    Unknown tenants fall back to ``default`` (absent: permissive).
+    Returns ``{name: TenantConfig}`` with ``"default"`` always present.
+    """
+    known = {"rate", "burst", "max_concurrency", "tier", "priority"}
+    out: dict[str, TenantConfig] = {}
+
+    def build(name: str, fields: dict) -> TenantConfig:
+        if not isinstance(fields, dict):
+            raise ValueError(f"tenant {name}: config must be an object")
+        bad = set(fields) - known
+        if bad:
+            raise ValueError(f"tenant {name}: unknown key(s) {sorted(bad)}")
+        cfg = TenantConfig(name=name, **fields)
+        cfg.validate()
+        return cfg
+
+    out["default"] = build("default", doc.get("default", {}))
+    for name, fields in (doc.get("tenants") or {}).items():
+        out[name] = build(name, fields)
+    return out
+
+
+class _TenantState:
+    """One tenant's live bucket + quota cells. Guarded by the OWNING
+    controller's lock (one lock for the whole table: admissions are
+    cheap and the table is read whole by exporters)."""
+
+    __slots__ = ("cfg", "tokens", "t_refill", "in_flight", "admitted",
+                 "rejected")
+
+    def __init__(self, cfg: TenantConfig, now: float):
+        self.cfg = cfg
+        self.tokens = float(cfg.burst)
+        self.t_refill = now
+        self.in_flight = 0
+        self.admitted = 0
+        self.rejected = 0
+
+
+class AdmissionController:
+    """Per-tenant token buckets + concurrency quotas + priority tiers.
+
+    ``admit(tenant)`` either returns the tenant's resolved
+    :class:`TenantConfig` (and charges one token + one concurrency
+    slot) or raises :class:`AdmissionReject` with retry context;
+    ``release(tenant)`` returns the concurrency slot when the request
+    completes (any status). ``clock`` is injectable for tests."""
+
+    def __init__(self, configs: dict[str, TenantConfig] | None = None,
+                 *, registry=None, logger=None, clock=time.monotonic):
+        self._configs = dict(configs or {})   # guarded-by: init
+        self._configs.setdefault("default", TenantConfig())
+        self.registry = registry
+        self.logger = logger
+        self._clock = clock
+        self._lock = threading.Lock()
+        self._tenants: dict = {}   # name -> _TenantState; guarded-by: _lock
+
+    def config_for(self, tenant: str) -> TenantConfig:
+        cfg = self._configs.get(tenant)
+        if cfg is None:
+            base = self._configs["default"]
+            # the default policy applied under the caller's name (so
+            # metrics/events still break out the tenant)
+            cfg = TenantConfig(name=tenant, rate=base.rate,
+                               burst=base.burst,
+                               max_concurrency=base.max_concurrency,
+                               tier=base.tier, priority=base.priority)
+        return cfg
+
+    # -- the admission decision -----------------------------------------
+    def admit(self, tenant: str) -> TenantConfig:
+        """Charge one request against ``tenant``; raises
+        :class:`AdmissionReject` (reason ``rate_limited`` or
+        ``concurrency``) when over quota. The caller MUST pair every
+        successful admit with exactly one :meth:`release`."""
+        now = self._clock()
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is None:
+                st = self._tenants[tenant] = _TenantState(
+                    self.config_for(tenant), now)
+            cfg = st.cfg
+            if cfg.rate is not None:
+                st.tokens = min(float(cfg.burst),
+                                st.tokens + (now - st.t_refill) * cfg.rate)
+                st.t_refill = now
+                if st.tokens < 1.0:
+                    st.rejected += 1
+                    retry = (1.0 - st.tokens) / cfg.rate
+                    reject = AdmissionReject(
+                        tenant, "rate_limited", retry_after_s=retry,
+                        tokens_left=round(st.tokens, 4),
+                        limit=int(cfg.burst))
+                    self._count_reject(reject)
+                    raise reject
+                st.tokens -= 1.0
+            if cfg.max_concurrency is not None \
+                    and st.in_flight >= cfg.max_concurrency:
+                st.rejected += 1
+                reject = AdmissionReject(
+                    tenant, "concurrency", retry_after_s=0.1,
+                    in_flight=st.in_flight, limit=int(cfg.max_concurrency))
+                self._count_reject(reject)
+                raise reject
+            st.in_flight += 1
+            st.admitted += 1
+            in_flight = st.in_flight
+        if self.registry is not None:
+            self.registry.gauge(
+                "dgc_net_in_flight", "admitted requests in flight",
+                tenant=tenant).set(in_flight)
+        return cfg
+
+    def release(self, tenant: str) -> None:
+        with self._lock:
+            st = self._tenants.get(tenant)
+            if st is None or st.in_flight <= 0:
+                return   # defensive: release without admit is a no-op
+            st.in_flight -= 1
+            in_flight = st.in_flight
+        if self.registry is not None:
+            self.registry.gauge(
+                "dgc_net_in_flight", "admitted requests in flight",
+                tenant=tenant).set(in_flight)
+
+    def _count_reject(self, reject: AdmissionReject) -> None:
+        """Metrics only — the ``net_reject`` EVENT is emitted by the
+        listener (which adds the ticketless HTTP context)."""
+        if self.registry is not None:
+            self.registry.counter(
+                "dgc_net_rejected_total", "requests refused at admission",
+                tenant=reject.tenant, reason=reject.reason).inc()
+
+    # -- exporter-side reads --------------------------------------------
+    def snapshot(self) -> dict:
+        """Locked copy of every tenant's live admission state — the
+        safe read for ``/healthz`` and harness assertions (the tenant
+        table is mutated by listener and worker threads)."""
+        with self._lock:
+            return {name: {"tokens": round(st.tokens, 4),
+                           "in_flight": st.in_flight,
+                           "admitted": st.admitted,
+                           "rejected": st.rejected,
+                           "tier": st.cfg.tier,
+                           "priority": st.cfg.resolved_priority()}
+                    for name, st in self._tenants.items()}

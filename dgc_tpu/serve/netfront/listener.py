@@ -1,0 +1,390 @@
+"""The network front door: HTTP request path over ``ServeFrontEnd``.
+
+:class:`NetFront` maps 1:1 onto the existing front-end API — nothing in
+the serving tier below the socket changes semantics:
+
+- ``POST /v1/color`` — submit one coloring request. The body is either
+  a generator spec (``{"node_count", "max_degree", "seed"?,
+  "gen_method"?}``) or an inline reference-schema graph (``{"graph":
+  [{"id", "neighbors"}, ...]}``). The tenant rides the ``X-Dgc-Tenant``
+  header (default ``"anon"``). Returns ``202 {"ticket": id}``;
+  admission rejects and :class:`~dgc_tpu.serve.queue.QueueFull`
+  backpressure both return ``429`` with a ``Retry-After`` header and
+  the structured context in the body; a draining front end returns
+  ``503``.
+- ``GET /v1/result/<id>`` — poll: ``200`` with the result (add
+  ``?colors=1`` for the coloring vector), ``202`` while in flight,
+  ``404`` for unknown/expired tickets.
+- ``GET /v1/stream/<id>`` — chunked JSONL progress: one
+  ``{"attempt": ...}`` line per minimal-k attempt (forwarded from the
+  front end's ``on_attempt`` hook as they happen) and a final
+  ``{"result": ...}`` line.
+- ``POST /admin/drain`` — graceful rolling-restart drain over
+  ``ServeFrontEnd.shutdown(drain=True)``: stops admitting (subsequent
+  submits get ``503``), finishes everything admitted, returns the
+  final counts. Idempotent and safe against a concurrent owner-side
+  ``shutdown()``; completed tickets stay pollable after the drain.
+
+The observability surface (``/metrics``, ``/healthz``,
+``/debug/flightrec``, ``/debug/profile``) mounts on the SAME listener
+via :func:`dgc_tpu.obs.httpd.mount_observability` — one port, one
+server. Every admission decision lands in the obs stream (``net_admit``
+/ ``net_reject`` / ``net_drain``) and per-tenant metrics labels land in
+the shared registry (``dgc_net_*`` families), so ``/metrics`` breaks
+out tenants.
+
+Thread model: handler threads run admission + submit; worker threads
+run completion callbacks; the ticket table and drain state are guarded
+by the netfront lock (netfront is in dgc-lint's lock-pass file set).
+"""
+
+from __future__ import annotations
+
+import json
+import threading
+import time
+from collections import deque
+
+import numpy as np
+
+from dgc_tpu.models.graph import Graph
+from dgc_tpu.models.node import Node
+from dgc_tpu.obs.httpd import (Request, Response, RoutingHTTPServer,
+                               StreamingResponse, json_response,
+                               mount_observability)
+from dgc_tpu.serve.netfront.admission import (AdmissionController,
+                                              AdmissionReject)
+from dgc_tpu.serve.queue import QueueFull, ServeError
+
+TENANT_HEADER = "X-Dgc-Tenant"
+
+# completed tickets retained for polling before FIFO eviction; in-flight
+# tickets are never evicted (zero-lost-results contract, tools/soak.py)
+DEFAULT_RESULT_CAPACITY = 65536
+
+# a stream poller abandoned by its request gives up after this long
+STREAM_TIMEOUT_S = 600.0
+
+_VERTEX_CAP = 4_000_000   # generator-spec bound: one request ≠ one pod
+
+
+class _NetTicket:
+    """One submitted request's netfront-side state. ``cond`` guards the
+    attempt feed and the completion slot; streamers wait on it."""
+
+    __slots__ = ("ticket_id", "tenant", "priority", "cond", "attempts",
+                 "result", "t_submit")
+
+    def __init__(self, ticket_id: str, tenant: str, priority: int):
+        self.ticket_id = ticket_id
+        self.tenant = tenant
+        self.priority = priority
+        self.cond = threading.Condition()
+        self.attempts: list = []   # guarded-by: cond
+        self.result = None         # guarded-by: cond
+        self.t_submit = time.perf_counter()
+
+
+def _result_doc(res, with_colors: bool = False) -> dict:
+    doc = {"status": res.status,
+           "minimal_colors": res.minimal_colors,
+           "queue_ms": round(res.queue_s * 1e3, 3),
+           "service_ms": round(res.service_s * 1e3, 3),
+           "batched": res.batched,
+           "shape_class": res.shape_class,
+           "attempts": len(res.attempts),
+           "error": res.error}
+    if with_colors and res.colors is not None:
+        doc["colors"] = np.asarray(res.colors).tolist()
+    return doc
+
+
+class NetFront:
+    """``NetFront(front, admission=..., registry=...).start()`` — the
+    production listener over a STARTED :class:`~dgc_tpu.serve.queue
+    .ServeFrontEnd`. ``port=0`` binds any free port (read ``.port``
+    back). ``close()`` stops the listener only; ``drain()`` (or ``POST
+    /admin/drain``) drains the front end through it. The optional
+    ``recorder`` / ``profiler`` / ``flightrec_dir`` wire the debug
+    routes exactly like ``MetricsHTTPServer``."""
+
+    def __init__(self, front, *, admission: AdmissionController | None = None,
+                 registry=None, logger=None, recorder=None, profiler=None,
+                 flightrec_dir: str = ".", host: str = "127.0.0.1",
+                 port: int = 0,
+                 result_capacity: int = DEFAULT_RESULT_CAPACITY):
+        self.front = front
+        self.admission = admission if admission is not None \
+            else AdmissionController(registry=registry, logger=logger)
+        self.registry = registry
+        self.logger = logger
+        self._lock = threading.Lock()
+        self._tickets: dict = {}      # id -> _NetTicket; guarded-by: _lock
+        self._completed: deque = deque()   # eviction order; guarded-by: _lock
+        self._next_ticket = 0         # guarded-by: _lock
+        self._draining = False        # guarded-by: _lock
+        self._drain_doc = None        # guarded-by: _lock
+        # set once a drain fully completes — the CLI's listen loop (and
+        # rolling-restart supervisors) block on it
+        self.drained = threading.Event()
+        self.result_capacity = int(result_capacity)
+        # one listener, application + observability routes together
+        self.server = RoutingHTTPServer(port=port, host=host)
+        mount_observability(self.server, registry=registry,
+                            health_fn=self._health_doc, recorder=recorder,
+                            profiler=profiler, flightrec_dir=flightrec_dir)
+        self.server.route("POST", "/v1/color", self._post_color)
+        self.server.route("GET", "/v1/result/", self._get_result,
+                          prefix=True)
+        self.server.route("GET", "/v1/stream/", self._get_stream,
+                          prefix=True)
+        self.server.route("POST", "/admin/drain", self._post_drain)
+
+    # -- obs plumbing ---------------------------------------------------
+    def _event(self, kind: str, **fields) -> None:
+        if self.logger is not None:
+            self.logger.event(kind, **fields)
+
+    # -- lifecycle ------------------------------------------------------
+    @property
+    def port(self) -> int:
+        return self.server.port
+
+    def start(self) -> "NetFront":
+        self.server.start()
+        return self
+
+    def close(self) -> None:
+        self.server.close()
+
+    def _health_doc(self) -> dict:
+        doc = self.front.health()
+        with self._lock:
+            doc["draining"] = self._draining
+        doc["tenants"] = self.admission.snapshot()
+        return doc
+
+    # -- request parsing ------------------------------------------------
+    @staticmethod
+    def _load_graph(doc: dict) -> Graph:
+        if "graph" in doc:
+            nodes = doc["graph"]
+            if not isinstance(nodes, list) or not nodes:
+                raise ValueError("'graph' must be a non-empty node list")
+            return Graph.from_nodes([Node.from_dict(d) for d in nodes])
+        if "node_count" in doc and "max_degree" in doc:
+            n = int(doc["node_count"])
+            if not 1 <= n <= _VERTEX_CAP:
+                raise ValueError(
+                    f"node_count must be in [1, {_VERTEX_CAP}]")
+            return Graph.generate(n, int(doc["max_degree"]),
+                                  seed=doc.get("seed"),
+                                  method=doc.get("gen_method", "fast"))
+        raise ValueError(
+            "request needs either 'graph' (inline node list) or "
+            "'node_count'+'max_degree' (generator spec)")
+
+    # -- POST /v1/color --------------------------------------------------
+    def _post_color(self, req: Request):
+        tenant = (req.headers.get(TENANT_HEADER) or "anon").strip()
+        with self._lock:
+            draining = self._draining
+        if draining:
+            self._event("net_reject", tenant=tenant, reason="draining")
+            return json_response(
+                {"error": "draining", "reason": "draining",
+                 "tenant": tenant}, status=503)
+        try:
+            doc = req.json()
+            if not isinstance(doc, dict):
+                raise ValueError("request body must be a JSON object")
+            graph = self._load_graph(doc)
+        except (ValueError, KeyError, TypeError) as e:
+            return json_response(
+                {"error": f"bad request: {e}", "tenant": tenant},
+                status=400)
+        try:
+            cfg = self.admission.admit(tenant)
+        except AdmissionReject as e:
+            fields = e.to_fields()
+            self._event("net_reject", **fields)
+            return self._reject_response(fields)
+        priority = cfg.resolved_priority()
+        with self._lock:
+            ticket_id = f"t{self._next_ticket:08x}"
+            self._next_ticket += 1
+        net_ticket = _NetTicket(ticket_id, tenant, priority)
+
+        def on_attempt(res, val):
+            att = {"k": int(res.k), "status": res.status.name,
+                   "supersteps": int(res.supersteps)}
+            with net_ticket.cond:
+                net_ticket.attempts.append(att)
+                net_ticket.cond.notify_all()
+
+        try:
+            serve_ticket = self.front.submit(
+                graph.arrays, request_id=ticket_id,
+                priority=priority, on_attempt=on_attempt)
+        except QueueFull as e:
+            self.admission.release(tenant)
+            fields = dict(e.to_fields(), tenant=tenant,
+                          reason="queue_full")
+            self._event("net_reject", **fields)
+            return self._reject_response(fields)
+        except ServeError:
+            # the front end began draining between our check and submit
+            self.admission.release(tenant)
+            self._event("net_reject", tenant=tenant, reason="draining")
+            return json_response(
+                {"error": "draining", "reason": "draining",
+                 "tenant": tenant}, status=503)
+        with self._lock:
+            self._tickets[ticket_id] = net_ticket
+        serve_ticket.add_done_callback(
+            lambda result: self._on_done(net_ticket, result))
+        snap = self.admission.snapshot().get(tenant, {})
+        self._event("net_admit", tenant=tenant, ticket=ticket_id,
+                    tier=cfg.tier, priority=priority,
+                    in_flight=int(snap.get("in_flight", 1)),
+                    v=int(graph.num_vertices))
+        if self.registry is not None:
+            self.registry.counter(
+                "dgc_net_admitted_total", "requests admitted",
+                tenant=tenant).inc()
+        return json_response(
+            {"ticket": ticket_id, "tenant": tenant, "priority": priority},
+            status=202)
+
+    @staticmethod
+    def _reject_response(fields: dict) -> Response:
+        headers = ()
+        retry = fields.get("retry_after_s")
+        if retry is not None:
+            # Retry-After is integer seconds; never advertise 0 (a
+            # client busy-loop), always at least 1
+            headers = (("Retry-After", max(1, int(round(retry)))),)
+        return json_response(dict(fields, error=fields["reason"]),
+                             status=429, headers=headers)
+
+    # -- completion (worker thread) --------------------------------------
+    def _on_done(self, net_ticket: _NetTicket, result) -> None:
+        with net_ticket.cond:
+            net_ticket.result = result
+            net_ticket.cond.notify_all()
+        self.admission.release(net_ticket.tenant)
+        if self.registry is not None:
+            self.registry.counter(
+                "dgc_net_requests_total", "completed network requests",
+                tenant=net_ticket.tenant, status=result.status).inc()
+            self.registry.histogram(
+                "dgc_net_service_seconds",
+                "request service time by tenant",
+                tenant=net_ticket.tenant).observe(result.service_s)
+        # bounded retention: completed tickets are evictable FIFO once
+        # the table outgrows result_capacity; in-flight ones never are
+        with self._lock:
+            self._completed.append(net_ticket.ticket_id)
+            while len(self._tickets) > self.result_capacity \
+                    and self._completed:
+                self._tickets.pop(self._completed.popleft(), None)
+
+    # -- GET /v1/result/<id> ---------------------------------------------
+    def _ticket_for(self, req: Request, prefix: str):
+        ticket_id = req.path[len(prefix):]
+        with self._lock:
+            return ticket_id, self._tickets.get(ticket_id)
+
+    def _get_result(self, req: Request):
+        ticket_id, net_ticket = self._ticket_for(req, "/v1/result/")
+        if net_ticket is None:
+            return json_response(
+                {"error": f"unknown or expired ticket {ticket_id!r}"},
+                status=404)
+        with net_ticket.cond:
+            result = net_ticket.result
+            attempts = list(net_ticket.attempts)
+        if result is None:
+            return json_response(
+                {"ticket": ticket_id, "status": "pending",
+                 "attempts": len(attempts)}, status=202)
+        with_colors = req.query.get("colors", ["0"])[0] in ("1", "true")
+        doc = dict(_result_doc(result, with_colors=with_colors),
+                   ticket=ticket_id, tenant=net_ticket.tenant)
+        return json_response(doc)
+
+    # -- GET /v1/stream/<id> ---------------------------------------------
+    def _get_stream(self, req: Request):
+        ticket_id, net_ticket = self._ticket_for(req, "/v1/stream/")
+        if net_ticket is None:
+            return json_response(
+                {"error": f"unknown or expired ticket {ticket_id!r}"},
+                status=404)
+
+        def chunks():
+            sent = 0
+            deadline = time.perf_counter() + STREAM_TIMEOUT_S
+            while True:
+                with net_ticket.cond:
+                    while (len(net_ticket.attempts) <= sent
+                           and net_ticket.result is None):
+                        left = deadline - time.perf_counter()
+                        if left <= 0:
+                            yield (json.dumps(
+                                {"error": "stream timeout"}) + "\n").encode()
+                            return
+                        net_ticket.cond.wait(timeout=min(left, 1.0))
+                    fresh = net_ticket.attempts[sent:]
+                    result = net_ticket.result
+                sent += len(fresh)
+                for att in fresh:
+                    yield (json.dumps({"attempt": att}) + "\n").encode()
+                if result is not None:
+                    yield (json.dumps(
+                        {"result": _result_doc(result)}) + "\n").encode()
+                    return
+
+        return StreamingResponse(chunks())
+
+    # -- POST /admin/drain -----------------------------------------------
+    def drain(self, timeout: float = 60.0) -> dict:
+        """Graceful drain: stop admitting, finish everything admitted
+        (``ServeFrontEnd.shutdown(drain=True)``), report final counts.
+        Concurrent callers (and an owner-side ``shutdown()`` racing
+        this) all converge on one drain; repeat calls return the first
+        drain's document."""
+        health = self.front.health()
+        with self._lock:
+            already = self._drain_doc
+            first = not self._draining
+            self._draining = True
+        if already is not None or not first:
+            # a drain is finished or in progress: wait for the winner
+            self.front.shutdown(drain=True, timeout=timeout)
+            with self._lock:
+                return dict(self._drain_doc or {"drained": True})
+        t0 = time.perf_counter()
+        in_flight = int(health["in_flight"])
+        queued = int(health["queue_depth"])
+        self.front.shutdown(drain=True, timeout=timeout)
+        st = self.front.stats_snapshot()
+        doc = {"drained": True, "in_flight": in_flight, "queued": queued,
+               "completed": st["completed"], "failed": st["failed"],
+               "wall_s": round(time.perf_counter() - t0, 4)}
+        self._event("net_drain", in_flight=in_flight, queued=queued,
+                    completed=st["completed"], failed=st["failed"],
+                    timeout_s=float(timeout),
+                    wall_s=doc["wall_s"])
+        with self._lock:
+            self._drain_doc = doc
+        self.drained.set()
+        return doc
+
+    def _post_drain(self, req: Request):
+        try:
+            body = req.json()
+            timeout = float(body.get("timeout_s", 60.0)) \
+                if isinstance(body, dict) else 60.0
+        except ValueError:
+            return json_response({"error": "bad request body"}, status=400)
+        return json_response(self.drain(timeout=timeout))

@@ -43,7 +43,33 @@ from dgc_tpu.serve.shape_classes import DEFAULT_LADDER, ShapeLadder, pad_member
 
 
 class QueueFull(RuntimeError):
-    """Backpressure signal: the bounded request queue is at capacity."""
+    """Backpressure signal: the bounded request queue is at capacity.
+
+    Carries machine-readable context (PR 12): ``queue_depth`` /
+    ``capacity`` at rejection time and a ``retry_after_s`` suggestion
+    (queue length × recent mean service time / workers), so the network
+    path's 429 responses and the flight recorder's ``net_reject``
+    events get structured fields instead of a parsed message string."""
+
+    def __init__(self, message: str, *, queue_depth: int | None = None,
+                 capacity: int | None = None,
+                 retry_after_s: float | None = None):
+        super().__init__(message)
+        self.queue_depth = queue_depth
+        self.capacity = capacity
+        self.retry_after_s = retry_after_s
+
+    def to_fields(self) -> dict:
+        """The structured backpressure context (429 body / event
+        fields); only the populated fields appear."""
+        doc = {}
+        if self.queue_depth is not None:
+            doc["queue_depth"] = int(self.queue_depth)
+        if self.capacity is not None:
+            doc["capacity"] = int(self.capacity)
+        if self.retry_after_s is not None:
+            doc["retry_after_s"] = round(float(self.retry_after_s), 4)
+        return doc
 
 
 @dataclass
@@ -51,6 +77,12 @@ class ServeRequest:
     request_id: int
     arrays: GraphArrays
     t_submit: float = field(default_factory=time.perf_counter)
+    # priority tier (netfront admission): >0 jumps the request queue
+    # and shortens the batch scheduler's window (engine.priority_window)
+    priority: int = 0
+    # optional per-attempt progress hook (the netfront streaming route):
+    # called on the worker thread after every minimal-k attempt
+    on_attempt: object = None
     # request-scoped tracing (obs.trace): the root span covering the
     # request's whole life and the queue-wait child, begun at submit
     root_span: object = None
@@ -76,22 +108,48 @@ class ServeResult:
 
 
 class ServeTicket:
-    """Handle returned by ``submit``; ``result()`` blocks for completion."""
+    """Handle returned by ``submit``; ``result()`` blocks for completion.
+    ``add_done_callback`` registers asynchronous completion observers
+    (the netfront uses it to release admission slots and notify pollers
+    without parking a thread per ticket)."""
 
     def __init__(self, request: ServeRequest):
         self.request = request
         self._done = threading.Event()
-        self._result: ServeResult | None = None
+        self._result: ServeResult | None = None   # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._callbacks: list = []                # guarded-by: _lock
 
     def _complete(self, result: ServeResult) -> None:
-        self._result = result
+        with self._lock:
+            self._result = result
+            callbacks, self._callbacks = self._callbacks, []
         self._done.set()
+        for fn in callbacks:
+            try:
+                fn(result)
+            except Exception:   # observer bug must not kill the worker
+                pass
+
+    def add_done_callback(self, fn) -> None:
+        """Call ``fn(result)`` on completion (immediately if already
+        done); exceptions from ``fn`` are swallowed."""
+        with self._lock:
+            if self._result is None:
+                self._callbacks.append(fn)
+                return
+            result = self._result
+        fn(result)
+
+    def done(self) -> bool:
+        return self._done.is_set()
 
     def result(self, timeout: float | None = None) -> ServeResult:
         if not self._done.wait(timeout):
             raise TimeoutError(
                 f"request {self.request.request_id} still in flight")
-        return self._result
+        with self._lock:
+            return self._result
 
 
 # the serve fallback ladder: flagship single-device engine first, CPU
@@ -187,11 +245,17 @@ class ServeFrontEnd:
         # the Condition wraps an RLock, so guarded sections nest freely
         self._lock = threading.Condition()
         self._queue: deque = deque()   # guarded-by: _lock
+        # shutdown serializer: a drain racing another shutdown() joins
+        # the first call's teardown instead of double-joining workers
+        self._shutdown_lock = threading.Lock()
         self._threads: list = []       # guarded-by: owner
         self._in_flight = 0            # guarded-by: _lock
         self._next_id = 0              # guarded-by: _lock
         self._started = False          # guarded-by: _lock
         self._draining = False         # guarded-by: _lock
+        # recent mean service seconds (EWMA) — the retry-after
+        # suggestion QueueFull carries on the network path
+        self._ewma_service = 0.0       # guarded-by: _lock
         # mutated by every worker thread, read live by health/summary
         self.stats = {"submitted": 0, "completed": 0, "failed": 0,
                       "rejected": 0, "fallbacks": 0}   # guarded-by: _lock
@@ -280,7 +344,12 @@ class ServeFrontEnd:
     def shutdown(self, drain: bool = True, timeout: float = 60.0) -> None:
         """Stop accepting; with ``drain`` finish everything admitted
         first (the queue-semantics contract: no admitted request is
-        dropped), then stop workers and the batch dispatcher."""
+        dropped), then stop workers and the batch dispatcher.
+
+        Safe to call concurrently (the netfront's ``/admin/drain``
+        racing an owner's ``shutdown()``): the first caller tears down,
+        later callers block on the serializer until teardown is done
+        and then return — never a double-join or a deadlock."""
         with self._lock:
             self._draining = True
             if not drain:
@@ -293,11 +362,14 @@ class ServeFrontEnd:
                     self.stats["failed"] += 1
                 self._queue.clear()
             self._lock.notify_all()
-        deadline = time.perf_counter() + timeout
-        for t in self._threads:
-            t.join(timeout=max(0.0, deadline - time.perf_counter()))
-        self._threads.clear()
-        self.scheduler.stop()
+        with self._shutdown_lock:
+            if not self._threads:
+                return   # another caller already tore down
+            deadline = time.perf_counter() + timeout
+            for t in self._threads:
+                t.join(timeout=max(0.0, deadline - time.perf_counter()))
+            self._threads.clear()
+            self.scheduler.stop()
         with self._lock:
             st = dict(self.stats)
         self._event("serve_done", requests=st["submitted"],
@@ -306,10 +378,24 @@ class ServeFrontEnd:
                     rejected=st["rejected"])
 
     # -- submission -----------------------------------------------------
+    def _retry_after(self, queue_len: int, ewma_service: float) -> float:
+        """Suggested resubmit delay when the queue sheds: queue length ×
+        recent mean service seconds / workers, clamped to [0.05, 30] —
+        roughly when a queue slot next frees up. The guarded inputs are
+        read by the caller under ``_lock`` and passed in."""
+        est = queue_len * (ewma_service or 0.5) / max(1, self.workers)
+        return min(30.0, max(0.05, est))
+
     def submit(self, arrays: GraphArrays, request_id: int | None = None,
-               timeout: float = 0.0) -> ServeTicket:
-        """Admit one request; raises :class:`QueueFull` when the bounded
-        queue stays full past ``timeout`` (0 = reject immediately)."""
+               timeout: float = 0.0, priority: int = 0,
+               on_attempt=None) -> ServeTicket:
+        """Admit one request; raises :class:`QueueFull` (with structured
+        backpressure context) when the bounded queue stays full past
+        ``timeout`` (0 = reject immediately). ``priority`` > 0 (the
+        netfront's paid tiers) queues ahead of lower-priority waiters
+        and rides into the batch scheduler's affinity path;
+        ``on_attempt(res, val)`` observes every minimal-k attempt from
+        the worker thread (the streaming route's progress feed)."""
         with self._lock:
             if not self._started:
                 raise ServeError("front-end not started")
@@ -331,14 +417,20 @@ class ServeFrontEnd:
                         "dgc_serve_rejected_total",
                         "requests shed by queue backpressure").inc()
                 raise QueueFull(
-                    f"queue at capacity ({self.queue_depth})")
+                    f"queue at capacity ({self.queue_depth})",
+                    queue_depth=len(self._queue),
+                    capacity=self.queue_depth,
+                    retry_after_s=self._retry_after(
+                        len(self._queue), self._ewma_service))
             if request_id is None:
                 request_id = self._next_id
             if isinstance(request_id, int):
                 # non-int ids (e.g. string ids from a JSONL replay) skip
                 # the auto-id bookkeeping; they are carried through as-is
                 self._next_id = max(self._next_id, request_id) + 1
-            req = ServeRequest(request_id=request_id, arrays=arrays)
+            req = ServeRequest(request_id=request_id, arrays=arrays,
+                               priority=max(0, int(priority)),
+                               on_attempt=on_attempt)
             # trace root + queue-wait child: begun under the admission
             # lock (the worker popping this request must find the spans
             # in place), trace id = the request id
@@ -348,7 +440,18 @@ class ServeFrontEnd:
             req.queue_span = self.tracer.begin("queue",
                                                parent=req.root_span)
             ticket = ServeTicket(req)
-            self._queue.append((req, ticket))
+            if req.priority > 0:
+                # priority tiers jump the line: insert ahead of the
+                # first strictly-lower-priority waiter (FIFO within a
+                # tier — the queue is bounded, so the scan is cheap)
+                idx = len(self._queue)
+                for i, (other, _t) in enumerate(self._queue):
+                    if other.priority < req.priority:
+                        idx = i
+                        break
+                self._queue.insert(idx, (req, ticket))
+            else:
+                self._queue.append((req, ticket))
             self.stats["submitted"] += 1
             self._lock.notify_all()
         return ticket
@@ -454,6 +557,10 @@ class ServeFrontEnd:
                     self.stats["completed"] += 1
                 else:
                     self.stats["failed"] += 1
+                # EWMA of service time — QueueFull's retry-after basis
+                self._ewma_service = (
+                    result.service_s if self._ewma_service == 0.0
+                    else 0.8 * self._ewma_service + 0.2 * result.service_s)
             self._event(
                 "serve_request", request_id=req.request_id,
                 status=result.status,
@@ -496,6 +603,11 @@ class ServeFrontEnd:
         def on_attempt(res, val):
             attempts.append((int(res.k), res.status.name,
                              int(res.supersteps)))
+            if req.on_attempt is not None:
+                try:
+                    req.on_attempt(res, val)
+                except Exception:   # progress observer ≠ request failure
+                    pass
 
         validate = make_validator(arrays) if self.validate else None
         post_reduce = make_reducer(arrays) if self.post_reduce else None
@@ -503,7 +615,8 @@ class ServeFrontEnd:
         if batched:
             try:
                 engine = BatchMemberEngine(pad_member(arrays, cls),
-                                           self.scheduler)
+                                           self.scheduler,
+                                           priority=req.priority)
                 result = find_minimal_coloring(
                     engine, initial_k=engine.member.k0,
                     validate=validate, on_attempt=on_attempt,

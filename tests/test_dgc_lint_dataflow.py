@@ -464,6 +464,78 @@ class Reader:
     assert len(got) == 1
 
 
+# netfront fixture (PR 12): the tenant token-bucket/quota table is
+# mutated from listener threads and read by exporters — the exact shape
+# LK004 must police over dgc_tpu/serve/netfront/
+NETFRONT_FIXTURE = '''
+import threading
+
+class TokenBucket:
+    def __init__(self):
+        self._lock = threading.Lock()
+        self.tokens = 5.0        # guarded-by: _lock
+        self.in_flight = 0       # guarded-by: _lock
+
+class Listener:
+    def __init__(self, bucket: TokenBucket):
+        self.bucket = bucket
+
+    def admit(self):
+        %s
+
+    def snapshot(self):
+        with self.bucket._lock:
+            return (self.bucket.tokens, self.bucket.in_flight)
+'''
+
+
+def test_pointsto_netfront_fixture_unlocked_bucket_fires():
+    src = NETFRONT_FIXTURE % \
+        "return self.bucket.tokens          # LK004"
+    got = [f for f in check_locks([SourceModule("fix/nf.py", src)])
+           if f.rule == "LK004"]
+    assert len(got) == 1
+    assert "bucket.tokens" in got[0].detail
+
+
+def test_pointsto_netfront_fixture_locked_bucket_discharges():
+    src = NETFRONT_FIXTURE % ("with self.bucket._lock:\n"
+                              "            return self.bucket.tokens")
+    assert [f for f in check_locks([SourceModule("fix/nf.py", src)])
+            if f.rule == "LK004"] == []
+
+
+def test_pointsto_netfront_real_tier_is_clean():
+    """The shipped netfront (admission table under the controller's
+    lock, ticket feed under each ticket's condition) discharges LK004 —
+    the PR 12 satellite: the points-to pass runs over netfront/."""
+    mods = [SourceModule.load(ROOT, rel) for rel in
+            ("dgc_tpu/serve/netfront/admission.py",
+             "dgc_tpu/serve/netfront/listener.py",
+             "dgc_tpu/serve/queue.py")]
+    assert [f for f in check_locks(mods) if f.rule == "LK004"] == []
+
+
+def test_pointsto_netfront_seeded_unlocked_ticket_write_fires():
+    """Strip the completion callback's lock: writing the ticket's
+    result slot outside its condition races the stream/poll readers —
+    the mutation LK004 must catch (the `net_ticket: _NetTicket`
+    annotation seeds the points-to set)."""
+    rel = "dgc_tpu/serve/netfront/listener.py"
+    real = (ROOT / rel).read_text()
+    broken = real.replace("""        with net_ticket.cond:
+            net_ticket.result = result
+            net_ticket.cond.notify_all()""",
+                          """        net_ticket.result = result""")
+    assert broken != real, "fixture out of sync with listener.py"
+    mods = [SourceModule(rel, broken),
+            SourceModule.load(ROOT, "dgc_tpu/serve/netfront/admission.py"),
+            SourceModule.load(ROOT, "dgc_tpu/serve/queue.py")]
+    got = [f for f in check_locks(mods) if f.rule == "LK004"]
+    assert any("net_ticket.result" in f.detail and "cond" in f.detail
+               for f in got)
+
+
 def test_pointsto_real_metrics_exporters_discharge():
     """The real registry exporters (`with m._lock:` over the snapshot
     loop) and the fixed latency summary must be clean — the ROADMAP

@@ -8,9 +8,15 @@
 #      a tiny profile window -> tools/xplane_split.py -> a parsing
 #      timing_crosscheck verdict, and a perf-ledger round trip with a
 #      forced regression exiting nonzero.
-# Steps 1-3 are AST-only (seconds); step 4 compiles one toy kernel on
-# CPU (~1 min cold) — the only gate that proves the profiler plumbing
-# end-to-end before device time is spent.
+#   5. netfront CPU soak smoke (PR 12, same DGC_TPU_CI_NO_SMOKE=1 skip):
+#      tools/soak.py with a small client count over the real listener —
+#      zero lost/dup results, quota 429s with retry context, graceful
+#      drain — gated by tools/slo_check.py over the run manifest and
+#      accreting a row into PERF_DB.jsonl via tools/perf_db.py.
+# Steps 1-3 are AST-only (seconds); steps 4-5 compile toy kernels on
+# CPU (~1-2 min cold) — the only gates that prove the profiler and
+# serving-over-the-network plumbing end-to-end before device time is
+# spent.
 set -u
 cd "$(dirname "$0")/.."
 rc=0
@@ -80,6 +86,32 @@ print("ci_checks: crosscheck verdict %s (coverage %s)"
     echo "ci_checks: perf_db round-trip smoke OK" >&2
   else
     echo "ci_checks: perf_db round-trip smoke FAILED" >&2
+    rc=1
+  fi
+  # netfront soak smoke (PR 12): a small-count run of the many-client
+  # harness — the soak's own invariants (zero lost/dup, quota 429s,
+  # graceful drain) exit nonzero, then the SLO gate runs over the
+  # manifest and the record accretes into the perf ledger. Thresholds
+  # are structural (failure rate + a generous p95): the gate proves the
+  # pipeline, PERF.md holds the measured numbers.
+  cat > "$SMOKE_DIR/slo_soak.json" <<'EOF'
+{"service_ms": {"p95": 60000}, "failure_rate_max": 0.0}
+EOF
+  if JAX_PLATFORMS=cpu timeout 300 python tools/soak.py \
+      --clients 32 --requests-per-client 2 --greedy-clients 4 \
+      --nodes 120 --degree 6 \
+      --log-json "$SMOKE_DIR/soak.jsonl" \
+      --run-manifest "$SMOKE_DIR/soak_man.json" \
+      > "$SMOKE_DIR/soak_record.json" \
+    && timeout 60 python tools/validate_runlog.py -q "$SMOKE_DIR/soak.jsonl" \
+    && timeout 60 python tools/slo_check.py "$SMOKE_DIR/soak_man.json" \
+      --thresholds "$SMOKE_DIR/slo_soak.json" \
+    && timeout 60 python tools/perf_db.py add --db PERF_DB.jsonl \
+      --threshold 0.5 --record "$SMOKE_DIR/soak_record.json" >/dev/null
+  then
+    echo "ci_checks: netfront soak smoke OK ($(cat "$SMOKE_DIR/soak_record.json" | python -c 'import json,sys; r=json.load(sys.stdin); print(r["requests"], "req,", r["value"], r["unit"])'))" >&2
+  else
+    echo "ci_checks: netfront soak smoke FAILED" >&2
     rc=1
   fi
   rm -rf "$SMOKE_DIR"

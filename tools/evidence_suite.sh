@@ -137,6 +137,20 @@ if [ "$battery_rc" -ne 2 ]; then
     --tuned-config tools/tuned_configs/rmat_1m.json --perf-db PERF_DB.jsonl 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
+  # netfront soak on-chip (PR 12): the 1000-client many-connection
+  # harness over the real TPU serving tier — the CPU rows (PERF.md
+  # "Network front door") prove the protocol under load; the TPU
+  # question is end-to-end graphs/s and in-quota p95 when the lanes
+  # are parallel hardware. Zero lost/dup + quota + drain invariants
+  # exit nonzero inside the harness; the record accretes into the
+  # perf ledger beside the serve A/Bs.
+  echo "=== netfront 1000-client soak (TPU serving tier) ===" | tee -a /dev/stderr >/dev/null
+  timeout 3600 python tools/soak.py --clients 1000 --requests-per-client 1 \
+    --greedy-clients 100 --nodes 20000 --degree 16 \
+    --log-json netfront_soak_tpu.jsonl \
+    --run-manifest netfront_soak_tpu_man.json --perf-db PERF_DB.jsonl 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+
   echo "=== cold compile, unified pipeline 1M-RMAT ===" | tee -a /dev/stderr >/dev/null
   # fresh cache dir = genuinely cold compile (removed after); outer
   # timeout sits ABOVE bench.py's 5400s in-process deadline so the

@@ -224,6 +224,30 @@ def render(doc: dict, width: int = 48) -> str:
                 f"degraded={hl.get('degraded')} "
                 f"backend={hl.get('backend')} rung={hl.get('rung')}")
 
+    nf = doc.get("netfront")
+    if nf:
+        # network front door (serve.netfront): per-tenant admission
+        # breakdown + the graceful-drain record
+        add("")
+        tenants = nf.get("tenants") or {}
+        total_adm = sum(t.get("admitted", 0) for t in tenants.values())
+        total_rej = sum(sum((t.get("rejected") or {}).values())
+                        for t in tenants.values())
+        add(f"netfront: {total_adm} admitted, {total_rej} rejected "
+            f"across {len(tenants)} tenant(s)")
+        for name in sorted(tenants):
+            t = tenants[name]
+            rej = t.get("rejected") or {}
+            rej_s = ", ".join(f"{r} {n}" for r, n in sorted(rej.items()))
+            add(f"  tenant {name}: {t.get('admitted', 0)} admitted"
+                + (f", rejected: {rej_s}" if rej else ""))
+        dr = nf.get("drain")
+        if dr:
+            add(f"  drain: {dr.get('in_flight')} in flight + "
+                f"{dr.get('queued')} queued at drain, "
+                f"{dr.get('completed')} completed / "
+                f"{dr.get('failed')} failed in {dr.get('wall_s')}s")
+
     ph = doc.get("phases") or {}
     totals = ph.get("totals") or {}
     if totals:

@@ -1,0 +1,365 @@
+#!/usr/bin/env python
+"""Many-client soak harness for the network front door
+(``dgc_tpu.serve.netfront``).
+
+Stands a full serving stack in-process — ``ServeFrontEnd`` + admission
+control + the one-port listener — and drives it with N concurrent HTTP
+clients (one persistent connection per client thread; ``--clients
+1000`` means 1000 live sockets), proving the acceptance contract the
+ROADMAP's "millions of users" claim rests on:
+
+- **zero lost or duplicated results** — every accepted ticket returns
+  exactly one terminal result, every ticket id is unique, and the
+  server-side completed count matches the client-side account;
+- **per-tenant quotas enforced** — ``--greedy-clients`` run under a
+  rate-limited tenant and MUST see 429s carrying structured retry
+  context (``retry_after_s``, token state) while the in-quota tenant's
+  requests all land;
+- **graceful drain under load** — with ``--drain`` (default) the
+  harness POSTs ``/admin/drain`` once every submission is accepted but
+  while requests are still in flight; all of them must still complete
+  and remain pollable after the drain.
+
+Artifacts: the server-side run log (``--log-json``, schema-validated by
+``tools/validate_runlog.py``) and manifest (``--run-manifest``) feed
+``tools/slo_check.py`` — the SLO gate over the soak — and the one JSON
+record printed to stdout feeds ``tools/perf_db.py`` (or pass
+``--perf-db`` to append + regression-check directly), so "multi-tenant
+serving under load" is a ledgered number. ``tools/ci_checks.sh`` runs a
+small-count smoke of exactly this pipeline; the 1000-client CPU row
+lives in PERF.md ("Network front door").
+
+Usage:
+  JAX_PLATFORMS=cpu python tools/soak.py --clients 1000 --nodes 120 \\
+      --degree 6 --log-json soak.jsonl --run-manifest soak_man.json \\
+      --perf-db PERF_DB.jsonl
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import sys
+import threading
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(os.path.abspath(__file__))))
+
+# client-side retry bound: a 429'd submit retries with the server's
+# retry_after_s hint (exponentially backed off — the hint prices ONE
+# token, not the whole herd contending for it) this many times before
+# counting as shed for good
+MAX_SUBMIT_RETRIES = 100
+
+
+class _Client:
+    """One soak client: a persistent connection submitting then polling
+    its own requests. All fields are thread-confined to the client's
+    thread; the harness reads them only after join()."""
+
+    def __init__(self, idx: int, port: int, tenant: str, args):
+        self.idx = idx
+        self.port = port
+        self.tenant = tenant
+        self.args = args
+        self.tickets: list = []        # accepted ticket ids, in order
+        self.results: dict = {}        # ticket -> result doc
+        self.rejects: list = []        # structured 429 bodies
+        self.shed = 0                  # submits given up after retries
+        self.client_ms: list = []      # accept -> terminal result, ms
+        self.errors: list = []
+
+    def _request(self, method, path, doc=None):
+        """One request on the client's persistent connection, retrying
+        transient socket failures (the connect herd of a 1000-client
+        ramp can outrun even a deep accept backlog) on a fresh
+        connection with jittered backoff."""
+        body = json.dumps(doc).encode() if doc is not None else None
+        headers = {"X-Dgc-Tenant": self.tenant}
+        if body is not None:
+            headers["Content-Type"] = "application/json"
+        last = None
+        for attempt in range(8):
+            try:
+                if self._conn is None:
+                    self._conn = http.client.HTTPConnection(
+                        "127.0.0.1", self.port, timeout=120)
+                self._conn.request(method, path, body=body,
+                                   headers=headers)
+                resp = self._conn.getresponse()
+                payload = resp.read()
+                return resp.status, (json.loads(payload) if payload
+                                     else {})
+            except (OSError, http.client.HTTPException) as e:
+                last = e
+                if self._conn is not None:
+                    try:
+                        self._conn.close()
+                    except OSError:
+                        pass
+                self._conn = None
+                time.sleep(0.05 * (attempt + 1) + (self.idx % 17) * 1e-3)
+        raise last
+
+    def run(self, submit_barrier: threading.Barrier) -> None:
+        self._conn = None
+        try:
+            # phase 1: submit everything (retrying on backpressure)
+            for r in range(self.args.requests_per_client):
+                doc = {"node_count": self.args.nodes,
+                       "max_degree": self.args.degree,
+                       "seed": self.idx * 10_000 + r,
+                       "gen_method": "fast"}
+                accepted = False
+                for _attempt in range(MAX_SUBMIT_RETRIES):
+                    status, body = self._request("POST", "/v1/color", doc)
+                    if status == 202:
+                        self.tickets.append(
+                            (body["ticket"], time.perf_counter()))
+                        accepted = True
+                        break
+                    if status == 429:
+                        self.rejects.append(body)
+                        hint = float(body.get("retry_after_s") or 0.1)
+                        time.sleep(min(2.0, max(hint, 0.05)
+                                       * (1 << min(_attempt, 5))))
+                        continue
+                    self.errors.append(f"submit HTTP {status}: {body}")
+                    break
+                if not accepted and not self.errors:
+                    self.shed += 1
+        except Exception as e:   # noqa: BLE001 — harness accounting
+            self.errors.append(f"{type(e).__name__}: {e}")
+        finally:
+            # rendezvous UNCONDITIONALLY: the harness drains only after
+            # every client finished submitting, and a failed client
+            # must not wedge the barrier
+            try:
+                submit_barrier.wait(timeout=600)
+            except threading.BrokenBarrierError:
+                self.errors.append("submit barrier broken")
+        try:
+            # phase 2: poll every accepted ticket to a terminal result
+            for ticket, t_accept in self.tickets:
+                while True:
+                    status, body = self._request(
+                        "GET", f"/v1/result/{ticket}")
+                    if status == 200:
+                        if ticket in self.results:
+                            self.errors.append(f"duplicate {ticket}")
+                        self.results[ticket] = body
+                        self.client_ms.append(
+                            (time.perf_counter() - t_accept) * 1e3)
+                        break
+                    if status == 202:
+                        time.sleep(0.05)
+                        continue
+                    self.errors.append(f"poll {ticket} HTTP {status}")
+                    break
+            if self._conn is not None:
+                self._conn.close()
+        except Exception as e:   # noqa: BLE001 — harness accounting
+            self.errors.append(f"{type(e).__name__}: {e}")
+
+
+def _platform() -> str | None:
+    try:
+        import jax
+
+        return jax.default_backend()
+    except Exception:   # noqa: BLE001 — record stays writable without jax
+        return None
+
+
+def _pctile(xs: list, q: float) -> float | None:
+    if not xs:
+        return None
+    ys = sorted(xs)
+    return ys[min(len(ys) - 1, int(q * len(ys)))]
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--clients", type=int, default=1000,
+                   help="concurrent client connections (default 1000)")
+    p.add_argument("--requests-per-client", type=int, default=1)
+    p.add_argument("--greedy-clients", type=int, default=None,
+                   help="clients assigned to the rate-limited 'greedy' "
+                        "tenant to prove quota enforcement (default: "
+                        "clients // 10)")
+    p.add_argument("--nodes", type=int, default=120,
+                   help="vertices per generated request graph")
+    p.add_argument("--degree", type=int, default=6)
+    p.add_argument("--batch-max", type=int, default=8)
+    p.add_argument("--queue-depth", type=int, default=256)
+    p.add_argument("--workers", type=int, default=None)
+    p.add_argument("--window-ms", type=float, default=2.0)
+    p.add_argument("--tenants", type=str, default=None,
+                   help="tenant config JSON (inline or path); default: "
+                        "a permissive 'load' tenant + a rate-limited "
+                        "'greedy' tenant (rate 5/s, burst 5)")
+    p.add_argument("--no-drain", action="store_true",
+                   help="skip the mid-soak graceful drain")
+    p.add_argument("--log-json", type=str, default=None)
+    p.add_argument("--run-manifest", type=str, default=None)
+    p.add_argument("--perf-db", type=str, default=None,
+                   help="append the soak record to this perf ledger "
+                        "(tools/perf_db.py) and exit 1 on regression")
+    args = p.parse_args(argv)
+
+    from dgc_tpu.obs import MetricsRegistry, RunLogger, RunManifest
+    from dgc_tpu.serve.netfront import (AdmissionController, NetFront,
+                                        load_tenant_configs)
+    from dgc_tpu.serve.queue import ServeFrontEnd
+    from dgc_tpu.serve.shape_classes import DEFAULT_LADDER
+
+    if args.tenants:
+        raw = args.tenants
+        if not raw.lstrip().startswith("{"):
+            raw = open(raw).read()
+        tenant_doc = json.loads(raw)
+    else:
+        tenant_doc = {"default": {},
+                      "tenants": {"load": {"tier": "paid"},
+                                  "greedy": {"rate": 5.0, "burst": 5.0}}}
+    greedy = (args.greedy_clients if args.greedy_clients is not None
+              else args.clients // 10)
+
+    logger = RunLogger(jsonl_path=args.log_json, echo=False)
+    registry = MetricsRegistry()
+    manifest = RunManifest()
+    logger.add_sink(manifest)
+    front = ServeFrontEnd(batch_max=args.batch_max,
+                          window_s=args.window_ms / 1e3,
+                          queue_depth=args.queue_depth,
+                          workers=args.workers,
+                          logger=logger, registry=registry).start()
+    admission = AdmissionController(load_tenant_configs(tenant_doc),
+                                    registry=registry, logger=logger)
+    nf = NetFront(front, admission=admission, registry=registry,
+                  logger=logger).start()
+
+    # compile off the soak clock: warm the one shape class the soak's
+    # generator spec lands in (the --warm-classes convention)
+    warm_s = None
+    from dgc_tpu.models.graph import Graph
+
+    probe = Graph.generate(args.nodes, args.degree, seed=0, method="fast")
+    cls = DEFAULT_LADDER.class_for(probe.num_vertices,
+                                   probe.arrays.max_degree)
+    if cls is not None:
+        warm_s = front.warm([cls.name])["seconds"]
+
+    clients = [_Client(i, nf.port,
+                       "greedy" if i < greedy else "load", args)
+               for i in range(args.clients)]
+    # parties: every client + the harness thread (drain rendezvous)
+    barrier = threading.Barrier(args.clients + 1)
+    threads = [threading.Thread(target=c.run, args=(barrier,),
+                                name=f"soak-client-{c.idx}", daemon=True)
+               for c in clients]
+    t0 = time.perf_counter()
+    for t in threads:
+        t.start()
+    try:
+        barrier.wait(timeout=600)
+    except threading.BrokenBarrierError:
+        print("SOAK: submit barrier broken (client failures); "
+              "draining anyway", file=sys.stderr)
+    # every submission is in (accepted or accounted); drain while the
+    # tail is still in flight — the graceful-drain-under-load proof
+    drain_doc = None
+    if not args.no_drain:
+        drain_doc = nf.drain(timeout=300)
+    for t in threads:
+        t.join(timeout=600)
+    wall = time.perf_counter() - t0
+
+    # -- the zero-lost / zero-dup account --------------------------------
+    problems: list = []
+    all_tickets = [tk for c in clients for tk, _ in c.tickets]
+    accepted = len(all_tickets)
+    if len(set(all_tickets)) != accepted:
+        problems.append("duplicate ticket ids issued")
+    done = sum(len(c.results) for c in clients)
+    ok = sum(1 for c in clients for r in c.results.values()
+             if r.get("status") == "ok")
+    if done != accepted:
+        problems.append(f"lost results: {accepted} accepted, {done} "
+                        f"polled to completion")
+    if ok != accepted:
+        problems.append(f"non-ok results: {ok}/{accepted} ok")
+    for c in clients:
+        problems.extend(c.errors)
+    st = front.stats_snapshot()
+    if st["completed"] != accepted:
+        problems.append(f"server completed {st['completed']} != "
+                        f"{accepted} accepted")
+    rejects = [r for c in clients for r in c.rejects]
+    rate_limited = [r for r in rejects
+                    if r.get("reason") == "rate_limited"]
+    if greedy > 0 and args.requests_per_client * greedy > 5:
+        if not rate_limited:
+            problems.append("greedy tenant never rate-limited "
+                            "(quota not enforced?)")
+        elif not all(r.get("retry_after_s") is not None
+                     for r in rate_limited):
+            problems.append("429 without retry_after_s context")
+    shed = sum(c.shed for c in clients)
+    if shed:
+        problems.append(f"{shed} submits shed after "
+                        f"{MAX_SUBMIT_RETRIES} retries")
+    if drain_doc is not None and not drain_doc.get("drained"):
+        problems.append(f"drain failed: {drain_doc}")
+
+    client_ms = [ms for c in clients for ms in c.client_ms]
+    record = {
+        "metric": f"soak_netfront_c{args.clients}"
+                  f"_r{args.requests_per_client}"
+                  f"_n{args.nodes}d{args.degree}",
+        "value": round(accepted / wall, 3) if wall > 0 else None,
+        "unit": "graphs/s",
+        "backend": "netfront",
+        "platform": _platform(),
+        "serve_mode": front.scheduler.mode,
+        "clients": args.clients,
+        "requests": accepted,
+        "rejected_429": len(rejects),
+        "rate_limited": len(rate_limited),
+        "p95_client_ms": (round(_pctile(client_ms, 0.95), 3)
+                          if client_ms else None),
+        "wall_s": round(wall, 3),
+        "warmup_s": warm_s,
+        "drain_wall_s": drain_doc.get("wall_s") if drain_doc else None,
+        "soak_ok": not problems,
+    }
+
+    front.health(emit=True)
+    if args.no_drain:
+        front.shutdown(drain=True)
+    nf.close()
+    if args.run_manifest:
+        manifest.finalize(registry=registry)
+        manifest.write(args.run_manifest)
+        logger.event("manifest_written", path=args.run_manifest)
+    logger.close()
+
+    rc = 0
+    for prob in problems:
+        print(f"SOAK FAIL: {prob}", file=sys.stderr)
+        rc = 1
+    if args.perf_db and not problems and record["value"] is not None:
+        from tools.perf_db import record_and_check, render_verdict
+
+        verdict = record_and_check(args.perf_db, record)
+        print(render_verdict(verdict), file=sys.stderr)
+        if verdict.get("regression"):
+            rc = 1
+    print(json.dumps(record))
+    return rc
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

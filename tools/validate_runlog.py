@@ -98,6 +98,35 @@ def _semantic_problems(record: dict) -> list[str]:
                 and record.get("baseline_median") is None:
             problems.append("perf_regression: regression=true without a "
                             "baseline_median")
+    # network front door (serve.netfront, PR 12): reject reasons come
+    # from the admission layer's closed vocabulary, retry hints and
+    # drain counts are non-negative, and tenants are never empty —
+    # keeping the 429/drain contract machine-checkable end to end
+    elif kind in ("net_admit", "net_reject"):
+        if record.get("tenant") == "":
+            problems.append(f"{kind}: empty tenant")
+        if kind == "net_reject":
+            from dgc_tpu.serve.netfront.admission import REJECT_REASONS
+
+            if record.get("reason") not in REJECT_REASONS:
+                problems.append(
+                    f"net_reject: reason {record.get('reason')!r} not in "
+                    f"{REJECT_REASONS}")
+            retry = record.get("retry_after_s")
+            if isinstance(retry, (int, float)) and not isinstance(
+                    retry, bool) and retry < 0:
+                problems.append(
+                    f"net_reject: retry_after_s {retry} < 0")
+        if kind == "net_admit" \
+                and isinstance(record.get("priority"), int) \
+                and record["priority"] < 0:
+            problems.append(
+                f"net_admit: priority {record['priority']} < 0")
+    elif kind == "net_drain":
+        for fieldname in ("in_flight", "queued", "completed", "failed"):
+            v = record.get(fieldname)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+                problems.append(f"net_drain: {fieldname} {v} < 0")
     return problems
 
 
