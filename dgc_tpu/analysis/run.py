@@ -56,6 +56,10 @@ LOCK_FILES = ("dgc_tpu/obs/metrics.py", "dgc_tpu/obs/httpd.py",
               # and worker callbacks read — LK* incl. points-to (LK004)
               "dgc_tpu/serve/netfront/admission.py",
               "dgc_tpu/serve/netfront/listener.py",
+              # durable ticket journal (crash-safe serve PR): handler
+              # threads and worker callbacks append under the journal
+              # cond while the flusher thread group-commits fsyncs
+              "dgc_tpu/serve/netfront/journal.py",
               "tools/soak.py", "bench.py")
 TRANSFER_FILES = ("dgc_tpu/serve/batched.py", "dgc_tpu/serve/engine.py")
 

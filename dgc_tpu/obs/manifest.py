@@ -99,10 +99,23 @@ class RunManifest:
         # admit/reject AGGREGATES (a soak emits thousands of decisions —
         # the manifest keeps counts, the JSONL keeps every event) plus
         # the drain record; the slot appears only when net_* events do
-        elif kind in ("net_admit", "net_reject", "net_drain"):
+        elif kind in ("net_admit", "net_reject", "net_drain",
+                      "net_recover"):
             nf = self.doc.setdefault("netfront",
                                      {"tenants": {}, "drain": None})
-            if kind == "net_drain":
+            if kind == "net_recover":
+                # journal recovery: per-ticket actions aggregate to
+                # counts, the summary record lands whole (the crash-safe
+                # serve tier's restart provenance)
+                if fields.get("action") == "summary":
+                    nf["recover"] = fields
+                else:
+                    counts = nf.setdefault(
+                        "recover_actions",
+                        {"restored": 0, "replayed": 0, "replay_failed": 0})
+                    act = fields.get("action", "?")
+                    counts[act] = counts.get(act, 0) + 1
+            elif kind == "net_drain":
                 nf["drain"] = fields
             else:
                 t = nf["tenants"].setdefault(
@@ -114,7 +127,8 @@ class RunManifest:
                     reason = fields.get("reason", "?")
                     t["rejected"][reason] = t["rejected"].get(reason, 0) + 1
         elif (kind.startswith("serve_")
-              or kind in ("lane_recycled", "slice_recalibrated")):
+              or kind in ("lane_recycled", "slice_recalibrated",
+                          "lane_rebuild")):
             # serving path (dgc_tpu.serve) — the slot appears only when
             # serve events do, so non-serve manifests stay byte-identical
             serve = self.doc.setdefault(
@@ -134,6 +148,10 @@ class RunManifest:
             elif kind == "slice_recalibrated":
                 # measured slice-size re-pricing (timing mode)
                 serve.setdefault("recalibrations", []).append(fields)
+            elif kind == "lane_rebuild":
+                # fault-plane recoveries (dispatch abort / watchdog
+                # hang): the serve tier's resilience provenance
+                serve.setdefault("rebuilds", []).append(fields)
             elif kind == "serve_warmup":
                 serve["warmup"] = fields
             elif kind == "serve_request":

@@ -134,6 +134,14 @@ EVENT_SCHEMAS: dict = {
         {"shape_class": "str", "lane": "int"},
         {"k": "int", "depth_bucket": "int", "slices": "int",
          "queue_ms": NUM, "service_ms": NUM, "device_us": "int"}),
+    # serve-tier fault recovery (crash-safe serve PR): a dispatch abort
+    # or watchdog hang tore one class's lane pool down — survivors
+    # reseated, poison requests quarantined (structured failure with rc
+    # context). reason ∈ {"abort", "hang"} (validate_runlog enforces)
+    "lane_rebuild": (
+        {"shape_class": "str", "reason": "str"},
+        {"reseated": "int", "quarantined": "int", "aborts_max": "int",
+         "error": ("str", "null")}),
     # slice-size recalibration from the measured overhead/compute split
     # (timing mode, slice_steps auto): once per shape class
     "slice_recalibrated": (
@@ -159,6 +167,17 @@ EVENT_SCHEMAS: dict = {
     "net_drain": (
         {"in_flight": "int", "queued": "int"},
         {"completed": "int", "failed": "int", "timeout_s": NUM,
+         "wall_s": NUM}),
+    # journal recovery (serve.netfront.journal): one event per ticket
+    # the listener restores/replays from the durable ticket journal on
+    # startup plus a closing summary. Action vocabulary ("restored",
+    # "replayed", "replay_failed", "summary") and count non-negativity
+    # are enforced by tools/validate_runlog.py
+    "net_recover": (
+        {"action": "str"},
+        {"ticket": ("str", "null"), "tenant": ("str", "null"),
+         "error": ("str", "null"), "records": "int", "restored": "int",
+         "replayed": "int", "failed": "int", "high_water": "int",
          "wall_s": NUM}),
     "serve_warmup": (
         {"classes": "int", "kernels": "int", "seconds": NUM},

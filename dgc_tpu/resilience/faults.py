@@ -12,6 +12,21 @@ injection points threaded through the real execution path:
 - ``transfer``          — device→host result transfer (after the engine call)
 - ``checkpoint_write``  — after ``CheckpointManager.save`` lands its files
 
+and, since the serve tier grew its own fault plane (the crash-safe serve
+PR — quarantine/watchdog semantics live in ``serve.engine``, journal
+recovery in ``serve.netfront``):
+
+- ``serve_dispatch``    — every batched slice/pair kernel dispatch
+  (``serve.engine.BatchScheduler``; hangs here are what the dispatch
+  watchdog tears down and rebuilds)
+- ``lane_seat``         — seating one queued call into a lane
+- ``deliver``           — handing a finished result back to its ticket
+  (``serve.queue.ServeFrontEnd._worker``)
+- ``journal_write``     — every ticket-journal append
+  (``serve.netfront.journal.TicketJournal``)
+- ``net_accept``        — the listener's submit path
+  (``serve.netfront.listener.NetFront``)
+
 and fault *kinds* that mimic the production failure classes:
 
 - ``transient``  — an ``XlaRuntimeError``-shaped ``UNAVAILABLE`` error
@@ -43,13 +58,22 @@ Spec grammar (CLI ``--inject-faults`` / chaos harness)::
 from __future__ import annotations
 
 import os
+import threading
 import time
 from dataclasses import dataclass
 
 KILL_RC = 137  # simulated SIGKILL exit code (128 + 9), documented in README
 
-POINTS = ("device_init", "compile", "attempt", "transfer", "checkpoint_write")
+POINTS = ("device_init", "compile", "attempt", "transfer", "checkpoint_write",
+          # serve tier (crash-safe serve PR)
+          "serve_dispatch", "lane_seat", "deliver", "journal_write",
+          "net_accept")
 KINDS = ("transient", "oom", "fatal", "hang", "truncate", "corrupt", "kill")
+
+# the serve tier's injection points (chaos_serve schedules draw over
+# exactly these; the sweep-side chaos harness never hits them)
+SERVE_POINTS = ("serve_dispatch", "lane_seat", "deliver", "journal_write",
+                "net_accept")
 
 # kinds that act on checkpoint files need the checkpoint_write context
 _CHECKPOINT_KINDS = ("truncate", "corrupt")
@@ -168,33 +192,83 @@ class FaultSchedule:
             specs.append(spec)
         return cls(specs)
 
+    @classmethod
+    def random_serve(cls, rng, n_faults: int = 2, *,
+                     kinds: tuple = ("transient", "oom", "fatal", "hang"),
+                     points: tuple = SERVE_POINTS,
+                     must_cover: str | None = None,
+                     max_occurrence: int = 3,
+                     hang_seconds: float = 0.2) -> "FaultSchedule":
+        """Seeded serve-tier schedule: faults land on the serve points
+        (``tools/chaos_serve.py``'s entry). ``must_cover`` forces at
+        least one fault onto that point, so a round-robin over
+        ``SERVE_POINTS`` provably exercises every point. No ``kill``
+        kind here — in-process serve chaos asserts recovery, and the
+        real-process kill leg is the harness's SIGKILL-at-journal-offset
+        cycle, not an injected exit."""
+        specs: list[FaultSpec] = []
+        want = list(points)
+        if must_cover is not None:
+            want = [must_cover] + [p for p in want if p != must_cover]
+        for i in range(n_faults):
+            point = want[0] if i == 0 and must_cover is not None \
+                else rng.choice(list(points))
+            kind = rng.choice(list(kinds))
+            occ = rng.randint(1, max_occurrence)
+            param = hang_seconds if kind == "hang" else None
+            spec = FaultSpec(point=point, occurrence=occ, kind=kind,
+                             param=param)
+            if any(s.point == spec.point and s.occurrence == spec.occurrence
+                   for s in specs):
+                continue  # one fault per (point, occurrence) slot
+            specs.append(spec)
+        return cls(specs)
+
 
 class FaultPlane:
     """Armed fault schedule: counts hits per point, fires matching specs.
 
     ``on_fire(record)`` (if given) observes every fired fault — the CLI
     routes it into the obs event stream. ``fired`` keeps the same records
-    for callers that poll (bench, tests)."""
+    for callers that poll (bench, tests).
+
+    Hit counting is lock-guarded: the sweep tier fires from one driver
+    thread, but the serve points fire concurrently from listener handler
+    threads, the batch dispatcher, and worker threads — occurrence
+    semantics must stay exact under that interleaving. The fault BODY
+    runs outside the lock (a ``hang`` at one point must not serialize
+    every other point's no-op hit)."""
 
     def __init__(self, schedule: FaultSchedule, *, hard_kill: bool = False,
                  on_fire=None):
         self.schedule = schedule
         self.hard_kill = hard_kill
         self.on_fire = on_fire
-        self.fired: list[dict] = []
-        self._counts: dict[str, int] = {}
+        self._lock = threading.Lock()
+        self.fired: list[dict] = []          # guarded-by: _lock
+        self._counts: dict[str, int] = {}    # guarded-by: _lock
 
     def fire(self, point: str, **ctx) -> None:
-        n = self._counts.get(point, 0) + 1
-        self._counts[point] = n
-        for spec in self.schedule:
-            if spec.point == point and spec.occurrence == n:
-                record = {"point": point, "kind": spec.kind, "occurrence": n,
-                          "param": spec.param}
-                self.fired.append(record)
-                if self.on_fire is not None:
-                    self.on_fire(record)
-                self._execute(spec, ctx)
+        due: list[tuple] = []
+        with self._lock:
+            n = self._counts.get(point, 0) + 1
+            self._counts[point] = n
+            for spec in self.schedule:
+                if spec.point == point and spec.occurrence == n:
+                    record = {"point": point, "kind": spec.kind,
+                              "occurrence": n, "param": spec.param}
+                    self.fired.append(record)
+                    due.append((spec, record))
+        for spec, record in due:
+            if self.on_fire is not None:
+                self.on_fire(record)
+            self._execute(spec, ctx)
+
+    def fired_snapshot(self) -> list[dict]:
+        """Locked copy of the fired records (pollers racing serve
+        threads)."""
+        with self._lock:
+            return [dict(r) for r in self.fired]
 
     # -- fault bodies ---------------------------------------------------
 

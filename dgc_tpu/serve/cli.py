@@ -67,6 +67,37 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "'tenants': {name: {rate, burst, "
                         "max_concurrency, tier|priority}}}; absent = "
                         "permissive single-tenant admission")
+    p.add_argument("--journal-dir", type=str, default=None, metavar="DIR",
+                   help="durable ticket journal for --listen "
+                        "(serve.netfront.journal): every accepted "
+                        "submit is fsync-journaled ahead of its 202, "
+                        "and a restart over the same DIR recovers the "
+                        "ticket table — completed tickets pollable "
+                        "again, in-flight tickets replayed, ticket ids "
+                        "resumed past the journal high-water mark "
+                        "(tools/chaos_serve.py is the kill-resume "
+                        "proof); absent = the in-memory-only table")
+    p.add_argument("--inject-faults", type=str, default=None,
+                   metavar="SPEC",
+                   help="arm the resilience fault plane "
+                        "(POINT@N=KIND[:PARAM], comma-separated) over "
+                        "the serve tier's points: serve_dispatch, "
+                        "lane_seat, deliver, journal_write, net_accept "
+                        "(plus the sweep-side points on the fallback "
+                        "path); kill faults exit 137 like a real "
+                        "SIGKILL")
+    p.add_argument("--dispatch-timeout", type=float, default=None,
+                   metavar="SECONDS",
+                   help="arm the dispatch watchdog: a batched slice/"
+                        "pair dispatch past this deadline is abandoned, "
+                        "the lane pool torn down and rebuilt, and "
+                        "surviving requests reseated (lane_rebuild "
+                        "event); default off")
+    p.add_argument("--max-lane-aborts", type=int, default=3,
+                   help="poison-request quarantine budget: a request "
+                        "whose lane aborts this many times is "
+                        "structured-failed with rc context instead of "
+                        "re-crashing the batch forever (default 3)")
     p.add_argument("--results", type=str, default=None,
                    help="write per-request JSONL results here "
                         "(default: stdout)")
@@ -204,6 +235,7 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
                       profiler=lambda ms: profiler.timed_window(
                           args.profile_logdir, ms, trigger="http",
                           logger=logger),
+                      journal_dir=args.journal_dir,
                       host=args.listen_host, port=args.listen).start()
     except OSError as e:
         print(f"--listen: cannot bind {args.listen}: {e}",
@@ -293,6 +325,34 @@ def serve_main(argv: list[str] | None = None) -> int:
                                   registry=registry)
         logger.add_sink(recorder)
         install_sigusr1(recorder, args.flightrec_dir, logger=logger)
+    # serve-tier fault plane (--inject-faults): armed exactly like the
+    # sweep CLI's — hard_kill (a real process dies like a SIGKILL, rc
+    # 137) and every fired fault into the event stream + registry. With
+    # the flag unset nothing is installed: fault_point stays the
+    # one-None-check no-op.
+    if args.inject_faults:
+        from dgc_tpu.resilience import faults
+
+        try:
+            schedule = faults.FaultSchedule.parse(args.inject_faults)
+        except ValueError as e:
+            print(f"Bad --inject-faults spec: {e}", file=sys.stderr)
+            return 2
+
+        def on_fire(rec):
+            logger.event("fault_injected", point=rec["point"],
+                         fault_kind=rec["kind"],
+                         occurrence=rec["occurrence"], param=rec["param"])
+            registry.counter("dgc_faults_injected_total",
+                             "faults fired by the injection plane",
+                             point=rec["point"], kind=rec["kind"]).inc()
+            if rec["kind"] == "kill" and recorder is not None:
+                recorder.dump(args.flightrec_dir, reason="injected_kill",
+                              logger=logger)
+
+        faults.install(faults.FaultPlane(schedule, hard_kill=True,
+                                         on_fire=on_fire))
+
     tuned_cache = None
     if args.tuned_cache_dir:
         # the cache directory serves two layers: per-shape fallback
@@ -348,8 +408,13 @@ def serve_main(argv: list[str] | None = None) -> int:
         validate=not args.no_validate,
         post_reduce=not args.no_reduce_colors,
         auto_tune=args.auto_tune, tuned_cache=tuned_cache,
+        max_lane_aborts=args.max_lane_aborts,
+        dispatch_timeout=args.dispatch_timeout,
         logger=logger, registry=registry,
     ).start()
+    if args.journal_dir is not None and args.listen is None:
+        print("# --journal-dir ignored without --listen: the replay "
+              "mode has no ticket table to journal", file=sys.stderr)
 
     # live scrape endpoint (obs.httpd): GET /metrics serves the registry
     # in Prometheus text format for the whole replay — the ROADMAP

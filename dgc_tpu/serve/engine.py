@@ -65,6 +65,8 @@ from dgc_tpu.engine.base import AttemptResult, empty_budget_failure
 from dgc_tpu.layout import (CARRY_LEN, CARRY_NC, CARRY_PHASE, CARRY_RUNG,
                             T_US)
 from dgc_tpu.obs.trace import NULL_TRACER
+from dgc_tpu.resilience.faults import fault_point
+from dgc_tpu.resilience.supervisor import STRUCTURED_ABORT_RC
 from dgc_tpu.serve.batched import (
     DEFAULT_STALL_WINDOW,
     auto_slice_steps,
@@ -94,6 +96,22 @@ class ServeError(RuntimeError):
     fallback, scheduler shut down mid-call)."""
 
 
+class PoisonedRequest(ServeError):
+    """Quarantine verdict: this request's lane aborted
+    ``max_lane_aborts`` times, so it is structured-failed with rc
+    context. Deliberately NOT the generic :class:`ServeError` the
+    front end retries on the single-graph fallback — a request that
+    keeps crashing its batch must stop consuming engines, not migrate
+    to the next one."""
+
+
+class _DispatchHang(RuntimeError):
+    """The dispatch watchdog's verdict: a slice/batch dispatch ran past
+    ``dispatch_timeout_s``. Treated like any other dispatch abort — the
+    lane pool is torn down and rebuilt, survivors reseated — except the
+    ``lane_rebuild`` event says ``reason="hang"``."""
+
+
 def _pow2_ceil(n: int) -> int:
     return 1 << max(0, (int(n) - 1).bit_length())
 
@@ -120,7 +138,8 @@ def priority_window(window_s: float, priority: int) -> float:
 
 class _SweepCall:
     __slots__ = ("member", "k", "depth", "priority", "done", "result",
-                 "error", "t_enqueue", "span", "lane_span", "device_us")
+                 "error", "t_enqueue", "span", "lane_span", "device_us",
+                 "aborts")
 
     def __init__(self, member, k, span=None, priority=0):
         self.member = member
@@ -131,6 +150,10 @@ class _SweepCall:
         self.result = None
         self.error = None
         self.t_enqueue = time.perf_counter()
+        # lane aborts survived so far (dispatch failure / hang / seat
+        # fault); at max_lane_aborts the call is quarantined — the
+        # poison-request policy (dispatcher-owned, like lane state)
+        self.aborts = 0
         # request-scoped tracing (obs.trace): the sweep span begun at
         # enqueue; the lane span the dispatcher opens when the call is
         # seated (closed at recycle/delivery)
@@ -405,6 +428,8 @@ class BatchScheduler:
                  recal_min_slices: int = 8,
                  stages="auto", device_carry: bool = False,
                  tuned_cache=None,
+                 max_lane_aborts: int = 3,
+                 dispatch_timeout_s: float | None = None,
                  on_batch=None, on_event=None, tracer=None):
         if batch_max < 1:
             raise ValueError(f"batch_max must be >= 1, got {batch_max}")
@@ -413,6 +438,13 @@ class BatchScheduler:
         if slice_steps is not None and int(slice_steps) < 1:
             raise ValueError(
                 f"slice_steps must be >= 1 or None (auto), got {slice_steps}")
+        if max_lane_aborts < 1:
+            raise ValueError(
+                f"max_lane_aborts must be >= 1, got {max_lane_aborts}")
+        if dispatch_timeout_s is not None and dispatch_timeout_s <= 0:
+            raise ValueError(
+                f"dispatch_timeout_s must be > 0 or None (off), "
+                f"got {dispatch_timeout_s}")
         if not (stages in ("auto", "off") or isinstance(stages, tuple)):
             raise ValueError(
                 f"stages must be 'auto', 'off', or a stage ladder tuple, "
@@ -445,6 +477,16 @@ class BatchScheduler:
         # post-ladder MEDIAN, never the expensive opening slices.
         self.timing = bool(timing)
         self.recal_min_slices = int(recal_min_slices)
+        # serve-tier fault plane (crash-safe serve PR): a call whose
+        # lane aborts max_lane_aborts times is QUARANTINED (structured
+        # failure with rc context) instead of re-crashing the pool
+        # forever; dispatch_timeout_s arms the dispatch watchdog — a
+        # dispatch past it is abandoned, the pool rebuilt, survivors
+        # reseated (lane_rebuild event). None = off, the exact default
+        # dispatch path.
+        self.max_lane_aborts = int(max_lane_aborts)
+        self.dispatch_timeout_s = (None if dispatch_timeout_s is None
+                                   else float(dispatch_timeout_s))
         self.on_batch = on_batch
         self.on_event = on_event
         self.tracer = tracer if tracer is not None else NULL_TRACER
@@ -464,7 +506,8 @@ class BatchScheduler:
         self.stats = {"batches": 0, "sweeps": 0, "compile_hits": 0,
                       "compile_misses": 0, "slices": 0, "recycles": 0,
                       "max_live": 0, "recals": 0,
-                      "h2d_bytes": 0, "d2h_bytes": 0}   # guarded-by: _lock
+                      "h2d_bytes": 0, "d2h_bytes": 0,
+                      "rebuilds": 0, "quarantined": 0}   # guarded-by: _lock
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "BatchScheduler":
@@ -741,6 +784,92 @@ class BatchScheduler:
                     "samples": int(n), "rung": int(acc["rung"]),
                 })
 
+    # -- fault plane: guarded dispatch + quarantine -----------------------
+    def _run_dispatch(self, fn):
+        """Run one kernel dispatch, watchdogged. With the watchdog off
+        (``dispatch_timeout_s=None``, the default) this is a direct
+        call — zero change to the shipped dispatch path. Armed, the
+        dispatch runs on a helper thread and a join past the deadline
+        raises :class:`_DispatchHang`; the abandoned thread's eventual
+        result is discarded (it only holds the pre-rebuild buffers)."""
+        if self.dispatch_timeout_s is None:
+            return fn()
+        box: dict = {}
+        done = threading.Event()
+
+        def run():
+            try:
+                box["result"] = fn()
+            except BaseException as e:   # noqa: BLE001 — re-raised below
+                box["error"] = e
+            done.set()
+
+        t = threading.Thread(target=run, daemon=True,
+                             name="dgc-serve-dispatch")
+        t.start()
+        if not done.wait(self.dispatch_timeout_s):
+            raise _DispatchHang(
+                f"dispatch exceeded {self.dispatch_timeout_s}s "
+                f"(watchdog); pool will be rebuilt")
+        if "error" in box:
+            raise box["error"]
+        return box["result"]
+
+    def _quarantine(self, call, error) -> None:
+        """Poison-request policy: structured-fail one call with rc
+        context after its lane abort budget is spent."""
+        if call.lane_span is not None:
+            call.lane_span.end({"error": "quarantined"})
+            call.lane_span = None
+        call.error = PoisonedRequest(
+            f"request quarantined after {call.aborts} lane aborts "
+            f"(rc {STRUCTURED_ABORT_RC}): {type(error).__name__}: {error}")
+        call.done.set()
+        with self._lock:
+            self.stats["quarantined"] += 1
+
+    def _recover_class(self, cls, error) -> None:
+        """Dispatch failure/hang recovery: tear the class's pool down,
+        quarantine calls past their abort budget, reseat the survivors
+        (their sweep restarts from its inputs — deterministic, so the
+        re-run is invisible in the output), emit ``lane_rebuild``."""
+        pool = self._pools.pop(cls, None)
+        survivors, poisoned = [], []
+        aborts_max = 0
+        for call in (pool.calls if pool is not None else []):
+            if call is None:
+                continue
+            call.aborts += 1
+            aborts_max = max(aborts_max, call.aborts)
+            if call.lane_span is not None:
+                call.lane_span.end({"error": f"lane aborted: {error}"})
+                call.lane_span = None
+            (poisoned if call.aborts >= self.max_lane_aborts
+             else survivors).append(call)
+        for call in poisoned:
+            call.error = PoisonedRequest(
+                f"request quarantined after {call.aborts} lane aborts "
+                f"(rc {STRUCTURED_ABORT_RC}): "
+                f"{type(error).__name__}: {error}")
+            call.done.set()
+        with self._lock:
+            if survivors:
+                # reseat ahead of fresh arrivals: they were seated once
+                self._pending.setdefault(cls, [])[:0] = survivors
+            self.stats["rebuilds"] += 1
+            self.stats["quarantined"] += len(poisoned)
+            self._lock.notify_all()
+        if self.on_event is not None:
+            self.on_event("lane_rebuild", {
+                "shape_class": cls.name,
+                "reason": ("hang" if isinstance(error, _DispatchHang)
+                           else "abort"),
+                "reseated": len(survivors),
+                "quarantined": len(poisoned),
+                "aborts_max": int(aborts_max),
+                "error": f"{type(error).__name__}: {error}"[:300],
+            })
+
     # =====================================================================
     # continuous mode: lane recycling
     # =====================================================================
@@ -804,18 +933,12 @@ class BatchScheduler:
                         return
                 try:
                     self._service_class(cls)
-                except Exception as e:  # pragma: no cover - defensive
-                    pool = self._pools.pop(cls, None)
-                    failed = [c for c in (pool.calls if pool else [])
-                              if c is not None]
-                    with self._lock:
-                        failed.extend(self._pending.pop(cls, []))
-                    for call in failed:
-                        if call.lane_span is not None:
-                            call.lane_span.end({"error": "dispatch failed"})
-                        call.error = ServeError(
-                            f"batched dispatch failed: {e}")
-                        call.done.set()
+                except Exception as e:
+                    # dispatch abort (injected fault, real XLA error) or
+                    # watchdog hang: rebuild instead of failing the whole
+                    # batch — survivors reseat, poisoned calls
+                    # structured-fail (the quarantine policy)
+                    self._recover_class(cls, e)
 
     def _service_class(self, cls) -> None:
         """One slice of one class's pool: seat queued calls in free
@@ -838,6 +961,20 @@ class BatchScheduler:
             if take:
                 pool.reserve(len(take))   # ONE resize for the whole wave
             for call in take:
+                try:
+                    fault_point("lane_seat", shape_class=cls.name)
+                except Exception as e:
+                    # a seat fault costs THIS call one abort (quarantine
+                    # past the budget, back of the queue otherwise); the
+                    # rest of the wave still seats
+                    call.aborts += 1
+                    if call.aborts >= self.max_lane_aborts:
+                        self._quarantine(call, e)
+                    else:
+                        with self._lock:
+                            self._pending.setdefault(cls, []).append(call)
+                            self._lock.notify_all()
+                    continue
                 lane = pool.fill(call)
                 call.lane_span = self.tracer.begin(
                     "lane", parent=call.span,
@@ -879,13 +1016,27 @@ class BatchScheduler:
                          + pool.reset.nbytes)
             if isinstance(pool.carry[0], np.ndarray):
                 pool.h2d += carry_nbytes(pool.carry)
-        carry = kernel(comb_dev, degrees_dev, k0_in, ms_in, reset_in,
-                       pool.carry)
-        # the per-lane scheduling scalars — the ONLY unconditional
-        # device→host transfer per slice: done mask + stage telemetry
-        phase = np.asarray(carry[CARRY_PHASE])   # forces the dispatch
-        rung = np.asarray(carry[CARRY_RUNG])
-        nc = np.asarray(carry[CARRY_NC])
+        def run_slice():
+            # the serve_dispatch fault point and the forcing transfers
+            # run INSIDE the guarded call: an injected hang (or a real
+            # wedged dispatch) blocks here, where the watchdog sees it
+            fault_point("serve_dispatch", shape_class=cls.name)
+            carry = kernel(comb_dev, degrees_dev, k0_in, ms_in, reset_in,
+                           pool.carry)
+            # the per-lane scheduling scalars — the ONLY unconditional
+            # device→host transfer per slice: done mask + stage telemetry
+            phase = np.asarray(carry[CARRY_PHASE])   # forces the dispatch
+            rung = np.asarray(carry[CARRY_RUNG])
+            nc = np.asarray(carry[CARRY_NC])
+            return carry, phase, rung, nc
+
+        try:
+            carry, phase, rung, nc = self._run_dispatch(run_slice)
+        except BaseException as e:
+            # close the slice span before the rebuild path takes over —
+            # every opened span must end (the validate_runlog contract)
+            slice_span.end({"error": f"{type(e).__name__}: {e}"})
+            raise
         pool.d2h += 3 * phase.nbytes
         device_s = time.perf_counter() - t0
         pool.rearm(carry)
@@ -1053,10 +1204,34 @@ class BatchScheduler:
             cls, calls = got
             try:
                 self._dispatch(cls, calls)
-            except Exception as e:  # pragma: no cover - defensive
+            except Exception as e:
+                # same quarantine policy as the continuous loop: each
+                # batch member pays one abort; survivors requeue at the
+                # head, poisoned members structured-fail
+                survivors = []
+                aborts_max = 0
                 for call in calls:
-                    call.error = ServeError(f"batched dispatch failed: {e}")
-                    call.done.set()
+                    call.aborts += 1
+                    aborts_max = max(aborts_max, call.aborts)
+                    if call.aborts >= self.max_lane_aborts:
+                        self._quarantine(call, e)
+                    else:
+                        survivors.append(call)
+                with self._lock:
+                    if survivors:
+                        self._pending.setdefault(cls, [])[:0] = survivors
+                    self.stats["rebuilds"] += 1
+                    self._lock.notify_all()
+                if self.on_event is not None:
+                    self.on_event("lane_rebuild", {
+                        "shape_class": cls.name,
+                        "reason": ("hang" if isinstance(e, _DispatchHang)
+                                   else "abort"),
+                        "reseated": len(survivors),
+                        "quarantined": len(calls) - len(survivors),
+                        "aborts_max": int(aborts_max),
+                        "error": f"{type(e).__name__}: {e}"[:300],
+                    })
 
     def _dispatch(self, cls, calls) -> None:
         b = len(calls)
@@ -1081,8 +1256,19 @@ class BatchScheduler:
             "batch", trace="sched",
             attrs={"cls": cls.name, "batch": int(b), "b_pad": int(b_pad)})
         t0 = time.perf_counter()
-        p1, s1, st1, used, p2, s2, st2 = kernel(comb, degrees, k0, max_steps)
-        st2 = np.asarray(st2)   # one transfer point for the epilogues
+
+        def run_pair():
+            fault_point("serve_dispatch", shape_class=cls.name)
+            out = kernel(comb, degrees, k0, max_steps)
+            # one transfer point for the epilogues (forces the dispatch
+            # inside the watchdog's view)
+            return out[:6] + (np.asarray(out[6]),)
+
+        try:
+            p1, s1, st1, used, p2, s2, st2 = self._run_dispatch(run_pair)
+        except BaseException as e:
+            batch_span.end({"error": f"{type(e).__name__}: {e}"})
+            raise
         device_s = time.perf_counter() - t0
         batch_span.end()
 

@@ -25,6 +25,15 @@ per-class latency histograms, live Prometheus ``/metrics`` +
   :class:`~dgc_tpu.obs.metrics.MetricsRegistry` so ``/metrics`` breaks
   out tenants.
 
+- ``journal`` — :class:`TicketJournal`: the durable ticket journal
+  (crash-safe serve PR) — an append-only, fsync-batched write-ahead
+  log of ticket lifecycle records the listener writes ahead of every
+  ``202`` ack and recovers the ticket table from on startup: completed
+  tickets pollable again, in-flight tickets replayed under their
+  original ids, the id counter resumed past the journal high-water
+  mark. ``tools/chaos_serve.py`` SIGKILLs a serving listener at seeded
+  journal offsets and proves zero acked-ticket loss across restarts.
+
 ``tools/soak.py`` is the many-client soak harness over this package;
 its run log feeds ``tools/slo_check.py`` and its record feeds
 ``tools/perf_db.py`` — multi-tenant serving under load as a ledgered
@@ -34,7 +43,10 @@ number.
 from dgc_tpu.serve.netfront.admission import (AdmissionController,
                                               AdmissionReject, TenantConfig,
                                               load_tenant_configs)
+from dgc_tpu.serve.netfront.journal import (JournalError, TicketJournal,
+                                            scan_journal)
 from dgc_tpu.serve.netfront.listener import NetFront
 
-__all__ = ["AdmissionController", "AdmissionReject", "NetFront",
-           "TenantConfig", "load_tenant_configs"]
+__all__ = ["AdmissionController", "AdmissionReject", "JournalError",
+           "NetFront", "TenantConfig", "TicketJournal",
+           "load_tenant_configs", "scan_journal"]

@@ -41,8 +41,13 @@ from dataclasses import dataclass
 TIER_PRIORITY = {"free": 0, "standard": 0, "paid": 1, "premium": 2}
 
 # reject reasons — the closed vocabulary tools/validate_runlog.py
-# enforces on net_reject events (and the 429/503 body's "reason")
-REJECT_REASONS = ("rate_limited", "concurrency", "queue_full", "draining")
+# enforces on net_reject events (and the 429/503 body's "reason").
+# "journal_error" / "listener_fault": the durable ticket journal (or an
+# injected net_accept fault) refused the submit — the listener answers
+# 503 WITHOUT acking, because an un-journaled 202 is exactly the acked-
+# ticket loss the crash-safe serve tier exists to prevent
+REJECT_REASONS = ("rate_limited", "concurrency", "queue_full", "draining",
+                  "journal_error", "listener_fault")
 
 
 class AdmissionReject(RuntimeError):

@@ -1,0 +1,400 @@
+"""Durable ticket journal: the serve tier's write-ahead log.
+
+The PR 12 ticket table is process memory — every rolling restart loses
+acked work (ROADMAP item 1's "persistent ticket store across rolling
+restarts"). This module is the durability layer under it: an
+append-only JSONL journal of ticket lifecycle records that
+:class:`~dgc_tpu.serve.netfront.listener.NetFront` writes ahead of the
+``202`` ack and replays on startup, so a SIGKILL'd listener restarted
+over the same ``--journal-dir`` loses nothing a client was promised.
+
+Record stream (one JSON object per line, ``rec`` is the type)::
+
+    {"rec": "admitted",  "ticket": "t00000003", "tenant": ..,
+     "priority": .., "payload": {..the request document..}}
+    {"rec": "seated",    "ticket": ..}            # front-end accepted it
+    {"rec": "attempt",   "ticket": .., "k": .., "status": ..,
+     "supersteps": ..}                            # one per minimal-k attempt
+    {"rec": "delivered", "ticket": .., "result": {..incl. colors..}}
+    {"rec": "failed",    "ticket": .., "result": {..error doc..}}
+    {"rec": "aborted",   "ticket": .., "reason": ..}   # never acked (429/503)
+
+Durability contract: ``append(..., durable=True)`` returns only after
+the record (and everything written before it) is fsync'd. Syncs are
+**group-committed, leader/follower**: appends land in the file under
+the journal lock; the first durable appender with no sync in flight
+performs the ``fsync`` itself (lock released around the syscall) and
+every concurrent appender's record rides that one commit — so
+concurrent acks share one ``fsync`` instead of paying one each, and
+the uncontended ack pays zero cross-thread round trips. That is what
+keeps the journal's soak overhead inside the ≤5% bar (PERF.md
+"Durable ticket journal"). The journal is TWO files: the ack-critical
+WAL (``admitted``/``seated``/``aborted`` — small records, fsync'd) and
+a results log (``attempt``/``delivered``/``failed`` — the bulky
+colors-bearing records, flushed lazily, fsync'd on close), so each
+ack's fsync never drags result payloads through the filesystem
+journal. A crash loses at most the un-flushed results tail; those
+tickets just re-execute on recovery — deterministic engines make the
+re-execution invisible.
+
+Recovery (:func:`scan_journal`) folds the stream into per-ticket state:
+
+- a ticket with a ``delivered``/``failed`` record is **completed** —
+  the listener restores it into the table, pollable again;
+- ``admitted`` without a terminal record is **in flight** — the
+  listener replays its ``payload`` through ``ServeFrontEnd.submit``
+  under the SAME ticket id (dedup by id; re-runs are exact because the
+  engines are deterministic);
+- ``aborted`` tickets were never acked and are dropped;
+- the ticket-id **high-water mark** (max parsed ``tNNNNNNNN``) seeds
+  the listener's counter so restarted processes never re-issue a live
+  id (the PR 12 collision bug: the counter reset to 0 every start).
+
+A torn trailing line (the SIGKILL landed mid-write) is tolerated and
+dropped — everything before it was fsync-ordered ahead of any ack that
+depended on it. The journal is crash-consistent, not compacted;
+compaction (drop records of evicted tickets) is a follow-on.
+
+Fault injection: every append passes the ``journal_write`` point of the
+resilience plane (``POINT@N=KIND`` grammar, ``--inject-faults``), so
+``tools/chaos_serve.py`` can prove the listener's journal-error path
+(503 with structured context, no ack without durability) on demand.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import re
+import threading
+import time
+from dataclasses import dataclass, field
+
+from dgc_tpu.resilience.faults import fault_point
+
+JOURNAL_FILE = "ticket_journal.jsonl"
+RESULTS_FILE = "ticket_results.jsonl"
+
+REC_TYPES = ("admitted", "seated", "attempt", "delivered", "failed",
+             "aborted")
+
+# the ack-critical lifecycle records live in the small WAL
+# (JOURNAL_FILE, fsync-group-committed); the bulky breadcrumbs —
+# per-attempt progress and terminal results WITH colors — live in the
+# results log (RESULTS_FILE, flushed lazily, fsync'd only on close).
+# Keeping ~3KB of per-request result data out of the WAL keeps each
+# ack's fsync off the filesystem-journal path that drags every dirty
+# page of the process through one commit (PERF.md "Durable ticket
+# journal": −2.8% on the batch-8 soak at real request weight, with the
+# light-request ack-latency sensitivity analysed there). Crash window:
+# losing un-flushed results records only means those tickets REPLAY on
+# recovery, which deterministic engines make invisible.
+_WAL_RECS = ("admitted", "seated", "aborted")
+
+_TICKET_RE = re.compile(r"^t([0-9a-f]{8})$")
+
+
+class JournalError(RuntimeError):
+    """The journal cannot accept the record (closed, or the underlying
+    write failed) — the listener turns this into a 503 instead of
+    acking un-durable work."""
+
+
+class TicketJournal:
+    """Append-only, fsync-batched ticket WAL over ``directory``.
+
+    One writer file handle, opened in append mode so a restarted
+    process continues the same journal its predecessor was killed over.
+    Thread model: listener handler threads and worker completion
+    callbacks append concurrently under ``_cond``.
+
+    Group commit is **inline leader/follower** (no flusher thread — a
+    cross-thread fsync round trip costs two context switches per ack
+    on a busy 1-core host; inline commit at real request weight
+    measured inside the ≤5% soak bar, PERF.md): the first durable
+    appender to find no
+    sync in flight becomes the leader, flushes under the lock, releases
+    it around the ``fsync`` so concurrent appenders batch into the NEXT
+    commit, and wakes every follower whose record the fsync covered.
+    Breadcrumb appends (``durable=False``) never trigger a sync — file
+    order means the next durable commit covers them for free."""
+
+    def __init__(self, directory: str, *, commit_window_s: float = 0.0):
+        self.directory = str(directory)
+        os.makedirs(self.directory, exist_ok=True)
+        self.path = os.path.join(self.directory, JOURNAL_FILE)
+        self.results_path = os.path.join(self.directory, RESULTS_FILE)
+        # commit window (Postgres commit_delay): the leader sleeps this
+        # long before its fsync so a submit burst's acks share one
+        # commit. Default OFF: against closed-loop clients every ms of
+        # ack latency converts straight into wall time (measured: a
+        # 20 ms window cost MORE soak throughput than the fsyncs it
+        # saved — PERF.md "Durable ticket journal"); the knob exists
+        # for open-loop traffic on multi-core hosts where fsync rate,
+        # not ack latency, is the binding cost.
+        self.commit_window_s = float(commit_window_s)
+        self._fh = open(self.path, "ab")
+        self._rh = open(self.results_path, "ab")
+        self._cond = threading.Condition()
+        self._written = 0      # records appended; guarded-by: _cond
+        self._synced = 0       # WAL records fsync-covered; guarded-by: _cond
+        self._wal_written = 0  # WAL records appended; guarded-by: _cond
+        self._syncing = False  # a leader's fsync in flight; guarded-by: _cond
+        self._closed = False   # guarded-by: _cond
+
+    # -- append ----------------------------------------------------------
+    def append(self, rec: str, ticket: str, *, durable: bool = True,
+               **fields) -> None:
+        """Append one lifecycle record; with ``durable`` block until the
+        fsync batch covering it lands. Raises :class:`JournalError` when
+        closed and re-raises injected/OS write failures — the caller
+        must NOT ack work whose record did not land."""
+        if rec not in REC_TYPES:
+            raise ValueError(f"unknown journal record type {rec!r}")
+        line = (json.dumps({"rec": rec, "ticket": ticket,
+                            "t": round(time.time(), 6), **fields})
+                + "\n").encode()
+        wal = rec in _WAL_RECS
+        with self._cond:
+            if self._closed:
+                raise JournalError("ticket journal is closed")
+            fault_point("journal_write", rec=rec, ticket=ticket)
+            try:
+                if wal:
+                    self._fh.write(line)
+                else:
+                    self._rh.write(line)
+            except OSError as e:
+                raise JournalError(f"journal append failed: {e}") from e
+            self._written += 1
+            if not wal:
+                return   # results log: flushed lazily, fsync'd on close
+            self._wal_written += 1
+            seq = self._wal_written
+        if durable:
+            # the lock drops between the write and the commit: any
+            # append that slips in simply rides this commit (the
+            # leader syncs to the CURRENT high-water mark, not ``seq``)
+            self._commit(seq)
+
+    def _commit(self, seq: int) -> None:
+        """Leader/follower group commit: return once WAL record ``seq``
+        is fsync-covered. Called WITHOUT the lock (append drops it
+        between write and commit — an append that slips in just rides
+        this commit, because the leader syncs to the current high-water
+        mark, not to ``seq``). A failed flush/fsync closes the journal
+        and fails every waiter loudly — no ack without durability."""
+        with self._cond:
+            while self._synced < seq:
+                if self._closed:
+                    raise JournalError(
+                        "journal closed before record synced")
+                if self._syncing:
+                    # follower: a leader's fsync is in flight; our
+                    # record either rides it or the leader we become
+                    # after it completes
+                    self._cond.wait(timeout=5.0)
+                    continue
+                self._syncing = True
+                if self.commit_window_s > 0:
+                    # leader's batching nap: the lock releases inside
+                    # wait(), so concurrent appends land and ride this
+                    # commit (nobody notifies mid-window; it sleeps)
+                    deadline = (time.perf_counter()
+                                + self.commit_window_s)
+                    while not self._closed:
+                        left = deadline - time.perf_counter()
+                        if left <= 0:
+                            break
+                        self._cond.wait(timeout=left)
+                target = self._wal_written
+                try:
+                    self._fh.flush()
+                    fd = self._fh.fileno()
+                except (OSError, ValueError) as e:
+                    self._syncing = False
+                    self._closed = True
+                    self._cond.notify_all()
+                    raise JournalError(
+                        f"journal flush failed: {e}") from e
+                # release around the syscall: followers append (and
+                # queue onto the next commit) while the disk works
+                self._cond.release()
+                try:
+                    try:
+                        # fdatasync: the WAL needs its DATA (and size)
+                        # durable, not atime/mtime metadata — one
+                        # fewer filesystem-journal obligation per commit
+                        os.fdatasync(fd)
+                        err = None
+                    except OSError as e:
+                        err = e
+                finally:
+                    self._cond.acquire()
+                self._syncing = False
+                if err is not None:
+                    self._closed = True
+                    self._cond.notify_all()
+                    raise JournalError(
+                        f"journal fsync failed: {err}") from err
+                self._synced = max(self._synced, target)
+                self._cond.notify_all()
+
+    def sync(self) -> None:
+        """Block until every WAL record appended so far is fsync'd and
+        the results log is flushed+fsync'd too (test/shutdown helper;
+        the live path never waits on the results log)."""
+        with self._cond:
+            if self._closed:
+                return
+            seq = self._wal_written
+        self._commit(seq)
+        with self._cond:
+            try:
+                self._rh.flush()
+                os.fsync(self._rh.fileno())
+            except OSError as e:
+                raise JournalError(f"results sync failed: {e}") from e
+
+    def close(self) -> None:
+        with self._cond:
+            if self._closed:
+                return
+            seq = self._wal_written
+        try:
+            self._commit(seq)
+        except JournalError:
+            pass   # close proceeds; the WAL tail was best-effort
+        with self._cond:
+            if self._closed:
+                return
+            self._closed = True
+            self._cond.notify_all()
+            for fh in (self._fh, self._rh):
+                try:
+                    fh.flush()
+                    os.fsync(fh.fileno())
+                    fh.close()
+                except (OSError, ValueError):
+                    pass
+
+    def records_written(self) -> int:
+        with self._cond:
+            return self._written
+
+
+# -- recovery -------------------------------------------------------------
+
+@dataclass
+class JournalTicket:
+    """One ticket's folded journal state."""
+
+    ticket: str
+    tenant: str = "anon"
+    priority: int = 0
+    payload: dict | None = None
+    attempts: list = field(default_factory=list)
+    result_doc: dict | None = None   # delivered/failed terminal doc
+    aborted: bool = False
+    seated: bool = False
+
+    @property
+    def completed(self) -> bool:
+        return self.result_doc is not None
+
+
+@dataclass
+class JournalState:
+    """The whole journal folded for recovery: tickets in first-admit
+    order, the id high-water mark, and the raw record count."""
+
+    tickets: list = field(default_factory=list)
+    high_water: int = -1     # max parsed ticket ordinal (-1 = none)
+    records: int = 0
+    torn: bool = False       # a torn trailing line was dropped
+
+
+def _scan_lines(path: str):
+    """Parsed (doc, torn) records of one journal file; tolerates a torn
+    trailing line, raises :class:`JournalError` on corruption anywhere
+    else. A missing file yields nothing (first boot)."""
+    try:
+        with open(path, "rb") as fh:
+            raw = fh.read()
+    except FileNotFoundError:
+        return [], False
+    lines = raw.split(b"\n")
+    torn_tail = not raw.endswith(b"\n")
+    docs = []
+    torn = False
+    for lineno, line in enumerate(lines, 1):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            doc = json.loads(line)
+        except json.JSONDecodeError:
+            if torn_tail and lineno == len(lines):
+                torn = True
+                continue
+            raise JournalError(
+                f"{path}:{lineno}: unparseable journal record") from None
+        rec = doc.get("rec")
+        if rec not in REC_TYPES or not isinstance(doc.get("ticket"), str):
+            raise JournalError(
+                f"{path}:{lineno}: malformed journal record {doc!r}")
+        docs.append(doc)
+    return docs, torn
+
+
+def scan_journal(path: str) -> JournalState:
+    """Fold a journal (the WAL at ``path`` plus its sibling results
+    log) into :class:`JournalState`. A missing file is an empty state;
+    a torn trailing line in either file is dropped (the crash landed
+    mid-write — nothing acked depended on it)."""
+    state = JournalState()
+    wal_docs, wal_torn = _scan_lines(path)
+    res_docs, res_torn = _scan_lines(
+        os.path.join(os.path.dirname(path), RESULTS_FILE))
+    state.torn = wal_torn or res_torn
+    by_id: dict[str, JournalTicket] = {}
+    for doc in wal_docs:
+        rec, ticket = doc["rec"], doc["ticket"]
+        state.records += 1
+        m = _TICKET_RE.match(ticket)
+        if m is not None:
+            state.high_water = max(state.high_water, int(m.group(1), 16))
+        ent = by_id.get(ticket)
+        if ent is None:
+            ent = by_id[ticket] = JournalTicket(ticket=ticket)
+            state.tickets.append(ent)
+        if rec == "admitted":
+            # dedup by ticket id: the first admit wins (a replayed
+            # ticket is never re-admitted, so a second admit for the
+            # same id would be a writer bug, not a crash artifact)
+            if ent.payload is None:
+                ent.tenant = str(doc.get("tenant", "anon"))
+                ent.priority = int(doc.get("priority", 0))
+                ent.payload = doc.get("payload")
+        elif rec == "seated":
+            ent.seated = True
+        elif rec == "aborted":
+            ent.aborted = True
+    for doc in res_docs:
+        rec, ticket = doc["rec"], doc["ticket"]
+        ent = by_id.get(ticket)
+        if ent is None:
+            # a results record can outrun its WAL fsync (the worker's
+            # first attempt races the seated commit); a ticket absent
+            # from the WAL was never acked, so its breadcrumbs drop
+            continue
+        state.records += 1
+        if rec == "attempt":
+            ent.attempts.append(
+                {k: doc[k] for k in ("k", "status", "supersteps")
+                 if k in doc})
+        elif rec in ("delivered", "failed"):
+            # the LAST terminal record wins: a replay after a crash
+            # inside the delivered-flush window re-runs and re-delivers
+            ent.result_doc = doc.get("result") or {}
+    return state

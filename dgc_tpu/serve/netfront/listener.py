@@ -36,6 +36,23 @@ out tenants.
 Thread model: handler threads run admission + submit; worker threads
 run completion callbacks; the ticket table and drain state are guarded
 by the netfront lock (netfront is in dgc-lint's lock-pass file set).
+
+Crash safety (the durable ticket journal, ``journal_dir=`` / the serve
+CLI's ``--journal-dir``): every accepted submit is journaled
+(``admitted`` with the request payload, then ``seated``) **before** the
+``202`` leaves the process — the ack waits on the journal's group-
+commit fsync. On startup, :meth:`NetFront.start` recovers the table
+from the journal: completed tickets become pollable again, in-flight
+tickets are REPLAYED through ``ServeFrontEnd.submit`` under their
+original ids (the engines are deterministic, so the re-run is
+bit-identical), the ticket counter resumes past the journal's
+high-water mark so ids never collide across restarts, and every
+recovery action lands in the run log as a ``net_recover`` event.
+``tools/chaos_serve.py`` SIGKILLs a serving listener at seeded journal
+offsets and proves zero acked-ticket loss over restart. A journal
+append failure (disk gone, injected ``journal_write`` fault) answers
+``503 journal_error`` without acking; the injected ``net_accept`` point
+covers the listener's own submit path.
 """
 
 from __future__ import annotations
@@ -52,9 +69,11 @@ from dgc_tpu.models.node import Node
 from dgc_tpu.obs.httpd import (Request, Response, RoutingHTTPServer,
                                StreamingResponse, json_response,
                                mount_observability)
+from dgc_tpu.resilience.faults import fault_point
 from dgc_tpu.serve.netfront.admission import (AdmissionController,
                                               AdmissionReject)
-from dgc_tpu.serve.queue import QueueFull, ServeError
+from dgc_tpu.serve.netfront.journal import TicketJournal, scan_journal
+from dgc_tpu.serve.queue import QueueFull, ServeError, ServeResult
 
 TENANT_HEADER = "X-Dgc-Tenant"
 
@@ -112,12 +131,21 @@ class NetFront:
                  registry=None, logger=None, recorder=None, profiler=None,
                  flightrec_dir: str = ".", host: str = "127.0.0.1",
                  port: int = 0,
-                 result_capacity: int = DEFAULT_RESULT_CAPACITY):
+                 result_capacity: int = DEFAULT_RESULT_CAPACITY,
+                 journal: TicketJournal | None = None,
+                 journal_dir: str | None = None,
+                 replay_timeout: float = 60.0):
         self.front = front
         self.admission = admission if admission is not None \
             else AdmissionController(registry=registry, logger=logger)
         self.registry = registry
         self.logger = logger
+        # durable ticket journal (module docstring): None = the PR 12
+        # in-memory-only behavior, byte-identical with the flag unset
+        self.journal = journal if journal is not None else (
+            TicketJournal(journal_dir) if journal_dir is not None else None)
+        self.replay_timeout = float(replay_timeout)
+        self._recovered = False       # guarded-by: owner (start())
         self._lock = threading.Lock()
         self._tickets: dict = {}      # id -> _NetTicket; guarded-by: _lock
         self._completed: deque = deque()   # eviction order; guarded-by: _lock
@@ -151,11 +179,18 @@ class NetFront:
         return self.server.port
 
     def start(self) -> "NetFront":
+        # recovery runs BEFORE the socket opens: a client polling a
+        # restored ticket must never see a transient 404 window
+        if self.journal is not None and not self._recovered:
+            self._recovered = True
+            self._recover()
         self.server.start()
         return self
 
     def close(self) -> None:
         self.server.close()
+        if self.journal is not None:
+            self.journal.close()
 
     def _health_doc(self) -> dict:
         doc = self.front.health()
@@ -187,6 +222,17 @@ class NetFront:
     # -- POST /v1/color --------------------------------------------------
     def _post_color(self, req: Request):
         tenant = (req.headers.get(TENANT_HEADER) or "anon").strip()
+        try:
+            # the listener's own injection point (resilience plane): an
+            # injected fault here answers 503 structured — the client
+            # retries, nothing was acked, nothing is lost
+            fault_point("net_accept", tenant=tenant)
+        except Exception as e:
+            self._event("net_reject", tenant=tenant,
+                        reason="listener_fault")
+            return json_response(
+                {"error": f"listener fault: {e}",
+                 "reason": "listener_fault", "tenant": tenant}, status=503)
         with self._lock:
             draining = self._draining
         if draining:
@@ -214,20 +260,27 @@ class NetFront:
             ticket_id = f"t{self._next_ticket:08x}"
             self._next_ticket += 1
         net_ticket = _NetTicket(ticket_id, tenant, priority)
-
-        def on_attempt(res, val):
-            att = {"k": int(res.k), "status": res.status.name,
-                   "supersteps": int(res.supersteps)}
-            with net_ticket.cond:
-                net_ticket.attempts.append(att)
-                net_ticket.cond.notify_all()
-
+        # write-ahead: the admitted record (with the replayable payload)
+        # goes to the journal BEFORE the submit; the durable wait rides
+        # the "seated" append below so both land under one group commit
+        if self.journal is not None:
+            try:
+                self.journal.append("admitted", ticket_id, durable=False,
+                                    tenant=tenant, priority=priority,
+                                    payload=doc)
+            except Exception as e:
+                self.admission.release(tenant)
+                self._event("net_reject", tenant=tenant,
+                            reason="journal_error")
+                return json_response(
+                    {"error": f"ticket journal unavailable: {e}",
+                     "reason": "journal_error", "tenant": tenant},
+                    status=503)
         try:
-            serve_ticket = self.front.submit(
-                graph.arrays, request_id=ticket_id,
-                priority=priority, on_attempt=on_attempt)
+            self._attach(net_ticket, graph)
         except QueueFull as e:
             self.admission.release(tenant)
+            self._journal_soft("aborted", ticket_id, reason="queue_full")
             fields = dict(e.to_fields(), tenant=tenant,
                           reason="queue_full")
             self._event("net_reject", **fields)
@@ -235,14 +288,27 @@ class NetFront:
         except ServeError:
             # the front end began draining between our check and submit
             self.admission.release(tenant)
+            self._journal_soft("aborted", ticket_id, reason="draining")
             self._event("net_reject", tenant=tenant, reason="draining")
             return json_response(
                 {"error": "draining", "reason": "draining",
                  "tenant": tenant}, status=503)
-        with self._lock:
-            self._tickets[ticket_id] = net_ticket
-        serve_ticket.add_done_callback(
-            lambda result: self._on_done(net_ticket, result))
+        if self.journal is not None:
+            try:
+                # the 202 ack below waits HERE: seated (and the admitted
+                # record before it) must be fsync-covered before the
+                # client can believe the ticket exists
+                self.journal.append("seated", ticket_id)
+            except Exception as e:
+                # the request is already in flight — its completion
+                # callback releases the admission slot; we just refuse
+                # to ack un-durable work (the client will retry)
+                self._event("net_reject", tenant=tenant,
+                            reason="journal_error")
+                return json_response(
+                    {"error": f"ticket journal unavailable: {e}",
+                     "reason": "journal_error", "tenant": tenant},
+                    status=503)
         snap = self.admission.snapshot().get(tenant, {})
         self._event("net_admit", tenant=tenant, ticket=ticket_id,
                     tier=cfg.tier, priority=priority,
@@ -255,6 +321,44 @@ class NetFront:
         return json_response(
             {"ticket": ticket_id, "tenant": tenant, "priority": priority},
             status=202)
+
+    def _attach(self, net_ticket: _NetTicket, graph: Graph,
+                timeout: float = 0.0) -> None:
+        """Submit ``graph`` under ``net_ticket``'s id and register the
+        ticket: the shared tail of the live submit path and journal
+        replay (the only difference is replay's queue-space timeout —
+        a recovering listener may hold more in-flight tickets than the
+        bounded queue admits at once)."""
+        ticket_id = net_ticket.ticket_id
+
+        def on_attempt(res, val):
+            att = {"k": int(res.k), "status": res.status.name,
+                   "supersteps": int(res.supersteps)}
+            with net_ticket.cond:
+                net_ticket.attempts.append(att)
+                net_ticket.cond.notify_all()
+            self._journal_soft("attempt", ticket_id, **att)
+
+        serve_ticket = self.front.submit(
+            graph.arrays, request_id=ticket_id,
+            timeout=timeout, priority=net_ticket.priority,
+            on_attempt=on_attempt)
+        with self._lock:
+            self._tickets[ticket_id] = net_ticket
+        serve_ticket.add_done_callback(
+            lambda result: self._on_done(net_ticket, result))
+
+    # -- journal plumbing ------------------------------------------------
+    def _journal_soft(self, rec: str, ticket_id: str, **fields) -> None:
+        """Best-effort lifecycle breadcrumb (attempt/delivered/aborted):
+        journal loss here degrades recovery fidelity (a crash replays a
+        little more work) but must never fail the live request path."""
+        if self.journal is None:
+            return
+        try:
+            self.journal.append(rec, ticket_id, durable=False, **fields)
+        except Exception:
+            pass
 
     @staticmethod
     def _reject_response(fields: dict) -> Response:
@@ -269,6 +373,15 @@ class NetFront:
 
     # -- completion (worker thread) --------------------------------------
     def _on_done(self, net_ticket: _NetTicket, result) -> None:
+        # terminal journal record first (durable=False: it rides the
+        # next group commit — a crash inside the window re-runs the
+        # request on recovery, which deterministic engines make
+        # invisible). Colors ride along so a restored ticket's poll
+        # serves the full result without recomputing anything.
+        self._journal_soft(
+            "delivered" if result.status == "ok" else "failed",
+            net_ticket.ticket_id,
+            result=_result_doc(result, with_colors=True))
         with net_ticket.cond:
             net_ticket.result = result
             net_ticket.cond.notify_all()
@@ -388,3 +501,102 @@ class NetFront:
         except ValueError:
             return json_response({"error": "bad request body"}, status=400)
         return json_response(self.drain(timeout=timeout))
+
+    # -- journal recovery (start()) --------------------------------------
+    @staticmethod
+    def _recovered_result(ticket_id: str, doc: dict) -> ServeResult:
+        """Rebuild a pollable :class:`ServeResult` from a journaled
+        terminal record (``_result_doc`` shape, colors included)."""
+        colors = doc.get("colors")
+        return ServeResult(
+            request_id=ticket_id,
+            status=str(doc.get("status", "error")),
+            colors=(np.asarray(colors, np.int32)
+                    if colors is not None else None),
+            minimal_colors=doc.get("minimal_colors"),
+            attempts=[None] * int(doc.get("attempts", 0) or 0),
+            queue_s=float(doc.get("queue_ms", 0.0) or 0.0) / 1e3,
+            service_s=float(doc.get("service_ms", 0.0) or 0.0) / 1e3,
+            batched=bool(doc.get("batched", False)),
+            shape_class=doc.get("shape_class"),
+            error=doc.get("error"))
+
+    def _restore_completed(self, ticket_id: str,
+                           net_ticket: _NetTicket) -> None:
+        with self._lock:
+            self._tickets[ticket_id] = net_ticket
+            self._completed.append(ticket_id)
+
+    def _recover(self) -> None:
+        """Rebuild the ticket table from the journal (module docstring):
+        completed tickets restored pollable, in-flight tickets replayed
+        through the front end under their original ids, the id counter
+        resumed past the high-water mark. Runs on the owner thread
+        before the listener socket opens."""
+        t0 = time.perf_counter()
+        state = scan_journal(self.journal.path)
+        with self._lock:
+            self._next_ticket = max(self._next_ticket,
+                                    state.high_water + 1)
+        restored = replayed = failed = 0
+        for ent in state.tickets:
+            if ent.aborted:
+                continue   # never acked — nothing was promised
+            net_ticket = _NetTicket(ent.ticket, ent.tenant, ent.priority)
+            # pre-publication the ticket is thread-confined, but the
+            # cond is cheap and keeps the lock discipline uniform
+            with net_ticket.cond:
+                net_ticket.attempts = list(ent.attempts)
+            if ent.completed:
+                with net_ticket.cond:
+                    net_ticket.result = self._recovered_result(
+                        ent.ticket, ent.result_doc)
+                self._restore_completed(ent.ticket, net_ticket)
+                restored += 1
+                self._event("net_recover", action="restored",
+                            ticket=ent.ticket, tenant=ent.tenant)
+                continue
+            # in flight at the crash: replay the journaled payload.
+            # Dedup is by ticket id — the id is already allocated below
+            # the resumed counter, so a replay can never collide with a
+            # fresh submit.
+            try:
+                graph = self._load_graph(ent.payload or {})
+                self._attach(net_ticket, graph,
+                             timeout=self.replay_timeout)
+                replayed += 1
+                self._event("net_recover", action="replayed",
+                            ticket=ent.ticket, tenant=ent.tenant)
+            except Exception as e:
+                # payload unparseable or the queue refused past the
+                # replay timeout: the ticket completes as a structured
+                # failure instead of silently vanishing
+                msg = f"journal replay failed: {type(e).__name__}: {e}"
+                with net_ticket.cond:
+                    net_ticket.result = ServeResult(
+                        request_id=ent.ticket, status="error", colors=None,
+                        minimal_colors=None, attempts=[], queue_s=0.0,
+                        service_s=0.0, batched=False, shape_class=None,
+                        error=msg)
+                self._restore_completed(ent.ticket, net_ticket)
+                self._journal_soft("failed", ent.ticket,
+                                   result={"status": "error",
+                                           "error": msg})
+                failed += 1
+                self._event("net_recover", action="replay_failed",
+                            ticket=ent.ticket, tenant=ent.tenant,
+                            error=msg[:200])
+        if self.registry is not None and (restored or replayed or failed):
+            self.registry.counter(
+                "dgc_net_recovered_total",
+                "tickets recovered from the journal on startup",
+                action="restored").inc(restored)
+            self.registry.counter(
+                "dgc_net_recovered_total",
+                "tickets recovered from the journal on startup",
+                action="replayed").inc(replayed)
+        self._event("net_recover", action="summary",
+                    records=state.records, restored=restored,
+                    replayed=replayed, failed=failed,
+                    high_water=state.high_water,
+                    wall_s=round(time.perf_counter() - t0, 4))

@@ -37,8 +37,11 @@ from dgc_tpu.engine.minimal_k import (find_minimal_coloring, make_reducer,
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.obs.metrics import MetricsRegistry
 from dgc_tpu.obs.trace import NULL_TRACER, tracer_for
-from dgc_tpu.resilience.supervisor import RungState, supervise_sweep
-from dgc_tpu.serve.engine import BatchMemberEngine, BatchScheduler, ServeError
+from dgc_tpu.resilience.faults import FaultInjected, fault_point
+from dgc_tpu.resilience.supervisor import (STRUCTURED_ABORT_RC, RungState,
+                                           supervise_sweep)
+from dgc_tpu.serve.engine import (BatchMemberEngine, BatchScheduler,
+                                  PoisonedRequest, ServeError)
 from dgc_tpu.serve.shape_classes import DEFAULT_LADDER, ShapeLadder, pad_member
 
 
@@ -202,6 +205,8 @@ class ServeFrontEnd:
                  validate: bool = True, post_reduce: bool = True,
                  auto_tune: bool = False, tuned_cache=None,
                  retries: int = 0,
+                 max_lane_aborts: int = 3,
+                 dispatch_timeout: float | None = None,
                  fallback_factories=None,
                  logger=None, registry: MetricsRegistry | None = None,
                  rung_state: RungState | None = None):
@@ -239,6 +244,8 @@ class ServeFrontEnd:
                                         stages=stages,
                                         device_carry=device_carry,
                                         tuned_cache=self._tuned_cache,
+                                        max_lane_aborts=max_lane_aborts,
+                                        dispatch_timeout_s=dispatch_timeout,
                                         on_batch=self._on_batch,
                                         on_event=self._on_sched_event,
                                         tracer=self.tracer)
@@ -543,6 +550,16 @@ class ServeFrontEnd:
             self.tracer.push(serve_span)
             try:
                 result = self._serve_one(req)
+                try:
+                    # serve-tier fault plane: the result handoff's
+                    # injection point — a fault here structured-fails
+                    # THIS request with rc context (the worker, the
+                    # loop, and every other request keep going)
+                    fault_point("deliver", request_id=req.request_id)
+                except FaultInjected as e:
+                    result = self._error_result(
+                        req, f"delivery aborted "
+                             f"(rc {STRUCTURED_ABORT_RC}): {e}")
             except Exception as e:
                 result = self._error_result(req, f"{type(e).__name__}: {e}")
             finally:
@@ -621,6 +638,11 @@ class ServeFrontEnd:
                     engine, initial_k=engine.member.k0,
                     validate=validate, on_attempt=on_attempt,
                     post_reduce=post_reduce)
+            except PoisonedRequest:
+                # quarantine is terminal (poison-request policy): the
+                # request structured-fails with its rc context instead
+                # of migrating to the fallback ladder and crashing that
+                raise
             except ServeError:
                 batched = False   # scheduler refused: single-graph path
         if not batched:

@@ -1,0 +1,213 @@
+"""Serve-tier fault plane (crash-safe serve PR): one tier-1 test per
+new injection point — ``serve_dispatch`` / ``lane_seat`` / ``deliver``
+/ ``journal_write`` / ``net_accept`` — each asserting the
+recover-or-structured-abort contract under a seeded ``--inject-faults``
+spec, plus the quarantine and dispatch-watchdog policies, the sync-mode
+requeue path, and a subprocess run of the serve CLI with the flag.
+
+The journal-side points (``journal_write`` / ``net_accept``) and the
+kill-at-journal-boundary resume sweep live in ``tests/test_journal.py``
+beside the journal they exercise."""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import numpy as np
+import pytest
+
+from dgc_tpu.models.graph import Graph
+from dgc_tpu.resilience import faults
+from dgc_tpu.serve.queue import ServeFrontEnd
+from tools.validate_runlog import validate_file
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+pytestmark = [pytest.mark.chaos, pytest.mark.serve]
+
+
+@pytest.fixture(scope="module")
+def graph():
+    # 400 vertices lands in the batched shape ladder (v2048 class), so
+    # the dispatch/seat points are on the real batched path
+    return Graph.generate(400, 5, seed=3, method="fast")
+
+
+@pytest.fixture(scope="module")
+def front(graph, tmp_path_factory):
+    log = tmp_path_factory.mktemp("chaos_serve") / "run.jsonl"
+    from dgc_tpu.obs import RunLogger
+
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    fe = ServeFrontEnd(batch_max=2, workers=2, queue_depth=16,
+                       window_s=0.0, dispatch_timeout=4.0,
+                       max_lane_aborts=3, logger=logger).start()
+    fe._test_log = str(log)
+    # warm the class so the per-test sweeps measure faults, not compiles
+    r = fe.submit(graph.arrays).result(timeout=300)
+    assert r.status == "ok" and r.batched
+    fe._baseline_colors = np.asarray(r.colors).tolist()
+    yield fe
+    fe.shutdown()
+    logger.close()
+    assert validate_file(str(log)) == []
+
+
+def _sweep(front, graph, spec):
+    plane = faults.FaultPlane(faults.FaultSchedule.parse(spec))
+    with faults.injected(plane):
+        res = front.submit(graph.arrays).result(timeout=300)
+    return res, plane.fired_snapshot()
+
+
+def _rebuild_events(front):
+    return [json.loads(ln) for ln in open(front._test_log)
+            if '"lane_rebuild"' in ln]
+
+
+def test_serve_dispatch_transient_recovers_bit_identical(front, graph):
+    before = len(_rebuild_events(front))
+    res, fired = _sweep(front, graph, "serve_dispatch@1=transient")
+    assert fired and res.status == "ok" and res.batched
+    # recovery is invisible in the output: the reseated sweep restarts
+    # from its inputs and the kernel is deterministic
+    assert np.asarray(res.colors).tolist() == front._baseline_colors
+    events = _rebuild_events(front)[before:]
+    assert events and events[0]["reason"] == "abort"
+    assert events[0]["reseated"] == 1 and events[0]["quarantined"] == 0
+
+
+def test_serve_dispatch_poison_quarantined_with_rc(front, graph):
+    res, fired = _sweep(
+        front, graph,
+        "serve_dispatch@1=transient,serve_dispatch@2=oom,"
+        "serve_dispatch@3=fatal")
+    assert len(fired) == 3
+    assert res.status == "error"
+    assert "quarantined" in res.error and "rc 114" in res.error
+    events = _rebuild_events(front)
+    assert any(e["quarantined"] == 1 for e in events)
+
+
+def test_serve_dispatch_hang_watchdog_rebuilds(front, graph):
+    t0 = time.perf_counter()
+    res, fired = _sweep(front, graph, "serve_dispatch@1=hang:30")
+    wall = time.perf_counter() - t0
+    assert fired and res.status == "ok"
+    # the 30s injected hang was cut at the 4s watchdog deadline
+    assert wall < 25.0
+    assert any(e["reason"] == "hang" for e in _rebuild_events(front))
+    assert np.asarray(res.colors).tolist() == front._baseline_colors
+
+
+def test_lane_seat_fault_retries_then_serves(front, graph):
+    res, fired = _sweep(front, graph, "lane_seat@1=oom")
+    assert fired and res.status == "ok"
+    assert np.asarray(res.colors).tolist() == front._baseline_colors
+
+
+def test_deliver_fault_structured_fails_one_request(front, graph):
+    res, fired = _sweep(front, graph, "deliver@1=transient")
+    assert fired and res.status == "error"
+    assert "delivery aborted" in res.error and "rc 114" in res.error
+    # the worker survived: the next request serves clean
+    res2 = front.submit(graph.arrays).result(timeout=300)
+    assert res2.status == "ok"
+    assert np.asarray(res2.colors).tolist() == front._baseline_colors
+
+
+def test_quarantine_stats_and_config_validation(front):
+    st = front.scheduler.stats_snapshot()
+    assert st["rebuilds"] >= 1 and st["quarantined"] >= 1
+    from dgc_tpu.serve.engine import BatchScheduler
+
+    with pytest.raises(ValueError):
+        BatchScheduler(max_lane_aborts=0)
+    with pytest.raises(ValueError):
+        BatchScheduler(dispatch_timeout_s=-1.0)
+
+
+def test_sync_mode_dispatch_fault_requeues(graph):
+    """The sync (batch-complete) loop shares the quarantine policy:
+    a failed pair dispatch requeues survivors at the head."""
+    fe = ServeFrontEnd(batch_max=2, workers=2, queue_depth=8,
+                       window_s=0.0, mode="sync",
+                       max_lane_aborts=3).start()
+    try:
+        plane = faults.FaultPlane(
+            faults.FaultSchedule.parse("serve_dispatch@1=transient"))
+        with faults.injected(plane):
+            res = fe.submit(graph.arrays).result(timeout=300)
+        assert plane.fired_snapshot() and res.status == "ok"
+    finally:
+        fe.shutdown()
+
+
+@pytest.mark.slow
+def test_chaos_serve_harness_smoke(tmp_path):
+    """End-to-end harness smoke: 2 seeded schedules + 1 SIGKILL/resume
+    cycle must exit 0 with a well-formed report (the ci_checks.sh gate
+    runs the slightly larger 3+1 version)."""
+    report = tmp_path / "chaos_serve.json"
+    r = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_serve.py"),
+         "--schedules", "2", "--kills", "1", "--clients", "2",
+         "--requests-per-client", "2", "--nodes", "400", "--degree", "5",
+         "--deadline", "240", "--report", str(report)],
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+        cwd=REPO, capture_output=True, text=True, timeout=560,
+    )
+    assert r.returncode == 0, (r.stdout, r.stderr)
+    summary = json.loads(r.stdout.strip().splitlines()[-1])
+    assert summary["chaos_serve"]["failed"] == 0
+
+    from tools.chaos_serve import validate_chaos_serve_report
+
+    doc = json.loads(report.read_text())
+    assert validate_chaos_serve_report(doc) == []
+    assert doc["kill_resume"]["outcome"] == "ok"
+    assert doc["kill_resume"]["kills"] >= 1
+
+
+def test_serve_cli_inject_faults_flag(tmp_path):
+    """The serve CLI's --inject-faults end to end (replay mode): a
+    deliver fault structured-fails its request, the fault lands in the
+    run log as fault_injected, and the log schema-validates."""
+    reqs = tmp_path / "reqs.jsonl"
+    reqs.write_text(
+        '{"id": 1, "node_count": 30, "max_degree": 3, "seed": 1}\n'
+        '{"id": 2, "node_count": 30, "max_degree": 3, "seed": 2}\n')
+    results = tmp_path / "results.jsonl"
+    log = tmp_path / "run.jsonl"
+    r = subprocess.run(
+        [sys.executable, "-m", "dgc_tpu.cli", "serve",
+         "--requests", str(reqs), "--results", str(results),
+         "--log-json", str(log), "--batch-max", "2",
+         "--inject-faults", "deliver@1=transient"],
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+        cwd=REPO, capture_output=True, text=True, timeout=300,
+    )
+    assert r.returncode == 1, (r.stdout, r.stderr)   # one failed request
+    rows = [json.loads(ln) for ln in results.read_text().splitlines()]
+    failed = [row for row in rows if row["status"] != "ok"]
+    assert len(failed) == 1
+    assert "rc 114" in failed[0]["error"]
+    assert sum(1 for row in rows if row["status"] == "ok") == 1
+    log_lines = log.read_text()
+    assert '"fault_injected"' in log_lines
+    assert validate_file(str(log)) == []
+
+
+def test_bad_inject_faults_spec_exits_2(tmp_path):
+    reqs = tmp_path / "reqs.jsonl"
+    reqs.write_text('{"id": 1, "node_count": 10, "max_degree": 2}\n')
+    r = subprocess.run(
+        [sys.executable, "-m", "dgc_tpu.cli", "serve",
+         "--requests", str(reqs), "--inject-faults", "nonsense"],
+        env={**os.environ, "PYTHONPATH": REPO, "JAX_PLATFORMS": "cpu"},
+        cwd=REPO, capture_output=True, text=True, timeout=120,
+    )
+    assert r.returncode == 2
+    assert "inject-faults" in r.stderr

@@ -349,10 +349,12 @@ def test_tr001_mutation_post_donation_read_is_caught():
 
 def test_tr003_mutation_unwhitelisted_slot_is_caught():
     real = (ROOT / "dgc_tpu/serve/engine.py").read_text()
+    # the forcing transfers live inside the guarded dispatch closure
+    # (crash-safe serve PR), hence the 12-space indent
     mut = real.replace(
-        "        nc = np.asarray(carry[CARRY_NC])",
-        "        nc = np.asarray(carry[CARRY_NC])\n"
-        "        pk = np.asarray(carry[CARRY_PACKED])")
+        "            nc = np.asarray(carry[CARRY_NC])",
+        "            nc = np.asarray(carry[CARRY_NC])\n"
+        "            pk = np.asarray(carry[CARRY_PACKED])")
     assert mut != real
     got = [f for f in _real_transfer(mut) if f.rule == "TR003"]
     assert got and "slot 2" in got[0].detail

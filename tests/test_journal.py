@@ -1,0 +1,449 @@
+"""Durable ticket journal (serve.netfront.journal): append/scan
+round-trips, group-commit durability under concurrent writers, torn-tail
+tolerance, and NetFront's recovery semantics — completed tickets
+restored pollable, in-flight tickets replayed under their original ids,
+the ticket counter resumed past the journal high-water mark (the PR 12
+id-collision regression), and the kill-at-every-journal-boundary resume
+sweep."""
+
+import json
+import subprocess
+import sys
+import threading
+import time
+
+import numpy as np
+import pytest
+
+from dgc_tpu.obs import RunLogger
+from dgc_tpu.serve.netfront import NetFront, TicketJournal, scan_journal
+from dgc_tpu.serve.netfront.journal import JournalError
+from dgc_tpu.serve.queue import ServeFrontEnd, ServeResult
+from tools.validate_runlog import validate_file
+
+pytestmark = pytest.mark.serve
+
+
+# -- no-jax front end (the test_netfront pattern) -----------------------
+
+class _FakeAttempt:
+    class _Status:
+        name = "SUCCESS"
+
+    def __init__(self, k):
+        self.k = int(k)
+        self.status = self._Status()
+        self.supersteps = 5
+
+
+class _InstantFront(ServeFrontEnd):
+    """``_serve_one`` fabricates a deterministic result keyed off the
+    graph's vertex count — recovery replays must reproduce it."""
+
+    def _serve_one(self, req):
+        t0 = time.perf_counter()
+        if req.on_attempt is not None:
+            try:
+                req.on_attempt(_FakeAttempt(3), None)
+            except Exception:
+                pass
+        v = int(req.arrays.num_vertices)
+        return ServeResult(
+            request_id=req.request_id, status="ok",
+            colors=np.arange(v, dtype=np.int32) % 3, minimal_colors=3,
+            attempts=[(3, "SUCCESS", 5)], queue_s=t0 - req.t_submit,
+            service_s=time.perf_counter() - t0,
+            batched=False, shape_class=None)
+
+
+def _post(port, path, doc):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json"}, method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else {})
+
+
+def _get(port, path):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else {})
+
+
+def _poll(port, ticket, timeout=30.0):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        st, doc = _get(port, f"/v1/result/{ticket}?colors=1")
+        if st != 202:
+            return st, doc
+        time.sleep(0.01)
+    raise TimeoutError(f"ticket {ticket} never terminal")
+
+
+def _stack(tmp_path, logger=None, **nf_kw):
+    front = _InstantFront(batch_max=2, workers=2, queue_depth=32,
+                          window_s=0.0, logger=logger).start()
+    nf = NetFront(front, logger=logger,
+                  journal_dir=str(tmp_path / "journal"), **nf_kw).start()
+    return front, nf
+
+
+_SPEC = {"node_count": 24, "max_degree": 3, "seed": 5,
+         "gen_method": "fast"}
+
+
+# -- journal unit -------------------------------------------------------
+
+def test_append_scan_roundtrip(tmp_path):
+    j = TicketJournal(str(tmp_path))
+    j.append("admitted", "t00000000", tenant="a", priority=1,
+             payload=dict(_SPEC))
+    j.append("seated", "t00000000")
+    j.append("attempt", "t00000000", durable=False, k=4,
+             status="SUCCESS", supersteps=7)
+    j.append("delivered", "t00000000", durable=False,
+             result={"status": "ok", "minimal_colors": 3,
+                     "colors": [0, 1, 2], "attempts": 1})
+    j.append("admitted", "t00000003", tenant="b", priority=0,
+             payload=dict(_SPEC))
+    j.close()
+    st = scan_journal(j.path)
+    assert st.records == 5 and st.high_water == 3 and not st.torn
+    done, inflight = st.tickets
+    assert done.completed and done.tenant == "a" and done.priority == 1
+    assert done.result_doc["colors"] == [0, 1, 2]
+    assert done.attempts == [{"k": 4, "status": "SUCCESS",
+                              "supersteps": 7}]
+    assert not inflight.completed and not inflight.aborted
+    assert inflight.payload == _SPEC
+
+
+def test_last_terminal_record_wins_and_aborted_drops(tmp_path):
+    j = TicketJournal(str(tmp_path))
+    j.append("admitted", "t00000000", payload=dict(_SPEC))
+    j.append("failed", "t00000000", result={"status": "error",
+                                            "error": "first"})
+    # a replay after a crash re-delivers: the later record is the truth
+    j.append("delivered", "t00000000", result={"status": "ok",
+                                               "colors": [1]})
+    j.append("admitted", "t00000001", payload=dict(_SPEC))
+    j.append("aborted", "t00000001", reason="queue_full")
+    j.close()
+    st = scan_journal(j.path)
+    assert st.tickets[0].result_doc["status"] == "ok"
+    assert st.tickets[1].aborted
+
+
+def test_torn_tail_tolerated_mid_file_garbage_raises(tmp_path):
+    j = TicketJournal(str(tmp_path))
+    j.append("admitted", "t00000000", payload=dict(_SPEC))
+    j.close()
+    with open(j.path, "ab") as fh:
+        fh.write(b'{"rec": "adm')   # the SIGKILL landed mid-write
+    st = scan_journal(j.path)
+    assert st.torn and st.records == 1
+    # but garbage anywhere ELSE is real corruption, not a torn tail
+    with open(j.path, "ab") as fh:
+        fh.write(b'itted"}\n{"rec": "bogus_type", "ticket": "x"}\n')
+    with pytest.raises(JournalError):
+        scan_journal(j.path)
+
+
+def test_missing_file_is_empty_state(tmp_path):
+    st = scan_journal(str(tmp_path / "journal" / "nope.jsonl"))
+    assert st.records == 0 and st.high_water == -1 and not st.tickets
+
+
+def test_unknown_record_type_rejected(tmp_path):
+    j = TicketJournal(str(tmp_path))
+    with pytest.raises(ValueError):
+        j.append("bogus", "t00000000")
+    j.close()
+
+
+def test_append_after_close_raises(tmp_path):
+    j = TicketJournal(str(tmp_path))
+    j.close()
+    with pytest.raises(JournalError):
+        j.append("admitted", "t00000000")
+
+
+def test_concurrent_durable_appends_group_commit(tmp_path):
+    """8 writers x 25 durable appends: every record on disk once, in
+    valid JSONL, with the written count exact — the group-commit fsync
+    path under the contention the listener actually produces."""
+    j = TicketJournal(str(tmp_path))
+    errors = []
+
+    def writer(w):
+        try:
+            for i in range(25):
+                # seated is a WAL record and durable by default: every
+                # append here waits on (and shares) a group commit
+                j.append("seated", f"t{w:04x}{i:04x}")
+        except Exception as e:   # noqa: BLE001 — surfaced below
+            errors.append(e)
+
+    threads = [threading.Thread(target=writer, args=(w,))
+               for w in range(8)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(timeout=60)
+    assert not errors
+    assert j.records_written() == 200
+    j.close()
+    lines = [ln for ln in open(j.path).read().splitlines() if ln]
+    assert len(lines) == 200
+    assert all(json.loads(ln)["rec"] == "seated" for ln in lines)
+
+
+# -- NetFront recovery --------------------------------------------------
+
+def test_restart_restores_completed_ticket(tmp_path):
+    front, nf = _stack(tmp_path)
+    st, doc = _post(nf.port, "/v1/color", dict(_SPEC))
+    assert st == 202
+    ticket = doc["ticket"]
+    st, first = _poll(nf.port, ticket)
+    assert st == 200 and first["status"] == "ok"
+    nf.close()
+    front.shutdown()
+    # "restart": a fresh process-equivalent over the same journal dir
+    log = tmp_path / "recover.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    front2, nf2 = _stack(tmp_path, logger=logger)
+    st, again = _get(nf2.port, f"/v1/result/{ticket}?colors=1")
+    assert st == 200
+    assert again["colors"] == first["colors"]
+    assert again["minimal_colors"] == first["minimal_colors"]
+    assert again["attempts"] == first["attempts"]
+    nf2.close()
+    front2.shutdown()
+    logger.close()
+    recs = [json.loads(ln) for ln in open(log)
+            if '"net_recover"' in ln]
+    assert [r["action"] for r in recs] == ["restored", "summary"]
+    assert recs[-1]["restored"] == 1 and recs[-1]["replayed"] == 0
+    assert validate_file(str(log)) == []
+
+
+def test_restart_replays_in_flight_ticket(tmp_path):
+    """A ticket journaled admitted+seated but never delivered (the
+    crash window) is replayed through submit under its ORIGINAL id."""
+    j = TicketJournal(str(tmp_path / "journal"))
+    j.append("admitted", "t00000007", tenant="x", priority=0,
+             payload=dict(_SPEC))
+    j.append("seated", "t00000007")
+    j.close()
+    log = tmp_path / "replay.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    front, nf = _stack(tmp_path, logger=logger)
+    st, doc = _poll(nf.port, "t00000007")
+    assert st == 200 and doc["status"] == "ok"
+    assert doc["colors"] == [i % 3 for i in range(_SPEC["node_count"])]
+    nf.close()
+    front.shutdown()
+    logger.close()
+    recs = [json.loads(ln) for ln in open(log) if '"net_recover"' in ln]
+    assert [r["action"] for r in recs] == ["replayed", "summary"]
+    assert validate_file(str(log)) == []
+
+
+def test_restart_never_reuses_ticket_ids(tmp_path):
+    """The PR 12 collision regression: the counter reset to 0 on every
+    process start (listener.py's ``_next_ticket``), so a restarted
+    listener re-issued live ids. Seeded from the journal high-water
+    mark, a new submit must mint an id ABOVE every journaled one."""
+    j = TicketJournal(str(tmp_path / "journal"))
+    j.append("admitted", "t0000000f", payload=dict(_SPEC))
+    j.append("delivered", "t0000000f", durable=False,
+             result={"status": "ok", "colors": [0], "attempts": 1})
+    j.close()
+    front, nf = _stack(tmp_path)
+    st, doc = _post(nf.port, "/v1/color", dict(_SPEC))
+    assert st == 202
+    assert doc["ticket"] == "t00000010"   # high water 0xf -> next 0x10
+    # and the journaled ticket is still resolvable, not clobbered
+    st, old = _get(nf.port, "/v1/result/t0000000f")
+    assert st == 200 and old["status"] == "ok"
+    nf.close()
+    front.shutdown()
+
+
+def test_replay_failure_is_structured_not_silent(tmp_path):
+    """An admitted record whose payload cannot be replayed (garbage
+    spec) completes as a structured failure — pollable, never lost."""
+    j = TicketJournal(str(tmp_path / "journal"))
+    j.append("admitted", "t00000000", payload={"nonsense": True})
+    j.close()
+    log = tmp_path / "fail.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    front, nf = _stack(tmp_path, logger=logger)
+    st, doc = _get(nf.port, "/v1/result/t00000000")
+    assert st == 200
+    assert doc["status"] == "error"
+    assert "journal replay failed" in doc["error"]
+    nf.close()
+    front.shutdown()
+    logger.close()
+    recs = [json.loads(ln) for ln in open(log) if '"net_recover"' in ln]
+    assert [r["action"] for r in recs] == ["replay_failed", "summary"]
+    assert validate_file(str(log)) == []
+
+
+def test_aborted_tickets_are_not_replayed(tmp_path):
+    j = TicketJournal(str(tmp_path / "journal"))
+    j.append("admitted", "t00000000", payload=dict(_SPEC))
+    j.append("aborted", "t00000000", reason="queue_full")
+    j.close()
+    front, nf = _stack(tmp_path)
+    st, _doc = _get(nf.port, "/v1/result/t00000000")
+    assert st == 404   # never acked, so nothing was promised
+    nf.close()
+    front.shutdown()
+
+
+def test_kill_at_every_journal_boundary_resumes(tmp_path):
+    """The kill-at-journal-boundary resume sweep: truncate a real
+    session's journal after EVERY record boundary, recover a fresh
+    stack over the prefix, and assert every acked ticket is either
+    restored (terminal record in the prefix) or replayed to the same
+    deterministic result — and that fresh ids never collide."""
+    front, nf = _stack(tmp_path)
+    tickets = []
+    for i in range(2):
+        st, doc = _post(nf.port, "/v1/color",
+                        dict(_SPEC, seed=i, node_count=12 + i))
+        assert st == 202
+        tickets.append(doc["ticket"])
+    expected = {}
+    for t in tickets:
+        st, doc = _poll(nf.port, t)
+        assert st == 200
+        expected[t] = doc["colors"]
+    nf.close()
+    front.shutdown()
+    journal_path = tmp_path / "journal" / "ticket_journal.jsonl"
+    results_path = tmp_path / "journal" / "ticket_results.jsonl"
+    lines = journal_path.read_bytes().splitlines(keepends=True)
+    assert len(lines) >= 4   # 2x (admitted, seated) in the WAL
+    for boundary in range(1, len(lines) + 1):
+        bdir = tmp_path / f"b{boundary}"
+        (bdir / "journal").mkdir(parents=True)
+        (bdir / "journal" / "ticket_journal.jsonl").write_bytes(
+            b"".join(lines[:boundary]))
+        # the results log survives whole (its records for tickets not
+        # yet in the WAL prefix must be ignored by the scan)
+        (bdir / "journal" / "ticket_results.jsonl").write_bytes(
+            results_path.read_bytes())
+        f2, n2 = _stack(bdir)
+        try:
+            state = scan_journal(str(bdir / "journal"
+                                     / "ticket_journal.jsonl"))
+            for ent in state.tickets:
+                if ent.aborted:
+                    continue
+                st, doc = _poll(n2.port, ent.ticket)
+                assert st == 200, (boundary, ent.ticket)
+                if doc["status"] == "ok":
+                    assert doc["colors"] == expected[ent.ticket], \
+                        (boundary, ent.ticket)
+            # fresh ids stay above everything in the prefix
+            st, doc = _post(n2.port, "/v1/color", dict(_SPEC))
+            assert st == 202
+            assert int(doc["ticket"][1:], 16) > state.high_water
+        finally:
+            n2.close()
+            f2.shutdown()
+
+
+def test_journal_write_fault_rejects_structured(tmp_path):
+    """An injected journal_write fault on the admitted record answers
+    503 journal_error — no ack without durability — and the next
+    attempt (fault consumed) is accepted and served."""
+    from dgc_tpu.resilience import faults
+
+    front, nf = _stack(tmp_path)
+    plane = faults.FaultPlane(
+        faults.FaultSchedule.parse("journal_write@1=transient"))
+    with faults.injected(plane):
+        st, doc = _post(nf.port, "/v1/color", dict(_SPEC))
+        assert st == 503 and doc["reason"] == "journal_error"
+        st, doc = _post(nf.port, "/v1/color", dict(_SPEC))
+        assert st == 202
+    st, res = _poll(nf.port, doc["ticket"])
+    assert st == 200 and res["status"] == "ok"
+    # the rejected attempt journaled nothing acked: recovery must not
+    # resurrect it
+    nf.close()
+    front.shutdown()
+    state = scan_journal(str(tmp_path / "journal"
+                             / "ticket_journal.jsonl"))
+    assert [e.ticket for e in state.tickets if not e.aborted] \
+        == [doc["ticket"]]
+
+
+def test_net_accept_fault_rejects_structured(tmp_path):
+    from dgc_tpu.resilience import faults
+
+    front, nf = _stack(tmp_path)
+    plane = faults.FaultPlane(
+        faults.FaultSchedule.parse("net_accept@1=fatal"))
+    with faults.injected(plane):
+        st, doc = _post(nf.port, "/v1/color", dict(_SPEC))
+        assert st == 503 and doc["reason"] == "listener_fault"
+        st, doc = _post(nf.port, "/v1/color", dict(_SPEC))
+        assert st == 202
+    st, res = _poll(nf.port, doc["ticket"])
+    assert st == 200 and res["status"] == "ok"
+    nf.close()
+    front.shutdown()
+
+
+def test_no_journal_flag_means_no_journal_side_effects(tmp_path):
+    """All-flags-unset contract: without journal_dir nothing is written
+    anywhere and the table is memory-only (the PR 12 behavior)."""
+    front = _InstantFront(batch_max=2, workers=2, queue_depth=8,
+                          window_s=0.0).start()
+    nf = NetFront(front).start()
+    assert nf.journal is None
+    st, doc = _post(nf.port, "/v1/color", dict(_SPEC))
+    assert st == 202
+    _poll(nf.port, doc["ticket"])
+    nf.close()
+    front.shutdown()
+    assert list(tmp_path.iterdir()) == []
+
+
+def test_scan_is_idempotent_across_double_restart(tmp_path):
+    """Restart-of-a-restart: records appended by recovery itself
+    (replayed delivery) fold cleanly on the NEXT recovery."""
+    j = TicketJournal(str(tmp_path / "journal"))
+    j.append("admitted", "t00000002", payload=dict(_SPEC))
+    j.append("seated", "t00000002")
+    j.close()
+    for _round in range(2):
+        front, nf = _stack(tmp_path)
+        st, doc = _poll(nf.port, "t00000002")
+        assert st == 200 and doc["status"] == "ok"
+        nf.close()
+        front.shutdown()
+    state = scan_journal(str(tmp_path / "journal"
+                             / "ticket_journal.jsonl"))
+    # one ticket, completed; round 2 restored instead of re-replaying
+    assert len(state.tickets) == 1 and state.tickets[0].completed

@@ -246,6 +246,64 @@ def test_queue_full_carries_structured_context():
         fe.shutdown()
 
 
+def test_retry_after_tracks_service_time_ewma():
+    """QueueFull.retry_after_s under sustained overload: the hint is
+    queue length x the service-time EWMA / workers, so it must GROW as
+    queue residence time grows (slow service feeding the EWMA) and fall
+    back after a drain lets fast completions pull the estimate down —
+    the adaptive half of the 429 Retry-After contract."""
+    fe = _InstantFront(batch_max=1, workers=1, queue_depth=4,
+                       window_s=0.0, service_delay=0.01).start()
+
+    class _A:
+        num_vertices = 4
+        max_degree = 2
+
+    def overload():
+        tickets = []
+        deadline = time.perf_counter() + 20
+        while time.perf_counter() < deadline:
+            try:
+                tickets.append(fe.submit(_A()))
+            except QueueFull as e:
+                return tickets, e.retry_after_s
+            time.sleep(0.001)
+        pytest.fail("queue never filled")
+
+    def drain(tickets):
+        for t in tickets:
+            assert t.result(timeout=60) is not None
+
+    def seed(n):
+        # seeding submits wait for queue space (timeout) — only the
+        # overload() probes are supposed to shed
+        drain([fe.submit(_A(), timeout=30.0) for _ in range(n)])
+
+    try:
+        # fast service seeds a small EWMA; the first shed's hint is tiny
+        seed(3)
+        tickets, fast_hint = overload()
+        drain(tickets)
+        # sustained overload at 25x the service time: residence grows,
+        # the EWMA follows, the hint grows with it
+        fe._service_delay = 0.25
+        seed(3)
+        tickets, slow_hint = overload()
+        drain(tickets)
+        assert slow_hint > fast_hint
+        # after the drain, fast completions reset the estimate back down
+        fe._service_delay = 0.01
+        seed(8)
+        tickets, reset_hint = overload()
+        drain(tickets)
+        assert reset_hint < slow_hint
+        # hints always stay inside the clamp the 429 path advertises
+        for hint in (fast_hint, slow_hint, reset_hint):
+            assert 0.05 <= hint <= 30.0
+    finally:
+        fe.shutdown()
+
+
 # -- the HTTP surface ---------------------------------------------------
 
 def _net(front=None, tenants=None, registry=None, logger=None, **nf_kw):

@@ -1,0 +1,710 @@
+#!/usr/bin/env python
+"""Chaos harness for the crash-safe serve tier: seeded serve-point fault
+schedules + SIGKILL/resume cycles over the durable ticket journal.
+
+The serve-tier analogue of ``tools/chaos_sweep.py``. Two legs, one
+report:
+
+**Leg 1 — seeded serve-point schedules (in-process).** ``--schedules N``
+runs of a full serving stack (``ServeFrontEnd`` + admission + the
+``NetFront`` listener + ticket journal), each under a deterministic
+:meth:`FaultSchedule.random_serve` draw; a round-robin ``must_cover``
+guarantees every serve injection point (``serve_dispatch``,
+``lane_seat``, ``deliver``, ``journal_write``, ``net_accept``) is
+exercised. The invariant per schedule:
+
+    every accepted (202) ticket reaches a terminal result — either
+    ``ok`` with colors **bit-identical to the fault-free run** of the
+    same request, or a STRUCTURED failure carrying rc context (the
+    quarantine / delivery-abort / journal-error paths) — within the
+    harness deadline. Never a hang, never a silently wrong coloring,
+    never a lost or duplicated ticket. The run log schema-validates.
+
+**Leg 2 — kill-resume soak (real processes).** The serve CLI
+(``dgc-tpu serve --listen --journal-dir``) runs as a subprocess; N
+concurrent clients submit generator-spec requests and poll through
+restarts. A watcher thread SIGKILLs the server whenever the journal
+crosses the next of ``--kills`` seeded record offsets (drawn against
+the fault-free run's journal length); the harness restarts it — same
+command, same ``--journal-dir`` — the way a rolling-restart supervisor
+would. Asserted at the end:
+
+    zero acked-ticket loss (every 202 polls to a terminal 200 after the
+    last restart), zero duplicate ticket ids across ALL incarnations
+    (the high-water-mark seeding), no duplicate deliveries (a ticket's
+    result is stable across repeated polls), and every replayed
+    request's colors byte-identical to the fault-free baseline.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_serve.py --schedules 5 --kills 3 \\
+        --clients 8 --requests-per-client 2 --nodes 500 --degree 6 \\
+        --report /tmp/chaos_serve.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import http.client
+import json
+import os
+import random
+import shutil
+import signal
+import socket
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from dgc_tpu.resilience.faults import SERVE_POINTS, FaultSchedule  # noqa: E402
+from tools.validate_runlog import validate_file  # noqa: E402
+
+CHAOS_SERVE_REPORT_VERSION = 1
+
+_OUTCOMES = ("ok", "structured", "hang", "error", "mismatch")
+
+
+# ---------------------------------------------------------------------------
+# shared HTTP plumbing (retries across restarts)
+# ---------------------------------------------------------------------------
+
+def _http(method: str, port: int, path: str, doc=None, tenant=None,
+          retries: int = 120, deadline_s: float = 240.0):
+    """One request, retried through connection failures (the server may
+    be dead between a SIGKILL and its restart) with capped backoff.
+    Returns (status, body_doc)."""
+    body = json.dumps(doc).encode() if doc is not None else None
+    headers = {"Content-Type": "application/json"}
+    if tenant:
+        headers["X-Dgc-Tenant"] = tenant
+    t_end = time.perf_counter() + deadline_s
+    last = None
+    for attempt in range(retries):
+        if time.perf_counter() > t_end:
+            break
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+            return resp.status, (json.loads(payload) if payload else {})
+        except (OSError, http.client.HTTPException,
+                json.JSONDecodeError) as e:
+            last = e
+            time.sleep(min(1.0, 0.05 * (attempt + 1)))
+        finally:
+            try:
+                conn.close()
+            except OSError:
+                pass
+    raise RuntimeError(f"server unreachable on :{port}: {last}")
+
+
+def _request_doc(nodes: int, degree: int, seed: int) -> dict:
+    return {"node_count": nodes, "max_degree": degree, "seed": seed,
+            "gen_method": "fast"}
+
+
+# ---------------------------------------------------------------------------
+# leg 1: in-process seeded serve-point schedules
+# ---------------------------------------------------------------------------
+
+def _stand_stack(workdir: str, args, logger):
+    """One in-process serving stack over a fresh journal dir."""
+    from dgc_tpu.serve.netfront import NetFront
+    from dgc_tpu.serve.queue import ServeFrontEnd
+
+    front = ServeFrontEnd(
+        batch_max=args.batch_max, window_s=0.0,
+        queue_depth=max(64, args.clients * args.requests_per_client * 2),
+        dispatch_timeout=args.dispatch_timeout,
+        max_lane_aborts=args.max_lane_aborts,
+        logger=logger).start()
+    nf = NetFront(front, logger=logger,
+                  journal_dir=os.path.join(workdir, "journal")).start()
+    return front, nf
+
+
+def _drive_requests(port: int, reqs: list, deadline_s: float):
+    """Submit every request doc then poll each accepted ticket to a
+    terminal result. Returns (tickets, results, rejects, errors):
+    ``results[ticket]`` is the final 200 body (colors included)."""
+    tickets: list = []
+    rejects = 0
+    errors: list = []
+    for doc in reqs:
+        accepted = False
+        for _ in range(60):
+            st, body = _http("POST", port, "/v1/color", doc,
+                             deadline_s=deadline_s)
+            if st == 202:
+                tickets.append(body["ticket"])
+                accepted = True
+                break
+            if st in (429, 503):
+                rejects += 1
+                time.sleep(0.05)
+                continue
+            errors.append(f"submit HTTP {st}: {body}")
+            break
+        if not accepted and not errors:
+            errors.append("submit never accepted")
+    results: dict = {}
+    t_end = time.perf_counter() + deadline_s
+    for ticket in tickets:
+        while True:
+            if time.perf_counter() > t_end:
+                errors.append(f"poll deadline for {ticket}")
+                break
+            st, body = _http("GET", port, f"/v1/result/{ticket}?colors=1",
+                             deadline_s=deadline_s)
+            if st == 200:
+                results[ticket] = body
+                break
+            if st == 202:
+                time.sleep(0.02)
+                continue
+            errors.append(f"poll {ticket} HTTP {st}")
+            break
+    return tickets, results, rejects, errors
+
+
+def _baseline_colors(args, reqs: list) -> dict:
+    """Fault-free in-process run: request seed -> colors (the
+    bit-identity reference for both legs)."""
+    from dgc_tpu.obs import RunLogger
+
+    workdir = tempfile.mkdtemp(prefix="dgc_chaos_serve_base_")
+    logger = RunLogger(jsonl_path=None, echo=False)
+    front, nf = _stand_stack(workdir, args, logger)
+    try:
+        _tickets, results, _rej, errors = _drive_requests(
+            nf.port, reqs, args.deadline)
+        if errors:
+            raise RuntimeError(f"fault-free baseline failed: {errors[:3]}")
+        by_seed = {}
+        for doc in results.values():
+            if doc.get("status") != "ok":
+                raise RuntimeError(f"fault-free baseline non-ok: {doc}")
+        # map ticket order back to request order (tickets are issued in
+        # submit order and _drive_requests submits sequentially)
+        for req, ticket in zip(reqs, _tickets):
+            by_seed[req["seed"]] = results[ticket]["colors"]
+        return by_seed
+    finally:
+        nf.close()
+        front.shutdown()
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
+_STRUCTURED_MARKERS = ("rc 114", "quarantined", "delivery aborted",
+                       "journal replay failed")
+
+
+def _run_schedule(index: int, args, reqs: list, baseline: dict) -> dict:
+    """One seeded schedule against a fresh stack; returns the report
+    entry."""
+    from dgc_tpu.obs import RunLogger
+    from dgc_tpu.resilience import faults
+
+    rng = random.Random(args.seed * 61_001 + index)
+    must = SERVE_POINTS[index % len(SERVE_POINTS)]
+    schedule = FaultSchedule.random_serve(
+        rng, n_faults=rng.randint(1, args.max_faults), must_cover=must,
+        hang_seconds=min(2.0, args.dispatch_timeout + 0.5))
+    spec = schedule.to_spec()
+    entry = {"index": index, "spec": spec, "must_cover": must,
+             "fired": 0, "log_problems": 0, "outcome": "error"}
+    workdir = tempfile.mkdtemp(prefix="dgc_chaos_serve_")
+    log = os.path.join(workdir, "run.jsonl")
+    logger = RunLogger(jsonl_path=log, echo=False)
+    plane = faults.FaultPlane(schedule)
+    front = nf = None
+    try:
+        with faults.injected(plane):
+            front, nf = _stand_stack(workdir, args, logger)
+            tickets, results, rejects, errors = _drive_requests(
+                nf.port, reqs, args.deadline)
+        entry["fired"] = len(plane.fired_snapshot())
+        entry["rejects"] = rejects
+        if len(set(tickets)) != len(tickets):
+            errors.append("duplicate ticket ids")
+        structured = 0
+        mismatched = 0
+        for req, ticket in zip(reqs, tickets):
+            doc = results.get(ticket)
+            if doc is None:
+                continue   # already accounted as a poll error
+            if doc.get("status") == "ok":
+                if doc.get("colors") != baseline[req["seed"]]:
+                    mismatched += 1
+            elif any(m in (doc.get("error") or "")
+                     for m in _STRUCTURED_MARKERS):
+                structured += 1
+            else:
+                errors.append(f"unstructured failure: {doc.get('error')}")
+        entry["structured"] = structured
+        if os.path.exists(log):
+            entry["log_problems"] = len(validate_file(log))
+        if mismatched:
+            entry["outcome"] = "mismatch"
+        elif errors or entry["log_problems"] or len(results) != len(tickets):
+            entry["outcome"] = "error"
+            entry["errors"] = errors[:5]
+        else:
+            entry["outcome"] = "structured" if structured else "ok"
+    except RuntimeError as e:
+        entry["outcome"] = "hang" if "unreachable" in str(e) else "error"
+        entry["errors"] = [str(e)[:300]]
+    finally:
+        if nf is not None:
+            nf.close()
+        if front is not None:
+            front.shutdown()
+        logger.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# leg 2: SIGKILL at seeded journal offsets, restart, resume
+# ---------------------------------------------------------------------------
+
+def _free_port() -> int:
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    return port
+
+
+class _Server:
+    """The serve CLI as a managed subprocess (one incarnation)."""
+
+    def __init__(self, port: int, journal_dir: str, log_path: str, args):
+        self.args = [sys.executable, "-m", "dgc_tpu.cli", "serve",
+                     "--listen", str(port), "--journal-dir", journal_dir,
+                     "--log-json", log_path,
+                     "--batch-max", str(args.batch_max),
+                     "--queue-depth",
+                     str(max(64, args.clients
+                             * args.requests_per_client * 2)),
+                     "--window-ms", "0",
+                     "--dispatch-timeout", str(args.dispatch_timeout),
+                     "--max-lane-aborts", str(args.max_lane_aborts)]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            self.args, env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self.port = port
+
+    def wait_ready(self, deadline_s: float = 120.0) -> None:
+        t_end = time.perf_counter() + deadline_s
+        while time.perf_counter() < t_end:
+            if self.proc.poll() is not None:
+                raise RuntimeError(
+                    f"server exited rc {self.proc.returncode} "
+                    f"before ready")
+            try:
+                st, _doc = _http("GET", self.port, "/healthz", retries=1,
+                                 deadline_s=5.0)
+                if st == 200:
+                    return
+            except RuntimeError:
+                pass
+            time.sleep(0.1)
+        raise RuntimeError("server never became ready")
+
+    def sigkill(self) -> None:
+        self.proc.send_signal(signal.SIGKILL)
+        self.proc.wait(timeout=30)
+
+
+def _journal_records(path: str) -> int:
+    try:
+        with open(path, "rb") as fh:
+            return fh.read().count(b"\n")
+    except OSError:
+        return 0
+
+
+def _run_kill_resume(args, reqs: list, baseline: dict) -> dict:
+    """The kill-resume soak: drive clients, SIGKILL at seeded journal
+    offsets, restart over the same journal, assert nothing acked was
+    lost and every color matches the fault-free run."""
+    from dgc_tpu.serve.netfront.journal import JOURNAL_FILE
+
+    workdir = args.workdir or tempfile.mkdtemp(prefix="dgc_chaos_kill_")
+    os.makedirs(workdir, exist_ok=True)
+    journal_dir = os.path.join(workdir, "journal")
+    journal_path = os.path.join(journal_dir, JOURNAL_FILE)
+    port = _free_port()
+    entry = {"kills_planned": int(args.kills), "kills": 0, "restarts": 0,
+             "incarnations": 1, "outcome": "error", "log_problems": 0}
+    errors: list = []
+
+    # seed the kill offsets against the expected WAL record count: 2
+    # records per request (admitted + seated; results ride a separate
+    # file) — the exact rhythm doesn't matter, only that the offsets
+    # land mid-soak and are the same for every run of the same --seed
+    expect = max(6, 2 * len(reqs))
+    rng = random.Random(args.seed * 93_077 + 17)
+    hi = max(4, expect - 2)
+    offsets = sorted(rng.sample(range(2, hi),
+                                min(args.kills, hi - 2)))
+    entry["offsets"] = offsets
+
+    logs = [os.path.join(workdir, "server_0.jsonl")]
+    server = _Server(port, journal_dir, logs[0], args)
+    stop_watch = threading.Event()
+    kills_done = []
+
+    def watcher():
+        """SIGKILL the current incarnation as the journal crosses each
+        seeded record offset."""
+        pending = list(offsets)
+        while pending and not stop_watch.is_set():
+            n = _journal_records(journal_path)
+            if n >= pending[0]:
+                pending.pop(0)
+                try:
+                    server_box["server"].sigkill()
+                except Exception as e:   # noqa: BLE001 — accounting
+                    errors.append(f"kill failed: {e}")
+                    return
+                kills_done.append(n)
+            time.sleep(0.005)
+
+    # the restart supervisor: whatever kills the server (the watcher's
+    # SIGKILLs), bring it back over the SAME journal dir — the
+    # rolling-restart operator loop, automated
+    server_box = {"server": server}
+    stop_sup = threading.Event()
+
+    def supervisor():
+        while not stop_sup.is_set():
+            srv = server_box["server"]
+            if srv.proc.poll() is not None:
+                entry["restarts"] += 1
+                logs.append(os.path.join(
+                    workdir, f"server_{entry['restarts']}.jsonl"))
+                nxt = _Server(port, journal_dir, logs[-1], args)
+                try:
+                    nxt.wait_ready()
+                except RuntimeError as e:
+                    errors.append(f"restart failed: {e}")
+                    stop_sup.set()
+                server_box["server"] = nxt
+            time.sleep(0.02)
+
+    # concurrent clients: each submits its requests then polls its own
+    # tickets to terminal results, riding _http's reconnect loop through
+    # every kill window
+    tickets: list = []
+    ticket_of: dict = {}
+    results: dict = {}
+    acct = threading.Lock()
+
+    def client(reqs_slice):
+        mine = []
+        for doc in reqs_slice:
+            t_end = time.perf_counter() + args.deadline
+            while time.perf_counter() < t_end:
+                try:
+                    st, body = _http("POST", port, "/v1/color", doc,
+                                     retries=8, deadline_s=30.0)
+                except RuntimeError:
+                    continue   # server down: supervisor is on it
+                if st == 202:
+                    with acct:
+                        tickets.append(body["ticket"])
+                        ticket_of[body["ticket"]] = doc
+                    mine.append(body["ticket"])
+                    break
+                if st in (429, 503):
+                    time.sleep(0.05)
+                    continue
+                with acct:
+                    errors.append(f"submit HTTP {st}: {body}")
+                break
+        for ticket in mine:
+            t_end = time.perf_counter() + args.deadline
+            while time.perf_counter() < t_end:
+                try:
+                    st, body = _http(
+                        "GET", port, f"/v1/result/{ticket}?colors=1",
+                        retries=8, deadline_s=30.0)
+                except RuntimeError:
+                    continue
+                if st == 200:
+                    with acct:
+                        results[ticket] = body
+                    break
+                if st == 202:
+                    time.sleep(0.02)
+                    continue
+                with acct:
+                    if st == 404:
+                        errors.append(f"acked ticket {ticket} LOST (404)")
+                        results[ticket] = {"status": "lost"}
+                    else:
+                        errors.append(f"poll {ticket} HTTP {st}")
+                        results[ticket] = {"status": f"http {st}"}
+                break
+            else:
+                with acct:
+                    errors.append(f"poll deadline for {ticket}")
+
+    try:
+        server.wait_ready()
+        watch = threading.Thread(target=watcher, daemon=True)
+        watch.start()
+        sup = threading.Thread(target=supervisor, daemon=True)
+        sup.start()
+        per = max(1, args.requests_per_client)
+        slices = [reqs[i:i + per] for i in range(0, len(reqs), per)]
+        threads = [threading.Thread(target=client, args=(s,), daemon=True)
+                   for s in slices]
+        for t in threads:
+            t.start()
+        deadline = time.perf_counter() + args.deadline
+        for t in threads:
+            t.join(timeout=max(1.0, deadline - time.perf_counter()))
+            if t.is_alive():
+                errors.append("client thread past deadline (hang)")
+        stop_watch.set()
+        stop_sup.set()
+        sup.join(timeout=10)
+        server = server_box["server"]
+        if server.proc.poll() is not None:
+            # the last kill landed after the supervisor stopped: one
+            # final operator restart so the end-state asserts can run
+            entry["restarts"] += 1
+            logs.append(os.path.join(
+                workdir, f"server_{entry['restarts']}.jsonl"))
+            server = _Server(port, journal_dir, logs[-1], args)
+            server.wait_ready()
+        entry["kills"] = len(kills_done)
+        entry["incarnations"] = entry["restarts"] + 1
+
+        # -- the invariants ---------------------------------------------
+        if len(set(tickets)) != len(tickets):
+            errors.append("duplicate ticket ids across incarnations")
+        mismatched = 0
+        for ticket, doc in results.items():
+            if doc.get("status") != "ok":
+                errors.append(f"{ticket}: non-ok terminal {doc.get('status')}"
+                              f" ({doc.get('error')})")
+            elif doc.get("colors") != baseline[ticket_of[ticket]["seed"]]:
+                mismatched += 1
+        # duplicate-delivery check: re-polling a delivered ticket (on
+        # the final incarnation — possibly across a replay) must
+        # converge to the SAME colors, never a second different result
+        for ticket in tickets[: min(4, len(tickets))]:
+            if results.get(ticket, {}).get("status") != "ok":
+                continue
+            t_end = time.perf_counter() + 60.0
+            while time.perf_counter() < t_end:
+                st, again = _http("GET", port,
+                                  f"/v1/result/{ticket}?colors=1",
+                                  retries=8, deadline_s=30.0)
+                if st == 202:   # replayed after the final restart
+                    time.sleep(0.05)
+                    continue
+                if st != 200 or again.get("colors") != results[ticket].get(
+                        "colors"):
+                    errors.append(f"{ticket}: unstable result across "
+                                  f"polls (HTTP {st})")
+                break
+        # graceful exit: drain, then the CLI loop ends on its own
+        try:
+            _http("POST", port, "/admin/drain", {}, retries=8,
+                  deadline_s=60.0)
+            server.proc.wait(timeout=60)
+        except (RuntimeError, subprocess.TimeoutExpired):
+            server.proc.kill()
+        # every incarnation's log must schema-validate (spans torn by
+        # the SIGKILL are tolerated per the flight-recorder convention:
+        # only the LAST line may be torn; unclosed spans in killed
+        # incarnations are expected, so spans are checked only on logs
+        # whose process exited cleanly — the final one)
+        if os.path.exists(logs[-1]):
+            entry["log_problems"] = len(validate_file(logs[-1]))
+        if mismatched:
+            entry["outcome"] = "mismatch"
+        elif errors or entry["log_problems"]:
+            entry["outcome"] = "error"
+            entry["errors"] = errors[:8]
+        else:
+            entry["outcome"] = "ok"
+        return entry
+    except RuntimeError as e:
+        entry["outcome"] = "hang" if "unreachable" in str(e) \
+            or "never became ready" in str(e) else "error"
+        entry["errors"] = [str(e)[:300]]
+        return entry
+    finally:
+        stop_watch.set()
+        stop_sup.set()
+        srv = server_box["server"]
+        if srv.proc.poll() is None:
+            srv.proc.kill()
+        if not args.keep_workdir and args.workdir is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def validate_chaos_serve_report(doc) -> list[str]:
+    """Structural check (the chaos_sweep convention: list of problems,
+    empty = well-formed)."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return ["report is not an object"]
+    if doc.get("chaos_serve_report_version") != CHAOS_SERVE_REPORT_VERSION:
+        problems.append("missing/wrong chaos_serve_report_version")
+    if not isinstance(doc.get("config"), dict):
+        problems.append("missing config object")
+    schedules = doc.get("schedules")
+    if not isinstance(schedules, list):
+        problems.append("missing schedules list")
+        schedules = []
+    for i, s in enumerate(schedules):
+        for fieldname, ty in (("index", int), ("spec", str),
+                              ("outcome", str), ("must_cover", str)):
+            if not isinstance(s.get(fieldname), ty):
+                problems.append(
+                    f"schedules[{i}]: missing/invalid {fieldname!r}")
+        if s.get("outcome") not in _OUTCOMES:
+            problems.append(
+                f"schedules[{i}]: unknown outcome {s.get('outcome')!r}")
+    kr = doc.get("kill_resume")
+    if kr is not None:
+        for fieldname in ("kills_planned", "kills", "restarts"):
+            if not isinstance(kr.get(fieldname), int):
+                problems.append(f"kill_resume: missing/invalid "
+                                f"{fieldname!r}")
+        if kr.get("outcome") not in _OUTCOMES:
+            problems.append(
+                f"kill_resume: unknown outcome {kr.get('outcome')!r}")
+    summary = doc.get("summary")
+    if not isinstance(summary, dict):
+        problems.append("missing summary object")
+    else:
+        for fieldname in ("total", "ok", "structured", "failed"):
+            if not isinstance(summary.get(fieldname), int):
+                problems.append(f"summary: missing/invalid {fieldname!r}")
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--schedules", type=int, default=10,
+                   help="seeded in-process serve-point schedules (a "
+                        "round-robin must_cover guarantees every point)")
+    p.add_argument("--kills", type=int, default=3,
+                   help="SIGKILL/restart cycles at seeded journal "
+                        "offsets (0 skips the kill-resume leg)")
+    p.add_argument("--clients", type=int, default=4,
+                   help="request streams (requests are submitted "
+                        "sequentially; concurrency comes from the serve "
+                        "tier itself)")
+    p.add_argument("--requests-per-client", type=int, default=2)
+    p.add_argument("--nodes", type=int, default=500,
+                   help="vertices per generated request (>=~300 lands "
+                        "in the batched shape ladder so the dispatch "
+                        "points are exercised)")
+    p.add_argument("--degree", type=int, default=6)
+    p.add_argument("--batch-max", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0,
+                   help="master seed: schedules AND kill offsets derive "
+                        "from it deterministically")
+    p.add_argument("--max-faults", type=int, default=3)
+    p.add_argument("--dispatch-timeout", type=float, default=3.0,
+                   help="dispatch watchdog deadline for the stacks under "
+                        "test (injected hangs must recover through it)")
+    p.add_argument("--max-lane-aborts", type=int, default=3)
+    p.add_argument("--deadline", type=float, default=180.0,
+                   help="per-leg hard deadline; a run past it is a "
+                        "chaos failure (hang)")
+    p.add_argument("--report", default="chaos_serve_report.json")
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--keep-workdir", action="store_true")
+    args = p.parse_args(argv)
+
+    reqs = [_request_doc(args.nodes, args.degree,
+                         seed=c * 10_000 + r)
+            for c in range(args.clients)
+            for r in range(args.requests_per_client)]
+    print(f"# chaos_serve: {len(reqs)} requests V={args.nodes} "
+          f"deg={args.degree} seed={args.seed} schedules={args.schedules} "
+          f"kills={args.kills}", file=sys.stderr)
+    baseline = _baseline_colors(args, reqs)
+    print(f"# chaos_serve: fault-free baseline captured "
+          f"({len(baseline)} colorings)", file=sys.stderr)
+
+    schedules = []
+    for i in range(args.schedules):
+        entry = _run_schedule(i, args, reqs, baseline)
+        schedules.append(entry)
+        print(f"# [{i}] {entry['outcome']:<12} fired={entry['fired']} "
+              f"cover={entry['must_cover']} spec={entry['spec']}",
+              file=sys.stderr)
+
+    kill_resume = None
+    if args.kills > 0:
+        kill_resume = _run_kill_resume(args, reqs, baseline)
+        print(f"# kill-resume: {kill_resume['outcome']} "
+              f"kills={kill_resume['kills']}/"
+              f"{kill_resume['kills_planned']} "
+              f"restarts={kill_resume['restarts']}", file=sys.stderr)
+
+    ok = sum(1 for e in schedules if e["outcome"] == "ok")
+    structured = sum(1 for e in schedules if e["outcome"] == "structured")
+    failed = len(schedules) - ok - structured
+    if kill_resume is not None:
+        if kill_resume["outcome"] == "ok":
+            ok += 1
+        else:
+            failed += 1
+    report = {
+        "chaos_serve_report_version": CHAOS_SERVE_REPORT_VERSION,
+        "config": {"schedules": args.schedules, "kills": args.kills,
+                   "clients": args.clients,
+                   "requests_per_client": args.requests_per_client,
+                   "nodes": args.nodes, "degree": args.degree,
+                   "seed": args.seed, "batch_max": args.batch_max,
+                   "dispatch_timeout": args.dispatch_timeout,
+                   "max_lane_aborts": args.max_lane_aborts},
+        "schedules": schedules,
+        "kill_resume": kill_resume,
+        "summary": {"total": len(schedules) + (1 if kill_resume else 0),
+                    "ok": ok, "structured": structured, "failed": failed},
+    }
+    problems = validate_chaos_serve_report(report)
+    if problems:
+        for prob in problems:
+            print(f"# chaos_serve report malformed: {prob}",
+                  file=sys.stderr)
+        failed += 1
+    with open(args.report, "w") as fh:
+        json.dump(report, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps({"chaos_serve": {
+        "total": report["summary"]["total"], "ok": ok,
+        "structured": structured, "failed": failed,
+        "report": args.report}}))
+    return 0 if failed == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

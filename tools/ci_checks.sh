@@ -13,6 +13,11 @@
 #      zero lost/dup results, quota 429s with retry context, graceful
 #      drain — gated by tools/slo_check.py over the run manifest and
 #      accreting a row into PERF_DB.jsonl via tools/perf_db.py.
+#   6. chaos-serve smoke (crash-safe serve tier, same skip): 3 seeded
+#      serve-point fault schedules + one SIGKILL-at-journal-offset
+#      kill-resume cycle through tools/chaos_serve.py — recover or
+#      structured abort at every serve fault point, zero acked-ticket
+#      loss across the restart, colors bit-identical to fault-free.
 # Steps 1-3 are AST-only (seconds); steps 4-5 compile toy kernels on
 # CPU (~1-2 min cold) — the only gates that prove the profiler and
 # serving-over-the-network plumbing end-to-end before device time is
@@ -112,6 +117,35 @@ EOF
     echo "ci_checks: netfront soak smoke OK ($(cat "$SMOKE_DIR/soak_record.json" | python -c 'import json,sys; r=json.load(sys.stdin); print(r["requests"], "req,", r["value"], r["unit"])'))" >&2
   else
     echo "ci_checks: netfront soak smoke FAILED" >&2
+    rc=1
+  fi
+  # chaos-serve smoke (crash-safe serve tier): seeded schedules over
+  # every serve fault point + one kill-resume cycle over the durable
+  # ticket journal; the harness's own invariants (zero acked loss, no
+  # dup ids, bit-identical replay colors, schema-valid logs) exit
+  # nonzero, and the report is structurally validated on top
+  if JAX_PLATFORMS=cpu timeout 560 python tools/chaos_serve.py \
+      --schedules 3 --kills 1 --clients 3 --requests-per-client 2 \
+      --nodes 400 --degree 5 --deadline 240 \
+      --report "$SMOKE_DIR/chaos_serve.json" \
+      > "$SMOKE_DIR/chaos_serve_summary.json" \
+    && python - "$SMOKE_DIR/chaos_serve.json" <<'EOF'
+import json, sys
+sys.path.insert(0, ".")
+from tools.chaos_serve import validate_chaos_serve_report
+doc = json.load(open(sys.argv[1]))
+problems = validate_chaos_serve_report(doc)
+assert not problems, problems
+assert doc["summary"]["failed"] == 0, doc["summary"]
+kr = doc.get("kill_resume")
+assert kr and kr["outcome"] == "ok" and kr["kills"] >= 1, kr
+print("ci_checks: chaos-serve %d schedule(s) + kill-resume ok"
+      % len(doc["schedules"]), file=sys.stderr)
+EOF
+  then
+    echo "ci_checks: chaos-serve smoke OK" >&2
+  else
+    echo "ci_checks: chaos-serve smoke FAILED" >&2
     rc=1
   fi
   rm -rf "$SMOKE_DIR"

@@ -151,6 +151,18 @@ if [ "$battery_rc" -ne 2 ]; then
     --run-manifest netfront_soak_tpu_man.json --perf-db PERF_DB.jsonl 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
+  # chaos-serve soak on-chip (crash-safe serve tier): the full seeded
+  # schedule battery over every serve fault point plus SIGKILL/resume
+  # cycles at seeded journal offsets, against the real TPU lanes — the
+  # CPU legs (ci_checks.sh smoke + tests/test_chaos_serve.py) prove the
+  # protocol; the TPU question is whether recovery stays bit-identical
+  # when dispatch aborts land mid-flight on real hardware queues.
+  echo "=== chaos-serve soak (TPU kill-resume + serve fault points) ===" | tee -a /dev/stderr >/dev/null
+  timeout 3600 python tools/chaos_serve.py --schedules 10 --kills 3 \
+    --clients 8 --requests-per-client 2 --nodes 20000 --degree 16 \
+    --deadline 900 --report chaos_serve_tpu.json 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+
   echo "=== cold compile, unified pipeline 1M-RMAT ===" | tee -a /dev/stderr >/dev/null
   # fresh cache dir = genuinely cold compile (removed after); outer
   # timeout sits ABOVE bench.py's 5400s in-process deadline so the

@@ -218,6 +218,14 @@ def render(doc: dict, width: int = 48) -> str:
                 f"ok, {summ.get('failed')} failed, "
                 f"{summ.get('rejected', 0)} shed"
                 + (f", {gps} graphs/s" if gps is not None else ""))
+        rebuilds = sv.get("rebuilds") or []
+        if rebuilds:
+            # fault-plane recoveries: pool teardown/rebuild + poison
+            # quarantines (the crash-safe serve tier's lane_rebuild)
+            quarantined = sum(r.get("quarantined", 0) for r in rebuilds)
+            hangs = sum(1 for r in rebuilds if r.get("reason") == "hang")
+            add(f"  rebuilds: {len(rebuilds)} ({hangs} watchdog hang(s), "
+                f"{quarantined} request(s) quarantined)")
         hl = sv.get("health")
         if hl is not None and (not hl.get("ready") or hl.get("degraded")):
             add(f"  health: ready={hl.get('ready')} "
@@ -247,6 +255,15 @@ def render(doc: dict, width: int = 48) -> str:
                 f"{dr.get('queued')} queued at drain, "
                 f"{dr.get('completed')} completed / "
                 f"{dr.get('failed')} failed in {dr.get('wall_s')}s")
+        rec = nf.get("recover")
+        if rec:
+            # journal recovery (crash-safe serve tier): what a restart
+            # pulled back out of the durable ticket journal
+            add(f"  journal recovery: {rec.get('restored', 0)} restored, "
+                f"{rec.get('replayed', 0)} replayed, "
+                f"{rec.get('failed', 0)} failed "
+                f"({rec.get('records', 0)} record(s), high water "
+                f"{rec.get('high_water')}, {rec.get('wall_s')}s)")
 
     ph = doc.get("phases") or {}
     totals = ph.get("totals") or {}

@@ -202,6 +202,12 @@ def main(argv: list[str] | None = None) -> int:
                         "'greedy' tenant (rate 5/s, burst 5)")
     p.add_argument("--no-drain", action="store_true",
                    help="skip the mid-soak graceful drain")
+    p.add_argument("--journal-dir", type=str, default=None,
+                   help="durable ticket journal directory (the crash-"
+                        "safe serve tier): every accepted submit is "
+                        "fsync-journaled ahead of its 202 — the "
+                        "journal-on vs journal-off throughput delta is "
+                        "the PERF.md \"Durable ticket journal\" row")
     p.add_argument("--log-json", type=str, default=None)
     p.add_argument("--run-manifest", type=str, default=None)
     p.add_argument("--perf-db", type=str, default=None,
@@ -239,7 +245,7 @@ def main(argv: list[str] | None = None) -> int:
     admission = AdmissionController(load_tenant_configs(tenant_doc),
                                     registry=registry, logger=logger)
     nf = NetFront(front, admission=admission, registry=registry,
-                  logger=logger).start()
+                  logger=logger, journal_dir=args.journal_dir).start()
 
     # compile off the soak clock: warm the one shape class the soak's
     # generator spec lands in (the --warm-classes convention)
@@ -318,7 +324,9 @@ def main(argv: list[str] | None = None) -> int:
     record = {
         "metric": f"soak_netfront_c{args.clients}"
                   f"_r{args.requests_per_client}"
-                  f"_n{args.nodes}d{args.degree}",
+                  f"_n{args.nodes}d{args.degree}"
+                  + ("_journal" if args.journal_dir else ""),
+        "journal": bool(args.journal_dir),
         "value": round(accepted / wall, 3) if wall > 0 else None,
         "unit": "graphs/s",
         "backend": "netfront",

@@ -127,6 +127,29 @@ def _semantic_problems(record: dict) -> list[str]:
             v = record.get(fieldname)
             if isinstance(v, int) and not isinstance(v, bool) and v < 0:
                 problems.append(f"net_drain: {fieldname} {v} < 0")
+    # crash-safe serve tier: journal recovery actions come from a closed
+    # vocabulary, rebuild reasons likewise, and every recovery/rebuild
+    # count is non-negative — the kill-resume chaos harness's artifacts
+    # stay machine-checkable end to end
+    elif kind == "net_recover":
+        if record.get("action") not in ("restored", "replayed",
+                                        "replay_failed", "summary"):
+            problems.append(
+                f"net_recover: action {record.get('action')!r} not in "
+                f"('restored', 'replayed', 'replay_failed', 'summary')")
+        for fieldname in ("records", "restored", "replayed", "failed"):
+            v = record.get(fieldname)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+                problems.append(f"net_recover: {fieldname} {v} < 0")
+    elif kind == "lane_rebuild":
+        if record.get("reason") not in ("abort", "hang"):
+            problems.append(
+                f"lane_rebuild: reason {record.get('reason')!r} not in "
+                f"('abort', 'hang')")
+        for fieldname in ("reseated", "quarantined", "aborts_max"):
+            v = record.get(fieldname)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+                problems.append(f"lane_rebuild: {fieldname} {v} < 0")
     return problems
 
 
