@@ -145,12 +145,20 @@ def _serve_throughput(args, phases: dict, context: dict,
     mode_cfg = {}
     for m in modes:
         base, *flags = m.split("+")
-        bad = [f for f in flags if f not in ("nostage", "devcarry")]
+        bad = [f for f in flags if f not in ("nostage", "devcarry",
+                                             "shard")]
         if base not in ("continuous", "sync") or bad:
             raise SystemExit(f"--serve-modes: unknown mode {m!r}")
         mode_cfg[m] = dict(mode=base,
                            stages="off" if "nostage" in flags else "auto",
-                           device_carry="devcarry" in flags)
+                           device_carry="devcarry" in flags,
+                           # +shard: lane axis over the local device
+                           # mesh (serve.batched.lane_mesh "auto" — the
+                           # largest pow2 device count; on a 1-device
+                           # host this resolves to the unsharded path,
+                           # so the A/B needs forced/real multi-device)
+                           mesh_devices="auto" if "shard" in flags
+                           else None)
     slice_steps = (None if args.serve_slice_steps == "auto"
                    else int(args.serve_slice_steps))
     n = max(args.serve_graphs, max(batch_sizes))
@@ -191,6 +199,10 @@ def _serve_throughput(args, phases: dict, context: dict,
 
     mode_curves: dict = {m: {} for m in modes}
     transfers: dict = {m: {} for m in modes}
+    # +shard accounting: per (mode, batch) mesh size + mean per-device
+    # live-lane occupancy (scheduler.mesh_snapshot) — empty for
+    # unsharded modes
+    mesh_acct: dict = {m: {} for m in modes}
     parity_ok = True
     for mode in modes:
         cfg = mode_cfg[mode]
@@ -198,6 +210,7 @@ def _serve_throughput(args, phases: dict, context: dict,
             fe = ServeFrontEnd(batch_max=b, workers=b, mode=cfg["mode"],
                                stages=cfg["stages"],
                                device_carry=cfg["device_carry"],
+                               mesh_devices=cfg["mesh_devices"],
                                slice_steps=slice_steps,
                                window_s=args.serve_window_ms / 1e3,
                                queue_depth=max(64, 2 * n),
@@ -224,8 +237,11 @@ def _serve_throughput(args, phases: dict, context: dict,
                 # raced the dispatcher's post-delivery bookkeeping —
                 # ticket.result() returns before the slice's stats land
                 sched_stats = fe.scheduler.stats_snapshot()
+                mesh_snap = fe.scheduler.mesh_snapshot()
             finally:
                 fe.shutdown()
+            if mesh_snap is not None:
+                mesh_acct[mode][str(b)] = mesh_snap
             phases[f"serve_{key}_s"] = elapsed
             mode_curves[mode][str(b)] = round(n / elapsed, 3)
             # measured per-slice host<->device traffic (the
@@ -310,6 +326,7 @@ def _serve_throughput(args, phases: dict, context: dict,
         "batches": batches,
         "modes": mode_curves,
         "transfers": transfers,
+        "mesh": {m: acct for m, acct in mesh_acct.items() if acct},
         "serve_mode": modes[0],
         "slice_steps": args.serve_slice_steps,
         "monotone_curve": monotone,
@@ -399,9 +416,12 @@ def main() -> int:
                         "suffix with '+': '+nostage' compiles the "
                         "full-table kernels (staged-vs-full A/B) and "
                         "'+devcarry' keeps the carry device-resident "
-                        "(transfer A/B) — e.g. "
+                        "(transfer A/B), '+shard' shards the lane axis "
+                        "over the local device mesh (multi-device A/B; "
+                        "per-device occupancy lands in the record's "
+                        "'mesh' slot) — e.g. "
                         "'continuous,continuous+nostage,"
-                        "continuous+devcarry'")
+                        "continuous+devcarry,continuous+shard'")
     p.add_argument("--serve-slice-steps", type=str, default="auto",
                    help="supersteps per continuous-mode slice, or "
                         "'auto' to price against dispatch overhead "

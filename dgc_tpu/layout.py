@@ -73,6 +73,21 @@ N_OUT = 7              # result slots p1..st2
 # then CARRY_P1..CARRY_ST2.
 D2H_SLOTS = (0, 13, 15, 16, 6, 7, 8, 9, 10, 11, 12)
 
+# -- serve lane-mesh sharding contract (serve.batched sharded kernels) ----
+#
+# The multi-device serve tier lays every batch-leading buffer — the
+# [B, ...] carry slots above, the comb/degrees input stacks, and the
+# k0/max_steps/reset scheduling vectors — out over a one-axis device
+# mesh named MESH_AXIS, partitioned on axis LANES_AXIS (the batch/lane
+# axis) with everything else replicated. The executed ladder rung stays
+# a GLOBAL scalar (min over live lanes, all-reduced by SPMD
+# partitioning), so the lane bodies are byte-identical to the
+# single-device kernels. The transfer pass (TR003) whitelist D2H_SLOTS
+# applies unchanged: sharded or not, only those slots may cross
+# device→host per slice in device-carry mode.
+LANES_AXIS = 0         # the axis every serve buffer shards on
+MESH_AXIS = "lanes"    # the serve mesh's single axis name
+
 # -- sharded flat-pipeline carry (engine/sharded.py `_flat_pipeline`) -----
 #
 # (packed_l, step, status, prev_active, stall,   -- live sweep state

@@ -102,7 +102,11 @@ EVENT_SCHEMAS: dict = {
         {"mode": "str", "slice_steps": ("int", "null"),
          "affinity": "bool", "timing": "bool", "tracing": "bool",
          # staged frontier ladder + device-resident carry (PR 9)
-         "stages": "str", "device_carry": "bool"}),
+         "stages": "str", "device_carry": "bool",
+         # multi-device serve tier (--mesh-devices): the resolved lane
+         # mesh size — present ONLY when the lane axis is sharded, so
+         # the unsharded event stream stays byte-identical
+         "mesh_devices": "int"}),
     "serve_batch": (
         {"shape_class": "str", "batch": "int", "occupancy": NUM,
          "padding_waste": NUM},
@@ -111,7 +115,10 @@ EVENT_SCHEMAS: dict = {
          "depth_buckets": "int",
          # compiled stage-branch count of the class's ladder (1 = the
          # full-table kernel; sync mode has no mid-sweep rung visibility)
-         "stage_bodies": "int"}),
+         "stage_bodies": "int",
+         # lane-mesh occupancy (mesh mode only): real lanes per device /
+         # the device's lane count, one entry per mesh device
+         "mesh_devices": "int", "device_occupancy": "list"}),
     # continuous batching (lane recycling): one serve_slice per sliced
     # kernel dispatch, one lane_recycled per completed sweep swapped out
     "serve_slice": (
@@ -129,7 +136,10 @@ EVENT_SCHEMAS: dict = {
          "stage_occupancy": NUM,
          # per-slice host<->device transfer accounting (the
          # --device-carry A/B evidence; serve_summary totals them)
-         "h2d_bytes": "int", "d2h_bytes": "int"}),
+         "h2d_bytes": "int", "d2h_bytes": "int",
+         # lane-mesh occupancy (mesh mode only): live lanes per device /
+         # the device's lane count — the sharded tier's utilization
+         "mesh_devices": "int", "device_occupancy": "list"}),
     "lane_recycled": (
         {"shape_class": "str", "lane": "int"},
         {"k": "int", "depth_bucket": "int", "slices": "int",
@@ -241,7 +251,10 @@ EVENT_SCHEMAS: dict = {
          # histogram quantiles, ms): {class: {p50, p95, p99, count}}
          "latency_ms": "dict", "recals": "int",
          # whole-run host<->device transfer totals (serve_slice sums)
-         "h2d_mb": NUM, "d2h_mb": NUM}),
+         "h2d_mb": NUM, "d2h_mb": NUM,
+         # lane-mesh summary (mesh mode only): mesh size + each
+         # device's MEAN live-lane occupancy over the whole run
+         "mesh_devices": "int", "device_occupancy": "list"}),
 }
 
 

@@ -124,7 +124,7 @@ from __future__ import annotations
 
 import math
 import os
-from functools import partial
+from functools import lru_cache, partial
 
 import jax
 import jax.numpy as jnp
@@ -133,8 +133,8 @@ import numpy as np
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
 from dgc_tpu.engine.bucketed import decode_combined, initial_packed, status_step
 from dgc_tpu.engine.compact import _check_stage_ladder, _compact_idx
-from dgc_tpu.layout import (CARRY_LEN, CARRY_PHASE, N_OUT, OUT0, T_PREV,
-                            T_US)
+from dgc_tpu.layout import (CARRY_LEN, CARRY_PHASE, MESH_AXIS, N_OUT, OUT0,
+                            T_PREV, T_US)
 from dgc_tpu.ops.speculative import speculative_update_mc
 
 _RUNNING = AttemptStatus.RUNNING
@@ -586,6 +586,15 @@ def batched_slice_kernel_donated(comb, degrees, k0, max_steps, reset, carry,
                          stages=stages)
 
 
+def _seat_lane_body(comb, degrees, k0, max_steps, reset, lane,
+                    m_comb, m_degrees, m_k0, m_max_steps):
+    """The one seat-scatter body the single-device and lane-sharded
+    seat kernels share (so the two cannot drift)."""
+    return (comb.at[lane].set(m_comb), degrees.at[lane].set(m_degrees),
+            k0.at[lane].set(m_k0), max_steps.at[lane].set(m_max_steps),
+            reset.at[lane].set(1))
+
+
 @_donated_seat_jit
 def seat_lane_kernel(comb, degrees, k0, max_steps, reset, lane,
                      m_comb, m_degrees, m_k0, m_max_steps):
@@ -596,9 +605,12 @@ def seat_lane_kernel(comb, degrees, k0, max_steps, reset, lane,
     host-mirror path pays. ``reset`` is never donated: the scheduler
     passes its cached all-zeros buffer and must keep it valid for the
     next post-slice rearm."""
-    return (comb.at[lane].set(m_comb), degrees.at[lane].set(m_degrees),
-            k0.at[lane].set(m_k0), max_steps.at[lane].set(m_max_steps),
-            reset.at[lane].set(1))
+    return _seat_lane_body(comb, degrees, k0, max_steps, reset, lane,
+                           m_comb, m_degrees, m_k0, m_max_steps)
+
+
+def _permute_carry_body(carry, base, src, dst):
+    return tuple(b.at[dst].set(a[src]) for a, b in zip(carry, base))
 
 
 @jax.jit
@@ -614,7 +626,17 @@ def permute_carry_kernel(carry, base, src, dst):  # dgc-lint: distinct-buffers
     collapse equal-valued constant slots built on device into one
     buffer — donating one buffer through two carry slots corrupts the
     heap (observed as a glibc abort on the CPU backend)."""
-    return tuple(b.at[dst].set(a[src]) for a, b in zip(carry, base))
+    return _permute_carry_body(carry, base, src, dst)
+
+
+def _resize_inputs_body(comb, degrees, k0, max_steps, src,
+                        dummy_comb, dummy_degrees, dummy_k0, dummy_ms):
+    comb_ext = jnp.concatenate([comb, dummy_comb[None]], axis=0)
+    degrees_ext = jnp.concatenate([degrees, dummy_degrees[None]], axis=0)
+    k0_ext = jnp.concatenate([k0, dummy_k0[None]])
+    ms_ext = jnp.concatenate([max_steps, dummy_ms[None]])
+    return (comb_ext[src], degrees_ext[src], k0_ext[src], ms_ext[src],
+            jnp.zeros(src.shape[0], jnp.int32))
 
 
 @jax.jit
@@ -626,12 +648,196 @@ def resize_inputs_kernel(comb, degrees, k0, max_steps, src,
     device and only the (pool-cached) dummy row ever crossed the bus.
     Reset flags come back all-zero: seats pending at resize time are
     re-scattered by ``seat_lane_kernel`` afterwards."""
-    comb_ext = jnp.concatenate([comb, dummy_comb[None]], axis=0)
-    degrees_ext = jnp.concatenate([degrees, dummy_degrees[None]], axis=0)
-    k0_ext = jnp.concatenate([k0, dummy_k0[None]])
-    ms_ext = jnp.concatenate([max_steps, dummy_ms[None]])
-    return (comb_ext[src], degrees_ext[src], k0_ext[src], ms_ext[src],
-            jnp.zeros(src.shape[0], jnp.int32))
+    return _resize_inputs_body(comb, degrees, k0, max_steps, src,
+                               dummy_comb, dummy_degrees, dummy_k0,
+                               dummy_ms)
+
+
+# -- multi-device lane sharding (ROADMAP 2(a)) ----------------------------
+#
+# One host's local devices form a one-axis ``Mesh(devices, ("lanes",))``
+# and every batch-leading buffer — the input stacks, the scheduling
+# vectors, and all CARRY_LEN carry slots — is laid out with
+# ``NamedSharding(mesh, P("lanes"))`` on axis 0 (``layout.LANES_AXIS``),
+# so each device owns B/n contiguous lanes. The kernels below are the
+# SAME ``_sweep_kernel``/``_slice_kernel``/seat/permute/resize bodies
+# compiled through an explicit in/out-shardings jit wrapper (the
+# SNIPPETS.md compile-step pattern): SPMD partitioning changes buffer
+# placement, never the math. The exactness argument is one sentence on
+# top of the module docstring's: every cross-lane value in the body is a
+# full reduction (the executed rung ``r_exec = min`` over live lanes,
+# the ``jnp.any``/``jnp.all`` cond predicates), which GSPMD lowers to an
+# all-reduce producing the same REPLICATED scalar on every device — so
+# each device runs the identical stage branch and epilogue conds over
+# its own lanes, and a lane's per-superstep values are byte-identical to
+# the single-device kernel's (int32 throughout, no reassociation).
+# Donation of the sharded carry stays behind the same
+# ``DGC_TPU_DONATE_CARRY`` opt-in as the single-device donated twin.
+
+def mesh_device_count(devices="auto") -> int:
+    """Resolve a ``--mesh-devices`` value to a lane-mesh size: ``auto``
+    (or None) is the largest power of two ≤ the local device count; an
+    explicit N must be a power of two (lane pads are powers of two and
+    must stay divisible by the mesh — the even-shard precondition) and
+    ≤ the local device count. Returns 1 on a single-device host —
+    callers treat size 1 as "no mesh" (the byte-identical unsharded
+    path)."""
+    n_avail = len(jax.devices())
+    if devices in ("auto", None):
+        return 1 << max(0, n_avail.bit_length() - 1)
+    n = int(devices)
+    if n < 1 or (n & (n - 1)) != 0:
+        raise ValueError(
+            f"mesh devices must be a power of two (lane pads are pow2 "
+            f"and must shard evenly), got {devices!r}")
+    if n > n_avail:
+        raise ValueError(
+            f"mesh devices {n} exceeds the {n_avail} local device(s)")
+    return n
+
+
+def lane_mesh(devices="auto"):
+    """The serve tier's one-axis device mesh over the first
+    :func:`mesh_device_count` local devices, axis ``layout.MESH_AXIS``."""
+    n = mesh_device_count(devices)
+    return jax.sharding.Mesh(np.array(jax.devices()[:n]), (MESH_AXIS,))
+
+
+def lane_sharding(mesh):
+    """``NamedSharding`` partitioning axis 0 (the lane axis) over the
+    mesh — the layout of every batch-leading serve buffer."""
+    return jax.sharding.NamedSharding(
+        mesh, jax.sharding.PartitionSpec(MESH_AXIS))
+
+
+def replicated_sharding(mesh):
+    """``NamedSharding`` replicating a value on every mesh device (the
+    seat scalars, permute/resize index vectors, dummy rows)."""
+    return jax.sharding.NamedSharding(mesh, jax.sharding.PartitionSpec())
+
+
+@lru_cache(maxsize=None)
+def _sharded_sweep_jit(mesh, planes: int, stall_window: int, stages):
+    lane = lane_sharding(mesh)
+    fn = partial(_sweep_kernel, planes=planes, stall_window=stall_window,
+                 stages=stages)
+    return jax.jit(fn, in_shardings=(lane, lane, lane, lane),
+                   out_shardings=lane)
+
+
+@lru_cache(maxsize=None)
+def _sharded_slice_jit(mesh, planes: int, slice_steps: int,
+                       stall_window: int, timing: bool, stages,
+                       donate: bool):
+    lane = lane_sharding(mesh)
+    fn = partial(_slice_kernel, planes=planes, slice_steps=slice_steps,
+                 stall_window=stall_window, timing=timing, stages=stages)
+    kw = {"donate_argnums": (5,)} if (donate and _DONATE_CARRY) else {}
+    return jax.jit(fn, in_shardings=(lane, lane, lane, lane, lane, lane),
+                   out_shardings=lane, **kw)
+
+
+@lru_cache(maxsize=None)
+def _sharded_seat_jit(mesh):
+    lane = lane_sharding(mesh)
+    repl = replicated_sharding(mesh)
+    kw = {"donate_argnums": (0, 1, 2, 3)} if _DONATE_CARRY else {}
+    return jax.jit(_seat_lane_body,
+                   in_shardings=(lane,) * 5 + (repl,) * 5,
+                   out_shardings=lane, **kw)
+
+
+@lru_cache(maxsize=None)
+def _sharded_permute_jit(mesh):
+    lane = lane_sharding(mesh)
+    repl = replicated_sharding(mesh)
+    return jax.jit(_permute_carry_body,
+                   in_shardings=(lane, lane, repl, repl),
+                   out_shardings=lane)
+
+
+@lru_cache(maxsize=None)
+def _sharded_resize_jit(mesh):
+    lane = lane_sharding(mesh)
+    repl = replicated_sharding(mesh)
+    return jax.jit(_resize_inputs_body,
+                   in_shardings=(lane,) * 4 + (repl,) * 5,
+                   out_shardings=lane)
+
+
+def batched_sweep_kernel_sharded(mesh, comb, degrees, k0, max_steps,
+                                 planes: int,
+                                 stall_window: int = DEFAULT_STALL_WINDOW,
+                                 stages=None):
+    """:func:`batched_sweep_kernel` with the batch axis sharded over
+    ``mesh`` (sync mode's sharded dispatch). ``B`` must be a multiple of
+    the mesh size (the scheduler pads lanes in mesh multiples). One jit
+    cache entry per (mesh, B, V_pad, W_pad, planes, stages)."""
+    return _sharded_sweep_jit(mesh, planes, stall_window, stages)(
+        comb, degrees, k0, max_steps)
+
+
+def batched_slice_kernel_sharded(mesh, comb, degrees, k0, max_steps,
+                                 reset, carry, planes: int,
+                                 slice_steps: int,
+                                 stall_window: int = DEFAULT_STALL_WINDOW,
+                                 timing: bool = False, stages=None):
+    """:func:`batched_slice_kernel` with every batch-leading input and
+    all carry slots sharded over ``mesh`` (continuous mode's sharded
+    dispatch). Host numpy inputs shard on upload; the returned carry is
+    lane-sharded (out-shardings pinned, so re-entering it reshards
+    nothing)."""
+    return _sharded_slice_jit(mesh, planes, slice_steps, stall_window,
+                              timing, stages, False)(
+        comb, degrees, k0, max_steps, reset, carry)
+
+
+def batched_slice_kernel_sharded_donated(mesh, comb, degrees, k0,
+                                         max_steps, reset, carry,
+                                         planes: int, slice_steps: int,
+                                         stall_window: int =
+                                         DEFAULT_STALL_WINDOW,
+                                         timing: bool = False,
+                                         stages=None):
+    """The sharded device-resident-carry slice dispatch: the scheduler
+    re-enters the returned lane-sharded carry and never touches the old
+    buffers again. True in-place donation of the sharded carry stays
+    behind ``DGC_TPU_DONATE_CARRY`` with the same non-donated fallback
+    as the single-device twin (the jax-0.4.37 persistent-cache aliasing
+    bug is placement-independent)."""
+    return _sharded_slice_jit(mesh, planes, slice_steps, stall_window,
+                              timing, stages, True)(
+        comb, degrees, k0, max_steps, reset, carry)
+
+
+def seat_lane_kernel_sharded(mesh, comb, degrees, k0, max_steps, reset,
+                             lane, m_comb, m_degrees, m_k0, m_max_steps):
+    """:func:`seat_lane_kernel` over sharded input stacks: the scatter
+    touches one lane's row, so only its OWNING shard's buffer changes —
+    seating stays a shard-local scatter plus the replicated scalar
+    broadcast of the seated row."""
+    return _sharded_seat_jit(mesh)(comb, degrees, k0, max_steps, reset,
+                                   lane, m_comb, m_degrees, m_k0,
+                                   m_max_steps)
+
+
+def permute_carry_kernel_sharded(mesh, carry, base, src, dst):  # dgc-lint: distinct-buffers
+    """:func:`permute_carry_kernel` over sharded carries: kept lanes may
+    cross shards (SPMD lowers the gather to the needed collective), and
+    ``base`` must be per-slot-distinct lane-sharded buffers for exactly
+    the reason the unsharded docstring gives — the outputs seed the next
+    donated sharded slice call."""
+    return _sharded_permute_jit(mesh)(carry, base, src, dst)
+
+
+def resize_inputs_kernel_sharded(mesh, comb, degrees, k0, max_steps, src,
+                                 dummy_comb, dummy_degrees, dummy_k0,
+                                 dummy_ms):
+    """:func:`resize_inputs_kernel` over sharded input stacks (the
+    dummy row rides replicated)."""
+    return _sharded_resize_jit(mesh)(comb, degrees, k0, max_steps, src,
+                                     dummy_comb, dummy_degrees, dummy_k0,
+                                     dummy_ms)
 
 
 def idle_carry(b_pad: int, v_pad: int, a_pad: int = 1):

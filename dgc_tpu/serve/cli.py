@@ -137,6 +137,16 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "one lane's inputs, and per-slice host↔device "
                         "traffic drops to the scheduling scalars plus "
                         "done lanes' result rows")
+    p.add_argument("--mesh-devices", type=str, default=None,
+                   metavar="auto|N",
+                   help="shard the serve lane axis over the local "
+                        "devices (Mesh + NamedSharding over the batch "
+                        "axis): 'auto' uses the largest power-of-two "
+                        "device count, N (a power of two) pins the mesh "
+                        "size; lane pools pad in mesh multiples and "
+                        "every kernel dispatches through the sharded "
+                        "compile path. Unset (or N=1 / a single-device "
+                        "host) keeps the exact single-device path")
     p.add_argument("--warm-classes", type=str, default=None,
                    metavar="CLS1,CLS2,...",
                    help="pre-compile these shape classes' kernel pad "
@@ -263,6 +273,10 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
         summary_kw["latency_ms"] = latency
     if sst.get("recals"):
         summary_kw["recals"] = sst["recals"]
+    mesh_snap = front.scheduler.mesh_snapshot()
+    if mesh_snap is not None:
+        summary_kw["mesh_devices"] = mesh_snap["mesh_devices"]
+        summary_kw["device_occupancy"] = mesh_snap["device_occupancy"]
     done = st["completed"]
     logger.event("serve_summary", requests=st["submitted"],
                  completed=done, failed=st["failed"],
@@ -396,22 +410,37 @@ def serve_main(argv: list[str] | None = None) -> int:
             print(f"--slice-steps must be an integer or 'auto', got "
                   f"{args.slice_steps!r}", file=sys.stderr)
             return 2
-    front = ServeFrontEnd(
-        batch_max=args.batch_max, window_s=args.window_ms / 1e3,
-        queue_depth=args.queue_depth, workers=args.workers,
-        mode=args.serve_mode,
-        slice_steps=(None if args.slice_steps == "auto"
-                     else args.slice_steps),
-        affinity=not args.no_affinity,
-        stages=args.serve_stages, device_carry=args.device_carry,
-        timing=args.kernel_timing, trace=not args.no_trace,
-        validate=not args.no_validate,
-        post_reduce=not args.no_reduce_colors,
-        auto_tune=args.auto_tune, tuned_cache=tuned_cache,
-        max_lane_aborts=args.max_lane_aborts,
-        dispatch_timeout=args.dispatch_timeout,
-        logger=logger, registry=registry,
-    ).start()
+    mesh_devices = args.mesh_devices
+    if mesh_devices is not None and mesh_devices != "auto":
+        try:
+            mesh_devices = int(mesh_devices)
+        except ValueError:
+            print(f"--mesh-devices must be 'auto' or an integer, got "
+                  f"{args.mesh_devices!r}", file=sys.stderr)
+            return 2
+    try:
+        front = ServeFrontEnd(
+            batch_max=args.batch_max, window_s=args.window_ms / 1e3,
+            queue_depth=args.queue_depth, workers=args.workers,
+            mode=args.serve_mode,
+            slice_steps=(None if args.slice_steps == "auto"
+                         else args.slice_steps),
+            affinity=not args.no_affinity,
+            stages=args.serve_stages, device_carry=args.device_carry,
+            mesh_devices=mesh_devices,
+            timing=args.kernel_timing, trace=not args.no_trace,
+            validate=not args.no_validate,
+            post_reduce=not args.no_reduce_colors,
+            auto_tune=args.auto_tune, tuned_cache=tuned_cache,
+            max_lane_aborts=args.max_lane_aborts,
+            dispatch_timeout=args.dispatch_timeout,
+            logger=logger, registry=registry,
+        ).start()
+    except ValueError as e:
+        # a bad --mesh-devices (non-pow2, more than the host has) is a
+        # usage error, not a crash
+        print(f"--mesh-devices: {e}", file=sys.stderr)
+        return 2
     if args.journal_dir is not None and args.listen is None:
         print("# --journal-dir ignored without --listen: the replay "
               "mode has no ticket table to journal", file=sys.stderr)
@@ -519,6 +548,10 @@ def serve_main(argv: list[str] | None = None) -> int:
         summary_kw["latency_ms"] = latency
     if sst.get("recals"):
         summary_kw["recals"] = sst["recals"]
+    mesh_snap = front.scheduler.mesh_snapshot()
+    if mesh_snap is not None:
+        summary_kw["mesh_devices"] = mesh_snap["mesh_devices"]
+        summary_kw["device_occupancy"] = mesh_snap["device_occupancy"]
     logger.event("serve_summary", requests=len(requests), completed=done,
                  failed=st["failed"],
                  rejected=st["rejected"],

@@ -58,6 +58,7 @@ from __future__ import annotations
 
 import threading
 import time
+from functools import partial
 
 import numpy as np
 
@@ -72,15 +73,24 @@ from dgc_tpu.serve.batched import (
     auto_slice_steps,
     batched_slice_kernel,
     batched_slice_kernel_donated,
+    batched_slice_kernel_sharded,
+    batched_slice_kernel_sharded_donated,
     batched_sweep_kernel,
+    batched_sweep_kernel_sharded,
     carry_nbytes,
     finish_pair,
     idle_carry,
+    lane_mesh,
     lane_outputs,
+    lane_sharding,
+    mesh_device_count,
     permute_carry_kernel,
+    permute_carry_kernel_sharded,
     priced_slice_steps,
     resize_inputs_kernel,
+    resize_inputs_kernel_sharded,
     seat_lane_kernel,
+    seat_lane_kernel_sharded,
     stage_idx_width,
 )
 from dgc_tpu.serve.shape_classes import (dummy_member, pad_ladder,
@@ -180,20 +190,31 @@ class _LanePool:   # dgc-lint: owned-by dispatcher
     scalars plus each DONE lane's two result rows. ``h2d``/``d2h``
     count every host↔device byte either mode actually moves — the
     transfer accounting the ``serve_slice`` events and PERF.md publish.
-    """
+
+    ``mesh`` (``--mesh-devices``) shards the lane axis over the local
+    device mesh (``serve.batched.lane_mesh``): every batch-leading
+    buffer uploads with the lane ``NamedSharding``, the pool width stays
+    a multiple of the mesh size (each device owns ``b_pad / n``
+    contiguous lanes), seating prefers the least-loaded shard so work
+    spreads across devices, and the kernels dispatch through the
+    sharded jit wrappers. ``mesh=None`` is the byte-identical
+    single-device path."""
 
     __slots__ = ("cls", "b_pad", "comb", "degrees", "k0", "max_steps",
                  "reset", "carry", "calls", "t_fill", "slices_in",
                  "t_seen", "_dev_inputs", "_dirty", "_dummy", "device",
                  "_dev", "_zeros_reset", "_dummy_dev", "h2d", "d2h",
-                 "a_pad")
+                 "a_pad", "mesh", "mesh_n", "_lane_sh")
 
     def __init__(self, cls, b_pad: int, dummy, device: bool = False,
-                 a_pad: int = 1):
+                 a_pad: int = 1, mesh=None):
         self.cls = cls
         self._dummy = dummy
         self.device = bool(device)
         self.a_pad = int(a_pad)   # the class ladder's CARRY_IDX width
+        self.mesh = mesh
+        self.mesh_n = int(mesh.devices.size) if mesh is not None else 1
+        self._lane_sh = lane_sharding(mesh) if mesh is not None else None
         self.b_pad = 0
         self.calls = []
         self.t_fill = []
@@ -202,7 +223,33 @@ class _LanePool:   # dgc-lint: owned-by dispatcher
         self.d2h = 0
         self._dev = None
         self._dummy_dev = None    # device mirror of the class dummy row
-        self._resize(b_pad)
+        self._resize(self._pad(b_pad))
+
+    def _pad(self, n: int) -> int:
+        """The pool width that seats ``n`` lanes: the power-of-two pad,
+        floored at the mesh size so the lane axis always shards evenly
+        (a mesh-less pool floors at 1 — the exact pre-mesh pads)."""
+        return max(_pow2_ceil(max(int(n), 1)), self.mesh_n)
+
+    def _put(self, x):
+        """Host→device upload with the pool's lane layout: lane-sharded
+        over the mesh, or the default single-device placement."""
+        import jax
+
+        if self._lane_sh is not None:
+            return jax.device_put(x, self._lane_sh)
+        return jax.device_put(x)
+
+    def device_live(self) -> list:
+        """Live-lane count per mesh device (lane ``i`` lives on shard
+        ``i // (b_pad / n)`` — ``NamedSharding`` partitions axis 0 into
+        contiguous blocks). A mesh-less pool reports one shard."""
+        per = self.b_pad // self.mesh_n
+        counts = [0] * self.mesh_n
+        for i, c in enumerate(self.calls):
+            if c is not None:
+                counts[i // per] += 1
+        return counts
 
     def _resize(self, b_pad: int) -> None:
         """(Re)allocate at ``b_pad`` lanes, compacting live lanes into
@@ -255,12 +302,19 @@ class _LanePool:   # dgc-lint: owned-by dispatcher
             # idle carry from host — its slots must be DISTINCT buffers
             # because they seed the next donated slice call
             # (permute_carry_kernel docstring: CSE'd equal-constant
-            # slots would be donated twice and corrupt the heap)
-            base = tuple(jax.device_put(a) for a in carry)
+            # slots would be donated twice and corrupt the heap); in
+            # mesh mode the base uploads lane-sharded (device_put of
+            # distinct numpy arrays stays per-slot-distinct under any
+            # sharding) and the permute runs the sharded kernel
+            base = tuple(self._put(a) for a in carry)
             self.h2d += carry_nbytes(base)
             src = np.asarray(keep, np.int32)
             dst = np.arange(len(keep), dtype=np.int32)
-            carry = permute_carry_kernel(dev_old, base, src, dst)
+            if self.mesh is not None:
+                carry = permute_carry_kernel_sharded(self.mesh, dev_old,
+                                                     base, src, dst)
+            else:
+                carry = permute_carry_kernel(dev_old, base, src, dst)
         new_dev = None
         if dev_old is not None and self._dev is not None:
             import jax
@@ -273,10 +327,16 @@ class _LanePool:   # dgc-lint: owned-by dispatcher
             src_map = np.full(b_pad, old_b, np.int32)   # old_b = dummy
             for new_i, old_i in enumerate(keep):
                 src_map[new_i] = old_i
-            new_dev = resize_inputs_kernel(
-                *self._dev[:4], src_map,
-                self._dummy_dev[0], self._dummy_dev[1],
-                np.int32(1), np.int32(dummy.max_steps))
+            if self.mesh is not None:
+                new_dev = resize_inputs_kernel_sharded(
+                    self.mesh, *self._dev[:4], src_map,
+                    self._dummy_dev[0], self._dummy_dev[1],
+                    np.int32(1), np.int32(dummy.max_steps))
+            else:
+                new_dev = resize_inputs_kernel(
+                    *self._dev[:4], src_map,
+                    self._dummy_dev[0], self._dummy_dev[1],
+                    np.int32(1), np.int32(dummy.max_steps))
             dirty_new = [keep.index(l) for l in self._dirty if l in keep]
         self.b_pad = b_pad
         self.comb, self.degrees = comb, degrees
@@ -306,17 +366,35 @@ class _LanePool:   # dgc-lint: owned-by dispatcher
         doubling per seat would pay that per pad during a ramp)."""
         need = self.live + n
         if need > self.b_pad:
-            self._resize(_pow2_ceil(need))
+            self._resize(self._pad(need))
+
+    def _free_lane(self) -> int:
+        """The lane the next seat lands in: the first free lane — or,
+        on a mesh, the first free lane of the LEAST-LOADED shard, so
+        live lanes spread across devices instead of piling onto shard 0
+        (per-device occupancy is the sharded tier's utilization metric;
+        lane choice is scheduler policy and result-invariant — every
+        lane runs the same class kernel)."""
+        if self.mesh is None:
+            return self.calls.index(None)
+        per = self.b_pad // self.mesh_n
+        live = self.device_live()
+        order = sorted(range(self.mesh_n), key=lambda d: (live[d], d))
+        for d in order:
+            for i in range(d * per, (d + 1) * per):
+                if self.calls[i] is None:
+                    return i
+        raise ValueError("no free lane")
 
     def fill(self, call: _SweepCall) -> int:
         """Seat ``call`` in a free lane (growing the pool if every lane
         is taken); the kernel re-inits the lane from these inputs on the
         next slice (``reset``)."""
         try:
-            lane = self.calls.index(None)
+            lane = self._free_lane()
         except ValueError:
             self._resize(self.b_pad * 2)
-            lane = self.calls.index(None)
+            lane = self._free_lane()
         m = call.member
         self.comb[lane] = m.comb
         self.degrees[lane] = m.degrees
@@ -335,11 +413,9 @@ class _LanePool:   # dgc-lint: owned-by dispatcher
         where a swap (or resize) actually mutated the host copy — the
         steady state between recycles re-uses the same device buffers
         (no per-slice upload of the big table stack)."""
-        import jax
-
         if self._dev_inputs is None or self._dirty:
-            self._dev_inputs = (jax.device_put(self.comb),
-                                jax.device_put(self.degrees))
+            self._dev_inputs = (self._put(self.comb),
+                                self._put(self.degrees))
             self.h2d += self.comb.nbytes + self.degrees.nbytes
             self._dirty = []
         return self._dev_inputs
@@ -352,17 +428,14 @@ class _LanePool:   # dgc-lint: owned-by dispatcher
         (``seat_lane_kernel``) whose host→device traffic is that lane's
         table row — the full-stack re-upload the host-mirror path pays
         per swap never recurs."""
-        import jax
-
         if self._zeros_reset is None:
-            self._zeros_reset = jax.device_put(
-                np.zeros(self.b_pad, np.int32))
+            self._zeros_reset = self._put(np.zeros(self.b_pad, np.int32))
         if self._dev is None:
-            self._dev = (jax.device_put(self.comb),
-                         jax.device_put(self.degrees),
-                         jax.device_put(self.k0),
-                         jax.device_put(self.max_steps),
-                         jax.device_put(self.reset))
+            self._dev = (self._put(self.comb),
+                         self._put(self.degrees),
+                         self._put(self.k0),
+                         self._put(self.max_steps),
+                         self._put(self.reset))
             self.h2d += (self.comb.nbytes + self.degrees.nbytes
                          + self.k0.nbytes + self.max_steps.nbytes
                          + self.reset.nbytes)
@@ -370,11 +443,22 @@ class _LanePool:   # dgc-lint: owned-by dispatcher
         elif self._dirty:
             comb, degrees, k0, max_steps, reset = self._dev
             for lane in self._dirty:
-                comb, degrees, k0, max_steps, reset = seat_lane_kernel(
-                    comb, degrees, k0, max_steps, reset,
-                    np.int32(lane), self.comb[lane], self.degrees[lane],
-                    np.int32(self.k0[lane]),
-                    np.int32(self.max_steps[lane]))
+                if self.mesh is not None:
+                    # shard-local scatter: only the seated lane's owning
+                    # shard buffer changes (the scattered row rides
+                    # replicated — one lane's table row on the bus)
+                    (comb, degrees, k0, max_steps,
+                     reset) = seat_lane_kernel_sharded(
+                        self.mesh, comb, degrees, k0, max_steps, reset,
+                        np.int32(lane), self.comb[lane],
+                        self.degrees[lane], np.int32(self.k0[lane]),
+                        np.int32(self.max_steps[lane]))
+                else:
+                    comb, degrees, k0, max_steps, reset = seat_lane_kernel(
+                        comb, degrees, k0, max_steps, reset,
+                        np.int32(lane), self.comb[lane], self.degrees[lane],
+                        np.int32(self.k0[lane]),
+                        np.int32(self.max_steps[lane]))
                 self.h2d += (self.comb[lane].nbytes
                              + self.degrees[lane].nbytes + 12)
             self._dev = (comb, degrees, k0, max_steps, reset)
@@ -402,7 +486,7 @@ class _LanePool:   # dgc-lint: owned-by dispatcher
         re-doubles on demand (``fill``/``reserve``), and every pow2
         pad's kernel is pre-warmed by ``warm_class``, so the resize
         itself is host-array bookkeeping plus one device re-upload."""
-        target = _pow2_ceil(max(self.live, 1))
+        target = self._pad(max(self.live, 1))
         if target < self.b_pad:
             self._resize(target)
 
@@ -428,6 +512,7 @@ class BatchScheduler:
                  recal_min_slices: int = 8,
                  stages="auto", device_carry: bool = False,
                  tuned_cache=None,
+                 mesh_devices=None,
                  max_lane_aborts: int = 3,
                  dispatch_timeout_s: float | None = None,
                  on_batch=None, on_event=None, tracer=None):
@@ -466,6 +551,25 @@ class BatchScheduler:
         # scheduling scalars + done lanes' result rows
         self.device_carry = bool(device_carry)
         self._tuned_cache = tuned_cache
+        # multi-device lane sharding (--mesh-devices, ROADMAP 2(a)):
+        # "auto"/N builds the one-axis lane mesh over the local devices
+        # (serve.batched.lane_mesh); every pool shards its lane axis
+        # over it and the kernels dispatch through the sharded jit
+        # wrappers. None — or a resolved size of 1 (single-device host,
+        # or an explicit N=1) — keeps self.mesh None: the byte-identical
+        # pre-mesh path, kernels, cache keys, and event stream.
+        self.mesh = None
+        self.mesh_devices = 0
+        if mesh_devices is not None:
+            n = mesh_device_count(mesh_devices)
+            if n > 1:
+                self.mesh = lane_mesh(n)
+                self.mesh_devices = n
+        # mean per-device live-lane occupancy accumulator (mesh mode):
+        # summed per-shard live counts + lane-slice count, read by
+        # mesh_snapshot() for the bench/summary accounting
+        self._dev_live_sum = [0] * max(1, self.mesh_devices)  # guarded-by: _lock
+        self._dev_live_n = 0       # guarded-by: _lock
         # in-kernel timing (obs.devclock): compiles the slice kernels'
         # timing variant, splits slice wall time into superstep compute
         # vs dispatch overhead, and — with slice_steps auto — re-prices
@@ -590,7 +694,8 @@ class BatchScheduler:
                 dummy = self._dummies[cls] = dummy_member(cls)
         t0 = time.perf_counter()
         warmed = 0
-        for b in pad_ladder(self.batch_max):
+        for b in pad_ladder(self.batch_max,
+                            min_pad=max(1, self.mesh_devices)):
             comb = np.repeat(dummy.comb[None], b, axis=0)
             degrees = np.zeros((b, cls.v_pad), np.int32)
             k0 = np.ones(b, np.int32)
@@ -654,6 +759,21 @@ class BatchScheduler:
         with self._lock:
             return dict(self.stats)
 
+    def mesh_snapshot(self) -> dict | None:
+        """Mesh-mode utilization summary, or None when the lane axis is
+        not sharded: the mesh size and each device's MEAN live-lane
+        occupancy over every dispatched slice/batch (the ``+shard``
+        bench accounting; the per-dispatch series rides the
+        ``serve_slice``/``serve_batch`` events)."""
+        if self.mesh is None:
+            return None
+        with self._lock:
+            n = self._dev_live_n
+            sums = list(self._dev_live_sum)
+        return {"mesh_devices": self.mesh_devices,
+                "device_occupancy": [round(s / n, 4) if n else 0.0
+                                     for s in sums]}
+
     # -- stage-ladder resolution ----------------------------------------
     def stages_for(self, cls):
         """The staged-frontier-ladder schedule this scheduler compiles
@@ -690,13 +810,25 @@ class BatchScheduler:
     # dgc-lint LK finding this section now locks against
     def _kernel_for(self, cls, b_pad: int):
         stages = self.stages_for(cls)
+        # the cache key is class × b_pad × statics — and × mesh shape
+        # when the lane axis is sharded (a sharded executable partitions
+        # differently per mesh size; the mesh-less key is unchanged so
+        # the unsharded path stays byte-identical)
         key = ("sync", cls.v_pad, cls.w_pad, cls.planes, b_pad, stages)
+        if self.mesh is not None:
+            key += ("mesh", self.mesh_devices)
         with self._lock:
             hit = key in self._kernels
             if not hit:
-                self._kernels[key] = lambda *a: batched_sweep_kernel(
-                    *a, planes=cls.planes, stall_window=self.stall_window,
-                    stages=stages)
+                if self.mesh is not None:
+                    self._kernels[key] = \
+                        lambda *a: batched_sweep_kernel_sharded(
+                            self.mesh, *a, planes=cls.planes,
+                            stall_window=self.stall_window, stages=stages)
+                else:
+                    self._kernels[key] = lambda *a: batched_sweep_kernel(
+                        *a, planes=cls.planes,
+                        stall_window=self.stall_window, stages=stages)
                 self.stats["compile_misses"] += 1
             else:
                 self.stats["compile_hits"] += 1
@@ -705,10 +837,16 @@ class BatchScheduler:
     def _slice_kernel_for(self, cls, b_pad: int):
         s = self.resolved_slice_steps(cls, b_pad)
         stages = self.stages_for(cls)
-        kern = (batched_slice_kernel_donated if self.device_carry
-                else batched_slice_kernel)
         key = ("slice", cls.v_pad, cls.w_pad, cls.planes, b_pad, s,
                self.timing, stages, self.device_carry)
+        if self.mesh is not None:
+            key += ("mesh", self.mesh_devices)
+            kern = partial(batched_slice_kernel_sharded_donated, self.mesh
+                           ) if self.device_carry else partial(
+                               batched_slice_kernel_sharded, self.mesh)
+        else:
+            kern = (batched_slice_kernel_donated if self.device_carry
+                    else batched_slice_kernel)
         with self._lock:
             hit = key in self._kernels
             if not hit:
@@ -952,7 +1090,8 @@ class BatchScheduler:
                     dummy = self._dummies[cls] = dummy_member(cls)
             pool = self._pools[cls] = _LanePool(
                 cls, 1, dummy, device=self.device_carry,
-                a_pad=stage_idx_width(self.stages_for(cls)))
+                a_pad=stage_idx_width(self.stages_for(cls)),
+                mesh=self.mesh)
 
         free = self.batch_max - pool.live
         admitted = 0
@@ -990,6 +1129,13 @@ class BatchScheduler:
             has_pending = bool(self._pending.get(cls))
         if not has_pending:
             pool.maybe_shrink()
+        # per-device occupancy (mesh mode): live lanes per shard at
+        # dispatch time — captured AFTER the shrink so the counts and
+        # the b_pad they normalize by describe the same pool width
+        # (pre-shrink counts over post-shrink width read > 1), and
+        # before the delivery loop clears done lanes (consistent with
+        # `live`)
+        dev_live = pool.device_live() if self.mesh is not None else None
 
         kernel, cache_hit = self._slice_kernel_for(cls, pool.b_pad)
         slice_steps = self.resolved_slice_steps(cls, pool.b_pad)
@@ -1125,6 +1271,10 @@ class BatchScheduler:
             self.stats["max_live"] = max(self.stats["max_live"], live)
             self.stats["h2d_bytes"] += h2d
             self.stats["d2h_bytes"] += d2h
+            if dev_live is not None:
+                for d, c in enumerate(dev_live):
+                    self._dev_live_sum[d] += c
+                self._dev_live_n += pool.b_pad // self.mesh_devices
         slice_span.end({"done": len(done_lanes), "admitted": int(admitted)})
         if self.on_event is not None:
             rec = {
@@ -1141,6 +1291,11 @@ class BatchScheduler:
                                     if slot_total else 0.0),
                 "h2d_bytes": int(h2d), "d2h_bytes": int(d2h),
             }
+            if dev_live is not None:
+                per = pool.b_pad // self.mesh_devices
+                rec["mesh_devices"] = int(self.mesh_devices)
+                rec["device_occupancy"] = [round(c / per, 4)
+                                           for c in dev_live]
             if sstep_s is not None:
                 rec["sstep_ms"] = round(sstep_s * 1e3, 3)
                 rec["overhead_ms"] = round(overhead_s * 1e3, 3)
@@ -1238,6 +1393,11 @@ class BatchScheduler:
         b_pad = min(_pow2_ceil(b), self.batch_max)
         if b_pad < b:   # batch_max not a power of two: pad up past it
             b_pad = _pow2_ceil(b)
+        if self.mesh is not None:
+            # the lane axis shards evenly: mesh mode always dispatches
+            # at a power-of-two pad ≥ the mesh size (a non-pow2
+            # batch_max pad like 6 would not divide over 4 devices)
+            b_pad = max(_pow2_ceil(b), self.mesh_devices)
         members = [c.member for c in calls]
         fill = b_pad - b
         if fill:
@@ -1278,6 +1438,11 @@ class BatchScheduler:
             self.stats["batches"] += 1
             self.stats["sweeps"] += b
             self.stats["max_live"] = max(self.stats["max_live"], b)
+            if self.mesh is not None:
+                per = b_pad // self.mesh_devices
+                for d in range(self.mesh_devices):
+                    self._dev_live_sum[d] += max(0, min(per, b - d * per))
+                self._dev_live_n += per
         if self.on_batch is not None:
             # straggler waste: the fraction of dispatched real-lane
             # supersteps spent re-running already-finished lanes while
@@ -1290,7 +1455,7 @@ class BatchScheduler:
                      if smax > 0 else 0.0)
             depths = {c.depth for c in calls}
             stages = self.stages_for(cls)
-            self.on_batch({
+            rec = {
                 "shape_class": cls.name, "batch": b, "b_pad": int(b_pad),
                 "occupancy": round(b / b_pad, 4),
                 "padding_waste": padding_waste([c.member for c in calls],
@@ -1301,7 +1466,17 @@ class BatchScheduler:
                 "device_ms": round(device_s * 1e3, 3),
                 "queue_ms_max": round(queue_ms_max, 3),
                 "stage_bodies": len(stages) if stages else 1,
-            })
+            }
+            if self.mesh is not None:
+                # real (non-dummy) lanes per shard — sync mode fills
+                # lanes 0..b-1 so shard d holds rows [d·per, (d+1)·per)
+                per = b_pad // self.mesh_devices
+                dev_live = [max(0, min(per, b - d * per))
+                            for d in range(self.mesh_devices)]
+                rec["mesh_devices"] = int(self.mesh_devices)
+                rec["device_occupancy"] = [round(c / per, 4)
+                                           for c in dev_live]
+            self.on_batch(rec)
         for i, call in enumerate(calls):
             call.result = (p1[i], s1[i], st1[i], int(np.asarray(used)[i]),
                            p2[i], s2[i], int(st2[i]))

@@ -201,6 +201,7 @@ class ServeFrontEnd:
                  mode: str = "continuous", slice_steps: int | None = None,
                  affinity: bool = True,
                  stages="auto", device_carry: bool = False,
+                 mesh_devices=None,
                  timing: bool = False, trace: bool = True,
                  validate: bool = True, post_reduce: bool = True,
                  auto_tune: bool = False, tuned_cache=None,
@@ -243,6 +244,7 @@ class ServeFrontEnd:
                                         affinity=affinity, timing=timing,
                                         stages=stages,
                                         device_carry=device_carry,
+                                        mesh_devices=mesh_devices,
                                         tuned_cache=self._tuned_cache,
                                         max_lane_aborts=max_lane_aborts,
                                         dispatch_timeout_s=dispatch_timeout,
@@ -307,6 +309,10 @@ class ServeFrontEnd:
                                  name=f"dgc-serve-worker-{i}")
             t.start()
             self._threads.append(t)
+        # the mesh field appears only when the lane axis is actually
+        # sharded, so the unsharded event stream stays byte-identical
+        mesh_kw = ({"mesh_devices": self.scheduler.mesh_devices}
+                   if self.scheduler.mesh is not None else {})
         self._event("serve_start", batch_max=self.batch_max,
                     window_ms=round(self.scheduler.window_s * 1e3, 3),
                     queue_depth=self.queue_depth, workers=self.workers,
@@ -318,7 +324,7 @@ class ServeFrontEnd:
                             if isinstance(self.scheduler.stages, str)
                             else "custom"),
                     device_carry=self.scheduler.device_carry,
-                    tracing=self.tracer.enabled)
+                    tracing=self.tracer.enabled, **mesh_kw)
         return self
 
     def warm(self, class_names: list) -> dict:

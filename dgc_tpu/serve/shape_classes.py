@@ -193,20 +193,28 @@ def dummy_member(cls: ShapeClass) -> ServeMember:
     )
 
 
-def pad_ladder(batch_max: int) -> tuple:
+def pad_ladder(batch_max: int, min_pad: int = 1) -> tuple:
     """Every batch pad a ``batch_max``-lane scheduler can dispatch at,
     widest first: the power-of-two ladder (the adaptive lane pool grows
     by doubling and shrinks to the live set's pad; sync mode pads
     partial batches up to pow2), plus ``batch_max`` itself when it is
     not a power of two (sync full batches dispatch unpadded at it).
     This IS the compiled-kernel pad set per class — what
-    ``--warm-classes`` pre-compiles."""
+    ``--warm-classes`` pre-compiles.
+
+    ``min_pad`` (a power of two) floors the ladder: a lane-sharded
+    scheduler (``--mesh-devices``) never dispatches below the mesh size
+    — its pools pad lanes in mesh multiples and every dispatch is a
+    power-of-two pad, so the narrow rungs (and the non-pow2
+    ``batch_max`` pad) would compile executables that never run."""
+    min_pad = max(1, int(min_pad))
     b = 1 << max(0, (int(batch_max) - 1).bit_length())
+    b = max(b, min_pad)
     pads = []
-    while b >= 1:
+    while b >= min_pad:
         pads.append(b)
         b //= 2
-    if batch_max not in pads:
+    if min_pad == 1 and batch_max not in pads:
         pads.append(int(batch_max))
         pads.sort(reverse=True)
     return tuple(pads)

@@ -1,18 +1,44 @@
 """Test env: an 8-device virtual CPU platform for multi-device tests.
 
-Multi-device behavior (shard_map engines, collectives) is exercised on a
-virtual 8-device CPU mesh per the build plan (SURVEY.md §7.2 step 5) — no
-TPU pod needed in CI.
+Multi-device behavior (shard_map engines, collectives, the lane-sharded
+serve tier) is exercised on a virtual 8-device CPU mesh per the build
+plan (SURVEY.md §7.2 step 5) — no TPU pod needed in CI.
 
-This image's sitecustomize (PYTHONPATH=/root/.axon_site) pre-imports JAX and
-pins the axon TPU backend before conftest runs, so env tweaks here would be
-too late. If JAX arrives pre-imported, re-exec pytest once with a clean
-PYTHONPATH and JAX_PLATFORMS=cpu; the re-exec'd process then configures 8
-virtual CPU devices before any backend initializes.
+Forcing 8 devices: this jax (0.4.37) predates the ``jax_num_cpu_devices``
+config option, so the ONLY lever is the XLA flag
+``--xla_force_host_platform_device_count=8``, which must be in the
+environment BEFORE the first jax import initializes a backend. Two
+paths get it there:
+
+- normally conftest imports before jax, so :func:`_force_host_devices`
+  below appends the flag to ``XLA_FLAGS`` and the in-process import
+  sees 8 devices;
+- this image's sitecustomize (PYTHONPATH=/root/.axon_site) may
+  pre-import JAX and pin the axon TPU backend before conftest runs — in
+  that case env tweaks are too late and pytest re-execs ONCE with a
+  clean PYTHONPATH, JAX_PLATFORMS=cpu, and the forced XLA flag.
+
+If neither works (the re-exec already happened and the device count is
+still 1 — some embedding process imported jax with a pinned backend),
+the multi-device test modules skip cleanly via their own
+``skipif(jax.device_count() < 8)`` guards instead of failing, and the
+``DGC_TPU_TEST_ON_TPU=1`` escape hatch disables forcing entirely so the
+suite can run against a real chip's native device set.
 """
 
 import os
 import sys
+
+_FORCE_FLAG = "--xla_force_host_platform_device_count=8"
+
+
+def _force_host_devices(env: dict) -> None:
+    """Append the 8-device forcing flag to ``env``'s XLA_FLAGS (idempotent;
+    a caller-provided device-count flag wins)."""
+    flags = env.get("XLA_FLAGS", "")
+    if "xla_force_host_platform_device_count" not in flags:
+        env["XLA_FLAGS"] = (flags + " " + _FORCE_FLAG).strip()
+
 
 if (
     "jax" in sys.modules
@@ -23,9 +49,12 @@ if (
     env["PYTHONPATH"] = ""
     env["JAX_PLATFORMS"] = "cpu"
     env["DGC_TPU_TEST_REEXEC"] = "1"
+    _force_host_devices(env)
     os.execve(sys.executable, [sys.executable, "-m", "pytest", *sys.argv[1:]], env)
 
 os.environ.setdefault("JAX_PLATFORMS", "cpu")
+if os.environ.get("DGC_TPU_TEST_ON_TPU") != "1":
+    _force_host_devices(os.environ)
 
 # flight-recorder dumps (obs.flightrec) default to the process cwd — the
 # right breadcrumb for a real aborted run, the wrong one for a test suite

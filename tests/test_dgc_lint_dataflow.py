@@ -321,16 +321,96 @@ def test_transfer_real_serve_tier_is_clean():
 def test_tr002_mutation_pr9_cse_aliasing_is_caught():
     """Acceptance: re-introduce the PR 9 heap corruption — a shared
     ``jnp.zeros`` constant fed through every slot of the permute base —
-    and TR002 must catch it."""
+    and TR002 must catch it. The one base-construction site seeds BOTH
+    the single-device and the lane-sharded permute (``pool._put``), so
+    this mutation covers the sharded donation-seeding path too."""
     real = (ROOT / "dgc_tpu/serve/engine.py").read_text()
     mut = real.replace(
-        "            base = tuple(jax.device_put(a) for a in carry)",
+        "            base = tuple(self._put(a) for a in carry)",
         "            zeros = jnp.zeros((b_pad,), jnp.int32)\n"
         "            base = (zeros,) * CARRY_LEN")
     assert mut != real, "mutation anchor out of sync with engine.py"
     got = [f for f in _real_transfer(mut) if f.rule == "TR002"]
-    assert len(got) == 1
-    assert "permute_carry_kernel" in got[0].detail
+    # the poisoned base reaches both permute call sites (mesh and
+    # single-device branches of _resize)
+    assert 1 <= len(got) <= 2
+    assert all("permute_carry_kernel" in f.detail for f in got)
+
+
+def test_tr002_sharded_permute_fixture():
+    """The lane-sharded donation-seeding path stays a mutation-tested
+    rule: ``permute_carry_kernel_sharded`` carries the same
+    ``distinct-buffers`` contract (its outputs seed the next DONATED
+    sharded slice call), so per-shard-equal device constants in its
+    base must flag and distinct ``device_put`` buffers must not —
+    sharding a buffer does not make CSE aliasing safe."""
+    bad = '''
+import jax
+import jax.numpy as jnp
+
+def permute_carry_kernel_sharded(mesh, carry, base, src, dst):  # dgc-lint: distinct-buffers
+    return _jit(mesh)(carry, base, src, dst)
+
+def resize(mesh, old, src, dst):
+    base = (jnp.zeros((8,), jnp.int32), jnp.zeros((8,), jnp.int32))
+    return permute_carry_kernel_sharded(mesh, old, base, src, dst)  # TR002
+'''
+    got = _transfer([SourceModule("fix/t.py", bad)])
+    assert rules_of(got) == {"TR002"}
+    clean = '''
+import jax
+
+def permute_carry_kernel_sharded(mesh, carry, base, src, dst):  # dgc-lint: distinct-buffers
+    return _jit(mesh)(carry, base, src, dst)
+
+def resize(mesh, lane_sh, old, idle, src, dst):
+    base = tuple(jax.device_put(a, lane_sh) for a in idle)  # distinct
+    return permute_carry_kernel_sharded(mesh, old, base, src, dst)
+'''
+    assert _transfer([SourceModule("fix/t.py", clean)]) == []
+
+
+def test_tr005_mutation_ungated_sharded_factory_is_caught():
+    """Acceptance against the REAL tree: strip the DGC_TPU_DONATE_CARRY
+    gate from the sharded slice-kernel factory's donation — TR005 must
+    flag the unconditional donation (the jax-0.4.37 persistent-cache
+    aliasing bug is placement-independent, so the sharded path needs
+    the same gate + fallback twin as the single-device one)."""
+    real = (ROOT / "dgc_tpu/serve/batched.py").read_text()
+    mut = real.replace(
+        '    kw = {"donate_argnums": (5,)} if (donate and _DONATE_CARRY)'
+        ' else {}',
+        '    kw = {"donate_argnums": (5,)}')
+    assert mut != real, "TR005 mutation anchor out of sync with batched.py"
+    consts, d2h = _layout()
+    mods = [SourceModule("dgc_tpu/serve/batched.py", mut)]
+    got = [f for f in check_transfer(mods, layout_consts=consts,
+                                     d2h_slots=d2h)
+           if f.rule == "TR005"]
+    assert got, "ungated sharded donation not caught"
+
+
+def test_tr002_mutation_sharded_base_cse_is_caught():
+    """Acceptance against the REAL tree: collapse the mesh-mode permute
+    base into per-slot-equal sharded constants (`jnp.zeros` device_put
+    through one name) — the sharded heap-corruption class — and TR002
+    must catch it at the sharded permute call."""
+    real = (ROOT / "dgc_tpu/serve/engine.py").read_text()
+    mut = real.replace(
+        "            if self.mesh is not None:\n"
+        "                carry = permute_carry_kernel_sharded(self.mesh, "
+        "dev_old,\n"
+        "                                                     base, src, "
+        "dst)",
+        "            if self.mesh is not None:\n"
+        "                zs = jnp.zeros((b_pad,), jnp.int32)\n"
+        "                carry = permute_carry_kernel_sharded(self.mesh, "
+        "dev_old,\n"
+        "                                                     (zs,) * "
+        "CARRY_LEN, src, dst)")
+    assert mut != real, "sharded mutation anchor out of sync with engine.py"
+    got = [f for f in _real_transfer(mut) if f.rule == "TR002"]
+    assert any("permute_carry_kernel_sharded" in f.detail for f in got)
 
 
 def test_tr001_mutation_post_donation_read_is_caught():

@@ -165,6 +165,7 @@ def test_ring_bucketed_tables_bit_identical_rmat():
     assert bkt_entries < 8 * g.num_directed_edges
 
 
+@needs8
 def test_ring_bucketed_auto_selects_on_heavy_tail():
     from dgc_tpu.models.generators import generate_rmat_graph, generate_random_graph
 

@@ -6,6 +6,7 @@ whose max degree far exceeds the flat engines' representable range — the
 multi-chip capability VERDICT r1 flagged as missing.
 """
 
+import jax
 import numpy as np
 import pytest
 
@@ -16,6 +17,12 @@ from dgc_tpu.engine.sharded_bucketed import ShardedBucketedEngine, build_sharded
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.models.generators import generate_random_graph, generate_rmat_graph
 from dgc_tpu.ops.validate import validate_coloring
+
+# conftest forces 8 virtual CPU devices (XLA_FLAGS); skip cleanly when
+# forcing was impossible instead of failing tier-1 forever
+pytestmark = pytest.mark.skipif(
+    jax.device_count() < 8,
+    reason="needs 8 (virtual) devices; forcing impossible in this process")
 
 
 @pytest.mark.parametrize("num_shards", [1, 2, 8])

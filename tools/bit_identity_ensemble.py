@@ -46,7 +46,10 @@ ladder runs at its shipped default (``stages="auto"``), and the draw
 sizes reach the v32768 class where the ladder actually engages — so the
 committed ensemble also locks bit-identity across compaction-stage
 boundaries; ``--serve-device-carry`` re-runs it with the donated
-device-resident carry.
+device-resident carry, and ``--serve-mesh-devices N`` re-runs it with
+the lane axis sharded over an N-device mesh (the committed
+``serve_parity.jsonl`` is generated under a forced 8-host-device mesh:
+``XLA_FLAGS=--xla_force_host_platform_device_count=8``).
 
 One JSON line per draw, nonzero exit on any mismatch.
 """
@@ -110,6 +113,7 @@ def serve_mode(args) -> int:
                                         if args.serve_mode == "continuous"
                                         else None),
                            device_carry=args.serve_device_carry,
+                           mesh_devices=args.serve_mesh_devices,
                            timing=telemetry, trace=telemetry,
                            logger=logger, registry=registry).start()
         try:
@@ -176,6 +180,7 @@ def serve_mode(args) -> int:
                    slices=stats_obs.get("slices", 0),
                    stages="auto",
                    device_carry=bool(args.serve_device_carry),
+                   mesh_devices=(args.serve_mesh_devices or 0),
                    telemetry="events+metrics+trace+kernel_timing")
     print(json.dumps(summary))
     if out:
@@ -210,6 +215,14 @@ def main() -> int:
                         "device-resident carry (donated slice kernels + "
                         "on-device lane seating) — bit-identity must "
                         "hold there too")
+    p.add_argument("--serve-mesh-devices", type=int, default=None,
+                   help="run the --serve ensemble with the lane axis "
+                        "sharded over this many local devices (the "
+                        "serve CLI's --mesh-devices N; run under "
+                        "XLA_FLAGS=--xla_force_host_platform_device_"
+                        "count=8 on a CPU host) — colors, supersteps, "
+                        "and attempt sequences must stay byte-identical "
+                        "to the single-device scheduler")
     args = p.parse_args()
     if args.serve:
         return serve_mode(args)

@@ -119,11 +119,15 @@ def _stand_stack(workdir: str, args, logger):
     from dgc_tpu.serve.netfront import NetFront
     from dgc_tpu.serve.queue import ServeFrontEnd
 
+    mesh_devices = getattr(args, "mesh_devices", None)
+    if mesh_devices not in (None, "auto"):
+        mesh_devices = int(mesh_devices)
     front = ServeFrontEnd(
         batch_max=args.batch_max, window_s=0.0,
         queue_depth=max(64, args.clients * args.requests_per_client * 2),
         dispatch_timeout=args.dispatch_timeout,
         max_lane_aborts=args.max_lane_aborts,
+        mesh_devices=mesh_devices,
         logger=logger).start()
     nf = NetFront(front, logger=logger,
                   journal_dir=os.path.join(workdir, "journal")).start()
@@ -633,6 +637,13 @@ def main(argv: list[str] | None = None) -> int:
                    help="dispatch watchdog deadline for the stacks under "
                         "test (injected hangs must recover through it)")
     p.add_argument("--max-lane-aborts", type=int, default=3)
+    p.add_argument("--mesh-devices", type=str, default=None,
+                   metavar="auto|N",
+                   help="run leg 1's serving stack with the lane axis "
+                        "sharded over the local devices (the serve "
+                        "CLI's --mesh-devices) — proves fault recovery "
+                        "(quarantine, watchdog rebuild, reseat) "
+                        "composes with sharding")
     p.add_argument("--deadline", type=float, default=180.0,
                    help="per-leg hard deadline; a run past it is a "
                         "chaos failure (hang)")

@@ -18,6 +18,12 @@
 #      kill-resume cycle through tools/chaos_serve.py — recover or
 #      structured abort at every serve fault point, zero acked-ticket
 #      loss across the restart, colors bit-identical to fault-free.
+#   7. sharded serve-parity smoke (multi-device serve tier, same skip):
+#      3 draws of the batched-vs-single bit-identity ensemble with the
+#      lane axis sharded over a FORCED 8-host-device mesh
+#      (XLA_FLAGS=--xla_force_host_platform_device_count=8) — colors,
+#      supersteps, and attempt sequences byte-identical to the
+#      single-graph sweep under sharding, seconds-scale.
 # Steps 1-3 are AST-only (seconds); steps 4-5 compile toy kernels on
 # CPU (~1-2 min cold) — the only gates that prove the profiler and
 # serving-over-the-network plumbing end-to-end before device time is
@@ -146,6 +152,32 @@ EOF
     echo "ci_checks: chaos-serve smoke OK" >&2
   else
     echo "ci_checks: chaos-serve smoke FAILED" >&2
+    rc=1
+  fi
+  # sharded serve-parity smoke (multi-device serve tier): a 3-draw leg
+  # of the bit-identity ensemble with --mesh-devices over a forced
+  # 8-host-device mesh — the cheapest end-to-end proof that the sharded
+  # compile path (Mesh + NamedSharding over the lane axis) stays
+  # byte-identical to the single-device scheduler; the committed
+  # 12-draw artifact is tools/serve_parity.jsonl
+  if PYTHONPATH=. JAX_PLATFORMS=cpu \
+      XLA_FLAGS="--xla_force_host_platform_device_count=8" \
+      timeout 560 python tools/bit_identity_ensemble.py --serve \
+      --draws 3 --serve-slice-steps 2 --serve-mesh-devices 8 \
+      --out "$SMOKE_DIR/serve_parity_mesh.jsonl" >/dev/null 2>&1 \
+    && python - "$SMOKE_DIR/serve_parity_mesh.jsonl" <<'EOF'
+import json, sys
+lines = [json.loads(ln) for ln in open(sys.argv[1])]
+summary = lines[-1]
+assert summary["mismatches"] == 0, summary
+assert summary["mesh_devices"] == 8, summary
+print("ci_checks: sharded serve parity %d draw(s), 0 mismatches"
+      % summary["draws"], file=sys.stderr)
+EOF
+  then
+    echo "ci_checks: sharded serve-parity smoke OK" >&2
+  else
+    echo "ci_checks: sharded serve-parity smoke FAILED" >&2
     rc=1
   fi
   rm -rf "$SMOKE_DIR"

@@ -109,6 +109,28 @@ if [ "$battery_rc" -ne 2 ]; then
     --serve-modes continuous,continuous+nostage,continuous+devcarry --perf-db PERF_DB.jsonl 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
+  # multi-device serve A/B (ROADMAP 2(a)): the same 64-graph stream
+  # with the lane axis sharded over every local chip (+shard: Mesh +
+  # NamedSharding over the batch axis, per-device occupancy in the
+  # record's `mesh` slot) vs the single-device scheduler. The CPU
+  # 8-host-device A/B (PERF.md "Multi-device serve tier") can only
+  # prove bit-identity and accounting — forced host devices SHARE one
+  # core, so its wall-clock is a prediction, not a result; the TPU
+  # question is the real one: does one host serve ~N devices' worth of
+  # lanes at the wide batch widths (batch 32/64 over N chips), and
+  # where does the per-slice all-reduce of the executed rung start to
+  # bite. The sharded parity leg re-proves bit-identity on real chips
+  # before the throughput rows are trusted.
+  echo "=== multi-device serve A/B (20k class, +shard, batch 8/32/64) ===" | tee -a /dev/stderr >/dev/null
+  timeout 1200 env PYTHONPATH=. python tools/bit_identity_ensemble.py --serve \
+    --draws 6 --serve-slice-steps 2 --serve-mesh-devices "$(python -c 'import jax; n=len(jax.devices()); print(1 << max(0, n.bit_length()-1))')" \
+    --out serve_parity_mesh_tpu.jsonl 2>&1 \
+    | tee -a /dev/stderr >/dev/null || true
+  timeout 7200 python bench.py --serve-throughput \
+    --serve-graphs 64 --serve-batch-sizes 8,32,64 \
+    --serve-modes continuous,continuous+shard --perf-db PERF_DB.jsonl 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+
   # in-kernel timing column cross-check (PR 7 queued it, PR 11 tooled
   # it): ONE 200k-RMAT run with --superstep-timing (the trajectory
   # buffer's col-5 device wall-time) AND a --profile-window over every

@@ -125,7 +125,18 @@ def render(doc: dict, width: int = 48) -> str:
         add(f"serve:    batch_max={cfg.get('batch_max')} "
             f"window_ms={cfg.get('window_ms')} "
             f"queue_depth={cfg.get('queue_depth')}"
-            + (f" mode={cfg.get('mode')}" if cfg.get("mode") else ""))
+            + (f" mode={cfg.get('mode')}" if cfg.get("mode") else "")
+            + (f" mesh_devices={cfg.get('mesh_devices')}"
+               if cfg.get("mesh_devices") else ""))
+        summ0 = sv.get("summary") or {}
+        if summ0.get("device_occupancy"):
+            # multi-device serve tier: mean live-lane occupancy per mesh
+            # device over the whole run (the serve_slice events carry
+            # the per-dispatch series)
+            occ_d = summ0["device_occupancy"]
+            add(f"  mesh: {summ0.get('mesh_devices')} device(s), mean "
+                f"per-device occupancy "
+                + " ".join(f"{x:.2f}" for x in occ_d))
         warm = sv.get("warmup")
         if warm:
             add(f"  warmup: {warm.get('kernels')} kernel(s) over "

@@ -150,6 +150,29 @@ def _semantic_problems(record: dict) -> list[str]:
             v = record.get(fieldname)
             if isinstance(v, int) and not isinstance(v, bool) and v < 0:
                 problems.append(f"lane_rebuild: {fieldname} {v} < 0")
+    # multi-device serve tier (--mesh-devices): the lane mesh is ≥ 2
+    # devices when reported at all (size 1 is the unsharded path and
+    # emits no mesh fields), and the per-device occupancy series has
+    # one [0, 1] entry per mesh device
+    if kind in ("serve_start", "serve_slice", "serve_batch",
+                "serve_summary"):
+        mesh_n = record.get("mesh_devices")
+        if mesh_n is not None and isinstance(mesh_n, int) and mesh_n < 2:
+            problems.append(f"{kind}: mesh_devices {mesh_n} < 2 (the "
+                            f"unsharded path emits no mesh fields)")
+        occ = record.get("device_occupancy")
+        if occ is not None and isinstance(occ, list):
+            if isinstance(mesh_n, int) and len(occ) != mesh_n:
+                problems.append(
+                    f"{kind}: device_occupancy has {len(occ)} entries "
+                    f"for mesh_devices={mesh_n}")
+            for x in occ:
+                if not isinstance(x, (int, float)) or isinstance(x, bool) \
+                        or x < 0 or x > 1:
+                    problems.append(
+                        f"{kind}: device_occupancy entry {x!r} outside "
+                        f"[0, 1]")
+                    break
     return problems
 
 
