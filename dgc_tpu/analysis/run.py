@@ -60,6 +60,12 @@ LOCK_FILES = ("dgc_tpu/obs/metrics.py", "dgc_tpu/obs/httpd.py",
               # threads and worker callbacks append under the journal
               # cond while the flusher thread group-commits fsyncs
               "dgc_tpu/serve/netfront/journal.py",
+              # failure-domain plane: the dispatcher mutates health/
+              # state-machine fields that /healthz handler threads read
+              "dgc_tpu/resilience/domains.py",
+              # write-behind checkpoints: the sweep thread hands
+              # snapshots to the writer thread under the manager's cond
+              "dgc_tpu/utils/checkpoint.py",
               "tools/soak.py", "bench.py")
 TRANSFER_FILES = ("dgc_tpu/serve/batched.py", "dgc_tpu/serve/engine.py")
 

@@ -78,6 +78,17 @@ def build_parser() -> argparse.ArgumentParser:
         help="decrement k one-by-one like the reference instead of jumping to colors_used-1",
     )
     p.add_argument("--checkpoint-dir", type=str, default=None, help="checkpoint/resume directory")
+    p.add_argument(
+        "--checkpoint-write-behind", action="store_true",
+        help="stream checkpoints off the sweep clock (failure-domain "
+             "plane): save() double-buffers the attempt state onto a "
+             "background writer thread (newest pending snapshot wins, "
+             "colors copied, no fsync on the attempt boundary) and "
+             "restore/fallback flush first — on-disk artifacts are "
+             "byte-compatible with the synchronous manager's; a crash "
+             "costs at most one attempt of (deterministically re-run) "
+             "progress",
+    )
     p.add_argument("--log-json", type=str, default=None, help="write structured JSONL run log")
     # observability (dgc_tpu.obs): both flags enable in-kernel superstep
     # telemetry — the fused kernels record per-superstep metrics in the
@@ -179,8 +190,21 @@ def build_parser() -> argparse.ArgumentParser:
     p.add_argument(
         "--inject-faults", type=str, default=None, metavar="SPEC",
         help="deterministic fault schedule for chaos testing, e.g. "
-             "'attempt@2=transient,checkpoint_write@1=truncate' "
-             "(POINT@N=KIND[:PARAM]; see dgc_tpu.resilience.faults)",
+             "'attempt@2=transient,checkpoint_write@1=truncate' or "
+             "'mesh@1=device_loss:3' (POINT@N=KIND[:PARAM]; see "
+             "dgc_tpu.resilience.faults)",
+    )
+    p.add_argument(
+        "--reshard-on-loss", action="store_true",
+        help="failure-domain resilience for the sharded backends: "
+             "insert a re-shard rung (--backend rebuilt over one fewer "
+             "device, e.g. sharded@7) between the primary rung and the "
+             "single-device fallback ladder, SHARING the primary's "
+             "checkpoint namespace — a device loss resumes the sweep on "
+             "N−1 devices from the last attempt checkpoint (exact: the "
+             "sharded engines are shard-count-invariant bit-for-bit) "
+             "before conceding to single-device engines; requires "
+             "--shards (the ladder is built before device init)",
     )
     p.add_argument(
         "--skip-graph-validation", action="store_true",
@@ -223,6 +247,27 @@ def build_parser() -> argparse.ArgumentParser:
 
 # backends whose constructors accept tuned-schedule overrides
 _TUNABLE_BACKENDS = frozenset({"ell-compact", "sharded-bucketed"})
+
+# multi-device backends — the only ones re-shard rungs apply to
+_SHARDED_BACKENDS = frozenset({"sharded", "sharded-bucketed",
+                               "sharded-ring"})
+
+
+def _rung_base(name: str) -> str:
+    """A ladder rung's engine backend: ``sharded@7`` → ``sharded``. A
+    re-shard rung (``resilience.domains.reshard_ladder``) is the SAME
+    engine rebuilt over fewer devices, and shares the base backend's
+    checkpoint namespace — shard-count invariance makes resuming the
+    primary rung's checkpoint on fewer devices exact."""
+    return name.split("@", 1)[0]
+
+
+def _rung_shards(name: str) -> int | None:
+    """The re-shard rung's device count (``sharded@7`` → 7), or None
+    for a plain rung. Raises ValueError on a malformed suffix."""
+    if "@" not in name:
+        return None
+    return int(name.split("@", 1)[1])
 
 
 def resolve_tuned_config(args, graph: Graph, logger=None, phases=None):
@@ -365,10 +410,19 @@ def main(argv: list[str] | None = None) -> int:
         return 2
 
     logger = RunLogger(jsonl_path=args.log_json)
+    args._ckpts = []   # write-behind managers needing a flush at exit
     try:
         return _run(args, logger)
     finally:
         faults.uninstall()  # in-process callers must not leak a fault plane
+        for m in args._ckpts:
+            close = getattr(m, "close", None)
+            if close is not None:
+                try:
+                    close()
+                except Exception as e:   # a torn writer must not mask rc
+                    print(f"# checkpoint writer close failed: {e}",
+                          file=sys.stderr)
         logger.close()
 
 
@@ -386,6 +440,8 @@ def _write_obs_outputs(args, logger, manifest, phases, registry) -> None:
 
 def _run(args, logger: RunLogger) -> int:
     t_start = time.perf_counter()
+    if not hasattr(args, "_ckpts"):
+        args._ckpts = []   # direct _run callers (tests) skip main()
 
     # obs subsystem: registry/phases always collect (cheap host-side);
     # manifest + in-kernel trajectories are opt-in via the flags
@@ -483,7 +539,8 @@ def _run(args, logger: RunLogger) -> int:
     # with all of them unset the driver takes the exact pre-resilience path
     # below (bit-identical output, no proxy in the dispatch chain)
     resilient = bool(args.retries > 0 or args.attempt_timeout > 0
-                     or args.fallback_ladder or args.inject_faults)
+                     or args.fallback_ladder or args.inject_faults
+                     or args.reshard_on_loss)
     if args.inject_faults:
         try:
             schedule = faults.FaultSchedule.parse(args.inject_faults)
@@ -529,14 +586,29 @@ def _run(args, logger: RunLogger) -> int:
     def make_ckpt(backend: str, per_rung: bool = False):
         if not args.checkpoint_dir:
             return None
-        from dgc_tpu.utils.checkpoint import CheckpointManager, graph_fingerprint
-        directory = (os.path.join(args.checkpoint_dir, f"rung_{backend}")
+        from dgc_tpu.utils.checkpoint import (CheckpointManager,
+                                              WriteBehindCheckpointManager,
+                                              graph_fingerprint)
+
+        # a re-shard rung (sharded@7) keys by its BASE backend, so it
+        # resumes the primary sharded rung's checkpoint — exact by
+        # shard-count invariance; distinct engines keep distinct
+        # namespaces exactly as before
+        base = _rung_base(backend)
+        directory = (os.path.join(args.checkpoint_dir, f"rung_{base}")
                      if per_rung else args.checkpoint_dir)
-        return CheckpointManager(
+        manager_cls = (WriteBehindCheckpointManager
+                       if args.checkpoint_write_behind
+                       else CheckpointManager)
+        m = manager_cls(
             directory,
-            fingerprint=graph_fingerprint(graph.arrays, backend,
+            fingerprint=graph_fingerprint(graph.arrays, base,
                                           args.strict_decrement),
         )
+        # write-behind managers are flushed/closed by main()'s finally —
+        # a completed run must not exit with a snapshot still in flight
+        args._ckpts.append(m)
+        return m
 
     if resilient:
         if args.fallback_ladder:
@@ -544,16 +616,49 @@ def _run(args, logger: RunLogger) -> int:
                 b.strip() for b in args.fallback_ladder.split(",") if b.strip()]
         else:
             ladder_names = default_ladder(args.backend)
+        if args.reshard_on_loss:
+            if args.backend not in _SHARDED_BACKENDS:
+                print(f"warning: --reshard-on-loss only applies to the "
+                      f"sharded backends ({', '.join(sorted(_SHARDED_BACKENDS))}); "
+                      f"ignored for --backend {args.backend}",
+                      file=sys.stderr)
+            elif not args.shards or args.shards < 2:
+                # the ladder is built before device init (the probe
+                # watchdog must stay the only thing that touches a
+                # possibly-dead backend), so the device count cannot be
+                # discovered here
+                print("--reshard-on-loss needs --shards N (>= 2): the "
+                      "re-shard rung is the same engine over N-1 devices",
+                      file=sys.stderr)
+                return 2
+            else:
+                from dgc_tpu.resilience.domains import reshard_ladder
+
+                # primary + re-shard rung(s), then the configured (or
+                # default) single-device suffix below the primary
+                ladder_names = (reshard_ladder(args.backend, args.shards)
+                                + ladder_names[1:])
         for name in ladder_names:
-            if name not in _ALL_BACKENDS:
+            base, suffix_ok = _rung_base(name), True
+            try:
+                sh = _rung_shards(name)
+                suffix_ok = sh is None or (sh >= 1
+                                           and base in _SHARDED_BACKENDS)
+            except ValueError:
+                suffix_ok = False
+            if base not in _ALL_BACKENDS or not suffix_ok:
                 print(f"Unknown backend {name!r} in --fallback-ladder "
-                      f"(choose from {', '.join(_ALL_BACKENDS)})", file=sys.stderr)
+                      f"(choose from {', '.join(_ALL_BACKENDS)}; re-shard "
+                      f"rungs look like sharded@N)", file=sys.stderr)
                 return 2
 
         def rung_factory(name: str):
             def build():
                 rung_args = argparse.Namespace(**vars(args))
-                rung_args.backend = name
+                rung_args.backend = _rung_base(name)
+                sh = _rung_shards(name)
+                if sh is not None:
+                    rung_args.shards = sh   # the re-shard rung's mesh
                 with phases.section("host_engine_build"):
                     eng = make_engine(rung_args, graph, logger=logger)
                 if (args.superstep_timing and telemetry

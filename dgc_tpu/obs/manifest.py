@@ -128,7 +128,8 @@ class RunManifest:
                     t["rejected"][reason] = t["rejected"].get(reason, 0) + 1
         elif (kind.startswith("serve_")
               or kind in ("lane_recycled", "slice_recalibrated",
-                          "lane_rebuild")):
+                          "lane_rebuild", "mesh_degrade",
+                          "mesh_restore")):
             # serving path (dgc_tpu.serve) — the slot appears only when
             # serve events do, so non-serve manifests stay byte-identical
             serve = self.doc.setdefault(
@@ -152,6 +153,11 @@ class RunManifest:
                 # fault-plane recoveries (dispatch abort / watchdog
                 # hang): the serve tier's resilience provenance
                 serve.setdefault("rebuilds", []).append(fields)
+            elif kind in ("mesh_degrade", "mesh_restore"):
+                # failure-domain plane: every mesh reshape with its
+                # direction — the degraded tier's restart provenance
+                serve.setdefault("mesh_events", []).append(
+                    dict(fields, event=kind))
             elif kind == "serve_warmup":
                 serve["warmup"] = fields
             elif kind == "serve_request":

@@ -152,6 +152,20 @@ EVENT_SCHEMAS: dict = {
         {"shape_class": "str", "reason": "str"},
         {"reseated": "int", "quarantined": "int", "aborts_max": "int",
          "error": ("str", "null")}),
+    # failure-domain plane (resilience.domains): a device loss
+    # re-sharded the lane axis onto the largest surviving power-of-two
+    # sub-mesh (mesh_degrade; devices_after 1 = collapsed to the
+    # unsharded path), or a healthy-again mesh was rebuilt at full size
+    # (mesh_restore). reseated counts the live lanes evacuated and
+    # requeued; validate_runlog enforces the direction (degrade shrinks,
+    # restore grows) and count non-negativity
+    "mesh_degrade": (
+        {"devices_before": "int", "devices_after": "int"},
+        {"lost_device": ("int", "null"), "reseated": "int",
+         "quarantined": "int", "error": ("str", "null")}),
+    "mesh_restore": (
+        {"devices_before": "int", "devices_after": "int"},
+        {"reseated": "int"}),
     # slice-size recalibration from the measured overhead/compute split
     # (timing mode, slice_steps auto): once per shape class
     "slice_recalibrated": (
@@ -207,7 +221,11 @@ EVENT_SCHEMAS: dict = {
         {"ready": "bool", "queue_depth": "int"},
         {"in_flight": "int", "capacity": "int", "degraded": "bool",
          "backend": ("str", "null"), "rung": ("int", "null"),
-         "retry_pressure": "int"}),
+         "retry_pressure": "int",
+         # failure-domain mesh state (mesh mode only): devices
+         # total/surviving, degraded flag, per-device health — the
+         # /healthz mesh block verbatim
+         "mesh": "dict"}),
     "serve_done": (
         {"requests": "int", "completed": "int", "failed": "int"},
         {"rejected": "int"}),
@@ -254,7 +272,10 @@ EVENT_SCHEMAS: dict = {
          "h2d_mb": NUM, "d2h_mb": NUM,
          # lane-mesh summary (mesh mode only): mesh size + each
          # device's MEAN live-lane occupancy over the whole run
-         "mesh_devices": "int", "device_occupancy": "list"}),
+         "mesh_devices": "int", "device_occupancy": "list",
+         # failure-domain plane: degrades survived and live lanes
+         # evacuated across them (present only when a degrade happened)
+         "mesh_degrades": "int", "lanes_evacuated": "int"}),
 }
 
 

@@ -14,12 +14,21 @@ def make_mesh(num_devices: int | None = None, devices=None) -> Mesh:
     """1-D mesh over the vertex axis. ``num_devices=None`` uses all local
     devices (the reference hardcodes ``local[*]``, ``coloring.py:192``; here
     the mesh is discovered)."""
+    # failure-domain test plane (resilience.faults): a mesh@N=device_loss
+    # schedule makes the Nth mesh construction fail like a host whose
+    # device dropped between attempts — the supervisor's re-shard rung
+    # (built with fewer shards) is the next make_mesh call, so chained
+    # occurrences exercise repeated losses. One None check when no
+    # plane is armed.
+    from dgc_tpu.resilience.faults import fault_point
+
     if devices is None:
         devices = jax.devices()
     if num_devices is not None:
         if num_devices > len(devices):
             raise ValueError(f"requested {num_devices} devices, have {len(devices)}")
         devices = devices[:num_devices]
+    fault_point("mesh", devices=len(devices))
     return Mesh(np.array(devices), (VERTEX_AXIS,))
 
 

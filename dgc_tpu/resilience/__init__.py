@@ -7,8 +7,11 @@ kills, or dies with a *structured* abort (never a garbage coloring):
 
 - ``resilience.faults`` — deterministic, seeded fault-injection plane
   (named points, spec-string schedules, zero-overhead no-op when off);
-- ``resilience.retry`` — transient/resource/fatal error classifier plus
-  bounded exponential-backoff-with-jitter retry policy;
+- ``resilience.retry`` — transient/resource/fatal/device-loss error
+  classifier plus bounded exponential-backoff-with-jitter retry policy;
+- ``resilience.domains`` — the failure-domain plane: device-health
+  model, domain map with largest-pow2 survivor sub-meshes, the
+  degrade/restore state machine, and the supervisor's re-shard rungs;
 - ``resilience.supervisor`` — the supervised sweep driver: per-attempt
   soft watchdog, transient retries, per-rung checkpoint resume, and the
   engine-fallback ladder (sharded → fused ELL → compact → reference-sim).
@@ -18,6 +21,8 @@ under seeded fault schedules and asserts bit-identical recovery or a
 structured abort.
 """
 
+from dgc_tpu.resilience.domains import (DeviceHealth, DomainMap, MeshState,
+                                        is_device_loss, reshard_ladder)
 from dgc_tpu.resilience.faults import (FaultPlane, FaultSchedule, FaultSpec,
                                        KILL_RC, SimulatedKill, fault_point)
 from dgc_tpu.resilience.retry import (ErrorClass, RetryBudget, RetryPolicy,
@@ -31,7 +36,10 @@ from dgc_tpu.resilience.supervisor import (AttemptTimeout, DEFAULT_LADDER,
 __all__ = [
     "AttemptTimeout",
     "DEFAULT_LADDER",
+    "DeviceHealth",
+    "DomainMap",
     "ErrorClass",
+    "MeshState",
     "FaultPlane",
     "FaultSchedule",
     "FaultSpec",
@@ -47,5 +55,7 @@ __all__ = [
     "classify_error",
     "default_ladder",
     "fault_point",
+    "is_device_loss",
+    "reshard_ladder",
     "supervise_sweep",
 ]

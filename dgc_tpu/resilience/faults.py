@@ -27,6 +27,15 @@ recovery in ``serve.netfront``):
 - ``net_accept``        — the listener's submit path
   (``serve.netfront.listener.NetFront``)
 
+and, since the failure-domain plane (``resilience.domains``) taught the
+mesh tiers to survive losing hardware:
+
+- ``mesh``              — every sharded dispatch (the serve scheduler's
+  sharded slice/pair kernels when ``--mesh-devices`` is active, and
+  ``parallel.mesh.make_mesh`` on the single-graph sharded engines'
+  build path), so a fault can land exactly at the Nth multi-device
+  dispatch
+
 and fault *kinds* that mimic the production failure classes:
 
 - ``transient``  — an ``XlaRuntimeError``-shaped ``UNAVAILABLE`` error
@@ -40,6 +49,13 @@ and fault *kinds* that mimic the production failure classes:
 - ``kill``       — die mid-sweep: ``os._exit(KILL_RC)`` when the plane is
   ``hard_kill`` (real process, chaos harness) or raise ``SimulatedKill``
   (a ``BaseException`` no handler swallows) for in-process tests
+- ``device_loss`` — one mesh device drops out mid-run
+  (``POINT@N=device_loss:DEV`` — DEV is the lost device's index;
+  composable with every serve/sweep point above): raises
+  :class:`InjectedDeviceLoss`, which the failure-domain plane
+  (``resilience.domains``) classifies as a device loss — the serve
+  scheduler re-shards onto the survivors, the single-graph supervisor
+  takes its re-shard rung
 
 **Zero overhead when disabled**: every call site goes through
 :func:`fault_point`, which is a single module-global ``None`` check — no
@@ -67,8 +83,12 @@ KILL_RC = 137  # simulated SIGKILL exit code (128 + 9), documented in README
 POINTS = ("device_init", "compile", "attempt", "transfer", "checkpoint_write",
           # serve tier (crash-safe serve PR)
           "serve_dispatch", "lane_seat", "deliver", "journal_write",
-          "net_accept")
-KINDS = ("transient", "oom", "fatal", "hang", "truncate", "corrupt", "kill")
+          "net_accept",
+          # failure-domain plane: sharded dispatches (serve mesh kernels,
+          # make_mesh on the single-graph sharded build path)
+          "mesh")
+KINDS = ("transient", "oom", "fatal", "hang", "truncate", "corrupt", "kill",
+         "device_loss")
 
 # the serve tier's injection points (chaos_serve schedules draw over
 # exactly these; the sweep-side chaos harness never hits them)
@@ -95,6 +115,21 @@ class InjectedResourceExhausted(FaultInjected):
 
 class InjectedFatalError(FaultInjected):
     error_class = "fatal"
+
+
+class InjectedDeviceLoss(FaultInjected):
+    """One mesh device dropped out (the ``device_loss`` kind). ``device``
+    is the lost device's index into the mesh's device list (None when
+    the spec carried no ``:DEV`` param — an anonymous loss the health
+    model attributes conservatively). Non-retryable on the same mesh by
+    construction: the classifier sends it to the failure-domain plane
+    (re-shard onto survivors), never into same-engine retries."""
+
+    error_class = "device_loss"
+
+    def __init__(self, message: str, device: int | None = None):
+        super().__init__(message)
+        self.device = device
 
 
 class SimulatedKill(BaseException):
@@ -224,6 +259,29 @@ class FaultSchedule:
             specs.append(spec)
         return cls(specs)
 
+    @classmethod
+    def random_mesh(cls, rng, n_devices: int, n_faults: int = 1, *,
+                    points: tuple = ("mesh", "serve_dispatch", "lane_seat"),
+                    max_occurrence: int = 4) -> "FaultSchedule":
+        """Seeded device-kill schedule for the failure-domain chaos
+        harness (``tools/chaos_mesh.py``): every fault is a
+        ``device_loss`` of a drawn device index, landed on a drawn
+        sharded point/occurrence — so seeded draws cover losses at
+        slice boundaries (``mesh``/``serve_dispatch``), mid-ladder
+        (later occurrences), and during seating (``lane_seat``)."""
+        specs: list[FaultSpec] = []
+        for _ in range(n_faults):
+            spec = FaultSpec(
+                point=rng.choice(list(points)),
+                occurrence=rng.randint(1, max_occurrence),
+                kind="device_loss",
+                param=float(rng.randrange(max(1, n_devices))))
+            if any(s.point == spec.point and s.occurrence == spec.occurrence
+                   for s in specs):
+                continue  # one fault per (point, occurrence) slot
+            specs.append(spec)
+        return cls(specs)
+
 
 class FaultPlane:
     """Armed fault schedule: counts hits per point, fires matching specs.
@@ -293,6 +351,12 @@ class FaultPlane:
             if self.hard_kill:
                 os._exit(KILL_RC)
             raise SimulatedKill(f"injected kill at {spec.point}@{spec.occurrence}")
+        if kind == "device_loss":
+            dev = None if spec.param is None else int(spec.param)
+            raise InjectedDeviceLoss(
+                f"INJECTED DEVICE_LOST: mesh device "
+                f"{'?' if dev is None else dev} dropped at "
+                f"{spec.point}@{spec.occurrence}", device=dev)
         if kind in _CHECKPOINT_KINDS:
             directory = ctx.get("directory")
             if directory is None:

@@ -35,6 +35,18 @@ class ErrorClass(str, enum.Enum):
     TRANSIENT = "transient"
     RESOURCE = "resource"
     FATAL = "fatal"
+    # a mesh device dropped out: deterministic for the same mesh (the
+    # device is gone), so never retried on the same engine — the
+    # failure-domain plane (resilience.domains) re-shards onto the
+    # survivors instead (serve tier) or the supervisor takes its
+    # re-shard rung (single-graph sharded sweep)
+    DEVICE_LOSS = "device_loss"
+
+
+# device-loss status markers beyond the injected class: what a real lost
+# chip surfaces through XLA/PJRT (message-based, like the classes below)
+_DEVICE_LOSS_MARKERS = ("DEVICE_LOST", "DEVICE IS LOST", "CHIP REBOOT",
+                        "DEVICE OR RESOURCE BUSY")
 
 
 # gRPC/XLA status markers, checked against str(exc) uppercased. RESOURCE
@@ -58,6 +70,8 @@ def classify_error(exc: BaseException) -> ErrorClass:
     # XlaRuntimeError isn't importable without jaxlib, and wrapped device
     # errors (e.g. through shard_map) keep the status prefix in the
     # message — so classification is message-based for any exception type
+    if any(m in msg for m in _DEVICE_LOSS_MARKERS):
+        return ErrorClass.DEVICE_LOSS
     if any(m in msg for m in _RESOURCE_MARKERS):
         return ErrorClass.RESOURCE
     if any(m in msg for m in _TRANSIENT_MARKERS):

@@ -703,6 +703,21 @@ def lane_mesh(devices="auto"):
     return jax.sharding.Mesh(np.array(jax.devices()[:n]), (MESH_AXIS,))
 
 
+def lane_mesh_over(devices):
+    """A lane mesh over an EXPLICIT device list — the failure-domain
+    plane's degraded shapes (``resilience.domains``: the largest pow2
+    sub-mesh of the surviving devices after a device loss, and the full
+    set again on restore). The list length must be a power of two ≥ 2
+    (a 1-device survivor set collapses to the unsharded path — mesh
+    None — instead of a trivial mesh)."""
+    n = len(devices)
+    if n < 2 or (n & (n - 1)) != 0:
+        raise ValueError(
+            f"lane_mesh_over needs a power-of-two device list >= 2 "
+            f"(got {n}); a single survivor takes the unsharded path")
+    return jax.sharding.Mesh(np.array(list(devices)), (MESH_AXIS,))
+
+
 def lane_sharding(mesh):
     """``NamedSharding`` partitioning axis 0 (the lane axis) over the
     mesh — the layout of every batch-leading serve buffer."""

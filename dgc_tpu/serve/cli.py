@@ -277,6 +277,12 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
     if mesh_snap is not None:
         summary_kw["mesh_devices"] = mesh_snap["mesh_devices"]
         summary_kw["device_occupancy"] = mesh_snap["device_occupancy"]
+    if sst.get("mesh_degrades"):
+        # failure-domain plane: degrade/evacuation counters appear only
+        # when a degrade actually happened (unsharded/undegraded summary
+        # stays byte-identical)
+        summary_kw["mesh_degrades"] = sst["mesh_degrades"]
+        summary_kw["lanes_evacuated"] = sst.get("lanes_evacuated", 0)
     done = st["completed"]
     logger.event("serve_summary", requests=st["submitted"],
                  completed=done, failed=st["failed"],
@@ -552,6 +558,12 @@ def serve_main(argv: list[str] | None = None) -> int:
     if mesh_snap is not None:
         summary_kw["mesh_devices"] = mesh_snap["mesh_devices"]
         summary_kw["device_occupancy"] = mesh_snap["device_occupancy"]
+    if sst.get("mesh_degrades"):
+        # failure-domain plane: degrade/evacuation counters appear only
+        # when a degrade actually happened (unsharded/undegraded summary
+        # stays byte-identical)
+        summary_kw["mesh_degrades"] = sst["mesh_degrades"]
+        summary_kw["lanes_evacuated"] = sst.get("lanes_evacuated", 0)
     logger.event("serve_summary", requests=len(requests), completed=done,
                  failed=st["failed"],
                  rejected=st["rejected"],

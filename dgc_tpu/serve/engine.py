@@ -52,6 +52,20 @@ Caches (the per-request costs this path amortizes):
 The fallback path also feeds the resilience supervisor's rung state when
 a ladder is configured: a request that degrades off its primary engine
 flips the front-end's health (``resilience.supervisor.RungState``).
+
+**Failure-domain-aware mesh resilience** (``resilience.domains``): in
+mesh mode a dispatch error classified as a DEVICE LOSS does not rebuild
+over the dead device — ``_degrade_mesh`` marks it lost in the
+per-device health model, evacuates every pool (live lanes reseat from
+queue state under the same quarantine accounting as a rebuild —
+deterministic re-run, so recovery is invisible in the output), and
+re-lowers the SAME kernel bodies over the largest power-of-two sub-mesh
+of the survivors (compile caches key on mesh shape + generation).
+Fewer than two survivors collapses to the unsharded single-device path.
+``request_restore`` + ``device_health.mark_healthy`` walk back up:
+``mesh_degrade``/``mesh_restore`` events, ``mesh_degrades``/
+``lanes_evacuated`` counters, and per-device health in ``/healthz``
+(``mesh_health``) record every transition.
 """
 
 from __future__ import annotations
@@ -66,6 +80,7 @@ from dgc_tpu.engine.base import AttemptResult, empty_budget_failure
 from dgc_tpu.layout import (CARRY_LEN, CARRY_NC, CARRY_PHASE, CARRY_RUNG,
                             T_US)
 from dgc_tpu.obs.trace import NULL_TRACER
+from dgc_tpu.resilience.domains import DeviceHealth, MeshState, is_device_loss
 from dgc_tpu.resilience.faults import fault_point
 from dgc_tpu.resilience.supervisor import STRUCTURED_ABORT_RC
 from dgc_tpu.serve.batched import (
@@ -81,6 +96,7 @@ from dgc_tpu.serve.batched import (
     finish_pair,
     idle_carry,
     lane_mesh,
+    lane_mesh_over,
     lane_outputs,
     lane_sharding,
     mesh_device_count,
@@ -558,13 +574,33 @@ class BatchScheduler:
         # wrappers. None — or a resolved size of 1 (single-device host,
         # or an explicit N=1) — keeps self.mesh None: the byte-identical
         # pre-mesh path, kernels, cache keys, and event stream.
-        self.mesh = None
-        self.mesh_devices = 0
+        # mesh + mesh_devices are reshaped by the failure-domain plane
+        # (degrade/restore) on the dispatcher thread only; other threads
+        # read them for display (health/summary), never for dispatch
+        self.mesh = None               # guarded-by: dispatcher
+        self.mesh_devices = 0          # guarded-by: dispatcher
+        # failure-domain plane (resilience.domains): the configured full
+        # device list, the per-device health model, and the degrade/
+        # restore state machine — all None/empty on the unsharded path.
+        # Mesh shape and generation are dispatcher-owned (every degrade/
+        # restore happens on the dispatcher thread); health is its own
+        # thread-safe model (/healthz handler threads read it live).
+        self._mesh_all = []            # guarded-by: dispatcher
+        self.device_health = None
+        self._mesh_state = None
+        self._mesh_gen = 0             # guarded-by: dispatcher
+        self._restore_requested = False   # guarded-by: _lock
         if mesh_devices is not None:
             n = mesh_device_count(mesh_devices)
             if n > 1:
                 self.mesh = lane_mesh(n)
                 self.mesh_devices = n
+                self._mesh_all = list(self.mesh.devices.flat)
+                self.device_health = DeviceHealth(n)
+                self._mesh_state = MeshState(n)
+        # the configured mesh size (degrade reference: current size below
+        # it = degraded; restore returns to it); 0 = never sharded
+        self.mesh_devices0 = self.mesh_devices
         # mean per-device live-lane occupancy accumulator (mesh mode):
         # summed per-shard live counts + lane-slice count, read by
         # mesh_snapshot() for the bench/summary accounting
@@ -611,7 +647,11 @@ class BatchScheduler:
                       "compile_misses": 0, "slices": 0, "recycles": 0,
                       "max_live": 0, "recals": 0,
                       "h2d_bytes": 0, "d2h_bytes": 0,
-                      "rebuilds": 0, "quarantined": 0}   # guarded-by: _lock
+                      "rebuilds": 0, "quarantined": 0,
+                      # failure-domain plane: mesh degrades/restores and
+                      # the live lanes evacuated (reseated) across them
+                      "mesh_degrades": 0, "mesh_restores": 0,
+                      "lanes_evacuated": 0}   # guarded-by: _lock
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "BatchScheduler":
@@ -769,10 +809,48 @@ class BatchScheduler:
             return None
         with self._lock:
             n = self._dev_live_n
-            sums = list(self._dev_live_sum)
+            # sliced to the CURRENT mesh size: a degraded mesh reports
+            # occupancy for the devices actually serving (validate_runlog
+            # checks one entry per reported mesh device)
+            sums = list(self._dev_live_sum[:self.mesh_devices])
         return {"mesh_devices": self.mesh_devices,
                 "device_occupancy": [round(s / n, 4) if n else 0.0
                                      for s in sums]}
+
+    def mesh_health(self) -> dict | None:
+        """Failure-domain health for ``/healthz`` (None when the lane
+        axis was never sharded): configured vs surviving device counts,
+        the degraded flag, and the per-device health states — so a pod
+        probe sees "6/8 devices, degraded" instead of a silent
+        throughput drop. Safe from any thread (the health model locks;
+        the mesh counters are plain int reads)."""
+        if self.device_health is None:
+            return None
+        snap = self.device_health.snapshot()
+        surviving = sum(1 for s in snap["devices"] if s == "healthy")
+        with self._lock:
+            degrades = self.stats["mesh_degrades"]
+            restores = self.stats["mesh_restores"]
+        return {"devices_total": int(self.mesh_devices0),
+                "devices_surviving": int(surviving),
+                "mesh_devices": int(max(1, self.mesh_devices)),
+                "degraded": bool(self.mesh_devices < self.mesh_devices0),
+                "degrades": int(degrades), "restores": int(restores),
+                "devices": snap["devices"]}
+
+    def request_restore(self) -> None:
+        """Arm the restore path: once every lost device is marked
+        healthy again (``device_health.mark_healthy`` — an operator or
+        probe decision), the dispatcher rebuilds the FULL mesh at its
+        next quiet point, evacuating live lanes onto it exactly like a
+        degrade (reseat from queue state, deterministic re-run). A
+        request made while devices are still lost is dropped (re-request
+        after marking them healthy). No-op on the unsharded path."""
+        if self.device_health is None:
+            return
+        with self._lock:
+            self._restore_requested = True
+            self._lock.notify_all()
 
     # -- stage-ladder resolution ----------------------------------------
     def stages_for(self, cls):
@@ -816,7 +894,11 @@ class BatchScheduler:
         # the unsharded path stays byte-identical)
         key = ("sync", cls.v_pad, cls.w_pad, cls.planes, b_pad, stages)
         if self.mesh is not None:
-            key += ("mesh", self.mesh_devices)
+            # the generation disambiguates same-SIZE meshes over
+            # different survivor sets across degrade/restore cycles
+            # (gen 0 = the pre-degrade mesh: the unsharded and
+            # never-degraded keys are byte-identical to PR 14's)
+            key += ("mesh", self.mesh_devices, self._mesh_gen)
         with self._lock:
             hit = key in self._kernels
             if not hit:
@@ -840,7 +922,7 @@ class BatchScheduler:
         key = ("slice", cls.v_pad, cls.w_pad, cls.planes, b_pad, s,
                self.timing, stages, self.device_carry)
         if self.mesh is not None:
-            key += ("mesh", self.mesh_devices)
+            key += ("mesh", self.mesh_devices, self._mesh_gen)
             kern = partial(batched_slice_kernel_sharded_donated, self.mesh
                            ) if self.device_carry else partial(
                                batched_slice_kernel_sharded, self.mesh)
@@ -966,23 +1048,30 @@ class BatchScheduler:
         with self._lock:
             self.stats["quarantined"] += 1
 
-    def _recover_class(self, cls, error) -> None:
-        """Dispatch failure/hang recovery: tear the class's pool down,
-        quarantine calls past their abort budget, reseat the survivors
-        (their sweep restarts from its inputs — deterministic, so the
-        re-run is invisible in the output), emit ``lane_rebuild``."""
+    def _evacuate_pool(self, cls, error):
+        """Tear one class's pool down and requeue its live calls at the
+        queue head (they were seated once — deterministic re-run from
+        their inputs). With an ``error`` each call is charged one lane
+        abort and quarantined past its budget (the PR 13 accounting);
+        ``error=None`` is a VOLUNTARY evacuation (mesh restore) — no
+        abort charge, nothing quarantined. Returns ``(survivors,
+        poisoned, aborts_max)``."""
         pool = self._pools.pop(cls, None)
         survivors, poisoned = [], []
         aborts_max = 0
         for call in (pool.calls if pool is not None else []):
             if call is None:
                 continue
-            call.aborts += 1
+            if error is not None:
+                call.aborts += 1
             aborts_max = max(aborts_max, call.aborts)
             if call.lane_span is not None:
-                call.lane_span.end({"error": f"lane aborted: {error}"})
+                call.lane_span.end(
+                    {"error": f"lane aborted: {error}"} if error is not None
+                    else {"error": "lane evacuated (mesh reshape)"})
                 call.lane_span = None
-            (poisoned if call.aborts >= self.max_lane_aborts
+            (poisoned if error is not None
+             and call.aborts >= self.max_lane_aborts
              else survivors).append(call)
         for call in poisoned:
             call.error = PoisonedRequest(
@@ -994,9 +1083,18 @@ class BatchScheduler:
             if survivors:
                 # reseat ahead of fresh arrivals: they were seated once
                 self._pending.setdefault(cls, [])[:0] = survivors
-            self.stats["rebuilds"] += 1
             self.stats["quarantined"] += len(poisoned)
             self._lock.notify_all()
+        return survivors, poisoned, aborts_max
+
+    def _recover_class(self, cls, error) -> None:
+        """Dispatch failure/hang recovery: tear the class's pool down,
+        quarantine calls past their abort budget, reseat the survivors
+        (their sweep restarts from its inputs — deterministic, so the
+        re-run is invisible in the output), emit ``lane_rebuild``."""
+        survivors, poisoned, aborts_max = self._evacuate_pool(cls, error)
+        with self._lock:
+            self.stats["rebuilds"] += 1
         if self.on_event is not None:
             self.on_event("lane_rebuild", {
                 "shape_class": cls.name,
@@ -1008,6 +1106,107 @@ class BatchScheduler:
                 "error": f"{type(error).__name__}: {error}"[:300],
             })
 
+    # -- failure-domain plane: mesh degrade / restore ---------------------
+    def _degrade_mesh(self, error, sync_batch=None) -> None:
+        """Device-loss recovery (``resilience.domains``): mark the lost
+        device in the health model, tear EVERY pool down (the old mesh's
+        buffers span the dead device), reseat live lanes from queue
+        state under the PR 13 quarantine accounting, and rebuild over
+        the largest power-of-two sub-mesh of the survivors — the same
+        kernel bodies re-lower onto the smaller mesh through the
+        compile caches' mesh-shape keys. Fewer than two survivors
+        collapses to the unsharded single-device path (``mesh=None``).
+        ``sync_batch=(cls, calls)`` carries sync mode's in-flight batch
+        (no pools there) through the same accounting. Dispatcher-thread
+        only."""
+        before = max(1, self.mesh_devices)
+        dev = getattr(error, "device", None)
+        if dev is None or not (0 <= int(dev) < self.mesh_devices0):
+            # anonymous loss (a real XLA error rarely names the chip):
+            # blame the highest-index survivor — deterministic, and the
+            # degrade shape only depends on the survivor COUNT
+            surv = self.device_health.surviving()
+            dev = surv[-1] if surv else 0
+        dev = int(dev)
+        self.device_health.mark_lost(dev)
+        reseated = quarantined = 0
+        for cls in sorted(list(self._pools), key=lambda c: c.name):
+            s, p, _ = self._evacuate_pool(cls, error)
+            reseated += len(s)
+            quarantined += len(p)
+        if sync_batch is not None:
+            cls, calls = sync_batch
+            survivors = []
+            for call in calls:
+                call.aborts += 1
+                if call.aborts >= self.max_lane_aborts:
+                    self._quarantine(call, error)
+                    quarantined += 1
+                else:
+                    survivors.append(call)
+            with self._lock:
+                if survivors:
+                    self._pending.setdefault(cls, [])[:0] = survivors
+                self._lock.notify_all()
+            reseated += len(survivors)
+        plan = self._mesh_state.on_loss(self.device_health.surviving())
+        if len(plan["devices"]) >= 2:
+            self.mesh = lane_mesh_over(
+                [self._mesh_all[i] for i in plan["devices"]])
+            self.mesh_devices = len(plan["devices"])
+        else:
+            self.mesh = None
+            self.mesh_devices = 0
+        self._mesh_gen = plan["generation"]
+        with self._lock:
+            self.stats["mesh_degrades"] += 1
+            self.stats["lanes_evacuated"] += reseated
+        if self.on_event is not None:
+            rec = {
+                "devices_before": int(before),
+                "devices_after": int(max(1, self.mesh_devices)),
+                "lost_device": dev,
+                "reseated": int(reseated),
+                "quarantined": int(quarantined),
+                "error": f"{type(error).__name__}: {error}"[:300],
+            }
+            self.on_event("mesh_degrade", rec)
+
+    def _maybe_restore(self) -> None:
+        """Serviced restore request (``request_restore``): when every
+        device is healthy again and the mesh is below its configured
+        size, evacuate live lanes (no abort charge — voluntary) and
+        rebuild the FULL mesh. Dispatcher-thread only."""
+        with self._lock:
+            want = self._restore_requested
+            self._restore_requested = False
+        if not want or self._mesh_state is None:
+            return
+        if self.mesh_devices == self.mesh_devices0:
+            return
+        if self.device_health.lost():
+            return   # still unhealthy: re-request after mark_healthy
+        before = max(1, self.mesh_devices)
+        reseated = 0
+        for cls in sorted(list(self._pools), key=lambda c: c.name):
+            s, _p, _ = self._evacuate_pool(cls, None)
+            reseated += len(s)
+        plan = self._mesh_state.on_restore()
+        self.mesh = lane_mesh_over(
+            [self._mesh_all[i] for i in plan["devices"]])
+        self.mesh_devices = len(plan["devices"])
+        self._mesh_gen = plan["generation"]
+        with self._lock:
+            self.stats["mesh_restores"] += 1
+            self.stats["lanes_evacuated"] += reseated
+        if self.on_event is not None:
+            rec = {
+                "devices_before": int(before),
+                "devices_after": int(self.mesh_devices),
+                "reseated": int(reseated),
+            }
+            self.on_event("mesh_restore", rec)
+
     # =====================================================================
     # continuous mode: lane recycling
     # =====================================================================
@@ -1018,6 +1217,7 @@ class BatchScheduler:
         already has live lanes to keep slicing."""
         with self._lock:
             while (not self._stop and not self._pending
+                   and not self._restore_requested
                    and not any(p.live for p in self._pools.values())):
                 self._lock.wait()
             if self._stop:
@@ -1061,6 +1261,7 @@ class BatchScheduler:
         while True:
             if not self._wait_for_work():
                 return
+            self._maybe_restore()
             with self._lock:
                 classes = set(self._pending)
             classes.update(c for c, p in self._pools.items() if p.live)
@@ -1072,11 +1273,18 @@ class BatchScheduler:
                 try:
                     self._service_class(cls)
                 except Exception as e:
-                    # dispatch abort (injected fault, real XLA error) or
-                    # watchdog hang: rebuild instead of failing the whole
-                    # batch — survivors reseat, poisoned calls
-                    # structured-fail (the quarantine policy)
-                    self._recover_class(cls, e)
+                    if self.mesh is not None and is_device_loss(e):
+                        # a mesh device dropped out: re-shard onto the
+                        # survivors instead of rebuilding over the dead
+                        # device (failure-domain plane)
+                        self._degrade_mesh(e)
+                    else:
+                        # dispatch abort (injected fault, real XLA
+                        # error) or watchdog hang: rebuild instead of
+                        # failing the whole batch — survivors reseat,
+                        # poisoned calls structured-fail (the
+                        # quarantine policy)
+                        self._recover_class(cls, e)
 
     def _service_class(self, cls) -> None:
         """One slice of one class's pool: seat queued calls in free
@@ -1103,6 +1311,17 @@ class BatchScheduler:
                 try:
                     fault_point("lane_seat", shape_class=cls.name)
                 except Exception as e:
+                    if self.mesh is not None and is_device_loss(e):
+                        # a device died during seating: requeue this
+                        # call and the rest of the wave at the queue
+                        # head, then let the loop's device-loss handler
+                        # re-shard (already-seated lanes are evacuated
+                        # there)
+                        with self._lock:
+                            self._pending.setdefault(cls, [])[:0] = \
+                                take[take.index(call):]
+                            self._lock.notify_all()
+                        raise
                     # a seat fault costs THIS call one abort (quarantine
                     # past the budget, back of the queue otherwise); the
                     # rest of the wave still seats
@@ -1144,29 +1363,44 @@ class BatchScheduler:
             attrs={"cls": cls.name, "live": int(live),
                    "b_pad": int(pool.b_pad)})
         t0 = time.perf_counter()
-        if self.device_carry:
-            # device-resident carry: every input lives on device (lane
-            # seats landed as on-device scatters), the carry buffers are
-            # DONATED and re-entered in place — pool.carry is replaced
-            # below and the donated arrays never touched again
-            comb_dev, degrees_dev, k0_in, ms_in, reset_in = pool.dev_state()
-            if isinstance(pool.carry[0], np.ndarray):
-                pool.h2d += carry_nbytes(pool.carry)   # first upload only
-        else:
-            comb_dev, degrees_dev = pool.dev_inputs()
-            k0_in, ms_in, reset_in = pool.k0, pool.max_steps, pool.reset
-            # the host-mirror path re-uploads the scheduling vectors
-            # every slice (numpy → device) and the carry once (its first
-            # invocation; afterwards the returned device arrays re-enter)
-            pool.h2d += (pool.k0.nbytes + pool.max_steps.nbytes
-                         + pool.reset.nbytes)
-            if isinstance(pool.carry[0], np.ndarray):
-                pool.h2d += carry_nbytes(pool.carry)
+
         def run_slice():
-            # the serve_dispatch fault point and the forcing transfers
-            # run INSIDE the guarded call: an injected hang (or a real
-            # wedged dispatch) blocks here, where the watchdog sees it
+            # the fault points, the INPUT-SIDE device kernels (the
+            # device-carry seat/resize scatters inside dev_state — real
+            # sharded dispatches), the slice kernel itself, and the
+            # forcing transfers all run INSIDE the guarded call: an
+            # injected hang (or a real wedged dispatch, sharded or not)
+            # blocks here, where the watchdog sees it and the
+            # pool-rebuild recovery applies. A watchdog-abandoned thread
+            # only ever mutates the pool the rebuild discards.
             fault_point("serve_dispatch", shape_class=cls.name)
+            if self.mesh is not None:
+                # failure-domain plane: the sharded-dispatch fault point
+                # (mesh@N=device_loss:DEV lands a device loss exactly at
+                # the Nth multi-device dispatch)
+                fault_point("mesh", shape_class=cls.name,
+                            mesh_devices=self.mesh_devices)
+            if self.device_carry:
+                # device-resident carry: every input lives on device
+                # (lane seats landed as on-device scatters), the carry
+                # buffers are DONATED and re-entered in place —
+                # pool.carry is replaced below and the donated arrays
+                # never touched again
+                comb_dev, degrees_dev, k0_in, ms_in, reset_in = \
+                    pool.dev_state()
+                if isinstance(pool.carry[0], np.ndarray):
+                    pool.h2d += carry_nbytes(pool.carry)  # first upload
+            else:
+                comb_dev, degrees_dev = pool.dev_inputs()
+                k0_in, ms_in, reset_in = pool.k0, pool.max_steps, pool.reset
+                # the host-mirror path re-uploads the scheduling vectors
+                # every slice (numpy → device) and the carry once (its
+                # first invocation; afterwards the returned device
+                # arrays re-enter)
+                pool.h2d += (pool.k0.nbytes + pool.max_steps.nbytes
+                             + pool.reset.nbytes)
+                if isinstance(pool.carry[0], np.ndarray):
+                    pool.h2d += carry_nbytes(pool.carry)
             carry = kernel(comb_dev, degrees_dev, k0_in, ms_in, reset_in,
                            pool.carry)
             # the per-lane scheduling scalars — the ONLY unconditional
@@ -1183,6 +1417,8 @@ class BatchScheduler:
             # every opened span must end (the validate_runlog contract)
             slice_span.end({"error": f"{type(e).__name__}: {e}"})
             raise
+        if self.device_health is not None:
+            self.device_health.record_ok()
         pool.d2h += 3 * phase.nbytes
         device_s = time.perf_counter() - t0
         pool.rearm(carry)
@@ -1319,9 +1555,12 @@ class BatchScheduler:
         batch (the largest same-depth affinity group when enabled).
         Returns (cls, calls) or None on stop."""
         with self._lock:
-            while not self._stop and not self._pending:
+            while (not self._stop and not self._pending
+                   and not self._restore_requested):
                 self._lock.wait()
-            if self._stop:
+            if self._stop or not self._pending:
+                # stop, or a restore request woke us with nothing queued
+                # — the loop services the restore and comes back
                 return None
             # window: give same-class calls a chance to coalesce (the
             # highest-priority pending call picks the class and shortens
@@ -1353,13 +1592,23 @@ class BatchScheduler:
 
     def _loop_sync(self) -> None:
         while True:
+            self._maybe_restore()
             got = self._take_batch()
             if got is None:
-                return
+                with self._lock:
+                    if self._stop:
+                        return
+                continue   # restore request woke us; serviced above
             cls, calls = got
             try:
                 self._dispatch(cls, calls)
             except Exception as e:
+                if self.mesh is not None and is_device_loss(e):
+                    # device loss: the failure-domain plane re-shards
+                    # onto the survivors; this batch rides the same
+                    # quarantine accounting through sync_batch
+                    self._degrade_mesh(e, sync_batch=(cls, calls))
+                    continue
                 # same quarantine policy as the continuous loop: each
                 # batch member pays one abort; survivors requeue at the
                 # head, poisoned members structured-fail
@@ -1419,6 +1668,9 @@ class BatchScheduler:
 
         def run_pair():
             fault_point("serve_dispatch", shape_class=cls.name)
+            if self.mesh is not None:
+                fault_point("mesh", shape_class=cls.name,
+                            mesh_devices=self.mesh_devices)
             out = kernel(comb, degrees, k0, max_steps)
             # one transfer point for the epilogues (forces the dispatch
             # inside the watchdog's view)
@@ -1429,6 +1681,8 @@ class BatchScheduler:
         except BaseException as e:
             batch_span.end({"error": f"{type(e).__name__}: {e}"})
             raise
+        if self.device_health is not None:
+            self.device_health.record_ok()
         device_s = time.perf_counter() - t0
         batch_span.end()
 

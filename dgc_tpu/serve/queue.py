@@ -296,6 +296,24 @@ class ServeFrontEnd:
             self.registry.counter(
                 "dgc_serve_recycles_total", "lane swaps (sweeps completed)",
                 shape_class=record["shape_class"]).inc()
+        elif kind == "mesh_degrade":
+            # failure-domain plane: a lost device re-sharded the lane
+            # axis onto the survivors (resilience.domains)
+            self.registry.counter(
+                "dgc_serve_mesh_degrades_total",
+                "mesh degrades (device loss -> survivor re-shard)").inc()
+            self.registry.gauge(
+                "dgc_serve_mesh_devices",
+                "devices the lane axis currently shards over").set(
+                record["devices_after"])
+        elif kind == "mesh_restore":
+            self.registry.counter(
+                "dgc_serve_mesh_restores_total",
+                "mesh restores back to the full device set").inc()
+            self.registry.gauge(
+                "dgc_serve_mesh_devices",
+                "devices the lane axis currently shards over").set(
+                record["devices_after"])
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "ServeFrontEnd":
@@ -523,6 +541,13 @@ class ServeFrontEnd:
                 "rung": rung["rung"],
                 "retry_pressure": rung["retry_pressure"],
             }
+        # failure-domain plane: mesh state (devices total/surviving,
+        # degraded flag, per-device health) — present ONLY when the lane
+        # axis was configured sharded, so the unsharded health doc (and
+        # its serve_health event) stays byte-identical
+        mesh = self.scheduler.mesh_health()
+        if mesh is not None:
+            doc["mesh"] = mesh
         if emit:
             self._event("serve_health", **doc)
         if self.registry is not None:

@@ -13,6 +13,17 @@ defect — truncated/undecodable manifest, missing or partial
 ``best_colors.npy``, checksum mismatch — as "no checkpoint" with a stderr
 warning instead of raising. A corrupt checkpoint can therefore cost a
 restart from k0, but can never crash a resume or hand it garbage state.
+
+:class:`WriteBehindCheckpointManager` (failure-domain plane) takes the
+checkpoint write off the sweep clock: ``save()`` double-buffers the
+attempt state (colors copied — the caller's buffers are free to be
+donated back to the device) and returns immediately; a background
+writer thread lands the newest pending snapshot through the SAME atomic
+save path (sha-256 manifest included), coalescing bursts — so a 1M-
+vertex colors vector never serializes an attempt boundary. ``restore``
+/``clear``/``close`` flush first, so a resume always sees the newest
+landed state and an engine fallback (the supervisor's re-shard rung)
+hands over a quiesced directory.
 """
 
 from __future__ import annotations
@@ -21,6 +32,7 @@ import hashlib
 import json
 import os
 import sys
+import threading
 from pathlib import Path
 
 import numpy as np
@@ -127,6 +139,138 @@ class CheckpointManager:
             p = self.dir / name
             if p.exists():
                 p.unlink()
+
+
+class WriteBehindCheckpointManager(CheckpointManager):   # dgc-lint: threaded
+    """Write-behind (streamed) checkpointing off the sweep clock.
+
+    ``save()`` snapshots the attempt state into a one-deep pending slot
+    (newest wins — the double buffer: a burst of attempt boundaries
+    coalesces to the last one, which is the only state a resume can use
+    anyway) and returns without touching the filesystem; the writer
+    thread lands it through :meth:`CheckpointManager.save` — the same
+    atomic-rename + sha-256 path, so on-disk artifacts are
+    indistinguishable from the synchronous manager's and every restore
+    hardening applies verbatim.
+
+    A crash between ``save()`` and the writer landing it costs at most
+    one attempt of progress (resume re-runs it deterministically —
+    exact, just not free); ``restore``/``clear``/``close`` flush first,
+    so engine fallbacks (the supervisor's re-shard rung resuming the
+    SAME directory on fewer devices) always read the newest landed
+    state. Writer errors are re-raised on the next ``flush`` — a
+    checkpoint write can fail without crashing the sweep mid-attempt,
+    exactly like the fault-plane's ``checkpoint_write`` kinds expect.
+
+    Managers over the same directory (an old rung's writer draining
+    while the next rung's manager restores) serialize on a process-wide
+    per-directory lock, so two writers can never interleave one
+    directory's rename pair."""
+
+    _dir_locks: dict = {}                    # guarded-by: _dir_locks_lock
+    _dir_locks_lock = threading.Lock()
+
+    def __init__(self, directory: str | os.PathLike,
+                 fingerprint: str | None = None):
+        super().__init__(directory, fingerprint=fingerprint)
+        key = str(Path(directory).resolve())
+        with WriteBehindCheckpointManager._dir_locks_lock:
+            self._dir_lock = WriteBehindCheckpointManager._dir_locks \
+                .setdefault(key, threading.Lock())
+        self._cond = threading.Condition()
+        self._pending = None        # guarded-by: _cond (newest snapshot)
+        self._writing = False       # guarded-by: _cond
+        self._error = None          # guarded-by: _cond (writer's raise)
+        self._closed = False        # guarded-by: _cond
+        self._thread = None         # guarded-by: _cond
+
+    # -- the async save -------------------------------------------------
+    def save(self, k: int, best, failed: bool) -> None:
+        import numpy as np
+
+        # double-buffer: copy the colors vector NOW (the engine may
+        # reuse/donate its buffers the moment save returns), then hand
+        # the snapshot to the writer — newest pending wins
+        snap_best = best
+        if best is not None:
+            snap_best = type(best)(
+                status=best.status,
+                colors=np.array(best.colors, copy=True),
+                supersteps=int(best.supersteps), k=int(best.k))
+        with self._cond:
+            if self._closed:
+                raise RuntimeError("checkpoint manager is closed")
+            self._pending = (int(k), snap_best, bool(failed))
+            if self._thread is None:
+                self._thread = threading.Thread(
+                    target=self._writer, daemon=True,
+                    name="dgc-ckpt-writebehind")
+                self._thread.start()
+            self._cond.notify_all()
+
+    def _writer(self) -> None:
+        while True:
+            with self._cond:
+                while self._pending is None and not self._closed:
+                    self._cond.wait()
+                if self._pending is None and self._closed:
+                    return
+                snap, self._pending = self._pending, None
+                self._writing = True
+            try:
+                with self._dir_lock:
+                    CheckpointManager.save(self, *snap)
+            except BaseException as e:   # incl. SimulatedKill: surfaced
+                with self._cond:         # on the next flush, never lost
+                    self._error = e
+                    self._writing = False
+                    self._cond.notify_all()
+                return
+            with self._cond:
+                self._writing = False
+                self._cond.notify_all()
+
+    def flush(self, timeout: float = 60.0) -> None:
+        """Block until every pending snapshot has landed (or re-raise
+        the writer's stored error)."""
+        import time
+
+        deadline = time.perf_counter() + timeout
+        with self._cond:
+            while ((self._pending is not None or self._writing)
+                   and self._error is None):
+                left = deadline - time.perf_counter()
+                if left <= 0:
+                    raise TimeoutError(
+                        f"write-behind checkpoint flush exceeded "
+                        f"{timeout:g}s")
+                self._cond.wait(timeout=left)
+            err, self._error = self._error, None
+        if err is not None:
+            raise err
+
+    # -- flush-first overrides ------------------------------------------
+    def restore(self):
+        self.flush()
+        with self._dir_lock:
+            return super().restore()
+
+    def clear(self) -> None:
+        self.flush()
+        with self._dir_lock:
+            super().clear()
+
+    def close(self) -> None:
+        """Drain and stop the writer (idempotent)."""
+        try:
+            self.flush()
+        finally:
+            with self._cond:
+                self._closed = True
+                self._cond.notify_all()
+                t = self._thread
+            if t is not None:
+                t.join(timeout=10)
 
 
 def graph_fingerprint(arrays, backend: str, strict_decrement: bool) -> str:

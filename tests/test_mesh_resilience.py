@@ -1,0 +1,534 @@
+"""Failure-domain-aware mesh resilience (resilience.domains + serve tier).
+
+The contract under test: a device loss mid-run costs capacity, never
+correctness —
+
+- the serve scheduler re-shards the lane axis onto the largest surviving
+  power-of-two sub-mesh (collapsing to the unsharded path below two
+  survivors), evacuated lanes reseat from queue state and re-run
+  deterministically, so delivered colors are byte-identical to the
+  fault-free run;
+- ``mesh_degrade``/``mesh_restore`` events are schema-valid, the
+  ``mesh_degrades``/``lanes_evacuated`` counters move, and ``/healthz``
+  (``ServeFrontEnd.health``) reports the degraded mesh with per-device
+  health;
+- the single-graph sharded sweep falls to the supervisor's re-shard rung
+  (``sharded@N-1``) and resumes from the write-behind attempt
+  checkpoint, byte-identical to fault-free;
+- the dispatch watchdog covers the SHARDED dispatch path (a hung
+  sharded kernel call triggers the same pool rebuild).
+
+Unit pieces (domain map, health model, state machine, write-behind
+manager, fault grammar) run anywhere; the mesh end-to-end tests need
+the conftest-forced 8-device virtual CPU mesh and skip cleanly when
+forcing was impossible.
+"""
+
+import json
+import os
+import subprocess
+import sys
+import time
+
+import jax
+import numpy as np
+import pytest
+
+from dgc_tpu.resilience import faults
+from dgc_tpu.resilience.domains import (DeviceHealth, DomainMap, MeshState,
+                                        is_device_loss, largest_pow2,
+                                        reshard_ladder)
+from dgc_tpu.resilience.faults import (FaultSchedule, FaultSpec,
+                                       InjectedDeviceLoss)
+from dgc_tpu.resilience.retry import ErrorClass, classify_error
+
+pytestmark = [pytest.mark.chaos]
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+needs8 = pytest.mark.skipif(jax.device_count() < 8,
+                            reason="needs 8 (virtual) devices")
+
+
+# ---------------------------------------------------------------------------
+# fault grammar + classification
+# ---------------------------------------------------------------------------
+
+def test_device_loss_spec_round_trip():
+    spec = FaultSpec.parse_token("mesh@2=device_loss:3")
+    assert (spec.point, spec.occurrence, spec.kind) == ("mesh", 2,
+                                                        "device_loss")
+    assert spec.param == 3.0
+    assert spec.to_token() == "mesh@2=device_loss:3"
+    # composable with every serve/sweep point
+    for point in ("serve_dispatch", "lane_seat", "attempt"):
+        FaultSpec.parse_token(f"{point}@1=device_loss:0")
+
+
+def test_device_loss_fires_with_device_index():
+    plane = faults.FaultPlane(FaultSchedule.parse("mesh@1=device_loss:5"))
+    with faults.injected(plane):
+        with pytest.raises(InjectedDeviceLoss) as ei:
+            faults.fault_point("mesh")
+    assert ei.value.device == 5
+    # anonymous loss: no :DEV param -> device None
+    plane = faults.FaultPlane(FaultSchedule.parse("mesh@1=device_loss"))
+    with faults.injected(plane):
+        with pytest.raises(InjectedDeviceLoss) as ei:
+            faults.fault_point("mesh")
+    assert ei.value.device is None
+
+
+def test_device_loss_classification():
+    assert classify_error(InjectedDeviceLoss("x", 1)) \
+        is ErrorClass.DEVICE_LOSS
+    assert classify_error(RuntimeError("INTERNAL: DEVICE_LOST: chip 3")) \
+        is ErrorClass.DEVICE_LOSS
+    assert is_device_loss(InjectedDeviceLoss("x", None))
+    assert not is_device_loss(RuntimeError("UNAVAILABLE: blip"))
+    assert not is_device_loss(ValueError("nope"))
+
+
+def test_random_mesh_schedule_is_seeded_and_device_loss_only():
+    import random
+
+    a = FaultSchedule.random_mesh(random.Random(7), 8, n_faults=3)
+    b = FaultSchedule.random_mesh(random.Random(7), 8, n_faults=3)
+    assert a.to_spec() == b.to_spec()
+    for spec in a:
+        assert spec.kind == "device_loss"
+        assert 0 <= int(spec.param) < 8
+
+
+# ---------------------------------------------------------------------------
+# domains: map, health, state machine, ladder
+# ---------------------------------------------------------------------------
+
+def test_largest_pow2():
+    assert [largest_pow2(n) for n in (0, 1, 2, 3, 7, 8, 9)] \
+        == [0, 1, 2, 2, 4, 8, 8]
+
+
+def test_domain_map_submesh_and_blast_radius():
+    dm = DomainMap(8)
+    assert dm.submesh(range(8)) == tuple(range(8))
+    assert dm.submesh((1, 2, 3, 4, 5, 6, 7)) == (1, 2, 3, 4)   # pow2 prefix
+    assert dm.submesh((3,)) == (3,)
+    assert dm.submesh(()) == ()
+    assert dm.blast_radius(3) == (3,)
+    # two 4-device hosts: losing device 1 takes its whole host
+    hosts = DomainMap(8, domain_of=[0, 0, 0, 0, 1, 1, 1, 1])
+    assert hosts.blast_radius(1) == (0, 1, 2, 3)
+    with pytest.raises(ValueError):
+        DomainMap(4, domain_of=[0, 1])
+
+
+def test_device_health_loss_and_restore():
+    h = DeviceHealth(4)
+    assert h.surviving() == (0, 1, 2, 3)
+    assert h.mark_lost(2) == (2,)
+    assert h.mark_lost(2) == ()          # idempotent
+    assert h.lost() == (2,)
+    assert h.surviving() == (0, 1, 3)
+    h.mark_healthy(2)
+    assert h.lost() == ()
+    # host-domain loss takes the whole domain
+    h2 = DeviceHealth(4, domains=DomainMap(4, domain_of=[0, 0, 1, 1]))
+    assert h2.mark_lost(0) == (0, 1)
+    assert h2.surviving() == (2, 3)
+    snap = h2.snapshot()
+    assert snap["devices"] == ["lost", "lost", "healthy", "healthy"]
+    assert snap["losses"] == 1
+
+
+def test_mesh_state_machine_generations():
+    st = MeshState(8)
+    assert st.snapshot()["state"] == "full"
+    plan = st.on_loss((0, 1, 2, 3, 4, 5, 6))
+    assert plan == {"devices": (0, 1, 2, 3), "state": "degraded",
+                    "generation": 1}
+    plan = st.on_loss((6,))
+    assert plan["state"] == "collapsed" and plan["generation"] == 2
+    plan = st.on_restore()
+    assert plan["devices"] == tuple(range(8)) and plan["state"] == "full"
+    snap = st.snapshot()
+    assert snap["degrades"] == 2 and snap["restores"] == 1
+    assert snap["generation"] == 3
+
+
+def test_reshard_ladder():
+    assert reshard_ladder("sharded", 8) == ["sharded", "sharded@7"]
+    assert reshard_ladder("sharded", 8, rungs=3) \
+        == ["sharded", "sharded@7", "sharded@6", "sharded@5"]
+    assert reshard_ladder("sharded", 2, rungs=5) == ["sharded", "sharded@1"]
+    assert reshard_ladder("sharded", 1) == ["sharded"]
+
+
+# ---------------------------------------------------------------------------
+# write-behind checkpoint manager
+# ---------------------------------------------------------------------------
+
+def _attempt(k=5, v=16):
+    from dgc_tpu.engine.base import AttemptResult, AttemptStatus
+
+    rng = np.random.default_rng(k)
+    return AttemptResult(AttemptStatus.SUCCESS,
+                         rng.integers(0, k, v).astype(np.int32), 7, k)
+
+
+def test_write_behind_round_trip_matches_sync(tmp_path):
+    from dgc_tpu.utils.checkpoint import (CheckpointManager,
+                                          WriteBehindCheckpointManager)
+
+    best = _attempt()
+    sync = CheckpointManager(tmp_path / "sync", fingerprint="fp")
+    sync.save(4, best, False)
+    wb = WriteBehindCheckpointManager(tmp_path / "wb", fingerprint="fp")
+    wb.save(4, best, False)
+    wb.flush()
+    # on-disk artifacts byte-compatible with the synchronous manager's
+    assert (tmp_path / "wb" / "sweep_state.json").read_text() \
+        == (tmp_path / "sync" / "sweep_state.json").read_text()
+    assert (tmp_path / "wb" / "best_colors.npy").read_bytes() \
+        == (tmp_path / "sync" / "best_colors.npy").read_bytes()
+    k, restored, done = wb.restore()
+    assert (k, done) == (4, False)
+    np.testing.assert_array_equal(restored.colors, best.colors)
+    wb.close()
+
+
+def test_write_behind_coalesces_and_restore_flushes(tmp_path):
+    from dgc_tpu.utils.checkpoint import WriteBehindCheckpointManager
+
+    wb = WriteBehindCheckpointManager(tmp_path, fingerprint="fp")
+    # a burst of attempt boundaries: restore() must see the NEWEST
+    for k in range(9, 2, -1):
+        wb.save(k, _attempt(k), False)
+    k, restored, _done = wb.restore()
+    assert k == 3 and restored.k == 3
+    wb.close()
+
+
+def test_write_behind_copies_colors(tmp_path):
+    from dgc_tpu.utils.checkpoint import WriteBehindCheckpointManager
+
+    wb = WriteBehindCheckpointManager(tmp_path, fingerprint="fp")
+    best = _attempt(6)
+    expect = best.colors.copy()
+    wb.save(5, best, False)
+    best.colors[:] = -7    # caller reuses its buffer immediately
+    _k, restored, _done = wb.restore()
+    np.testing.assert_array_equal(restored.colors, expect)
+    wb.close()
+
+
+def test_write_behind_writer_error_surfaces_on_flush(tmp_path,
+                                                     monkeypatch):
+    from dgc_tpu.utils import checkpoint as ck
+
+    wb = ck.WriteBehindCheckpointManager(tmp_path, fingerprint="fp")
+
+    def boom(self, k, best, failed):
+        raise OSError("disk gone")
+
+    monkeypatch.setattr(ck.CheckpointManager, "save", boom)
+    wb.save(4, _attempt(), False)
+    with pytest.raises(OSError, match="disk gone"):
+        wb.flush()
+    monkeypatch.undo()
+    wb.close()   # idempotent after a writer death
+
+
+# ---------------------------------------------------------------------------
+# serve tier: degrade / collapse / restore / watchdog (8-device mesh)
+# ---------------------------------------------------------------------------
+
+def _graphs(n, v=400, seed0=0):
+    from dgc_tpu.models.graph import Graph
+
+    return [Graph.generate(v, 6, seed=seed0 + s) for s in range(n)]
+
+
+def _serve_all(front, graphs, timeout=180):
+    tickets = [front.submit(g.arrays) for g in graphs]
+    return [t.result(timeout) for t in tickets]
+
+
+def _validate(log_path):
+    sys.path.insert(0, os.path.join(REPO, "tools"))
+    from tools.validate_runlog import validate_file
+
+    return validate_file(str(log_path))
+
+
+@needs8
+@pytest.mark.serve
+def test_mesh_degrade_serves_identical_colors(tmp_path):
+    from dgc_tpu.obs import RunLogger
+    from dgc_tpu.serve.queue import ServeFrontEnd
+
+    graphs = _graphs(5)
+    base_front = ServeFrontEnd(batch_max=4, window_s=0.0).start()
+    base = [r.colors.tolist() for r in _serve_all(base_front, graphs)]
+    base_front.shutdown()
+
+    log = tmp_path / "degrade.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    plane = faults.FaultPlane(
+        FaultSchedule.parse("serve_dispatch@2=device_loss:3"))
+    with faults.injected(plane):
+        front = ServeFrontEnd(batch_max=4, window_s=0.0, mesh_devices=8,
+                              logger=logger).start()
+        results = _serve_all(front, graphs)
+        health = front.health(emit=True)
+        front.shutdown()
+    logger.close()
+
+    assert [r.status for r in results] == ["ok"] * len(graphs)
+    assert [r.colors.tolist() for r in results] == base
+    sched = front.scheduler
+    assert sched.mesh_devices == 4          # 8 -> lost one -> pow2(7) = 4
+    stats = sched.stats_snapshot()
+    assert stats["mesh_degrades"] == 1
+    assert stats["lanes_evacuated"] >= 1
+    # /healthz mesh block: total/surviving/degraded + per-device states
+    mesh = health["mesh"]
+    assert mesh["devices_total"] == 8
+    assert mesh["devices_surviving"] == 7
+    assert mesh["degraded"] is True
+    assert mesh["devices"][3] == "lost"
+    # schema + semantics hold, and the degrade event is in the stream
+    assert _validate(log) == []
+    events = [json.loads(line) for line in open(log)]
+    degr = [e for e in events if e["event"] == "mesh_degrade"]
+    assert len(degr) == 1
+    assert degr[0]["devices_before"] == 8
+    assert degr[0]["devices_after"] == 4
+    assert degr[0]["lost_device"] == 3
+    # the summary carries the counters
+    summ = [e for e in events if e["event"] == "serve_health"]
+    assert summ and summ[-1]["mesh"]["degraded"] is True
+
+
+@needs8
+@pytest.mark.serve
+def test_mesh_degrade_sync_mode(tmp_path):
+    from dgc_tpu.obs import RunLogger
+    from dgc_tpu.serve.queue import ServeFrontEnd
+
+    graphs = _graphs(4, seed0=20)
+    base_front = ServeFrontEnd(batch_max=4, window_s=0.0,
+                               mode="sync").start()
+    base = [r.colors.tolist() for r in _serve_all(base_front, graphs)]
+    base_front.shutdown()
+
+    log = tmp_path / "sync.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    plane = faults.FaultPlane(FaultSchedule.parse("mesh@1=device_loss:0"))
+    with faults.injected(plane):
+        front = ServeFrontEnd(batch_max=4, window_s=0.0, mode="sync",
+                              mesh_devices=8, logger=logger).start()
+        results = _serve_all(front, graphs)
+        front.shutdown()
+    logger.close()
+    assert [r.status for r in results] == ["ok"] * len(graphs)
+    assert [r.colors.tolist() for r in results] == base
+    assert front.scheduler.mesh_devices == 4
+    assert _validate(log) == []
+    events = [json.loads(line) for line in open(log)]
+    assert any(e["event"] == "mesh_degrade" for e in events)
+
+
+@needs8
+@pytest.mark.serve
+def test_mesh_collapse_to_unsharded_still_serves():
+    from dgc_tpu.serve.queue import ServeFrontEnd
+
+    graphs = _graphs(3, seed0=40)
+    spec = ",".join(f"mesh@{i}=device_loss:{i - 1}" for i in range(1, 8))
+    plane = faults.FaultPlane(FaultSchedule.parse(spec))
+    with faults.injected(plane):
+        front = ServeFrontEnd(batch_max=4, window_s=0.0, mesh_devices=8,
+                              max_lane_aborts=20).start()
+        results = _serve_all(front, graphs)
+        front.shutdown()
+    assert [r.status for r in results] == ["ok"] * len(graphs)
+    # below two survivors the scheduler collapses to the unsharded path
+    assert front.scheduler.mesh is None
+    assert front.scheduler.mesh_health()["degraded"] is True
+
+
+@needs8
+@pytest.mark.serve
+def test_mesh_restore_after_degrade(tmp_path):
+    from dgc_tpu.obs import RunLogger
+    from dgc_tpu.serve.queue import ServeFrontEnd
+
+    graphs = _graphs(3, seed0=60)
+    log = tmp_path / "restore.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    plane = faults.FaultPlane(FaultSchedule.parse("mesh@1=device_loss:1"))
+    with faults.injected(plane):
+        front = ServeFrontEnd(batch_max=4, window_s=0.0, mesh_devices=8,
+                              logger=logger).start()
+        r1 = _serve_all(front, graphs[:2])
+        assert [r.status for r in r1] == ["ok", "ok"]
+        assert front.scheduler.mesh_devices == 4
+        # restore is gated on health: while the device is lost, a
+        # request is dropped
+        front.scheduler.request_restore()
+        time.sleep(0.3)
+        assert front.scheduler.mesh_devices == 4
+        # operator marks the device healthy -> restore succeeds
+        front.scheduler.device_health.mark_healthy(1)
+        front.scheduler.request_restore()
+        deadline = time.time() + 10
+        while front.scheduler.mesh_devices != 8 and time.time() < deadline:
+            time.sleep(0.05)
+        assert front.scheduler.mesh_devices == 8
+        r2 = _serve_all(front, graphs[2:])
+        assert r2[0].status == "ok"
+        health = front.health()
+        front.shutdown()
+    logger.close()
+    assert health["mesh"]["degraded"] is False
+    assert front.scheduler.stats_snapshot()["mesh_restores"] == 1
+    assert _validate(log) == []
+    events = [json.loads(line) for line in open(log)]
+    rest = [e for e in events if e["event"] == "mesh_restore"]
+    assert len(rest) == 1 and rest[0]["devices_after"] == 8
+
+
+@needs8
+@pytest.mark.serve
+def test_dispatch_watchdog_covers_sharded_path(tmp_path):
+    """Satellite: a hung SHARDED kernel dispatch must trigger the same
+    pool-rebuild the unsharded watchdog does (the seat/resize device
+    kernels now run inside the watchdogged closure too)."""
+    from dgc_tpu.obs import RunLogger
+    from dgc_tpu.serve.queue import ServeFrontEnd
+
+    graphs = _graphs(2, seed0=80)
+    log = tmp_path / "hang.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    plane = faults.FaultPlane(FaultSchedule.parse("serve_dispatch@1=hang:8"))
+    with faults.injected(plane):
+        front = ServeFrontEnd(batch_max=4, window_s=0.0, mesh_devices=8,
+                              dispatch_timeout=1.0, logger=logger).start()
+        results = _serve_all(front, graphs)
+        front.shutdown()
+    logger.close()
+    assert [r.status for r in results] == ["ok", "ok"]
+    assert _validate(log) == []
+    events = [json.loads(line) for line in open(log)]
+    rebuilds = [e for e in events if e["event"] == "lane_rebuild"]
+    assert rebuilds and rebuilds[0]["reason"] == "hang"
+    # the hang was NOT a device loss: the mesh stays at full size
+    assert front.scheduler.mesh_devices == 8
+    assert front.scheduler.stats_snapshot()["mesh_degrades"] == 0
+
+
+# ---------------------------------------------------------------------------
+# single-graph sharded sweep: re-shard rung + write-behind resume
+# ---------------------------------------------------------------------------
+
+def _cli(extra, out, nodes=300):
+    cmd = [sys.executable, "-m", "dgc_tpu.cli", "--node-count", str(nodes),
+           "--max-degree", "8", "--seed", "5", "--gen-method", "fast",
+           "--backend", "sharded", "--shards", "8", "--strict-decrement",
+           "--output-coloring", str(out)] + extra
+    return subprocess.run(cmd, cwd=REPO, env=dict(os.environ),
+                          capture_output=True, text=True, timeout=300)
+
+
+@needs8
+def test_reshard_rung_resumes_from_write_behind_checkpoint(tmp_path):
+    p0 = _cli([], tmp_path / "base.json")
+    assert p0.returncode == 0, p0.stderr[-2000:]
+    log = tmp_path / "run.jsonl"
+    p1 = _cli(["--reshard-on-loss", "--checkpoint-write-behind",
+               "--checkpoint-dir", str(tmp_path / "ck"),
+               "--inject-faults", "attempt@3=device_loss:5",
+               "--log-json", str(log)], tmp_path / "got.json")
+    assert p1.returncode == 0, p1.stderr[-2000:]
+    assert json.load(open(tmp_path / "base.json")) \
+        == json.load(open(tmp_path / "got.json"))
+    events = [json.loads(line) for line in open(log)]
+    fb = [(e["from_backend"], e["to_backend"], e["error_class"])
+          for e in events if e["event"] == "fallback"]
+    assert fb == [("sharded", "sharded@7", "device_loss")]
+    # the re-shard rung RESUMED the shared checkpoint namespace (two
+    # attempts were already banked by the primary rung)
+    resumes = [e for e in events if e["event"] == "checkpoint_resume"]
+    assert resumes and resumes[0]["backend"] == "sharded@7"
+    assert resumes[0]["next_k"] >= 1
+    assert _validate(log) == []
+
+
+@needs8
+def test_reshard_needs_shards_flag(tmp_path):
+    p = subprocess.run(
+        [sys.executable, "-m", "dgc_tpu.cli", "--node-count", "50",
+         "--max-degree", "4", "--backend", "sharded", "--reshard-on-loss",
+         "--output-coloring", str(tmp_path / "x.json")],
+        cwd=REPO, env=dict(os.environ), capture_output=True, text=True,
+        timeout=120)
+    assert p.returncode == 2
+    assert "--shards" in p.stderr
+
+
+def test_bad_reshard_rung_name_rejected(tmp_path):
+    p = subprocess.run(
+        [sys.executable, "-m", "dgc_tpu.cli", "--node-count", "50",
+         "--max-degree", "4", "--backend", "ell-compact",
+         "--fallback-ladder", "ell-compact@3",
+         "--output-coloring", str(tmp_path / "x.json")],
+        cwd=REPO, env=dict(os.environ), capture_output=True, text=True,
+        timeout=120)
+    assert p.returncode == 2
+    assert "re-shard" in p.stderr or "Unknown backend" in p.stderr
+
+
+# ---------------------------------------------------------------------------
+# chaos composition: kill-resume while the mesh is degraded
+# ---------------------------------------------------------------------------
+
+@needs8
+@pytest.mark.serve
+@pytest.mark.slow
+def test_chaos_mesh_degraded_kill_resume(tmp_path):
+    """The chaos_mesh leg-3 invariants end to end: SIGKILL at a seeded
+    journal offset while every incarnation runs a DEGRADED mesh — zero
+    acked-ticket loss, no duplicate ticket ids, replayed colors
+    byte-identical across incarnations."""
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_mesh.py"),
+         "--schedules", "0", "--sweeps", "0", "--kill-resume", "1",
+         "--clients", "2", "--requests-per-client", "2",
+         "--report", str(tmp_path / "report.json"),
+         "--workdir", str(tmp_path / "work")],
+        cwd=REPO, env=dict(os.environ), capture_output=True, text=True,
+        timeout=560)
+    assert p.returncode == 0, p.stderr[-3000:]
+    doc = json.load(open(tmp_path / "report.json"))
+    kr = doc["kill_resume"]
+    assert kr["outcome"] == "ok"
+    assert kr["kills"] >= 1 and kr["restarts"] >= 1
+
+
+@needs8
+@pytest.mark.serve
+def test_chaos_mesh_serve_schedule_smoke(tmp_path):
+    """One seeded serve-tier device-loss schedule through the real
+    chaos_mesh harness (in-process stack + listener + journal)."""
+    p = subprocess.run(
+        [sys.executable, os.path.join(REPO, "tools", "chaos_mesh.py"),
+         "--schedules", "1", "--sweeps", "0", "--kill-resume", "0",
+         "--clients", "2", "--requests-per-client", "1",
+         "--report", str(tmp_path / "report.json")],
+        cwd=REPO, env=dict(os.environ), capture_output=True, text=True,
+        timeout=560)
+    assert p.returncode == 0, p.stderr[-3000:]
+    doc = json.load(open(tmp_path / "report.json"))
+    assert doc["summary"]["failed"] == 0
+    assert doc["schedules"][0]["outcome"] in ("ok", "structured")

@@ -105,7 +105,8 @@ def test_mesh_unset_or_one_keeps_the_exact_path():
     assert sharded.mesh is not None and sharded.mesh_devices == 8
     sharded._kernel_for(c, 8)
     (key,) = sharded._kernels
-    assert key[-2:] == ("mesh", 8)
+    # mesh size + degrade/restore generation (0 = the pre-degrade mesh)
+    assert key[-3:] == ("mesh", 8, 0)
 
 
 def test_pad_ladder_mesh_floor():

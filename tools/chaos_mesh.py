@@ -1,0 +1,478 @@
+#!/usr/bin/env python
+"""Chaos harness for failure-domain mesh resilience: seeded device-kill
+schedules under a forced 8-host-device mesh.
+
+The mesh-tier analogue of ``tools/chaos_serve.py``. Three legs, one
+report, every leg on the SAME forced-device CPU mesh the committed
+parity artifacts use (``XLA_FLAGS=--xla_force_host_platform_device_count
+=N`` — the process re-execs itself once to get the flag in before the
+first jax import, the conftest pattern):
+
+**Leg 1 — serve-tier device-loss schedules (in-process).**
+``--schedules N`` runs of the full serving stack (``ServeFrontEnd``
+with ``--mesh-devices N`` + ``NetFront`` listener + ticket journal),
+each under a seeded ``device_loss`` schedule; a round-robin must-cover
+over the sharded points (``mesh`` = slice-boundary loss,
+``serve_dispatch`` = mid-ladder loss, ``lane_seat`` = loss during
+seating) guarantees every loss site is exercised. Invariant per
+schedule: every accepted ticket reaches a terminal result — ``ok`` with
+colors **bit-identical to the fault-free mesh run**, or a structured
+failure with rc context — the run log schema-validates, and when a
+fault fired the log carries a ``mesh_degrade`` and ``/healthz`` reports
+the degraded mesh. Never a hang, never a wrong coloring.
+
+**Leg 2 — single-graph re-shard sweeps (real processes).** Seeded
+variants of the sharded sweep CLI (``--backend sharded --shards N
+--reshard-on-loss --checkpoint-write-behind``) each under an injected
+device loss — at mesh construction, mid-sweep at an attempt boundary
+(strict mode, so the re-shard rung provably resumes from the
+write-behind attempt checkpoint), and a chained double loss that walks
+the ladder down to the single-device engines. Invariant: rc 0 with the
+output coloring byte-identical to the fault-free run (or a structured
+rc-114 abort — never a hang, never a wrong answer).
+
+**Leg 3 — kill-resume while DEGRADED (``--kill-resume``).** The
+``chaos_serve`` SIGKILL-at-seeded-journal-offset soak re-run with every
+server incarnation started ``--mesh-devices N`` plus an injected
+``device_loss`` — so the journal recovery, ticket-id high-water
+resume, and byte-identical replay are proven while the mesh is
+degraded, not just on the happy mesh. Zero acked-ticket loss, zero
+duplicate ids, stable re-polls.
+
+Usage::
+
+    python tools/chaos_mesh.py --schedules 6 --sweeps 3 --kill-resume 1 \\
+        --report /tmp/chaos_mesh.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import subprocess
+import sys
+import tempfile
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+CHAOS_MESH_REPORT_VERSION = 1
+
+_OUTCOMES = ("ok", "structured", "hang", "error", "mismatch")
+
+# the sharded loss sites leg 1 must cover (round-robin):
+# slice-boundary, mid-ladder dispatch, during seating
+MESH_POINTS = ("mesh", "serve_dispatch", "lane_seat")
+
+
+def _ensure_forced_devices(n: int) -> None:
+    """Re-exec ONCE with a clean env forcing ``n`` host devices before
+    any jax import (the tests/conftest.py pattern: this jax predates
+    jax_num_cpu_devices, so the XLA flag must be in the environment
+    before the backend initializes)."""
+    flags = os.environ.get("XLA_FLAGS", "")
+    forced = "xla_force_host_platform_device_count" in flags
+    if os.environ.get("DGC_TPU_CHAOS_MESH_REEXEC") == "1" or (
+            forced and "jax" not in sys.modules
+            and os.environ.get("JAX_PLATFORMS") == "cpu"):
+        return
+    env = dict(os.environ)
+    env["JAX_PLATFORMS"] = "cpu"
+    if not forced:
+        env["XLA_FLAGS"] = (
+            flags + f" --xla_force_host_platform_device_count={n}").strip()
+    env["DGC_TPU_CHAOS_MESH_REEXEC"] = "1"
+    env["PYTHONPATH"] = REPO
+    os.execve(sys.executable, [sys.executable] + sys.argv, env)
+
+
+# ---------------------------------------------------------------------------
+# leg 1: serve-tier device-loss schedules
+# ---------------------------------------------------------------------------
+
+def _leg1_schedule(index: int, args):
+    """Seeded device-loss schedule with a round-robin must-cover point."""
+    from dgc_tpu.resilience.faults import FaultSchedule, FaultSpec
+
+    rng = random.Random(args.seed * 77_003 + index)
+    must = MESH_POINTS[index % len(MESH_POINTS)]
+    specs = [FaultSpec(point=must, occurrence=rng.randint(1, 3),
+                       kind="device_loss",
+                       param=float(rng.randrange(args.mesh_devices)))]
+    extra = FaultSchedule.random_mesh(
+        rng, args.mesh_devices, n_faults=rng.randint(0, args.max_faults - 1),
+        points=MESH_POINTS)
+    for spec in extra:
+        if any(s.point == spec.point and s.occurrence == spec.occurrence
+               for s in specs):
+            continue
+        specs.append(spec)
+    return FaultSchedule(specs), must
+
+
+def _run_mesh_schedule(index: int, args, reqs, baseline) -> dict:
+    """One seeded device-loss schedule against a fresh mesh stack."""
+    from dgc_tpu.obs import RunLogger
+    from dgc_tpu.resilience import faults
+    from tools.chaos_serve import (_STRUCTURED_MARKERS, _drive_requests,
+                                   _stand_stack)
+    from tools.validate_runlog import validate_file
+
+    schedule, must = _leg1_schedule(index, args)
+    spec = schedule.to_spec()
+    entry = {"index": index, "spec": spec, "must_cover": must,
+             "fired": 0, "degrades": 0, "log_problems": 0,
+             "outcome": "error"}
+    workdir = tempfile.mkdtemp(prefix="dgc_chaos_mesh_")
+    log = os.path.join(workdir, "run.jsonl")
+    logger = RunLogger(jsonl_path=log, echo=False)
+    plane = faults.FaultPlane(schedule)
+    front = nf = None
+    errors: list = []
+    try:
+        with faults.injected(plane):
+            front, nf = _stand_stack(workdir, args, logger)
+            tickets, results, rejects, errors = _drive_requests(
+                nf.port, reqs, args.deadline)
+            health = front.health()
+        entry["fired"] = len(plane.fired_snapshot())
+        entry["rejects"] = rejects
+        if len(set(tickets)) != len(tickets):
+            errors.append("duplicate ticket ids")
+        structured = mismatched = 0
+        for req, ticket in zip(reqs, tickets):
+            doc = results.get(ticket)
+            if doc is None:
+                continue   # already accounted as a poll error
+            if doc.get("status") == "ok":
+                if doc.get("colors") != baseline[req["seed"]]:
+                    mismatched += 1
+            elif any(m in (doc.get("error") or "")
+                     for m in _STRUCTURED_MARKERS):
+                structured += 1
+            else:
+                errors.append(f"unstructured failure: {doc.get('error')}")
+        entry["structured"] = structured
+        if os.path.exists(log):
+            entry["log_problems"] = len(validate_file(log))
+        # a fired loss must be VISIBLE: a mesh_degrade in the stream and
+        # the degraded flag in /healthz (the observability half of the
+        # recovery contract)
+        with open(log) as fh:
+            entry["degrades"] = sum(
+                1 for line in fh
+                if '"event": "mesh_degrade"' in line
+                or '"event":"mesh_degrade"' in line)
+        if entry["fired"] and not entry["degrades"]:
+            errors.append("fault fired but no mesh_degrade event")
+        mesh_doc = health.get("mesh")
+        if entry["fired"]:
+            if not mesh_doc or not mesh_doc.get("degraded"):
+                errors.append(f"/healthz mesh state not degraded after "
+                              f"loss: {mesh_doc}")
+        if mismatched:
+            entry["outcome"] = "mismatch"
+        elif errors or entry["log_problems"] or len(results) != len(tickets):
+            entry["outcome"] = "error"
+            entry["errors"] = errors[:5]
+        else:
+            entry["outcome"] = "structured" if structured else "ok"
+    except RuntimeError as e:
+        entry["outcome"] = "hang" if "unreachable" in str(e) else "error"
+        entry["errors"] = [str(e)[:300]]
+    finally:
+        if nf is not None:
+            nf.close()
+        if front is not None:
+            front.shutdown()
+        logger.close()
+        shutil.rmtree(workdir, ignore_errors=True)
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# leg 2: single-graph re-shard sweeps
+# ---------------------------------------------------------------------------
+
+def _sweep_cmd(args, out, extra):
+    return [sys.executable, "-m", "dgc_tpu.cli",
+            "--node-count", str(args.sweep_nodes),
+            "--max-degree", str(args.sweep_degree),
+            "--seed", str(args.seed), "--gen-method", "fast",
+            "--backend", "sharded", "--shards", str(args.mesh_devices),
+            "--strict-decrement",
+            "--output-coloring", out] + extra
+
+
+def _run_sweep_variant(index: int, args, baseline_path: str) -> dict:
+    """One seeded single-graph device-loss variant: inject, run the CLI,
+    demand rc 0 + byte-identical colors (or a structured rc-114)."""
+    rng = random.Random(args.seed * 50_021 + index)
+    dev = rng.randrange(args.mesh_devices)
+    variants = (
+        # loss at mesh construction: the re-shard rung rebuilds at N-1
+        (f"mesh@1=device_loss:{dev}", "mesh-build"),
+        # loss mid-sweep at an attempt boundary: the re-shard rung
+        # resumes from the write-behind attempt checkpoint
+        (f"attempt@{rng.randint(2, 4)}=device_loss:{dev}", "mid-sweep"),
+        # chained double loss: primary AND re-shard rung both lose a
+        # device — the ladder concedes to the single-device engines
+        (f"mesh@1=device_loss:{dev},"
+         f"mesh@2=device_loss:{(dev + 1) % args.mesh_devices}",
+         "double-loss"),
+    )
+    spec, label = variants[index % len(variants)]
+    entry = {"index": index, "spec": spec, "variant": label,
+             "outcome": "error"}
+    workdir = tempfile.mkdtemp(prefix="dgc_chaos_mesh_sweep_")
+    out = os.path.join(workdir, "colors.json")
+    log = os.path.join(workdir, "run.jsonl")
+    cmd = _sweep_cmd(args, out, [
+        "--reshard-on-loss", "--inject-faults", spec,
+        "--checkpoint-dir", os.path.join(workdir, "ck"),
+        "--checkpoint-write-behind", "--log-json", log])
+    try:
+        p = subprocess.run(cmd, cwd=REPO, env=dict(os.environ),
+                           capture_output=True, text=True,
+                           timeout=args.deadline)
+    except subprocess.TimeoutExpired:
+        entry["outcome"] = "hang"
+        shutil.rmtree(workdir, ignore_errors=True)
+        return entry
+    entry["rc"] = p.returncode
+    try:
+        from tools.validate_runlog import validate_file
+
+        entry["log_problems"] = (len(validate_file(log))
+                                 if os.path.exists(log) else 0)
+        if p.returncode == 114:
+            # structured abort: acceptable (never a wrong answer), the
+            # ladder genuinely exhausted under the schedule
+            entry["outcome"] = ("structured" if not entry["log_problems"]
+                                else "error")
+        elif p.returncode != 0:
+            entry["outcome"] = "error"
+            entry["errors"] = [p.stderr[-300:]]
+        else:
+            with open(baseline_path) as fh:
+                base = json.load(fh)
+            with open(out) as fh:
+                got = json.load(fh)
+            if base != got:
+                entry["outcome"] = "mismatch"
+            elif entry["log_problems"]:
+                entry["outcome"] = "error"
+            else:
+                entry["outcome"] = "ok"
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def validate_chaos_mesh_report(doc) -> list[str]:
+    """Structural check (the chaos_sweep/chaos_serve convention)."""
+    problems: list[str] = []
+    if not isinstance(doc, dict):
+        return ["report is not an object"]
+    if doc.get("chaos_mesh_report_version") != CHAOS_MESH_REPORT_VERSION:
+        problems.append("missing/wrong chaos_mesh_report_version")
+    if not isinstance(doc.get("config"), dict):
+        problems.append("missing config object")
+    for leg, needs in (("schedules", ("index", "spec", "must_cover",
+                                      "outcome")),
+                       ("sweeps", ("index", "spec", "variant", "outcome"))):
+        entries = doc.get(leg)
+        if not isinstance(entries, list):
+            problems.append(f"missing {leg} list")
+            continue
+        for i, s in enumerate(entries):
+            for fieldname in needs:
+                if fieldname not in s:
+                    problems.append(f"{leg}[{i}]: missing {fieldname!r}")
+            if s.get("outcome") not in _OUTCOMES:
+                problems.append(
+                    f"{leg}[{i}]: unknown outcome {s.get('outcome')!r}")
+    kr = doc.get("kill_resume")
+    if kr is not None and kr.get("outcome") not in _OUTCOMES:
+        problems.append(f"kill_resume: unknown outcome "
+                        f"{kr.get('outcome')!r}")
+    summary = doc.get("summary")
+    if not isinstance(summary, dict):
+        problems.append("missing summary object")
+    else:
+        for fieldname in ("total", "ok", "structured", "failed"):
+            if not isinstance(summary.get(fieldname), int):
+                problems.append(f"summary: missing/invalid {fieldname!r}")
+    return problems
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--schedules", type=int, default=6,
+                   help="seeded serve-tier device-loss schedules (round-"
+                        "robin must-cover over mesh/serve_dispatch/"
+                        "lane_seat)")
+    p.add_argument("--sweeps", type=int, default=3,
+                   help="seeded single-graph re-shard sweep variants "
+                        "(mesh-build / mid-sweep / double-loss cycle)")
+    p.add_argument("--kill-resume", type=int, default=0, metavar="KILLS",
+                   help="SIGKILL/restart cycles at seeded journal "
+                        "offsets with every incarnation running a "
+                        "DEGRADED mesh (0 skips the leg)")
+    p.add_argument("--mesh-devices", type=int, default=8,
+                   help="forced host-device mesh size (default 8 — the "
+                        "committed parity shape)")
+    p.add_argument("--clients", type=int, default=3)
+    p.add_argument("--requests-per-client", type=int, default=2)
+    p.add_argument("--nodes", type=int, default=500,
+                   help="vertices per serve request (>=~300 lands in "
+                        "the batched shape ladder)")
+    p.add_argument("--degree", type=int, default=6)
+    p.add_argument("--sweep-nodes", type=int, default=300)
+    p.add_argument("--sweep-degree", type=int, default=8)
+    p.add_argument("--batch-max", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0)
+    p.add_argument("--max-faults", type=int, default=2)
+    p.add_argument("--dispatch-timeout", type=float, default=5.0)
+    p.add_argument("--max-lane-aborts", type=int, default=5,
+                   help="quarantine budget for the stacks under test "
+                        "(default 5: a request must survive a few "
+                        "witnessed losses before quarantining)")
+    p.add_argument("--deadline", type=float, default=240.0)
+    p.add_argument("--report", default="chaos_mesh_report.json")
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--keep-workdir", action="store_true")
+    args = p.parse_args(argv)
+    _ensure_forced_devices(args.mesh_devices)
+
+    import jax
+
+    if jax.device_count() < args.mesh_devices:
+        print(f"# chaos_mesh: only {jax.device_count()} device(s) after "
+              f"forcing — cannot exercise an {args.mesh_devices}-device "
+              f"mesh", file=sys.stderr)
+        return 2
+
+    from tools.chaos_serve import (_baseline_colors, _request_doc,
+                                   _run_kill_resume)
+
+    reqs = [_request_doc(args.nodes, args.degree, seed=c * 10_000 + r)
+            for c in range(args.clients)
+            for r in range(args.requests_per_client)]
+    print(f"# chaos_mesh: {len(reqs)} serve requests, mesh="
+          f"{args.mesh_devices}, schedules={args.schedules}, "
+          f"sweeps={args.sweeps}, kill-resume={args.kill_resume}",
+          file=sys.stderr)
+
+    schedules = []
+    baseline = {}
+    if args.schedules > 0 or args.kill_resume > 0:
+        # fault-free baseline ON THE MESH (PR 14 proves mesh on/off
+        # byte-identity; this pins the reference the faulted runs must
+        # reproduce)
+        baseline = _baseline_colors(args, reqs)
+        print(f"# chaos_mesh: fault-free mesh baseline captured "
+              f"({len(baseline)} colorings)", file=sys.stderr)
+    for i in range(args.schedules):
+        entry = _run_mesh_schedule(i, args, reqs, baseline)
+        schedules.append(entry)
+        print(f"# [serve {i}] {entry['outcome']:<12} "
+              f"fired={entry['fired']} degrades={entry['degrades']} "
+              f"cover={entry['must_cover']} spec={entry['spec']}",
+              file=sys.stderr)
+
+    sweeps = []
+    if args.sweeps > 0:
+        base_dir = tempfile.mkdtemp(prefix="dgc_chaos_mesh_base_")
+        baseline_path = os.path.join(base_dir, "base.json")
+        t0 = time.perf_counter()
+        p0 = subprocess.run(_sweep_cmd(args, baseline_path, []), cwd=REPO,
+                            env=dict(os.environ), capture_output=True,
+                            text=True, timeout=args.deadline)
+        if p0.returncode != 0:
+            print(f"# chaos_mesh: fault-free sweep baseline failed rc "
+                  f"{p0.returncode}: {p0.stderr[-300:]}", file=sys.stderr)
+            return 2
+        print(f"# chaos_mesh: sweep baseline in "
+              f"{time.perf_counter() - t0:.1f}s", file=sys.stderr)
+        for i in range(args.sweeps):
+            entry = _run_sweep_variant(i, args, baseline_path)
+            sweeps.append(entry)
+            print(f"# [sweep {i}] {entry['outcome']:<12} "
+                  f"variant={entry['variant']} spec={entry['spec']}",
+                  file=sys.stderr)
+        shutil.rmtree(base_dir, ignore_errors=True)
+
+    kill_resume = None
+    if args.kill_resume > 0:
+        # leg 3: the chaos_serve kill-resume soak with every incarnation
+        # degraded — --mesh-devices plus an injected device loss ride in
+        # through the server_extra hook
+        rng = random.Random(args.seed * 13_009 + 5)
+        kr_args = argparse.Namespace(**vars(args))
+        kr_args.kills = args.kill_resume
+        kr_args.server_extra = [
+            "--mesh-devices", str(args.mesh_devices),
+            "--inject-faults",
+            f"serve_dispatch@2=device_loss:"
+            f"{rng.randrange(args.mesh_devices)}"]
+        kill_resume = _run_kill_resume(kr_args, reqs, baseline)
+        print(f"# kill-resume (degraded): {kill_resume['outcome']} "
+              f"kills={kill_resume['kills']}/"
+              f"{kill_resume['kills_planned']} "
+              f"restarts={kill_resume['restarts']}", file=sys.stderr)
+
+    entries = schedules + sweeps
+    ok = sum(1 for e in entries if e["outcome"] == "ok")
+    structured = sum(1 for e in entries if e["outcome"] == "structured")
+    failed = len(entries) - ok - structured
+    if kill_resume is not None:
+        if kill_resume["outcome"] == "ok":
+            ok += 1
+        else:
+            failed += 1
+    report = {
+        "chaos_mesh_report_version": CHAOS_MESH_REPORT_VERSION,
+        "config": {"schedules": args.schedules, "sweeps": args.sweeps,
+                   "kill_resume": args.kill_resume,
+                   "mesh_devices": args.mesh_devices,
+                   "clients": args.clients,
+                   "requests_per_client": args.requests_per_client,
+                   "nodes": args.nodes, "degree": args.degree,
+                   "sweep_nodes": args.sweep_nodes,
+                   "sweep_degree": args.sweep_degree,
+                   "seed": args.seed, "batch_max": args.batch_max,
+                   "dispatch_timeout": args.dispatch_timeout,
+                   "max_lane_aborts": args.max_lane_aborts},
+        "schedules": schedules,
+        "sweeps": sweeps,
+        "kill_resume": kill_resume,
+        "summary": {"total": len(entries) + (1 if kill_resume else 0),
+                    "ok": ok, "structured": structured, "failed": failed},
+    }
+    problems = validate_chaos_mesh_report(report)
+    if problems:
+        for prob in problems:
+            print(f"# chaos_mesh report malformed: {prob}",
+                  file=sys.stderr)
+        failed += 1
+    with open(args.report, "w") as fh:
+        json.dump(report, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps({"chaos_mesh": {
+        "total": report["summary"]["total"], "ok": ok,
+        "structured": structured, "failed": failed,
+        "report": args.report}}))
+    return 0 if failed == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

@@ -301,6 +301,11 @@ class _Server:
                      "--window-ms", "0",
                      "--dispatch-timeout", str(args.dispatch_timeout),
                      "--max-lane-aborts", str(args.max_lane_aborts)]
+        # harness-composition hook (tools/chaos_mesh.py): extra serve-CLI
+        # flags every incarnation runs with — e.g. --mesh-devices 8 plus
+        # an injected device_loss, so the kill-resume soak exercises a
+        # DEGRADED mesh's journal recovery
+        self.args += list(getattr(args, "server_extra", []) or [])
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO
         env["JAX_PLATFORMS"] = "cpu"

@@ -18,7 +18,13 @@
 #      kill-resume cycle through tools/chaos_serve.py — recover or
 #      structured abort at every serve fault point, zero acked-ticket
 #      loss across the restart, colors bit-identical to fault-free.
-#   7. sharded serve-parity smoke (multi-device serve tier, same skip):
+#   7. chaos-mesh smoke (failure-domain plane, same skip): seeded
+#      device-loss schedules under a forced 8-host-device mesh through
+#      tools/chaos_mesh.py — survivor re-shard with colors bit-identical
+#      to fault-free (serve tier AND the single-graph re-shard rung with
+#      write-behind checkpoint resume), plus one kill-resume cycle on a
+#      DEGRADED mesh with zero acked-ticket loss.
+#   8. sharded serve-parity smoke (multi-device serve tier, same skip):
 #      3 draws of the batched-vs-single bit-identity ensemble with the
 #      lane axis sharded over a FORCED 8-host-device mesh
 #      (XLA_FLAGS=--xla_force_host_platform_device_count=8) — colors,
@@ -152,6 +158,38 @@ EOF
     echo "ci_checks: chaos-serve smoke OK" >&2
   else
     echo "ci_checks: chaos-serve smoke FAILED" >&2
+    rc=1
+  fi
+  # chaos-mesh smoke (failure-domain plane): 3 seeded device-loss
+  # schedules over the serve mesh points + the 3 single-graph re-shard
+  # variants (mesh-build / mid-sweep checkpoint resume / double loss)
+  # + 1 kill-resume cycle on a DEGRADED mesh — the harness's own
+  # invariants (recovery-or-structured-abort, zero acked loss,
+  # bit-identical colors, schema-valid logs) exit nonzero, and the
+  # report is structurally validated on top
+  if timeout 560 python tools/chaos_mesh.py \
+      --schedules 3 --sweeps 3 --kill-resume 1 \
+      --clients 2 --requests-per-client 2 --deadline 240 \
+      --report "$SMOKE_DIR/chaos_mesh.json" \
+      > "$SMOKE_DIR/chaos_mesh_summary.json" \
+    && python - "$SMOKE_DIR/chaos_mesh.json" <<'EOF'
+import json, sys
+sys.path.insert(0, ".")
+from tools.chaos_mesh import validate_chaos_mesh_report
+doc = json.load(open(sys.argv[1]))
+problems = validate_chaos_mesh_report(doc)
+assert not problems, problems
+assert doc["summary"]["failed"] == 0, doc["summary"]
+kr = doc.get("kill_resume")
+assert kr and kr["outcome"] == "ok" and kr["kills"] >= 1, kr
+print("ci_checks: chaos-mesh %d schedule(s) + %d sweep(s) + degraded "
+      "kill-resume ok" % (len(doc["schedules"]), len(doc["sweeps"])),
+      file=sys.stderr)
+EOF
+  then
+    echo "ci_checks: chaos-mesh smoke OK" >&2
+  else
+    echo "ci_checks: chaos-mesh smoke FAILED" >&2
     rc=1
   fi
   # sharded serve-parity smoke (multi-device serve tier): a 3-draw leg

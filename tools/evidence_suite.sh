@@ -185,6 +185,25 @@ if [ "$battery_rc" -ne 2 ]; then
     --deadline 900 --report chaos_serve_tpu.json 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
+  # chaos-mesh soak on-chip (failure-domain plane): seeded device-loss
+  # schedules + single-graph re-shard sweeps + a degraded kill-resume
+  # cycle, against the REAL device mesh — the CPU legs (ci_checks.sh
+  # smoke + tests/test_mesh_resilience.py) prove the protocol on forced
+  # host devices; the TPU question is whether survivor re-sharding
+  # stays bit-identical (and how long a degrade's evacuation +
+  # recompile actually stalls the serve loop) when the lost "device"
+  # is a real chip with in-flight work on its queues. NOTE: injected
+  # losses only — on-chip the plane raises InjectedDeviceLoss; a
+  # physically-dead chip additionally exercises the message-based
+  # classifier (retry._DEVICE_LOSS_MARKERS), which only a real outage
+  # can prove.
+  echo "=== chaos-mesh soak (device-loss schedules + degraded kill-resume) ===" | tee -a /dev/stderr >/dev/null
+  timeout 3600 python tools/chaos_mesh.py --schedules 6 --sweeps 3 \
+    --kill-resume 2 --mesh-devices "$(python -c 'import jax; n=len(jax.devices()); print(1 << max(0, n.bit_length()-1))')" \
+    --nodes 20000 --degree 16 --deadline 900 \
+    --report chaos_mesh_tpu.json 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+
   echo "=== cold compile, unified pipeline 1M-RMAT ===" | tee -a /dev/stderr >/dev/null
   # fresh cache dir = genuinely cold compile (removed after); outer
   # timeout sits ABOVE bench.py's 5400s in-process deadline so the

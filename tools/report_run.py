@@ -228,7 +228,10 @@ def render(doc: dict, width: int = 48) -> str:
             add(f"  summary: {summ.get('completed')}/{summ.get('requests')} "
                 f"ok, {summ.get('failed')} failed, "
                 f"{summ.get('rejected', 0)} shed"
-                + (f", {gps} graphs/s" if gps is not None else ""))
+                + (f", {gps} graphs/s" if gps is not None else "")
+                + (f", {summ['mesh_degrades']} mesh degrade(s) "
+                   f"({summ.get('lanes_evacuated', 0)} lane(s) evacuated)"
+                   if summ.get("mesh_degrades") else ""))
         rebuilds = sv.get("rebuilds") or []
         if rebuilds:
             # fault-plane recoveries: pool teardown/rebuild + poison
@@ -237,11 +240,30 @@ def render(doc: dict, width: int = 48) -> str:
             hangs = sum(1 for r in rebuilds if r.get("reason") == "hang")
             add(f"  rebuilds: {len(rebuilds)} ({hangs} watchdog hang(s), "
                 f"{quarantined} request(s) quarantined)")
+        mesh_ev = sv.get("mesh_events") or []
+        if mesh_ev:
+            # failure-domain plane: every mesh reshape in order —
+            # degrade (device loss -> survivor sub-mesh) and restore
+            walk = " -> ".join(
+                f"{e.get('devices_before')}→{e.get('devices_after')}"
+                f"{'' if e.get('event') == 'mesh_restore' else ' (lost dev ' + str(e.get('lost_device')) + ')'}"
+                for e in mesh_ev)
+            evacuated = sum(e.get("reseated", 0) for e in mesh_ev)
+            degrades = sum(1 for e in mesh_ev
+                           if e.get("event") == "mesh_degrade")
+            add(f"  mesh resilience: {degrades} degrade(s), "
+                f"{len(mesh_ev) - degrades} restore(s), "
+                f"{evacuated} lane(s) evacuated [{walk}]")
         hl = sv.get("health")
         if hl is not None and (not hl.get("ready") or hl.get("degraded")):
             add(f"  health: ready={hl.get('ready')} "
                 f"degraded={hl.get('degraded')} "
                 f"backend={hl.get('backend')} rung={hl.get('rung')}")
+        if hl is not None and hl.get("mesh") is not None:
+            m = hl["mesh"]
+            add(f"  mesh health: {m.get('devices_surviving')}/"
+                f"{m.get('devices_total')} device(s) surviving"
+                + (", DEGRADED" if m.get("degraded") else ""))
 
     nf = doc.get("netfront")
     if nf:

@@ -141,6 +141,32 @@ def _semantic_problems(record: dict) -> list[str]:
             v = record.get(fieldname)
             if isinstance(v, int) and not isinstance(v, bool) and v < 0:
                 problems.append(f"net_recover: {fieldname} {v} < 0")
+    # failure-domain plane: a degrade must shrink the mesh (and a
+    # restore grow it back), device counts stay >= 1 (devices_after 1 =
+    # collapsed to the unsharded path), and every evacuation count is
+    # non-negative — the chaos_mesh artifacts stay machine-checkable
+    elif kind in ("mesh_degrade", "mesh_restore"):
+        before, after = record.get("devices_before"), record.get(
+            "devices_after")
+        if isinstance(before, int) and isinstance(after, int):
+            if after < 1 or before < 1:
+                problems.append(f"{kind}: device counts must be >= 1 "
+                                f"({before} -> {after})")
+            elif kind == "mesh_degrade" and after > before:
+                # a degrade may KEEP the size (8 devices lose one ->
+                # pow2 4; a second loss leaves 6 survivors -> still 4,
+                # over a different survivor set) but never grow it
+                problems.append(
+                    f"mesh_degrade: devices_after {after} above "
+                    f"devices_before {before}")
+            elif kind == "mesh_restore" and after <= before:
+                problems.append(
+                    f"mesh_restore: devices_after {after} not above "
+                    f"devices_before {before}")
+        for fieldname in ("reseated", "quarantined"):
+            v = record.get(fieldname)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+                problems.append(f"{kind}: {fieldname} {v} < 0")
     elif kind == "lane_rebuild":
         if record.get("reason") not in ("abort", "hang"):
             problems.append(
@@ -154,6 +180,11 @@ def _semantic_problems(record: dict) -> list[str]:
     # devices when reported at all (size 1 is the unsharded path and
     # emits no mesh fields), and the per-device occupancy series has
     # one [0, 1] entry per mesh device
+    if kind == "serve_summary":
+        for fieldname in ("mesh_degrades", "lanes_evacuated"):
+            v = record.get(fieldname)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+                problems.append(f"serve_summary: {fieldname} {v} < 0")
     if kind in ("serve_start", "serve_slice", "serve_batch",
                 "serve_summary"):
         mesh_n = record.get("mesh_devices")
