@@ -49,6 +49,11 @@ LAYOUT_FILES = ("dgc_tpu/layout.py", "dgc_tpu/serve/batched.py",
 SCHEMA_GLOBS = ("dgc_tpu/**/*.py", "bench.py", "tools/*.py")
 LOCK_FILES = ("dgc_tpu/obs/metrics.py", "dgc_tpu/obs/httpd.py",
               "dgc_tpu/obs/flightrec.py",
+              # fleet telemetry plane: the sampler tick thread and
+              # scrape handlers share the timeseries ring; handler
+              # threads, worker callbacks and the run-log sink share
+              # the usage meter's accumulator rows
+              "dgc_tpu/obs/timeseries.py", "dgc_tpu/obs/usage.py",
               "dgc_tpu/serve/queue.py", "dgc_tpu/serve/engine.py",
               "dgc_tpu/serve/cli.py",
               # network front door (PR 12): listener threads mutate the

@@ -37,6 +37,13 @@ leaving the fused fast path**:
 - ``obs.profiler`` — programmatic ``jax.profiler`` windows
   (``--profile-window``, SLO-violation triggers, timed HTTP grabs)
   emitting manifest-linked artifacts for ``tools/xplane_split.py``.
+- ``obs.usage`` — per-tenant usage metering: the live ``UsageMeter``
+  behind ``GET /admin/usage`` and the conservation-checked journal fold
+  behind ``tools/usage_export.py``.
+- ``obs.timeseries`` — continuous SLO telemetry: the bounded metrics
+  sampler ring (``GET /debug/timeseries``) and the multi-window
+  burn-rate evaluator that fires ``slo_burn`` + flight-recorder dumps
+  while an incident is live.
 
 ``utils.logging`` and ``utils.tracing`` are backward-compatible shims over
 this package.
@@ -50,9 +57,12 @@ from dgc_tpu.obs.kernel import SuperstepTrajectory, decode_trajectory
 from dgc_tpu.obs.manifest import RunManifest
 from dgc_tpu.obs.metrics import MetricsRegistry
 from dgc_tpu.obs.phases import PhaseCollector
+from dgc_tpu.obs.timeseries import BurnRateEvaluator, TimeseriesSampler
 from dgc_tpu.obs.trace import NULL_TRACER, Tracer, tracer_for
+from dgc_tpu.obs.usage import UsageMeter
 
 __all__ = [
+    "BurnRateEvaluator",
     "FlightRecorder",
     "MetricsHTTPServer",
     "MetricsRegistry",
@@ -62,7 +72,9 @@ __all__ = [
     "RunLogger",
     "RunManifest",
     "SuperstepTrajectory",
+    "TimeseriesSampler",
     "Tracer",
+    "UsageMeter",
     "decode_trajectory",
     "install_sigusr1",
     "tracer_for",

@@ -13,7 +13,12 @@ goes wrong:
   through the CLI/bench abort callbacks and ``supervise_sweep``;
 - SLO-gate violations (``tools/slo_check.ViolationHooks``);
 - SIGUSR1 (:func:`install_sigusr1` — poke a live process for its tail);
-- on demand via ``GET /debug/flightrec`` (``obs.httpd``).
+- on demand via ``GET /debug/flightrec`` (``obs.httpd``);
+- armed incident events (:meth:`FlightRecorder.arm_auto_dump` — e.g.
+  ``mesh_degrade``: the ring is dumped the instant the event lands, so
+  the file holds the lead-up to the device loss, not its aftermath) and
+  continuous SLO burns (``obs.timeseries.BurnRateEvaluator`` through
+  ``ViolationHooks``).
 
 The dump is a valid run log: every retained record already passed
 through ``RunLogger`` (per-record schema holds by construction), and
@@ -37,6 +42,7 @@ from __future__ import annotations
 import json
 import os
 import threading
+import time
 from collections import deque
 from pathlib import Path
 
@@ -55,13 +61,54 @@ class FlightRecorder:
         self._seen = 0                         # guarded-by: _lock
         self._dumps = 0                        # guarded-by: _lock
         self._lock = threading.Lock()
+        # auto-dump triggers (arm_auto_dump): event kind -> last-fire
+        # monotonic time (None = never fired); guarded-by: _lock
+        self._auto: dict = {}
+        self._auto_dir = "."                   # guarded-by: _lock
+        self._auto_logger = None               # guarded-by: _lock
+        self._auto_cooldown = 10.0             # guarded-by: _lock
+
+    def arm_auto_dump(self, events, directory: str = ".", *,
+                      logger=None, cooldown_s: float = 10.0) -> None:
+        """Dump the ring automatically the moment any event whose kind
+        is in ``events`` lands in it (e.g. ``mesh_degrade`` — the ring
+        then holds the lead-up to the incident, not its aftermath).
+        Re-fires for the same kind are suppressed for ``cooldown_s``.
+        ``flightrec_dump`` itself is rejected as a trigger (the dump's
+        own live-stream trailer would recurse)."""
+        kinds = {str(k) for k in events}
+        if "flightrec_dump" in kinds:
+            raise ValueError("flightrec_dump cannot trigger itself")
+        with self._lock:
+            for kind in kinds:
+                self._auto.setdefault(kind, None)
+            self._auto_dir = directory
+            self._auto_logger = logger
+            self._auto_cooldown = float(cooldown_s)
 
     # -- RunLogger sink -------------------------------------------------
     def __call__(self, record: dict) -> None:
         rec = dict(record)   # writers may reuse/mutate their dicts
+        kind = rec.get("event")
+        fire = None
         with self._lock:
             self._ring.append(rec)
             self._seen += 1
+            if kind in self._auto:
+                now = time.monotonic()
+                last = self._auto[kind]
+                if last is None or now - last >= self._auto_cooldown:
+                    self._auto[kind] = now
+                    fire = (self._auto_dir, self._auto_logger)
+        if fire is not None:
+            # outside the lock: dump re-enters snapshot()'s lock, and the
+            # trailer event re-enters __call__ via the sink chain (safe —
+            # flightrec_dump is never an armed trigger)
+            try:
+                self.dump(fire[0], reason="auto", trigger=str(kind),
+                          logger=fire[1])
+            except OSError:
+                pass   # diagnostics must never take down the emitter
 
     def snapshot(self) -> tuple:
         """(records, seen) — a consistent copy for rendering/inspection."""

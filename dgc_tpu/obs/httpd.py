@@ -27,9 +27,20 @@ from __future__ import annotations
 
 import json
 import threading
+import time
 from dataclasses import dataclass, field
 from http.server import BaseHTTPRequestHandler, ThreadingHTTPServer
 from urllib.parse import parse_qs
+
+# process birth (monotonic): /metrics and /healthz report uptime
+# relative to this, set once at import — module import IS process start
+# for every dgc_tpu entry point
+_PROC_T0 = time.monotonic()
+
+
+def process_uptime_s() -> float:
+    """Seconds since this process imported the observability stack."""
+    return time.monotonic() - _PROC_T0
 
 # /debug/profile bounds: long enough for a useful window, short enough
 # that a fat-fingered request cannot wedge the handler pool
@@ -234,17 +245,46 @@ class RoutingHTTPServer:   # dgc-lint: threaded
 
 def mount_observability(server: RoutingHTTPServer, *, registry,
                         health_fn=None, recorder=None, profiler=None,
-                        flightrec_dir: str = ".") -> RoutingHTTPServer:
+                        flightrec_dir: str = ".", build_info=None,
+                        timeseries=None,
+                        usage_fn=None) -> RoutingHTTPServer:
     """Register the observability surface on ``server``: ``/metrics``
     (and ``/``) from ``registry.to_prometheus()``, ``/healthz`` from
     ``health_fn() -> dict``, ``/debug/flightrec`` from a
     ``FlightRecorder``, ``/debug/profile?ms=N`` from a profiler callable
     (``(ms) -> dict | None``, e.g. a bound ``obs.profiler
     .timed_window``). Backends left ``None`` are simply not mounted
-    (404). The registry/recorder/profiler guard their own state, so the
-    handlers are thread-safe by construction."""
+    (404).
+
+    Fleet-telemetry extensions: ``build_info`` (a flat string-valued
+    dict, e.g. version/backend/mesh) becomes the conventional
+    ``dgc_build_info`` all-labels gauge plus a ``build`` block in
+    ``/healthz``; both surfaces also report process uptime
+    (``dgc_process_uptime_seconds``, refreshed at scrape time).
+    ``timeseries`` (a :class:`~dgc_tpu.obs.timeseries
+    .TimeseriesSampler`) backs ``GET /debug/timeseries`` (the ring as
+    JSONL); ``usage_fn`` (``() -> list`` of ``usage_rollup`` rows, e.g.
+    a bound ``UsageMeter.snapshot``) backs ``GET /admin/usage``.
+
+    The registry/recorder/profiler/sampler/meter guard their own state,
+    so the handlers are thread-safe by construction."""
+
+    # gauges only with a registry (a registry-less listener still gets
+    # /healthz uptime + build; /metrics was always registry-backed)
+    uptime_gauge = None
+    if registry is not None:
+        if build_info:
+            registry.gauge(
+                "dgc_build_info",
+                "build identity (value is always 1; the labels carry it)",
+                **{k: str(v) for k, v in sorted(build_info.items())}
+            ).set(1)
+        uptime_gauge = registry.gauge(
+            "dgc_process_uptime_seconds", "seconds since process start")
 
     def metrics(req: Request) -> Response:
+        if uptime_gauge is not None:
+            uptime_gauge.set(round(process_uptime_s(), 3))
         return Response(body=registry.to_prometheus(),
                         ctype=PROM_CONTENT_TYPE)
 
@@ -252,8 +292,14 @@ def mount_observability(server: RoutingHTTPServer, *, registry,
     server.route("GET", "/", metrics)
 
     if health_fn is not None:
-        server.route("GET", "/healthz",
-                     lambda req: json_response(health_fn()))
+        def healthz(req: Request) -> Response:
+            doc = dict(health_fn())
+            doc["uptime_s"] = round(process_uptime_s(), 3)
+            if build_info:
+                doc["build"] = dict(build_info)
+            return json_response(doc)
+
+        server.route("GET", "/healthz", healthz)
 
     if recorder is not None:
         def flightrec(req: Request) -> Response:
@@ -284,6 +330,16 @@ def mount_observability(server: RoutingHTTPServer, *, registry,
             return json_response(result)
 
         server.route("GET", "/debug/profile", profile)
+
+    if timeseries is not None:
+        server.route(
+            "GET", "/debug/timeseries",
+            lambda req: Response(body=timeseries.to_jsonl(),
+                                 ctype="application/jsonl"))
+
+    if usage_fn is not None:
+        server.route("GET", "/admin/usage",
+                     lambda req: json_response({"usage": usage_fn()}))
     return server
 
 
@@ -299,7 +355,8 @@ class MetricsHTTPServer:   # dgc-lint: threaded
 
     def __init__(self, registry, port: int = 0, host: str = "127.0.0.1",
                  health_fn=None, recorder=None, profiler=None,
-                 flightrec_dir: str = "."):
+                 flightrec_dir: str = ".", build_info=None,
+                 timeseries=None, usage_fn=None):
         self.registry = registry
         self.health_fn = health_fn
         self.recorder = recorder
@@ -308,7 +365,8 @@ class MetricsHTTPServer:   # dgc-lint: threaded
         self._server = mount_observability(
             RoutingHTTPServer(port=port, host=host), registry=registry,
             health_fn=health_fn, recorder=recorder, profiler=profiler,
-            flightrec_dir=flightrec_dir)
+            flightrec_dir=flightrec_dir, build_info=build_info,
+            timeseries=timeseries, usage_fn=usage_fn)
 
     @property
     def port(self) -> int:

@@ -183,7 +183,34 @@ EVENT_SCHEMAS: dict = {
     "net_admit": (
         {"tenant": "str", "ticket": "str"},
         {"tier": "str", "priority": "int", "in_flight": "int",
-         "v": "int"}),
+         "v": "int",
+         # cross-boundary trace propagation: the W3C trace id the caller
+         # sent in ``traceparent`` — present ONLY when the request
+         # carried one, so the unheadered event stream stays
+         # byte-identical
+         "trace": "str"}),
+    # per-tenant usage metering (obs.usage): one accounting row per
+    # tenant, shared by the live /admin/usage snapshot and the offline
+    # journal fold of tools/usage_export.py. Semantic enforcement
+    # (non-negative counts, source vocabulary, in_flight conservation)
+    # lives in tools/validate_runlog.py
+    "usage_rollup": (
+        {"tenant": "str", "admitted": "int", "delivered": "int",
+         "failed": "int", "aborted": "int"},
+        {"in_flight": "int", "vertices": "int", "vertex_supersteps": "int",
+         "device_ms": NUM, "queue_ms": NUM, "service_ms": NUM,
+         "source": "str", "export_version": "int"}),
+    # continuous SLO burn-rate telemetry (obs.timeseries): one event per
+    # objective whose fast AND slow trailing-window burns crossed the
+    # threshold; ``dump``/``profile`` record the diagnostics the firing
+    # triggered (ViolationHooks). Objective vocabulary and the
+    # burn-needs-window rule are enforced by tools/validate_runlog.py
+    "slo_burn": (
+        {"objective": "str", "window_s": NUM, "burn": NUM},
+        {"fast_window_s": NUM, "slow_window_s": NUM,
+         "fast_burn": NUM, "slow_burn": NUM, "threshold": NUM,
+         "value": (*NUM, "null"), "limit": NUM,
+         "dump": ("str", "null"), "profile": "bool"}),
     "net_reject": (
         {"tenant": "str", "reason": "str"},
         {"retry_after_s": NUM, "queue_depth": "int", "capacity": "int",

@@ -1,0 +1,349 @@
+"""Continuous SLO telemetry: metrics timeseries ring + burn-rate alerts.
+
+Until now the SLO layer was post-hoc: ``tools/slo_check.py`` gates a
+FINISHED run's artifact. This module makes the same thresholds
+continuous. :class:`TimeseriesSampler` snapshots the
+:class:`~dgc_tpu.obs.metrics.MetricsRegistry` on an interval into a
+bounded in-memory ring (``to_dict()`` snapshots — the manifest's exact
+shape), dumpable as JSONL and served live at ``GET /debug/timeseries``.
+:class:`BurnRateEvaluator` rides the sampler's tick and evaluates the
+``tools/slo_check.py`` thresholds file over TWO trailing windows — the
+multi-window burn-rate pattern: a **fast** window (catches a sharp
+incident quickly) and a **slow** window (suppresses blips) must BOTH
+burn past the threshold before an ``slo_burn`` event fires. Firing
+triggers the existing :class:`tools.slo_check.ViolationHooks` — a
+flight-recorder dump and an optional profiler window — *while the
+incident is live*, instead of after exit.
+
+Windowed values are DELTAS between ring samples (counter differences,
+per-bucket histogram differences with bucket-interpolated quantiles —
+``obs.metrics.Histogram.quantile`` semantics), so a long-running serve
+loop's burn reflects the last minutes, not the lifetime average that
+would mask every incident after warm-up.
+
+Thread model: the sampler owns one daemon thread; the ring and the
+evaluator's fire state are lock-guarded (scrape handlers snapshot the
+ring concurrently with the tick). Everything is off unless the serve
+CLI arms it (``--timeseries-interval``), and the evaluator emits events
+only on an actual burn — the idle event stream stays byte-identical.
+"""
+
+from __future__ import annotations
+
+import json
+import re
+import threading
+import time
+from collections import deque
+
+DEFAULT_CAPACITY = 600
+
+# burn values are capped here: a zero limit (e.g. failure_rate_max = 0)
+# with any violation would otherwise be an infinite burn, which JSON
+# cannot carry portably
+BURN_CAP = 1e6
+
+_QUANTS = {"p50": 0.50, "p95": 0.95, "p99": 0.99}
+
+_STATUS_RE = re.compile(r'status="([^"]*)"')
+
+# the latency objective -> histogram family map (slo_check's)
+_LATENCY_FAMILIES = {"service_ms": "dgc_serve_service_seconds",
+                     "queue_ms": "dgc_serve_queue_seconds"}
+
+
+class TimeseriesSampler:
+    """Bounded thread-safe registry sampler.
+
+    ``start()`` spawns the tick thread; each tick appends
+    ``{"t": wall, "mono": perf_counter, "metrics": registry.to_dict()}``
+    to the ring and invokes ``on_sample(sample)`` (the evaluator's hook)
+    outside the lock. ``capacity`` bounds memory: at the default 1 s
+    interval the ring holds the trailing 10 minutes."""
+
+    def __init__(self, registry, interval_s: float = 1.0,
+                 capacity: int = DEFAULT_CAPACITY, on_sample=None):
+        if interval_s <= 0:
+            raise ValueError(f"interval_s must be > 0, got {interval_s}")
+        self.registry = registry                    # guarded-by: init
+        self.interval_s = float(interval_s)         # guarded-by: init
+        self.capacity = max(2, int(capacity))       # guarded-by: init
+        self.on_sample = on_sample                  # guarded-by: init
+        self._ring: deque = deque(maxlen=self.capacity)  # guarded-by: _lock
+        self._lock = threading.Lock()
+        self._stop = threading.Event()
+        self._thread: threading.Thread | None = None   # guarded-by: owner
+
+    def sample_once(self) -> dict:
+        """Take one sample now (the tick body; tests call it directly)."""
+        sample = {"t": round(time.time(), 6),
+                  "mono": time.perf_counter(),
+                  "metrics": self.registry.to_dict()}
+        with self._lock:
+            self._ring.append(sample)
+        cb = self.on_sample
+        if cb is not None:
+            try:
+                cb(sample)
+            except Exception:   # evaluator bug must not kill the sampler
+                pass
+        return sample
+
+    def _loop(self) -> None:
+        while not self._stop.wait(self.interval_s):
+            self.sample_once()
+
+    def start(self) -> "TimeseriesSampler":
+        if self._thread is None:
+            self._thread = threading.Thread(
+                target=self._loop, daemon=True, name="dgc-timeseries")
+            self._thread.start()
+        return self
+
+    def close(self) -> None:
+        self._stop.set()
+        if self._thread is not None:
+            self._thread.join(timeout=5)
+            self._thread = None
+
+    # -- reads -----------------------------------------------------------
+    def snapshot(self) -> list:
+        """Oldest-first copy of the ring."""
+        with self._lock:
+            return [dict(s) for s in self._ring]
+
+    def to_jsonl(self) -> str:
+        """The ring as JSONL (the ``GET /debug/timeseries`` body and the
+        ``--timeseries-jsonl`` dump artifact)."""
+        samples = self.snapshot()
+        if not samples:
+            return ""
+        return "\n".join(json.dumps(s) for s in samples) + "\n"
+
+    def write_jsonl(self, path: str) -> int:
+        """Dump the ring to ``path``; returns the sample count."""
+        samples = self.snapshot()
+        with open(path, "w") as fh:
+            for s in samples:
+                fh.write(json.dumps(s) + "\n")
+        return len(samples)
+
+
+# -- windowed delta helpers -------------------------------------------------
+
+def _counter_deltas(base: dict, latest: dict, family: str) -> dict:
+    """Per-status counter increments of one family between two registry
+    snapshots (a series absent at the base counts from zero)."""
+    out: dict = {}
+    for key, snap in latest.items():
+        if key.split("{", 1)[0] != family or snap.get("kind") != "counter":
+            continue
+        prev = base.get(key) or {}
+        delta = float(snap.get("value", 0)) - float(prev.get("value", 0))
+        m = _STATUS_RE.search(key)
+        status = m.group(1) if m is not None else ""
+        out[status] = out.get(status, 0.0) + max(0.0, delta)
+    return out
+
+
+def _histogram_delta(base: dict, latest: dict, family: str) -> tuple:
+    """Merged per-bucket increments of one histogram family between two
+    snapshots, summed across label variants (the window's latency
+    population). Returns (sorted [(hi_edge, count)], inf_count)."""
+    buckets: dict = {}
+    inf = 0.0
+    for key, snap in latest.items():
+        if key.split("{", 1)[0] != family \
+                or snap.get("kind") != "histogram":
+            continue
+        prev = base.get(key) or {}
+        prev_buckets = prev.get("buckets") or {}
+        for edge, count in (snap.get("buckets") or {}).items():
+            delta = float(count) - float(prev_buckets.get(edge, 0))
+            if delta > 0:
+                e = float(edge)
+                buckets[e] = buckets.get(e, 0.0) + delta
+        inf += max(0.0, float(snap.get("inf", 0))
+                   - float(prev.get("inf", 0)))
+    return sorted(buckets.items()), inf
+
+
+def _bucket_quantile(buckets: list, inf_count: float, q: float):
+    """Bucket-interpolated quantile over delta counts
+    (``obs.metrics.Histogram.quantile`` semantics); None when empty.
+    Mass in the +Inf bucket resolves to the last finite edge."""
+    total = sum(c for _, c in buckets) + inf_count
+    if total <= 0:
+        return None
+    target = q * total
+    cum = 0.0
+    lo = 0.0
+    for hi, c in buckets:
+        if c > 0 and cum + c >= target:
+            return lo + (hi - lo) * max(0.0, target - cum) / c
+        cum += c
+        lo = hi
+    return lo if buckets else None
+
+
+def _objectives(thresholds: dict) -> list:
+    """Flatten a ``tools/slo_check.py`` thresholds document into
+    continuously-evaluable objectives: ``(name, kind, quantile, limit)``
+    tuples. Per-class gates and throughput floors stay post-hoc (they
+    need the request list / the final wall clock)."""
+    out: list = []
+    for metric in ("service_ms", "queue_ms"):
+        for pname, limit in (thresholds.get(metric) or {}).items():
+            q = _QUANTS.get(pname)
+            if q is not None:
+                out.append((f"{metric}_{pname}", metric, q, float(limit)))
+    if thresholds.get("failure_rate_max") is not None:
+        out.append(("failure_rate", "failure_rate", None,
+                    float(thresholds["failure_rate_max"])))
+    return out
+
+
+def burn_rate(value: float, limit: float) -> float:
+    """value/limit, with the zero-limit edge mapped onto the cap (any
+    violation of a zero-tolerance objective is a max burn)."""
+    if limit > 0:
+        return min(BURN_CAP, value / limit)
+    return BURN_CAP if value > 0 else 0.0
+
+
+class BurnRateEvaluator:
+    """Multi-window burn-rate evaluation over a sampler's ring.
+
+    Construct with the sampler and a ``tools/slo_check.py`` thresholds
+    document, then ``sampler.on_sample = evaluator`` (or call
+    :meth:`evaluate` directly — the tests' path). An objective fires
+    when its burn is ≥ ``burn_threshold`` in BOTH the fast and the slow
+    trailing window (each window needs at least half its span of ring
+    coverage before it is considered warmed). Firing emits one
+    ``slo_burn`` event per objective, bumps
+    ``dgc_slo_burn_fired_total``, and trips ``hooks.fire`` (flightrec
+    dump + profiler window) once per evaluation; per-objective re-fires
+    are suppressed for ``cooldown_s`` (default: the fast window)."""
+
+    def __init__(self, sampler: TimeseriesSampler, thresholds: dict, *,
+                 fast_window_s: float = 60.0, slow_window_s: float = 300.0,
+                 burn_threshold: float = 1.0, cooldown_s: float | None = None,
+                 hooks=None, logger=None, registry=None):
+        if fast_window_s <= 0 or slow_window_s <= 0:
+            raise ValueError("burn windows must be > 0")
+        if slow_window_s < fast_window_s:
+            raise ValueError(
+                f"slow window {slow_window_s} shorter than fast window "
+                f"{fast_window_s}")
+        self.sampler = sampler                       # guarded-by: init
+        self.objectives = _objectives(thresholds)    # guarded-by: init
+        self.fast_window_s = float(fast_window_s)    # guarded-by: init
+        self.slow_window_s = float(slow_window_s)    # guarded-by: init
+        self.burn_threshold = float(burn_threshold)  # guarded-by: init
+        self.cooldown_s = (float(cooldown_s) if cooldown_s is not None
+                           else float(fast_window_s))  # guarded-by: init
+        self.hooks = hooks                           # guarded-by: init
+        self.logger = logger                         # guarded-by: init
+        self.registry = registry                     # guarded-by: init
+        self._lock = threading.Lock()
+        self._last_fire: dict = {}   # objective -> mono; guarded-by: _lock
+        self.fired = 0               # total firings; guarded-by: _lock
+
+    # the sampler's on_sample hook
+    def __call__(self, sample: dict) -> None:
+        self.evaluate(sample)
+
+    def _window_value(self, base: dict, latest: dict, kind: str,
+                      quantile):
+        """One objective's windowed value between two samples; None when
+        the window saw no traffic (no burn without evidence)."""
+        if kind == "failure_rate":
+            deltas = _counter_deltas(base["metrics"], latest["metrics"],
+                                     "dgc_serve_requests_total")
+            total = sum(deltas.values())
+            if total <= 0:
+                return None
+            return (total - deltas.get("ok", 0.0)) / total
+        buckets, inf = _histogram_delta(base["metrics"], latest["metrics"],
+                                        _LATENCY_FAMILIES[kind])
+        got = _bucket_quantile(buckets, inf, quantile)
+        return None if got is None else got * 1e3   # seconds -> ms
+
+    def _window_base(self, ring: list, latest: dict, window_s: float):
+        """The window's baseline sample: the oldest ring entry inside
+        the trailing window — or None while the ring covers less than
+        half the window (unwarmed windows never fire)."""
+        edge = latest["mono"] - window_s
+        base = None
+        for s in ring:
+            if s["mono"] >= edge:
+                base = s
+                break
+        if base is None or base is latest:
+            return None
+        if latest["mono"] - base["mono"] < window_s * 0.5:
+            return None
+        return base
+
+    def evaluate(self, sample: dict | None = None) -> list:
+        """Evaluate every objective at ``sample`` (default: the ring's
+        newest); returns the list of fired objective documents."""
+        ring = self.sampler.snapshot()
+        if not ring:
+            return []
+        latest = sample if sample is not None else ring[-1]
+        fast_base = self._window_base(ring, latest, self.fast_window_s)
+        slow_base = self._window_base(ring, latest, self.slow_window_s)
+        if fast_base is None or slow_base is None:
+            return []
+        fired: list = []
+        now = latest["mono"]
+        for name, kind, quantile, limit in self.objectives:
+            fast_v = self._window_value(fast_base, latest, kind, quantile)
+            slow_v = self._window_value(slow_base, latest, kind, quantile)
+            if fast_v is None or slow_v is None:
+                continue
+            fast_burn = burn_rate(fast_v, limit)
+            slow_burn = burn_rate(slow_v, limit)
+            if fast_burn < self.burn_threshold \
+                    or slow_burn < self.burn_threshold:
+                continue
+            with self._lock:
+                last = self._last_fire.get(name)
+                if last is not None and now - last < self.cooldown_s:
+                    continue
+                self._last_fire[name] = now
+                self.fired += 1
+            fired.append({"objective": name,
+                          "fast_burn": round(fast_burn, 4),
+                          "slow_burn": round(slow_burn, 4),
+                          "value": round(slow_v, 4), "limit": limit})
+        if not fired:
+            return []
+        hook_out = {"dump": None, "profile": None}
+        if self.hooks is not None:
+            try:
+                hook_out = self.hooks.fire(
+                    [f"slo_burn: {f['objective']} burn "
+                     f"{f['slow_burn']}x" for f in fired])
+            except Exception:   # diagnostics must never mask the burn
+                pass
+        for f in fired:
+            if self.registry is not None:
+                self.registry.counter(
+                    "dgc_slo_burn_fired_total",
+                    "continuous SLO burn-rate firings",
+                    objective=f["objective"]).inc()
+            if self.logger is not None:
+                self.logger.event(
+                    "slo_burn", objective=f["objective"],
+                    window_s=self.slow_window_s,
+                    burn=f["slow_burn"],
+                    fast_window_s=self.fast_window_s,
+                    slow_window_s=self.slow_window_s,
+                    fast_burn=f["fast_burn"], slow_burn=f["slow_burn"],
+                    threshold=self.burn_threshold,
+                    value=f["value"], limit=f["limit"],
+                    dump=hook_out.get("dump"),
+                    profile=hook_out.get("profile") is not None)
+        return fired

@@ -34,7 +34,9 @@ Design points:
 
 from __future__ import annotations
 
+import hashlib
 import itertools
+import re
 import threading
 import time
 
@@ -43,6 +45,54 @@ def now_us() -> int:
     """Monotonic microseconds (``time.perf_counter_ns`` base — the same
     clock family as ``RunLogger``'s relative ``t``)."""
     return time.perf_counter_ns() // 1000
+
+
+# -- W3C trace context (cross-boundary propagation) ------------------------
+#
+# The fleet telemetry plane speaks the W3C Trace Context wire format on
+# the HTTP boundary: ``traceparent: 00-<32hex trace>-<16hex parent>-<2hex
+# flags>``. An inbound header roots the request's span tree under the
+# CALLER's trace id (the span ``trace`` field becomes the 32-hex id, the
+# caller's span id rides the root span's ``attrs.remote_parent`` — never
+# the structural ``parent`` field, whose begin record the validator would
+# demand in OUR log), so one trace id spans client, listener, and every
+# restart incarnation that replays the journaled ticket.
+
+_TRACEPARENT_RE = re.compile(
+    r"^([0-9a-f]{2})-([0-9a-f]{32})-([0-9a-f]{16})-([0-9a-f]{2})$")
+
+
+def parse_traceparent(header) -> tuple[str, str] | None:
+    """Parse a W3C ``traceparent`` header into ``(trace_id, parent_id)``
+    (lowercase hex), or None for anything malformed: wrong shape, the
+    forbidden version ``ff``, or the all-zero trace/parent ids the spec
+    reserves as invalid. Absent/None headers return None — the caller's
+    no-propagation path."""
+    if not isinstance(header, str):
+        return None
+    m = _TRACEPARENT_RE.match(header.strip().lower())
+    if m is None:
+        return None
+    version, trace_id, parent_id, _flags = m.groups()
+    if version == "ff" or trace_id == "0" * 32 or parent_id == "0" * 16:
+        return None
+    return trace_id, parent_id
+
+
+def format_traceparent(trace_id: str, span_id: str,
+                       sampled: bool = True) -> str:
+    """Render a version-00 ``traceparent`` header value."""
+    return f"00-{trace_id}-{span_id}-{'01' if sampled else '00'}"
+
+
+def boundary_span_id(ticket_id: str) -> str:
+    """Deterministic 16-hex span id for the service boundary, derived
+    from the ticket id — every incarnation that touches the same ticket
+    derives the SAME id, so the ``traceparent`` echoed in the 202 (and
+    any downstream hop keyed on it) stays stable across crash-resume
+    replays. All-zero (spec-invalid) output is remapped."""
+    digest = hashlib.sha256(ticket_id.encode()).hexdigest()[:16]
+    return digest if digest != "0" * 16 else "1" * 16
 
 
 class Span:

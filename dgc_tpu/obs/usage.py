@@ -1,0 +1,277 @@
+"""Per-tenant usage metering: the fleet telemetry plane's billing rows.
+
+ROADMAP item 2 ("per-tenant usage metering and billing export — the
+ticket journal already sees every admitted/delivered record per tenant,
+so it is the natural metering substrate") lands here in two halves over
+ONE row shape:
+
+- :class:`UsageMeter` — the **live** accumulator the network front door
+  feeds on its own request path (admit / abort / completion callbacks)
+  and, registered as a ``RunLogger`` sink, the device-time attributor:
+  a sweep span's closing ``attrs.device_us`` is charged to the tenant
+  whose request trace it rode in on. ``snapshot()`` serves
+  ``GET /admin/usage`` — the same rows, live.
+- :func:`fold_journal` — the **offline** fold ``tools/usage_export.py``
+  runs over a durable ticket journal (plus run logs for the device-time
+  column): per-tenant accounting rows recomputed from the crash-safe
+  record stream, so a kill-resume soak's N incarnations fold into ONE
+  ledger with no lost or double-metered ticket (``scan_journal`` dedups
+  by ticket id; the conservation check in the exporter proves the sums
+  equal the journal's raw totals exactly).
+
+Row shape (the ``usage_rollup`` event schema, ``obs.schema``): lifecycle
+counts (admitted / delivered / failed / aborted / in_flight), work
+volume (vertices, vertices·supersteps), kernel device-ms (the PR 7
+timing column, joined through the trace id), and summed queue/service
+latency milliseconds. ``COUNT_FIELDS`` is the conservation vocabulary —
+every count is per-ticket-once by construction.
+"""
+
+from __future__ import annotations
+
+import threading
+
+USAGE_EXPORT_VERSION = 1
+
+# the conservation-checked lifecycle counts: each counts a ticket at
+# most once (admitted exactly once; delivered/failed are mutually
+# exclusive terminals; aborted marks the never-acked)
+COUNT_FIELDS = ("admitted", "delivered", "failed", "aborted")
+
+USAGE_SOURCES = ("live", "journal")
+
+
+def _fresh_acc() -> dict:
+    return {"admitted": 0, "delivered": 0, "failed": 0, "aborted": 0,
+            "vertices": 0, "vertex_supersteps": 0, "device_us": 0,
+            "queue_ms": 0.0, "service_ms": 0.0}
+
+
+def rollup_row(tenant: str, acc: dict, source: str) -> dict:
+    """Shape one tenant's accumulator into the ``usage_rollup`` event
+    fields (shared by the live ``/admin/usage`` rows and the offline
+    export, so the two can never drift)."""
+    in_flight = (acc["admitted"] - acc["delivered"] - acc["failed"]
+                 - acc["aborted"])
+    return {"tenant": tenant,
+            "admitted": int(acc["admitted"]),
+            "delivered": int(acc["delivered"]),
+            "failed": int(acc["failed"]),
+            "aborted": int(acc["aborted"]),
+            "in_flight": int(in_flight),
+            "vertices": int(acc["vertices"]),
+            "vertex_supersteps": int(acc["vertex_supersteps"]),
+            "device_ms": round(acc["device_us"] / 1e3, 3),
+            "queue_ms": round(float(acc["queue_ms"]), 3),
+            "service_ms": round(float(acc["service_ms"]), 3),
+            "source": source,
+            "export_version": USAGE_EXPORT_VERSION}
+
+
+def payload_vertices(payload) -> int:
+    """Vertex count of a journaled request payload (generator spec or
+    inline graph); 0 when unknown/malformed — metering must never fail
+    the path it rides."""
+    if not isinstance(payload, dict):
+        return 0
+    try:
+        if "node_count" in payload:
+            return max(0, int(payload["node_count"]))
+        graph = payload.get("graph")
+        if isinstance(graph, list):
+            return len(graph)
+    except (TypeError, ValueError):
+        pass
+    return 0
+
+
+class UsageMeter:
+    """Thread-safe live per-tenant usage accumulator.
+
+    The netfront calls the ``record_*`` hooks from handler threads and
+    worker completion callbacks; registered as a ``RunLogger`` sink it
+    additionally charges closing sweep spans' ``attrs.device_us`` to
+    the tenant whose trace was bound at admission — all under one lock,
+    all O(1) per event (the byte-identity bar: metering adds no events
+    to the stream, only a live read surface)."""
+
+    def __init__(self):
+        self._lock = threading.Lock()
+        self._rows: dict = {}     # tenant -> accumulator; guarded-by: _lock
+        self._traces: dict = {}   # trace id -> tenant; guarded-by: _lock
+
+    def _row(self, tenant: str) -> dict:
+        # caller-holds-lock helper: every call site is inside
+        # ``with self._lock`` (the lock pass can't see across the call)
+        row = self._rows.get(tenant)  # dgc-lint: ok LK001
+        if row is None:
+            row = self._rows[tenant] = _fresh_acc()  # dgc-lint: ok LK001
+        return row
+
+    def record_admitted(self, tenant: str, vertices: int,
+                        trace: str | None = None) -> None:
+        """One admitted ticket; ``trace`` (the request's span trace id)
+        binds subsequent device-time attribution to ``tenant``."""
+        with self._lock:
+            row = self._row(tenant)
+            row["admitted"] += 1
+            row["vertices"] += int(vertices)
+            if trace is not None:
+                self._traces[str(trace)] = tenant
+
+    def record_aborted(self, tenant: str) -> None:
+        """An admitted ticket that was never acked (queue shed / drain
+        race) — mirrors the journal's ``aborted`` record."""
+        with self._lock:
+            self._row(tenant)["aborted"] += 1
+
+    def record_done(self, tenant: str, status: str, queue_s: float,
+                    service_s: float, vertices: int = 0,
+                    supersteps: int = 0) -> None:
+        """One terminal result: delivered (``status == "ok"``) or
+        failed, plus the latency and vertices·supersteps columns."""
+        with self._lock:
+            row = self._row(tenant)
+            row["delivered" if status == "ok" else "failed"] += 1
+            row["queue_ms"] += float(queue_s) * 1e3
+            row["service_ms"] += float(service_s) * 1e3
+            row["vertex_supersteps"] += int(vertices) * int(supersteps)
+
+    # -- RunLogger sink: device-time attribution -------------------------
+    def __call__(self, record: dict) -> None:
+        if record.get("event") != "span" or record.get("ph") != "E":
+            return
+        attrs = record.get("attrs") or {}
+        us = attrs.get("device_us")
+        if not isinstance(us, int) or isinstance(us, bool):
+            return
+        with self._lock:
+            tenant = self._traces.get(record.get("trace"))
+            if tenant is not None:
+                self._row(tenant)["device_us"] += us
+
+    def snapshot(self) -> list:
+        """Per-tenant ``usage_rollup`` rows (``source="live"``), sorted
+        by tenant — the ``GET /admin/usage`` body."""
+        with self._lock:
+            rows = {t: dict(acc) for t, acc in self._rows.items()}
+        return [rollup_row(t, acc, source="live")
+                for t, acc in sorted(rows.items())]
+
+
+# -- offline fold (tools/usage_export.py) ----------------------------------
+
+def fold_journal(journal_path: str, log_paths=()) -> list:
+    """Fold a durable ticket journal (plus optional run-log JSONLs for
+    the device-time column) into per-tenant ``usage_rollup`` rows
+    (``source="journal"``). Ticket-exact: ``scan_journal`` dedups every
+    lifecycle stage by ticket id, so N crash-resume incarnations over
+    one journal meter each ticket once."""
+    import json
+
+    from dgc_tpu.serve.netfront.journal import scan_journal
+
+    state = scan_journal(journal_path)
+    accs: dict = {}
+    trace_of: dict = {}   # request trace id -> tenant
+    for ent in state.tickets:
+        acc = accs.setdefault(ent.tenant, _fresh_acc())
+        acc["admitted"] += 1
+        v = payload_vertices(ent.payload)
+        acc["vertices"] += v
+        trace_of[ent.trace or f"req-{ent.ticket}"] = ent.tenant
+        if ent.aborted:
+            acc["aborted"] += 1
+        if ent.result_doc is not None:
+            doc = ent.result_doc
+            acc["delivered" if doc.get("status") == "ok" else "failed"] += 1
+            acc["queue_ms"] += float(doc.get("queue_ms") or 0.0)
+            acc["service_ms"] += float(doc.get("service_ms") or 0.0)
+            acc["vertex_supersteps"] += v * sum(
+                int(a.get("supersteps") or 0) for a in ent.attempts)
+    for path in log_paths:
+        try:
+            with open(path) as fh:
+                raw = fh.read()
+        except OSError:
+            continue
+        lines = raw.split("\n")
+        torn_tail = not raw.endswith("\n")
+        for i, line in enumerate(lines):
+            line = line.strip()
+            if not line:
+                continue
+            try:
+                rec = json.loads(line)
+            except json.JSONDecodeError:
+                if torn_tail and i == len(lines) - 1:
+                    continue   # live log mid-write
+                raise ValueError(f"{path}:{i + 1}: unparseable JSON line")
+            if not isinstance(rec, dict) or rec.get("event") != "span" \
+                    or rec.get("ph") != "E":
+                continue
+            us = (rec.get("attrs") or {}).get("device_us")
+            tenant = trace_of.get(rec.get("trace"))
+            if tenant is not None and isinstance(us, int) \
+                    and not isinstance(us, bool):
+                accs[tenant]["device_us"] += us
+    return [rollup_row(t, acc, source="journal")
+            for t, acc in sorted(accs.items())]
+
+
+def journal_totals(journal_path: str) -> dict:
+    """The conservation reference: lifecycle totals recomputed straight
+    from the raw journal record stream (dedup by ticket id per stage,
+    results for tickets absent from the WAL dropped — the recovery
+    scanner's exact admission rules, derived independently of the
+    per-tenant fold so the two can disagree when either is wrong)."""
+    import os
+
+    from dgc_tpu.serve.netfront.journal import RESULTS_FILE, _scan_lines
+
+    wal_docs, _ = _scan_lines(journal_path)
+    res_docs, _ = _scan_lines(
+        os.path.join(os.path.dirname(journal_path), RESULTS_FILE))
+    admitted: dict = {}   # ticket -> payload vertices
+    aborted: set = set()
+    terminal: dict = {}   # ticket -> last terminal status
+    for doc in wal_docs:
+        rec, ticket = doc["rec"], doc["ticket"]
+        if rec == "admitted" and ticket not in admitted:
+            admitted[ticket] = payload_vertices(doc.get("payload"))
+        elif rec == "aborted":
+            aborted.add(ticket)
+    for doc in res_docs:
+        if doc["ticket"] not in admitted:
+            continue   # never acked: breadcrumbs drop, exactly as recovery
+        if doc["rec"] in ("delivered", "failed"):
+            terminal[doc["ticket"]] = (doc.get("result") or {}).get("status")
+    delivered = sum(1 for s in terminal.values() if s == "ok")
+    return {"admitted": len(admitted),
+            "delivered": delivered,
+            "failed": len(terminal) - delivered,
+            "aborted": len(aborted & set(admitted)),
+            "vertices": sum(admitted.values())}
+
+
+def conservation_problems(rows: list, journal_path: str) -> list:
+    """Exact-equality check: per-tenant rollup sums vs the journal's raw
+    totals (:func:`journal_totals`). Empty list = conserved; anything
+    else means a ticket was lost or double-metered somewhere between
+    the journal and the rows."""
+    totals = journal_totals(journal_path)
+    problems: list = []
+    for fieldname in (*COUNT_FIELDS, "vertices"):
+        got = sum(int(r.get(fieldname, 0)) for r in rows)
+        want = totals[fieldname]
+        if got != want:
+            problems.append(
+                f"usage conservation: sum({fieldname}) = {got} != "
+                f"journal total {want}")
+    for r in rows:
+        if r.get("in_flight", 0) < 0:
+            problems.append(
+                f"usage conservation: tenant {r.get('tenant')!r} "
+                f"in_flight {r['in_flight']} < 0 (double-metered "
+                f"terminal?)")
+    return problems

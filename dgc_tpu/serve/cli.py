@@ -211,11 +211,97 @@ def build_serve_parser() -> argparse.ArgumentParser:
                    help="disable request-scoped span tracing (spans are "
                         "emitted into --log-json by default; "
                         "tools/export_trace.py renders them)")
+    p.add_argument("--timeseries-interval", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="sample the metrics registry into a bounded "
+                        "in-memory ring every SECONDS (obs.timeseries); "
+                        "served at GET /debug/timeseries; 0 (default) "
+                        "disables the sampler")
+    p.add_argument("--timeseries-capacity", type=int, default=600,
+                   help="samples retained in the timeseries ring "
+                        "(default 600 — 10 min at a 1 s interval)")
+    p.add_argument("--timeseries-jsonl", type=str, default=None,
+                   metavar="PATH",
+                   help="dump the timeseries ring to PATH as JSONL at "
+                        "shutdown (with --timeseries-interval)")
+    p.add_argument("--slo-thresholds", type=str, default=None,
+                   metavar="JSON",
+                   help="continuous SLO burn-rate evaluation (with "
+                        "--timeseries-interval): a path to (or inline) "
+                        "tools/slo_check.py thresholds JSON; each "
+                        "sampler tick evaluates the objectives over "
+                        "fast+slow trailing windows and a sustained "
+                        "burn fires slo_burn events + the flight-"
+                        "recorder dump while the incident is live")
+    p.add_argument("--burn-fast-window", type=float, default=60.0,
+                   metavar="SECONDS",
+                   help="fast burn-rate window (default 60)")
+    p.add_argument("--burn-slow-window", type=float, default=300.0,
+                   metavar="SECONDS",
+                   help="slow burn-rate window (default 300)")
+    p.add_argument("--burn-threshold", type=float, default=1.0,
+                   help="burn rate (windowed value / SLO limit) both "
+                        "windows must reach to fire (default 1.0)")
+    p.add_argument("--burn-profile-ms", type=float, default=0.0,
+                   help="also open a jax.profiler window of this length "
+                        "on an SLO burn (0 = no profiler window)")
     return p
 
 
+def _build_timeseries(args, registry, recorder, logger):
+    """Stand the continuous-telemetry plane (``obs.timeseries``) when
+    ``--timeseries-interval`` is set: the sampler ring, and — with
+    ``--slo-thresholds`` — the burn-rate evaluator wired to the flight
+    recorder / profiler through ``tools/slo_check.ViolationHooks``.
+    Returns the started sampler or None; raises ValueError on a bad
+    thresholds document."""
+    if args.timeseries_interval <= 0:
+        if args.slo_thresholds:
+            print("# --slo-thresholds ignored without "
+                  "--timeseries-interval: burn rates need samples",
+                  file=sys.stderr)
+        return None
+    from dgc_tpu.obs.timeseries import BurnRateEvaluator, TimeseriesSampler
+
+    sampler = TimeseriesSampler(registry,
+                                interval_s=args.timeseries_interval,
+                                capacity=args.timeseries_capacity)
+    if args.slo_thresholds:
+        raw = args.slo_thresholds
+        if not raw.lstrip().startswith("{"):
+            raw = Path(raw).read_text()
+        thresholds = json.loads(raw)
+        if not isinstance(thresholds, dict):
+            raise ValueError("--slo-thresholds must be a JSON object")
+        hooks = None
+        try:
+            # tools/ is a sibling of the package in a source checkout;
+            # reach it the same way the test suite does
+            repo_root = str(Path(__file__).resolve().parents[2])
+            if repo_root not in sys.path:
+                sys.path.insert(0, repo_root)
+            from tools.slo_check import ViolationHooks
+
+            hooks = ViolationHooks(
+                recorder=recorder, dump_dir=args.flightrec_dir,
+                profile_logdir=(args.profile_logdir
+                                if args.burn_profile_ms > 0 else None),
+                profile_ms=args.burn_profile_ms, logger=logger)
+        except ImportError:
+            print("# tools/slo_check.py not importable: slo_burn "
+                  "events fire without flightrec/profiler hooks",
+                  file=sys.stderr)
+        sampler.on_sample = BurnRateEvaluator(
+            sampler, thresholds,
+            fast_window_s=args.burn_fast_window,
+            slow_window_s=args.burn_slow_window,
+            burn_threshold=args.burn_threshold,
+            hooks=hooks, logger=logger, registry=registry)
+    return sampler.start()
+
+
 def _listen_main(args, front, logger, registry, manifest, recorder,
-                 warmup) -> int:
+                 warmup, sampler=None) -> int:
     """Network mode (``--listen``): stand the netfront listener over
     the started front end and serve until a drain completes (``POST
     /admin/drain`` or Ctrl-C). Application and observability routes
@@ -246,6 +332,7 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
                           args.profile_logdir, ms, trigger="http",
                           logger=logger),
                       journal_dir=args.journal_dir,
+                      timeseries=sampler,
                       host=args.listen_host, port=args.listen).start()
     except OSError as e:
         print(f"--listen: cannot bind {args.listen}: {e}",
@@ -298,6 +385,7 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
                  d2h_mb=round(sst["d2h_bytes"] / 1e6, 3),
                  **summary_kw)
     nf.close()
+    _close_timeseries(args, sampler)
     if args.run_manifest:
         manifest.finalize(registry=registry)
         manifest.write(args.run_manifest)
@@ -307,6 +395,21 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
         logger.event("metrics_written", path=args.metrics_prom)
     logger.close()
     return 0
+
+
+def _close_timeseries(args, sampler) -> None:
+    """Stop the sampler and land the ring artifact
+    (``--timeseries-jsonl``) on the way out."""
+    if sampler is None:
+        return
+    sampler.close()
+    if args.timeseries_jsonl:
+        try:
+            n = sampler.write_jsonl(args.timeseries_jsonl)
+            print(f"# timeseries: {n} samples -> {args.timeseries_jsonl}",
+                  file=sys.stderr)
+        except OSError as e:
+            print(f"# --timeseries-jsonl: {e}", file=sys.stderr)
 
 
 def _load_request_graph(doc: dict) -> Graph:
@@ -345,6 +448,11 @@ def serve_main(argv: list[str] | None = None) -> int:
                                   registry=registry)
         logger.add_sink(recorder)
         install_sigusr1(recorder, args.flightrec_dir, logger=logger)
+        # incident auto-dump: a device loss (mesh_degrade) dumps the
+        # ring the moment the event lands — the file holds the lead-up
+        # to the failure, exactly what a post-mortem needs
+        recorder.arm_auto_dump({"mesh_degrade"}, args.flightrec_dir,
+                               logger=logger)
     # serve-tier fault plane (--inject-faults): armed exactly like the
     # sweep CLI's — hard_kill (a real process dies like a SIGKILL, rc
     # 137) and every fired fault into the event stream + registry. With
@@ -372,6 +480,14 @@ def serve_main(argv: list[str] | None = None) -> int:
 
         faults.install(faults.FaultPlane(schedule, hard_kill=True,
                                          on_fire=on_fire))
+
+    # continuous telemetry plane (obs.timeseries): sampler ring +
+    # optional burn-rate evaluation over --slo-thresholds
+    try:
+        sampler = _build_timeseries(args, registry, recorder, logger)
+    except (OSError, ValueError, json.JSONDecodeError) as e:
+        print(f"--slo-thresholds: {e}", file=sys.stderr)
+        return 2
 
     tuned_cache = None
     if args.tuned_cache_dir:
@@ -462,16 +578,19 @@ def serve_main(argv: list[str] | None = None) -> int:
               "on the listener port", file=sys.stderr)
     elif args.metrics_port is not None:
         from dgc_tpu.obs import MetricsHTTPServer, profiler
+        from dgc_tpu.serve.netfront.listener import build_info_doc
 
         try:
             metrics_server = MetricsHTTPServer(
                 registry, port=args.metrics_port,
                 health_fn=lambda: front.health(),
+                build_info=build_info_doc(front),
                 # live diagnostics (PR 11): GET /debug/flightrec streams
                 # the ring; GET /debug/profile?ms= opens a timed
                 # jax.profiler window over the running loop
                 recorder=recorder,
                 flightrec_dir=args.flightrec_dir,
+                timeseries=sampler,
                 profiler=lambda ms: profiler.timed_window(
                     args.profile_logdir, ms, trigger="http",
                     logger=logger)).start()
@@ -500,7 +619,7 @@ def serve_main(argv: list[str] | None = None) -> int:
 
     if args.listen is not None:
         return _listen_main(args, front, logger, registry, manifest,
-                            recorder, warmup)
+                            recorder, warmup, sampler=sampler)
 
     t0 = time.perf_counter()
     bad = 0
@@ -582,6 +701,7 @@ def serve_main(argv: list[str] | None = None) -> int:
                  **summary_kw)
     if metrics_server is not None:
         metrics_server.close()
+    _close_timeseries(args, sampler)
     if args.run_manifest:
         manifest.finalize(registry=registry)
         manifest.write(args.run_manifest)

@@ -11,7 +11,8 @@ over the same ``--journal-dir`` loses nothing a client was promised.
 Record stream (one JSON object per line, ``rec`` is the type)::
 
     {"rec": "admitted",  "ticket": "t00000003", "tenant": ..,
-     "priority": .., "payload": {..the request document..}}
+     "priority": .., "payload": {..the request document..},
+     "trace": ..?, "trace_parent": ..?}   # W3C ids, only when propagated
     {"rec": "seated",    "ticket": ..}            # front-end accepted it
     {"rec": "attempt",   "ticket": .., "k": .., "status": ..,
      "supersteps": ..}                            # one per minimal-k attempt
@@ -297,6 +298,12 @@ class JournalTicket:
     result_doc: dict | None = None   # delivered/failed terminal doc
     aborted: bool = False
     seated: bool = False
+    # cross-boundary trace context (obs.trace): the W3C trace id and
+    # caller span id the request arrived under, persisted in the
+    # admitted record so a recovery replay RESUMES the original trace
+    # across incarnations instead of minting a fresh one
+    trace: str | None = None
+    trace_parent: str | None = None
 
     @property
     def completed(self) -> bool:
@@ -376,6 +383,13 @@ def scan_journal(path: str) -> JournalState:
                 ent.tenant = str(doc.get("tenant", "anon"))
                 ent.priority = int(doc.get("priority", 0))
                 ent.payload = doc.get("payload")
+                # trace fields are absent unless the submit carried a
+                # traceparent (byte-identity: untraced journals are
+                # unchanged)
+                if doc.get("trace") is not None:
+                    ent.trace = str(doc["trace"])
+                if doc.get("trace_parent") is not None:
+                    ent.trace_parent = str(doc["trace_parent"])
         elif rec == "seated":
             ent.seated = True
         elif rec == "aborted":

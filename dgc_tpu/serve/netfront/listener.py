@@ -69,6 +69,9 @@ from dgc_tpu.models.node import Node
 from dgc_tpu.obs.httpd import (Request, Response, RoutingHTTPServer,
                                StreamingResponse, json_response,
                                mount_observability)
+from dgc_tpu.obs.trace import (boundary_span_id, format_traceparent,
+                               parse_traceparent)
+from dgc_tpu.obs.usage import UsageMeter, payload_vertices
 from dgc_tpu.resilience.faults import fault_point
 from dgc_tpu.serve.netfront.admission import (AdmissionController,
                                               AdmissionReject)
@@ -76,6 +79,33 @@ from dgc_tpu.serve.netfront.journal import TicketJournal, scan_journal
 from dgc_tpu.serve.queue import QueueFull, ServeError, ServeResult
 
 TENANT_HEADER = "X-Dgc-Tenant"
+
+# W3C Trace Context (cross-boundary propagation, obs.trace): an inbound
+# traceparent roots the request's span tree under the caller's trace id
+TRACEPARENT_HEADER = "traceparent"
+
+
+def build_info_doc(front=None) -> dict:
+    """The build-identity labels ``/metrics`` (``dgc_build_info``) and
+    ``/healthz`` carry: package version, resolved JAX backend, and the
+    serve tier's lane-mesh shape. Never raises — a fleet dashboard must
+    render even when the backend is half-initialized."""
+    from dgc_tpu.version import __version__
+    doc = {"version": str(__version__)}
+    try:
+        import jax
+        doc["backend"] = str(jax.default_backend())
+    except Exception:
+        doc["backend"] = "unknown"
+    mesh = None
+    if front is not None:
+        try:
+            mesh = front.health().get("mesh")
+        except Exception:
+            mesh = None
+    devices = (mesh or {}).get("devices_total")
+    doc["mesh"] = f"{devices}x1" if devices else "1x1"
+    return doc
 
 # completed tickets retained for polling before FIFO eviction; in-flight
 # tickets are never evicted (zero-lost-results contract, tools/soak.py)
@@ -92,9 +122,10 @@ class _NetTicket:
     attempt feed and the completion slot; streamers wait on it."""
 
     __slots__ = ("ticket_id", "tenant", "priority", "cond", "attempts",
-                 "result", "t_submit")
+                 "result", "t_submit", "trace", "v")
 
-    def __init__(self, ticket_id: str, tenant: str, priority: int):
+    def __init__(self, ticket_id: str, tenant: str, priority: int,
+                 trace: str | None = None, v: int = 0):
         self.ticket_id = ticket_id
         self.tenant = tenant
         self.priority = priority
@@ -102,6 +133,11 @@ class _NetTicket:
         self.attempts: list = []   # guarded-by: cond
         self.result = None         # guarded-by: cond
         self.t_submit = time.perf_counter()
+        # trace id the request's span tree runs under (W3C id when the
+        # caller propagated one, else the req-<ticket> default) and the
+        # vertex count — the usage meter's join keys
+        self.trace = trace if trace is not None else f"req-{ticket_id}"
+        self.v = int(v)
 
 
 def _result_doc(res, with_colors: bool = False) -> dict:
@@ -134,12 +170,20 @@ class NetFront:
                  result_capacity: int = DEFAULT_RESULT_CAPACITY,
                  journal: TicketJournal | None = None,
                  journal_dir: str | None = None,
-                 replay_timeout: float = 60.0):
+                 replay_timeout: float = 60.0,
+                 usage: UsageMeter | None = None,
+                 timeseries=None):
         self.front = front
         self.admission = admission if admission is not None \
             else AdmissionController(registry=registry, logger=logger)
         self.registry = registry
         self.logger = logger
+        # per-tenant usage metering (obs.usage): fed on the admit/abort/
+        # completion path and, as a run-log sink, by closing sweep
+        # spans' device_us — served live from GET /admin/usage
+        self.usage = usage if usage is not None else UsageMeter()
+        if logger is not None:
+            logger.add_sink(self.usage)
         # durable ticket journal (module docstring): None = the PR 12
         # in-memory-only behavior, byte-identical with the flag unset
         self.journal = journal if journal is not None else (
@@ -160,7 +204,10 @@ class NetFront:
         self.server = RoutingHTTPServer(port=port, host=host)
         mount_observability(self.server, registry=registry,
                             health_fn=self._health_doc, recorder=recorder,
-                            profiler=profiler, flightrec_dir=flightrec_dir)
+                            profiler=profiler, flightrec_dir=flightrec_dir,
+                            build_info=build_info_doc(front),
+                            timeseries=timeseries,
+                            usage_fn=self.usage.snapshot)
         self.server.route("POST", "/v1/color", self._post_color)
         self.server.route("GET", "/v1/result/", self._get_result,
                           prefix=True)
@@ -256,18 +303,29 @@ class NetFront:
             self._event("net_reject", **fields)
             return self._reject_response(fields)
         priority = cfg.resolved_priority()
+        # cross-boundary trace propagation: a valid inbound traceparent
+        # roots this request's span tree under the CALLER's trace id
+        # (absent/malformed headers change nothing — the unheadered
+        # request path stays byte-identical with PR 15)
+        tp = parse_traceparent(req.headers.get(TRACEPARENT_HEADER))
         with self._lock:
             ticket_id = f"t{self._next_ticket:08x}"
             self._next_ticket += 1
-        net_ticket = _NetTicket(ticket_id, tenant, priority)
+        net_ticket = _NetTicket(ticket_id, tenant, priority,
+                                trace=(tp[0] if tp is not None else None),
+                                v=graph.num_vertices)
         # write-ahead: the admitted record (with the replayable payload)
         # goes to the journal BEFORE the submit; the durable wait rides
-        # the "seated" append below so both land under one group commit
+        # the "seated" append below so both land under one group commit.
+        # The trace ids ride the admitted record so a recovery replay in
+        # a later incarnation resumes the ORIGINAL trace.
+        trace_fields = ({} if tp is None
+                        else {"trace": tp[0], "trace_parent": tp[1]})
         if self.journal is not None:
             try:
                 self.journal.append("admitted", ticket_id, durable=False,
                                     tenant=tenant, priority=priority,
-                                    payload=doc)
+                                    payload=doc, **trace_fields)
             except Exception as e:
                 self.admission.release(tenant)
                 self._event("net_reject", tenant=tenant,
@@ -276,10 +334,15 @@ class NetFront:
                     {"error": f"ticket journal unavailable: {e}",
                      "reason": "journal_error", "tenant": tenant},
                     status=503)
+        self.usage.record_admitted(tenant, graph.num_vertices,
+                                   trace=net_ticket.trace)
         try:
-            self._attach(net_ticket, graph)
+            self._attach(net_ticket, graph,
+                         trace=(tp[0] if tp is not None else None),
+                         trace_remote=(tp[1] if tp is not None else None))
         except QueueFull as e:
             self.admission.release(tenant)
+            self.usage.record_aborted(tenant)
             self._journal_soft("aborted", ticket_id, reason="queue_full")
             fields = dict(e.to_fields(), tenant=tenant,
                           reason="queue_full")
@@ -288,6 +351,7 @@ class NetFront:
         except ServeError:
             # the front end began draining between our check and submit
             self.admission.release(tenant)
+            self.usage.record_aborted(tenant)
             self._journal_soft("aborted", ticket_id, reason="draining")
             self._event("net_reject", tenant=tenant, reason="draining")
             return json_response(
@@ -310,25 +374,36 @@ class NetFront:
                      "reason": "journal_error", "tenant": tenant},
                     status=503)
         snap = self.admission.snapshot().get(tenant, {})
+        admit_fields = {} if tp is None else {"trace": tp[0]}
         self._event("net_admit", tenant=tenant, ticket=ticket_id,
                     tier=cfg.tier, priority=priority,
                     in_flight=int(snap.get("in_flight", 1)),
-                    v=int(graph.num_vertices))
+                    v=int(graph.num_vertices), **admit_fields)
         if self.registry is not None:
             self.registry.counter(
                 "dgc_net_admitted_total", "requests admitted",
                 tenant=tenant).inc()
-        return json_response(
-            {"ticket": ticket_id, "tenant": tenant, "priority": priority},
-            status=202)
+        body = {"ticket": ticket_id, "tenant": tenant,
+                "priority": priority}
+        headers = ()
+        if tp is not None:
+            # echo the continued trace: same trace id, OUR boundary span
+            # id (ticket-derived, stable across crash-resume replays)
+            body["trace"] = tp[0]
+            headers = ((TRACEPARENT_HEADER,
+                        format_traceparent(tp[0],
+                                           boundary_span_id(ticket_id))),)
+        return json_response(body, status=202, headers=headers)
 
     def _attach(self, net_ticket: _NetTicket, graph: Graph,
-                timeout: float = 0.0) -> None:
+                timeout: float = 0.0, trace: str | None = None,
+                trace_remote: str | None = None) -> None:
         """Submit ``graph`` under ``net_ticket``'s id and register the
         ticket: the shared tail of the live submit path and journal
         replay (the only difference is replay's queue-space timeout —
         a recovering listener may hold more in-flight tickets than the
-        bounded queue admits at once)."""
+        bounded queue admits at once). ``trace``/``trace_remote``
+        propagate an inbound W3C trace context into the span tree."""
         ticket_id = net_ticket.ticket_id
 
         def on_attempt(res, val):
@@ -342,7 +417,8 @@ class NetFront:
         serve_ticket = self.front.submit(
             graph.arrays, request_id=ticket_id,
             timeout=timeout, priority=net_ticket.priority,
-            on_attempt=on_attempt)
+            on_attempt=on_attempt, trace=trace,
+            trace_remote=trace_remote)
         with self._lock:
             self._tickets[ticket_id] = net_ticket
         serve_ticket.add_done_callback(
@@ -382,10 +458,19 @@ class NetFront:
             "delivered" if result.status == "ok" else "failed",
             net_ticket.ticket_id,
             result=_result_doc(result, with_colors=True))
+        # every attempt is already appended by completion time, so the
+        # usage read can take its own acquisition ahead of publication
+        with net_ticket.cond:
+            supersteps = sum(int(a.get("supersteps") or 0)
+                             for a in net_ticket.attempts)
         with net_ticket.cond:
             net_ticket.result = result
             net_ticket.cond.notify_all()
         self.admission.release(net_ticket.tenant)
+        self.usage.record_done(net_ticket.tenant, result.status,
+                               result.queue_s, result.service_s,
+                               vertices=net_ticket.v,
+                               supersteps=supersteps)
         if self.registry is not None:
             self.registry.counter(
                 "dgc_net_requests_total", "completed network requests",
@@ -542,28 +627,43 @@ class NetFront:
         for ent in state.tickets:
             if ent.aborted:
                 continue   # never acked — nothing was promised
-            net_ticket = _NetTicket(ent.ticket, ent.tenant, ent.priority)
+            net_ticket = _NetTicket(ent.ticket, ent.tenant, ent.priority,
+                                    trace=ent.trace)
+            # bind the original trace (journaled W3C id or the stable
+            # req-<ticket> default) so this incarnation's device time
+            # meters to the right tenant
+            self.usage.record_admitted(ent.tenant,
+                                       payload_vertices(ent.payload),
+                                       trace=net_ticket.trace)
             # pre-publication the ticket is thread-confined, but the
             # cond is cheap and keeps the lock discipline uniform
             with net_ticket.cond:
                 net_ticket.attempts = list(ent.attempts)
             if ent.completed:
+                res = self._recovered_result(ent.ticket, ent.result_doc)
                 with net_ticket.cond:
-                    net_ticket.result = self._recovered_result(
-                        ent.ticket, ent.result_doc)
+                    net_ticket.result = res
                 self._restore_completed(ent.ticket, net_ticket)
+                self.usage.record_done(net_ticket.tenant, res.status,
+                                       res.queue_s, res.service_s)
                 restored += 1
                 self._event("net_recover", action="restored",
                             ticket=ent.ticket, tenant=ent.tenant)
                 continue
-            # in flight at the crash: replay the journaled payload.
+            # in flight at the crash: replay the journaled payload —
+            # under the ORIGINAL trace id (cross-incarnation trace
+            # continuity: the journaled W3C context, when present, or
+            # the deterministic req-<ticket> default either way).
             # Dedup is by ticket id — the id is already allocated below
             # the resumed counter, so a replay can never collide with a
             # fresh submit.
             try:
                 graph = self._load_graph(ent.payload or {})
+                net_ticket.v = graph.num_vertices
                 self._attach(net_ticket, graph,
-                             timeout=self.replay_timeout)
+                             timeout=self.replay_timeout,
+                             trace=ent.trace,
+                             trace_remote=ent.trace_parent)
                 replayed += 1
                 self._event("net_recover", action="replayed",
                             ticket=ent.ticket, tenant=ent.tenant)
@@ -579,6 +679,8 @@ class NetFront:
                         service_s=0.0, batched=False, shape_class=None,
                         error=msg)
                 self._restore_completed(ent.ticket, net_ticket)
+                self.usage.record_done(net_ticket.tenant, "error",
+                                       0.0, 0.0)
                 self._journal_soft("failed", ent.ticket,
                                    result={"status": "error",
                                            "error": msg})

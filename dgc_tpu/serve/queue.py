@@ -419,14 +419,22 @@ class ServeFrontEnd:
 
     def submit(self, arrays: GraphArrays, request_id: int | None = None,
                timeout: float = 0.0, priority: int = 0,
-               on_attempt=None) -> ServeTicket:
+               on_attempt=None, trace: str | None = None,
+               trace_remote: str | None = None) -> ServeTicket:
         """Admit one request; raises :class:`QueueFull` (with structured
         backpressure context) when the bounded queue stays full past
         ``timeout`` (0 = reject immediately). ``priority`` > 0 (the
         netfront's paid tiers) queues ahead of lower-priority waiters
         and rides into the batch scheduler's affinity path;
         ``on_attempt(res, val)`` observes every minimal-k attempt from
-        the worker thread (the streaming route's progress feed)."""
+        the worker thread (the streaming route's progress feed).
+        ``trace`` overrides the span tree's trace id (cross-boundary
+        propagation: the netfront passes an inbound W3C traceparent's
+        32-hex id so the whole tree roots under the caller's trace);
+        ``trace_remote`` records the caller's span id in the root span's
+        ``attrs.remote_parent`` — attrs, not the structural ``parent``
+        field, whose begin record lives in the CALLER's log, not ours.
+        Both default to the PR 7 behavior (trace ``req-<id>``)."""
         with self._lock:
             if not self._started:
                 raise ServeError("front-end not started")
@@ -464,10 +472,16 @@ class ServeFrontEnd:
                                on_attempt=on_attempt)
             # trace root + queue-wait child: begun under the admission
             # lock (the worker popping this request must find the spans
-            # in place), trace id = the request id
+            # in place), trace id = the request id unless the caller
+            # propagated one across the boundary
+            attrs = {"v": int(arrays.num_vertices)}
+            if trace_remote is not None:
+                attrs["remote_parent"] = str(trace_remote)
             req.root_span = self.tracer.begin(
-                "request", trace=f"req-{request_id}",
-                attrs={"v": int(arrays.num_vertices)})
+                "request",
+                trace=(str(trace) if trace is not None
+                       else f"req-{request_id}"),
+                attrs=attrs)
             req.queue_span = self.tracer.begin("queue",
                                                parent=req.root_span)
             ticket = ServeTicket(req)

@@ -451,11 +451,18 @@ def test_one_listener_serves_app_and_observability_routes():
         # per-tenant labels break out on the shared registry
         assert 'dgc_net_admitted_total{tenant="acme"}' in text
         assert 'dgc_net_requests_total' in text
+        # build identity + process uptime ride the same scrape
+        assert 'dgc_build_info{' in text
+        assert 'version="0.1.0"' in text and 'backend="' in text
+        assert "dgc_process_uptime_seconds" in text
         st, body = _get(nf.port, "/healthz")
         health = json.loads(body)
         assert st == 200 and health["ready"] is True
         assert health["draining"] is False
         assert "acme" in health["tenants"]
+        assert health["uptime_s"] > 0
+        assert health["build"]["version"] == "0.1.0"
+        assert health["build"]["mesh"] == "1x1"
         st, body = _get(nf.port, "/debug/flightrec")
         assert st == 200 and b"net_admit" in body
         assert _get(nf.port, "/nope")[0] == 404

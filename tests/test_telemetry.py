@@ -1,0 +1,603 @@
+"""Fleet telemetry plane tests: W3C traceparent propagation across the
+HTTP boundary and journal-replay incarnations, per-tenant usage metering
+(live meter, journal fold, exact conservation), the timeseries sampler
+ring + multi-window SLO burn-rate evaluator, the mesh_degrade
+flight-recorder auto-dump trigger, and the new ``usage_rollup`` /
+``slo_burn`` schema + validate_runlog semantics."""
+
+import json
+import time
+
+import numpy as np
+import pytest
+
+from dgc_tpu.obs import (FlightRecorder, MetricsRegistry, RunLogger,
+                         UsageMeter)
+from dgc_tpu.obs.timeseries import BurnRateEvaluator, TimeseriesSampler
+from dgc_tpu.obs.trace import (boundary_span_id, format_traceparent,
+                               parse_traceparent)
+from dgc_tpu.obs.usage import (conservation_problems, fold_journal,
+                               journal_totals, payload_vertices)
+from dgc_tpu.serve.netfront import NetFront, TicketJournal, scan_journal
+from dgc_tpu.serve.queue import ServeFrontEnd, ServeResult
+from tools.validate_runlog import validate_file
+
+pytestmark = pytest.mark.serve
+
+TRACE_ID = "4bf92f3577b34da6a3ce929d0e0e4736"
+PARENT_ID = "00f067aa0ba902b7"
+TRACEPARENT = f"00-{TRACE_ID}-{PARENT_ID}-01"
+
+
+# -- no-jax front end (the test_netfront pattern) -----------------------
+
+class _FakeAttempt:
+    class _Status:
+        name = "SUCCESS"
+
+    def __init__(self, k):
+        self.k = int(k)
+        self.status = self._Status()
+        self.supersteps = 5
+
+
+class _InstantFront(ServeFrontEnd):
+    def _serve_one(self, req):
+        t0 = time.perf_counter()
+        if req.on_attempt is not None:
+            try:
+                req.on_attempt(_FakeAttempt(3), None)
+            except Exception:
+                pass
+        v = int(req.arrays.num_vertices)
+        return ServeResult(
+            request_id=req.request_id, status="ok",
+            colors=np.arange(v, dtype=np.int32) % 3, minimal_colors=3,
+            attempts=[(3, "SUCCESS", 5)], queue_s=t0 - req.t_submit,
+            service_s=time.perf_counter() - t0,
+            batched=False, shape_class=None)
+
+
+_SPEC = {"node_count": 24, "max_degree": 3, "seed": 5,
+         "gen_method": "fast"}
+
+
+def _post(port, path, doc, headers=None):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json", **(headers or {})},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else {}), dict(e.headers)
+
+
+def _get(port, path):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return r.status, r.read()
+    except urllib.error.HTTPError as e:
+        return e.code, e.read()
+
+
+def _poll(port, ticket, timeout=30.0):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        st, body = _get(port, f"/v1/result/{ticket}?colors=1")
+        if st != 202:
+            return st, json.loads(body)
+        time.sleep(0.01)
+    raise TimeoutError(f"ticket {ticket} never terminal")
+
+
+def _stack(tmp_path, logger=None, **nf_kw):
+    front = _InstantFront(batch_max=2, workers=2, queue_depth=32,
+                          window_s=0.0, logger=logger).start()
+    nf = NetFront(front, logger=logger,
+                  journal_dir=str(tmp_path / "journal"), **nf_kw).start()
+    return front, nf
+
+
+# -- W3C traceparent parse/format ---------------------------------------
+
+def test_traceparent_parse_format_roundtrip():
+    assert parse_traceparent(TRACEPARENT) == (TRACE_ID, PARENT_ID)
+    # case-insensitive, whitespace-tolerant
+    assert parse_traceparent(f"  {TRACEPARENT.upper()} ") \
+        == (TRACE_ID, PARENT_ID)
+    assert format_traceparent(TRACE_ID, PARENT_ID) == TRACEPARENT
+    assert format_traceparent(TRACE_ID, PARENT_ID, sampled=False) \
+        == f"00-{TRACE_ID}-{PARENT_ID}-00"
+    assert parse_traceparent(format_traceparent(TRACE_ID, PARENT_ID)) \
+        == (TRACE_ID, PARENT_ID)
+
+
+@pytest.mark.parametrize("bad", [
+    None, 7, "", "garbage",
+    f"ff-{TRACE_ID}-{PARENT_ID}-01",          # forbidden version
+    f"00-{'0' * 32}-{PARENT_ID}-01",          # all-zero trace id
+    f"00-{TRACE_ID}-{'0' * 16}-01",           # all-zero parent id
+    f"00-{TRACE_ID[:-1]}-{PARENT_ID}-01",     # short trace id
+    f"00-{TRACE_ID}-{PARENT_ID}",             # missing flags
+])
+def test_traceparent_rejects_invalid(bad):
+    assert parse_traceparent(bad) is None
+
+
+def test_boundary_span_id_is_stable_16hex():
+    a = boundary_span_id("t00000007")
+    assert a == boundary_span_id("t00000007")
+    assert len(a) == 16 and int(a, 16) != 0
+    assert a != boundary_span_id("t00000008")
+
+
+# -- cross-boundary propagation over HTTP -------------------------------
+
+def test_inbound_traceparent_roots_span_tree_and_echoes(tmp_path):
+    log = tmp_path / "run.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    front, nf = _stack(tmp_path, logger=logger)
+    try:
+        st, doc, headers = _post(nf.port, "/v1/color", dict(_SPEC),
+                                 headers={"traceparent": TRACEPARENT})
+        assert st == 202
+        ticket = doc["ticket"]
+        # the 202 continues the trace: caller's id in body AND header,
+        # our boundary span id (deterministic per ticket) as parent
+        assert doc["trace"] == TRACE_ID
+        assert headers["traceparent"] == format_traceparent(
+            TRACE_ID, boundary_span_id(ticket))
+        st, res = _poll(nf.port, ticket)
+        assert st == 200 and res["status"] == "ok"
+    finally:
+        nf.close()
+        front.shutdown()
+        logger.close()
+    recs = [json.loads(ln) for ln in open(log) if ln.strip()]
+    spans = [r for r in recs if r.get("event") == "span"]
+    assert spans and all(s["trace"] == TRACE_ID for s in spans)
+    # root span records the caller's span id as remote_parent (attrs,
+    # not the structural parent the validator would demand a B for)
+    roots = [s for s in spans
+             if s["name"] == "request" and s["ph"] == "B"]
+    assert len(roots) == 1
+    assert roots[0]["parent"] is None
+    assert roots[0]["attrs"]["remote_parent"] == PARENT_ID
+    # the admitted journal record persists the trace context
+    ent = scan_journal(str(tmp_path / "journal"
+                           / "ticket_journal.jsonl")).tickets[0]
+    assert ent.trace == TRACE_ID and ent.trace_parent == PARENT_ID
+    # net_admit carries the trace id; the whole log schema-validates
+    admits = [r for r in recs if r.get("event") == "net_admit"]
+    assert admits and admits[0]["trace"] == TRACE_ID
+    assert validate_file(str(log)) == []
+
+
+def test_no_traceparent_keeps_stream_byte_identical_shape(tmp_path):
+    """Flags-unset contract: without the header there is no ``trace``
+    field anywhere — not in the 202 body, not in net_admit, not in the
+    journal — and spans run under the classic req-<id> trace."""
+    log = tmp_path / "run.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    front, nf = _stack(tmp_path, logger=logger)
+    try:
+        st, doc, headers = _post(nf.port, "/v1/color", dict(_SPEC))
+        assert st == 202
+        assert "trace" not in doc
+        assert "traceparent" not in {k.lower() for k in headers}
+        _poll(nf.port, doc["ticket"])
+    finally:
+        nf.close()
+        front.shutdown()
+        logger.close()
+    recs = [json.loads(ln) for ln in open(log) if ln.strip()]
+    admits = [r for r in recs if r.get("event") == "net_admit"]
+    assert admits and "trace" not in admits[0]
+    spans = [r for r in recs if r.get("event") == "span"]
+    assert spans and all(s["trace"].startswith("req-") for s in spans)
+    ent = scan_journal(str(tmp_path / "journal"
+                           / "ticket_journal.jsonl")).tickets[0]
+    assert ent.trace is None and ent.trace_parent is None
+
+
+def test_replay_resumes_original_trace_across_incarnations(tmp_path):
+    """A ticket journaled with a W3C trace context and crashed in
+    flight is replayed under the ORIGINAL trace id with the caller's
+    span id re-attached — incarnation 2's spans join incarnation 1's
+    trace."""
+    j = TicketJournal(str(tmp_path / "journal"))
+    j.append("admitted", "t00000007", tenant="x", priority=0,
+             payload=dict(_SPEC), trace=TRACE_ID, trace_parent=PARENT_ID)
+    j.append("seated", "t00000007")
+    j.close()
+    log = tmp_path / "incarnation2.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    front, nf = _stack(tmp_path, logger=logger)
+    try:
+        st, doc = _poll(nf.port, "t00000007")
+        assert st == 200 and doc["status"] == "ok"
+    finally:
+        nf.close()
+        front.shutdown()
+        logger.close()
+    spans = [json.loads(ln) for ln in open(log)
+             if '"span"' in ln and ln.strip()]
+    spans = [s for s in spans if s.get("event") == "span"]
+    assert spans and all(s["trace"] == TRACE_ID for s in spans)
+    roots = [s for s in spans
+             if s["name"] == "request" and s["ph"] == "B"]
+    assert roots[0]["attrs"]["remote_parent"] == PARENT_ID
+    assert validate_file(str(log)) == []
+
+
+def test_merged_export_one_track_across_incarnations(tmp_path):
+    """tools/export_trace.py multi-log merge: two incarnations' spans
+    under one trace id land on ONE process track with one thread lane
+    per incarnation."""
+    from tools.export_trace import merge_chrome_traces, read_spans
+
+    for i, name in enumerate(("inc1.jsonl", "inc2.jsonl")):
+        logger = RunLogger(jsonl_path=str(tmp_path / name), echo=False)
+        logger.event("span", name="request", ph="B", trace=TRACE_ID,
+                     span="s1", parent=None, ts_us=10 + i * 100,
+                     attrs=None)
+        logger.event("span", name="request", ph="E", trace=TRACE_ID,
+                     span="s1", parent=None, ts_us=50 + i * 100,
+                     attrs=None)
+        logger.close()
+    labeled = [(name, read_spans(str(tmp_path / name)))
+               for name in ("inc1.jsonl", "inc2.jsonl")]
+    doc = merge_chrome_traces(labeled)
+    xs = [e for e in doc["traceEvents"] if e["ph"] == "X"]
+    assert len(xs) == 2
+    assert len({e["pid"] for e in xs}) == 1     # one track
+    assert {e["tid"] for e in xs} == {1, 2}     # two incarnation lanes
+    assert {e["args"]["source"] for e in xs} \
+        == {"inc1.jsonl", "inc2.jsonl"}
+    names = [e for e in doc["traceEvents"]
+             if e["ph"] == "M" and e["name"] == "thread_name"]
+    assert {m["args"]["name"] for m in names} \
+        == {"inc1.jsonl", "inc2.jsonl"}
+
+
+# -- per-tenant usage metering ------------------------------------------
+
+def test_usage_meter_lifecycle_and_device_attribution():
+    m = UsageMeter()
+    m.record_admitted("acme", 100, trace=TRACE_ID)
+    m.record_admitted("acme", 50)
+    m.record_admitted("bob", 10)
+    m.record_done("acme", "ok", 0.5, 1.5, vertices=100, supersteps=7)
+    m.record_done("bob", "error", 0.1, 0.2)
+    m.record_aborted("acme")
+    # the RunLogger-sink half: closing sweep spans charge device time
+    # to the tenant whose trace was bound at admission
+    m({"event": "span", "ph": "E", "trace": TRACE_ID,
+       "attrs": {"device_us": 2500}})
+    m({"event": "span", "ph": "E", "trace": "unknown-trace",
+       "attrs": {"device_us": 999}})           # unbound: dropped
+    m({"event": "span", "ph": "E", "trace": TRACE_ID,
+       "attrs": {"device_us": True}})          # bool is not device time
+    rows = {r["tenant"]: r for r in m.snapshot()}
+    acme = rows["acme"]
+    assert acme["admitted"] == 2 and acme["delivered"] == 1
+    assert acme["aborted"] == 1 and acme["in_flight"] == 0
+    assert acme["vertices"] == 150
+    assert acme["vertex_supersteps"] == 700
+    assert acme["device_ms"] == 2.5
+    assert acme["queue_ms"] == 500.0 and acme["service_ms"] == 1500.0
+    assert acme["source"] == "live" and acme["export_version"] == 1
+    bob = rows["bob"]
+    assert bob["failed"] == 1 and bob["delivered"] == 0
+    assert payload_vertices(dict(_SPEC)) == 24
+    assert payload_vertices({"graph": [[1], [0]]}) == 2
+    assert payload_vertices("junk") == 0
+
+
+def test_admin_usage_route_live_rows(tmp_path):
+    logger = RunLogger(echo=False)
+    front, nf = _stack(tmp_path, logger=logger)
+    try:
+        st, doc, _ = _post(nf.port, "/v1/color", dict(_SPEC))
+        assert st == 202
+        _poll(nf.port, doc["ticket"])
+        st, body = _get(nf.port, "/admin/usage")
+        assert st == 200
+        rows = json.loads(body)["usage"]
+        assert len(rows) == 1
+        row = rows[0]
+        assert row["tenant"] == "anon" and row["admitted"] == 1
+        assert row["delivered"] == 1 and row["in_flight"] == 0
+        assert row["vertices"] == _SPEC["node_count"]
+        assert row["source"] == "live"
+    finally:
+        nf.close()
+        front.shutdown()
+
+
+def test_journal_fold_conservation_exact(tmp_path):
+    """fold_journal over a multi-tenant journal with crash-duplicate
+    records: per-tenant sums EXACTLY equal journal_totals, and a
+    deliberately broken fold is caught."""
+    j = TicketJournal(str(tmp_path))
+    j.append("admitted", "t00000000", tenant="a", payload=dict(_SPEC))
+    # crash-window duplicate admit of the same ticket: metered once
+    j.append("admitted", "t00000000", tenant="a", payload=dict(_SPEC))
+    j.append("attempt", "t00000000", durable=False, k=3,
+             status="SUCCESS", supersteps=5)
+    j.append("delivered", "t00000000", durable=False,
+             result={"status": "ok", "queue_ms": 2.0, "service_ms": 8.0})
+    j.append("admitted", "t00000001", tenant="b", payload=dict(_SPEC))
+    j.append("aborted", "t00000001", reason="queue_full")
+    j.append("admitted", "t00000002", tenant="b", payload=dict(_SPEC))
+    j.append("failed", "t00000002", durable=False,
+             result={"status": "error", "error": "rc 114"})
+    j.append("admitted", "t00000003", tenant="a", payload=dict(_SPEC))
+    j.close()
+    rows = fold_journal(j.path)
+    assert [r["tenant"] for r in rows] == ["a", "b"]
+    a, b = rows
+    assert a["admitted"] == 2 and a["delivered"] == 1
+    assert a["in_flight"] == 1                     # t3 never finished
+    assert a["vertex_supersteps"] == 24 * 5
+    assert a["queue_ms"] == 2.0 and a["service_ms"] == 8.0
+    assert b["admitted"] == 2 and b["aborted"] == 1 and b["failed"] == 1
+    assert a["source"] == "journal"
+    totals = journal_totals(j.path)
+    assert totals == {"admitted": 4, "delivered": 1, "failed": 1,
+                      "aborted": 1, "vertices": 96}
+    assert conservation_problems(rows, j.path) == []
+    # a lost ticket or a double-metered terminal does NOT conserve
+    broken = [dict(r) for r in rows]
+    broken[0]["delivered"] += 1
+    assert any("delivered" in p
+               for p in conservation_problems(broken, j.path))
+    broken[0]["delivered"] -= 2
+    probs = conservation_problems(broken, j.path)
+    assert any("delivered" in p for p in probs)
+
+
+def test_usage_export_cli_artifact_and_check(tmp_path, capsys):
+    from tools.usage_export import main as export_main
+
+    jdir = tmp_path / "journal"
+    j = TicketJournal(str(jdir))
+    j.append("admitted", "t00000000", tenant="acme",
+             payload=dict(_SPEC), trace=TRACE_ID)
+    j.append("delivered", "t00000000", durable=False,
+             result={"status": "ok"})
+    j.close()
+    # a run log supplies the device-time column through the trace join
+    log = tmp_path / "server_0.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    logger.event("span", name="sweep", ph="E", trace=TRACE_ID,
+                 span="s2", parent=None, ts_us=9, attrs={"device_us": 4000})
+    logger.close()
+    out = tmp_path / "usage.jsonl"
+    rc = export_main([str(jdir), "--logs", str(log), "-o", str(out),
+                      "--check"])
+    assert rc == 0
+    lines = [json.loads(ln) for ln in open(out) if ln.strip()]
+    assert len(lines) == 1
+    assert lines[0]["event"] == "usage_rollup"
+    assert lines[0]["tenant"] == "acme"
+    assert lines[0]["device_ms"] == 4.0
+    # the artifact is a schema-valid run log
+    assert validate_file(str(out)) == []
+    err = capsys.readouterr().err
+    assert "conservation" in err
+    # missing journal is a structured error, not a traceback
+    assert export_main([str(tmp_path / "nope")]) == 2
+
+
+# -- timeseries sampler + burn-rate evaluator ---------------------------
+
+def test_sampler_ring_bounded_and_routes(tmp_path):
+    registry = MetricsRegistry()
+    counter = registry.counter("dgc_demo_total", "demo")
+    sampler = TimeseriesSampler(registry, interval_s=9.0, capacity=4)
+    for i in range(7):
+        counter.inc()
+        sampler.sample_once()
+    snap = sampler.snapshot()
+    assert len(snap) == 4                       # ring bound
+    assert snap[-1]["metrics"]["dgc_demo_total"]["value"] == 7.0
+    assert snap[0]["metrics"]["dgc_demo_total"]["value"] == 4.0
+    assert snap[0]["mono"] <= snap[-1]["mono"]
+    dump = tmp_path / "ts.jsonl"
+    assert sampler.write_jsonl(str(dump)) == 4
+    assert len([ln for ln in open(dump) if ln.strip()]) == 4
+    with pytest.raises(ValueError):
+        TimeseriesSampler(registry, interval_s=0.0)
+    # the listener serves the ring live at /debug/timeseries
+    front = _InstantFront(batch_max=1, workers=1, queue_depth=8,
+                          window_s=0.0).start()
+    nf = NetFront(front, timeseries=sampler).start()
+    try:
+        st, body = _get(nf.port, "/debug/timeseries")
+        assert st == 200
+        served = [json.loads(ln) for ln in body.decode().splitlines()
+                  if ln.strip()]
+        assert len(served) == 4
+        assert served[-1]["metrics"]["dgc_demo_total"]["value"] == 7.0
+    finally:
+        nf.close()
+        front.shutdown()
+    sampler.close()
+
+
+def test_burn_evaluator_fires_on_sustained_burn(tmp_path):
+    """Failure-rate burn over both windows fires slo_burn, bumps the
+    counter, dumps the flight recorder, and cools down."""
+    import sys
+
+    sys.path.insert(0, "tools")
+    import slo_check
+
+    registry = MetricsRegistry()
+    log = tmp_path / "burn.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    recorder = FlightRecorder(capacity=32, registry=registry)
+    logger.add_sink(recorder)
+    hooks = slo_check.ViolationHooks(recorder=recorder,
+                                     dump_dir=str(tmp_path),
+                                     logger=logger)
+    sampler = TimeseriesSampler(registry, interval_s=9.0, capacity=16)
+    ev = BurnRateEvaluator(sampler, {"failure_rate_max": 0.1},
+                           fast_window_s=0.1, slow_window_s=0.1,
+                           hooks=hooks, logger=logger, registry=registry)
+    ok = registry.counter("dgc_serve_requests_total", "reqs", status="ok")
+    err = registry.counter("dgc_serve_requests_total", "reqs",
+                           status="error")
+    ok.inc()
+    sampler.sample_once()
+    # a warmed window (>= half its span of coverage) full of failures
+    time.sleep(0.06)
+    for _ in range(9):
+        err.inc()
+    sample = sampler.sample_once()
+    fired = ev.evaluate(sample)
+    assert [f["objective"] for f in fired] == ["failure_rate"]
+    assert fired[0]["slow_burn"] == pytest.approx(10.0, rel=1e-3)
+    assert ev.fired == 1
+    # cooldown (= fast window) suppresses an immediate re-fire
+    assert ev.evaluate(sampler.sample_once()) == []
+    logger.close()
+    recs = [json.loads(ln) for ln in open(log) if ln.strip()]
+    burns = [r for r in recs if r.get("event") == "slo_burn"]
+    assert len(burns) == 1
+    b = burns[0]
+    assert b["objective"] == "failure_rate" and b["burn"] >= 1.0
+    assert b["limit"] == 0.1 and b["profile"] is False
+    # the hook dumped the recorder while the incident was live
+    assert b["dump"] and (tmp_path / b["dump"].split("/")[-1]).exists()
+    dumps = [r for r in recs if r.get("event") == "flightrec_dump"]
+    assert dumps and dumps[0]["reason"] == "slo_violation"
+    key = 'dgc_slo_burn_fired_total{objective="failure_rate"}'
+    assert registry.to_dict()[key]["value"] == 1.0
+    assert validate_file(str(log)) == []
+
+
+def test_burn_evaluator_quiet_without_traffic_or_warmup():
+    registry = MetricsRegistry()
+    sampler = TimeseriesSampler(registry, interval_s=9.0, capacity=16)
+    ev = BurnRateEvaluator(sampler, {"failure_rate_max": 0.0,
+                                     "service_ms": {"p95": 50}},
+                           fast_window_s=0.05, slow_window_s=0.05)
+    assert ev.evaluate() == []                  # empty ring
+    sampler.sample_once()
+    assert ev.evaluate() == []                  # single sample: no base
+    time.sleep(0.04)
+    # no traffic in the window -> no evidence -> no burn, even with a
+    # zero-tolerance failure objective
+    assert ev.evaluate(sampler.sample_once()) == []
+    with pytest.raises(ValueError):
+        BurnRateEvaluator(sampler, {}, fast_window_s=10, slow_window_s=1)
+
+
+def test_burn_evaluator_latency_quantile_objective():
+    registry = MetricsRegistry()
+    sampler = TimeseriesSampler(registry, interval_s=9.0, capacity=16)
+    ev = BurnRateEvaluator(sampler, {"service_ms": {"p95": 10.0}},
+                           fast_window_s=0.05, slow_window_s=0.05,
+                           registry=registry)
+    hist = registry.histogram("dgc_serve_service_seconds", "svc",
+                              shape_class="c128")
+    sampler.sample_once()
+    time.sleep(0.04)
+    for _ in range(20):
+        hist.observe(0.5)                       # 500 ms >> 10 ms limit
+    fired = ev.evaluate(sampler.sample_once())
+    assert [f["objective"] for f in fired] == ["service_ms_p95"]
+    assert fired[0]["value"] > 10.0
+
+
+# -- flight recorder: mesh_degrade auto-dump ----------------------------
+
+def test_flightrec_auto_dump_on_mesh_degrade(tmp_path):
+    log = tmp_path / "mesh.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    recorder = FlightRecorder(capacity=32)
+    logger.add_sink(recorder)
+    recorder.arm_auto_dump({"mesh_degrade"}, str(tmp_path),
+                           logger=logger, cooldown_s=60.0)
+    logger.event("mesh_restore", devices_before=7, devices_after=8)
+    assert not list(tmp_path.glob("flightrec_*.jsonl"))
+    logger.event("mesh_degrade", devices_before=8, devices_after=7,
+                 lost_device=3, reseated=2, quarantined=1)
+    dumps = list(tmp_path.glob("flightrec_*.jsonl"))
+    assert len(dumps) == 1
+    dumped = [json.loads(ln) for ln in open(dumps[0]) if ln.strip()]
+    assert any(r.get("event") == "mesh_degrade" for r in dumped)
+    meta = [r for r in dumped if r.get("event") == "flightrec_dump"]
+    assert meta and meta[0]["reason"] == "auto"
+    assert meta[0]["trigger"] == "mesh_degrade"
+    # cooldown: a second degrade inside the window does not re-dump
+    logger.event("mesh_degrade", devices_before=7, devices_after=6)
+    assert len(list(tmp_path.glob("flightrec_*.jsonl"))) == 1
+    logger.close()
+    # arming the dump's own event kind would recurse: rejected
+    with pytest.raises(ValueError):
+        recorder.arm_auto_dump({"flightrec_dump"}, str(tmp_path))
+    assert validate_file(str(log)) == []
+
+
+# -- schema + validate_runlog semantics ---------------------------------
+
+def _write_log(tmp_path, records):
+    path = tmp_path / "log.jsonl"
+    with open(path, "w") as fh:
+        for rec in records:
+            fh.write(json.dumps({"t": 0.1, **rec}) + "\n")
+    return str(path)
+
+
+def _usage_rec(**over):
+    rec = {"event": "usage_rollup", "tenant": "a", "admitted": 2,
+           "delivered": 1, "failed": 0, "aborted": 0, "in_flight": 1,
+           "vertices": 48, "vertex_supersteps": 120, "device_ms": 1.5,
+           "queue_ms": 2.0, "service_ms": 9.0, "source": "journal",
+           "export_version": 1}
+    rec.update(over)
+    return rec
+
+
+def _burn_rec(**over):
+    rec = {"event": "slo_burn", "objective": "failure_rate",
+           "window_s": 300.0, "burn": 4.2, "fast_window_s": 60.0,
+           "slow_window_s": 300.0, "fast_burn": 5.0, "slow_burn": 4.2,
+           "threshold": 1.0, "value": 0.42, "limit": 0.1, "dump": None,
+           "profile": False}
+    rec.update(over)
+    return rec
+
+
+def test_usage_rollup_schema_and_semantics(tmp_path):
+    assert validate_file(_write_log(tmp_path, [_usage_rec()])) == []
+    for bad in (_usage_rec(admitted=-1),
+                _usage_rec(in_flight=-2),
+                _usage_rec(source="billing"),
+                {k: v for k, v in _usage_rec().items() if k != "tenant"}):
+        assert validate_file(_write_log(tmp_path, [bad])) != []
+
+
+def test_slo_burn_schema_and_semantics(tmp_path):
+    assert validate_file(_write_log(tmp_path, [_burn_rec()])) == []
+    assert validate_file(_write_log(
+        tmp_path, [_burn_rec(objective="service_ms_p99",
+                             dump="flightrec_1.jsonl")])) == []
+    for bad in (_burn_rec(window_s=0),
+                _burn_rec(burn=-1.0),
+                _burn_rec(objective="vibes"),
+                {k: v for k, v in _burn_rec().items() if k != "burn"}):
+        assert validate_file(_write_log(tmp_path, [bad])) != []

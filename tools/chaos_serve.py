@@ -35,6 +35,16 @@ would. Asserted at the end:
     result is stable across repeated polls), and every replayed
     request's colors byte-identical to the fault-free baseline.
 
+Fleet-telemetry invariants ride leg 2: clients propagate deterministic
+per-seed W3C ``traceparent`` headers, and the post-soak asserts prove
+(a) per-tenant usage conservation — the journal fold
+(``tools/usage_export.py``) EXACTLY equals the raw journal totals
+across all incarnations and the ``usage_rollup`` artifact
+schema-validates — and (b) cross-incarnation trace continuity — every
+journal-replayed ticket's trace id carries spans in ≥2 incarnations'
+logs and the merged Perfetto export (``tools/export_trace.py``) shows
+one track with multiple incarnation lanes.
+
 Usage::
 
     JAX_PLATFORMS=cpu python tools/chaos_serve.py --schedules 5 --kills 3 \\
@@ -45,6 +55,7 @@ Usage::
 from __future__ import annotations
 
 import argparse
+import hashlib
 import http.client
 import json
 import os
@@ -74,7 +85,8 @@ _OUTCOMES = ("ok", "structured", "hang", "error", "mismatch")
 # ---------------------------------------------------------------------------
 
 def _http(method: str, port: int, path: str, doc=None, tenant=None,
-          retries: int = 120, deadline_s: float = 240.0):
+          retries: int = 120, deadline_s: float = 240.0,
+          headers_extra=None):
     """One request, retried through connection failures (the server may
     be dead between a SIGKILL and its restart) with capped backoff.
     Returns (status, body_doc)."""
@@ -82,6 +94,8 @@ def _http(method: str, port: int, path: str, doc=None, tenant=None,
     headers = {"Content-Type": "application/json"}
     if tenant:
         headers["X-Dgc-Tenant"] = tenant
+    if headers_extra:
+        headers.update(headers_extra)
     t_end = time.perf_counter() + deadline_s
     last = None
     for attempt in range(retries):
@@ -108,6 +122,14 @@ def _http(method: str, port: int, path: str, doc=None, tenant=None,
 def _request_doc(nodes: int, degree: int, seed: int) -> dict:
     return {"node_count": nodes, "max_degree": degree, "seed": seed,
             "gen_method": "fast"}
+
+
+def _traceparent_ids(seed: int) -> tuple[str, str]:
+    """Deterministic W3C (trace_id, parent_id) for one request seed —
+    the kill-resume clients propagate these so a replayed ticket's
+    resumed spans are provably the CALLER's trace, not a fresh one."""
+    h = hashlib.sha256(f"chaos-serve-{seed}".encode()).hexdigest()
+    return h[:32], h[32:48]
 
 
 # ---------------------------------------------------------------------------
@@ -424,14 +446,24 @@ def _run_kill_resume(args, reqs: list, baseline: dict) -> dict:
     def client(reqs_slice):
         mine = []
         for doc in reqs_slice:
+            # W3C context propagation: every submit carries the caller's
+            # deterministic traceparent; the 202 must echo the trace id
+            tid, span_id = _traceparent_ids(doc["seed"])
+            tp = {"traceparent": f"00-{tid}-{span_id}-01"}
             t_end = time.perf_counter() + args.deadline
             while time.perf_counter() < t_end:
                 try:
                     st, body = _http("POST", port, "/v1/color", doc,
-                                     retries=8, deadline_s=30.0)
+                                     retries=8, deadline_s=30.0,
+                                     headers_extra=tp)
                 except RuntimeError:
                     continue   # server down: supervisor is on it
                 if st == 202:
+                    if body.get("trace") != tid:
+                        with acct:
+                            errors.append(
+                                f"202 trace {body.get('trace')!r} != "
+                                f"caller trace {tid!r}")
                     with acct:
                         tickets.append(body["ticket"])
                         ticket_of[body["ticket"]] = doc
@@ -546,6 +578,13 @@ def _run_kill_resume(args, reqs: list, baseline: dict) -> dict:
         # whose process exited cleanly — the final one)
         if os.path.exists(logs[-1]):
             entry["log_problems"] = len(validate_file(logs[-1]))
+        try:
+            _telemetry_invariants(entry, errors, workdir, journal_path,
+                                  logs)
+        except Exception as e:   # noqa: BLE001 — a broken telemetry
+            # invariant is a chaos FAILURE, not a harness crash
+            errors.append(f"telemetry invariants raised: "
+                          f"{type(e).__name__}: {e}")
         if mismatched:
             entry["outcome"] = "mismatch"
         elif errors or entry["log_problems"]:
@@ -567,6 +606,102 @@ def _run_kill_resume(args, reqs: list, baseline: dict) -> dict:
             srv.proc.kill()
         if not args.keep_workdir and args.workdir is None:
             shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _jsonl_events(path: str) -> list:
+    """All parseable records in one JSONL log (torn tail tolerated —
+    SIGKILL can cut the final line mid-write)."""
+    out: list = []
+    try:
+        with open(path) as fh:
+            raw = fh.read()
+    except OSError:
+        return out
+    lines = raw.split("\n")
+    torn_tail = not raw.endswith("\n")
+    for i, line in enumerate(lines):
+        line = line.strip()
+        if not line:
+            continue
+        try:
+            rec = json.loads(line)
+        except json.JSONDecodeError:
+            if torn_tail and i == len(lines) - 1:
+                continue
+            raise
+        if isinstance(rec, dict):
+            out.append(rec)
+    return out
+
+
+def _telemetry_invariants(entry: dict, errors: list, workdir: str,
+                          journal_path: str, logs: list) -> None:
+    """Post-soak fleet-telemetry assertions over the FINAL journal and
+    every incarnation's run log:
+
+    - **usage conservation** — the per-tenant journal fold
+      (``obs.usage.fold_journal``) must EXACTLY equal the journal's raw
+      totals across all incarnations, and the exported ``usage_rollup``
+      artifact must schema-validate;
+    - **cross-incarnation trace continuity** — every journal-replayed
+      ticket's trace id must carry span events in at least two
+      incarnations' logs, and the merged Perfetto export must show one
+      process track with multiple incarnation lanes."""
+    from dgc_tpu.obs.usage import conservation_problems, fold_journal
+    from dgc_tpu.serve.netfront.journal import scan_journal
+    from tools.export_trace import merge_chrome_traces, read_spans
+    from tools.usage_export import write_artifact
+
+    present = [p for p in logs if os.path.exists(p)]
+
+    # -- usage conservation across incarnations -------------------------
+    rows = fold_journal(journal_path, log_paths=present)
+    cons = conservation_problems(rows, journal_path)
+    entry["usage_tenants"] = len(rows)
+    entry["usage_conservation"] = "ok" if not cons else "fail"
+    errors.extend(f"usage conservation: {c}" for c in cons[:4])
+    artifact = os.path.join(workdir, "usage.jsonl")
+    write_artifact(rows, artifact)
+    entry["usage_artifact_problems"] = len(validate_file(artifact))
+    if entry["usage_artifact_problems"]:
+        errors.append("usage_rollup artifact fails schema validation")
+
+    # -- cross-incarnation trace continuity ------------------------------
+    labeled = [(os.path.basename(p), read_spans(p)) for p in present]
+    files_of_trace: dict = {}    # trace id -> {file index}
+    for idx, (_label, spans) in enumerate(labeled):
+        for rec in spans:
+            files_of_trace.setdefault(rec.get("trace"), set()).add(idx)
+    trace_of_ticket = {
+        ent.ticket: (ent.trace or f"req-{ent.ticket}")
+        for ent in scan_journal(journal_path).tickets}
+    replayed = set()
+    for path in present[1:]:     # recovery only runs on restart
+        for rec in _jsonl_events(path):
+            if (rec.get("event") == "net_recover"
+                    and rec.get("action") == "replayed"):
+                replayed.add(rec.get("ticket"))
+    cross = sum(1 for t in replayed
+                if len(files_of_trace.get(trace_of_ticket.get(t), ()))
+                >= 2)
+    entry["replayed_tickets"] = len(replayed)
+    entry["cross_incarnation_traces"] = cross
+    if replayed and cross == 0:
+        errors.append("no replayed ticket's trace id has spans in "
+                      "multiple incarnations (trace resume broken)")
+    merged = merge_chrome_traces(labeled)
+    merged_path = os.path.join(workdir, "trace_merged.json")
+    with open(merged_path, "w") as fh:
+        json.dump(merged, fh)
+        fh.write("\n")
+    if cross:
+        lanes: dict = {}         # pid -> {tid} over complete events
+        for ev in merged["traceEvents"]:
+            if ev.get("ph") == "X":
+                lanes.setdefault(ev["pid"], set()).add(ev["tid"])
+        if not any(len(tids) >= 2 for tids in lanes.values()):
+            errors.append("merged Perfetto export has no track spanning "
+                          "two incarnation lanes")
 
 
 # ---------------------------------------------------------------------------

@@ -30,6 +30,13 @@
 #      (XLA_FLAGS=--xla_force_host_platform_device_count=8) — colors,
 #      supersteps, and attempt sequences byte-identical to the
 #      single-graph sweep under sharding, seconds-scale.
+#   9. fleet-telemetry smoke (telemetry plane, same skip): (a) a
+#      synthesized multi-tenant journal with a crash-duplicate admit
+#      must export schema-valid usage_rollup rows whose per-tenant sums
+#      EXACTLY equal the journal's own totals (tools/usage_export.py
+#      --check), and (b) an injected SLO violation — a failure burst
+#      over warmed burn windows — must raise slo_burn AND dump the
+#      flight recorder mid-incident; sub-second, pure CPU.
 # Steps 1-3 are AST-only (seconds); steps 4-5 compile toy kernels on
 # CPU (~1-2 min cold) — the only gates that prove the profiler and
 # serving-over-the-network plumbing end-to-end before device time is
@@ -151,8 +158,11 @@ assert not problems, problems
 assert doc["summary"]["failed"] == 0, doc["summary"]
 kr = doc.get("kill_resume")
 assert kr and kr["outcome"] == "ok" and kr["kills"] >= 1, kr
-print("ci_checks: chaos-serve %d schedule(s) + kill-resume ok"
-      % len(doc["schedules"]), file=sys.stderr)
+assert kr.get("usage_conservation") == "ok", kr
+print("ci_checks: chaos-serve %d schedule(s) + kill-resume ok "
+      "(usage conserved, %d cross-incarnation trace(s))"
+      % (len(doc["schedules"]), kr.get("cross_incarnation_traces", 0)),
+      file=sys.stderr)
 EOF
   then
     echo "ci_checks: chaos-serve smoke OK" >&2
@@ -216,6 +226,89 @@ EOF
     echo "ci_checks: sharded serve-parity smoke OK" >&2
   else
     echo "ci_checks: sharded serve-parity smoke FAILED" >&2
+    rc=1
+  fi
+  # fleet-telemetry smoke (telemetry plane): (a) usage-export
+  # conservation — a synthesized journal with a crash-duplicate admit
+  # exported through the CLI's --check gate (per-tenant sums must
+  # EXACTLY equal the journal's own totals; the artifact must be a
+  # schema-valid run log); (b) injected SLO violation — a failure
+  # burst over warmed fast+slow burn windows must raise slo_burn,
+  # dump the flight recorder mid-incident, and leave a schema-valid
+  # event stream
+  if timeout 120 python - "$SMOKE_DIR" <<'EOF'
+import glob, json, sys, time
+sys.path.insert(0, ".")
+sys.path.insert(0, "tools")
+import slo_check
+from dgc_tpu.obs import FlightRecorder, MetricsRegistry, RunLogger
+from dgc_tpu.obs.timeseries import BurnRateEvaluator, TimeseriesSampler
+from dgc_tpu.serve.netfront import TicketJournal
+from tools.usage_export import main as export_main
+from tools.validate_runlog import validate_file
+
+smoke = sys.argv[1]
+
+# (a) journal -> usage_rollup artifact -> conservation gate
+spec = {"node_count": 24, "max_degree": 3, "seed": 5, "gen_method": "fast"}
+j = TicketJournal(smoke + "/usage_journal")
+j.append("admitted", "t00000000", tenant="acme", payload=dict(spec))
+# crash-window duplicate admit: metered once or conservation breaks
+j.append("admitted", "t00000000", tenant="acme", payload=dict(spec))
+j.append("attempt", "t00000000", durable=False, k=3, status="SUCCESS",
+         supersteps=5)
+j.append("delivered", "t00000000", durable=False,
+         result={"status": "ok", "queue_ms": 2.0, "service_ms": 8.0})
+j.append("admitted", "t00000001", tenant="beta", payload=dict(spec))
+j.append("aborted", "t00000001", reason="queue_full")
+j.close()
+out = smoke + "/usage.jsonl"
+rc = export_main([smoke + "/usage_journal", "-o", out, "--check"])
+assert rc == 0, "usage_export --check exited %d" % rc
+rows = [json.loads(ln) for ln in open(out) if ln.strip()]
+assert {r["tenant"] for r in rows} == {"acme", "beta"}, rows
+assert all(r["event"] == "usage_rollup" for r in rows), rows
+assert validate_file(out) == [], validate_file(out)
+print("ci_checks: usage-export conservation ok (%d tenant row(s))"
+      % len(rows), file=sys.stderr)
+
+# (b) injected SLO violation -> slo_burn + flight-recorder dump
+registry = MetricsRegistry()
+log = smoke + "/burn.jsonl"
+logger = RunLogger(jsonl_path=log, echo=False)
+recorder = FlightRecorder(capacity=32, registry=registry)
+logger.add_sink(recorder)
+hooks = slo_check.ViolationHooks(recorder=recorder, dump_dir=smoke,
+                                 logger=logger)
+sampler = TimeseriesSampler(registry, interval_s=9.0, capacity=16)
+ev = BurnRateEvaluator(sampler, {"failure_rate_max": 0.1},
+                       fast_window_s=0.1, slow_window_s=0.1,
+                       hooks=hooks, logger=logger, registry=registry)
+ok = registry.counter("dgc_serve_requests_total", "reqs", status="ok")
+err = registry.counter("dgc_serve_requests_total", "reqs", status="error")
+ok.inc()
+sampler.sample_once()
+# >= half-span coverage but still inside the 0.1s windows
+time.sleep(0.06)
+for _ in range(9):
+    err.inc()
+fired = ev.evaluate(sampler.sample_once())
+assert [f["objective"] for f in fired] == ["failure_rate"], fired
+logger.close()
+recs = [json.loads(ln) for ln in open(log) if ln.strip()]
+burns = [r for r in recs if r.get("event") == "slo_burn"]
+assert len(burns) == 1 and burns[0]["burn"] >= 1.0, burns
+dumps = [r for r in recs if r.get("event") == "flightrec_dump"]
+assert dumps and dumps[0]["reason"] == "slo_violation", dumps
+assert glob.glob(smoke + "/flightrec_*.jsonl"), "no flight-recorder dump"
+assert validate_file(log) == [], validate_file(log)
+print("ci_checks: injected SLO violation -> slo_burn + flightrec dump ok",
+      file=sys.stderr)
+EOF
+  then
+    echo "ci_checks: fleet-telemetry smoke OK" >&2
+  else
+    echo "ci_checks: fleet-telemetry smoke FAILED" >&2
     rc=1
   fi
   rm -rf "$SMOKE_DIR"

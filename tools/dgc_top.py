@@ -1,0 +1,202 @@
+#!/usr/bin/env python
+"""Live ops console over a serving listener's telemetry surface.
+
+``dgc_top`` polls one dgc-tpu listener (the serve CLI's ``--listen``
+port, or a standalone ``--metrics-port`` scraper) and renders a
+refreshing terminal view of the fleet telemetry plane:
+
+- build identity + uptime + readiness (``/healthz``, ``dgc_build_info``)
+- queue depth / in-flight / capacity, and the lane-mesh block when the
+  lane axis is sharded: surviving devices and per-device health
+- per-tenant admission state (``/healthz`` tenants) joined with the
+  live usage rollups (``GET /admin/usage``): admitted / delivered /
+  failed / in-flight, vertices·supersteps, device-ms
+- SLO burn status: ``dgc_slo_burn_fired_total`` by objective, plus the
+  timeseries ring depth when the sampler is armed
+  (``GET /debug/timeseries``)
+
+Pure stdlib, read-only (GETs only), and tolerant of missing routes — a
+listener without the sampler or the meter just renders fewer panes.
+``--once`` prints a single frame and exits (the CI smoke's mode);
+otherwise the screen clears and redraws every ``--interval`` seconds
+(the ``tools/tail_run.py`` convention).
+
+Usage:
+    python tools/dgc_top.py --url http://127.0.0.1:8080
+    python tools/dgc_top.py --url http://127.0.0.1:8080 --once
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import re
+import sys
+import time
+import urllib.error
+import urllib.request
+
+CLEAR = "\x1b[2J\x1b[H"   # clear + home (tools/tail_run.py convention)
+
+_SERIES_RE = re.compile(r"^([a-zA-Z_:][a-zA-Z0-9_:]*)(\{[^}]*\})?\s+(\S+)$")
+_LABEL_RE = re.compile(r'([a-zA-Z_][a-zA-Z0-9_]*)="([^"]*)"')
+
+
+def fetch(url: str, timeout: float = 3.0) -> str | None:
+    """GET ``url``; None on any failure (a pane, not a crash)."""
+    try:
+        with urllib.request.urlopen(url, timeout=timeout) as resp:
+            return resp.read().decode("utf-8", "replace")
+    except (urllib.error.URLError, OSError, ValueError):
+        return None
+
+
+def parse_prom(text: str) -> list:
+    """Prometheus text lines as ``(name, labels_dict, value)`` tuples;
+    comments and malformed lines are skipped."""
+    out: list = []
+    for line in text.splitlines():
+        line = line.strip()
+        if not line or line.startswith("#"):
+            continue
+        m = _SERIES_RE.match(line)
+        if m is None:
+            continue
+        name, labels_raw, value_raw = m.groups()
+        try:
+            value = float(value_raw)
+        except ValueError:
+            continue
+        labels = dict(_LABEL_RE.findall(labels_raw or ""))
+        out.append((name, labels, value))
+    return out
+
+
+def _select(series: list, name: str) -> list:
+    return [(labels, value) for n, labels, value in series if n == name]
+
+
+def _fmt_count(v: float) -> str:
+    return str(int(v)) if float(v).is_integer() else f"{v:.2f}"
+
+
+def render_frame(base_url: str) -> str:
+    """One console frame from the listener's live surfaces."""
+    lines: list = []
+    now = time.strftime("%H:%M:%S")
+    lines.append(f"dgc-top  {base_url}  {now}")
+
+    health_raw = fetch(f"{base_url}/healthz")
+    health = None
+    if health_raw:
+        try:
+            health = json.loads(health_raw)
+        except json.JSONDecodeError:
+            health = None
+    if health is None:
+        lines.append("  [unreachable: /healthz]")
+        return "\n".join(lines) + "\n"
+
+    build = health.get("build") or {}
+    ident = " ".join(f"{k}={build[k]}" for k in sorted(build))
+    up = health.get("uptime_s")
+    if up is not None:
+        ident = f"{ident or 'build=?'}  up={up:.0f}s"
+    if ident:
+        lines.append(f"  {ident}")
+    state = "READY" if health.get("ready") else "NOT-READY"
+    if health.get("draining"):
+        state += " DRAINING"
+    if health.get("degraded"):
+        state += " DEGRADED"
+    lines.append(f"  {state}  queue={health.get('queue_depth', '?')}"
+                 f"  in_flight={health.get('in_flight', '?')}"
+                 f"  capacity={health.get('capacity', '?')}")
+
+    mesh = health.get("mesh")
+    if isinstance(mesh, dict):
+        lines.append(
+            f"  mesh: {mesh.get('devices_surviving', '?')}/"
+            f"{mesh.get('devices_total', '?')} devices"
+            f"  degrades={mesh.get('degrades', 0)}"
+            f"  restores={mesh.get('restores', 0)}")
+        states = mesh.get("devices")
+        if isinstance(states, list):
+            glyphs = "".join("#" if s == "healthy" else "x"
+                             for s in states)
+            lines.append(f"  devices: [{glyphs}]")
+
+    series = parse_prom(fetch(f"{base_url}/metrics") or "")
+    burns = _select(series, "dgc_slo_burn_fired_total")
+    if burns:
+        burned = ", ".join(
+            f"{labels.get('objective', '?')}x{_fmt_count(v)}"
+            for labels, v in sorted(burns,
+                                    key=lambda lv: -lv[1]) if v > 0)
+        lines.append(f"  SLO BURN: {burned or 'none'}")
+
+    ts_raw = fetch(f"{base_url}/debug/timeseries")
+    if ts_raw is not None:
+        samples = [ln for ln in ts_raw.splitlines() if ln.strip()]
+        lines.append(f"  timeseries: {len(samples)} sample(s) in ring")
+
+    # per-tenant pane: admission state joined with live usage rollups
+    tenants = health.get("tenants") or {}
+    usage_rows: dict = {}
+    usage_raw = fetch(f"{base_url}/admin/usage")
+    if usage_raw:
+        try:
+            for row in json.loads(usage_raw).get("usage", []):
+                usage_rows[row.get("tenant")] = row
+        except json.JSONDecodeError:
+            pass
+    names = sorted(set(tenants) | set(usage_rows))
+    if names:
+        lines.append("")
+        lines.append(f"  {'tenant':<14} {'infl':>5} {'adm':>6} "
+                     f"{'done':>6} {'fail':>5} {'abrt':>5} "
+                     f"{'v*steps':>10} {'dev_ms':>9}")
+        for name in names:
+            adm = tenants.get(name) or {}
+            row = usage_rows.get(name) or {}
+            lines.append(
+                f"  {name:<14} "
+                f"{adm.get('in_flight', row.get('in_flight', 0)):>5} "
+                f"{row.get('admitted', 0):>6} "
+                f"{row.get('delivered', 0):>6} "
+                f"{row.get('failed', 0):>5} "
+                f"{row.get('aborted', 0):>5} "
+                f"{row.get('vertex_supersteps', 0):>10} "
+                f"{row.get('device_ms', 0.0):>9.1f}")
+    return "\n".join(lines) + "\n"
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--url", default="http://127.0.0.1:9100",
+                   help="listener base URL (default "
+                        "http://127.0.0.1:9100)")
+    p.add_argument("--interval", type=float, default=2.0,
+                   help="refresh interval in seconds (default 2)")
+    p.add_argument("--once", action="store_true",
+                   help="print one frame and exit (CI mode; no screen "
+                        "clearing)")
+    args = p.parse_args(argv)
+    base = args.url.rstrip("/")
+    if args.once:
+        frame = render_frame(base)
+        sys.stdout.write(frame)
+        return 0 if "[unreachable" not in frame else 1
+    try:
+        while True:
+            frame = render_frame(base)
+            sys.stdout.write(CLEAR + frame)
+            sys.stdout.flush()
+            time.sleep(max(0.2, args.interval))
+    except KeyboardInterrupt:
+        print()
+        return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

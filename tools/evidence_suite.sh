@@ -185,6 +185,30 @@ if [ "$battery_rc" -ne 2 ]; then
     --deadline 900 --report chaos_serve_tpu.json 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
+  # fleet-telemetry capture on-chip (telemetry plane): one more
+  # kill-resume cycle with a KEPT workdir, then the fleet-debugging
+  # artifacts are folded out of the wreckage — the per-tenant usage
+  # ledger (tools/usage_export.py --check gates on EXACT conservation
+  # vs the journal's raw totals; its nonzero exit is the leg's verdict)
+  # and the ONE merged Perfetto trace whose request spans cross the
+  # kill boundary under the caller's trace id. The CPU smoke
+  # (ci_checks.sh step 9) proves this plumbing on toy graphs; this leg
+  # proves the trace/usage plane survives a SIGKILL on real hardware
+  # queues with in-flight device work.
+  echo "=== fleet-telemetry capture (kill-resume usage + merged trace) ===" | tee -a /dev/stderr >/dev/null
+  TEL_DIR=$(mktemp -d)
+  timeout 1800 python tools/chaos_serve.py --schedules 1 --kills 1 \
+    --clients 8 --requests-per-client 2 --nodes 20000 --degree 16 \
+    --deadline 900 --workdir "$TEL_DIR" \
+    --report chaos_serve_telemetry_tpu.json 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+  timeout 300 python tools/usage_export.py "$TEL_DIR/journal" \
+    --logs "$TEL_DIR/server_*.jsonl" -o usage_tpu.jsonl --check 2>&1 \
+    | tee -a /dev/stderr >/dev/null || true
+  timeout 300 python tools/export_trace.py "$TEL_DIR"/server_*.jsonl \
+    -o trace_merged_tpu.json 2>&1 | tee -a /dev/stderr >/dev/null || true
+  rm -rf "$TEL_DIR"
+
   # chaos-mesh soak on-chip (failure-domain plane): seeded device-loss
   # schedules + single-graph re-shard sweeps + a degraded kill-resume
   # cycle, against the REAL device mesh — the CPU legs (ci_checks.sh

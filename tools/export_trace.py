@@ -14,9 +14,20 @@ arrived (a crashed or still-running producer) is emitted with zero
 duration and ``args.unclosed = true`` so it is visible, not dropped.
 Torn trailing lines (a live log mid-write) are tolerated.
 
+Multi-log merge (cross-incarnation traces): given SEVERAL run logs —
+e.g. ``server_0.jsonl .. server_N.jsonl`` from a kill-resume soak —
+spans are paired within each file (span ids like ``s3`` restart per
+process and would collide across files), but process tracks are keyed
+by TRACE id across all files: a journal-replayed ticket that resumed
+its original trace in a later incarnation lands on the SAME Perfetto
+track as its first attempt, one thread lane per incarnation
+(``thread_name`` = the source file). That is the cross-boundary
+propagation proof: one trace id, one track, N incarnations.
+
 Usage:
     python tools/export_trace.py RUN.jsonl -o trace.json
     python tools/export_trace.py RUN.jsonl --trace req-7   # one request
+    python tools/export_trace.py server_*.jsonl -o merged.json  # merge
 """
 
 from __future__ import annotations
@@ -103,24 +114,103 @@ def to_chrome_trace(spans: list[dict], trace_filter: str | None = None) -> dict:
     return {"traceEvents": events, "displayTimeUnit": "ms"}
 
 
+def merge_chrome_traces(labeled: list,
+                        trace_filter: str | None = None) -> dict:
+    """Merge N runs' spans into one chrome trace. ``labeled`` is
+    ``[(label, spans), ...]`` — one entry per run-log file, in
+    incarnation order.
+
+    B/E pairing is PER FILE (every process restarts its ``s<N>`` span
+    id counter, so ``(trace, span)`` keys collide across files), but
+    the process track is per TRACE id across ALL files — the merged
+    view shows a crash-resumed request as one track whose thread lanes
+    are its incarnations."""
+    events: list = []
+    pids: dict = {}          # trace id -> pid (shared across files)
+    named_tids: set = set()  # (pid, tid) with thread_name emitted
+
+    def pid_for(trace: str) -> int:
+        if trace not in pids:
+            pids[trace] = len(pids) + 1
+            events.append({"ph": "M", "name": "process_name",
+                           "pid": pids[trace], "tid": 0,
+                           "args": {"name": trace}})
+        return pids[trace]
+
+    def lane(trace: str, tid: int, label: str) -> int:
+        pid = pid_for(trace)
+        if (pid, tid) not in named_tids:
+            named_tids.add((pid, tid))
+            events.append({"ph": "M", "name": "thread_name",
+                           "pid": pid, "tid": tid,
+                           "args": {"name": label}})
+        return pid
+
+    for tid, (label, spans) in enumerate(labeled, 1):
+        open_spans: dict = {}
+        for rec in spans:
+            trace = rec.get("trace")
+            if trace_filter is not None and trace != trace_filter:
+                continue
+            key = (trace, rec.get("span"))
+            if rec.get("ph") == "B":
+                open_spans[key] = rec
+            elif rec.get("ph") == "E":
+                begin = open_spans.pop(key, None)
+                if begin is None:
+                    continue
+                args = dict(begin.get("attrs") or {})
+                args.update(rec.get("attrs") or {})
+                args["span"] = rec.get("span")
+                args["source"] = label
+                if begin.get("parent"):
+                    args["parent"] = begin["parent"]
+                events.append({
+                    "ph": "X", "name": begin.get("name", "?"),
+                    "cat": "dgc", "pid": lane(trace, tid, label),
+                    "tid": tid, "ts": begin.get("ts_us", 0),
+                    "dur": max(0, rec.get("ts_us", 0)
+                               - begin.get("ts_us", 0)),
+                    "args": args,
+                })
+        for (trace, span_id), begin in open_spans.items():
+            args = dict(begin.get("attrs") or {})
+            args.update(span=span_id, unclosed=True, source=label)
+            events.append({
+                "ph": "X", "name": begin.get("name", "?"), "cat": "dgc",
+                "pid": lane(trace, tid, label), "tid": tid,
+                "ts": begin.get("ts_us", 0), "dur": 0, "args": args,
+            })
+    events.sort(key=lambda e: (e["pid"], e["tid"], e.get("ts", 0)))
+    return {"traceEvents": events, "displayTimeUnit": "ms"}
+
+
 def main(argv: list[str] | None = None) -> int:
     p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
-    p.add_argument("path", help="JSONL run log with span events")
+    p.add_argument("paths", nargs="+", metavar="PATH",
+                   help="JSONL run log(s) with span events; several "
+                        "paths (incarnation order) are merged by trace "
+                        "id, one thread lane per file")
     p.add_argument("-o", "--out", default=None,
                    help="output trace JSON (default: stdout)")
     p.add_argument("--trace", default=None, metavar="TRACE_ID",
                    help="export only this trace (e.g. req-7)")
     args = p.parse_args(argv)
+    labeled = []
     try:
-        spans = read_spans(args.path)
+        for path in args.paths:
+            labeled.append((path, read_spans(path)))
     except (OSError, ValueError) as e:
         print(f"error: {e}", file=sys.stderr)
         return 2
-    if not spans:
-        print(f"{args.path}: no span events (tracing off, or not a serve "
-              f"log?)", file=sys.stderr)
+    if not any(spans for _, spans in labeled):
+        print(f"{', '.join(args.paths)}: no span events (tracing off, "
+              f"or not a serve log?)", file=sys.stderr)
         return 1
-    doc = to_chrome_trace(spans, trace_filter=args.trace)
+    if len(labeled) == 1:
+        doc = to_chrome_trace(labeled[0][1], trace_filter=args.trace)
+    else:
+        doc = merge_chrome_traces(labeled, trace_filter=args.trace)
     if not doc["traceEvents"]:
         print(f"--trace {args.trace}: no matching spans", file=sys.stderr)
         return 1

@@ -38,6 +38,7 @@ Usage:
 from __future__ import annotations
 
 import argparse
+import hashlib
 import http.client
 import json
 import os
@@ -71,7 +72,7 @@ class _Client:
         self.client_ms: list = []      # accept -> terminal result, ms
         self.errors: list = []
 
-    def _request(self, method, path, doc=None):
+    def _request(self, method, path, doc=None, headers_extra=None):
         """One request on the client's persistent connection, retrying
         transient socket failures (the connect herd of a 1000-client
         ramp can outrun even a deep accept backlog) on a fresh
@@ -80,6 +81,8 @@ class _Client:
         headers = {"X-Dgc-Tenant": self.tenant}
         if body is not None:
             headers["Content-Type"] = "application/json"
+        if headers_extra:
+            headers.update(headers_extra)
         last = None
         for attempt in range(8):
             try:
@@ -112,9 +115,18 @@ class _Client:
                        "max_degree": self.args.degree,
                        "seed": self.idx * 10_000 + r,
                        "gen_method": "fast"}
+                tp = None
+                if self.args.telemetry:
+                    # deterministic per-request W3C trace context — the
+                    # propagation cost rides every submit, like a fleet
+                    # router stamping each hop
+                    h = hashlib.sha256(
+                        f"soak-{self.idx}-{r}".encode()).hexdigest()
+                    tp = {"traceparent": f"00-{h[:32]}-{h[32:48]}-01"}
                 accepted = False
                 for _attempt in range(MAX_SUBMIT_RETRIES):
-                    status, body = self._request("POST", "/v1/color", doc)
+                    status, body = self._request("POST", "/v1/color",
+                                                 doc, headers_extra=tp)
                     if status == 202:
                         self.tickets.append(
                             (body["ticket"], time.perf_counter()))
@@ -208,6 +220,12 @@ def main(argv: list[str] | None = None) -> int:
                         "fsync-journaled ahead of its 202 — the "
                         "journal-on vs journal-off throughput delta is "
                         "the PERF.md \"Durable ticket journal\" row")
+    p.add_argument("--telemetry", action="store_true",
+                   help="arm the fleet-telemetry plane under load: a "
+                        "1s timeseries sampler on the listener AND a "
+                        "per-request W3C traceparent header from every "
+                        "client — the on/off A/B is the PERF.md "
+                        "\"Fleet telemetry overhead\" row")
     p.add_argument("--log-json", type=str, default=None)
     p.add_argument("--run-manifest", type=str, default=None)
     p.add_argument("--perf-db", type=str, default=None,
@@ -244,8 +262,14 @@ def main(argv: list[str] | None = None) -> int:
                           logger=logger, registry=registry).start()
     admission = AdmissionController(load_tenant_configs(tenant_doc),
                                     registry=registry, logger=logger)
+    sampler = None
+    if args.telemetry:
+        from dgc_tpu.obs.timeseries import TimeseriesSampler
+
+        sampler = TimeseriesSampler(registry, interval_s=1.0).start()
     nf = NetFront(front, admission=admission, registry=registry,
-                  logger=logger, journal_dir=args.journal_dir).start()
+                  logger=logger, journal_dir=args.journal_dir,
+                  timeseries=sampler).start()
 
     # compile off the soak clock: warm the one shape class the soak's
     # generator spec lands in (the --warm-classes convention)
@@ -325,8 +349,10 @@ def main(argv: list[str] | None = None) -> int:
         "metric": f"soak_netfront_c{args.clients}"
                   f"_r{args.requests_per_client}"
                   f"_n{args.nodes}d{args.degree}"
-                  + ("_journal" if args.journal_dir else ""),
+                  + ("_journal" if args.journal_dir else "")
+                  + ("_telemetry" if args.telemetry else ""),
         "journal": bool(args.journal_dir),
+        "telemetry": args.telemetry,
         "value": round(accepted / wall, 3) if wall > 0 else None,
         "unit": "graphs/s",
         "backend": "netfront",
@@ -348,6 +374,8 @@ def main(argv: list[str] | None = None) -> int:
     if args.no_drain:
         front.shutdown(drain=True)
     nf.close()
+    if sampler is not None:
+        sampler.close()
     if args.run_manifest:
         manifest.finalize(registry=registry)
         manifest.write(args.run_manifest)

@@ -1,0 +1,118 @@
+#!/usr/bin/env python
+"""Export per-tenant usage rollups from a durable ticket journal.
+
+The offline half of the fleet's usage metering (``dgc_tpu.obs.usage``):
+fold a serve tier's ticket journal directory (``--journal-dir`` /
+``tools/chaos_serve.py`` workdirs) — plus optional run-log JSONLs for
+the kernel device-time column — into one ``usage_rollup`` row per
+tenant, written as a versioned JSONL artifact. Each row is emitted as a
+schema-valid ``usage_rollup`` event (``tools/validate_runlog.py``
+validates the artifact like any run log).
+
+The fold is crash-resume exact: ``scan_journal`` dedups every lifecycle
+stage by ticket id, so a kill-resume soak's N incarnations over one
+journal meter each ticket once. ``--check`` proves it — the per-tenant
+sums are recomputed against the journal's RAW record totals
+(``obs.usage.journal_totals``, an independent derivation) and any
+inequality exits nonzero. Conservation is exact equality, not a
+tolerance: billing rows that "mostly" add up are wrong.
+
+Usage:
+    python tools/usage_export.py JOURNAL_DIR -o usage.jsonl
+    python tools/usage_export.py JOURNAL_DIR --logs 'server_*.jsonl' \\
+        --check          # conservation-gated export (CI smoke)
+"""
+
+from __future__ import annotations
+
+import argparse
+import glob
+import json
+import os
+import sys
+import time
+
+sys.path.insert(0, os.path.dirname(os.path.dirname(
+    os.path.abspath(__file__))))
+
+from dgc_tpu.obs.usage import (conservation_problems,  # noqa: E402
+                               fold_journal, journal_totals)
+from dgc_tpu.serve.netfront.journal import JOURNAL_FILE  # noqa: E402
+
+
+def export_rows(journal_dir: str, log_globs=()) -> list:
+    """Per-tenant ``usage_rollup`` rows for one journal directory; log
+    globs feed the device-time column."""
+    journal_path = os.path.join(journal_dir, JOURNAL_FILE)
+    log_paths: list = []
+    for pattern in log_globs:
+        log_paths.extend(sorted(glob.glob(pattern)))
+    return fold_journal(journal_path, log_paths=log_paths)
+
+
+def write_artifact(rows: list, out_path: str) -> None:
+    """The versioned JSONL artifact: one schema-valid ``usage_rollup``
+    event per tenant (``t`` is export wall time — rows are totals, not
+    a timeline)."""
+    t = round(time.time(), 6)
+    with open(out_path, "w") as fh:
+
+        def event(kind: str, **fields) -> None:
+            fh.write(json.dumps({"t": t, "event": kind, **fields})
+                     + "\n")
+
+        for row in rows:
+            event("usage_rollup", **row)
+
+
+def main(argv: list[str] | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("journal_dir",
+                   help="ticket journal directory (the serve CLI's "
+                        "--journal-dir)")
+    p.add_argument("--logs", action="append", default=[],
+                   metavar="GLOB",
+                   help="run-log JSONL glob(s) for the per-tenant "
+                        "device-time column (e.g. 'server_*.jsonl'); "
+                        "repeatable")
+    p.add_argument("-o", "--out", default=None,
+                   help="write the rollup JSONL artifact here "
+                        "(default: stdout)")
+    p.add_argument("--check", action="store_true",
+                   help="conservation gate: per-tenant sums must "
+                        "EXACTLY equal the journal's raw totals, else "
+                        "exit 1")
+    args = p.parse_args(argv)
+    journal_path = os.path.join(args.journal_dir, JOURNAL_FILE)
+    if not os.path.exists(journal_path):
+        print(f"error: no {JOURNAL_FILE} in {args.journal_dir}",
+              file=sys.stderr)
+        return 2
+    try:
+        rows = export_rows(args.journal_dir, log_globs=args.logs)
+    except (OSError, ValueError) as e:
+        print(f"error: {e}", file=sys.stderr)
+        return 2
+    if args.out:
+        write_artifact(rows, args.out)
+    else:
+        for row in rows:
+            print(json.dumps(row))
+    totals = journal_totals(journal_path)
+    print(f"# {len(rows)} tenant(s); journal totals: "
+          f"{totals['admitted']} admitted, {totals['delivered']} "
+          f"delivered, {totals['failed']} failed, "
+          f"{totals['aborted']} aborted", file=sys.stderr)
+    if args.check:
+        problems = conservation_problems(rows, journal_path)
+        for problem in problems:
+            print(f"CHECK FAIL: {problem}", file=sys.stderr)
+        if problems:
+            return 1
+        print("# conservation: per-tenant sums equal journal totals "
+              "exactly", file=sys.stderr)
+    return 0
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

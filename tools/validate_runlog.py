@@ -176,6 +176,43 @@ def _semantic_problems(record: dict) -> list[str]:
             v = record.get(fieldname)
             if isinstance(v, int) and not isinstance(v, bool) and v < 0:
                 problems.append(f"lane_rebuild: {fieldname} {v} < 0")
+    # per-tenant usage metering (obs.usage): every lifecycle count is
+    # non-negative, a negative in_flight means a ticket was terminal
+    # twice, and the source comes from the closed live/journal
+    # vocabulary — the billing rows stay machine-checkable
+    elif kind == "usage_rollup":
+        for fieldname in ("admitted", "delivered", "failed", "aborted",
+                          "in_flight", "vertices", "vertex_supersteps"):
+            v = record.get(fieldname)
+            if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+                problems.append(f"usage_rollup: {fieldname} {v} < 0")
+        src = record.get("source")
+        if src is not None and src not in ("live", "journal"):
+            problems.append(
+                f"usage_rollup: source {src!r} not in "
+                f"('live', 'journal')")
+    # continuous SLO burn-rate telemetry (obs.timeseries): a burn is
+    # meaningless without a positive evaluation window, burns are
+    # non-negative, and the objective comes from the evaluator's closed
+    # vocabulary (slo_check threshold keys x quantiles)
+    elif kind == "slo_burn":
+        w = record.get("window_s")
+        if isinstance(w, (int, float)) and not isinstance(w, bool) \
+                and w <= 0:
+            problems.append(f"slo_burn: window_s {w} <= 0 "
+                            f"(burn needs a window)")
+        for fieldname in ("burn", "fast_burn", "slow_burn"):
+            v = record.get(fieldname)
+            if isinstance(v, (int, float)) and not isinstance(v, bool) \
+                    and v < 0:
+                problems.append(f"slo_burn: {fieldname} {v} < 0")
+        obj = record.get("objective")
+        allowed = ("failure_rate",
+                   "service_ms_p50", "service_ms_p95", "service_ms_p99",
+                   "queue_ms_p50", "queue_ms_p95", "queue_ms_p99")
+        if isinstance(obj, str) and obj not in allowed:
+            problems.append(
+                f"slo_burn: objective {obj!r} not in {allowed}")
     # multi-device serve tier (--mesh-devices): the lane mesh is ≥ 2
     # devices when reported at all (size 1 is the unsharded path and
     # emits no mesh fields), and the per-device occupancy series has
