@@ -71,6 +71,11 @@ LOCK_FILES = ("dgc_tpu/obs/metrics.py", "dgc_tpu/obs/httpd.py",
               # write-behind checkpoints: the sweep thread hands
               # snapshots to the writer thread under the manager's cond
               "dgc_tpu/utils/checkpoint.py",
+              # replicated serve fleet: the supervisor's child table is
+              # main-thread-confined (guarded-by: owner annotations);
+              # the probe's tick thread shares device-health state with
+              # the dispatcher and /healthz handlers
+              "dgc_tpu/serve/fleet.py", "dgc_tpu/resilience/probe.py",
               "tools/soak.py", "bench.py")
 TRANSFER_FILES = ("dgc_tpu/serve/batched.py", "dgc_tpu/serve/engine.py")
 

@@ -26,6 +26,7 @@ server: a handful of routes, GET/POST only, loopback by default.
 from __future__ import annotations
 
 import json
+import socket
 import threading
 import time
 from dataclasses import dataclass, field
@@ -111,7 +112,8 @@ class RoutingHTTPServer:   # dgc-lint: threaded
     ``start()`` and only read by handler threads afterwards; everything
     a handler touches beyond it must be thread-safe."""
 
-    def __init__(self, port: int = 0, host: str = "127.0.0.1"):
+    def __init__(self, port: int = 0, host: str = "127.0.0.1",
+                 reuse_port: bool = False):
         self._exact: dict = {}      # (method, path) -> fn; guarded-by: init
         self._prefix: list = []     # (method, prefix, fn); guarded-by: init
         outer = self
@@ -196,6 +198,16 @@ class RoutingHTTPServer:   # dgc-lint: threaded
             # connection-refused before a handler thread ever spawns.
             # The kernel clamps this to net.core.somaxconn.
             request_queue_size = 1024
+
+            def server_bind(self):
+                # SO_REUSEPORT (before bind): N fleet replica processes
+                # share ONE listening port and the kernel load-balances
+                # accepted connections across them — the stdlib
+                # listener is GIL-bound, so fan-out is process-level
+                if reuse_port:
+                    self.socket.setsockopt(socket.SOL_SOCKET,
+                                           socket.SO_REUSEPORT, 1)
+                super().server_bind()
 
         self._server = _Server((host, int(port)), _Handler)
         self._server.daemon_threads = True

@@ -214,7 +214,17 @@ EVENT_SCHEMAS: dict = {
     "net_reject": (
         {"tenant": "str", "reason": "str"},
         {"retry_after_s": NUM, "queue_depth": "int", "capacity": "int",
-         "tokens_left": NUM, "in_flight": "int", "limit": "int"}),
+         "tokens_left": NUM, "in_flight": "int", "limit": "int",
+         # brownout context: the tenant's tier and the shed level that
+         # refused it (reason="brownout" only)
+         "tier": "str", "level": "int"}),
+    # burn-driven brownout (netfront.admission.BrownoutController):
+    # one event per shed-level transition. Action vocabulary
+    # ("shed"/"restore"), level bounds, and shed⇒level≥1 are enforced
+    # by tools/validate_runlog.py
+    "net_brownout": (
+        {"action": "str", "level": "int"},
+        {"objectives": "list", "retry_after_s": NUM}),
     "net_drain": (
         {"in_flight": "int", "queued": "int"},
         {"completed": "int", "failed": "int", "timeout_s": NUM,
@@ -229,7 +239,18 @@ EVENT_SCHEMAS: dict = {
         {"ticket": ("str", "null"), "tenant": ("str", "null"),
          "error": ("str", "null"), "records": "int", "restored": "int",
          "replayed": "int", "failed": "int", "high_water": "int",
-         "wall_s": NUM}),
+         "wall_s": NUM,
+         # fleet recovery (summary only): namespaces merge-scanned and
+         # in-flight tickets left to sibling replicas' recover sets
+         "namespaces": "int", "foreign": "int"}),
+    # automatic mesh-restore probe (resilience.probe.HealthProbe): one
+    # event per canary attempt on a benched device, plus the restore
+    # arm once the bench empties. Action vocabulary ("probed" /
+    # "restore_requested"), backoff non-negativity, and ok/backoff
+    # consistency are enforced by tools/validate_runlog.py
+    "mesh_probe": (
+        {"device": "int", "ok": "bool"},
+        {"action": "str", "attempt": "int", "backoff_s": NUM}),
     "serve_warmup": (
         {"classes": "int", "kernels": "int", "seconds": NUM},
         # compiled stage branches across the warmed kernels (the staged

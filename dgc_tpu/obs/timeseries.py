@@ -228,7 +228,7 @@ class BurnRateEvaluator:
     def __init__(self, sampler: TimeseriesSampler, thresholds: dict, *,
                  fast_window_s: float = 60.0, slow_window_s: float = 300.0,
                  burn_threshold: float = 1.0, cooldown_s: float | None = None,
-                 hooks=None, logger=None, registry=None):
+                 hooks=None, logger=None, registry=None, brownout=None):
         if fast_window_s <= 0 or slow_window_s <= 0:
             raise ValueError("burn windows must be > 0")
         if slow_window_s < fast_window_s:
@@ -245,6 +245,12 @@ class BurnRateEvaluator:
         self.hooks = hooks                           # guarded-by: init
         self.logger = logger                         # guarded-by: init
         self.registry = registry                     # guarded-by: init
+        # burn-driven brownout controller (admission.BrownoutController):
+        # notified on EVERY warmed evaluation with the pre-cooldown
+        # burning-objective list — empty lists are the clear signal that
+        # steps shedding back down, so pacing is decoupled from the
+        # per-objective event cooldown
+        self.brownout = brownout                     # guarded-by: init
         self._lock = threading.Lock()
         self._last_fire: dict = {}   # objective -> mono; guarded-by: _lock
         self.fired = 0               # total firings; guarded-by: _lock
@@ -297,6 +303,7 @@ class BurnRateEvaluator:
         if fast_base is None or slow_base is None:
             return []
         fired: list = []
+        burning: list = []
         now = latest["mono"]
         for name, kind, quantile, limit in self.objectives:
             fast_v = self._window_value(fast_base, latest, kind, quantile)
@@ -308,6 +315,7 @@ class BurnRateEvaluator:
             if fast_burn < self.burn_threshold \
                     or slow_burn < self.burn_threshold:
                 continue
+            burning.append(name)
             with self._lock:
                 last = self._last_fire.get(name)
                 if last is not None and now - last < self.cooldown_s:
@@ -318,6 +326,11 @@ class BurnRateEvaluator:
                           "fast_burn": round(fast_burn, 4),
                           "slow_burn": round(slow_burn, 4),
                           "value": round(slow_v, 4), "limit": limit})
+        if self.brownout is not None:
+            try:
+                self.brownout.on_evaluate(burning)
+            except Exception:
+                pass   # shedding must never mask the evaluation
         if not fired:
             return []
         hook_out = {"dump": None, "profile": None}

@@ -161,17 +161,48 @@ class UsageMeter:
 
 # -- offline fold (tools/usage_export.py) ----------------------------------
 
-def fold_journal(journal_path: str, log_paths=()) -> list:
+def _merged_state(journal_paths):
+    """One :class:`JournalState` folded over N fleet namespace WALs —
+    all WALs before any results log (a replayed ticket's terminal
+    record lands in a LATER incarnation's journal than its admit), in
+    the caller's path order, salvage-scanned (a corrupt namespace
+    contributes its clean prefix)."""
+    import os
+
+    from dgc_tpu.serve.netfront.journal import (RESULTS_FILE, _Folder,
+                                                _scan_lines)
+
+    folder = _Folder()
+    per_res = []
+    for path in journal_paths:
+        wal_docs, _, _ = _scan_lines(path, salvage=True)
+        folder.add_wal(wal_docs, namespace=os.path.dirname(path))
+        res_docs, _, _ = _scan_lines(
+            os.path.join(os.path.dirname(path), RESULTS_FILE),
+            salvage=True)
+        per_res.append(res_docs)
+    for res_docs in per_res:
+        folder.add_results(res_docs)
+    return folder.state
+
+
+def fold_journal(journal_path, log_paths=()) -> list:
     """Fold a durable ticket journal (plus optional run-log JSONLs for
     the device-time column) into per-tenant ``usage_rollup`` rows
     (``source="journal"``). Ticket-exact: ``scan_journal`` dedups every
     lifecycle stage by ticket id, so N crash-resume incarnations over
-    one journal meter each ticket once."""
+    one journal meter each ticket once. ``journal_path`` may be a LIST
+    of fleet namespace WAL paths — the fold then merges them the way
+    fleet recovery does, so an N-replica fleet's ledger is still one
+    per-tenant rollup with no lost or double-metered ticket."""
     import json
 
     from dgc_tpu.serve.netfront.journal import scan_journal
 
-    state = scan_journal(journal_path)
+    if isinstance(journal_path, (list, tuple)):
+        state = _merged_state(journal_path)
+    else:
+        state = scan_journal(journal_path)
     accs: dict = {}
     trace_of: dict = {}   # request trace id -> tenant
     for ent in state.tickets:
@@ -219,19 +250,33 @@ def fold_journal(journal_path: str, log_paths=()) -> list:
             for t, acc in sorted(accs.items())]
 
 
-def journal_totals(journal_path: str) -> dict:
+def journal_totals(journal_path) -> dict:
     """The conservation reference: lifecycle totals recomputed straight
     from the raw journal record stream (dedup by ticket id per stage,
     results for tickets absent from the WAL dropped — the recovery
     scanner's exact admission rules, derived independently of the
-    per-tenant fold so the two can disagree when either is wrong)."""
+    per-tenant fold so the two can disagree when either is wrong).
+    ``journal_path`` may be a list of fleet namespace WAL paths: all
+    WALs are folded before any results log, salvage-scanned, exactly
+    like :func:`_merged_state` and fleet recovery."""
     import os
 
     from dgc_tpu.serve.netfront.journal import RESULTS_FILE, _scan_lines
 
-    wal_docs, _ = _scan_lines(journal_path)
-    res_docs, _ = _scan_lines(
-        os.path.join(os.path.dirname(journal_path), RESULTS_FILE))
+    paths = (list(journal_path)
+             if isinstance(journal_path, (list, tuple))
+             else [journal_path])
+    salvage = isinstance(journal_path, (list, tuple))
+    wal_docs = []
+    res_docs = []
+    for path in paths:
+        docs, _, _ = _scan_lines(path, salvage=salvage)
+        wal_docs.extend(docs)
+    for path in paths:
+        docs, _, _ = _scan_lines(
+            os.path.join(os.path.dirname(path), RESULTS_FILE),
+            salvage=salvage)
+        res_docs.extend(docs)
     admitted: dict = {}   # ticket -> payload vertices
     aborted: set = set()
     terminal: dict = {}   # ticket -> last terminal status
@@ -254,11 +299,12 @@ def journal_totals(journal_path: str) -> dict:
             "vertices": sum(admitted.values())}
 
 
-def conservation_problems(rows: list, journal_path: str) -> list:
+def conservation_problems(rows: list, journal_path) -> list:
     """Exact-equality check: per-tenant rollup sums vs the journal's raw
     totals (:func:`journal_totals`). Empty list = conserved; anything
     else means a ticket was lost or double-metered somewhere between
-    the journal and the rows."""
+    the journal and the rows. ``journal_path`` accepts a list of fleet
+    namespace WAL paths (the fleet ledger conserves as one unit)."""
     totals = journal_totals(journal_path)
     problems: list = []
     for fieldname in (*COUNT_FIELDS, "vertices"):

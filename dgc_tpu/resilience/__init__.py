@@ -12,6 +12,10 @@ kills, or dies with a *structured* abort (never a garbage coloring):
 - ``resilience.domains`` — the failure-domain plane: device-health
   model, domain map with largest-pow2 survivor sub-meshes, the
   degrade/restore state machine, and the supervisor's re-shard rungs;
+- ``resilience.probe`` — the automatic mesh-restore probe: periodic
+  canary dispatch on benched devices with per-device backoff, driving
+  ``mark_healthy`` → ``request_restore`` itself (the operator-armed
+  restore gap, closed);
 - ``resilience.supervisor`` — the supervised sweep driver: per-attempt
   soft watchdog, transient retries, per-rung checkpoint resume, and the
   engine-fallback ladder (sharded → fused ELL → compact → reference-sim).
@@ -25,6 +29,7 @@ from dgc_tpu.resilience.domains import (DeviceHealth, DomainMap, MeshState,
                                         is_device_loss, reshard_ladder)
 from dgc_tpu.resilience.faults import (FaultPlane, FaultSchedule, FaultSpec,
                                        KILL_RC, SimulatedKill, fault_point)
+from dgc_tpu.resilience.probe import HealthProbe, canary_probe
 from dgc_tpu.resilience.retry import (ErrorClass, RetryBudget, RetryPolicy,
                                       classify_error)
 from dgc_tpu.resilience.supervisor import (AttemptTimeout, DEFAULT_LADDER,
@@ -43,6 +48,7 @@ __all__ = [
     "FaultPlane",
     "FaultSchedule",
     "FaultSpec",
+    "HealthProbe",
     "KILL_RC",
     "ResilienceStats",
     "RetryBudget",
@@ -52,6 +58,7 @@ __all__ = [
     "STRUCTURED_ABORT_RC",
     "SimulatedKill",
     "SweepAbort",
+    "canary_probe",
     "classify_error",
     "default_ladder",
     "fault_point",

@@ -77,6 +77,48 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "resumed past the journal high-water mark "
                         "(tools/chaos_serve.py is the kill-resume "
                         "proof); absent = the in-memory-only table")
+    p.add_argument("--replicas", type=int, default=1, metavar="N",
+                   help="replicated serve fleet (serve.fleet): supervise "
+                        "N listener replicas sharing --listen's port via "
+                        "SO_REUSEPORT, each journaling into its own "
+                        "namespace under --journal-dir (which becomes "
+                        "required) with replica-prefixed ticket ids; a "
+                        "crashed replica respawns under a fresh "
+                        "incarnation and fleet recovery merge-scans "
+                        "every namespace (tools/chaos_fleet.py is the "
+                        "kill/merge proof); default 1 = the exact "
+                        "single-listener path")
+    p.add_argument("--probe-interval", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="automatic mesh-restore probe (resilience."
+                        "probe): every SECONDS, dispatch a canary onto "
+                        "each benched (lost) device with per-device "
+                        "exponential backoff; a passing probe drives "
+                        "mark_healthy -> request_restore itself (the "
+                        "operator-armed restore loop, closed); 0 "
+                        "(default) keeps restore operator-driven")
+    p.add_argument("--brownout", action="store_true",
+                   help="burn-driven brownout (with --slo-thresholds): "
+                        "sustained slo_burn sheds the lowest admission "
+                        "tiers first (structured 503 + Retry-After, "
+                        "net_brownout transitions) and restores them as "
+                        "the burn clears")
+    p.add_argument("--brownout-sustain", type=int, default=3,
+                   help="consecutive burning evaluations before the "
+                        "brownout escalates one shed level (default 3)")
+    p.add_argument("--brownout-clear", type=int, default=3,
+                   help="consecutive clean evaluations before the "
+                        "brownout de-escalates one level (default 3)")
+    # fleet-internal flags (supervisor -> replica child; not a user
+    # surface, hence suppressed): the replica id, its incarnation
+    # number, and the comma-joined recover partition ("." = the bare
+    # pre-fleet root journal)
+    p.add_argument("--fleet-replica", type=str, default=None,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--fleet-incarnation", type=int, default=0,
+                   help=argparse.SUPPRESS)
+    p.add_argument("--fleet-recover", type=str, default=None,
+                   help=argparse.SUPPRESS)
     p.add_argument("--inject-faults", type=str, default=None,
                    metavar="SPEC",
                    help="arm the resilience fault plane "
@@ -248,7 +290,7 @@ def build_serve_parser() -> argparse.ArgumentParser:
     return p
 
 
-def _build_timeseries(args, registry, recorder, logger):
+def _build_timeseries(args, registry, recorder, logger, brownout=None):
     """Stand the continuous-telemetry plane (``obs.timeseries``) when
     ``--timeseries-interval`` is set: the sampler ring, and — with
     ``--slo-thresholds`` — the burn-rate evaluator wired to the flight
@@ -296,12 +338,13 @@ def _build_timeseries(args, registry, recorder, logger):
             fast_window_s=args.burn_fast_window,
             slow_window_s=args.burn_slow_window,
             burn_threshold=args.burn_threshold,
-            hooks=hooks, logger=logger, registry=registry)
+            hooks=hooks, logger=logger, registry=registry,
+            brownout=brownout)
     return sampler.start()
 
 
 def _listen_main(args, front, logger, registry, manifest, recorder,
-                 warmup, sampler=None) -> int:
+                 warmup, sampler=None, brownout=None) -> int:
     """Network mode (``--listen``): stand the netfront listener over
     the started front end and serve until a drain completes (``POST
     /admin/drain`` or Ctrl-C). Application and observability routes
@@ -324,6 +367,29 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
             return 2
     admission = AdmissionController(configs, registry=registry,
                                     logger=logger)
+    # fleet replica child (--fleet-replica, spawned by serve.fleet):
+    # journal into this incarnation's OWN namespace under the shared
+    # --journal-dir, recover the supervisor-assigned partition, share
+    # the port via SO_REUSEPORT. Unset = the exact single-listener path.
+    journal_dir = args.journal_dir
+    replica = fleet_dir = None
+    recover = None
+    if args.fleet_replica is not None:
+        from dgc_tpu.serve.netfront import namespace_name
+
+        if args.journal_dir is None:
+            print("--fleet-replica requires --journal-dir",
+                  file=sys.stderr)
+            front.shutdown(drain=False)
+            return 2
+        replica = args.fleet_replica
+        fleet_dir = args.journal_dir
+        journal_dir = os.path.join(
+            args.journal_dir,
+            namespace_name(replica, args.fleet_incarnation))
+        recover = tuple("" if ns == "." else ns
+                        for ns in (args.fleet_recover or "").split(",")
+                        if ns)
     try:
         nf = NetFront(front, admission=admission, registry=registry,
                       logger=logger, recorder=recorder,
@@ -331,7 +397,11 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
                       profiler=lambda ms: profiler.timed_window(
                           args.profile_logdir, ms, trigger="http",
                           logger=logger),
-                      journal_dir=args.journal_dir,
+                      journal_dir=journal_dir,
+                      replica=replica, fleet_dir=fleet_dir,
+                      recover_namespaces=recover,
+                      reuse_port=replica is not None,
+                      brownout=brownout,
                       timeseries=sampler,
                       host=args.listen_host, port=args.listen).start()
     except OSError as e:
@@ -340,6 +410,20 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
         front.shutdown(drain=False)
         return 2
     logger.event("metrics_server", port=nf.port, host=args.listen_host)
+    # automatic mesh-restore probe (resilience.probe): canary-sweep
+    # benched devices and drive mark_healthy -> request_restore without
+    # an operator; 0 (default) keeps PR 15's operator-armed loop
+    probe = None
+    if args.probe_interval > 0:
+        if front.scheduler.device_health is not None:
+            from dgc_tpu.resilience.probe import HealthProbe
+
+            probe = HealthProbe(front.scheduler,
+                                interval_s=args.probe_interval,
+                                logger=logger, registry=registry).start()
+        else:
+            print("# --probe-interval ignored without --mesh-devices: "
+                  "no device-health plane to probe", file=sys.stderr)
     print(f"# listening: http://{args.listen_host}:{nf.port}/v1/color "
           f"(metrics on /metrics, drain via POST /admin/drain)",
           file=sys.stderr)
@@ -351,6 +435,8 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
         print("# interrupt: draining...", file=sys.stderr)
         nf.drain()
     wall = time.perf_counter() - t0
+    if probe is not None:
+        probe.close()
     front.health(emit=True)
     st = front.stats_snapshot()
     sst = front.scheduler.stats_snapshot()
@@ -429,6 +515,19 @@ def serve_main(argv: list[str] | None = None) -> int:
         print("one of --requests (replay) or --listen PORT (network "
               "mode) is required", file=sys.stderr)
         return 2
+    if args.replicas < 1:
+        print("--replicas must be >= 1", file=sys.stderr)
+        return 2
+    if args.replicas >= 2:
+        # replicated fleet: hand the ORIGINAL argv to the supervisor,
+        # which re-invokes this CLI once per replica (minus --replicas,
+        # plus the suppressed --fleet-* flags); everything below this
+        # branch is the single-listener path a replica child runs
+        from dgc_tpu.serve.fleet import fleet_main
+
+        return fleet_main(args,
+                          list(argv) if argv is not None
+                          else sys.argv[2:])
 
     from dgc_tpu.obs import MetricsRegistry, RunLogger, RunManifest
     from dgc_tpu.serve.queue import QueueFull, ServeFrontEnd
@@ -481,10 +580,30 @@ def serve_main(argv: list[str] | None = None) -> int:
         faults.install(faults.FaultPlane(schedule, hard_kill=True,
                                          on_fire=on_fire))
 
+    # burn-driven brownout (netfront.admission.BrownoutController):
+    # built BEFORE the telemetry plane so the burn-rate evaluator can
+    # notify it, handed to the listener so it can shed
+    brownout = None
+    if args.brownout:
+        if (args.listen is None or not args.slo_thresholds
+                or args.timeseries_interval <= 0):
+            print("# --brownout ignored: shedding is driven by the "
+                  "burn-rate evaluator (needs --listen + "
+                  "--timeseries-interval + --slo-thresholds)",
+                  file=sys.stderr)
+        else:
+            from dgc_tpu.serve.netfront import BrownoutController
+
+            brownout = BrownoutController(sustain=args.brownout_sustain,
+                                          clear=args.brownout_clear,
+                                          logger=logger,
+                                          registry=registry)
+
     # continuous telemetry plane (obs.timeseries): sampler ring +
     # optional burn-rate evaluation over --slo-thresholds
     try:
-        sampler = _build_timeseries(args, registry, recorder, logger)
+        sampler = _build_timeseries(args, registry, recorder, logger,
+                                    brownout=brownout)
     except (OSError, ValueError, json.JSONDecodeError) as e:
         print(f"--slo-thresholds: {e}", file=sys.stderr)
         return 2
@@ -619,7 +738,8 @@ def serve_main(argv: list[str] | None = None) -> int:
 
     if args.listen is not None:
         return _listen_main(args, front, logger, registry, manifest,
-                            recorder, warmup, sampler=sampler)
+                            recorder, warmup, sampler=sampler,
+                            brownout=brownout)
 
     t0 = time.perf_counter()
     bad = 0

@@ -1,0 +1,323 @@
+"""Replicated serve fleet — the ``serve --replicas N`` supervisor.
+
+One process supervises N listener replicas that share ONE port via
+``SO_REUSEPORT`` (the kernel load-balances accepted connections across
+the replicas' accept queues — no proxy tier, no port fan-out). Each
+replica incarnation journals into its own namespace under the shared
+``--journal-dir`` (``<dir>/r<K>-<incarnation>/``) and mints
+replica-prefixed ticket ids (``r0-t00000007``), so two replicas — or
+two incarnations of one replica — can NEVER collide on a ticket id or
+a journal file.
+
+The supervisor's jobs:
+
+- **namespace assignment** — scan the journal dir's existing
+  namespaces (``journal.list_namespaces``) and partition them across
+  the N replicas (namespace ``rJ-*`` → replica ``J % N``; a bare
+  pre-fleet root journal → replica 0). Each replica receives its
+  partition as ``--fleet-recover``: the set of namespaces whose
+  in-flight tickets IT replays, so a fleet cold-restart replays every
+  acked ticket exactly once fleet-wide (completed tickets are merged
+  into every replica's table by the fleet scan and stay pollable from
+  any replica).
+- **respawn** — a replica that dies (rc != 0: SIGKILL, crash, OOM)
+  comes back under a FRESH incarnation number over the same journal
+  dir, re-recovering its own partition. Consecutive crash-on-arrival
+  respawns are capped so a poisoned config cannot spin forever.
+- **drain propagation** — a replica that exits rc 0 finished a
+  graceful drain (``POST /admin/drain`` lands on ONE replica via the
+  kernel's connection balancing); the supervisor SIGINTs the rest
+  (the serve CLI's Ctrl-C drain path) and the fleet exits 0.
+- **fleet state** — ``<journal-dir>/fleet_state.json`` records the
+  resolved port, replica pids and incarnations after every (re)spawn,
+  so harnesses (``tools/chaos_fleet.py``) can target kills at real
+  replica processes without parsing supervisor output.
+
+Per-replica run logs land next to the requested ``--log-json`` as
+``<base>.r<K>-<incarnation>.jsonl`` — one log per incarnation, the
+same layout ``tools/chaos_serve.py`` already merges for trace
+continuity.
+"""
+
+from __future__ import annotations
+
+import json
+import os
+import signal
+import socket
+import subprocess
+import sys
+import time
+
+from dgc_tpu.serve.netfront.journal import (list_namespaces, namespace_name,
+                                            split_namespace)
+
+# a replica that dies within this many seconds of spawn, this many times
+# in a row, is a crash loop (bad flags, unreadable journal) — give up
+# instead of spinning
+_CRASH_LOOP_WINDOW_S = 2.0
+_CRASH_LOOP_LIMIT = 5
+
+FLEET_STATE_FILE = "fleet_state.json"
+
+
+def _resolve_port(requested: int, host: str) -> int:
+    """Pin the fleet's shared port. ``--listen 0`` means "any free
+    port", but every replica must bind the SAME number — so the
+    supervisor resolves it once here and passes the concrete port to
+    every child."""
+    if requested != 0:
+        return requested
+    s = socket.socket()
+    try:
+        s.setsockopt(socket.SOL_SOCKET, socket.SO_REUSEPORT, 1)
+        s.bind((host, 0))
+        return s.getsockname()[1]
+    finally:
+        s.close()
+
+
+def _strip_flag(argv: list, name: str, has_value: bool = True) -> list:
+    """Remove ``name`` (and its value) from an argv list, tolerating
+    both ``--flag VALUE`` and ``--flag=VALUE`` spellings."""
+    out = []
+    skip = False
+    for tok in argv:
+        if skip:
+            skip = False
+            continue
+        if tok == name:
+            skip = has_value
+            continue
+        if tok.startswith(name + "="):
+            continue
+        out.append(tok)
+    return out
+
+
+def _set_flag(argv: list, name: str, value: str) -> list:
+    """Replace (or append) ``--name value`` in an argv list."""
+    return _strip_flag(argv, name) + [name, value]
+
+
+def assign_namespaces(existing: list, replicas: int) -> dict:
+    """Partition existing journal namespaces across the fleet:
+    ``{replica_index: [namespace, ...]}``. Namespace ``rJ-*`` goes to
+    replica ``J % replicas`` (a shrunk fleet adopts the departed
+    replicas' history); the bare pre-fleet root journal (``""``) goes
+    to replica 0. Every replica index appears, possibly empty."""
+    owned = {k: [] for k in range(replicas)}
+    for ns in existing:
+        if ns == "":
+            owned[0].append(ns)
+            continue
+        replica, _inc = split_namespace(ns)
+        owned[int(replica[1:]) % replicas].append(ns)
+    return owned
+
+
+def next_incarnation(existing: list, replica: int) -> int:
+    """First unused incarnation number for ``r<replica>`` given the
+    namespaces already on disk."""
+    hi = -1
+    for ns in existing:
+        if ns == "":
+            continue
+        rep, inc = split_namespace(ns)
+        if rep == f"r{replica}":
+            hi = max(hi, inc)
+    return hi + 1
+
+
+class _Replica:
+    """One listener replica subprocess (one incarnation)."""
+
+    def __init__(self, index: int, incarnation: int, namespace: str,
+                 argv: list, log_path):
+        self.index = index
+        self.incarnation = incarnation
+        self.namespace = namespace
+        self.spawned_at = time.monotonic()
+        self.argv = [sys.executable, "-m", "dgc_tpu.cli", "serve"] + argv
+        out = open(log_path, "ab") if log_path else subprocess.DEVNULL
+        try:
+            self.proc = subprocess.Popen(self.argv, stdout=out, stderr=out)
+        finally:
+            if log_path:
+                out.close()
+
+    def poll(self):
+        return self.proc.poll()
+
+
+class FleetSupervisor:
+    """Spawn, watch, respawn, and drain the replica set."""
+
+    def __init__(self, args, argv: list):
+        self.args = args
+        self.replicas = int(args.replicas)
+        self.journal_dir = args.journal_dir
+        self.host = args.listen_host
+        self.port = _resolve_port(args.listen, args.listen_host)
+        # the child argv: the fleet flags OUT (a child is a plain
+        # single listener), the resolved port IN
+        base = _strip_flag(list(argv), "--replicas")
+        base = _set_flag(base, "--listen", str(self.port))
+        self.base_argv = _strip_flag(base, "--log-json")
+        self.log_base = args.log_json
+        self.children: dict = {}          # guarded-by: owner (main thread)
+        self.crash_streak = {k: 0 for k in range(self.replicas)}
+
+    # -- spawn plumbing ---------------------------------------------------
+
+    def _child_log(self, namespace: str):
+        if not self.log_base:
+            return None
+        base = self.log_base
+        if base.endswith(".jsonl"):
+            base = base[: -len(".jsonl")]
+        return f"{base}.{namespace}.jsonl"
+
+    def _spawn(self, index: int) -> _Replica:
+        """(Re)spawn replica ``index`` under a fresh incarnation whose
+        recover partition is every namespace currently assigned to it."""
+        existing = list_namespaces(self.journal_dir)
+        incarnation = next_incarnation(existing, index)
+        namespace = namespace_name(f"r{index}", incarnation)
+        recover = assign_namespaces(existing, self.replicas)[index]
+        argv = list(self.base_argv)
+        argv += ["--fleet-replica", f"r{index}",
+                 "--fleet-incarnation", str(incarnation)]
+        if recover:
+            # the bare pre-fleet root journal is namespace "" — spelled
+            # "." on the argv boundary (an empty list element would not
+            # survive the comma join)
+            argv += ["--fleet-recover",
+                     ",".join(ns if ns else "." for ns in recover)]
+        log_path = self._child_log(namespace)
+        if log_path:
+            argv = _set_flag(argv, "--log-json", log_path)
+        child = _Replica(index, incarnation, namespace, argv, log_path)
+        self.children[index] = child
+        return child
+
+    def write_state(self) -> None:
+        """Land ``fleet_state.json`` for harnesses: the resolved port
+        plus each live replica's pid/incarnation/namespace."""
+        doc = {
+            "port": self.port,
+            "host": self.host,
+            "replicas": self.replicas,
+            "children": {
+                f"r{k}": {"pid": c.proc.pid, "incarnation": c.incarnation,
+                          "namespace": c.namespace}
+                for k, c in sorted(self.children.items())
+            },
+        }
+        path = os.path.join(self.journal_dir, FLEET_STATE_FILE)
+        tmp = path + ".tmp"
+        with open(tmp, "w") as fh:
+            json.dump(doc, fh, indent=2)
+            fh.write("\n")
+        os.replace(tmp, path)
+
+    # -- lifecycle --------------------------------------------------------
+
+    def start(self) -> "FleetSupervisor":
+        os.makedirs(self.journal_dir, exist_ok=True)
+        for k in range(self.replicas):
+            self._spawn(k)
+        self.write_state()
+        return self
+
+    def _interrupt_rest(self, except_index) -> None:
+        for k, child in self.children.items():
+            if k == except_index or child.poll() is not None:
+                continue
+            try:
+                child.proc.send_signal(signal.SIGINT)
+            except OSError:
+                pass
+
+    def _reap_all(self, timeout_s: float = 60.0) -> int:
+        """Wait for every child; SIGKILL stragglers past the deadline.
+        Returns the worst child rc (0 if all drained cleanly)."""
+        worst = 0
+        deadline = time.monotonic() + timeout_s
+        for child in self.children.values():
+            budget = max(0.1, deadline - time.monotonic())
+            try:
+                rc = child.proc.wait(timeout=budget)
+            except subprocess.TimeoutExpired:
+                child.proc.kill()
+                rc = child.proc.wait()
+            # a SIGINT-drained child exits 0; anything else propagates
+            worst = max(worst, abs(rc))
+        return worst
+
+    def run(self) -> int:
+        """The supervision loop: respawn crashed replicas, propagate
+        the first clean drain, cap crash loops."""
+        try:
+            while True:
+                for k in list(self.children):
+                    child = self.children[k]
+                    rc = child.poll()
+                    if rc is None:
+                        continue
+                    if rc == 0:
+                        # graceful drain completed on one replica: the
+                        # fleet follows it down
+                        print(f"# fleet: r{k} drained; stopping fleet",
+                              file=sys.stderr)
+                        self._interrupt_rest(k)
+                        return self._reap_all()
+                    fast = (time.monotonic() - child.spawned_at
+                            < _CRASH_LOOP_WINDOW_S)
+                    self.crash_streak[k] = (self.crash_streak[k] + 1
+                                            if fast else 1)
+                    if self.crash_streak[k] > _CRASH_LOOP_LIMIT:
+                        print(f"# fleet: r{k} crash loop (rc {rc} x"
+                              f"{self.crash_streak[k]}); aborting fleet",
+                              file=sys.stderr)
+                        self._interrupt_rest(None)
+                        self._reap_all()
+                        return 1
+                    print(f"# fleet: r{k} exited rc {rc}; respawning",
+                          file=sys.stderr)
+                    self._spawn(k)
+                    self.write_state()
+                time.sleep(0.05)
+        except KeyboardInterrupt:
+            print("# fleet: interrupt: draining replicas...",
+                  file=sys.stderr)
+            self._interrupt_rest(None)
+            return self._reap_all()
+        finally:
+            for child in self.children.values():
+                if child.poll() is None:
+                    child.proc.kill()
+
+
+def fleet_main(args, argv: list) -> int:
+    """``serve --replicas N`` entry point (N >= 2): validate the fleet
+    preconditions, then supervise."""
+    if args.listen is None:
+        print("--replicas requires --listen (the fleet is a network "
+              "front)", file=sys.stderr)
+        return 2
+    if args.journal_dir is None:
+        print("--replicas requires --journal-dir: replicas coordinate "
+              "recovery through the shared journal namespaces",
+              file=sys.stderr)
+        return 2
+    if not hasattr(socket, "SO_REUSEPORT"):
+        print("--replicas needs SO_REUSEPORT (unavailable on this "
+              "platform)", file=sys.stderr)
+        return 2
+    sup = FleetSupervisor(args, argv).start()
+    print(f"# fleet: {sup.replicas} replicas on "
+          f"http://{sup.host}:{sup.port}/v1/color "
+          f"(state: {os.path.join(sup.journal_dir, FLEET_STATE_FILE)})",
+          file=sys.stderr)
+    return sup.run()

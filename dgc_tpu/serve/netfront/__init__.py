@@ -25,6 +25,11 @@ per-class latency histograms, live Prometheus ``/metrics`` +
   :class:`~dgc_tpu.obs.metrics.MetricsRegistry` so ``/metrics`` breaks
   out tenants.
 
+- :class:`BrownoutController` — burn-driven graceful degradation: under
+  sustained ``slo_burn`` the listener sheds the lowest tiers first
+  (structured 503 + ``Retry-After``, ``net_brownout`` transitions) and
+  restores them as the burn clears.
+
 - ``journal`` — :class:`TicketJournal`: the durable ticket journal
   (crash-safe serve PR) — an append-only, fsync-batched write-ahead
   log of ticket lifecycle records the listener writes ahead of every
@@ -33,6 +38,11 @@ per-class latency histograms, live Prometheus ``/metrics`` +
   original ids, the id counter resumed past the journal high-water
   mark. ``tools/chaos_serve.py`` SIGKILLs a serving listener at seeded
   journal offsets and proves zero acked-ticket loss across restarts.
+  A replicated fleet (``serve --replicas N``) gives each replica
+  incarnation its own journal namespace and replica-prefixed ticket
+  ids; :func:`scan_fleet` merge-scans every namespace so fleet
+  recovery restores/replays across ALL incarnations
+  (``tools/chaos_fleet.py`` is the fleet-level chaos harness).
 
 ``tools/soak.py`` is the many-client soak harness over this package;
 its run log feeds ``tools/slo_check.py`` and its record feeds
@@ -41,12 +51,17 @@ number.
 """
 
 from dgc_tpu.serve.netfront.admission import (AdmissionController,
-                                              AdmissionReject, TenantConfig,
+                                              AdmissionReject,
+                                              BrownoutController,
+                                              TenantConfig,
                                               load_tenant_configs)
-from dgc_tpu.serve.netfront.journal import (JournalError, TicketJournal,
-                                            scan_journal)
+from dgc_tpu.serve.netfront.journal import (FleetScan, JournalError,
+                                            TicketJournal, list_namespaces,
+                                            namespace_name, parse_ticket,
+                                            scan_fleet, scan_journal)
 from dgc_tpu.serve.netfront.listener import NetFront
 
-__all__ = ["AdmissionController", "AdmissionReject", "JournalError",
-           "NetFront", "TenantConfig", "TicketJournal",
-           "load_tenant_configs", "scan_journal"]
+__all__ = ["AdmissionController", "AdmissionReject", "BrownoutController",
+           "FleetScan", "JournalError", "NetFront", "TenantConfig",
+           "TicketJournal", "list_namespaces", "load_tenant_configs",
+           "namespace_name", "parse_ticket", "scan_fleet", "scan_journal"]

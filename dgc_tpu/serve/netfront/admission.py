@@ -45,9 +45,12 @@ TIER_PRIORITY = {"free": 0, "standard": 0, "paid": 1, "premium": 2}
 # "journal_error" / "listener_fault": the durable ticket journal (or an
 # injected net_accept fault) refused the submit — the listener answers
 # 503 WITHOUT acking, because an un-journaled 202 is exactly the acked-
-# ticket loss the crash-safe serve tier exists to prevent
+# ticket loss the crash-safe serve tier exists to prevent.
+# "brownout": burn-driven load shedding (BrownoutController) — under
+# sustained slo_burn the lowest tiers 503 with Retry-After so overload
+# degrades by tier instead of collapsing the queue for everyone
 REJECT_REASONS = ("rate_limited", "concurrency", "queue_full", "draining",
-                  "journal_error", "listener_fault")
+                  "journal_error", "listener_fault", "brownout")
 
 
 class AdmissionReject(RuntimeError):
@@ -253,3 +256,106 @@ class AdmissionController:
                            "tier": st.cfg.tier,
                            "priority": st.cfg.resolved_priority()}
                     for name, st in self._tenants.items()}
+
+
+class BrownoutController:
+    """Burn-driven graceful degradation ahead of admission.
+
+    The burn evaluator (``obs.timeseries.BurnRateEvaluator``) notifies
+    :meth:`on_evaluate` with the burning-objective list on every warmed
+    evaluation. ``sustain`` consecutive burning evaluations raise the
+    shed level by one (up to ``max_level``); ``clear`` consecutive
+    clean evaluations lower it by one — hysteresis, so a flapping burn
+    cannot flap tenants. At level L the listener's pre-parse
+    :meth:`check` sheds every tenant whose resolved priority is < L
+    (free/standard first, paid next, premium only at L=3 which the
+    default ``max_level=2`` never reaches) with a structured 503 +
+    Retry-After. Every level transition lands in the obs stream as a
+    ``net_brownout`` event and on the ``dgc_net_brownout_level`` gauge.
+
+    Thread model: the evaluator thread drives level transitions while
+    listener handler threads call :meth:`check` — all state under one
+    lock."""
+
+    def __init__(self, *, sustain: int = 3, clear: int = 3,
+                 max_level: int = 2, retry_after_s: float = 5.0,
+                 logger=None, registry=None):
+        if sustain < 1 or clear < 1:
+            raise ValueError("brownout sustain/clear must be >= 1")
+        if max_level < 1:
+            raise ValueError("brownout max_level must be >= 1")
+        self.sustain = int(sustain)             # guarded-by: init
+        self.clear = int(clear)                 # guarded-by: init
+        self.max_level = int(max_level)         # guarded-by: init
+        self.retry_after_s = float(retry_after_s)   # guarded-by: init
+        self.logger = logger                    # guarded-by: init
+        self.registry = registry                # guarded-by: init
+        self._lock = threading.Lock()
+        self._level = 0      # current shed level; guarded-by: _lock
+        self._burning = 0    # consecutive burning evals; guarded-by: _lock
+        self._clean = 0      # consecutive clean evals; guarded-by: _lock
+        self._shed = 0       # total requests shed; guarded-by: _lock
+
+    def level(self) -> int:
+        with self._lock:
+            return self._level
+
+    # -- the burn evaluator's tick --------------------------------------
+    def on_evaluate(self, burning: list) -> None:
+        """One warmed burn evaluation: ``burning`` is the (possibly
+        empty) list of objective names over threshold in both windows.
+        Escalates / de-escalates the shed level with hysteresis and
+        emits ``net_brownout`` on every transition."""
+        action = None
+        with self._lock:
+            if burning:
+                self._burning += 1
+                self._clean = 0
+                if self._burning >= self.sustain \
+                        and self._level < self.max_level:
+                    self._level += 1
+                    self._burning = 0
+                    action = ("shed", self._level)
+            else:
+                self._clean += 1
+                self._burning = 0
+                if self._clean >= self.clear and self._level > 0:
+                    self._level -= 1
+                    self._clean = 0
+                    action = ("restore", self._level)
+            level = self._level
+        if self.registry is not None:
+            self.registry.gauge(
+                "dgc_net_brownout_level",
+                "current burn-driven shed level (0 = off)").set(level)
+        if action is not None and self.logger is not None:
+            self.logger.event(
+                "net_brownout", action=action[0], level=action[1],
+                objectives=list(burning),
+                retry_after_s=round(self.retry_after_s, 4))
+
+    # -- the listener's pre-admission gate ------------------------------
+    def check(self, tenant: str, cfg: TenantConfig):
+        """``AdmissionReject(reason="brownout")`` when ``tenant``'s
+        tier sheds at the current level, else None. Pure read + counter
+        bump — never blocks the request path on the evaluator."""
+        priority = cfg.resolved_priority()
+        with self._lock:
+            level = self._level
+            if level <= 0 or priority >= level:
+                return None
+            self._shed += 1
+        reject = AdmissionReject(
+            tenant, "brownout", retry_after_s=self.retry_after_s,
+            tier=cfg.tier, level=level)
+        if self.registry is not None:
+            self.registry.counter(
+                "dgc_net_rejected_total", "requests refused at admission",
+                tenant=tenant, reason="brownout").inc()
+        return reject
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            return {"level": self._level, "shed": self._shed,
+                    "max_level": self.max_level,
+                    "sustain": self.sustain, "clear": self.clear}

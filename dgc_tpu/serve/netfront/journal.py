@@ -56,6 +56,20 @@ dropped — everything before it was fsync-ordered ahead of any ack that
 depended on it. The journal is crash-consistent, not compacted;
 compaction (drop records of evicted tickets) is a follow-on.
 
+Fleet namespaces: a replicated fleet (``serve --replicas N``) gives
+each listener-replica *incarnation* its own namespace subdirectory
+(``--journal-dir/<replica>-<incarnation>/``) and prefixes its ticket
+ids ``<replica>-tNNNNNNNN``, so two replicas can never mint colliding
+ids no matter where their counters resume. :func:`scan_fleet` merges
+EVERY namespace for recovery — all WALs fold before any results log,
+because a replayed ticket's terminal record lands in the replaying
+incarnation's journal, not the one that admitted it — and reports the
+first-admit namespace per ticket (:class:`FleetScan.admitted_in`),
+the ownership key the fleet uses to replay each in-flight ticket
+exactly once across N recovering replicas. Namespace scans always run
+in salvage mode: a corrupt namespace contributes its clean prefix and
+is flagged instead of aborting the other N−1.
+
 Fault injection: every append passes the ``journal_write`` point of the
 resilience plane (``POINT@N=KIND`` grammar, ``--inject-faults``), so
 ``tools/chaos_serve.py`` can prove the listener's journal-error path
@@ -92,7 +106,17 @@ REC_TYPES = ("admitted", "seated", "attempt", "delivered", "failed",
 # recovery, which deterministic engines make invisible.
 _WAL_RECS = ("admitted", "seated", "aborted")
 
-_TICKET_RE = re.compile(r"^t([0-9a-f]{8})$")
+# ticket ids: plain ``tNNNNNNNN`` (single listener, unchanged bytes)
+# or fleet-namespaced ``<replica>-tNNNNNNNN`` — the replica prefix is
+# what makes ids collision-free ACROSS processes (the latent PR 12+
+# bug: two listeners over one --journal-dir each resumed their counter
+# from their OWN journal's high water and re-issued each other's ids)
+_TICKET_RE = re.compile(r"^(?:(r\d+)-)?t([0-9a-f]{8})$")
+
+# fleet journal namespaces: ``--journal-dir/<replica>-<incarnation>/``,
+# one per listener-replica incarnation ("" names the bare root journal
+# a pre-fleet single listener wrote — migration keeps it recoverable)
+NAMESPACE_RE = re.compile(r"^(r\d+)-(\d{3,})$")
 
 
 class JournalError(RuntimeError):
@@ -120,7 +144,8 @@ class TicketJournal:
     Breadcrumb appends (``durable=False``) never trigger a sync — file
     order means the next durable commit covers them for free."""
 
-    def __init__(self, directory: str, *, commit_window_s: float = 0.0):
+    def __init__(self, directory: str, *, commit_window_s: float = 0.0,
+                 flush_results: bool = False):
         self.directory = str(directory)
         os.makedirs(self.directory, exist_ok=True)
         self.path = os.path.join(self.directory, JOURNAL_FILE)
@@ -134,6 +159,11 @@ class TicketJournal:
         # for open-loop traffic on multi-core hosts where fsync rate,
         # not ack latency, is the binding cost.
         self.commit_window_s = float(commit_window_s)
+        # fleet mode: flush (not fsync) the results log per terminal
+        # record so a SIBLING replica's read-through poll sees the
+        # delivered colors promptly — off by default, the single
+        # listener keeps its lazy results tail (byte-identity)
+        self.flush_results = bool(flush_results)
         self._fh = open(self.path, "ab")
         self._rh = open(self.results_path, "ab")
         self._cond = threading.Condition()
@@ -169,6 +199,11 @@ class TicketJournal:
                 raise JournalError(f"journal append failed: {e}") from e
             self._written += 1
             if not wal:
+                if self.flush_results:
+                    try:
+                        self._rh.flush()
+                    except OSError:
+                        pass   # read-through degrades; close() re-tries
                 return   # results log: flushed lazily, fsync'd on close
             self._wal_written += 1
             seq = self._wal_written
@@ -319,17 +354,21 @@ class JournalState:
     high_water: int = -1     # max parsed ticket ordinal (-1 = none)
     records: int = 0
     torn: bool = False       # a torn trailing line was dropped
+    corrupt: bool = False    # a salvage scan dropped a mid-file suffix
 
 
-def _scan_lines(path: str):
-    """Parsed (doc, torn) records of one journal file; tolerates a torn
-    trailing line, raises :class:`JournalError` on corruption anywhere
-    else. A missing file yields nothing (first boot)."""
+def _scan_lines(path: str, salvage: bool = False):
+    """Parsed ``(docs, torn, corrupt)`` records of one journal file;
+    tolerates a torn trailing line, raises :class:`JournalError` on
+    corruption anywhere else — unless ``salvage``, where the scan stops
+    at the first bad record and keeps the clean prefix (fleet recovery
+    must survive one mangled namespace without abandoning the other
+    N−1). A missing file yields nothing (first boot)."""
     try:
         with open(path, "rb") as fh:
             raw = fh.read()
     except FileNotFoundError:
-        return [], False
+        return [], False, False
     lines = raw.split(b"\n")
     torn_tail = not raw.endswith(b"\n")
     docs = []
@@ -344,71 +383,211 @@ def _scan_lines(path: str):
             if torn_tail and lineno == len(lines):
                 torn = True
                 continue
+            if salvage:
+                return docs, torn, True
             raise JournalError(
                 f"{path}:{lineno}: unparseable journal record") from None
         rec = doc.get("rec")
         if rec not in REC_TYPES or not isinstance(doc.get("ticket"), str):
+            if salvage:
+                return docs, torn, True
             raise JournalError(
                 f"{path}:{lineno}: malformed journal record {doc!r}")
         docs.append(doc)
-    return docs, torn
+    return docs, torn, False
 
 
-def scan_journal(path: str) -> JournalState:
+class _Folder:
+    """The one fold: WAL docs then results docs into per-ticket state.
+    :func:`scan_journal` runs it over one namespace; :func:`scan_fleet`
+    runs ALL namespaces' WALs through it first (sorted namespace order,
+    file order within), then all results — so a ticket admitted in one
+    incarnation's namespace and delivered in a later one (the replay
+    path journals its terminal record into the CURRENT journal) still
+    folds to completed."""
+
+    def __init__(self):
+        self.state = JournalState()
+        self.by_id: dict[str, JournalTicket] = {}
+        self.admitted_in: dict[str, str] = {}   # ticket -> namespace
+
+    def add_wal(self, docs, namespace: str = "") -> None:
+        state = self.state
+        for doc in docs:
+            rec, ticket = doc["rec"], doc["ticket"]
+            state.records += 1
+            m = _TICKET_RE.match(ticket)
+            if m is not None:
+                state.high_water = max(state.high_water,
+                                       int(m.group(2), 16))
+            ent = self.by_id.get(ticket)
+            if ent is None:
+                ent = self.by_id[ticket] = JournalTicket(ticket=ticket)
+                state.tickets.append(ent)
+            if rec == "admitted":
+                # dedup by ticket id: the first admit wins (a replayed
+                # ticket is never re-admitted, so a second admit for the
+                # same id would be a writer bug, not a crash artifact)
+                if ent.payload is None:
+                    ent.tenant = str(doc.get("tenant", "anon"))
+                    ent.priority = int(doc.get("priority", 0))
+                    ent.payload = doc.get("payload")
+                    self.admitted_in.setdefault(ticket, namespace)
+                    # trace fields are absent unless the submit carried
+                    # a traceparent (byte-identity: untraced journals
+                    # are unchanged)
+                    if doc.get("trace") is not None:
+                        ent.trace = str(doc["trace"])
+                    if doc.get("trace_parent") is not None:
+                        ent.trace_parent = str(doc["trace_parent"])
+            elif rec == "seated":
+                ent.seated = True
+            elif rec == "aborted":
+                ent.aborted = True
+
+    def add_results(self, docs) -> None:
+        state = self.state
+        for doc in docs:
+            rec, ticket = doc["rec"], doc["ticket"]
+            ent = self.by_id.get(ticket)
+            if ent is None:
+                # a results record can outrun its WAL fsync (the
+                # worker's first attempt races the seated commit); a
+                # ticket absent from the WAL was never acked, so its
+                # breadcrumbs drop
+                continue
+            state.records += 1
+            if rec == "attempt":
+                ent.attempts.append(
+                    {k: doc[k] for k in ("k", "status", "supersteps")
+                     if k in doc})
+            elif rec in ("delivered", "failed"):
+                # the LAST terminal record wins: a replay after a crash
+                # inside the delivered-flush window re-runs and
+                # re-delivers
+                ent.result_doc = doc.get("result") or {}
+
+
+def scan_journal(path: str, salvage: bool = False) -> JournalState:
     """Fold a journal (the WAL at ``path`` plus its sibling results
     log) into :class:`JournalState`. A missing file is an empty state;
     a torn trailing line in either file is dropped (the crash landed
     mid-write — nothing acked depended on it)."""
-    state = JournalState()
-    wal_docs, wal_torn = _scan_lines(path)
-    res_docs, res_torn = _scan_lines(
-        os.path.join(os.path.dirname(path), RESULTS_FILE))
-    state.torn = wal_torn or res_torn
-    by_id: dict[str, JournalTicket] = {}
-    for doc in wal_docs:
-        rec, ticket = doc["rec"], doc["ticket"]
-        state.records += 1
-        m = _TICKET_RE.match(ticket)
-        if m is not None:
-            state.high_water = max(state.high_water, int(m.group(1), 16))
-        ent = by_id.get(ticket)
-        if ent is None:
-            ent = by_id[ticket] = JournalTicket(ticket=ticket)
-            state.tickets.append(ent)
-        if rec == "admitted":
-            # dedup by ticket id: the first admit wins (a replayed
-            # ticket is never re-admitted, so a second admit for the
-            # same id would be a writer bug, not a crash artifact)
-            if ent.payload is None:
-                ent.tenant = str(doc.get("tenant", "anon"))
-                ent.priority = int(doc.get("priority", 0))
-                ent.payload = doc.get("payload")
-                # trace fields are absent unless the submit carried a
-                # traceparent (byte-identity: untraced journals are
-                # unchanged)
-                if doc.get("trace") is not None:
-                    ent.trace = str(doc["trace"])
-                if doc.get("trace_parent") is not None:
-                    ent.trace_parent = str(doc["trace_parent"])
-        elif rec == "seated":
-            ent.seated = True
-        elif rec == "aborted":
-            ent.aborted = True
-    for doc in res_docs:
-        rec, ticket = doc["rec"], doc["ticket"]
-        ent = by_id.get(ticket)
-        if ent is None:
-            # a results record can outrun its WAL fsync (the worker's
-            # first attempt races the seated commit); a ticket absent
-            # from the WAL was never acked, so its breadcrumbs drop
+    wal_docs, wal_torn, wal_bad = _scan_lines(path, salvage)
+    res_docs, res_torn, res_bad = _scan_lines(
+        os.path.join(os.path.dirname(path), RESULTS_FILE), salvage)
+    folder = _Folder()
+    folder.add_wal(wal_docs)
+    folder.add_results(res_docs)
+    folder.state.torn = wal_torn or res_torn
+    folder.state.corrupt = wal_bad or res_bad
+    return folder.state
+
+
+# -- fleet namespaces ------------------------------------------------------
+
+def namespace_name(replica: str, incarnation: int) -> str:
+    """``--journal-dir`` subdirectory of one replica incarnation."""
+    return f"{replica}-{int(incarnation):03d}"
+
+
+def split_namespace(name: str):
+    """``(replica, incarnation)`` of a namespace directory name, or
+    ``None`` when it is not one (("", 0) names the bare root)."""
+    if name == "":
+        return ("", 0)
+    m = NAMESPACE_RE.match(name)
+    if m is None:
+        return None
+    return (m.group(1), int(m.group(2)))
+
+
+def parse_ticket(ticket: str):
+    """``(replica | None, ordinal)`` of a ticket id, or ``None`` when
+    the id is not journal-minted (foreign/garbage ids never match)."""
+    m = _TICKET_RE.match(ticket)
+    if m is None:
+        return None
+    return (m.group(1), int(m.group(2), 16))
+
+
+def list_namespaces(journal_dir: str) -> list:
+    """Namespace names under a fleet ``--journal-dir``, sorted by
+    (replica, incarnation) so the fold order is deterministic. The bare
+    root journal (a pre-fleet single listener's) lists as ``""`` first;
+    directories that merely look the part but hold no journal files are
+    skipped."""
+    names = []
+    if os.path.exists(os.path.join(journal_dir, JOURNAL_FILE)) or \
+            os.path.exists(os.path.join(journal_dir, RESULTS_FILE)):
+        names.append("")
+    try:
+        entries = sorted(os.listdir(journal_dir))
+    except FileNotFoundError:
+        return names
+    for entry in entries:
+        key = split_namespace(entry)
+        if key is None or entry == "":
             continue
-        state.records += 1
-        if rec == "attempt":
-            ent.attempts.append(
-                {k: doc[k] for k in ("k", "status", "supersteps")
-                 if k in doc})
-        elif rec in ("delivered", "failed"):
-            # the LAST terminal record wins: a replay after a crash
-            # inside the delivered-flush window re-runs and re-delivers
-            ent.result_doc = doc.get("result") or {}
-    return state
+        sub = os.path.join(journal_dir, entry)
+        if os.path.isdir(sub) and (
+                os.path.exists(os.path.join(sub, JOURNAL_FILE))
+                or os.path.exists(os.path.join(sub, RESULTS_FILE))):
+            names.append(entry)
+    names.sort(key=lambda n: (split_namespace(n)[0],
+                              split_namespace(n)[1]))
+    return names
+
+
+@dataclass
+class FleetScan:
+    """Every namespace under a fleet ``--journal-dir`` folded into ONE
+    merged :class:`JournalState` (``state``), plus the per-namespace
+    scan facts recovery reports and the first-admit namespace of every
+    ticket (``admitted_in``) — the exactly-once ownership key: the
+    replica whose recover set contains a ticket's admit namespace is
+    the ONLY one that replays it."""
+
+    state: JournalState = field(default_factory=JournalState)
+    namespaces: list = field(default_factory=list)
+    per_namespace: dict = field(default_factory=dict)
+    admitted_in: dict = field(default_factory=dict)
+
+
+def scan_fleet(journal_dir: str) -> FleetScan:
+    """Merge-scan every namespace under ``journal_dir`` (always in
+    salvage mode: a corrupt namespace contributes its clean prefix and
+    is flagged, never aborts the other N−1). All WALs fold before any
+    results log so cross-incarnation delivery — admitted in
+    ``r0-000``, delivered by the replay in ``r0-001`` — lands
+    completed."""
+    scan = FleetScan()
+    scan.namespaces = list_namespaces(journal_dir)
+    folder = _Folder()
+    per_res: list = []
+    for ns in scan.namespaces:
+        base = os.path.join(journal_dir, ns) if ns else journal_dir
+        wal_docs, wal_torn, wal_bad = _scan_lines(
+            os.path.join(base, JOURNAL_FILE), salvage=True)
+        res_docs, res_torn, res_bad = _scan_lines(
+            os.path.join(base, RESULTS_FILE), salvage=True)
+        folder.add_wal(wal_docs, namespace=ns)
+        ns_hw = -1
+        for doc in wal_docs:
+            m = _TICKET_RE.match(doc["ticket"])
+            if m is not None:
+                ns_hw = max(ns_hw, int(m.group(2), 16))
+        scan.per_namespace[ns] = {
+            "wal_records": len(wal_docs),
+            "torn": wal_torn or res_torn,
+            "corrupt": wal_bad or res_bad,
+            "high_water": ns_hw}
+        per_res.append(res_docs)
+    for res_docs in per_res:
+        folder.add_results(res_docs)
+    scan.state = folder.state
+    scan.state.torn = any(d["torn"] for d in scan.per_namespace.values())
+    scan.state.corrupt = any(
+        d["corrupt"] for d in scan.per_namespace.values())
+    scan.admitted_in = folder.admitted_in
+    return scan

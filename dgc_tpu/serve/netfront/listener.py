@@ -75,7 +75,8 @@ from dgc_tpu.obs.usage import UsageMeter, payload_vertices
 from dgc_tpu.resilience.faults import fault_point
 from dgc_tpu.serve.netfront.admission import (AdmissionController,
                                               AdmissionReject)
-from dgc_tpu.serve.netfront.journal import TicketJournal, scan_journal
+from dgc_tpu.serve.netfront.journal import (TicketJournal, parse_ticket,
+                                            scan_fleet, scan_journal)
 from dgc_tpu.serve.queue import QueueFull, ServeError, ServeResult
 
 TENANT_HEADER = "X-Dgc-Tenant"
@@ -172,8 +173,26 @@ class NetFront:
                  journal_dir: str | None = None,
                  replay_timeout: float = 60.0,
                  usage: UsageMeter | None = None,
-                 timeseries=None):
+                 timeseries=None,
+                 replica: str | None = None,
+                 fleet_dir: str | None = None,
+                 recover_namespaces=None,
+                 reuse_port: bool = False,
+                 brownout=None):
         self.front = front
+        # fleet mode (all default-off — the single listener stays
+        # byte-identical): ``replica`` prefixes minted ticket ids,
+        # ``fleet_dir`` is the ROOT --journal-dir whose namespaces
+        # recovery merge-scans and polls read through, and
+        # ``recover_namespaces`` is the subset of namespaces whose
+        # in-flight tickets THIS replica replays (the supervisor
+        # partitions namespaces so each is owned exactly once)
+        self.replica = replica
+        self.fleet_dir = fleet_dir
+        self.recover_namespaces = tuple(recover_namespaces or ())
+        # burn-driven brownout (admission.BrownoutController): consulted
+        # per submit; None = no shedding, byte-identical
+        self.brownout = brownout
         self.admission = admission if admission is not None \
             else AdmissionController(registry=registry, logger=logger)
         self.registry = registry
@@ -187,7 +206,9 @@ class NetFront:
         # durable ticket journal (module docstring): None = the PR 12
         # in-memory-only behavior, byte-identical with the flag unset
         self.journal = journal if journal is not None else (
-            TicketJournal(journal_dir) if journal_dir is not None else None)
+            TicketJournal(journal_dir,
+                          flush_results=(fleet_dir is not None))
+            if journal_dir is not None else None)
         self.replay_timeout = float(replay_timeout)
         self._recovered = False       # guarded-by: owner (start())
         self._lock = threading.Lock()
@@ -201,7 +222,10 @@ class NetFront:
         self.drained = threading.Event()
         self.result_capacity = int(result_capacity)
         # one listener, application + observability routes together
-        self.server = RoutingHTTPServer(port=port, host=host)
+        # (reuse_port: N fleet replicas bind the SAME port and the
+        # kernel load-balances accepts across them)
+        self.server = RoutingHTTPServer(port=port, host=host,
+                                        reuse_port=reuse_port)
         mount_observability(self.server, registry=registry,
                             health_fn=self._health_doc, recorder=recorder,
                             profiler=profiler, flightrec_dir=flightrec_dir,
@@ -244,6 +268,10 @@ class NetFront:
         with self._lock:
             doc["draining"] = self._draining
         doc["tenants"] = self.admission.snapshot()
+        if self.replica is not None:
+            doc["replica"] = self.replica
+        if self.brownout is not None:
+            doc["brownout"] = self.brownout.snapshot()
         return doc
 
     # -- request parsing ------------------------------------------------
@@ -287,6 +315,16 @@ class NetFront:
             return json_response(
                 {"error": "draining", "reason": "draining",
                  "tenant": tenant}, status=503)
+        if self.brownout is not None:
+            # burn-driven load shedding: under sustained slo_burn the
+            # lowest tiers 503 (structured, Retry-After) BEFORE the
+            # body is even parsed — overload sheds cheaply
+            shed = self.brownout.check(tenant,
+                                       self.admission.config_for(tenant))
+            if shed is not None:
+                fields = shed.to_fields()
+                self._event("net_reject", **fields)
+                return self._reject_response(fields)
         try:
             doc = req.json()
             if not isinstance(doc, dict):
@@ -308,8 +346,14 @@ class NetFront:
         # (absent/malformed headers change nothing — the unheadered
         # request path stays byte-identical with PR 15)
         tp = parse_traceparent(req.headers.get(TRACEPARENT_HEADER))
+        # fleet ids carry the replica prefix (``r0-t00000007``) so two
+        # replicas over one --journal-dir can NEVER mint the same id —
+        # the per-journal high-water resume alone could not guarantee
+        # that across processes. Unprefixed single-listener ids are
+        # byte-identical to before.
+        prefix = f"{self.replica}-" if self.replica is not None else ""
         with self._lock:
-            ticket_id = f"t{self._next_ticket:08x}"
+            ticket_id = f"{prefix}t{self._next_ticket:08x}"
             self._next_ticket += 1
         net_ticket = _NetTicket(ticket_id, tenant, priority,
                                 trace=(tp[0] if tp is not None else None),
@@ -444,8 +488,11 @@ class NetFront:
             # Retry-After is integer seconds; never advertise 0 (a
             # client busy-loop), always at least 1
             headers = (("Retry-After", max(1, int(round(retry)))),)
+        # brownout is server overload, not client misbehavior: 503 so
+        # well-behaved clients back off globally instead of per-tenant
+        status = 503 if fields.get("reason") == "brownout" else 429
         return json_response(dict(fields, error=fields["reason"]),
-                             status=429, headers=headers)
+                             status=status, headers=headers)
 
     # -- completion (worker thread) --------------------------------------
     def _on_done(self, net_ticket: _NetTicket, result) -> None:
@@ -493,8 +540,47 @@ class NetFront:
         with self._lock:
             return ticket_id, self._tickets.get(ticket_id)
 
+    def _foreign_lookup(self, ticket_id: str):
+        """Fleet read-through for a ticket this replica does not hold:
+        merge-scan the fleet namespaces and answer from the journals.
+        Returns ``("done", net_ticket)`` (terminal found — cached into
+        the table so repeat polls skip the rescan), ``("pending", n)``
+        (admitted fleet-wide, n attempts so far, not yet terminal —
+        rescanned per poll; the owning replica holds the live state),
+        or ``("miss", None)``. SO_REUSEPORT round-robins a client's
+        polls across replicas, so this is the path that makes every
+        completed ticket pollable from ANY replica."""
+        if self.fleet_dir is None or parse_ticket(ticket_id) is None:
+            return ("miss", None)
+        try:
+            scan = scan_fleet(self.fleet_dir)
+        except Exception:
+            return ("miss", None)
+        ent = next((t for t in scan.state.tickets
+                    if t.ticket == ticket_id), None)
+        if ent is None or ent.aborted:
+            return ("miss", None)
+        if not ent.completed:
+            return ("pending", len(ent.attempts))
+        net_ticket = _NetTicket(ent.ticket, ent.tenant, ent.priority,
+                                trace=ent.trace)
+        with net_ticket.cond:
+            net_ticket.attempts = list(ent.attempts)
+            net_ticket.result = self._recovered_result(ent.ticket,
+                                                       ent.result_doc)
+        # cache WITHOUT usage metering — the owning replica metered it
+        self._restore_completed(ticket_id, net_ticket)
+        return ("done", net_ticket)
+
     def _get_result(self, req: Request):
         ticket_id, net_ticket = self._ticket_for(req, "/v1/result/")
+        if net_ticket is None and self.fleet_dir is not None:
+            kind, found = self._foreign_lookup(ticket_id)
+            if kind == "pending":
+                return json_response(
+                    {"ticket": ticket_id, "status": "pending",
+                     "attempts": int(found)}, status=202)
+            net_ticket = found
         if net_ticket is None:
             return json_response(
                 {"error": f"unknown or expired ticket {ticket_id!r}"},
@@ -514,6 +600,15 @@ class NetFront:
     # -- GET /v1/stream/<id> ---------------------------------------------
     def _get_stream(self, req: Request):
         ticket_id, net_ticket = self._ticket_for(req, "/v1/stream/")
+        if net_ticket is None and self.fleet_dir is not None:
+            kind, found = self._foreign_lookup(ticket_id)
+            if kind == "pending":
+                # a foreign in-flight ticket cannot feed attempts live
+                # from this replica; degrade to a poll hint
+                return json_response(
+                    {"ticket": ticket_id, "status": "pending",
+                     "attempts": int(found)}, status=202)
+            net_ticket = found
         if net_ticket is None:
             return json_response(
                 {"error": f"unknown or expired ticket {ticket_id!r}"},
@@ -619,14 +714,39 @@ class NetFront:
         resumed past the high-water mark. Runs on the owner thread
         before the listener socket opens."""
         t0 = time.perf_counter()
-        state = scan_journal(self.journal.path)
+        if self.fleet_dir is not None:
+            # fleet recovery: merge-scan EVERY namespace under the root
+            # --journal-dir. Completed tickets restore into THIS
+            # replica's table too (pollable from any replica without a
+            # read-through rescan); in-flight tickets replay only when
+            # their first-admit namespace is in this replica's recover
+            # set — the supervisor partitions namespaces across the
+            # fleet, so each in-flight ticket replays exactly once.
+            fleet = scan_fleet(self.fleet_dir)
+            state = fleet.state
+            owned = set(self.recover_namespaces)
+            admitted_in = fleet.admitted_in
+        else:
+            fleet = None
+            state = scan_journal(self.journal.path)
+            owned = None
+            admitted_in = {}
         with self._lock:
+            # the counter resumes past the high water of EVERY scanned
+            # namespace, not just this replica's own (the S1 collision
+            # fix is belt — the replica id prefix — AND braces)
             self._next_ticket = max(self._next_ticket,
                                     state.high_water + 1)
-        restored = replayed = failed = 0
+        restored = replayed = failed = foreign = 0
         for ent in state.tickets:
             if ent.aborted:
                 continue   # never acked — nothing was promised
+            if not ent.completed and owned is not None \
+                    and admitted_in.get(ent.ticket) not in owned:
+                # a sibling replica owns this in-flight ticket's
+                # namespace and replays it; polls here read through
+                foreign += 1
+                continue
             net_ticket = _NetTicket(ent.ticket, ent.tenant, ent.priority,
                                     trace=ent.trace)
             # bind the original trace (journaled W3C id or the stable
@@ -697,8 +817,11 @@ class NetFront:
                 "dgc_net_recovered_total",
                 "tickets recovered from the journal on startup",
                 action="replayed").inc(replayed)
+        fleet_fields = {} if fleet is None else {
+            "namespaces": len(fleet.namespaces), "foreign": foreign}
         self._event("net_recover", action="summary",
                     records=state.records, restored=restored,
                     replayed=replayed, failed=failed,
                     high_water=state.high_water,
-                    wall_s=round(time.perf_counter() - t0, 4))
+                    wall_s=round(time.perf_counter() - t0, 4),
+                    **fleet_fields)

@@ -1,0 +1,566 @@
+"""Replicated serve fleet tests (dgc_tpu.serve.fleet + the fleet paths
+in serve.netfront): replica-prefixed ticket ids (the two-replica
+same-journal-dir collision regression), the cross-incarnation fleet
+merge scan (torn tails, overlapping in-flight, corrupt namespaces,
+usage conservation over the merged WALs), supervisor namespace
+partitioning / incarnation numbering, the burn-driven
+``BrownoutController`` (hysteresis, tier-ordered shedding, the 503
+surface), and the supervisor argv plumbing. A ``slow``-marked
+subprocess test proves the cold fleet restart end to end; the fast
+in-process tests cover the same merge semantics without process spawns.
+"""
+
+import json
+import os
+import time
+
+import numpy as np
+import pytest
+
+from dgc_tpu.obs import MetricsRegistry, RunLogger
+from dgc_tpu.obs.timeseries import BurnRateEvaluator, TimeseriesSampler
+from dgc_tpu.obs.usage import conservation_problems, fold_journal
+from dgc_tpu.serve.fleet import (_set_flag, _strip_flag, assign_namespaces,
+                                 next_incarnation)
+from dgc_tpu.serve.netfront import (AdmissionController, BrownoutController,
+                                    NetFront, TicketJournal, list_namespaces,
+                                    load_tenant_configs, namespace_name,
+                                    parse_ticket, scan_fleet)
+from dgc_tpu.serve.netfront.journal import (JOURNAL_FILE, split_namespace)
+from dgc_tpu.serve.queue import ServeFrontEnd, ServeResult
+from tools.validate_runlog import validate_file
+
+pytestmark = pytest.mark.serve
+
+
+# -- no-jax front end (the test_journal pattern) ------------------------
+
+class _FakeAttempt:
+    class _Status:
+        name = "SUCCESS"
+
+    def __init__(self, k):
+        self.k = int(k)
+        self.status = self._Status()
+        self.supersteps = 5
+
+
+class _InstantFront(ServeFrontEnd):
+    """``_serve_one`` fabricates a deterministic result keyed off the
+    graph's vertex count — fleet replays must reproduce it."""
+
+    def _serve_one(self, req):
+        t0 = time.perf_counter()
+        if req.on_attempt is not None:
+            try:
+                req.on_attempt(_FakeAttempt(3), None)
+            except Exception:
+                pass
+        v = int(req.arrays.num_vertices)
+        return ServeResult(
+            request_id=req.request_id, status="ok",
+            colors=np.arange(v, dtype=np.int32) % 3, minimal_colors=3,
+            attempts=[(3, "SUCCESS", 5)], queue_s=t0 - req.t_submit,
+            service_s=time.perf_counter() - t0,
+            batched=False, shape_class=None)
+
+
+def _post(port, path, doc, tenant=None):
+    import urllib.error
+    import urllib.request
+
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json",
+                 **({"X-Dgc-Tenant": tenant} if tenant else {})},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read()), dict(r.headers)
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else {}), dict(e.headers)
+
+
+def _get(port, path):
+    import urllib.error
+    import urllib.request
+
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else {})
+
+
+def _poll(port, ticket, timeout=30.0):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        st, doc = _get(port, f"/v1/result/{ticket}?colors=1")
+        if st != 202:
+            return st, doc
+        time.sleep(0.01)
+    raise TimeoutError(f"ticket {ticket} never terminal")
+
+
+def _replica_stack(journal_root, replica, incarnation, recover=(),
+                   logger=None):
+    ns = namespace_name(replica, incarnation)
+    front = _InstantFront(batch_max=2, workers=2, queue_depth=32,
+                          window_s=0.0, logger=logger).start()
+    nf = NetFront(front, logger=logger,
+                  journal_dir=os.path.join(str(journal_root), ns),
+                  replica=replica, fleet_dir=str(journal_root),
+                  recover_namespaces=recover).start()
+    return front, nf
+
+
+_SPEC = {"node_count": 24, "max_degree": 3, "seed": 5,
+         "gen_method": "fast"}
+
+
+# -- namespace / ticket-id helpers --------------------------------------
+
+def test_namespace_helpers_round_trip():
+    assert namespace_name("r0", 0) == "r0-000"
+    assert namespace_name("r12", 41) == "r12-041"
+    assert split_namespace("r12-041") == ("r12", 41)
+    assert split_namespace("") == ("", 0)
+    assert parse_ticket("t0000002a") == (None, 0x2A)
+    assert parse_ticket("r3-t0000002a") == ("r3", 0x2A)
+    assert parse_ticket("bogus") is None
+
+
+def test_assign_namespaces_partition_and_shrink():
+    existing = ["", "r0-000", "r1-000", "r2-000", "r2-001", "r3-000"]
+    owned = assign_namespaces(existing, 2)
+    # rJ-* -> replica J % N; the bare pre-fleet root journal -> r0
+    assert owned[0] == ["", "r0-000", "r2-000", "r2-001"]
+    assert owned[1] == ["r1-000", "r3-000"]
+    # every replica index appears even when empty
+    assert assign_namespaces([], 3) == {0: [], 1: [], 2: []}
+
+
+def test_next_incarnation_skips_used_numbers():
+    existing = ["", "r0-000", "r0-002", "r1-000"]
+    assert next_incarnation(existing, 0) == 3
+    assert next_incarnation(existing, 1) == 1
+    assert next_incarnation(existing, 2) == 0
+
+
+def test_argv_flag_plumbing():
+    argv = ["--listen", "0", "--replicas", "3", "--journal-dir", "j"]
+    out = _strip_flag(argv, "--replicas")
+    assert "--replicas" not in out and "3" not in out
+    assert _strip_flag(["--replicas=3", "--listen", "0"], "--replicas") \
+        == ["--listen", "0"]
+    assert _set_flag(["--listen", "0"], "--listen", "8080") \
+        == ["--listen", "8080"]
+
+
+# -- S1 regression: two replicas over ONE journal dir -------------------
+
+def test_two_replicas_one_journal_dir_no_ticket_collision(tmp_path):
+    """The fleet id-collision fix: two replicas sharing --journal-dir
+    mint replica-prefixed, fleet-unique ids; a restart of one replica
+    resumes past ITS namespaces' high water, never colliding with the
+    sibling's ids."""
+    fa, na = _replica_stack(tmp_path, "r0", 0)
+    fb, nb = _replica_stack(tmp_path, "r1", 0)
+    try:
+        tickets = []
+        for port in (na.port, nb.port, na.port, nb.port):
+            st, doc, _hdr = _post(port, "/v1/color", dict(_SPEC))
+            assert st == 202
+            tickets.append(doc["ticket"])
+        assert len(set(tickets)) == 4
+        assert {parse_ticket(t)[0] for t in tickets} == {"r0", "r1"}
+        for t in tickets:
+            st, doc = _poll(na.port if t.startswith("r0") else nb.port, t)
+            assert st == 200 and doc["status"] == "ok"
+    finally:
+        na.close()
+        fa.shutdown()
+        nb.close()
+        fb.shutdown()
+
+    # restart r0 under a fresh incarnation recovering its own namespace
+    fa2, na2 = _replica_stack(tmp_path, "r0", 1, recover=("r0-000",))
+    try:
+        st, doc, _hdr = _post(na2.port, "/v1/color", dict(_SPEC))
+        assert st == 202
+        fresh = doc["ticket"]
+        assert fresh not in tickets
+        # counter resumed PAST the merged high water, prefixed r0
+        assert parse_ticket(fresh)[0] == "r0"
+        prior = max(parse_ticket(t)[1] for t in tickets)
+        assert parse_ticket(fresh)[1] > prior
+        _poll(na2.port, fresh)
+    finally:
+        na2.close()
+        fa2.shutdown()
+    scan = scan_fleet(str(tmp_path))
+    ids = [t.ticket for t in scan.state.tickets]
+    assert len(ids) == len(set(ids)) == 5
+
+
+# -- S3: fleet journal merge scan ---------------------------------------
+
+def _write_ns(root, ns, tickets, terminal=True, torn=False,
+              corrupt_line=None):
+    """Hand-build one namespace: ``tickets`` admitted+seated, terminal
+    delivered records when asked, an optional torn WAL tail / corrupt
+    mid-file line."""
+    d = os.path.join(str(root), ns)
+    j = TicketJournal(d, flush_results=True)
+    for t in tickets:
+        j.append("admitted", t, tenant="acme", priority=0,
+                 payload=dict(_SPEC))
+        j.append("seated", t)
+        if terminal:
+            j.append("delivered", t, durable=False,
+                     result={"status": "ok", "minimal_colors": 3,
+                             "colors": [0, 1, 2], "attempts": 1})
+    j.close()
+    wal = os.path.join(d, JOURNAL_FILE)
+    if torn:
+        with open(wal, "a") as fh:
+            fh.write('{"rec": "admitted", "tick')   # mid-record cut
+    if corrupt_line is not None:
+        lines = open(wal).read().splitlines(keepends=True)
+        lines.insert(corrupt_line, "NOT JSON AT ALL\n")
+        with open(wal, "w") as fh:
+            fh.writelines(lines)
+    return d
+
+
+def test_scan_fleet_merges_all_namespaces(tmp_path):
+    _write_ns(tmp_path, "r0-000", ["r0-t00000000", "r0-t00000001"])
+    _write_ns(tmp_path, "r1-000", ["r1-t00000000"], terminal=False)
+    _write_ns(tmp_path, "r0-001", ["r0-t00000005"], terminal=False)
+    os.makedirs(tmp_path / "r2-000")               # journal-less: skipped
+    scan = scan_fleet(str(tmp_path))
+    assert list(scan.namespaces) == ["r0-000", "r0-001", "r1-000"]
+    by_id = {t.ticket: t for t in scan.state.tickets}
+    assert sorted(by_id) == ["r0-t00000000", "r0-t00000001",
+                             "r0-t00000005", "r1-t00000000"]
+    assert by_id["r0-t00000001"].completed
+    assert not by_id["r1-t00000000"].completed
+    # exactly-once bookkeeping: first-admit namespace per ticket
+    assert scan.admitted_in["r0-t00000005"] == "r0-001"
+    assert scan.admitted_in["r1-t00000000"] == "r1-000"
+    # merged high water covers every namespace's ordinals
+    assert scan.state.high_water == 5
+
+
+def test_scan_fleet_tolerates_torn_and_corrupt_namespaces(tmp_path):
+    _write_ns(tmp_path, "r0-000", ["r0-t00000000"])
+    _write_ns(tmp_path, "r1-000", ["r1-t00000000", "r1-t00000001"],
+              torn=True)
+    # corruption AFTER the first ticket's records: the clean prefix
+    # (ticket 0) survives, the rest of that namespace is ignored
+    _write_ns(tmp_path, "r2-000", ["r2-t00000000", "r2-t00000001"],
+              terminal=False, corrupt_line=2)
+    scan = scan_fleet(str(tmp_path))
+    assert scan.per_namespace["r1-000"]["torn"] is True
+    assert scan.per_namespace["r2-000"]["corrupt"] is True
+    ids = {t.ticket for t in scan.state.tickets}
+    assert "r0-t00000000" in ids and "r1-t00000001" in ids
+    assert "r2-t00000000" in ids and "r2-t00000001" not in ids
+    # the corrupt namespace never poisons its siblings
+    assert scan.per_namespace["r0-000"]["corrupt"] is False
+
+
+def test_scan_fleet_cross_incarnation_completion(tmp_path):
+    """A ticket admitted by r0-000 whose replay DELIVERED in r0-001
+    folds to completed: every WAL is folded before ANY results log."""
+    _write_ns(tmp_path, "r0-000", ["r0-t00000000"], terminal=False)
+    d1 = os.path.join(str(tmp_path), "r0-001")
+    j = TicketJournal(d1, flush_results=True)
+    j.append("delivered", "r0-t00000000", durable=False,
+             result={"status": "ok", "minimal_colors": 3,
+                     "colors": [0, 1, 2], "attempts": 1})
+    j.close()
+    scan = scan_fleet(str(tmp_path))
+    by_id = {t.ticket: t for t in scan.state.tickets}
+    assert by_id["r0-t00000000"].completed
+    assert scan.admitted_in["r0-t00000000"] == "r0-000"
+
+
+def test_fleet_usage_conservation_over_merged_wals(tmp_path):
+    """PR 16's conservation checker holds over the fleet merge: folding
+    the namespace WAL list equals the per-tenant journal totals."""
+    _write_ns(tmp_path, "r0-000", ["r0-t00000000", "r0-t00000001"])
+    _write_ns(tmp_path, "r1-000", ["r1-t00000000"])
+    wals = [os.path.join(str(tmp_path), ns, JOURNAL_FILE)
+            for ns in list_namespaces(str(tmp_path))]
+    rows = fold_journal(wals)
+    assert conservation_problems(rows, wals) == []
+    assert [r["tenant"] for r in rows] == ["acme"]
+    assert rows[0]["admitted"] == 3 and rows[0]["delivered"] == 3
+    assert rows[0]["in_flight"] == 0
+
+
+# -- fleet recovery: exactly-once replay, read-through ------------------
+
+def test_fleet_recovery_partition_replays_exactly_once(tmp_path):
+    """Two in-flight namespaces, two recovering replicas with disjoint
+    recover partitions: each in-flight ticket replays on exactly one
+    replica; completed tickets are pollable from BOTH."""
+    _write_ns(tmp_path, "r0-000", ["r0-t00000000"], terminal=False)
+    _write_ns(tmp_path, "r1-000", ["r1-t00000000"], terminal=False)
+    _write_ns(tmp_path, "r1-001", ["r1-t00000005"])   # completed history
+    log0 = tmp_path / "r0.jsonl"
+    log1 = tmp_path / "r1.jsonl"
+    lg0 = RunLogger(jsonl_path=str(log0), echo=False)
+    lg1 = RunLogger(jsonl_path=str(log1), echo=False)
+    f0, n0 = _replica_stack(tmp_path, "r0", 1, recover=("r0-000",),
+                            logger=lg0)
+    f1, n1 = _replica_stack(tmp_path, "r1", 2,
+                            recover=("r1-000", "r1-001"), logger=lg1)
+    try:
+        for port in (n0.port, n1.port):
+            for t in ("r0-t00000000", "r1-t00000000", "r1-t00000005"):
+                st, doc = _poll(port, t)
+                assert st == 200, (port, t, doc)
+                assert doc["status"] == "ok"
+    finally:
+        n0.close()
+        f0.shutdown()
+        n1.close()
+        f1.shutdown()
+        lg0.close()
+        lg1.close()
+    assert validate_file(str(log0)) == []
+    assert validate_file(str(log1)) == []
+
+    def replayed(path):
+        return [r["ticket"] for r in map(json.loads, open(path))
+                if r.get("event") == "net_recover"
+                and r.get("action") == "replayed"]
+
+    # the partition: each in-flight ticket replayed by exactly one
+    # replica, fleet-wide
+    r0_replays, r1_replays = replayed(log0), replayed(log1)
+    assert r0_replays == ["r0-t00000000"]
+    assert r1_replays == ["r1-t00000000"]
+    # the non-owner saw the foreign in-flight ticket and skipped it
+    summaries = [r for r in map(json.loads, open(log0))
+                 if r.get("event") == "net_recover"
+                 and r.get("action") == "summary"]
+    # 4 namespaces in the scan: the three with history PLUS r0's own
+    # fresh incarnation dir (created before recovery runs)
+    assert summaries and summaries[0]["namespaces"] == 4
+    assert summaries[0]["foreign"] == 1
+
+
+def test_fleet_read_through_pending_poll(tmp_path):
+    """A ticket this replica does not hold but a sibling admitted polls
+    202 pending (not 404) through the fleet scan."""
+    _write_ns(tmp_path, "r1-000", ["r1-t00000000"], terminal=False)
+    f0, n0 = _replica_stack(tmp_path, "r0", 0)
+    try:
+        st, doc = _get(n0.port, "/v1/result/r1-t00000000")
+        assert st == 202 and doc["status"] == "pending"
+        # a ticket NO namespace admitted is still a 404
+        st, _doc = _get(n0.port, "/v1/result/r9-t000000ff")
+        assert st == 404
+    finally:
+        n0.close()
+        f0.shutdown()
+
+
+# -- brownout: hysteresis, tier ordering, 503 surface -------------------
+
+def test_brownout_hysteresis_and_events(tmp_path):
+    log = tmp_path / "brownout.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    registry = MetricsRegistry()
+    bo = BrownoutController(sustain=2, clear=2, logger=logger,
+                            registry=registry)
+    bo.on_evaluate(["failure_rate"])
+    assert bo.level() == 0                      # one burn: not sustained
+    bo.on_evaluate(["failure_rate"])
+    assert bo.level() == 1                      # sustained -> shed
+    bo.on_evaluate([])
+    bo.on_evaluate(["failure_rate"])            # clean run interrupted
+    assert bo.level() == 1
+    bo.on_evaluate([])
+    bo.on_evaluate([])
+    assert bo.level() == 0                      # sustained clean -> restore
+    logger.close()
+    events = [json.loads(ln) for ln in open(log)]
+    acts = [(e["action"], e["level"]) for e in events
+            if e["event"] == "net_brownout"]
+    assert acts == [("shed", 1), ("restore", 0)]
+    assert validate_file(str(log)) == []
+    with pytest.raises(ValueError):
+        BrownoutController(sustain=0)
+
+
+def test_brownout_sheds_lowest_tiers_only():
+    bo = BrownoutController(sustain=1, clear=1, max_level=2)
+    cfgs = load_tenant_configs({"tenants": {
+        "free": {"tier": "free"}, "paid": {"tier": "paid"},
+        "prem": {"tier": "premium"}}})
+    adm = AdmissionController(cfgs)
+    assert bo.check("free", adm.config_for("free")) is None   # level 0
+    bo.on_evaluate(["x"])                                      # -> 1
+    rej = bo.check("free", adm.config_for("free"))
+    assert rej is not None and rej.reason == "brownout"
+    assert rej.to_fields()["tier"] == "free"
+    assert bo.check("paid", adm.config_for("paid")) is None
+    assert bo.check("prem", adm.config_for("prem")) is None
+    bo.on_evaluate(["x"])                                      # -> 2 (max)
+    bo.on_evaluate(["x"])                                      # capped
+    assert bo.level() == 2
+    assert bo.check("paid", adm.config_for("paid")) is not None
+    # premium (priority 2) is never shed at the default max_level
+    assert bo.check("prem", adm.config_for("prem")) is None
+    assert bo.snapshot()["shed"] == 2
+
+
+def test_brownout_503_on_listener(tmp_path):
+    """The wire surface: a shed tier gets a structured 503 +
+    Retry-After; a premium tenant sails through; net_reject carries
+    tier + level."""
+    log = tmp_path / "shed.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    bo = BrownoutController(sustain=1, clear=1, retry_after_s=7.0,
+                            logger=logger)
+    bo.on_evaluate(["failure_rate"])            # force level 1
+    cfgs = load_tenant_configs({"tenants": {
+        "free": {"tier": "free"}, "prem": {"tier": "premium"}}})
+    front = _InstantFront(batch_max=2, workers=2, queue_depth=32,
+                          window_s=0.0).start()
+    nf = NetFront(front, admission=AdmissionController(cfgs),
+                  logger=logger, brownout=bo).start()
+    try:
+        st, doc, hdr = _post(nf.port, "/v1/color", dict(_SPEC),
+                             tenant="free")
+        assert st == 503
+        assert doc["reason"] == "brownout" and doc["level"] == 1
+        assert float(hdr["Retry-After"]) == 7.0
+        st, doc, _hdr = _post(nf.port, "/v1/color", dict(_SPEC),
+                              tenant="prem")
+        assert st == 202
+        _poll(nf.port, doc["ticket"])
+        # /healthz surfaces the brownout block
+        st, health = _get(nf.port, "/healthz")
+        assert health["brownout"]["level"] == 1
+        # burn cleared -> the shed tier is admitted again
+        bo.on_evaluate([])
+        st, doc, _hdr = _post(nf.port, "/v1/color", dict(_SPEC),
+                              tenant="free")
+        assert st == 202
+        _poll(nf.port, doc["ticket"])
+    finally:
+        nf.close()
+        front.shutdown()
+        logger.close()
+    events = [json.loads(ln) for ln in open(log)]
+    rejects = [e for e in events if e.get("event") == "net_reject"
+               and e.get("reason") == "brownout"]
+    assert rejects and rejects[0]["tier"] == "free"
+    assert rejects[0]["level"] == 1
+    assert validate_file(str(log)) == []
+
+
+def test_burn_evaluator_notifies_brownout(tmp_path):
+    """The evaluator->brownout wire: sustained burn escalates through
+    on_evaluate; a clean warmed evaluation (empty burning list) is the
+    clear signal."""
+    registry = MetricsRegistry()
+    sampler = TimeseriesSampler(registry, interval_s=9.0, capacity=16)
+    bo = BrownoutController(sustain=2, clear=2)
+    ev = BurnRateEvaluator(sampler, {"failure_rate_max": 0.1},
+                           fast_window_s=0.1, slow_window_s=0.1,
+                           registry=registry, brownout=bo)
+    ok = registry.counter("dgc_serve_requests_total", "reqs", status="ok")
+    err = registry.counter("dgc_serve_requests_total", "reqs",
+                           status="error")
+    ok.inc()
+    sampler.sample_once()
+    for round_ in range(2):
+        time.sleep(0.06)
+        for _ in range(9):
+            err.inc()
+        ev.evaluate(sampler.sample_once())
+    assert bo.level() == 1                      # 2 burning evaluations
+    # the burn clears: error counter stops moving, ok traffic continues
+    for _ in range(2):
+        time.sleep(0.06)
+        for _ in range(9):
+            ok.inc()
+        ev.evaluate(sampler.sample_once())
+    assert bo.level() == 0
+
+
+# -- cold fleet restart end to end (subprocess; slow) -------------------
+
+@pytest.mark.slow
+def test_cold_fleet_restart_recovers_all_tickets(tmp_path):
+    """Kill-all + cold restart: a 2-replica fleet serves and drains;
+    a SECOND fleet over the same --journal-dir merges every namespace
+    and keeps all prior tickets pollable with identical colors."""
+    import subprocess
+    import sys as _sys
+    import urllib.request
+
+    repo = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+    journal = str(tmp_path / "journal")
+
+    def fleet():
+        return subprocess.Popen(
+            [_sys.executable, "-m", "dgc_tpu.cli", "serve", "--listen",
+             "0", "--replicas", "2", "--journal-dir", journal,
+             "--batch-max", "2", "--window-ms", "0"],
+            cwd=repo, env={**os.environ, "JAX_PLATFORMS": "cpu"},
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+
+    def wait_port():
+        state = os.path.join(journal, "fleet_state.json")
+        deadline = time.time() + 120
+        while time.time() < deadline:
+            try:
+                port = json.load(open(state))["port"]
+                with urllib.request.urlopen(
+                        f"http://127.0.0.1:{port}/healthz", timeout=5):
+                    return port
+            except Exception:
+                time.sleep(0.2)
+        raise TimeoutError("fleet never ready")
+
+    sup = fleet()
+    try:
+        port = wait_port()
+        tickets, colors = [], {}
+        for s in range(4):
+            st, doc, _h = _post(port, "/v1/color",
+                                {"node_count": 150, "max_degree": 5,
+                                 "seed": s, "gen_method": "fast"})
+            assert st == 202
+            tickets.append(doc["ticket"])
+        for t in tickets:
+            st, doc = _poll(port, t, timeout=120)
+            assert st == 200 and doc["status"] == "ok"
+            colors[t] = doc["colors"]
+        assert len(set(tickets)) == 4
+    finally:
+        sup.kill()
+        sup.wait(timeout=30)
+
+    # cold restart: every namespace merges, every ticket still polls
+    # to the SAME colors
+    sup = fleet()
+    try:
+        port = wait_port()
+        for t in tickets:
+            st, doc = _poll(port, t, timeout=120)
+            assert st == 200, (t, st, doc)
+            assert doc["colors"] == colors[t]
+    finally:
+        sup.kill()
+        sup.wait(timeout=30)

@@ -532,3 +532,171 @@ def test_chaos_mesh_serve_schedule_smoke(tmp_path):
     doc = json.load(open(tmp_path / "report.json"))
     assert doc["summary"]["failed"] == 0
     assert doc["schedules"][0]["outcome"] in ("ok", "structured")
+
+
+# ---------------------------------------------------------------------------
+# automatic mesh-restore probe (resilience.probe)
+# ---------------------------------------------------------------------------
+
+class _SchedStub:
+    """Just enough scheduler for the probe: a health plane and a
+    restore hook."""
+
+    def __init__(self, n=4):
+        self.device_health = DeviceHealth(n)
+        self.restores = 0
+
+    def request_restore(self):
+        self.restores += 1
+
+
+def test_probe_backoff_walk_then_restore(tmp_path):
+    """degrade -> probe-fail -> exponential backoff -> probe-ok ->
+    mark_healthy -> request_restore, on a fake clock (no sleeping)."""
+    from dgc_tpu.obs import MetricsRegistry, RunLogger
+    from dgc_tpu.resilience.probe import HealthProbe
+
+    log = tmp_path / "probe.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    registry = MetricsRegistry()
+    sched = _SchedStub(4)
+    sched.device_health.mark_lost(2)
+    clock = [0.0]
+    verdicts = [False, False, True]
+    probe = HealthProbe(sched, interval_s=1.0, backoff_base=2.0,
+                        probe_fn=lambda d: verdicts.pop(0),
+                        logger=logger, registry=registry,
+                        clock=lambda: clock[0])
+    assert probe.tick() == 1                    # fail #1 -> backoff 1 s
+    snap = probe.snapshot()
+    assert snap["benched"][2]["backoff_s"] == 1.0
+    assert probe.tick() == 0                    # not due yet
+    clock[0] = 1.0
+    assert probe.tick() == 1                    # fail #2 -> backoff 2 s
+    assert probe.snapshot()["benched"][2]["backoff_s"] == 2.0
+    clock[0] = 2.0
+    assert probe.tick() == 0                    # still inside backoff
+    clock[0] = 3.0
+    assert probe.tick() == 1                    # probe-ok
+    assert sched.device_health.lost() == ()
+    assert sched.restores == 1
+    snap = probe.snapshot()
+    assert snap["restores_armed"] == 1 and snap["benched"] == {}
+    logger.close()
+    assert _validate(log) == []
+    events = [json.loads(line) for line in open(log)]
+    probes = [e for e in events if e["event"] == "mesh_probe"]
+    assert [e["action"] for e in probes] \
+        == ["probed", "probed", "probed", "restore_requested"]
+    assert [e["ok"] for e in probes] == [False, False, True, True]
+    assert probes[0]["backoff_s"] == 1.0 and probes[1]["backoff_s"] == 2.0
+    key = 'dgc_mesh_probe_total{ok="false"}'
+    assert registry.to_dict()[key]["value"] == 2.0
+
+
+def test_probe_backoff_caps_and_restore_waits_for_full_bench(tmp_path):
+    """Two benched devices: the restore arms only once the LAST one
+    probes ok; a persistently dead device's backoff caps."""
+    from dgc_tpu.resilience.probe import HealthProbe
+
+    sched = _SchedStub(4)
+    sched.device_health.mark_lost(1)
+    sched.device_health.mark_lost(3)
+    clock = [0.0]
+    alive = {1: False, 3: True}
+    probe = HealthProbe(sched, interval_s=1.0, backoff_base=2.0,
+                        backoff_max_s=4.0,
+                        probe_fn=lambda d: alive[d],
+                        clock=lambda: clock[0])
+    probe.tick()                                # 3 ok, 1 fails
+    assert sched.device_health.lost() == (1,)
+    assert sched.restores == 0                  # bench not empty yet
+    for t in (1.0, 3.0, 7.0, 11.0):             # 1, 2, 4(cap), 4(cap)
+        clock[0] = t
+        probe.tick()
+    assert probe.snapshot()["benched"][1]["backoff_s"] == 4.0
+    alive[1] = True
+    clock[0] = 15.0
+    probe.tick()
+    assert sched.device_health.lost() == ()
+    assert sched.restores == 1
+
+
+def test_probe_noop_without_health_plane_and_bad_interval():
+    from dgc_tpu.resilience.probe import HealthProbe, canary_probe
+
+    class _Unsharded:
+        device_health = None
+
+    probe = HealthProbe(_Unsharded(), interval_s=0.5)
+    assert probe.tick() == 0
+    with pytest.raises(ValueError):
+        HealthProbe(_SchedStub(), interval_s=0.0)
+    # the real canary refuses an out-of-range device instead of raising
+    assert canary_probe(10_000) is False
+
+
+@needs8
+@pytest.mark.serve
+def test_probe_restores_degraded_mesh_no_operator(tmp_path):
+    """End to end on the forced 8-device mesh: device loss degrades to
+    the 4-survivor submesh, then the probe's canary (the REAL
+    device_put canary — the virtual device answers) drives the restore
+    with no operator call, and serving continues."""
+    from dgc_tpu.obs import RunLogger
+    from dgc_tpu.resilience.probe import HealthProbe
+    from dgc_tpu.serve.queue import ServeFrontEnd
+
+    graphs = _graphs(3, seed0=120)
+    log = tmp_path / "probe_e2e.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    plane = faults.FaultPlane(FaultSchedule.parse("mesh@1=device_loss:1"))
+    with faults.injected(plane):
+        front = ServeFrontEnd(batch_max=4, window_s=0.0, mesh_devices=8,
+                              logger=logger).start()
+        r1 = _serve_all(front, graphs[:2])
+        assert [r.status for r in r1] == ["ok", "ok"]
+        assert front.scheduler.mesh_devices == 4
+        probe = HealthProbe(front.scheduler, interval_s=0.05,
+                            logger=logger).start()
+        deadline = time.time() + 15
+        while front.scheduler.mesh_devices != 8 and time.time() < deadline:
+            time.sleep(0.05)
+        probe.close()
+        assert front.scheduler.mesh_devices == 8
+        r2 = _serve_all(front, graphs[2:])
+        assert r2[0].status == "ok"
+        front.shutdown()
+    logger.close()
+    assert front.scheduler.stats_snapshot()["mesh_restores"] == 1
+    assert probe.snapshot()["restores_armed"] == 1
+    assert _validate(log) == []
+    events = [json.loads(line) for line in open(log)]
+    acts = [e["action"] for e in events if e["event"] == "mesh_probe"]
+    assert "probed" in acts and "restore_requested" in acts
+    assert any(e["event"] == "mesh_restore" for e in events)
+
+
+@needs8
+@pytest.mark.serve
+def test_probe_disabled_keeps_operator_armed_path():
+    """Probe off (the default): the bench persists — colors still
+    byte-identical to fault-free (the PR 15 contract, unchanged), and
+    nothing restores the mesh behind the operator's back."""
+    from dgc_tpu.serve.queue import ServeFrontEnd
+
+    graphs = _graphs(3, seed0=140)
+    base_front = ServeFrontEnd(batch_max=4, window_s=0.0).start()
+    base = [r.colors.tolist() for r in _serve_all(base_front, graphs)]
+    base_front.shutdown()
+
+    plane = faults.FaultPlane(FaultSchedule.parse("mesh@1=device_loss:2"))
+    with faults.injected(plane):
+        front = ServeFrontEnd(batch_max=4, window_s=0.0,
+                              mesh_devices=8).start()
+        results = _serve_all(front, graphs)
+        time.sleep(0.5)
+        assert front.scheduler.mesh_devices == 4    # no auto restore
+        assert front.scheduler.device_health.lost() != ()
+        front.shutdown()
+    assert [r.colors.tolist() for r in results] == base

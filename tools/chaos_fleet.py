@@ -1,0 +1,629 @@
+#!/usr/bin/env python
+"""Chaos harness for the replicated serve fleet: seeded SIGKILL
+schedules over replica subsets + kill-all cold restart + the brownout
+tier contract, over one shared ``--journal-dir``.
+
+The fleet-level analogue of ``tools/chaos_serve.py`` (which hammers ONE
+listener). Three legs, one report:
+
+**Leg 1 — replica-subset kill schedule.** A real fleet
+(``dgc-tpu serve --listen --replicas N --journal-dir``) serves
+concurrent clients while a watcher thread SIGKILLs seeded replica
+subsets whenever the MERGED write-ahead journal (all namespaces'
+``ticket_journal.jsonl``) crosses the next seeded record offset — kills
+land mid-group-commit by construction. The fleet supervisor respawns
+each casualty under a fresh incarnation; clients ride the shared
+SO_REUSEPORT port through every kill window. Asserted: every acked
+(202) ticket reaches a terminal 200, zero duplicate ticket ids
+FLEET-WIDE (the replica-prefix contract), and every replayed request's
+colors are byte-identical to the fault-free baseline.
+
+**Leg 2 — kill-all + cold fleet restart.** Every replica AND the
+supervisor are SIGKILLed at once; a brand-new fleet process starts over
+the same ``--journal-dir``. The cold fleet's merge-scan
+(``scan_fleet``) must fold every incarnation's namespace: all of leg
+1's tickets still poll to the same colors, the merged scan holds no
+duplicate ids, and per-tenant usage conservation (PR 16's checker)
+holds over the namespace WAL list.
+
+**Leg 3 — brownout tier contract (in-process, deterministic).** A
+listener with a ``BrownoutController`` forced through its burn
+evaluations must shed ONLY the low tiers: at level 1 a free-tier submit
+gets a structured 503 + ``Retry-After`` while premium traffic is
+admitted and served; when the burn clears, the shed tier is admitted
+again, and the ``net_brownout``/``net_reject`` stream schema-validates.
+
+Usage::
+
+    JAX_PLATFORMS=cpu python tools/chaos_fleet.py --replicas 2 \\
+        --kills 2 --clients 4 --requests-per-client 2 \\
+        --report /tmp/chaos_fleet.json
+"""
+
+from __future__ import annotations
+
+import argparse
+import json
+import os
+import random
+import shutil
+import signal
+import subprocess
+import sys
+import tempfile
+import threading
+import time
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+sys.path.insert(0, REPO)
+
+from tools.chaos_serve import (_baseline_colors, _free_port, _http,  # noqa: E402
+                               _request_doc)
+from tools.validate_runlog import validate_file  # noqa: E402
+
+CHAOS_FLEET_REPORT_VERSION = 1
+
+_OUTCOMES = ("ok", "hang", "error", "mismatch")
+
+
+# ---------------------------------------------------------------------------
+# the fleet under test
+# ---------------------------------------------------------------------------
+
+class _Fleet:
+    """One ``serve --replicas N`` supervisor process + its replicas."""
+
+    def __init__(self, port: int, journal_dir: str, log_base: str, args):
+        self.cmd = [sys.executable, "-m", "dgc_tpu.cli", "serve",
+                    "--listen", str(port), "--replicas",
+                    str(args.replicas), "--journal-dir", journal_dir,
+                    "--log-json", log_base,
+                    "--batch-max", str(args.batch_max),
+                    "--queue-depth",
+                    str(max(64, args.clients
+                            * args.requests_per_client * 2)),
+                    "--window-ms", "0",
+                    "--dispatch-timeout", str(args.dispatch_timeout),
+                    "--max-lane-aborts", str(args.max_lane_aborts)]
+        env = dict(os.environ)
+        env["PYTHONPATH"] = REPO
+        env["JAX_PLATFORMS"] = "cpu"
+        self.proc = subprocess.Popen(
+            self.cmd, env=env, cwd=REPO,
+            stdout=subprocess.DEVNULL, stderr=subprocess.DEVNULL)
+        self.port = port
+        self.journal_dir = journal_dir
+
+    def state(self) -> dict:
+        """The supervisor's ``fleet_state.json`` (written atomically;
+        {} while it does not exist yet)."""
+        try:
+            with open(os.path.join(self.journal_dir,
+                                   "fleet_state.json")) as fh:
+                return json.load(fh)
+        except (OSError, json.JSONDecodeError):
+            return {}
+
+    def replica_pids(self) -> dict:
+        return {name: c["pid"]
+                for name, c in self.state().get("children", {}).items()}
+
+    def wait_ready(self, deadline_s: float = 180.0) -> None:
+        t_end = time.perf_counter() + deadline_s
+        while time.perf_counter() < t_end:
+            if self.proc.poll() is not None:
+                raise RuntimeError(f"fleet exited rc {self.proc.returncode}"
+                                   f" before ready")
+            if len(self.replica_pids()) > 0:
+                try:
+                    st, _doc = _http("GET", self.port, "/healthz",
+                                     retries=1, deadline_s=5.0)
+                    if st == 200:
+                        return
+                except RuntimeError:
+                    pass
+            time.sleep(0.1)
+        raise RuntimeError("fleet never became ready")
+
+    def kill_replicas(self, names) -> int:
+        """SIGKILL the named replicas' CURRENT incarnations; returns
+        how many signals landed."""
+        landed = 0
+        for name, pid in self.replica_pids().items():
+            if name in names:
+                try:
+                    os.kill(pid, signal.SIGKILL)
+                    landed += 1
+                except OSError:
+                    pass
+        return landed
+
+    def kill_all(self) -> None:
+        """Kill-all: every replica AND the supervisor, no drain."""
+        pids = list(self.replica_pids().values())
+        self.proc.kill()
+        self.proc.wait(timeout=30)
+        for pid in pids:
+            try:
+                os.kill(pid, signal.SIGKILL)
+            except OSError:
+                pass
+
+
+def _wal_records(journal_dir: str) -> int:
+    """Merged WAL record count across every namespace — the kill
+    clock."""
+    total = 0
+    try:
+        names = os.listdir(journal_dir)
+    except OSError:
+        return 0
+    for name in names:
+        path = os.path.join(journal_dir, name, "ticket_journal.jsonl")
+        try:
+            with open(path, "rb") as fh:
+                total += fh.read().count(b"\n")
+        except OSError:
+            continue
+    return total
+
+
+# ---------------------------------------------------------------------------
+# legs 1+2: subset kills, then kill-all cold restart
+# ---------------------------------------------------------------------------
+
+def _drive_clients(args, reqs, port, tickets, ticket_of, results, errors):
+    """Concurrent client threads: submit, then poll own tickets to
+    terminal results, riding _http's reconnect loop through kills."""
+    acct = threading.Lock()
+
+    def client(reqs_slice):
+        mine = []
+        for doc in reqs_slice:
+            t_end = time.perf_counter() + args.deadline
+            while time.perf_counter() < t_end:
+                try:
+                    st, body = _http("POST", port, "/v1/color", doc,
+                                     retries=8, deadline_s=30.0)
+                except RuntimeError:
+                    continue   # fleet mid-respawn
+                if st == 202:
+                    with acct:
+                        tickets.append(body["ticket"])
+                        ticket_of[body["ticket"]] = doc
+                    mine.append(body["ticket"])
+                    break
+                if st in (429, 503):
+                    time.sleep(0.05)
+                    continue
+                with acct:
+                    errors.append(f"submit HTTP {st}: {body}")
+                break
+        for ticket in mine:
+            t_end = time.perf_counter() + args.deadline
+            while time.perf_counter() < t_end:
+                try:
+                    st, body = _http(
+                        "GET", port, f"/v1/result/{ticket}?colors=1",
+                        retries=8, deadline_s=30.0)
+                except RuntimeError:
+                    continue
+                if st == 200:
+                    with acct:
+                        results[ticket] = body
+                    break
+                if st == 202:
+                    time.sleep(0.02)
+                    continue
+                with acct:
+                    if st == 404:
+                        errors.append(f"acked ticket {ticket} LOST (404)")
+                        results[ticket] = {"status": "lost"}
+                    else:
+                        errors.append(f"poll {ticket} HTTP {st}")
+                        results[ticket] = {"status": f"http {st}"}
+                break
+            else:
+                with acct:
+                    errors.append(f"poll deadline for {ticket}")
+
+    per = max(1, args.requests_per_client)
+    slices = [reqs[i:i + per] for i in range(0, len(reqs), per)]
+    threads = [threading.Thread(target=client, args=(s,), daemon=True)
+               for s in slices]
+    for t in threads:
+        t.start()
+    deadline = time.perf_counter() + args.deadline
+    for t in threads:
+        t.join(timeout=max(1.0, deadline - time.perf_counter()))
+        if t.is_alive():
+            errors.append("client thread past deadline (hang)")
+
+
+def _run_fleet_kills(args, reqs: list, baseline: dict) -> tuple:
+    """Leg 1 + leg 2 over one workdir. Returns (kill_entry,
+    cold_entry)."""
+    workdir = args.workdir or tempfile.mkdtemp(prefix="dgc_chaos_fleet_")
+    os.makedirs(workdir, exist_ok=True)
+    journal_dir = os.path.join(workdir, "journal")
+    port = _free_port()
+    entry = {"kills_planned": int(args.kills), "kills": 0,
+             "outcome": "error", "log_problems": 0}
+    cold = {"outcome": "error", "log_problems": 0}
+    errors: list = []
+
+    # seeded kill plan: each kill fires when the MERGED WAL crosses its
+    # offset and takes a seeded replica subset (at least one kill hits
+    # >1 replica when the fleet has >1)
+    rng = random.Random(args.seed * 104_729 + 7)
+    expect = max(6, 2 * len(reqs))
+    hi = max(4, expect - 2)
+    offsets = sorted(rng.sample(range(2, hi), min(args.kills, hi - 2)))
+    subsets = []
+    for i in range(len(offsets)):
+        size = (max(2, args.replicas) if i == len(offsets) - 1
+                and args.replicas > 1 else rng.randint(1, args.replicas))
+        subsets.append(sorted(rng.sample(range(args.replicas),
+                                         min(size, args.replicas))))
+    entry["offsets"] = offsets
+    entry["subsets"] = subsets
+
+    log_base = os.path.join(workdir, "fleet.jsonl")
+    fleet = _Fleet(port, journal_dir, log_base, args)
+    stop_watch = threading.Event()
+    kills_done = []
+
+    def watcher():
+        plan = list(zip(offsets, subsets))
+        while plan and not stop_watch.is_set():
+            if _wal_records(journal_dir) >= plan[0][0]:
+                _off, subset = plan.pop(0)
+                landed = fleet.kill_replicas({f"r{k}" for k in subset})
+                kills_done.append({"offset": _off, "subset": subset,
+                                   "landed": landed})
+            time.sleep(0.005)
+
+    tickets: list = []
+    ticket_of: dict = {}
+    results: dict = {}
+    try:
+        fleet.wait_ready()
+        watch = threading.Thread(target=watcher, daemon=True)
+        watch.start()
+        _drive_clients(args, reqs, port, tickets, ticket_of, results,
+                       errors)
+        stop_watch.set()
+        entry["kills"] = len(kills_done)
+        entry["kill_detail"] = kills_done
+
+        # -- leg-1 invariants -------------------------------------------
+        if len(set(tickets)) != len(tickets):
+            errors.append("duplicate ticket ids fleet-wide")
+        replicas_seen = {t.split("-")[0] for t in tickets if "-" in t}
+        entry["replicas_serving"] = sorted(replicas_seen)
+        mismatched = 0
+        for ticket, doc in results.items():
+            if doc.get("status") != "ok":
+                errors.append(f"{ticket}: non-ok terminal "
+                              f"{doc.get('status')} ({doc.get('error')})")
+            elif doc.get("colors") != baseline[ticket_of[ticket]["seed"]]:
+                mismatched += 1
+        if len(results) != len(tickets):
+            errors.append(f"{len(tickets) - len(results)} tickets never "
+                          f"reached a terminal result")
+        if mismatched:
+            entry["outcome"] = "mismatch"
+        elif errors:
+            entry["outcome"] = "error"
+            entry["errors"] = errors[:8]
+        else:
+            entry["outcome"] = "ok"
+
+        # -- leg 2: kill-all + cold restart -----------------------------
+        cold_errors: list = []
+        fleet.kill_all()
+        fleet = _Fleet(port, journal_dir, log_base, args)
+        fleet.wait_ready()
+        stable = 0
+        for ticket, doc in results.items():
+            if doc.get("status") != "ok":
+                continue
+            t_end = time.perf_counter() + args.deadline
+            while time.perf_counter() < t_end:
+                st, again = _http("GET", port,
+                                  f"/v1/result/{ticket}?colors=1",
+                                  retries=8, deadline_s=30.0)
+                if st == 202:   # replayed by the cold fleet
+                    time.sleep(0.05)
+                    continue
+                if st != 200:
+                    cold_errors.append(
+                        f"{ticket}: HTTP {st} after cold restart")
+                elif again.get("colors") != doc.get("colors"):
+                    cold_errors.append(
+                        f"{ticket}: colors changed across cold restart")
+                else:
+                    stable += 1
+                break
+        cold["tickets_stable"] = stable
+        cold.update(_merge_invariants(journal_dir, cold_errors))
+        try:
+            _http("POST", port, "/admin/drain", {}, retries=8,
+                  deadline_s=60.0)
+            fleet.proc.wait(timeout=90)
+        except (RuntimeError, subprocess.TimeoutExpired):
+            fleet.proc.kill()
+        # the supervisor's per-incarnation logs: validate the ones whose
+        # process exited cleanly (killed incarnations may be torn)
+        base = log_base[:-len(".jsonl")]
+        final_logs = sorted(
+            p for p in os.listdir(workdir)
+            if p.startswith(os.path.basename(base) + ".r"))
+        entry["incarnation_logs"] = len(final_logs)
+        cold["outcome"] = "ok" if not cold_errors else "error"
+        if cold_errors:
+            cold["errors"] = cold_errors[:8]
+        return entry, cold
+    except RuntimeError as e:
+        bad = "hang" if "unreachable" in str(e) \
+            or "never became ready" in str(e) else "error"
+        if entry["outcome"] == "error":
+            entry["outcome"] = bad
+            entry["errors"] = [str(e)[:300]]
+        else:
+            cold["outcome"] = bad
+            cold["errors"] = [str(e)[:300]]
+        return entry, cold
+    finally:
+        stop_watch.set()
+        if fleet.proc.poll() is None:
+            fleet.kill_all()
+        if not args.keep_workdir and args.workdir is None:
+            shutil.rmtree(workdir, ignore_errors=True)
+
+
+def _merge_invariants(journal_dir: str, errors: list) -> dict:
+    """Cold-fleet merge asserts straight off the journal dir: unique
+    ids across ALL namespaces, and PR 16 usage conservation over the
+    merged WAL list."""
+    from dgc_tpu.obs.usage import conservation_problems, fold_journal
+    from dgc_tpu.serve.netfront.journal import (JOURNAL_FILE,
+                                                list_namespaces,
+                                                scan_fleet)
+
+    out: dict = {}
+    scan = scan_fleet(journal_dir)
+    ids = [t.ticket for t in scan.state.tickets]
+    out["namespaces"] = len(scan.namespaces)
+    out["merged_tickets"] = len(ids)
+    if len(ids) != len(set(ids)):
+        errors.append("fleet merge scan holds duplicate ticket ids")
+    torn = [ns for ns, meta in scan.per_namespace.items()
+            if meta.get("torn")]
+    out["torn_namespaces"] = len(torn)
+    wals = [os.path.join(journal_dir, ns, JOURNAL_FILE) if ns
+            else os.path.join(journal_dir, JOURNAL_FILE)
+            for ns in list_namespaces(journal_dir)]
+    rows = fold_journal(wals)
+    cons = conservation_problems(rows, wals)
+    out["usage_conservation"] = "ok" if not cons else "fail"
+    errors.extend(f"usage conservation: {c}" for c in cons[:4])
+    return out
+
+
+# ---------------------------------------------------------------------------
+# leg 3: brownout tier contract (in-process, deterministic)
+# ---------------------------------------------------------------------------
+
+def _run_brownout(args) -> dict:
+    """Force a brownout level and prove the tier contract on the wire:
+    low tier shed with a structured 503, premium admitted AND served,
+    full admission back once the burn clears."""
+    from dgc_tpu.obs import RunLogger
+    from dgc_tpu.serve.netfront import (AdmissionController,
+                                        BrownoutController, NetFront,
+                                        load_tenant_configs)
+    from dgc_tpu.serve.queue import ServeFrontEnd
+
+    entry = {"outcome": "error", "log_problems": 0}
+    errors: list = []
+    workdir = tempfile.mkdtemp(prefix="dgc_chaos_brownout_")
+    log = os.path.join(workdir, "brownout.jsonl")
+    logger = RunLogger(jsonl_path=log, echo=False)
+    bo = BrownoutController(sustain=1, clear=1, logger=logger)
+    cfgs = load_tenant_configs({"tenants": {
+        "free": {"tier": "free"}, "prem": {"tier": "premium"}}})
+    front = nf = None
+    doc = _request_doc(args.nodes, args.degree, seed=424_242)
+    try:
+        front = ServeFrontEnd(batch_max=args.batch_max, window_s=0.0,
+                              logger=logger).start()
+        nf = NetFront(front, admission=AdmissionController(cfgs),
+                      logger=logger, brownout=bo).start()
+        bo.on_evaluate(["failure_rate"])            # sustained burn
+        st, body = _http("POST", nf.port, "/v1/color", doc,
+                         tenant="free", deadline_s=args.deadline)
+        if st != 503 or body.get("reason") != "brownout":
+            errors.append(f"free tier under burn: HTTP {st} {body}")
+        st, body = _http("POST", nf.port, "/v1/color", doc,
+                         tenant="prem", deadline_s=args.deadline)
+        if st != 202:
+            errors.append(f"premium under burn rejected: HTTP {st}")
+        else:
+            ticket = body["ticket"]
+            t_end = time.perf_counter() + args.deadline
+            while time.perf_counter() < t_end:
+                st, body = _http("GET", nf.port,
+                                 f"/v1/result/{ticket}",
+                                 deadline_s=args.deadline)
+                if st != 202:
+                    break
+                time.sleep(0.02)
+            if st != 200 or body.get("status") != "ok":
+                errors.append(f"premium ticket under burn: HTTP {st}")
+        bo.on_evaluate([])                          # the burn clears
+        st, _body = _http("POST", nf.port, "/v1/color", doc,
+                          tenant="free", deadline_s=args.deadline)
+        if st != 202:
+            errors.append(f"free tier after clear: HTTP {st}")
+        entry["shed"] = bo.snapshot()["shed"]
+        entry["level_final"] = bo.level()
+    except RuntimeError as e:
+        errors.append(str(e)[:300])
+    finally:
+        if nf is not None:
+            nf.close()
+        if front is not None:
+            front.shutdown()
+        logger.close()
+    entry["log_problems"] = len(validate_file(log))
+    events = [json.loads(ln) for ln in open(log) if ln.strip()]
+    trans = [(e["action"], e["level"]) for e in events
+             if e.get("event") == "net_brownout"]
+    if trans != [("shed", 1), ("restore", 0)]:
+        errors.append(f"net_brownout transitions {trans}")
+    sheds = [e for e in events if e.get("event") == "net_reject"
+             and e.get("reason") == "brownout"]
+    if any(e.get("tier") not in ("free", "standard") for e in sheds):
+        errors.append("brownout shed a non-low tier")
+    shutil.rmtree(workdir, ignore_errors=True)
+    if errors or entry["log_problems"]:
+        entry["outcome"] = "error"
+        entry["errors"] = errors[:8]
+    else:
+        entry["outcome"] = "ok"
+    return entry
+
+
+# ---------------------------------------------------------------------------
+# report
+# ---------------------------------------------------------------------------
+
+def validate_chaos_fleet_report(doc) -> list:
+    """Structural check (the chaos_sweep convention: list of problems,
+    empty = well-formed)."""
+    problems: list = []
+    if not isinstance(doc, dict):
+        return ["report is not an object"]
+    if doc.get("chaos_fleet_report_version") != CHAOS_FLEET_REPORT_VERSION:
+        problems.append("missing/wrong chaos_fleet_report_version")
+    if not isinstance(doc.get("config"), dict):
+        problems.append("missing config object")
+    for leg in ("kill_resume", "cold_restart", "brownout"):
+        ent = doc.get(leg)
+        if ent is None:
+            continue
+        if not isinstance(ent, dict):
+            problems.append(f"{leg}: not an object")
+            continue
+        if ent.get("outcome") not in _OUTCOMES:
+            problems.append(f"{leg}: unknown outcome "
+                            f"{ent.get('outcome')!r}")
+    kr = doc.get("kill_resume")
+    if kr is not None:
+        for fieldname in ("kills_planned", "kills"):
+            if not isinstance(kr.get(fieldname), int):
+                problems.append(
+                    f"kill_resume: missing/invalid {fieldname!r}")
+    summary = doc.get("summary")
+    if not isinstance(summary, dict):
+        problems.append("missing summary object")
+    else:
+        for fieldname in ("total", "ok", "failed"):
+            if not isinstance(summary.get(fieldname), int):
+                problems.append(f"summary: missing/invalid {fieldname!r}")
+    return problems
+
+
+def main(argv: list | None = None) -> int:
+    p = argparse.ArgumentParser(description=__doc__.splitlines()[0])
+    p.add_argument("--replicas", type=int, default=2,
+                   help="fleet width under test (default 2)")
+    p.add_argument("--kills", type=int, default=2,
+                   help="seeded replica-subset SIGKILLs at merged-WAL "
+                        "offsets (0 skips legs 1+2)")
+    p.add_argument("--clients", type=int, default=4)
+    p.add_argument("--requests-per-client", type=int, default=2)
+    p.add_argument("--nodes", type=int, default=300,
+                   help="vertices per generated request")
+    p.add_argument("--degree", type=int, default=6)
+    p.add_argument("--batch-max", type=int, default=4)
+    p.add_argument("--seed", type=int, default=0,
+                   help="master seed: kill offsets AND replica subsets "
+                        "derive from it deterministically")
+    p.add_argument("--dispatch-timeout", type=float, default=3.0)
+    p.add_argument("--max-lane-aborts", type=int, default=3)
+    p.add_argument("--skip-brownout", action="store_true",
+                   help="skip leg 3 (the in-process brownout contract)")
+    p.add_argument("--deadline", type=float, default=240.0,
+                   help="per-leg hard deadline; a run past it is a "
+                        "chaos failure (hang)")
+    p.add_argument("--report", default="chaos_fleet_report.json")
+    p.add_argument("--workdir", default=None)
+    p.add_argument("--keep-workdir", action="store_true")
+    args = p.parse_args(argv)
+    if args.replicas < 2:
+        print("--replicas must be >= 2 (that is the point)",
+              file=sys.stderr)
+        return 2
+
+    reqs = [_request_doc(args.nodes, args.degree, seed=c * 10_000 + r)
+            for c in range(args.clients)
+            for r in range(args.requests_per_client)]
+    print(f"# chaos_fleet: {len(reqs)} requests V={args.nodes} "
+          f"replicas={args.replicas} seed={args.seed} "
+          f"kills={args.kills}", file=sys.stderr)
+
+    kill_resume = cold_restart = None
+    if args.kills > 0:
+        baseline = _baseline_colors(args, reqs)
+        print(f"# chaos_fleet: fault-free baseline captured "
+              f"({len(baseline)} colorings)", file=sys.stderr)
+        kill_resume, cold_restart = _run_fleet_kills(args, reqs, baseline)
+        print(f"# kill-resume: {kill_resume['outcome']} "
+              f"kills={kill_resume['kills']}/"
+              f"{kill_resume['kills_planned']}", file=sys.stderr)
+        print(f"# cold-restart: {cold_restart['outcome']} "
+              f"stable={cold_restart.get('tickets_stable')} "
+              f"namespaces={cold_restart.get('namespaces')}",
+              file=sys.stderr)
+
+    brownout = None
+    if not args.skip_brownout:
+        brownout = _run_brownout(args)
+        print(f"# brownout: {brownout['outcome']} "
+              f"shed={brownout.get('shed')}", file=sys.stderr)
+
+    legs = [e for e in (kill_resume, cold_restart, brownout)
+            if e is not None]
+    ok = sum(1 for e in legs if e["outcome"] == "ok")
+    failed = len(legs) - ok
+    report = {
+        "chaos_fleet_report_version": CHAOS_FLEET_REPORT_VERSION,
+        "config": {"replicas": args.replicas, "kills": args.kills,
+                   "clients": args.clients,
+                   "requests_per_client": args.requests_per_client,
+                   "nodes": args.nodes, "degree": args.degree,
+                   "seed": args.seed, "batch_max": args.batch_max},
+        "kill_resume": kill_resume,
+        "cold_restart": cold_restart,
+        "brownout": brownout,
+        "summary": {"total": len(legs), "ok": ok, "failed": failed},
+    }
+    problems = validate_chaos_fleet_report(report)
+    if problems:
+        for prob in problems:
+            print(f"# chaos_fleet report malformed: {prob}",
+                  file=sys.stderr)
+        failed += 1
+    with open(args.report, "w") as fh:
+        json.dump(report, fh, indent=2)
+        fh.write("\n")
+    print(json.dumps({"chaos_fleet": {
+        "total": report["summary"]["total"], "ok": ok, "failed": failed,
+        "report": args.report}}))
+    return 0 if failed == 0 else 1
+
+
+if __name__ == "__main__":
+    raise SystemExit(main())

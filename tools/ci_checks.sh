@@ -37,6 +37,15 @@
 #      --check), and (b) an injected SLO violation — a failure burst
 #      over warmed burn windows — must raise slo_burn AND dump the
 #      flight recorder mid-incident; sub-second, pure CPU.
+#  10. chaos-fleet smoke (replicated serve fleet, same skip): a real
+#      2-replica fleet (SO_REUSEPORT one-port, per-replica journal
+#      namespaces) through tools/chaos_fleet.py — one seeded
+#      replica-subset SIGKILL at a merged-WAL offset with supervisor
+#      respawn, a kill-all + cold fleet restart whose merge-scan
+#      replays every acked ticket (colors bit-identical, zero dup ids
+#      fleet-wide, usage conserved over the merged namespace WALs),
+#      and the brownout tier contract (low tier 503-shed, premium
+#      served); ~15s on CPU.
 # Steps 1-3 are AST-only (seconds); steps 4-5 compile toy kernels on
 # CPU (~1-2 min cold) — the only gates that prove the profiler and
 # serving-over-the-network plumbing end-to-end before device time is
@@ -309,6 +318,44 @@ EOF
     echo "ci_checks: fleet-telemetry smoke OK" >&2
   else
     echo "ci_checks: fleet-telemetry smoke FAILED" >&2
+    rc=1
+  fi
+  # chaos-fleet smoke (replicated serve fleet): 2 replicas on one
+  # SO_REUSEPORT port, 1 seeded replica-subset kill at a merged-WAL
+  # offset + the kill-all cold restart + the brownout tier contract —
+  # the harness's own invariants (zero acked loss, zero dup ids
+  # FLEET-WIDE, bit-identical replay colors, usage conservation over
+  # the merged namespace WALs) exit nonzero, and the report is
+  # structurally validated on top
+  if JAX_PLATFORMS=cpu timeout 560 python tools/chaos_fleet.py \
+      --replicas 2 --kills 1 --clients 2 --requests-per-client 1 \
+      --nodes 120 --degree 6 --deadline 240 \
+      --report "$SMOKE_DIR/chaos_fleet.json" \
+      > "$SMOKE_DIR/chaos_fleet_summary.json" \
+    && python - "$SMOKE_DIR/chaos_fleet.json" <<'EOF'
+import json, sys
+sys.path.insert(0, ".")
+from tools.chaos_fleet import validate_chaos_fleet_report
+doc = json.load(open(sys.argv[1]))
+problems = validate_chaos_fleet_report(doc)
+assert not problems, problems
+assert doc["summary"]["failed"] == 0, doc["summary"]
+kr = doc["kill_resume"]
+assert kr["outcome"] == "ok" and kr["kills"] >= 1, kr
+cold = doc["cold_restart"]
+assert cold["outcome"] == "ok", cold
+assert cold["usage_conservation"] == "ok", cold
+bo = doc["brownout"]
+assert bo["outcome"] == "ok" and bo["shed"] >= 1, bo
+print("ci_checks: chaos-fleet kill-resume + cold restart ok "
+      "(%d namespace(s) merged, %d ticket(s) stable, brownout shed %d)"
+      % (cold["namespaces"], cold["tickets_stable"], bo["shed"]),
+      file=sys.stderr)
+EOF
+  then
+    echo "ci_checks: chaos-fleet smoke OK" >&2
+  else
+    echo "ci_checks: chaos-fleet smoke FAILED" >&2
     rc=1
   fi
   rm -rf "$SMOKE_DIR"

@@ -228,6 +228,28 @@ if [ "$battery_rc" -ne 2 ]; then
     --report chaos_mesh_tpu.json 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
+  # replicated serve fleet on-chip (ROADMAP 2(a) robustness): (a) the
+  # chaos-fleet battery — seeded replica-subset SIGKILLs at merged-WAL
+  # offsets, the kill-all cold fleet restart, and the brownout tier
+  # contract, against real TPU lanes (the CPU legs are ci_checks.sh
+  # step 10 + tests/test_fleet.py; the TPU question is whether the
+  # cross-incarnation merge replay stays bit-identical when the killed
+  # incarnations held real device work) — and (b) the fleet-overhead
+  # A/B: soak.py --replicas 2 prices the SO_REUSEPORT fleet against
+  # the single listener at batch-8 and gates the overhead SLO (<= 5%)
+  # into the perf ledger.
+  echo "=== chaos-fleet soak (replica kills + cold fleet restart) ===" | tee -a /dev/stderr >/dev/null
+  timeout 3600 python tools/chaos_fleet.py --replicas 3 --kills 3 \
+    --clients 8 --requests-per-client 2 --nodes 20000 --degree 16 \
+    --deadline 900 --report chaos_fleet_tpu.json 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+
+  echo "=== fleet-overhead A/B (soak --replicas 2, batch-8 SLO gate) ===" | tee -a /dev/stderr >/dev/null
+  timeout 3600 python tools/soak.py --replicas 2 --clients 64 \
+    --requests-per-client 4 --nodes 20000 --degree 16 --batch-max 8 \
+    --perf-db PERF_DB.jsonl 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+
   echo "=== cold compile, unified pipeline 1M-RMAT ===" | tee -a /dev/stderr >/dev/null
   # fresh cache dir = genuinely cold compile (removed after); outer
   # timeout sits ABOVE bench.py's 5400s in-process deadline so the

@@ -176,6 +176,204 @@ class _Client:
             self.errors.append(f"{type(e).__name__}: {e}")
 
 
+def _one_shot(port: int, method: str, path: str, doc=None,
+              deadline_s: float = 180.0):
+    """One request against a subprocess serve leg, retried through
+    connection failures (the server may still be binding). Returns
+    (status, body_doc)."""
+    body = json.dumps(doc).encode() if doc is not None else None
+    headers = {"Content-Type": "application/json"} if body else {}
+    t_end = time.perf_counter() + deadline_s
+    last: Exception | None = None
+    while time.perf_counter() < t_end:
+        conn = http.client.HTTPConnection("127.0.0.1", port, timeout=60)
+        try:
+            conn.request(method, path, body=body, headers=headers)
+            resp = conn.getresponse()
+            payload = resp.read()
+            return resp.status, (json.loads(payload) if payload else {})
+        except (OSError, http.client.HTTPException) as e:
+            last = e
+            time.sleep(0.1)
+        finally:
+            conn.close()
+    raise RuntimeError(f"port {port} unreachable: {last}")
+
+
+def _serve_leg(args, replicas: int, workdir: str) -> tuple[dict, list]:
+    """Soak ONE subprocess serve tier — a single listener
+    (``replicas == 1``) or a fleet (``serve --replicas N``) — with the
+    same client pool, so the two legs' graphs/s are an apples-to-apples
+    A/B. Returns (facts, problems)."""
+    import socket
+    import subprocess
+
+    s = socket.socket()
+    s.bind(("127.0.0.1", 0))
+    port = s.getsockname()[1]
+    s.close()
+    journal = os.path.join(workdir, f"journal_x{replicas}")
+    cmd = [sys.executable, "-m", "dgc_tpu.cli", "serve",
+           "--listen", str(port), "--journal-dir", journal,
+           "--batch-max", str(args.batch_max),
+           "--queue-depth", str(args.queue_depth),
+           "--window-ms", str(args.window_ms)]
+    if replicas >= 2:
+        cmd += ["--replicas", str(replicas)]
+    # compile off the A/B clock: EVERY replica pre-warms the soak's one
+    # shape class at startup (readiness gates on it), so the fleet isn't
+    # charged N-1 extra JIT warmups the single listener doesn't pay
+    from dgc_tpu.models.graph import Graph
+    from dgc_tpu.serve.shape_classes import DEFAULT_LADDER
+
+    probe = Graph.generate(args.nodes, args.degree, seed=0,
+                           method="fast")
+    cls = DEFAULT_LADDER.class_for(probe.num_vertices,
+                                   probe.arrays.max_degree)
+    if cls is not None:
+        cmd += ["--warm-classes", cls.name]
+    env = dict(os.environ)
+    env["PYTHONPATH"] = os.path.dirname(
+        os.path.dirname(os.path.abspath(__file__)))
+    env.setdefault("JAX_PLATFORMS", "cpu")
+    proc = subprocess.Popen(cmd, env=env, stdout=subprocess.DEVNULL,
+                            stderr=subprocess.DEVNULL)
+    problems: list = []
+    facts: dict = {"replicas": replicas}
+    try:
+        _one_shot(port, "GET", "/healthz")
+        clients = [_Client(i, port, "load", args)
+                   for i in range(args.clients)]
+        barrier = threading.Barrier(args.clients + 1)
+        threads = [threading.Thread(target=c.run, args=(barrier,),
+                                    name=f"soak-fleet-{c.idx}",
+                                    daemon=True) for c in clients]
+        t0 = time.perf_counter()
+        for t in threads:
+            t.start()
+        barrier.wait(timeout=600)
+        for t in threads:
+            t.join(timeout=600)
+        wall = time.perf_counter() - t0
+
+        all_tickets = [tk for c in clients for tk, _ in c.tickets]
+        accepted = len(all_tickets)
+        if len(set(all_tickets)) != accepted:
+            problems.append(
+                f"x{replicas}: duplicate ticket ids fleet-wide")
+        done = sum(len(c.results) for c in clients)
+        ok = sum(1 for c in clients for r in c.results.values()
+                 if r.get("status") == "ok")
+        if done != accepted or ok != accepted:
+            problems.append(f"x{replicas}: {accepted} accepted, {done} "
+                            f"polled, {ok} ok")
+        for c in clients:
+            problems.extend(f"x{replicas}: {e}" for e in c.errors)
+        # clients are done — drain through the front door (one replica
+        # takes it; the supervisor follows it down) and require a clean
+        # fleet exit
+        _one_shot(port, "POST", "/admin/drain", {})
+        rc = proc.wait(timeout=300)
+        if rc != 0:
+            problems.append(f"x{replicas}: serve tier exited rc {rc}")
+        client_ms = [ms for c in clients for ms in c.client_ms]
+        facts.update(
+            requests=accepted, wall_s=round(wall, 3),
+            value=round(accepted / wall, 3) if wall > 0 else None,
+            p95_client_ms=(round(_pctile(client_ms, 0.95), 3)
+                           if client_ms else None))
+        return facts, problems
+    except (RuntimeError, threading.BrokenBarrierError) as e:
+        problems.append(f"x{replicas}: {e}")
+        facts.setdefault("value", None)
+        return facts, problems
+    finally:
+        if proc.poll() is None:
+            proc.kill()
+            proc.wait(timeout=60)
+
+
+# the replicated-tier tax budget: the fleet's graphs/s may trail the
+# single listener's by at most this much at the same batch ceiling
+FLEET_OVERHEAD_SLO_PCT = 5.0
+
+
+def _fleet_ab(args) -> int:
+    """``--replicas N``: the fleet A/B. Soak a single subprocess
+    listener, then an N-replica fleet, with identical client pools;
+    emit ONE perf record (the fleet row, baseline attached) gated on
+    the fleet-overhead SLO."""
+    import shutil
+    import tempfile
+
+    workdir = tempfile.mkdtemp(prefix="dgc_soak_fleet_")
+
+    def best_of(replicas: int) -> tuple[dict, list]:
+        # throughput = best of K trials per leg (scheduler noise on a
+        # shared box swamps a one-shot A/B); correctness problems from
+        # EVERY trial count — a lost ticket is real no matter the trial
+        best: dict = {}
+        probs: list = []
+        for trial in range(max(1, args.ab_trials)):
+            facts, trial_probs = _serve_leg(
+                args, replicas,
+                os.path.join(workdir, f"x{replicas}_t{trial}"))
+            probs.extend(trial_probs)
+            if facts.get("value") and facts["value"] > best.get(
+                    "value", 0.0):
+                best = facts
+        return best or facts, probs
+
+    try:
+        base_facts, problems = best_of(1)
+        fleet_facts, fleet_problems = best_of(args.replicas)
+        problems.extend(fleet_problems)
+        overhead = None
+        if base_facts.get("value") and fleet_facts.get("value"):
+            overhead = round(
+                100.0 * (base_facts["value"] - fleet_facts["value"])
+                / base_facts["value"], 2)
+            if overhead > FLEET_OVERHEAD_SLO_PCT:
+                problems.append(
+                    f"fleet overhead {overhead}% > "
+                    f"{FLEET_OVERHEAD_SLO_PCT}% SLO "
+                    f"(single {base_facts['value']} vs fleet "
+                    f"{fleet_facts['value']} graphs/s)")
+        record = {
+            "metric": f"soak_netfront_fleet{args.replicas}"
+                      f"_c{args.clients}_r{args.requests_per_client}"
+                      f"_n{args.nodes}d{args.degree}",
+            "value": fleet_facts.get("value"),
+            "unit": "graphs/s",
+            "backend": "netfront_fleet",
+            "platform": _platform(),
+            "replicas": args.replicas,
+            "clients": args.clients,
+            "requests": fleet_facts.get("requests"),
+            "p95_client_ms": fleet_facts.get("p95_client_ms"),
+            "wall_s": fleet_facts.get("wall_s"),
+            "single_value": base_facts.get("value"),
+            "fleet_overhead_pct": overhead,
+            "slo_fleet_overhead_pct_max": FLEET_OVERHEAD_SLO_PCT,
+            "soak_ok": not problems,
+        }
+        rc = 0
+        for prob in problems:
+            print(f"SOAK FAIL: {prob}", file=sys.stderr)
+            rc = 1
+        if args.perf_db and not problems and record["value"] is not None:
+            from tools.perf_db import record_and_check, render_verdict
+
+            verdict = record_and_check(args.perf_db, record)
+            print(render_verdict(verdict), file=sys.stderr)
+            if verdict.get("regression"):
+                rc = 1
+        print(json.dumps(record))
+        return rc
+    finally:
+        shutil.rmtree(workdir, ignore_errors=True)
+
+
 def _platform() -> str | None:
     try:
         import jax
@@ -226,12 +424,27 @@ def main(argv: list[str] | None = None) -> int:
                         "per-request W3C traceparent header from every "
                         "client — the on/off A/B is the PERF.md "
                         "\"Fleet telemetry overhead\" row")
+    p.add_argument("--replicas", type=int, default=1,
+                   help="N >= 2 switches to the fleet A/B: soak a "
+                        "single subprocess listener, then a "
+                        "``serve --replicas N`` fleet on one "
+                        "SO_REUSEPORT port, and gate the fleet's "
+                        "graphs/s within the fleet-overhead SLO "
+                        f"({FLEET_OVERHEAD_SLO_PCT}% of the single "
+                        "listener's)")
+    p.add_argument("--ab-trials", type=int, default=3,
+                   help="trials per fleet-A/B leg; throughput is the "
+                        "best trial (damps scheduler noise), "
+                        "correctness failures from any trial count")
     p.add_argument("--log-json", type=str, default=None)
     p.add_argument("--run-manifest", type=str, default=None)
     p.add_argument("--perf-db", type=str, default=None,
                    help="append the soak record to this perf ledger "
                         "(tools/perf_db.py) and exit 1 on regression")
     args = p.parse_args(argv)
+
+    if args.replicas >= 2:
+        return _fleet_ab(args)
 
     from dgc_tpu.obs import MetricsRegistry, RunLogger, RunManifest
     from dgc_tpu.serve.netfront import (AdmissionController, NetFront,

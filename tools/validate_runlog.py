@@ -137,10 +137,48 @@ def _semantic_problems(record: dict) -> list[str]:
             problems.append(
                 f"net_recover: action {record.get('action')!r} not in "
                 f"('restored', 'replayed', 'replay_failed', 'summary')")
-        for fieldname in ("records", "restored", "replayed", "failed"):
+        for fieldname in ("records", "restored", "replayed", "failed",
+                          "namespaces", "foreign"):
             v = record.get(fieldname)
             if isinstance(v, int) and not isinstance(v, bool) and v < 0:
                 problems.append(f"net_recover: {fieldname} {v} < 0")
+    # closed-loop robustness controllers (PR 17): probe actions and
+    # brownout transitions come from closed vocabularies, backoffs and
+    # levels stay in range — chaos_fleet's artifacts stay
+    # machine-checkable end to end
+    elif kind == "mesh_probe":
+        if record.get("action") not in ("probed", "restore_requested"):
+            problems.append(
+                f"mesh_probe: action {record.get('action')!r} not in "
+                f"('probed', 'restore_requested')")
+        if record.get("action") == "restore_requested" \
+                and record.get("ok") is not True:
+            problems.append("mesh_probe: restore_requested with ok != "
+                            "true (restore armed off a failed canary?)")
+        backoff = record.get("backoff_s")
+        if isinstance(backoff, (int, float)) \
+                and not isinstance(backoff, bool):
+            if backoff < 0:
+                problems.append(f"mesh_probe: backoff_s {backoff} < 0")
+            if record.get("ok") is True:
+                problems.append(
+                    "mesh_probe: backoff_s on a successful probe")
+        device = record.get("device")
+        if isinstance(device, int) and not isinstance(device, bool) \
+                and device < 0:
+            problems.append(f"mesh_probe: device {device} < 0")
+    elif kind == "net_brownout":
+        action, level = record.get("action"), record.get("level")
+        if action not in ("shed", "restore"):
+            problems.append(
+                f"net_brownout: action {action!r} not in "
+                f"('shed', 'restore')")
+        if isinstance(level, int) and not isinstance(level, bool):
+            if level < 0:
+                problems.append(f"net_brownout: level {level} < 0")
+            if action == "shed" and level < 1:
+                problems.append(
+                    "net_brownout: shed transition to level < 1")
     # failure-domain plane: a degrade must shrink the mesh (and a
     # restore grow it back), device counts stay >= 1 (devices_after 1 =
     # collapsed to the unsharded path), and every evacuation count is
