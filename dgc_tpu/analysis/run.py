@@ -76,6 +76,10 @@ LOCK_FILES = ("dgc_tpu/obs/metrics.py", "dgc_tpu/obs/httpd.py",
               # the probe's tick thread shares device-health state with
               # the dispatcher and /healthz handlers
               "dgc_tpu/serve/fleet.py", "dgc_tpu/resilience/probe.py",
+              # content-addressed result cache: listener handler
+              # threads and worker done-callbacks race on the LRU and
+              # its stats under the cache lock
+              "dgc_tpu/serve/resultcache.py",
               "tools/soak.py", "bench.py")
 TRANSFER_FILES = ("dgc_tpu/serve/batched.py", "dgc_tpu/serve/engine.py")
 

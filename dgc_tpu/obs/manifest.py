@@ -100,10 +100,18 @@ class RunManifest:
         # the manifest keeps counts, the JSONL keeps every event) plus
         # the drain record; the slot appears only when net_* events do
         elif kind in ("net_admit", "net_reject", "net_drain",
-                      "net_recover"):
+                      "net_recover", "net_cache"):
             nf = self.doc.setdefault("netfront",
                                      {"tenants": {}, "drain": None})
-            if kind == "net_recover":
+            if kind == "net_cache":
+                # content-addressed result cache: per-request outcomes
+                # aggregate to action counts (hit/miss/coalesced/store/
+                # promote) — the slot key appears only when the cache
+                # is on, so cache-off manifests stay byte-identical
+                counts = nf.setdefault("cache", {})
+                act = fields.get("action", "?")
+                counts[act] = counts.get(act, 0) + 1
+            elif kind == "net_recover":
                 # journal recovery: per-ticket actions aggregate to
                 # counts, the summary record lands whole (the crash-safe
                 # serve tier's restart provenance)

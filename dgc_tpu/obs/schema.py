@@ -199,7 +199,26 @@ EVENT_SCHEMAS: dict = {
          "failed": "int", "aborted": "int"},
         {"in_flight": "int", "vertices": "int", "vertex_supersteps": "int",
          "device_ms": NUM, "queue_ms": NUM, "service_ms": NUM,
-         "source": "str", "export_version": "int"}),
+         "source": "str", "export_version": "int",
+         # result-cache deliveries (the cheaper billing unit, a subset
+         # of delivered/failed) — present only when nonzero, so
+         # cache-off rows stay byte-identical
+         "cached": "int"}),
+    # content-addressed result cache + single-flight coalescing
+    # (serve.resultcache / the netfront): one event per cache-served
+    # request ("hit"), per follower attachment ("coalesced"), per
+    # leader miss ("miss"), per published entry ("store"), and per
+    # follower promoted to recompute after leader loss ("promote").
+    # Action vocabulary and count non-negativity are enforced by
+    # tools/validate_runlog.py
+    "net_cache": (
+        {"action": "str"},
+        {"tenant": ("str", "null"), "ticket": ("str", "null"),
+         # "mem" | "disk" — which cache tier answered (hit only)
+         "source": "str",
+         # provenance: the ticket whose compute produced the colors
+         "cached_from": ("str", "null"),
+         "key": "str", "v": "int"}),
     # continuous SLO burn-rate telemetry (obs.timeseries): one event per
     # objective whose fast AND slow trailing-window burns crossed the
     # threshold; ``dump``/``profile`` record the diagnostics the firing
@@ -323,7 +342,13 @@ EVENT_SCHEMAS: dict = {
          "mesh_devices": "int", "device_occupancy": "list",
          # failure-domain plane: degrades survived and live lanes
          # evacuated across them (present only when a degrade happened)
-         "mesh_degrades": "int", "lanes_evacuated": "int"}),
+         "mesh_degrades": "int", "lanes_evacuated": "int",
+         # content-addressed result cache (present only when the cache
+         # is enabled): lookup outcomes, coalesced followers, entries
+         # published, and the LRU's final population
+         "cache_hits": "int", "cache_misses": "int",
+         "cache_coalesced": "int", "cache_stores": "int",
+         "cache_entries": "int"}),
 }
 
 

@@ -43,6 +43,7 @@ USAGE_SOURCES = ("live", "journal")
 
 def _fresh_acc() -> dict:
     return {"admitted": 0, "delivered": 0, "failed": 0, "aborted": 0,
+            "cached": 0,
             "vertices": 0, "vertex_supersteps": 0, "device_us": 0,
             "queue_ms": 0.0, "service_ms": 0.0}
 
@@ -50,22 +51,29 @@ def _fresh_acc() -> dict:
 def rollup_row(tenant: str, acc: dict, source: str) -> dict:
     """Shape one tenant's accumulator into the ``usage_rollup`` event
     fields (shared by the live ``/admin/usage`` rows and the offline
-    export, so the two can never drift)."""
+    export, so the two can never drift). ``cached`` — deliveries served
+    from the result cache or a coalesced flight, the cheaper billing
+    unit (a subset of ``delivered``/``failed``, NOT a lifecycle count)
+    — is emitted only when nonzero, so a cache-off run's rows stay
+    byte-identical."""
     in_flight = (acc["admitted"] - acc["delivered"] - acc["failed"]
                  - acc["aborted"])
-    return {"tenant": tenant,
-            "admitted": int(acc["admitted"]),
-            "delivered": int(acc["delivered"]),
-            "failed": int(acc["failed"]),
-            "aborted": int(acc["aborted"]),
-            "in_flight": int(in_flight),
-            "vertices": int(acc["vertices"]),
-            "vertex_supersteps": int(acc["vertex_supersteps"]),
-            "device_ms": round(acc["device_us"] / 1e3, 3),
-            "queue_ms": round(float(acc["queue_ms"]), 3),
-            "service_ms": round(float(acc["service_ms"]), 3),
-            "source": source,
-            "export_version": USAGE_EXPORT_VERSION}
+    row = {"tenant": tenant,
+           "admitted": int(acc["admitted"]),
+           "delivered": int(acc["delivered"]),
+           "failed": int(acc["failed"]),
+           "aborted": int(acc["aborted"]),
+           "in_flight": int(in_flight),
+           "vertices": int(acc["vertices"]),
+           "vertex_supersteps": int(acc["vertex_supersteps"]),
+           "device_ms": round(acc["device_us"] / 1e3, 3),
+           "queue_ms": round(float(acc["queue_ms"]), 3),
+           "service_ms": round(float(acc["service_ms"]), 3),
+           "source": source,
+           "export_version": USAGE_EXPORT_VERSION}
+    if acc.get("cached"):
+        row["cached"] = int(acc["cached"])
+    return row
 
 
 def payload_vertices(payload) -> int:
@@ -127,12 +135,17 @@ class UsageMeter:
 
     def record_done(self, tenant: str, status: str, queue_s: float,
                     service_s: float, vertices: int = 0,
-                    supersteps: int = 0) -> None:
+                    supersteps: int = 0, cached: bool = False) -> None:
         """One terminal result: delivered (``status == "ok"``) or
-        failed, plus the latency and vertices·supersteps columns."""
+        failed, plus the latency and vertices·supersteps columns.
+        ``cached`` additionally counts the ticket in the cheaper
+        ``cached`` billing unit (result-cache hit or coalesced
+        delivery — no device work ran for it)."""
         with self._lock:
             row = self._row(tenant)
             row["delivered" if status == "ok" else "failed"] += 1
+            if cached:
+                row["cached"] += 1
             row["queue_ms"] += float(queue_s) * 1e3
             row["service_ms"] += float(service_s) * 1e3
             row["vertex_supersteps"] += int(vertices) * int(supersteps)
@@ -216,6 +229,11 @@ def fold_journal(journal_path, log_paths=()) -> list:
         if ent.result_doc is not None:
             doc = ent.result_doc
             acc["delivered" if doc.get("status") == "ok" else "failed"] += 1
+            if doc.get("cached"):
+                # result-cache hit / coalesced delivery: the terminal
+                # record carries the cached flag, so the offline ledger
+                # bills the cheaper unit exactly like the live meter
+                acc["cached"] += 1
             acc["queue_ms"] += float(doc.get("queue_ms") or 0.0)
             acc["service_ms"] += float(doc.get("service_ms") or 0.0)
             acc["vertex_supersteps"] += v * sum(
@@ -279,7 +297,7 @@ def journal_totals(journal_path) -> dict:
         res_docs.extend(docs)
     admitted: dict = {}   # ticket -> payload vertices
     aborted: set = set()
-    terminal: dict = {}   # ticket -> last terminal status
+    terminal: dict = {}   # ticket -> (last terminal status, cached flag)
     for doc in wal_docs:
         rec, ticket = doc["rec"], doc["ticket"]
         if rec == "admitted" and ticket not in admitted:
@@ -290,12 +308,15 @@ def journal_totals(journal_path) -> dict:
         if doc["ticket"] not in admitted:
             continue   # never acked: breadcrumbs drop, exactly as recovery
         if doc["rec"] in ("delivered", "failed"):
-            terminal[doc["ticket"]] = (doc.get("result") or {}).get("status")
-    delivered = sum(1 for s in terminal.values() if s == "ok")
+            result = doc.get("result") or {}
+            terminal[doc["ticket"]] = (result.get("status"),
+                                       bool(result.get("cached")))
+    delivered = sum(1 for s, _ in terminal.values() if s == "ok")
     return {"admitted": len(admitted),
             "delivered": delivered,
             "failed": len(terminal) - delivered,
             "aborted": len(aborted & set(admitted)),
+            "cached": sum(1 for _, c in terminal.values() if c),
             "vertices": sum(admitted.values())}
 
 
@@ -307,7 +328,7 @@ def conservation_problems(rows: list, journal_path) -> list:
     namespace WAL paths (the fleet ledger conserves as one unit)."""
     totals = journal_totals(journal_path)
     problems: list = []
-    for fieldname in (*COUNT_FIELDS, "vertices"):
+    for fieldname in (*COUNT_FIELDS, "cached", "vertices"):
         got = sum(int(r.get(fieldname, 0)) for r in rows)
         want = totals[fieldname]
         if got != want:

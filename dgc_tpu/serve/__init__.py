@@ -40,7 +40,13 @@ per-request host loop. This package amortizes all three:
   admission control (token buckets, concurrency quotas, priority
   tiers) ahead of the bounded queue, sharing one port with the
   ``/metrics`` + ``/healthz`` + debug surface. Imported lazily — the
-  offline replay path never pays for it.
+  offline replay path never pays for it;
+- :mod:`~dgc_tpu.serve.resultcache` — the content-addressed result
+  cache (ROADMAP 2(c)): exact-graph content hashing + a bounded LRU +
+  an optional shared on-disk store, consulted by the netfront AHEAD of
+  admission so repeat traffic is served at memcpy speed, with
+  single-flight coalescing deduplicating concurrent identical
+  submissions onto one compute.
 """
 
 from dgc_tpu.serve.shape_classes import (  # noqa: F401
@@ -55,4 +61,9 @@ from dgc_tpu.serve.queue import (  # noqa: F401
     ServeFrontEnd,
     ServeRequest,
     ServeResult,
+)
+from dgc_tpu.serve.resultcache import (  # noqa: F401
+    CachedResult,
+    ResultCache,
+    graph_content_hash,
 )

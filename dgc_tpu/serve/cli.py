@@ -77,6 +77,23 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "resumed past the journal high-water mark "
                         "(tools/chaos_serve.py is the kill-resume "
                         "proof); absent = the in-memory-only table")
+    p.add_argument("--result-cache", type=int, default=0, metavar="N",
+                   help="content-addressed result cache for --listen "
+                        "(serve.resultcache): keep up to N results in "
+                        "an in-memory LRU keyed by exact-graph content "
+                        "hash; a repeat submission is served straight "
+                        "from the cache (byte-identical colors, by "
+                        "engine determinism) and concurrent identical "
+                        "submissions single-flight-coalesce onto one "
+                        "compute; 0 (default) disables — the exact "
+                        "cache-off request path")
+    p.add_argument("--result-cache-dir", type=str, default=None,
+                   metavar="DIR",
+                   help="optional on-disk content-addressed store "
+                        "behind --result-cache: entries publish via "
+                        "atomic rename and survive restarts; a fleet's "
+                        "replicas share one DIR (torn or corrupt "
+                        "entries read as misses, never errors)")
     p.add_argument("--replicas", type=int, default=1, metavar="N",
                    help="replicated serve fleet (serve.fleet): supervise "
                         "N listener replicas sharing --listen's port via "
@@ -390,6 +407,22 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
         recover = tuple("" if ns == "." else ns
                         for ns in (args.fleet_recover or "").split(",")
                         if ns)
+    # content-addressed result cache (serve.resultcache): the engine
+    # key pins every result-relevant serve knob — a config change can
+    # never serve another config's colors. Tuned-schedule knobs are
+    # result-invariant by the tuned-config contract, so auto-tune
+    # state stays OUT of the key (and out of the hit rate).
+    resultcache = None
+    if getattr(args, "result_cache", 0) > 0:
+        from dgc_tpu.serve.resultcache import ResultCache
+        from dgc_tpu.version import __version__
+
+        resultcache = ResultCache(
+            args.result_cache, cache_dir=args.result_cache_dir,
+            engine_key=(f"v{__version__};"
+                        f"validate={int(not args.no_validate)};"
+                        f"post_reduce={int(not args.no_reduce_colors)};"
+                        f"stages={args.serve_stages}"))
     try:
         nf = NetFront(front, admission=admission, registry=registry,
                       logger=logger, recorder=recorder,
@@ -402,6 +435,7 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
                       recover_namespaces=recover,
                       reuse_port=replica is not None,
                       brownout=brownout,
+                      resultcache=resultcache,
                       timeseries=sampler,
                       host=args.listen_host, port=args.listen).start()
     except OSError as e:
@@ -456,6 +490,15 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
         # stays byte-identical)
         summary_kw["mesh_degrades"] = sst["mesh_degrades"]
         summary_kw["lanes_evacuated"] = sst.get("lanes_evacuated", 0)
+    if nf.resultcache is not None:
+        # result-cache outcome totals appear only when the cache is on
+        # (cache-off summaries stay byte-identical)
+        cs = nf.resultcache.snapshot()
+        summary_kw["cache_hits"] = int(cs["hits"])
+        summary_kw["cache_misses"] = int(cs["misses"])
+        summary_kw["cache_coalesced"] = int(cs["coalesced"])
+        summary_kw["cache_stores"] = int(cs["stores"])
+        summary_kw["cache_entries"] = int(cs["entries"])
     done = st["completed"]
     logger.event("serve_summary", requests=st["submitted"],
                  completed=done, failed=st["failed"],

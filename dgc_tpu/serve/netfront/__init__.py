@@ -44,6 +44,13 @@ per-class latency histograms, live Prometheus ``/metrics`` +
   recovery restores/replays across ALL incarnations
   (``tools/chaos_fleet.py`` is the fleet-level chaos harness).
 
+- The **content-addressed result cache** (``dgc_tpu.serve.resultcache``,
+  ``serve --result-cache N [--result-cache-dir DIR]``): exact-graph
+  content hashing turns repeat submissions into cache hits served
+  ahead of admission, and single-flight coalescing attaches concurrent
+  identical submissions to one in-flight compute — ROADMAP 2(c)'s
+  repeat-traffic lever, wired through the listener.
+
 ``tools/soak.py`` is the many-client soak harness over this package;
 its run log feeds ``tools/slo_check.py`` and its record feeds
 ``tools/perf_db.py`` — multi-tenant serving under load as a ledgered

@@ -78,6 +78,7 @@ from dgc_tpu.serve.netfront.admission import (AdmissionController,
 from dgc_tpu.serve.netfront.journal import (TicketJournal, parse_ticket,
                                             scan_fleet, scan_journal)
 from dgc_tpu.serve.queue import QueueFull, ServeError, ServeResult
+from dgc_tpu.serve.resultcache import CachedResult
 
 TENANT_HEADER = "X-Dgc-Tenant"
 
@@ -123,7 +124,7 @@ class _NetTicket:
     attempt feed and the completion slot; streamers wait on it."""
 
     __slots__ = ("ticket_id", "tenant", "priority", "cond", "attempts",
-                 "result", "t_submit", "trace", "v")
+                 "result", "t_submit", "trace", "v", "ckey")
 
     def __init__(self, ticket_id: str, tenant: str, priority: int,
                  trace: str | None = None, v: int = 0):
@@ -139,6 +140,22 @@ class _NetTicket:
         # vertex count — the usage meter's join keys
         self.trace = trace if trace is not None else f"req-{ticket_id}"
         self.v = int(v)
+        # content-address of the request's graph (result cache enabled
+        # only); None = the cache-off path, no flight bookkeeping
+        self.ckey: str | None = None
+
+
+class _Flight:
+    """One in-flight single-flight group: the leader ticket id plus the
+    follower tickets that coalesced onto it. Lives in the netfront
+    ``_flights`` table and is only ever touched under the netfront
+    lock."""
+
+    __slots__ = ("leader", "followers")
+
+    def __init__(self, leader: str):
+        self.leader = leader
+        self.followers: list = []   # guarded-by: NetFront._lock
 
 
 def _result_doc(res, with_colors: bool = False) -> dict:
@@ -178,8 +195,13 @@ class NetFront:
                  fleet_dir: str | None = None,
                  recover_namespaces=None,
                  reuse_port: bool = False,
-                 brownout=None):
+                 brownout=None,
+                 resultcache=None):
         self.front = front
+        # content-addressed result cache + single-flight coalescing
+        # (resultcache.ResultCache): consulted per submit AHEAD of
+        # admission; None = no caching, byte-identical request path
+        self.resultcache = resultcache
         # fleet mode (all default-off — the single listener stays
         # byte-identical): ``replica`` prefixes minted ticket ids,
         # ``fleet_dir`` is the ROOT --journal-dir whose namespaces
@@ -213,6 +235,8 @@ class NetFront:
         self._recovered = False       # guarded-by: owner (start())
         self._lock = threading.Lock()
         self._tickets: dict = {}      # id -> _NetTicket; guarded-by: _lock
+        # single-flight table: ckey -> _Flight while a leader computes
+        self._flights: dict = {}      # guarded-by: _lock
         self._completed: deque = deque()   # eviction order; guarded-by: _lock
         self._next_ticket = 0         # guarded-by: _lock
         self._draining = False        # guarded-by: _lock
@@ -272,6 +296,8 @@ class NetFront:
             doc["replica"] = self.replica
         if self.brownout is not None:
             doc["brownout"] = self.brownout.snapshot()
+        if self.resultcache is not None:
+            doc["result_cache"] = self.resultcache.snapshot()
         return doc
 
     # -- request parsing ------------------------------------------------
@@ -334,6 +360,20 @@ class NetFront:
             return json_response(
                 {"error": f"bad request: {e}", "tenant": tenant},
                 status=400)
+        # content-addressed result cache (ROADMAP 2(c)): the lookup runs
+        # AHEAD of admission — a hit answers straight from the cache
+        # without taking an admission slot (the cheaper unit the usage
+        # meter bills as ``cached``); a miss falls through carrying the
+        # content key so the ticket can lead — or coalesce onto — a
+        # single-flight group below. Cache off (None) = byte-identical.
+        ckey = None
+        if self.resultcache is not None:
+            ckey = self.resultcache.key_for(
+                graph.arrays, k0=int(graph.arrays.max_degree) + 1)
+            hit = self.resultcache.get(ckey)
+            if hit is not None:
+                return self._serve_cached(req, tenant, doc, graph,
+                                          ckey, hit[0], hit[1])
         try:
             cfg = self.admission.admit(tenant)
         except AdmissionReject as e:
@@ -358,6 +398,7 @@ class NetFront:
         net_ticket = _NetTicket(ticket_id, tenant, priority,
                                 trace=(tp[0] if tp is not None else None),
                                 v=graph.num_vertices)
+        net_ticket.ckey = ckey
         # write-ahead: the admitted record (with the replayable payload)
         # goes to the journal BEFORE the submit; the durable wait rides
         # the "seated" append below so both land under one group commit.
@@ -380,27 +421,71 @@ class NetFront:
                     status=503)
         self.usage.record_admitted(tenant, graph.num_vertices,
                                    trace=net_ticket.trace)
-        try:
-            self._attach(net_ticket, graph,
-                         trace=(tp[0] if tp is not None else None),
-                         trace_remote=(tp[1] if tp is not None else None))
-        except QueueFull as e:
-            self.admission.release(tenant)
-            self.usage.record_aborted(tenant)
-            self._journal_soft("aborted", ticket_id, reason="queue_full")
-            fields = dict(e.to_fields(), tenant=tenant,
-                          reason="queue_full")
-            self._event("net_reject", **fields)
-            return self._reject_response(fields)
-        except ServeError:
-            # the front end began draining between our check and submit
-            self.admission.release(tenant)
-            self.usage.record_aborted(tenant)
-            self._journal_soft("aborted", ticket_id, reason="draining")
-            self._event("net_reject", tenant=tenant, reason="draining")
-            return json_response(
-                {"error": "draining", "reason": "draining",
-                 "tenant": tenant}, status=503)
+        # single-flight decision (journaled tickets only — the flight
+        # joins AFTER the admitted record so an un-journaled 503 never
+        # leaves a ghost follower): the first miss for a key leads and
+        # computes; concurrent identical submissions attach as
+        # followers the leader's completion fans out to.
+        follower_of = None
+        if ckey is not None:
+            with self._lock:
+                fl = self._flights.get(ckey)
+                if fl is None:
+                    self._flights[ckey] = _Flight(ticket_id)
+                else:
+                    fl.followers.append(net_ticket)
+                    follower_of = fl.leader
+        if follower_of is not None:
+            # follower: no submit — just register the ticket pollable;
+            # the leader's _on_done delivers (or _flight_abort promotes)
+            with self._lock:
+                self._tickets[ticket_id] = net_ticket
+            self.resultcache.note_coalesced()
+            self._event("net_cache", action="coalesced", tenant=tenant,
+                        ticket=ticket_id, cached_from=follower_of,
+                        v=int(graph.num_vertices))
+            if self.registry is not None:
+                self.registry.counter(
+                    "dgc_net_cache_coalesced_total",
+                    "submissions coalesced onto an in-flight leader",
+                    tenant=tenant).inc()
+        else:
+            if ckey is not None:
+                self._event("net_cache", action="miss", tenant=tenant,
+                            ticket=ticket_id,
+                            v=int(graph.num_vertices))
+                if self.registry is not None:
+                    self.registry.counter(
+                        "dgc_net_cache_misses_total",
+                        "cache misses that led a fresh compute").inc()
+            try:
+                self._attach(net_ticket, graph,
+                             trace=(tp[0] if tp is not None else None),
+                             trace_remote=(tp[1] if tp is not None
+                                           else None))
+            except QueueFull as e:
+                self._flight_abort(net_ticket, graph)
+                self.admission.release(tenant)
+                self.usage.record_aborted(tenant)
+                self._journal_soft("aborted", ticket_id,
+                                   reason="queue_full")
+                fields = dict(e.to_fields(), tenant=tenant,
+                              reason="queue_full")
+                self._event("net_reject", **fields)
+                return self._reject_response(fields)
+            except ServeError:
+                # the front end began draining between our check and
+                # submit
+                self._flight_abort(net_ticket, graph)
+                self.admission.release(tenant)
+                self.usage.record_aborted(tenant)
+                self._journal_soft("aborted", ticket_id,
+                                   reason="draining")
+                self._event("net_reject", tenant=tenant,
+                            reason="draining")
+                return json_response(
+                    {"error": "draining", "reason": "draining",
+                     "tenant": tenant}, status=503)
         if self.journal is not None:
             try:
                 # the 202 ack below waits HERE: seated (and the admitted
@@ -439,6 +524,100 @@ class NetFront:
                                            boundary_span_id(ticket_id))),)
         return json_response(body, status=202, headers=headers)
 
+    def _serve_cached(self, req: Request, tenant: str, doc: dict,
+                      graph: Graph, ckey: str, ent: CachedResult,
+                      source: str):
+        """Answer a submit straight from the result cache: no admission
+        slot, no compute. The ticket is minted and journaled like any
+        other (admitted with the replayable payload, delivered with
+        ``cached``/``cached_from`` provenance, then the durable seated
+        ack) so kill-resume replays it correctly, and it is pollable
+        the moment the 202 leaves. Metered as a ``cached`` delivery —
+        the cheaper unit. Engine determinism makes the served colors
+        byte-identical to a fresh compute."""
+        tp = parse_traceparent(req.headers.get(TRACEPARENT_HEADER))
+        prefix = f"{self.replica}-" if self.replica is not None else ""
+        with self._lock:
+            ticket_id = f"{prefix}t{self._next_ticket:08x}"
+            self._next_ticket += 1
+        net_ticket = _NetTicket(ticket_id, tenant, 0,
+                                trace=(tp[0] if tp is not None else None),
+                                v=graph.num_vertices)
+        net_ticket.ckey = ckey
+        trace_fields = ({} if tp is None
+                        else {"trace": tp[0], "trace_parent": tp[1]})
+        if self.journal is not None:
+            try:
+                self.journal.append("admitted", ticket_id, durable=False,
+                                    tenant=tenant, priority=0,
+                                    payload=doc, **trace_fields)
+            except Exception as e:
+                self._event("net_reject", tenant=tenant,
+                            reason="journal_error")
+                return json_response(
+                    {"error": f"ticket journal unavailable: {e}",
+                     "reason": "journal_error", "tenant": tenant},
+                    status=503)
+        self.usage.record_admitted(tenant, graph.num_vertices,
+                                   trace=net_ticket.trace)
+        res = ServeResult(
+            request_id=ticket_id, status="ok", colors=ent.colors,
+            minimal_colors=int(ent.minimal_colors),
+            attempts=[None] * int(ent.attempts),
+            queue_s=0.0,
+            service_s=max(0.0,
+                          time.perf_counter() - net_ticket.t_submit),
+            batched=ent.batched, shape_class=ent.shape_class,
+            error=None)
+        rdoc = dict(_result_doc(res, with_colors=True), cached=True)
+        if ent.source_ticket:
+            rdoc["cached_from"] = ent.source_ticket
+        self._journal_soft("delivered", ticket_id, result=rdoc)
+        with net_ticket.cond:
+            net_ticket.result = res
+            net_ticket.cond.notify_all()
+        self._restore_completed(ticket_id, net_ticket)
+        with self._lock:
+            while len(self._tickets) > self.result_capacity \
+                    and self._completed:
+                self._tickets.pop(self._completed.popleft(), None)
+        self.usage.record_done(tenant, "ok", 0.0, res.service_s,
+                               vertices=net_ticket.v, cached=True)
+        if self.journal is not None:
+            try:
+                # the 202 ack waits on the seated fsync exactly like
+                # the compute path — an acked cache hit is durable
+                self.journal.append("seated", ticket_id)
+            except Exception as e:
+                self._event("net_reject", tenant=tenant,
+                            reason="journal_error")
+                return json_response(
+                    {"error": f"ticket journal unavailable: {e}",
+                     "reason": "journal_error", "tenant": tenant},
+                    status=503)
+        hit_fields = {} if not ent.source_ticket \
+            else {"cached_from": ent.source_ticket}
+        self._event("net_cache", action="hit", tenant=tenant,
+                    ticket=ticket_id, source=source,
+                    v=int(graph.num_vertices), **hit_fields)
+        if self.registry is not None:
+            self.registry.counter(
+                "dgc_net_cache_hits_total",
+                "requests served from the result cache",
+                tenant=tenant, source=source).inc()
+            self.registry.counter(
+                "dgc_net_requests_total", "completed network requests",
+                tenant=tenant, status="ok").inc()
+        body = {"ticket": ticket_id, "tenant": tenant, "priority": 0,
+                "cached": True}
+        headers = ()
+        if tp is not None:
+            body["trace"] = tp[0]
+            headers = ((TRACEPARENT_HEADER,
+                        format_traceparent(tp[0],
+                                           boundary_span_id(ticket_id))),)
+        return json_response(body, status=202, headers=headers)
+
     def _attach(self, net_ticket: _NetTicket, graph: Graph,
                 timeout: float = 0.0, trace: str | None = None,
                 trace_remote: str | None = None) -> None:
@@ -462,7 +641,8 @@ class NetFront:
             graph.arrays, request_id=ticket_id,
             timeout=timeout, priority=net_ticket.priority,
             on_attempt=on_attempt, trace=trace,
-            trace_remote=trace_remote)
+            trace_remote=trace_remote,
+            content_hash=net_ticket.ckey)
         with self._lock:
             self._tickets[ticket_id] = net_ticket
         serve_ticket.add_done_callback(
@@ -496,6 +676,40 @@ class NetFront:
 
     # -- completion (worker thread) --------------------------------------
     def _on_done(self, net_ticket: _NetTicket, result) -> None:
+        # every attempt is already appended by completion time, so the
+        # usage read can take its own acquisition ahead of publication
+        with net_ticket.cond:
+            supersteps = sum(int(a.get("supersteps") or 0)
+                             for a in net_ticket.attempts)
+        # publish to the content cache BEFORE popping the flight: a
+        # concurrent identical submit either hits the fresh cache entry
+        # or still finds the flight to follow — it can never fall into
+        # the gap between the two and recompute needlessly
+        if self.resultcache is not None and net_ticket.ckey is not None \
+                and result.status == "ok" and result.colors is not None:
+            self.resultcache.put(net_ticket.ckey, CachedResult(
+                colors=np.asarray(result.colors, np.int32),
+                minimal_colors=int(result.minimal_colors),
+                attempts=len(result.attempts),
+                shape_class=result.shape_class,
+                batched=bool(result.batched),
+                source_ticket=net_ticket.ticket_id,
+                supersteps=supersteps))
+            self._event("net_cache", action="store",
+                        tenant=net_ticket.tenant,
+                        ticket=net_ticket.ticket_id,
+                        key=net_ticket.ckey)
+            if self.registry is not None:
+                self.registry.counter(
+                    "dgc_net_cache_stores_total",
+                    "results published to the result cache").inc()
+        followers = ()
+        if net_ticket.ckey is not None:
+            with self._lock:
+                fl = self._flights.get(net_ticket.ckey)
+                if fl is not None and fl.leader == net_ticket.ticket_id:
+                    del self._flights[net_ticket.ckey]
+                    followers = tuple(fl.followers)
         # terminal journal record first (durable=False: it rides the
         # next group commit — a crash inside the window re-runs the
         # request on recovery, which deterministic engines make
@@ -505,11 +719,6 @@ class NetFront:
             "delivered" if result.status == "ok" else "failed",
             net_ticket.ticket_id,
             result=_result_doc(result, with_colors=True))
-        # every attempt is already appended by completion time, so the
-        # usage read can take its own acquisition ahead of publication
-        with net_ticket.cond:
-            supersteps = sum(int(a.get("supersteps") or 0)
-                             for a in net_ticket.attempts)
         with net_ticket.cond:
             net_ticket.result = result
             net_ticket.cond.notify_all()
@@ -533,6 +742,114 @@ class NetFront:
             while len(self._tickets) > self.result_capacity \
                     and self._completed:
                 self._tickets.pop(self._completed.popleft(), None)
+        # single-flight fan-out: every coalesced follower gets its own
+        # delivery (journaled with provenance, metered as cached)
+        for f in followers:
+            self._deliver_cached(f, result, net_ticket.ticket_id)
+
+    def _deliver_cached(self, net_ticket: _NetTicket, lead_result,
+                        cached_from: str) -> None:
+        """Deliver a leader's completed result to one coalesced
+        follower (worker thread): the follower gets its own terminal
+        journal record carrying ``cached_from`` provenance, releases
+        its own admission slot, and meters as a ``cached`` delivery —
+        the colors array is the leader's, byte-identical."""
+        res = ServeResult(
+            request_id=net_ticket.ticket_id, status=lead_result.status,
+            colors=lead_result.colors,
+            minimal_colors=lead_result.minimal_colors,
+            attempts=list(lead_result.attempts),
+            queue_s=0.0,
+            service_s=max(0.0,
+                          time.perf_counter() - net_ticket.t_submit),
+            batched=lead_result.batched,
+            shape_class=lead_result.shape_class,
+            error=lead_result.error)
+        rdoc = dict(_result_doc(res, with_colors=True), cached=True,
+                    cached_from=cached_from)
+        self._journal_soft(
+            "delivered" if res.status == "ok" else "failed",
+            net_ticket.ticket_id, result=rdoc)
+        with net_ticket.cond:
+            net_ticket.result = res
+            net_ticket.cond.notify_all()
+        self.admission.release(net_ticket.tenant)
+        self.usage.record_done(net_ticket.tenant, res.status,
+                               res.queue_s, res.service_s,
+                               vertices=net_ticket.v, cached=True)
+        if self.registry is not None:
+            self.registry.counter(
+                "dgc_net_requests_total", "completed network requests",
+                tenant=net_ticket.tenant, status=res.status).inc()
+            self.registry.histogram(
+                "dgc_net_service_seconds",
+                "request service time by tenant",
+                tenant=net_ticket.tenant).observe(res.service_s)
+        with self._lock:
+            self._completed.append(net_ticket.ticket_id)
+            while len(self._tickets) > self.result_capacity \
+                    and self._completed:
+                self._tickets.pop(self._completed.popleft(), None)
+
+    def _flight_abort(self, net_ticket: _NetTicket, graph: Graph) -> None:
+        """Unwind a failed leader submit's single-flight group: every
+        already-attached follower is promoted to its own recompute
+        (acked tickets never lost); a follower unwinding itself is
+        just unlinked."""
+        if net_ticket.ckey is None:
+            return
+        promote = ()
+        with self._lock:
+            fl = self._flights.get(net_ticket.ckey)
+            if fl is None:
+                return
+            if fl.leader == net_ticket.ticket_id:
+                del self._flights[net_ticket.ckey]
+                promote = tuple(fl.followers)
+            else:
+                try:
+                    fl.followers.remove(net_ticket)
+                except ValueError:
+                    pass
+        for f in promote:
+            self._promote(f, graph)
+
+    def _promote(self, net_ticket: _NetTicket, graph: Graph) -> None:
+        """A follower whose leader died in flight becomes its own
+        compute: the content-identical graph is resubmitted under the
+        follower's already-acked ticket id (the replay timeout buys
+        queue space, same as journal recovery). A submit that still
+        fails completes the ticket as a structured failure instead of
+        silently vanishing."""
+        if self.resultcache is not None:
+            self.resultcache.note_promoted()
+        self._event("net_cache", action="promote",
+                    tenant=net_ticket.tenant,
+                    ticket=net_ticket.ticket_id)
+        if self.registry is not None:
+            self.registry.counter(
+                "dgc_net_cache_promotions_total",
+                "followers promoted to recompute after leader loss",
+                tenant=net_ticket.tenant).inc()
+        try:
+            self._attach(net_ticket, graph, timeout=self.replay_timeout)
+        except Exception as e:
+            msg = (f"coalesced leader failed and promotion was "
+                   f"refused: {type(e).__name__}: {e}")
+            res = ServeResult(
+                request_id=net_ticket.ticket_id, status="error",
+                colors=None, minimal_colors=None, attempts=[],
+                queue_s=0.0, service_s=0.0, batched=False,
+                shape_class=None, error=msg)
+            self._journal_soft("failed", net_ticket.ticket_id,
+                               result={"status": "error", "error": msg})
+            with net_ticket.cond:
+                net_ticket.result = res
+                net_ticket.cond.notify_all()
+            self.admission.release(net_ticket.tenant)
+            self.usage.record_done(net_ticket.tenant, "error", 0.0, 0.0)
+            with self._lock:
+                self._completed.append(net_ticket.ticket_id)
 
     # -- GET /v1/result/<id> ---------------------------------------------
     def _ticket_for(self, req: Request, prefix: str):

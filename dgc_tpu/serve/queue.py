@@ -90,6 +90,10 @@ class ServeRequest:
     # request's whole life and the queue-wait child, begun at submit
     root_span: object = None
     queue_span: object = None
+    # exact-graph content hash (serve.resultcache, when the netfront's
+    # result cache is on): the tuned-config cache's exact-hash fast
+    # path keys on it ahead of the degree-histogram shape hash
+    content_hash: str | None = None
 
 
 @dataclass
@@ -420,7 +424,8 @@ class ServeFrontEnd:
     def submit(self, arrays: GraphArrays, request_id: int | None = None,
                timeout: float = 0.0, priority: int = 0,
                on_attempt=None, trace: str | None = None,
-               trace_remote: str | None = None) -> ServeTicket:
+               trace_remote: str | None = None,
+               content_hash: str | None = None) -> ServeTicket:
         """Admit one request; raises :class:`QueueFull` (with structured
         backpressure context) when the bounded queue stays full past
         ``timeout`` (0 = reject immediately). ``priority`` > 0 (the
@@ -469,7 +474,8 @@ class ServeFrontEnd:
                 self._next_id = max(self._next_id, request_id) + 1
             req = ServeRequest(request_id=request_id, arrays=arrays,
                                priority=max(0, int(priority)),
-                               on_attempt=on_attempt)
+                               on_attempt=on_attempt,
+                               content_hash=content_hash)
             # trace root + queue-wait child: begun under the admission
             # lock (the worker popping this request must find the spans
             # in place), trace id = the request id unless the caller
@@ -692,7 +698,8 @@ class ServeFrontEnd:
                 batched = False   # scheduler refused: single-graph path
         if not batched:
             result = self._fallback_sweep(arrays, validate, on_attempt,
-                                          post_reduce)
+                                          post_reduce,
+                                          content_hash=req.content_hash)
         service_s = time.perf_counter() - t_start
         ok = result.colors is not None
         return ServeResult(
@@ -701,17 +708,21 @@ class ServeFrontEnd:
             attempts=attempts, queue_s=queue_s, service_s=service_s,
             batched=batched, shape_class=cls.name if cls else None)
 
-    def _fallback_sweep(self, arrays, validate, on_attempt, post_reduce):
+    def _fallback_sweep(self, arrays, validate, on_attempt, post_reduce,
+                        content_hash=None):
         """Single-graph path for graphs beyond the shape ladder: a
         supervised sweep down the fallback ladder, rung state feeding
         :meth:`health`. The tuned-config cache (when auto-tuning) keys
         the first rung's schedule by graph-shape hash — recurring shapes
-        skip the replay (ROADMAP serving-path item)."""
+        skip the replay (ROADMAP serving-path item); when the netfront's
+        result cache computed an exact content hash, the cache consults
+        it FIRST (an exact hit skips even the histogram pass)."""
         with self._lock:
             self.stats["fallbacks"] += 1
         tuned_kw: dict = {}
         if self._tuned_cache is not None and self.auto_tune:
-            tuned_kw = self._tuned_cache.get_or_tune(arrays).engine_kwargs(
+            tuned_kw = self._tuned_cache.get_or_tune(
+                arrays, content_hash=content_hash).engine_kwargs(
                 "ell-compact")
         factories = self._fallback_factories(arrays)
         if tuned_kw:

@@ -1,0 +1,258 @@
+"""Content-addressed result cache for the serve tier (ROADMAP 2(c)).
+
+The tuned-config cache keys graphs by *shape* (degree histogram —
+``tune.config.graph_shape_hash``) because schedule knobs only depend on
+the bucket layout. Results depend on the exact adjacency, so this cache
+keys by *content*: a canonical hash over the sorted-CSR byte image plus
+the engine identity (k0 and every result-relevant engine flag). Two
+submissions with equal keys are guaranteed the same coloring by engine
+determinism, which is what makes serving a cached ``colors`` array
+byte-identical to a fresh compute — the invariant the tests and the
+chaos_fleet ``--result-cache`` leg lock.
+
+Two storage tiers, both optional:
+
+- a bounded in-memory LRU (per process / per fleet replica), and
+- an on-disk content-addressed store (``<key>.json``) shared across
+  replicas and across restarts. Entries publish via write-to-temp +
+  ``os.replace`` like the tuned-config artifacts, so readers never see
+  a torn file from a concurrent writer; a torn or corrupt entry from a
+  killed writer is tolerated as a miss (and left for the next store to
+  overwrite).
+
+Single-flight coalescing lives in the listener (it owns the ticket
+table); this module only provides the storage + hashing + stats so the
+listener's ``_lock`` remains the single mutable-state lock on the
+request path.
+"""
+
+from __future__ import annotations
+
+import hashlib
+import json
+import os
+import threading
+from collections import OrderedDict
+from dataclasses import dataclass, field
+from pathlib import Path
+
+import numpy as np
+
+RESULT_CACHE_VERSION = 1
+
+# stat keys snapshot() always reports (stable schema for /healthz and
+# serve_summary consumers)
+_STAT_KEYS = ("hits", "mem_hits", "disk_hits", "misses", "coalesced",
+              "promotions", "stores", "corrupt", "evictions")
+
+
+def graph_content_hash(arrays, k0=None, engine_key: str = "") -> str:
+    """Canonical exact-graph content hash.
+
+    Hashes the *sorted* CSR byte image — neighbor order within a row is
+    engine-irrelevant (the generators emit sorted rows, but externally
+    loaded graphs may not), so two adjacency-equal graphs that differ
+    only in row order must collide. Row membership itself is positional
+    (``indptr`` delimits rows), so hashing ``indptr`` plus the row-major
+    lexsorted ``indices`` pins the exact adjacency. The header pins the
+    result-relevant identity: CSR dtype, the k0 the sweep starts from,
+    and ``engine_key`` (engine/config flags the caller folds in — a
+    different validate/post_reduce/engine build must not share entries).
+    """
+    indptr = np.asarray(arrays.indptr, dtype=np.int64)
+    indices = np.asarray(arrays.indices, dtype=np.int64)
+    if len(indices):
+        rows = np.repeat(np.arange(len(indptr) - 1, dtype=np.int64),
+                         np.diff(indptr))
+        order = np.lexsort((indices, rows))
+        indices = indices[order]
+    v = int(len(indptr) - 1)
+    k0_s = "" if k0 is None else int(k0)
+    h = hashlib.sha256()
+    h.update(f"dgcgraph;v{RESULT_CACHE_VERSION};V={v};"
+             f"E2={len(indices)};dtype={arrays.indices.dtype.str};"
+             f"k0={k0_s};engine={engine_key};".encode())
+    h.update(indptr.tobytes())
+    h.update(indices.tobytes())
+    return "dgcgraph-" + h.hexdigest()[:32]
+
+
+@dataclass
+class CachedResult:
+    """One cached serve outcome: exactly what a hit must replay.
+
+    ``colors`` is the int32 per-vertex assignment (the byte-identity
+    payload); the rest is the result-doc metadata a delivered journal
+    record carries so recovered and cached deliveries render alike.
+    """
+
+    colors: np.ndarray
+    minimal_colors: int
+    attempts: int = 0
+    shape_class: str | None = None
+    batched: bool = False
+    source_ticket: str | None = None
+    supersteps: int = 0
+
+    def to_doc(self, key: str) -> dict:
+        return {"version": RESULT_CACHE_VERSION, "key": key,
+                "v": int(len(self.colors)),
+                "minimal_colors": int(self.minimal_colors),
+                "attempts": int(self.attempts),
+                "shape_class": self.shape_class,
+                "batched": bool(self.batched),
+                "source_ticket": self.source_ticket,
+                "supersteps": int(self.supersteps),
+                "colors": [int(c) for c in self.colors]}
+
+    @classmethod
+    def from_doc(cls, doc: dict) -> "CachedResult":
+        colors = np.asarray(doc["colors"], dtype=np.int32)
+        return cls(colors=colors,
+                   minimal_colors=int(doc["minimal_colors"]),
+                   attempts=int(doc.get("attempts", 0)),
+                   shape_class=doc.get("shape_class"),
+                   batched=bool(doc.get("batched", False)),
+                   source_ticket=doc.get("source_ticket"),
+                   supersteps=int(doc.get("supersteps", 0)))
+
+
+class ResultCache:
+    """Bounded thread-safe LRU over :class:`CachedResult` entries, with
+    an optional shared on-disk content-addressed store behind it.
+
+    Listener handler threads and worker done-callbacks race on every
+    method; all mutable state is guarded by ``_lock``. Disk I/O happens
+    outside the lock (the store is append-only content-addressed data —
+    worst case two writers publish the same bytes twice).
+    """
+
+    def __init__(self, capacity: int, cache_dir=None,
+                 engine_key: str = ""):
+        if capacity < 1:
+            raise ValueError(f"result cache capacity must be >= 1, "
+                             f"got {capacity}")
+        self.capacity = int(capacity)
+        self.cache_dir = Path(cache_dir) if cache_dir else None
+        if self.cache_dir is not None:
+            self.cache_dir.mkdir(parents=True, exist_ok=True)
+        self.engine_key = engine_key
+        self._lock = threading.Lock()
+        # LRU map key -> CachedResult, evicted at capacity from the
+        # cold end
+        self._mem: OrderedDict = OrderedDict()   # guarded-by: _lock
+        self._stats = {k: 0 for k in _STAT_KEYS}  # guarded-by: _lock
+
+    # -- hashing ----------------------------------------------------
+
+    def key_for(self, arrays, k0=None) -> str:
+        return graph_content_hash(arrays, k0=k0,
+                                  engine_key=self.engine_key)
+
+    # -- lookup / publish -------------------------------------------
+
+    def get(self, key: str):
+        """Returns ``(entry, source)`` — source ``"mem"`` or ``"disk"``
+        — or ``None`` on a miss. Disk hits are promoted into the LRU."""
+        with self._lock:
+            ent = self._mem.get(key)
+            if ent is not None:
+                self._mem.move_to_end(key)
+                self._stats["hits"] += 1
+                self._stats["mem_hits"] += 1
+                return ent, "mem"
+        ent = self._disk_get(key)
+        if ent is not None:
+            with self._lock:
+                self._stats["hits"] += 1
+                self._stats["disk_hits"] += 1
+                self._insert(key, ent)
+            return ent, "disk"
+        with self._lock:
+            self._stats["misses"] += 1
+        return None
+
+    def put(self, key: str, entry: CachedResult) -> None:
+        """Publish a computed result under its content key (memory +
+        disk). Last-writer-wins is safe: equal keys imply equal colors
+        by engine determinism."""
+        with self._lock:
+            self._insert(key, entry)
+            self._stats["stores"] += 1
+        if self.cache_dir is not None:
+            path = self.cache_dir / f"{key}.json"
+            tmp = self.cache_dir / f"{key}.{os.getpid()}.tmp"
+            try:
+                tmp.write_text(json.dumps(entry.to_doc(key)))
+                os.replace(tmp, path)
+            except OSError:
+                # disk store is best-effort; the in-memory tier already
+                # has the entry
+                try:
+                    tmp.unlink(missing_ok=True)
+                except OSError:
+                    pass
+
+    def _insert(self, key: str, entry: CachedResult) -> None:
+        # caller-holds-lock helper: every call site is inside
+        # ``with self._lock`` (the lock pass can't see across the call)
+        self._mem[key] = entry                     # dgc-lint: ok LK001
+        self._mem.move_to_end(key)                 # dgc-lint: ok LK001
+        while len(self._mem) > self.capacity:      # dgc-lint: ok LK001
+            self._mem.popitem(last=False)          # dgc-lint: ok LK001
+            self._stats["evictions"] += 1          # dgc-lint: ok LK001
+
+    def _disk_get(self, key: str):
+        if self.cache_dir is None:
+            return None
+        path = self.cache_dir / f"{key}.json"
+        try:
+            doc = json.loads(path.read_text())
+        except FileNotFoundError:
+            return None
+        except (OSError, json.JSONDecodeError, UnicodeDecodeError):
+            # torn/corrupt entry (killed writer, disk fault): a miss,
+            # never an error — the next store overwrites it
+            with self._lock:
+                self._stats["corrupt"] += 1
+            return None
+        try:
+            if (doc.get("version") != RESULT_CACHE_VERSION
+                    or doc.get("key") != key):
+                raise ValueError("key/version mismatch")
+            ent = CachedResult.from_doc(doc)
+            if len(ent.colors) != int(doc.get("v", -1)):
+                raise ValueError("truncated colors")
+        except (ValueError, TypeError, KeyError):
+            with self._lock:
+                self._stats["corrupt"] += 1
+            return None
+        return ent
+
+    # -- accounting -------------------------------------------------
+
+    def note_coalesced(self, n: int = 1) -> None:
+        """Count follower attachments (single-flight lives in the
+        listener; the cache keeps the stat so one snapshot covers the
+        whole dedup plane)."""
+        with self._lock:
+            self._stats["coalesced"] += n
+
+    def note_promoted(self, n: int = 1) -> None:
+        """Count followers promoted to their own recompute after leader
+        loss — the term that keeps the served-request account exact:
+        ``accepted == computed + hits + coalesced - promotions``."""
+        with self._lock:
+            self._stats["promotions"] += n
+
+    def snapshot(self) -> dict:
+        with self._lock:
+            out = dict(self._stats)
+            out["entries"] = len(self._mem)
+            out["capacity"] = self.capacity
+        out["disk"] = self.cache_dir is not None
+        return out
+
+    def __len__(self) -> int:
+        with self._lock:
+            return len(self._mem)

@@ -45,9 +45,15 @@ class TunedConfigCache:
         if self._dir is not None:
             self._dir.mkdir(parents=True, exist_ok=True)
         self._mem: dict[str, TunedConfig] = {}
+        # exact-graph content hash -> config (the result-cache fast
+        # path): an exact hit skips even the degree-histogram pass the
+        # shape hash costs, and — being content-pinned — can never take
+        # the shape-mismatch warn path
+        self._exact: dict[str, TunedConfig] = {}
         self._lock = threading.Lock()
         self._tuning: dict[str, threading.Lock] = {}
-        self.stats = {"hits": 0, "misses": 0, "disk_hits": 0}
+        self.stats = {"hits": 0, "misses": 0, "disk_hits": 0,
+                      "exact_hits": 0}
 
     def __len__(self) -> int:
         return len(self._mem)
@@ -55,13 +61,24 @@ class TunedConfigCache:
     def _path(self, shape: str) -> Path | None:
         return None if self._dir is None else self._dir / f"{shape}.json"
 
-    def get(self, arrays) -> TunedConfig | None:
-        """Cached config for this graph's shape, or None (no tuning)."""
+    def get(self, arrays, content_hash: str | None = None) \
+            -> TunedConfig | None:
+        """Cached config for this graph's shape, or None (no tuning).
+        ``content_hash`` (the netfront result cache's exact-graph key,
+        when available) is consulted BEFORE the shape hash — computing
+        the shape hash costs a histogram pass over the edge array."""
+        if content_hash is not None:
+            with self._lock:
+                cfg = self._exact.get(content_hash)
+            if cfg is not None:
+                self.stats["exact_hits"] += 1
+                return cfg
         shape = graph_shape_hash(arrays)
         with self._lock:
             cfg = self._mem.get(shape)
         if cfg is not None:
             self.stats["hits"] += 1
+            self._remember_exact(content_hash, cfg)
             return cfg
         path = self._path(shape)
         if path is not None and path.exists():
@@ -69,13 +86,23 @@ class TunedConfigCache:
             with self._lock:
                 self._mem[shape] = cfg
             self.stats["disk_hits"] += 1
+            self._remember_exact(content_hash, cfg)
             return cfg
         return None
 
-    def put(self, arrays, cfg: TunedConfig) -> None:
+    def _remember_exact(self, content_hash: str | None,
+                        cfg: TunedConfig) -> None:
+        if content_hash is None:
+            return
+        with self._lock:
+            self._exact[content_hash] = cfg
+
+    def put(self, arrays, cfg: TunedConfig,
+            content_hash: str | None = None) -> None:
         shape = graph_shape_hash(arrays)
         with self._lock:
             self._mem[shape] = cfg
+        self._remember_exact(content_hash, cfg)
         path = self._path(shape)
         if path is not None:
             cfg.save(str(path))
@@ -112,20 +139,24 @@ class TunedConfigCache:
             return cfg
         return None
 
-    def get_or_tune(self, arrays, tune=None) -> TunedConfig:
+    def get_or_tune(self, arrays, tune=None,
+                    content_hash: str | None = None) -> TunedConfig:
         """Config for this shape, tuning on first sight.
 
         ``tune(arrays) -> TunedConfig`` defaults to the build-time
         replay (``dgc_tpu.tune.tune_schedule``). Per-shape locking: a
-        burst of same-shaped misses replays once."""
-        cached = self.get(arrays)
+        burst of same-shaped misses replays once. ``content_hash``
+        threads the exact-hash fast path through both lookups and
+        binds the tuned config to the exact graph on a miss."""
+        cached = self.get(arrays, content_hash=content_hash)
         if cached is not None:
             return cached
         shape = graph_shape_hash(arrays)
         with self._lock:
             gate = self._tuning.setdefault(shape, threading.Lock())
         with gate:
-            cached = self.get(arrays)   # a peer finished while we waited
+            # a peer finished while we waited
+            cached = self.get(arrays, content_hash=content_hash)
             if cached is not None:
                 return cached
             if tune is None:
@@ -134,5 +165,5 @@ class TunedConfigCache:
                 tune = tune_schedule
             cfg = tune(arrays)
             self.stats["misses"] += 1
-            self.put(arrays, cfg)
+            self.put(arrays, cfg, content_hash=content_hash)
             return cfg

@@ -1,0 +1,510 @@
+"""Content-addressed result cache + single-flight coalescing tests
+(dgc_tpu.serve.resultcache and its netfront wiring, ROADMAP 2(c)):
+hash canonicalization, LRU/disk-store semantics (torn entries are
+misses), the end-to-end cache-hit request path (byte-identical colors,
+journaled + metered ``cached`` deliveries), the N-concurrent-identical
+hammer (exactly one compute), leader-failure follower promotion,
+kill-resume replay of a coalesced group, tenant isolation of usage,
+usage conservation with cached deliveries, the cache-off byte-identity
+contract, and the tuned-config cache's exact-hash fast path."""
+
+import json
+import threading
+import time
+import urllib.error
+import urllib.request
+
+import numpy as np
+import pytest
+
+from dgc_tpu.models.arrays import GraphArrays
+from dgc_tpu.models.graph import Graph
+from dgc_tpu.obs import RunLogger
+from dgc_tpu.obs.metrics import MetricsRegistry
+from dgc_tpu.obs.usage import conservation_problems, journal_totals
+from dgc_tpu.serve.netfront import NetFront, TicketJournal
+from dgc_tpu.serve.queue import QueueFull, ServeFrontEnd, ServeResult
+from dgc_tpu.serve.resultcache import (CachedResult, ResultCache,
+                                       graph_content_hash)
+from dgc_tpu.tune.cache import TunedConfigCache
+from dgc_tpu.tune.config import TunedConfig, graph_shape_hash
+from tools.validate_runlog import validate_file
+
+pytestmark = pytest.mark.serve
+
+
+# -- fixtures -----------------------------------------------------------
+
+class _CountingFront(ServeFrontEnd):
+    """No-jax front end that counts ``_serve_one`` invocations — the
+    single-flight assertions hinge on exactly how many computes ran.
+    Colors are a pure function of V, so identical submissions get
+    byte-identical results the way deterministic engines guarantee."""
+
+    def __init__(self, *a, gate=None, **kw):
+        super().__init__(*a, **kw)
+        self._gate = gate
+        self.computes = 0
+        self._count_lock = threading.Lock()
+
+    def _serve_one(self, req):
+        with self._count_lock:
+            self.computes += 1
+        t0 = time.perf_counter()
+        if self._gate is not None:
+            self._gate.wait(30)
+        v = int(len(req.arrays.indptr) - 1)
+        return ServeResult(
+            request_id=req.request_id, status="ok",
+            colors=np.arange(v, dtype=np.int32) % 3, minimal_colors=3,
+            attempts=[(3, "SUCCESS", 5)],
+            queue_s=t0 - req.t_submit,
+            service_s=time.perf_counter() - t0,
+            batched=False, shape_class=None)
+
+
+class _WedgeSubmitFront(_CountingFront):
+    """Front whose NEXT ``submit`` wedges (holding the caller inside the
+    listener's leader path) and then raises ``QueueFull`` — the
+    deterministic window for attaching a follower before leader loss."""
+
+    def __init__(self, *a, **kw):
+        super().__init__(*a, **kw)
+        self.submit_wedged = threading.Event()
+        self.submit_release = threading.Event()
+        self._fail_next = False
+
+    def arm_failure(self):
+        self._fail_next = True
+
+    def submit(self, *a, **kw):
+        if self._fail_next:
+            self._fail_next = False
+            self.submit_wedged.set()
+            self.submit_release.wait(30)
+            raise QueueFull("synthetic backpressure", queue_depth=1,
+                            capacity=1, retry_after_s=0.5)
+        return super().submit(*a, **kw)
+
+
+def _stack(tmp_path, logger=None, gate=None, cache=None, registry=None,
+           front_cls=_CountingFront, **nf_kw):
+    front = front_cls(batch_max=2, workers=2, queue_depth=32,
+                      window_s=0.0, logger=logger, gate=gate).start()
+    nf = NetFront(front, logger=logger, registry=registry,
+                  journal_dir=str(tmp_path / "journal"),
+                  resultcache=cache, **nf_kw).start()
+    return front, nf
+
+
+def _post(port, path, doc, tenant=None):
+    req = urllib.request.Request(
+        f"http://127.0.0.1:{port}{path}", data=json.dumps(doc).encode(),
+        headers={"Content-Type": "application/json",
+                 **({"X-Dgc-Tenant": tenant} if tenant else {})},
+        method="POST")
+    try:
+        with urllib.request.urlopen(req, timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else {})
+
+
+def _get(port, path):
+    try:
+        with urllib.request.urlopen(
+                f"http://127.0.0.1:{port}{path}", timeout=30) as r:
+            return r.status, json.loads(r.read())
+    except urllib.error.HTTPError as e:
+        body = e.read()
+        return e.code, (json.loads(body) if body else {})
+
+
+def _poll(port, ticket, timeout=30.0):
+    deadline = time.perf_counter() + timeout
+    while time.perf_counter() < deadline:
+        st, doc = _get(port, f"/v1/result/{ticket}?colors=1")
+        if st != 202:
+            return st, doc
+        time.sleep(0.01)
+    raise TimeoutError(f"ticket {ticket} never terminal")
+
+
+_SPEC = {"node_count": 24, "max_degree": 3, "seed": 5,
+         "gen_method": "fast"}
+
+
+def _entry(v=4, **kw):
+    return CachedResult(colors=np.arange(v, dtype=np.int32) % 3,
+                        minimal_colors=3, attempts=1, **kw)
+
+
+# -- content hash -------------------------------------------------------
+
+def test_content_hash_deterministic_and_splits_on_identity():
+    a = Graph.generate(40, 4, seed=7, method="fast").arrays
+    b = Graph.generate(40, 4, seed=7, method="fast").arrays
+    c = Graph.generate(40, 4, seed=8, method="fast").arrays
+    h = graph_content_hash(a, k0=5, engine_key="e1")
+    assert h == graph_content_hash(b, k0=5, engine_key="e1")
+    assert h.startswith("dgcgraph-")
+    # a different graph, a different k0, and a different engine
+    # identity must each get their own key
+    assert h != graph_content_hash(c, k0=5, engine_key="e1")
+    assert h != graph_content_hash(a, k0=6, engine_key="e1")
+    assert h != graph_content_hash(a, k0=5, engine_key="e2")
+
+
+def test_content_hash_neighbor_order_invariant():
+    """Row-internal neighbor order is engine-irrelevant; externally
+    loaded CSRs may be unsorted and must still collide with the sorted
+    image of the same adjacency."""
+    tri_sorted = GraphArrays(indptr=np.array([0, 2, 4, 6], np.int32),
+                             indices=np.array([1, 2, 0, 2, 0, 1],
+                                              np.int32))
+    tri_shuffled = GraphArrays(indptr=np.array([0, 2, 4, 6], np.int32),
+                               indices=np.array([2, 1, 2, 0, 1, 0],
+                                                np.int32))
+    assert (graph_content_hash(tri_sorted, k0=3)
+            == graph_content_hash(tri_shuffled, k0=3))
+    # row MEMBERSHIP is positional: moving an edge between rows is a
+    # different adjacency even with the same multiset of indices
+    other = GraphArrays(indptr=np.array([0, 1, 4, 6], np.int32),
+                        indices=np.array([1, 0, 0, 2, 1, 1], np.int32))
+    assert (graph_content_hash(tri_sorted, k0=3)
+            != graph_content_hash(other, k0=3))
+
+
+# -- cache storage tiers ------------------------------------------------
+
+def test_capacity_must_be_positive():
+    with pytest.raises(ValueError):
+        ResultCache(0)
+
+
+def test_lru_eviction_order():
+    rc = ResultCache(2)
+    rc.put("k1", _entry())
+    rc.put("k2", _entry())
+    rc.get("k1")                       # k1 now most-recent
+    rc.put("k3", _entry())             # evicts k2, the cold end
+    assert rc.get("k2") is None
+    assert rc.get("k1") is not None and rc.get("k3") is not None
+    snap = rc.snapshot()
+    assert snap["evictions"] == 1 and snap["entries"] == 2
+    assert snap["capacity"] == 2 and snap["disk"] is False
+
+
+def test_disk_store_roundtrip_across_instances(tmp_path):
+    writer = ResultCache(4, cache_dir=str(tmp_path / "store"))
+    ent = _entry(v=9, source_ticket="t00000001", shape_class="v64w8")
+    writer.put("kx", ent)
+    reader = ResultCache(4, cache_dir=str(tmp_path / "store"))
+    got = reader.get("kx")
+    assert got is not None and got[1] == "disk"
+    assert np.array_equal(got[0].colors, ent.colors)
+    assert got[0].colors.dtype == np.int32
+    assert got[0].minimal_colors == 3
+    assert got[0].source_ticket == "t00000001"
+    assert got[0].shape_class == "v64w8"
+    # the disk hit is promoted: the second lookup is a memory hit
+    assert reader.get("kx")[1] == "mem"
+    snap = reader.snapshot()
+    assert snap["disk_hits"] == 1 and snap["mem_hits"] == 1
+
+
+def test_torn_disk_entry_is_a_miss_not_an_error(tmp_path):
+    store = tmp_path / "store"
+    rc = ResultCache(4, cache_dir=str(store))
+    (store / "kt.json").write_text('{"version": 1, "key": "kt", "col')
+    assert rc.get("kt") is None
+    # key/version mismatches are the same class of fault: a writer
+    # publishing under the wrong name must never serve wrong colors
+    (store / "km.json").write_text(json.dumps(_entry().to_doc("other")))
+    assert rc.get("km") is None
+    snap = rc.snapshot()
+    assert snap["corrupt"] == 2 and snap["misses"] == 2
+    # a store overwrites the torn entry and the key serves again
+    rc.put("kt", _entry())
+    assert ResultCache(4, cache_dir=str(store)).get("kt") is not None
+
+
+# -- end-to-end: cache hits over the netfront ---------------------------
+
+def test_cache_hit_serves_byte_identical_colors(tmp_path):
+    log = tmp_path / "run.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    front, nf = _stack(tmp_path, logger=logger, cache=ResultCache(32))
+    st, doc = _post(nf.port, "/v1/color", dict(_SPEC), tenant="a")
+    assert st == 202 and "cached" not in doc
+    st, first = _poll(nf.port, doc["ticket"])
+    assert st == 200 and first["status"] == "ok"
+    # identical resubmission: acked as a hit, pollable immediately
+    st, doc2 = _post(nf.port, "/v1/color", dict(_SPEC), tenant="a")
+    assert st == 202 and doc2["cached"] is True and doc2["priority"] == 0
+    st, again = _get(nf.port, f"/v1/result/{doc2['ticket']}?colors=1")
+    assert st == 200
+    assert again["colors"] == first["colors"]
+    assert again["minimal_colors"] == first["minimal_colors"]
+    assert front.computes == 1
+    st, health = _get(nf.port, "/healthz")
+    assert health["result_cache"]["hits"] == 1
+    assert health["result_cache"]["stores"] == 1
+    assert health["result_cache"]["entries"] == 1
+    nf.close()
+    front.shutdown()
+    logger.close()
+    recs = [json.loads(ln) for ln in open(log) if '"net_cache"' in ln]
+    assert [r["action"] for r in recs] == ["miss", "store", "hit"]
+    assert recs[-1]["source"] == "mem"
+    assert recs[-1]["cached_from"] == doc["ticket"]
+    assert validate_file(str(log)) == []
+
+
+def test_concurrent_identical_hammer_computes_once(tmp_path):
+    """The single-flight contract: N concurrent identical submissions,
+    exactly ONE compute, N-1 followers coalesced, every ticket served
+    the same colors."""
+    n = 8
+    log = tmp_path / "run.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    gate = threading.Event()
+    front, nf = _stack(tmp_path, logger=logger, gate=gate,
+                       cache=ResultCache(32))
+    st, lead = _post(nf.port, "/v1/color", dict(_SPEC))
+    assert st == 202
+    tickets, errs = [lead["ticket"]], []
+
+    def submit():
+        try:
+            st, doc = _post(nf.port, "/v1/color", dict(_SPEC))
+            assert st == 202
+            tickets.append(doc["ticket"])
+        except Exception as e:       # pragma: no cover - surfaced below
+            errs.append(e)
+
+    threads = [threading.Thread(target=submit) for _ in range(n - 1)]
+    for t in threads:
+        t.start()
+    for t in threads:
+        t.join(30)
+    assert not errs and len(tickets) == n
+    gate.set()
+    colors = []
+    for t in tickets:
+        st, doc = _poll(nf.port, t)
+        assert st == 200 and doc["status"] == "ok"
+        colors.append(doc["colors"])
+    assert all(c == colors[0] for c in colors)
+    assert front.computes == 1
+    snap = nf.resultcache.snapshot()
+    assert snap["coalesced"] == n - 1
+    # once the leader published, fresh submissions are plain hits
+    st, doc = _post(nf.port, "/v1/color", dict(_SPEC))
+    assert st == 202 and doc["cached"] is True
+    assert front.computes == 1
+    nf.close()
+    front.shutdown()
+    logger.close()
+    recs = [json.loads(ln) for ln in open(log) if '"net_cache"' in ln]
+    acts = [r["action"] for r in recs]
+    assert acts.count("coalesced") == n - 1 and acts.count("miss") == 1
+    for r in recs:
+        if r["action"] == "coalesced":
+            assert r["cached_from"] == lead["ticket"]
+    assert validate_file(str(log)) == []
+
+
+def test_leader_failure_promotes_follower(tmp_path):
+    """A follower whose leader dies before computing is promoted to its
+    own recompute — an acked ticket is never lost to coalescing."""
+    log = tmp_path / "run.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    registry = MetricsRegistry()
+    front, nf = _stack(tmp_path, logger=logger, cache=ResultCache(32),
+                       registry=registry, front_cls=_WedgeSubmitFront)
+    front.arm_failure()
+    lead_resp = {}
+
+    def lead():
+        lead_resp["st"], lead_resp["doc"] = _post(
+            nf.port, "/v1/color", dict(_SPEC))
+
+    t = threading.Thread(target=lead)
+    t.start()
+    assert front.submit_wedged.wait(30)
+    st, fdoc = _post(nf.port, "/v1/color", dict(_SPEC))
+    assert st == 202 and "cached" not in fdoc
+    front.submit_release.set()
+    t.join(30)
+    # the leader itself got structured backpressure...
+    assert lead_resp["st"] == 429
+    assert lead_resp["doc"]["reason"] == "queue_full"
+    # ...while the already-acked follower completed via promotion
+    st, doc = _poll(nf.port, fdoc["ticket"])
+    assert st == 200 and doc["status"] == "ok"
+    assert doc["colors"] == [i % 3 for i in range(_SPEC["node_count"])]
+    assert front.computes == 1
+    st, metrics = _get(nf.port, "/healthz")
+    nf.close()
+    front.shutdown()
+    logger.close()
+    recs = [json.loads(ln) for ln in open(log) if '"net_cache"' in ln]
+    acts = [r["action"] for r in recs]
+    assert acts.count("coalesced") == 1 and acts.count("promote") == 1
+    promoted = [r for r in recs if r["action"] == "promote"]
+    assert promoted[0]["ticket"] == fdoc["ticket"]
+    assert validate_file(str(log)) == []
+
+
+def test_kill_resume_replays_coalesced_group(tmp_path):
+    """The crash window for a single-flight group: leader AND follower
+    journaled admitted+seated, neither delivered. Recovery replays each
+    under its original id as an independent compute — determinism makes
+    the two colorings identical, so coalescing never weakens the
+    journal's zero-acked-loss contract."""
+    j = TicketJournal(str(tmp_path / "journal"))
+    j.append("admitted", "t00000000", tenant="x", priority=1,
+             payload=dict(_SPEC))
+    j.append("seated", "t00000000")
+    j.append("admitted", "t00000001", tenant="y", priority=1,
+             payload=dict(_SPEC))
+    j.append("seated", "t00000001")
+    j.close()
+    log = tmp_path / "replay.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    front, nf = _stack(tmp_path, logger=logger, cache=ResultCache(32))
+    st, a = _poll(nf.port, "t00000000")
+    st2, b = _poll(nf.port, "t00000001")
+    assert st == 200 and st2 == 200
+    assert a["status"] == "ok" and b["status"] == "ok"
+    assert a["colors"] == b["colors"]
+    nf.close()
+    front.shutdown()
+    logger.close()
+    recs = [json.loads(ln) for ln in open(log) if '"net_recover"' in ln]
+    assert recs[-1]["replayed"] == 2 and recs[-1]["restored"] == 0
+    assert validate_file(str(log)) == []
+
+
+# -- metering -----------------------------------------------------------
+
+def test_usage_isolates_cached_unit_per_tenant(tmp_path):
+    """Tenant ``a`` pays for the compute; tenant ``b``'s identical
+    submission meters as the cheaper ``cached`` unit — and the cached
+    count never leaks into the computing tenant's row."""
+    front, nf = _stack(tmp_path, cache=ResultCache(32))
+    st, doc = _post(nf.port, "/v1/color", dict(_SPEC), tenant="a")
+    assert st == 202
+    _poll(nf.port, doc["ticket"])
+    st, doc2 = _post(nf.port, "/v1/color", dict(_SPEC), tenant="b")
+    assert st == 202 and doc2["cached"] is True
+    st, rows = _get(nf.port, "/admin/usage")
+    assert st == 200
+    by_tenant = {r["tenant"]: r for r in rows["usage"]}
+    assert by_tenant["a"]["delivered"] == 1 and "cached" not in by_tenant["a"]
+    assert by_tenant["b"]["delivered"] == 1 and by_tenant["b"]["cached"] == 1
+    nf.close()
+    front.shutdown()
+
+
+def test_usage_conservation_holds_with_cached_deliveries(tmp_path):
+    """Per-tenant usage rows vs the journal's ground truth, with hits
+    and coalesced deliveries in the mix: every lifecycle count — and
+    the ``cached`` unit — must reconcile exactly."""
+    gate = threading.Event()
+    front, nf = _stack(tmp_path, gate=gate, cache=ResultCache(32))
+    st, lead = _post(nf.port, "/v1/color", dict(_SPEC), tenant="a")
+    assert st == 202
+    st, fol = _post(nf.port, "/v1/color", dict(_SPEC), tenant="b")
+    assert st == 202
+    gate.set()
+    _poll(nf.port, lead["ticket"])
+    _poll(nf.port, fol["ticket"])
+    st, hit = _post(nf.port, "/v1/color", dict(_SPEC), tenant="a")
+    assert st == 202 and hit["cached"] is True
+    st, rows = _get(nf.port, "/admin/usage")
+    jpath = nf.journal.path
+    nf.close()
+    front.shutdown()
+    totals = journal_totals(jpath)
+    assert totals["admitted"] == 3 and totals["delivered"] == 3
+    assert totals["cached"] == 2
+    assert conservation_problems(rows["usage"], jpath) == []
+
+
+# -- the off switch -----------------------------------------------------
+
+def test_cache_off_is_byte_identical(tmp_path):
+    """``resultcache=None`` (the default) must reproduce the PR 17
+    surface exactly: no ``net_cache`` events, no ``cached`` fields in
+    acks or usage rows, no ``result_cache`` health block, and every
+    identical submission pays its own compute."""
+    log = tmp_path / "run.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    front, nf = _stack(tmp_path, logger=logger)
+    for _ in range(2):
+        st, doc = _post(nf.port, "/v1/color", dict(_SPEC), tenant="a")
+        assert st == 202 and "cached" not in doc
+        st, res = _poll(nf.port, doc["ticket"])
+        assert st == 200 and "cached" not in res
+    assert front.computes == 2
+    st, health = _get(nf.port, "/healthz")
+    assert "result_cache" not in health
+    st, rows = _get(nf.port, "/admin/usage")
+    assert all("cached" not in r for r in rows["usage"])
+    jpath = nf.journal.path
+    nf.close()
+    front.shutdown()
+    logger.close()
+    assert not any('"net_cache"' in ln for ln in open(log))
+    assert journal_totals(jpath)["cached"] == 0
+    assert validate_file(str(log)) == []
+
+
+# -- tuned-config exact-hash fast path ----------------------------------
+
+def test_tuned_cache_exact_hash_skips_shape_pass(tmp_path):
+    arrays = Graph.generate(48, 4, seed=3, method="fast").arrays
+    other = Graph.generate(96, 6, seed=9, method="fast").arrays
+    cache = TunedConfigCache()
+    cfg = TunedConfig(prune_u_div=8,
+                      graph_shape_hash=graph_shape_hash(arrays))
+    cache.put(arrays, cfg, content_hash="ck")
+    # the exact hit returns without computing the shape hash at all:
+    # content-identity pins the config even when the passed arrays
+    # would shape-hash elsewhere
+    got = cache.get(other, content_hash="ck")
+    assert got is cfg and cache.stats["exact_hits"] == 1
+    assert cache.stats["hits"] == 0
+
+
+def test_tuned_cache_hash_mismatch_falls_back_to_shape(tmp_path):
+    """The regression the fast path must not introduce: an unknown
+    content hash (same shape, different exact graph) degrades to the
+    shape-hash lookup — never a miss, never a wrong config — and the
+    fallback binds the new hash for next time."""
+    arrays = Graph.generate(48, 4, seed=3, method="fast").arrays
+    cache = TunedConfigCache()
+    cfg = TunedConfig(prune_u_div=8,
+                      graph_shape_hash=graph_shape_hash(arrays))
+    cache.put(arrays, cfg)
+    got = cache.get(arrays, content_hash="unseen")
+    assert got is cfg
+    assert cache.stats["hits"] == 1 and cache.stats["exact_hits"] == 0
+    # ...and the miss remembered the binding: same hash now exact-hits
+    got = cache.get(arrays, content_hash="unseen")
+    assert got is cfg and cache.stats["exact_hits"] == 1
+
+
+def test_tuned_cache_exact_binding_survives_disk_reload(tmp_path):
+    arrays = Graph.generate(48, 4, seed=3, method="fast").arrays
+    shape = graph_shape_hash(arrays)
+    warm = TunedConfigCache(cache_dir=str(tmp_path / "tuned"))
+    warm.put(arrays, TunedConfig(prune_u_div=8, graph_shape_hash=shape))
+    cold = TunedConfigCache(cache_dir=str(tmp_path / "tuned"))
+    got = cold.get(arrays, content_hash="ck")
+    assert got is not None and cold.stats["disk_hits"] == 1
+    assert cold.get(arrays, content_hash="ck") is got
+    assert cold.stats["exact_hits"] == 1

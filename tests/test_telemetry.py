@@ -355,7 +355,7 @@ def test_journal_fold_conservation_exact(tmp_path):
     assert a["source"] == "journal"
     totals = journal_totals(j.path)
     assert totals == {"admitted": 4, "delivered": 1, "failed": 1,
-                      "aborted": 1, "vertices": 96}
+                      "aborted": 1, "cached": 0, "vertices": 96}
     assert conservation_problems(rows, j.path) == []
     # a lost ticket or a double-metered terminal does NOT conserve
     broken = [dict(r) for r in rows]

@@ -85,6 +85,15 @@ class _Fleet:
                     "--window-ms", "0",
                     "--dispatch-timeout", str(args.dispatch_timeout),
                     "--max-lane-aborts", str(args.max_lane_aborts)]
+        if getattr(args, "result_cache", 0) > 0:
+            # per-replica LRUs over ONE shared content-addressed store
+            # (a journal-dir sibling, so it survives kill-all the same
+            # way the WAL does) — the supervisor forwards both flags to
+            # every replica incarnation
+            self.cmd += ["--result-cache", str(args.result_cache),
+                         "--result-cache-dir",
+                         os.path.join(os.path.dirname(journal_dir),
+                                      "result_cache")]
         env = dict(os.environ)
         env["PYTHONPATH"] = REPO
         env["JAX_PLATFORMS"] = "cpu"
@@ -346,7 +355,42 @@ def _run_fleet_kills(args, reqs: list, baseline: dict) -> tuple:
                     stable += 1
                 break
         cold["tickets_stable"] = stable
-        cold.update(_merge_invariants(journal_dir, cold_errors))
+        if getattr(args, "result_cache", 0) > 0:
+            # cold-cache probe: the fresh fleet's in-memory LRUs are
+            # empty, so a re-submitted hot seed must hit the SHARED
+            # disk store that survived kill-all — acked cached, colors
+            # byte-identical to the fault-free baseline
+            probes = 0
+            for seed in (11, 22):
+                doc = _request_doc(args.nodes, args.degree, seed=seed)
+                st, body = _http("POST", port, "/v1/color", doc,
+                                 retries=8, deadline_s=30.0)
+                if st != 202 or not body.get("cached"):
+                    cold_errors.append(f"cold-cache probe seed {seed}: "
+                                       f"HTTP {st} cached="
+                                       f"{body.get('cached')}")
+                    continue
+                ticket = body["ticket"]
+                t_end = time.perf_counter() + args.deadline
+                while time.perf_counter() < t_end:
+                    st, res = _http("GET", port,
+                                    f"/v1/result/{ticket}?colors=1",
+                                    retries=8, deadline_s=30.0)
+                    if st != 202:
+                        break
+                    time.sleep(0.02)
+                if st != 200 or res.get("status") != "ok":
+                    cold_errors.append(f"cold-cache probe seed {seed}: "
+                                       f"terminal HTTP {st}")
+                elif res.get("colors") != baseline[seed]:
+                    cold_errors.append(f"cold-cache probe seed {seed}: "
+                                       f"colors differ from baseline")
+                else:
+                    probes += 1
+            cold["cache_probes_ok"] = probes
+        cold.update(_merge_invariants(
+            journal_dir, cold_errors,
+            expect_cached=getattr(args, "result_cache", 0) > 0))
         try:
             _http("POST", port, "/admin/drain", {}, retries=8,
                   deadline_s=60.0)
@@ -382,10 +426,14 @@ def _run_fleet_kills(args, reqs: list, baseline: dict) -> tuple:
             shutil.rmtree(workdir, ignore_errors=True)
 
 
-def _merge_invariants(journal_dir: str, errors: list) -> dict:
+def _merge_invariants(journal_dir: str, errors: list,
+                      expect_cached: bool = False) -> dict:
     """Cold-fleet merge asserts straight off the journal dir: unique
     ids across ALL namespaces, and PR 16 usage conservation over the
-    merged WAL list."""
+    merged WAL list. With ``expect_cached`` the duplicate-heavy traffic
+    mix must have produced at least one cached/coalesced delivery in
+    the merged ledger — otherwise the cache arm silently tested
+    nothing."""
     from dgc_tpu.obs.usage import conservation_problems, fold_journal
     from dgc_tpu.serve.netfront.journal import (JOURNAL_FILE,
                                                 list_namespaces,
@@ -408,6 +456,11 @@ def _merge_invariants(journal_dir: str, errors: list) -> dict:
     cons = conservation_problems(rows, wals)
     out["usage_conservation"] = "ok" if not cons else "fail"
     errors.extend(f"usage conservation: {c}" for c in cons[:4])
+    cached = sum(int(r.get("cached", 0)) for r in rows)
+    out["cached_deliveries"] = cached
+    if expect_cached and cached == 0:
+        errors.append("result cache armed but zero cached deliveries "
+                      "in the merged ledger")
     return out
 
 
@@ -525,6 +578,13 @@ def validate_chaos_fleet_report(doc) -> list:
             if not isinstance(kr.get(fieldname), int):
                 problems.append(
                     f"kill_resume: missing/invalid {fieldname!r}")
+    cfg = doc.get("config")
+    cr = doc.get("cold_restart")
+    if (isinstance(cfg, dict) and cfg.get("result_cache", 0)
+            and cr is not None
+            and not isinstance(cr.get("cached_deliveries"), int)):
+        problems.append("cold_restart: result cache armed but "
+                        "missing/invalid 'cached_deliveries'")
     summary = doc.get("summary")
     if not isinstance(summary, dict):
         problems.append("missing summary object")
@@ -553,6 +613,13 @@ def main(argv: list | None = None) -> int:
                         "derive from it deterministically")
     p.add_argument("--dispatch-timeout", type=float, default=3.0)
     p.add_argument("--max-lane-aborts", type=int, default=3)
+    p.add_argument("--result-cache", type=int, default=0, metavar="N",
+                   help="arm the serve-tier result cache (per-replica "
+                        "LRU of N + shared disk store) and switch the "
+                        "traffic mix duplicate-heavy: cached hits and "
+                        "coalesced flights must survive kills and cold "
+                        "restart byte-identical to the fault-free "
+                        "baseline (0 = off)")
     p.add_argument("--skip-brownout", action="store_true",
                    help="skip leg 3 (the in-process brownout contract)")
     p.add_argument("--deadline", type=float, default=240.0,
@@ -570,9 +637,19 @@ def main(argv: list | None = None) -> int:
     reqs = [_request_doc(args.nodes, args.degree, seed=c * 10_000 + r)
             for c in range(args.clients)
             for r in range(args.requests_per_client)]
+    if args.result_cache > 0:
+        # duplicate-heavy mix: every other request re-submits one of
+        # two hot seeds, so kills land across cache hits and coalesced
+        # flights too. The baseline dict is keyed by seed, so the
+        # byte-identity assert covers cached deliveries for free.
+        pool = (11, 22)
+        for i in range(1, len(reqs), 2):
+            reqs[i] = _request_doc(args.nodes, args.degree,
+                                   seed=pool[(i // 2) % len(pool)])
     print(f"# chaos_fleet: {len(reqs)} requests V={args.nodes} "
           f"replicas={args.replicas} seed={args.seed} "
-          f"kills={args.kills}", file=sys.stderr)
+          f"kills={args.kills} result_cache={args.result_cache}",
+          file=sys.stderr)
 
     kill_resume = cold_restart = None
     if args.kills > 0:
@@ -604,7 +681,8 @@ def main(argv: list | None = None) -> int:
                    "clients": args.clients,
                    "requests_per_client": args.requests_per_client,
                    "nodes": args.nodes, "degree": args.degree,
-                   "seed": args.seed, "batch_max": args.batch_max},
+                   "seed": args.seed, "batch_max": args.batch_max,
+                   "result_cache": args.result_cache},
         "kill_resume": kill_resume,
         "cold_restart": cold_restart,
         "brownout": brownout,

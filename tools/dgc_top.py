@@ -126,6 +126,17 @@ def render_frame(base_url: str) -> str:
                              for s in states)
             lines.append(f"  devices: [{glyphs}]")
 
+    rc = health.get("result_cache")
+    if isinstance(rc, dict):
+        # content-addressed result cache (the pane appears only when
+        # the listener was started with --result-cache)
+        lines.append(
+            f"  cache: {rc.get('entries', 0)}/{rc.get('capacity', '?')}"
+            f" entries  hits={rc.get('hits', 0)}"
+            f"  coalesced={rc.get('coalesced', 0)}"
+            f"  misses={rc.get('misses', 0)}"
+            + ("  [disk]" if rc.get("disk") else ""))
+
     series = parse_prom(fetch(f"{base_url}/metrics") or "")
     burns = _select(series, "dgc_slo_burn_fired_total")
     if burns:
@@ -154,7 +165,7 @@ def render_frame(base_url: str) -> str:
     if names:
         lines.append("")
         lines.append(f"  {'tenant':<14} {'infl':>5} {'adm':>6} "
-                     f"{'done':>6} {'fail':>5} {'abrt':>5} "
+                     f"{'done':>6} {'fail':>5} {'abrt':>5} {'cach':>5} "
                      f"{'v*steps':>10} {'dev_ms':>9}")
         for name in names:
             adm = tenants.get(name) or {}
@@ -166,6 +177,7 @@ def render_frame(base_url: str) -> str:
                 f"{row.get('delivered', 0):>6} "
                 f"{row.get('failed', 0):>5} "
                 f"{row.get('aborted', 0):>5} "
+                f"{row.get('cached', 0):>5} "
                 f"{row.get('vertex_supersteps', 0):>10} "
                 f"{row.get('device_ms', 0.0):>9.1f}")
     return "\n".join(lines) + "\n"

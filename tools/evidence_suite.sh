@@ -250,6 +250,19 @@ if [ "$battery_rc" -ne 2 ]; then
     --perf-db PERF_DB.jsonl 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
+  # result-cache A/B on-chip (content-addressed result cache): the CPU
+  # rows (PERF.md "Content-addressed result cache") prove the hit path
+  # at memcpy speed against a ~35ms CPU compute; the TPU question is
+  # the same ratio against real accelerator latency AND that the 0%-
+  # duplicate overhead stays <=2% when admission is fed by parallel
+  # hardware lanes. Both SLO gates exit nonzero inside the harness.
+  echo "=== result-cache A/B (soak --cache-ab, 60% duplicates, 20k class) ===" | tee -a /dev/stderr >/dev/null
+  timeout 3600 python tools/soak.py --cache-ab --ab-trials 3 \
+    --duplicate-pct 60 --clients 64 --requests-per-client 4 \
+    --nodes 20000 --degree 16 --batch-max 8 --result-cache 512 \
+    --perf-db PERF_DB.jsonl 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+
   echo "=== cold compile, unified pipeline 1M-RMAT ===" | tee -a /dev/stderr >/dev/null
   # fresh cache dir = genuinely cold compile (removed after); outer
   # timeout sits ABOVE bench.py's 5400s in-process deadline so the

@@ -68,6 +68,10 @@ def config_hash(record: dict) -> str:
 
 
 def better_direction(record: dict) -> str:
+    # an explicit record-level direction wins — unit alone is ambiguous
+    # for "pct" (overhead wants lower, occupancy would want higher)
+    if record.get("better") in ("lower", "higher"):
+        return record["better"]
     unit = record.get("unit")
     return "lower" if unit in _LOWER_IS_BETTER_UNITS else "higher"
 

@@ -232,6 +232,14 @@ def render(doc: dict, width: int = 48) -> str:
                 + (f", {summ['mesh_degrades']} mesh degrade(s) "
                    f"({summ.get('lanes_evacuated', 0)} lane(s) evacuated)"
                    if summ.get("mesh_degrades") else ""))
+        if summ and summ.get("cache_hits") is not None:
+            # content-addressed result cache totals (the slot appears
+            # only when the cache was armed)
+            add(f"  result cache: {summ['cache_hits']} hit(s), "
+                f"{summ.get('cache_coalesced', 0)} coalesced, "
+                f"{summ.get('cache_misses', 0)} miss(es), "
+                f"{summ.get('cache_stores', 0)} store(s), "
+                f"{summ.get('cache_entries', 0)} resident")
         rebuilds = sv.get("rebuilds") or []
         if rebuilds:
             # fault-plane recoveries: pool teardown/rebuild + poison
@@ -297,6 +305,16 @@ def render(doc: dict, width: int = 48) -> str:
                 f"{rec.get('failed', 0)} failed "
                 f"({rec.get('records', 0)} record(s), high water "
                 f"{rec.get('high_water')}, {rec.get('wall_s')}s)")
+        cache = nf.get("cache")
+        if cache:
+            # net_cache per-request outcomes (manifest aggregates the
+            # stream to action counts; hit/coalesced are the dedup wins,
+            # promote is a follower recomputing after leader loss)
+            order = ("hit", "coalesced", "miss", "store", "promote")
+            parts = [f"{cache[a]} {a}" for a in order if cache.get(a)]
+            parts += [f"{n} {a}" for a, n in sorted(cache.items())
+                      if a not in order]
+            add("  result cache: " + ", ".join(parts))
 
     ph = doc.get("phases") or {}
     totals = ph.get("totals") or {}

@@ -142,6 +142,31 @@ def _semantic_problems(record: dict) -> list[str]:
             v = record.get(fieldname)
             if isinstance(v, int) and not isinstance(v, bool) and v < 0:
                 problems.append(f"net_recover: {fieldname} {v} < 0")
+    # content-addressed result cache + single-flight coalescing: cache
+    # actions come from a closed vocabulary, the hit tier (when named)
+    # is mem/disk, a coalesced follower always names its leader, and
+    # tenants are never empty — the cache A/B and chaos_fleet
+    # ``--result-cache`` artifacts stay machine-checkable end to end
+    elif kind == "net_cache":
+        action = record.get("action")
+        if action not in ("hit", "miss", "coalesced", "store",
+                          "promote"):
+            problems.append(
+                f"net_cache: action {action!r} not in "
+                f"('hit', 'miss', 'coalesced', 'store', 'promote')")
+        if record.get("tenant") == "":
+            problems.append("net_cache: empty tenant")
+        source = record.get("source")
+        if source is not None and source not in ("mem", "disk"):
+            problems.append(
+                f"net_cache: source {source!r} not in ('mem', 'disk')")
+        if action == "coalesced" and not record.get("cached_from"):
+            problems.append(
+                "net_cache: coalesced follower without a cached_from "
+                "leader ticket")
+        v = record.get("v")
+        if isinstance(v, int) and not isinstance(v, bool) and v < 0:
+            problems.append(f"net_cache: v {v} < 0")
     # closed-loop robustness controllers (PR 17): probe actions and
     # brownout transitions come from closed vocabularies, backoffs and
     # levels stay in range — chaos_fleet's artifacts stay
