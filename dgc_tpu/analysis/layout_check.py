@@ -77,7 +77,8 @@ DEFAULT_SPECS = (
                       "CARRY_P1", "CARRY_S1", "CARRY_ST1", "CARRY_USED",
                       "CARRY_P2", "CARRY_S2", "CARRY_ST2", "T_US",
                       "T_PREV", "CARRY_RUNG", "CARRY_NC",
-                      "CARRY_IDX_RUNG", "CARRY_IDX", "OUT0"),
+                      "CARRY_IDX_RUNG", "CARRY_IDX", "CARRY_SPEC",
+                      "OUT0"),
         var_names=("carry", "out_src"),
         extra_modules=("dgc_tpu/serve/engine.py", "tests/test_serve.py"),
         shared_body=(("batched_sweep_kernel", "batched_slice_kernel",

@@ -80,6 +80,11 @@ LOCK_FILES = ("dgc_tpu/obs/metrics.py", "dgc_tpu/obs/httpd.py",
               # threads and worker done-callbacks race on the LRU and
               # its stats under the cache lock
               "dgc_tpu/serve/resultcache.py",
+              # speculative minimal-k: the proxy engine's window map is
+              # sweep-thread-confined, but it seats/cancels scheduler
+              # calls whose state worker callbacks mutate under the
+              # scheduler lock
+              "dgc_tpu/serve/speculate.py",
               "tools/soak.py", "bench.py")
 TRANSFER_FILES = ("dgc_tpu/serve/batched.py", "dgc_tpu/serve/engine.py")
 

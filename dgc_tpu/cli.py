@@ -77,6 +77,19 @@ def build_parser() -> argparse.ArgumentParser:
         action="store_true",
         help="decrement k one-by-one like the reference instead of jumping to colors_used-1",
     )
+    p.add_argument(
+        "--speculate-k", type=str, default=None, metavar="DEPTH|auto",
+        help="speculative minimal-k: route the sweep through a "
+             "one-request serve pool (serve.speculate) that keeps the "
+             "next DEPTH budgets' attempts running in sibling lanes "
+             "while the driver consumes the current one — the outer "
+             "k-loop in parallel, byte-identical results; 'auto' "
+             "prices the depth off the lane count. The win needs "
+             "--strict-decrement (jump mode fuses find+confirm — "
+             "nothing to speculate); the sweep runs on the batched "
+             "serve kernel, so --backend applies only to the "
+             "speculation-free path",
+    )
     p.add_argument("--checkpoint-dir", type=str, default=None, help="checkpoint/resume directory")
     p.add_argument(
         "--checkpoint-write-behind", action="store_true",
@@ -438,6 +451,53 @@ def _write_obs_outputs(args, logger, manifest, phases, registry) -> None:
         logger.event("metrics_written", path=args.metrics_prom)
 
 
+def _speculative_sweep(args, graph, k0, depth, validate, on_attempt,
+                       post_reduce, logger, phases):
+    """Route a single-graph sweep through a one-request serve pool with
+    the speculative minimal-k driver (``serve.speculate``): sibling
+    lanes of the batched serve kernel run the next ``depth`` budgets'
+    attempts while the driver consumes the current one — the outer
+    k-loop in parallel, byte-identical results by attempt determinism.
+    Returns the sweep result, or None when the route cannot apply (the
+    graph is beyond the serve shape ladder) so the caller falls back to
+    the normal engine path."""
+    from dgc_tpu.serve.engine import BatchScheduler
+    from dgc_tpu.serve.shape_classes import DEFAULT_LADDER, pad_member
+    from dgc_tpu.serve.speculate import SpeculativeMinimalKEngine
+
+    cls = DEFAULT_LADDER.class_for(graph.num_vertices, graph.max_degree)
+    if cls is None:
+        print("# --speculate-k: graph beyond the serve shape ladder; "
+              "running the speculation-free path", file=sys.stderr)
+        return None
+    if not args.strict_decrement:
+        print("# --speculate-k: jump mode fuses find+confirm (nothing "
+              "to speculate); add --strict-decrement for the "
+              "parallel-window win", file=sys.stderr)
+    if args.checkpoint_dir:
+        print("# --speculate-k: checkpointing does not apply to the "
+              "serve-pool route; running without", file=sys.stderr)
+    with phases.section("host_engine_build"):
+        # one-request pool: one lane for the driver's own claims plus
+        # the window's `depth` sibling lanes
+        sched = BatchScheduler(
+            batch_max=depth + 1, mode="continuous",
+            on_event=lambda kind, rec: logger.event(kind, **rec))
+        sched.start()
+        engine = SpeculativeMinimalKEngine(pad_member(graph.arrays, cls),
+                                           sched, depth=depth)
+    try:
+        with phases.section("sweep_total"):
+            return find_minimal_coloring(
+                engine, initial_k=k0,
+                strict_decrement=args.strict_decrement,
+                validate=validate, on_attempt=on_attempt,
+                post_reduce=post_reduce)
+    finally:
+        engine.close()
+        sched.stop()
+
+
 def _run(args, logger: RunLogger) -> int:
     t_start = time.perf_counter()
     if not hasattr(args, "_ckpts"):
@@ -541,6 +601,30 @@ def _run(args, logger: RunLogger) -> int:
     resilient = bool(args.retries > 0 or args.attempt_timeout > 0
                      or args.fallback_ladder or args.inject_faults
                      or args.reshard_on_loss)
+    # speculative minimal-k (serve.speculate): parse the window depth up
+    # front so a bad value fails before device init; the route itself
+    # happens in the non-resilient branch below (the supervised ladder
+    # drives its rung engines directly — no pool to speculate in)
+    spec_depth = None
+    if getattr(args, "speculate_k", None):
+        if args.speculate_k == "auto":
+            from dgc_tpu.serve.speculate import AUTO_DEPTH_CAP
+
+            spec_depth = AUTO_DEPTH_CAP
+        else:
+            try:
+                spec_depth = int(args.speculate_k)
+                if spec_depth < 1:
+                    raise ValueError
+            except ValueError:
+                print(f"--speculate-k must be a positive integer or "
+                      f"'auto', got {args.speculate_k!r}", file=sys.stderr)
+                return 2
+        if resilient:
+            print("# --speculate-k ignored with the resilience flags: "
+                  "the supervised ladder drives engines directly",
+                  file=sys.stderr)
+            spec_depth = None
     if args.inject_faults:
         try:
             schedule = faults.FaultSchedule.parse(args.inject_faults)
@@ -706,26 +790,34 @@ def _run(args, logger: RunLogger) -> int:
                       file=sys.stderr)
                 return ab.rc
     else:
-        with phases.section("host_engine_build"):
-            engine = make_engine(args, graph, logger=logger)
-        if (args.superstep_timing and telemetry
-                and hasattr(engine, "record_timing")):
-            # the trajectory buffer's col-5 timing column (obs.devclock)
-            engine.record_timing = True
-        engine = ObservedEngine(engine, phases=phases, registry=registry,
-                                record_trajectory=telemetry)
-        if profile_window is not None:
-            engine = profile_window.wrap(engine)
-        with phases.section("sweep_total"):
-            result = find_minimal_coloring(
-                engine,
-                initial_k=k0,
-                strict_decrement=args.strict_decrement,
-                validate=make_validator(graph.arrays),
-                on_attempt=on_attempt,
-                checkpoint=make_ckpt(args.backend),
-                post_reduce=make_post_reduce(args.backend),
-            )
+        result = None
+        if spec_depth is not None:
+            result = _speculative_sweep(
+                args, graph, k0, spec_depth,
+                make_validator(graph.arrays), on_attempt,
+                make_post_reduce("ell-compact"), logger, phases)
+        if result is None:
+            with phases.section("host_engine_build"):
+                engine = make_engine(args, graph, logger=logger)
+            if (args.superstep_timing and telemetry
+                    and hasattr(engine, "record_timing")):
+                # the trajectory buffer's col-5 timing column (obs.devclock)
+                engine.record_timing = True
+            engine = ObservedEngine(engine, phases=phases,
+                                    registry=registry,
+                                    record_trajectory=telemetry)
+            if profile_window is not None:
+                engine = profile_window.wrap(engine)
+            with phases.section("sweep_total"):
+                result = find_minimal_coloring(
+                    engine,
+                    initial_k=k0,
+                    strict_decrement=args.strict_decrement,
+                    validate=make_validator(graph.arrays),
+                    on_attempt=on_attempt,
+                    checkpoint=make_ckpt(args.backend),
+                    post_reduce=make_post_reduce(args.backend),
+                )
     phases.log_device_memory()
     if profile_window is not None:
         # a sweep that converged before dispatch K+W-1 leaves the window

@@ -3,7 +3,8 @@
 These fixed-shape int32 contracts cross module (and host/device)
 boundaries and have historically been hand-maintained in lockstep at
 every growth (PR 6 grew the serve carry 13 slots, PR 7 to 15, PR 9 to
-17; PR 3/5/7 grew the trajectory row 4→5→6 columns):
+17, the speculative minimal-k PR to 20; PR 3/5/7 grew the trajectory
+row 4→5→6 columns):
 
 - the **serve slice carry** — the per-lane state tuple
   ``serve.batched.batched_slice_kernel`` round-trips host↔device every
@@ -37,7 +38,8 @@ from __future__ import annotations
 # (phase, k, packed, step, prev_active, stall,   -- live sweep state
 #  p1, s1, st1, used, p2, s2, st2,               -- jump-pair result slots
 #  t_us, t_prev,                                 -- in-kernel timing slots
-#  rung, nc, idx_rung, idx)                      -- frontier-ladder stage state
+#  rung, nc, idx_rung, idx,                      -- frontier-ladder stage state
+#  spec)                                         -- speculation tag
 CARRY_PHASE = 0        # 0 first attempt, 1 confirm, >=2 done/idle
 CARRY_K = 1            # live color budget
 CARRY_PACKED = 2       # packed per-vertex color/freshness state
@@ -57,7 +59,10 @@ CARRY_RUNG = 15        # compaction-stage ladder rung the lane has reached
 CARRY_NC = 16          # lane's live frontier after its last superstep
 CARRY_IDX_RUNG = 17    # rung the lane's compacted slot list was built at
 CARRY_IDX = 18         # compacted slot list (int32[A0]; dummy = V_pad)
-CARRY_LEN = 19
+CARRY_SPEC = 19        # speculation tag: nonzero = attempt-only lane
+#                        (skips the fused confirm; cancellable at slice
+#                        boundaries via the cancel mask input)
+CARRY_LEN = 20
 
 OUT0 = 6               # first result slot (== CARRY_P1)
 N_OUT = 7              # result slots p1..st2

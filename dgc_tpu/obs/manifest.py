@@ -137,7 +137,8 @@ class RunManifest:
         elif (kind.startswith("serve_")
               or kind in ("lane_recycled", "slice_recalibrated",
                           "lane_rebuild", "mesh_degrade",
-                          "mesh_restore")):
+                          "mesh_restore", "spec_seated", "spec_win",
+                          "spec_cancelled")):
             # serving path (dgc_tpu.serve) — the slot appears only when
             # serve events do, so non-serve manifests stay byte-identical
             serve = self.doc.setdefault(
@@ -166,6 +167,27 @@ class RunManifest:
                 # direction — the degraded tier's restart provenance
                 serve.setdefault("mesh_events", []).append(
                     dict(fields, event=kind))
+            elif kind in ("spec_seated", "spec_win", "spec_cancelled"):
+                # speculative minimal-k plane: per-attempt events
+                # aggregate to counts (a deep sweep seats dozens) — the
+                # slot key appears only when speculation is armed, so
+                # speculation-off manifests stay byte-identical
+                spec = serve.setdefault(
+                    "speculation", {"seated": 0, "wins": 0,
+                                    "claims_ready": 0, "cancelled": {},
+                                    "wasted_steps": 0})
+                if kind == "spec_seated":
+                    spec["seated"] += 1
+                elif kind == "spec_win":
+                    spec["wins"] += 1
+                    if fields.get("ready"):
+                        spec["claims_ready"] += 1
+                else:
+                    where = fields.get("where", "?")
+                    spec["cancelled"][where] = (
+                        spec["cancelled"].get(where, 0) + 1)
+                    spec["wasted_steps"] += int(
+                        fields.get("wasted_steps", 0) or 0)
             elif kind == "serve_warmup":
                 serve["warmup"] = fields
             elif kind == "serve_request":

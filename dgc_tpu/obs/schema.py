@@ -106,7 +106,11 @@ EVENT_SCHEMAS: dict = {
          # multi-device serve tier (--mesh-devices): the resolved lane
          # mesh size — present ONLY when the lane axis is sharded, so
          # the unsharded event stream stays byte-identical
-         "mesh_devices": "int"}),
+         "mesh_devices": "int",
+         # speculative minimal-k (serve.speculate): the resolved window
+         # depth — present ONLY when speculation is armed, so the
+         # unarmed event stream stays byte-identical
+         "speculate_k": "int"}),
     "serve_batch": (
         {"shape_class": "str", "batch": "int", "occupancy": NUM,
          "padding_waste": NUM},
@@ -139,7 +143,28 @@ EVENT_SCHEMAS: dict = {
          "h2d_bytes": "int", "d2h_bytes": "int",
          # lane-mesh occupancy (mesh mode only): live lanes per device /
          # the device's lane count — the sharded tier's utilization
-         "mesh_devices": "int", "device_occupancy": "list"}),
+         "mesh_devices": "int", "device_occupancy": "list",
+         # speculation plane (armed runs only): live speculative lanes
+         # after the slice, speculative seats this slice, and cancelled
+         # speculative lanes dropped at this boundary
+         "spec_live": "int", "spec_admitted": "int",
+         "spec_killed": "int"}),
+    # speculative minimal-k (serve.speculate): one spec_seated per
+    # speculative attempt seated into an idle lane, one spec_win per
+    # attempt claimed by its driver at the budget the sequential
+    # schedule reached (ready = the lane had already finished), one
+    # spec_cancelled per attempt killed before its claim (reason e.g.
+    # "sweep failed"/"superseded"/"preempted"/"evacuated"; where ∈
+    # {"queue", "lane", "done"} — validate_runlog enforces the
+    # vocabulary and wasted-superstep non-negativity)
+    "spec_seated": (
+        {"shape_class": "str", "lane": "int", "k": "int"}, {}),
+    "spec_win": (
+        {"shape_class": "str", "k": "int", "ready": "bool"}, {}),
+    "spec_cancelled": (
+        {"shape_class": "str", "k": "int", "reason": "str",
+         "where": "str"},
+        {"wasted_steps": "int"}),
     "lane_recycled": (
         {"shape_class": "str", "lane": "int"},
         {"k": "int", "depth_bucket": "int", "slices": "int",
@@ -211,6 +236,9 @@ EVENT_SCHEMAS: dict = {
     # follower promoted to recompute after leader loss ("promote").
     # Action vocabulary and count non-negativity are enforced by
     # tools/validate_runlog.py
+    # ("evict" = a disk-store entry unlinked by the GC sweep — reason
+    # "ttl" or "max_bytes"; "recover_fill" = a journal-recovered
+    # delivered result inserted on startup)
     "net_cache": (
         {"action": "str"},
         {"tenant": ("str", "null"), "ticket": ("str", "null"),
@@ -218,7 +246,9 @@ EVENT_SCHEMAS: dict = {
          "source": "str",
          # provenance: the ticket whose compute produced the colors
          "cached_from": ("str", "null"),
-         "key": "str", "v": "int"}),
+         "key": "str", "v": "int",
+         # disk-GC eviction context (evict only)
+         "reason": "str", "bytes": "int"}),
     # continuous SLO burn-rate telemetry (obs.timeseries): one event per
     # objective whose fast AND slow trailing-window burns crossed the
     # threshold; ``dump``/``profile`` record the diagnostics the firing
@@ -348,7 +378,13 @@ EVENT_SCHEMAS: dict = {
          # published, and the LRU's final population
          "cache_hits": "int", "cache_misses": "int",
          "cache_coalesced": "int", "cache_stores": "int",
-         "cache_entries": "int"}),
+         "cache_entries": "int",
+         # speculation plane (present only when an attempt actually
+         # speculated): seats, claimed wins, cancellations (preemptions
+         # a subset), and the supersteps cancelled lanes burnt
+         "spec_seated": "int", "spec_wins": "int",
+         "spec_cancelled": "int", "spec_preempted": "int",
+         "spec_wasted_steps": "int"}),
 }
 
 

@@ -133,8 +133,8 @@ import numpy as np
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus
 from dgc_tpu.engine.bucketed import decode_combined, initial_packed, status_step
 from dgc_tpu.engine.compact import _check_stage_ladder, _compact_idx
-from dgc_tpu.layout import (CARRY_LEN, CARRY_PHASE, MESH_AXIS, N_OUT, OUT0,
-                            T_PREV, T_US)
+from dgc_tpu.layout import (CARRY_K, CARRY_LEN, CARRY_PHASE, CARRY_SPEC,
+                            MESH_AXIS, N_OUT, OUT0, T_PREV, T_US)
 from dgc_tpu.ops.speculative import speculative_update_mc
 
 _RUNNING = AttemptStatus.RUNNING
@@ -149,10 +149,20 @@ DEFAULT_STALL_WINDOW = 64  # the engines' shared defensive exit
 # (phase, k, packed, step, prev_active, stall,   -- live sweep state
 #  p1, s1, st1, used, p2, s2, st2,               -- jump-pair result slots
 #  t_us, t_prev,                                 -- in-kernel timing slots
-#  rung, nc)                                     -- ladder stage state
+#  rung, nc,                                     -- ladder stage state
+#  idx_rung, idx, spec)                          -- slot list + spec tag
 # The timing slots ride inert (zeros) unless the kernel is compiled with
 # ``timing=True`` (obs.devclock); rung/nc track the lane's compaction-
 # stage rung and last compacted slot count (v_pad for full-table).
+#
+# The ``spec`` slot is the speculative-minimal-k plane's per-lane tag
+# (layout.CARRY_SPEC): nonzero marks an ATTEMPT-ONLY lane — it finishes
+# after its first attempt instead of deriving the fused confirm (the
+# speculative driver claims single attempts, never pairs), and it is the
+# only kind of lane the slice kernel's ``cancel`` input may kill at a
+# slice boundary. All-zero tags (every non-speculative caller) make both
+# mechanisms compile to the identity, so the PR-era event/result stream
+# is byte-identical when speculation is off.
 
 
 def _resolve_stages(stages, v: int):
@@ -206,7 +216,8 @@ def _fresh_lanes(degrees, k0, a0: int):
             zeros_v, z, jnp.full((b,), int(_FAILURE), jnp.int32),  # slot 2
             z, z,                                       # timing slots
             z, z,                                       # rung, frontier
-            z, jnp.full((b, a0), v, jnp.int32))         # idx_rung, idx
+            z, jnp.full((b, a0), v, jnp.int32),         # idx_rung, idx
+            z)                                          # spec tag
 
 
 def _lane_superstep_math(pk_rows, np_, beats, k, planes: int):
@@ -295,7 +306,7 @@ def _superstep_body(c, comb, packed0, max_steps, v: int, *,
     """
     (phase, k, packed, step, prev_active, stall,
      p1, s1, st1, used, p2, s2, st2, t_us, t_prev, rung, nc,
-     idx_rung, idx) = c
+     idx_rung, idx, spec) = c
     live = phase < 2
     first = phase == 0
     n_stages = len(stages)
@@ -395,7 +406,10 @@ def _superstep_body(c, comb, packed0, max_steps, v: int, *,
         jnp.any(fin & live), _boundary,
         lambda op: op + (used,), (new_packed, p1, p2))
     k2 = used_new - 1
-    run2 = fin & first & (status_fin == _SUCCESS) & (k2 >= 1)
+    # attempt-only lanes (spec tag set) never derive the confirm: the
+    # speculative driver claims exact single attempts — spec == 0
+    # everywhere makes this the PR-era jump-pair transition verbatim
+    run2 = fin & first & (status_fin == _SUCCESS) & (k2 >= 1) & (spec == 0)
 
     if timing:
         from dgc_tpu.obs.devclock import kernel_clock_us, wrap_delta_us_jax
@@ -430,6 +444,7 @@ def _superstep_body(c, comb, packed0, max_steps, v: int, *,
         # until the next stage-entry rebuild overwrites it
         jnp.where(fin, 0, idx_rung_new).astype(jnp.int32),
         idx_new,
+        spec,
     )
 
     # freeze finished lanes: each element selected on its OWN live mask
@@ -469,9 +484,9 @@ def _sweep_kernel(comb, degrees, k0, max_steps, *, planes: int,
     return out[OUT0:OUT0 + N_OUT]
 
 
-def _slice_kernel(comb, degrees, k0, max_steps, reset, carry, *,
-                  planes: int, slice_steps: int, stall_window: int,
-                  timing: bool, stages):
+def _slice_kernel(comb, degrees, k0, max_steps, reset, carry, spec=None,
+                  cancel=None, *, planes: int, slice_steps: int,
+                  stall_window: int, timing: bool, stages):
     v = degrees.shape[1]
     stages, pads, a0 = _resolve_stages(stages, v)
     packed0 = initial_packed(degrees)
@@ -480,6 +495,20 @@ def _slice_kernel(comb, degrees, k0, max_steps, reset, carry, *,
         jnp.where(fresh if jnp.ndim(f) == 1 else fresh[:, None], f,
                   jnp.asarray(c))
         for f, c in zip(_fresh_lanes(degrees, k0, a0), carry))
+    if spec is not None or cancel is not None:
+        # speculation plane: seat the per-lane spec tag on re-init, and
+        # kill cancelled speculative lanes at the slice boundary (phase
+        # := done before any superstep runs — the lane freezes
+        # deliverable-free and the scheduler recycles it). Reset wins
+        # over cancel (~fresh): a same-slice reseat is a fresh lane.
+        b = degrees.shape[0]
+        zb = jnp.zeros((b,), jnp.int32)
+        spec_in = zb if spec is None else jnp.asarray(spec, jnp.int32)
+        cancel_in = zb if cancel is None else jnp.asarray(cancel, jnp.int32)
+        spec_slot = jnp.where(fresh, spec_in, carry[CARRY_SPEC])
+        killed = (cancel_in != 0) & (spec_slot != 0) & ~fresh
+        phase = jnp.where(killed, jnp.int32(2), carry[CARRY_PHASE])
+        carry = (phase,) + carry[CARRY_K:CARRY_SPEC] + (spec_slot,)
     if timing:
         from dgc_tpu.obs.devclock import kernel_clock_us
 
@@ -525,6 +554,7 @@ def batched_sweep_kernel(comb, degrees, k0, max_steps, planes: int,
 @partial(jax.jit, static_argnames=("planes", "slice_steps", "stall_window",
                                    "timing", "stages"))
 def batched_slice_kernel(comb, degrees, k0, max_steps, reset, carry,
+                         spec=None, cancel=None, *,
                          planes: int, slice_steps: int,
                          stall_window: int = DEFAULT_STALL_WINDOW,
                          timing: bool = False, stages=None):
@@ -537,9 +567,13 @@ def batched_slice_kernel(comb, degrees, k0, max_steps, reset, carry,
     telemetry. ``timing`` (static) accumulates each lane's live
     superstep wall-µs into carry slot :data:`T_US` (``obs.devclock``;
     the scheduler's dispatch-overhead split) — the sweep outputs are
-    byte-identical either way. One jit cache entry per (B, V_pad,
-    W_pad, planes, slice_steps, timing, stages)."""
+    byte-identical either way. ``spec``/``cancel`` (optional int32[B])
+    are the speculation plane's seat-tag and slice-boundary kill
+    vectors (module docstring); omitting them compiles the PR-era
+    kernel. One jit cache entry per (B, V_pad, W_pad, planes,
+    slice_steps, timing, stages)."""
     return _slice_kernel(comb, degrees, k0, max_steps, reset, carry,
+                         spec, cancel,
                          planes=planes, slice_steps=slice_steps,
                          stall_window=stall_window, timing=timing,
                          stages=stages)
@@ -570,6 +604,7 @@ _donated_seat_jit = partial(
 
 @_donated_slice_jit
 def batched_slice_kernel_donated(comb, degrees, k0, max_steps, reset, carry,
+                                 spec=None, cancel=None, *,
                                  planes: int, slice_steps: int,
                                  stall_window: int = DEFAULT_STALL_WINDOW,
                                  timing: bool = False, stages=None):
@@ -581,6 +616,7 @@ def batched_slice_kernel_donated(comb, degrees, k0, max_steps, reset, carry,
     carry buffers are additionally DONATED and re-entered in place
     (see :data:`_DONATE_CARRY` for why that is opt-in)."""
     return _slice_kernel(comb, degrees, k0, max_steps, reset, carry,
+                         spec, cancel,
                          planes=planes, slice_steps=slice_steps,
                          stall_window=stall_window, timing=timing,
                          stages=stages)
@@ -748,7 +784,9 @@ def _sharded_slice_jit(mesh, planes: int, slice_steps: int,
     fn = partial(_slice_kernel, planes=planes, slice_steps=slice_steps,
                  stall_window=stall_window, timing=timing, stages=stages)
     kw = {"donate_argnums": (5,)} if (donate and _DONATE_CARRY) else {}
-    return jax.jit(fn, in_shardings=(lane, lane, lane, lane, lane, lane),
+    # 8 lane-sharded positional args: the five input stacks/vectors, the
+    # carry tuple, and the speculation plane's spec/cancel [B] vectors
+    return jax.jit(fn, in_shardings=(lane,) * 8,
                    out_shardings=lane, **kw)
 
 
@@ -792,9 +830,21 @@ def batched_sweep_kernel_sharded(mesh, comb, degrees, k0, max_steps,
         comb, degrees, k0, max_steps)
 
 
+def _spec_vectors(spec, cancel, b: int):
+    """Materialize the speculation-plane vectors for the sharded jits
+    (whose in-shardings need real leaves): omitted vectors become the
+    all-zeros no-op tags, preserving byte-identity with the PR-era
+    dispatch."""
+    if spec is None:
+        spec = np.zeros(b, np.int32)
+    if cancel is None:
+        cancel = np.zeros(b, np.int32)
+    return spec, cancel
+
+
 def batched_slice_kernel_sharded(mesh, comb, degrees, k0, max_steps,
-                                 reset, carry, planes: int,
-                                 slice_steps: int,
+                                 reset, carry, spec=None, cancel=None, *,
+                                 planes: int, slice_steps: int,
                                  stall_window: int = DEFAULT_STALL_WINDOW,
                                  timing: bool = False, stages=None):
     """:func:`batched_slice_kernel` with every batch-leading input and
@@ -802,13 +852,15 @@ def batched_slice_kernel_sharded(mesh, comb, degrees, k0, max_steps,
     dispatch). Host numpy inputs shard on upload; the returned carry is
     lane-sharded (out-shardings pinned, so re-entering it reshards
     nothing)."""
+    spec, cancel = _spec_vectors(spec, cancel, degrees.shape[0])
     return _sharded_slice_jit(mesh, planes, slice_steps, stall_window,
                               timing, stages, False)(
-        comb, degrees, k0, max_steps, reset, carry)
+        comb, degrees, k0, max_steps, reset, carry, spec, cancel)
 
 
 def batched_slice_kernel_sharded_donated(mesh, comb, degrees, k0,
                                          max_steps, reset, carry,
+                                         spec=None, cancel=None, *,
                                          planes: int, slice_steps: int,
                                          stall_window: int =
                                          DEFAULT_STALL_WINDOW,
@@ -820,9 +872,10 @@ def batched_slice_kernel_sharded_donated(mesh, comb, degrees, k0,
     behind ``DGC_TPU_DONATE_CARRY`` with the same non-donated fallback
     as the single-device twin (the jax-0.4.37 persistent-cache aliasing
     bug is placement-independent)."""
+    spec, cancel = _spec_vectors(spec, cancel, degrees.shape[0])
     return _sharded_slice_jit(mesh, planes, slice_steps, stall_window,
                               timing, stages, True)(
-        comb, degrees, k0, max_steps, reset, carry)
+        comb, degrees, k0, max_steps, reset, carry, spec, cancel)
 
 
 def seat_lane_kernel_sharded(mesh, comb, degrees, k0, max_steps, reset,
@@ -869,7 +922,8 @@ def idle_carry(b_pad: int, v_pad: int, a_pad: int = 1):
             pk.copy(), z.copy(), np.full(b_pad, int(_FAILURE), np.int32),
             z.copy(), z.copy(),
             z.copy(), z.copy(),
-            z.copy(), np.full((b_pad, a_pad), v_pad, np.int32))
+            z.copy(), np.full((b_pad, a_pad), v_pad, np.int32),
+            z.copy())
 
 
 def lane_outputs(carry, lane: int):
@@ -961,3 +1015,15 @@ def finish_pair(member, p1, s1, st1, used, p2, s2, st2, attempt_fallback):
         lambda k2: _finish(p2, st2, s2, k2),
         v, attempt_fallback,
     )
+
+
+def finish_attempt(member, p1, s1, st1, k: int) -> AttemptResult:
+    """Host epilogue for one ATTEMPT-ONLY lane (spec-tagged — the
+    speculative minimal-k plane): decode the first-attempt result slots
+    exactly as :func:`finish_pair` decodes slot 1, so a claimed
+    speculative attempt is byte-identical to the attempt the sequential
+    driver would have computed at the same ``(graph, k)``."""
+    v = member.num_vertices
+    packed = np.asarray(p1)[:v]
+    colors = np.where(packed >= 0, packed >> 1, -1).astype(np.int32)
+    return AttemptResult(AttemptStatus(int(st1)), colors, int(s1), int(k))

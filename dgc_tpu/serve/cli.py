@@ -94,6 +94,20 @@ def build_serve_parser() -> argparse.ArgumentParser:
                         "atomic rename and survive restarts; a fleet's "
                         "replicas share one DIR (torn or corrupt "
                         "entries read as misses, never errors)")
+    p.add_argument("--result-cache-ttl", type=float, default=0.0,
+                   metavar="SECONDS",
+                   help="disk-store GC age bound (with "
+                        "--result-cache-dir): entries older than "
+                        "SECONDS are atomically unlinked by the "
+                        "store-time sweep (a concurrent reader of a "
+                        "dying entry gets a clean miss); 0 (default) "
+                        "keeps entries forever")
+    p.add_argument("--result-cache-max-bytes", type=int, default=0,
+                   metavar="BYTES",
+                   help="disk-store GC size bound (with "
+                        "--result-cache-dir): when the store exceeds "
+                        "BYTES the sweep evicts oldest-written entries "
+                        "until it fits; 0 (default) = unbounded")
     p.add_argument("--replicas", type=int, default=1, metavar="N",
                    help="replicated serve fleet (serve.fleet): supervise "
                         "N listener replicas sharing --listen's port via "
@@ -167,6 +181,19 @@ def build_serve_parser() -> argparse.ArgumentParser:
     p.add_argument("--batch-max", type=int, default=8,
                    help="max graphs per batched dispatch / lane pool "
                         "(default 8)")
+    p.add_argument("--speculate-k", type=str, default=None,
+                   metavar="DEPTH|auto",
+                   help="speculative minimal-k (serve.speculate): keep "
+                        "a window of DEPTH attempts at budgets below "
+                        "the live one seated in otherwise-idle lanes, "
+                        "priority strictly below real traffic "
+                        "(cancelled at slice boundaries when real "
+                        "requests need the lanes); 'auto' prices the "
+                        "depth off the free-lane count. Engages on "
+                        "strict-decrement sweeps (the single-graph "
+                        "CLI's --speculate-k route); jump-mode serve "
+                        "requests run the fused pair unchanged. Unset "
+                        "(default) = the exact speculation-free path")
     p.add_argument("--serve-mode", choices=["continuous", "sync"],
                    default="continuous",
                    help="continuous (default): lane recycling — finished "
@@ -419,6 +446,8 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
 
         resultcache = ResultCache(
             args.result_cache, cache_dir=args.result_cache_dir,
+            ttl_s=args.result_cache_ttl,
+            max_bytes=args.result_cache_max_bytes,
             engine_key=(f"v{__version__};"
                         f"validate={int(not args.no_validate)};"
                         f"post_reduce={int(not args.no_reduce_colors)};"
@@ -490,6 +519,14 @@ def _listen_main(args, front, logger, registry, manifest, recorder,
         # stays byte-identical)
         summary_kw["mesh_degrades"] = sst["mesh_degrades"]
         summary_kw["lanes_evacuated"] = sst.get("lanes_evacuated", 0)
+    if sst.get("spec_seated") or sst.get("spec_cancelled"):
+        # speculation plane: totals appear only when an attempt actually
+        # speculated (speculation-off summaries stay byte-identical)
+        summary_kw["spec_seated"] = sst["spec_seated"]
+        summary_kw["spec_wins"] = sst["spec_wins"]
+        summary_kw["spec_cancelled"] = sst["spec_cancelled"]
+        summary_kw["spec_preempted"] = sst["spec_preempted"]
+        summary_kw["spec_wasted_steps"] = sst["spec_wasted_steps"]
     if nf.resultcache is not None:
         # result-cache outcome totals appear only when the cache is on
         # (cache-off summaries stay byte-identical)
@@ -702,6 +739,21 @@ def serve_main(argv: list[str] | None = None) -> int:
             print(f"--mesh-devices must be 'auto' or an integer, got "
                   f"{args.mesh_devices!r}", file=sys.stderr)
             return 2
+    speculate_k = args.speculate_k
+    if speculate_k is not None and speculate_k != "auto":
+        try:
+            speculate_k = int(speculate_k)
+            if speculate_k < 1:
+                raise ValueError
+        except ValueError:
+            print(f"--speculate-k must be a positive integer or 'auto', "
+                  f"got {args.speculate_k!r}", file=sys.stderr)
+            return 2
+    if ((args.result_cache_ttl or args.result_cache_max_bytes)
+            and not args.result_cache_dir):
+        print("# --result-cache-ttl/--result-cache-max-bytes ignored "
+              "without --result-cache-dir: the in-memory LRU is already "
+              "bounded by --result-cache N", file=sys.stderr)
     try:
         front = ServeFrontEnd(
             batch_max=args.batch_max, window_s=args.window_ms / 1e3,
@@ -718,6 +770,7 @@ def serve_main(argv: list[str] | None = None) -> int:
             auto_tune=args.auto_tune, tuned_cache=tuned_cache,
             max_lane_aborts=args.max_lane_aborts,
             dispatch_timeout=args.dispatch_timeout,
+            speculate_k=speculate_k,
             logger=logger, registry=registry,
         ).start()
     except ValueError as e:
@@ -846,6 +899,14 @@ def serve_main(argv: list[str] | None = None) -> int:
         # stays byte-identical)
         summary_kw["mesh_degrades"] = sst["mesh_degrades"]
         summary_kw["lanes_evacuated"] = sst.get("lanes_evacuated", 0)
+    if sst.get("spec_seated") or sst.get("spec_cancelled"):
+        # speculation plane: totals appear only when an attempt actually
+        # speculated (speculation-off summaries stay byte-identical)
+        summary_kw["spec_seated"] = sst["spec_seated"]
+        summary_kw["spec_wins"] = sst["spec_wins"]
+        summary_kw["spec_cancelled"] = sst["spec_cancelled"]
+        summary_kw["spec_preempted"] = sst["spec_preempted"]
+        summary_kw["spec_wasted_steps"] = sst["spec_wasted_steps"]
     logger.event("serve_summary", requests=len(requests), completed=done,
                  failed=st["failed"],
                  rejected=st["rejected"],

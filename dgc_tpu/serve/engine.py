@@ -116,6 +116,19 @@ from dgc_tpu.serve.shape_classes import (dummy_member, pad_ladder,
 # batching windows — affinity may reorder, never starve
 _STARVE_WINDOWS = 50.0
 
+# A class whose last speculative submit/seat is within this horizon is
+# "spec-hot": its pool is kept warm (no pop at live==0, no shrink) so
+# the next window generation reuses the lanes instead of rebuilding
+# them — the rebuild (a _resize + full table re-upload) was measured at
+# ~30x a b_pad=1 attempt
+_SPEC_IDLE_S = 0.05
+
+# When a freshly seated wave is entirely unclaimed-speculative, the
+# dispatcher waits up to this long for the rest of the window's
+# speculate() calls before slicing — without it the refill trickle
+# (one submit per claim) seats solo lanes and serializes the window
+_SPEC_COALESCE_S = 500e-6
+
 
 class ServeError(RuntimeError):
     """A request the serving path cannot complete (engine error after
@@ -165,9 +178,11 @@ def priority_window(window_s: float, priority: int) -> float:
 class _SweepCall:
     __slots__ = ("member", "k", "depth", "priority", "done", "result",
                  "error", "t_enqueue", "span", "lane_span", "device_us",
-                 "aborts")
+                 "aborts", "attempt_only", "speculative", "cancelled",
+                 "claimed", "cancel_reason")
 
-    def __init__(self, member, k, span=None, priority=0):
+    def __init__(self, member, k, span=None, priority=0,
+                 attempt_only=False, speculative=False):
         self.member = member
         self.k = int(k)
         self.depth = depth_bucket(k)
@@ -186,6 +201,20 @@ class _SweepCall:
         self.span = span
         self.lane_span = None
         self.device_us = None      # in-kernel superstep µs (timing mode)
+        # speculation plane (speculative minimal-k PR): attempt_only
+        # lanes carry the kernel spec tag (the fused confirm is skipped
+        # and the lane is cancellable at slice boundaries); speculative
+        # calls additionally seat BELOW every real pending call and may
+        # be cancelled/preempted before delivery. cancelled/claimed/
+        # cancel_reason are check-and-marked under the scheduler's
+        # _lock — the claim/cancel/preempt races all resolve there
+        # (preemption only ever cancels UNCLAIMED speculative calls;
+        # a driver never cancels a call it will claim).
+        self.attempt_only = bool(attempt_only)
+        self.speculative = bool(speculative)
+        self.cancelled = False       # guarded-by: scheduler._lock
+        self.claimed = False         # guarded-by: scheduler._lock
+        self.cancel_reason = None    # guarded-by: scheduler._lock
 
 
 class _LanePool:   # dgc-lint: owned-by dispatcher
@@ -633,6 +662,20 @@ class BatchScheduler:
         # the Condition wraps an RLock, so guarded sections nest freely
         self._lock = threading.Condition()
         self._pending: dict = {}   # class -> [_SweepCall]; guarded-by: _lock
+        # speculation plane: pending speculative calls, seated only into
+        # capacity left over AFTER every real pending call (never before
+        # real traffic); sticky _spec_used flips once at the first
+        # speculative/attempt-only submission — from then on the slice
+        # kernels take the spec/cancel vectors (all-zero vectors compile
+        # to the identity, so already-running classes stay bit-identical
+        # across the flip)
+        self._spec_pending: dict = {}  # class -> [_SweepCall]; guarded-by: _lock
+        self._spec_used = False        # guarded-by: _lock (sticky)
+        # last speculative submit/seat per class: while a class is
+        # "spec-hot" its pool is kept warm across the window-generation
+        # gaps of an active speculative sweep (no pop, no shrink — each
+        # would charge the next generation a full lane rebuild)
+        self._spec_last: dict = {}     # class -> perf_counter s; guarded-by: _lock
         self._kernels: dict = {}   # compile-cache key -> fn; guarded-by: _lock
         self._dummies: dict = {}   # class -> ServeMember; guarded-by: _lock
         self._class_stages: dict = {}  # class -> stages|None; guarded-by: _lock
@@ -651,7 +694,14 @@ class BatchScheduler:
                       # failure-domain plane: mesh degrades/restores and
                       # the live lanes evacuated (reseated) across them
                       "mesh_degrades": 0, "mesh_restores": 0,
-                      "lanes_evacuated": 0}   # guarded-by: _lock
+                      "lanes_evacuated": 0,
+                      # speculation plane (speculative minimal-k PR):
+                      # seated / cancelled / preempted speculative
+                      # attempts, claims that paid off, and the
+                      # supersteps burned by killed lanes
+                      "spec_seated": 0, "spec_cancelled": 0,
+                      "spec_preempted": 0, "spec_wins": 0,
+                      "spec_wasted_steps": 0}   # guarded-by: _lock
 
     # -- lifecycle ------------------------------------------------------
     def start(self) -> "BatchScheduler":
@@ -676,6 +726,9 @@ class BatchScheduler:
         with self._lock:
             stranded = [c for calls in self._pending.values() for c in calls]
             self._pending.clear()
+            stranded.extend(c for calls in self._spec_pending.values()
+                            for c in calls)
+            self._spec_pending.clear()
         for pool in self._pools.values():
             stranded.extend(c for c in pool.calls if c is not None)
         self._pools.clear()
@@ -714,6 +767,173 @@ class BatchScheduler:
                  if call.device_us is not None else None)
         return call.result
 
+    # -- speculation plane (speculative minimal-k PR) --------------------
+    # The outer k-loop's attempts at different budgets are independent,
+    # so a minimal-k driver (serve.speculate.SpeculativeMinimalKEngine)
+    # seats a WINDOW of candidate budgets into lanes the real traffic is
+    # not using, then claims each one exactly when the sequential
+    # schedule would have run it — the stopping rule (and every byte of
+    # output) is the sequential driver's by construction, because each
+    # attempt is deterministic in (member, k) and claims happen in the
+    # sequential order. Losers are cancelled at slice boundaries through
+    # the kernel's cancel mask; real pending calls preempt unclaimed
+    # speculative lanes (lowest k first) so throughput traffic is never
+    # displaced. NOT the cascade-speculation rule family (PERF.md
+    # "Measured dead end — cascade speculation"): the candidate rule is
+    # untouched — only driver scheduling changes.
+
+    def single_attempt(self, member, k: int, priority: int = 0):
+        """Blocking batched single attempt (no fused confirm): returns
+        the raw per-member kernel outputs — only the attempt-1 slots
+        ``(p1, s1, st1)`` are meaningful. Continuous mode runs it as an
+        attempt-only lane (the spec carry tag skips the confirm); sync
+        mode runs the full pair and the caller discards the confirm."""
+        span = self.tracer.begin("attempt", attrs={"k": int(k),
+                                                   "cls": member.cls.name})
+        call = _SweepCall(member, k, span=span, priority=priority,
+                          attempt_only=(self.mode == "continuous"))
+        try:
+            with self._lock:
+                if self._stop:
+                    raise ServeError("batch scheduler stopped")
+                self._spec_used = True
+                self._pending.setdefault(member.cls, []).append(call)
+                self._lock.notify_all()
+            call.done.wait()
+            if call.error is not None:
+                raise call.error
+        except BaseException as e:
+            span.end({"error": f"{type(e).__name__}: {e}"})
+            raise
+        span.end({"device_us": call.device_us}
+                 if call.device_us is not None else None)
+        return call.result
+
+    def speculate(self, member, k: int, priority: int = 0):
+        """Enqueue one speculative attempt-only call (non-blocking).
+        Returns the call handle for :meth:`claim_speculative` /
+        :meth:`cancel_speculative`, or None when speculation cannot help
+        (sync mode — no lane recycling to seat into — k below the
+        floor, or the scheduler stopping). The call seats only into
+        capacity no real pending call wants, strictly after every real
+        call of its class."""
+        if self.mode != "continuous" or k < 1:
+            return None
+        call = _SweepCall(member, k, priority=priority,
+                          attempt_only=True, speculative=True)
+        with self._lock:
+            if self._stop:
+                return None
+            self._spec_used = True
+            self._spec_pending.setdefault(member.cls, []).append(call)
+            self._spec_last[member.cls] = time.perf_counter()
+            self._lock.notify_all()
+        return call
+
+    def speculate_many(self, member, ks, priority: int = 0):
+        """Enqueue a whole speculative window atomically (one lock
+        hold, one wakeup). Returns one handle per budget, None where
+        :meth:`speculate` would return None. Submitting the window in
+        one batch matters: per-k submits trickle in one per claim, and
+        a zero-window dispatcher seats (and slices) each solo — the
+        coalesce wait in ``_service_class`` can only batch calls that
+        are already queued when the wave seats."""
+        if self.mode != "continuous":
+            return [None for _ in ks]
+        calls = [
+            _SweepCall(member, k, priority=priority,
+                       attempt_only=True, speculative=True)
+            if k >= 1 else None
+            for k in ks
+        ]
+        with self._lock:
+            if self._stop:
+                return [None for _ in ks]
+            live = [c for c in calls if c is not None]
+            if live:
+                self._spec_used = True
+                self._spec_pending.setdefault(member.cls, []).extend(live)
+                self._spec_last[member.cls] = time.perf_counter()
+                self._lock.notify_all()
+        return calls
+
+    def _spec_hot(self, cls) -> bool:
+        """Speculative activity on this class within the keep-warm
+        horizon? Lock-free read: a dict lookup of a float is atomic
+        under the GIL, and the gate is a heuristic — a stale read only
+        costs one extra pool rebuild or one extra warm pool."""
+        return (time.perf_counter()
+                - self._spec_last.get(cls, float("-inf"))  # dgc-lint: ok LK001
+                < _SPEC_IDLE_S)
+
+    def claim_speculative(self, call):
+        """Adopt a speculative call as the driver's real next attempt:
+        block until its result lands and return the raw kernel outputs
+        (attempt-1 slots meaningful, like :meth:`single_attempt`), or
+        None when the call was cancelled/preempted before the claim —
+        the caller then runs the attempt for real. A claimed call still
+        waiting to seat is PROMOTED to the head of the real queue (it is
+        now the driver's critical path, not speculation)."""
+        with self._lock:
+            if call.cancelled:
+                return None
+            call.claimed = True
+            ready = call.done.is_set()
+            lst = self._spec_pending.get(call.member.cls)
+            if lst is not None and call in lst:
+                lst.remove(call)
+                if not lst:
+                    self._spec_pending.pop(call.member.cls, None)
+                self._pending.setdefault(call.member.cls, [])[:0] = [call]
+                self._lock.notify_all()
+        call.done.wait()
+        if call.error is not None:
+            raise call.error
+        with self._lock:
+            self.stats["spec_wins"] += 1
+        if self.on_event is not None:
+            self.on_event("spec_win", {
+                "shape_class": call.member.cls.name, "k": call.k,
+                "ready": bool(ready),
+            })
+        return call.result
+
+    def cancel_speculative(self, call, reason: str = "superseded") -> None:
+        """Cancel a speculative call the driver will never claim. A call
+        still in the speculative queue is dropped immediately; a seated
+        one is killed at its next slice boundary (the kernel cancel
+        mask) and its lane freed; an already-delivered one just drops
+        its parked result. Claimed or already-cancelled calls are left
+        alone (the claim owns the result)."""
+        if call is None:
+            return
+        with self._lock:
+            if call.cancelled or call.claimed:
+                return
+            call.cancelled = True
+            call.cancel_reason = reason
+            self.stats["spec_cancelled"] += 1
+            where = "lane"
+            lst = self._spec_pending.get(call.member.cls)
+            if lst is not None and call in lst:
+                lst.remove(call)
+                if not lst:
+                    self._spec_pending.pop(call.member.cls, None)
+                where = "queue"
+            elif call.done.is_set():
+                where = "done"
+                # the whole attempt ran for nothing: charge its steps
+                wasted = int(np.asarray(call.result[1]))
+                self.stats["spec_wasted_steps"] += wasted
+        if self.on_event is not None and where != "lane":
+            # seated calls get their spec_cancelled from the dispatcher
+            # at kill time (it knows the wasted supersteps); queue/done
+            # cancels are fully resolved here
+            self.on_event("spec_cancelled", {
+                "shape_class": call.member.cls.name, "k": call.k,
+                "reason": reason, "where": where,
+            })
+
     # -- warmup ---------------------------------------------------------
     def warm_class(self, cls) -> dict:
         """Pre-compile a class's whole power-of-two pad ladder (every
@@ -746,6 +966,18 @@ class BatchScheduler:
                 kernel(comb, degrees, k0, max_steps, reset,
                        idle_carry(b, cls.v_pad,
                                   stage_idx_width(self.stages_for(cls))))
+                with self._lock:
+                    spec_used = self._spec_used
+                if spec_used:
+                    # speculation retraced the slice kernel (the two
+                    # per-lane vectors change the jitted arity): warm
+                    # that variant's ladder too, so a rung first visited
+                    # mid-measurement doesn't compile on the clock
+                    kernel, _ = self._slice_kernel_for(cls, b, spec=True)
+                    kernel(comb, degrees, k0, max_steps, reset,
+                           idle_carry(b, cls.v_pad,
+                                      stage_idx_width(self.stages_for(cls))),
+                           np.zeros(b, np.int32), np.zeros(b, np.int32))
             else:
                 kernel, _ = self._kernel_for(cls, b)
                 kernel(comb, degrees, k0, max_steps)
@@ -916,11 +1148,16 @@ class BatchScheduler:
                 self.stats["compile_hits"] += 1
             return self._kernels[key], hit
 
-    def _slice_kernel_for(self, cls, b_pad: int):
+    def _slice_kernel_for(self, cls, b_pad: int, spec: bool = False):
         s = self.resolved_slice_steps(cls, b_pad)
         stages = self.stages_for(cls)
         key = ("slice", cls.v_pad, cls.w_pad, cls.planes, b_pad, s,
                self.timing, stages, self.device_carry)
+        if spec:
+            # the speculation vectors change the jitted arity — one
+            # retrace per class when speculation first appears, honestly
+            # accounted as a compile miss
+            key += ("spec",)
         if self.mesh is not None:
             key += ("mesh", self.mesh_devices, self._mesh_gen)
             kern = partial(batched_slice_kernel_sharded_donated, self.mesh
@@ -1061,6 +1298,25 @@ class BatchScheduler:
         aborts_max = 0
         for call in (pool.calls if pool is not None else []):
             if call is None:
+                continue
+            if call.speculative and not call.claimed:
+                # unclaimed speculation is just dropped with the pool —
+                # no abort charge, no requeue (the driver's claim sees
+                # cancelled and runs the attempt for real); claimed
+                # speculative calls are the driver's critical path and
+                # ride the normal requeue/quarantine accounting below
+                with self._lock:
+                    if not call.cancelled:
+                        call.cancelled = True
+                        call.cancel_reason = "evacuated"
+                        self.stats["spec_cancelled"] += 1
+                    reason = call.cancel_reason
+                call.done.set()
+                if self.on_event is not None:
+                    self.on_event("spec_cancelled", {
+                        "shape_class": cls.name, "k": call.k,
+                        "reason": reason, "where": "lane",
+                    })
                 continue
             if error is not None:
                 call.aborts += 1
@@ -1217,6 +1473,7 @@ class BatchScheduler:
         already has live lanes to keep slicing."""
         with self._lock:
             while (not self._stop and not self._pending
+                   and not self._spec_pending
                    and not self._restore_requested
                    and not any(p.live for p in self._pools.values())):
                 self._lock.wait()
@@ -1263,7 +1520,7 @@ class BatchScheduler:
                 return
             self._maybe_restore()
             with self._lock:
-                classes = set(self._pending)
+                classes = set(self._pending) | set(self._spec_pending)
             classes.update(c for c, p in self._pools.items() if p.live)
             # deterministic service order (sets hash-order otherwise)
             for cls in sorted(classes, key=lambda c: c.name):
@@ -1302,6 +1559,50 @@ class BatchScheduler:
                 mesh=self.mesh)
 
         free = self.batch_max - pool.live
+        spec_evicted: list = []
+        evict_b_pad = pool.b_pad
+        with self._lock:
+            spec_used = self._spec_used
+            n_real = len(self._pending.get(cls) or [])
+        if spec_used and n_real > free:
+            # real traffic preempts speculation: cancel unclaimed
+            # speculative lanes (lowest k first — the least likely to
+            # be claimed soon) and hand their lanes to the real wave
+            # THIS slice (the seat's reset wins over the cancel bit
+            # in-kernel, so a reseated lane re-inits cleanly)
+            need = n_real - free
+            cand = sorted((int(pool.k0[i]), i)
+                          for i in range(pool.b_pad)
+                          if pool.calls[i] is not None
+                          and pool.calls[i].speculative)
+            steps_now = self.resolved_slice_steps(cls, pool.b_pad)
+            victims = []
+            with self._lock:
+                for _k, i in cand:
+                    if len(victims) >= need:
+                        break
+                    c = pool.calls[i]
+                    if c.claimed or c.cancelled:
+                        continue
+                    c.cancelled = True
+                    c.cancel_reason = "preempted"
+                    victims.append(i)
+                self.stats["spec_cancelled"] += len(victims)
+                self.stats["spec_preempted"] += len(victims)
+                self.stats["spec_wasted_steps"] += sum(
+                    pool.slices_in[i] * steps_now for i in victims)
+            for i in victims:
+                c = pool.calls[i]
+                if self.on_event is not None:
+                    self.on_event("spec_cancelled", {
+                        "shape_class": cls.name, "k": c.k,
+                        "reason": "preempted", "where": "lane",
+                        "wasted_steps": int(pool.slices_in[i] * steps_now),
+                    })
+                c.done.set()
+                pool.calls[i] = None
+                spec_evicted.append(i)
+            free = self.batch_max - pool.live
         admitted = 0
         if free > 0:
             take = self._pop_pending(cls, free, pool.live_depths())
@@ -1338,15 +1639,87 @@ class BatchScheduler:
                     "lane", parent=call.span,
                     attrs={"lane": int(lane), "b_pad": int(pool.b_pad)})
                 admitted += 1
+        # speculation: capacity no real call wanted seats pending
+        # speculative attempts (strictly after the real wave — a
+        # speculative call never displaces queued traffic)
+        spec_admitted = 0
+
+        def _seat_spec_wave() -> int:
+            with self._lock:
+                sl = self._spec_pending.get(cls) or []
+                room = self.batch_max - pool.live
+                spec_take, rest = sl[:room], sl[room:]
+                if rest:
+                    self._spec_pending[cls] = rest
+                elif cls in self._spec_pending:
+                    del self._spec_pending[cls]
+                if spec_take:
+                    self._spec_last[cls] = time.perf_counter()
+            seated = 0
+            for call in spec_take:
+                lane = pool.fill(call)
+                seated += 1
+                with self._lock:
+                    self.stats["spec_seated"] += 1
+                if self.on_event is not None:
+                    self.on_event("spec_seated", {
+                        "shape_class": cls.name, "lane": int(lane),
+                        "k": call.k})
+            return seated
+
+        if spec_used and pool.live < self.batch_max:
+            spec_admitted += _seat_spec_wave()
+        if (spec_admitted and admitted == 0 and pool.live < self.batch_max
+                and all(c is None or (c.speculative and not c.claimed)
+                        for c in pool.calls)):
+            # the wave is entirely unclaimed-speculative: the window's
+            # remaining speculate() submits may still be in flight (the
+            # driver refills one budget per claim), and slicing a
+            # partial wave serializes the generation into solo lanes.
+            # Wait a hair for the stragglers — but bail immediately for
+            # a claim, a real arrival, or shutdown (those ARE the
+            # critical path)
+            deadline = time.perf_counter() + _SPEC_COALESCE_S
+            while pool.live < self.batch_max:
+                if any(c is not None and c.claimed for c in pool.calls):
+                    break
+                with self._lock:
+                    if self._stop or self._pending.get(cls):
+                        break
+                    if not self._spec_pending.get(cls):
+                        left = deadline - time.perf_counter()
+                        if left <= 0:
+                            break
+                        self._lock.wait(timeout=left)
+                        continue
+                spec_admitted += _seat_spec_wave()
+                # quiet-period semantics: each arrival re-arms the
+                # window, so a claim burst's whole refill stream (one
+                # submit per claim, ~claim-work apart) lands in ONE
+                # generation instead of splitting on the fixed deadline
+                deadline = time.perf_counter() + _SPEC_COALESCE_S
         live = pool.live
         if live == 0:
-            self._pools.pop(cls, None)
+            # speculation drains a whole window between claims: popping
+            # the pool here would make every window generation rebuild
+            # lanes from scratch (a _resize + full table re-upload per
+            # generation — measured ~30x a b_pad=1 attempt). Keep the
+            # pool warm while the class is spec-hot; once the sweep goes
+            # idle past the horizon the pool pops as before, and the
+            # spec-never-used path pops exactly as it always did
+            # (byte-identical scheduling for --speculate-k unset)
+            if not self._spec_hot(cls):
+                self._pools.pop(cls, None)
             return
         # shrink a draining tail — but not while queued work is about to
         # refill the freed lanes (shrink→grow thrash re-uploads tables)
         with self._lock:
-            has_pending = bool(self._pending.get(cls))
-        if not has_pending:
+            has_pending = bool(self._pending.get(cls)) or bool(
+                self._spec_pending.get(cls))
+        if not has_pending and not self._spec_hot(cls):
+            # a spec-hot class is mid-sweep: the queue empties between
+            # window generations, and shrinking there thrashes b_pad
+            # (4 -> 1 -> 4 with a table re-upload each way)
             pool.maybe_shrink()
         # per-device occupancy (mesh mode): live lanes per shard at
         # dispatch time — captured AFTER the shrink so the counts and
@@ -1356,8 +1729,33 @@ class BatchScheduler:
         # `live`)
         dev_live = pool.device_live() if self.mesh is not None else None
 
-        kernel, cache_hit = self._slice_kernel_for(cls, pool.b_pad)
+        kernel, cache_hit = self._slice_kernel_for(cls, pool.b_pad,
+                                                   spec=spec_used)
         slice_steps = self.resolved_slice_steps(cls, pool.b_pad)
+        # speculation vectors: the per-lane spec tag (attempt-only) and
+        # the cancel mask the kernel kills at the slice boundary. Built
+        # only once speculation was ever used — before that the kernel
+        # call (and its compile-cache key) is the exact pre-spec path
+        spec_vec = cancel_vec = None
+        if spec_used:
+            spec_vec = np.zeros(pool.b_pad, np.int32)
+            cancel_vec = np.zeros(pool.b_pad, np.int32)
+            with self._lock:
+                for i, c in enumerate(pool.calls):
+                    if c is None:
+                        continue
+                    if c.attempt_only:
+                        spec_vec[i] = 1
+                    if c.speculative and c.cancelled:
+                        cancel_vec[i] = 1
+            for i in spec_evicted:
+                # a preempted lane no real call reseated: its stale
+                # kernel state still carries the spec tag, so the
+                # cancel bit retires it (a resize during seating
+                # already compacted such lanes away — b_pad guard)
+                if pool.b_pad == evict_b_pad and pool.calls[i] is None:
+                    spec_vec[i] = 1
+                    cancel_vec[i] = 1
         slice_span = self.tracer.begin(
             "slice", trace="sched",
             attrs={"cls": cls.name, "live": int(live),
@@ -1401,8 +1799,15 @@ class BatchScheduler:
                              + pool.reset.nbytes)
                 if isinstance(pool.carry[0], np.ndarray):
                     pool.h2d += carry_nbytes(pool.carry)
-            carry = kernel(comb_dev, degrees_dev, k0_in, ms_in, reset_in,
-                           pool.carry)
+            if spec_vec is not None:
+                # the two per-lane speculation vectors ride up with the
+                # scheduling vectors every slice
+                pool.h2d += spec_vec.nbytes + cancel_vec.nbytes
+                carry = kernel(comb_dev, degrees_dev, k0_in, ms_in,
+                               reset_in, pool.carry, spec_vec, cancel_vec)
+            else:
+                carry = kernel(comb_dev, degrees_dev, k0_in, ms_in,
+                               reset_in, pool.carry)
             # the per-lane scheduling scalars — the ONLY unconditional
             # device→host transfer per slice: done mask + stage telemetry
             phase = np.asarray(carry[CARRY_PHASE])   # forces the dispatch
@@ -1443,6 +1848,7 @@ class BatchScheduler:
 
         done_lanes = [i for i in range(pool.b_pad)
                       if pool.calls[i] is not None and phase[i] >= 2]
+        spec_killed = 0
         if done_lanes:
             if self.device_carry:
                 # transfer ONLY the done lanes' result slots (two packed
@@ -1455,6 +1861,27 @@ class BatchScheduler:
             now = time.perf_counter()
             for lane in done_lanes:
                 call = pool.calls[lane]
+                with self._lock:
+                    spec_dropped = call.speculative and call.cancelled
+                if spec_dropped:
+                    # a cancelled speculative lane the kernel killed at
+                    # this slice boundary (or that finished after its
+                    # cancel): free the lane, deliver nothing, charge
+                    # the burned supersteps to the speculation plane
+                    wasted = int(pool.slices_in[lane]) * int(slice_steps)
+                    with self._lock:
+                        self.stats["spec_wasted_steps"] += wasted
+                        reason = call.cancel_reason or "superseded"
+                    call.done.set()
+                    pool.calls[lane] = None
+                    spec_killed += 1
+                    if self.on_event is not None:
+                        self.on_event("spec_cancelled", {
+                            "shape_class": cls.name, "k": call.k,
+                            "reason": reason, "where": "lane",
+                            "wasted_steps": wasted,
+                        })
+                    continue
                 call.result = lane_outputs(out_src, lane)
                 if t_acc is not None:
                     call.device_us = int(t_acc[lane])
@@ -1535,6 +1962,15 @@ class BatchScheduler:
             if sstep_s is not None:
                 rec["sstep_ms"] = round(sstep_s * 1e3, 3)
                 rec["overhead_ms"] = round(overhead_s * 1e3, 3)
+            if spec_used:
+                # wasted-superstep accounting (the speculation plane's
+                # cost side): live speculative lanes, this slice's
+                # seats, and the lanes the cancel mask just retired
+                rec["spec_live"] = int(sum(
+                    1 for c in pool.calls
+                    if c is not None and c.speculative))
+                rec["spec_admitted"] = int(spec_admitted)
+                rec["spec_killed"] = int(spec_killed)
             self.on_event("serve_slice", rec)
         # recalibration samples: full slices only (no lane finished
         # early), where every live lane ran exactly slice_steps bodies;
@@ -1544,7 +1980,11 @@ class BatchScheduler:
                 and sstep_s is not None and sstep_s > 0):
             self._timing_sample(cls, overhead_s, sstep_s / slice_steps,
                                 rung=rung_min)
-        if pool.live == 0:
+        if pool.live == 0 and not self._spec_hot(cls):
+            # spec-hot pools stay warm across window generations (see
+            # the seat-time keep above) — the sweep thread is about to
+            # refill this pool, and popping it here would charge every
+            # generation a full lane rebuild
             self._pools.pop(cls, None)
 
     # =====================================================================

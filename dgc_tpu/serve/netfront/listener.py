@@ -687,7 +687,7 @@ class NetFront:
         # the gap between the two and recompute needlessly
         if self.resultcache is not None and net_ticket.ckey is not None \
                 and result.status == "ok" and result.colors is not None:
-            self.resultcache.put(net_ticket.ckey, CachedResult(
+            evicted = self.resultcache.put(net_ticket.ckey, CachedResult(
                 colors=np.asarray(result.colors, np.int32),
                 minimal_colors=int(result.minimal_colors),
                 attempts=len(result.attempts),
@@ -703,6 +703,7 @@ class NetFront:
                 self.registry.counter(
                     "dgc_net_cache_stores_total",
                     "results published to the result cache").inc()
+            self._emit_cache_evictions(evicted)
         followers = ()
         if net_ticket.ckey is not None:
             with self._lock:
@@ -1024,6 +1025,51 @@ class NetFront:
             self._tickets[ticket_id] = net_ticket
             self._completed.append(ticket_id)
 
+    def _emit_cache_evictions(self, evicted) -> None:
+        """Disk-GC eviction accounting: one ``net_cache`` evict event
+        per entry the store-time sweep unlinked (resultcache.gc)."""
+        for ev in evicted:
+            self._event("net_cache", action="evict", key=ev["key"],
+                        reason=ev["reason"], bytes=ev["bytes"])
+            if self.registry is not None:
+                self.registry.counter(
+                    "dgc_net_cache_disk_evictions_total",
+                    "disk-store entries unlinked by the GC sweep",
+                    reason=ev["reason"]).inc()
+
+    def _cache_fill_recovered(self, ent, res) -> None:
+        """Recovery-path cache fill (ROADMAP 2(c) follow-on): a
+        delivered record the WAL scan just restored carries its colors —
+        re-derive its content key from the journaled payload and insert
+        them into the result cache, so a cold fleet serves duplicates of
+        already-computed tickets straight from the journal it just
+        scanned instead of recomputing. Best-effort: an unparseable
+        payload skips the fill (the ticket itself is still pollable)."""
+        if self.resultcache is None or res.status != "ok" \
+                or res.colors is None or res.minimal_colors is None:
+            return
+        try:
+            graph = self._load_graph(ent.payload or {})
+            ckey = self.resultcache.key_for(
+                graph.arrays, k0=int(graph.arrays.max_degree) + 1)
+        except Exception:
+            return
+        evicted = self.resultcache.put(ckey, CachedResult(
+            colors=np.asarray(res.colors, np.int32),
+            minimal_colors=int(res.minimal_colors),
+            attempts=len(res.attempts),
+            shape_class=res.shape_class,
+            batched=bool(res.batched),
+            source_ticket=ent.ticket))
+        self._event("net_cache", action="recover_fill",
+                    ticket=ent.ticket, key=ckey)
+        if self.registry is not None:
+            self.registry.counter(
+                "dgc_net_cache_recover_fills_total",
+                "recovered delivered results inserted into the "
+                "result cache").inc()
+        self._emit_cache_evictions(evicted)
+
     def _recover(self) -> None:
         """Rebuild the ticket table from the journal (module docstring):
         completed tickets restored pollable, in-flight tickets replayed
@@ -1084,6 +1130,7 @@ class NetFront:
                 self.usage.record_done(net_ticket.tenant, res.status,
                                        res.queue_s, res.service_s)
                 restored += 1
+                self._cache_fill_recovered(ent, res)
                 self._event("net_recover", action="restored",
                             ticket=ent.ticket, tenant=ent.tenant)
                 continue

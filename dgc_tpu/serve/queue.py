@@ -212,6 +212,7 @@ class ServeFrontEnd:
                  retries: int = 0,
                  max_lane_aborts: int = 3,
                  dispatch_timeout: float | None = None,
+                 speculate_k=None,
                  fallback_factories=None,
                  logger=None, registry: MetricsRegistry | None = None,
                  rung_state: RungState | None = None):
@@ -219,6 +220,21 @@ class ServeFrontEnd:
             raise ValueError(f"queue_depth must be >= 1, got {queue_depth}")
         self.ladder = ladder
         self.batch_max = int(batch_max)
+        # speculative minimal-k (serve.speculate): arm the scheduler's
+        # speculation plane for batched requests. Serve requests run the
+        # jump-mode fused pair, where the speculative proxy delegates to
+        # the plain engine (byte-identical, nothing to speculate) — the
+        # plane engages on strict-decrement sweeps (the single-graph
+        # CLI's one-request pool) and on any attempt-path execution.
+        # "auto" prices the window depth off the free-lane count.
+        if speculate_k == "auto":
+            from dgc_tpu.serve.speculate import auto_depth
+
+            speculate_k = auto_depth(self.batch_max)
+        if speculate_k is not None and int(speculate_k) < 1:
+            raise ValueError(
+                f"speculate_k must be >= 1 or 'auto', got {speculate_k}")
+        self.speculate_k = int(speculate_k) if speculate_k else None
         self.queue_depth = int(queue_depth)
         self.workers = int(workers) if workers is not None else self.batch_max
         self.validate = validate
@@ -300,6 +316,28 @@ class ServeFrontEnd:
             self.registry.counter(
                 "dgc_serve_recycles_total", "lane swaps (sweeps completed)",
                 shape_class=record["shape_class"]).inc()
+        elif kind == "spec_seated":
+            # speculation plane (serve.speculate): attempts seated into
+            # otherwise-idle lanes / cancelled losers / claimed wins
+            self.registry.counter(
+                "dgc_serve_spec_seated_total",
+                "speculative attempts seated into idle lanes",
+                shape_class=record["shape_class"]).inc()
+        elif kind == "spec_cancelled":
+            self.registry.counter(
+                "dgc_serve_spec_cancelled_total",
+                "speculative attempts cancelled before their claim",
+                reason=record["reason"]).inc()
+            if record.get("wasted_steps"):
+                self.registry.counter(
+                    "dgc_serve_spec_wasted_supersteps_total",
+                    "supersteps burnt by cancelled speculation").inc(
+                    record["wasted_steps"])
+        elif kind == "spec_win":
+            self.registry.counter(
+                "dgc_serve_spec_wins_total",
+                "speculative attempts claimed by their driver",
+                shape_class=record["shape_class"]).inc()
         elif kind == "mesh_degrade":
             # failure-domain plane: a lost device re-sharded the lane
             # axis onto the survivors (resilience.domains)
@@ -335,6 +373,11 @@ class ServeFrontEnd:
         # sharded, so the unsharded event stream stays byte-identical
         mesh_kw = ({"mesh_devices": self.scheduler.mesh_devices}
                    if self.scheduler.mesh is not None else {})
+        if self.speculate_k:
+            # speculation armed: present only then, so the unarmed
+            # serve_start (the --speculate-k-unset path) stays
+            # byte-identical
+            mesh_kw["speculate_k"] = self.speculate_k
         self._event("serve_start", batch_max=self.batch_max,
                     window_ms=round(self.scheduler.window_s * 1e3, 3),
                     queue_depth=self.queue_depth, workers=self.workers,
@@ -682,13 +725,31 @@ class ServeFrontEnd:
 
         if batched:
             try:
-                engine = BatchMemberEngine(pad_member(arrays, cls),
-                                           self.scheduler,
-                                           priority=req.priority)
-                result = find_minimal_coloring(
-                    engine, initial_k=engine.member.k0,
-                    validate=validate, on_attempt=on_attempt,
-                    post_reduce=post_reduce)
+                member = pad_member(arrays, cls)
+                spec = None
+                if self.speculate_k:
+                    # speculative proxy: jump-mode requests delegate to
+                    # the fused sweep (byte-identical to the plain
+                    # engine); the attempt path speculates. close() in
+                    # the finally frees any window the sweep left.
+                    from dgc_tpu.serve.speculate import \
+                        SpeculativeMinimalKEngine
+
+                    spec = SpeculativeMinimalKEngine(
+                        member, self.scheduler, depth=self.speculate_k,
+                        priority=req.priority)
+                    engine = spec
+                else:
+                    engine = BatchMemberEngine(member, self.scheduler,
+                                               priority=req.priority)
+                try:
+                    result = find_minimal_coloring(
+                        engine, initial_k=engine.member.k0,
+                        validate=validate, on_attempt=on_attempt,
+                        post_reduce=post_reduce)
+                finally:
+                    if spec is not None:
+                        spec.close()
             except PoisonedRequest:
                 # quarantine is terminal (poison-request policy): the
                 # request structured-fails with its rc context instead

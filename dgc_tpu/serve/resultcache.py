@@ -32,6 +32,7 @@ import hashlib
 import json
 import os
 import threading
+import time
 from collections import OrderedDict
 from dataclasses import dataclass, field
 from pathlib import Path
@@ -43,7 +44,8 @@ RESULT_CACHE_VERSION = 1
 # stat keys snapshot() always reports (stable schema for /healthz and
 # serve_summary consumers)
 _STAT_KEYS = ("hits", "mem_hits", "disk_hits", "misses", "coalesced",
-              "promotions", "stores", "corrupt", "evictions")
+              "promotions", "stores", "corrupt", "evictions",
+              "disk_evictions")
 
 
 def graph_content_hash(arrays, k0=None, engine_key: str = "") -> str:
@@ -128,7 +130,8 @@ class ResultCache:
     """
 
     def __init__(self, capacity: int, cache_dir=None,
-                 engine_key: str = ""):
+                 engine_key: str = "", ttl_s: float = 0.0,
+                 max_bytes: int = 0):
         if capacity < 1:
             raise ValueError(f"result cache capacity must be >= 1, "
                              f"got {capacity}")
@@ -137,6 +140,12 @@ class ResultCache:
         if self.cache_dir is not None:
             self.cache_dir.mkdir(parents=True, exist_ok=True)
         self.engine_key = engine_key
+        # disk-store GC bounds (ROADMAP 2(c) follow-on): entries older
+        # than ttl_s, and oldest-written entries past max_bytes, are
+        # unlinked by the store-time sweep (gc()); 0 = unbounded, the
+        # pre-GC store semantics
+        self.ttl_s = float(ttl_s or 0.0)
+        self.max_bytes = int(max_bytes or 0)
         self._lock = threading.Lock()
         # LRU map key -> CachedResult, evicted at capacity from the
         # cold end
@@ -172,10 +181,12 @@ class ResultCache:
             self._stats["misses"] += 1
         return None
 
-    def put(self, key: str, entry: CachedResult) -> None:
+    def put(self, key: str, entry: CachedResult) -> list:
         """Publish a computed result under its content key (memory +
         disk). Last-writer-wins is safe: equal keys imply equal colors
-        by engine determinism."""
+        by engine determinism. Returns the disk entries the store-time
+        GC sweep evicted (empty without GC bounds) so the caller can
+        emit their eviction events."""
         with self._lock:
             self._insert(key, entry)
             self._stats["stores"] += 1
@@ -192,6 +203,59 @@ class ResultCache:
                     tmp.unlink(missing_ok=True)
                 except OSError:
                     pass
+            return self.gc()
+        return []
+
+    def gc(self, now: float | None = None) -> list:
+        """Disk-store GC sweep: unlink entries older than ``ttl_s``,
+        then oldest-written entries until the store fits ``max_bytes``
+        (the just-written entry is the newest, so a sweep right after a
+        store never evicts it unless it alone exceeds the bound).
+        Eviction is a bare atomic ``unlink`` — a concurrent reader of a
+        dying entry gets a clean FileNotFoundError miss, and a
+        concurrent sweeper losing the unlink race just skips the entry.
+        Returns ``[{"key", "reason", "bytes"}, ...]`` for the caller's
+        ``net_cache`` evict events; no-op without bounds or a disk
+        store."""
+        if self.cache_dir is None or not (self.ttl_s or self.max_bytes):
+            return []
+        if now is None:
+            now = time.time()
+        entries = []
+        for p in self.cache_dir.glob("*.json"):
+            try:
+                st = p.stat()
+            except OSError:
+                continue   # lost a race with another sweeper
+            entries.append((st.st_mtime, int(st.st_size), p))
+        entries.sort()   # oldest-written first
+        doomed = []
+        survivors = []
+        for mtime, size, p in entries:
+            if self.ttl_s and now - mtime > self.ttl_s:
+                doomed.append((p, "ttl", size))
+            else:
+                survivors.append((size, p))
+        if self.max_bytes:
+            total = sum(size for size, _ in survivors)
+            for size, p in survivors:   # still oldest-written first
+                if total <= self.max_bytes:
+                    break
+                doomed.append((p, "max_bytes", size))
+                total -= size
+        out = []
+        for p, reason, size in doomed:
+            try:
+                p.unlink()
+            except FileNotFoundError:
+                continue   # a concurrent sweeper won the unlink
+            except OSError:
+                continue
+            with self._lock:
+                self._stats["disk_evictions"] += 1
+            out.append({"key": p.name[:-len(".json")], "reason": reason,
+                        "bytes": size})
+        return out
 
     def _insert(self, key: str, entry: CachedResult) -> None:
         # caller-holds-lock helper: every call site is inside

@@ -252,7 +252,7 @@ def test_layout_widened_carry_catches_stale_sites_on_real_tree():
     real pack/unpack sites: every one of them must light up — the
     hand-maintained-lockstep failure the pass exists to catch."""
     real = (ROOT / "dgc_tpu" / "layout.py").read_text()
-    widened = re.sub(r"^CARRY_LEN = 19$", "CARRY_LEN = 20", real,
+    widened = re.sub(r"^CARRY_LEN = 20$", "CARRY_LEN = 21", real,
                      flags=re.M)
     assert widened != real
     layout = SourceModule("dgc_tpu/layout.py", widened)
@@ -269,7 +269,10 @@ def test_layout_widened_carry_catches_stale_sites_on_real_tree():
 
 def test_layout_stale_index_constant_on_real_tree():
     real = (ROOT / "dgc_tpu" / "layout.py").read_text()
-    stale = re.sub(r"^T_US = 13\b", "T_US = 19", real, flags=re.M)
+    # mutate to a value safely past CARRY_LEN no matter how wide the
+    # carry grows (19 stopped being out-of-range when the speculation
+    # tag widened CARRY_LEN to 20)
+    stale = re.sub(r"^T_US = 13\b", "T_US = 99", real, flags=re.M)
     assert stale != real
     layout = SourceModule("dgc_tpu/layout.py", stale)
     got = check_layout(layout, {"dgc_tpu/layout.py": layout},

@@ -9,6 +9,7 @@ usage conservation with cached deliveries, the cache-off byte-identity
 contract, and the tuned-config cache's exact-hash fast path."""
 
 import json
+import os
 import threading
 import time
 import urllib.error
@@ -228,6 +229,127 @@ def test_torn_disk_entry_is_a_miss_not_an_error(tmp_path):
     # a store overwrites the torn entry and the key serves again
     rc.put("kt", _entry())
     assert ResultCache(4, cache_dir=str(store)).get("kt") is not None
+
+
+# -- disk-store GC ------------------------------------------------------
+
+def test_disk_gc_ttl_evicts_stale_entries(tmp_path):
+    store = tmp_path / "store"
+    rc = ResultCache(4, cache_dir=str(store), ttl_s=60.0)
+    rc.put("old", _entry())
+    old_path = store / "old.json"
+    past = time.time() - 120
+    os.utime(old_path, (past, past))
+    # the store-time sweep rides put(): the fresh entry survives, the
+    # stale one unlinks, and the eviction record surfaces to the caller
+    evicted = rc.put("new", _entry())
+    assert [e["key"] for e in evicted] == ["old"]
+    assert evicted[0]["reason"] == "ttl" and evicted[0]["bytes"] > 0
+    assert not old_path.exists() and (store / "new.json").exists()
+    assert rc.snapshot()["disk_evictions"] == 1
+    # the dead entry is a clean miss for a fresh instance
+    assert ResultCache(4, cache_dir=str(store)).get("old") is None
+
+
+def test_disk_gc_max_bytes_evicts_oldest_first(tmp_path):
+    store = tmp_path / "store"
+    rc = ResultCache(8, cache_dir=str(store))   # no bounds: no GC yet
+    for i, key in enumerate(("k0", "k1", "k2")):
+        assert rc.put(key, _entry()) == []
+        t = time.time() - 100 + 10 * i
+        os.utime(store / f"{key}.json", (t, t))
+    size = (store / "k2.json").stat().st_size
+    bounded = ResultCache(8, cache_dir=str(store), max_bytes=2 * size)
+    evicted = bounded.gc()
+    assert [e["key"] for e in evicted] == ["k0"]
+    assert evicted[0]["reason"] == "max_bytes"
+    assert sorted(p.name for p in store.glob("*.json")) == \
+        ["k1.json", "k2.json"]
+    # already within bounds: the next sweep is a no-op
+    assert bounded.gc() == []
+
+
+def test_disk_gc_never_evicts_the_entry_just_stored(tmp_path):
+    probe = ResultCache(8, cache_dir=str(tmp_path / "probe"))
+    probe.put("k", _entry())
+    size = (tmp_path / "probe" / "k.json").stat().st_size
+    store = tmp_path / "store"
+    # room for one-and-a-half entries: every store evicts the previous
+    # entry, never itself (mtime ordering drops the OLDER entry first)
+    rc = ResultCache(8, cache_dir=str(store),
+                     max_bytes=size + size // 2)
+    assert rc.put("k0", _entry()) == []
+    time.sleep(0.02)
+    evicted = rc.put("k1", _entry())
+    assert [e["key"] for e in evicted] == ["k0"]
+    assert (store / "k1.json").exists()
+
+
+def test_store_time_gc_emits_evict_event(tmp_path):
+    """End-to-end: a store whose sweep unlinks a stale disk entry emits
+    a schema-valid ``net_cache`` evict event and bumps the counter."""
+    log = tmp_path / "run.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    reg = MetricsRegistry()
+    store = tmp_path / "rcache"
+    cache = ResultCache(32, cache_dir=str(store), ttl_s=60.0)
+    front, nf = _stack(tmp_path, logger=logger, cache=cache,
+                       registry=reg)
+    st, a = _post(nf.port, "/v1/color", dict(_SPEC), tenant="a")
+    assert st == 202
+    _poll(nf.port, a["ticket"])
+    first = next(iter(store.glob("*.json")))
+    past = time.time() - 120
+    os.utime(first, (past, past))
+    st, b = _post(nf.port, "/v1/color", dict(_SPEC, seed=6), tenant="a")
+    assert st == 202
+    _poll(nf.port, b["ticket"])
+    assert not first.exists()
+    nf.close()
+    front.shutdown()
+    logger.close()
+    recs = [json.loads(ln) for ln in open(log) if '"net_cache"' in ln]
+    ev = [r for r in recs if r["action"] == "evict"]
+    assert len(ev) == 1
+    assert ev[0]["reason"] == "ttl" and ev[0]["bytes"] > 0
+    assert ev[0]["key"] == first.name[:-len(".json")]
+    snap = reg.to_dict()
+    assert snap[
+        'dgc_net_cache_disk_evictions_total{reason="ttl"}']["value"] == 1
+    assert validate_file(str(log)) == []
+
+
+# -- recovery-path cache fill -------------------------------------------
+
+def test_recovery_fills_result_cache(tmp_path):
+    """A restart's WAL scan inserts every restored delivered record's
+    colors into the (empty) result cache: a duplicate of an
+    already-computed ticket serves as a hit with ZERO recomputes."""
+    front, nf = _stack(tmp_path, cache=ResultCache(32))
+    st, doc = _post(nf.port, "/v1/color", dict(_SPEC), tenant="a")
+    assert st == 202
+    st, res = _poll(nf.port, doc["ticket"])
+    assert st == 200 and res["status"] == "ok"
+    nf.close()
+    front.shutdown()
+    # second incarnation: fresh empty cache, same journal dir
+    log = tmp_path / "run2.jsonl"
+    logger = RunLogger(jsonl_path=str(log), echo=False)
+    front2, nf2 = _stack(tmp_path, logger=logger, cache=ResultCache(32))
+    st, hit = _post(nf2.port, "/v1/color", dict(_SPEC), tenant="b")
+    assert st == 202 and hit["cached"] is True
+    st, again = _get(nf2.port, f"/v1/result/{hit['ticket']}?colors=1")
+    assert st == 200 and again["colors"] == res["colors"]
+    assert front2.computes == 0
+    snap = nf2.resultcache.snapshot()
+    assert snap["stores"] >= 1 and snap["hits"] == 1
+    nf2.close()
+    front2.shutdown()
+    logger.close()
+    recs = [json.loads(ln) for ln in open(log) if '"net_cache"' in ln]
+    fills = [r for r in recs if r["action"] == "recover_fill"]
+    assert len(fills) == 1 and fills[0]["ticket"] == doc["ticket"]
+    assert validate_file(str(log)) == []
 
 
 # -- end-to-end: cache hits over the netfront ---------------------------

@@ -1,0 +1,326 @@
+"""Speculative minimal-k (serve-tier outer-k-loop parallelism).
+
+Locks the three load-bearing properties of
+:class:`dgc_tpu.serve.speculate.SpeculativeMinimalKEngine`:
+
+- **Byte-identity** — a strict-decrement sweep driven through the
+  speculative engine yields the exact colors, minimal k, and attempt
+  sequence of the sequential single-graph reference, across telemetry
+  on/off and mesh on/off (the 12-draw parity ensemble).
+- **Cancellation** — losers die at slice boundaries (S=1 makes every
+  superstep a boundary, staged ladders make every rung transition one),
+  the stopping rule cancels the whole window at first failure, and the
+  wasted-superstep account is charged.
+- **Starvation-freedom** — speculation seats strictly below real
+  traffic: a real wave arriving while speculation holds lanes preempts
+  the speculative lanes THIS slice.
+"""
+
+from __future__ import annotations
+
+import io
+import threading
+
+import numpy as np
+import pytest
+
+from dgc_tpu.engine.compact import CompactFrontierEngine
+from dgc_tpu.engine.minimal_k import (find_minimal_coloring, make_reducer,
+                                      make_validator)
+from dgc_tpu.models.generators import (generate_random_graph_fast,
+                                       generate_rmat_graph)
+from dgc_tpu.serve.engine import BatchMemberEngine, BatchScheduler
+from dgc_tpu.serve.queue import ServeFrontEnd
+from dgc_tpu.serve.shape_classes import DEFAULT_LADDER, pad_member
+from dgc_tpu.serve.speculate import (AUTO_DEPTH_CAP,
+                                     SpeculativeMinimalKEngine, auto_depth)
+
+
+def _strict_reference(g):
+    """The parity target: the sequential single-graph strict-decrement
+    sweep with the CLI defaults (validate + recolor pass)."""
+    attempts = []
+    res = find_minimal_coloring(
+        CompactFrontierEngine(g), initial_k=g.max_degree + 1,
+        strict_decrement=True, validate=make_validator(g),
+        on_attempt=lambda r, v: attempts.append(
+            (int(r.k), r.status.name, int(r.supersteps))),
+        post_reduce=make_reducer(g))
+    return res, attempts
+
+
+def _speculative_run(g, sched, depth=2, on_attempt_list=None):
+    cls = DEFAULT_LADDER.class_for(g.num_vertices, g.max_degree)
+    engine = SpeculativeMinimalKEngine(pad_member(g, cls), sched,
+                                       depth=depth)
+    attempts = [] if on_attempt_list is None else on_attempt_list
+    try:
+        res = find_minimal_coloring(
+            engine, initial_k=engine.member.k0, strict_decrement=True,
+            validate=make_validator(g),
+            on_attempt=lambda r, v: attempts.append(
+                (int(r.k), r.status.name, int(r.supersteps))),
+            post_reduce=make_reducer(g))
+    finally:
+        engine.close()
+    return res, attempts, engine
+
+
+# -- auto depth ---------------------------------------------------------
+
+def test_auto_depth_policy():
+    # free lanes bound the window; cap bounds deep pools; floor is 1
+    assert auto_depth(2) == 1
+    assert auto_depth(4) == 3
+    assert auto_depth(8) == AUTO_DEPTH_CAP
+    assert auto_depth(8, live=6) == 1
+    assert auto_depth(1) == 1
+    assert auto_depth(16, cap=8) == 8
+
+
+def test_depth_must_be_positive():
+    sched = BatchScheduler(batch_max=2).start()
+    try:
+        g = generate_random_graph_fast(60, avg_degree=4, seed=0)
+        cls = DEFAULT_LADDER.class_for(g.num_vertices, g.max_degree)
+        with pytest.raises(ValueError):
+            SpeculativeMinimalKEngine(pad_member(g, cls), sched, depth=0)
+    finally:
+        sched.stop()
+
+
+# -- byte-identity parity ensemble --------------------------------------
+
+def test_speculative_strict_parity_ensemble():
+    """12 draws x {telemetry on/off} x {mesh on/off}: the speculative
+    strict-decrement sweep is byte-identical to the sequential
+    single-graph reference — colors, minimal k, and the full attempt
+    sequence (k, status, supersteps per attempt)."""
+    draws = []
+    for i in range(12):
+        gen = (generate_rmat_graph if i % 3 == 2
+               else generate_random_graph_fast)
+        draws.append(gen(240 + 20 * i, avg_degree=4 + i % 2,
+                         seed=100 + i))
+    configs = [(telemetry, mesh) for telemetry in (False, True)
+               for mesh in (False, True)]
+    for ci, (telemetry, mesh) in enumerate(configs):
+        events = []
+        kw = dict(batch_max=4, window_s=0.0, slice_steps=4)
+        if telemetry:
+            kw["on_event"] = lambda kind, rec: events.append((kind, rec))
+        if mesh:
+            kw["mesh_devices"] = "auto"
+        sched = BatchScheduler(**kw).start()
+        try:
+            for g in draws[ci * 3:(ci + 1) * 3]:
+                want, want_attempts = _strict_reference(g)
+                got, got_attempts, eng = _speculative_run(g, sched,
+                                                          depth=2)
+                assert got.minimal_colors == want.minimal_colors
+                assert np.array_equal(got.colors, want.colors)
+                assert got_attempts == want_attempts
+                # the window actually speculated (overlap existed)
+                assert eng.spec_stats["speculated"] > 0
+                assert eng.spec_stats["claims"] > 0
+            stats = sched.stats_snapshot()
+            assert stats["spec_seated"] > 0
+            assert stats["spec_wins"] > 0
+        finally:
+            sched.stop()
+        if telemetry:
+            kinds = {k for k, _ in events}
+            assert "spec_seated" in kinds
+            assert "spec_win" in kinds
+
+
+def test_jump_mode_is_inert():
+    """Without --strict-decrement the driver runs the fused find/confirm
+    pair through ``sweep`` — the speculative proxy must delegate and
+    never seat a single speculative attempt, so the default serve path
+    stays byte-identical (events included) with speculation armed."""
+    g = generate_random_graph_fast(500, avg_degree=6, seed=11)
+    events = []
+    sched = BatchScheduler(batch_max=4, window_s=0.0,
+                           on_event=lambda k, r: events.append(k)).start()
+    try:
+        cls = DEFAULT_LADDER.class_for(g.num_vertices, g.max_degree)
+        engine = SpeculativeMinimalKEngine(pad_member(g, cls), sched,
+                                           depth=3)
+        try:
+            got = find_minimal_coloring(
+                engine, initial_k=engine.member.k0,
+                validate=make_validator(g), post_reduce=make_reducer(g))
+        finally:
+            engine.close()
+        ref = find_minimal_coloring(
+            CompactFrontierEngine(g), initial_k=g.max_degree + 1,
+            validate=make_validator(g), post_reduce=make_reducer(g))
+        assert got.minimal_colors == ref.minimal_colors
+        assert np.array_equal(got.colors, ref.colors)
+        assert engine.spec_stats["speculated"] == 0
+        stats = sched.stats_snapshot()
+        assert stats["spec_seated"] == 0
+        assert not any(k.startswith("spec_") for k in events)
+    finally:
+        sched.stop()
+
+
+# -- cancellation at slice boundaries -----------------------------------
+
+_STAGE_LADDERS = (
+    "off",                              # full-table kernel, 1 rung
+    ((None, 128), (128, 0)),            # 2-rung ladder
+    ((None, 512), (512, 128), (128, 0)),  # 3-rung ladder
+)
+
+
+@pytest.mark.parametrize("stages", _STAGE_LADDERS,
+                         ids=["off", "rungs2", "rungs3"])
+def test_slice_boundary_cancellation_every_stage_rung(stages):
+    """S=1 makes EVERY superstep a slice boundary — including every
+    stage-rung transition of the staged frontier ladder — and the
+    stopping rule's first failure cancels the live window there. The
+    killed lanes charge their burned supersteps, and parity holds."""
+    g = generate_random_graph_fast(450, avg_degree=7, seed=77)
+    events = []
+    sched = BatchScheduler(
+        batch_max=4, window_s=0.0, slice_steps=1, stages=stages,
+        on_event=lambda kind, rec: events.append((kind, rec))).start()
+    try:
+        want, want_attempts = _strict_reference(g)
+        got, got_attempts, eng = _speculative_run(g, sched, depth=3)
+        assert got.minimal_colors == want.minimal_colors
+        assert np.array_equal(got.colors, want.colors)
+        assert got_attempts == want_attempts
+        stats = sched.stats_snapshot()
+        # the failing attempt ends the sweep with budgets below it still
+        # speculating: they MUST be cancelled, not claimed
+        assert stats["spec_cancelled"] > 0
+    finally:
+        sched.stop()
+    cancelled = [rec for kind, rec in events if kind == "spec_cancelled"]
+    assert cancelled
+    assert all(rec["where"] in ("queue", "lane", "done")
+               for rec in cancelled)
+    # every cancel is below the sweep's answer+... the failure budget:
+    # the window never held a budget the sequential schedule consumed
+    fail_k = min(k for k, _, _ in want_attempts)
+    assert all(rec["k"] <= fail_k for rec in cancelled)
+    # seated-lane kills report the supersteps they burned
+    lane_kills = [rec for rec in cancelled if rec["where"] == "lane"]
+    for rec in lane_kills:
+        assert rec.get("wasted_steps", 0) >= 0
+
+
+def test_close_cancels_outstanding_window():
+    """An abandoned sweep (engine.close without reaching the window)
+    frees every speculative lane instead of leaking it."""
+    g = generate_random_graph_fast(400, avg_degree=6, seed=31)
+    sched = BatchScheduler(batch_max=4, window_s=0.0,
+                           slice_steps=1).start()
+    try:
+        cls = DEFAULT_LADDER.class_for(g.num_vertices, g.max_degree)
+        engine = SpeculativeMinimalKEngine(pad_member(g, cls), sched,
+                                           depth=3)
+        engine.attempt(engine.member.k0)   # seeds the window below k0
+        assert engine._window
+        engine.close()
+        assert not engine._window
+        stats = sched.stats_snapshot()
+        assert stats["spec_cancelled"] >= 1
+    finally:
+        sched.stop()
+
+
+# -- starvation-freedom: real traffic preempts speculation --------------
+
+def test_real_requests_preempt_speculative_lanes():
+    """Speculation seats strictly below queued traffic: with every lane
+    speculative and a real wave larger than the free capacity, the
+    dispatcher preempts the speculative lanes the same slice and seats
+    the real wave — speculation can never starve a paying request."""
+    slow = generate_random_graph_fast(900, avg_degree=12, seed=50)
+    cls = DEFAULT_LADDER.class_for(slow.num_vertices, slow.max_degree)
+    sched = BatchScheduler(batch_max=2, window_s=0.0,
+                           slice_steps=1).start()
+    try:
+        member = pad_member(slow, cls)
+        # fill both lanes with speculative attempts (deep budgets: long
+        # frontier chains keep the lanes busy)
+        calls = [sched.speculate(member, member.k0 - 1 - i)
+                 for i in range(2)]
+        assert all(c is not None for c in calls)
+        import time
+        deadline = time.time() + 30
+        while (sched.stats_snapshot()["spec_seated"] < 1
+               and time.time() < deadline):
+            time.sleep(0.005)
+        assert sched.stats_snapshot()["spec_seated"] >= 1
+
+        # a real wave bigger than the free capacity arrives
+        real = [generate_random_graph_fast(300 + 40 * i, avg_degree=5,
+                                           seed=60 + i) for i in range(3)]
+        results = {}
+
+        def run_real(i, g):
+            eng = BatchMemberEngine(pad_member(g, cls), sched)
+            results[i] = find_minimal_coloring(
+                eng, initial_k=eng.member.k0, validate=make_validator(g),
+                post_reduce=make_reducer(g))
+
+        threads = [threading.Thread(target=run_real, args=(i, g))
+                   for i, g in enumerate(real)]
+        for t in threads:
+            t.start()
+        for t in threads:
+            t.join(timeout=300)
+        assert len(results) == 3
+        for i, g in enumerate(real):
+            ref = find_minimal_coloring(
+                CompactFrontierEngine(g), initial_k=g.max_degree + 1,
+                validate=make_validator(g), post_reduce=make_reducer(g))
+            assert results[i].minimal_colors == ref.minimal_colors
+            assert np.array_equal(results[i].colors, ref.colors)
+        stats = sched.stats_snapshot()
+        assert stats["spec_preempted"] >= 1
+        preempted = [c for c in calls
+                     if c.cancelled and c.cancel_reason == "preempted"]
+        assert preempted
+        for c in calls:
+            sched.cancel_speculative(c, "test done")
+    finally:
+        sched.stop()
+
+
+# -- serve front end wiring ---------------------------------------------
+
+def test_frontend_speculate_k_auto_resolves_and_serves():
+    """``speculate_k='auto'`` resolves against batch_max, and serve
+    requests (jump mode) stay byte-identical with speculation armed —
+    the engine substitution is inert by construction there."""
+    from dgc_tpu.obs import RunLogger
+
+    g = generate_random_graph_fast(400, avg_degree=6, seed=90)
+    stream = io.StringIO()
+    fe = ServeFrontEnd(batch_max=4, window_s=0.0, queue_depth=8,
+                       speculate_k="auto",
+                       logger=RunLogger(stream=stream, echo=False)).start()
+    try:
+        assert fe.speculate_k == auto_depth(4)
+        r = fe.submit(g).result(timeout=600)
+        assert r.ok
+        ref = find_minimal_coloring(
+            CompactFrontierEngine(g), initial_k=g.max_degree + 1,
+            validate=make_validator(g), post_reduce=make_reducer(g))
+        assert r.minimal_colors == ref.minimal_colors
+        assert np.array_equal(r.colors, ref.colors)
+    finally:
+        fe.shutdown()
+    # jump-mode serve requests never seat speculation
+    assert '"spec_seated"' not in stream.getvalue()
+
+
+def test_frontend_speculate_k_validation():
+    with pytest.raises(ValueError):
+        ServeFrontEnd(batch_max=2, speculate_k=0)

@@ -55,6 +55,12 @@
 #      cold-cache probe proving the shared disk store survives
 #      kill-all, colors byte-identical to the fault-free baseline,
 #      cached deliveries present in the merged usage ledger).
+#  12. speculation smoke (speculative minimal-k, same skip): a 3-draw
+#      strict-decrement parity leg through SpeculativeMinimalKEngine —
+#      colors, minimal k, and attempt sequences byte-identical to the
+#      sequential single-graph sweep, with speculative attempts
+#      actually seated AND the stopping rule's cancellation observed
+#      (the window below the first failure dies, never leaks).
 # Steps 1-3 are AST-only (seconds); steps 4-5 compile toy kernels on
 # CPU (~1-2 min cold) — the only gates that prove the profiler and
 # serving-over-the-network plumbing end-to-end before device time is
@@ -410,6 +416,67 @@ EOF
     echo "ci_checks: result-cache smoke OK" >&2
   else
     echo "ci_checks: result-cache smoke FAILED" >&2
+    rc=1
+  fi
+  # speculation smoke (speculative minimal-k): 3-draw strict-decrement
+  # parity through the speculative engine + the cancellation contract
+  if JAX_PLATFORMS=cpu timeout 300 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+import numpy as np
+from dgc_tpu.engine.compact import CompactFrontierEngine
+from dgc_tpu.engine.minimal_k import (find_minimal_coloring,
+                                      make_reducer, make_validator)
+from dgc_tpu.models.generators import generate_random_graph_fast
+from dgc_tpu.serve.engine import BatchScheduler
+from dgc_tpu.serve.shape_classes import DEFAULT_LADDER, pad_member
+from dgc_tpu.serve.speculate import SpeculativeMinimalKEngine
+
+events = []
+sched = BatchScheduler(batch_max=4, window_s=0.0, slice_steps=2,
+                       on_event=lambda k, r: events.append((k, r))).start()
+try:
+    for seed in (1, 2, 3):
+        g = generate_random_graph_fast(300 + 60 * seed, avg_degree=5,
+                                       seed=seed)
+        want_attempts, got_attempts = [], []
+        want = find_minimal_coloring(
+            CompactFrontierEngine(g), initial_k=g.max_degree + 1,
+            strict_decrement=True, validate=make_validator(g),
+            on_attempt=lambda r, v: want_attempts.append(
+                (int(r.k), r.status.name, int(r.supersteps))),
+            post_reduce=make_reducer(g))
+        cls = DEFAULT_LADDER.class_for(g.num_vertices, g.max_degree)
+        eng = SpeculativeMinimalKEngine(pad_member(g, cls), sched, depth=2)
+        try:
+            got = find_minimal_coloring(
+                eng, initial_k=eng.member.k0, strict_decrement=True,
+                validate=make_validator(g),
+                on_attempt=lambda r, v: got_attempts.append(
+                    (int(r.k), r.status.name, int(r.supersteps))),
+                post_reduce=make_reducer(g))
+        finally:
+            eng.close()
+        assert got.minimal_colors == want.minimal_colors
+        assert np.array_equal(got.colors, want.colors)
+        assert got_attempts == want_attempts, (got_attempts, want_attempts)
+    stats = sched.stats_snapshot()
+finally:
+    sched.stop()
+assert stats["spec_seated"] > 0, stats
+assert stats["spec_wins"] > 0, stats
+# the stopping rule cancels the window below the first failure
+assert stats["spec_cancelled"] > 0, stats
+kinds = {k for k, _ in events}
+assert {"spec_seated", "spec_win", "spec_cancelled"} <= kinds, kinds
+print("ci_checks: speculation parity 3 draw(s), %d seated / %d win(s) "
+      "/ %d cancelled" % (stats["spec_seated"], stats["spec_wins"],
+                          stats["spec_cancelled"]), file=sys.stderr)
+EOF
+  then
+    echo "ci_checks: speculation smoke OK" >&2
+  else
+    echo "ci_checks: speculation smoke FAILED" >&2
     rc=1
   fi
   rm -rf "$SMOKE_DIR"

@@ -138,6 +138,21 @@ def render_frame(base_url: str) -> str:
             + ("  [disk]" if rc.get("disk") else ""))
 
     series = parse_prom(fetch(f"{base_url}/metrics") or "")
+    spec_seated = _select(series, "dgc_serve_spec_seated_total")
+    if spec_seated:
+        # speculative minimal-k pane (appears only when --speculate-k
+        # armed the engine and at least one attempt was seated)
+        seated = sum(v for _, v in spec_seated)
+        wins = sum(v for _, v in
+                   _select(series, "dgc_serve_spec_wins_total"))
+        cancelled = sum(v for _, v in
+                        _select(series, "dgc_serve_spec_cancelled_total"))
+        wasted = sum(v for _, v in _select(
+            series, "dgc_serve_spec_wasted_supersteps_total"))
+        lines.append(f"  speculation: seated={_fmt_count(seated)}"
+                     f"  wins={_fmt_count(wins)}"
+                     f"  cancelled={_fmt_count(cancelled)}"
+                     f"  wasted_steps={_fmt_count(wasted)}")
     burns = _select(series, "dgc_slo_burn_fired_total")
     if burns:
         burned = ", ".join(

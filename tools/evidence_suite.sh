@@ -109,6 +109,26 @@ if [ "$battery_rc" -ne 2 ]; then
     --serve-modes continuous,continuous+nostage,continuous+devcarry --perf-db PERF_DB.jsonl 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
+  # speculative minimal-k A/B (ROADMAP 4(a)): strict-decrement sweeps
+  # with the k-1..k-depth window seated in sibling lanes vs the
+  # serve-sequential single_attempt driver over the SAME pool — the
+  # outer-k-loop parallelism measurement. The CPU rows (PERF.md
+  # "Speculative minimal-k") win 1.7-2.3x purely on per-slice dispatch
+  # amortization + claim overlap because CPU lanes scale near-linearly
+  # in compute; the TPU question is the real one: sibling lanes are
+  # parallel hardware there, so the window should approach
+  # ~max(attempt depth) supersteps instead of Σ(attempt depths).
+  # Parity (colors + attempt sequences + minimal k vs the off-pool
+  # compact reference) is asserted in-run; slice size is the auto
+  # policy (prices the ~65 ms on-chip dispatch amortization).
+  echo "=== speculative minimal-k A/B (2k class, depth 3/7) ===" | tee -a /dev/stderr >/dev/null
+  timeout 3600 python bench.py --speculate-ab --avg-degree 2.5 \
+    --speculate-depth 3 --perf-db PERF_DB.jsonl 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+  timeout 3600 python bench.py --speculate-ab --avg-degree 2.5 \
+    --speculate-depth 7 --perf-db PERF_DB.jsonl 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+
   # multi-device serve A/B (ROADMAP 2(a)): the same 64-graph stream
   # with the lane axis sharded over every local chip (+shard: Mesh +
   # NamedSharding over the batch axis, per-device occupancy in the

@@ -232,6 +232,26 @@ def render(doc: dict, width: int = 48) -> str:
                 + (f", {summ['mesh_degrades']} mesh degrade(s) "
                    f"({summ.get('lanes_evacuated', 0)} lane(s) evacuated)"
                    if summ.get("mesh_degrades") else ""))
+        spec = sv.get("speculation")
+        if spec or (summ and summ.get("spec_seated") is not None):
+            # speculative minimal-k plane (the slot appears only when
+            # --speculate-k armed it); summary totals win over the
+            # per-event aggregates when both are present
+            seated = (summ or {}).get("spec_seated",
+                                      (spec or {}).get("seated", 0))
+            wins = (summ or {}).get("spec_wins",
+                                    (spec or {}).get("wins", 0))
+            cancelled = (summ or {}).get(
+                "spec_cancelled",
+                sum((spec or {}).get("cancelled", {}).values()))
+            wasted = (summ or {}).get(
+                "spec_wasted_steps", (spec or {}).get("wasted_steps", 0))
+            add(f"  speculation: {seated} seated, {wins} win(s), "
+                f"{cancelled} cancelled "
+                f"({wasted} superstep(s) wasted"
+                + (f", {summ['spec_preempted']} preempted"
+                   if summ and summ.get("spec_preempted") else "")
+                + ")")
         if summ and summ.get("cache_hits") is not None:
             # content-addressed result cache totals (the slot appears
             # only when the cache was armed)

@@ -150,10 +150,11 @@ def _semantic_problems(record: dict) -> list[str]:
     elif kind == "net_cache":
         action = record.get("action")
         if action not in ("hit", "miss", "coalesced", "store",
-                          "promote"):
+                          "promote", "evict", "recover_fill"):
             problems.append(
                 f"net_cache: action {action!r} not in "
-                f"('hit', 'miss', 'coalesced', 'store', 'promote')")
+                f"('hit', 'miss', 'coalesced', 'store', 'promote', "
+                f"'evict', 'recover_fill')")
         if record.get("tenant") == "":
             problems.append("net_cache: empty tenant")
         source = record.get("source")
@@ -164,9 +165,45 @@ def _semantic_problems(record: dict) -> list[str]:
             problems.append(
                 "net_cache: coalesced follower without a cached_from "
                 "leader ticket")
+        # disk-GC evictions name their bound and a non-negative size
+        if action == "evict" \
+                and record.get("reason") not in ("ttl", "max_bytes"):
+            problems.append(
+                f"net_cache: evict reason {record.get('reason')!r} not "
+                f"in ('ttl', 'max_bytes')")
+        nbytes = record.get("bytes")
+        if isinstance(nbytes, int) and not isinstance(nbytes, bool) \
+                and nbytes < 0:
+            problems.append(f"net_cache: bytes {nbytes} < 0")
         v = record.get("v")
         if isinstance(v, int) and not isinstance(v, bool) and v < 0:
             problems.append(f"net_cache: v {v} < 0")
+    # speculative minimal-k (serve.speculate): cancellation reasons and
+    # sites come from closed vocabularies, budgets are >= 1 (a
+    # speculative seat below k=1 can never be claimed), and wasted
+    # supersteps are non-negative — the speculation A/B artifacts stay
+    # machine-checkable end to end
+    elif kind in ("spec_seated", "spec_win", "spec_cancelled"):
+        k = record.get("k")
+        if isinstance(k, int) and not isinstance(k, bool) and k < 1:
+            problems.append(f"{kind}: k {k} < 1")
+        if kind == "spec_seated":
+            lane = record.get("lane")
+            if isinstance(lane, int) and not isinstance(lane, bool) \
+                    and lane < 0:
+                problems.append(f"spec_seated: lane {lane} < 0")
+        elif kind == "spec_cancelled":
+            if record.get("where") not in ("queue", "lane", "done"):
+                problems.append(
+                    f"spec_cancelled: where {record.get('where')!r} "
+                    f"not in ('queue', 'lane', 'done')")
+            if not record.get("reason"):
+                problems.append("spec_cancelled: empty reason")
+            wasted = record.get("wasted_steps")
+            if isinstance(wasted, int) and not isinstance(wasted, bool) \
+                    and wasted < 0:
+                problems.append(
+                    f"spec_cancelled: wasted_steps {wasted} < 0")
     # closed-loop robustness controllers (PR 17): probe actions and
     # brownout transitions come from closed vocabularies, backoffs and
     # levels stay in range — chaos_fleet's artifacts stay
