@@ -542,6 +542,147 @@ def _speculate_ab(args, phases: dict, context: dict, recorder=None) -> int:
     return 0 if parity_ok else 1
 
 
+def _block_ab(args, phases: dict, context: dict, recorder=None) -> int:
+    """``--block-ab``: blocked vs sequential strict-decrement minimal-k
+    on the single-graph compact engine — the dispatch-amortization A/B
+    (PERF.md "Dispatch amortization"). Both arms run the UNMODIFIED
+    ``find_minimal_coloring(strict_decrement=True)`` over an
+    ``ObservedEngine``-wrapped :class:`CompactFrontierEngine`; the
+    blocked arm adds ``attempts_per_dispatch=A`` so the driver chains up
+    to ``A`` outer-loop attempts into one ``attempt_block`` device
+    dispatch. Each arm's own ``MetricsRegistry`` counts
+    ``dgc_device_dispatches_total`` — the record publishes both counts
+    and their ratio, and at ``A >= 4`` the run HARD-FAILS unless the
+    blocked arm cut dispatches by at least 3x (the issue's acceptance
+    floor; the stopping rule legitimately pays one extra dispatch when
+    the failure lands on a block boundary, so the bound is 3x, not A).
+    Parity every trial: minimal colors, the color vector, and the full
+    attempt tuple sequence must be byte-identical between arms. Timing
+    is best-of-``--block-trials`` after a warm pass per arm (both
+    kernels compiled off the clock), so the wall-clock delta is
+    schedule + transfer, not compile. Emits ONE JSON line (value =
+    speedup_x, ``"better": "higher"``)."""
+    import numpy as np
+
+    from dgc_tpu.engine.compact import CompactFrontierEngine
+    from dgc_tpu.engine.minimal_k import (find_minimal_coloring,
+                                          make_reducer, make_validator)
+    from dgc_tpu.models.generators import (generate_random_graph_fast,
+                                           generate_rmat_graph)
+    from dgc_tpu.obs import MetricsRegistry
+    from dgc_tpu.obs.instrument import ObservedEngine
+    from dgc_tpu.tune.config import graph_shape_hash
+
+    a_per = int(args.block_attempts)
+    if a_per < 2:
+        raise SystemExit("--block-attempts must be >= 2 (1 is the "
+                         "sequential arm)")
+    gen = (generate_rmat_graph if args.gen == "rmat"
+           else generate_random_graph_fast)
+    t0 = time.perf_counter()
+    g = gen(args.nodes, avg_degree=args.avg_degree, seed=args.seed)
+    phases["gen_s"] = time.perf_counter() - t0
+    context["graph_shape_hash"] = graph_shape_hash(g)
+    print(f"# block-ab: V={g.num_vertices} maxdeg={g.max_degree} "
+          f"attempts_per_dispatch={a_per} trials={args.block_trials}",
+          file=sys.stderr)
+
+    validator = make_validator(g)
+    reducer = make_reducer(g)
+
+    def run_arm(attempts_per_dispatch: int, registry=None):
+        eng = ObservedEngine(CompactFrontierEngine(g), registry=registry,
+                             record_trajectory=False)
+        attempts = []
+        res = find_minimal_coloring(
+            eng, initial_k=g.max_degree + 1, strict_decrement=True,
+            validate=validator,
+            on_attempt=lambda r, v, a=attempts: a.append(
+                (int(r.k), r.status.name, int(r.supersteps),
+                 int(r.colors_used))),
+            post_reduce=reducer,
+            attempts_per_dispatch=attempts_per_dispatch)
+        return res, attempts
+
+    # warm both arms (compile off the clock), counting dispatches once —
+    # the counter is deterministic per arm, so the warm pass IS the
+    # dispatch measurement and the timed trials stay registry-free
+    t0 = time.perf_counter()
+    reg_seq, reg_blk = MetricsRegistry(), MetricsRegistry()
+    ref_res, ref_at = run_arm(1, registry=reg_seq)
+    blk_res, blk_at = run_arm(a_per, registry=reg_blk)
+    phases["warmup_s"] = time.perf_counter() - t0
+    d_seq = int(reg_seq.counter("dgc_device_dispatches_total").value)
+    d_blk = int(reg_blk.counter("dgc_device_dispatches_total").value)
+    ratio = d_seq / d_blk if d_blk else 0.0
+    print(f"# dispatches: sequential {d_seq} vs blocked {d_blk} "
+          f"-> {ratio:.2f}x", file=sys.stderr)
+
+    parity_ok = (blk_res.minimal_colors == ref_res.minimal_colors
+                 and np.array_equal(blk_res.colors, ref_res.colors)
+                 and blk_at == ref_at)
+    if not parity_ok:
+        print("# PARITY FAILURE: blocked arm diverged from the "
+              "sequential sweep", file=sys.stderr)
+    dispatch_ok = not (a_per >= 4) or ratio >= 3.0
+    if not dispatch_ok:
+        print(f"# DISPATCH FAILURE: blocked arm reduced dispatches only "
+              f"{ratio:.2f}x at A={a_per} (floor 3.0x)", file=sys.stderr)
+
+    seq_times, blk_times = [], []
+    for _ in range(args.block_trials):
+        t0 = time.perf_counter()
+        s_res, s_at = run_arm(1)
+        seq_times.append(time.perf_counter() - t0)
+        t0 = time.perf_counter()
+        b_res, b_at = run_arm(a_per)
+        blk_times.append(time.perf_counter() - t0)
+        if not (b_res.minimal_colors == s_res.minimal_colors
+                and np.array_equal(b_res.colors, s_res.colors)
+                and b_at == s_at):
+            parity_ok = False
+            print("# PARITY FAILURE: arms diverged in a timed trial",
+                  file=sys.stderr)
+    seq_s, blk_s = min(seq_times), min(blk_times)
+    phases["sequential_s"] = seq_s
+    phases["blocked_s"] = blk_s
+    speedup = seq_s / blk_s if blk_s else 0.0
+    print(f"# sequential {seq_s:.3f}s vs blocked {blk_s:.3f}s "
+          f"-> {speedup:.2f}x", file=sys.stderr)
+
+    record = {
+        "metric": f"block_minimal_k_{args.nodes}v_avgdeg"
+                  f"{args.avg_degree:g}"
+                  f"{'_rmat' if args.gen == 'rmat' else ''}"
+                  f"_a{a_per}",
+        "value": round(speedup, 3),
+        "unit": "x",
+        "better": "higher",
+        "vs_baseline": "sequential one-attempt-per-dispatch strict sweep "
+                       "(same engine, same kernels)",
+        "sequential_s": round(seq_s, 4),
+        "blocked_s": round(blk_s, 4),
+        "attempts_per_dispatch": a_per,
+        "attempts": len(ref_at),
+        "dispatches": {"sequential": d_seq, "blocked": d_blk,
+                       "ratio": round(ratio, 3)},
+        "trials": args.block_trials,
+        "parity_ok": parity_ok,
+        "dispatch_ok": dispatch_ok,
+        "phases": {k: round(v, 4) for k, v in phases.items()},
+        "backend": args.backend,
+        "platform": context["platform"],
+        "graph_shape_hash": context.get("graph_shape_hash"),
+    }
+    perf = _perf_db_check(args, record)
+    if perf is not None:
+        record["perf_db"] = perf
+    print(json.dumps(record))
+    if perf is not None and perf.get("regression"):
+        return 1
+    return 0 if (parity_ok and dispatch_ok) else 1
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=None,
@@ -631,6 +772,20 @@ def main() -> int:
     p.add_argument("--speculate-trials", type=int, default=3,
                    help="timed A/B trials; best-of wall-clock per arm "
                         "(default 3)")
+    # device-resident minimal-k (engine.compact attempt_block): blocked
+    # vs sequential strict sweep on the single-graph compact engine —
+    # the dispatch-amortization A/B (PERF.md "Dispatch amortization")
+    p.add_argument("--block-ab", action="store_true",
+                   help="measure blocked-vs-sequential strict minimal-k "
+                        "wall-clock + device-dispatch counts on the "
+                        "compact engine (value = speedup_x; hard-fails "
+                        "unless dispatches drop >= 3x at A >= 4)")
+    p.add_argument("--block-attempts", type=int, default=4,
+                   help="attempts chained per device dispatch in the "
+                        "blocked arm (default 4)")
+    p.add_argument("--block-trials", type=int, default=3,
+                   help="timed A/B trials; best-of wall-clock per arm "
+                        "(default 3)")
     p.add_argument("--serve-slice-steps", type=str, default="auto",
                    help="supersteps per continuous-mode slice, or "
                         "'auto' to price against dispatch overhead "
@@ -669,6 +824,7 @@ def main() -> int:
         # smallest ladder rung); serve-throughput to its multi-class mix
         args.nodes = (2_000 if args.speculate_ab
                       else 20_000 if args.serve_throughput
+                      else 100_000 if args.block_ab
                       else 1_000_000)
 
     import jax
@@ -732,6 +888,8 @@ def main() -> int:
         return _serve_throughput(args, phases, context, recorder=recorder)
     if args.speculate_ab:
         return _speculate_ab(args, phases, context, recorder=recorder)
+    if args.block_ab:
+        return _block_ab(args, phases, context, recorder=recorder)
 
     t0 = time.perf_counter()
     if args.gen == "rmat":

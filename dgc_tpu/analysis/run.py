@@ -86,7 +86,13 @@ LOCK_FILES = ("dgc_tpu/obs/metrics.py", "dgc_tpu/obs/httpd.py",
               # scheduler lock
               "dgc_tpu/serve/speculate.py",
               "tools/soak.py", "bench.py")
-TRANSFER_FILES = ("dgc_tpu/serve/batched.py", "dgc_tpu/serve/engine.py")
+TRANSFER_FILES = ("dgc_tpu/serve/batched.py", "dgc_tpu/serve/engine.py",
+                  # device-resident minimal-k: the blocked attempt kernel
+                  # donates its carry (best_pe + resume ring) under the
+                  # same DGC_TPU_DONATE_CARRY gate, and launders the
+                  # donated/plain twin through a dict-subscript kernel
+                  # cache the TR pass now tracks
+                  "dgc_tpu/engine/compact.py")
 
 PASSES = ("staging", "layout", "schema", "locks", "transfer")
 

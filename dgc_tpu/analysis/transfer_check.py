@@ -44,15 +44,24 @@ positions; a function whose name ends in ``_donated`` is donating with
 unknown positions (TR002 then checks every positional argument); the
 ``distinct-buffers`` marker adds donation-*seeding* callees. Call sites
 resolve through the file set's imports (``common.SymbolTable`` — the
-same call-graph substrate the staging pass closes over). Pallas bodies
-need no special-casing here: ``pl.program_id`` and friends are
-device-side values, and none of the host-materializer names match them
-— the queued Pallas gather/bitmask kernel lints on arrival.
+same call-graph substrate the staging pass closes over). Donation also
+tracks through *dict-subscript kernel caches*: a store
+``self._kernels[key] = fn`` whose value resolves to a donating callee
+(through an ``a if gate else b`` twin selection too) marks the cache
+base, and a later ``self._kernels[key](...)`` — or the laundered
+two-step ``kern = self._kernels[key]; kern(...)`` — resolves to that
+donator (conservatively merged to unknown positions when different
+donators land in one cache). Pallas bodies need no special-casing here:
+``pl.program_id`` and friends are device-side values, and none of the
+host-materializer names match them — the queued Pallas gather/bitmask
+kernel lints on arrival.
 
-Scope limits (honest ones): the analysis is intra-procedural — a kernel
-reference laundered through a compile cache (``self._kernels[key]``) is
-not resolved, and the runtime parity ensembles stay the authority
-there. Findings skip ``*args`` splats rather than guessing.
+Scope limits (honest ones): the analysis is intra-procedural past the
+cache tracking above — a kernel reference laundered through anything
+richer than a single-assignment subscript cache (a factory return, a
+getattr chain) is not resolved, and the runtime parity ensembles stay
+the authority there. Findings skip ``*args`` splats rather than
+guessing.
 """
 
 from __future__ import annotations
@@ -145,6 +154,50 @@ def _collect_donators(modules: list[SourceModule],
             if donates or distinct:
                 out[(mod.rel, node.name)] = _Donator(
                     node.name, positions, distinct_only=not donates)
+    return out
+
+
+def _collect_subscript_caches(modules: list[SourceModule],
+                              table: SymbolTable,
+                              donators: dict[tuple, _Donator]
+                              ) -> dict[tuple, _Donator]:
+    """(module rel, cache base key) → _Donator for every dict-subscript
+    kernel-cache store whose value resolves to a donating callee:
+    ``self._kernels[key] = _step_donated`` (or the gated twin selection
+    ``a if _DONATE_CARRY else b``) marks base ``self._kernels``. Two
+    different donators landing in one cache merge to unknown positions
+    (TR002 then checks every positional argument at the call)."""
+    out: dict[tuple, _Donator] = {}
+    for mod in modules:
+        for node in ast.walk(mod.tree):
+            if not isinstance(node, ast.Assign):
+                continue
+            for t in node.targets:
+                if not isinstance(t, ast.Subscript):
+                    continue
+                base = _access_key(t.value)
+                if base is None:
+                    continue
+                for ref in ast.walk(node.value):
+                    if not isinstance(ref, ast.Name):
+                        continue
+                    d = None
+                    resolved = table.resolve(mod, ref)
+                    if resolved is not None \
+                            and hasattr(resolved[1], "name"):
+                        d = donators.get((resolved[0].rel,
+                                          resolved[1].name))
+                    if d is None:
+                        d = donators.get((mod.rel, ref.id))
+                    if d is None and ref.id.endswith("_donated"):
+                        d = _Donator(ref.id, None)
+                    if d is None or d.distinct_only:
+                        continue
+                    prev = out.get((mod.rel, base))
+                    if prev is not None \
+                            and prev.positions != d.positions:
+                        d = _Donator(d.name, None)
+                    out[(mod.rel, base)] = d
     return out
 
 
@@ -661,6 +714,7 @@ def check_transfer(modules: list[SourceModule], *,
     d2h = set(d2h_slots if d2h_slots is not None else ())
     table = SymbolTable(modules)
     donators = _collect_donators(modules, table)
+    caches = _collect_subscript_caches(modules, table, donators)
     out: list[Finding] = []
 
     for mod in modules:
@@ -669,7 +723,7 @@ def check_transfer(modules: list[SourceModule], *,
         jax_heads = {a for a, d in imports.items()
                      if d == "jax" or d.startswith("jax.")}
 
-        def resolve_call(call: ast.Call, mod=mod):
+        def resolve_call(call: ast.Call, mod=mod, assigns=None):
             name = None
             if isinstance(call.func, ast.Name):
                 name = call.func.id
@@ -686,21 +740,41 @@ def check_transfer(modules: list[SourceModule], *,
                 local = donators.get((mod.rel, name))
                 if local is not None:
                     return local
+            # dict-subscript kernel caches: `self._kernels[key](...)`
+            # directly, or laundered through a single local rebind
+            # (`kern = self._kernels[key]; kern(...)`)
+            sub = None
+            if isinstance(call.func, ast.Subscript):
+                sub = call.func
+            elif isinstance(call.func, ast.Name) and assigns:
+                bound = assigns.get(call.func.id, [])
+                if len(bound) == 1 and isinstance(bound[0], ast.Subscript):
+                    sub = bound[0]
+            if sub is not None:
+                base = _access_key(sub.value)
+                if base is not None:
+                    d = caches.get((mod.rel, base))
+                    if d is not None:
+                        return d
             return None
 
         funcs = [(n, n.name) for n in ast.walk(mod.tree)
                  if isinstance(n, (ast.FunctionDef, ast.AsyncFunctionDef))]
         for func, label in funcs:
             assigns = _local_assigns(func)
+
+            def rc(call, _a=assigns):
+                return resolve_call(call, assigns=_a)
+
             # TR002 at every donating call site
             for node in ast.walk(func):
                 if isinstance(node, ast.Call):
-                    donator = resolve_call(node)
+                    donator = rc(node)
                     if donator is not None:
                         _check_tr002(mod, label, node, donator, assigns,
                                      jax_heads, out)
             # TR001/TR004 linear scan
-            _DonationScan(mod, label, resolve_call, out).run(func)
+            _DonationScan(mod, label, rc, out).run(func)
             # TR003 materialization scan
             _MaterializeScan(mod, label, layout_consts, d2h, carry_vars,
                              device_attrs, np_heads, jax_heads,

@@ -90,6 +90,19 @@ def build_parser() -> argparse.ArgumentParser:
              "serve kernel, so --backend applies only to the "
              "speculation-free path",
     )
+    p.add_argument(
+        "--attempts-per-dispatch", type=str, default=None, metavar="A|auto",
+        help="device-resident minimal-k: chain up to A attempts of the "
+             "outer k-loop inside ONE device dispatch (engines with an "
+             "attempt_block kernel — ell-compact), with the in-kernel "
+             "stopping rule ending the block early; per-block host "
+             "traffic is the stopping-rule scalars plus the final "
+             "colors row, so the per-attempt dispatch overhead "
+             "amortizes by ~A; 'auto' prices A off the expected "
+             "attempt count (utils.schedule_model); 1/unset is "
+             "byte-identical to the sequential driver; results, "
+             "checkpoints and telemetry are byte-identical at any A",
+    )
     p.add_argument("--checkpoint-dir", type=str, default=None, help="checkpoint/resume directory")
     p.add_argument(
         "--checkpoint-write-behind", action="store_true",
@@ -608,9 +621,12 @@ def _run(args, logger: RunLogger) -> int:
     spec_depth = None
     if getattr(args, "speculate_k", None):
         if args.speculate_k == "auto":
-            from dgc_tpu.serve.speculate import AUTO_DEPTH_CAP
+            # priced adaptive depth: the survival curve of the strict
+            # chain from THIS graph's starting budget, not the fixed
+            # pre-pricing cap (utils.schedule_model.speculation_auto_cap)
+            from dgc_tpu.utils.schedule_model import speculation_auto_cap
 
-            spec_depth = AUTO_DEPTH_CAP
+            spec_depth = speculation_auto_cap(graph.initial_k())
         else:
             try:
                 spec_depth = int(args.speculate_k)
@@ -625,6 +641,47 @@ def _run(args, logger: RunLogger) -> int:
                   "the supervised ladder drives engines directly",
                   file=sys.stderr)
             spec_depth = None
+    # device-resident minimal-k (engine attempt_block): parse up front so
+    # a bad value fails before device init; 1/unset takes the exact
+    # sequential dispatch path (byte-identical, no blocked kernel built)
+    attempts_per_dispatch = 1
+    if getattr(args, "attempts_per_dispatch", None):
+        if args.attempts_per_dispatch == "auto":
+            from dgc_tpu.utils.schedule_model import (
+                auto_attempts_per_dispatch)
+
+            attempts_per_dispatch = auto_attempts_per_dispatch(
+                graph.initial_k())
+        else:
+            try:
+                attempts_per_dispatch = int(args.attempts_per_dispatch)
+                if attempts_per_dispatch < 1:
+                    raise ValueError
+            except ValueError:
+                print(f"--attempts-per-dispatch must be a positive integer "
+                      f"or 'auto', got {args.attempts_per_dispatch!r}",
+                      file=sys.stderr)
+                return 2
+    elif getattr(args, "_tuned_cfg", None) is not None:
+        # tuned-config artifacts may carry the blocking factor (a driver
+        # knob, not an engine kwarg — engine_kwargs never forwards it)
+        attempts_per_dispatch = max(
+            1, int(getattr(args._tuned_cfg, "attempts_per_dispatch", None)
+                   or 1))
+    if attempts_per_dispatch > 1 and spec_depth is not None:
+        # the speculative proxy has no attempt_block surface; the blocked
+        # driver would silently fall back — say so instead
+        print("# --attempts-per-dispatch ignored with --speculate-k: the "
+              "speculation pool dispatches attempts individually",
+              file=sys.stderr)
+        attempts_per_dispatch = 1
+
+    def on_block(k, attempts):
+        # flight-recorder visibility for the in-flight block span: a hang
+        # inside a block dumps with this as the last engine-facing event,
+        # bracketing which attempts (k .. k-attempts+1 at most) were in
+        # flight on-device
+        logger.event("attempt_block", k=int(k), attempts=int(attempts))
     if args.inject_faults:
         try:
             schedule = faults.FaultSchedule.parse(args.inject_faults)
@@ -773,6 +830,8 @@ def _run(args, logger: RunLogger) -> int:
                     policy=RetryPolicy(seed=args.seed or 0),
                     retry_budget=max(args.retries, 0),
                     attempt_timeout_s=args.attempt_timeout,
+                    attempts_per_dispatch=attempts_per_dispatch,
+                    on_block=on_block,
                     logger=logger, registry=registry,
                     # rc-114 capture: the supervisor emits the
                     # structured_abort event and dumps the recorder's
@@ -817,6 +876,8 @@ def _run(args, logger: RunLogger) -> int:
                     on_attempt=on_attempt,
                     checkpoint=make_ckpt(args.backend),
                     post_reduce=make_post_reduce(args.backend),
+                    attempts_per_dispatch=attempts_per_dispatch,
+                    on_block=on_block,
                 )
     phases.log_device_memory()
     if profile_window is not None:

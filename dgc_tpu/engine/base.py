@@ -48,6 +48,26 @@ class AttemptResult:
         return int(colored.max()) + 1 if len(colored) else 0
 
 
+@dataclass
+class BlockAttemptResult(AttemptResult):
+    """One attempt decoded from a fused attempt-block dispatch
+    (``CompactFrontierEngine.attempt_block``): the kernel returns
+    per-attempt scalars for every chained attempt but only the final and
+    best packed color rows, so ``colors`` may be None until the driver
+    materializes it at a block boundary (``engine.minimal_k``). ``used``
+    carries the in-kernel color count (max color + 1), so ``colors_used``
+    stays exact — and byte-identical to the sequential driver's — without
+    the row."""
+
+    used: int = 0
+
+    @property
+    def colors_used(self) -> int:
+        if self.colors is None:
+            return int(self.used)
+        return AttemptResult.colors_used.fget(self)
+
+
 class ColoringEngine(Protocol):
     """One k-attempt. Implementations: oracle, reference_sim, ell, dense, sharded."""
 

@@ -371,11 +371,14 @@ class BucketedELLEngine:
                                     max_planes=self._window_cap)
         return True
 
-    def _finish(self, packed: np.ndarray, status, steps: int, k: int) -> AttemptResult:
+    def _decode_colors(self, packed: np.ndarray) -> np.ndarray:
         colors_new = np.where(packed >= 0, packed >> 1, -1).astype(np.int32)
         colors = np.empty_like(colors_new)
         colors[self.perm] = colors_new  # back to original ids
-        return AttemptResult(status, colors, steps, int(k))
+        return colors
+
+    def _finish(self, packed: np.ndarray, status, steps: int, k: int) -> AttemptResult:
+        return AttemptResult(status, self._decode_colors(packed), steps, int(k))
 
     def attempt(self, k: int) -> AttemptResult:
         if k < 1:

@@ -95,14 +95,16 @@ row that absorbs duplicate scatter writes).
 
 from __future__ import annotations
 
+import os
 from functools import partial
 
 import jax
 import jax.numpy as jnp
 import numpy as np
 
-from dgc_tpu.engine.base import AttemptResult, AttemptStatus
-from dgc_tpu.engine.fused import finish_sweep_pair
+from dgc_tpu import layout
+from dgc_tpu.engine.base import AttemptResult, AttemptStatus, BlockAttemptResult
+from dgc_tpu.engine.fused import BlockOutcome, finish_sweep_pair
 from dgc_tpu.engine.bucketed import (
     BucketedELLEngine,
     build_combined_rows,
@@ -112,6 +114,7 @@ from dgc_tpu.engine.bucketed import (
 )
 from dgc_tpu.models.arrays import GraphArrays
 from dgc_tpu.obs.kernel import (
+    decode_block_trajectories,
     decode_trajectory,
     make_trajstep,
     traj_cap_for,
@@ -1723,6 +1726,133 @@ def _sweep_kernel_staged(buckets, flat_ext, degrees, k0, planes: tuple,
             out[14], out[15])
 
 
+# -- attempt-block kernel: the minimal-k outer loop fused one level up ----
+#
+# Donation gating mirrors serve/batched.py's TR005 pattern: jax 0.4.37's
+# XLA-CPU persistent-cache executables drop input-output aliasing, so the
+# donated twin is opt-in via DGC_TPU_DONATE_CARRY=1 (TPU deployments, where
+# the carry rows are worth keeping hot) and the non-donated twin is the
+# default everywhere results must survive the call.
+_DONATE_CARRY = os.environ.get("DGC_TPU_DONATE_CARRY") == "1"
+
+_BLOCK_STATIC_NAMES = _STATIC_NAMES + ("attempts", "strict")
+
+
+def _block_kernel_body(buckets, flat_ext, degrees, k0, k_min,
+                       best_pe, rec, attempts: int, strict: bool,
+                       record_traj: bool = False, traj_cap: int = 1,
+                       traj_timing: bool = False, **static_kw):
+    """Chain up to ``attempts`` k-attempts inside ONE ``while_loop``,
+    early-exiting when the stopping rule fires mid-block. Output layout:
+    ``layout.BK_*`` — per-attempt scalar records, the stopping-rule
+    scalars, the best/last packed color rows, the prefix-resume ring, and
+    the stacked per-attempt trajectory buffers.
+
+    Budget chaining is the sequential drivers' rule verbatim: strict mode
+    decrements (``k − 1``); jump mode re-budgets at ``used − 1``, which is
+    simultaneously the fused pair's confirm rule *and* the driver's
+    across-pair rule — so one uniform in-kernel rule replays the exact
+    budget sequence of either sequential driver. ``k_next`` reports the
+    next budget after a success (sub-floor included — the checkpoint
+    convention) and the failed budget after a failure.
+
+    Every attempt both records into and restores from the carried
+    prefix-resume ring. Soundness is the ring's bracket argument
+    (``_staged_pipeline`` docstring), which is budget-generic: an entry
+    recorded at any larger budget whose (m_old, m_new] bracket contains
+    k' is exactly the state a scratch run at k' reaches on its own — so
+    colors, status, AND step counts stay byte-identical to scratch runs,
+    in both strict and jump modes, across block boundaries included.
+    """
+    v = degrees.shape[0]
+    nb = len(static_kw["init_bucket_active"])
+    a = int(attempts)
+    traj0 = traj_empty(traj_cap, nb=nb, dummy=not record_traj,
+                       unconf_b=record_traj)
+    tstack0 = jnp.tile(traj0[None], (a, 1, 1))
+    att0 = jnp.full((a, layout.BK_ATT_COLS), -1, jnp.int32)
+    init = (jnp.int32(0), jnp.asarray(k0, jnp.int32), jnp.bool_(False),
+            att0, best_pe, jnp.zeros(v + 2, jnp.int32)) + tuple(rec) + (tstack0,)
+    k_min = jnp.asarray(k_min, jnp.int32)
+
+    def cond(c):
+        ai, done = c[0], c[2]
+        return (ai < a) & (~done)
+
+    def body(c):
+        ai, k, done, att, best_pe, last_pe = c[:6]
+        rec = c[6:11]
+        tstack = c[11]
+
+        pe_i, step_i, act_i, stall_i, ba_i = _default_init(
+            degrees, static_kw["init_bucket_active"])
+        pe_i, ba_i, step_i, stall_i, act_i = restore_from_ring(
+            rec, k, jnp.bool_(False), pe_i, ba_i, step_i, stall_i, act_i)
+
+        pe, steps, status, rec, traj = _staged_pipeline(
+            buckets, flat_ext, degrees, k,
+            (pe_i, step_i, act_i, stall_i, ba_i), rec, jnp.bool_(True),
+            traj=traj0, record_traj=record_traj, traj_timing=traj_timing,
+            **static_kw)
+        colors = jnp.where(pe[:v] >= 0, pe[:v] >> 1, -1)
+        used = jnp.max(colors, initial=-1) + 1
+        success = status == _SUCCESS
+        row = jnp.stack([k, steps, status, used]).astype(jnp.int32)
+        att = jax.lax.dynamic_update_slice(att, row[None], (ai, 0))
+        best_pe = jnp.where(success, pe, best_pe)
+        k_dec = (k - 1) if strict else (used - 1)
+        stop = (~success) | (k_dec < k_min)
+        k_next = jnp.where(success, k_dec, k).astype(jnp.int32)
+        tstack = jax.lax.dynamic_update_slice(tstack, traj[None], (ai, 0, 0))
+        return ((ai + 1, k_next, stop, att, best_pe, pe)
+                + tuple(rec) + (tstack,))
+
+    out = jax.lax.while_loop(cond, body, init)
+    return (out[3], out[0], out[1], out[2], out[4], out[5]) + out[6:12]
+
+
+# the donated twin and the non-donated twin share one traced body; only
+# the jit wrapper differs (donate_argnums present vs absent), so the
+# executables are the same program modulo aliasing
+# donated positions: the device-resident block carry — positional args 5
+# (best_pe) and 6 (rec ring) of the block kernels (literal, so the
+# dgc-lint TR pass reads the positions straight off the decorator)
+_donated_block_jit = partial(
+    jax.jit, static_argnames=_BLOCK_STATIC_NAMES,
+    **({"donate_argnums": (5, 6)} if _DONATE_CARRY else {}))
+
+
+@_donated_block_jit
+def _block_kernel_staged_donated(buckets, flat_ext, degrees, k0, k_min,
+                                 best_pe, rec, attempts: int,
+                                 strict: bool, record_traj: bool = False,
+                                 traj_cap: int = 1,
+                                 traj_timing: bool = False, **static_kw):
+    """Donated twin of ``_block_kernel_staged``: the device-resident block
+    carry (best_pe + prefix-resume ring) is donated in→out, so XLA reuses
+    the rows across blocks instead of allocating fresh ones per dispatch.
+    The carry buffers are dead to the caller after the call — the engine
+    only ever touches the *returned* carry."""
+    return _block_kernel_body(
+        buckets, flat_ext, degrees, k0, k_min, best_pe, rec,
+        attempts, strict, record_traj=record_traj, traj_cap=traj_cap,
+        traj_timing=traj_timing, **static_kw)
+
+
+@partial(jax.jit, static_argnames=_BLOCK_STATIC_NAMES)
+def _block_kernel_staged(buckets, flat_ext, degrees, k0, k_min,
+                         best_pe, rec, attempts: int, strict: bool,
+                         record_traj: bool = False, traj_cap: int = 1,
+                         traj_timing: bool = False, **static_kw):
+    """Multi-attempt block kernel (non-donated twin; see
+    ``_block_kernel_body`` for the chaining semantics and
+    ``_block_kernel_staged_donated`` for the donated variant)."""
+    return _block_kernel_body(
+        buckets, flat_ext, degrees, k0, k_min, best_pe, rec,
+        attempts, strict, record_traj=record_traj, traj_cap=traj_cap,
+        traj_timing=traj_timing, **static_kw)
+
+
 class CompactFrontierEngine(BucketedELLEngine):
     """Single-call staged frontier-compacted engine (single device).
 
@@ -1769,6 +1899,9 @@ class CompactFrontierEngine(BucketedELLEngine):
         # requires record_trajectory; statically off by default)
         self.record_trajectory = False
         self.record_timing = False
+        # attempt-block kernel cache: donation mode → jitted kernel
+        # (resolved once per mode so a flipped env var cannot mix twins)
+        self._block_kernels = {}
         v = arrays.num_vertices
 
         sizes = [cb.shape[0] for cb in self.combined_buckets]
@@ -1901,3 +2034,110 @@ class CompactFrontierEngine(BucketedELLEngine):
         return finish_sweep_pair(
             first, used, status2, finish_second, v, self.attempt,
         )
+
+    def _fresh_block_carry(self):
+        """Device-resident attempt-block carry: the best packed-colors row
+        plus the prefix-resume ring. Each slot is a freshly-built array —
+        under DGC_TPU_DONATE_CARRY=1 XLA aliases every donated input to an
+        output buffer, so no two slots may share storage.
+        """
+        # dgc-lint: distinct-buffers
+        v = self.arrays.num_vertices
+        nb = len(self.init_bucket_active)
+        return (jnp.zeros(v + 2, jnp.int32), _empty_rec(v, nb))
+
+    def attempt_block(self, k: int, attempts: int, *,
+                      strict_decrement: bool = False, carry=None,
+                      k_min: int = 1, want_best: bool = False) -> BlockOutcome:
+        """Run up to ``attempts`` chained k-attempts in ONE device call —
+        the minimal-k outer loop's dispatch amortization (PERF.md
+        "Dispatch amortization"). Returns ``engine.fused.BlockOutcome``;
+        drive it with ``engine.minimal_k.find_minimal_coloring(...,
+        attempts_per_dispatch=A)``.
+
+        Per-block host traffic is the ``layout.BK_D2H_SLOTS`` whitelist:
+        the stopping-rule scalars and per-attempt records every call; the
+        packed color rows only at boundary syncs (``want_best``, sweep
+        end, widen fallback); the trajectory stack when recording. The
+        prefix-resume ring and best row stay device-resident in ``carry``
+        (donated under DGC_TPU_DONATE_CARRY=1) — always pass the
+        *returned* carry to the next call and never reuse an older one.
+
+        A STALLED attempt exits the block: its budget re-runs through
+        ``attempt`` (which owns the widen-and-retry loop) and the next
+        block starts from a fresh carry, since widening changes the
+        kernel's static schedule. The decoded attempt sequence — budgets,
+        statuses, supersteps, colors_used — is byte-identical to the
+        sequential driver's in both strict and jump modes (the ring's
+        budget-generic bracket argument; ``_block_kernel_body``).
+        """
+        v = self.arrays.num_vertices
+        a = max(1, int(attempts))
+        if k < 1:
+            res = self._finish(np.full(v, -1, np.int32),
+                               AttemptStatus.FAILURE, 0, k)
+            return BlockOutcome([res], int(k), True, None, None)
+        if carry is None:
+            carry = self._fresh_block_carry()
+        key = ("attempt_block", _DONATE_CARRY)
+        if key not in self._block_kernels:
+            self._block_kernels[key] = (
+                _block_kernel_staged_donated if _DONATE_CARRY
+                else _block_kernel_staged)
+        kern = self._block_kernels[key]
+        out = kern(
+            self.combined_buckets, self.flat_ext, self.degrees, k, k_min,
+            carry[0], carry[1], attempts=a, strict=bool(strict_decrement),
+            **self._traj_kw(), **self._kernel_kw())
+        att = np.asarray(out[layout.BK_ATT])
+        n_att = int(out[layout.BK_N_ATT])
+        k_next = int(out[layout.BK_K_NEXT])
+        done = bool(out[layout.BK_DONE])
+        best_pe = out[layout.BK_BEST]
+        rec = out[layout.BK_REC0:layout.BK_REC0 + layout.BK_N_REC]
+
+        stalled_tail = (n_att > 0
+                        and int(att[n_att - 1, layout.BKC_STATUS])
+                        == int(AttemptStatus.STALLED))
+        n_dec = n_att - 1 if stalled_tail else n_att
+        trajs = None
+        if self.record_trajectory:
+            trajs = decode_block_trajectories(
+                out[layout.BK_TRAJ], att[:, layout.BKC_STEPS], n_dec,
+                unconf_b=True)
+        results: list[AttemptResult] = []
+        for i in range(n_dec):
+            res = BlockAttemptResult(
+                AttemptStatus(int(att[i, layout.BKC_STATUS])), None,
+                int(att[i, layout.BKC_STEPS]), int(att[i, layout.BKC_K]),
+                used=int(att[i, layout.BKC_USED]))
+            if trajs is not None:
+                res.trajectory = trajs[i]
+            results.append(res)
+        if results and not stalled_tail:
+            # the final attempt's colors always come home: a failing row is
+            # the --compat-failed-output row, a sweep-ending success the
+            # result row; intermediate successes stay scalar-only
+            results[-1].colors = self._decode_colors(
+                np.asarray(out[layout.BK_LAST])[:v])
+
+        best_colors = None
+        carry_out = (best_pe, rec)
+        if stalled_tail:
+            # boundary sync before the carry reset: whoever tracks the
+            # best-so-far materializes it now or never (the device best
+            # row dies with the old carry)
+            best_colors = self._decode_colors(np.asarray(best_pe)[:v])
+            k_st = int(att[n_att - 1, layout.BKC_K])
+            res_st = self.attempt(k_st)  # owns the widen-and-retry loop
+            results.append(res_st)
+            if res_st.success:
+                k_next = ((k_st - 1) if strict_decrement
+                          else res_st.colors_used - 1)
+                done = k_next < k_min
+            else:
+                k_next, done = k_st, True
+            carry_out = None
+        elif want_best or done:
+            best_colors = self._decode_colors(np.asarray(best_pe)[:v])
+        return BlockOutcome(results, k_next, done, carry_out, best_colors)

@@ -11,6 +11,7 @@ single-device variant — no collective ``used`` reduction there).
 
 from __future__ import annotations
 
+from dataclasses import dataclass
 from functools import partial
 from typing import Callable
 
@@ -20,6 +21,35 @@ from jax.sharding import PartitionSpec as P
 
 from dgc_tpu.engine.base import AttemptResult, AttemptStatus, empty_budget_failure
 from dgc_tpu.parallel.mesh import VERTEX_AXIS, fetch_global
+
+
+@dataclass
+class BlockOutcome:
+    """One decoded attempt-block dispatch (``attempt_block`` engines —
+    the minimal-k outer loop chained inside a single device call, one
+    level up from the fused pair this module hosts).
+
+    ``results``: the chained attempts in execution order
+    (``base.BlockAttemptResult``; ``colors`` is populated on the final
+    attempt and on any widen-fallback re-run — intermediate successes
+    stay scalar-only until the driver materializes ``best_colors``).
+    ``k_next``: the next budget — after a failure, the *failed* budget
+    (the sequential drivers' checkpoint convention).
+    ``done``: the stopping rule fired inside (or at the edge of) the
+    block.
+    ``carry``: opaque device-resident carry for the next block, or None
+    to start fresh; consumed — and, under DGC_TPU_DONATE_CARRY=1,
+    donated — by the next ``attempt_block`` call, so never reuse an old
+    one.
+    ``best_colors``: the device best row, downloaded only at boundary
+    syncs (checkpointing, sweep end, widen fallback); None otherwise.
+    """
+
+    results: list
+    k_next: int
+    done: bool
+    carry: tuple | None
+    best_colors: object | None = None
 
 _SUCCESS = AttemptStatus.SUCCESS
 _FAILURE = AttemptStatus.FAILURE

@@ -54,6 +54,8 @@ def find_minimal_coloring(
     on_attempt: Callable[[AttemptResult, ValidationResult | None], None] | None = None,
     checkpoint=None,
     post_reduce: Callable | None = None,
+    attempts_per_dispatch: int = 1,
+    on_block: Callable[[int, int], None] | None = None,
 ) -> MinimalColoringResult:
     """Run k-attempts until failure; return minimal count + last valid coloring.
 
@@ -64,7 +66,22 @@ def find_minimal_coloring(
     run are skipped on resume. ``post_reduce(colors) -> colors`` (see
     ``ops.reduce_colors``) is applied to the final coloring; it may only
     preserve validity and lower the count.
+
+    ``attempts_per_dispatch > 1`` routes engines exposing ``attempt_block``
+    through the blocked driver (``_find_minimal_blocked``): up to that many
+    attempts chain inside one device call, amortizing the per-dispatch
+    floor (PERF.md "Dispatch amortization"). The attempt sequence and final
+    coloring are byte-identical to this sequential loop; ``1``/unset takes
+    this loop unchanged. ``on_block(k, attempts)`` fires before each block
+    dispatch (the flight recorder's in-flight span marker).
     """
+    if (int(attempts_per_dispatch) > 1
+            and hasattr(engine, "attempt_block")):
+        return _find_minimal_blocked(
+            engine, initial_k, strict_decrement=strict_decrement,
+            k_min=k_min, validate=validate, on_attempt=on_attempt,
+            checkpoint=checkpoint, post_reduce=post_reduce,
+            attempts=int(attempts_per_dispatch), on_block=on_block)
     t0 = time.perf_counter()
     result = MinimalColoringResult(minimal_colors=None, colors=None)
 
@@ -122,6 +139,98 @@ def find_minimal_coloring(
                 break
             k = next_k
 
+    return _finalize_result(result, best, validate, post_reduce, t0)
+
+
+def _find_minimal_blocked(
+    engine,
+    initial_k: int,
+    *,
+    strict_decrement: bool,
+    k_min: int,
+    validate: Callable | None,
+    on_attempt,
+    checkpoint,
+    post_reduce: Callable | None,
+    attempts: int,
+    on_block,
+) -> MinimalColoringResult:
+    """Blocked minimal-k driver: the outer loop's budgets chain inside
+    ``engine.attempt_block`` dispatches, with host syncs only at block
+    boundaries. Contracts relative to the sequential loop:
+
+    - the attempt sequence (budgets, statuses, supersteps, colors_used),
+      the final coloring, and ``minimal_colors`` are byte-identical — the
+      kernel runs the drivers' budget rules verbatim and the sub-floor
+      stop matches the floor's attempt-dropping behavior;
+    - intermediate successes come back scalar-only
+      (``base.BlockAttemptResult``, ``colors=None``); the best row is
+      materialized from the device at boundary syncs, so ``validate``
+      runs once per materialization (block grain) instead of once per
+      success — same AssertionError trigger, coarser cadence;
+    - ``checkpoint.save`` fires once per block with the final attempt's
+      (next_k, failed) pair — a crash mid-block re-runs one block of
+      deterministic work, a kill at a block boundary resumes exactly;
+    - ``on_attempt`` still fires once per decoded attempt, in order.
+    """
+    t0 = time.perf_counter()
+    result = MinimalColoringResult(minimal_colors=None, colors=None)
+
+    k = initial_k
+    best: AttemptResult | None = None
+    done = False
+    if checkpoint is not None:
+        restored = checkpoint.restore()
+        if restored is not None:
+            k, best, done = restored
+            if best is not None:
+                result.attempts.append(best)
+
+    carry = None
+    while not done and k >= k_min:
+        if on_block is not None:
+            on_block(int(k), int(attempts))
+        out = engine.attempt_block(
+            k, attempts, strict_decrement=strict_decrement, carry=carry,
+            k_min=k_min, want_best=checkpoint is not None)
+        carry = out.carry
+        last = None
+        for res in out.results:
+            result.attempts.append(res)
+            last = res
+            val = None
+            if res.success:
+                best = res
+                if res.colors is not None and validate is not None:
+                    val = validate(res.colors)
+                    if not val.valid:
+                        raise AssertionError(
+                            f"engine produced invalid coloring at k={res.k}: {val}"
+                        )
+            if on_attempt is not None:
+                on_attempt(res, val)
+        if (best is not None and best.colors is None
+                and out.best_colors is not None):
+            # boundary sync: the device best row lands in the tracked best
+            best.colors = out.best_colors
+            if validate is not None:
+                bval = validate(best.colors)
+                if not bval.valid:
+                    raise AssertionError(
+                        f"engine produced invalid coloring at k={best.k}: {bval}"
+                    )
+        if checkpoint is not None:
+            checkpoint.save(k=out.k_next, best=best,
+                            failed=last is not None and not last.success)
+        if last is not None and not last.success:
+            done = True
+        k = out.k_next
+
+    return _finalize_result(result, best, validate, post_reduce, t0)
+
+
+def _finalize_result(result, best, validate, post_reduce, t0):
+    """Shared sweep epilogue: post-reduce + final validation + timing."""
     if best is not None and best.success:
         result.minimal_colors = best.colors_used
         result.swept_colors = best.colors_used

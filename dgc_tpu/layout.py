@@ -121,6 +121,41 @@ SB_N_REC = 5           # ring slots (engine.fused.shard_rec_empty layout)
 SB_TRAJ = 11           # trajectory buffer rides last
 SB_CARRY_LEN = 12
 
+# -- attempt-block kernel output (engine/compact.py `_block_kernel_staged`,
+#    the minimal-k outer loop fused into one dispatch) --------------------
+#
+# (att, n_att, k_next, done,                     -- stopping-rule scalars
+#  best_pe, last_pe,                             -- packed color rows
+#  rec...,                                       -- prefix-resume ring (5)
+#  tstack)                                       -- stacked trajectory buffers
+BK_ATT = 0             # per-attempt scalar records int32[A, BK_ATT_COLS]
+BK_N_ATT = 1           # attempts executed in the block
+BK_K_NEXT = 2          # next budget (the failed budget when done by failure)
+BK_DONE = 3            # the stopping rule fired inside the block
+BK_BEST = 4            # best successful packed colors (device-resident carry)
+BK_LAST = 5            # final attempt's packed colors (the compat output row)
+BK_REC0 = 6            # first prefix-resume ring slot (engine.compact layout)
+BK_N_REC = 5           # ring slots
+BK_TRAJ = 11           # stacked per-attempt trajectory buffers int32[A, cap, C]
+BK_LEN = 12
+
+# per-attempt record row (BK_ATT columns)
+BKC_K = 0              # the attempt's color budget
+BKC_STEPS = 1          # BSP supersteps executed
+BKC_STATUS = 2         # AttemptStatus exit code
+BKC_USED = 3           # colors used (max color + 1; next-budget source)
+BK_ATT_COLS = 4
+
+# block-output host-read whitelist (dgc-lint transfer pass): the ONLY
+# block outputs the driver may materialize per dispatch — the
+# stopping-rule scalars + per-attempt records each block, the packed
+# color rows at boundary syncs (checkpoint / sweep end / widen
+# fallback), and the telemetry stack when recording. The prefix-resume
+# ring (BK_REC0..BK_REC0+BK_N_REC) stays device-resident between blocks
+# (donated under DGC_TPU_DONATE_CARRY=1). Plain literals: BK_ATT,
+# BK_N_ATT, BK_K_NEXT, BK_DONE, BK_BEST, BK_LAST, BK_TRAJ.
+BK_D2H_SLOTS = (0, 1, 2, 3, 4, 5, 11)
+
 # -- trajectory buffer row (obs.kernel, one column per metric) ------------
 COL_ACTIVE = 0         # global active count after the superstep
 COL_FAIL = 1           # failure-predicate flag

@@ -28,6 +28,9 @@ class ObservedEngine:
         # the driver feature-detects the fused path via hasattr(e, "sweep")
         if hasattr(engine, "sweep"):
             self.sweep = self._sweep
+        # likewise for the blocked path (attempts_per_dispatch > 1)
+        if hasattr(engine, "attempt_block"):
+            self.attempt_block = self._attempt_block
 
     def __getattr__(self, name):
         return getattr(self._engine, name)
@@ -44,7 +47,19 @@ class ObservedEngine:
             self._registry.counter(
                 "dgc_engine_calls_total", "attempt/sweep engine calls",
                 kind=kind).inc()
-            results = out if kind == "sweep" else (out,)
+            # the dispatch-amortization observable: one device call per
+            # engine call regardless of how many attempts it chains —
+            # the bench A/B's dispatch-count numerator/denominator
+            self._registry.counter(
+                "dgc_device_dispatches_total",
+                "device dispatches (an attempt-block counts once)",
+            ).inc()
+            if kind == "sweep":
+                results = out
+            elif kind == "attempt_block":
+                results = out.results
+            else:
+                results = (out,)
             for res in results:
                 if res is None:
                     continue
@@ -65,3 +80,8 @@ class ObservedEngine:
 
     def _sweep(self, k0: int):
         return self._observe("sweep", k0, lambda: self._engine.sweep(k0))
+
+    def _attempt_block(self, k: int, attempts: int, **kw):
+        return self._observe(
+            "attempt_block", k,
+            lambda: self._engine.attempt_block(k, attempts, **kw))

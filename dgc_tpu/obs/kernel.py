@@ -234,3 +234,20 @@ def decode_trajectory(buf, supersteps: int | None = None,
             if unconf_b and nb > 0 else None),
         step_us=step_us,
     )
+
+
+def decode_block_trajectories(stack, att_steps, n_att: int,
+                              unconf_b: bool = False) -> list:
+    """Decode an attempt-block kernel's stacked telemetry buffer
+    (int32[A, cap, cols], ``layout.BK_TRAJ``; one per-attempt buffer per
+    chained attempt) into one ``SuperstepTrajectory`` per *executed*
+    attempt: one host transfer, ``n_att`` decodes. ``att_steps`` is the
+    per-attempt superstep column of the block's scalar records
+    (``layout.BKC_STEPS``) — each attempt's truncation flag needs its own
+    final step counter. A prefix-resumed attempt records only its
+    post-resume rows, exactly like the fused pair's confirm leg (the
+    decoder's ``first_step``)."""
+    stack = np.asarray(stack)
+    att_steps = np.asarray(att_steps)
+    return [decode_trajectory(stack[i], int(att_steps[i]), unconf_b=unconf_b)
+            for i in range(int(n_att))]

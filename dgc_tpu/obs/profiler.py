@@ -169,6 +169,8 @@ class _WindowedEngine:
         self._window = window
         if hasattr(engine, "sweep"):
             self.sweep = self._sweep
+        if hasattr(engine, "attempt_block"):
+            self.attempt_block = self._attempt_block
 
     def __getattr__(self, name):
         return getattr(self._engine, name)
@@ -185,3 +187,9 @@ class _WindowedEngine:
 
     def _sweep(self, k0: int):
         return self._call(lambda: self._engine.sweep(k0))
+
+    def _attempt_block(self, k: int, attempts: int, **kw):
+        # one blocked dispatch = one window slot (the window prices
+        # device calls, and the whole block is one)
+        return self._call(
+            lambda: self._engine.attempt_block(k, attempts, **kw))

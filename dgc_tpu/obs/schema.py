@@ -43,6 +43,11 @@ EVENT_SCHEMAS: dict = {
         {"k": "int", "status": "str", "supersteps": "int",
          "colors_used": ("int", "null")},
         {"valid": "bool", "uncolored": "int", "conflicts": "int"}),
+    # device-resident minimal-k: one event per attempt-block dispatch,
+    # BEFORE the kernel is issued — the flight recorder's in-flight
+    # span marker (a hang inside the block dumps with this as the last
+    # engine-facing event, bracketing budgets k .. k-attempts+1)
+    "attempt_block": ({"k": "int", "attempts": "int"}, {}),
     "trajectory": (
         {"k": "int", "active": "list", "fail": "list", "mc": "list",
          "first_step": "int", "truncated": "bool"},

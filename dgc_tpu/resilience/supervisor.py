@@ -181,6 +181,8 @@ class RetryingEngine:
         self._cold = True
         if hasattr(engine, "sweep"):
             self.sweep = self._sweep
+        if hasattr(engine, "attempt_block"):
+            self.attempt_block = self._attempt_block
 
     def __getattr__(self, name):
         return getattr(self._engine, name)
@@ -191,12 +193,22 @@ class RetryingEngine:
     def _sweep(self, k0: int):
         return self._call("sweep", k0, lambda: self._engine.sweep(k0))
 
+    def _attempt_block(self, k: int, attempts: int, **kw):
+        # a block dispatch chains up to ``attempts`` budgets, so the soft
+        # watchdog budget scales with it — the per-attempt deadline the
+        # flag promises, applied to the fat dispatch as a whole
+        return self._call(
+            "attempt_block", k,
+            lambda: self._engine.attempt_block(k, attempts, **kw),
+            timeout_s=self._timeout_s * max(1, int(attempts)))
+
     # -- dispatch -------------------------------------------------------
 
-    def _dispatch(self, fn):
+    def _dispatch(self, fn, timeout_s: float | None = None):
+        t_s = self._timeout_s if timeout_s is None else timeout_s
         if self._cold:
             faults.fault_point("compile", backend=self._backend)
-        if self._timeout_s <= 0:
+        if t_s <= 0:
             faults.fault_point("attempt", backend=self._backend)
             res = fn()
             faults.fault_point("transfer", backend=self._backend)
@@ -221,19 +233,19 @@ class RetryingEngine:
 
         t = threading.Thread(target=worker, daemon=True)
         t.start()
-        if not done.wait(self._timeout_s):
+        if not done.wait(t_s):
             cancelled.set()
             raise AttemptTimeout(
-                f"attempt on {self._backend} exceeded {self._timeout_s:g}s")
+                f"attempt on {self._backend} exceeded {t_s:g}s")
         if "exc" in out:
             raise out["exc"]
         self._cold = False
         return out.get("res")
 
-    def _call(self, kind: str, k: int, fn):
+    def _call(self, kind: str, k: int, fn, timeout_s: float | None = None):
         while True:
             try:
-                return self._dispatch(fn)
+                return self._dispatch(fn, timeout_s=timeout_s)
             except SimulatedKill:
                 raise
             except Exception as e:
@@ -282,6 +294,8 @@ def supervise_sweep(
     rung_state: RungState | None = None,
     flight_recorder=None,
     flightrec_dir: str = ".",
+    attempts_per_dispatch: int = 1,
+    on_block=None,
 ):
     """Run the minimal-k sweep down an engine ladder.
 
@@ -326,7 +340,9 @@ def supervise_sweep(
                 strict_decrement=strict_decrement, k_min=k_min,
                 validate=validate, on_attempt=on_attempt, checkpoint=ckpt,
                 post_reduce=(make_post_reduce(name)
-                             if make_post_reduce is not None else None))
+                             if make_post_reduce is not None else None),
+                attempts_per_dispatch=attempts_per_dispatch,
+                on_block=on_block)
             stats.engine_used = name
             return result, stats
         except SimulatedKill:

@@ -50,13 +50,27 @@ AUTO_DEPTH_CAP = 4
 
 
 def auto_depth(batch_max: int, live: int = 0,
-               cap: int = AUTO_DEPTH_CAP) -> int:
+               cap: int | None = None, k0: int | None = None) -> int:
     """The ``--speculate-k auto`` window depth: the free-lane count the
     scheduler could seat speculation into (``batch_max`` minus the lane
     the driver's own claims occupy and the ``live`` real lanes),
     clamped to ``[1, cap]`` — speculation only helps while free lanes
     are otherwise idle, and the marginal attempt's priced savings decay
-    with depth (see module constant)."""
+    with depth (see module constant).
+
+    The cap defaults to the *priced* survival cap when the sweep's
+    starting budget ``k0`` is known
+    (``utils.schedule_model.speculation_auto_cap`` — the depth where the
+    modeled survival of the d-th decrement stops clearing the value
+    floor), and to the fixed ``AUTO_DEPTH_CAP`` otherwise (the
+    pre-pricing behavior, byte-identical for legacy callers)."""
+    if cap is None:
+        if k0 is not None:
+            from dgc_tpu.utils.schedule_model import speculation_auto_cap
+
+            cap = speculation_auto_cap(int(k0))
+        else:
+            cap = AUTO_DEPTH_CAP
     free = int(batch_max) - 1 - max(0, int(live))
     return max(1, min(int(cap), free if free > 0 else 1))
 

@@ -56,7 +56,12 @@ _SHARDED_KWARGS = {
 _INT_KNOBS = ("flat_cap", "max_ranges", "range_coalesce_pct",
               "hub_uncond_entries",
               "prune_u_min", "prune_u_div", "prune_p_div",
-              "prune_p2_min", "prune_p2_div")
+              "prune_p2_min", "prune_p2_div",
+              # driver knob, not an engine kwarg: how many outer-loop
+              # attempts the minimal-k driver chains per device dispatch
+              # (engine_kwargs never forwards it; the CLI reads it when
+              # --attempts-per-dispatch is unset)
+              "attempts_per_dispatch")
 
 _KNOWN_KEYS = frozenset(
     ("version", "graph_shape_hash", "stages", "hub_prune_overrides",
@@ -138,6 +143,7 @@ class TunedConfig:
     prune_p_div: int | None = None
     prune_p2_min: int | None = None
     prune_p2_div: int | None = None
+    attempts_per_dispatch: int | None = None   # driver knob (see _INT_KNOBS)
     hub_prune_overrides: dict | None = None   # bucket index -> knob dict
     provenance: dict = field(default_factory=dict)
 

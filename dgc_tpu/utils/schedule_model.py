@@ -480,6 +480,91 @@ def price_edge_tail(price: SchedulePrice, traj: Trajectory,
     return best
 
 
+# -- dispatch-amortization pricing (the minimal-k outer loop) -------------
+#
+# Both prices below are *predictions* from a uniform stopping-bracket
+# model, not measurements — the PERF.md prediction-vs-result caveat
+# applies: anything here steers a schedule knob, never a headline number.
+
+# per-device-call floor (PERF.md "Primitive rates"; the serve tier's
+# ``_DISPATCH_OVERHEAD_S["tpu"]`` — duplicated as a plain literal so the
+# pricing model stays importable without the serve tier)
+DISPATCH_OVERHEAD_S = 65e-3
+
+
+def strict_survival_curve(k0: int, k_floor: int = 2,
+                          cap: int = 16) -> tuple:
+    """Modeled survival of the strict-decrement sweep: entry ``d`` (for
+    d = 1..cap) is the probability the sweep, currently at budget ``k0``,
+    still *executes* the attempt at ``k0 − d``. Before any attempt runs,
+    the stopping budget is only bracketed — it lies in [k_floor, k0]
+    (first-fit at k0 = Δ+1 always succeeds; nothing nontrivial colors
+    below 2) — so the curve prices it uniform over the bracket:
+    ``S(d) = max(0, span − d) / span`` with span = k0 − k_floor + 1.
+    Coarse by construction (a prediction, not a measurement), but it is
+    exactly the shape the speculative window and the attempt-block sizing
+    need: linear decay to zero at the bracket edge, instead of a fixed
+    depth pretending every budget survives equally."""
+    span = max(1, int(k0) - int(k_floor) + 1)
+    return tuple(max(0.0, (span - d) / span) for d in range(1, int(cap) + 1))
+
+
+def speculation_auto_cap(k0: int, *, k_floor: int = 2,
+                         value_floor: float = 0.35,
+                         hard_cap: int = 8) -> int:
+    """Priced ``--speculate-k auto`` depth: the deepest speculative budget
+    whose modeled survival (:func:`strict_survival_curve`) clears
+    ``value_floor`` — a speculative lane costs a full attempt's compute,
+    so seating one that survives with lower probability wastes more slice
+    time than the dispatch it hides. Clamped to ``hard_cap`` (the old
+    fixed ``serve.speculate.AUTO_DEPTH_CAP`` bound — lane memory) and
+    floored at 1 (the sequential lane always runs). Deterministic in
+    ``k0``, hence unit-testable."""
+    depth = 0
+    for d, s in enumerate(strict_survival_curve(k0, k_floor, cap=hard_cap),
+                          start=1):
+        if s >= value_floor:
+            depth = d
+    return max(1, min(int(hard_cap), depth))
+
+
+def auto_attempts_per_dispatch(k0: int, *, k_floor: int = 2,
+                               overhead_s: float = DISPATCH_OVERHEAD_S,
+                               compile_s: float = 0.0,
+                               cap: int = 8) -> int:
+    """Price ``--attempts-per-dispatch auto``: chaining A attempts per
+    block turns the sweep's ~E dispatches into ~E/A, saving
+    ``(E − E/A) · overhead_s`` of pure dispatch floor against
+    ``compile_s`` paid once for the fatter program (0 with a warm
+    persistent compile cache — the repo default; the block kernel's outer
+    loop is rolled, so its program is ~the pair kernel's size, not A×).
+    E is the expected attempt count under the same uniform stopping
+    bracket as :func:`strict_survival_curve`: E ≈ (span + 1) / 2.
+
+    Returns the smallest A capturing ≥ 90% of the saturating saving —
+    past that, each extra A only buys tail amortization while costing a
+    distinct kernel specialization — clamped to ``cap`` and to the
+    expected sweep length itself (a block longer than the sweep never
+    fills), or 1 when no A prices positive."""
+    import math
+
+    span = max(1, int(k0) - int(k_floor) + 1)
+    e = (span + 1) / 2.0
+
+    def saved(a: int) -> float:
+        return ((e - e / a) * float(overhead_s)
+                - (float(compile_s) if a > 1 else 0.0))
+
+    hi = max(1, min(int(cap), max(2, int(math.ceil(e)))))
+    best = max(saved(a) for a in range(1, hi + 1))
+    if best <= 0:
+        return 1
+    for a in range(1, hi + 1):
+        if saved(a) >= 0.9 * best:
+            return a
+    return hi
+
+
 def _main(argv=None) -> int:
     """``python -m dgc_tpu.utils.schedule_model`` — replay + price one
     graph and print the attribution table (same graph flags as the

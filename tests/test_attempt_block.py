@@ -1,0 +1,387 @@
+"""Device-resident minimal-k: attempt-block driver tests.
+
+The blocked driver (``find_minimal_coloring(..., attempts_per_dispatch=A)``)
+chains up to A budgets inside one ``engine.attempt_block`` device call.
+Its contract against the sequential loop is byte-identity — same attempt
+sequence (budgets, statuses, supersteps, colors_used), same final colors,
+same ``minimal_colors`` — in both strict and jump modes, with telemetry
+on or off, across a kill at a block boundary, and under the donated-carry
+variant (``DGC_TPU_DONATE_CARRY=1``). These tests pin that contract plus
+the observables the perf claim rests on (``dgc_device_dispatches_total``)
+and the resilience semantics (soft watchdog budget scaled by the block's
+attempt count; the in-flight ``attempt_block`` marker in a flight-recorder
+dump).
+"""
+
+import json
+import os
+import subprocess
+import sys
+
+import numpy as np
+import pytest
+
+from dgc_tpu.engine.base import AttemptStatus, BlockAttemptResult
+from dgc_tpu.engine.compact import CompactFrontierEngine
+from dgc_tpu.engine.minimal_k import (find_minimal_coloring, make_reducer,
+                                      make_validator)
+from dgc_tpu.models.generators import (generate_random_graph_fast,
+                                       generate_rmat_graph)
+
+REPO = os.path.dirname(os.path.dirname(os.path.abspath(__file__)))
+
+
+def _graph(seed=7, n=400, avg=6.0):
+    return generate_random_graph_fast(n, avg_degree=avg, seed=seed)
+
+
+def _sweep(g, *, strict, attempts=1, engine=None, checkpoint=None,
+           on_block=None, validate=True, reduce=True):
+    """One minimal-k sweep; returns (result, attempt tuples)."""
+    eng = engine if engine is not None else CompactFrontierEngine(g)
+    log = []
+    res = find_minimal_coloring(
+        eng, initial_k=g.max_degree + 1, strict_decrement=strict,
+        validate=make_validator(g) if validate else None,
+        on_attempt=lambda r, v: log.append(
+            (int(r.k), r.status.name, int(r.supersteps),
+             int(r.colors_used))),
+        checkpoint=checkpoint,
+        post_reduce=make_reducer(g) if reduce else None,
+        attempts_per_dispatch=attempts, on_block=on_block)
+    return res, log
+
+
+def _key(res, log):
+    return (res.minimal_colors, tuple(log), res.colors.tobytes())
+
+
+# ---------------- parity: the byte-identity contract ----------------
+
+
+@pytest.mark.parametrize("strict", [True, False], ids=["strict", "jump"])
+@pytest.mark.parametrize("attempts", [2, 3, 5])
+def test_block_parity_both_modes(strict, attempts):
+    for seed in (3, 11):
+        g = _graph(seed=seed)
+        want = _key(*_sweep(g, strict=strict, attempts=1))
+        got = _key(*_sweep(g, strict=strict, attempts=attempts))
+        assert got == want
+
+
+def test_block_parity_rmat_long_strict_chain():
+    # RMAT's hub-heavy degree profile gives a long strict chain (initial
+    # k = Δ+1 is far above the stopping budget) — many full blocks plus a
+    # ragged tail, the shape that exercises the in-kernel early exit
+    g = generate_rmat_graph(1500, avg_degree=8, seed=5)
+    want_res, want_log = _sweep(g, strict=True, attempts=1)
+    assert len(want_log) > 12  # the chain must actually be long
+    got = _key(*_sweep(g, strict=True, attempts=4))
+    assert got == _key(want_res, want_log)
+
+
+def test_attempts_one_is_the_sequential_loop():
+    # attempts_per_dispatch=1 (the flag's default) must not even route
+    # through attempt_block — byte-identical results AND the same engine
+    # call pattern as an unflagged run
+    g = _graph(seed=9)
+    calls = []
+
+    class Spy(CompactFrontierEngine):
+        def attempt_block(self, *a, **kw):
+            calls.append("attempt_block")
+            return super().attempt_block(*a, **kw)
+
+    want = _key(*_sweep(g, strict=True, attempts=1))
+    got = _key(*_sweep(g, strict=True, attempts=1, engine=Spy(g)))
+    assert got == want
+    assert calls == []
+
+
+# ---------------- decoded results + scalar-only intermediates --------
+
+
+def test_block_results_are_scalar_until_boundary():
+    g = _graph(seed=4)
+    eng = CompactFrontierEngine(g)
+    out = eng.attempt_block(g.max_degree + 1, 3, strict_decrement=True)
+    assert 1 <= len(out.results) <= 3
+    for res in out.results[:-1]:
+        # intermediate successes come back scalar-only: the colors row
+        # stays device-resident in the carry
+        assert isinstance(res, BlockAttemptResult)
+        assert res.colors is None
+        if res.status is AttemptStatus.SUCCESS:
+            assert res.colors_used == res.used > 0
+
+
+def test_block_attempt_result_colors_used_prefers_used():
+    r = BlockAttemptResult(AttemptStatus.SUCCESS, None, 5, 8, used=6)
+    assert r.colors_used == 6
+    # once the row is materialized, the array (when present) still wins
+    # nothing — `used` is authoritative for block results
+    r2 = BlockAttemptResult(AttemptStatus.SUCCESS,
+                            np.array([0, 1, 2], np.int32), 5, 8, used=3)
+    assert r2.colors_used == 3
+
+
+# ---------------- dispatch-count observable --------------------------
+
+
+def test_block_dispatch_counter_amortizes():
+    from dgc_tpu.obs import MetricsRegistry
+    from dgc_tpu.obs.instrument import ObservedEngine
+
+    g = _graph(seed=6)
+    counts = {}
+    for attempts in (1, 4):
+        reg = MetricsRegistry()
+        eng = ObservedEngine(CompactFrontierEngine(g), registry=reg,
+                             record_trajectory=False)
+        res, log = _sweep(g, strict=True, attempts=attempts, engine=eng)
+        counts[attempts] = dict(
+            key=_key(res, log),
+            dispatches=int(reg.counter("dgc_device_dispatches_total").value),
+            blocks=int(reg.counter("dgc_engine_calls_total",
+                                   kind="attempt_block").value),
+            attempts=int(sum(
+                reg.counter("dgc_attempts_total", status=s).value
+                for s in ("SUCCESS", "FAILURE", "STALLED"))))
+    seq, blk = counts[1], counts[4]
+    assert blk["key"] == seq["key"]
+    assert blk["attempts"] == seq["attempts"] == len(
+        counts[1]["key"][1])
+    assert seq["blocks"] == 0 and blk["blocks"] >= 1
+    # the perf claim's numerator/denominator: one device call per block
+    assert blk["dispatches"] < seq["dispatches"]
+    assert blk["dispatches"] <= -(-seq["dispatches"] // 4) + 1
+
+
+# ---------------- telemetry decode -----------------------------------
+
+
+def test_block_trajectory_decode_per_attempt():
+    g = _graph(seed=8)
+    off = _key(*_sweep(g, strict=True, attempts=3))
+
+    eng = CompactFrontierEngine(g)
+    eng.record_trajectory = True
+    res, log = _sweep(g, strict=True, attempts=3, engine=eng)
+    # telemetry is inert: same attempts, same colors
+    assert _key(res, log) == off
+    # and every decoded attempt carries its own per-superstep trajectory
+    assert len(res.attempts) == len(log)
+    for r in res.attempts:
+        assert r.trajectory is not None
+        if not r.trajectory.truncated:
+            assert len(r.trajectory) + r.trajectory.first_step \
+                == r.supersteps
+
+
+# ---------------- checkpoint: kill at a block boundary ---------------
+
+
+def test_block_checkpoint_boundary_resume():
+    from dgc_tpu.utils.checkpoint import CheckpointManager
+    import tempfile
+
+    g = generate_rmat_graph(1200, avg_degree=6, seed=13)
+    want_res, want_log = _sweep(g, strict=True, attempts=1)
+    assert len(want_log) > 6
+
+    class _Kill(Exception):
+        pass
+
+    with tempfile.TemporaryDirectory() as d:
+        blocks = []
+
+        def killer(k, attempts):
+            blocks.append((k, attempts))
+            if len(blocks) == 2:
+                raise _Kill
+
+        pre_log = []
+        try:
+            find_minimal_coloring(
+                CompactFrontierEngine(g), initial_k=g.max_degree + 1,
+                strict_decrement=True, validate=make_validator(g),
+                on_attempt=lambda r, v: pre_log.append(
+                    (int(r.k), r.status.name, int(r.supersteps),
+                     int(r.colors_used))),
+                checkpoint=CheckpointManager(d),
+                attempts_per_dispatch=3, on_block=killer)
+            pytest.fail("killer never fired: sweep finished in one block")
+        except _Kill:
+            pass
+        assert len(pre_log) == 3  # exactly the first block's attempts
+
+        res2, post_log = _sweep(g, strict=True, attempts=3,
+                                checkpoint=CheckpointManager(d))
+        # the restored best re-enters result.attempts silently (no
+        # on_attempt replay), so the two logs concatenate exactly
+        merged = pre_log + post_log
+        assert (res2.minimal_colors, tuple(merged),
+                res2.colors.tobytes()) == _key(want_res, want_log)
+
+
+# ---------------- donated-carry twin ---------------------------------
+
+
+def test_block_donated_carry_parity():
+    # the donated kernel variant invalidates its input carry buffers, so
+    # it can only be proven in a subprocess where the gate is set at
+    # import time (module-load static, TR005 twin)
+    code = (
+        "import json, sys\n"
+        "sys.path.insert(0, %r)\n"
+        "from dgc_tpu.engine.compact import CompactFrontierEngine\n"
+        "from dgc_tpu.engine.minimal_k import (find_minimal_coloring,\n"
+        "                                      make_validator)\n"
+        "from dgc_tpu.models.generators import generate_random_graph_fast\n"
+        "g = generate_random_graph_fast(500, avg_degree=6.0, seed=21)\n"
+        "log = []\n"
+        "res = find_minimal_coloring(\n"
+        "    CompactFrontierEngine(g), initial_k=g.max_degree + 1,\n"
+        "    strict_decrement=True, validate=make_validator(g),\n"
+        "    on_attempt=lambda r, v: log.append(\n"
+        "        (int(r.k), r.status.name, int(r.supersteps),\n"
+        "         int(r.colors_used))),\n"
+        "    attempts_per_dispatch=4)\n"
+        "print(json.dumps({'mk': res.minimal_colors, 'log': log,\n"
+        "                  'colors': res.colors.tolist()}))\n"
+    ) % REPO
+    outs = {}
+    for donate in ("0", "1"):
+        env = dict(os.environ, JAX_PLATFORMS="cpu",
+                   DGC_TPU_DONATE_CARRY=donate)
+        r = subprocess.run([sys.executable, "-c", code], env=env,
+                           cwd=REPO, capture_output=True, text=True,
+                           timeout=300)
+        assert r.returncode == 0, r.stderr
+        outs[donate] = json.loads(r.stdout.strip().splitlines()[-1])
+    assert outs["1"] == outs["0"]
+    assert outs["1"]["mk"] is not None
+
+
+# ---------------- watchdog: per-attempt budget scales with the block --
+
+
+def test_watchdog_budget_scales_with_block_and_recovers():
+    from dgc_tpu.resilience import faults
+    from dgc_tpu.resilience.faults import FaultPlane, FaultSchedule
+    from dgc_tpu.resilience.supervisor import RetryingEngine, RetryBudget
+
+    g = _graph(seed=5, n=200, avg=5.0)
+    want = _key(*_sweep(g, strict=True, attempts=1))
+    # warm the block kernels first: the soft watchdog times the whole
+    # dispatch, and a cold XLA compile would swamp the hang margins
+    assert _key(*_sweep(g, strict=True, attempts=3)) == want
+
+    # a hang LONGER than the per-attempt budget but SHORTER than the
+    # block-scaled budget must NOT trip the watchdog: the flag promises
+    # a per-attempt deadline, and a 3-attempt block is 3 attempts of work
+    plane = FaultPlane(FaultSchedule.parse("attempt@1=hang:0.6"))
+    with faults.injected(plane):
+        eng = RetryingEngine(CompactFrontierEngine(g), backend="compact",
+                             budget=RetryBudget(2), attempt_timeout_s=0.3)
+        res, log = _sweep(g, strict=True, attempts=3, engine=eng)
+    assert eng.stats.attempt_timeouts == 0
+    assert _key(res, log) == want
+
+    # a hang past even the scaled budget trips it, classifies TRANSIENT,
+    # and the retry (occurrence 2 is off the schedule) recovers exactly
+    plane = FaultPlane(FaultSchedule.parse("attempt@1=hang:5"))
+    with faults.injected(plane):
+        eng = RetryingEngine(CompactFrontierEngine(g), backend="compact",
+                             budget=RetryBudget(2), attempt_timeout_s=0.3)
+        res, log = _sweep(g, strict=True, attempts=3, engine=eng)
+    assert eng.stats.attempt_timeouts == 1
+    assert eng.stats.retries == 1
+    assert _key(res, log) == want
+
+
+def test_flightrec_dump_records_in_flight_block():
+    from dgc_tpu.obs.events import RunLogger
+    from dgc_tpu.obs.flightrec import FlightRecorder
+
+    g = _graph(seed=5, n=200, avg=5.0)
+    logger = RunLogger(jsonl_path=None, echo=False)
+    rec = FlightRecorder(capacity=64)
+    logger.add_sink(rec)
+
+    class _Abort(Exception):
+        pass
+
+    def on_block(k, attempts):
+        # the CLI's marker: emitted BEFORE the kernel is issued, so a
+        # hang inside the block leaves this as the ring's last record
+        logger.event("attempt_block", k=int(k), attempts=int(attempts))
+        if len([1]) and k < g.max_degree + 1:
+            raise _Abort  # simulate the rc-113 abort mid-second-block
+
+    with pytest.raises(_Abort):
+        _sweep(g, strict=True, attempts=2, on_block=on_block)
+
+    text, trailer = rec.render("abort")
+    body = [json.loads(ln) for ln in text.strip().splitlines()]
+    marks = [r for r in body if r.get("event") == "attempt_block"]
+    assert len(marks) == 2
+    assert marks[-1] == body[-2]  # the in-flight block is the dump's tail
+    assert marks[-1]["attempts"] == 2
+    assert marks[-1]["k"] < marks[0]["k"] == g.max_degree + 1
+
+
+# ---------------- pricing: schedule_model + auto depths ---------------
+
+
+def test_strict_survival_curve_shape():
+    from dgc_tpu.utils.schedule_model import strict_survival_curve
+
+    c = strict_survival_curve(13)
+    assert len(c) == 16
+    assert all(0.0 <= s <= 1.0 for s in c)
+    assert all(a >= b for a, b in zip(c, c[1:]))  # monotone decay
+    assert c[-1] == 0.0                           # dead at the bracket edge
+    # degenerate bracket: k0 at the floor has no surviving decrements
+    assert set(strict_survival_curve(2)) == {0.0}
+
+
+def test_speculation_auto_cap_priced_depths():
+    from dgc_tpu.utils.schedule_model import speculation_auto_cap
+
+    assert speculation_auto_cap(17) == 8   # deep bracket saturates hard_cap
+    assert speculation_auto_cap(13) == 7
+    assert speculation_auto_cap(5) == 2
+    assert speculation_auto_cap(3) == 1
+    assert speculation_auto_cap(2) == 1    # floored: sequential lane only
+    # monotone in k0: a wider stopping bracket never prices shallower
+    caps = [speculation_auto_cap(k0) for k0 in range(2, 30)]
+    assert all(a <= b for a, b in zip(caps, caps[1:]))
+
+
+def test_auto_attempts_per_dispatch_pricing():
+    from dgc_tpu.utils.schedule_model import auto_attempts_per_dispatch
+
+    assert auto_attempts_per_dispatch(13) == 5
+    assert auto_attempts_per_dispatch(17) == 5
+    # a compile cost the amortization can't repay prices the flag off
+    assert auto_attempts_per_dispatch(2, compile_s=1.0) == 1
+    for k0 in range(2, 40):
+        a = auto_attempts_per_dispatch(k0)
+        assert 1 <= a <= 8
+
+
+def test_serve_auto_depth_pricing_and_legacy():
+    from dgc_tpu.serve.speculate import AUTO_DEPTH_CAP, auto_depth
+    from dgc_tpu.utils.schedule_model import speculation_auto_cap
+
+    # legacy callers (no k0): byte-identical to the fixed cap
+    assert AUTO_DEPTH_CAP == 4
+    assert auto_depth(16) == 4
+    assert auto_depth(16, live=13) == 2
+    assert auto_depth(2) == 1
+    # k0-aware: the priced survival cap replaces the fixed one
+    assert auto_depth(16, k0=17) == speculation_auto_cap(17) == 8
+    assert auto_depth(16, k0=3) == 1
+    # an explicit cap still wins over both
+    assert auto_depth(16, cap=6, k0=17) == 6

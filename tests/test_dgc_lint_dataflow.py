@@ -112,6 +112,88 @@ def drive(carry, x, flag: bool):
 
 
 # ---------------------------------------------------------------------------
+# dict-subscript kernel-cache laundering (the attempt_block idiom)
+# ---------------------------------------------------------------------------
+
+CACHE_FIXTURE_HEADER = DONATED_FIXTURE_HEADER + '''
+@jax.jit
+def step_plain(carry, x):
+    return carry + x
+'''
+
+
+def test_tr001_dict_subscript_cache_two_step_laundering_fires():
+    """``self._kernels[key] = fn`` then ``kern = self._kernels[key];
+    kern(...)`` — the compile-cache laundering the TR pass now resolves
+    (the engine.compact attempt_block idiom, gated twin selection
+    included)."""
+    src = CACHE_FIXTURE_HEADER + '''
+class Eng:
+    def __init__(self):
+        self._kernels = {}
+
+    def drive(self, carry, x, key):
+        if key not in self._kernels:
+            self._kernels[key] = (step_donated if _DONATE
+                                  else step_plain)
+        kern = self._kernels[key]
+        out = kern(carry, x)
+        return carry.sum() + out      # TR001: carry is dead
+'''
+    got = _transfer([SourceModule("fix/t.py", src)])
+    assert "TR001" in rules_of(got)
+    assert any("carry" in f.detail and "step_donated" in f.detail
+               for f in got)
+
+
+def test_tr001_dict_subscript_cache_direct_call_fires():
+    src = CACHE_FIXTURE_HEADER + '''
+class Eng:
+    def __init__(self):
+        self._kernels = {}
+        self._kernels["a"] = step_donated
+
+    def drive(self, carry, x, key):
+        out = self._kernels[key](carry, x)
+        return carry + out            # TR001: carry is dead
+'''
+    got = _transfer([SourceModule("fix/t.py", src)])
+    assert "TR001" in rules_of(got)
+
+
+def test_tr001_dict_subscript_cache_rebind_is_clean():
+    src = CACHE_FIXTURE_HEADER + '''
+class Eng:
+    def __init__(self):
+        self._kernels = {}
+        self._kernels["a"] = step_donated
+
+    def drive(self, carry, xs, key):
+        kern = self._kernels[key]
+        for x in xs:
+            carry = kern(carry, x)    # rebound every iteration
+        return carry
+'''
+    assert _transfer([SourceModule("fix/t.py", src)]) == []
+
+
+def test_tr001_nondonating_cache_stays_unresolved():
+    """A cache that only ever holds non-donating kernels must not
+    poison anything (no false positives from the new resolution)."""
+    src = CACHE_FIXTURE_HEADER + '''
+class Eng:
+    def __init__(self):
+        self._kernels = {}
+        self._kernels["a"] = step_plain
+
+    def drive(self, carry, x, key):
+        out = self._kernels[key](carry, x)
+        return carry + out            # fine: step_plain donates nothing
+'''
+    assert _transfer([SourceModule("fix/t.py", src)]) == []
+
+
+# ---------------------------------------------------------------------------
 # TR002: distinct allocation sites
 # ---------------------------------------------------------------------------
 
@@ -425,6 +507,53 @@ def test_tr001_mutation_post_donation_read_is_caught():
     got = [f for f in _real_transfer(mut) if f.rule == "TR001"]
     assert got, "seeded post-donation read not caught"
     assert any("seat_lane_kernel" in f.detail for f in got)
+
+
+def _real_compact_transfer(text=None):
+    consts, d2h = _layout()
+    mod = (SourceModule.load(ROOT, "dgc_tpu/engine/compact.py")
+           if text is None
+           else SourceModule("dgc_tpu/engine/compact.py", text))
+    return check_transfer([mod], layout_consts=consts, d2h_slots=d2h)
+
+
+def test_transfer_real_compact_engine_is_clean():
+    """The blocked attempt kernel's donation discipline (device-resident
+    minimal-k) discharges over the real engine/compact.py."""
+    assert _real_compact_transfer() == []
+
+
+def test_tr001_mutation_block_cache_laundered_read_is_caught():
+    """Acceptance against the REAL tree: seed a read of the donated
+    block carry AFTER the laundered kernel-cache call in
+    ``CompactFrontierEngine.attempt_block`` (``kern =
+    self._block_kernels[key]; kern(...)``) — the dict-subscript cache
+    tracking must resolve ``kern`` to the donated twin and flag the
+    read."""
+    real = (ROOT / "dgc_tpu/engine/compact.py").read_text()
+    mut = real.replace(
+        "        kern = self._block_kernels[key]\n"
+        "        out = kern(\n"
+        "            self.combined_buckets, self.flat_ext, self.degrees,"
+        " k, k_min,\n"
+        "            carry[0], carry[1], attempts=a,"
+        " strict=bool(strict_decrement),\n"
+        "            **self._traj_kw(), **self._kernel_kw())\n"
+        "        att = np.asarray(out[layout.BK_ATT])",
+        "        kern = self._block_kernels[key]\n"
+        "        best0 = carry[0]\n"
+        "        out = kern(\n"
+        "            self.combined_buckets, self.flat_ext, self.degrees,"
+        " k, k_min,\n"
+        "            best0, carry[1], attempts=a,"
+        " strict=bool(strict_decrement),\n"
+        "            **self._traj_kw(), **self._kernel_kw())\n"
+        "        att = np.asarray(out[layout.BK_ATT]) + 0 * best0[0]")
+    assert mut != real, "mutation anchor out of sync with compact.py"
+    got = [f for f in _real_compact_transfer(mut) if f.rule == "TR001"]
+    assert got, "laundered post-donation read not caught"
+    assert any("best0" in f.detail
+               and "_block_kernel_staged_donated" in f.detail for f in got)
 
 
 def test_tr003_mutation_unwhitelisted_slot_is_caught():

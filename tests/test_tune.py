@@ -514,6 +514,28 @@ def test_cli_tuned_config_end_to_end(tmp_path):
     assert problems == []
 
 
+def test_cli_tuned_config_carries_blocking_factor(tmp_path):
+    # a tuned artifact may carry attempts_per_dispatch (a driver knob the
+    # engine never sees); with --attempts-per-dispatch unset the CLI reads
+    # it and the run goes through the blocked driver — attempt_block
+    # events in the stream, byte-identical colors
+    from dgc_tpu.cli import main
+
+    base, blk = tmp_path / "base.json", tmp_path / "blk.json"
+    log = tmp_path / "r.jsonl"
+    args = ["--node-count", "60", "--max-degree", "8", "--seed", "2",
+            "--strict-decrement"]
+    assert main([*args, "--output-coloring", str(base)]) == 0
+    rc = main([*args, "--output-coloring", str(blk), "--log-json", str(log),
+               "--tuned-config",
+               _tiny_cfg(tmp_path, attempts_per_dispatch=3)])
+    assert rc == 0
+    events = [json.loads(ln) for ln in log.read_text().splitlines() if ln]
+    blocks = [e for e in events if e["event"] == "attempt_block"]
+    assert blocks and all(e["attempts"] == 3 for e in blocks)
+    assert blk.read_bytes() == base.read_bytes()
+
+
 def test_cli_tuned_config_flags_validated(tmp_path):
     from dgc_tpu.cli import main
 

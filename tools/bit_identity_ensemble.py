@@ -51,6 +51,21 @@ the lane axis sharded over an N-device mesh (the committed
 ``serve_parity.jsonl`` is generated under a forced 8-host-device mesh:
 ``XLA_FLAGS=--xla_force_host_platform_device_count=8``).
 
+``--blocked`` switches to the device-resident minimal-k ensemble
+(``CompactFrontierEngine.attempt_block``): seeded uniform/RMAT draws run
+the UNMODIFIED ``find_minimal_coloring`` sequentially and with
+``attempts_per_dispatch=A`` (A varies across draws), in BOTH strict and
+jump modes, and every leg's colors, minimal count, and full attempt
+tuple sequence (budget, status, supersteps, colors_used) must be
+byte-identical to the sequential driver's. Additional legs per draw:
+telemetry on vs off (the blocked trajectory stack must be inert),
+``attempts_per_dispatch=1`` vs flag-unset (the byte-identical
+passthrough contract), and a kill-at-block-boundary checkpoint resume —
+the sweep is killed after the first block's checkpoint save, resumed
+from disk by a fresh engine, and the concatenated attempt sequence plus
+final colors must equal the uninterrupted sequential run
+(``tools/block_parity.jsonl`` is the committed run).
+
 One JSON line per draw, nonzero exit on any mismatch.
 """
 
@@ -189,6 +204,134 @@ def serve_mode(args) -> int:
     return 1 if bad else 0
 
 
+def blocked_mode(args) -> int:
+    """Device-resident minimal-k ensemble (module docstring)."""
+    import shutil
+    import tempfile
+
+    import numpy as np
+
+    from dgc_tpu.engine.compact import CompactFrontierEngine
+    from dgc_tpu.engine.minimal_k import find_minimal_coloring, make_validator
+    from dgc_tpu.models.generators import (generate_random_graph_fast,
+                                           generate_rmat_graph)
+    from dgc_tpu.utils.checkpoint import CheckpointManager
+
+    class _Kill(Exception):
+        """Simulated crash at a block boundary."""
+
+    def sweep(g, *, strict, attempts=1, telemetry=False, checkpoint=None,
+              kill_after_blocks=None):
+        eng = CompactFrontierEngine(g)
+        if telemetry:
+            eng.record_trajectory = True
+        attempts_seen, blocks = [], [0]
+
+        def on_block(k, a):
+            if kill_after_blocks is not None \
+                    and blocks[0] >= kill_after_blocks:
+                raise _Kill
+            blocks[0] += 1
+
+        res = find_minimal_coloring(
+            eng, initial_k=g.max_degree + 1, strict_decrement=strict,
+            validate=make_validator(g),
+            on_attempt=lambda r, v: attempts_seen.append(
+                (int(r.k), r.status.name, int(r.supersteps),
+                 int(r.colors_used))),
+            checkpoint=checkpoint,
+            attempts_per_dispatch=attempts, on_block=on_block)
+        return res, attempts_seen
+
+    def key(res, attempts_seen):
+        return (res.minimal_colors, attempts_seen,
+                None if res.colors is None else res.colors.tobytes())
+
+    out = open(args.out, "w") if args.out else None
+    bad = 0
+    for i in range(args.draws):
+        seed = args.seed0 + i
+        gen = "rmat" if i % 2 else "uniform"
+        a_per = 2 + i % 4              # A in {2,3,4,5} across the draws
+        t0 = time.perf_counter()
+        g = (generate_random_graph_fast(args.nodes,
+                                        avg_degree=args.avg_degree,
+                                        seed=seed)
+             if gen == "uniform" else
+             generate_rmat_graph(args.nodes, avg_degree=args.avg_degree,
+                                 seed=seed))
+
+        seq_strict = sweep(g, strict=True)
+        blk_strict = sweep(g, strict=True, attempts=a_per)
+        seq_jump = sweep(g, strict=False)
+        blk_jump = sweep(g, strict=False, attempts=a_per)
+        blk_tele = sweep(g, strict=True, attempts=a_per, telemetry=True)
+        one = sweep(g, strict=True, attempts=1)
+
+        # kill-at-block-boundary resume: the driver checkpoints once per
+        # block; kill before the second block dispatches, then resume
+        # from disk with a fresh engine — the concatenated attempt
+        # sequence and the final colors must equal the uninterrupted run
+        ckpt_dir = tempfile.mkdtemp(prefix="dgc_block_ens_")
+        try:
+            pre_attempts = []
+            try:
+                eng = CompactFrontierEngine(g)
+                find_minimal_coloring(
+                    eng, initial_k=g.max_degree + 1, strict_decrement=True,
+                    validate=make_validator(g),
+                    on_attempt=lambda r, v: pre_attempts.append(
+                        (int(r.k), r.status.name, int(r.supersteps),
+                         int(r.colors_used))),
+                    checkpoint=CheckpointManager(ckpt_dir),
+                    attempts_per_dispatch=a_per,
+                    on_block=(lambda k, a, b=[0]:
+                              b.__setitem__(0, b[0] + 1)
+                              if b[0] < 1 else (_ for _ in ()).throw(
+                                  _Kill())))
+                killed = False
+            except _Kill:
+                killed = True
+            res2, post_attempts = sweep(
+                g, strict=True, attempts=a_per,
+                checkpoint=CheckpointManager(ckpt_dir))
+        finally:
+            shutil.rmtree(ckpt_dir, ignore_errors=True)
+        resume_exact = (key(res2, pre_attempts + post_attempts)
+                        == key(*seq_strict)) if killed else None
+
+        checks = {
+            "strict_parity": key(*blk_strict) == key(*seq_strict),
+            "jump_parity": key(*blk_jump) == key(*seq_jump),
+            "telemetry_inert": key(*blk_tele) == key(*blk_strict),
+            "flag_unset_identity": key(*one) == key(*seq_strict),
+            # a sweep short enough to finish in one block has no
+            # boundary to kill at — recorded as null, not a failure
+            "resume_exact": resume_exact,
+        }
+        rec = dict(draw=i, seed=seed, gen=gen, v=g.num_vertices,
+                   max_degree=int(g.max_degree),
+                   attempts_per_dispatch=a_per,
+                   strict_attempts=len(seq_strict[1]),
+                   minimal_colors=seq_strict[0].minimal_colors,
+                   killed_at_boundary=killed,
+                   seconds=round(time.perf_counter() - t0, 2), **checks)
+        line = json.dumps(rec)
+        print(line)
+        if out:
+            out.write(line + "\n")
+        if not all(v is not False for v in checks.values()):
+            bad += 1
+    summary = dict(draws=args.draws, mismatches=bad, mode="blocked",
+                   legs=["strict_parity", "jump_parity", "telemetry_inert",
+                         "flag_unset_identity", "resume_exact"])
+    print(json.dumps(summary))
+    if out:
+        out.write(json.dumps(summary) + "\n")
+        out.close()
+    return 1 if bad else 0
+
+
 def main() -> int:
     p = argparse.ArgumentParser()
     p.add_argument("--nodes", type=int, default=20_000)
@@ -202,6 +345,11 @@ def main() -> int:
     p.add_argument("--serve", action="store_true",
                    help="serving-path ensemble: batched front-end vs the "
                         "single-graph fused sweep (module docstring)")
+    p.add_argument("--blocked", action="store_true",
+                   help="device-resident minimal-k ensemble: blocked "
+                        "(attempts_per_dispatch) vs sequential driver, "
+                        "strict + jump, telemetry on/off, checkpoint "
+                        "resume at a block boundary (module docstring)")
     p.add_argument("--serve-mode", choices=["continuous", "sync"],
                    default="continuous",
                    help="dispatch mode for --serve (default continuous — "
@@ -226,6 +374,8 @@ def main() -> int:
     args = p.parse_args()
     if args.serve:
         return serve_mode(args)
+    if args.blocked:
+        return blocked_mode(args)
 
     import numpy as np
 

@@ -479,6 +479,60 @@ EOF
     echo "ci_checks: speculation smoke FAILED" >&2
     rc=1
   fi
+  # mega-dispatch smoke (device-resident minimal-k): 3-draw parity of
+  # the blocked driver (attempts_per_dispatch=3) against the sequential
+  # sweep in both strict and jump modes, plus the dispatch-count
+  # amortization observable
+  if JAX_PLATFORMS=cpu timeout 300 python - <<'EOF'
+import sys
+sys.path.insert(0, ".")
+import numpy as np
+from dgc_tpu.engine.compact import CompactFrontierEngine
+from dgc_tpu.engine.minimal_k import (find_minimal_coloring,
+                                      make_reducer, make_validator)
+from dgc_tpu.models.generators import generate_random_graph_fast
+from dgc_tpu.obs import MetricsRegistry
+from dgc_tpu.obs.instrument import ObservedEngine
+
+d_seq = d_blk = 0
+for seed in (1, 2, 3):
+    g = generate_random_graph_fast(300 + 60 * seed, avg_degree=5,
+                                   seed=seed)
+    for strict in (True, False):
+        runs = []
+        for attempts in (1, 3):
+            reg = MetricsRegistry()
+            eng = ObservedEngine(CompactFrontierEngine(g), registry=reg,
+                                 record_trajectory=False)
+            attempt_log = []
+            res = find_minimal_coloring(
+                eng, initial_k=g.max_degree + 1, strict_decrement=strict,
+                validate=make_validator(g),
+                on_attempt=lambda r, v: attempt_log.append(
+                    (int(r.k), r.status.name, int(r.supersteps),
+                     int(r.colors_used))),
+                post_reduce=make_reducer(g),
+                attempts_per_dispatch=attempts)
+            disp = int(reg.counter("dgc_device_dispatches_total").value)
+            runs.append((res, attempt_log, disp))
+        (want, want_at, ds), (got, got_at, db) = runs
+        assert got.minimal_colors == want.minimal_colors
+        assert np.array_equal(got.colors, want.colors)
+        assert got_at == want_at, (got_at, want_at)
+        assert db <= ds, (db, ds)
+        if strict:
+            d_seq, d_blk = d_seq + ds, d_blk + db
+# 3-attempt blocks must amortize the strict chains' dispatch count
+assert d_blk < d_seq, (d_blk, d_seq)
+print("ci_checks: mega-dispatch parity 3 draw(s) x {strict,jump}, "
+      "%d -> %d strict dispatches" % (d_seq, d_blk), file=sys.stderr)
+EOF
+  then
+    echo "ci_checks: mega-dispatch smoke OK" >&2
+  else
+    echo "ci_checks: mega-dispatch smoke FAILED" >&2
+    rc=1
+  fi
   rm -rf "$SMOKE_DIR"
 fi
 

@@ -129,6 +129,22 @@ if [ "$battery_rc" -ne 2 ]; then
     --speculate-depth 7 --perf-db PERF_DB.jsonl 2>&1 \
     | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
 
+  # mega-dispatch A/B (ROADMAP 5): the blocked minimal-k driver
+  # (attempts_per_dispatch=4, strict mode) vs the sequential
+  # one-attempt-per-dispatch sweep on the SAME 1M graph. The CPU rows
+  # (PERF.md "Dispatch amortization") already prove parity and the
+  # >=3x dispatch-count reduction, but CPU wall-clock barely moves
+  # because the interpreter overhead per dispatch is microseconds; the
+  # TPU question is the real one: each avoided dispatch saves ~65 ms
+  # of launch + host round-trip, so a 13->4 dispatch strict chain
+  # should recover seconds per sweep. Parity (colors + attempt tuples
+  # incl. colors_used) and the dispatch-ratio floor are asserted
+  # in-run; the record's `dispatches` slot carries the counter A/B.
+  echo "=== mega-dispatch blocked-vs-sequential A/B (1M, A=4) ===" | tee -a /dev/stderr >/dev/null
+  timeout 7200 python bench.py --block-ab --nodes 1000000 \
+    --block-attempts 4 --perf-db PERF_DB.jsonl 2>&1 \
+    | tee -a /dev/stderr | grep '^{' >> "$OUT" || true
+
   # multi-device serve A/B (ROADMAP 2(a)): the same 64-graph stream
   # with the lane axis sharded over every local chip (+shard: Mesh +
   # NamedSharding over the batch axis, per-device occupancy in the
